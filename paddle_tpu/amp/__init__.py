@@ -1,0 +1,148 @@
+"""Automatic mixed precision.
+
+Parity: python/paddle/fluid/contrib/mixed_precision/ (decorator.py:27
+OptimizerWithMixedPrecision, fp16_utils.py rewrite, fp16_lists.py
+black/white lists, dynamic loss scaling).
+
+TPU-native: bfloat16 is the first-class policy (MXU-native, needs NO loss
+scaling — this is where the TPU build beats the reference's fp16
+machinery); fp16+dynamic-loss-scaling is kept for compatibility. Instead
+of rewriting a program's ops through black/white lists, the policy casts
+at the function boundary: params stay fp32 ("master weights",
+ref: decorator.py master-weight logic), compute runs in the chosen
+half dtype, and the loss scaler wraps the grad computation.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Policy", "bfloat16_policy", "float16_policy", "cast_tree",
+    "LossScaler", "decorate", "black_list", "white_list",
+]
+
+# fp16_lists.py parity: ops that must stay fp32 under half policies
+black_list = {"softmax_with_cross_entropy", "cross_entropy", "mean",
+              "layer_norm", "batch_norm", "reduce_sum", "exp", "log"}
+white_list = {"matmul", "mul", "conv2d", "fc"}
+
+
+class Policy:
+    def __init__(self, compute_dtype, param_dtype=jnp.float32,
+                 output_dtype=jnp.float32):
+        self.compute_dtype = compute_dtype
+        self.param_dtype = param_dtype
+        self.output_dtype = output_dtype
+
+
+def bfloat16_policy():
+    return Policy(jnp.bfloat16)
+
+
+def float16_policy():
+    return Policy(jnp.float16)
+
+
+def cast_tree(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+class LossScaler:
+    """Dynamic loss scaling (decorator.py incr/decr_every_n semantics).
+    State is a small pytree so it lives inside the jitted step."""
+
+    def __init__(self, init_loss_scaling=2.0 ** 15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+        self.incr_every_n = incr_every_n_steps
+        self.decr_every_n = decr_every_n_nan_or_inf
+        self.dynamic = use_dynamic_loss_scaling
+        self.init_scale = init_loss_scaling
+
+    def init(self):
+        return {"scale": jnp.float32(self.init_scale),
+                "good": jnp.int32(0), "bad": jnp.int32(0)}
+
+    def scale_loss(self, loss, state):
+        return loss * state["scale"]
+
+    def unscale_and_update(self, grads, state):
+        """Returns (unscaled_grads, grads_finite, new_state)."""
+        inv = 1.0 / state["scale"]
+        grads = jax.tree.map(lambda g: g * inv, grads)
+        finite = jnp.all(jnp.stack(
+            [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)]))
+        if not self.dynamic:
+            return grads, finite, state
+        good = jnp.where(finite, state["good"] + 1, 0)
+        bad = jnp.where(finite, 0, state["bad"] + 1)
+        scale = state["scale"]
+        scale = jnp.where(good >= self.incr_every_n,
+                          scale * self.incr_ratio, scale)
+        good = jnp.where(good >= self.incr_every_n, 0, good)
+        scale = jnp.where(bad >= self.decr_every_n,
+                          jnp.maximum(scale * self.decr_ratio, 1.0), scale)
+        bad = jnp.where(bad >= self.decr_every_n, 0, bad)
+        return grads, finite, {"scale": scale, "good": good, "bad": bad}
+
+
+class OptimizerWithMixedPrecision:
+    """decorate() product: wraps an Optimizer for half-precision training.
+
+    Functional protocol mirrors Optimizer: init(params) / apply_gradients.
+    grads are expected to be computed from a loss scaled by
+    `scaler.scale_loss`; non-finite steps are skipped (params unchanged),
+    matching the reference's update-halting
+    (mixed_precision/decorator.py)."""
+
+    def __init__(self, optimizer, policy=None, scaler=None):
+        self.opt = optimizer
+        self.policy = policy or bfloat16_policy()
+        needs_scaler = self.policy.compute_dtype == jnp.float16
+        self.scaler = scaler or (LossScaler() if needs_scaler else None)
+
+    def init(self, params):
+        st = {"opt": self.opt.init(params)}
+        if self.scaler:
+            st["loss_scale"] = self.scaler.init()
+        return st
+
+    def cast_params(self, params):
+        return cast_tree(params, self.policy.compute_dtype)
+
+    def scale_loss(self, loss, state):
+        if self.scaler:
+            return self.scaler.scale_loss(loss, state["loss_scale"])
+        return loss
+
+    def apply_gradients(self, params, grads, state):
+        grads = cast_tree(grads, jnp.float32)
+        if self.scaler:
+            grads, finite, ls = self.scaler.unscale_and_update(
+                grads, state["loss_scale"])
+            new_p, new_o = self.opt.apply_gradients(params, grads,
+                                                    state["opt"])
+            new_p = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_p, params)
+            sel = lambda n, o: jnp.where(finite, n, o)
+            new_o = jax.tree.map(sel, new_o, state["opt"])
+            return new_p, {"opt": new_o, "loss_scale": ls}
+        new_p, new_o = self.opt.apply_gradients(params, grads, state["opt"])
+        return new_p, {"opt": new_o}
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             use_dynamic_loss_scaling=True, use_bf16=True):
+    """contrib.mixed_precision.decorate parity."""
+    policy = bfloat16_policy() if use_bf16 else float16_policy()
+    scaler = None
+    if not use_bf16:
+        scaler = LossScaler(init_loss_scaling,
+                            use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+    return OptimizerWithMixedPrecision(optimizer, policy, scaler)

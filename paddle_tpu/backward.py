@@ -1,0 +1,5 @@
+"""fluid.backward parity — re-exports the static autodiff entry points."""
+
+from paddle_tpu.static.backward import append_backward, gradients, GRAD_SUFFIX
+
+__all__ = ["append_backward", "gradients", "GRAD_SUFFIX"]

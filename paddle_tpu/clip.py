@@ -1,0 +1,72 @@
+"""Gradient clipping.
+
+Parity: python/paddle/fluid/clip.py (GradientClipByValue,
+GradientClipByNorm, GradientClipByGlobalNorm, ErrorClipByValue) and
+dygraph_grad_clip.py. A clip object transforms a {name: grad} tree; global
+-norm clip is a tree-wide operation, the others are per-tensor.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradientClipByValue", "GradientClipByNorm", "GradientClipByGlobalNorm",
+    "ErrorClipByValue", "set_gradient_clip",
+]
+
+
+class GradientClipBase:
+    def clip_tree(self, grads):
+        """grads: pytree of arrays -> same tree clipped."""
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def clip_tree(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def clip_tree(self, grads):
+        def one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return g * (self.clip_norm / jnp.maximum(n, self.clip_norm))
+        return jax.tree.map(one, grads)
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def clip_tree(self, grads):
+        leaves = jax.tree.leaves(grads)
+        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        return jax.tree.map(lambda g: g * scale, grads)
+
+
+class ErrorClipByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """fluid.clip.set_gradient_clip parity: attach a default clip used by
+    Optimizer.minimize in static mode. Stored on the Program itself (an
+    id()-keyed side table would outlive the program and could mis-apply a
+    stale clip to a recycled id)."""
+    from paddle_tpu.static.program import default_main_program
+    program = program or default_main_program()
+    program._grad_clip = clip
+
+
+def get_gradient_clip(program):
+    return getattr(program, "_grad_clip", None)

@@ -1,0 +1,11 @@
+"""Core data model: dtypes, places, flags, diagnostics, ragged metadata.
+
+TPU-native analog of the reference's layer 0/1
+(paddle/fluid/platform + paddle/fluid/framework core data model).
+"""
+
+from paddle_tpu.core import dtypes
+from paddle_tpu.core import enforce
+from paddle_tpu.core import flags
+from paddle_tpu.core import place
+from paddle_tpu.core import lod

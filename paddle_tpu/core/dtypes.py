@@ -1,0 +1,63 @@
+"""Dtype registry.
+
+Analog of the reference's VarType dtype enum
+(ref: paddle/fluid/framework/framework.proto:105-162) and the software
+float16 type (ref: paddle/fluid/platform/float16.h). On TPU, bfloat16 is
+the first-class reduced-precision type (MXU-native); fp16 is kept for
+compatibility.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+float32 = jnp.float32
+float64 = jnp.float64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+
+_STR_TO_DTYPE = {
+    "float32": float32, "fp32": float32,
+    "float64": float64, "double": float64, "fp64": float64,
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8,
+    "bool": bool_,
+}
+
+FLOATING = (float16, bfloat16, float32, float64)
+INTEGER = (int8, int16, int32, int64, uint8)
+
+
+def convert_dtype(dtype):
+    """Normalize a string/numpy/jnp dtype spec to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key not in _STR_TO_DTYPE:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        return _STR_TO_DTYPE[key]
+    return jnp.dtype(dtype).type
+
+
+def is_floating(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def dtype_name(dtype):
+    return jnp.dtype(dtype).name
+
+
+def numpy_dtype(dtype):
+    return np.dtype(jnp.dtype(convert_dtype(dtype)))

@@ -1,0 +1,80 @@
+"""Framework flag system.
+
+Analog of the reference's three-tier config (ref: SURVEY §5.6): C++ gflags
+exported through env FLAGS_* strings
+(ref: python/paddle/fluid/__init__.py __bootstrap__,
+paddle/fluid/platform/init.cc:39). Here: a typed registry seeded from
+``FLAGS_<name>`` environment variables, mutable at runtime via
+``set_flags`` (same surface as fluid.set_flags).
+"""
+
+import os
+import threading
+
+_lock = threading.Lock()
+_REGISTRY = {}
+
+
+class _Flag:
+    __slots__ = ("name", "value", "type", "help")
+
+    def __init__(self, name, default, help=""):
+        self.name = name
+        self.type = type(default)
+        self.help = help
+        env = os.environ.get("FLAGS_" + name)
+        self.value = self._parse(env) if env is not None else default
+
+    def _parse(self, s):
+        if self.type is bool:
+            return s.lower() in ("1", "true", "yes", "on")
+        return self.type(s)
+
+
+def define_flag(name, default, help=""):
+    with _lock:
+        if name not in _REGISTRY:
+            _REGISTRY[name] = _Flag(name, default, help)
+    return _REGISTRY[name]
+
+
+def get_flag(name):
+    return _REGISTRY[name].value
+
+
+def set_flags(flags_dict):
+    """fluid.set_flags parity: {'FLAGS_x': v} or {'x': v}."""
+    for k, v in flags_dict.items():
+        name = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if name not in _REGISTRY:
+            define_flag(name, v)
+        else:
+            _REGISTRY[name].value = _REGISTRY[name].type(v)
+
+
+class _FlagsView:
+    """Attribute access: flags.paddle_num_threads."""
+
+    def __getattr__(self, name):
+        try:
+            return get_flag(name)
+        except KeyError:
+            raise AttributeError(name)
+
+
+flags = _FlagsView()
+
+# Core flags (analogs of the reference's most-used gflags).
+define_flag("paddle_num_threads", os.cpu_count() or 1,
+            "Host threads for the data pipeline "
+            "(ref: platform/init.cc:39 FLAGS_paddle_num_threads)")
+define_flag("check_nan_inf", False,
+            "Check outputs for nan/inf after each step "
+            "(ref: framework/operator.cc FLAGS_check_nan_inf)")
+define_flag("benchmark", False, "Print per-step timing")
+define_flag("reader_queue_capacity", 64,
+            "Capacity of async feeding queues "
+            "(ref: reader/lod_tensor_blocking_queue.h)")
+define_flag("allocator_strategy", "xla",
+            "Host staging allocator strategy "
+            "(ref: memory/allocation/allocator_strategy.cc:19)")

@@ -1,0 +1,117 @@
+"""Ragged sequence batches — the TPU-native replacement for LoDTensor.
+
+The reference threads variable-length sequence structure through every
+sequence op as offset-based "level of detail" metadata attached to a dense
+tensor (ref: paddle/fluid/framework/lod_tensor.h:110, offset doc :229).
+That representation implies dynamic shapes, which XLA cannot tile onto the
+MXU. The TPU-native design is **dense padding + explicit lengths/segment
+ids** with static shapes:
+
+- ``RaggedBatch``: data padded to [batch, max_len, ...] + ``lengths[batch]``.
+- masks/segment ids derived on demand (``sequence_mask``) and fused by XLA
+  into the consuming op.
+- bucketing-by-length (the padding-waste mitigation) lives in the data
+  pipeline, not the type.
+
+A RaggedBatch is a JAX pytree, so it flows through jit/grad/shard_map.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class RaggedBatch:
+    """Dense-padded batch of variable-length sequences.
+
+    data:    [batch, max_len, ...] padded values
+    lengths: [batch] int32 valid lengths
+    """
+
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = lengths
+
+    # -- pytree protocol ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_list(cls, seqs, max_len=None, dtype=None, pad_value=0):
+        """Build from a python list of per-sequence numpy arrays/lists."""
+        seqs = [np.asarray(s) for s in seqs]
+        lengths = np.array([len(s) for s in seqs], dtype=np.int32)
+        max_len = int(max_len or (lengths.max() if len(seqs) else 0))
+        tail = seqs[0].shape[1:] if seqs else ()
+        dtype = dtype or (seqs[0].dtype if seqs else np.float32)
+        out = np.full((len(seqs), max_len) + tail, pad_value, dtype=dtype)
+        for i, s in enumerate(seqs):
+            out[i, : len(s)] = s[:max_len]
+        return cls(jnp.asarray(out), jnp.asarray(lengths))
+
+    @classmethod
+    def from_lod(cls, flat_data, lod, max_len=None):
+        """Compat shim: build from the reference's (flat values, offsets)
+        representation (ref: lod_tensor.h:229 offset-based LoD)."""
+        flat_data = np.asarray(flat_data)
+        offsets = np.asarray(lod[-1] if isinstance(lod[0], (list, tuple, np.ndarray)) else lod)
+        seqs = [flat_data[offsets[i]: offsets[i + 1]]
+                for i in range(len(offsets) - 1)]
+        return cls.from_list(seqs, max_len=max_len)
+
+    # -- views -------------------------------------------------------------
+    @property
+    def batch_size(self):
+        return self.data.shape[0]
+
+    @property
+    def max_len(self):
+        return self.data.shape[1]
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def mask(self, dtype=jnp.float32):
+        """[batch, max_len] 1/0 validity mask."""
+        pos = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        return (pos < self.lengths[:, None]).astype(dtype)
+
+    def segment_ids(self):
+        """Flat [batch*max_len] ids, padding marked with batch index too —
+        combine with mask for segment reductions."""
+        return jnp.repeat(jnp.arange(self.batch_size, dtype=jnp.int32),
+                          self.max_len)
+
+    def to_lod(self):
+        """Back-compat: (flat concatenated values, offsets)."""
+        lens = np.asarray(self.lengths)
+        data = np.asarray(self.data)
+        flat = np.concatenate([data[i, : lens[i]] for i in range(len(lens))],
+                              axis=0) if len(lens) else data.reshape((0,) + data.shape[2:])
+        offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+        return flat, [offsets.tolist()]
+
+    def __repr__(self):
+        return (f"RaggedBatch(shape={tuple(self.data.shape)}, "
+                f"dtype={self.data.dtype}, lengths={self.lengths})")
+
+
+def sequence_mask(lengths, maxlen=None, dtype=jnp.float32):
+    """fluid.layers.sequence_mask parity (ref: python/paddle/fluid/layers/
+    nn.py sequence_mask)."""
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        raise ValueError("maxlen must be static under jit; pass it explicitly")
+    pos = jnp.arange(maxlen, dtype=lengths.dtype)
+    return (pos[None, :] < lengths[:, None]).astype(dtype)

@@ -1,0 +1,95 @@
+"""Places — device tags.
+
+Analog of platform::Place (ref: paddle/fluid/platform/place.h:26,37,52:
+CPUPlace/CUDAPlace/CUDAPinnedPlace). The TPU-native build replaces
+CUDAPlace with TPUPlace; DeviceContext/stream management collapses into
+XLA's runtime (there is no per-op stream bookkeeping when the whole step is
+one compiled computation), so a Place here simply names a `jax.Device`.
+"""
+
+import functools
+
+import jax
+
+
+class Place:
+    """Base device tag; wraps a jax.Device."""
+
+    device_kind = None
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _matches(d, self)]
+        if not devs:
+            # fall back to any available device (e.g. CPUPlace under
+            # tpu-only or TPUPlace under forced-cpu test runs)
+            devs = jax.local_devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    device_kind = "cpu"
+
+
+class TPUPlace(Place):
+    device_kind = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):  # compat alias: pinned host staging
+    pass
+
+
+def _matches(dev, place):
+    plat = dev.platform.lower()
+    if place.device_kind == "cpu":
+        return plat == "cpu"
+    return plat != "cpu"  # any accelerator counts as the TPU place
+
+
+def is_compiled_with_tpu():
+    return any(d.platform.lower() != "cpu" for d in jax.devices())
+
+
+# fluid compat: code written against the reference checks for CUDA
+def is_compiled_with_cuda():
+    return False
+
+
+def default_place():
+    return TPUPlace(0) if is_compiled_with_tpu() else CPUPlace(0)
+
+
+def device_count():
+    return len(jax.devices())
+
+
+_current = {"device": None}
+
+
+def set_device(device):
+    """'tpu', 'cpu', 'tpu:0' — analog of paddle.set_device."""
+    name, _, idx = device.partition(":")
+    place = CPUPlace(int(idx or 0)) if name == "cpu" else TPUPlace(int(idx or 0))
+    _current["device"] = place
+    return place
+
+
+def get_device():
+    return _current["device"] or default_place()
+
+
+@functools.lru_cache(maxsize=None)
+def local_device_count():
+    return jax.local_device_count()

@@ -1,0 +1,40 @@
+"""Global RNG state.
+
+The reference's random ops are stateful (per-device curand generators,
+seeded by op attr or globally). JAX RNG is functional; this module bridges
+the two: a process-global seed + draw counter that mints fresh
+`jax.random` keys for eager calls, while jitted/static paths thread keys
+explicitly.
+"""
+
+import threading
+
+import jax
+
+_state = threading.local()
+_GLOBAL = {"seed": 0, "counter": 0}
+_lock = threading.Lock()
+
+
+def seed(s):
+    """paddle.seed parity: reset the global generator."""
+    with _lock:
+        _GLOBAL["seed"] = int(s)
+        _GLOBAL["counter"] = 0
+
+
+def next_key():
+    """Mint a fresh PRNG key (eager use only — impure)."""
+    with _lock:
+        k = jax.random.fold_in(jax.random.PRNGKey(_GLOBAL["seed"]),
+                               _GLOBAL["counter"])
+        _GLOBAL["counter"] += 1
+    return k
+
+
+def key_for(op_seed):
+    """Deterministic key for ops that carry their own seed attr (the
+    reference pattern: seed=0 means 'use global')."""
+    if op_seed:
+        return jax.random.PRNGKey(int(op_seed))
+    return next_key()

@@ -1,0 +1,12 @@
+"""Datasets + feeding.
+
+Parity: python/paddle/dataset (mnist, cifar, uci_housing, imdb, …) and
+fluid.data_feeder / fluid.reader.PyReader. Builtin datasets are synthetic
+generators with the reference datasets' shapes/vocab sizes (the reference
+downloads real data at test time; CI here is hermetic — swap in real
+loaders via the same reader contract).
+"""
+
+from paddle_tpu.data import dataset
+from paddle_tpu.data.feeder import DataFeeder, batch_reader
+from paddle_tpu.data.pyreader import PyReader

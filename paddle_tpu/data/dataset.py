@@ -1,0 +1,83 @@
+"""Builtin datasets (synthetic, reference-shaped).
+
+Parity: python/paddle/dataset/{mnist,cifar,uci_housing,imdb,imikolov,
+movielens,…}.py — same reader contract (`train()`/`test()` return
+zero-arg callables yielding tuples), same sample shapes/ranges, but
+deterministic synthetic data so tests are hermetic (the reference
+downloads with md5 caching, dataset/common.py).
+"""
+
+import numpy as np
+
+__all__ = ["mnist", "cifar10", "uci_housing", "imdb", "imikolov"]
+
+
+class _Synthetic:
+    def __init__(self, make_sample, n_train, n_test, seed=7):
+        self._make = make_sample
+        self.n_train = n_train
+        self.n_test = n_test
+        self.seed = seed
+
+    def train(self):
+        def reader():
+            rng = np.random.RandomState(self.seed)
+            for _ in range(self.n_train):
+                yield self._make(rng)
+        return reader
+
+    def test(self):
+        def reader():
+            rng = np.random.RandomState(self.seed + 1)
+            for _ in range(self.n_test):
+                yield self._make(rng)
+        return reader
+
+
+def _mnist_sample(rng):
+    img = rng.uniform(-1, 1, size=(784,)).astype(np.float32)
+    label = rng.randint(0, 10)
+    return img, label
+
+
+mnist = _Synthetic(_mnist_sample, n_train=1024, n_test=256)
+
+
+def _cifar_sample(rng):
+    img = rng.uniform(0, 1, size=(3, 32, 32)).astype(np.float32)
+    label = rng.randint(0, 10)
+    return img.reshape(-1), label
+
+
+cifar10 = _Synthetic(_cifar_sample, n_train=1024, n_test=256)
+
+
+def _housing_sample(rng):
+    x = rng.uniform(-1, 1, size=(13,)).astype(np.float32)
+    w = np.linspace(-0.5, 0.5, 13).astype(np.float32)
+    y = np.array([float(x @ w) + 0.1 * rng.randn()], np.float32)
+    return x, y
+
+
+uci_housing = _Synthetic(_housing_sample, n_train=512, n_test=128)
+
+IMDB_VOCAB = 5147  # matches paddle.dataset.imdb word_dict size order
+
+
+def _imdb_sample(rng):
+    n = rng.randint(8, 100)
+    words = rng.randint(0, IMDB_VOCAB, size=(n,)).astype(np.int64)
+    label = rng.randint(0, 2)
+    return words, label
+
+
+imdb = _Synthetic(_imdb_sample, n_train=512, n_test=128)
+
+IMIKOLOV_VOCAB = 2074
+
+
+def _imikolov_sample(rng):
+    return tuple(rng.randint(0, IMIKOLOV_VOCAB) for _ in range(5))
+
+
+imikolov = _Synthetic(_imikolov_sample, n_train=512, n_test=128)

@@ -1,0 +1,53 @@
+"""DataFeeder — samples → batched device arrays.
+
+Parity: python/paddle/fluid/data_feeder.py (DataFeeder.feed) +
+paddle.batch. Converts a list of sample tuples into named dense arrays
+(ragged fields become RaggedBatch), the TPU feed format.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.lod import RaggedBatch
+
+__all__ = ["DataFeeder", "batch_reader"]
+
+
+def batch_reader(reader, batch_size, drop_last=True):
+    """paddle.batch parity."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_names = [f if isinstance(f, str) else f.name
+                           for f in feed_list]
+        self.feed_vars = [f for f in feed_list
+                          if not isinstance(f, str)]
+
+    def feed(self, iterable):
+        """iterable: list of sample tuples aligned with feed_list.
+        Returns {name: array-or-RaggedBatch}."""
+        cols = list(zip(*iterable))
+        out = {}
+        for name, col in zip(self.feed_names, cols):
+            first = np.asarray(col[0])
+            ragged = any(np.asarray(c).shape != first.shape for c in col)
+            if ragged:
+                out[name] = RaggedBatch.from_list(list(col))
+            else:
+                arr = np.stack([np.asarray(c) for c in col])
+                if arr.dtype == np.float64:
+                    arr = arr.astype(np.float32)
+                out[name] = jnp.asarray(arr)
+        return out

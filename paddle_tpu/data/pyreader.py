@@ -1,0 +1,59 @@
+"""PyReader — async device feeding.
+
+Parity: python/paddle/fluid/reader.py PyReader:46 over
+LoDTensorBlockingQueue (operators/reader/lod_tensor_blocking_queue.h) and
+buffered_reader.cc's async prefetch. TPU-native: a background thread
+converts+transfers batches to device while the step runs — double
+buffering host→HBM (the same overlap the reference gets from
+double_buffer readers).
+"""
+
+import queue
+import threading
+
+import jax
+
+from paddle_tpu.core.flags import get_flag
+
+__all__ = ["PyReader"]
+
+_END = object()
+
+
+class PyReader:
+    def __init__(self, feed_list=None, capacity=None, iterable=True,
+                 return_list=False):
+        self.capacity = capacity or get_flag("reader_queue_capacity")
+        self.feed_list = feed_list
+        self._reader = None
+        self._feeder = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        from paddle_tpu.data.feeder import DataFeeder
+        self._feeder = DataFeeder(self.feed_list or [])
+        self._reader = reader
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._reader = reader
+        self._feeder = None
+
+    def __iter__(self):
+        q = queue.Queue(maxsize=self.capacity)
+
+        def worker():
+            try:
+                for batch in self._reader():
+                    if self._feeder is not None:
+                        batch = self._feeder.feed(batch)
+                    else:
+                        batch = jax.tree.map(jax.device_put, batch)
+                    q.put(batch)
+            finally:
+                q.put(_END)
+
+        threading.Thread(target=worker, daemon=True).start()
+        while True:
+            b = q.get()
+            if b is _END:
+                return
+            yield b

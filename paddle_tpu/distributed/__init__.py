@@ -1,0 +1,10 @@
+"""Distributed user API (fleet) + launcher + sparse path.
+
+Parity: python/paddle/fluid/incubate/fleet (fleet_base.py, role_maker.py,
+collective/__init__.py), paddle.distributed.launch (launch.py:132).
+"""
+
+from paddle_tpu.distributed.role_maker import (
+    RoleMakerBase, PaddleCloudRoleMaker, UserDefinedRoleMaker, Role,
+)
+from paddle_tpu.distributed.fleet import fleet, DistributedStrategy

@@ -1,0 +1,87 @@
+"""Fleet — the distributed-training facade.
+
+Parity: python/paddle/fluid/incubate/fleet/base/fleet_base.py (init,
+is_worker/is_server, distributed_optimizer) + collective impl
+(incubate/fleet/collective/__init__.py:135 CollectiveOptimizer).
+
+TPU-native: `distributed_optimizer` wraps an Optimizer so its
+apply_gradients all-reduces gradients over the "data" mesh axis when
+called inside shard_map, and is a pass-through under full-SPMD jit
+(where XLA inserts the collective from shardings) — the two styles mirror
+the reference's collective transpiler vs ParallelExecutor paths.
+"""
+
+import jax
+
+from paddle_tpu.distributed.role_maker import PaddleCloudRoleMaker
+from paddle_tpu.parallel.collective import all_reduce
+from paddle_tpu.parallel.mesh import DATA_AXIS
+
+__all__ = ["fleet", "DistributedStrategy", "DistributedOptimizer"]
+
+
+class DistributedStrategy:
+    """collective DistributedStrategy parity (subset of knobs that still
+    mean something under XLA)."""
+
+    def __init__(self):
+        self.nccl_comm_num = 1          # kept for API compat; no-op
+        self.use_hierarchical_allreduce = False
+        self.fuse_all_reduce_ops = True  # XLA buckets automatically
+        self.gradient_scale = "avg"      # avg|sum
+
+
+class DistributedOptimizer:
+    def __init__(self, optimizer, strategy=None, axis_name=DATA_AXIS,
+                 in_spmd=True):
+        self.opt = optimizer
+        self.strategy = strategy or DistributedStrategy()
+        self.axis = axis_name
+        self.in_spmd = in_spmd
+
+    def init(self, params):
+        return self.opt.init(params)
+
+    def apply_gradients(self, params, grads, state):
+        if not self.in_spmd:
+            op = "avg" if self.strategy.gradient_scale == "avg" else "sum"
+            grads = jax.tree.map(
+                lambda g: all_reduce(g, op=op, axis_name=self.axis), grads)
+        return self.opt.apply_gradients(params, grads, state)
+
+    def __getattr__(self, k):
+        return getattr(self.opt, k)
+
+
+class _Fleet:
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+
+    def init(self, role_maker=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker()
+        self._role_maker.generate_role()
+        return self
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def is_server(self):
+        return self._role_maker is not None and self._role_maker.is_server()
+
+    def is_first_worker(self):
+        return self._role_maker is None or \
+            self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index() if self._role_maker else 0
+
+    def worker_num(self):
+        return self._role_maker.worker_num() if self._role_maker else 1
+
+    def distributed_optimizer(self, optimizer, strategy=None, **kw):
+        self._strategy = strategy or DistributedStrategy()
+        return DistributedOptimizer(optimizer, self._strategy, **kw)
+
+
+fleet = _Fleet()

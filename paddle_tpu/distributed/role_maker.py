@@ -1,0 +1,84 @@
+"""Role makers — who am I in the job.
+
+Parity: python/paddle/fluid/incubate/fleet/base/role_maker.py
+(RoleMakerBase:30, env-based MultiProcessRoleMaker:106, MPIRoleMaker:146
+— MPI path replaced by the TPU scheduler / jax.distributed).
+"""
+
+import os
+from enum import Enum
+
+import jax
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role(Enum):
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_num = 1
+        self._server_endpoints = []
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def generate_role(self):
+        pass
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var driven role maker (the reference's cloud/launch wiring)."""
+
+    def __init__(self, is_collective=True):
+        super().__init__()
+        self.is_collective = is_collective
+
+    def generate_role(self):
+        if self.is_collective:
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get(
+                "PADDLE_TRAINER_ID", jax.process_index()))
+            self._worker_num = int(os.environ.get(
+                "PADDLE_TRAINERS_NUM", jax.process_count()))
+        else:
+            role = os.environ.get("TRAINING_ROLE", "TRAINER")
+            self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            self._worker_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+            eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+            self._server_endpoints = eps.split(",") if eps else []
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+        self._server_endpoints = server_endpoints or []

@@ -1,0 +1,120 @@
+"""Framework-level user helpers.
+
+Parity targets: python/paddle/fluid/framework.py (unique_name, ParamAttr
+from param_attr.py, Variable), dygraph base (to_variable, no_grad
+ref: python/paddle/fluid/dygraph/base.py).
+"""
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "unique_name", "ParamAttr", "WeightNormParamAttr", "Variable",
+    "to_variable", "no_grad", "grad", "stop_gradient",
+]
+
+_uid = threading.local()
+
+
+class _UniqueNameGenerator:
+    """python/paddle/fluid/unique_name.py parity."""
+
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, prefix):
+        n = self.ids.get(prefix, 0)
+        self.ids[prefix] = n + 1
+        return f"{prefix}_{n}" if n else prefix
+
+    def reset(self):
+        self.ids = {}
+
+
+class _UniqueNameModule:
+    def __init__(self):
+        self._gen = _UniqueNameGenerator()
+
+    def generate(self, prefix):
+        return self._gen(prefix)
+
+    def reset(self):
+        self._gen.reset()
+
+    @contextlib.contextmanager
+    def guard(self):
+        old = self._gen
+        self._gen = _UniqueNameGenerator()
+        try:
+            yield
+        finally:
+            self._gen = old
+
+
+unique_name = _UniqueNameModule()
+
+
+class ParamAttr:
+    """python/paddle/fluid/param_attr.py parity."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, gradient_clip=None,
+                 do_model_average=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.gradient_clip = gradient_clip
+        self.do_model_average = do_model_average
+
+    @staticmethod
+    def to_attr(arg):
+        if arg is None:
+            return ParamAttr()
+        if isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if arg is False:
+            return None
+        # an initializer instance
+        return ParamAttr(initializer=arg)
+
+
+class WeightNormParamAttr(ParamAttr):
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+# Variable is the static-graph symbolic tensor; defined in static.program,
+# re-exported here for fluid.framework parity.
+from paddle_tpu.static.program import Variable  # noqa: E402
+
+
+def to_variable(value, name=None, zero_copy=None):
+    """dygraph.to_variable parity: host array → device array (eager)."""
+    if isinstance(value, jnp.ndarray):
+        return value
+    return jnp.asarray(np.asarray(value))
+
+
+@contextlib.contextmanager
+def no_grad():
+    """dygraph.no_grad parity. Eager JAX doesn't build tapes, so this is a
+    semantic no-op context; provided for API compatibility."""
+    yield
+
+
+def stop_gradient(x):
+    return jax.lax.stop_gradient(x)
+
+
+def grad(fn, argnums=0, has_aux=False):
+    """Expose JAX autodiff under the framework namespace."""
+    return jax.grad(fn, argnums=argnums, has_aux=has_aux)

@@ -1,0 +1,150 @@
+"""Parameter initializers.
+
+Parity target: python/paddle/fluid/initializer.py (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA, Bilinear, NumpyArrayInitializer).
+An initializer is a callable ``(key, shape, dtype) -> array``; in the
+static path it becomes an op in the startup program (the reference runs
+initializer ops there too).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Initializer", "Constant", "ConstantInitializer", "Uniform",
+    "UniformInitializer", "Normal", "NormalInitializer", "TruncatedNormal",
+    "TruncatedNormalInitializer", "Xavier", "XavierInitializer", "MSRA",
+    "MSRAInitializer", "Bilinear", "BilinearInitializer",
+    "NumpyArrayInitializer",
+]
+
+
+def _fans(shape):
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    # fluid convention: fan_in = shape[0]*receptive for conv (IOHW view is
+    # [out,in,h,w]); for 2-D [in, out]
+    fan_in = shape[1] * receptive if len(shape) > 2 else shape[0]
+    fan_out = shape[0] * receptive if len(shape) > 2 else shape[1]
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, key, shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if self.seed:
+            key = jax.random.PRNGKey(self.seed)
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if self.seed:
+            key = jax.random.PRNGKey(self.seed)
+        return self.loc + self.scale * jax.random.normal(key, shape, dtype)
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if self.seed:
+            key = jax.random.PRNGKey(self.seed)
+        return self.loc + self.scale * jax.random.truncated_normal(
+            key, -2.0, 2.0, shape, dtype)
+
+
+class XavierInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = \
+            uniform, fan_in, fan_out, seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if self.seed:
+            key = jax.random.PRNGKey(self.seed)
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return jax.random.uniform(key, shape, dtype, -limit, limit)
+        std = math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class MSRAInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if self.seed:
+            key = jax.random.PRNGKey(self.seed)
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return jax.random.uniform(key, shape, dtype, -limit, limit)
+        std = math.sqrt(2.0 / fi)
+        return std * jax.random.normal(key, shape, dtype)
+
+
+class BilinearInitializer(Initializer):
+    """For upsampling deconv filters (initializer.py Bilinear)."""
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs 4-D weight")
+        f = np.zeros(shape, np.float32)
+        k = shape[3]
+        factor = (k + 1) // 2
+        center = factor - 1.0 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[:k, :k]
+        filt = (1 - abs(og[0] - center) / factor) * \
+               (1 - abs(og[1] - center) / factor)
+        f[range(shape[0]), range(shape[1]) if shape[1] == shape[0] else 0] = filt
+        return jnp.asarray(f, dtype)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, key, shape, dtype=jnp.float32):
+        return jnp.asarray(self.value, dtype).reshape(shape)
+
+
+# fluid-style aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer

@@ -1,0 +1,43 @@
+"""fluid.io parity: checkpoint save/load + inference model export.
+
+Parity: python/paddle/fluid/io.py (save_params:242, save_persistables:475,
+load_params:527, load_persistables:714, save_inference_model:921,
+load_inference_model:1109). Sharded/async checkpoint for SPMD training
+lives in paddle_tpu.io_checkpoint (orbax-style per-host shards).
+"""
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from paddle_tpu.static.io import (
+    save_inference_model, load_inference_model, save_params, load_params,
+    save_persistables, load_persistables,
+)
+
+__all__ = [
+    "save_inference_model", "load_inference_model", "save_params",
+    "load_params", "save_persistables", "load_persistables",
+    "save_pytree", "load_pytree",
+]
+
+
+def save_pytree(tree, path):
+    """Save a params/state pytree (eager path checkpointing — the analog
+    of dygraph/checkpoint.py save_dygraph)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
+    with open(path, "wb") as f:
+        pickle.dump({"treedef": pickle.dumps(treedef),
+                     "leaves": [np.asarray(l) for l in leaves]}, f)
+
+
+def load_pytree(path):
+    import jax.numpy as jnp
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    treedef = pickle.loads(blob["treedef"])
+    return jax.tree.unflatten(treedef, [jnp.asarray(l)
+                                        for l in blob["leaves"]])

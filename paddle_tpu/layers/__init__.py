@@ -1,0 +1,567 @@
+"""fluid.layers parity surface.
+
+Parity: python/paddle/fluid/layers/{nn.py (184 fns), tensor.py,
+control_flow.py, learning_rate_scheduler.py, sequence ops, metric_op.py}.
+
+Every function works in BOTH modes, like the reference's layers do
+(static program building vs dygraph):
+- **eager**: computes immediately via the functional op library
+  (paddle_tpu.ops). Parameterized layers (fc, conv2d, …) additionally
+  work inside an nn module context, collecting params functionally.
+- **static** (inside `program_guard`): appends an op to the current
+  Program and returns a symbolic Variable; output shapes are inferred by
+  `jax.eval_shape` over the same functional implementation — the op's
+  compute IS its shape function, so there is no separate InferShape
+  (ref: framework/shape_inference.h is subsumed).
+"""
+
+import functools
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import initializer as I
+from paddle_tpu import ops as _ops
+from paddle_tpu.core.dtypes import convert_dtype
+from paddle_tpu.core.enforce import EnforceNotMet
+from paddle_tpu.framework import ParamAttr, unique_name
+from paddle_tpu.nn import module as _module
+from paddle_tpu.static.program import (
+    OP_REGISTRY, Variable, default_main_program, default_startup_program,
+    in_static_mode, data,
+)
+from paddle_tpu.layers import learning_rate_scheduler
+from paddle_tpu.layers.learning_rate_scheduler import (
+    noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup,
+)
+
+# ---------------------------------------------------------------------------
+# generic static-dispatch machinery for stateless ops
+# ---------------------------------------------------------------------------
+
+# ops whose leading-N args are tensors (default 1)
+_NARGS = {
+    "elementwise_add": 2, "elementwise_sub": 2, "elementwise_mul": 2,
+    "elementwise_div": 2, "elementwise_min": 2, "elementwise_max": 2,
+    "elementwise_pow": 2, "elementwise_mod": 2, "elementwise_floordiv": 2,
+    "minus": 2, "matmul": 2, "mul": 2, "bmm": 2, "dot": 2,
+    "cross_entropy": 2, "softmax_with_cross_entropy": 2,
+    "sigmoid_cross_entropy_with_logits": 2, "square_error_cost": 2,
+    "smooth_l1": 2, "huber_loss": 2, "log_loss": 2, "hinge_loss": 2,
+    "margin_rank_loss": 3, "rank_loss": 3, "kldiv_loss": 2, "bpr_loss": 2,
+    "cos_sim": 2, "modified_huber_loss": 2, "mse_loss": 2,
+    "teacher_student_sigmoid_loss": 2, "npair_loss": 3,
+    "gather": 2, "gather_nd": 2, "scatter": 3, "scatter_nd_add": 3,
+    "where": 3, "expand_as": 2, "pad_constant_like": 2,
+    "logical_and": 2, "logical_or": 2, "logical_xor": 2,
+    "equal": 2, "not_equal": 2, "less_than": 2, "less_equal": 2,
+    "greater_than": 2, "greater_equal": 2,
+    "accuracy": 2, "auc": 2,
+    "fill_constant": 0, "zeros": 0, "ones": 0, "eye": 0,
+    "linspace": 0, "arange": 0, "gaussian_random": 0, "uniform_random": 0,
+    "truncated_gaussian_random": 0, "randint": 0,
+    "prelu": 2, "conv2d": 2, "conv2d_transpose": 2, "conv3d": 2,
+    "depthwise_conv2d": 2, "embedding": 2,
+}
+
+# ops whose first arg is a LIST of tensors
+_LIST_FIRST = {"concat", "sums", "stack", "multiplex"}
+
+# ops that draw randomness (executor must feed them a key)
+_NEEDS_RNG = {"dropout", "gaussian_random", "uniform_random",
+              "truncated_gaussian_random", "randint", "sampling_id",
+              "random_crop", "shuffle_batch",
+              "uniform_random_batch_size_like",
+              "gaussian_random_batch_size_like"}
+
+_MULTI_OUT = {"topk": 2, "argsort": 2}
+
+
+def _register(name, fn):
+    n_tensor = _NARGS.get(name, 1)
+    listy = name in _LIST_FIRST
+
+    def compute(ins, attrs):
+        xs = ins.get("X", [])
+        attrs = dict(attrs)
+        attrs.pop("_needs_rng", None)
+        if listy:
+            out = fn(list(xs), **attrs)
+        else:
+            out = fn(*xs, **attrs)
+        return {"Out": list(out) if isinstance(out, tuple) else [out]}
+
+    OP_REGISTRY[name] = compute
+    return n_tensor, listy
+
+
+def _sub_dyn(shape, val=2):
+    return tuple(val if (s is None or s == -1) else int(s) for s in shape)
+
+
+def _spec_of(v, val=2):
+    if v.shape is None:
+        raise EnforceNotMet(
+            f"variable '{v.name}' has unknown shape (producer op's shape "
+            f"inference failed: {getattr(v, '_shape_error', 'unknown')})")
+    return jax.ShapeDtypeStruct(_sub_dyn(v.shape, val), v.dtype)
+
+
+def _append_static(name, fn, tensor_vals, attrs, listy):
+    blk = default_main_program().global_block()
+    program = default_main_program()
+    in_names = []
+    specs2, specs3 = [], []
+    had_dyn = False
+    flat = tensor_vals[0] if listy else tensor_vals
+    for tv in flat:
+        if isinstance(tv, Variable):
+            in_names.append(tv.name)
+            specs2.append(_spec_of(tv, 2))
+            specs3.append(_spec_of(tv, 3))
+            if tv.shape and any(s in (-1, None) for s in tv.shape):
+                had_dyn = True
+        else:
+            arr = jnp.asarray(tv)
+            cname = unique_name.generate(f"const_{name}")
+            blk.create_var(name=cname, shape=arr.shape, dtype=arr.dtype,
+                           persistable=False)
+            program._constants[cname] = arr
+            in_names.append(cname)
+            sp = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+            specs2.append(sp)
+            specs3.append(sp)
+
+    eval_attrs = dict(attrs)
+    if name in _NEEDS_RNG:
+        eval_attrs["rng"] = jax.random.PRNGKey(0)
+
+    def infer(specs):
+        if listy:
+            return jax.eval_shape(lambda *xs: fn(list(xs), **eval_attrs),
+                                  *specs)
+        return jax.eval_shape(lambda *xs: fn(*xs, **eval_attrs), *specs)
+
+    # dynamic dims are probed with two substitute sizes (2 and 3): any
+    # output dim that shifts between the probes depends on a dynamic input
+    # dim and is recorded as -1, not a literal
+    shape_error = None
+    legacy_batch_fixup = False
+    try:
+        out_spec = infer(specs2)
+    except Exception as e:  # shape inference failure -> unknown shape
+        out_spec = out_spec3 = None
+        shape_error = f"{type(e).__name__}: {e}"
+    else:
+        try:
+            out_spec3 = infer(specs3) if had_dyn else out_spec
+        except Exception:
+            # op only traces at the first probe size (e.g. a reshape attr
+            # tied to it): fall back to marking just the batch dim dynamic
+            out_spec3 = out_spec
+            legacy_batch_fixup = had_dyn
+
+    n_out = _MULTI_OUT.get(name, 1)
+    outs = []
+
+    def listify(spec):
+        return (list(spec) if isinstance(spec, (tuple, list))
+                else [spec] * n_out if spec is None else [spec])
+
+    out_specs = listify(out_spec)
+    out_specs3 = listify(out_spec3)
+    for i in range(n_out):
+        sp = out_specs[i] if i < len(out_specs) else None
+        sp3 = out_specs3[i] if i < len(out_specs3) else None
+        shape = None
+        dtype = jnp.float32
+        if sp is not None:
+            dtype = sp.dtype
+            shape = [d if sp3 is None or d == sp3.shape[j] else -1
+                     for j, d in enumerate(sp.shape)]
+            if legacy_batch_fixup and shape and shape[0] == 2:
+                shape[0] = -1
+        v = blk.create_var(name=unique_name.generate(f"{name}.out"),
+                           shape=shape, dtype=dtype)
+        if shape is None:
+            v._shape_error = shape_error
+        outs.append(v)
+    op_attrs = dict(attrs)
+    if name in _NEEDS_RNG:
+        op_attrs["_needs_rng"] = True
+    blk.append_op(type=name, inputs={"X": in_names},
+                  outputs={"Out": [v.name for v in outs]}, attrs=op_attrs)
+    return outs[0] if n_out == 1 else tuple(outs)
+
+
+def _has_variable(vals):
+    for v in vals:
+        if isinstance(v, Variable):
+            return True
+        if isinstance(v, (list, tuple)) and any(
+                isinstance(x, Variable) for x in v):
+            return True
+    return False
+
+
+def _dual(name, fn):
+    n_tensor, listy = _register(name, fn)
+    sig = inspect.signature(fn)
+    pnames = list(sig.parameters)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        bound = sig.bind(*args, **kwargs)
+        bound.apply_defaults()
+        vals = bound.arguments
+        if listy:
+            tensor_vals = [list(vals[pnames[0]])]
+            attr_names = pnames[1:]
+        else:
+            tensor_vals = [vals[p] for p in pnames[:n_tensor]]
+            attr_names = pnames[n_tensor:]
+        attrs = {p: vals[p] for p in attr_names
+                 if p in vals and p not in ("name", "rng")
+                 and vals[p] is not inspect.Parameter.empty}
+        if in_static_mode() and _has_variable(
+                tensor_vals[0] if listy else tensor_vals):
+            return _append_static(name, fn, tensor_vals, attrs, listy)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+# auto-wrap every exported functional op
+_EXCLUDE = {"fc_act", "batch_norm", "sequence_mask"}
+_this = globals()
+for _n in dir(_ops):
+    if _n.startswith("_") or _n in _EXCLUDE:
+        continue
+    _f = getattr(_ops, _n)
+    if callable(_f) and getattr(_f, "__module__", "").startswith("paddle_tpu.ops"):
+        _this[_n] = _dual(_n, _f)
+
+# sequence_mask needs maxlen attr; expose directly (works both modes)
+sequence_mask = _dual("sequence_mask", _ops.sequence_mask)
+
+
+# ---------------------------------------------------------------------------
+# parameterized layer functions
+# ---------------------------------------------------------------------------
+def _make_param(prefix, shape, dtype, attr, default_init, trainable=True):
+    """Create a parameter in whichever context is active (static program
+    or nn module frame)."""
+    attr = ParamAttr.to_attr(attr) if attr is not None else ParamAttr()
+    init = attr.initializer or default_init
+    if in_static_mode():
+        blk = default_main_program().global_block()
+        name = attr.name or unique_name.generate(prefix)
+        p = blk.create_parameter(
+            name, shape, dtype, trainable=attr.trainable and trainable,
+            regularizer=attr.regularizer, gradient_clip=attr.gradient_clip,
+            optimize_attr={"learning_rate": attr.learning_rate},
+            initializer=init)
+        sblk = default_startup_program().global_block()
+        if not sblk.has_var(name):
+            sblk.create_parameter(name, shape, dtype, initializer=init)
+            sblk.append_op(
+                type="init_param", inputs={},
+                outputs={"Out": [name]},
+                attrs={"initializer": init, "shape": tuple(shape),
+                       "dtype": np.dtype(dtype).name if not isinstance(dtype, str) else dtype,
+                       "_needs_rng": True})
+        return p
+    if _module.in_module_ctx():
+        return _module.create_parameter(prefix, shape, dtype,
+                                        initializer=init, attr=attr)
+    raise EnforceNotMet(
+        f"parameterized layer needs a Program (use program_guard) or a "
+        f"module context (nn.transform / Layer.init)")
+
+
+def register_op_init_param():
+    def compute(ins, attrs):
+        init = attrs["initializer"]
+        rng = attrs.get("rng", jax.random.PRNGKey(0))
+        return {"Out": [init(rng, tuple(attrs["shape"]),
+                             convert_dtype(attrs["dtype"]))]}
+    OP_REGISTRY["init_param"] = compute
+
+
+register_op_init_param()
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """fluid.layers.create_parameter parity."""
+    default = default_initializer or (
+        I.Constant(0.0) if is_bias else I.Xavier())
+    if attr is None and name is not None:
+        attr = ParamAttr(name=name)
+    return _make_param(name or "param", tuple(shape), convert_dtype(dtype),
+                       attr, default)
+
+
+def create_global_var(shape, value, dtype="float32", persistable=False,
+                      force_cpu=False, name=None):
+    """fluid.layers.create_global_var parity (static only)."""
+    return _make_param(name or "gvar", tuple(shape), convert_dtype(dtype),
+                       ParamAttr(name=name, trainable=False),
+                       I.Constant(value), trainable=False)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid.layers.fc parity (ref: python/paddle/fluid/layers/nn.py fc).
+
+    On TPU this is the canonical MXU op: a flattened matmul + fused bias +
+    fused activation (the reference's separate fc/fused-fc ops collapse
+    into XLA fusion)."""
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    attrs = (list(param_attr) if isinstance(param_attr, (list, tuple))
+             else [param_attr] * len(inputs))
+    out = None
+    for x, pa in zip(inputs, attrs):
+        in_dim = 1
+        for d in x.shape[num_flatten_dims:]:
+            if d in (-1, None):
+                raise EnforceNotMet(
+                    f"fc: flattened input dims must be static, got shape "
+                    f"{x.shape} with num_flatten_dims={num_flatten_dims}")
+            in_dim *= int(d)
+        w = _make_param("fc_w", (in_dim, size), jnp.float32, pa, I.Xavier())
+        o = mul(x, w, x_num_col_dims=num_flatten_dims)
+        out = o if out is None else elementwise_add(out, o)
+    # one shared bias regardless of how many input branches (fluid layout)
+    if bias_attr is not False:
+        b = _make_param("fc_b", (size,), jnp.float32, bias_attr,
+                        I.Constant(0.0))
+        out = elementwise_add(out, b, axis=num_flatten_dims)
+    return _apply_act(out, act)
+
+
+def _apply_act(x, act):
+    if act is None:
+        return x
+    return globals()[act](x)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """fluid.layers.embedding / lookup_table parity. is_sparse/
+    is_distributed are advisory on TPU (see distributed/sparse.py for the
+    host-sharded big-table path)."""
+    w = _make_param("emb_w", tuple(size), convert_dtype(dtype), param_attr,
+                    I.Xavier())
+    pi = padding_idx if padding_idx is None or padding_idx >= 0 \
+        else size[0] + padding_idx
+    return _emb_dispatch(input, w, pi)
+
+
+def _emb_dispatch(input, w, padding_idx):
+    if in_static_mode() and isinstance(input, Variable):
+        return _append_static("embedding", _ops.embedding, [input, w],
+                              {"padding_idx": padding_idx}, False)
+    return _ops.embedding(input, w, padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None, data_format="NCHW"):
+    """fluid.layers.conv2d parity (use_cudnn accepted and ignored — XLA
+    owns kernel choice on TPU)."""
+    c_in = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _make_param("conv2d_w",
+                    (num_filters, c_in // groups) + tuple(fs),
+                    jnp.float32, param_attr, I.MSRA(uniform=False))
+    out = _conv_dispatch("conv2d", _ops.conv2d, input, w,
+                         dict(stride=stride, padding=padding,
+                              dilation=dilation, groups=groups,
+                              data_format=data_format))
+    if bias_attr is not False:
+        b = _make_param("conv2d_b", (num_filters,), jnp.float32, bias_attr,
+                        I.Constant(0.0))
+        out = elementwise_add(out, b, axis=1)
+    return _apply_act(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     use_cudnn=True, name=None):
+    c_in = int(input.shape[1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = _make_param("conv2dT_w", (c_in, num_filters // groups) + tuple(fs),
+                    jnp.float32, param_attr, I.Xavier())
+    out = _conv_dispatch("conv2d_transpose", _ops.conv2d_transpose, input, w,
+                         dict(stride=stride, padding=padding,
+                              dilation=dilation, groups=groups))
+    if bias_attr is not False:
+        b = _make_param("conv2dT_b", (num_filters,), jnp.float32, bias_attr,
+                        I.Constant(0.0))
+        out = elementwise_add(out, b, axis=1)
+    return _apply_act(out, act)
+
+
+def _conv_dispatch(name, fn, input, w, attrs):
+    if in_static_mode() and isinstance(input, Variable):
+        return _append_static(name, fn, [input, w], attrs, False)
+    return fn(input, w, **attrs)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               use_global_stats=False):
+    """fluid.layers.batch_norm parity. Running stats are persistable state:
+    static mode stores them as non-trainable parameters updated by the op;
+    module mode uses nn state."""
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    scale = _make_param("bn_scale", (c,), jnp.float32, param_attr,
+                        I.Constant(1.0))
+    bias = _make_param("bn_bias", (c,), jnp.float32, bias_attr,
+                       I.Constant(0.0))
+    if in_static_mode() and isinstance(input, Variable):
+        mean = _make_param(moving_mean_name or "bn_mean", (c,), jnp.float32,
+                           ParamAttr(name=moving_mean_name, trainable=False),
+                           I.Constant(0.0), trainable=False)
+        var = _make_param(moving_variance_name or "bn_variance", (c,),
+                          jnp.float32,
+                          ParamAttr(name=moving_variance_name,
+                                    trainable=False),
+                          I.Constant(1.0), trainable=False)
+        blk = default_main_program().global_block()
+        out = blk.create_var(name=unique_name.generate("bn.out"),
+                             shape=input.shape, dtype=input.dtype)
+        blk.append_op(
+            type="batch_norm",
+            inputs={"X": [input.name, scale.name, bias.name, mean.name,
+                          var.name]},
+            outputs={"Out": [out.name], "MeanOut": [mean.name],
+                     "VarianceOut": [var.name]},
+            attrs={"epsilon": epsilon, "momentum": momentum,
+                   "is_test": is_test,
+                   "data_layout": data_layout,
+                   "use_global_stats": use_global_stats})
+        return _apply_act(out, act)
+    # module/eager path
+    mean = _module.create_state("bn_mean", (c,), jnp.float32, 0.0)
+    var = _module.create_state("bn_variance", (c,), jnp.float32, 1.0)
+    out, m_out, v_out, _, _ = _ops.batch_norm(
+        input, scale, bias, mean, var, epsilon, momentum, is_test,
+        data_layout, use_global_stats)
+    if not is_test:
+        _module.set_state("bn_mean", m_out)
+        _module.set_state("bn_variance", v_out)
+    return _apply_act(out, act)
+
+
+def _bn_compute(ins, attrs):
+    x, scale, bias, mean, var = ins["X"]
+    out, m_out, v_out, _, _ = _ops.batch_norm(
+        x, scale, bias, mean, var, attrs["epsilon"], attrs["momentum"],
+        attrs["is_test"], attrs["data_layout"], attrs["use_global_stats"])
+    return {"Out": [out], "MeanOut": [m_out], "VarianceOut": [v_out]}
+
+
+OP_REGISTRY["batch_norm"] = _bn_compute
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    flat = 1
+    for s in shape:
+        flat *= s
+    s = _make_param("ln_scale", (flat,), jnp.float32, param_attr,
+                    I.Constant(1.0)) if scale else None
+    b = _make_param("ln_bias", (flat,), jnp.float32, bias_attr,
+                    I.Constant(0.0)) if shift else None
+    tensors = [t for t in (input, s, b) if t is not None]
+    if in_static_mode() and isinstance(input, Variable):
+        attrs = {"begin_norm_axis": begin_norm_axis, "epsilon": epsilon,
+                 "has_scale": s is not None, "has_bias": b is not None}
+        out = _append_static("layer_norm_flex", _ln_flex, tensors, attrs,
+                             False)
+        return _apply_act(out, act)
+    return _apply_act(_ln_flex(*tensors, begin_norm_axis=begin_norm_axis,
+                               epsilon=epsilon, has_scale=s is not None,
+                               has_bias=b is not None), act)
+
+
+def _ln_flex(*tensors, begin_norm_axis=1, epsilon=1e-5, has_scale=True,
+             has_bias=True):
+    it = iter(tensors)
+    x = next(it)
+    s = next(it) if has_scale else None
+    b = next(it) if has_bias else None
+    return _ops.layer_norm(x, s, b, begin_norm_axis, epsilon)
+
+
+_register("layer_norm_flex", _ln_flex)
+_NARGS["layer_norm_flex"] = 3
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    c = int(input.shape[1])
+    s = _make_param("gn_scale", (c,), jnp.float32, param_attr,
+                    I.Constant(1.0))
+    b = _make_param("gn_bias", (c,), jnp.float32, bias_attr,
+                    I.Constant(0.0))
+    if in_static_mode() and isinstance(input, Variable):
+        return _apply_act(
+            _append_static("group_norm_p", _gn_p, [input, s, b],
+                           {"groups": groups, "epsilon": epsilon}, False),
+            act)
+    return _apply_act(_gn_p(input, s, b, groups=groups, epsilon=epsilon),
+                      act)
+
+
+def _gn_p(x, s, b, groups=32, epsilon=1e-5):
+    return _ops.group_norm(x, s, b, groups, epsilon)
+
+
+_register("group_norm_p", _gn_p)
+_NARGS["group_norm_p"] = 3
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    if in_static_mode() and isinstance(input, Variable):
+        return _append_static("softmax", _ops.softmax, [input],
+                              {"axis": axis}, False)
+    return _ops.softmax(input, axis=axis)
+
+
+def mean(x, name=None):
+    if in_static_mode() and isinstance(x, Variable):
+        return _append_static("mean", _ops.mean, [x], {}, False)
+    return _ops.mean(x)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    if in_static_mode() and isinstance(x, Variable):
+        return _append_static(
+            "dropout", _ops.dropout, [x],
+            {"dropout_prob": dropout_prob, "is_test": is_test,
+             "dropout_implementation": dropout_implementation}, False)
+    rng = _module.current_rng() if _module.in_module_ctx() and not is_test \
+        else None
+    return _ops.dropout(x, dropout_prob, is_test, seed,
+                        dropout_implementation, rng=rng)
+
+
+# simple data helpers
+def shape(input):
+    if isinstance(input, Variable):
+        return jnp.array([-1 if s in (None, -1) else s
+                          for s in input.shape], jnp.int32)
+    return _ops.shape(input)

@@ -1,0 +1,109 @@
+"""Learning-rate schedules.
+
+Parity: python/paddle/fluid/layers/learning_rate_scheduler.py (noam_decay,
+exponential_decay, natural_exp_decay, inverse_time_decay,
+polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup).
+
+A schedule is a callable ``step -> lr`` built from jnp ops, traced into
+the compiled train step (the reference materializes a lr Variable updated
+by ops; here the schedule is just math on the step counter inside the same
+XLA computation).
+"""
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = [
+    "noam_decay", "exponential_decay", "natural_exp_decay",
+    "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+    "cosine_decay", "linear_lr_warmup",
+]
+
+
+class Schedule:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, step):
+        return self._fn(jnp.asarray(step, jnp.float32))
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    def fn(step):
+        step = jnp.maximum(step, 1.0)
+        a = step ** -0.5
+        b = step * (warmup_steps ** -1.5)
+        return learning_rate * (d_model ** -0.5) * jnp.minimum(a, b)
+    return Schedule(fn)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    def fn(step):
+        e = step / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate * (decay_rate ** e)
+    return Schedule(fn)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    def fn(step):
+        e = step / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate * jnp.exp(-decay_rate * e)
+    return Schedule(fn)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    def fn(step):
+        e = step / decay_steps
+        if staircase:
+            e = jnp.floor(e)
+        return learning_rate / (1.0 + decay_rate * e)
+    return Schedule(fn)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    def fn(step):
+        if cycle:
+            div = jnp.maximum(jnp.ceil(step / decay_steps), 1.0)
+            ds = decay_steps * div
+        else:
+            ds = decay_steps
+            step = jnp.minimum(step, ds)
+        return ((learning_rate - end_learning_rate)
+                * (1 - step / ds) ** power + end_learning_rate)
+    return Schedule(fn)
+
+
+def piecewise_decay(boundaries, values):
+    bs = jnp.asarray(boundaries, jnp.float32)
+    vs = jnp.asarray(values, jnp.float32)
+
+    def fn(step):
+        idx = jnp.sum((step >= bs).astype(jnp.int32))
+        return vs[idx]
+    return Schedule(fn)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    def fn(step):
+        epoch = jnp.floor(step / step_each_epoch)
+        return learning_rate * 0.5 * (jnp.cos(epoch * math.pi / epochs) + 1)
+    return Schedule(fn)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    base = learning_rate if not isinstance(learning_rate, Schedule) else None
+
+    def fn(step):
+        lr = learning_rate(step) if base is None else base
+        warm = start_lr + (end_lr - start_lr) * (step / warmup_steps)
+        return jnp.where(step < warmup_steps, warm, lr)
+    return Schedule(fn)

@@ -1,0 +1,21 @@
+"""Module/Layer system — the eager (dygraph) API.
+
+Parity: paddle/fluid/imperative (VarBase/Tracer, layer.h:133) +
+python/paddle/fluid/dygraph (Layer base, nn.py layers). On TPU the tracer
+machinery collapses: eager ops ARE dispatched immediately by JAX, and
+autograd is the `grad` transform, not a tape (ref: SURVEY §2.8 note). What
+remains is parameter bookkeeping, which this package provides in the
+functional style JAX needs: `Layer.init(rng, *x) -> (params, state)` /
+`Layer.apply(params, state, rng, *x) -> (out, new_state)`, with a
+haiku-like implicit collection context so layer code reads imperatively.
+"""
+
+from paddle_tpu.nn.module import (
+    Layer, transform, create_parameter, create_state, get_state,
+    set_state, in_module_ctx, current_rng, Sequential, LayerList,
+)
+from paddle_tpu.nn.layers import (
+    Linear, FC, Conv2D, Conv2DTranspose, Pool2D, BatchNorm, LayerNorm,
+    GroupNorm, InstanceNorm, Embedding, Dropout, PRelu, GRUUnit, LSTMCell,
+    GRUCell, SpectralNorm, NCE, BilinearTensorProduct,
+)

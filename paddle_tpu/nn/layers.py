@@ -1,0 +1,407 @@
+"""Standard layers (dygraph parity).
+
+Parity: python/paddle/fluid/dygraph/nn.py (Conv2D, Pool2D, FC, BatchNorm,
+Embedding, GRUUnit, LayerNorm, NCE, PRelu, BilinearTensorProduct,
+Conv2DTranspose, GroupNorm, SpectralNorm, TreeConv) — TreeConv deferred.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import initializer as I
+from paddle_tpu import ops
+from paddle_tpu.nn.module import (
+    Layer, create_parameter, create_state, current_rng, set_state, _frame,
+)
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("linear")
+        self.input_dim, self.output_dim = input_dim, output_dim
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.act, self.dtype = act, dtype
+
+    def forward(self, x):
+        w = create_parameter("w", (self.input_dim, self.output_dim),
+                             self.dtype, attr=self.param_attr)
+        out = jnp.matmul(x, w)
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.output_dim,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b
+        return ops.fc_act(out, self.act)
+
+
+FC = Linear
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("conv2d")
+        self.num_channels, self.num_filters = num_channels, num_filters
+        self.filter_size = filter_size if isinstance(filter_size, (tuple, list)) \
+            else (filter_size, filter_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.param_attr, self.bias_attr, self.act = param_attr, bias_attr, act
+        self.dtype = dtype
+
+    def forward(self, x):
+        w = create_parameter(
+            "w", (self.num_filters, self.num_channels // self.groups)
+            + tuple(self.filter_size), self.dtype,
+            initializer=I.MSRA(uniform=False), attr=self.param_attr)
+        out = ops.conv2d(x, w, self.stride, self.padding, self.dilation,
+                         self.groups)
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.num_filters,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b.reshape(1, -1, 1, 1)
+        return ops.fc_act(out, self.act)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("conv2d_transpose")
+        self.num_channels, self.num_filters = num_channels, num_filters
+        self.filter_size = filter_size if isinstance(filter_size, (tuple, list)) \
+            else (filter_size, filter_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.param_attr, self.bias_attr, self.act = param_attr, bias_attr, act
+        self.dtype = dtype
+
+    def forward(self, x):
+        w = create_parameter(
+            "w", (self.num_channels, self.num_filters // self.groups)
+            + tuple(self.filter_size), self.dtype,
+            initializer=I.Xavier(), attr=self.param_attr)
+        out = ops.conv2d_transpose(x, w, self.stride, self.padding,
+                                   self.dilation, self.groups)
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.num_filters,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b.reshape(1, -1, 1, 1)
+        return ops.fc_act(out, self.act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=2, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True):
+        super().__init__("pool2d")
+        self.kw = dict(pool_size=pool_size, pool_type=pool_type,
+                       pool_stride=pool_stride, pool_padding=pool_padding,
+                       global_pooling=global_pooling, ceil_mode=ceil_mode,
+                       exclusive=exclusive)
+
+    def forward(self, x):
+        return ops.pool2d(x, **self.kw)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 data_layout="NCHW", use_global_stats=False,
+                 trainable_statistics=False, dtype=jnp.float32):
+        super().__init__("batch_norm")
+        self.c = num_channels
+        self.act, self.is_test = act, is_test
+        self.momentum, self.epsilon = momentum, epsilon
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.data_layout = data_layout
+        self.use_global_stats = use_global_stats
+        self.dtype = dtype
+
+    def forward(self, x, is_test=None):
+        is_test = self.is_test if is_test is None else is_test
+        scale = create_parameter("scale", (self.c,), self.dtype,
+                                 initializer=I.Constant(1.0),
+                                 attr=self.param_attr)
+        bias = create_parameter("bias", (self.c,), self.dtype,
+                                initializer=I.Constant(0.0),
+                                attr=self.bias_attr)
+        mean = create_state("mean", (self.c,), self.dtype, 0.0)
+        var = create_state("variance", (self.c,), self.dtype, 1.0)
+        out, mean_out, var_out, _, _ = ops.batch_norm(
+            x, scale, bias, mean, var, self.epsilon, self.momentum,
+            is_test=is_test, data_layout=self.data_layout,
+            use_global_stats=self.use_global_stats)
+        if not is_test:
+            set_state("mean", mean_out)
+            set_state("variance", var_out)
+        return ops.fc_act(out, self.act)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+                 dtype=jnp.float32):
+        super().__init__("layer_norm")
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.ns = tuple(normalized_shape)
+        self.scale, self.shift = scale, shift
+        self.epsilon, self.act, self.dtype = epsilon, act, dtype
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+
+    def forward(self, x):
+        s = create_parameter("scale", self.ns, self.dtype,
+                             initializer=I.Constant(1.0),
+                             attr=self.param_attr) if self.scale else None
+        b = create_parameter("bias", self.ns, self.dtype,
+                             initializer=I.Constant(0.0),
+                             attr=self.bias_attr) if self.shift else None
+        out = ops.layer_norm(x, s, b,
+                             begin_norm_axis=x.ndim - len(self.ns),
+                             epsilon=self.epsilon)
+        return ops.fc_act(out, self.act)
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("group_norm")
+        self.c, self.g, self.epsilon = channels, groups, epsilon
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.act, self.dtype = act, dtype
+
+    def forward(self, x):
+        s = create_parameter("scale", (self.c,), self.dtype,
+                             initializer=I.Constant(1.0), attr=self.param_attr)
+        b = create_parameter("bias", (self.c,), self.dtype,
+                             initializer=I.Constant(0.0), attr=self.bias_attr)
+        return ops.fc_act(
+            ops.group_norm(x, s, b, self.g, self.epsilon), self.act)
+
+
+class InstanceNorm(Layer):
+    def __init__(self, channels, epsilon=1e-5, dtype=jnp.float32):
+        super().__init__("instance_norm")
+        self.c, self.epsilon, self.dtype = channels, epsilon, dtype
+
+    def forward(self, x):
+        s = create_parameter("scale", (self.c,), self.dtype,
+                             initializer=I.Constant(1.0))
+        b = create_parameter("bias", (self.c,), self.dtype,
+                             initializer=I.Constant(0.0))
+        return ops.instance_norm(x, s, b, self.epsilon)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype=jnp.float32):
+        super().__init__("embedding")
+        self.size = tuple(size)
+        self.padding_idx = padding_idx
+        self.param_attr, self.dtype = param_attr, dtype
+        self.is_sparse = is_sparse  # advisory on TPU (gather either way)
+
+    def forward(self, ids):
+        w = create_parameter("w", self.size, self.dtype,
+                             initializer=I.Xavier(), attr=self.param_attr)
+        return ops.embedding(ids, w, self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__("dropout")
+        self.p = p
+        self.impl = dropout_implementation
+
+    def forward(self, x, is_test=False):
+        if is_test or self.p == 0.0:
+            return ops.dropout(x, self.p, is_test=True,
+                               dropout_implementation=self.impl)
+        return ops.dropout(x, self.p, rng=current_rng(),
+                           dropout_implementation=self.impl)
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype=jnp.float32):
+        super().__init__("prelu")
+        self.mode, self.channel, self.input_shape = mode, channel, input_shape
+        self.param_attr, self.dtype = param_attr, dtype
+
+    def forward(self, x):
+        if self.mode == "all":
+            shape = (1,)
+        elif self.mode == "channel":
+            shape = (self.channel or x.shape[1],)
+        else:
+            shape = tuple(self.input_shape or x.shape[1:])
+        a = create_parameter("alpha", shape, self.dtype,
+                             initializer=I.Constant(0.25),
+                             attr=self.param_attr)
+        return ops.prelu(x, a, self.mode)
+
+
+class GRUUnit(Layer):
+    """dygraph/nn.py GRUUnit parity (gate_activation sigmoid, candidate
+    tanh; update semantics of gru_unit_op.cc)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype=jnp.float32):
+        super().__init__("gru_unit")
+        self.hidden = size // 3
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.activation, self.gate_activation = activation, gate_activation
+        self.origin_mode = origin_mode
+        self.dtype = dtype
+
+    def forward(self, input, hidden):
+        d = self.hidden
+        w = create_parameter("w", (d, d * 3), self.dtype,
+                             attr=self.param_attr)
+        b = create_parameter("b", (d * 3,), self.dtype,
+                             initializer=I.Constant(0.0),
+                             attr=self.bias_attr) \
+            if self.bias_attr is not False else 0.0
+        x = input + b
+        xu, xr, xc = x[:, :d], x[:, d:2 * d], x[:, 2 * d:]
+        hu, hr = hidden @ w[:, :d], hidden @ w[:, d:2 * d]
+        gact = getattr(ops, self.gate_activation)
+        act = getattr(ops, self.activation)
+        u = gact(xu + hu)
+        r = gact(xr + hr)
+        c = act(xc + (r * hidden) @ w[:, 2 * d:])
+        if self.origin_mode:
+            h = u * hidden + (1 - u) * c
+        else:
+            h = (1 - u) * hidden + u * c
+        return h
+
+
+class LSTMCell(Layer):
+    """Basic LSTM cell (cudnn_lstm_op / lstm_unit_op.cc semantics)."""
+
+    def __init__(self, hidden_size, input_size, param_attr=None,
+                 bias_attr=None, forget_bias=1.0, dtype=jnp.float32):
+        super().__init__("lstm_cell")
+        self.h, self.i = hidden_size, input_size
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.forget_bias = forget_bias
+        self.dtype = dtype
+
+    def forward(self, input, pre_hidden, pre_cell):
+        w = create_parameter("w", (self.i + self.h, 4 * self.h), self.dtype,
+                             attr=self.param_attr)
+        b = create_parameter("b", (4 * self.h,), self.dtype,
+                             initializer=I.Constant(0.0),
+                             attr=self.bias_attr)
+        gates = jnp.concatenate([input, pre_hidden], axis=-1) @ w + b
+        i, f, c, o = jnp.split(gates, 4, axis=-1)
+        new_cell = (jax.nn.sigmoid(f + self.forget_bias) * pre_cell
+                    + jax.nn.sigmoid(i) * jnp.tanh(c))
+        new_hidden = jax.nn.sigmoid(o) * jnp.tanh(new_cell)
+        return new_hidden, new_cell
+
+
+class GRUCell(Layer):
+    def __init__(self, hidden_size, input_size, dtype=jnp.float32):
+        super().__init__("gru_cell")
+        self.h, self.i, self.dtype = hidden_size, input_size, dtype
+
+    def forward(self, input, pre_hidden):
+        wx = create_parameter("wx", (self.i, 3 * self.h), self.dtype)
+        wh = create_parameter("wh", (self.h, 3 * self.h), self.dtype)
+        b = create_parameter("b", (3 * self.h,), self.dtype,
+                             initializer=I.Constant(0.0))
+        gx = input @ wx + b
+        gh = pre_hidden @ wh
+        xu, xr, xc = jnp.split(gx, 3, axis=-1)
+        hu, hr, hc = jnp.split(gh, 3, axis=-1)
+        u = jax.nn.sigmoid(xu + hu)
+        r = jax.nn.sigmoid(xr + hr)
+        c = jnp.tanh(xc + r * hc)
+        return (1 - u) * pre_hidden + u * c
+
+
+class SpectralNorm(Layer):
+    """spectral_norm_op.cc parity via power iteration on apply."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype=jnp.float32):
+        super().__init__("spectral_norm")
+        self.shape = tuple(weight_shape)
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        self.dtype = dtype
+
+    def forward(self, weight):
+        w = jnp.moveaxis(weight, self.dim, 0).reshape(self.shape[self.dim], -1)
+        h, wdim = w.shape
+        u = create_state("u", (h,), self.dtype, 1.0)
+        v = create_state("v", (wdim,), self.dtype, 1.0)
+        for _ in range(self.power_iters):
+            v = w.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = w @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        set_state("u", jax.lax.stop_gradient(u))
+        set_state("v", jax.lax.stop_gradient(v))
+        sigma = u @ w @ v
+        return weight / sigma
+
+
+class NCE(Layer):
+    """nce_op.cc parity (sampled softmax / noise-contrastive estimation;
+    uniform sampler, training loss only)."""
+
+    def __init__(self, num_total_classes, dim, num_neg_samples=10,
+                 param_attr=None, bias_attr=None, dtype=jnp.float32):
+        super().__init__("nce")
+        self.n, self.dim = num_total_classes, dim
+        self.k = num_neg_samples
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.dtype = dtype
+
+    def forward(self, input, label):
+        w = create_parameter("w", (self.n, self.dim), self.dtype,
+                             attr=self.param_attr)
+        b = create_parameter("b", (self.n,), self.dtype,
+                             initializer=I.Constant(0.0),
+                             attr=self.bias_attr)
+        label = jnp.asarray(label).reshape(-1)
+        bsz = input.shape[0]
+        neg = jax.random.randint(current_rng(), (bsz, self.k), 0, self.n)
+        pos_logit = jnp.sum(input * w[label], axis=-1) + b[label]
+        neg_logit = jnp.einsum("bd,bkd->bk", input, w[neg]) + b[neg]
+        p = 1.0 / self.n
+        pos_loss = -jax.nn.log_sigmoid(pos_logit - jnp.log(self.k * p))
+        neg_loss = -jnp.sum(
+            jnp.log1p(-jax.nn.sigmoid(neg_logit - jnp.log(self.k * p))
+                      + 1e-12), axis=-1)
+        return (pos_loss + neg_loss)[:, None]
+
+
+class BilinearTensorProduct(Layer):
+    """bilinear_tensor_product_op.cc parity."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("bilinear_tensor_product")
+        self.d1, self.d2, self.out = input1_dim, input2_dim, output_dim
+        self.param_attr, self.bias_attr, self.act = param_attr, bias_attr, act
+        self.dtype = dtype
+
+    def forward(self, x, y):
+        w = create_parameter("w", (self.out, self.d1, self.d2), self.dtype,
+                             attr=self.param_attr)
+        out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.out,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b
+        return ops.fc_act(out, self.act)

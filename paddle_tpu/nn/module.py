@@ -1,0 +1,239 @@
+"""Parameter-collection core for the Layer system.
+
+Implicit-context functional modules: during `init`/`apply` a frame holds
+the parameter and state dicts keyed by slash-joined scope names
+(`fc_0/w`). Layer code calls `create_parameter` imperatively; the frame
+makes it pure. This replaces the reference's Scope-owned parameters
+(framework/scope.h) for the eager path.
+"""
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import EnforceNotMet
+from paddle_tpu import initializer as I
+
+_tls = threading.local()
+
+
+def _frames():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+class _Frame:
+    def __init__(self, mode, params=None, state=None, rng=None):
+        self.mode = mode                      # "init" | "apply"
+        self.params = dict(params or {})
+        self.state = dict(state or {})
+        self.rng = rng
+        self.name_stack = []
+        self._name_counts = [{}]
+
+    def scoped_name(self, name):
+        return "/".join(self.name_stack + [name])
+
+    def next_rng(self):
+        if self.rng is None:
+            from paddle_tpu.core import random as ptrandom
+            return ptrandom.next_key()
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+    @contextlib.contextmanager
+    def scope(self, name):
+        counts = self._name_counts[-1]
+        n = counts.get(name, 0)
+        counts[name] = n + 1
+        self.name_stack.append(f"{name}_{n}" if n else name)
+        self._name_counts.append({})
+        try:
+            yield
+        finally:
+            self.name_stack.pop()
+            self._name_counts.pop()
+
+
+def in_module_ctx():
+    return bool(_frames())
+
+
+def _frame():
+    if not _frames():
+        raise EnforceNotMet(
+            "create_parameter called outside a module context — call the "
+            "layer through .init()/.apply() or inside nn.transform")
+    return _frames()[-1]
+
+
+def current_rng():
+    return _frame().next_rng()
+
+
+def create_parameter(name, shape, dtype=jnp.float32, initializer=None,
+                     attr=None):
+    """Create/fetch a parameter in the current frame.
+
+    `attr` is a ParamAttr; its initializer/name override the defaults
+    (param_attr.py parity)."""
+    from paddle_tpu.framework import ParamAttr
+    attr = ParamAttr.to_attr(attr) if attr is not None else None
+    if attr is None and isinstance(initializer, ParamAttr):
+        attr, initializer = initializer, None
+    if attr is not None:
+        if attr.initializer is not None:
+            initializer = attr.initializer
+        if attr.name:
+            name = attr.name
+    initializer = initializer or I.Xavier()
+    f = _frame()
+    full = f.scoped_name(name)
+    if full not in f.params:
+        if f.mode != "init":
+            raise EnforceNotMet(
+                f"Parameter {full!r} missing at apply time — params dict "
+                f"doesn't match the module structure")
+        f.params[full] = initializer(f.next_rng(), tuple(shape),
+                                     jnp.dtype(dtype).type)
+    return f.params[full]
+
+
+def create_state(name, shape, dtype=jnp.float32, init_value=0.0):
+    """Non-trainable carried state (batch-norm running stats — the analog
+    of the reference's persistable-but-not-Parameter vars)."""
+    f = _frame()
+    full = f.scoped_name(name)
+    if full not in f.state:
+        if f.mode != "init":
+            raise EnforceNotMet(f"State {full!r} missing at apply time")
+        f.state[full] = jnp.full(tuple(shape), init_value,
+                                 jnp.dtype(dtype).type)
+    return f.state[full]
+
+
+def get_state(name):
+    f = _frame()
+    return f.state.get(f.scoped_name(name))
+
+
+def set_state(name, value):
+    f = _frame()
+    f.state[f.scoped_name(name)] = value
+
+
+class Layer:
+    """dygraph.Layer parity: subclass and implement forward()."""
+
+    def __init__(self, name_scope=None):
+        self._scope_name = name_scope or type(self).__name__.lower()
+        self._sublayers = {}
+
+    def __setattr__(self, k, v):
+        if isinstance(v, Layer):
+            self.__dict__.setdefault("_sublayers", {})[k] = v
+        super().__setattr__(k, v)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        if not in_module_ctx():
+            raise EnforceNotMet(
+                f"{type(self).__name__} called outside a module context — "
+                f"use .init(rng, ...) then .apply(params, state, ...)")
+        with _frame().scope(self._scope_name):
+            return self.forward(*args, **kwargs)
+
+    # -- functional entry points ------------------------------------------
+    def init(self, rng, *args, **kwargs):
+        """Returns (params, state)."""
+        f = _Frame("init", rng=rng)
+        _frames().append(f)
+        try:
+            self(*args, **kwargs)
+        finally:
+            _frames().pop()
+        return f.params, f.state
+
+    def apply(self, params, state, rng, *args, **kwargs):
+        """Returns (out, new_state)."""
+        f = _Frame("apply", params=params, state=state, rng=rng)
+        _frames().append(f)
+        try:
+            out = self(*args, **kwargs)
+        finally:
+            _frames().pop()
+        return out, f.state
+
+    def sublayers(self):
+        return list(self._sublayers.values())
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        self._layers = []
+        for i, l in enumerate(layers):
+            setattr(self, f"l{i}", l)
+            self._layers.append(l)
+
+    def forward(self, x):
+        for l in self._layers:
+            x = l(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, layers=()):
+        super().__init__()
+        self._layers = []
+        for i, l in enumerate(layers):
+            setattr(self, f"l{i}", l)
+            self._layers.append(l)
+
+    def append(self, l):
+        setattr(self, f"l{len(self._layers)}", l)
+        self._layers.append(l)
+
+    def __iter__(self):
+        return iter(self._layers)
+
+    def __getitem__(self, i):
+        return self._layers[i]
+
+    def __len__(self):
+        return len(self._layers)
+
+    def forward(self, *a, **k):
+        raise EnforceNotMet("LayerList is a container; call its members")
+
+
+def transform(fn):
+    """haiku-style: wrap a function using create_parameter into
+    (init, apply) pair."""
+    class _T:
+        @staticmethod
+        def init(rng, *args, **kwargs):
+            f = _Frame("init", rng=rng)
+            _frames().append(f)
+            try:
+                fn(*args, **kwargs)
+            finally:
+                _frames().pop()
+            return f.params, f.state
+
+        @staticmethod
+        def apply(params, state, rng, *args, **kwargs):
+            f = _Frame("apply", params=params, state=state, rng=rng)
+            _frames().append(f)
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                _frames().pop()
+            return out, f.state
+
+    return _T()

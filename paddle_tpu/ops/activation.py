@@ -1,0 +1,146 @@
+"""Activation ops.
+
+Parity target: operators/activation_op.cc (sigmoid, logsigmoid, relu,
+gelu, tanh, tanh_shrink, softplus, softsign, brelu, leaky_relu, soft_relu,
+elu, relu6, stanh, hard_sigmoid, swish, thresholded_relu, hard_shrink…)
+plus softmax_op.cc, maxout_op.cc, prelu_op.cc, selu_op.cc.
+
+All are VPU-friendly elementwise maps; XLA fuses them into adjacent
+matmuls/convs, which is the TPU answer to the reference's
+fused_elemwise_activation op (operators/fused/).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "relu", "relu6", "leaky_relu", "prelu", "elu", "selu", "gelu",
+    "sigmoid", "logsigmoid", "hard_sigmoid", "tanh", "tanh_shrink",
+    "softplus", "softsign", "softshrink", "hard_shrink", "brelu",
+    "soft_relu", "stanh", "swish", "hard_swish", "thresholded_relu",
+    "maxout", "softmax", "log_softmax", "mish",
+]
+
+
+def relu(x, name=None):
+    return jnp.maximum(jnp.asarray(x), 0)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return jnp.clip(jnp.asarray(x), 0, threshold)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x > 0, x, alpha * x)
+
+
+def prelu(x, weight, mode="all", name=None):
+    """prelu_op.cc parity; mode all|channel|element."""
+    x = jnp.asarray(x)
+    w = jnp.asarray(weight)
+    if mode == "channel" and w.ndim == 1:
+        w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, w * x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(jnp.asarray(x), alpha)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = jnp.asarray(x)
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(jnp.asarray(x), approximate=approximate)
+
+
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(jnp.asarray(x))
+
+
+def logsigmoid(x, name=None):
+    return jax.nn.log_sigmoid(jnp.asarray(x))
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return jnp.clip(slope * jnp.asarray(x) + offset, 0.0, 1.0)
+
+
+def tanh(x, name=None):
+    return jnp.tanh(jnp.asarray(x))
+
+
+def tanh_shrink(x, name=None):
+    x = jnp.asarray(x)
+    return x - jnp.tanh(x)
+
+
+def softplus(x, name=None):
+    return jax.nn.softplus(jnp.asarray(x))
+
+
+def softsign(x, name=None):
+    x = jnp.asarray(x)
+    return x / (1 + jnp.abs(x))
+
+
+def softshrink(x, alpha=0.5, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x > alpha, x - alpha, jnp.where(x < -alpha, x + alpha, 0.0))
+
+
+def hard_shrink(x, threshold=0.5, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return jnp.clip(jnp.asarray(x), t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    x = jnp.clip(jnp.asarray(x), -threshold, threshold)
+    return jnp.log1p(jnp.exp(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * jnp.asarray(x))
+
+
+def swish(x, beta=1.0, name=None):
+    x = jnp.asarray(x)
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    x = jnp.asarray(x)
+    return x * jnp.clip(x + offset, 0, threshold) / scale
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    x = jnp.asarray(x)
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def mish(x, name=None):
+    x = jnp.asarray(x)
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    """maxout_op.cc parity: channel axis split into groups, max over group."""
+    x = jnp.asarray(x)
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def softmax(x, axis=-1, name=None):
+    return jax.nn.softmax(jnp.asarray(x), axis=axis)
+
+
+def log_softmax(x, axis=-1, name=None):
+    return jax.nn.log_softmax(jnp.asarray(x), axis=axis)

@@ -1,0 +1,123 @@
+"""Structured control flow.
+
+Parity targets: operators/controlflow/ (while_op.cc,
+conditional_block_op.cc), layers/control_flow.py (While:630, IfElse:1564,
+Switch:1436, StaticRNN:280, DynamicRNN:1700).
+
+The reference interprets sub-blocks op-by-op under While/cond; under XLA
+control flow must be structured primitives traced once
+(lax.while_loop/cond/scan — no data-dependent Python control flow inside
+jit). DynamicRNN/StaticRNN map onto `scan` with masking for ragged
+sequences.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.lod import RaggedBatch
+
+__all__ = [
+    "cond", "case", "switch_case", "while_loop", "scan", "static_rnn",
+    "dynamic_rnn",
+]
+
+
+def cond(pred, true_fn, false_fn, operands=()):
+    """conditional_block / layers.cond parity."""
+    return lax.cond(pred, lambda ops: true_fn(*ops),
+                    lambda ops: false_fn(*ops), operands)
+
+
+def case(pred_fn_pairs, default=None):
+    """layers.case parity: first true predicate wins."""
+    preds = [p for p, _ in pred_fn_pairs]
+    fns = [f for _, f in pred_fn_pairs]
+    if default is None:
+        default = fns[-1]
+        preds, fns = preds[:-1], fns[:-1]
+
+    def build(i):
+        if i == len(preds):
+            return default()
+        return lax.cond(preds[i], fns[i], lambda: build(i + 1))
+
+    return build(0)
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    """layers.switch_case parity."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map branch_index onto dense positions
+        idx = jnp.sum(jnp.stack(
+            [jnp.where(branch_index == k, i, 0) for i, k in enumerate(keys)]))
+        matched = jnp.any(jnp.stack(
+            [branch_index == k for k in keys]))
+        if default is not None:
+            fns = fns + [default]
+            idx = jnp.where(matched, idx, len(fns) - 1)
+        return lax.switch(idx, fns)
+    fns = list(branch_fns)
+    if default is not None:
+        fns.append(default)
+        branch_index = jnp.clip(branch_index, 0, len(fns) - 1)
+    return lax.switch(branch_index, fns)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """layers.while_loop parity over lax.while_loop."""
+    single = not isinstance(loop_vars, (tuple, list))
+    vars_ = (loop_vars,) if single else tuple(loop_vars)
+
+    def body(vs):
+        out = body_fn(*vs)
+        return (out,) if single else tuple(out)
+
+    out = lax.while_loop(lambda vs: cond_fn(*vs), body, vars_)
+    return out[0] if single else list(out)
+
+
+def scan(f, init, xs, reverse=False):
+    return lax.scan(f, init, xs, reverse=reverse)
+
+
+def static_rnn(step_fn, inputs, initial_state):
+    """StaticRNN parity: inputs [B, T, ...] unrolled via scan (time major
+    internally). step_fn(state, x_t) -> (new_state, out_t)."""
+    xs = jnp.swapaxes(inputs, 0, 1)  # [T, B, ...]
+    final, outs = lax.scan(step_fn, initial_state, xs)
+    return final, jnp.swapaxes(outs, 0, 1)
+
+
+def dynamic_rnn(step_fn, inputs, initial_state):
+    """DynamicRNN parity over ragged input: state freezes past each row's
+    length (so final state == state at the last valid step, matching the
+    reference's shrink-memory semantics,
+    ref: operators/shrink_rnn_memory_op.cc)."""
+    if not isinstance(inputs, RaggedBatch):
+        raise TypeError("dynamic_rnn expects a RaggedBatch")
+    data, lengths = inputs.data, inputs.lengths
+    xs = jnp.swapaxes(data, 0, 1)  # [T, B, ...]
+    tsteps = data.shape[1]
+
+    def body(carry, inp):
+        t, state = carry
+        x_t = inp
+        new_state, out_t = step_fn(state, x_t)
+        alive = (t < lengths)
+
+        def sel(new, old):
+            m = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(m, new, old)
+
+        state = jax.tree.map(sel, new_state, state)
+        out_t = jax.tree.map(
+            lambda o: jnp.where(
+                alive.reshape((-1,) + (1,) * (o.ndim - 1)), o, 0), out_t)
+        return (t + 1, state), out_t
+
+    (_, final), outs = lax.scan(body, (jnp.int32(0), initial_state), xs)
+    outs = jax.tree.map(lambda o: jnp.swapaxes(o, 0, 1), outs)
+    return final, RaggedBatch(outs, lengths)

@@ -1,0 +1,187 @@
+"""Loss ops.
+
+Parity targets: cross_entropy_op.cc, softmax_with_cross_entropy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, squared_l2_distance_op.cc,
+smooth_l1_loss_op.cc, huber_loss_op.cc, log_loss_op.cc, hinge_loss_op.cc,
+margin_rank_loss_op.cc, rank_loss_op.cc, kldiv_loss_op.cc, bpr_loss_op.cc,
+cos_sim_op.cc, modified_huber_loss_op.cc, npair? (absent), mse (square_error),
+teacher_student_sigmoid_loss_op.cc, center_loss (absent in this rev).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost",
+    "smooth_l1", "huber_loss", "log_loss", "hinge_loss",
+    "margin_rank_loss", "rank_loss", "kldiv_loss", "bpr_loss", "cos_sim",
+    "modified_huber_loss", "mse_loss", "teacher_student_sigmoid_loss",
+    "npair_loss",
+]
+
+
+def _squeeze_label(label):
+    label = jnp.asarray(label)
+    if label.ndim and label.shape[-1] == 1:
+        return label[..., 0]
+    return label
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100,
+                  name=None):
+    """cross_entropy_op.cc parity: input is a probability distribution
+    (post-softmax). Returns [..., 1] like the reference."""
+    eps = 1e-12
+    if soft_label:
+        loss = -jnp.sum(label * jnp.log(input + eps), axis=-1, keepdims=True)
+        return loss
+    lab = _squeeze_label(label)
+    picked = jnp.take_along_axis(input, lab[..., None].astype(jnp.int32),
+                                 axis=-1)
+    loss = -jnp.log(picked + eps)
+    if ignore_index >= 0:
+        loss = jnp.where(lab[..., None] == ignore_index, 0.0, loss)
+    return loss
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False,
+                               axis=-1, name=None):
+    """softmax_with_cross_entropy_op.cc parity — numerically-stable fused
+    form (the op exists in the reference precisely because composing
+    softmax+CE is unstable; here XLA fuses the stable logsumexp form)."""
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = jnp.asarray(label)
+        # label is logits-shaped with the class axis of size 1, or has the
+        # class axis dropped entirely; normalize to the former
+        if lab.ndim != logp.ndim:
+            lab = jnp.expand_dims(lab, axis)
+        labx = lab.astype(jnp.int32)
+        loss = -jnp.take_along_axis(logp, labx, axis=axis)
+        if ignore_index >= 0:
+            loss = jnp.where(labx == ignore_index, 0.0, loss)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      normalize=False, name=None):
+    """sigmoid_cross_entropy_with_logits_op.cc parity."""
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    valid = (label != ignore_index)
+    loss = jnp.where(valid, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return loss
+
+
+def square_error_cost(input, label, name=None):
+    return jnp.square(input - label)
+
+
+def mse_loss(input, label):
+    return jnp.mean(jnp.square(input - label))
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=1.0,
+              name=None):
+    """smooth_l1_loss_op.cc parity; returns [N, 1] summed over trailing dims."""
+    sigma2 = sigma * sigma
+    diff = x - y
+    if inside_weight is not None:
+        diff = diff * inside_weight
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * diff * diff,
+                     ad - 0.5 / sigma2)
+    if outside_weight is not None:
+        loss = loss * outside_weight
+    return jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+
+
+def huber_loss(input, label, delta=1.0, name=None):
+    d = label - input
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return (-label * jnp.log(input + epsilon)
+            - (1 - label) * jnp.log(1 - input + epsilon))
+
+
+def hinge_loss(input, label, name=None):
+    return jnp.maximum(0.0, 1.0 - input * (2 * label - 1))
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+def rank_loss(label, left, right, name=None):
+    d = left - right
+    return jnp.log1p(jnp.exp(d)) - label * d
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    """kldiv_loss_op.cc parity: x is log-prob, target is prob."""
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    loss = jnp.where(target > 0, loss, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return loss
+
+
+def bpr_loss(input, label, name=None):
+    """bpr_loss_op.cc parity: Bayesian personalized ranking over softmax
+    correct-vs-others."""
+    lab = _squeeze_label(label).astype(jnp.int32)
+    pos = jnp.take_along_axis(input, lab[:, None], axis=1)
+    diff = input - pos
+    loss = jnp.log1p(jnp.exp(diff))
+    n = input.shape[1]
+    mask = jax.nn.one_hot(lab, n, dtype=loss.dtype)
+    loss = jnp.sum(loss * (1 - mask), axis=1, keepdims=True) / (n - 1)
+    return loss
+
+
+def cos_sim(x, y, name=None):
+    """cos_sim_op.cc parity: row-wise cosine similarity, y broadcastable."""
+    x2 = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    y2 = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    xy = jnp.sum(x * y, axis=-1, keepdims=True)
+    return xy / (x2 * y2 + 1e-12)
+
+
+def modified_huber_loss(input, label, name=None):
+    a = (2 * label - 1) * input
+    return jnp.where(a < -1, -4.0 * a,
+                     jnp.square(jnp.maximum(0.0, 1.0 - a)))
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0, name=None):
+    x = jnp.clip(input, soft_max_lower_bound, soft_max_up_bound)
+    z = jnp.asarray(label)
+    # teacher (z<=0 means no teacher signal) + student parts, per the op
+    sig = jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0.0)
+    student = sig - x * (z > 0.5).astype(x.dtype)
+    return student
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    sim = jnp.matmul(anchor, positive.T)
+    lab = labels.reshape(-1)
+    tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1)
+    l2 = jnp.mean(jnp.sum(jnp.square(anchor) + jnp.square(positive), axis=1))
+    return jnp.mean(ce) + l2_reg * l2 * 0.25

@@ -1,0 +1,199 @@
+"""Elementwise & linear-algebra math ops.
+
+Parity targets: operators/elementwise/* (broadcast machinery
+ref: operators/elementwise/elementwise_op_function.h), matmul_op.cc,
+mul_op.cc, scale_op.cc, sum_op.cc, cumsum_op.cc, clip_op.cc,
+clip_by_norm_op.cc, cast_op.cc, isfinite_op.cc, increment_op.cc.
+
+The reference's elementwise ops take an ``axis`` attr to align a
+lower-rank Y against X's dims (elementwise_op_function.h trim/expand);
+here that is reproduced by reshaping Y before the broadcast, and XLA fuses
+the rest.
+"""
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_min", "elementwise_max",
+    "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
+    "matmul", "mul", "bmm", "dot", "scale", "sums", "cumsum",
+    "clip", "clip_by_norm", "cast", "increment", "isfinite",
+    "abs", "ceil", "floor", "round", "exp", "log", "sqrt", "rsqrt",
+    "square", "reciprocal", "sign", "cos", "sin", "pow",
+    "logical_and", "logical_or", "logical_xor", "logical_not",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "minus",
+]
+
+
+def _align(x, y, axis=-1):
+    """Reference broadcast rule: align y's dims starting at `axis` of x."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    if x.ndim == y.ndim or y.ndim == 0:
+        return x, y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    shape = [1] * x.ndim
+    shape[axis: axis + y.ndim] = y.shape
+    return x, y.reshape(shape)
+
+
+def _binary(fn):
+    def op(x, y, axis=-1, name=None):
+        x, y = _align(x, y, axis)
+        return fn(x, y)
+    return op
+
+
+elementwise_add = _binary(jnp.add)
+elementwise_sub = _binary(jnp.subtract)
+elementwise_mul = _binary(jnp.multiply)
+elementwise_div = _binary(jnp.divide)
+elementwise_min = _binary(jnp.minimum)
+elementwise_max = _binary(jnp.maximum)
+elementwise_pow = _binary(jnp.power)
+elementwise_mod = _binary(jnp.mod)
+elementwise_floordiv = _binary(jnp.floor_divide)
+
+
+def minus(x, y):
+    return jnp.subtract(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    """matmul_op.cc parity: batched matmul with optional transposes.
+
+    Feeds the MXU; keep operands >=2D and let XLA batch. 1-D operands get
+    the reference's vec-mat promotion.
+    """
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    squeeze_l = squeeze_r = False
+    if x.ndim == 1:
+        x, squeeze_l = x[None, :], True
+    if y.ndim == 1:
+        y, squeeze_r = y[:, None], True
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    if squeeze_l:
+        out = out[..., 0, :]
+    if squeeze_r:
+        out = out[..., 0]
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """mul_op.cc parity: flatten x to 2-D at x_num_col_dims, y likewise,
+    then 2-D matmul."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    # shapes are static under tracing: compute flatten sizes in Python so
+    # mul stays jit/eval_shape-traceable (no data-dependent shapes on TPU)
+    xs = x.reshape((math.prod(x.shape[:x_num_col_dims]), -1)) \
+        if x.ndim > 2 or x_num_col_dims != 1 else x.reshape((x.shape[0], -1))
+    ys = y.reshape((math.prod(y.shape[:y_num_col_dims]), -1))
+    out = jnp.matmul(xs, ys)
+    return out.reshape(x.shape[:x_num_col_dims] + (ys.shape[-1],))
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    """scale_op.cc parity."""
+    x = jnp.asarray(x)
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def sums(inputs, name=None):
+    """sum_op.cc parity: add a list of tensors."""
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def cumsum(x, axis=None, exclusive=False, reverse=False, name=None):
+    x = jnp.asarray(x)
+    if axis is None:
+        x, axis = x.ravel(), 0
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def clip(x, min, max, name=None):
+    return jnp.clip(jnp.asarray(x), min, max)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """clip_by_norm_op.cc parity: x * max_norm / max(norm, max_norm)."""
+    x = jnp.asarray(x)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return x * (max_norm / jnp.maximum(norm, max_norm))
+
+
+def cast(x, dtype):
+    from paddle_tpu.core.dtypes import convert_dtype
+    return jnp.asarray(x).astype(convert_dtype(dtype))
+
+
+def increment(x, value=1.0, name=None):
+    return jnp.asarray(x) + value
+
+
+def isfinite(x, name=None):
+    """isfinite_op.cc parity: reduce-all finite check."""
+    return jnp.all(jnp.isfinite(jnp.asarray(x)))
+
+
+# -- simple unary (activation_op.cc registers several of these too) --------
+def abs(x, name=None): return jnp.abs(jnp.asarray(x))            # noqa: E704
+def ceil(x, name=None): return jnp.ceil(jnp.asarray(x))          # noqa: E704
+def floor(x, name=None): return jnp.floor(jnp.asarray(x))        # noqa: E704
+def round(x, name=None): return jnp.round(jnp.asarray(x))        # noqa: E704
+def exp(x, name=None): return jnp.exp(jnp.asarray(x))            # noqa: E704
+def log(x, name=None): return jnp.log(jnp.asarray(x))            # noqa: E704
+def sqrt(x, name=None): return jnp.sqrt(jnp.asarray(x))          # noqa: E704
+def rsqrt(x, name=None): return lax.rsqrt(jnp.asarray(x))        # noqa: E704
+def square(x, name=None): return jnp.square(jnp.asarray(x))      # noqa: E704
+def reciprocal(x, name=None): return 1.0 / jnp.asarray(x)        # noqa: E704
+def sign(x, name=None): return jnp.sign(jnp.asarray(x))          # noqa: E704
+def cos(x, name=None): return jnp.cos(jnp.asarray(x))            # noqa: E704
+def sin(x, name=None): return jnp.sin(jnp.asarray(x))            # noqa: E704
+
+
+def pow(x, factor=1.0, name=None):
+    return jnp.power(jnp.asarray(x), factor)
+
+
+# -- logical / compare (operators/controlflow/{logical,compare}_op.cc) -----
+def logical_and(x, y, name=None): return jnp.logical_and(x, y)   # noqa: E704
+def logical_or(x, y, name=None): return jnp.logical_or(x, y)     # noqa: E704
+def logical_xor(x, y, name=None): return jnp.logical_xor(x, y)   # noqa: E704
+def logical_not(x, name=None): return jnp.logical_not(x)         # noqa: E704
+def equal(x, y, name=None): return jnp.equal(x, y)               # noqa: E704
+def not_equal(x, y, name=None): return jnp.not_equal(x, y)       # noqa: E704
+def less_than(x, y, name=None): return jnp.less(x, y)            # noqa: E704
+def less_equal(x, y, name=None): return jnp.less_equal(x, y)     # noqa: E704
+def greater_than(x, y, name=None): return jnp.greater(x, y)      # noqa: E704
+def greater_equal(x, y, name=None): return jnp.greater_equal(x, y)  # noqa: E704

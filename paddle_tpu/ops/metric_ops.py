@@ -1,0 +1,43 @@
+"""Metric ops.
+
+Parity targets: operators/metrics/ (accuracy_op.cc, auc_op.cc,
+precision_recall_op.cc), chunk_eval_op.cc (python-side in metrics.py).
+"""
+
+import jax.numpy as jnp
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, name=None):
+    """accuracy_op.cc parity: top-k accuracy; returns scalar [1]."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label)
+    if label.ndim == 2 and label.shape[1] == 1:
+        label = label[:, 0]
+    if k == 1:
+        pred = jnp.argmax(input, axis=-1)
+        correct = (pred == label)
+    else:
+        idx = jnp.argsort(-input, axis=-1)[:, :k]
+        correct = jnp.any(idx == label[:, None], axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+def auc(predict, label, num_thresholds=4096, name=None):
+    """auc_op.cc parity (batch AUC via threshold histogram)."""
+    predict = jnp.asarray(predict)
+    label = jnp.asarray(label).reshape(-1)
+    pos_prob = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 \
+        else predict.reshape(-1)
+    bins = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32),
+                    0, num_thresholds - 1)
+    pos = jnp.zeros(num_thresholds).at[bins].add(label.astype(jnp.float32))
+    neg = jnp.zeros(num_thresholds).at[bins].add(1.0 - label)
+    # integrate from the top threshold down
+    tp = jnp.cumsum(pos[::-1])
+    fp = jnp.cumsum(neg[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1.0)
+    fpr = fp / jnp.maximum(tot_neg, 1.0)
+    return jnp.trapezoid(tpr, fpr)

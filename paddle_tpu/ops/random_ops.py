@@ -1,0 +1,101 @@
+"""Random-generation ops.
+
+Parity targets: gaussian_random_op.cc, uniform_random_op.cc,
+truncated_gaussian_random_op.cc, random_crop_op.cc, sampling_id_op.cc,
+*_batch_size_like variants. Eager calls draw keys from the global RNG
+(paddle_tpu.core.random); jitted code should pass `rng=` explicitly.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import random as ptrandom
+from paddle_tpu.core.dtypes import convert_dtype
+
+__all__ = [
+    "gaussian_random", "uniform_random", "truncated_gaussian_random",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "randint", "sampling_id", "random_crop", "shuffle_batch",
+]
+
+
+def _key(seed, rng):
+    return rng if rng is not None else ptrandom.key_for(seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    rng=None, name=None):
+    k = _key(seed, rng)
+    return mean + std * jax.random.normal(k, tuple(shape),
+                                          convert_dtype(dtype))
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,
+                   rng=None, name=None):
+    k = _key(seed, rng)
+    return jax.random.uniform(k, tuple(shape), convert_dtype(dtype),
+                              minval=min, maxval=max)
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, seed=0,
+                              dtype="float32", rng=None, name=None):
+    k = _key(seed, rng)
+    return mean + std * jax.random.truncated_normal(
+        k, -2.0, 2.0, tuple(shape), convert_dtype(dtype))
+
+
+def uniform_random_batch_size_like(input, shape, input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0,
+                                   seed=0, dtype="float32", rng=None,
+                                   name=None):
+    shape = list(shape)
+    shape[output_dim_idx] = jnp.asarray(input).shape[input_dim_idx]
+    return uniform_random(shape, dtype, min, max, seed, rng)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32", rng=None,
+                                    name=None):
+    shape = list(shape)
+    shape[output_dim_idx] = jnp.asarray(input).shape[input_dim_idx]
+    return gaussian_random(shape, mean, std, seed, dtype, rng)
+
+
+def randint(low, high=None, shape=(1,), dtype="int64", seed=0, rng=None):
+    if high is None:
+        low, high = 0, low
+    k = _key(seed, rng)
+    return jax.random.randint(k, tuple(shape), low, high,
+                              convert_dtype(dtype))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64", rng=None,
+                name=None):
+    """sampling_id_op.cc parity: sample one category per row of prob
+    matrix x."""
+    k = _key(seed, rng)
+    return jax.random.categorical(k, jnp.log(jnp.maximum(x, 1e-20)),
+                                  axis=-1).astype(convert_dtype(dtype))
+
+
+def random_crop(x, shape, seed=0, rng=None, name=None):
+    """random_crop_op.cc parity: random spatial crop of trailing dims."""
+    k = _key(seed, rng)
+    x = jnp.asarray(x)
+    nd, tail = x.ndim, len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        k, sub = jax.random.split(k)
+        hi = x.shape[nd - tail + i] - s + 1
+        starts.append(jax.random.randint(sub, (), 0, hi))
+    out = x
+    for i, (st, sz) in enumerate(zip(starts, shape)):
+        out = jax.lax.dynamic_slice_in_dim(out, st, sz, axis=nd - tail + i)
+    return out
+
+
+def shuffle_batch(x, seed=0, rng=None, name=None):
+    k = _key(seed, rng)
+    perm = jax.random.permutation(k, jnp.asarray(x).shape[0])
+    return jnp.take(jnp.asarray(x), perm, axis=0)

@@ -1,0 +1,82 @@
+"""Reduction ops.
+
+Parity targets: operators/reduce_ops/ (reduce_sum/mean/max/min/prod/all/
+any), mean_op.cc, squared_l2_norm_op.cc, l1_norm_op.cc, norm_op.cc,
+mean_iou_op.cc, frobenius (absent).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_all", "reduce_any", "mean", "squared_l2_norm", "l1_norm",
+    "l2_normalize", "norm", "mean_iou",
+]
+
+
+def _axes(dim, keep_dim):
+    if dim is None:
+        return None, keep_dim
+    if isinstance(dim, int):
+        dim = (dim,)
+    return tuple(dim), keep_dim
+
+
+def _reduce(fn):
+    def op(input, dim=None, keep_dim=False, name=None):
+        axes, keep = _axes(dim, keep_dim)
+        return fn(jnp.asarray(input), axis=axes, keepdims=keep)
+    return op
+
+
+reduce_sum = _reduce(jnp.sum)
+reduce_mean = _reduce(jnp.mean)
+reduce_max = _reduce(jnp.max)
+reduce_min = _reduce(jnp.min)
+reduce_prod = _reduce(jnp.prod)
+reduce_all = _reduce(jnp.all)
+reduce_any = _reduce(jnp.any)
+
+
+def mean(x, name=None):
+    """mean_op.cc parity: scalar mean of all elements."""
+    return jnp.mean(jnp.asarray(x))
+
+
+def squared_l2_norm(x, name=None):
+    return jnp.sum(jnp.square(jnp.asarray(x)))
+
+
+def l1_norm(x, name=None):
+    return jnp.sum(jnp.abs(jnp.asarray(x)))
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    x = jnp.asarray(x)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(norm, epsilon)
+
+
+def norm(x, axis=-1, epsilon=1e-10, name=None):
+    """norm_op.cc parity: returns normalized x (out) like the op's Out."""
+    x = jnp.asarray(x)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + epsilon)
+    return x / n
+
+
+def mean_iou(input, label, num_classes):
+    """mean_iou_op.cc parity: (miou, out_wrong, out_correct)."""
+    pred = jnp.asarray(input).reshape(-1)
+    lab = jnp.asarray(label).reshape(-1)
+    cm = jnp.zeros((num_classes, num_classes), jnp.int64)
+    idx = lab * num_classes + pred
+    cm = cm.reshape(-1).at[idx].add(1).reshape(num_classes, num_classes)
+    inter = jnp.diag(cm).astype(jnp.float32)
+    union = (jnp.sum(cm, 0) + jnp.sum(cm, 1)).astype(jnp.float32) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    wrong = jnp.sum(cm, 1).astype(jnp.int64) - jnp.diag(cm)
+    correct = jnp.diag(cm)
+    return miou, wrong, correct

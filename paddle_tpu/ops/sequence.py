@@ -1,0 +1,179 @@
+"""Sequence ops over RaggedBatch (dense padding + lengths).
+
+Parity targets: operators/sequence_ops/ (sequence_pool, sequence_softmax,
+sequence_expand, sequence_pad/unpad, sequence_concat, sequence_reverse,
+sequence_mask, sequence_slice, sequence_erase, sequence_enumerate,
+sequence_first/last_step) — the reference implements these over
+offset-based LoD (ref: lod_tensor.h:229); here every op is a masked dense
+computation with static shapes, which is what XLA needs to tile onto the
+VPU/MXU (ref: SURVEY §5.7 design note).
+
+Sequence inputs are `RaggedBatch` (data [B, T, ...], lengths [B]) or a
+(data, lengths) pair.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.lod import RaggedBatch, sequence_mask
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_softmax", "sequence_expand",
+    "sequence_pad", "sequence_unpad", "sequence_concat", "sequence_reverse",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_scatter", "sequence_expand_as",
+]
+
+
+def _unpack(x):
+    if isinstance(x, RaggedBatch):
+        return x.data, x.lengths
+    if isinstance(x, (tuple, list)) and len(x) == 2:
+        return jnp.asarray(x[0]), jnp.asarray(x[1])
+    raise TypeError("sequence op needs RaggedBatch or (data, lengths)")
+
+
+def _mask(data, lengths):
+    m = sequence_mask(lengths, maxlen=data.shape[1], dtype=data.dtype)
+    return m.reshape(m.shape + (1,) * (data.ndim - 2))
+
+
+def sequence_pool(input, pool_type="sum", name=None):
+    """sequence_pool_op parity: reduce each sequence over time.
+    Returns [B, ...]."""
+    data, lengths = _unpack(input)
+    m = _mask(data, lengths)
+    pt = pool_type.lower()
+    denom = jnp.maximum(lengths, 1).astype(data.dtype)
+    denom = denom.reshape((-1,) + (1,) * (data.ndim - 2))
+    if pt == "sum":
+        return jnp.sum(data * m, axis=1)
+    if pt == "average" or pt == "mean":
+        return jnp.sum(data * m, axis=1) / denom
+    if pt == "sqrt":
+        return jnp.sum(data * m, axis=1) / jnp.sqrt(denom)
+    if pt == "max":
+        neg = jnp.finfo(data.dtype).min if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return jnp.max(jnp.where(m > 0, data, neg), axis=1)
+    if pt == "first":
+        return data[:, 0]
+    if pt == "last":
+        return sequence_last_step(input)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+def sequence_first_step(input, name=None):
+    data, _ = _unpack(input)
+    return data[:, 0]
+
+
+def sequence_last_step(input, name=None):
+    data, lengths = _unpack(input)
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(
+        data, idx.reshape((-1, 1) + (1,) * (data.ndim - 2)).astype(jnp.int32),
+        axis=1)[:, 0]
+
+
+def sequence_softmax(input, name=None):
+    """sequence_softmax_op parity: softmax within each sequence, padding
+    excluded."""
+    data, lengths = _unpack(input)
+    m = _mask(data, lengths)
+    neg = jnp.finfo(data.dtype).min
+    logits = jnp.where(m > 0, data, neg)
+    out = jax.nn.softmax(logits, axis=1)
+    return RaggedBatch(out * m, lengths)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """sequence_expand_op parity, dense form: repeat each row of x to match
+    y's per-sequence lengths. x: [B, ...] (one entry per sequence),
+    y: RaggedBatch giving the target lengths. Returns RaggedBatch
+    [B, T, ...] with x broadcast across time."""
+    ydata, ylen = _unpack(y)
+    xb = jnp.asarray(x)
+    out = jnp.broadcast_to(xb[:, None],
+                           (xb.shape[0], ydata.shape[1]) + xb.shape[1:])
+    return RaggedBatch(out * _mask(out, ylen), ylen)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, name=None):
+    """sequence_pad_op parity: RaggedBatch is already padded; re-pad to
+    maxlen and return (data, lengths) like the reference's (Out, Length)."""
+    data, lengths = _unpack(x)
+    if maxlen is not None and maxlen != data.shape[1]:
+        if maxlen > data.shape[1]:
+            cfg = [(0, 0), (0, maxlen - data.shape[1])] + [(0, 0)] * (data.ndim - 2)
+            data = jnp.pad(data, cfg, constant_values=pad_value)
+        else:
+            data = data[:, :maxlen]
+    m = _mask(data, lengths)
+    data = jnp.where(m > 0, data, pad_value)
+    return data, lengths
+
+
+def sequence_unpad(x, length, name=None):
+    """sequence_unpad_op parity: wrap dense (x, length) as RaggedBatch."""
+    return RaggedBatch(jnp.asarray(x), jnp.asarray(length))
+
+
+def sequence_concat(input, name=None):
+    """sequence_concat_op parity: concat along time per batch row."""
+    datas, lens = zip(*[_unpack(t) for t in input])
+    total = sum(d.shape[1] for d in datas)
+    b = datas[0].shape[0]
+    tail = datas[0].shape[2:]
+    out = jnp.zeros((b, total) + tail, datas[0].dtype)
+    out_len = sum(lens)
+    # place each segment at the running offset per row via scatter of
+    # time indices
+    offs = jnp.zeros((b,), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(total, dtype=jnp.int32), (b, total))
+    for d, l in zip(datas, lens):
+        t = d.shape[1]
+        tpos = jnp.arange(t, dtype=jnp.int32)[None, :] + offs[:, None]
+        valid = jnp.arange(t, dtype=jnp.int32)[None, :] < l[:, None]
+        onehot = (pos[:, :, None] == tpos[:, None, :]) & valid[:, None, :]
+        upd = jnp.einsum("bts,bs...->bt...", onehot.astype(d.dtype), d)
+        out = out + upd
+        offs = offs + l
+    return RaggedBatch(out, out_len)
+
+
+def sequence_reverse(x, name=None):
+    """sequence_reverse_op parity: reverse valid prefix of each row."""
+    data, lengths = _unpack(x)
+    t = data.shape[1]
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    src = lengths[:, None] - 1 - pos
+    src = jnp.where(src >= 0, src, pos)  # padding stays in place
+    return RaggedBatch(
+        jnp.take_along_axis(
+            data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=1),
+        lengths)
+
+
+def sequence_slice(input, offset, length, name=None):
+    """sequence_slice_op parity: per-sequence [offset, offset+length)."""
+    data, _ = _unpack(input)
+    offset = jnp.asarray(offset).reshape(-1)
+    length = jnp.asarray(length).reshape(-1)
+    maxl = data.shape[1]
+    pos = jnp.arange(maxl, dtype=jnp.int32)[None, :]
+    src = pos + offset[:, None]
+    src = jnp.clip(src, 0, maxl - 1)
+    out = jnp.take_along_axis(
+        data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=1)
+    return RaggedBatch(out, length.astype(jnp.int32))
+
+
+def sequence_scatter(x, index, updates, name=None):
+    """sequence_scatter_op parity (dense): add updates at given positions."""
+    x = jnp.asarray(x)
+    idx = jnp.asarray(index)
+    return x.at[jnp.arange(x.shape[0])[:, None], idx].add(updates)

@@ -1,0 +1,301 @@
+"""Tensor manipulation ops.
+
+Parity targets: concat_op.cc, split_op.cc, stack_op.cc, unstack_op.cc,
+squeeze_op.cc, unsqueeze_op.cc, reshape_op.cc, flatten_op.cc,
+transpose_op.cc, slice_op.cc, strided_slice (absent), gather_op.cc,
+scatter_op.cc, expand_op.cc, tile (absent, expand is the analog),
+shape_op.cc, fill_constant_op.cc, fill_any_like_op.cc,
+fill_zeros_like_op.cc, assign_op.cc, arg_max/arg_min/argsort_op.cc,
+top_k_op.cc, where_op.cc, diag_op.cc, linspace_op.cc, range_op.cc,
+reverse_op.cc, unique_op.cc, size_op.cc, is_empty_op.cc, multiplex_op.cc,
+crop_op.cc, im2sequence via unfold, tensor_array_to_tensor_op.cc.
+"""
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import convert_dtype
+
+builtins_slice = builtins.slice
+builtins_list = builtins.list
+
+__all__ = [
+    "concat", "split", "stack", "unstack", "squeeze", "unsqueeze",
+    "reshape", "flatten", "transpose", "slice", "strided_slice", "gather",
+    "gather_nd", "scatter", "scatter_nd_add", "expand", "expand_as",
+    "tile", "shape", "size", "fill_constant", "fill_constant_batch_size_like",
+    "zeros", "ones", "zeros_like", "ones_like", "full_like", "assign",
+    "argmax", "argmin", "argsort", "topk", "where", "where_index", "diag",
+    "linspace", "arange", "reverse", "unique_with_counts", "is_empty",
+    "multiplex", "crop", "roll", "flip", "meshgrid", "eye",
+]
+
+
+def concat(input, axis=0, name=None):
+    return jnp.concatenate([jnp.asarray(t) for t in input], axis=axis)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    input = jnp.asarray(input)
+    if isinstance(num_or_sections, int):
+        return jnp.split(input, num_or_sections, axis=dim)
+    idx = jnp.cumsum(jnp.array(num_or_sections[:-1])).tolist()
+    return jnp.split(input, idx, axis=dim)
+
+
+def stack(x, axis=0, name=None):
+    return jnp.stack([jnp.asarray(t) for t in x], axis=axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = jnp.asarray(x)
+    return [jnp.squeeze(t, axis=axis)
+            for t in jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def squeeze(input, axes=None, name=None):
+    input = jnp.asarray(input)
+    if not axes:
+        return jnp.squeeze(input)
+    axes = [a for a in axes if input.shape[a] == 1]
+    return jnp.squeeze(input, axis=tuple(axes)) if axes else input
+
+
+def unsqueeze(input, axes, name=None):
+    input = jnp.asarray(input)
+    if isinstance(axes, int):
+        axes = [axes]
+    for a in sorted(axes):
+        input = jnp.expand_dims(input, a)
+    return input
+
+
+def reshape(x, shape, inplace=False, name=None):
+    return jnp.reshape(jnp.asarray(x), shape)
+
+
+def flatten(x, axis=1, name=None):
+    """flatten_op.cc parity: collapse dims [0,axis) and [axis, ndim)."""
+    x = jnp.asarray(x)
+    lead = 1
+    for d in x.shape[:axis]:
+        lead *= d
+    return x.reshape(lead, -1)
+
+
+def transpose(x, perm, name=None):
+    return jnp.transpose(jnp.asarray(x), perm)
+
+
+def slice(input, axes, starts, ends, name=None):
+    """slice_op.cc parity."""
+    input = jnp.asarray(input)
+    idx = [builtins_slice(None)] * input.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        dim = input.shape[ax]
+        st2 = st + dim if st < 0 else min(st, dim)
+        en2 = en + dim if en < 0 else min(en, dim)
+        idx[ax] = builtins_slice(st2, en2)
+    return input[tuple(idx)]
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    input = jnp.asarray(input)
+    idx = [builtins_slice(None)] * input.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins_slice(st, en, sd)
+    return input[tuple(idx)]
+
+
+def gather(input, index, overwrite=True, name=None):
+    """gather_op.cc parity: select rows along axis 0."""
+    index = jnp.asarray(index)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    return jnp.take(jnp.asarray(input), index, axis=0)
+
+
+def gather_nd(input, index, name=None):
+    input, index = jnp.asarray(input), jnp.asarray(index)
+    return input[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    """scatter_op.cc parity: write (or add) update rows at index."""
+    input = jnp.asarray(input)
+    index = jnp.asarray(index)
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    if overwrite:
+        return input.at[index].set(updates)
+    return input.at[index].add(updates)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    ref, index = jnp.asarray(ref), jnp.asarray(index)
+    return ref.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def expand(x, expand_times, name=None):
+    """expand_op.cc parity: tile each dim expand_times[i] times."""
+    return jnp.tile(jnp.asarray(x), expand_times)
+
+
+def expand_as(x, target_tensor, name=None):
+    x = jnp.asarray(x)
+    times = [t // s for s, t in zip(x.shape, target_tensor.shape)]
+    return jnp.tile(x, times)
+
+
+def tile(x, repeat_times, name=None):
+    return jnp.tile(jnp.asarray(x), repeat_times)
+
+
+def shape(input, name=None):
+    return jnp.array(jnp.asarray(input).shape, dtype=jnp.int32)
+
+
+def size(input, name=None):
+    return jnp.array(jnp.asarray(input).size, dtype=jnp.int64)
+
+
+def fill_constant(shape, dtype, value, name=None):
+    return jnp.full(tuple(int(s) for s in shape), value,
+                    dtype=convert_dtype(dtype))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  name=None):
+    shape = builtins_list(shape)
+    shape[output_dim_idx] = jnp.asarray(input).shape[input_dim_idx]
+    return jnp.full(tuple(shape), value, dtype=convert_dtype(dtype))
+
+
+def zeros(shape, dtype="float32", name=None):
+    return jnp.zeros(tuple(shape), convert_dtype(dtype))
+
+
+def ones(shape, dtype="float32", name=None):
+    return jnp.ones(tuple(shape), convert_dtype(dtype))
+
+
+def zeros_like(x, out=None, name=None):
+    return jnp.zeros_like(jnp.asarray(x))
+
+
+def ones_like(x, out=None, name=None):
+    return jnp.ones_like(jnp.asarray(x))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return jnp.full_like(jnp.asarray(x), fill_value,
+                         dtype=convert_dtype(dtype) if dtype else None)
+
+
+def assign(input, output=None, name=None):
+    return jnp.asarray(input)
+
+
+def argmax(x, axis=0, name=None):
+    return jnp.argmax(jnp.asarray(x), axis=axis).astype(jnp.int64)
+
+
+def argmin(x, axis=0, name=None):
+    return jnp.argmin(jnp.asarray(x), axis=axis).astype(jnp.int64)
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    """argsort_op.cc parity: returns (sorted, indices)."""
+    input = jnp.asarray(input)
+    if descending:
+        idx = jnp.argsort(-input, axis=axis)
+    else:
+        idx = jnp.argsort(input, axis=axis)
+    out = jnp.take_along_axis(input, idx, axis=axis)
+    return out, idx.astype(jnp.int64)
+
+
+def topk(input, k, name=None):
+    """top_k_op.cc parity over last axis: (values, indices)."""
+    v, i = jax.lax.top_k(jnp.asarray(input), k)
+    return v, i.astype(jnp.int64)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return where_index(condition)
+    return jnp.where(condition, x, y)
+
+
+def where_index(condition, name=None):
+    """where_op.cc parity: indices of true elements. Dynamic-shaped; only
+    usable eagerly (outside jit), like the reference's CPU-side usage."""
+    import numpy as np
+    return jnp.asarray(np.argwhere(np.asarray(condition)))
+
+
+def diag(diagonal, name=None):
+    return jnp.diag(jnp.asarray(diagonal))
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return jnp.linspace(start, stop, int(num), dtype=convert_dtype(dtype))
+
+
+def arange(start, end=None, step=1, dtype="float32", name=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+def reverse(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(jnp.asarray(x), axis=tuple(axis))
+
+
+def flip(x, axis, name=None):
+    return reverse(x, axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(jnp.asarray(x), shifts, axis=axis)
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    """unique_op.cc parity (eager only: dynamic output shape)."""
+    import numpy as np
+    out, index, counts = np.unique(np.asarray(x), return_inverse=True,
+                                   return_counts=True)
+    return (jnp.asarray(out), jnp.asarray(index.astype(dtype)),
+            jnp.asarray(counts.astype(dtype)))
+
+
+def is_empty(x, name=None):
+    return jnp.array(jnp.asarray(x).size == 0)
+
+
+def multiplex(inputs, index, name=None):
+    """multiplex_op.cc parity: per-row select among candidate tensors."""
+    stacked = jnp.stack([jnp.asarray(t) for t in inputs], axis=0)
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = jnp.asarray(x)
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(builtins_slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*[jnp.asarray(a) for a in args], indexing="ij")
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))

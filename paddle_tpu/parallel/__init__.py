@@ -1,0 +1,20 @@
+"""Parallelism: mesh, collectives, SPMD data/tensor/pipeline parallel.
+
+TPU-native replacement for the reference's ParallelExecutor + NCCL stack
+(ref: framework/parallel_executor.cc, details/all_reduce_op_handle.cc,
+platform/nccl_helper.h, operators/collective/): parallelism is expressed
+as shardings over a `jax.sharding.Mesh`; XLA inserts ICI/DCN collectives
+(ref: SURVEY §2.5/§2.6 translation table).
+"""
+
+from paddle_tpu.parallel.mesh import (
+    make_mesh, get_mesh, set_mesh, mesh_shape_for, MeshConfig,
+)
+from paddle_tpu.parallel.collective import (
+    all_reduce, all_gather, reduce_scatter, broadcast, ppermute, barrier,
+    psum, pmean,
+)
+from paddle_tpu.parallel.data_parallel import (
+    DataParallelTrainer, shard_batch, replicate,
+)
+from paddle_tpu.parallel.env import ParallelEnv, get_rank, get_world_size

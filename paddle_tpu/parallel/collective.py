@@ -1,0 +1,89 @@
+"""Collective ops.
+
+Parity: operators/collective/ (c_allreduce_{sum,max,min,prod}
+ref: collective/c_allreduce_op.h:33, c_allgather, c_reducescatter,
+c_broadcast, c_sync_*) and the python mirrors (layers/collective.py).
+
+TPU-native: these are jax.lax collectives over named mesh axes, usable
+inside shard_map/pjit — XLA schedules them on ICI and overlaps with
+compute (the reference needed dedicated comm streams + sync ops for
+that; c_sync_calc_stream/c_sync_comm_stream have no analog because the
+compiler owns scheduling). ring_id → axis_name.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.parallel.mesh import DATA_AXIS
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "broadcast", "ppermute",
+    "barrier", "psum", "pmean", "pmax", "pmin", "axis_index",
+]
+
+
+def psum(x, axis_name=DATA_AXIS):
+    return lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name=DATA_AXIS):
+    return lax.pmean(x, axis_name)
+
+
+def pmax(x, axis_name=DATA_AXIS):
+    return lax.pmax(x, axis_name)
+
+
+def pmin(x, axis_name=DATA_AXIS):
+    return lax.pmin(x, axis_name)
+
+
+def all_reduce(x, op="sum", ring_id=None, axis_name=DATA_AXIS):
+    """c_allreduce parity; op in sum/max/min/prod/avg."""
+    axis = ring_id if isinstance(ring_id, str) else axis_name
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "avg" or op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op == "prod":
+        return jnp.exp(lax.psum(jnp.log(x), axis))
+    raise ValueError(f"unknown allreduce op {op}")
+
+
+def all_gather(x, axis_name=DATA_AXIS, axis=0, tiled=True):
+    """c_allgather parity: concatenate shards along `axis`."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name=DATA_AXIS, axis=0):
+    """c_reducescatter parity."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                            tiled=True)
+
+
+def broadcast(x, root=0, axis_name=DATA_AXIS):
+    """c_broadcast parity: every participant gets root's value."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ppermute(x, perm, axis_name=DATA_AXIS):
+    """collective_permute — the ring-attention / pipeline transfer
+    primitive."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def barrier(axis_name=DATA_AXIS):
+    """No-op under SPMD (XLA programs are globally scheduled); kept for
+    API parity with the reference's barrier ops."""
+    return None
+
+
+def axis_index(axis_name=DATA_AXIS):
+    return lax.axis_index(axis_name)

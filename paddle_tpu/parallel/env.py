@@ -1,0 +1,67 @@
+"""Distributed process environment.
+
+Parity: the reference's env-var identity wiring (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_CURRENT_ENDPOINT…
+ref: python/paddle/fluid/dygraph/parallel.py:54-82, test_dist_base.py:429)
+and `paddle.distributed.launch` (launch.py:132). On TPU pods, JAX's
+runtime provides process_index/process_count from the scheduler, so env
+vars are a fallback for CPU-multihost testing.
+"""
+
+import os
+
+import jax
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size", "init_parallel_env"]
+
+
+class ParallelEnv:
+    """dygraph.parallel.ParallelEnv parity."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get(
+            "PADDLE_TRAINER_ID", jax.process_index()))
+        self._world = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", jax.process_count()))
+        self._endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self._endpoints = os.environ.get(
+            "PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world
+
+    @property
+    def dev_id(self):
+        return 0  # one process drives all local chips under JAX
+
+    @property
+    def current_endpoint(self):
+        return self._endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints
+
+
+def get_rank():
+    return ParallelEnv().local_rank
+
+
+def get_world_size():
+    return ParallelEnv().nranks
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Multi-host bring-up: the analog of gen_nccl_id + comm init
+    (ref: distributed_ops/gen_nccl_id_op.cc — TPU needs no id exchange;
+    jax.distributed handles the DCN rendezvous)."""
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    return ParallelEnv()

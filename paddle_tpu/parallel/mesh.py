@@ -1,0 +1,96 @@
+"""Device mesh bookkeeping.
+
+Replaces NCCLContextMap / NCCLCommunicator ring bookkeeping
+(ref: platform/nccl_helper.h:90,179 — flat + hierarchical comm groups;
+platform/collective_helper.h named comms). On TPU the runtime knows the
+topology; a mesh names axes (data/model/pipe/seq) and XLA lowers
+collectives onto ICI rings per axis. The BuildStrategy knobs
+(hierarchical allreduce, multi-ring, ref: details/build_strategy.h:129-138)
+correspond to how axes are laid out over the physical topology.
+
+Canonical axis names:
+  "data"  — data parallel (the reference's trainer replicas)
+  "model" — tensor/op parallelism (not in the reference; free via GSPMD)
+  "pipe"  — pipeline stages (ref: PipelineTrainer)
+  "seq"   — sequence/context parallelism (ring attention)
+"""
+
+import contextlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+
+@dataclass
+class MeshConfig:
+    data: int = -1     # -1 = all remaining devices
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+    axis_order: tuple = (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+
+def mesh_shape_for(n_devices, cfg):
+    sizes = {DATA_AXIS: cfg.data, MODEL_AXIS: cfg.model,
+             PIPE_AXIS: cfg.pipe, SEQ_AXIS: cfg.seq}
+    fixed = 1
+    for a, s in sizes.items():
+        if s != -1:
+            fixed *= s
+    for a in sizes:
+        if sizes[a] == -1:
+            sizes[a] = n_devices // fixed
+    return tuple(sizes[a] for a in cfg.axis_order)
+
+
+def make_mesh(config=None, devices=None):
+    """Build a Mesh over the given (default: all) devices.
+
+    Axis layout note: the innermost mesh axis maps to adjacent devices,
+    so put the highest-bandwidth-demand axis ("model") innermost — the
+    analog of the reference's hierarchical inter/exter ring split
+    (parallel_executor.cc:158-180)."""
+    devices = devices if devices is not None else jax.devices()
+    config = config or MeshConfig()
+    shape = mesh_shape_for(len(devices), config)
+    used = 1
+    for s in shape:
+        used *= s
+    arr = np.array(devices[:used]).reshape(shape)
+    return Mesh(arr, config.axis_order)
+
+
+_current_mesh = [None]
+
+
+def set_mesh(mesh):
+    _current_mesh[0] = mesh
+    return mesh
+
+
+def get_mesh():
+    if _current_mesh[0] is None:
+        set_mesh(make_mesh())
+    return _current_mesh[0]
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh):
+    old = _current_mesh[0]
+    _current_mesh[0] = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh[0] = old
+
+
+def named_sharding(mesh, *spec):
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,84 @@
+"""Profiler.
+
+Parity: python/paddle/fluid/profiler.py (start_profiler, stop_profiler,
+profiler context manager, reset_profiler) over the reference's two-layer
+host+CUPTI tracer (ref: platform/profiler.h, platform/device_tracer.h,
+tools/timeline.py). TPU-native: host spans recorded here; device tracing
+delegates to jax.profiler (XPlane → TensorBoard/Perfetto), which plays
+the CUPTI role.
+"""
+
+import contextlib
+import time
+
+import jax
+
+__all__ = [
+    "profiler", "start_profiler", "stop_profiler", "reset_profiler",
+    "RecordEvent",
+]
+
+_events = []
+_active = {"on": False, "jax_dir": None}
+
+
+class RecordEvent:
+    """RAII span (ref: platform/profiler.h:81 RecordEvent)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _active["on"]:
+            _events.append((self.name,
+                            self.t0, time.perf_counter() - self.t0))
+
+
+def start_profiler(state="All", tracer_option=None, trace_dir=None):
+    _active["on"] = True
+    if trace_dir:
+        _active["jax_dir"] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    _active["on"] = False
+    if _active["jax_dir"]:
+        jax.profiler.stop_trace()
+        _active["jax_dir"] = None
+    return summary(sorted_key, profile_path)
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def summary(sorted_key="total", profile_path=None):
+    agg = {}
+    for name, _, dur in _events:
+        tot, cnt = agg.get(name, (0.0, 0))
+        agg[name] = (tot + dur, cnt + 1)
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, (tot, cnt) in rows:
+        lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}"
+                     f"{tot / cnt * 1e3:>12.3f}")
+    report = "\n".join(lines)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report)
+    return report
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None,
+             trace_dir=None):
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        print(stop_profiler(sorted_key, profile_path))

@@ -1,0 +1,186 @@
+"""Reader decorators — composable python data pipelines.
+
+Parity: python/paddle/reader/decorator.py (map_readers, shuffle:82,
+chain, compose, buffered:196, firstn, xmap_readers:267,
+multiprocess_reader:360) and fluid.io.cache. A reader is a zero-arg
+callable returning an iterator; decorators wrap readers — same contract
+as the reference so user data code ports directly. The native C++
+high-throughput pipeline is paddle_tpu/data/native.py; these python
+decorators are the compatibility/composability layer.
+"""
+
+import itertools
+import queue
+import random as pyrandom
+import threading
+
+__all__ = [
+    "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
+    "xmap_readers", "cache", "multiprocess_reader",
+]
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                pyrandom.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            pyrandom.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        it = zip(*rs) if check_alignment else itertools.zip_longest(*rs)
+        for outputs in it:
+            yield sum((make_tuple(o) for o in outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (decorator.py:196)."""
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def worker():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map over a reader with worker threads (decorator.py:267)."""
+    end = object()
+
+    def xreader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feeder():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        results = {}
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, d = item
+                out_q.put((i, mapper(d)))
+
+        threading.Thread(target=feeder, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=worker, daemon=True).start()
+
+        finished = 0
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                with lock:
+                    results[item[0]] = item[1]
+                while next_idx in results:
+                    yield results.pop(next_idx)
+                    next_idx += 1
+        if order:
+            while next_idx in results:
+                yield results.pop(next_idx)
+                next_idx += 1
+    return xreader
+
+
+def cache(reader):
+    all_data = []
+    cached = [False]
+
+    def cache_reader():
+        if not cached[0]:
+            for d in reader():
+                all_data.append(d)
+                yield d
+            cached[0] = True
+        else:
+            yield from all_data
+    return cache_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Thread-based equivalent (TPU hosts favor threads feeding the
+    device; the reference forks processes to dodge the GIL for python
+    decoding — heavy decode belongs in the native pipeline instead)."""
+    return chain(*readers) if len(readers) == 1 else _interleave(readers)
+
+
+def _interleave(readers):
+    def reader():
+        its = [r() for r in readers]
+        while its:
+            nxt = []
+            for it in its:
+                try:
+                    yield next(it)
+                    nxt.append(it)
+                except StopIteration:
+                    pass
+            its = nxt
+    return reader

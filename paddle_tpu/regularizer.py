@@ -1,0 +1,30 @@
+"""Weight regularizers.
+
+Parity: python/paddle/fluid/regularizer.py (L1Decay/L2Decay appended as
+ops onto gradients). Here a regularizer is ``(param, grad) -> grad`` —
+applied inside the compiled update step.
+"""
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer"]
+
+
+class L2DecayRegularizer:
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * param
+
+
+class L1DecayRegularizer:
+    def __init__(self, regularization_coeff=0.0):
+        self.coeff = regularization_coeff
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+
+L2Decay = L2DecayRegularizer
+L1Decay = L1DecayRegularizer

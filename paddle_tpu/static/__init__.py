@@ -1,0 +1,27 @@
+"""Static-graph (Program) subsystem.
+
+Parity target: the reference's core identity — ProgramDesc/BlockDesc/OpDesc
+(ref: paddle/fluid/framework/framework.proto:43-188, python framework.py
+Program:2775/Block:1436/Operator:985) plus Executor
+(ref: python executor.py:294, C++ framework/executor.cc).
+
+TPU-native redesign: a Program is still a serializable op-list IR (so
+save/load/prune/inference parity holds), but execution is NOT an op-by-op
+interpreter (ref hot loop: executor.cc:417-421). The Executor traces the
+whole block through the functional op registry and compiles it with
+`jax.jit` into ONE XLA computation; parameters and optimizer state live in
+a Scope carried across steps as a donated pytree.
+"""
+
+from paddle_tpu.static.program import (
+    Program, Block, Operator, Variable, Parameter, program_guard,
+    default_main_program, default_startup_program, name_scope,
+    OP_REGISTRY, register_op, in_static_mode, static_mode_guard, data,
+    enable_static, disable_static,
+)
+from paddle_tpu.static.executor import Executor, Scope, global_scope, scope_guard
+from paddle_tpu.static.backward import append_backward, gradients
+from paddle_tpu.static.io import (
+    save_inference_model, load_inference_model, save_params,
+    load_params, save_persistables, load_persistables,
+)

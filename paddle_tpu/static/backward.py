@@ -1,0 +1,60 @@
+"""Static autodiff.
+
+Parity: python/paddle/fluid/backward.py append_backward:432 /
+calc_gradient:695. The reference walks forward OpDescs in reverse emitting
+grad ops from per-op GradOpDescMakers, de-duping with sum ops
+(backward.py:135). The TPU-native design replaces the whole mechanism with
+one `autodiff` pseudo-op marking "differentiate the block prefix w.r.t.
+the trainable parameters": the Executor lowers it to
+`jax.value_and_grad` over the traced prefix, so forward+backward compile
+into one fused XLA computation and gradient de-dup/pruning fall out of
+XLA's DCE instead of desc rewriting.
+
+Gradient variables keep the reference's `<param>@GRAD` naming so
+optimizer ops and user code match fluid.
+"""
+
+from paddle_tpu.static.program import Parameter, default_main_program
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append the autodiff marker and grad vars; returns
+    [(param, grad_var)] like the reference."""
+    program = loss.block.program
+    blk = program.global_block()
+    params = [p for p in blk.all_parameters() if p.trainable]
+    if parameter_list:
+        wanted = {p if isinstance(p, str) else p.name
+                  for p in parameter_list}
+        params = [p for p in params if p.name in wanted]
+    if no_grad_set:
+        banned = {p if isinstance(p, str) else p.name for p in no_grad_set}
+        params = [p for p in params if p.name not in banned]
+
+    param_names = [p.name for p in params]
+    grad_vars = []
+    for p in params:
+        g = blk.create_var(name=p.name + GRAD_SUFFIX, shape=p.shape,
+                           dtype=p.dtype)
+        grad_vars.append(g)
+    blk.append_op(
+        type="autodiff",
+        inputs={"Loss": [loss.name]},
+        outputs={"Grads": [g.name for g in grad_vars]},
+        attrs={"loss": loss.name, "params": param_names,
+               "checkpoint": bool(checkpoints)})
+    program._loss_names.append(loss.name)
+    return list(zip(params, grad_vars))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """fluid.gradients parity (calc_gradient backward.py:695) — restricted
+    form: targets is a single loss var, inputs are parameters/vars."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    pg = append_backward(t, parameter_list=[
+        i if isinstance(i, str) else i.name
+        for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])])
+    return [g for _, g in pg]

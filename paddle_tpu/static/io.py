@@ -1,0 +1,137 @@
+"""Model save/load.
+
+Parity: python/paddle/fluid/io.py (save_params:242, save_persistables:475,
+load_params:527, load_persistables:714, save_inference_model:921,
+load_inference_model:1109) and the save/load ops
+(operators/save_op.cc, load_op.cc, save_combine_op.cc).
+
+Format: params in a single .npz (the reference's save_combine "one file"
+form); program IR pickled (the reference serializes ProgramDesc proto —
+our IR is plain data: op type/slots/attrs).
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from paddle_tpu.static.executor import global_scope
+from paddle_tpu.static.program import (
+    Operator, Parameter, Program, default_main_program,
+)
+
+PARAMS_FILE = "params.npz"
+PROGRAM_FILE = "__model__"
+
+
+def _collect(program, scope, predicate):
+    out = {}
+    for name, var in program.global_block().vars.items():
+        if predicate(var):
+            val = scope.find_var(name)
+            if val is not None:
+                out[name] = np.asarray(val)
+    return out
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    vals = _collect(main_program, global_scope(),
+                    lambda v: isinstance(v, Parameter))
+    np.savez(os.path.join(dirname, filename or PARAMS_FILE), **vals)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    vals = _collect(main_program, scope, lambda v: v.persistable)
+    # optimizer state lives scope-side without block vars; include it
+    for name in scope.names():
+        if name not in vals and not name.startswith("@") \
+                and scope.find_var(name) is not None \
+                and not main_program.global_block().has_var(name):
+            vals[name] = np.asarray(scope.find_var(name))
+    np.savez(os.path.join(dirname, filename or PARAMS_FILE), **vals)
+
+
+def _load_npz(path, scope):
+    import jax.numpy as jnp
+    with np.load(path, allow_pickle=False) as data:
+        for name in data.files:
+            scope.set_var(name, jnp.asarray(data[name]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    _load_npz(os.path.join(dirname, filename or PARAMS_FILE),
+              global_scope())
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    _load_npz(os.path.join(dirname, filename or PARAMS_FILE),
+              global_scope())
+
+
+def _prune(program, feed_names, fetch_names):
+    """Backward-reachability prune from fetches, stopping at feeds —
+    io.py:921's prune+inference_optimize analog."""
+    blk = program.global_block()
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(blk.ops):
+        if op.type == "autodiff":
+            continue
+        if any(n in needed for n in op.output_names()):
+            kept.append(op)
+            needed.update(op.input_names())
+    kept.reverse()
+
+    pruned = Program()
+    pb = pruned.global_block()
+    for name, var in blk.vars.items():
+        if name in needed or name in fetch_names:
+            import copy
+            nv = copy.copy(var)
+            nv.block = pb
+            pb.vars[name] = nv
+    for op in kept:
+        new = Operator(pb, op.type, None, None, dict(op.attrs))
+        new.inputs = {k: list(v) for k, v in op.inputs.items()}
+        new.outputs = {k: list(v) for k, v in op.outputs.items()}
+        pb.ops.append(new)
+    pruned._bump()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None):
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    fetch_names = [t if isinstance(t, str) else t.name for t in target_vars]
+    inference_program = _prune(main_program.clone(for_test=True),
+                               feeded_var_names, fetch_names)
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+        "program": inference_program,
+    }
+    with open(os.path.join(dirname, model_filename or PROGRAM_FILE),
+              "wb") as f:
+        pickle.dump(meta, f)
+    vals = _collect(inference_program, global_scope(),
+                    lambda v: v.persistable)
+    np.savez(os.path.join(dirname, params_filename or PARAMS_FILE), **vals)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or PROGRAM_FILE),
+              "rb") as f:
+        meta = pickle.load(f)
+    _load_npz(os.path.join(dirname, params_filename or PARAMS_FILE),
+              global_scope())
+    program = meta["program"]
+    return program, meta["feed_names"], meta["fetch_names"]

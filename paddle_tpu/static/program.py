@@ -1,0 +1,345 @@
+"""Program IR: Program / Block / Operator / Variable.
+
+Parity: framework.proto ProgramDesc{BlockDesc{OpDesc,VarDesc}}
+(ref: paddle/fluid/framework/framework.proto:43-188) and the python
+builders (ref: python/paddle/fluid/framework.py Variable:376 Operator:985
+Block:1436 Program:2775 Parameter:3589).
+
+An Operator carries (type, input slots, output slots, attrs); semantics
+come from OP_REGISTRY[type], a pure function over jax arrays — the
+TPU-native replacement for the (place × dtype × layout) kernel registry
+(ref: framework/op_registry.h, operator.cc:986 ChooseKernel). Because every
+registered fn is traceable, a Block is a pure function of its inputs and
+can be jitted whole.
+"""
+
+import contextlib
+import copy
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.dtypes import convert_dtype, dtype_name
+from paddle_tpu.core.enforce import EnforceNotMet, enforce
+
+# ---------------------------------------------------------------------------
+# op registry: type -> fn(inputs: dict[str, list], attrs: dict) -> dict
+# ---------------------------------------------------------------------------
+OP_REGISTRY = {}
+
+
+def register_op(type_name, fn=None):
+    """Register an op compute function. fn(ins, attrs) -> outs, where ins
+    and outs are {slot: [array, ...]}."""
+    def deco(f):
+        OP_REGISTRY[type_name] = f
+        return f
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def register_simple(type_name, fn, in_slots=("X",), out_slot="Out"):
+    """Wrap a positional functional op: slots map to positional args,
+    attrs to kwargs."""
+    def compute(ins, attrs):
+        args = []
+        for s in in_slots:
+            vals = ins.get(s, [])
+            args.extend(vals)
+        out = fn(*args, **attrs)
+        return {out_slot: list(out) if isinstance(out, tuple) else [out]}
+    OP_REGISTRY[type_name] = compute
+    return compute
+
+
+# ---------------------------------------------------------------------------
+# IR node classes
+# ---------------------------------------------------------------------------
+class Variable:
+    """Symbolic tensor in a Block (VarDesc parity)."""
+
+    def __init__(self, block, name, shape=None, dtype="float32",
+                 persistable=False, stop_gradient=False, is_data=False,
+                 lod_level=0):
+        self.block = block
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = convert_dtype(dtype)
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.lod_level = lod_level
+
+    @property
+    def program(self):
+        return self.block.program
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={dtype_name(self.dtype)})")
+
+    # arithmetic sugar (framework.py monkey-patches these on Variable)
+    def _binary(self, other, op_type):
+        from paddle_tpu import layers
+        return getattr(layers, op_type)(self, other)
+
+    def __add__(self, other):
+        return self._binary(other, "elementwise_add")
+
+    def __sub__(self, other):
+        return self._binary(other, "elementwise_sub")
+
+    def __mul__(self, other):
+        return self._binary(other, "elementwise_mul")
+
+    def __truediv__(self, other):
+        return self._binary(other, "elementwise_div")
+
+
+class Parameter(Variable):
+    """Parameter (framework.py:3589 parity): persistable + trainable with
+    optimizer attributes."""
+
+    def __init__(self, block, name, shape, dtype="float32", trainable=True,
+                 optimize_attr=None, regularizer=None, gradient_clip=None,
+                 do_model_average=True, initializer=None):
+        super().__init__(block, name, shape, dtype, persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = optimize_attr or {"learning_rate": 1.0}
+        self.regularizer = regularizer
+        self.gradient_clip = gradient_clip
+        self.do_model_average = do_model_average
+        self.initializer = initializer
+
+
+class Operator:
+    """OpDesc parity: (type, inputs, outputs, attrs)."""
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.inputs = {k: [v if isinstance(v, str) else v.name
+                           for v in (vs if isinstance(vs, (list, tuple)) else [vs])]
+                       for k, vs in (inputs or {}).items()}
+        self.outputs = {k: [v if isinstance(v, str) else v.name
+                            for v in (vs if isinstance(vs, (list, tuple)) else [vs])]
+                        for k, vs in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input_names(self):
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self):
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def __repr__(self):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        return f"{{Op({self.type}): in={ins} out={outs}}}"
+
+
+class Block:
+    """BlockDesc parity: ordered ops + var table."""
+
+    def __init__(self, program, idx=0, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = {}
+        self.ops = []
+
+    def create_var(self, name=None, shape=None, dtype="float32", **kw):
+        from paddle_tpu.framework import unique_name
+        name = name or unique_name.generate("tmp")
+        v = Variable(self, name, shape, dtype, **kw)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name, shape, dtype="float32", **kw):
+        p = Parameter(self, name, shape, dtype, **kw)
+        self.vars[name] = p
+        return p
+
+    def var(self, name):
+        v = self.vars.get(name)
+        if v is None:
+            raise EnforceNotMet(f"Variable {name!r} not found in block "
+                                f"{self.idx}")
+        return v
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        enforce(type in OP_REGISTRY or type in ("autodiff",),
+                f"op type {type!r} has no registered compute function")
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        self.program._bump()
+        return op
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def __repr__(self):
+        lines = [f"Block[{self.idx}] vars={len(self.vars)}"]
+        lines += [f"  {op!r}" for op in self.ops]
+        return "\n".join(lines)
+
+
+class Program:
+    """ProgramDesc parity. Single current block for now; sub-blocks are
+    carried inside op attrs (structured control flow) rather than as flat
+    block lists — lax.cond/scan hold their bodies the same way."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0
+        self._seed_counter = 0
+        # literal (non-Variable) operands captured at graph-build time,
+        # name -> jnp array; Executor seeds the trace env with these
+        self._constants = {}
+        # bookkeeping used by append_backward / optimizers
+        self._loss_names = []
+        self._lr_schedulers = []
+        # optional gradient clip installed by clip.set_gradient_clip
+        self._grad_clip = None
+
+    def _bump(self):
+        self._version += 1
+
+    @property
+    def version(self):
+        return self._version
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    def list_vars(self):
+        return list(self.global_block().vars.values())
+
+    def clone(self, for_test=False):
+        """Program.clone parity. for_test=True freezes dropout/batch_norm
+        to inference behavior (the reference rewrites op attrs the same
+        way, framework.py clone)."""
+        p = Program()
+        p.random_seed = self.random_seed
+        p._constants = dict(self._constants)
+        p._grad_clip = self._grad_clip
+        blk = p.global_block()
+        blk.vars = {n: copy.copy(v) for n, v in self.global_block().vars.items()}
+        for v in blk.vars.values():
+            v.block = blk
+        for op in self.global_block().ops:
+            attrs = dict(op.attrs)
+            if for_test and "is_test" in _TEST_MODE_ATTRS.get(op.type, ()):
+                attrs["is_test"] = True
+            new = Operator(blk, op.type, None, None, attrs)
+            new.inputs = {k: list(v) for k, v in op.inputs.items()}
+            new.outputs = {k: list(v) for k, v in op.outputs.items()}
+            blk.ops.append(new)
+        p._bump()
+        return p
+
+    def __repr__(self):
+        return "\n".join(repr(b) for b in self.blocks)
+
+
+_TEST_MODE_ATTRS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+
+# ---------------------------------------------------------------------------
+# default program machinery (framework.py default_main_program parity)
+# ---------------------------------------------------------------------------
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "main"):
+        _tls.main = Program()
+        _tls.startup = Program()
+        _tls.static_mode = False
+    return _tls
+
+
+def default_main_program():
+    return _state().main
+
+
+def default_startup_program():
+    return _state().startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    st = _state()
+    old = (st.main, st.startup, st.static_mode)
+    st.main = main_program
+    if startup_program is not None:
+        st.startup = startup_program
+    st.static_mode = True
+    try:
+        yield
+    finally:
+        st.main, st.startup, st.static_mode = old
+
+
+def in_static_mode():
+    return _state().static_mode
+
+
+def enable_static():
+    """Switch the ambient mode to static graph building (fluid's default
+    posture): layer calls append ops to default_main_program(). Matches
+    paddle.enable_static(); fluid-1.x-style scripts call this once at the
+    top instead of wrapping everything in program_guard."""
+    _state().static_mode = True
+
+
+def disable_static():
+    """Back to eager (dygraph) dispatch — the package default."""
+    _state().static_mode = False
+
+
+@contextlib.contextmanager
+def static_mode_guard(on=True):
+    st = _state()
+    old = st.static_mode
+    st.static_mode = on
+    try:
+        yield
+    finally:
+        st.static_mode = old
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    """fluid.name_scope parity (purely cosmetic here)."""
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True):
+    """fluid.layers.data / fluid.data parity: declare a feed variable.
+
+    append_batch_size=True prepends a batch dim (the fluid.layers.data
+    convention where shape omits batch). ``None`` dims (the fluid.data /
+    2.x spelling of "dynamic") normalize to -1."""
+    shape = [-1 if s is None else int(s) for s in shape]
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    blk = default_main_program().global_block()
+    v = blk.create_var(name=name, shape=shape, dtype=dtype, is_data=True,
+                       lod_level=lod_level, stop_gradient=True)
+    return v

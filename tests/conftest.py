@@ -1,0 +1,55 @@
+"""Test env: force a virtual 8-device CPU platform BEFORE jax imports.
+
+Mirrors the reference's CI posture (GPU tests runnable on CPU,
+ref: SURVEY §4 implication) — all sharding/collective tests run on an
+8-device CPU mesh; real-TPU runs use the same code with the env unset.
+"""
+
+import os
+
+# Force CPU: the ambient env pins JAX_PLATFORMS to the real TPU tunnel
+# (single chip, serialized), which unit tests must not touch. The TPU
+# PJRT plugin is registered by a sitecustomize at interpreter startup —
+# before this conftest runs and with jax already imported — so env vars
+# alone are too late. Backend init is lazy, though: dropping the plugin's
+# backend factory and updating jax.config before the first jax.devices()
+# call gives a pure 8-device virtual-CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax internals moved
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import paddle_tpu
+    from paddle_tpu.core import random as ptrandom
+    ptrandom.seed(0)
+    yield
+
+
+@pytest.fixture
+def fresh_programs():
+    """Fresh default main/startup programs + scope for static tests."""
+    import paddle_tpu as pt
+    from paddle_tpu.static.executor import Scope, scope_guard
+    from paddle_tpu.framework import unique_name
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard(), \
+            scope_guard(Scope()) as scope:
+        yield main, startup, scope

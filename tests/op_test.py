@@ -1,0 +1,67 @@
+"""Universal op test harness.
+
+Parity: python/paddle/fluid/tests/unittests/op_test.py (OpTest:134) —
+the reference checks every op's gradient against numeric finite
+differences (get_numeric_gradient op_test.py:45, check_grad :532). Here
+the analytic side is jax.grad over the functional op; the numeric side is
+central differences; both run on CPU XLA.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def numeric_grad(fn, args, wrt, eps=5e-3):
+    """d(sum(fn(args)))/d(args[wrt]) by central differences."""
+    args = [np.asarray(a) for a in args]
+    base = [np.array(a, dtype=np.float64) if a.dtype.kind == "f" else a
+            for a in args]
+    x = base[wrt]
+    grad = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def eval_sum(xv):
+        call_args = list(base)
+        call_args[wrt] = xv.astype(args[wrt].dtype)
+        out = fn(*[jnp.asarray(a.astype(np.float32)
+                               if a.dtype == np.float64 else a)
+                   for a in call_args])
+        leaves = jax.tree.leaves(out)
+        return float(sum(np.sum(np.asarray(l, np.float64)) for l in leaves))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = eval_sum(x)
+        flat[i] = orig - eps
+        fm = eval_sum(x)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * eps)
+    return grad
+
+
+def check_grad(fn, args, wrt=0, rtol=1e-2, atol=1e-3, eps=5e-3):
+    """Compare jax.grad of sum(fn) against numeric finite differences."""
+    jargs = [jnp.asarray(np.asarray(a, np.float32)
+                         if np.asarray(a).dtype.kind == "f"
+                         else np.asarray(a)) for a in args]
+
+    def loss(x):
+        call = list(jargs)
+        call[wrt] = x
+        out = fn(*call)
+        return sum(jnp.sum(l.astype(jnp.float32))
+                   for l in jax.tree.leaves(out))
+
+    analytic = np.asarray(jax.grad(loss)(jargs[wrt]), np.float64)
+    numeric = numeric_grad(fn, args, wrt, eps)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+def check_output(fn, args, expected, rtol=1e-5, atol=1e-6):
+    out = fn(*[jnp.asarray(a) for a in args])
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=rtol,
+                               atol=atol)

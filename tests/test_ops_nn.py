@@ -1,0 +1,236 @@
+"""conv / pool / norm / dropout / embedding / sequence op tests."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu import ops
+from paddle_tpu.core.lod import RaggedBatch
+from op_test import check_grad
+
+
+def r(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+class TestConv:
+    def test_conv2d_shape(self):
+        x, w = r(2, 3, 8, 8), r(6, 3, 3, 3)
+        assert ops.conv2d(x, w).shape == (2, 6, 6, 6)
+        assert ops.conv2d(x, w, padding=1).shape == (2, 6, 8, 8)
+        assert ops.conv2d(x, w, stride=2, padding=1).shape == (2, 6, 4, 4)
+
+    def test_conv2d_identity(self):
+        x = r(1, 1, 5, 5)
+        w = np.zeros((1, 1, 3, 3), np.float32)
+        w[0, 0, 1, 1] = 1.0
+        out = ops.conv2d(x, w, padding=1)
+        np.testing.assert_allclose(out, x, rtol=1e-5)
+
+    def test_conv2d_grad(self):
+        x, w = r(1, 2, 5, 5), r(3, 2, 3, 3)
+        check_grad(lambda a, b: ops.conv2d(a, b, padding=1), [x, w], wrt=0,
+                   rtol=2e-2, atol=2e-3)
+        check_grad(lambda a, b: ops.conv2d(a, b, padding=1), [x, w], wrt=1,
+                   rtol=2e-2, atol=2e-3)
+
+    def test_depthwise(self):
+        x, w = r(2, 4, 6, 6), r(4, 1, 3, 3)
+        assert ops.depthwise_conv2d(x, w, padding=1).shape == (2, 4, 6, 6)
+
+    def test_conv2d_transpose_shape(self):
+        x, w = r(2, 4, 5, 5), r(4, 6, 3, 3)
+        out = ops.conv2d_transpose(x, w, stride=2, padding=1)
+        assert out.shape == (2, 6, 9, 9)
+
+    def test_conv_transpose_inverts_stride1(self):
+        # conv_transpose with 1x1 identity weight == identity
+        x = r(1, 2, 4, 4)
+        w = np.zeros((2, 2, 1, 1), np.float32)
+        w[0, 0, 0, 0] = 1.0
+        w[1, 1, 0, 0] = 1.0
+        out = ops.conv2d_transpose(x, w)
+        np.testing.assert_allclose(out, x, rtol=1e-5)
+
+
+class TestPool:
+    def test_maxpool(self):
+        x = r(2, 3, 6, 6)
+        out = ops.pool2d(x, 2, "max", 2)
+        expect = x.reshape(2, 3, 3, 2, 3, 2).max((3, 5))
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_avgpool(self):
+        x = r(2, 3, 6, 6)
+        out = ops.pool2d(x, 2, "avg", 2)
+        expect = x.reshape(2, 3, 3, 2, 3, 2).mean((3, 5))
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_global_pool(self):
+        x = r(2, 3, 5, 5)
+        out = ops.pool2d(x, pool_type="avg", global_pooling=True)
+        np.testing.assert_allclose(out[..., 0, 0], x.mean((2, 3)),
+                                   rtol=1e-5)
+
+    def test_avg_exclusive_padding(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        out = ops.pool2d(x, 3, "avg", 1, 1, exclusive=True)
+        # exclusive: corners average over 4 valid cells -> still 1.0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.ones_like(np.asarray(out)), rtol=1e-5)
+
+
+class TestNorms:
+    def test_batch_norm_train(self):
+        x = r(4, 3, 5, 5) * 3 + 1
+        scale, bias = np.ones(3, np.float32), np.zeros(3, np.float32)
+        mean, var = np.zeros(3, np.float32), np.ones(3, np.float32)
+        out, m_out, v_out, sm, sv = ops.batch_norm(
+            x, scale, bias, mean, var, is_test=False)
+        np.testing.assert_allclose(np.asarray(out).mean((0, 2, 3)),
+                                   np.zeros(3), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out).std((0, 2, 3)),
+                                   np.ones(3), atol=1e-3)
+        # running stats: new = m*old + (1-m)*batch
+        np.testing.assert_allclose(
+            np.asarray(m_out), 0.1 * x.mean((0, 2, 3)), rtol=1e-4)
+
+    def test_batch_norm_infer(self):
+        x = r(4, 3, 2, 2)
+        scale = np.full(3, 2.0, np.float32)
+        bias = np.full(3, 0.5, np.float32)
+        mean, var = np.zeros(3, np.float32), np.ones(3, np.float32)
+        out, *_ = ops.batch_norm(x, scale, bias, mean, var, is_test=True,
+                                 epsilon=0.0)
+        np.testing.assert_allclose(
+            out, x * 2.0 + 0.5, rtol=1e-4, atol=1e-5)
+
+    def test_layer_norm(self):
+        x = r(4, 10)
+        out = ops.layer_norm(x, np.ones(10, np.float32),
+                             np.zeros(10, np.float32))
+        np.testing.assert_allclose(np.asarray(out).mean(-1), np.zeros(4),
+                                   atol=1e-5)
+        check_grad(lambda t: ops.layer_norm(
+            t, jnp.ones(10), jnp.zeros(10)), [x], rtol=2e-2, atol=2e-3)
+
+    def test_group_norm(self):
+        x = r(2, 8, 4, 4)
+        out = ops.group_norm(x, groups=4)
+        g = np.asarray(out).reshape(2, 4, 2, 4, 4)
+        np.testing.assert_allclose(g.mean((2, 3, 4)), np.zeros((2, 4)),
+                                   atol=1e-5)
+
+
+class TestDropoutEmbedding:
+    def test_dropout_modes(self):
+        import jax
+        x = np.ones((100, 100), np.float32)
+        rng = jax.random.PRNGKey(0)
+        out = np.asarray(ops.dropout(x, 0.3, rng=rng))
+        frac = (out == 0).mean()
+        assert 0.25 < frac < 0.35
+        # downgrade_in_infer: test-time scales by (1-p)
+        ti = np.asarray(ops.dropout(x, 0.3, is_test=True))
+        np.testing.assert_allclose(ti, x * 0.7, rtol=1e-6)
+        # upscale_in_train: train-time scales kept by 1/(1-p)
+        up = np.asarray(ops.dropout(
+            x, 0.3, rng=rng, dropout_implementation="upscale_in_train"))
+        kept = up[up != 0]
+        np.testing.assert_allclose(kept, np.full_like(kept, 1 / 0.7),
+                                   rtol=1e-5)
+
+    def test_embedding(self):
+        w = r(10, 4)
+        ids = np.array([[1], [3], [9]], np.int64)
+        out = ops.embedding(ids, w)
+        np.testing.assert_allclose(out, w[[1, 3, 9]], rtol=1e-6)
+        out2 = ops.embedding(ids, w, padding_idx=3)
+        assert np.allclose(np.asarray(out2)[1], 0.0)
+
+    def test_embedding_grad_is_sparse_rowsum(self):
+        import jax
+        w = r(5, 3)
+        ids = np.array([0, 0, 2], np.int64)
+        g = jax.grad(lambda t: float(0) + jnp.sum(
+            ops.embedding(ids, t) * 1.0))(jnp.asarray(w))
+        assert np.asarray(g)[0].sum() != 0
+        assert np.allclose(np.asarray(g)[1], 0)
+
+
+class TestSequence:
+    def make(self):
+        return RaggedBatch.from_list(
+            [np.arange(3 * 2).reshape(3, 2).astype(np.float32),
+             np.arange(5 * 2).reshape(5, 2).astype(np.float32) + 1],
+        )
+
+    def test_mask(self):
+        rb = self.make()
+        m = np.asarray(rb.mask())
+        assert m.shape == (2, 5)
+        np.testing.assert_allclose(m[0], [1, 1, 1, 0, 0])
+
+    def test_pool_sum_mean_max(self):
+        rb = self.make()
+        s = np.asarray(ops.sequence_pool(rb, "sum"))
+        np.testing.assert_allclose(s[0], rb.data[0, :3].sum(0), rtol=1e-6)
+        m = np.asarray(ops.sequence_pool(rb, "average"))
+        np.testing.assert_allclose(m[1], np.asarray(rb.data[1]).mean(0),
+                                   rtol=1e-6)
+        mx = np.asarray(ops.sequence_pool(rb, "max"))
+        np.testing.assert_allclose(mx[0], np.asarray(rb.data[0, :3]).max(0),
+                                   rtol=1e-6)
+
+    def test_first_last(self):
+        rb = self.make()
+        f = np.asarray(ops.sequence_first_step(rb))
+        l = np.asarray(ops.sequence_last_step(rb))
+        np.testing.assert_allclose(f[0], rb.data[0, 0], rtol=1e-6)
+        np.testing.assert_allclose(l[0], rb.data[0, 2], rtol=1e-6)
+
+    def test_softmax(self):
+        rb = self.make()
+        out = ops.sequence_softmax(RaggedBatch(rb.data[..., 0], rb.lengths))
+        o = np.asarray(out.data)
+        np.testing.assert_allclose(o[0, :3].sum(), 1.0, rtol=1e-5)
+        assert np.allclose(o[0, 3:], 0.0)
+
+    def test_reverse(self):
+        rb = self.make()
+        out = ops.sequence_reverse(rb)
+        np.testing.assert_allclose(np.asarray(out.data)[0, 0],
+                                   np.asarray(rb.data)[0, 2], rtol=1e-6)
+
+    def test_lod_roundtrip(self):
+        flat = np.arange(8).reshape(8, 1).astype(np.float32)
+        rb = RaggedBatch.from_lod(flat, [[0, 3, 8]])
+        assert rb.batch_size == 2 and rb.max_len == 5
+        flat2, lod = rb.to_lod()
+        np.testing.assert_allclose(flat2, flat, rtol=1e-6)
+        assert lod == [[0, 3, 8]]
+
+
+class TestControlFlow:
+    def test_dynamic_rnn_stops_at_length(self):
+        data = np.ones((2, 4, 3), np.float32)
+        rb = RaggedBatch(jnp.asarray(data),
+                         jnp.asarray(np.array([2, 4], np.int32)))
+
+        def step(state, x):
+            new = state + x[:, 0]
+            return new, new
+
+        final, outs = ops.dynamic_rnn(step, rb,
+                                      jnp.zeros((2,), jnp.float32))
+        np.testing.assert_allclose(np.asarray(final), [2.0, 4.0],
+                                   rtol=1e-6)
+
+    def test_while_cond(self):
+        out = ops.while_loop(lambda i, s: i < 5,
+                             lambda i, s: (i + 1, s + i),
+                             [jnp.int32(0), jnp.int32(0)])
+        assert int(out[1]) == 10
+        y = ops.cond(jnp.bool_(True), lambda: 1.0, lambda: 2.0)
+        assert float(y) == 1.0
