@@ -1,0 +1,63 @@
+"""Benchmark: BERT-base MLM pretraining step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = measured MFU / 0.35 (the BASELINE.json north-star MFU).
+Metric format follows the reference's examples/sec convention
+(ref: benchmark/fluid/fluid_benchmark.py:297-300), as tokens/sec here.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, set_mesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    cfg = bert.bert_base() if on_tpu else bert.bert_tiny()
+    batch, seq = (32, 512) if on_tpu else (2, 32)
+    steps = 20 if on_tpu else 3
+
+    # single-chip benchmark: pin a 1-device mesh whatever the platform
+    mesh = set_mesh(make_mesh(MeshConfig(data=1),
+                              devices=jax.devices()[:1]))
+    opt = pt.optimizer.Adam(learning_rate=1e-4)
+    init_fn, step_fn = bert.make_train_step(cfg, opt, mesh)
+    data = bert.synthetic_batch(cfg, batch_size=batch, seq_len=seq)
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+
+    # warmup/compile
+    loss, params, opt_state = step_fn(params, opt_state, data)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state = step_fn(params, opt_state, data)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_sec = tokens / dt
+    # MFU vs bf16 peak (v5e ~197 TFLOP/s; other gens still get a number)
+    peak = 197e12
+    flops = bert.flops_per_token(cfg, seq_len=seq)
+    mfu = tok_per_sec * flops / peak
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }))
+    print(f"# device={dev.platform} batch={batch} seq={seq} steps={steps} "
+          f"loss={float(loss):.4f} mfu={mfu:.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Model zoo.
+
+Parity targets: the reference's benchmark models
+(ref: benchmark/fluid/models/{mnist,resnet,vgg,stacked_dynamic_lstm,
+machine_translation}.py) and book examples (ref:
+python/paddle/fluid/tests/book/). BERT/transformer is the flagship
+(north-star config in BASELINE.json) — not in the reference's zoo but its
+ERNIE/transformer tests (dist_transformer.py) set the shape.
+"""
+
+from paddle_tpu.models import bert
+
+__all__ = ["bert"]
