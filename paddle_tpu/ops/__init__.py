@@ -21,3 +21,4 @@ from paddle_tpu.ops.sequence import *        # noqa: F401,F403
 from paddle_tpu.ops.random_ops import *      # noqa: F401,F403
 from paddle_tpu.ops.control_flow import *    # noqa: F401,F403
 from paddle_tpu.ops.metric_ops import *      # noqa: F401,F403
+from paddle_tpu.ops.rnn import *             # noqa: F401,F403

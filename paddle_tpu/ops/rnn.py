@@ -1,0 +1,218 @@
+"""Recurrent ops: LSTM / GRU / simple RNN over padded batches.
+
+Parity targets: operators/lstm_op.cc, operators/gru_op.cc,
+operators/lstmp_op.cc, operators/cudnn_lstm_op.cu.cc and the math kernels
+operators/math/lstm_compute.cc / gru_compute.cc. The reference consumes
+LoD-batched sequences (framework/lod_tensor.h:229); here sequences are
+dense-padded [B, T, D] with an optional lengths vector (the LoD
+replacement, SURVEY §5.7) and recurrence is a lax.scan over time — one
+compiled loop instead of a per-step op chain (ref:
+operators/recurrent_op.cc).
+
+Gate layouts follow the reference: LSTM gate order i,f,c,o
+(math/lstm_compute wiring), GRU gate order update,reset,candidate
+(math/gru_compute).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["lstm", "dynamic_lstm", "dynamic_lstmp", "gru", "dynamic_gru",
+           "simple_rnn", "bidirectional_lstm"]
+
+
+def _mask_from_lengths(lengths, T, B):
+    if lengths is None:
+        return None
+    return (jnp.arange(T)[None, :] < lengths[:, None]).astype(jnp.float32)
+
+
+def lstm(x, w_ih, w_hh, b=None, h0=None, c0=None, lengths=None,
+         reverse=False):
+    """Single-layer LSTM. x: [B,T,D]; w_ih: [D,4H] or None when x is
+    already pre-projected [B,T,4H]; w_hh: [H,4H]; b: [4H]. Gate order
+    i,f,c,o (ref: operators/math/lstm_compute.h). Returns
+    (outputs [B,T,H], (h_T, c_T)). Padded steps (t >= lengths[b]) carry
+    state through unchanged and output 0."""
+    B, T, D = x.shape
+    H = w_hh.shape[0]
+    dt = x.dtype
+    h0 = h0 if h0 is not None else jnp.zeros((B, H), dt)
+    c0 = c0 if c0 is not None else jnp.zeros((B, H), dt)
+    mask = _mask_from_lengths(lengths, T, B)
+
+    # hoist the input projection out of the scan: one big MXU matmul
+    xp = x if w_ih is None else (x.reshape(B * T, D) @ w_ih)
+    if b is not None:
+        xp = xp + b
+    xp = xp.reshape(B, T, 4 * H)
+    if reverse:
+        xp = xp[:, ::-1]
+        mask = mask[:, ::-1] if mask is not None else None
+
+    def step(carry, t):
+        h, c = carry
+        xt, mt = t
+        gates = xt + h @ w_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if mt is not None:
+            m = mt[:, None]
+            c_new = m * c_new + (1 - m) * c
+            h_new = m * h_new + (1 - m) * h
+            out = h_new * m
+        else:
+            out = h_new
+        return (h_new, c_new), out
+
+    xs = (xp.transpose(1, 0, 2),
+          mask.transpose(1, 0) if mask is not None else None)
+    (hT, cT), outs = jax.lax.scan(step, (h0, c0), xs)
+    outs = outs.transpose(1, 0, 2)
+    if reverse:
+        outs = outs[:, ::-1]
+    return outs, (hT, cT)
+
+
+def dynamic_lstm(input, w_hh, bias=None, h0=None, c0=None, lengths=None,
+                 is_reverse=False, name=None):
+    """fluid.layers.dynamic_lstm parity (ref: operators/lstm_op.cc): input
+    is the *pre-projected* x@W [B,T,4H]; w_hh [H,4H]."""
+    return lstm(input, None, w_hh, b=bias, h0=h0, c0=c0, lengths=lengths,
+                reverse=is_reverse)
+
+
+def dynamic_lstmp(input, w_hh, w_proj, bias=None, lengths=None,
+                  is_reverse=False, name=None):
+    """LSTM with recurrent projection (ref: operators/lstmp_op.cc):
+    hidden H is projected to P each step; w_hh: [P,4H], w_proj: [H,P]."""
+    B, T, fourH = input.shape
+    H = fourH // 4
+    P_ = w_proj.shape[1]
+    dt = input.dtype
+    mask = _mask_from_lengths(lengths, T, B)
+    xp = input + (bias if bias is not None else 0.0)
+    if is_reverse:
+        xp = xp[:, ::-1]
+        mask = mask[:, ::-1] if mask is not None else None
+
+    def step(carry, t):
+        r, c = carry            # r: projected hidden [B,P]
+        xt, mt = t
+        gates = xt + r @ w_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        r_new = h_new @ w_proj
+        if mt is not None:
+            m = mt[:, None]
+            c_new = m * c_new + (1 - m) * c
+            r_new = m * r_new + (1 - m) * r
+            out = r_new * m
+        else:
+            out = r_new
+        return (r_new, c_new), out
+
+    xs = (xp.transpose(1, 0, 2),
+          mask.transpose(1, 0) if mask is not None else None)
+    (rT, cT), outs = jax.lax.scan(
+        step, (jnp.zeros((B, P_), dt), jnp.zeros((B, H), dt)), xs)
+    outs = outs.transpose(1, 0, 2)
+    if is_reverse:
+        outs = outs[:, ::-1]
+    return outs, (rT, cT)
+
+
+def gru(x, w_ih, w_hh, b=None, h0=None, lengths=None, reverse=False):
+    """Single-layer GRU. x: [B,T,D]; w_ih: [D,3H] or None when x is
+    pre-projected [B,T,3H]; w_hh: [H,3H], gate order
+    update,reset,candidate (ref: operators/math/gru_compute.cc). Returns
+    (outputs [B,T,H], h_T)."""
+    B, T, D = x.shape
+    H = w_hh.shape[0]
+    dt = x.dtype
+    h0 = h0 if h0 is not None else jnp.zeros((B, H), dt)
+    mask = _mask_from_lengths(lengths, T, B)
+
+    xp = x if w_ih is None else (x.reshape(B * T, D) @ w_ih)
+    if b is not None:
+        xp = xp + b
+    xp = xp.reshape(B, T, 3 * H)
+    if reverse:
+        xp = xp[:, ::-1]
+        mask = mask[:, ::-1] if mask is not None else None
+    w_uz, w_c = w_hh[:, :2 * H], w_hh[:, 2 * H:]
+
+    def step(h, t):
+        xt, mt = t
+        xu, xr, xc = jnp.split(xt, 3, axis=-1)
+        hz = h @ w_uz
+        u = jax.nn.sigmoid(xu + hz[:, :H])
+        r = jax.nn.sigmoid(xr + hz[:, H:])
+        c = jnp.tanh(xc + (r * h) @ w_c)
+        h_new = u * h + (1 - u) * c
+        if mt is not None:
+            m = mt[:, None]
+            h_new = m * h_new + (1 - m) * h
+            out = h_new * m
+        else:
+            out = h_new
+        return h_new, out
+
+    xs = (xp.transpose(1, 0, 2),
+          mask.transpose(1, 0) if mask is not None else None)
+    hT, outs = jax.lax.scan(step, h0, xs)
+    outs = outs.transpose(1, 0, 2)
+    if reverse:
+        outs = outs[:, ::-1]
+    return outs, hT
+
+
+def dynamic_gru(input, w_hh, bias=None, h0=None, lengths=None,
+                is_reverse=False, name=None):
+    """fluid.layers.dynamic_gru parity (ref: operators/gru_op.cc): input
+    pre-projected [B,T,3H]."""
+    return gru(input, None, w_hh, b=bias, h0=h0, lengths=lengths,
+               reverse=is_reverse)
+
+
+def simple_rnn(x, w_ih, w_hh, b=None, h0=None, lengths=None, act=jnp.tanh):
+    """Vanilla RNN (the StaticRNN building block,
+    ref: layers/control_flow.py StaticRNN:280)."""
+    B, T, D = x.shape
+    H = w_hh.shape[0]
+    dt = x.dtype
+    h0 = h0 if h0 is not None else jnp.zeros((B, H), dt)
+    mask = _mask_from_lengths(lengths, T, B)
+    xp = x.reshape(B * T, D) @ w_ih
+    if b is not None:
+        xp = xp + b
+    xp = xp.reshape(B, T, H)
+
+    def step(h, t):
+        xt, mt = t
+        h_new = act(xt + h @ w_hh)
+        if mt is not None:
+            m = mt[:, None]
+            h_new = m * h_new + (1 - m) * h
+            return h_new, h_new * m
+        return h_new, h_new
+
+    xs = (xp.transpose(1, 0, 2),
+          mask.transpose(1, 0) if mask is not None else None)
+    hT, outs = jax.lax.scan(step, h0, xs)
+    return outs.transpose(1, 0, 2), hT
+
+
+def bidirectional_lstm(x, fwd_w_ih, fwd_w_hh, bwd_w_ih, bwd_w_hh,
+                       fwd_b=None, bwd_b=None, lengths=None):
+    """Concat of forward + reverse LSTM outputs (the cudnn_lstm
+    bidirectional mode, ref: operators/cudnn_lstm_op.cu.cc)."""
+    f, _ = lstm(x, fwd_w_ih, fwd_w_hh, b=fwd_b, lengths=lengths)
+    b, _ = lstm(x, bwd_w_ih, bwd_w_hh, b=bwd_b, lengths=lengths,
+                reverse=True)
+    return jnp.concatenate([f, b], axis=-1)

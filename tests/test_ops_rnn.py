@@ -1,0 +1,160 @@
+"""RNN op tests: LSTM/GRU vs a pure-numpy step reference + masking/grad
+checks (the OpTest pattern, ref: unittests/test_lstm_op.py,
+test_gru_op.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import rnn
+
+
+def np_lstm_ref(x, w_ih, w_hh, b):
+    B, T, D = x.shape
+    H = w_hh.shape[0]
+    h = np.zeros((B, H)); c = np.zeros((B, H))
+    outs = []
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for t in range(T):
+        g = x[:, t] @ w_ih + h @ w_hh + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs, 1), h, c
+
+
+def np_gru_ref(x, w_ih, w_hh, b):
+    B, T, D = x.shape
+    H = w_hh.shape[0]
+    h = np.zeros((B, H))
+    outs = []
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for t in range(T):
+        p = x[:, t] @ w_ih + b
+        xu, xr, xc = np.split(p, 3, axis=-1)
+        hz = h @ w_hh[:, :2 * H]
+        u = sig(xu + hz[:, :H])
+        r = sig(xr + hz[:, H:])
+        cand = np.tanh(xc + (r * h) @ w_hh[:, 2 * H:])
+        h = u * h + (1 - u) * cand
+        outs.append(h)
+    return np.stack(outs, 1), h
+
+
+def _rand(shape, seed):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32) * 0.3
+
+
+class TestLSTM:
+    def test_matches_numpy(self):
+        B, T, D, H = 3, 5, 4, 6
+        x = _rand((B, T, D), 0)
+        w_ih, w_hh, b = _rand((D, 4 * H), 1), _rand((H, 4 * H), 2), \
+            _rand((4 * H,), 3)
+        outs, (hT, cT) = rnn.lstm(jnp.asarray(x), jnp.asarray(w_ih),
+                                  jnp.asarray(w_hh), jnp.asarray(b))
+        ro, rh, rc = np_lstm_ref(x, w_ih, w_hh, b)
+        assert np.allclose(np.asarray(outs), ro, atol=1e-5)
+        assert np.allclose(np.asarray(hT), rh, atol=1e-5)
+        assert np.allclose(np.asarray(cT), rc, atol=1e-5)
+
+    def test_masking(self):
+        """Sequence b with length L: outputs beyond L are 0 and final state
+        equals the state at step L."""
+        B, T, D, H = 2, 6, 3, 4
+        x = _rand((B, T, D), 0)
+        w_ih, w_hh = _rand((D, 4 * H), 1), _rand((H, 4 * H), 2)
+        lengths = jnp.asarray([6, 3])
+        outs, (hT, _) = rnn.lstm(jnp.asarray(x), jnp.asarray(w_ih),
+                                 jnp.asarray(w_hh), lengths=lengths)
+        assert np.allclose(np.asarray(outs)[1, 3:], 0.0)
+        # final state of seq 1 == running the first 3 steps only
+        outs3, (h3, _) = rnn.lstm(jnp.asarray(x[1:2, :3]),
+                                  jnp.asarray(w_ih), jnp.asarray(w_hh))
+        assert np.allclose(np.asarray(hT)[1], np.asarray(h3)[0], atol=1e-5)
+
+    def test_reverse(self):
+        B, T, D, H = 2, 4, 3, 4
+        x = _rand((B, T, D), 0)
+        w_ih, w_hh = _rand((D, 4 * H), 1), _rand((H, 4 * H), 2)
+        outs_r, _ = rnn.lstm(jnp.asarray(x), jnp.asarray(w_ih),
+                             jnp.asarray(w_hh), reverse=True)
+        outs_f, _ = rnn.lstm(jnp.asarray(x[:, ::-1]), jnp.asarray(w_ih),
+                             jnp.asarray(w_hh))
+        assert np.allclose(np.asarray(outs_r), np.asarray(outs_f)[:, ::-1],
+                           atol=1e-5)
+
+    def test_grad_finite_diff(self):
+        B, T, D, H = 2, 3, 3, 3
+        x = _rand((B, T, D), 0)
+        w_ih = _rand((D, 4 * H), 1)
+        w_hh = _rand((H, 4 * H), 2)
+
+        def f(w):
+            outs, _ = rnn.lstm(jnp.asarray(x), jnp.asarray(w_ih), w)
+            return jnp.sum(outs ** 2)
+
+        g = jax.grad(f)(jnp.asarray(w_hh))
+        eps = 1e-3
+        for idx in [(0, 0), (2, 7)]:
+            d = jnp.zeros_like(g).at[idx].set(eps)
+            fd = (f(jnp.asarray(w_hh) + d) - f(jnp.asarray(w_hh) - d)) \
+                / (2 * eps)
+            assert abs(float(g[idx]) - float(fd)) < 1e-3
+
+    def test_bidirectional(self):
+        B, T, D, H = 2, 4, 3, 4
+        x = _rand((B, T, D), 0)
+        ws = [_rand((D, 4 * H), i) for i in (1, 3)]
+        whs = [_rand((H, 4 * H), i) for i in (2, 4)]
+        out = rnn.bidirectional_lstm(jnp.asarray(x), jnp.asarray(ws[0]),
+                                     jnp.asarray(whs[0]), jnp.asarray(ws[1]),
+                                     jnp.asarray(whs[1]))
+        assert out.shape == (B, T, 2 * H)
+
+
+class TestGRU:
+    def test_matches_numpy(self):
+        B, T, D, H = 3, 5, 4, 6
+        x = _rand((B, T, D), 0)
+        w_ih, w_hh, b = _rand((D, 3 * H), 1), _rand((H, 3 * H), 2), \
+            _rand((3 * H,), 3)
+        outs, hT = rnn.gru(jnp.asarray(x), jnp.asarray(w_ih),
+                           jnp.asarray(w_hh), jnp.asarray(b))
+        ro, rh = np_gru_ref(x, w_ih, w_hh, b)
+        assert np.allclose(np.asarray(outs), ro, atol=1e-5)
+        assert np.allclose(np.asarray(hT), rh, atol=1e-5)
+
+    def test_dynamic_gru_preprojected(self):
+        B, T, D, H = 2, 4, 5, 4
+        x = _rand((B, T, D), 0)
+        w_ih, w_hh = _rand((D, 3 * H), 1), _rand((H, 3 * H), 2)
+        pre = jnp.asarray(x.reshape(B * T, D) @ w_ih).reshape(B, T, 3 * H)
+        o1, h1 = rnn.dynamic_gru(pre, jnp.asarray(w_hh))
+        o2, h2 = rnn.gru(jnp.asarray(x), jnp.asarray(w_ih),
+                         jnp.asarray(w_hh))
+        assert np.allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
+class TestLSTMP:
+    def test_shapes_and_projection(self):
+        B, T, H, Pdim = 2, 4, 6, 3
+        pre = jnp.asarray(_rand((B, T, 4 * H), 0))
+        w_hh = jnp.asarray(_rand((Pdim, 4 * H), 1))
+        w_proj = jnp.asarray(_rand((H, Pdim), 2))
+        outs, (rT, cT) = rnn.dynamic_lstmp(pre, w_hh, w_proj)
+        assert outs.shape == (B, T, Pdim)
+        assert rT.shape == (B, Pdim)
+        assert cT.shape == (B, H)
+
+
+class TestSimpleRNN:
+    def test_runs(self):
+        B, T, D, H = 2, 3, 3, 4
+        x = _rand((B, T, D), 0)
+        outs, hT = rnn.simple_rnn(jnp.asarray(x),
+                                  jnp.asarray(_rand((D, H), 1)),
+                                  jnp.asarray(_rand((H, H), 2)))
+        assert outs.shape == (B, T, H)
+        assert np.allclose(np.asarray(outs[:, -1]), np.asarray(hT))

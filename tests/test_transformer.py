@@ -1,0 +1,111 @@
+"""Transformer NMT tests — training convergence + greedy/beam decode.
+
+Mirrors the reference's dist_transformer.py test model and the book
+machine_translation beam-search path (ref: SURVEY §4,
+operators/beam_search_op.cc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, mesh_guard
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    # fp32 on the CPU test mesh: the decode-equality tests compare argmax
+    # between the incremental KV-cache path and the batch path, where bf16
+    # rounding legitimately flips ties
+    return tfm.transformer_tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return tfm.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def test_forward_shape(cfg, params):
+    b = tfm.synthetic_batch(cfg, 2, src_len=8, tgt_len=8)
+    logits = tfm.forward(params, cfg, jnp.asarray(b["src_ids"]),
+                         jnp.asarray(b["tgt_in"]))
+    assert logits.shape == (2, 8, cfg.tgt_vocab)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(cfg, params):
+    """Changing a future target token must not change earlier logits."""
+    b = tfm.synthetic_batch(cfg, 1, src_len=8, tgt_len=8)
+    t1 = jnp.asarray(b["tgt_in"])
+    t2 = t1.at[0, 6].set((t1[0, 6] + 1) % cfg.tgt_vocab)
+    l1 = tfm.forward(params, cfg, jnp.asarray(b["src_ids"]), t1)
+    l2 = tfm.forward(params, cfg, jnp.asarray(b["src_ids"]), t2)
+    assert np.allclose(np.asarray(l1[0, :6]), np.asarray(l2[0, :6]),
+                       atol=1e-5)
+    assert not np.allclose(np.asarray(l1[0, 6:]), np.asarray(l2[0, 6:]))
+
+
+def test_train_loss_decreases(cfg):
+    mesh = make_mesh(MeshConfig(data=2, model=2),
+                     devices=jax.devices()[:4])
+    with mesh_guard(mesh):
+        opt = pt.optimizer.Adam(learning_rate=1e-3)
+        init_fn, step_fn = tfm.make_train_step(cfg, opt, mesh)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+        batch = tfm.synthetic_batch(cfg, 4, src_len=8, tgt_len=8)
+        losses = []
+        for _ in range(10):
+            loss, params, opt_state = step_fn(params, opt_state, batch)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_greedy_decode_shapes(cfg, params):
+    b = tfm.synthetic_batch(cfg, 2, src_len=8)
+    out = tfm.greedy_decode(params, cfg, jnp.asarray(b["src_ids"]),
+                            jnp.asarray(b["src_mask"]), max_len=8)
+    assert out.shape == (2, 8)
+    assert out.dtype == jnp.int32
+
+
+def test_greedy_matches_teacher_forcing(cfg, params):
+    """Greedy decode's first token == argmax of the teacher-forced
+    distribution at position 0 — validates the incremental KV-cache path
+    against the full-attention path."""
+    b = tfm.synthetic_batch(cfg, 2, src_len=8)
+    src = jnp.asarray(b["src_ids"])
+    mask = jnp.asarray(b["src_mask"])
+    out = tfm.greedy_decode(params, cfg, src, mask, max_len=4)
+    # teacher-forced: feed BOS then the greedy prefix, compare argmax
+    tgt_in = jnp.concatenate(
+        [jnp.full((2, 1), cfg.bos_id, jnp.int32), out[:, :3]], axis=1)
+    logits = tfm.forward(params, cfg, src, tgt_in, mask,
+                         jnp.ones_like(tgt_in))
+    tf_argmax = jnp.argmax(logits, axis=-1)
+    assert np.array_equal(np.asarray(tf_argmax), np.asarray(out[:, :4]))
+
+
+def test_beam_search(cfg, params):
+    b = tfm.synthetic_batch(cfg, 2, src_len=8)
+    seqs, scores = tfm.beam_search_decode(
+        params, cfg, jnp.asarray(b["src_ids"]), jnp.asarray(b["src_mask"]),
+        beam_size=3, max_len=6)
+    assert seqs.shape == (2, 3, 6)
+    assert scores.shape == (2, 3)
+    # scores sorted best-first
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-5).all()
+    # top beam must equal greedy when beam contains it (sanity: finite)
+    assert np.isfinite(s[:, 0]).all()
+
+
+def test_beam1_matches_greedy(cfg, params):
+    b = tfm.synthetic_batch(cfg, 2, src_len=8)
+    src = jnp.asarray(b["src_ids"])
+    mask = jnp.asarray(b["src_mask"])
+    g = tfm.greedy_decode(params, cfg, src, mask, max_len=6)
+    seqs, _ = tfm.beam_search_decode(params, cfg, src, mask, beam_size=1,
+                                     max_len=6)
+    assert np.array_equal(np.asarray(seqs[:, 0]), np.asarray(g))
