@@ -45,6 +45,11 @@ class BertConfig:
     dropout: float = 0.1
     dtype: object = jnp.bfloat16     # activation/compute dtype
     remat: bool = True               # jax.checkpoint per block
+    # "dense": GSPMD gathers K/V over "seq"; "ring": blockwise ring
+    # attention (parallel/ring_attention.py) — K/V never materialised
+    # whole, permutes ride ICI neighbor links. Use "ring" for long-context
+    # runs where S/n_seq is still large.
+    attention_impl: str = "dense"
 
     @property
     def head_dim(self):
@@ -146,13 +151,27 @@ def _layer_norm(x, g, b, eps=1e-12):
     return ((x32 - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
 
 
-def _attention(lp, x, mask_bias, cfg):
-    """Standard MHA; seq axis sharding constraint lets GSPMD all-gather
-    K/V over "seq" (ring attention lives in parallel/ring_attention.py)."""
+def _attention(lp, x, mask_bias, cfg, mesh=None, key_padding_mask=None):
+    """MHA. "dense": GSPMD gathers K/V over "seq". "ring": blockwise
+    ring attention via shard_map + ppermute (never materialises full
+    K/V; parallel/ring_attention.py)."""
     B, S, H = x.shape
     nh, hd = cfg.num_heads, cfg.head_dim
     qkv = x @ lp["qkv_w"].astype(x.dtype) + lp["qkv_b"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    if (cfg.attention_impl == "ring" and mesh is not None
+            and mesh.shape.get(SEQ_AXIS, 1) > 1):
+        from paddle_tpu.parallel import ring_attention as _ra
+        def bshd(t):
+            return t.reshape(B, S, nh, hd).astype(jnp.float32)
+        kpm = (key_padding_mask if key_padding_mask is not None
+               else jnp.ones((B, S), jnp.float32))
+        ctx = _ra.ring_attention(mesh, bshd(q), bshd(k), bshd(v),
+                                 key_padding_mask=kpm)
+        ctx = ctx.reshape(B, S, H).astype(x.dtype)
+        return ctx @ lp["out_w"].astype(x.dtype) \
+            + lp["out_b"].astype(x.dtype)
 
     def heads(t):
         return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
@@ -166,8 +185,9 @@ def _attention(lp, x, mask_bias, cfg):
     return ctx @ lp["out_w"].astype(x.dtype) + lp["out_b"].astype(x.dtype)
 
 
-def _block(lp, x, mask_bias, cfg):
-    a = _attention(lp, x, mask_bias, cfg)
+def _block(lp, x, mask_bias, cfg, mesh=None, key_padding_mask=None):
+    a = _attention(lp, x, mask_bias, cfg, mesh=mesh,
+                   key_padding_mask=key_padding_mask)
     x = _layer_norm(x + a, lp["ln1_g"], lp["ln1_b"])
     hme = jax.nn.gelu(x @ lp["fc1_w"].astype(x.dtype)
                       + lp["fc1_b"].astype(x.dtype), approximate=True)
@@ -195,11 +215,15 @@ def forward(params, cfg, input_ids, token_type_ids=None, attention_mask=None,
         # bf16 and an all-padded row would softmax to NaN
         mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
                               -1e9).astype(cfg.dtype)
-    blk = _block
+    kpm = attention_mask
+
+    def blk(lp, x):
+        return _block(lp, x, mask_bias, cfg, mesh=mesh,
+                      key_padding_mask=kpm)
     if cfg.remat:
-        blk = jax.checkpoint(_block, static_argnums=(3,))
+        blk = jax.checkpoint(blk)
     for lp in params["layers"]:
-        x = blk(lp, x, mask_bias, cfg)
+        x = blk(lp, x)
         x = _shard_act(x, mesh)
     return x
 
@@ -266,7 +290,7 @@ def make_train_step(cfg, optimizer, mesh=None):
             out_shardings=pshard)(rng)
         opt_state = optimizer.init(params)
         opt_state = jax.device_put(
-            opt_state, _opt_shardings(opt_state, params, pshard, mesh))
+            opt_state, optimizer.state_shardings(opt_state, pshard, mesh))
         return params, opt_state
 
     def step(params, opt_state, batch):
@@ -284,19 +308,6 @@ def make_train_step(cfg, optimizer, mesh=None):
         return jit_step(params, opt_state, batch)
 
     return init_fn, step_fn
-
-
-def _opt_shardings(opt_state, params, pshard, mesh):
-    """Optimizer slots mirror their parameter's sharding exactly (a slot is
-    elementwise state of its param); step counter replicated."""
-    rep = NamedSharding(mesh, P())
-    flat_sh, ptreedef = jax.tree.flatten(pshard)
-    flat_slots = ptreedef.flatten_up_to(opt_state["slots"])
-    slots_sh = jax.tree.unflatten(
-        ptreedef,
-        [jax.tree.map(lambda _: sh, sd)
-         for sh, sd in zip(flat_sh, flat_slots)])
-    return {"step": rep, "slots": slots_sh}
 
 
 # ---------------------------------------------------------------------------

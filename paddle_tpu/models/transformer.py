@@ -306,8 +306,8 @@ def make_train_step(cfg, optimizer, mesh=None):
         params = jax.jit(functools.partial(init_params, cfg=cfg),
                          out_shardings=pshard)(rng)
         opt_state = optimizer.init(params)
-        rep_like = jax.tree.map(lambda _: NamedSharding(mesh, P()), opt_state)
-        opt_state = jax.device_put(opt_state, rep_like)
+        opt_state = jax.device_put(
+            opt_state, optimizer.state_shardings(opt_state, pshard, mesh))
         return params, opt_state
 
     def step(params, opt_state, batch):
@@ -377,6 +377,10 @@ def _decode_step(params, cfg, tok, pos, caches, cross_kvs, mem_bias):
 def greedy_decode(params, cfg, src_ids, src_mask, max_len=None):
     """Greedy argmax decode via lax.scan; returns [B, max_len] int32."""
     max_len = max_len or cfg.max_seq
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"max_len={max_len} exceeds cfg.max_seq={cfg.max_seq}: the "
+            f"K/V cache and sinusoid table are sized to max_seq")
     B = src_ids.shape[0]
     memory = encode(params, cfg, src_ids, src_mask)
     cross_kvs = _cross_kv(params, cfg, memory)
@@ -406,6 +410,10 @@ def beam_search_decode(params, cfg, src_ids, src_mask, beam_size=4,
     top-k beam pruning each step). Returns (tokens [B, beam, max_len],
     scores [B, beam]) sorted best-first with GNMT length penalty."""
     max_len = max_len or cfg.max_seq
+    if max_len > cfg.max_seq:
+        raise ValueError(
+            f"max_len={max_len} exceeds cfg.max_seq={cfg.max_seq}: the "
+            f"K/V cache and sinusoid table are sized to max_seq")
     B = src_ids.shape[0]
     K = beam_size
     V = cfg.tgt_vocab

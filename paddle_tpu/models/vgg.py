@@ -68,7 +68,11 @@ def init_params(rng, cfg):
             p["convs"].append(_conv_init(next(keys), 3, 3, cin, ch))
             p["bns"].append(_bn_init(ch))
             cin = ch
-    feat = cin * (cfg.image_size // 32) ** 2
+    # five SAME-padded stride-2 maxpools ceil-divide the spatial dims
+    side = cfg.image_size
+    for _ in range(5):
+        side = -(-side // 2)
+    feat = cin * side ** 2
     def fc(key, i, o):
         return {"w": (jax.random.normal(key, (i, o)) * np.sqrt(2.0 / i)
                       ).astype(jnp.float32), "b": jnp.zeros((o,), jnp.float32)}

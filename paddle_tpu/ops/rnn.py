@@ -28,18 +28,22 @@ def _mask_from_lengths(lengths, T, B):
 
 
 def lstm(x, w_ih, w_hh, b=None, h0=None, c0=None, lengths=None,
-         reverse=False):
+         reverse=False, peepholes=None):
     """Single-layer LSTM. x: [B,T,D]; w_ih: [D,4H] or None when x is
     already pre-projected [B,T,4H]; w_hh: [H,4H]; b: [4H]. Gate order
-    i,f,c,o (ref: operators/math/lstm_compute.h). Returns
-    (outputs [B,T,H], (h_T, c_T)). Padded steps (t >= lengths[b]) carry
-    state through unchanged and output 0."""
+    i,f,c,o (ref: operators/math/lstm_compute.h). peepholes: optional
+    [3H] (w_ic, w_fc, w_oc — elementwise cell→gate connections, the
+    reference's use_peepholes=True default, ref: operators/lstm_op.cc:75-83).
+    Returns (outputs [B,T,H], (h_T, c_T)). Padded steps (t >= lengths[b])
+    carry state through unchanged and output 0."""
     B, T, D = x.shape
     H = w_hh.shape[0]
     dt = x.dtype
     h0 = h0 if h0 is not None else jnp.zeros((B, H), dt)
     c0 = c0 if c0 is not None else jnp.zeros((B, H), dt)
     mask = _mask_from_lengths(lengths, T, B)
+    if peepholes is not None:
+        w_ic, w_fc, w_oc = jnp.split(peepholes, 3)
 
     # hoist the input projection out of the scan: one big MXU matmul
     xp = x if w_ih is None else (x.reshape(B * T, D) @ w_ih)
@@ -55,9 +59,15 @@ def lstm(x, w_ih, w_hh, b=None, h0=None, c0=None, lengths=None,
         xt, mt = t
         gates = xt + h @ w_hh
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        if peepholes is not None:
+            i = i + w_ic * c
+            f = f + w_fc * c
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
         g = jnp.tanh(g)
         c_new = f * c + i * g
+        if peepholes is not None:
+            o = o + w_oc * c_new
+        o = jax.nn.sigmoid(o)
         h_new = o * jnp.tanh(c_new)
         if mt is not None:
             m = mt[:, None]
@@ -78,11 +88,20 @@ def lstm(x, w_ih, w_hh, b=None, h0=None, c0=None, lengths=None,
 
 
 def dynamic_lstm(input, w_hh, bias=None, h0=None, c0=None, lengths=None,
-                 is_reverse=False, name=None):
+                 is_reverse=False, use_peepholes=True, name=None):
     """fluid.layers.dynamic_lstm parity (ref: operators/lstm_op.cc): input
-    is the *pre-projected* x@W [B,T,4H]; w_hh [H,4H]."""
-    return lstm(input, None, w_hh, b=bias, h0=h0, c0=c0, lengths=lengths,
-                reverse=is_reverse)
+    is the *pre-projected* x@W [B,T,4H]; w_hh [H,4H]. With
+    use_peepholes=True (the reference default) bias is [7H]: 4H gate
+    biases then 3H peephole weights w_ic,w_fc,w_oc."""
+    H = w_hh.shape[0]
+    peep = None
+    if use_peepholes and bias is not None:
+        bias = jnp.ravel(bias)
+        b, peep = bias[:4 * H], bias[4 * H:]
+    else:
+        b = bias
+    return lstm(input, None, w_hh, b=b, h0=h0, c0=c0, lengths=lengths,
+                reverse=is_reverse, peepholes=peep)
 
 
 def dynamic_lstmp(input, w_hh, w_proj, bias=None, lengths=None,
@@ -127,11 +146,14 @@ def dynamic_lstmp(input, w_hh, w_proj, bias=None, lengths=None,
     return outs, (rT, cT)
 
 
-def gru(x, w_ih, w_hh, b=None, h0=None, lengths=None, reverse=False):
+def gru(x, w_ih, w_hh, b=None, h0=None, lengths=None, reverse=False,
+        origin_mode=False):
     """Single-layer GRU. x: [B,T,D]; w_ih: [D,3H] or None when x is
     pre-projected [B,T,3H]; w_hh: [H,3H], gate order
-    update,reset,candidate (ref: operators/math/gru_compute.cc). Returns
-    (outputs [B,T,H], h_T)."""
+    update,reset,candidate (ref: operators/math/gru_compute.cc).
+    origin_mode=False (the reference's dynamic_gru default, ref:
+    python/paddle/fluid/layers/nn.py dynamic_gru): h = (1-u)*h + u*c;
+    origin_mode=True: h = u*h + (1-u)*c. Returns (outputs [B,T,H], h_T)."""
     B, T, D = x.shape
     H = w_hh.shape[0]
     dt = x.dtype
@@ -154,7 +176,8 @@ def gru(x, w_ih, w_hh, b=None, h0=None, lengths=None, reverse=False):
         u = jax.nn.sigmoid(xu + hz[:, :H])
         r = jax.nn.sigmoid(xr + hz[:, H:])
         c = jnp.tanh(xc + (r * h) @ w_c)
-        h_new = u * h + (1 - u) * c
+        h_new = (u * h + (1 - u) * c) if origin_mode \
+            else ((1 - u) * h + u * c)
         if mt is not None:
             m = mt[:, None]
             h_new = m * h_new + (1 - m) * h
@@ -173,11 +196,11 @@ def gru(x, w_ih, w_hh, b=None, h0=None, lengths=None, reverse=False):
 
 
 def dynamic_gru(input, w_hh, bias=None, h0=None, lengths=None,
-                is_reverse=False, name=None):
+                is_reverse=False, origin_mode=False, name=None):
     """fluid.layers.dynamic_gru parity (ref: operators/gru_op.cc): input
     pre-projected [B,T,3H]."""
     return gru(input, None, w_hh, b=bias, h0=h0, lengths=lengths,
-               reverse=is_reverse)
+               reverse=is_reverse, origin_mode=origin_mode)
 
 
 def simple_rnn(x, w_ih, w_hh, b=None, h0=None, lengths=None, act=jnp.tanh):

@@ -72,6 +72,20 @@ class Optimizer:
             "slots": jax.tree.map(self._slots, params),
         }
 
+    def state_shardings(self, opt_state, pshard, mesh):
+        """NamedShardings for opt state: each slot mirrors its param's
+        sharding (a slot is elementwise state of its param); the step
+        counter is replicated. pshard: param tree of NamedSharding."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(mesh, PartitionSpec())
+        flat_sh, ptreedef = jax.tree.flatten(pshard)
+        flat_slots = ptreedef.flatten_up_to(opt_state["slots"])
+        slots_sh = jax.tree.unflatten(
+            ptreedef,
+            [jax.tree.map(lambda _: sh, sd)
+             for sh, sd in zip(flat_sh, flat_slots)])
+        return {"step": rep, "slots": slots_sh}
+
     def apply_gradients(self, params, grads, state, param_meta=None):
         """Returns (new_params, new_state). params/grads are matching
         pytrees; slots is a tree-of-dicts aligned with params."""

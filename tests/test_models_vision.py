@@ -137,3 +137,16 @@ class TestVGG:
                 losses.append(float(loss))
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
+
+
+def test_vgg_non_multiple_of_32_image():
+    """ceil-divided pooling sizes the first FC correctly (48 -> 2x2)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import vgg
+
+    cfg = vgg.VGGConfig(depth=11, image_size=48, num_classes=10)
+    params = vgg.init_params(jax.random.PRNGKey(0), cfg)
+    x = jnp.zeros((2, 48, 48, 3), jnp.float32)
+    logits, _ = vgg.forward(params, cfg, x, train=False)
+    assert logits.shape == (2, 10)

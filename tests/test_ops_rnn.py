@@ -37,7 +37,9 @@ def np_gru_ref(x, w_ih, w_hh, b):
         u = sig(xu + hz[:, :H])
         r = sig(xr + hz[:, H:])
         cand = np.tanh(xc + (r * h) @ w_hh[:, 2 * H:])
-        h = u * h + (1 - u) * cand
+        # origin_mode=False (reference dynamic_gru default):
+        # h = (1-u)*h + u*cand
+        h = (1 - u) * h + u * cand
         outs.append(h)
     return np.stack(outs, 1), h
 
@@ -158,3 +160,41 @@ class TestSimpleRNN:
                                   jnp.asarray(_rand((H, H), 2)))
         assert outs.shape == (B, T, H)
         assert np.allclose(np.asarray(outs[:, -1]), np.asarray(hT))
+
+
+class TestParityFixes:
+    def test_gru_origin_mode(self):
+        """origin_mode=True uses h = u*h + (1-u)*c (the inverted blend)."""
+        B, T, D, H = 2, 3, 4, 5
+        x = _rand((B, T, D), 0)
+        w_ih, w_hh = _rand((D, 3 * H), 1), _rand((H, 3 * H), 2)
+        o_def, _ = rnn.gru(jnp.asarray(x), jnp.asarray(w_ih),
+                           jnp.asarray(w_hh))
+        o_orig, _ = rnn.gru(jnp.asarray(x), jnp.asarray(w_ih),
+                            jnp.asarray(w_hh), origin_mode=True)
+        assert not np.allclose(np.asarray(o_def), np.asarray(o_orig))
+
+    def test_lstm_peepholes(self):
+        """7H bias with use_peepholes=True changes outputs vs 4H bias and
+        matches a numpy step reference with cell->gate connections."""
+        B, T, H = 2, 3, 4
+        pre = _rand((B, T, 4 * H), 0)
+        w_hh = _rand((H, 4 * H), 1)
+        bias7 = _rand((7 * H,), 2)
+        outs, (hT, cT) = rnn.dynamic_lstm(jnp.asarray(pre),
+                                          jnp.asarray(w_hh),
+                                          bias=jnp.asarray(bias7))
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        b4, peep = bias7[:4 * H], bias7[4 * H:]
+        w_ic, w_fc, w_oc = np.split(peep, 3)
+        h = np.zeros((B, H)); c = np.zeros((B, H))
+        for t in range(T):
+            g = pre[:, t] + b4 + h @ w_hh
+            i, f, gg, o = np.split(g, 4, axis=-1)
+            i = sig(i + w_ic * c)
+            f = sig(f + w_fc * c)
+            c = f * c + i * np.tanh(gg)
+            o = sig(o + w_oc * c)
+            h = o * np.tanh(c)
+        assert np.allclose(np.asarray(hT), h, atol=1e-5)
+        assert np.allclose(np.asarray(cT), c, atol=1e-5)
