@@ -164,11 +164,12 @@ def _attention(lp, x, mask_bias, cfg, mesh=None, key_padding_mask=None):
             and mesh.shape.get(SEQ_AXIS, 1) > 1):
         from paddle_tpu.parallel import ring_attention as _ra
         def bshd(t):
-            return t.reshape(B, S, nh, hd).astype(jnp.float32)
-        kpm = (key_padding_mask if key_padding_mask is not None
-               else jnp.ones((B, S), jnp.float32))
+            return t.reshape(B, S, nh, hd)
+        # qkv stay in cfg.dtype (bf16 MXU matmuls); ring_attention keeps
+        # its softmax stats + output accumulator in fp32 internally.
+        # key_padding_mask=None takes the maskless path (no mask permute).
         ctx = _ra.ring_attention(mesh, bshd(q), bshd(k), bshd(v),
-                                 key_padding_mask=kpm)
+                                 key_padding_mask=key_padding_mask)
         ctx = ctx.reshape(B, S, H).astype(x.dtype)
         return ctx @ lp["out_w"].astype(x.dtype) \
             + lp["out_b"].astype(x.dtype)

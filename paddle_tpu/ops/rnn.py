@@ -95,11 +95,17 @@ def dynamic_lstm(input, w_hh, bias=None, h0=None, c0=None, lengths=None,
     biases then 3H peephole weights w_ic,w_fc,w_oc."""
     H = w_hh.shape[0]
     peep = None
+    b = bias
     if use_peepholes and bias is not None:
         bias = jnp.ravel(bias)
-        b, peep = bias[:4 * H], bias[4 * H:]
-    else:
-        b = bias
+        if bias.shape[0] == 7 * H:
+            b, peep = bias[:4 * H], bias[4 * H:]
+        elif bias.shape[0] == 4 * H:
+            b = bias          # gate biases only; no peephole weights given
+        else:
+            raise ValueError(
+                f"dynamic_lstm bias must be [4H]={4*H} or (with "
+                f"use_peepholes) [7H]={7*H}, got {bias.shape[0]}")
     return lstm(input, None, w_hh, b=b, h0=h0, c0=c0, lengths=lengths,
                 reverse=is_reverse, peepholes=peep)
 

@@ -37,17 +37,21 @@ _NEG_INF = -1e30
 def _block_attn(q, k, v, bias, scale):
     """One (q-block, kv-block) partial attention step.
 
-    q: [B, Sq, H, D]; k,v: [B, Sk, H, D]; bias: broadcastable to
-    [B, H, Sq, Sk] or None. Returns (o_unnorm [B,Sq,H,D], m [B,H,Sq],
-    l [B,H,Sq]) — unnormalised output, row max, row sum-exp.
+    q: [B, Sq, H, D]; k,v: [B, Sk, H, D] — any dtype (bf16 stays bf16 on
+    the MXU; accumulation and softmax stats are fp32 via
+    preferred_element_type). bias: broadcastable to [B, H, Sq, Sk] or
+    None. Returns (o_unnorm fp32 [B,Sq,H,D], m fp32 [B,H,Sq],
+    l fp32 [B,H,Sq]) — unnormalised output, row max, row sum-exp.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
     return o, m, l
 
 
@@ -83,15 +87,17 @@ def ring_attention_local(q, k, v, *, axis_name=SEQ_AXIS, causal=False,
 
     # Derive initial carries FROM q so they inherit q's varying mesh axes
     # (jax>=0.7 shard_map rejects fori_loop carries whose varying-axis
-    # sets change between input and output).
-    zero_bs = q[:, :, 0, 0] * 0.0                          # [B, S_local]
+    # sets change between input and output). Accumulators are fp32
+    # regardless of q's dtype (online-softmax stats need the range).
+    zero_bs = (q[:, :, 0, 0] * 0.0).astype(jnp.float32)    # [B, S_local]
     if key_padding_mask is None:
         kpm = zero_bs + 1.0
     else:
         kpm = key_padding_mask.astype(jnp.float32) + zero_bs
 
-    o_acc = q * 0.0
-    zero_bhs = jnp.moveaxis(q[..., 0], -1, 1) * 0.0        # [B, H, S_local]
+    o_acc = (q * 0.0).astype(jnp.float32)
+    zero_bhs = (jnp.moveaxis(q[..., 0], -1, 1) * 0.0       # [B, H, S_local]
+                ).astype(jnp.float32)
     m_acc = zero_bhs + _NEG_INF
     l_acc = zero_bhs
 
@@ -114,7 +120,7 @@ def ring_attention_local(q, k, v, *, axis_name=SEQ_AXIS, causal=False,
 
     o_acc, m_acc, l_acc, _, _, _ = lax.fori_loop(
         0, n, step, (o_acc, m_acc, l_acc, k, v, kpm))
-    return o_acc / l_acc[..., None].swapaxes(1, 2)
+    return (o_acc / l_acc[..., None].swapaxes(1, 2)).astype(q.dtype)
 
 
 def ring_attention(mesh, q, k, v, *, causal=False, key_padding_mask=None,
@@ -183,9 +189,10 @@ def ulysses_attention_local(q, k, v, *, axis_name=SEQ_AXIS, causal=False,
 
 def ulysses_attention(mesh, q, k, v, *, causal=False, key_padding_mask=None,
                       scale=None, seq_axis=SEQ_AXIS, data_axis=DATA_AXIS):
-    """shard_map wrapper for Ulysses; heads must divide the seq-axis size.
-    Heads are NOT simultaneously sharded over "model" here (Ulysses uses
-    the head dim as its transport dim)."""
+    """shard_map wrapper for Ulysses; the seq-axis size must divide the
+    head count (H % n_seq == 0 — all_to_all splits the head dim). Heads
+    are NOT simultaneously sharded over "model" here (Ulysses uses the
+    head dim as its transport dim)."""
     qkv_spec = P(data_axis, seq_axis, None, None)
     mask_spec = P(data_axis, seq_axis)
     body = functools.partial(ulysses_attention_local, causal=causal,
