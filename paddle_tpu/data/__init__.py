@@ -10,3 +10,4 @@ loaders via the same reader contract).
 from paddle_tpu.data import dataset
 from paddle_tpu.data.feeder import DataFeeder, batch_reader
 from paddle_tpu.data.pyreader import PyReader
+from paddle_tpu.data.dataloader import FileDataLoader

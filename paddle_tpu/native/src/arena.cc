@@ -1,0 +1,139 @@
+// Buddy allocator for host staging buffers (input-pipeline batches,
+// checkpoint I/O buffers) — the role of the reference's buddy system
+// over pinned/host memory (ref: memory/detail/buddy_allocator.h:34,
+// memory/detail/system_allocator.cc). Device memory itself is
+// XLA-managed on TPU; this arena only backs host-side staging so batch
+// assembly doesn't churn the general heap.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <vector>
+
+#include "enforce.h"
+
+namespace {
+
+struct Arena {
+  char* base = nullptr;
+  size_t total = 0;
+  size_t min_block = 0;
+  int levels = 0;  // level 0 = whole arena; level k blocks = total >> k
+  // free_[k] = offsets of free blocks at level k
+  std::vector<std::set<size_t>> free_;
+  std::map<size_t, int> allocated_;  // offset -> level
+  std::mutex mu;
+  size_t in_use = 0;
+  size_t peak = 0;
+
+  ~Arena() { std::free(base); }
+};
+
+int level_for(const Arena* a, size_t n) {
+  size_t sz = a->total;
+  int lv = 0;
+  while (lv < a->levels && (sz >> 1) >= n && (sz >> 1) >= a->min_block) {
+    sz >>= 1;
+    ++lv;
+  }
+  return lv;
+}
+
+size_t block_size(const Arena* a, int lv) { return a->total >> lv; }
+
+}  // namespace
+
+extern "C" {
+
+void* pt_arena_create(long total_bytes, long min_block) {
+  PT_ENFORCE(total_bytes > 0 && (total_bytes & (total_bytes - 1)) == 0,
+             "arena: total_bytes must be a power of two, got %ld",
+             total_bytes);
+  PT_ENFORCE(min_block > 0 && (min_block & (min_block - 1)) == 0,
+             "arena: min_block must be a power of two, got %ld", min_block);
+  auto* a = new Arena();
+  a->base = static_cast<char*>(std::malloc(total_bytes));
+  if (a->base == nullptr) {
+    delete a;
+    pt::set_error("arena: malloc(%ld) failed", total_bytes);
+    return nullptr;
+  }
+  a->total = total_bytes;
+  a->min_block = min_block;
+  size_t sz = total_bytes;
+  while (sz > static_cast<size_t>(min_block)) {
+    sz >>= 1;
+    ++a->levels;
+  }
+  a->free_.resize(a->levels + 1);
+  a->free_[0].insert(0);
+  return a;
+}
+
+void* pt_arena_alloc(void* ap, long n) {
+  auto* a = static_cast<Arena*>(ap);
+  PT_ENFORCE(n > 0 && static_cast<size_t>(n) <= a->total,
+             "arena: bad alloc size %ld", n);
+  std::lock_guard<std::mutex> lk(a->mu);
+  int want = level_for(a, n);
+  int lv = want;
+  while (lv >= 0 && a->free_[lv].empty()) --lv;
+  if (lv < 0) {
+    pt::set_error("arena: out of memory for %ld bytes (in use %zu/%zu)",
+                  n, a->in_use, a->total);
+    return nullptr;
+  }
+  size_t off = *a->free_[lv].begin();
+  a->free_[lv].erase(a->free_[lv].begin());
+  // split down to the wanted level, keeping right buddies free
+  while (lv < want) {
+    ++lv;
+    a->free_[lv].insert(off + block_size(a, lv));
+  }
+  a->allocated_[off] = want;
+  a->in_use += block_size(a, want);
+  if (a->in_use > a->peak) a->peak = a->in_use;
+  return a->base + off;
+}
+
+int pt_arena_free(void* ap, void* p) {
+  auto* a = static_cast<Arena*>(ap);
+  std::lock_guard<std::mutex> lk(a->mu);
+  size_t off = static_cast<char*>(p) - a->base;
+  auto it = a->allocated_.find(off);
+  PT_ENFORCE_RC(it != a->allocated_.end(), -1,
+                "arena: free of unallocated offset %zu", off);
+  int lv = it->second;
+  a->allocated_.erase(it);
+  a->in_use -= block_size(a, lv);
+  // coalesce with buddy while possible
+  while (lv > 0) {
+    size_t bsz = block_size(a, lv);
+    size_t buddy = off ^ bsz;
+    auto fit = a->free_[lv].find(buddy);
+    if (fit == a->free_[lv].end()) break;
+    a->free_[lv].erase(fit);
+    off = off < buddy ? off : buddy;
+    --lv;
+  }
+  a->free_[lv].insert(off);
+  return 0;
+}
+
+long pt_arena_in_use(void* ap) {
+  auto* a = static_cast<Arena*>(ap);
+  std::lock_guard<std::mutex> lk(a->mu);
+  return static_cast<long>(a->in_use);
+}
+
+long pt_arena_peak(void* ap) {
+  auto* a = static_cast<Arena*>(ap);
+  std::lock_guard<std::mutex> lk(a->mu);
+  return static_cast<long>(a->peak);
+}
+
+void pt_arena_destroy(void* ap) { delete static_cast<Arena*>(ap); }
+
+}  // extern "C"

@@ -1,0 +1,236 @@
+// Threaded host data pipeline: blocking record queue + multi-threaded
+// file readers with an in-memory shuffle buffer.
+//
+// TPU-native rebuild of the reference's DataFeed/Dataset machinery
+// (ref: framework/data_feed.h:62 DataFeed, data_feed.h:205
+// InMemoryDataFeed, operators/reader/lod_tensor_blocking_queue.h,
+// operators/reader/buffered_reader.cc): producers read files off a
+// shared work list, records flow through a bounded blocking queue,
+// an optional reservoir-style shuffle buffer decorrelates order, and
+// Python consumes byte records zero-copy-ish (one memcpy into a
+// caller-owned buffer) to batch + transfer to device.
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "enforce.h"
+
+extern "C" {
+void* pt_recordio_scanner_open(const char* path);
+const char* pt_recordio_next(void* sp, long* len);
+void pt_recordio_scanner_close(void* sp);
+}
+
+namespace {
+
+// Bounded MPMC blocking queue of byte records
+// (the LoDTensorBlockingQueue analog).
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t cap) : cap_(cap) {}
+
+  bool Push(std::string&& rec) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return false;
+    q_.emplace_back(std::move(rec));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  // false => queue closed AND drained
+  bool Pop(std::string* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty() || closed_; });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable not_full_, not_empty_;
+  std::deque<std::string> q_;
+  size_t cap_;
+  bool closed_ = false;
+};
+
+struct Loader {
+  std::vector<std::string> files;
+  BlockingQueue queue;
+  std::vector<std::thread> workers;
+  std::mutex file_mu;
+  size_t next_file = 0;
+  int epochs;              // -1 = cycle forever
+  int mode;                // 0 = text lines, 1 = recordio
+  size_t shuffle_buf;      // 0 = no shuffle
+  uint64_t seed;
+  std::atomic<int> live_workers{0};
+  std::string last;        // buffer returned to Python (single consumer)
+  std::mutex err_mu;       // worker errors surface to the consumer
+  std::string error;
+
+  Loader(size_t cap) : queue(cap) {}
+
+  void SetError(const std::string& msg) {
+    std::lock_guard<std::mutex> lk(err_mu);
+    if (error.empty()) error = msg;
+  }
+
+  bool HasError() {
+    std::lock_guard<std::mutex> lk(err_mu);
+    return !error.empty();
+  }
+
+  bool NextFile(std::string* path) {
+    std::lock_guard<std::mutex> lk(file_mu);
+    if (epochs >= 0 &&
+        next_file >= files.size() * static_cast<size_t>(epochs))
+      return false;
+    *path = files[next_file % files.size()];
+    ++next_file;
+    return true;
+  }
+};
+
+void reader_main(Loader* L, int tid) {
+  std::mt19937_64 rng(L->seed + tid);
+  std::vector<std::string> shuf;
+  shuf.reserve(L->shuffle_buf);
+
+  auto emit = [&](std::string&& rec) -> bool {
+    if (L->shuffle_buf == 0) return L->queue.Push(std::move(rec));
+    if (shuf.size() < L->shuffle_buf) {
+      shuf.emplace_back(std::move(rec));
+      return true;
+    }
+    size_t j = rng() % shuf.size();
+    std::string out = std::move(shuf[j]);
+    shuf[j] = std::move(rec);
+    return L->queue.Push(std::move(out));
+  };
+
+  std::string path;
+  bool ok = true;
+  while (ok && L->NextFile(&path)) {
+    if (L->mode == 1) {
+      void* s = pt_recordio_scanner_open(path.c_str());
+      if (s == nullptr) {
+        // pt_last_error is thread_local: capture it in THIS thread
+        L->SetError(pt::g_last_error);
+        ok = false;
+        break;
+      }
+      long len = 0;
+      const char* p;
+      while ((p = pt_recordio_next(s, &len)) != nullptr) {
+        if (!emit(std::string(p, len))) { ok = false; break; }
+      }
+      pt_recordio_scanner_close(s);
+      if (len == -2) {  // scan error (CRC/corruption): stop, surface it
+        L->SetError(pt::g_last_error);
+        ok = false;
+      }
+    } else {
+      FILE* f = fopen(path.c_str(), "rb");
+      if (f == nullptr) {
+        L->SetError("loader: cannot open " + path);
+        ok = false;
+        break;
+      }
+      std::string line;
+      int c;
+      while (ok && (c = fgetc(f)) != EOF) {
+        if (c == '\n') {
+          if (!emit(std::move(line))) ok = false;
+          line.clear();
+        } else {
+          line.push_back(static_cast<char>(c));
+        }
+      }
+      if (ok && !line.empty()) ok = emit(std::move(line));
+      fclose(f);
+    }
+  }
+  // drain shuffle buffer
+  std::shuffle(shuf.begin(), shuf.end(), rng);
+  for (auto& r : shuf) {
+    if (!L->queue.Push(std::move(r))) break;
+  }
+  if (--L->live_workers == 0) L->queue.Close();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_loader_create(const char** files, int nfiles, int nthreads,
+                       long queue_cap, long shuffle_buf, long seed,
+                       int epochs, int mode) {
+  PT_ENFORCE(nfiles > 0, "loader: empty file list");
+  auto* L = new Loader(queue_cap > 0 ? queue_cap : 1024);
+  for (int i = 0; i < nfiles; ++i) L->files.emplace_back(files[i]);
+  L->epochs = epochs;
+  L->mode = mode;
+  L->shuffle_buf = shuffle_buf > 0 ? shuffle_buf : 0;
+  L->seed = static_cast<uint64_t>(seed);
+  int nt = nthreads > 0 ? nthreads : 1;
+  L->live_workers = nt;
+  for (int t = 0; t < nt; ++t)
+    L->workers.emplace_back(reader_main, L, t);
+  return L;
+}
+
+// Returns pointer valid until the next pt_loader_next call.
+// *len = -1 on end-of-stream; -2 if a worker failed (pt_loader_error).
+const char* pt_loader_next(void* lp, long* len) {
+  auto* L = static_cast<Loader*>(lp);
+  if (!L->queue.Pop(&L->last)) {
+    *len = L->HasError() ? -2 : -1;
+    return nullptr;
+  }
+  *len = static_cast<long>(L->last.size());
+  return L->last.data();
+}
+
+const char* pt_loader_error(void* lp) {
+  auto* L = static_cast<Loader*>(lp);
+  std::lock_guard<std::mutex> lk(L->err_mu);
+  return L->error.c_str();
+}
+
+long pt_loader_queue_size(void* lp) {
+  return static_cast<long>(static_cast<Loader*>(lp)->queue.Size());
+}
+
+void pt_loader_close(void* lp) {
+  auto* L = static_cast<Loader*>(lp);
+  L->queue.Close();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -89,10 +89,9 @@ def ring_attention_local(q, k, v, *, axis_name=SEQ_AXIS, causal=False,
     # (jax>=0.7 shard_map rejects fori_loop carries whose varying-axis
     # sets change between input and output). Accumulators are fp32
     # regardless of q's dtype (online-softmax stats need the range).
-    zero_bs = (q[:, :, 0, 0] * 0.0).astype(jnp.float32)    # [B, S_local]
-    if key_padding_mask is None:
-        kpm = zero_bs + 1.0
-    else:
+    masked = key_padding_mask is not None
+    if masked:
+        zero_bs = (q[:, :, 0, 0] * 0.0).astype(jnp.float32)  # [B, S_local]
         kpm = key_padding_mask.astype(jnp.float32) + zero_bs
 
     o_acc = (q * 0.0).astype(jnp.float32)
@@ -101,25 +100,44 @@ def ring_attention_local(q, k, v, *, axis_name=SEQ_AXIS, causal=False,
     m_acc = zero_bhs + _NEG_INF
     l_acc = zero_bhs
 
-    def step(i, carry):
-        o_acc, m_acc, l_acc, k, v, kpm = carry
+    def block_bias(i, kpm_cur):
         # kv block currently held arrived from device (idx - i); its
         # absolute positions are ((idx - i) mod n) * s_local + arange.
-        src = (idx - i) % n
-        k_pos = src * s_local + jnp.arange(s_local)
-        bias = jnp.where(kpm[:, None, None, :] > 0, 0.0, _NEG_INF)
+        bias = None
+        if kpm_cur is not None:
+            bias = jnp.where(kpm_cur[:, None, None, :] > 0, 0.0, _NEG_INF)
         if causal:
+            src = (idx - i) % n
+            k_pos = src * s_local + jnp.arange(s_local)
             cmask = q_pos[:, None] >= k_pos[None, :]       # [Sq, Sk]
-            bias = bias + jnp.where(cmask[None, None], 0.0, _NEG_INF)
-        o, m, l = _block_attn(q, k, v, bias, scale)
-        o_acc, m_acc, l_acc = _combine((o_acc, m_acc, l_acc), o, m, l)
-        k = lax.ppermute(k, axis_name, perm)
-        v = lax.ppermute(v, axis_name, perm)
-        kpm = lax.ppermute(kpm, axis_name, perm)
-        return o_acc, m_acc, l_acc, k, v, kpm
+            cbias = jnp.where(cmask[None, None], 0.0, _NEG_INF)
+            bias = cbias if bias is None else bias + cbias
+        return bias
 
-    o_acc, m_acc, l_acc, _, _, _ = lax.fori_loop(
-        0, n, step, (o_acc, m_acc, l_acc, k, v, kpm))
+    if masked:
+        def step(i, carry):
+            o_acc, m_acc, l_acc, k, v, kpm = carry
+            o, m, l = _block_attn(q, k, v, block_bias(i, kpm), scale)
+            o_acc, m_acc, l_acc = _combine((o_acc, m_acc, l_acc), o, m, l)
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+            kpm = lax.ppermute(kpm, axis_name, perm)
+            return o_acc, m_acc, l_acc, k, v, kpm
+
+        o_acc, m_acc, l_acc, _, _, _ = lax.fori_loop(
+            0, n, step, (o_acc, m_acc, l_acc, k, v, kpm))
+    else:
+        # maskless: no mask carry, no per-step mask permute or bias build
+        def step(i, carry):
+            o_acc, m_acc, l_acc, k, v = carry
+            o, m, l = _block_attn(q, k, v, block_bias(i, None), scale)
+            o_acc, m_acc, l_acc = _combine((o_acc, m_acc, l_acc), o, m, l)
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+            return o_acc, m_acc, l_acc, k, v
+
+        o_acc, m_acc, l_acc, _, _ = lax.fori_loop(
+            0, n, step, (o_acc, m_acc, l_acc, k, v))
     return (o_acc / l_acc[..., None].swapaxes(1, 2)).astype(q.dtype)
 
 
