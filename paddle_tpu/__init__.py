@@ -46,7 +46,7 @@ from paddle_tpu import io
 from paddle_tpu import amp
 from paddle_tpu import parallel
 from paddle_tpu import distributed
-from paddle_tpu import data as dataio
+from paddle_tpu import dataio
 from paddle_tpu import reader
 from paddle_tpu import profiler
 from paddle_tpu.framework import (

@@ -7,7 +7,7 @@ downloads real data at test time; CI here is hermetic — swap in real
 loaders via the same reader contract).
 """
 
-from paddle_tpu.data import dataset
-from paddle_tpu.data.feeder import DataFeeder, batch_reader
-from paddle_tpu.data.pyreader import PyReader
-from paddle_tpu.data.dataloader import FileDataLoader
+from paddle_tpu.dataio import dataset
+from paddle_tpu.dataio.feeder import DataFeeder, batch_reader
+from paddle_tpu.dataio.pyreader import PyReader
+from paddle_tpu.dataio.dataloader import FileDataLoader

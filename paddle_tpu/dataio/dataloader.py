@@ -29,16 +29,13 @@ def _py_record_iter(files, epochs, mode, shuffle_buffer=0, seed=0):
     buf = []
 
     def raw():
-        for _ in range(epochs):
+        ep = 0
+        while epochs < 0 or ep < epochs:  # epochs=-1: cycle forever
+            ep += 1
             for f in files:
-                if mode == "recordio":
-                    from paddle_tpu import native  # needs the native lib
-                    with native.RecordIOScanner(f) as sc:
-                        yield from sc
-                else:
-                    with open(f, "rb") as fh:
-                        for line in fh:
-                            yield line.rstrip(b"\n")
+                with open(f, "rb") as fh:
+                    for line in fh:
+                        yield line.rstrip(b"\n")
 
     if shuffle_buffer <= 0:
         yield from raw()
@@ -83,6 +80,11 @@ class FileDataLoader:
             raise ValueError(f"mode must be 'lines' or 'recordio', "
                              f"got {self.mode!r}")
         from paddle_tpu import native
+        if self.mode == "recordio" and not native.available():
+            raise RuntimeError(
+                "mode='recordio' needs the native library (no pure-Python "
+                "RecordIO scanner); the native build failed or no C++ "
+                "toolchain is present")
         if native.available():
             return native.NativeLoader(
                 self.files, nthreads=self.nthreads,

@@ -29,7 +29,7 @@ class PyReader:
         self._feeder = None
 
     def decorate_sample_list_generator(self, reader, places=None):
-        from paddle_tpu.data.feeder import DataFeeder
+        from paddle_tpu.dataio.feeder import DataFeeder
         self._feeder = DataFeeder(self.feed_list or [])
         self._reader = reader
 

@@ -565,3 +565,21 @@ def shape(input):
         return jnp.array([-1 if s in (None, -1) else s
                           for s in input.shape], jnp.int32)
     return _ops.shape(input)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """fluid.layers.linear_chain_crf parity: creates the ``crfw``
+    transition parameter ([num_tags+2, num_tags], ref:
+    operators/linear_chain_crf_op.cc OpMaker) and returns the per-sequence
+    negative log-likelihood. Decode with crf_decoding(input, crfw)."""
+    num_tags = int(input.shape[-1])
+    w = _make_param("crfw", (num_tags + 2, num_tags), jnp.float32,
+                    param_attr, I.Xavier())
+    if in_static_mode() and isinstance(input, Variable):
+        tensors = [input, w, label]
+        attrs = {}
+        if length is not None:
+            tensors.append(length)
+        return _append_static("linear_chain_crf", _ops.linear_chain_crf,
+                              tensors, attrs, False)
+    return _ops.linear_chain_crf(input, w, label, length)

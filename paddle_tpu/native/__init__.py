@@ -5,7 +5,7 @@ the pieces the reference implements in C++ (recordio/, framework/
 data_feed.*, memory/detail/buddy_allocator) stay native here too.
 Built on demand with g++ into a per-version cached .so and bound via
 ctypes (no pybind11 in the image). ``available()`` gates callers:
-everything has a documented pure-Python fallback in paddle_tpu.data.
+everything has a documented pure-Python fallback in paddle_tpu.dataio.
 """
 
 import ctypes
@@ -34,11 +34,14 @@ def _build():
     os.makedirs(out_dir, exist_ok=True)
     so = os.path.join(out_dir, f"libpt_native_{_src_fingerprint()}.so")
     if not os.path.exists(so):
+        # per-process tmp: concurrent builders (multi-process loaders on a
+        # shared fs) must not interleave writes into one tmp file
+        tmp = f"{so}.{os.getpid()}.tmp"
         srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
         cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
-               *srcs, "-lz", "-o", so + ".tmp"]
+               *srcs, "-lz", "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(so + ".tmp", so)
+        os.replace(tmp, so)
     return so
 
 

@@ -161,14 +161,30 @@ void reader_main(Loader* L, int tid) {
         ok = false;
         break;
       }
+      // bulk reads + memchr line split (a byte-at-a-time fgetc loop
+      // would serialize on the stdio lock and defeat the point of the
+      // native reader)
       std::string line;
-      int c;
-      while (ok && (c = fgetc(f)) != EOF) {
-        if (c == '\n') {
-          if (!emit(std::move(line))) ok = false;
-          line.clear();
-        } else {
-          line.push_back(static_cast<char>(c));
+      std::vector<char> buf(1 << 16);
+      size_t n;
+      while (ok && (n = fread(buf.data(), 1, buf.size(), f)) > 0) {
+        const char* p = buf.data();
+        const char* end = p + n;
+        while (ok && p < end) {
+          const char* nl =
+              static_cast<const char*>(memchr(p, '\n', end - p));
+          if (nl == nullptr) {
+            line.append(p, end - p);
+            break;
+          }
+          if (line.empty()) {
+            if (!emit(std::string(p, nl - p))) ok = false;
+          } else {
+            line.append(p, nl - p);
+            if (!emit(std::move(line))) ok = false;
+            line.clear();
+          }
+          p = nl + 1;
         }
       }
       if (ok && !line.empty()) ok = emit(std::move(line));

@@ -116,6 +116,13 @@ int read_chunk(Scanner* s) {
                     read_u32(s->f, &raw_len) && read_u32(s->f, &comp_len) &&
                     read_u32(s->f, &crc), -1,
                 "recordio: truncated chunk header");
+  // header fields are not covered by the CRC: bound them before
+  // allocating so a corrupt length can't bad_alloc across the C ABI
+  constexpr uint32_t kMaxChunk = 1u << 30;  // 1 GiB sanity cap
+  PT_ENFORCE_RC(comp_len <= kMaxChunk && raw_len <= kMaxChunk &&
+                    n_rec <= kMaxChunk / 4,
+                -1, "recordio: implausible chunk header (n_rec=%u raw=%u "
+                "comp=%u)", n_rec, raw_len, comp_len);
   std::string stored(comp_len, '\0');
   PT_ENFORCE_RC(fread(&stored[0], 1, comp_len, s->f) == comp_len, -1,
                 "recordio: truncated chunk payload");
@@ -190,7 +197,10 @@ int pt_recordio_write(void* wp, const char* data, long len) {
 int pt_recordio_writer_close(void* wp) {
   auto* w = static_cast<Writer*>(wp);
   int rc = flush_chunk(w);
-  fclose(w->f);
+  if (fclose(w->f) != 0 && rc == 0) {
+    pt::set_error("recordio: fclose failed (buffered data lost)");
+    rc = -1;
+  }
   delete w;
   return rc;
 }

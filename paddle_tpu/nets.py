@@ -1,0 +1,125 @@
+"""fluid.nets parity: composite network helpers.
+
+Rebuild of python/paddle/fluid/nets.py (simple_img_conv_pool,
+img_conv_group, sequence_conv_pool, glu, scaled_dot_product_attention)
+composed from paddle_tpu.layers primitives. On TPU these compose into a
+single XLA computation — the reference's per-op dispatch disappears.
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu import layers
+
+__all__ = [
+    "simple_img_conv_pool", "img_conv_group", "sequence_conv_pool", "glu",
+    "scaled_dot_product_attention",
+]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1, conv_padding=0,
+                         conv_dilation=1, conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    """nets.simple_img_conv_pool parity (ref: python/paddle/fluid/nets.py)."""
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding, dilation=conv_dilation,
+        groups=conv_groups, param_attr=param_attr, bias_attr=bias_attr,
+        act=act, use_cudnn=use_cudnn)
+    return layers.pool2d(
+        conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """nets.img_conv_group parity: conv(+bn+dropout)* then one pool."""
+    tmp = input
+    if not hasattr(conv_num_filter, "__len__"):
+        conv_num_filter = [conv_num_filter]
+
+    def _expand(v):
+        return v if hasattr(v, "__len__") else [v] * len(conv_num_filter)
+
+    padding = _expand(conv_padding)
+    fsize = _expand(conv_filter_size)
+    with_bn = _expand(conv_with_batchnorm)
+    drop = _expand(conv_batchnorm_drop_rate)
+    pattr = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(conv_num_filter)
+
+    for i, nf in enumerate(conv_num_filter):
+        local_act = conv_act if not with_bn[i] else None
+        tmp = layers.conv2d(
+            input=tmp, num_filters=nf, filter_size=fsize[i],
+            padding=padding[i], param_attr=pattr[i],
+            act=local_act, use_cudnn=use_cudnn)
+        if with_bn[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            if abs(drop[i]) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop[i])
+
+    return layers.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    """nets.sequence_conv_pool parity: context conv then sequence pool.
+
+    ``input``: RaggedBatch / (data [B, T, H], lengths)."""
+    from paddle_tpu.core.lod import RaggedBatch
+    from paddle_tpu.framework import ParamAttr
+    from paddle_tpu import initializer as I
+    from paddle_tpu.layers import _make_param, _apply_act
+    from paddle_tpu.ops import sequence as seq_ops
+
+    data = input.data if isinstance(input, RaggedBatch) else input[0]
+    h = int(data.shape[-1])
+    w = _make_param("seqconv_w", (filter_size * h, num_filters),
+                    jnp.float32, param_attr, I.Xavier())
+    conv_out = seq_ops.sequence_conv(input, w, filter_size)
+    if bias_attr is not False:
+        b = _make_param("seqconv_b", (num_filters,), jnp.float32, bias_attr,
+                        I.Constant(0.0))
+        conv_out = RaggedBatch(conv_out.data + b, conv_out.lengths)
+    conv_out = RaggedBatch(_apply_act(conv_out.data, act), conv_out.lengths)
+    return seq_ops.sequence_pool(conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    """nets.glu parity: a, b = split(x); a * sigmoid(b)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(a, layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """nets.scaled_dot_product_attention parity: multi-head attention from
+    primitive ops. [B, T, D] inputs; returns [B, Tq, Dv]. On TPU the
+    softmax(QK^T)V chain fuses in XLA; see ops/pallas for the flash
+    kernel used by the model zoo."""
+    q, k, v = (jnp.asarray(x) for x in (queries, keys, values))
+    b, tq, d = q.shape
+    dv = v.shape[-1]
+    if d % num_heads or dv % num_heads:
+        raise ValueError("hidden size must divide num_heads")
+
+    def split_heads(x):
+        bb, tt, dd = x.shape
+        return jnp.transpose(
+            x.reshape(bb, tt, num_heads, dd // num_heads), (0, 2, 1, 3))
+
+    qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
+    scale = (d // num_heads) ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    weights = layers.softmax(logits)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", weights, vh)
+    ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, tq, dv)
+    return ctx

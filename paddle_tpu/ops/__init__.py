@@ -22,3 +22,5 @@ from paddle_tpu.ops.random_ops import *      # noqa: F401,F403
 from paddle_tpu.ops.control_flow import *    # noqa: F401,F403
 from paddle_tpu.ops.metric_ops import *      # noqa: F401,F403
 from paddle_tpu.ops.rnn import *             # noqa: F401,F403
+from paddle_tpu.ops.crf import *             # noqa: F401,F403
+from paddle_tpu.ops.ctc import *             # noqa: F401,F403

@@ -1,0 +1,123 @@
+"""Linear-chain CRF ops.
+
+TPU-native rebuild of the reference CRF operators
+(ref: paddle/fluid/operators/linear_chain_crf_op.cc,
+ paddle/fluid/operators/crf_decoding_op.cc). The reference consumes LoD
+batches; here sequences are dense-padded [batch, time, num_tags] with an
+explicit ``length`` vector (SURVEY §5.7 LoD→padding+mask mapping), and the
+time recursions are `lax.scan` loops so the whole thing stays jittable.
+
+Transition parameter layout matches the reference exactly so weights are
+interchangeable: shape ``[num_tags + 2, num_tags]`` where row 0 holds start
+weights, row 1 stop weights, and rows 2: the [num_tags, num_tags]
+tag-to-tag transition matrix (ref: linear_chain_crf_op.cc OpMaker).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["linear_chain_crf", "crf_decoding"]
+
+_NEG = -1e30
+
+
+def _split_transition(transition):
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    return start, stop, trans
+
+
+def linear_chain_crf(input, transition, label, length=None):
+    """Negative log-likelihood of tag sequences under a linear-chain CRF.
+
+    Args:
+      input: emissions ``[batch, time, num_tags]`` (unnormalized).
+      transition: ``[num_tags + 2, num_tags]`` (see module docstring).
+      label: int tags ``[batch, time]`` (or ``[batch, time, 1]``).
+      length: int ``[batch]`` valid lengths; None means full time axis.
+
+    Returns:
+      ``[batch]`` per-sequence negative log-likelihood
+      (log_norm - path_score), the reference op's output semantics.
+    """
+    input = jnp.asarray(input)
+    label = jnp.asarray(label)
+    if label.ndim == 3:
+        label = label[..., 0]
+    b, t, d = input.shape
+    if length is None:
+        length = jnp.full((b,), t, jnp.int32)
+    length = jnp.asarray(length, jnp.int32)
+    start, stop, trans = _split_transition(jnp.asarray(transition))
+
+    # mask[b, t] = 1 for valid steps
+    steps = jnp.arange(t)
+    mask = (steps[None, :] < length[:, None]).astype(input.dtype)
+
+    # ---- log partition via forward algorithm ----
+    alpha0 = input[:, 0, :] + start[None, :]
+
+    def fwd(alpha, xs):
+        em, m = xs  # em [b, d], m [b]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None, :, :],
+                               axis=1) + em
+        alpha = jnp.where(m[:, None] > 0, nxt, alpha)
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(
+        fwd, alpha0,
+        (jnp.swapaxes(input, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:]))
+    log_norm = jax.nn.logsumexp(alpha + stop[None, :], axis=1)
+
+    # ---- score of the gold path ----
+    em_score = jnp.sum(
+        jnp.take_along_axis(input, label[..., None], axis=2)[..., 0] * mask,
+        axis=1)
+    pair_mask = mask[:, 1:]
+    tr_score = jnp.sum(trans[label[:, :-1], label[:, 1:]] * pair_mask, axis=1)
+    last_idx = jnp.maximum(length - 1, 0)
+    last_tag = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    gold = em_score + tr_score + start[label[:, 0]] + stop[last_tag]
+    return log_norm - gold
+
+
+def crf_decoding(input, transition, length=None):
+    """Viterbi decode: most likely tag path per sequence.
+
+    Returns int32 ``[batch, time]`` paths; steps past ``length`` are 0
+    (the reference emits LoD-cut sequences; callers mask with ``length``).
+    """
+    input = jnp.asarray(input)
+    b, t, d = input.shape
+    if length is None:
+        length = jnp.full((b,), t, jnp.int32)
+    length = jnp.asarray(length, jnp.int32)
+    start, stop, trans = _split_transition(jnp.asarray(transition))
+
+    steps = jnp.arange(t)
+    mask = steps[None, :] < length[:, None]
+
+    score0 = input[:, 0, :] + start[None, :]
+
+    def fwd(score, xs):
+        em, m = xs
+        cand = score[:, :, None] + trans[None, :, :]
+        back = jnp.argmax(cand, axis=1)                       # [b, d]
+        nxt = jnp.max(cand, axis=1) + em
+        score = jnp.where(m[:, None], nxt, score)
+        back = jnp.where(m[:, None], back, jnp.arange(d)[None, :])
+        return score, back
+
+    score, backs = jax.lax.scan(
+        fwd, score0,
+        (jnp.swapaxes(input, 0, 1)[1:], jnp.swapaxes(mask, 0, 1)[1:]))
+    # backs: [t-1, b, d]
+    last = jnp.argmax(score + stop[None, :], axis=1)          # [b]
+
+    def bwd(tag, back):
+        prev = jnp.take_along_axis(back, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first, tags = jax.lax.scan(bwd, last, backs, reverse=True)
+    path = jnp.concatenate([first[None, :], tags], axis=0)    # [t, b]
+    path = jnp.swapaxes(path, 0, 1).astype(jnp.int32)
+    return jnp.where(mask, path, 0)

@@ -1,0 +1,190 @@
+"""CTC ops: loss, greedy alignment, edit distance.
+
+TPU-native rebuild of the reference's warpctc / ctc_align / edit_distance
+operators (ref: paddle/fluid/operators/warpctc_op.cc — wraps the external
+warp-ctc library; operators/ctc_align_op.cc; operators/edit_distance_op.cc).
+Here the CTC forward recursion is written directly as a log-space `lax.scan`
+so it runs on TPU inside the jitted step and differentiates through JAX
+autodiff (the reference needed a hand-written CUDA gradient).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ctc_loss", "warpctc", "ctc_align", "edit_distance"]
+
+_NEG = -1e30
+
+
+def ctc_loss(logits, labels, logit_lengths=None, label_lengths=None,
+             blank=0, norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    Args:
+      logits: ``[batch, time, num_classes]`` unnormalized activations.
+      labels: int ``[batch, max_label_len]`` target label ids (no blanks).
+      logit_lengths / label_lengths: int ``[batch]``; None = full.
+      blank: blank class id.
+      norm_by_times: divide each loss by its logit length
+        (ref warpctc_op.cc attr ``norm_by_times``).
+
+    Returns:
+      ``[batch]`` negative log-likelihoods.
+    """
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels, jnp.int32)
+    b, t, c = logits.shape
+    l = labels.shape[1]
+    if logit_lengths is None:
+        logit_lengths = jnp.full((b,), t, jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.full((b,), l, jnp.int32)
+    logit_lengths = jnp.asarray(logit_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended label sequence with interleaved blanks: length s = 2l+1
+    s = 2 * l + 1
+    ext = jnp.full((b, s), blank, jnp.int32).at[:, 1::2].set(labels)
+    # skip-transition allowed at s when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    pos = jnp.arange(s)[None, :]
+    valid_s = pos < (2 * label_lengths[:, None] + 1)
+
+    # alpha[0]
+    a0 = jnp.full((b, s), _NEG)
+    a0 = a0.at[:, 0].set(jnp.take_along_axis(
+        logp[:, 0, :], ext[:, :1], axis=1)[:, 0])
+    has_label = (label_lengths > 0)
+    a0 = a0.at[:, 1].set(jnp.where(
+        has_label,
+        jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0],
+        _NEG))
+    a0 = jnp.where(valid_s, a0, _NEG)
+
+    def step(alpha, xs):
+        lp, live = xs  # lp [b, c], live [b] bool
+        a_prev = alpha
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=_NEG)[:, :s]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=_NEG)[:, :s]
+        a_m2 = jnp.where(can_skip, a_m2, _NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_m1), a_m2)
+        em = jnp.take_along_axis(lp, ext, axis=1)
+        nxt = jnp.where(valid_s, merged + em, _NEG)
+        alpha = jnp.where(live[:, None], nxt, alpha)
+        return alpha, None
+
+    tmask = jnp.arange(t)[None, :] < logit_lengths[:, None]
+    alpha, _ = jax.lax.scan(
+        step, a0,
+        (jnp.swapaxes(logp, 0, 1)[1:], jnp.swapaxes(tmask, 0, 1)[1:]))
+
+    end = 2 * label_lengths  # index of final blank
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_end1 = jnp.where(
+        has_label,
+        jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        _NEG)
+    ll = jnp.logaddexp(a_end, a_end1)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_lengths, 1).astype(loss.dtype)
+    return loss
+
+
+def warpctc(input, label, input_length=None, label_length=None,
+            blank=0, norm_by_times=False):
+    """Reference-name alias of :func:`ctc_loss` (ref: warpctc_op.cc)."""
+    return ctc_loss(input, label, input_length, label_length, blank,
+                    norm_by_times)
+
+
+def ctc_align(input, input_length=None, blank=0, padding_value=0):
+    """Greedy CTC decode: merge repeats, drop blanks
+    (ref: ctc_align_op.cc).
+
+    Args:
+      input: int frame-wise predictions ``[batch, time]`` (e.g. argmax of
+        logits) or float logits ``[batch, time, classes]``.
+
+    Returns:
+      (aligned ``[batch, time]`` padded with ``padding_value``,
+       lengths ``[batch]``).
+    """
+    input = jnp.asarray(input)
+    if input.ndim == 3:
+        input = jnp.argmax(input, axis=-1)
+    input = input.astype(jnp.int32)
+    b, t = input.shape
+    if input_length is None:
+        input_length = jnp.full((b,), t, jnp.int32)
+    tmask = jnp.arange(t)[None, :] < jnp.asarray(input_length)[:, None]
+
+    prev = jnp.pad(input, ((0, 0), (1, 0)), constant_values=-1)[:, :t]
+    keep = (input != blank) & (input != prev) & tmask
+    # stable compaction: target position of each kept token
+    idx = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((b, t), padding_value, jnp.int32)
+    rows = jnp.arange(b)[:, None] * jnp.ones((1, t), jnp.int32)
+    scatter_idx = jnp.where(keep, idx, t)  # dumped past the end when dropped
+    out = jnp.pad(out, ((0, 0), (0, 1)))
+    out = out.at[rows, scatter_idx].set(jnp.where(keep, input, padding_value))
+    out = out[:, :t]
+    lengths = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return out, lengths
+
+
+def edit_distance(input, label, input_length=None, label_length=None,
+                  normalized=True):
+    """Levenshtein distance between hypothesis and reference sequences
+    (ref: edit_distance_op.cc). Jittable DP over a `lax.scan`.
+
+    Returns (distances ``[batch]`` float32, sequence_num scalar).
+    """
+    hyp = jnp.asarray(input, jnp.int32)
+    ref = jnp.asarray(label, jnp.int32)
+    b, n = hyp.shape
+    m = ref.shape[1]
+    if input_length is None:
+        input_length = jnp.full((b,), n, jnp.int32)
+    if label_length is None:
+        label_length = jnp.full((b,), m, jnp.int32)
+    hlen = jnp.asarray(input_length, jnp.int32)
+    rlen = jnp.asarray(label_length, jnp.int32)
+
+    def one(h, r, hl, rl):
+        row0 = jnp.arange(m + 1, dtype=jnp.float32)
+
+        def row_step(prev_row, xs):
+            i, hi = xs  # 1-based row index, hyp token
+            sub = prev_row[:-1] + (hi != r).astype(jnp.float32)
+            dele = prev_row[1:] + 1.0
+
+            def cell(left, trip):
+                s, d = trip
+                val = jnp.minimum(jnp.minimum(s, d), left + 1.0)
+                return val, val
+
+            _, rest = jax.lax.scan(cell, i.astype(jnp.float32), (sub, dele))
+            row = jnp.concatenate([i.astype(jnp.float32)[None], rest])
+            row = jnp.where(i <= hl, row, prev_row)
+            return row, None
+
+        final, _ = jax.lax.scan(
+            row_step, row0, (jnp.arange(1, n + 1), h))
+        dist = final[rl]
+        # empty-reference convention of the reference op
+        dist = jnp.where(rl == 0, hl.astype(jnp.float32), dist)
+        if normalized:
+            dist = jnp.where(rl > 0, dist / rlen_safe(rl), dist)
+        return dist
+
+    def rlen_safe(rl):
+        return jnp.maximum(rl, 1).astype(jnp.float32)
+
+    dists = jax.vmap(one)(hyp, ref, hlen, rlen)
+    return dists, jnp.asarray(b, jnp.int32)

@@ -21,7 +21,7 @@ __all__ = [
     "sequence_mask", "sequence_pool", "sequence_softmax", "sequence_expand",
     "sequence_pad", "sequence_unpad", "sequence_concat", "sequence_reverse",
     "sequence_first_step", "sequence_last_step", "sequence_slice",
-    "sequence_scatter", "sequence_expand_as",
+    "sequence_scatter", "sequence_expand_as", "sequence_conv",
 ]
 
 
@@ -177,3 +177,38 @@ def sequence_scatter(x, index, updates, name=None):
     x = jnp.asarray(x)
     idx = jnp.asarray(index)
     return x.at[jnp.arange(x.shape[0])[:, None], idx].add(updates)
+
+
+def sequence_conv(input, filter, context_length, context_start=None,
+                  name=None):
+    """sequence_conv_op parity (ref: operators/sequence_ops/
+    sequence_conv_op.cc): context-window convolution over time.
+
+    ``input`` is RaggedBatch/(data [B, T, H], lengths) or a dense [B, T, H]
+    array; ``filter`` is [context_length * H, num_filters] (the reference's
+    im2col-then-matmul layout, operators/math/context_project.h). Padded
+    steps are zeroed before the window gather so results match the
+    reference's LoD behavior at sequence boundaries.
+    """
+    if isinstance(input, (RaggedBatch, tuple, list)):
+        data, lengths = _unpack(input)
+        data = data * _mask(data, lengths)
+    else:
+        data, lengths = jnp.asarray(input), None
+    b, t, h = data.shape
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    cols = []
+    for k in range(context_length):
+        off = context_start + k
+        shifted = jnp.roll(data, -off, axis=1)
+        if off < 0:
+            m = jnp.arange(t) >= -off
+        else:
+            m = jnp.arange(t) < t - off
+        cols.append(shifted * m[None, :, None].astype(data.dtype))
+    ctx = jnp.concatenate(cols, axis=-1)             # [B, T, cl*H]
+    out = ctx @ jnp.asarray(filter)                  # [B, T, F]
+    if lengths is not None:
+        return RaggedBatch(out * _mask(out, lengths), lengths)
+    return out

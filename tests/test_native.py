@@ -153,7 +153,7 @@ class TestHostArena:
 class TestFileDataLoader:
     def test_end_to_end_batches(self, tmp_path):
         import numpy as np
-        from paddle_tpu.data.dataloader import FileDataLoader
+        from paddle_tpu.dataio.dataloader import FileDataLoader
 
         p = tmp_path / "data.txt"
         p.write_text("".join(f"{i},{i*2}\n" for i in range(100)))
@@ -174,7 +174,7 @@ class TestFileDataLoader:
     def test_device_put_prefetch(self, tmp_path):
         import jax.numpy as jnp
         import numpy as np
-        from paddle_tpu.data.dataloader import FileDataLoader
+        from paddle_tpu.dataio.dataloader import FileDataLoader
 
         p = tmp_path / "d.txt"
         p.write_text("".join(f"{i}\n" for i in range(32)))

@@ -1,0 +1,69 @@
+"""CTC / edit-distance op tests (ref: unittests/test_warpctc_op.py,
+test_ctc_align_op.py, test_edit_distance_op.py). CTC loss is checked
+against torch's independent CTC implementation."""
+
+import numpy as np
+
+from paddle_tpu.ops import ctc
+
+
+class TestCTCLoss:
+    def test_matches_torch(self):
+        import torch
+        B, T, C, L = 4, 10, 6, 4
+        rng = np.random.RandomState(1)
+        logits = rng.randn(B, T, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, L))
+        ilen = np.array([10, 8, 6, 5])
+        llen = np.array([4, 3, 2, 1])
+        ours = np.asarray(ctc.ctc_loss(logits, labels, ilen, llen, blank=0))
+        lp = torch.log_softmax(torch.tensor(logits), -1).transpose(0, 1)
+        ref = torch.nn.functional.ctc_loss(
+            lp, torch.tensor(labels), torch.tensor(ilen),
+            torch.tensor(llen), blank=0, reduction="none").numpy()
+        assert np.allclose(ours, ref, atol=1e-4), (ours, ref)
+
+    def test_grad_finite_and_norm_by_times(self):
+        import jax
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        logits = rng.randn(2, 6, 5).astype(np.float32)
+        labels = rng.randint(1, 5, (2, 2))
+
+        g = jax.grad(lambda x: jnp.sum(ctc.ctc_loss(x, labels)))(
+            jnp.asarray(logits))
+        assert np.isfinite(np.asarray(g)).all()
+        plain = np.asarray(ctc.ctc_loss(logits, labels))
+        normed = np.asarray(ctc.ctc_loss(logits, labels,
+                                         norm_by_times=True))
+        assert np.allclose(normed, plain / 6.0, atol=1e-6)
+
+
+class TestCTCAlign:
+    def test_merge_and_blank(self):
+        inp = np.array([[0, 1, 1, 0, 2, 2, 3, 0],
+                        [5, 5, 0, 5, 4, 0, 0, 0]])
+        out, lens = ctc.ctc_align(inp, np.array([8, 5]), blank=0)
+        assert lens.tolist() == [3, 3]
+        assert out[0, :3].tolist() == [1, 2, 3]
+        assert out[1, :3].tolist() == [5, 5, 4]
+
+
+class TestEditDistance:
+    def test_known_distances(self):
+        hyp = np.array([[1, 2, 3, 4], [1, 2, 3, 4]])
+        ref = np.array([[1, 3, 3, 0], [1, 2, 3, 4]])
+        d, n = ctc.edit_distance(hyp, ref, np.array([4, 4]),
+                                 np.array([3, 4]), normalized=False)
+        assert d.tolist() == [2.0, 0.0]
+        assert int(n) == 2
+
+    def test_normalized_and_empty_ref(self):
+        hyp = np.array([[1, 2, 3]])
+        ref = np.array([[9, 9, 9]])
+        d, _ = ctc.edit_distance(hyp, ref, np.array([3]), np.array([0]),
+                                 normalized=False)
+        assert d.tolist() == [3.0]
+        dn, _ = ctc.edit_distance(np.array([[1, 2]]), np.array([[1, 3]]),
+                                  np.array([2]), np.array([2]))
+        assert np.allclose(dn, [0.5])
