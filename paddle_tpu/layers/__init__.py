@@ -65,6 +65,16 @@ _NARGS = {
     "truncated_gaussian_random": 0, "randint": 0,
     "prelu": 2, "conv2d": 2, "conv2d_transpose": 2, "conv3d": 2,
     "depthwise_conv2d": 2, "embedding": 2,
+    # crf / ctc families (optional trailing tensors promote dynamically)
+    "linear_chain_crf": 3, "crf_decoding": 2, "ctc_loss": 2,
+    "warpctc": 2, "edit_distance": 2,
+    # detection family
+    "iou_similarity": 2, "box_coder": 3, "prior_box": 2,
+    "density_prior_box": 2, "bipartite_match": 1, "target_assign": 2,
+    "multiclass_nms": 2, "detection_output": 4, "ssd_loss": 5,
+    "yolo_box": 2, "yolov3_loss": 3, "box_clip": 2,
+    "sigmoid_focal_loss": 3, "roi_align": 2, "roi_pool": 2,
+    "psroi_pool": 2, "generate_proposals": 5, "box_decoder_and_assign": 4,
 }
 
 # ops whose first arg is a LIST of tensors
@@ -77,7 +87,11 @@ _NEEDS_RNG = {"dropout", "gaussian_random", "uniform_random",
               "uniform_random_batch_size_like",
               "gaussian_random_batch_size_like"}
 
-_MULTI_OUT = {"topk": 2, "argsort": 2}
+_MULTI_OUT = {"topk": 2, "argsort": 2, "ctc_align": 2, "edit_distance": 2,
+              "prior_box": 2,
+              "density_prior_box": 2, "anchor_generator": 2,
+              "bipartite_match": 2, "yolo_box": 2, "target_assign": 2,
+              "generate_proposals": 3}
 
 
 def _register(name, fn):
@@ -88,8 +102,13 @@ def _register(name, fn):
         xs = ins.get("X", [])
         attrs = dict(attrs)
         attrs.pop("_needs_rng", None)
+        tparams = attrs.pop("_tensor_params", None)
         if listy:
             out = fn(list(xs), **attrs)
+        elif tparams is not None:
+            # inputs bound by parameter name (op had optional tensor args
+            # promoted from attr positions — e.g. ssd_loss's prior_box_var)
+            out = fn(**{**attrs, **dict(zip(tparams, xs))})
         else:
             out = fn(*xs, **attrs)
         return {"Out": list(out) if isinstance(out, tuple) else [out]}
@@ -110,13 +129,25 @@ def _spec_of(v, val=2):
     return jax.ShapeDtypeStruct(_sub_dyn(v.shape, val), v.dtype)
 
 
-def _append_static(name, fn, tensor_vals, attrs, listy):
+def _append_static(name, fn, tensor_vals, attrs, listy,
+                   tensor_params=None, promoted=None):
+    """Append one op to the current program.
+
+    ``tensor_params`` names the leading tensor parameters; ``promoted`` is
+    an ordered {param: Variable} of OPTIONAL tensor args found in attr
+    positions (they must ride the input list, not the attr dict — a
+    Variable baked into attrs would crash the executor)."""
     blk = default_main_program().global_block()
     program = default_main_program()
     in_names = []
     specs2, specs3 = [], []
     had_dyn = False
-    flat = tensor_vals[0] if listy else tensor_vals
+    flat = list(tensor_vals[0] if listy else tensor_vals)
+    all_params = list(tensor_params) if tensor_params else None
+    if promoted:
+        flat = flat + list(promoted.values())
+        all_params = all_params + list(promoted)
+        attrs = {k: v for k, v in attrs.items() if k not in promoted}
     for tv in flat:
         if isinstance(tv, Variable):
             in_names.append(tv.name)
@@ -143,6 +174,10 @@ def _append_static(name, fn, tensor_vals, attrs, listy):
         if listy:
             return jax.eval_shape(lambda *xs: fn(list(xs), **eval_attrs),
                                   *specs)
+        if promoted:
+            return jax.eval_shape(
+                lambda *xs: fn(**{**eval_attrs,
+                                  **dict(zip(all_params, xs))}), *specs)
         return jax.eval_shape(lambda *xs: fn(*xs, **eval_attrs), *specs)
 
     # dynamic dims are probed with two substitute sizes (2 and 3): any
@@ -192,6 +227,8 @@ def _append_static(name, fn, tensor_vals, attrs, listy):
     op_attrs = dict(attrs)
     if name in _NEEDS_RNG:
         op_attrs["_needs_rng"] = True
+    if promoted:
+        op_attrs["_tensor_params"] = tuple(all_params)
     blk.append_op(type=name, inputs={"X": in_names},
                   outputs={"Out": [v.name for v in outs]}, attrs=op_attrs)
     return outs[0] if n_out == 1 else tuple(outs)
@@ -226,16 +263,26 @@ def _dual(name, fn):
         attrs = {p: vals[p] for p in attr_names
                  if p in vals and p not in ("name", "rng")
                  and vals[p] is not inspect.Parameter.empty}
-        if in_static_mode() and _has_variable(
-                tensor_vals[0] if listy else tensor_vals):
-            return _append_static(name, fn, tensor_vals, attrs, listy)
+        if in_static_mode():
+            promoted = {p: v for p, v in attrs.items()
+                        if isinstance(v, Variable)}
+            if promoted or _has_variable(
+                    tensor_vals[0] if listy else tensor_vals):
+                return _append_static(name, fn, tensor_vals, attrs, listy,
+                                      tensor_params=pnames[:n_tensor],
+                                      promoted=promoted)
         return fn(*args, **kwargs)
 
     return wrapper
 
 
 # auto-wrap every exported functional op
-_EXCLUDE = {"fc_act", "batch_norm", "sequence_mask"}
+_EXCLUDE = {"fc_act", "batch_norm", "sequence_mask",
+            # host/numpy or list-in/list-out detection ops: exposed
+            # directly below, no static-program wrapper
+            "rpn_target_assign", "generate_proposal_labels",
+            "detection_map", "distribute_fpn_proposals",
+            "collect_fpn_proposals", "retinanet_detection_output"}
 _this = globals()
 for _n in dir(_ops):
     if _n.startswith("_") or _n in _EXCLUDE:
@@ -246,6 +293,14 @@ for _n in dir(_ops):
 
 # sequence_mask needs maxlen attr; expose directly (works both modes)
 sequence_mask = _dual("sequence_mask", _ops.sequence_mask)
+
+# host/list detection ops: eager-only passthroughs
+rpn_target_assign = _ops.rpn_target_assign
+generate_proposal_labels = _ops.generate_proposal_labels
+detection_map = _ops.detection_map
+distribute_fpn_proposals = _ops.distribute_fpn_proposals
+collect_fpn_proposals = _ops.collect_fpn_proposals
+retinanet_detection_output = _ops.retinanet_detection_output
 
 
 # ---------------------------------------------------------------------------

@@ -24,3 +24,4 @@ from paddle_tpu.ops.metric_ops import *      # noqa: F401,F403
 from paddle_tpu.ops.rnn import *             # noqa: F401,F403
 from paddle_tpu.ops.crf import *             # noqa: F401,F403
 from paddle_tpu.ops.ctc import *             # noqa: F401,F403
+from paddle_tpu.ops.detection import *       # noqa: F401,F403

@@ -18,7 +18,6 @@ import jax.numpy as jnp
 
 __all__ = ["linear_chain_crf", "crf_decoding"]
 
-_NEG = -1e30
 
 
 def _split_transition(transition):

@@ -247,7 +247,10 @@ class TestLabelSemanticRoles:
         ref: unittests/op_test.py get_numeric_gradient)."""
         from paddle_tpu.ops.crf import linear_chain_crf
         jax.config.update("jax_enable_x64", True)
-        self._gradcheck_body(linear_chain_crf)
+        try:
+            self._gradcheck_body(linear_chain_crf)
+        finally:
+            jax.config.update("jax_enable_x64", False)
 
     def _gradcheck_body(self, linear_chain_crf):
         rng = np.random.RandomState(0)
@@ -266,10 +269,7 @@ class TestLabelSemanticRoles:
                 tp[i, j] += eps
                 tm[i, j] -= eps
                 num[i, j] = (float(f(tp)) - float(f(tm))) / (2 * eps)
-        try:
-            assert np.allclose(np.asarray(ana), num, atol=1e-4)
-        finally:
-            jax.config.update("jax_enable_x64", False)
+        assert np.allclose(np.asarray(ana), num, atol=1e-4)
 
 
 class TestMachineTranslation:

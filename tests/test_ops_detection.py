@@ -1,0 +1,472 @@
+"""Detection op family tests.
+
+Models the reference's op-test pattern (unittests/test_multiclass_nms_op.py,
+test_prior_box_op.py, test_yolo_box_op.py, …): check against straightforward
+numpy re-implementations on small shapes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops import detection as D
+
+
+def _np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    n, m = len(a), len(b)
+    out = np.zeros((n, m), np.float32)
+    for i in range(n):
+        for j in range(m):
+            ix1 = max(a[i, 0], b[j, 0])
+            iy1 = max(a[i, 1], b[j, 1])
+            ix2 = min(a[i, 2], b[j, 2])
+            iy2 = min(a[i, 3], b[j, 3])
+            iw = max(ix2 - ix1 + off, 0.0)
+            ih = max(iy2 - iy1 + off, 0.0)
+            inter = iw * ih
+            ua = (a[i, 2] - a[i, 0] + off) * (a[i, 3] - a[i, 1] + off)
+            ub = (b[j, 2] - b[j, 0] + off) * (b[j, 3] - b[j, 1] + off)
+            u = ua + ub - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+def _rand_boxes(rng, n, size=1.0):
+    xy = rng.uniform(0, 0.6 * size, (n, 2))
+    wh = rng.uniform(0.1 * size, 0.4 * size, (n, 2))
+    return np.concatenate([xy, xy + wh], -1).astype(np.float32)
+
+
+class TestIoUAndCoder:
+    def test_iou_similarity(self):
+        rng = np.random.RandomState(0)
+        a = _rand_boxes(rng, 5)
+        b = _rand_boxes(rng, 7)
+        got = np.asarray(D.iou_similarity(a, b))
+        np.testing.assert_allclose(got, _np_iou(a, b), atol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(1)
+        priors = _rand_boxes(rng, 6)
+        var = np.full((6, 4), 0.1, np.float32)
+        targets = _rand_boxes(rng, 4)
+        enc = D.box_coder(priors, var, targets, "encode_center_size")
+        assert enc.shape == (4, 6, 4)
+        # decode row i against all priors; the diagonal-free roundtrip:
+        # decode(enc[i]) should give back target i for every prior column
+        dec = D.box_coder(priors, var, np.asarray(enc), "decode_center_size")
+        for i in range(4):
+            for j in range(6):
+                np.testing.assert_allclose(np.asarray(dec)[i, j],
+                                           targets[i], atol=1e-4)
+
+    def test_box_clip(self):
+        boxes = np.array([[-5.0, -5.0, 50.0, 80.0]], np.float32)
+        im_info = np.array([[40.0, 60.0, 1.0]], np.float32)
+        got = np.asarray(D.box_clip(boxes[None], im_info))[0, 0]
+        np.testing.assert_allclose(got, [0.0, 0.0, 50.0, 39.0])
+
+
+class TestPriors:
+    def test_prior_box_shapes_and_range(self):
+        feat = np.zeros((2, 8, 4, 4), np.float32)
+        img = np.zeros((2, 3, 32, 32), np.float32)
+        boxes, var = D.prior_box(feat, img, min_sizes=[4.0],
+                                 max_sizes=[8.0], aspect_ratios=[2.0],
+                                 flip=True, clip=True)
+        # priors per cell: ars {1, 2, 0.5} + 1 max_size box = 4
+        assert boxes.shape == (4, 4, 4, 4)
+        assert var.shape == boxes.shape
+        b = np.asarray(boxes)
+        assert (b >= 0).all() and (b <= 1).all()
+        # center of cell (0,0) is at offset 0.5 * step 8 / 32 = 0.125
+        sq = b[0, 0, 0]
+        np.testing.assert_allclose((sq[0] + sq[2]) / 2, 0.125, atol=1e-5)
+
+    def test_density_prior_box(self):
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        img = np.zeros((1, 3, 16, 16), np.float32)
+        boxes, var = D.density_prior_box(
+            feat, img, densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0])
+        assert boxes.shape == (2, 2, 4, 4)
+
+    def test_anchor_generator(self):
+        feat = np.zeros((1, 8, 3, 3), np.float32)
+        anchors, var = D.anchor_generator(
+            feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        assert anchors.shape == (3, 3, 2, 4)
+        a = np.asarray(anchors)[0, 0, 0]
+        # 32-anchor at cell 0: centered at 8, 32x32
+        np.testing.assert_allclose(a, [-8.0, -8.0, 24.0, 24.0], atol=1e-4)
+
+
+class TestMatching:
+    def test_bipartite_match_greedy(self):
+        dist = np.array([[0.9, 0.1, 0.3],
+                         [0.6, 0.8, 0.2]], np.float32)
+        idx, md = D.bipartite_match(dist)
+        # greedy max-first: (0,0)=0.9 then (1,1)=0.8; col 2 unmatched
+        np.testing.assert_array_equal(np.asarray(idx), [0, 1, -1])
+        np.testing.assert_allclose(np.asarray(md), [0.9, 0.8, 0.0])
+
+    def test_bipartite_per_prediction(self):
+        dist = np.array([[0.9, 0.1, 0.6],
+                         [0.6, 0.8, 0.2]], np.float32)
+        idx, _ = D.bipartite_match(dist, "per_prediction", 0.5)
+        # col 2's best row 0 has 0.6 > 0.5 → matched to row 0 as well
+        np.testing.assert_array_equal(np.asarray(idx), [0, 1, 0])
+
+    def test_target_assign(self):
+        x = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+        idx = np.array([[2, -1, 0]], np.int32)
+        out, w = D.target_assign(x, idx, mismatch_value=9.0)
+        np.testing.assert_allclose(np.asarray(out)[0, 0], x[0, 2])
+        np.testing.assert_allclose(np.asarray(out)[0, 1], [9.0] * 4)
+        np.testing.assert_allclose(np.asarray(w)[0, :, 0], [1, 0, 1])
+
+
+class TestNMS:
+    def test_multiclass_nms_suppresses(self):
+        # two near-identical boxes + one distant; expect 2 survivors
+        boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]  # class 1
+        out = np.asarray(D.multiclass_nms(
+            boxes, scores, background_label=0, score_threshold=0.1,
+            nms_top_k=3, nms_threshold=0.5, keep_top_k=5))
+        assert out.shape == (1, 5, 6)
+        valid = out[0][out[0, :, 0] >= 0]
+        assert len(valid) == 2
+        np.testing.assert_allclose(sorted(valid[:, 1]), [0.7, 0.9])
+        assert set(valid[:, 0]) == {1.0}
+
+    def test_multiclass_nms_score_threshold(self):
+        boxes = np.array([[[0, 0, 10, 10]]], np.float32)
+        scores = np.array([[[0.04], [0.04]]], np.float32)
+        out = np.asarray(D.multiclass_nms(boxes, scores,
+                                          score_threshold=0.05,
+                                          keep_top_k=3))
+        assert (out[0, :, 0] == -1).all()
+
+    def test_detection_output_runs(self):
+        rng = np.random.RandomState(2)
+        priors = _rand_boxes(rng, 8)
+        var = np.full((8, 4), 0.1, np.float32)
+        loc = rng.randn(2, 8, 4).astype(np.float32) * 0.1
+        sc = np.abs(rng.rand(2, 8, 3)).astype(np.float32)
+        out = D.detection_output(loc, sc, priors, var, keep_top_k=4)
+        assert out.shape == (2, 4, 6)
+
+
+class TestSSDLoss:
+    def test_ssd_loss_positive_and_finite(self):
+        rng = np.random.RandomState(3)
+        priors = _rand_boxes(rng, 12)
+        gt = np.stack([priors[2], priors[7]])[None]  # exact matches
+        gtl = np.array([[1, 2]], np.int32)
+        loc = rng.randn(1, 12, 4).astype(np.float32) * 0.05
+        conf = rng.randn(1, 12, 3).astype(np.float32)
+        loss = np.asarray(D.ssd_loss(loc, conf, gt, gtl, priors))
+        assert loss.shape == (1,)
+        assert np.isfinite(loss).all() and loss[0] > 0
+
+    def test_ssd_loss_ignores_padded_gt(self):
+        rng = np.random.RandomState(4)
+        priors = _rand_boxes(rng, 10)
+        loc = rng.randn(1, 10, 4).astype(np.float32) * 0.05
+        conf = rng.randn(1, 10, 3).astype(np.float32)
+        gt1 = np.stack([priors[0]])[None]
+        l1 = np.asarray(D.ssd_loss(loc, conf, gt1, np.array([[1]]), priors))
+        gt2 = np.concatenate([gt1, np.zeros((1, 3, 4), np.float32)], 1)
+        gtl2 = np.array([[1, -1, -1, -1]], np.int32)
+        l2 = np.asarray(D.ssd_loss(loc, conf, gt2, gtl2, priors))
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+class TestYolo:
+    def test_yolo_box_decode(self):
+        b, na, cnum, h, w = 1, 2, 3, 2, 2
+        x = np.zeros((b, na * (5 + cnum), h, w), np.float32)
+        x[0, 4] = 5.0  # objectness of anchor 0 high everywhere
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = D.yolo_box(x, img, anchors=[10, 10, 20, 20],
+                                   class_num=cnum, conf_thresh=0.5,
+                                   downsample_ratio=32)
+        assert boxes.shape == (1, na * h * w, 4)
+        assert scores.shape == (1, na * h * w, cnum)
+        bb = np.asarray(boxes).reshape(na, h, w, 4)
+        # anchor 0 cell (0,0): center (.5/2, .5/2) of img 64 → (16, 16),
+        # size 10/64*64=10
+        np.testing.assert_allclose(bb[0, 0, 0], [11, 11, 21, 21], atol=1e-3)
+        # anchor 1 suppressed by conf_thresh
+        assert (bb[1] == 0).all()
+
+    def test_yolov3_loss_finite_and_sensitive(self):
+        rng = np.random.RandomState(5)
+        b, cnum, h, w = 2, 4, 4, 4
+        mask = [0, 1]
+        x = rng.randn(b, len(mask) * (5 + cnum), h, w).astype(np.float32)
+        gt = np.zeros((b, 3, 4), np.float32)
+        gt[:, 0] = [0.5, 0.5, 0.3, 0.3]
+        gtl = np.zeros((b, 3), np.int32)
+        loss = np.asarray(D.yolov3_loss(
+            x, gt, gtl, anchors=[10, 13, 16, 30, 33, 23], anchor_mask=mask,
+            class_num=cnum, ignore_thresh=0.7, downsample_ratio=8))
+        assert loss.shape == (b,)
+        assert np.isfinite(loss).all() and (loss > 0).all()
+        # removing all gt must change (reduce location part of) the loss
+        loss0 = np.asarray(D.yolov3_loss(
+            x, np.zeros_like(gt), gtl, anchors=[10, 13, 16, 30, 33, 23],
+            anchor_mask=mask, class_num=cnum, ignore_thresh=0.7,
+            downsample_ratio=8))
+        assert not np.allclose(loss, loss0)
+
+
+class TestFocal:
+    def test_sigmoid_focal_loss(self):
+        x = np.array([[2.0, -2.0], [-1.0, 3.0]], np.float32)
+        label = np.array([1, 0], np.int32)  # row0 class1, row1 background
+        out = np.asarray(D.sigmoid_focal_loss(x, label, fg_num=1))
+        assert out.shape == (2, 2)
+        assert np.isfinite(out).all() and (out >= 0).all()
+        # confident correct (x=2, class present) ≈ small loss
+        assert out[0, 0] < out[0, 1]
+
+
+class TestRoI:
+    def test_roi_align_identity(self):
+        # 1x1 input region → constant feature value
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = np.asarray(D.roi_align(feat, rois, 2, 2, 1.0, 1))
+        assert out.shape == (1, 1, 2, 2)
+        # averages of the four quadrant bilinear samples stay in range
+        assert out.min() >= 0 and out.max() <= 15
+
+    def test_roi_align_const(self):
+        feat = np.full((1, 2, 5, 5), 3.0, np.float32)
+        rois = np.array([[1.0, 1.0, 4.0, 4.0]], np.float32)
+        out = np.asarray(D.roi_align(feat, rois, 3, 3, 1.0, 2))
+        np.testing.assert_allclose(out, 3.0, atol=1e-5)
+
+    def test_roi_pool_max(self):
+        feat = np.zeros((1, 1, 4, 4), np.float32)
+        feat[0, 0, 1, 1] = 7.0
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = np.asarray(D.roi_pool(feat, rois, 1, 1, 1.0))
+        np.testing.assert_allclose(out, [[[[7.0]]]])
+
+    def test_roi_batch_indices(self):
+        feat = np.stack([np.zeros((1, 3, 3)), np.ones((1, 3, 3))]) \
+            .astype(np.float32)
+        rois = np.array([[0, 0, 2, 2], [0, 0, 2, 2]], np.float32)
+        out = np.asarray(D.roi_pool(feat, rois, 1, 1, 1.0,
+                                    roi_batch_indices=[0, 1]))
+        np.testing.assert_allclose(out[:, 0, 0, 0], [0.0, 1.0])
+
+    def test_psroi_pool(self):
+        ph = pw = 2
+        oc = 1
+        feat = np.random.RandomState(6).rand(
+            1, oc * ph * pw, 6, 6).astype(np.float32)
+        rois = np.array([[0.0, 0.0, 5.0, 5.0]], np.float32)
+        out = np.asarray(D.psroi_pool(feat, rois, oc, 1.0, ph, pw))
+        assert out.shape == (1, oc, ph, pw)
+        assert np.isfinite(out).all()
+
+
+class TestProposals:
+    def _setup(self):
+        rng = np.random.RandomState(7)
+        h = w = 4
+        na = 3
+        feat = np.zeros((1, 8, h, w), np.float32)
+        anchors, var = D.anchor_generator(
+            feat, anchor_sizes=[16.0, 32.0, 64.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        scores = rng.rand(1, na, h, w).astype(np.float32)
+        deltas = rng.randn(1, na * 4, h, w).astype(np.float32) * 0.1
+        im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+        return scores, deltas, im_info, anchors, var
+
+    def test_generate_proposals(self):
+        scores, deltas, im_info, anchors, var = self._setup()
+        rois, probs, n = D.generate_proposals(
+            scores, deltas, im_info, anchors, var, pre_nms_top_n=20,
+            post_nms_top_n=8, nms_thresh=0.7, min_size=1.0)
+        assert rois.shape == (1, 8, 4)
+        assert probs.shape == (1, 8, 1)
+        nn = int(np.asarray(n)[0])
+        assert 0 < nn <= 8
+        r = np.asarray(rois)[0, :nn]
+        assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
+        assert r.min() >= 0 and r.max() <= 31
+
+    def test_fpn_distribute_collect(self):
+        rng = np.random.RandomState(8)
+        rois = np.concatenate([
+            _rand_boxes(rng, 4, 32.0),          # small → low level
+            _rand_boxes(rng, 4, 32.0) * 8,      # big → high level
+        ]).astype(np.float32)
+        multi, masks, restore = D.distribute_fpn_proposals(
+            rois, min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        assert len(multi) == 4
+        total = sum(int(np.asarray(m).sum()) for m in masks)
+        assert total == 8
+        restore = np.asarray(restore)
+        assert sorted(restore.tolist()) == list(range(8))
+        scores = [rng.rand(8).astype(np.float32) for _ in multi]
+        out_r, out_s = D.collect_fpn_proposals(
+            multi, scores, 2, 5, post_nms_top_n=6, valid_masks=masks)
+        assert out_r.shape == (6, 4)
+        assert (np.asarray(out_s)[:total][: 6] >= 0).all()
+
+
+class TestHostOps:
+    def test_rpn_target_assign(self):
+        feat = np.zeros((1, 8, 4, 4), np.float32)
+        anchors, var = D.anchor_generator(
+            feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        anchors = np.asarray(anchors).reshape(-1, 4)
+        gts = np.array([[4.0, 4.0, 20.0, 20.0]], np.float32)
+        im_info = np.array([32.0, 32.0, 1.0], np.float32)
+        loc_i, sc_i, lab, tgt, inw = D.rpn_target_assign(
+            None, None, anchors, None, gts, None, im_info,
+            rpn_batch_size_per_im=8)
+        assert loc_i.size > 0
+        assert sc_i.size >= loc_i.size
+        assert lab.shape[0] == sc_i.size
+        assert tgt.shape == (loc_i.size, 4)
+        assert np.isfinite(tgt).all()
+
+    def test_generate_proposal_labels(self):
+        rng = np.random.RandomState(9)
+        rois = _rand_boxes(rng, 10, 30.0)
+        gts = _rand_boxes(rng, 2, 30.0)
+        out = D.generate_proposal_labels(
+            rois, np.array([1, 2]), None, gts,
+            np.array([32.0, 32.0, 1.0]), batch_size_per_im=8,
+            class_nums=4)
+        rois_o, labels, tgt, inw, outw = out
+        assert rois_o.shape[1] == 4
+        assert labels.shape == (rois_o.shape[0], 1)
+        assert tgt.shape == (rois_o.shape[0], 16)
+        assert (outw == (inw > 0)).all()
+
+    def test_detection_map_perfect(self):
+        gt_box = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], np.float32)
+        gt_label = np.array([1, 2])
+        det = np.array([[1, 0.9, 0, 0, 10, 10],
+                        [2, 0.8, 20, 20, 30, 30]], np.float32)
+        m = D.detection_map(det, gt_label, gt_box, class_num=3)
+        assert m == pytest.approx(1.0)
+
+    def test_detection_map_miss(self):
+        gt_box = np.array([[0, 0, 10, 10]], np.float32)
+        gt_label = np.array([1])
+        det = np.array([[1, 0.9, 50, 50, 60, 60]], np.float32)
+        m = D.detection_map(det, gt_label, gt_box, class_num=2)
+        assert m == pytest.approx(0.0)
+
+
+class TestMisc:
+    def test_polygon_box_transform(self):
+        x = np.zeros((1, 8, 2, 2), np.float32)
+        out = np.asarray(D.polygon_box_transform(x))
+        # offsets zero → absolute grid coords * 4
+        np.testing.assert_allclose(out[0, 0], [[0, 4], [0, 4]])
+        np.testing.assert_allclose(out[0, 1], [[0, 0], [4, 4]])
+
+    def test_box_decoder_and_assign(self):
+        rng = np.random.RandomState(10)
+        priors = _rand_boxes(rng, 5, 30.0)
+        var = np.full((5, 4), 0.1, np.float32)
+        tgt = rng.randn(5, 12).astype(np.float32) * 0.1
+        score = np.abs(rng.rand(5, 3)).astype(np.float32)
+        dec, assigned = D.box_decoder_and_assign(priors, var, tgt, score)
+        assert dec.shape == (5, 12)
+        assert assigned.shape == (5, 4)
+
+    def test_retinanet_detection_output(self):
+        rng = np.random.RandomState(11)
+        levels = []
+        anchors = []
+        scoreses = []
+        for n in (6, 4):
+            levels.append(rng.randn(1, n, 4).astype(np.float32) * 0.1)
+            anchors.append(_rand_boxes(rng, n, 50.0))
+            scoreses.append(np.abs(rng.rand(1, n, 3)).astype(np.float32))
+        out = D.retinanet_detection_output(
+            levels, scoreses, anchors, np.array([[64.0, 64.0, 1.0]]),
+            keep_top_k=5)
+        assert out.shape == (1, 5, 6)
+
+
+class TestLayersSurface:
+    def test_exposed_in_layers(self):
+        for name in ("multiclass_nms", "prior_box", "yolo_box", "roi_align",
+                     "ssd_loss", "detection_map", "generate_proposals",
+                     "distribute_fpn_proposals", "rpn_target_assign"):
+            assert hasattr(pt.layers, name), name
+
+
+class TestStaticPromotion:
+    """Optional tensor args in attr positions must ride the input list
+    (regression: Variables were baked into op attrs and crashed the
+    executor)."""
+
+    def test_ssd_loss_static_with_prior_var(self):
+        rng = np.random.RandomState(20)
+        priors = _rand_boxes(rng, 6)
+        pvar = np.full((6, 4), 0.1, np.float32)
+        gt = np.stack([priors[1]])[None]
+        gtl = np.array([[1]], np.int32)
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                loc = pt.static.data("loc", [1, 6, 4], "float32",
+                                     append_batch_size=False)
+                conf = pt.static.data("conf", [1, 6, 3], "float32",
+                                      append_batch_size=False)
+                pb = pt.static.data("pb", [6, 4], "float32",
+                                    append_batch_size=False)
+                pbv = pt.static.data("pbv", [6, 4], "float32",
+                                     append_batch_size=False)
+                loss = pt.layers.ssd_loss(loc, conf, gt, gtl, pb,
+                                          prior_box_var=pbv)
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                out = exe.run(main, feed={
+                    "loc": rng.randn(1, 6, 4).astype(np.float32) * 0.05,
+                    "conf": rng.randn(1, 6, 3).astype(np.float32),
+                    "pb": priors, "pbv": pvar}, fetch_list=[loss])
+            assert np.isfinite(out[0]).all()
+        finally:
+            pt.disable_static()
+
+    def test_crf_decoding_static(self):
+        rng = np.random.RandomState(21)
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                em = pt.static.data("em", [2, 5, 3], "float32",
+                                    append_batch_size=False)
+                tr = pt.static.data("tr", [5, 3], "float32",
+                                    append_batch_size=False)
+                path = pt.layers.crf_decoding(em, tr)
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                out = exe.run(main, feed={
+                    "em": rng.randn(2, 5, 3).astype(np.float32),
+                    "tr": rng.randn(5, 3).astype(np.float32)},
+                    fetch_list=[path])
+            assert out[0].shape == (2, 5)
+        finally:
+            pt.disable_static()
