@@ -7,6 +7,7 @@ Metric format follows the reference's examples/sec convention
 """
 
 import json
+import os
 import sys
 import time
 
@@ -70,7 +71,11 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    cfg = bert.bert_base() if on_tpu else bert.bert_tiny()
+    # BENCH_ATTN=dense|flash selects the attention path (flash = Pallas
+    # blockwise kernel, ops/pallas_kernels.py) for A/B runs on the chip
+    attn = os.environ.get("BENCH_ATTN", "dense")
+    cfg = (bert.bert_base(attention_impl=attn) if on_tpu
+           else bert.bert_tiny(attention_impl=attn))
     batch, seq = (32, 512) if on_tpu else (2, 32)
     steps = 20 if on_tpu else 3
 

@@ -143,7 +143,7 @@ def _append_static(name, fn, tensor_vals, attrs, listy,
     specs2, specs3 = [], []
     had_dyn = False
     flat = list(tensor_vals[0] if listy else tensor_vals)
-    all_params = list(tensor_params) if tensor_params else None
+    all_params = list(tensor_params) if tensor_params is not None else []
     if promoted:
         flat = flat + list(promoted.values())
         all_params = all_params + list(promoted)

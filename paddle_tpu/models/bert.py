@@ -48,7 +48,9 @@ class BertConfig:
     # "dense": GSPMD gathers K/V over "seq"; "ring": blockwise ring
     # attention (parallel/ring_attention.py) — K/V never materialised
     # whole, permutes ride ICI neighbor links. Use "ring" for long-context
-    # runs where S/n_seq is still large.
+    # runs where S/n_seq is still large. "flash": Pallas blockwise
+    # online-softmax kernel (ops/pallas_kernels.py) — single-device/dp
+    # fast path; scores never materialise in HBM.
     attention_impl: str = "dense"
 
     @property
@@ -178,6 +180,18 @@ def _attention(lp, x, mask_bias, cfg, mesh=None, key_padding_mask=None):
         return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
+
+    if cfg.attention_impl == "flash":
+        # Pallas blockwise kernel: [S, S] scores never hit HBM
+        # (paddle_tpu/ops/pallas_kernels.py). mask_bias [B,1,1,S] is a
+        # key-padding bias → [B, S].
+        from paddle_tpu.ops import pallas_kernels as _pk
+        bias = mask_bias.reshape(B, S).astype(jnp.float32)
+        ctx = _pk.flash_attention(q, k, v, bias=bias)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H).astype(x.dtype)
+        return ctx @ lp["out_w"].astype(x.dtype) \
+            + lp["out_b"].astype(x.dtype)
+
     scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) / math.sqrt(hd)
     scores = scores + mask_bias  # [B,1,1,S] additive
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)

@@ -450,8 +450,18 @@ def multiclass_nms(bboxes, scores, background_label=0, score_threshold=0.05,
     bboxes = jnp.asarray(bboxes, jnp.float32)
     scores = jnp.asarray(scores, jnp.float32)
     bsz, ncls, m = scores.shape
+    # drop the background class BEFORE the per-class vmap — its lane
+    # would otherwise pay a full sort + KxK IoU + K-step NMS per image
+    if 0 <= background_label < ncls:
+        fg_cls = np.asarray([c for c in range(ncls)
+                             if c != background_label])
+        scores = scores[:, fg_cls, :]
+    else:
+        fg_cls = np.arange(ncls)
+    cls_ids = jnp.asarray(fg_cls, jnp.int32)
+    nfg = len(fg_cls)
     k = min(int(nms_top_k) if nms_top_k > 0 else m, m)
-    keep_k = int(keep_top_k) if keep_top_k > 0 else ncls * k
+    keep_k = int(keep_top_k) if keep_top_k > 0 else nfg * k
 
     def per_class(cls_scores, boxes):
         s = jnp.where(cls_scores > score_threshold, cls_scores, -jnp.inf)
@@ -464,9 +474,7 @@ def multiclass_nms(bboxes, scores, background_label=0, score_threshold=0.05,
 
     def per_image(boxes, img_scores):
         ks, kb = jax.vmap(lambda cs: per_class(cs, boxes))(img_scores)
-        labels = jnp.broadcast_to(jnp.arange(ncls)[:, None], (ncls, k))
-        if 0 <= background_label < ncls:
-            ks = ks.at[background_label].set(-jnp.inf)
+        labels = jnp.broadcast_to(cls_ids[:, None], (nfg, k))
         flat_s = ks.reshape(-1)
         flat_b = kb.reshape(-1, 4)
         flat_l = labels.reshape(-1)
@@ -992,7 +1000,14 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
         bh = jnp.exp(jnp.minimum(t[:, 3], 10.0)) * ah
         props = jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
                            cx + bw * 0.5 - 1.0, cy + bh * 0.5 - 1.0], -1)
-        props = box_clip(props, im)
+        # clip to the RESIZED image bounds (im_info h, w directly —
+        # the reference's ClipTiledBoxes with is_scale=false; box_clip
+        # would divide by scale and truncate half the image for scale>1)
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0, im[1] - 1.0),
+            jnp.clip(props[:, 1], 0, im[0] - 1.0),
+            jnp.clip(props[:, 2], 0, im[1] - 1.0),
+            jnp.clip(props[:, 3], 0, im[0] - 1.0)], axis=-1)
         # min_size filter in original-image scale
         ms = jnp.maximum(min_size, 1.0) * im[2]
         pw = props[:, 2] - props[:, 0] + 1.0
@@ -1184,6 +1199,10 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     """
     anchors = np.asarray(anchor_box, np.float32).reshape(-1, 4)
     gts = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+    if is_crowd is not None:
+        crowd = np.asarray(is_crowd).reshape(-1).astype(bool)
+        gts = gts[~crowd]  # crowd gt never produce positives (parity:
+        # rpn_target_assign_op.cc FilterCrowdGtBoxes)
     info = np.asarray(im_info, np.float32).reshape(-1)[:3]
     a = anchors.shape[0]
     rng = np.random.RandomState(seed)
@@ -1251,6 +1270,10 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     rois = np.asarray(rpn_rois, np.float32).reshape(-1, 4)
     gts = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
     gtc = np.asarray(gt_classes, np.int32).reshape(-1)
+    if is_crowd is not None:
+        crowd = np.asarray(is_crowd).reshape(-1).astype(bool)
+        gts = gts[~crowd]
+        gtc = gtc[~crowd]
     rng = np.random.RandomState(seed)
     # gt boxes participate as candidate rois
     cand = np.concatenate([rois, gts], 0) if gts.size else rois

@@ -1,0 +1,431 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference hand-writes CUDA for its hot paths (operators/math/,
+operators/jit/ xbyak codegen, fused_* ops — SURVEY §2.4); the TPU-native
+equivalent is Pallas (Mosaic) kernels sitting behind the same functional op
+surface. XLA already fuses the easy elementwise chains; these kernels cover
+what fusion can't express:
+
+- flash_attention — blockwise online-softmax attention; the [S, S] score
+  matrix never exists in HBM (the reference materialises scores in
+  operators/math/ softmax + matmul calls). Forward is a Pallas kernel;
+  backward is the standard blockwise recompute formulated for XLA.
+- fused_layer_norm — one VMEM pass for mean/var/normalise/affine.
+- softmax_cross_entropy — fused max/logsumexp/pick in one pass over the
+  vocab axis (the reference's softmax_with_cross_entropy fused op,
+  operators/softmax_with_cross_entropy_op.cc).
+
+Every entry point takes `interpret=None` → auto: compiled on TPU,
+interpreter mode elsewhere (CI runs on CPU; tests exercise the same code
+path the TPU runs).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu import fails on some CPU-only builds; interpret mode works
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["flash_attention", "fused_layer_norm", "softmax_cross_entropy"]
+
+_NEG_INF = -1e30
+
+
+def _auto_interpret(interpret):
+    if interpret is not None:
+        return interpret
+    try:
+        return jax.devices()[0].platform == "cpu"
+    except Exception:  # pragma: no cover
+        return True
+
+
+def _vmem_spec(*args, **kwargs):
+    if _HAS_PLTPU:
+        kwargs.setdefault("memory_space", pltpu.VMEM)
+    return pl.BlockSpec(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
+                      sm_scale, block_k, causal, seq_len, block_q):
+    """One (batch, head, q-block) cell: stream K/V blocks, keep running
+    (max, sum, acc) — the online-softmax recurrence."""
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # [bq, d]
+    bq, d = q.shape
+    nk = seq_len // block_k
+    iq = pl.program_id(2)
+
+    def body(jk, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(jk * block_k, block_k), :] \
+            .astype(jnp.float32)                           # [bk, d]
+        v_blk = v_ref[0, 0, pl.ds(jk * block_k, block_k), :] \
+            .astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        b_blk = bias_ref[0, pl.ds(jk * block_k, block_k)] \
+            .astype(jnp.float32)                           # [bk]
+        s = s + b_blk[None, :]
+        if causal:
+            qi = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            ki = jk * block_k + lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(ki <= qi, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)                        # [bq]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])                    # [bq, bk]
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k,
+               interpret):
+    b, h, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    grid = (b, h, s // block_q)
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, block_k=block_k,
+        causal=causal, seq_len=s, block_q=block_q)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+            _vmem_spec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+            _vmem_spec((1, s), lambda ib, ih, iq: (ib, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, block_q), lambda ib, ih, iq: (ib, ih, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, bias)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                     interpret):
+    o, _ = _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                      interpret)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                         interpret):
+    o, lse = _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k,
+                        interpret)
+    return o, (q, k, v, bias, o, lse)
+
+
+def _flash_attention_bwd(sm_scale, causal, block_q, block_k, interpret,
+                         res, do):
+    """Blockwise recompute backward (standard flash formulation), written
+    for XLA: scan over q blocks keeps live memory at
+    O(block_q · S) instead of O(S²)."""
+    q, k, v, bias, o, lse = res
+    b, h, s, d = q.shape
+    bq = min(block_q, s)
+    nblk = s // bq
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
+
+    def blocks(t):  # [B,H,S,...] -> [nblk, B,H,bq,...]
+        return jnp.moveaxis(
+            t.reshape(t.shape[:2] + (nblk, bq) + t.shape[3:]), 2, 0)
+
+    qb = blocks(q.astype(jnp.float32))
+    dob = blocks(do.astype(jnp.float32))
+    lseb = blocks(lse)
+    deltab = blocks(delta)
+    q_idx = jnp.arange(s).reshape(nblk, bq)
+    k_idx = jnp.arange(s)
+
+    def step(carry, xs):
+        dk_acc, dv_acc, db_acc = carry
+        q_blk, do_blk, lse_blk, d_blk, qi = xs
+        sres = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kf) * sm_scale
+        sres = sres + bias[:, None, None, :].astype(jnp.float32)
+        if causal:
+            sres = jnp.where(k_idx[None, None, None, :]
+                             <= qi[None, None, :, None], sres, _NEG_INF)
+        p = jnp.exp(sres - lse_blk[..., None])             # [B,H,bq,S]
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, do_blk)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_blk, vf)
+        ds = p * (dp - d_blk[..., None]) * sm_scale
+        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk)
+        db_acc = db_acc + jnp.sum(ds, axis=(1, 2)) / sm_scale
+        return (dk_acc, dv_acc, db_acc), dq_blk
+
+    zero_kv = jnp.zeros((b, h, s, d), jnp.float32)
+    (dk, dv, dbias), dqb = lax.scan(
+        step, (zero_kv, zero_kv, jnp.zeros((b, s), jnp.float32)),
+        (qb, dob, lseb, deltab, q_idx))
+    dq = jnp.moveaxis(dqb, 0, 2).reshape(b, h, s, d)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias.astype(bias.dtype))
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                    block_q=128, block_k=128, interpret=None):
+    """Blockwise (flash) attention.
+
+    q, k, v: [B, H, S, D]. bias: optional [B, S] additive key bias
+    (e.g. key-padding mask as 0 / -inf). Returns [B, H, S, D] in q.dtype.
+    Sequence is padded to the block size internally (padded keys masked).
+    """
+    q = jnp.asarray(q)
+    k = jnp.asarray(k)
+    v = jnp.asarray(v)
+    b, h, s, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if bias is None:
+        bias = jnp.zeros((b, s), jnp.float32)
+    bias = jnp.asarray(bias, jnp.float32).reshape(b, s)
+    interpret = _auto_interpret(interpret)
+    if s <= max(block_q, block_k):
+        # short sequences: one block each way
+        block_q = block_k = s
+        pad = 0
+    else:
+        block_q = min(block_q, s)
+        block_k = min(block_k, s)
+        # the grid floors by block_q and the kv loop by block_k — S must
+        # be a multiple of BOTH or trailing keys are silently dropped
+        pad = (-s) % math.lcm(block_q, block_k)
+    if pad:
+        zf = ((0, 0), (0, 0), (0, pad), (0, 0))
+        q = jnp.pad(q, zf)
+        k = jnp.pad(k, zf)
+        v = jnp.pad(v, zf)
+        bias = jnp.pad(bias, ((0, 0), (0, pad)),
+                       constant_values=_NEG_INF)
+    out = _flash_attention(q, k, v, bias, float(sm_scale), bool(causal),
+                           int(block_q), int(block_k), bool(interpret))
+    if pad:
+        out = out[:, :, :s, :]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fused layer norm
+# ---------------------------------------------------------------------------
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mu_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = lax.rsqrt(var + eps)
+    y = xc * rstd * g_ref[:].astype(jnp.float32) + b_ref[:].astype(
+        jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    mu_ref[:] = mu[:, 0]
+    rstd_ref[:] = rstd[:, 0]
+
+
+def _ln_fwd(x2, g, b, eps, block_n, interpret):
+    n, hdim = x2.shape
+    block_n = min(block_n, n)
+    grid = (pl.cdiv(n, block_n),)
+    y, mu, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            _vmem_spec((block_n, hdim), lambda i: (i, 0)),
+            _vmem_spec((hdim,), lambda i: (0,)),
+            _vmem_spec((hdim,), lambda i: (0,)),
+        ],
+        out_specs=[
+            _vmem_spec((block_n, hdim), lambda i: (i, 0)),
+            _vmem_spec((block_n,), lambda i: (i,)),
+            _vmem_spec((block_n,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, g, b)
+    return y, mu, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_layer_norm(x2, g, b, eps, block_n, interpret):
+    y, _, _ = _ln_fwd(x2, g, b, eps, block_n, interpret)
+    return y
+
+
+def _fused_ln_fwd(x2, g, b, eps, block_n, interpret):
+    y, mu, rstd = _ln_fwd(x2, g, b, eps, block_n, interpret)
+    return y, (x2, g, mu, rstd)
+
+
+def _fused_ln_bwd(eps, block_n, interpret, res, dy):
+    x2, g, mu, rstd = res
+    x32 = x2.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mu[:, None]) * rstd[:, None]
+    gf = g.astype(jnp.float32)
+    dg = jnp.sum(dy32 * xhat, axis=0)
+    db = jnp.sum(dy32, axis=0)
+    wdy = dy32 * gf
+    c1 = jnp.mean(wdy, axis=-1, keepdims=True)
+    c2 = jnp.mean(wdy * xhat, axis=-1, keepdims=True)
+    dx = (wdy - c1 - xhat * c2) * rstd[:, None]
+    return dx.astype(x2.dtype), dg.astype(g.dtype), db.astype(g.dtype)
+
+
+_fused_layer_norm.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def fused_layer_norm(x, gamma, beta, eps=1e-12, block_n=256,
+                     interpret=None):
+    """LayerNorm over the last axis in a single VMEM pass.
+
+    x: [..., H]; gamma/beta: [H]. Stats in fp32, output in x.dtype
+    (parity: operators/layer_norm_op.cc; jit/ layernorm kernel).
+    """
+    x = jnp.asarray(x)
+    shape = x.shape
+    hdim = shape[-1]
+    x2 = x.reshape(-1, hdim)
+    n = x2.shape[0]
+    interpret = _auto_interpret(interpret)
+    block_n = min(block_n, n)
+    pad = (-n) % block_n
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    y = _fused_layer_norm(x2, jnp.asarray(gamma), jnp.asarray(beta),
+                          float(eps), int(block_n), bool(interpret))
+    if pad:
+        y = y[:n]
+    return y.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax cross-entropy
+# ---------------------------------------------------------------------------
+
+def _xent_kernel(logits_ref, labels_ref, loss_ref, lse_ref):
+    x = logits_ref[:].astype(jnp.float32)                  # [bn, V]
+    lab = labels_ref[:]                                    # [bn]
+    m = jnp.max(x, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m[:, None]), axis=-1))
+    cols = lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    picked = jnp.sum(jnp.where(cols == lab[:, None], x, 0.0), axis=-1)
+    loss_ref[:] = lse - picked
+    lse_ref[:] = lse
+
+
+def _xent_fwd_call(logits2, labels1, block_n, interpret):
+    n, v = logits2.shape
+    block_n = min(block_n, n)
+    grid = (pl.cdiv(n, block_n),)
+    loss, lse = pl.pallas_call(
+        _xent_kernel,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((block_n, v), lambda i: (i, 0)),
+            _vmem_spec((block_n,), lambda i: (i,)),
+        ],
+        out_specs=[
+            _vmem_spec((block_n,), lambda i: (i,)),
+            _vmem_spec((block_n,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits2, labels1)
+    return loss, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _softmax_xent(logits2, labels1, block_n, interpret):
+    loss, _ = _xent_fwd_call(logits2, labels1, block_n, interpret)
+    return loss
+
+
+def _softmax_xent_fwd(logits2, labels1, block_n, interpret):
+    loss, lse = _xent_fwd_call(logits2, labels1, block_n, interpret)
+    return loss, (logits2, labels1, lse)
+
+
+def _softmax_xent_bwd(block_n, interpret, res, dloss):
+    logits2, labels1, lse = res
+    x = logits2.astype(jnp.float32)
+    p = jnp.exp(x - lse[:, None])
+    onehot = jax.nn.one_hot(labels1, x.shape[-1], dtype=jnp.float32)
+    dx = (p - onehot) * dloss[:, None]
+    return dx.astype(logits2.dtype), None
+
+
+_softmax_xent.defvjp(_softmax_xent_fwd, _softmax_xent_bwd)
+
+
+def softmax_cross_entropy(logits, labels, block_n=128, interpret=None):
+    """Fused per-example softmax cross-entropy.
+
+    logits: [..., V]; labels: [...] int. Returns [...] fp32 losses.
+    One pass computes max, logsumexp, and the label pick (parity:
+    operators/softmax_with_cross_entropy_op.cc fused op).
+    """
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels, jnp.int32)
+    v = logits.shape[-1]
+    lead = logits.shape[:-1]
+    logits2 = logits.reshape(-1, v)
+    labels1 = labels.reshape(-1)
+    n = logits2.shape[0]
+    interpret = _auto_interpret(interpret)
+    block_n = min(block_n, n)
+    pad = (-n) % block_n
+    if pad:
+        logits2 = jnp.pad(logits2, ((0, pad), (0, 0)))
+        labels1 = jnp.pad(labels1, (0, pad))
+    loss = _softmax_xent(logits2, labels1, int(block_n), bool(interpret))
+    if pad:
+        loss = loss[:n]
+    return loss.reshape(lead)

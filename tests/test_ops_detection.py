@@ -470,3 +470,53 @@ class TestStaticPromotion:
             assert out[0].shape == (2, 5)
         finally:
             pt.disable_static()
+
+
+class TestReviewRegressions:
+    def test_generate_proposals_clips_to_resized_image(self):
+        # scale=2: proposals must clip to the RESIZED 64x64 bounds (63),
+        # not original-image bounds (31)
+        rng = np.random.RandomState(30)
+        h = w = 4
+        feat = np.zeros((1, 8, h, w), np.float32)
+        anchors, var = D.anchor_generator(
+            feat, anchor_sizes=[64.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        scores = rng.rand(1, 1, h, w).astype(np.float32)
+        deltas = np.zeros((1, 4, h, w), np.float32)
+        im_info = np.array([[64.0, 64.0, 2.0]], np.float32)
+        rois, probs, n = D.generate_proposals(
+            scores, deltas, im_info, anchors, var, pre_nms_top_n=16,
+            post_nms_top_n=8, nms_thresh=0.9, min_size=1.0)
+        r = np.asarray(rois)[0][: int(np.asarray(n)[0])]
+        assert r.max() > 32.0          # not truncated at 31
+        assert r.max() <= 63.0
+
+    def test_nms_background_excluded_cheaply(self):
+        boxes = np.array([[[0, 0, 10, 10], [30, 30, 40, 40]]], np.float32)
+        scores = np.zeros((1, 3, 2), np.float32)
+        scores[0, 0] = [0.99, 0.99]    # background: must never appear
+        scores[0, 2] = [0.5, 0.4]
+        out = np.asarray(D.multiclass_nms(boxes, scores,
+                                          background_label=0,
+                                          score_threshold=0.1,
+                                          keep_top_k=4))
+        valid = out[0][out[0, :, 0] >= 0]
+        assert (valid[:, 0] == 2.0).all()
+
+    def test_rpn_target_assign_skips_crowd(self):
+        feat = np.zeros((1, 8, 4, 4), np.float32)
+        anchors, _ = D.anchor_generator(
+            feat, anchor_sizes=[16.0], aspect_ratios=[1.0],
+            stride=[8.0, 8.0])
+        anchors = np.asarray(anchors).reshape(-1, 4)
+        gts = np.array([[4.0, 4.0, 20.0, 20.0],
+                        [8.0, 8.0, 24.0, 24.0]], np.float32)
+        _, _, lab_all, _, _ = D.rpn_target_assign(
+            None, None, anchors, None, gts, np.array([0, 0]),
+            np.array([32.0, 32.0, 1.0]), rpn_batch_size_per_im=64)
+        _, _, lab_crowd, _, _ = D.rpn_target_assign(
+            None, None, anchors, None, gts, np.array([0, 1]),
+            np.array([32.0, 32.0, 1.0]), rpn_batch_size_per_im=64)
+        # with gt 2 crowd-filtered, positives can only come from gt 1
+        assert (lab_crowd == 1).sum() <= (lab_all == 1).sum()

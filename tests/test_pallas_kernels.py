@@ -1,0 +1,228 @@
+"""Pallas kernel tests (interpret mode on CPU — same code path as TPU).
+
+Pattern: every kernel checked against its dense jnp reference, values and
+gradients (the reference's OpTest numeric-vs-analytic discipline,
+unittests/op_test.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import pallas_kernels as K
+
+
+def _dense_attention(q, k, v, bias=None, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    if causal:
+        sq = s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sq), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+class TestFlashAttention:
+    def _rand(self, b=2, h=2, s=128, d=32, seed=0):
+        rng = np.random.RandomState(seed)
+        q = rng.randn(b, h, s, d).astype(np.float32) * 0.5
+        k = rng.randn(b, h, s, d).astype(np.float32) * 0.5
+        v = rng.randn(b, h, s, d).astype(np.float32)
+        return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+    def test_matches_dense(self):
+        q, k, v = self._rand()
+        got = K.flash_attention(q, k, v, block_q=64, block_k=64)
+        want = _dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_causal(self):
+        q, k, v = self._rand(s=128)
+        got = K.flash_attention(q, k, v, causal=True, block_q=64,
+                                block_k=64)
+        want = _dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_key_padding_bias(self):
+        q, k, v = self._rand(s=128)
+        bias = np.zeros((2, 128), np.float32)
+        bias[:, 100:] = -1e30  # mask tail keys
+        got = K.flash_attention(q, k, v, bias=jnp.asarray(bias),
+                                block_q=64, block_k=64)
+        want = _dense_attention(q, k, v, bias=jnp.asarray(bias))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_unaligned_seq_pads(self):
+        q, k, v = self._rand(s=100)  # not a multiple of any block
+        got = K.flash_attention(q, k, v)
+        want = _dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_gradients_match_dense(self):
+        q, k, v = self._rand(b=1, h=2, s=64, d=16, seed=1)
+
+        def f_flash(q, k, v):
+            return jnp.sum(K.flash_attention(q, k, v, block_q=32,
+                                             block_k=32) ** 2)
+
+        def f_dense(q, k, v):
+            return jnp.sum(_dense_attention(q, k, v) ** 2)
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=3e-4)
+
+    def test_causal_gradients(self):
+        q, k, v = self._rand(b=1, h=1, s=64, d=16, seed=2)
+
+        def f_flash(q):
+            return jnp.sum(K.flash_attention(q, k, v, causal=True,
+                                             block_q=32, block_k=32))
+
+        def f_dense(q):
+            return jnp.sum(_dense_attention(q, k, v, causal=True))
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(f_flash)(q)),
+            np.asarray(jax.grad(f_dense)(q)), atol=3e-4)
+
+    def test_bfloat16(self):
+        q, k, v = self._rand(s=64, d=32)
+        qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+        got = K.flash_attention(qb, kb, vb, block_q=32, block_k=32)
+        assert got.dtype == jnp.bfloat16
+        want = _dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want), atol=2e-2)
+
+
+class TestFusedLayerNorm:
+    def _ref(self, x, g, b, eps=1e-12):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.mean((x32 - mu) ** 2, -1, keepdims=True)
+        return (x32 - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+    def test_matches_reference(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(6, 5, 64).astype(np.float32))
+        g = jnp.asarray(rng.rand(64).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(64).astype(np.float32))
+        got = K.fused_layer_norm(x, g, b, block_n=8)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(self._ref(x, g, b)),
+                                   atol=1e-5)
+
+    def test_gradients(self):
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(10, 32).astype(np.float32))
+        g = jnp.asarray(rng.rand(32).astype(np.float32) + 0.5)
+        b = jnp.asarray(rng.randn(32).astype(np.float32))
+
+        def f1(x, g, b):
+            return jnp.sum(K.fused_layer_norm(x, g, b, block_n=4) ** 2)
+
+        def f2(x, g, b):
+            return jnp.sum(self._ref(x, g, b) ** 2)
+
+        g1 = jax.grad(f1, argnums=(0, 1, 2))(x, g, b)
+        g2 = jax.grad(f2, argnums=(0, 1, 2))(x, g, b)
+        for a, b_ in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=1e-4)
+
+    def test_unaligned_rows(self):
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(7, 16).astype(np.float32))  # 7 % 4 != 0
+        g = jnp.ones(16)
+        b = jnp.zeros(16)
+        got = K.fused_layer_norm(x, g, b, block_n=4)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(self._ref(x, g, b)),
+                                   atol=1e-5)
+
+
+class TestSoftmaxXent:
+    def test_matches_reference(self):
+        rng = np.random.RandomState(6)
+        logits = jnp.asarray(rng.randn(12, 50).astype(np.float32) * 3)
+        labels = jnp.asarray(rng.randint(0, 50, 12))
+        got = K.softmax_cross_entropy(logits, labels, block_n=4)
+        want = -jax.nn.log_softmax(logits)[jnp.arange(12), labels]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_gradients(self):
+        rng = np.random.RandomState(7)
+        logits = jnp.asarray(rng.randn(8, 20).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, 20, 8))
+
+        def f1(lg):
+            return jnp.mean(K.softmax_cross_entropy(lg, labels, block_n=4))
+
+        def f2(lg):
+            return jnp.mean(-jax.nn.log_softmax(lg)[jnp.arange(8), labels])
+
+        np.testing.assert_allclose(np.asarray(jax.grad(f1)(logits)),
+                                   np.asarray(jax.grad(f2)(logits)),
+                                   atol=1e-5)
+
+    def test_leading_dims(self):
+        rng = np.random.RandomState(8)
+        logits = jnp.asarray(rng.randn(2, 5, 30).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, 30, (2, 5)))
+        got = K.softmax_cross_entropy(logits, labels)
+        assert got.shape == (2, 5)
+
+
+class TestBertFlashIntegration:
+    def test_bert_flash_matches_dense(self):
+        from paddle_tpu.models import bert
+        cfg_d = bert.bert_tiny(attention_impl="dense")
+        cfg_f = bert.bert_tiny(attention_impl="flash")
+        params = bert.init_params(jax.random.PRNGKey(0), cfg_d)
+        batch = bert.synthetic_batch(cfg_d, batch_size=2, seq_len=64)
+        out_d = bert.forward(params, cfg_d, batch["input_ids"],
+                             batch["token_type_ids"],
+                             batch["attention_mask"])
+        out_f = bert.forward(params, cfg_f, batch["input_ids"],
+                             batch["token_type_ids"],
+                             batch["attention_mask"])
+        np.testing.assert_allclose(np.asarray(out_d, np.float32),
+                                   np.asarray(out_f, np.float32),
+                                   atol=3e-2)
+
+
+class TestFlashBlockRegression:
+    def test_mismatched_blocks_pad_to_lcm(self):
+        # S=192 with block_q=64, block_k=128 silently dropped keys
+        # 128..191 before the lcm padding fix
+        rng = np.random.RandomState(40)
+        q = jnp.asarray(rng.randn(1, 2, 192, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 2, 192, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 2, 192, 16).astype(np.float32))
+        got = K.flash_attention(q, k, v, block_q=64, block_k=128)
+        want = _dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+
+    def test_oversize_blocks(self):
+        rng = np.random.RandomState(41)
+        q = jnp.asarray(rng.randn(1, 1, 300, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 1, 300, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 1, 300, 16).astype(np.float32))
+        got = K.flash_attention(q, k, v, block_q=256, block_k=256)
+        want = _dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
