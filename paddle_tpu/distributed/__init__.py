@@ -8,3 +8,4 @@ from paddle_tpu.distributed.role_maker import (
     RoleMakerBase, PaddleCloudRoleMaker, UserDefinedRoleMaker, Role,
 )
 from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
+from paddle_tpu.distributed.sparse_embedding import SparseEmbeddingTable

@@ -1,0 +1,236 @@
+"""Host-resident sharded sparse embeddings — the parameter-server capability.
+
+Parity targets: the reference's large-sparse path — distributed lookup
+tables served by pservers (operators/distributed/parameter_prefetch.cc,
+parameter_send/recv), lookup_sparse_table_op.cc (auto-growing rows),
+pserver-side per-parameter optimize blocks (listen_and_serv_op.cc RunSyncLoop),
+SelectedRows sparse gradients (framework/selected_rows.h), and the async
+Communicator's merge-then-push (operators/distributed/communicator.h:103
+MergeVars).
+
+TPU-first redesign: giant embeddings live in HOST RAM, sharded by id hash;
+the TPU step only ever sees the dense [batch, slots, dim] slice that was
+prefetched for the current batch. Gradients w.r.t. that slice come out of
+the jitted step as ordinary dense arrays and are pushed back
+asynchronously — the push overlaps the next step's compute, so the sparse
+path never stalls the chip (the design constraint SURVEY §7 calls out).
+A "shard" here is the unit a multi-host deployment would place per host;
+in-process they are independent lock-protected tables, preserving the
+pserver sharding semantics (round-robin/hash dispatch,
+transpiler/ps_dispatcher.py) without the RPC hop.
+"""
+
+import os
+import queue
+import threading
+
+import numpy as np
+
+__all__ = ["SparseEmbeddingTable", "sparse_sgd", "sparse_adagrad"]
+
+
+def _hash_ids(ids, num_shards):
+    # splitmix-style mix so adjacent ids spread across shards
+    x = ids.astype(np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> np.uint64(31))
+    return (x % np.uint64(num_shards)).astype(np.int64)
+
+
+class _Shard:
+    """One id-hash shard: auto-growing row store + per-row optimizer slots
+    (lookup_sparse_table_op.cc auto-growth; pserver optimize block state)."""
+
+    def __init__(self, dim, initializer, seed, optimizer, grow=1024):
+        self.dim = dim
+        self.initializer = initializer
+        self.seed = seed
+        self.optimizer = optimizer
+        self.index = {}                      # id -> row
+        self.rows = np.zeros((0, dim), np.float32)
+        self.slot = np.zeros((0, dim), np.float32)   # adagrad accumulator
+        self.grow = grow
+        self.lock = threading.Lock()
+
+    def _ensure(self, ids):
+        # dedupe (order-preserving): a duplicate id in one batch must not
+        # claim two rows — the second claim would alias the next new id's
+        # row slot
+        new = list(dict.fromkeys(i for i in ids if i not in self.index))
+        if not new:
+            return
+        need = len(self.index) + len(new)
+        if need > len(self.rows):
+            cap = max(need, len(self.rows) + self.grow)
+            pad = cap - len(self.rows)
+            self.rows = np.concatenate(
+                [self.rows, np.zeros((pad, self.dim), np.float32)])
+            self.slot = np.concatenate(
+                [self.slot, np.zeros((pad, self.dim), np.float32)])
+        for i in new:
+            r = len(self.index)
+            self.index[i] = r
+            # deterministic per-id init: the same id always materialises
+            # the same row, on any shard layout
+            rng = np.random.RandomState((self.seed ^ (i * 2654435761))
+                                        & 0x7FFFFFFF)
+            self.rows[r] = self.initializer(rng, self.dim)
+
+    def pull(self, ids):
+        with self.lock:
+            self._ensure(ids)
+            rix = np.fromiter((self.index[i] for i in ids), np.int64,
+                              len(ids))
+            return self.rows[rix].copy()
+
+    def push(self, ids, grads, lr):
+        with self.lock:
+            self._ensure(ids)
+            rix = np.fromiter((self.index[i] for i in ids), np.int64,
+                              len(ids))
+            self.optimizer(self.rows, self.slot, rix, grads, lr)
+
+    def state(self):
+        with self.lock:
+            n = len(self.index)
+            ids = np.fromiter(self.index.keys(), np.int64, n)
+            rix = np.fromiter(self.index.values(), np.int64, n)
+            return ids, self.rows[rix].copy(), self.slot[rix].copy()
+
+    def load(self, ids, rows, slot):
+        with self.lock:
+            self.index = {int(i): r for r, i in enumerate(ids)}
+            self.rows = np.asarray(rows, np.float32).copy()
+            self.slot = np.asarray(slot, np.float32).copy()
+
+
+def sparse_sgd(rows, slot, rix, grads, lr):
+    """Sparse SGD row update (pserver sgd optimize block parity)."""
+    np.subtract.at(rows, rix, lr * grads)
+
+
+def sparse_adagrad(rows, slot, rix, grads, lr, eps=1e-6):
+    """Sparse Adagrad (operators/optimizers/adagrad_op.cc SelectedRows
+    kernel parity): accumulate g² per row, scale update."""
+    np.add.at(slot, rix, grads * grads)
+    denom = np.sqrt(slot[rix]) + eps
+    np.subtract.at(rows, rix, lr * grads / denom)
+
+
+_OPTIMIZERS = {"sgd": sparse_sgd, "adagrad": sparse_adagrad}
+
+
+class SparseEmbeddingTable:
+    """Sharded, auto-growing, host-RAM embedding table with async push.
+
+    - ``pull(ids)`` gathers dense rows (parameter_prefetch.cc parity),
+      initializing unseen ids deterministically.
+    - ``push(ids, grads)`` merges duplicate ids (SelectedRows merge-add,
+      merge_selected_rows_op.cc) then applies the sparse optimizer.
+    - ``push_async`` enqueues the push to a background thread per table —
+      the caller (TPU step loop) never blocks; ``flush()`` barriers, and
+      training-loop reads are safe because pull takes the shard lock.
+    - ``save(dir)/load(dir)`` checkpoint shard-by-shard
+      (listen_and_serv checkpoint block parity).
+    """
+
+    def __init__(self, dim, num_shards=1, initializer=None, seed=0,
+                 optimizer="sgd", learning_rate=0.01):
+        if initializer is None:
+            scale = 1.0 / np.sqrt(dim)
+            initializer = lambda rng, d: rng.uniform(
+                -scale, scale, d).astype(np.float32)
+        self.dim = dim
+        self.num_shards = num_shards
+        self.learning_rate = learning_rate
+        opt = _OPTIMIZERS[optimizer] if isinstance(optimizer, str) \
+            else optimizer
+        self._opt_name = optimizer if isinstance(optimizer, str) else "custom"
+        # every shard derives row init from the SAME base seed: a given id
+        # materialises identically under any shard count (shard-layout
+        # invariance — resharding a checkpointed table is a pure repartition)
+        self.shards = [_Shard(dim, initializer, seed, opt)
+                       for s in range(num_shards)]
+        self._q = queue.Queue()
+        self._worker = None
+        self._err = None
+
+    # -- pull ---------------------------------------------------------------
+    def pull(self, ids):
+        """ids: int array of any shape → rows [*ids.shape, dim]."""
+        ids = np.asarray(ids, np.int64)
+        flat = ids.reshape(-1)
+        out = np.empty((flat.size, self.dim), np.float32)
+        sh = _hash_ids(flat, self.num_shards)
+        for s in range(self.num_shards):
+            m = sh == s
+            if m.any():
+                out[m] = self.shards[s].pull(flat[m].tolist())
+        return out.reshape(ids.shape + (self.dim,))
+
+    # -- push ---------------------------------------------------------------
+    def _merge(self, flat_ids, flat_grads):
+        uniq, inv = np.unique(flat_ids, return_inverse=True)
+        merged = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(merged, inv, flat_grads)
+        return uniq, merged
+
+    def push(self, ids, grads, learning_rate=None):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(-1, self.dim)
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        uniq, merged = self._merge(ids, grads)
+        sh = _hash_ids(uniq, self.num_shards)
+        for s in range(self.num_shards):
+            m = sh == s
+            if m.any():
+                self.shards[s].push(uniq[m].tolist(), merged[m], lr)
+
+    def _worker_loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            try:
+                self.push(*item)
+            except Exception as e:  # surfaced on flush()
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def push_async(self, ids, grads, learning_rate=None):
+        """Enqueue a push; returns immediately (Communicator send-thread
+        parity, operators/distributed/communicator.h:160)."""
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._worker_loop,
+                                            daemon=True)
+            self._worker.start()
+        self._q.put((np.asarray(ids, np.int64).copy(),
+                     np.asarray(grads, np.float32).copy(), learning_rate))
+
+    def flush(self):
+        """Barrier: wait until queued pushes applied (send_barrier parity)."""
+        self._q.join()
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    # -- checkpoint ---------------------------------------------------------
+    def save(self, dirname, name="sparse_table"):
+        os.makedirs(dirname, exist_ok=True)
+        self.flush()
+        for s, shard in enumerate(self.shards):
+            ids, rows, slot = shard.state()
+            np.savez(os.path.join(dirname, f"{name}.shard{s}.npz"),
+                     ids=ids, rows=rows, slot=slot)
+
+    def load(self, dirname, name="sparse_table"):
+        for s, shard in enumerate(self.shards):
+            z = np.load(os.path.join(dirname, f"{name}.shard{s}.npz"))
+            shard.load(z["ids"], z["rows"], z["slot"])
+
+    @property
+    def size(self):
+        return sum(len(s.index) for s in self.shards)

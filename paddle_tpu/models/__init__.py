@@ -8,6 +8,6 @@ python/paddle/fluid/tests/book/). BERT/transformer is the flagship
 ERNIE/transformer tests (dist_transformer.py) set the shape.
 """
 
-from paddle_tpu.models import bert, resnet, transformer, vgg
+from paddle_tpu.models import bert, deepfm, resnet, transformer, vgg
 
-__all__ = ["bert", "resnet", "transformer", "vgg"]
+__all__ = ["bert", "deepfm", "resnet", "transformer", "vgg"]

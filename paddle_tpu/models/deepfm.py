@@ -1,0 +1,162 @@
+"""DeepFM CTR model over the host-resident sparse embedding service.
+
+Parity target: the reference's CTR configuration — DeepFM in
+benchmark-style form (the DistributeTranspiler + distributed-lookup-table
+setup SURVEY §2.5 catalogues: sparse slots pulled from pservers,
+dense net trained data-parallel; dist_ctr.py / ctr_reader test family).
+
+TPU-first shape: the jitted train step is a pure function of
+(dense params, pulled embedding slices, dense features, labels) and
+returns gradients for BOTH — dense grads feed the on-device optimizer,
+embedding-slice grads exit the step and are pushed asynchronously to
+`SparseEmbeddingTable` (never stalling the chip). FM math:
+logit = w0 + Σ first_order(slot) + ½[(Σe)² − Σe²]·1 + DNN(concat e, dense).
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.distributed.sparse_embedding import SparseEmbeddingTable
+
+__all__ = ["DeepFMConfig", "init_dense_params", "forward", "loss_fn",
+           "CTRTrainer", "synthetic_ctr_batch"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepFMConfig:
+    num_slots: int = 26          # criteo-style categorical slots
+    embed_dim: int = 8
+    dense_dim: int = 13          # continuous features
+    dnn_sizes: tuple = (64, 32)
+    vocab_per_slot: int = 100000  # id space (hashed); table auto-grows
+    num_shards: int = 1
+    sparse_lr: float = 0.05
+    sparse_optimizer: str = "adagrad"
+
+
+def init_dense_params(rng, cfg):
+    sizes = ((cfg.num_slots * cfg.embed_dim + cfg.dense_dim,)
+             + tuple(cfg.dnn_sizes) + (1,))
+    params = {"w0": jnp.zeros(())}
+    keys = jax.random.split(rng, len(sizes))
+    for i in range(len(sizes) - 1):
+        fan_in = sizes[i]
+        params[f"dnn_w{i}"] = jax.random.normal(
+            keys[i], (sizes[i], sizes[i + 1])) / np.sqrt(fan_in)
+        params[f"dnn_b{i}"] = jnp.zeros((sizes[i + 1],))
+    return params
+
+
+def forward(params, cfg, emb, first, dense):
+    """emb [B, slots, D] second-order embeddings; first [B, slots] pulled
+    first-order weights; dense [B, dense_dim]."""
+    b = emb.shape[0]
+    fo = jnp.sum(first, axis=1)                          # [B]
+    s1 = jnp.sum(emb, axis=1)                            # [B, D]
+    so = 0.5 * jnp.sum(s1 * s1 - jnp.sum(emb * emb, axis=1), axis=-1)
+    x = jnp.concatenate([emb.reshape(b, -1), dense], axis=-1)
+    n_layers = len(cfg.dnn_sizes) + 1
+    for i in range(n_layers):
+        x = x @ params[f"dnn_w{i}"] + params[f"dnn_b{i}"]
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return params["w0"] + fo + so + x[:, 0]              # logits [B]
+
+
+def loss_fn(params, cfg, emb, first, dense, labels):
+    from paddle_tpu.ops.loss import sigmoid_cross_entropy_with_logits
+    logits = forward(params, cfg, emb, first, dense)
+    loss = sigmoid_cross_entropy_with_logits(
+        logits, labels.astype(jnp.float32))
+    return jnp.mean(loss), logits
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _train_step(cfg, params, emb, first, dense, labels, lr):
+    """One jitted step: loss + grads for dense params AND the pulled
+    embedding slices (the slice grads leave the device for the async
+    sparse push)."""
+    def wrapped(params, emb, first):
+        l, logits = loss_fn(params, cfg, emb, first, dense, labels)
+        return l, logits
+
+    (loss, logits), grads = jax.value_and_grad(
+        wrapped, argnums=(0, 1, 2), has_aux=True)(params, emb, first)
+    gp, gemb, gfirst = grads
+    params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, gp)
+    return loss, logits, params, gemb, gfirst
+
+
+class CTRTrainer:
+    """Train loop glue: pull → jit step → async push.
+
+    The sparse push of step N's gradients runs on a background thread and
+    overlaps step N+1's pull + compute; the pull itself is synchronous
+    (each step reads the freshest rows, the sync-PS semantics). A
+    fully-async double-buffered pull (steps-behind embeddings, the
+    reference's async Communicator mode) is a policy choice layered on
+    top by pulling the next batch before finalizing the current one.
+    """
+
+    def __init__(self, cfg, seed=0, sync_push=False):
+        self.cfg = cfg
+        self.sync_push = sync_push
+        self.table = SparseEmbeddingTable(
+            cfg.embed_dim, num_shards=cfg.num_shards, seed=seed,
+            optimizer=cfg.sparse_optimizer, learning_rate=cfg.sparse_lr)
+        # first-order weights: their own 1-dim sharded table
+        self.table_w1 = SparseEmbeddingTable(
+            1, num_shards=cfg.num_shards, seed=seed + 1,
+            optimizer=cfg.sparse_optimizer, learning_rate=cfg.sparse_lr)
+        self.params = init_dense_params(jax.random.PRNGKey(seed), cfg)
+
+    def train_step(self, ids, dense, labels, lr=0.01):
+        """ids [B, slots] int64; dense [B, dense_dim]; labels [B]."""
+        ids = np.asarray(ids)
+        emb = self.table.pull(ids)                      # [B, slots, D]
+        first = self.table_w1.pull(ids)[..., 0]         # [B, slots]
+        loss, logits, self.params, gemb, gfirst = _train_step(
+            self.cfg, self.params, jnp.asarray(emb), jnp.asarray(first),
+            jnp.asarray(dense, jnp.float32),
+            jnp.asarray(labels), jnp.float32(lr))
+        gemb = np.asarray(gemb)
+        gfirst = np.asarray(gfirst)[..., None]
+        if self.sync_push:
+            self.table.push(ids, gemb)
+            self.table_w1.push(ids, gfirst)
+        else:
+            self.table.push_async(ids, gemb)
+            self.table_w1.push_async(ids, gfirst)
+        return float(loss), np.asarray(logits)
+
+    def finalize(self):
+        self.table.flush()
+        self.table_w1.flush()
+
+    def save(self, dirname):
+        self.table.save(dirname, "deepfm_emb")
+        self.table_w1.save(dirname, "deepfm_w1")
+
+    def load(self, dirname):
+        self.table.load(dirname, "deepfm_emb")
+        self.table_w1.load(dirname, "deepfm_w1")
+
+
+def synthetic_ctr_batch(cfg, batch_size, seed=0):
+    """Learnable synthetic CTR data: the label depends on a fixed random
+    score per id, so the model can overfit it."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_per_slot,
+                      (batch_size, cfg.num_slots)).astype(np.int64)
+    # slot offset so ids are disjoint across slots (reference uses one
+    # table per slot; we use one table with offset ids)
+    ids = ids + np.arange(cfg.num_slots)[None, :] * cfg.vocab_per_slot
+    dense = rng.rand(batch_size, cfg.dense_dim).astype(np.float32)
+    w = ((ids * 2654435761) % 97 / 97.0 - 0.5).sum(1)
+    score = w + dense.sum(1) * 0.3 - 0.15 * cfg.dense_dim
+    labels = (score > np.median(score)).astype(np.int64)
+    return ids, dense, labels

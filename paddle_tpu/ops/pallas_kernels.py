@@ -99,7 +99,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    if causal:
+        # stop at the diagonal: K blocks entirely above it are fully
+        # masked — skipping them halves causal attention FLOPs
+        nk_eff = jnp.minimum(
+            nk, ((iq + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    m, l, acc = lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)

@@ -1,0 +1,147 @@
+"""Sparse embedding service + DeepFM CTR tests.
+
+Patterns from the reference: distributed-vs-local loss equality
+(unittests/test_dist_base.py TestDistBase), sparse optimizer updates
+(test_adagrad_op SelectedRows branch), lookup-table auto-growth
+(test_lookup_sparse_table_op.py).
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.sparse_embedding import SparseEmbeddingTable
+from paddle_tpu.models import deepfm
+
+
+class TestTable:
+    def test_pull_deterministic_and_autogrow(self):
+        t = SparseEmbeddingTable(8, num_shards=2, seed=42)
+        ids = np.array([5, 100, 5, 77])
+        a = t.pull(ids)
+        b = t.pull(ids)
+        np.testing.assert_array_equal(a, b)
+        assert a.shape == (4, 8)
+        np.testing.assert_array_equal(a[0], a[2])  # same id, same row
+        assert t.size == 3
+
+    def test_push_sgd_merges_duplicates(self):
+        t = SparseEmbeddingTable(4, optimizer="sgd", learning_rate=0.5)
+        ids = np.array([1, 1, 2])
+        before = t.pull(np.array([1, 2]))
+        g = np.ones((3, 4), np.float32)
+        t.push(ids, g)
+        after = t.pull(np.array([1, 2]))
+        # id 1 receives the SUM of both duplicate grads (SelectedRows
+        # merge-add semantics)
+        np.testing.assert_allclose(after[0], before[0] - 0.5 * 2.0)
+        np.testing.assert_allclose(after[1], before[1] - 0.5 * 1.0)
+
+    def test_adagrad_update(self):
+        t = SparseEmbeddingTable(2, optimizer="adagrad", learning_rate=1.0)
+        ids = np.array([9])
+        w0 = t.pull(ids)[0].copy()
+        g = np.full((1, 2), 2.0, np.float32)
+        t.push(ids, g)
+        w1 = t.pull(ids)[0]
+        np.testing.assert_allclose(w1, w0 - 2.0 / (2.0 + 1e-6), rtol=1e-5)
+        t.push(ids, g)
+        w2 = t.pull(ids)[0]
+        denom = np.sqrt(8.0) + 1e-6
+        np.testing.assert_allclose(w2, w1 - 2.0 / denom, rtol=1e-5)
+
+    def test_shard_count_invariance(self):
+        """1-shard and 4-shard tables behave identically (the TestDistBase
+        'dist loss == local loss' property for the PS path)."""
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 1000, (50,))
+        grads = rng.randn(50, 8).astype(np.float32)
+        t1 = SparseEmbeddingTable(8, num_shards=1, seed=7)
+        t4 = SparseEmbeddingTable(8, num_shards=4, seed=7)
+        np.testing.assert_array_equal(t1.pull(ids), t4.pull(ids))
+        t1.push(ids, grads)
+        t4.push(ids, grads)
+        np.testing.assert_allclose(t1.pull(ids), t4.pull(ids), atol=1e-6)
+
+    def test_async_push_flush(self):
+        t = SparseEmbeddingTable(4, optimizer="sgd", learning_rate=0.1)
+        ids = np.arange(20)
+        w0 = t.pull(ids).copy()
+        for _ in range(5):
+            t.push_async(ids, np.ones((20, 4), np.float32))
+        t.flush()
+        np.testing.assert_allclose(t.pull(ids), w0 - 0.5, atol=1e-6)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        t = SparseEmbeddingTable(4, num_shards=2, optimizer="adagrad",
+                                 seed=3)
+        ids = np.array([10, 20, 30])
+        t.push(ids, np.random.RandomState(1).randn(3, 4).astype(np.float32))
+        w = t.pull(ids).copy()
+        t.save(str(tmp_path))
+        t2 = SparseEmbeddingTable(4, num_shards=2, optimizer="adagrad",
+                                  seed=99)  # different seed: state must load
+        t2.load(str(tmp_path))
+        np.testing.assert_array_equal(t2.pull(ids), w)
+        # optimizer slots restored too: next identical push matches
+        g = np.ones((3, 4), np.float32)
+        t.push(ids, g)
+        t2.push(ids, g)
+        np.testing.assert_allclose(t.pull(ids), t2.pull(ids), atol=1e-6)
+
+
+class TestDeepFM:
+    def _overfit(self, cfg, steps=60, sync_push=True):
+        tr = deepfm.CTRTrainer(cfg, seed=0, sync_push=sync_push)
+        ids, dense, labels = deepfm.synthetic_ctr_batch(cfg, 64, seed=5)
+        losses = []
+        for _ in range(steps):
+            l, logits = tr.train_step(ids, dense, labels, lr=0.05)
+            losses.append(l)
+        tr.finalize()
+        acc = float(((logits > 0) == (labels > 0)).mean())
+        return losses, acc, tr
+
+    def test_converges(self):
+        cfg = deepfm.DeepFMConfig(num_slots=6, embed_dim=4, dense_dim=4,
+                                  dnn_sizes=(16,), vocab_per_slot=1000)
+        losses, acc, _ = self._overfit(cfg)
+        assert losses[-1] < losses[0] * 0.7
+        assert acc > 0.8
+
+    def test_sharded_equals_single(self):
+        cfg1 = deepfm.DeepFMConfig(num_slots=4, embed_dim=4, dense_dim=3,
+                                   dnn_sizes=(8,), vocab_per_slot=500,
+                                   num_shards=1)
+        cfg4 = deepfm.DeepFMConfig(num_slots=4, embed_dim=4, dense_dim=3,
+                                   dnn_sizes=(8,), vocab_per_slot=500,
+                                   num_shards=4)
+        l1, _, _ = self._overfit(cfg1, steps=10)
+        l4, _, _ = self._overfit(cfg4, steps=10)
+        np.testing.assert_allclose(l1, l4, rtol=1e-4)
+
+    def test_async_matches_sync_when_flushed(self):
+        cfg = deepfm.DeepFMConfig(num_slots=4, embed_dim=4, dense_dim=3,
+                                  dnn_sizes=(8,), vocab_per_slot=500)
+        tr_s = deepfm.CTRTrainer(cfg, seed=0, sync_push=True)
+        tr_a = deepfm.CTRTrainer(cfg, seed=0, sync_push=False)
+        ids, dense, labels = deepfm.synthetic_ctr_batch(cfg, 32, seed=6)
+        for _ in range(5):
+            ls, _ = tr_s.train_step(ids, dense, labels)
+            tr_a.table.flush()       # force syncness for exact equality
+            tr_a.table_w1.flush()
+            la, _ = tr_a.train_step(ids, dense, labels)
+            assert ls == pytest.approx(la, rel=1e-5)
+        tr_a.finalize()
+
+    def test_checkpoint_resume(self, tmp_path):
+        cfg = deepfm.DeepFMConfig(num_slots=4, embed_dim=4, dense_dim=3,
+                                  dnn_sizes=(8,), vocab_per_slot=500)
+        _, _, tr = self._overfit(cfg, steps=5)
+        ids, dense, labels = deepfm.synthetic_ctr_batch(cfg, 32, seed=5)
+        tr.save(str(tmp_path))
+        tr2 = deepfm.CTRTrainer(cfg, seed=123, sync_push=True)
+        tr2.load(str(tmp_path))
+        tr2.params = tr.params
+        l1, _ = tr.train_step(ids, dense, labels, lr=0.0)
+        l2, _ = tr2.train_step(ids, dense, labels, lr=0.0)
+        assert l1 == pytest.approx(l2, rel=1e-5)
