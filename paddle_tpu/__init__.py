@@ -53,5 +53,8 @@ from paddle_tpu.framework import (
     ParamAttr, Variable, to_variable, no_grad, grad,
 )
 from paddle_tpu import backward
+from paddle_tpu import distributions
+from paddle_tpu import contrib
+from paddle_tpu import inference
 
 __version__ = "0.1.0"

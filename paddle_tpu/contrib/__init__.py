@@ -1,0 +1,6 @@
+"""Contrib toolkits (parity: python/paddle/fluid/contrib — AMP lives in
+paddle_tpu.amp; quantization/slim here)."""
+
+from paddle_tpu.contrib import quant
+
+__all__ = ["quant"]

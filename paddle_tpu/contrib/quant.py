@@ -1,0 +1,115 @@
+"""Quantization toolkit: QAT program rewriting + post-training quant.
+
+Parity: python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass inserts fake_quant/dequant around quantizable
+ops in the IR graph) and contrib/quantize/quantize_transpiler.py.
+
+TPU shape: the static `QuantizeTranspiler` rewrites the Program in place
+(our Program IS the IR here — no separate Graph form); eager/functional
+training uses `fake_quant_params` inside the loss. Gradients flow through
+the inserted ops via the STE custom_vjp in ops/quantize.py, so no grad
+registration step is needed (the reference patches grads in the pass).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops import quantize as Q
+from paddle_tpu.static.program import Operator
+
+__all__ = ["QuantizeTranspiler", "fake_quant_params",
+           "post_training_quantize", "dequantize_params"]
+
+_QUANTIZABLE = ("mul", "matmul", "conv2d", "depthwise_conv2d")
+
+
+class QuantizeTranspiler:
+    """Insert fake quant-dequant ops before every quantizable op's tensor
+    inputs in a static Program (QuantizationTransformPass parity —
+    weight_quantize_type/activation_quantize_type 'abs_max')."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantizable_op_type=_QUANTIZABLE):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.op_types = tuple(quantizable_op_type)
+
+    def transpile(self, program):
+        blk = program.global_block()
+        new_ops = []
+        quantized = {}       # var name -> quant-dequant output name
+        for op in blk.ops:
+            if op.type in self.op_types:
+                for slot, names in op.inputs.items():
+                    rewritten = []
+                    for name in names:
+                        if name not in quantized:
+                            var = blk.vars.get(name)
+                            is_w = var is not None and getattr(
+                                var, "persistable", False)
+                            bits = (self.weight_bits if is_w
+                                    else self.activation_bits)
+                            qname = f"{name}.quant_dequant"
+                            blk.create_var(
+                                name=qname,
+                                shape=var.shape if var is not None else None,
+                                dtype=var.dtype if var is not None
+                                else "float32")
+                            sname = f"{name}.quant_scale"
+                            blk.create_var(name=sname, shape=[],
+                                           dtype="float32")
+                            qop = Operator(
+                                blk, "fake_quantize_dequantize_abs_max",
+                                inputs={"X": [name]},
+                                outputs={"Out": [qname, sname]},
+                                attrs={"bit_length": bits})
+                            new_ops.append(qop)
+                            quantized[name] = qname
+                        rewritten.append(quantized[name])
+                    op.inputs[slot] = rewritten
+            new_ops.append(op)
+        blk.ops = new_ops
+        program._bump()
+        return program
+
+
+def fake_quant_params(params, bit_length=8, channel_wise=False):
+    """Eager QAT: quant-dequant every weight leaf (STE gradients flow).
+    Call inside the loss: loss_fn(fake_quant_params(params), ...)."""
+    def qd(p):
+        if p.ndim == 0:
+            return p
+        if channel_wise and p.ndim >= 2:
+            out, _ = Q.fake_channel_wise_quantize_dequantize_abs_max(
+                p, bit_length=bit_length)
+        else:
+            out, _ = Q.fake_quantize_dequantize_abs_max(
+                p, bit_length=bit_length)
+        return out
+    return jax.tree_util.tree_map(qd, params)
+
+
+def post_training_quantize(params, bit_length=8):
+    """PTQ: pytree of float weights → {path: (int8 values, fp32 scale)}
+    (contrib/slim post-training strategy parity, weight-only abs-max)."""
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    bins = (1 << (bit_length - 1)) - 1
+    dtype = (np.int8 if bit_length <= 8
+             else np.int16 if bit_length <= 16 else np.int32)
+    quantized = []
+    for p in flat:
+        p = np.asarray(p, np.float32)
+        scale = float(np.max(np.abs(p))) if p.size else 0.0
+        s = max(scale, 1e-12)
+        q = np.clip(np.round(p / s * bins), -bins - 1, bins).astype(dtype)
+        quantized.append((q, scale))
+    return quantized, treedef
+
+
+def dequantize_params(quantized, treedef, bit_length=8):
+    """Inverse of post_training_quantize."""
+    bins = (1 << (bit_length - 1)) - 1
+    flat = [np.asarray(q, np.float32) * max(s, 1e-12) / bins
+            for q, s in quantized]
+    return jax.tree_util.tree_unflatten(treedef, flat)

@@ -1,0 +1,154 @@
+"""Probability distributions.
+
+Parity: python/paddle/fluid/layers/distributions.py (Distribution base,
+Uniform :113, Normal :246 — sample / log_prob / entropy / kl_divergence).
+Categorical and MultivariateNormalDiag extend the family (they joined
+fluid after the reference revision).
+
+TPU-native: pure jnp math; sampling takes an explicit PRNG key (the
+reference threads a graph-level seed; explicit keys are the functional
+equivalent) — `seed=` is accepted for API parity and folded into a key.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag"]
+
+
+def _key(seed, rng):
+    if rng is not None:
+        return rng
+    return jax.random.PRNGKey(seed)
+
+
+class Distribution:
+    def sample(self, shape, seed=0, rng=None):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high); broadcasting like the reference (distributions.py:113)."""
+
+    def __init__(self, low, high):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+
+    def sample(self, shape, seed=0, rng=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                    self.high.shape)
+        u = jax.random.uniform(_key(seed, rng), shape)
+        return self.low + u * (self.high - self.low)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        lb = (value >= self.low).astype(jnp.float32)
+        ub = (value < self.high).astype(jnp.float32)
+        return jnp.log(lb * ub) - jnp.log(self.high - self.low)
+
+    def entropy(self):
+        return jnp.log(self.high - self.low)
+
+
+class Normal(Distribution):
+    """N(loc, scale) (distributions.py:246)."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def sample(self, shape, seed=0, rng=None):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
+        return self.loc + self.scale * jax.random.normal(_key(seed, rng),
+                                                         shape)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        var = self.scale * self.scale
+        return (-((value - self.loc) ** 2) / (2.0 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2.0 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2.0 * math.pi) + jnp.log(self.scale)
+
+    def kl_divergence(self, other):
+        # matches the reference formula (distributions.py:383)
+        assert isinstance(other, Normal)
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of `logits`."""
+
+    def __init__(self, logits):
+        self.logits = jnp.asarray(logits, jnp.float32)
+        self._logp = jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape, seed=0, rng=None):
+        shape = tuple(shape) + self.logits.shape[:-1]
+        return jax.random.categorical(_key(seed, rng), self.logits,
+                                      shape=shape)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(self._logp, value[..., None],
+                                   axis=-1)[..., 0]
+
+    def entropy(self):
+        p = jnp.exp(self._logp)
+        return -jnp.sum(p * self._logp, axis=-1)
+
+    def kl_divergence(self, other):
+        assert isinstance(other, Categorical)
+        p = jnp.exp(self._logp)
+        return jnp.sum(p * (self._logp - other._logp), axis=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale²)) — diagonal-covariance multivariate normal."""
+
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    @property
+    def _dim(self):
+        return self.loc.shape[-1]
+
+    def sample(self, shape, seed=0, rng=None):
+        shape = tuple(shape) + self.loc.shape
+        return self.loc + self.scale * jax.random.normal(_key(seed, rng),
+                                                         shape)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        z = (value - self.loc) / self.scale
+        return (-0.5 * jnp.sum(z * z, axis=-1)
+                - jnp.sum(jnp.log(self.scale), axis=-1)
+                - 0.5 * self._dim * math.log(2.0 * math.pi))
+
+    def entropy(self):
+        return (0.5 * self._dim * (1.0 + math.log(2.0 * math.pi))
+                + jnp.sum(jnp.log(self.scale), axis=-1))
+
+    def kl_divergence(self, other):
+        assert isinstance(other, MultivariateNormalDiag)
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return 0.5 * jnp.sum(var_ratio + t1 - 1.0 - jnp.log(var_ratio),
+                             axis=-1)

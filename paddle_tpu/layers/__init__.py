@@ -65,6 +65,13 @@ _NARGS = {
     "truncated_gaussian_random": 0, "randint": 0,
     "prelu": 2, "conv2d": 2, "conv2d_transpose": 2, "conv3d": 2,
     "depthwise_conv2d": 2, "embedding": 2,
+    # quantization family
+    "fake_quantize_range_abs_max": 3,
+    "fake_quantize_moving_average_abs_max": 3,
+    "fake_quantize_dequantize_moving_average_abs_max": 3,
+    "moving_average_abs_max_scale": 3,
+    "fake_dequantize_max_abs": 2, "quantize_linear": 2,
+    "dequantize_linear": 2, "fake_channel_wise_dequantize_max_abs": 1,
     # crf / ctc families (optional trailing tensors promote dynamically)
     "linear_chain_crf": 3, "crf_decoding": 2, "ctc_loss": 2,
     "warpctc": 2, "edit_distance": 2,
@@ -88,10 +95,33 @@ _NEEDS_RNG = {"dropout", "gaussian_random", "uniform_random",
               "gaussian_random_batch_size_like"}
 
 _MULTI_OUT = {"topk": 2, "argsort": 2, "ctc_align": 2, "edit_distance": 2,
+              "fake_quantize_abs_max": 2,
+              "fake_quantize_dequantize_abs_max": 2,
+              "fake_channel_wise_quantize_abs_max": 2,
+              "fake_channel_wise_quantize_dequantize_abs_max": 2,
+              "fake_quantize_range_abs_max": 2,
+              "moving_average_abs_max_scale": 3,
+              "fake_quantize_moving_average_abs_max": 4,
+              "fake_quantize_dequantize_moving_average_abs_max": 4,
               "prior_box": 2,
               "density_prior_box": 2, "anchor_generator": 2,
               "bipartite_match": 2, "yolo_box": 2, "target_assign": 2,
               "generate_proposals": 3}
+
+
+def _bind_tensor_params(tparams, xs):
+    """Rebuild {param: tensor-or-list} from the flattened input list."""
+    out = {}
+    i = 0
+    for entry in tparams:
+        if isinstance(entry, tuple):
+            pname, cnt = entry
+            out[pname] = list(xs[i:i + cnt])
+            i += cnt
+        else:
+            out[entry] = xs[i]
+            i += 1
+    return out
 
 
 def _register(name, fn):
@@ -107,8 +137,9 @@ def _register(name, fn):
             out = fn(list(xs), **attrs)
         elif tparams is not None:
             # inputs bound by parameter name (op had optional tensor args
-            # promoted from attr positions — e.g. ssd_loss's prior_box_var)
-            out = fn(**{**attrs, **dict(zip(tparams, xs))})
+            # promoted from attr positions — e.g. ssd_loss's prior_box_var);
+            # (name, count) entries regroup list-valued tensor params
+            out = fn(**{**attrs, **_bind_tensor_params(tparams, xs)})
         else:
             out = fn(*xs, **attrs)
         return {"Out": list(out) if isinstance(out, tuple) else [out]}
@@ -145,8 +176,16 @@ def _append_static(name, fn, tensor_vals, attrs, listy,
     flat = list(tensor_vals[0] if listy else tensor_vals)
     all_params = list(tensor_params) if tensor_params is not None else []
     if promoted:
-        flat = flat + list(promoted.values())
-        all_params = all_params + list(promoted)
+        for pname, pval in promoted.items():
+            if isinstance(pval, (list, tuple)):
+                # a LIST of tensors in an attr position (e.g.
+                # fake_channel_wise_dequantize_max_abs's scales):
+                # flatten into inputs, record (name, count) to regroup
+                flat.extend(pval)
+                all_params.append((pname, len(pval)))
+            else:
+                flat.append(pval)
+                all_params.append(pname)
         attrs = {k: v for k, v in attrs.items() if k not in promoted}
     for tv in flat:
         if isinstance(tv, Variable):
@@ -177,7 +216,8 @@ def _append_static(name, fn, tensor_vals, attrs, listy,
         if promoted:
             return jax.eval_shape(
                 lambda *xs: fn(**{**eval_attrs,
-                                  **dict(zip(all_params, xs))}), *specs)
+                                  **_bind_tensor_params(all_params, xs)}),
+                *specs)
         return jax.eval_shape(lambda *xs: fn(*xs, **eval_attrs), *specs)
 
     # dynamic dims are probed with two substitute sizes (2 and 3): any
@@ -265,7 +305,9 @@ def _dual(name, fn):
                  and vals[p] is not inspect.Parameter.empty}
         if in_static_mode():
             promoted = {p: v for p, v in attrs.items()
-                        if isinstance(v, Variable)}
+                        if isinstance(v, Variable)
+                        or (isinstance(v, (list, tuple))
+                            and any(isinstance(x, Variable) for x in v))}
             if promoted or _has_variable(
                     tensor_vals[0] if listy else tensor_vals):
                 return _append_static(name, fn, tensor_vals, attrs, listy,

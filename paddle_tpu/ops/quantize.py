@@ -1,0 +1,202 @@
+"""Quantization op family.
+
+Parity: operators/fake_quantize_op.cc (fake_quantize_abs_max,
+fake_quantize_range_abs_max, fake_quantize_moving_average_abs_max,
+fake_quantize_dequantize_moving_average_abs_max,
+fake_channel_wise_quantize_abs_max, moving_average_abs_max_scale),
+operators/fake_dequantize_op.cc (fake_dequantize_max_abs,
+fake_channel_wise_dequantize_max_abs), operators/quantize_op.cc /
+dequantize_op.cc (int8 cast for inference backends).
+
+TPU-native notes: the fake-quant ops carry a straight-through-estimator
+gradient (custom_vjp on the rounding), so quantization-aware training
+works under jax.grad out of the box — the reference relies on the
+identity-grad registration in quantization_pass.py. Stateful running-scale
+variants are functional: state in, state out.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "fake_quantize_abs_max", "fake_quantize_dequantize_abs_max",
+    "fake_channel_wise_quantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "fake_quantize_range_abs_max",
+    "fake_quantize_moving_average_abs_max",
+    "fake_quantize_dequantize_moving_average_abs_max",
+    "moving_average_abs_max_scale",
+    "fake_dequantize_max_abs", "fake_channel_wise_dequantize_max_abs",
+    "quantize_linear", "dequantize_linear",
+]
+
+
+def _bin_cnt(bit_length):
+    return (1 << (bit_length - 1)) - 1
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_round_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_round_bwd(_, g):
+    return (g,)  # straight-through: d round(x)/dx := 1
+
+
+_ste_round.defvjp(_ste_round_fwd, _ste_round_bwd)
+
+
+def fake_quantize_abs_max(x, bit_length=8):
+    """scale = max|x|; out = round(x / scale * bin_cnt) (a float tensor of
+    integers, like the reference). Returns (out, scale)."""
+    x = jnp.asarray(x)
+    bins = _bin_cnt(bit_length)
+    scale = jnp.max(jnp.abs(x))
+    s = jnp.maximum(scale, 1e-12)
+    out = _ste_round(x / s * bins)
+    return out, scale
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8):
+    """Quantize-dequantize roundtrip with STE — the QAT training op.
+    Returns (out, scale)."""
+    x = jnp.asarray(x)
+    bins = _bin_cnt(bit_length)
+    scale = jnp.max(jnp.abs(x))
+    s = jnp.maximum(scale, 1e-12)
+    out = _ste_round(x / s * bins) * s / bins
+    return out, scale
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
+    """Per-channel abs-max quantization (conv weights). Returns
+    (out, scales[channels])."""
+    x = jnp.asarray(x)
+    bins = _bin_cnt(bit_length)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    s = jnp.maximum(scale, 1e-12).reshape(shape)
+    out = _ste_round(x / s * bins)
+    return out, scale
+
+
+def fake_quantize_range_abs_max(x, in_scale, iteration, window_size=10000,
+                                bit_length=8, is_test=False):
+    """Windowed running-max scale. Returns (out, out_scale).
+    The reference keeps a scale window buffer; functionally the window
+    reduces to "reset the max at window boundaries"."""
+    x = jnp.asarray(x)
+    bins = _bin_cnt(bit_length)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale
+    else:
+        at_boundary = (iteration % window_size) == 0
+        scale = jnp.where(at_boundary, cur, jnp.maximum(in_scale, cur))
+    s = jnp.maximum(scale, 1e-12)
+    out = _ste_round(jnp.clip(x, -s, s) / s * bins)
+    return out, scale
+
+
+def moving_average_abs_max_scale(x, accum, state, moving_rate=0.9):
+    """EMA abs-max scale tracker (scale-only op). Returns
+    (scale, accum', state')."""
+    cur = jnp.max(jnp.abs(jnp.asarray(x)))
+    accum = accum * moving_rate + cur * (1.0 - moving_rate)
+    state = state * moving_rate + (1.0 - moving_rate)
+    return accum / jnp.maximum(state, 1e-12), accum, state
+
+
+def fake_quantize_moving_average_abs_max(x, accum, state, moving_rate=0.9,
+                                         bit_length=8, is_test=False):
+    """EMA-scaled quantization. Returns (out, scale, accum', state')."""
+    x = jnp.asarray(x)
+    bins = _bin_cnt(bit_length)
+    if is_test:
+        scale = accum / jnp.maximum(state, 1e-12)
+    else:
+        scale, accum, state = moving_average_abs_max_scale(
+            x, accum, state, moving_rate)
+    s = jnp.maximum(scale, 1e-12)
+    out = _ste_round(jnp.clip(x, -s, s) / s * bins)
+    return out, scale, accum, state
+
+
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, accum, state, moving_rate=0.9, bit_length=8, is_test=False):
+    """The QAT activation op: EMA scale + quant-dequant roundtrip.
+    Returns (out, scale, accum', state')."""
+    x = jnp.asarray(x)
+    bins = _bin_cnt(bit_length)
+    if is_test:
+        scale = accum / jnp.maximum(state, 1e-12)
+    else:
+        scale, accum, state = moving_average_abs_max_scale(
+            x, accum, state, moving_rate)
+    s = jnp.maximum(scale, 1e-12)
+    out = _ste_round(jnp.clip(x, -s, s) / s * bins) * s / bins
+    return out, scale, accum, state
+
+
+def fake_dequantize_max_abs(x, scale, max_range):
+    """out = x * scale / max_range (fake_dequantize_op.cc)."""
+    return jnp.asarray(x, jnp.float32) * scale / max_range
+
+
+def fake_channel_wise_dequantize_max_abs(x, scales, quant_bits=(8,),
+                                         quant_axis=0):
+    """Per-channel dequantize; `scales` as in the reference's two-scale
+    form (weight scales [, activation scale])."""
+    x = jnp.asarray(x, jnp.float32)
+    wscale = jnp.asarray(scales[0], jnp.float32)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    out = x * wscale.reshape(shape) / _bin_cnt(quant_bits[0])
+    if len(scales) > 1 and scales[1] is not None:
+        out = out * scales[1] / _bin_cnt(quant_bits[1])
+    return out
+
+
+def _storage_dtype(bit_length):
+    if bit_length <= 8:
+        return jnp.int8
+    if bit_length <= 16:
+        return jnp.int16
+    return jnp.int32
+
+
+def quantize_linear(x, scale, bit_length=8):
+    """Real integer cast (inference): round+clip at the given scale
+    (operators/quantize_op.cc); storage width follows bit_length."""
+    bins = _bin_cnt(bit_length)
+    q = jnp.round(jnp.asarray(x) / jnp.maximum(scale, 1e-12) * bins)
+    return jnp.clip(q, -bins - 1, bins).astype(_storage_dtype(bit_length))
+
+
+def dequantize_linear(q, scale, bit_length=8):
+    """int → float at the given scale (operators/dequantize_op.cc)."""
+    return q.astype(jnp.float32) * scale / _bin_cnt(bit_length)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0):
+    """Per-channel quant-dequant roundtrip with STE (QAT for conv/fc
+    weights). Returns (out, scales)."""
+    x = jnp.asarray(x)
+    bins = _bin_cnt(bit_length)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    s = jnp.maximum(scale, 1e-12).reshape(shape)
+    out = _ste_round(x / s * bins) * s / bins
+    return out, scale

@@ -9,16 +9,20 @@ the CUPTI role.
 """
 
 import contextlib
+import json
+import os
+import threading
 import time
 
 import jax
 
 __all__ = [
     "profiler", "start_profiler", "stop_profiler", "reset_profiler",
-    "RecordEvent",
+    "RecordEvent", "record_memory_event", "export_chrome_trace",
 ]
 
-_events = []
+_events = []          # (name, start_s, dur_s, tid)
+_mem_events = []      # (name, ts_s, bytes, place)
 _active = {"on": False, "jax_dir": None}
 
 
@@ -34,8 +38,44 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         if _active["on"]:
-            _events.append((self.name,
-                            self.t0, time.perf_counter() - self.t0))
+            _events.append((self.name, self.t0,
+                            time.perf_counter() - self.t0,
+                            threading.get_ident()))
+
+
+def record_memory_event(name, nbytes, place="host"):
+    """Memory event (ref: platform/profiler.h:44-57 MemEvent)."""
+    if _active["on"]:
+        _mem_events.append((name, time.perf_counter(), int(nbytes), place))
+
+
+def export_chrome_trace(path):
+    """Write the recorded host spans + memory counters as a Chrome
+    tracing JSON (chrome://tracing / Perfetto) — tools/timeline.py:131
+    parity. Device-side traces come from jax.profiler's XPlane dump
+    (start_profiler(trace_dir=...)); this export covers the host runtime
+    the way the reference's host profiler layer does."""
+    events = []
+    tids = {}
+    for name, t0, dur, tid in _events:
+        tids.setdefault(tid, len(tids))
+        events.append({
+            "name": name, "ph": "X", "cat": "host",
+            "ts": t0 * 1e6, "dur": dur * 1e6,
+            "pid": 0, "tid": tids[tid],
+        })
+    for name, ts, nbytes, place in _mem_events:
+        events.append({
+            "name": f"mem:{place}", "ph": "C", "ts": ts * 1e6,
+            "pid": 0, "args": {name: nbytes},
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "paddle_tpu host"}}]
+    trace = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
 
 
 def start_profiler(state="All", tracer_option=None, trace_dir=None):
@@ -55,11 +95,12 @@ def stop_profiler(sorted_key="total", profile_path=None):
 
 def reset_profiler():
     _events.clear()
+    _mem_events.clear()
 
 
 def summary(sorted_key="total", profile_path=None):
     agg = {}
-    for name, _, dur in _events:
+    for name, _, dur, _tid in _events:
         tot, cnt = agg.get(name, (0.0, 0))
         agg[name] = (tot + dur, cnt + 1)
     rows = sorted(agg.items(), key=lambda kv: -kv[1][0])

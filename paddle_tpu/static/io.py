@@ -127,11 +127,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, scope=None):
     with open(os.path.join(dirname, model_filename or PROGRAM_FILE),
               "rb") as f:
         meta = pickle.load(f)
     _load_npz(os.path.join(dirname, params_filename or PARAMS_FILE),
-              global_scope())
+              scope if scope is not None else global_scope())
     program = meta["program"]
     return program, meta["feed_names"], meta["fetch_names"]

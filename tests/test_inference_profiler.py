@@ -1,0 +1,131 @@
+"""Inference predictor + profiler timeline tests.
+
+Patterns: the reference's inference tests run a saved model and check
+outputs (inference/tests/api/tester_helper.h); timeline tests validate
+the chrome trace JSON structure (tools/timeline.py).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.inference import Config, create_predictor
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    """Train a tiny regressor, export with save_inference_model."""
+    pt.enable_static()
+    try:
+        main, startup = pt.Program(), pt.Program()
+        with pt.static.program_guard(main, startup):
+            x = pt.static.data("x", shape=[4], dtype="float32")
+            y = pt.static.data("y", shape=[1], dtype="float32")
+            h = pt.layers.fc(x, size=8, act="relu")
+            pred = pt.layers.fc(h, size=1)
+            loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+            test_prog = main.clone(for_test=True)
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+            exe = pt.static.Executor(pt.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            xv = rng.rand(16, 4).astype(np.float32)
+            yv = xv.sum(1, keepdims=True).astype(np.float32)
+            for _ in range(30):
+                exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            expected = exe.run(test_prog, feed={"x": xv, "y": yv},
+                               fetch_list=[pred])[0]
+            pt.static.io.save_inference_model(
+                str(tmp_path), ["x"], [pred], exe, main_program=main)
+        return str(tmp_path), xv, expected
+    finally:
+        pt.disable_static()
+
+
+class TestPredictor:
+    def test_run_feed_dict(self, saved_model):
+        dirname, xv, expected = saved_model
+        pred = create_predictor(Config(dirname))
+        assert pred.get_input_names() == ["x"]
+        assert len(pred.get_output_names()) == 1
+        out = pred.run({"x": xv})[0]
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+
+    def test_zero_copy_handles(self, saved_model):
+        dirname, xv, expected = saved_model
+        pred = create_predictor(Config(dirname))
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(xv)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        np.testing.assert_allclose(out.copy_to_cpu(), expected, atol=1e-5)
+
+    def test_shape_bucket_recompile(self, saved_model):
+        dirname, xv, expected = saved_model
+        pred = create_predictor(Config(dirname))
+        # different batch sizes: each compiles once, results consistent
+        for bs in (1, 4, 16):
+            out = pred.run({"x": xv[:bs]})[0]
+            np.testing.assert_allclose(out, expected[:bs], atol=1e-5)
+
+    def test_isolated_scopes(self, saved_model):
+        dirname, xv, expected = saved_model
+        p1 = create_predictor(Config(dirname))
+        p2 = create_predictor(Config(dirname))
+        np.testing.assert_allclose(p1.run({"x": xv})[0],
+                                   p2.run({"x": xv})[0], atol=1e-6)
+
+    def test_missing_input_raises(self, saved_model):
+        dirname, _, _ = saved_model
+        pred = create_predictor(Config(dirname))
+        with pytest.raises(KeyError):
+            pred.run({})
+
+    def test_ir_optim_prunes(self, saved_model):
+        dirname, xv, expected = saved_model
+        cfg = Config(dirname)
+        cfg.switch_ir_optim(True)
+        pred = create_predictor(cfg)
+        # training ops (autodiff/sgd) must not survive into the frozen
+        # program
+        types = {op.type for op in pred._program.global_block().ops}
+        assert "autodiff" not in types and "sgd" not in types
+        out = pred.run({"x": xv})[0]
+        np.testing.assert_allclose(out, expected, atol=1e-5)
+
+
+class TestProfilerTimeline:
+    def test_chrome_trace_export(self, tmp_path):
+        import time
+        pt.profiler.reset_profiler()
+        pt.profiler.start_profiler()
+        with pt.profiler.RecordEvent("forward"):
+            time.sleep(0.002)
+        with pt.profiler.RecordEvent("backward"):
+            time.sleep(0.001)
+        pt.profiler.record_memory_event("arena", 1 << 20, place="host")
+        pt.profiler.stop_profiler()
+        path = os.path.join(str(tmp_path), "trace.json")
+        pt.profiler.export_chrome_trace(path)
+        with open(path) as f:
+            trace = json.load(f)
+        evs = trace["traceEvents"]
+        names = [e["name"] for e in evs]
+        assert "forward" in names and "backward" in names
+        assert "mem:host" in names
+        fwd = next(e for e in evs if e["name"] == "forward")
+        assert fwd["ph"] == "X" and fwd["dur"] >= 1500  # ≥1.5ms in µs
+        pt.profiler.reset_profiler()
+
+    def test_summary_still_works(self):
+        import time
+        pt.profiler.reset_profiler()
+        pt.profiler.start_profiler()
+        with pt.profiler.RecordEvent("op"):
+            time.sleep(0.001)
+        report = pt.profiler.stop_profiler()
+        assert "op" in report
+        pt.profiler.reset_profiler()

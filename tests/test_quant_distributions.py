@@ -1,0 +1,246 @@
+"""Quantization ops + toolkit + distributions tests.
+
+Patterns: unittests/test_fake_quantize_op.py (numpy re-implementation),
+slim test_quantization_pass.py (transpiled program still trains),
+test_distributions.py (sample stats + closed forms).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.contrib import quant
+from paddle_tpu.ops import quantize as Q
+
+
+class TestFakeQuantOps:
+    def test_abs_max(self):
+        x = np.array([[-1.0, 0.5], [0.25, 2.0]], np.float32)
+        out, scale = Q.fake_quantize_abs_max(x, bit_length=8)
+        assert float(scale) == 2.0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.round(x / 2.0 * 127.0))
+
+    def test_quant_dequant_roundtrip_error_bound(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 32).astype(np.float32)
+        out, scale = Q.fake_quantize_dequantize_abs_max(x, bit_length=8)
+        err = np.abs(np.asarray(out) - x).max()
+        assert err <= float(scale) / 127.0 * 0.5 + 1e-6
+
+    def test_ste_gradient(self):
+        x = jnp.asarray(np.random.RandomState(1).randn(8, 8),
+                        jnp.float32)
+
+        def f(x):
+            out, _ = Q.fake_quantize_dequantize_abs_max(x)
+            return jnp.sum(out * out)
+
+        g = jax.grad(f)(x)
+        # STE: gradient flows (≈ 2*qdq(x) * d qdq/dx ≈ nonzero)
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_channel_wise(self):
+        x = np.stack([np.full((4,), 1.0), np.full((4,), 4.0)]) \
+            .astype(np.float32)
+        out, scales = Q.fake_channel_wise_quantize_abs_max(x, 8)
+        np.testing.assert_allclose(np.asarray(scales), [1.0, 4.0])
+        np.testing.assert_allclose(np.asarray(out), np.full((2, 4), 127.0))
+
+    def test_moving_average(self):
+        x = np.full((4,), 3.0, np.float32)
+        out, scale, accum, state = Q.fake_quantize_moving_average_abs_max(
+            x, jnp.float32(0.0), jnp.float32(0.0), moving_rate=0.9)
+        # accum = 0*.9 + 3*.1 ; state = .1 ; scale = 3
+        assert float(scale) == pytest.approx(3.0, rel=1e-5)
+        out2, scale2, _, _ = Q.fake_quantize_moving_average_abs_max(
+            np.full((4,), 1.0, np.float32), accum, state, moving_rate=0.9)
+        # EMA pulls toward 1 but stays above it
+        assert 1.0 < float(scale2) < 3.0
+
+    def test_range_abs_max_window(self):
+        x1 = np.full((2,), 1.0, np.float32)
+        x2 = np.full((2,), 3.0, np.float32)
+        _, s1 = Q.fake_quantize_range_abs_max(x1, jnp.float32(0.0), 1)
+        _, s2 = Q.fake_quantize_range_abs_max(x2, s1, 2)
+        assert float(s2) == 3.0
+        _, s3 = Q.fake_quantize_range_abs_max(x1, s2, 3)
+        assert float(s3) == 3.0  # running max persists inside window
+
+    def test_dequantize(self):
+        q = np.array([127, -127], np.float32)
+        out = Q.fake_dequantize_max_abs(q, 2.0, 127.0)
+        np.testing.assert_allclose(np.asarray(out), [2.0, -2.0])
+
+    def test_int8_linear_roundtrip(self):
+        x = np.array([0.5, -1.5, 1.0], np.float32)
+        q = Q.quantize_linear(x, 1.5)
+        assert q.dtype == jnp.int8
+        back = Q.dequantize_linear(q, 1.5)
+        np.testing.assert_allclose(np.asarray(back), x, atol=1.5 / 127)
+
+
+class TestQuantToolkit:
+    def test_transpiler_inserts_and_trains(self):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[8], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                h = pt.layers.fc(x, size=16, act="relu")
+                pred = pt.layers.fc(h, size=1)
+                loss = pt.layers.mean(
+                    pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+            n_before = len(main.global_block().ops)
+            quant.QuantizeTranspiler().transpile(main)
+            n_after = len(main.global_block().ops)
+            assert n_after > n_before
+            assert any(op.type == "fake_quantize_dequantize_abs_max"
+                       for op in main.global_block().ops)
+            with pt.static.program_guard(main, startup):
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                rng = np.random.RandomState(2)
+                xv = rng.rand(32, 8).astype(np.float32)
+                yv = xv.sum(1, keepdims=True).astype(np.float32) * 0.3
+                losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                        fetch_list=[loss])[0])
+                          for _ in range(25)]
+            assert losses[-1] < losses[0] * 0.5
+        finally:
+            pt.disable_static()
+
+    def test_eager_qat_converges(self):
+        rng = np.random.RandomState(3)
+        w_true = rng.randn(6, 1).astype(np.float32)
+        x = rng.rand(64, 6).astype(np.float32)
+        y = x @ w_true
+        params = {"w": jnp.zeros((6, 1))}
+
+        def loss_fn(params):
+            qp = quant.fake_quant_params(params)
+            return jnp.mean((x @ qp["w"] - y) ** 2)
+
+        for _ in range(150):
+            g = jax.grad(loss_fn)(params)
+            params = jax.tree_util.tree_map(
+                lambda p, gg: p - 0.3 * gg, params, g)
+        assert float(loss_fn(params)) < 0.05
+
+    def test_ptq_roundtrip(self):
+        rng = np.random.RandomState(4)
+        params = {"a": rng.randn(5, 5).astype(np.float32),
+                  "b": {"c": rng.randn(3).astype(np.float32)}}
+        qz, tree = quant.post_training_quantize(params)
+        back = quant.dequantize_params(qz, tree)
+        for k in ("a",):
+            err = np.abs(back[k] - params[k]).max()
+            assert err <= np.abs(params[k]).max() / 127 + 1e-6
+
+
+class TestDistributions:
+    def test_uniform(self):
+        d = pt.distributions.Uniform(2.0, 6.0)
+        s = d.sample([5000], seed=0)
+        assert float(s.min()) >= 2.0 and float(s.max()) < 6.0
+        assert float(jnp.mean(s)) == pytest.approx(4.0, abs=0.1)
+        assert float(d.entropy()) == pytest.approx(np.log(4.0))
+        np.testing.assert_allclose(float(d.log_prob(jnp.asarray(3.0))),
+                                   -np.log(4.0), rtol=1e-6)
+        assert float(d.log_prob(jnp.asarray(10.0))) == -np.inf
+
+    def test_normal(self):
+        d = pt.distributions.Normal(1.0, 2.0)
+        s = d.sample([20000], seed=1)
+        assert float(jnp.mean(s)) == pytest.approx(1.0, abs=0.1)
+        assert float(jnp.std(s)) == pytest.approx(2.0, abs=0.1)
+        # closed forms
+        assert float(d.entropy()) == pytest.approx(
+            0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0), rel=1e-6)
+        x = 1.5
+        want = -((x - 1.0) ** 2) / 8.0 - np.log(2.0) \
+            - 0.5 * np.log(2 * np.pi)
+        assert float(d.log_prob(jnp.asarray(x))) == pytest.approx(
+            want, rel=1e-5)
+
+    def test_normal_kl(self):
+        a = pt.distributions.Normal(0.0, 1.0)
+        b = pt.distributions.Normal(1.0, 2.0)
+        # KL(N0||N1) = log(s1/s0) + (s0² + (m0-m1)²)/(2 s1²) - ½
+        want = np.log(2.0) + (1.0 + 1.0) / 8.0 - 0.5
+        assert float(a.kl_divergence(b)) == pytest.approx(want, rel=1e-5)
+        assert float(a.kl_divergence(a)) == pytest.approx(0.0, abs=1e-6)
+
+    def test_categorical(self):
+        logits = jnp.asarray([0.0, 0.0, np.log(2.0)])
+        d = pt.distributions.Categorical(logits)
+        s = d.sample([8000], seed=2)
+        freq = np.bincount(np.asarray(s), minlength=3) / 8000
+        np.testing.assert_allclose(freq, [0.25, 0.25, 0.5], atol=0.03)
+        assert float(d.log_prob(jnp.asarray(2))) == pytest.approx(
+            np.log(0.5), rel=1e-5)
+        p = np.array([0.25, 0.25, 0.5])
+        assert float(d.entropy()) == pytest.approx(
+            -np.sum(p * np.log(p)), rel=1e-5)
+
+    def test_mvn_diag(self):
+        d = pt.distributions.MultivariateNormalDiag(
+            jnp.asarray([0.0, 1.0]), jnp.asarray([1.0, 2.0]))
+        lp = float(d.log_prob(jnp.asarray([0.0, 1.0])))
+        want = -np.log(2.0) - np.log(2 * np.pi)
+        assert lp == pytest.approx(want, rel=1e-5)
+        other = pt.distributions.MultivariateNormalDiag(
+            jnp.asarray([0.0, 1.0]), jnp.asarray([1.0, 2.0]))
+        assert float(d.kl_divergence(other)) == pytest.approx(0.0, abs=1e-6)
+
+
+class TestListPromotion:
+    def test_channel_wise_dequant_static_with_scale_vars(self):
+        """A LIST of Variables in an attr position must be promoted to
+        inputs (regression: they were baked into op attrs and crashed)."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", [2, 4], "float32",
+                                   append_batch_size=False)
+                s = pt.static.data("s", [2], "float32",
+                                   append_batch_size=False)
+                out = pt.layers.fake_channel_wise_dequantize_max_abs(
+                    x, [s])
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                got = exe.run(main, feed={
+                    "x": np.full((2, 4), 127.0, np.float32),
+                    "s": np.array([1.0, 2.0], np.float32)},
+                    fetch_list=[out])[0]
+            np.testing.assert_allclose(got[0], 1.0, rtol=1e-6)
+            np.testing.assert_allclose(got[1], 2.0, rtol=1e-6)
+        finally:
+            pt.disable_static()
+
+    def test_wide_bit_quantize_linear(self):
+        x = np.array([1.0, -0.5], np.float32)
+        q = Q.quantize_linear(x, 1.0, bit_length=16)
+        assert q.dtype == jnp.int16
+        np.testing.assert_array_equal(np.asarray(q), [32767, -16384])
+        back = Q.dequantize_linear(q, 1.0, bit_length=16)
+        np.testing.assert_allclose(np.asarray(back), x, atol=1e-4)
+
+    def test_wide_bit_ptq(self):
+        params = {"w": np.array([1.0, -0.5], np.float32)}
+        qz, tree = quant.post_training_quantize(params, bit_length=16)
+        back = quant.dequantize_params(qz, tree, bit_length=16)
+        np.testing.assert_allclose(back["w"], params["w"], atol=1e-4)
+
+    def test_channel_wise_qat(self):
+        rng = np.random.RandomState(9)
+        p = {"w": jnp.asarray(rng.randn(4, 8).astype(np.float32))}
+        qp = quant.fake_quant_params(p, channel_wise=True)
+        err = np.abs(np.asarray(qp["w"]) - np.asarray(p["w"])).max()
+        per_ch = np.abs(np.asarray(p["w"])).max(1)
+        assert err <= per_ch.max() / 127 + 1e-6
