@@ -91,25 +91,24 @@ def fake_quant_params(params, bit_length=8, channel_wise=False):
 
 
 def post_training_quantize(params, bit_length=8):
-    """PTQ: pytree of float weights → {path: (int8 values, fp32 scale)}
-    (contrib/slim post-training strategy parity, weight-only abs-max)."""
+    """PTQ: pytree of float weights → (list of (int values, fp32 scale)
+    leaves in flatten order, treedef) — weight-only abs-max
+    (contrib/slim post-training strategy parity). Integer width follows
+    bit_length via ops/quantize.quantize_linear."""
     flat, treedef = jax.tree_util.tree_flatten(params)
-    bins = (1 << (bit_length - 1)) - 1
-    dtype = (np.int8 if bit_length <= 8
-             else np.int16 if bit_length <= 16 else np.int32)
     quantized = []
     for p in flat:
         p = np.asarray(p, np.float32)
         scale = float(np.max(np.abs(p))) if p.size else 0.0
-        s = max(scale, 1e-12)
-        q = np.clip(np.round(p / s * bins), -bins - 1, bins).astype(dtype)
+        q = np.asarray(Q.quantize_linear(p, scale, bit_length=bit_length))
         quantized.append((q, scale))
     return quantized, treedef
 
 
 def dequantize_params(quantized, treedef, bit_length=8):
     """Inverse of post_training_quantize."""
-    bins = (1 << (bit_length - 1)) - 1
-    flat = [np.asarray(q, np.float32) * max(s, 1e-12) / bins
+    flat = [np.asarray(Q.dequantize_linear(jnp.asarray(q),
+                                           max(s, 1e-12),
+                                           bit_length=bit_length))
             for q, s in quantized]
     return jax.tree_util.tree_unflatten(treedef, flat)

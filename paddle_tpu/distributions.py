@@ -131,7 +131,8 @@ class MultivariateNormalDiag(Distribution):
         return self.loc.shape[-1]
 
     def sample(self, shape, seed=0, rng=None):
-        shape = tuple(shape) + self.loc.shape
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                    self.scale.shape)
         return self.loc + self.scale * jax.random.normal(_key(seed, rng),
                                                          shape)
 

@@ -74,20 +74,6 @@ class ZeroCopyTensor:
         return np.asarray(out)
 
 
-def _dead_op_elimination(program, fetch_names):
-    """ir_optim pass: drop ops whose outputs reach no fetch (the analysis
-    pipeline's prune, inference/analysis/passes/passes.cc)."""
-    blk = program.global_block()
-    needed = set(fetch_names)
-    kept = []
-    for op in reversed(blk.ops):
-        if any(n in needed for n in op.output_names()):
-            kept.append(op)
-            needed.update(op.input_names())
-    kept.reverse()
-    blk.ops = kept
-    program._bump()
-    return program
 
 
 class Predictor:
@@ -107,7 +93,10 @@ class Predictor:
             model_filename=config.prog_file,
             params_filename=config.params_file, scope=self._scope)
         if config.ir_optim():
-            prog = _dead_op_elimination(prog, fetches)
+            # re-prune to the fetch-reachable subgraph (idempotent on
+            # save_inference_model artifacts, which prune at save; covers
+            # hand-built or stale programs) — shares static/io's pass
+            prog = static_io._prune(prog, feeds, fetches)
         self._program = prog
         self._feed_names = feeds
         self._fetch_names = fetches

@@ -21,7 +21,8 @@ from paddle_tpu.core import random as ptrandom
 __all__ = [
     "conv2d", "conv2d_transpose", "conv3d", "depthwise_conv2d", "pool2d",
     "pool3d", "adaptive_pool2d", "batch_norm", "layer_norm", "group_norm",
-    "instance_norm", "data_norm", "dropout", "embedding", "one_hot",
+    "instance_norm", "data_norm", "sync_batch_norm", "dropout",
+    "embedding", "one_hot",
     "label_smooth", "lrn", "pad", "pad2d", "pad_constant_like",
     "interpolate", "resize_nearest", "resize_bilinear", "pixel_shuffle",
     "affine_channel", "unfold", "space_to_depth", "shuffle_channel",
@@ -182,6 +183,39 @@ def batch_norm(x, scale, bias, mean, variance, epsilon=1e-5, momentum=0.9,
 
     m = jnp.mean(x, axis=red)
     v = jnp.var(x, axis=red)
+    out = (x - m.reshape(bshape)) * (
+        scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + epsilon)
+    ) + bias.reshape(bshape)
+    mean_out = momentum * mean + (1 - momentum) * m
+    var_out = momentum * variance + (1 - momentum) * v
+    return out, mean_out, var_out, m, v
+
+
+def sync_batch_norm(x, scale, bias, mean, variance, epsilon=1e-5,
+                    momentum=0.9, is_test=False, data_layout="NCHW",
+                    axis_name=None, name=None):
+    """Cross-replica batch norm (sync_batch_norm_op.cu parity).
+
+    Batch statistics are averaged across the ``axis_name`` mesh axis via
+    XLA collectives (replacing the reference's hand-rolled two-pass NCCL
+    allreduce of sum/sum-of-squares). Call inside shard_map/pmap with the
+    data axis name; with axis_name=None it degrades to plain batch_norm
+    (single-replica semantics).
+    """
+    if is_test or axis_name is None:
+        return batch_norm(x, scale, bias, mean, variance, epsilon,
+                          momentum, is_test=is_test,
+                          data_layout=data_layout)
+    axis = 1 if data_layout == "NCHW" else x.ndim - 1
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    # two-moment form so one pmean pair gives exact global stats
+    m_local = jnp.mean(x, axis=red)
+    sq_local = jnp.mean(jnp.square(x), axis=red)
+    m = lax.pmean(m_local, axis_name)
+    sq = lax.pmean(sq_local, axis_name)
+    v = sq - jnp.square(m)
     out = (x - m.reshape(bshape)) * (
         scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + epsilon)
     ) + bias.reshape(bshape)

@@ -18,3 +18,5 @@ from paddle_tpu.parallel.data_parallel import (
     DataParallelTrainer, shard_batch, replicate,
 )
 from paddle_tpu.parallel.env import ParallelEnv, get_rank, get_world_size
+from paddle_tpu.parallel.local_sgd import LocalSGDTrainer
+from paddle_tpu.parallel import dgc

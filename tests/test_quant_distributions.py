@@ -244,3 +244,11 @@ class TestListPromotion:
         err = np.abs(np.asarray(qp["w"]) - np.asarray(p["w"])).max()
         per_ch = np.abs(np.asarray(p["w"])).max(1)
         assert err <= per_ch.max() / 127 + 1e-6
+
+
+class TestMVNBatchedScale:
+    def test_batched_scale_sample(self):
+        d = pt.distributions.MultivariateNormalDiag(
+            jnp.zeros(3), jnp.ones((2, 3)))
+        s = d.sample([5], seed=0)
+        assert s.shape == (5, 2, 3)
