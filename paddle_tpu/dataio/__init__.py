@@ -11,3 +11,6 @@ from paddle_tpu.dataio import dataset
 from paddle_tpu.dataio.feeder import DataFeeder, batch_reader
 from paddle_tpu.dataio.pyreader import PyReader
 from paddle_tpu.dataio.dataloader import FileDataLoader
+from paddle_tpu.dataio.fluid_dataset import (
+    DatasetFactory, InMemoryDataset, QueueDataset,
+)

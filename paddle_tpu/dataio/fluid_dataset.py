@@ -1,0 +1,217 @@
+"""fluid.dataset parity: DatasetFactory / InMemoryDataset / QueueDataset.
+
+Parity targets: python/paddle/fluid/dataset.py (DatasetFactory,
+InMemoryDataset.load_into_memory/local_shuffle/global_shuffle,
+QueueDataset), the C++ Dataset/DataFeed pair (framework/data_set.h:40,
+data_feed.h:62, MultiSlotDataFeed parsing) and the §3.4
+train_from_dataset call stack.
+
+TPU-first shape: file reading/shuffling runs in the native C++ pipeline
+(paddle_tpu/native, data_pipeline.cc — the reference's DataFeed thread
+pool); parsed samples batch into dense padded arrays (LoD → padding) and
+feed the SAME compiled program the feed/fetch path uses — the per-thread
+hogwild loop (hogwild_worker.cc) collapses into batched device compute.
+global_shuffle hash-partitions samples by trainer id, mirroring
+Dataset::GlobalShuffle's trainer-to-trainer exchange without the RPC hop
+(in-process trainers see disjoint hash buckets).
+"""
+
+import hashlib
+
+import numpy as np
+
+from paddle_tpu.core.dtypes import dtype_name
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.dataio.dataloader import _py_record_iter
+from paddle_tpu import native as _native
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+def _parse_multislot(line, slots):
+    """MultiSlotDataFeed line format (data_feed.cc CheckFile): for each
+    slot, '<n> v1 ... vn' space-separated; dtype from the slot's var."""
+    toks = line.split()
+    out = []
+    i = 0
+    for name, dtype in slots:
+        enforce(i < len(toks), f"multislot line truncated at slot {name}")
+        n = int(toks[i])
+        i += 1
+        vals = toks[i:i + n]
+        enforce(len(vals) == n,
+                f"multislot line truncated inside slot {name}: "
+                f"declared {n} values, found {len(vals)}")
+        i += n
+        if dtype in ("int64", "int32"):
+            out.append(np.asarray([int(v) for v in vals], np.int64))
+        else:
+            out.append(np.asarray([float(v) for v in vals], np.float32))
+    return out
+
+
+def _pad_batch(samples, slots):
+    """Batch per-sample ragged slot arrays into dense padded [B, L] (or
+    [B, L] float) — the LoD→padding translation (SURVEY §7)."""
+    batch = {}
+    for si, (name, dtype) in enumerate(slots):
+        arrs = [s[si] for s in samples]
+        maxlen = max(a.size for a in arrs)
+        if all(a.size == maxlen for a in arrs):
+            batch[name] = np.stack(arrs)
+        else:
+            out = np.zeros((len(arrs), maxlen), arrs[0].dtype)
+            for r, a in enumerate(arrs):
+                out[r, :a.size] = a
+            batch[name] = out
+    return batch
+
+
+class _DatasetBase:
+    def __init__(self):
+        self.filelist = []
+        self.batch_size = 1
+        self.thread_num = 1
+        self.slots = []               # [(var_name, dtype_str)]
+        self._parse_fn = None
+        self.drop_last = True
+
+    # -- fluid.dataset configuration surface --------------------------------
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_use_var(self, var_list):
+        slots = []
+        for v in var_list:
+            if isinstance(v, tuple):          # (name, dtype) pairs
+                slots.append((v[0], str(v[1])))
+            elif isinstance(v, str):
+                slots.append((v, "float32"))
+            else:                             # Variable
+                slots.append(
+                    (v.name, dtype_name(getattr(v, "dtype", "float32"))))
+        self.slots = slots
+
+    def set_pipe_command(self, cmd):
+        """The reference pipes lines through a shell command
+        (data_feed.py pipe_command); here a Python callable
+        line -> list[np.ndarray] plays that role. Strings are accepted
+        and ignored (parsing falls back to MultiSlot)."""
+        if callable(cmd):
+            self._parse_fn = cmd
+
+    def _parse(self, line):
+        if isinstance(line, bytes):
+            line = line.decode("utf-8", "replace")
+        if self._parse_fn is not None:
+            return self._parse_fn(line)
+        return _parse_multislot(line, self.slots)
+
+    def _iter_lines(self):
+        """Stream raw lines from the filelist: native threaded reader when
+        built (closed even on early consumer exit), else the shared
+        pure-python fallback from dataloader.py."""
+        enforce(bool(self.filelist), "set_filelist first")
+        if _native.available():
+            loader = _native.NativeLoader(self.filelist,
+                                          nthreads=self.thread_num)
+            try:
+                yield from loader
+            finally:
+                loader.close()
+        else:
+            yield from _py_record_iter(self.filelist, epochs=1, mode="lines")
+
+    def _batches_from(self, sample_iter):
+        buf = []
+        for s in sample_iter:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield _pad_batch(buf, self.slots)
+                buf = []
+        if buf and not self.drop_last:
+            yield _pad_batch(buf, self.slots)
+
+
+class InMemoryDataset(_DatasetBase):
+    """load_into_memory → shuffle → iterate (fluid.dataset.InMemoryDataset).
+
+    Loading streams through the native threaded reader when available.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._samples = []
+        self._trainer_id = 0
+        self._trainer_num = 1
+
+    def load_into_memory(self):
+        self._samples = [self._parse(ln) for ln in self._iter_lines()
+                         if ln.strip()]
+
+    def local_shuffle(self, seed=0):
+        np.random.RandomState(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=0):
+        """Hash-partition samples to this trainer then shuffle
+        (Dataset::GlobalShuffle data_set.h:92: every trainer ends with a
+        disjoint, hash-determined subset). The hash keys on sample
+        *content*, not load position — the threaded loader's line order
+        is nondeterministic, and all trainers must agree on which bucket
+        a sample belongs to."""
+        if fleet is not None:
+            self._trainer_id = fleet.worker_index()
+            self._trainer_num = fleet.worker_num()
+        if self._trainer_num > 1:
+            keep = []
+            for s in self._samples:
+                key = b"|".join(a.tobytes() for a in s)
+                h = int(hashlib.md5(key).hexdigest(), 16)
+                if h % self._trainer_num == self._trainer_id:
+                    keep.append(s)
+            self._samples = keep
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self):
+        return len(self._samples)
+
+    def __iter__(self):
+        return self._batches_from(iter(self._samples))
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming dataset: no load phase, files stream through the native
+    queue (fluid.dataset.QueueDataset; global_shuffle unsupported there
+    too — dataset.py raises)."""
+
+    def local_shuffle(self, seed=0):
+        raise RuntimeError("QueueDataset does not support local_shuffle "
+                           "(stream mode); use InMemoryDataset")
+
+    def global_shuffle(self, *a, **k):
+        raise RuntimeError("QueueDataset does not support global_shuffle; "
+                           "use InMemoryDataset")
+
+    def __iter__(self):
+        return self._batches_from(
+            self._parse(ln) for ln in self._iter_lines() if ln.strip())
+
+
+class DatasetFactory:
+    """fluid.DatasetFactory parity."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")

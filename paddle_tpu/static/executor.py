@@ -128,6 +128,42 @@ class Executor:
         return fetches
 
     # -- internals ---------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None,
+                           fetch_list=None, fetch_info=None,
+                           print_period=100, scope=None, debug=False):
+        """Dataset-driven training loop (executor.py:927 parity, call
+        stack SURVEY §3.4): iterate the dataset's batches, feed each into
+        the compiled program, print fetches every ``print_period`` steps
+        (the FetchConfig/LodTensorPrinter role). The reference's
+        per-thread hogwild workers collapse into batched device steps."""
+        enforce(dataset is not None, "dataset is required")
+        fetch_list = fetch_list or []
+        fetch_names = [f if isinstance(f, str) else f.name
+                       for f in fetch_list]
+        enforce(fetch_info is None or len(fetch_info) == len(fetch_names),
+                "fetch_info must match fetch_list in length")
+        labels = fetch_info or fetch_names
+        step = 0
+        last = []
+        for batch in dataset:
+            last = self.run(program, feed=batch, fetch_list=fetch_names,
+                            scope=scope)
+            step += 1
+            if fetch_names and step % print_period == 0:
+                msg = ", ".join(f"{l}={np.asarray(v).mean():.6f}"
+                                for l, v in zip(labels, last))
+                print(f"step {step}: {msg}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None,
+                           fetch_list=None, fetch_info=None,
+                           print_period=100, scope=None, debug=False):
+        """executor.py infer_from_dataset parity — same loop; the caller
+        passes an inference (for_test) program so no state is updated."""
+        return self.train_from_dataset(program, dataset, fetch_list,
+                                       fetch_info, print_period, scope,
+                                       debug)
+
     def _is_startup_like(self, program):
         blk = program.global_block()
         return all(op.type != "autodiff" for op in blk.ops) and all(

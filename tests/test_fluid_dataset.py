@@ -1,0 +1,127 @@
+"""fluid.dataset + train_from_dataset tests (call stack SURVEY §3.4).
+
+Pattern: the reference's dataset tests write MultiSlot text files and
+train from them (unittests/test_dataset.py).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.dataio import DatasetFactory
+
+
+def _write_multislot(path, n, seed, dim=4):
+    """Lines: '<dim> f...f 1 <label>' — one dense slot + one label slot."""
+    rng = np.random.RandomState(seed)
+    w = np.linspace(-0.5, 0.5, dim)
+    with open(path, "w") as f:
+        for _ in range(n):
+            x = rng.rand(dim)
+            y = float(x @ w)
+            f.write(f"{dim} " + " ".join(f"{v:.6f}" for v in x)
+                    + f" 1 {y:.6f}\n")
+    return path
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    return [_write_multislot(str(tmp_path / f"part-{i}"), 32, seed=i)
+            for i in range(3)]
+
+
+class TestInMemoryDataset:
+    def _make(self, files, batch=8):
+        ds = DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_filelist(files)
+        ds.set_batch_size(batch)
+        ds.set_thread(2)
+        ds.set_use_var([("x", "float32"), ("y", "float32")])
+        return ds
+
+    def test_load_and_iterate(self, slot_files):
+        ds = self._make(slot_files)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 96
+        batches = list(ds)
+        assert len(batches) == 12
+        b = batches[0]
+        assert b["x"].shape == (8, 4) and b["y"].shape == (8, 1)
+
+    def test_local_shuffle_changes_order(self, slot_files):
+        ds = self._make(slot_files)
+        ds.load_into_memory()
+        first = next(iter(ds))["x"].copy()
+        ds.local_shuffle(seed=3)
+        shuffled = next(iter(ds))["x"]
+        assert not np.allclose(first, shuffled)
+
+    def test_global_shuffle_partitions(self, slot_files):
+        sizes = []
+        for tid in range(2):
+            ds = self._make(slot_files)
+            ds.load_into_memory()
+            ds._trainer_id = tid
+            ds._trainer_num = 2
+            ds.global_shuffle()
+            sizes.append(ds.get_memory_data_size())
+        assert sum(sizes) == 96
+        assert all(s > 0 for s in sizes)
+
+    def test_release_memory(self, slot_files):
+        ds = self._make(slot_files)
+        ds.load_into_memory()
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+
+class TestQueueDataset:
+    def test_streams(self, slot_files):
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_filelist(slot_files)
+        ds.set_batch_size(16)
+        ds.set_use_var([("x", "float32"), ("y", "float32")])
+        batches = list(ds)
+        assert len(batches) == 6
+        assert batches[0]["x"].shape == (16, 4)
+
+    def test_no_shuffle_support(self, slot_files):
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        with pytest.raises(RuntimeError):
+            ds.local_shuffle()
+        with pytest.raises(RuntimeError):
+            ds.global_shuffle()
+
+
+class TestTrainFromDataset:
+    def test_trains_static_program(self, slot_files, capsys):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                pred = pt.layers.fc(x, size=1)
+                loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGDOptimizer(0.5).minimize(loss)
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                ds = DatasetFactory().create_dataset("InMemoryDataset")
+                ds.set_filelist(slot_files)
+                ds.set_batch_size(8)
+                ds.set_use_var([x, y])
+                ds.load_into_memory()
+                ds.local_shuffle()
+                first = exe.train_from_dataset(
+                    main, ds, fetch_list=[loss], print_period=4)
+                for _ in range(6):  # epochs
+                    last = exe.train_from_dataset(
+                        main, ds, fetch_list=[loss], print_period=1000)
+            assert float(np.asarray(last[0])) \
+                < float(np.asarray(first[0]))
+            out = capsys.readouterr().out
+            assert "step 4" in out  # print_period fired
+        finally:
+            pt.disable_static()
