@@ -2,5 +2,6 @@
 paddle_tpu.amp; quantization/slim here)."""
 
 from paddle_tpu.contrib import quant
+from paddle_tpu.contrib import slim
 
-__all__ = ["quant"]
+__all__ = ["quant", "slim"]

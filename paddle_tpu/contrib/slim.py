@@ -1,0 +1,172 @@
+"""Model-slim toolkit: pruning, distillation, sensitivity analysis.
+
+Parity targets: python/paddle/fluid/contrib/slim/ — prune strategies
+(slim/prune: SensitivePruneStrategy, ratio pruning of conv/fc weights),
+distillation losses (slim/distillation/distillation_strategy.py +
+distiller.py: FSPDistiller, L2Distiller, SoftLabelDistiller; the fsp op
+operators/fsp_op.cc), and the sensitivity-analysis loop the reference's
+auto-pruner runs.
+
+TPU-native shape: pruning is a pure function over the param pytree
+(mask + re-apply every step keeps XLA shapes static — actual sparsity
+on TPU is realized by the compiler/quantizer downstream, so masks ARE
+the artifact, exactly like the reference's parameter-backup + mask
+apply); distillation losses are plain jittable functions usable in any
+loss composition.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+__all__ = [
+    "magnitude_prune_mask", "structured_prune_mask", "apply_masks",
+    "prune_ratio", "sensitivity", "Pruner",
+    "soft_label_distill_loss", "l2_distill_loss", "fsp_matrix",
+    "fsp_distill_loss",
+]
+
+
+# ---------------------------------------------------------------------------
+# pruning
+# ---------------------------------------------------------------------------
+def magnitude_prune_mask(w, ratio):
+    """0/1 mask zeroing the smallest-|w| ``ratio`` fraction of entries
+    (slim's unstructured ratio pruning)."""
+    enforce(0.0 <= ratio < 1.0, "ratio in [0,1)")
+    k = int(np.floor(ratio * w.size))
+    if k == 0:
+        return jnp.ones_like(w)
+    # exactly-k by sorted index, not a threshold compare: with tied
+    # magnitudes (zero-init tensors) a threshold would drop every tie
+    flat = jnp.abs(w.reshape(-1))
+    drop = jnp.argsort(flat)[:k]
+    mask = jnp.ones(w.size, w.dtype).at[drop].set(0)
+    return mask.reshape(w.shape)
+
+
+def structured_prune_mask(w, ratio, axis=-1):
+    """Channel pruning: zero whole slices along ``axis`` with smallest
+    L1 norm (slim's filter pruning of conv output channels)."""
+    enforce(0.0 <= ratio < 1.0, "ratio in [0,1)")
+    axes = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    norms = jnp.sum(jnp.abs(w), axis=axes)
+    n = norms.shape[0]
+    k = int(np.floor(ratio * n))
+    if k == 0:
+        return jnp.ones_like(w)
+    drop = jnp.argsort(norms)[:k]          # exactly-k (tie-safe)
+    keep = jnp.ones(n, w.dtype).at[drop].set(0)
+    shape = [1] * w.ndim
+    shape[axis % w.ndim] = n
+    return jnp.broadcast_to(keep.reshape(shape), w.shape)
+
+
+def apply_masks(params, masks):
+    """Elementwise-apply a (possibly partial) mask tree to a param tree."""
+    def apply_one(path_params, path_masks):
+        return jax.tree.map(
+            lambda p, m: p * m if m is not None else p,
+            path_params, path_masks, is_leaf=lambda x: x is None)
+    return apply_one(params, masks)
+
+
+def prune_ratio(masks):
+    """Fraction of weights zeroed across all masked tensors."""
+    leaves = [m for m in jax.tree.leaves(masks) if m is not None]
+    if not leaves:
+        return 0.0
+    total = sum(m.size for m in leaves)
+    kept = sum(float(jnp.sum(m)) for m in leaves)
+    return 1.0 - kept / total
+
+
+def sensitivity(eval_fn, params, select, ratios=(0.1, 0.3, 0.5, 0.7)):
+    """Per-tensor prune sensitivity (slim's SensitivePruneStrategy
+    analysis loop): for each param chosen by ``select(path_name)``,
+    evaluate ``eval_fn(pruned_params)`` at each ratio.
+
+    Returns {param_name: {ratio: metric}}. eval_fn is typically
+    validation loss/accuracy on a held-out batch."""
+    pairs = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat = {jax.tree_util.keystr(kp): (kp, v) for kp, v in pairs}
+    out = {}
+    for name, (kp, w) in flat.items():
+        if not select(name):
+            continue
+        res = {}
+        for r in ratios:
+            mask = magnitude_prune_mask(w, r)
+
+            def sub(kp2, v):
+                return v * mask if jax.tree_util.keystr(kp2) == name else v
+            pruned = jax.tree_util.tree_map_with_path(sub, params)
+            res[float(r)] = float(eval_fn(pruned))
+        out[name] = res
+    return out
+
+
+class Pruner:
+    """Stateful convenience wrapper (slim Pruner parity): compute masks
+    once, re-apply after every optimizer step so pruned weights stay
+    zero through training."""
+
+    def __init__(self, ratio, structured=False, axis=-1,
+                 select=lambda name: True):
+        self.ratio = ratio
+        self.structured = structured
+        self.axis = axis
+        self.select = select
+        self.masks = None
+
+    def compute_masks(self, params):
+        def one(kp, w):
+            name = jax.tree_util.keystr(kp)
+            if not self.select(name) or w.ndim < 1:
+                return None
+            if self.structured and w.ndim >= 2:
+                return structured_prune_mask(w, self.ratio, self.axis)
+            return magnitude_prune_mask(w, self.ratio)
+        self.masks = jax.tree_util.tree_map_with_path(one, params)
+        return self.masks
+
+    def prune(self, params):
+        if self.masks is None:
+            self.compute_masks(params)
+        return apply_masks(params, self.masks)
+
+
+# ---------------------------------------------------------------------------
+# distillation (slim/distillation/distiller.py parity)
+# ---------------------------------------------------------------------------
+def soft_label_distill_loss(student_logits, teacher_logits,
+                            temperature=2.0):
+    """SoftLabelDistiller: KL(teacher_T || student_T) * T^2 (Hinton)."""
+    t = jax.nn.softmax(teacher_logits / temperature, axis=-1)
+    log_s = jax.nn.log_softmax(student_logits / temperature, axis=-1)
+    log_t = jax.nn.log_softmax(teacher_logits / temperature, axis=-1)
+    kl = jnp.sum(t * (log_t - log_s), axis=-1)
+    return jnp.mean(kl) * temperature ** 2
+
+
+def l2_distill_loss(student_feat, teacher_feat):
+    """L2Distiller: mean squared feature-map distance."""
+    return jnp.mean((student_feat - teacher_feat) ** 2)
+
+
+def fsp_matrix(a, b):
+    """operators/fsp_op.cc parity — delegates to ops.misc.fsp_matrix
+    (NCHW, like the rest of paddle_tpu.ops): [N,Ca,H,W] x [N,Cb,H,W]
+    -> [N, Ca, Cb]."""
+    from paddle_tpu.ops.misc import fsp_matrix as _fsp
+    return _fsp(a, b)
+
+
+def fsp_distill_loss(student_pair, teacher_pair):
+    """FSPDistiller: L2 between student and teacher FSP matrices.
+    Each pair is (feature_in, feature_out) from the same stage."""
+    gs = fsp_matrix(*student_pair)
+    gt = fsp_matrix(*teacher_pair)
+    return jnp.mean((gs - gt) ** 2)

@@ -1,0 +1,178 @@
+"""Multi-process launcher: `python -m paddle_tpu.distributed.launch`.
+
+Parity: python/paddle/distributed/launch.py:132,214 — spawn one training
+process per rank with the PADDLE_* identity env wired, stream logs,
+propagate the first failure. Two modes, like the reference:
+
+- collective (default): N trainer processes; each gets
+  PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_CURRENT_ENDPOINT /
+  PADDLE_TRAINER_ENDPOINTS. On a TPU pod each process drives its own
+  host's chips (JAX runtime discovers topology; the env is identity
+  metadata, not comm wiring — no gen_nccl_id exchange needed).
+- ps (--server_num/--worker_num): pserver processes get
+  TRAINING_ROLE=PSERVER + PADDLE_PSERVER_ENDPOINTS; workers get
+  TRAINING_ROLE=TRAINER. Matches the reference's test_dist_base.py:429
+  env contract, which role_maker.PaddleCloudRoleMaker consumes.
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["launch_collective", "launch_ps", "find_free_ports"]
+
+
+def find_free_ports(n, host="127.0.0.1"):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(cmd, env, log_prefix, log_dir):
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"{log_prefix}.log"), "wb")
+    else:
+        out = None
+    return subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out
+
+
+def _wait(procs, logs):
+    """Wait for all; on first failure terminate the rest (launch.py's
+    terminate_local_procs role). Returns the worst returncode."""
+    try:
+        rc = 0
+        alive = dict(procs)
+        while alive:
+            for name, p in list(alive.items()):
+                r = p.poll()
+                if r is None:
+                    continue
+                del alive[name]
+                if r != 0:
+                    print(f"[launch] {name} exited with code {r}",
+                          file=sys.stderr)
+                    rc = rc or r
+                    for q in alive.values():
+                        q.terminate()
+            time.sleep(0.2)
+        return rc
+    except KeyboardInterrupt:
+        for p in procs.values():
+            p.send_signal(signal.SIGINT)
+        raise
+    finally:
+        for f in logs:
+            if f:
+                f.close()
+
+
+def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
+                      log_dir=None, env_extra=None):
+    host = ips.split(",")[0]
+    ports = (find_free_ports(nproc, host) if started_port is None
+             else list(range(started_port, started_port + nproc)))
+    endpoints = ",".join(f"{host}:{p}" for p in ports)
+    procs, logs = {}, []
+    for rank in range(nproc):
+        env = dict(os.environ, **(env_extra or {}))
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[rank]}",
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "TRAINING_ROLE": "TRAINER",
+        })
+        p, f = _spawn([sys.executable, "-u"] + script_args, env,
+                      f"workerlog.{rank}", log_dir)
+        procs[f"trainer {rank}"] = p
+        logs.append(f)
+    return _wait(procs, logs)
+
+
+def launch_ps(script_args, server_num, worker_num, started_port=None,
+              log_dir=None, env_extra=None):
+    host = "127.0.0.1"
+    ports = (find_free_ports(server_num, host) if started_port is None
+             else list(range(started_port, started_port + server_num)))
+    server_eps = ",".join(f"{host}:{p}" for p in ports)
+    procs, logs = {}, []
+    for i in range(server_num):
+        env = dict(os.environ, **(env_extra or {}))
+        env.update({
+            "TRAINING_ROLE": "PSERVER",
+            "PADDLE_TRAINER_ID": str(i),
+            "PADDLE_TRAINERS_NUM": str(worker_num),
+            "PADDLE_PSERVER_ENDPOINTS": server_eps,
+            "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[i]}",
+        })
+        p, f = _spawn([sys.executable, "-u"] + script_args, env,
+                      f"serverlog.{i}", log_dir)
+        procs[f"pserver {i}"] = p
+        logs.append(f)
+    for i in range(worker_num):
+        env = dict(os.environ, **(env_extra or {}))
+        env.update({
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_TRAINER_ID": str(i),
+            "PADDLE_TRAINERS_NUM": str(worker_num),
+            "PADDLE_PSERVER_ENDPOINTS": server_eps,
+        })
+        p, f = _spawn([sys.executable, "-u"] + script_args, env,
+                      f"workerlog.{i}", log_dir)
+        procs[f"trainer {i}"] = p
+        logs.append(f)
+    return _wait(procs, logs)
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="spawn one training process per rank (launch.py parity)")
+    ap.add_argument("--nproc_per_node", type=int, default=None,
+                    help="collective mode: trainers on this node "
+                         "(default: local device count)")
+    ap.add_argument("--ips", default="127.0.0.1")
+    ap.add_argument("--started_port", type=int, default=None)
+    ap.add_argument("--server_num", type=int, default=0,
+                    help="ps mode: pserver process count")
+    ap.add_argument("--worker_num", type=int, default=0,
+                    help="ps mode: trainer process count")
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("training_script")
+    ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    script = [args.training_script] + args.training_script_args
+    if args.server_num or args.worker_num:
+        rc = launch_ps(script, args.server_num, max(args.worker_num, 1),
+                       args.started_port, args.log_dir)
+    else:
+        nproc = args.nproc_per_node
+        if nproc is None:
+            try:
+                import jax
+                nproc = max(jax.local_device_count(), 1)
+            except Exception:
+                nproc = 1
+        rc = launch_collective(script, nproc, args.started_port, args.ips,
+                               args.log_dir)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

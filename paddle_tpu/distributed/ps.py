@@ -1,0 +1,475 @@
+"""Parameter-server runtime: server, client, async Communicator.
+
+Parity targets (SURVEY §2.6/§3.3): the reference's RPC substrate
+(operators/distributed/rpc_client.h:33 AsyncSendVar/AsyncGetVar/
+AsyncPrefetchVar/barriers/checkpoint-notify, request handlers
+request_handler_impl.cc), the listen_and_serv op
+(distributed_ops/listen_and_serv_op.cc:330 — RunSyncLoop fan-in →
+optimize blocks → barrier → serve gets; RunAsyncLoop per-var update on
+arrival), the async Communicator (distributed/communicator.h:160 —
+background send threads with gradient merging), sparse parameter
+prefetch (distributed/parameter_prefetch.cc), and checkpoint notify
+(distributed_ops/checkpoint_notify_op.cc).
+
+TPU-native shape: dense data-parallelism belongs to SPMD/XLA collectives
+(paddle_tpu.parallel); the PS path remains for what genuinely needs a
+host-side service — giant/growing sparse tables and asynchronous
+trainers. The transport is a length-prefixed-pickle TCP protocol over
+persistent connections (the role of grpc_client.cc's bytebuffer serde;
+zero external deps), and the "optimize block" the reference executes per
+parameter is the same functional `Optimizer` rule the local executor
+uses, applied server-side.
+
+Sync semantics (RunSyncLoop parity): each var carries a round counter.
+``pull(name, min_round)`` blocks until the server has applied that many
+rounds; trainers push grads for round r+1, the server averages the
+fan-in of all trainers and steps the optimizer, then wakes pullers.
+Round 0 is the server-side initial value, so every trainer starts from
+identical parameters (the reference broadcasts startup from pserver the
+same way).
+"""
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+__all__ = ["ParameterServer", "PSClient", "Communicator", "run_pserver"]
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_msg(sock, obj):
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _DenseVar:
+    """One hosted parameter: value + optimizer state + round counter.
+
+    The update mirrors the local executor's per-param optimize op
+    (optimizer.py _apply_optimizer_compute) exactly: per-param
+    regularizer then lr * param_lr then the optimizer rule — and NO
+    gradient clipping here, because the trainer program keeps its
+    clip_grads op and clips before sending (fluid clips trainer-side in
+    PS mode too)."""
+
+    def __init__(self, value, optimizer, regularizer=None, param_lr=1.0):
+        self.value = np.asarray(value)
+        self.optimizer = optimizer
+        self.regularizer = regularizer
+        self.param_lr = param_lr
+        self.slots = None              # lazy: built on first update
+        self.step_count = 0
+        self.round = 0
+        self.accum = None              # sum of grads this round
+        self.pushed = set()            # trainer ids seen this round
+        self.cv = threading.Condition()
+
+    def _step(self, grad):
+        import jax.numpy as jnp
+        opt = self.optimizer
+        if opt is None:
+            return
+        p = jnp.asarray(self.value)
+        g = jnp.asarray(grad)
+        if self.slots is None:
+            self.slots = opt._slots(p)
+        self.step_count += 1
+        t = jnp.asarray(self.step_count, jnp.int32)
+        reg = self.regularizer or opt.regularization
+        if reg is not None:
+            g = reg(p, g)
+        lr = opt._lr_value(t.astype(jnp.float32)) * self.param_lr
+        new_p, self.slots = opt._update(p, g, self.slots, lr, t)
+        self.value = np.asarray(new_p)
+
+    def push_sync(self, trainer_id, grad, num_trainers, timeout=120.0):
+        with self.cv:
+            if trainer_id in self.pushed:
+                # stale duplicate (e.g. retry) — wait for next round
+                ok = self.cv.wait_for(
+                    lambda: trainer_id not in self.pushed, timeout=timeout)
+                enforce(ok, f"duplicate push from trainer {trainer_id} "
+                            f"timed out waiting for round fan-in")
+            self.accum = grad if self.accum is None else self.accum + grad
+            self.pushed.add(trainer_id)
+            if len(self.pushed) >= num_trainers:
+                self._step(self.accum / max(num_trainers, 1))
+                self.accum = None
+                self.pushed.clear()
+                self.round += 1
+                self.cv.notify_all()
+
+    def push_async(self, grad):
+        with self.cv:
+            self._step(grad)
+            self.round += 1
+            self.cv.notify_all()
+
+    def pull(self, min_round, timeout=120.0):
+        with self.cv:
+            ok = self.cv.wait_for(lambda: self.round >= min_round,
+                                  timeout=timeout)
+            enforce(ok, f"pull timed out waiting for round {min_round}")
+            return self.value
+
+
+class _SparseTable:
+    """Hosted sparse table (lookup_sparse_table / pserver sparse block
+    parity): rows materialize on first touch, SGD-updated on push."""
+
+    def __init__(self, dim, initializer=None, seed=0, lr=1.0):
+        self.dim = dim
+        self.lr = lr
+        self.rows = {}
+        self._rng = np.random.RandomState(seed)
+        self._init = initializer or (
+            lambda rng, dim: rng.normal(0, 0.01, dim).astype(np.float32))
+        self.lock = threading.Lock()
+
+    def pull(self, ids):
+        with self.lock:
+            out = np.empty((len(ids), self.dim), np.float32)
+            for i, x in enumerate(ids):
+                row = self.rows.get(int(x))
+                if row is None:
+                    row = self._init(self._rng, self.dim)
+                    self.rows[int(x)] = row
+                out[i] = row
+            return out
+
+    def push(self, ids, grads, lr=None):
+        lr = self.lr if lr is None else lr
+        with self.lock:
+            for x, g in zip(ids, grads):
+                x = int(x)
+                row = self.rows.get(x)
+                if row is None:
+                    row = self._init(self._rng, self.dim)
+                self.rows[x] = row - lr * g
+
+
+class ParameterServer:
+    """listen_and_serv parity: hosts a set of dense vars + sparse tables,
+    applies optimizer updates on grad fan-in, serves pulls/barriers/
+    checkpoint-notify over TCP."""
+
+    def __init__(self, endpoint, num_trainers=1, sync_mode=True):
+        self.host, port = endpoint.rsplit(":", 1)
+        self.port = int(port)
+        self.num_trainers = num_trainers
+        self.sync_mode = sync_mode
+        self.dense = {}
+        self.sparse = {}
+        self._barrier_lock = threading.Condition()
+        self._barrier_count = {}
+        self._barrier_gen = {}
+        self._server = None
+        self._thread = None
+
+    # -- hosting -----------------------------------------------------------
+    def host_dense(self, name, value, optimizer=None, regularizer=None,
+                   param_lr=1.0):
+        self.dense[name] = _DenseVar(value, optimizer, regularizer,
+                                     param_lr)
+
+    def host_sparse(self, name, dim, initializer=None, seed=0, lr=1.0):
+        self.sparse[name] = _SparseTable(dim, initializer, seed, lr)
+
+    # -- request handling (request_handler_impl.cc parity) -----------------
+    def _handle(self, msg):
+        kind = msg[0]
+        if kind == "push_grad":
+            _, name, trainer_id, grad = msg
+            v = self.dense[name]
+            if self.sync_mode:
+                v.push_sync(trainer_id, grad, self.num_trainers)
+            else:
+                v.push_async(grad)
+            return ("ok",)
+        if kind == "pull_param":
+            _, name, min_round = msg
+            if not self.sync_mode:
+                min_round = 0
+            return ("ok", self.dense[name].pull(min_round))
+        if kind == "pull_sparse":
+            _, name, ids = msg
+            return ("ok", self.sparse[name].pull(ids))
+        if kind == "push_sparse":
+            _, name, ids, grads, lr = msg
+            self.sparse[name].push(ids, grads, lr)
+            return ("ok",)
+        if kind == "barrier":
+            _, tag, _trainer_id = msg
+            with self._barrier_lock:
+                gen = self._barrier_gen.setdefault(tag, 0)
+                n = self._barrier_count.get(tag, 0) + 1
+                self._barrier_count[tag] = n
+                if n >= self.num_trainers:
+                    self._barrier_count[tag] = 0
+                    self._barrier_gen[tag] = gen + 1
+                    self._barrier_lock.notify_all()
+                else:
+                    ok = self._barrier_lock.wait_for(
+                        lambda: self._barrier_gen[tag] > gen, timeout=120.0)
+                    enforce(ok, f"barrier {tag!r} timed out")
+            return ("ok",)
+        if kind == "checkpoint_notify":
+            _, dirname = msg
+            self.save(dirname)
+            return ("ok",)
+        if kind == "list_vars":
+            return ("ok", sorted(self.dense), sorted(self.sparse))
+        if kind == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return ("ok",)
+        return ("err", f"unknown request {kind!r}")
+
+    # -- checkpoint (kCheckpointBlockId parity) ----------------------------
+    def save(self, dirname):
+        os.makedirs(dirname, exist_ok=True)
+        tag = f"{self.host}_{self.port}".replace(".", "_")
+        dense = {n: v.value for n, v in self.dense.items()}
+        np.savez(os.path.join(dirname, f"pserver_{tag}.npz"), **dense)
+        for n, t in self.sparse.items():
+            with t.lock:
+                ids = np.fromiter(t.rows, np.int64, len(t.rows))
+                rows = (np.stack([t.rows[int(i)] for i in ids])
+                        if len(ids) else np.zeros((0, t.dim), np.float32))
+            np.savez(os.path.join(dirname, f"pserver_{tag}_{n}.npz"),
+                     ids=ids, rows=rows)
+
+    def load(self, dirname):
+        tag = f"{self.host}_{self.port}".replace(".", "_")
+        path = os.path.join(dirname, f"pserver_{tag}.npz")
+        if os.path.exists(path):
+            blob = np.load(path)
+            for n in blob.files:
+                if n in self.dense:
+                    self.dense[n].value = blob[n]
+        for n, t in self.sparse.items():
+            p = os.path.join(dirname, f"pserver_{tag}_{n}.npz")
+            if os.path.exists(p):
+                blob = np.load(p)
+                t.rows = {int(i): r for i, r in
+                          zip(blob["ids"], blob["rows"])}
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        handle = self._handle
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        _send_msg(self.request, handle(_recv_msg(self.request)))
+                except (ConnectionError, EOFError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self.host, self.port), Handler)
+        if self.port == 0:
+            self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def run(self):
+        """Blocking serve (the listen_and_serv op's RunImpl): start if
+        needed and wait until stop() — used by pserver processes."""
+        if self._server is None:
+            self.start()
+        self._thread.join()
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class PSClient:
+    """RPCClient parity (rpc_client.h:33): persistent connections to every
+    pserver, var→endpoint routing, send/get/prefetch/barrier/checkpoint."""
+
+    def __init__(self, endpoints, var_ep=None, trainer_id=0):
+        self.endpoints = list(endpoints)
+        self.var_ep = dict(var_ep or {})
+        self.trainer_id = trainer_id
+        # connections are per-thread: a blocking pull (sync-mode round
+        # wait) in one thread must not serialize pushes from another
+        # (the Communicator's send thread, grpc_client's channel pool role)
+        self._tls = threading.local()
+        self._all_socks = []
+        self._all_lock = threading.Lock()
+
+    def _sock(self, ep):
+        socks = getattr(self._tls, "socks", None)
+        if socks is None:
+            socks = self._tls.socks = {}
+        s = socks.get(ep)
+        if s is None:
+            host, port = ep.rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=120.0)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            socks[ep] = s
+            with self._all_lock:
+                self._all_socks.append(s)
+        return s
+
+    def _call(self, ep, *msg):
+        s = self._sock(ep)
+        _send_msg(s, msg)
+        resp = _recv_msg(s)
+        enforce(resp[0] == "ok", f"pserver {ep} error: {resp[1:]}")
+        return resp[1] if len(resp) > 1 else None
+
+    def _ep_of(self, name):
+        ep = self.var_ep.get(name)
+        enforce(ep is not None, f"var {name!r} not routed to any pserver")
+        return ep
+
+    # -- dense -------------------------------------------------------------
+    def push_grad(self, name, grad):
+        self._call(self._ep_of(name), "push_grad", name, self.trainer_id,
+                   np.asarray(grad))
+
+    def pull_param(self, name, min_round=0):
+        return self._call(self._ep_of(name), "pull_param", name, min_round)
+
+    # -- sparse (parameter_prefetch.cc parity) -----------------------------
+    def pull_sparse(self, table, ids):
+        return self._call(self._ep_of(table), "pull_sparse", table,
+                          np.asarray(ids, np.int64))
+
+    def push_sparse(self, table, ids, grads, lr=None):
+        self._call(self._ep_of(table), "push_sparse", table,
+                   np.asarray(ids, np.int64), np.asarray(grads), lr)
+
+    # -- control -----------------------------------------------------------
+    def barrier(self, tag="global"):
+        for ep in self.endpoints:
+            self._call(ep, "barrier", tag, self.trainer_id)
+
+    def checkpoint_notify(self, dirname):
+        for ep in self.endpoints:
+            self._call(ep, "checkpoint_notify", dirname)
+
+    def stop_servers(self):
+        for ep in self.endpoints:
+            try:
+                self._call(ep, "stop")
+            except Exception:
+                pass
+
+    def close(self):
+        with self._all_lock:
+            for s in self._all_socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._all_socks.clear()
+        self._tls = threading.local()
+
+
+class Communicator:
+    """Async trainer-side grad sender (communicator.h:160 parity): grads
+    queue up per var, a background thread merges (sums) pending grads per
+    var and pushes merged updates — send_queue semantics of MergeVars."""
+
+    def __init__(self, client, merge_steps=1):
+        self.client = client
+        self.merge_steps = max(int(merge_steps), 1)
+        self._pending = {}
+        self._counts = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def send(self, name, grad):
+        with self._cv:
+            g = np.asarray(grad)
+            if name in self._pending:
+                self._pending[name] = self._pending[name] + g
+            else:
+                self._pending[name] = g.copy()
+            self._counts[name] = self._counts.get(name, 0) + 1
+            self._cv.notify()
+
+    def _drain(self):
+        ready = {}
+        for n, c in list(self._counts.items()):
+            if c >= self.merge_steps or self._stop:
+                ready[n] = self._pending.pop(n) / c
+                del self._counts[n]
+        return ready
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                self._cv.wait_for(
+                    lambda: self._stop or any(
+                        c >= self.merge_steps for c in self._counts.values()),
+                    timeout=0.5)
+                ready = self._drain()
+                done = self._stop and not self._counts
+            for n, g in ready.items():
+                self.client.push_grad(n, g)
+            if done:
+                return
+
+    def flush(self):
+        with self._cv:
+            ready = {n: self._pending.pop(n) / self._counts.pop(n)
+                     for n in list(self._counts)}
+        for n, g in ready.items():
+            self.client.push_grad(n, g)
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=10.0)
+
+
+def run_pserver(pserver_program):
+    """Build + run a blocking ParameterServer from a transpiled
+    PServerProgram (the exe.run(pserver_prog) role in §3.3)."""
+    server = pserver_program.build_server()
+    server.run()
+    return server

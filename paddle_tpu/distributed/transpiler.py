@@ -1,0 +1,282 @@
+"""DistributeTranspiler: rewrite a static Program for PS training.
+
+Parity targets (SURVEY §3.3): transpiler/distribute_transpiler.py
+(DistributeTranspiler:183, transpile:377, get_trainer_program:702,
+get_pserver_program:836, DistributeTranspilerConfig:131) and
+ps_dispatcher.py (RoundRobin / HashName placement).
+
+TPU-native shape: the trainer keeps its whole forward+backward as ONE
+jitted XLA computation (the reference's per-op graph stays a per-op
+graph); the transpiler strips the optimizer-apply ops and brackets the
+block with two *host* ops — ``ps_recv`` (pull params for this round,
+fetch_barrier role) at the head and ``ps_send`` (push grads, send +
+send_barrier role) at the tail. The Executor runs host ops eagerly
+between jitted device segments (see executor._compile), so the RPC hop
+never enters the XLA program. Parameters are placed whole (XLA arrays
+are atomic — the reference's slice_var_up block-slicing exists to
+load-balance pservers, which round-robin-by-size already achieves);
+optimization runs server-side with the same functional Optimizer rule.
+
+Round/initialization semantics live in ps.py: pserver-side init from the
+captured startup initializers makes every trainer start from identical
+parameters, so sync-PS loss matches local loss exactly (the
+TestDistBase assertion, test_dist_base.py:366).
+"""
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.distributed import ps as _ps
+from paddle_tpu.static.backward import GRAD_SUFFIX
+from paddle_tpu.static.program import (
+    OP_REGISTRY, Operator, default_main_program, default_startup_program,
+)
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "PServerProgram", "RoundRobin", "HashName"]
+
+
+# ---------------------------------------------------------------------------
+# pservers placement (ps_dispatcher.py parity)
+# ---------------------------------------------------------------------------
+class PSDispatcher:
+    def __init__(self, eplist):
+        self._eplist = list(eplist)
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    """Size-balanced round robin: biggest vars placed first onto the
+    currently lightest endpoint (subsumes slice_var_up's balancing)."""
+
+    def dispatch(self, varlist):
+        load = {ep: 0 for ep in self._eplist}
+        out = {}
+        for v in sorted(varlist, key=lambda v: -int(np.prod(
+                [s if s and s > 0 else 1 for s in (v.shape or (1,))]))):
+            ep = min(self._eplist, key=lambda e: load[e])
+            out[v.name] = ep
+            load[ep] += int(np.prod(
+                [s if s and s > 0 else 1 for s in (v.shape or (1,))]))
+        return out
+
+
+class HashName(PSDispatcher):
+    def dispatch(self, varlist):
+        # md5, not hash(): placement must agree across processes that
+        # transpile independently (python hashes are process-salted)
+        import hashlib
+
+        def h(name):
+            return int(hashlib.md5(name.encode()).hexdigest(), 16)
+        return {v.name: self._eplist[h(v.name) % len(self._eplist)]
+                for v in varlist}
+
+
+class DistributeTranspilerConfig:
+    """distribute_transpiler.py:131 parity (knobs that still mean
+    something here; slice_var_up/min_block_size are subsumed by
+    size-balanced whole-var placement)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.min_block_size = 8192
+        self.split_method = RoundRobin
+        self.sync_mode = True
+        self.runtime_split_send_recv = False
+
+
+# ---------------------------------------------------------------------------
+# host ops: ps_recv / ps_send
+# ---------------------------------------------------------------------------
+_CLIENTS = {}
+
+
+def _get_client(endpoints, var_ep, trainer_id):
+    key = (tuple(endpoints), trainer_id)
+    c = _CLIENTS.get(key)
+    if c is None:
+        c = _ps.PSClient(endpoints, var_ep, trainer_id)
+        c.step = 0
+        _CLIENTS[key] = c
+    else:
+        c.var_ep.update(var_ep)
+    return c
+
+
+def reset_clients():
+    for c in _CLIENTS.values():
+        c.close()
+    _CLIENTS.clear()
+
+
+def _ps_recv_compute(ins, attrs):
+    c = _get_client(attrs["endpoints"], attrs["var_ep"],
+                    attrs["trainer_id"])
+    min_round = c.step if attrs["sync_mode"] else 0
+    return {"Out": [c.pull_param(n, min_round)
+                    for n in attrs["param_names"]]}
+
+
+def _ps_send_compute(ins, attrs):
+    c = _get_client(attrs["endpoints"], attrs["var_ep"],
+                    attrs["trainer_id"])
+    for pname, g in zip(attrs["param_names"], ins["X"]):
+        c.push_grad(pname, np.asarray(g))
+    c.step += 1
+    return {}
+
+
+OP_REGISTRY["ps_recv"] = _ps_recv_compute
+OP_REGISTRY["ps_send"] = _ps_send_compute
+
+
+# ---------------------------------------------------------------------------
+# pserver program artifact
+# ---------------------------------------------------------------------------
+class PServerProgram:
+    """What get_pserver_program returns: the server's share of parameters
+    (spec + captured startup initializer + optimizer rule) — consumed by
+    ps.run_pserver / build_server (the listen_and_serv block)."""
+
+    def __init__(self, endpoint, num_trainers, sync_mode, startup_seed):
+        self.endpoint = endpoint
+        self.num_trainers = num_trainers
+        self.sync_mode = sync_mode
+        self.startup_seed = startup_seed
+        self.dense = {}    # name -> dict(shape dtype initializer op_idx opt)
+
+    def add_dense(self, name, shape, dtype, initializer, op_idx, optimizer,
+                  regularizer=None, param_lr=1.0):
+        self.dense[name] = dict(shape=tuple(shape), dtype=dtype,
+                                initializer=initializer, op_idx=op_idx,
+                                optimizer=optimizer, regularizer=regularizer,
+                                param_lr=param_lr)
+
+    def build_server(self):
+        """Materialize the ParameterServer: init each hosted param with
+        the SAME rng the local startup run would use
+        (executor._run_eager: fold_in(PRNGKey(seed), op_index)) so
+        distributed training starts from the local-run weights."""
+        import jax
+
+        from paddle_tpu.core.dtypes import convert_dtype
+        server = _ps.ParameterServer(self.endpoint, self.num_trainers,
+                                     self.sync_mode)
+        base = jax.random.PRNGKey(self.startup_seed)
+        for name, spec in self.dense.items():
+            key = jax.random.fold_in(base, spec["op_idx"])
+            val = np.asarray(spec["initializer"](
+                key, spec["shape"], convert_dtype(spec["dtype"])))
+            server.host_dense(name, val, spec["optimizer"],
+                              regularizer=spec["regularizer"],
+                              param_lr=spec["param_lr"])
+        return server
+
+
+# ---------------------------------------------------------------------------
+# the transpiler
+# ---------------------------------------------------------------------------
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._done = False
+
+    def transpile(self, trainer_id, program=None, pservers="",
+                  trainers=1, sync_mode=True, startup_program=None):
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
+        enforce(bool(endpoints), "pservers must name >=1 endpoint")
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.endpoints = endpoints
+
+        blk = program.global_block()
+        # optimized params + their full update spec (optimizer rule,
+        # per-param regularizer, per-param lr scale) from the
+        # apply_optimizer ops the server will take over
+        opt_ops = [op for op in blk.ops if op.type == "apply_optimizer"]
+        enforce(bool(opt_ops),
+                "transpile() needs optimizer.minimize() applied first")
+        param_opt = {op.inputs["Param"][0]:
+                     (op.attrs["opt"], op.attrs.get("regularizer"),
+                      op.attrs.get("param_lr", 1.0))
+                     for op in opt_ops}
+        pvars = [blk.var(n) for n in param_opt]
+        self.var_ep = self.config.split_method(endpoints).dispatch(pvars)
+
+        # capture startup init specs (op index == rng fold index)
+        sblk = startup.global_block()
+        init_spec = {}
+        for idx, op in enumerate(sblk.ops):
+            if op.type == "init_param":
+                (out,) = op.outputs["Out"]
+                init_spec[out] = (idx, op.attrs["initializer"],
+                                  op.attrs["shape"], op.attrs["dtype"])
+        self._startup_seed = startup.random_seed
+
+        self._build_trainer_program(program, list(param_opt))
+        self._pserver_programs = {}
+        for ep in endpoints:
+            pp = PServerProgram(ep, trainers, sync_mode, self._startup_seed)
+            for name, (opt, reg, param_lr) in param_opt.items():
+                if self.var_ep[name] != ep:
+                    continue
+                enforce(name in init_spec,
+                        f"param {name!r} has no startup initializer op")
+                idx, init, shape, dtype = init_spec[name]
+                pp.add_dense(name, shape, dtype, init, idx, opt,
+                             regularizer=reg, param_lr=param_lr)
+            self._pserver_programs[ep] = pp
+        self._done = True
+        return self
+
+    def _build_trainer_program(self, program, param_names):
+        t = program.clone()
+        blk = t.global_block()
+        # strip server-side ops (the optimize sub-block moves to pserver)
+        blk.ops = [op for op in blk.ops
+                   if op.type not in ("apply_optimizer", "increment_step")]
+        common = dict(endpoints=self.endpoints, var_ep=dict(self.var_ep),
+                      trainer_id=self.trainer_id,
+                      sync_mode=self.sync_mode, _host=True)
+        recv = Operator(blk, "ps_recv", inputs={},
+                        outputs={"Out": list(param_names)},
+                        attrs=dict(common, param_names=list(param_names)))
+        blk.ops.insert(0, recv)
+        blk.append_op(
+            "ps_send",
+            inputs={"X": [n + GRAD_SUFFIX for n in param_names]},
+            outputs={},
+            attrs=dict(common, param_names=list(param_names)))
+        t._bump()
+        self._trainer_program = t
+
+    # -- fluid API surface -------------------------------------------------
+    def get_trainer_program(self, wait_port=True):
+        enforce(self._done, "call transpile() first")
+        return self._trainer_program
+
+    def get_pserver_program(self, endpoint):
+        enforce(self._done, "call transpile() first")
+        enforce(endpoint in self._pserver_programs,
+                f"{endpoint!r} not in {list(self._pserver_programs)}")
+        return self._pserver_programs[endpoint]
+
+    def get_pserver_programs(self, endpoint):
+        # fluid returns (main, startup); server-side init is embedded
+        return (self.get_pserver_program(endpoint),
+                self.get_startup_program(endpoint))
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        """Pserver startup is embedded in PServerProgram.build_server
+        (initializers captured at transpile). Returns an EMPTY Program —
+        not None — so the canonical `exe.run(t.get_startup_program(ep))`
+        recipe no-ops instead of silently falling back to
+        default_main_program()."""
+        from paddle_tpu.static.program import Program
+        return Program()

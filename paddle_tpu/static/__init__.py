@@ -19,7 +19,10 @@ from paddle_tpu.static.program import (
     OP_REGISTRY, register_op, in_static_mode, static_mode_guard, data,
     enable_static, disable_static,
 )
-from paddle_tpu.static.executor import Executor, Scope, global_scope, scope_guard
+from paddle_tpu.static.executor import (
+    AsyncExecutor, Executor, Scope, global_scope, scope_guard,
+)
+from paddle_tpu.static.debugger import pprint_program, draw_graph, memory_usage
 from paddle_tpu.static.backward import append_backward, gradients
 from paddle_tpu.static.io import (
     save_inference_model, load_inference_model, save_params,

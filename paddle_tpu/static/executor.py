@@ -209,50 +209,139 @@ class Executor:
         return bound
 
     def _compile(self, program, state_names, feed_names, fetch_names):
+        """Partition the block into maximal device runs, each jitted as
+        ONE XLA computation (the whole block, in the common case), with
+        host segments (attrs['_host']: RPC send/recv, py_func-style
+        callbacks — ops the reference runs like any other in its per-op
+        loop, executor.cc:417) executed eagerly between them. The
+        PS-mode trainer program [ps_recv | fwd+bwd | ps_send] therefore
+        still compiles its whole compute as a single fused program.
+
+        Each op's rng key folds in its index *net of preceding host
+        ops*, so a transpiler that brackets a program with host ops
+        leaves the original ops' randomness (dropout masks…) unchanged
+        — transpiled runs remain bit-comparable to local runs."""
         blk = program.global_block()
         ops = list(blk.ops)
         constants = dict(getattr(program, "_constants", {}))
-        ad_idx = next((i for i, op in enumerate(ops)
-                       if op.type == "autodiff"), None)
+        state_set = set(state_names)
 
-        def interpret(env, ops_slice, key, start_idx):
-            for i, op in enumerate(ops_slice):
-                env.update(self._exec_op(op, env,
-                                         jax.random.fold_in(key, start_idx + i)))
+        # a host op BEFORE the autodiff marker splits the differentiated
+        # prefix across segments, so value_and_grad cannot see through it
+        # and upstream params would silently train with zero grads. The
+        # one legal shape is a host op whose outputs are exactly autodiff
+        # roots (ps_recv delivering params): refuse everything else.
+        ad_global = next((i for i, op in enumerate(ops)
+                          if op.type == "autodiff"), None)
+        if ad_global is not None:
+            roots = set(ops[ad_global].attrs["params"])
+            for i in range(ad_global):
+                op = ops[i]
+                if op.attrs.get("_host") and \
+                        not set(op.output_names()) <= roots:
+                    raise EnforceNotMet(
+                        f"host op {op.type!r} at position {i} feeds the "
+                        f"differentiated forward region — gradients cannot "
+                        f"flow through a host boundary, so every parameter "
+                        f"upstream of it would silently stop training. "
+                        f"Move it after the loss/backward, or use a "
+                        f"jax-traceable op instead")
+
+        hosts_before = []              # rng index adjustment
+        h = 0
+        for op in ops:
+            hosts_before.append(h)
+            if op.attrs.get("_host"):
+                h += 1
+
+        segs = []                      # (is_host, start, end)
+        i = 0
+        while i < len(ops):
+            j = i
+            is_host = bool(ops[i].attrs.get("_host"))
+            while j < len(ops) and bool(ops[j].attrs.get("_host")) == is_host:
+                j += 1
+            segs.append((is_host, i, j))
+            i = j
+
+        def interpret(env, lo, hi, key):
+            for k in range(lo, hi):
+                env.update(self._exec_op(
+                    ops[k], env,
+                    jax.random.fold_in(key, k - hosts_before[k])))
             return env
 
+        def make_device_fn(lo, hi):
+            ad = next((k for k in range(lo, hi)
+                       if ops[k].type == "autodiff"), None)
+            # only vars this segment WRITES may be donated: a donated
+            # input that XLA merely forwards to an output (pass-through
+            # state, e.g. a PS-mode trainer's orphaned optimizer step
+            # counter) comes back as a deleted buffer and poisons the
+            # scope for the next step
+            writes = set()
+            for k in range(lo, hi):
+                writes.update(ops[k].output_names())
+
+            def seg_fn(donated, rest, key):
+                # constants enter via closure -> XLA compile-time consts
+                env = dict(constants)
+                env.update(rest)
+                env.update(donated)
+                if ad is None:
+                    env = interpret(env, lo, hi, key)
+                else:
+                    adop = ops[ad]
+                    loss_name = adop.attrs["loss"]
+                    param_names = adop.attrs["params"]
+                    base = {k: v for k, v in env.items()
+                            if k not in param_names}
+
+                    def fwd(params):
+                        e = dict(base)
+                        e.update(params)
+                        e = interpret(e, lo, ad, key)
+                        return jnp.sum(e[loss_name]), e
+
+                    params = {n: env[n] for n in param_names}
+                    (_, env2), grads = jax.value_and_grad(
+                        fwd, has_aux=True)(params)
+                    env = env2
+                    for n in param_names:
+                        env[n + "@GRAD"] = grads[n]
+                    env = interpret(env, ad + 1, hi, key)
+                return {k: v for k, v in env.items() if k not in constants}
+
+            return jax.jit(seg_fn, donate_argnums=(0,)), writes
+
+        seg_fns = [None if is_host else make_device_fn(a, b)
+                   for is_host, a, b in segs]
+
         def step(state, feeds, key):
-            env = dict(constants)  # literals become XLA consts in the trace
+            env = dict(constants)
             env.update(state)
             env.update(feeds)
-            if ad_idx is None:
-                env = interpret(env, ops, key, 0)
-            else:
-                ad = ops[ad_idx]
-                loss_name = ad.attrs["loss"]
-                param_names = ad.attrs["params"]
-                base = {k: v for k, v in env.items()
-                        if k not in param_names}
-
-                def fwd(params):
-                    e = dict(base)
-                    e.update(params)
-                    e = interpret(e, ops[:ad_idx], key, 0)
-                    loss = e[loss_name]
-                    return jnp.sum(loss), e
-
-                params = {n: env[n] for n in param_names}
-                (_, env2), grads = jax.value_and_grad(
-                    fwd, has_aux=True)(params)
-                env = env2
-                for n in param_names:
-                    env[n + "@GRAD"] = grads[n]
-                env = interpret(env, ops[ad_idx + 1:], key, ad_idx + 1)
+            for (is_host, a, b), fn_w in zip(segs, seg_fns):
+                if is_host:
+                    env = interpret(env, a, b, key)
+                else:
+                    fn, writes = fn_w
+                    # donate only state this segment overwrites (params,
+                    # opt slots): feeds/constants may be reused by the
+                    # caller, and donated pass-through state comes back
+                    # as deleted buffers
+                    donated = {k: env.pop(k) for k in list(env)
+                               if k in state_set and k in writes}
+                    rest = {k: v for k, v in env.items()
+                            if k not in constants}
+                    out = fn(donated, rest, key)
+                    env = dict(constants)
+                    env.update(out)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in state_names}
             return fetches, new_state
 
-        return jax.jit(step, donate_argnums=(0,))
+        return step
 
     def _fetch_value(self, scope, name, return_numpy):
         v = scope.find_var(name)
@@ -260,3 +349,24 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+class AsyncExecutor:
+    """async_executor.h:62 parity (the legacy pre-Trainer thread-pool
+    trainer over DataFeed). On TPU the per-thread hogwild loops collapse
+    into batched device steps, so this is a thin facade over
+    Executor.train_from_dataset — kept because fluid user code
+    instantiates fluid.AsyncExecutor(place) and calls run_from_files."""
+
+    def __init__(self, place=None, run_mode=""):
+        self._exe = Executor(place)
+
+    def run(self, program, data_feed, filelist, thread_num, fetch,
+            mode="", debug=False):
+        data_feed.set_filelist(filelist)
+        data_feed.set_thread(thread_num)
+        return self._exe.train_from_dataset(
+            program, data_feed,
+            fetch_list=list(fetch) if fetch else None, debug=debug)
+
+    run_from_files = run

@@ -1,0 +1,48 @@
+"""Program introspection tests (debugger.py / net_drawer.py parity)."""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.static import draw_graph, memory_usage, pprint_program
+
+
+def _toy():
+    main, startup = pt.Program(), pt.Program()
+    with pt.static.program_guard(main, startup):
+        x = pt.static.data("x", shape=[4], dtype="float32")
+        y = pt.static.data("y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup
+
+
+class TestDebugger:
+    def setup_method(self):
+        pt.enable_static()
+
+    def teardown_method(self):
+        pt.disable_static()
+
+    def test_pprint_lists_vars_and_ops(self):
+        main, _ = _toy()
+        text = pprint_program(main)
+        assert "block 0" in text
+        assert "fc" in text and "autodiff" in text
+        assert "param" in text and "data" in text
+
+    def test_draw_graph_dot(self, tmp_path):
+        main, _ = _toy()
+        p = tmp_path / "g.dot"
+        text = draw_graph(main, path=str(p))
+        assert text.startswith("digraph")
+        assert text.rstrip().endswith("}")
+        assert "shape=box" in text and "peripheries=2" in text
+        assert p.read_text() == text
+
+    def test_memory_usage_band(self):
+        main, _ = _toy()
+        lo, hi = memory_usage(main, batch_size=32)
+        assert 0 < lo < hi
+        lo1, _ = memory_usage(main, batch_size=64)
+        assert lo1 > lo  # batch dim scales the estimate

@@ -1,0 +1,281 @@
+"""Parameter-server mode tests (SURVEY §3.3 + §4's TestDistBase pattern).
+
+Layers: (1) service-level unit tests on ParameterServer/PSClient/
+Communicator; (2) in-process transpiled training with the dist-loss ==
+local-loss assertion (test_dist_base.py:366's delta check, exact here
+because pserver-side init reproduces the local startup rng); (3) a real
+multi-process run through paddle_tpu.distributed.launch ps mode.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import (
+    Communicator, DistributeTranspiler, ParameterServer, PSClient,
+)
+from paddle_tpu.distributed.transpiler import (
+    HashName, RoundRobin, _get_client, reset_clients,
+)
+from paddle_tpu.framework import unique_name
+
+
+# ---------------------------------------------------------------------------
+# service level
+# ---------------------------------------------------------------------------
+class TestService:
+    def _server(self, n_trainers=1, sync=True):
+        s = ParameterServer("127.0.0.1:0", n_trainers, sync)
+        s.host_dense("w", np.ones(4, np.float32),
+                     pt.optimizer.SGDOptimizer(0.5))
+        s.start()
+        return s
+
+    def test_sync_fanin_averages_and_rounds(self):
+        s = self._server(n_trainers=2)
+        try:
+            c0 = PSClient([s.endpoint], {"w": s.endpoint}, trainer_id=0)
+            c1 = PSClient([s.endpoint], {"w": s.endpoint}, trainer_id=1)
+            assert np.allclose(c0.pull_param("w", 0), 1.0)
+            c0.push_grad("w", np.full(4, 2.0, np.float32))
+            done = []
+            th = threading.Thread(
+                target=lambda: done.append(c1.pull_param("w", 1)))
+            th.start()
+            import time
+            time.sleep(0.3)
+            assert not done  # blocked: fan-in incomplete
+            c1.push_grad("w", np.full(4, 4.0, np.float32))
+            th.join(timeout=30)
+            # avg grad 3.0, lr 0.5 -> w = 1 - 1.5
+            assert np.allclose(done[0], -0.5)
+        finally:
+            s.stop()
+
+    def test_async_applies_immediately(self):
+        s = self._server(n_trainers=2, sync=False)
+        try:
+            c = PSClient([s.endpoint], {"w": s.endpoint}, trainer_id=0)
+            c.push_grad("w", np.full(4, 2.0, np.float32))
+            assert np.allclose(c.pull_param("w"), 0.0)  # 1 - 0.5*2
+        finally:
+            s.stop()
+
+    def test_sparse_pull_push(self):
+        s = ParameterServer("127.0.0.1:0", 1, True)
+        s.host_sparse("emb", dim=3, seed=0, lr=1.0)
+        s.start()
+        try:
+            c = PSClient([s.endpoint], {"emb": s.endpoint})
+            rows = c.pull_sparse("emb", [5, 9, 5])
+            assert rows.shape == (3, 3)
+            assert np.allclose(rows[0], rows[2])  # same id, same row
+            c.push_sparse("emb", [5], np.ones((1, 3), np.float32))
+            after = c.pull_sparse("emb", [5])
+            assert np.allclose(after, rows[0] - 1.0)
+        finally:
+            s.stop()
+
+    def test_barrier_and_checkpoint(self, tmp_path):
+        s = self._server(n_trainers=2)
+        try:
+            c0 = PSClient([s.endpoint], {}, trainer_id=0)
+            c1 = PSClient([s.endpoint], {}, trainer_id=1)
+            hit = []
+            th = threading.Thread(
+                target=lambda: (c1.barrier("t"), hit.append(1)))
+            th.start()
+            import time
+            time.sleep(0.3)
+            assert not hit
+            c0.barrier("t")
+            th.join(timeout=30)
+            assert hit
+            c0.checkpoint_notify(str(tmp_path))
+            saved = [f for f in os.listdir(tmp_path)
+                     if f.startswith("pserver_")]
+            assert saved
+        finally:
+            s.stop()
+
+    def test_communicator_merges(self):
+        s = self._server(n_trainers=1, sync=False)
+        try:
+            c = PSClient([s.endpoint], {"w": s.endpoint})
+            comm = Communicator(c, merge_steps=4).start()
+            for _ in range(4):
+                comm.send("w", np.full(4, 1.0, np.float32))
+            comm.stop()
+            # merged mean grad 1.0 applied once: w = 1 - 0.5
+            assert np.allclose(c.pull_param("w"), 0.5)
+        finally:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# dispatchers
+# ---------------------------------------------------------------------------
+class TestDispatch:
+    def test_round_robin_balances_by_size(self):
+        class V:
+            def __init__(self, name, shape):
+                self.name, self.shape = name, shape
+        vs = [V("a", (100, 100)), V("b", (100, 100)), V("c", (10,)),
+              V("d", (10,))]
+        out = RoundRobin(["ep0", "ep1"]).dispatch(vs)
+        assert out["a"] != out["b"]          # the two big ones split
+        assert set(out.values()) == {"ep0", "ep1"}
+
+    def test_hash_name_stable(self):
+        class V:
+            def __init__(self, name):
+                self.name, self.shape = name, (4,)
+        out1 = HashName(["e0", "e1"]).dispatch([V("x"), V("y")])
+        out2 = HashName(["e0", "e1"]).dispatch([V("x"), V("y")])
+        assert out1 == out2
+
+
+# ---------------------------------------------------------------------------
+# transpiled training: dist loss == local loss (TestDistBase pattern)
+# ---------------------------------------------------------------------------
+DIM, STEPS = 4, 8
+
+
+def _build(seed=7):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.static.program_guard(main, startup):
+        x = pt.static.data("x", shape=[DIM], dtype="float32")
+        y = pt.static.data("y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.2).minimize(loss)
+    return main, startup, loss
+
+
+def _batch(step, tid=0, tnum=1):
+    rng = np.random.RandomState(100 + step)
+    w = np.linspace(-0.5, 0.5, DIM)
+    x = rng.rand(8, DIM).astype(np.float32)
+    y = (x @ w).astype(np.float32)[:, None]
+    return {"x": x[tid::tnum], "y": y[tid::tnum]}
+
+
+def _local_losses():
+    with unique_name.guard():
+        main, startup, loss = _build()
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.static.Executor(pt.CPUPlace())
+        exe.run(startup)
+        return [float(np.asarray(exe.run(main, feed=_batch(s),
+                                         fetch_list=[loss.name])[0]))
+                for s in range(STEPS)]
+
+
+class TestTranspiledTraining:
+    def setup_method(self):
+        reset_clients()
+
+    teardown_method = setup_method
+
+    def test_single_trainer_matches_local_exactly(self):
+        from paddle_tpu.distributed.launch import find_free_ports
+        local = _local_losses()
+        with unique_name.guard():
+            main, startup, loss = _build()
+        eps = ",".join(f"127.0.0.1:{p}" for p in find_free_ports(2))
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, pservers=eps,
+                    trainers=1, sync_mode=True, startup_program=startup)
+        servers = [t.get_pserver_program(ep).build_server().start()
+                   for ep in t.endpoints]
+        try:
+            tp = t.get_trainer_program()
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                dist = [float(np.asarray(
+                    exe.run(tp, feed=_batch(s), fetch_list=[loss.name])[0]))
+                    for s in range(STEPS)]
+            np.testing.assert_allclose(dist, local, rtol=1e-5)
+            assert dist[-1] < dist[0]
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_two_trainers_sync_matches_local(self):
+        """Two trainer threads on half-batches; averaged per-step losses
+        must equal the local full-batch run (grad-mean == full-batch
+        grad for equal halves)."""
+        from paddle_tpu.distributed.launch import find_free_ports
+        local = _local_losses()
+        ep = f"127.0.0.1:{find_free_ports(1)[0]}"
+        progs = []
+        for tid in range(2):
+            with unique_name.guard():
+                main, startup, loss = _build()
+            t = DistributeTranspiler()
+            t.transpile(tid, program=main,
+                        pservers=ep, trainers=2,
+                        sync_mode=True, startup_program=startup)
+            progs.append((t, startup, loss))
+        server = progs[0][0].get_pserver_program(ep).build_server().start()
+        results = [None, None]
+
+        def run_trainer(tid):
+            t, startup, loss = progs[tid]
+            tp = t.get_trainer_program()
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                results[tid] = [float(np.asarray(
+                    exe.run(tp, feed=_batch(s, tid, 2),
+                            fetch_list=[loss.name])[0]))
+                    for s in range(STEPS)]
+
+        try:
+            threads = [threading.Thread(target=run_trainer, args=(i,))
+                       for i in range(2)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=240)
+            assert all(r is not None for r in results)
+            avg = np.mean(results, axis=0)
+            np.testing.assert_allclose(avg, local, rtol=1e-4)
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# real multi-process run through the launcher
+# ---------------------------------------------------------------------------
+class TestLaunchPS:
+    def test_two_servers_two_trainers(self, tmp_path):
+        from paddle_tpu.distributed.launch import launch_ps
+        script = os.path.join(os.path.dirname(__file__),
+                              "dist_ps_linear.py")
+        result = str(tmp_path / "losses")
+        rc = launch_ps([script], server_num=2, worker_num=2,
+                       log_dir=str(tmp_path / "logs"),
+                       env_extra={"PT_DIST_RESULT": result,
+                                  "PYTHONPATH": os.pathsep.join(
+                                      [os.path.dirname(
+                                          os.path.dirname(__file__))]
+                                      + sys.path)})
+        assert rc == 0, "distributed run failed; see logs"
+        losses = []
+        for tid in range(2):
+            with open(result + f".{tid}") as f:
+                losses.append(json.load(f))
+        local = _local_losses()
+        avg = np.mean(losses, axis=0)
+        np.testing.assert_allclose(avg, local, rtol=1e-4)

@@ -1,0 +1,86 @@
+"""contrib.slim tests: pruning masks, sensitivity, distillation losses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.contrib import slim
+
+
+class TestPrune:
+    def test_magnitude_mask_ratio(self):
+        w = jnp.asarray(np.random.RandomState(0).randn(10, 10),
+                        jnp.float32)
+        m = slim.magnitude_prune_mask(w, 0.3)
+        assert m.shape == w.shape
+        assert abs(float(m.mean()) - 0.7) < 0.02
+        # zeroed entries are exactly the smallest-|w| ones
+        kept_min = float(jnp.min(jnp.where(m > 0, jnp.abs(w), jnp.inf)))
+        dropped_max = float(jnp.max(jnp.where(m == 0, jnp.abs(w), 0.0)))
+        assert kept_min >= dropped_max
+
+    def test_structured_mask_prunes_channels(self):
+        w = jnp.asarray(np.random.RandomState(1).randn(8, 16), jnp.float32)
+        m = slim.structured_prune_mask(w, 0.25, axis=-1)
+        col_alive = np.asarray(m).sum(axis=0)
+        assert set(np.unique(col_alive)) <= {0.0, 8.0}
+        assert (col_alive == 0).sum() == 4  # 25% of 16 columns
+
+    def test_pruner_keeps_zeros_through_steps(self):
+        params = {"w": jnp.asarray(
+            np.random.RandomState(2).randn(6, 6), jnp.float32)}
+        pruner = slim.Pruner(ratio=0.5)
+        p1 = pruner.prune(params)
+        # simulate an optimizer step densifying the weights
+        p2 = jax.tree.map(lambda x: x + 0.1, p1)
+        p3 = pruner.prune(p2)
+        mask = pruner.masks["w"]
+        assert np.all(np.asarray(p3["w"])[np.asarray(mask) == 0] == 0)
+        assert abs(slim.prune_ratio(pruner.masks) - 0.5) < 0.03
+
+    def test_sensitivity_orders_ratios(self):
+        rng = np.random.RandomState(3)
+        w = jnp.asarray(rng.randn(12, 1), jnp.float32)
+        x = jnp.asarray(rng.rand(64, 12), jnp.float32)
+        y = x @ w
+
+        def eval_fn(params):
+            return jnp.mean((x @ params["w"] - y) ** 2)
+
+        sens = slim.sensitivity(eval_fn, {"w": w},
+                                select=lambda n: "w" in n,
+                                ratios=(0.1, 0.5, 0.9))
+        (per,) = sens.values()
+        assert per[0.1] <= per[0.5] <= per[0.9]  # more pruning, worse
+
+
+class TestDistill:
+    def test_soft_label_zero_when_equal(self):
+        logits = jnp.asarray(np.random.RandomState(4).randn(8, 10),
+                             jnp.float32)
+        assert float(slim.soft_label_distill_loss(logits, logits)) \
+            == pytest.approx(0.0, abs=1e-6)
+        other = logits + 1.0 * jnp.asarray(
+            np.random.RandomState(5).randn(8, 10), jnp.float32)
+        assert float(slim.soft_label_distill_loss(other, logits)) > 0
+
+    def test_fsp_matrix_shape_and_loss(self):
+        rng = np.random.RandomState(6)
+        a = jnp.asarray(rng.randn(2, 3, 4, 4), jnp.float32)   # NCHW
+        b = jnp.asarray(rng.randn(2, 5, 4, 4), jnp.float32)
+        g = slim.fsp_matrix(a, b)
+        assert g.shape == (2, 3, 5)
+        assert float(slim.fsp_distill_loss((a, b), (a, b))) \
+            == pytest.approx(0.0, abs=1e-6)
+
+    def test_distill_gradients_flow(self):
+        rng = np.random.RandomState(7)
+        t = jnp.asarray(rng.randn(4, 6), jnp.float32)
+
+        def loss(s):
+            return slim.soft_label_distill_loss(s, t)
+
+        g = jax.grad(loss)(jnp.zeros((4, 6), jnp.float32))
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
