@@ -1,0 +1,21 @@
+"""DLPack interop (framework/dlpack_tensor.{h,cc} parity).
+
+Zero-copy exchange with torch/numpy/other frameworks via the DLPack
+protocol — jax arrays already speak it; this module pins the fluid-shaped
+API names."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a device array as a DLPack capsule."""
+    return jax.dlpack.to_dlpack(jnp.asarray(x))
+
+
+def from_dlpack(capsule_or_tensor):
+    """Import from a DLPack capsule or any __dlpack__-capable tensor
+    (torch.Tensor, numpy array, ...)."""
+    return jax.dlpack.from_dlpack(capsule_or_tensor)

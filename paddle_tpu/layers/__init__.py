@@ -680,3 +680,103 @@ def linear_chain_crf(input, label, param_attr=None, length=None):
         return _append_static("linear_chain_crf", _ops.linear_chain_crf,
                               tensors, attrs, False)
     return _ops.linear_chain_crf(input, w, label, length)
+
+
+# ---------------------------------------------------------------------------
+# host ops: Print / py_func (run eagerly between jitted device segments,
+# see executor._compile; ref: operators/print_op.cc, operators/py_func_op.cc)
+# ---------------------------------------------------------------------------
+def _print_cb(msg, summarize, counter, first_n, arr):
+    import sys
+    counter["n"] += 1
+    if first_n and first_n > 0 and counter["n"] > first_n:
+        return
+    arr = np.asarray(arr)
+    flat = arr.reshape(-1)[:summarize] if summarize and summarize > 0 \
+        else arr.reshape(-1)
+    print(f"{msg}shape={arr.shape} dtype={arr.dtype} "
+          f"data={np.array2string(flat, precision=6)}",
+          file=sys.stderr)
+
+
+def _backend_has_callbacks():
+    # the axon PJRT tunnel rejects host send/recv callbacks; standard
+    # cpu/gpu/tpu backends support them
+    return jax.default_backend() in ("cpu", "gpu", "tpu", "cuda", "rocm")
+
+
+def _print_compute(ins, attrs):
+    x = ins["X"][0]
+    # device op, not a host op: jax.debug.callback keeps Print inside
+    # the jitted (and differentiated) segment — identity for autodiff,
+    # so a mid-network Print never perturbs training (print_op.cc's
+    # grad op forwards gradients the same way)
+    if _backend_has_callbacks():
+        jax.debug.callback(
+            functools.partial(_print_cb, attrs.get("message", ""),
+                              attrs.get("summarize", 20),
+                              attrs["_counter"], attrs.get("first_n", -1)),
+            x)
+    elif not attrs["_counter"].get("warned"):
+        attrs["_counter"]["warned"] = True
+        import warnings
+        warnings.warn(
+            f"layers.Print({attrs.get('message', '')!r}) is inert: "
+            f"backend {jax.default_backend()!r} does not support host "
+            f"callbacks; the op passes its input through unchanged")
+    return {"Out": [x]}
+
+
+OP_REGISTRY["print"] = _print_compute
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """fluid.layers.Print parity (operators/print_op.cc): passthrough op
+    that logs the tensor's value each execution (at most ``first_n``
+    times)."""
+    msg = (message + " ") if message else ""
+    counter = {"n": 0}
+    if in_static_mode() and isinstance(input, Variable):
+        blk = input.block
+        out = blk.create_var(shape=input.shape, dtype=input.dtype)
+        blk.append_op("print", inputs={"X": [input.name]},
+                      outputs={"Out": [out.name]},
+                      attrs={"message": msg, "summarize": summarize,
+                             "first_n": first_n, "_counter": counter})
+        return out
+    _print_cb(msg, summarize, counter, -1, input)
+    return input
+
+
+def _py_func_compute(ins, attrs):
+    fn = attrs["func"]
+    outs = fn(*ins["X"])
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return {"Out": [jnp.asarray(o) for o in outs]}
+
+
+OP_REGISTRY["py_func"] = _py_func_compute
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """fluid.layers.py_func parity (operators/py_func_op.cc): run an
+    arbitrary python callable on host values mid-program. Host op — the
+    executor materializes inputs, calls ``func``, and feeds results back
+    into the surrounding jitted segments. backward_func is accepted for
+    API parity; the autodiff boundary treats py_func outputs as
+    constants (like the reference when no backward_func is given)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    if in_static_mode() and all(isinstance(v, Variable) for v in xs):
+        blk = xs[0].block
+        blk.append_op("py_func",
+                      inputs={"X": [v.name for v in xs]},
+                      outputs={"Out": [o.name for o in outs]},
+                      attrs={"func": func, "_host": True})
+        return outs if isinstance(out, (list, tuple)) else outs[0]
+    res = _py_func_compute({"X": list(xs)}, {"func": func})["Out"]
+    return res if isinstance(out, (list, tuple)) else res[0]

@@ -41,3 +41,130 @@ def auc(predict, label, num_thresholds=4096, name=None):
     tpr = tp / jnp.maximum(tot_pos, 1.0)
     fpr = fp / jnp.maximum(tot_neg, 1.0)
     return jnp.trapezoid(tpr, fpr)
+
+
+def precision_recall(predict, label, num_classes):
+    """operators/metrics/precision_recall_op.cc: per-class and macro
+    (precision, recall, f1). predict [B, C] scores, label [B]."""
+    import numpy as np
+    pred = np.asarray(jnp.argmax(predict, axis=-1)).reshape(-1)
+    lab = np.asarray(label).reshape(-1)
+    eps = 1e-12
+    per = []
+    for c in range(num_classes):
+        tp = float(((pred == c) & (lab == c)).sum())
+        fp = float(((pred == c) & (lab != c)).sum())
+        fn = float(((pred != c) & (lab == c)).sum())
+        p = tp / (tp + fp + eps)
+        r = tp / (tp + fn + eps)
+        f1 = 2 * p * r / (p + r + eps)
+        per.append((p, r, f1))
+    macro = tuple(sum(m[i] for m in per) / num_classes for i in range(3))
+    return per, macro
+
+
+def chunk_eval(inference, label, chunk_scheme="IOB", num_chunk_types=None,
+               excluded_chunk_types=()):
+    """operators/chunk_eval_op.cc: chunking F1 for sequence labeling.
+    Tags encode (type, position) as tag = type * tag_num + pos with the
+    scheme's position alphabet (IOB: B=0,I=1; IOE: I=0,E=1; IOBES:
+    B,I,E,S=0..3; plain: single tag per type). Returns
+    (precision, recall, f1, num_infer, num_label, num_correct)."""
+    import numpy as np
+
+    schemes = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    if chunk_scheme not in schemes:
+        raise ValueError(f"unknown chunk_scheme {chunk_scheme!r}")
+    width = schemes[chunk_scheme]
+
+    def extract(tags):
+        """tag sequence -> set of (start, end, type) chunks. Stray
+        continuation tags start a chunk (CoNLL/ChunkEvaluator behavior)."""
+        chunks = []
+        state = {"start": None, "type": None}
+
+        def close(i):
+            if state["start"] is not None:
+                chunks.append((state["start"], i - 1, state["type"]))
+            state["start"] = state["type"] = None
+
+        def open_(i, typ):
+            close(i)
+            state["start"], state["type"] = i, typ
+
+        for i, t in enumerate(list(tags) + [-1]):
+            if t < 0:
+                close(i)
+                continue
+            typ, pos = divmod(int(t), width)
+            outside = (num_chunk_types is not None
+                       and typ >= num_chunk_types)
+            if outside or typ in excluded_chunk_types:
+                close(i)      # 'O' tag (tag >= types*width) ends chunks
+                continue
+            if chunk_scheme == "plain":
+                if state["start"] is None or typ != state["type"]:
+                    open_(i, typ)
+            elif chunk_scheme == "IOB":          # B=0, I=1
+                if pos == 0 or state["start"] is None \
+                        or typ != state["type"]:
+                    open_(i, typ)
+            elif chunk_scheme == "IOE":          # I=0, E=1 (inclusive end)
+                if state["start"] is None or typ != state["type"]:
+                    open_(i, typ)
+                if pos == 1:
+                    chunks.append((state["start"], i, state["type"]))
+                    state["start"] = state["type"] = None
+            else:                                 # IOBES: B,I,E,S=0..3
+                if pos == 3:
+                    close(i)
+                    chunks.append((i, i, typ))
+                elif pos == 0:
+                    open_(i, typ)
+                else:                             # I or E
+                    if state["start"] is None or typ != state["type"]:
+                        open_(i, typ)
+                    if pos == 2:
+                        chunks.append((state["start"], i, state["type"]))
+                        state["start"] = state["type"] = None
+        return set(chunks)
+
+    inf = np.asarray(inference).reshape(-1)
+    lab = np.asarray(label).reshape(-1)
+    ci = extract(inf)
+    cl = extract(lab)
+    correct = len(ci & cl)
+    eps = 1e-12
+    p = correct / (len(ci) + eps)
+    r = correct / (len(cl) + eps)
+    f1 = 2 * p * r / (p + r + eps)
+    return p, r, f1, len(ci), len(cl), correct
+
+
+def positive_negative_pair(score, label, query_ids):
+    """operators/metrics/positive_negative_pair_op.cc: within each query,
+    count ordered pairs where the higher-labeled doc scores higher
+    (positive) vs lower (negative); ties are neutral."""
+    import numpy as np
+    s = np.asarray(score).reshape(-1)
+    l = np.asarray(label).reshape(-1)
+    q = np.asarray(query_ids).reshape(-1)
+    pos = neg = neu = 0
+    for qid in np.unique(q):
+        idx = np.nonzero(q == qid)[0]
+        for a in range(len(idx)):
+            for b in range(a + 1, len(idx)):
+                i, j = idx[a], idx[b]
+                if l[i] == l[j]:
+                    continue
+                hi, lo = (i, j) if l[i] > l[j] else (j, i)
+                if s[hi] > s[lo]:
+                    pos += 1
+                elif s[hi] < s[lo]:
+                    neg += 1
+                else:
+                    neu += 1
+    return pos, neg, neu
+
+
+__all__ += ["precision_recall", "chunk_eval", "positive_negative_pair"]

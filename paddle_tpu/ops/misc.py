@@ -1,0 +1,545 @@
+"""Long-tail ops from the reference's root operator directory.
+
+Parity targets (SURVEY §2.4 root-level op list — each function names its
+reference file): add_position_encoding, affine_grid, grid_sampler,
+bilinear_tensor_product, conv_shift, row_conv, im2sequence,
+similarity_focus, spectral_norm, spp, temporal_shift, pool_with_index /
+unpool, squared_l2_distance, fsp, hash, cvm, tree_conv, nce,
+hierarchical_sigmoid, sample_logits, gru_unit, lstm_unit, shuffle
+aliases (sum/top_k/arg_max/...). All pure jnp; layouts NCHW like the
+rest of paddle_tpu.ops.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+__all__ = [
+    "add_position_encoding", "affine_grid", "grid_sampler",
+    "bilinear_tensor_product", "conv_shift", "row_conv", "im2sequence",
+    "similarity_focus", "spectral_norm", "spp", "temporal_shift",
+    "max_pool2d_with_index", "unpool2d", "squared_l2_distance",
+    "fsp_matrix", "hash_embedding_ids", "cvm", "tree_conv", "nce",
+    "hierarchical_sigmoid", "sample_logits", "gru_unit", "lstm_unit",
+    "sum", "top_k", "arg_max", "arg_min", "fill_any_like",
+    "fill_zeros_like", "assign_value", "smooth_l1_loss", "lookup_table",
+]
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """operators/add_position_encoding_op.cc: out = alpha*x + beta*PE,
+    PE the sin/cos transformer table. x: [B, T, C] (C even)."""
+    b, t, c = x.shape
+    enforce(c % 2 == 0, "channels must be even")
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    div = jnp.power(jnp.asarray(10000.0, x.dtype),
+                    jnp.arange(c // 2, dtype=x.dtype) * 2.0 / c)
+    ang = pos / div
+    pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+    return alpha * x + beta * pe[None]
+
+
+def affine_grid(theta, out_shape):
+    """operators/affine_grid_op.cc: 2D sampling grid from batch of 2x3
+    affine matrices. theta [N,2,3], out_shape (N,C,H,W) -> [N,H,W,2]
+    (x,y) in [-1,1] source coords."""
+    n, _, h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    base = jnp.broadcast_to(base, (n, h * w, 3)).astype(theta.dtype)
+    out = jnp.einsum("nij,npj->npi", theta, base)    # [N,HW,2]
+    return out.reshape(n, h, w, 2)
+
+
+def grid_sampler(x, grid):
+    """operators/grid_sampler_op.cc: bilinear sample NCHW ``x`` at
+    ``grid`` [N,H,W,2] of (x,y) in [-1,1]; zero padding outside."""
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        # vmap over batch: x[b,:,yc[b],xc[b]] -> [N,C,Ho,Wo]
+        g = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yc, xc)
+        return g * valid[:, None].astype(x.dtype)
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(y0, x0 + 1) * (wx * (1 - wy))[:, None]
+           + gather(y0 + 1, x0) * ((1 - wx) * wy)[:, None]
+           + gather(y0 + 1, x0 + 1) * (wx * wy)[:, None])
+    return out
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """operators/bilinear_tensor_product_op.cc:
+    out[:, k] = x @ W[k] @ y^T diag. x [B,M], y [B,N], W [K,M,N]."""
+    out = jnp.einsum("bm,kmn,bn->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def conv_shift(x, y):
+    """operators/conv_shift_op.cc: circular convolution. x [B,M],
+    y [B,N] (N odd, N<=M): out[i] = sum_j x[(i+j-N//2) mod M] * y[j]."""
+    b, m = x.shape
+    n = y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None] - half) % m
+    return jnp.einsum("bmn,bn->bm", x[:, idx], y)
+
+
+def row_conv(x, weight):
+    """operators/row_conv_op.cc (lookahead conv): x [B,T,D],
+    weight [future_ctx, D]: out[t] = sum_k x[t+k] * w[k]."""
+    ctx = weight.shape[0]
+    b, t, d = x.shape
+    pad = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    idx = jnp.arange(t)[:, None] + jnp.arange(ctx)[None]
+    return jnp.einsum("btkd,kd->btd", pad[:, idx], weight)
+
+
+def im2sequence(x, filter_size, stride=1, padding=0):
+    """operators/im2sequence_op.cc: NCHW image -> sequence of flattened
+    patches [B, L, C*kh*kw] (the reference emits LoD; dense here)."""
+    kh, kw = ((filter_size, filter_size)
+              if isinstance(filter_size, int) else filter_size)
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
+
+
+def similarity_focus(x, axis, indexes):
+    """operators/similarity_focus_op.cc: for each selected channel index
+    along ``axis``, mark the argmax position per remaining-dim row; out
+    is x's shape mask of 0/1."""
+    enforce(x.ndim == 4 and axis in (1, 2, 3), "4-D input, axis in 1..3")
+    mask = jnp.zeros_like(x)
+    for ind in indexes:
+        sl = jax.lax.index_in_dim(x, ind, axis, keepdims=True)
+        for red in range(1, 4):
+            if red == axis:
+                continue
+            am = jnp.argmax(sl, axis=red, keepdims=True)
+            hit = (jnp.arange(x.shape[red])
+                   .reshape([-1 if i == red else 1 for i in range(4)])
+                   == am)
+            mask = jnp.maximum(
+                mask, jnp.broadcast_to(hit, x.shape).astype(x.dtype))
+    return mask
+
+
+def spectral_norm(weight, u=None, power_iters=1, eps=1e-12, dim=0):
+    """operators/spectral_norm_op.cc: W / sigma(W) via power iteration.
+    Returns (normalized_weight, new_u)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    h = w.shape[0]
+    mat = w.reshape(h, -1)
+    if u is None:
+        u = jax.random.normal(jax.random.PRNGKey(0), (h,), mat.dtype)
+    v = None
+    for _ in range(max(power_iters, 1)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return weight / sigma, u
+
+
+def spp(x, pyramid_height=3, pool_type="max"):
+    """operators/spp_op.cc: spatial pyramid pooling NCHW ->
+    [N, C * sum(4^l)] fixed-length descriptor."""
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(pyramid_height):
+        bins = 2 ** lvl
+        # adaptive pooling to bins x bins
+        ys = [int(np.floor(i * h / bins)) for i in range(bins + 1)]
+        xs = [int(np.floor(i * w / bins)) for i in range(bins + 1)]
+        cells = []
+        for i in range(bins):
+            for j in range(bins):
+                cell = x[:, :, ys[i]:max(ys[i + 1], ys[i] + 1),
+                         xs[j]:max(xs[j + 1], xs[j] + 1)]
+                if pool_type == "max":
+                    cells.append(cell.max(axis=(2, 3)))
+                else:
+                    cells.append(cell.mean(axis=(2, 3)))
+        outs.append(jnp.stack(cells, axis=-1).reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    """operators/temporal_shift_op.cc: shift 1/4 channels forward, 1/4
+    backward along time. x [N*T, C, H, W]."""
+    nt, c, h, w = x.shape
+    t = seg_num
+    n = nt // t
+    xr = x.reshape(n, t, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    back = jnp.concatenate(
+        [xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, c1:c2]), xr[:, :-1, c1:c2]], axis=1)
+    return jnp.concatenate([back, fwd, xr[:, :, c2:]],
+                           axis=2).reshape(nt, c, h, w)
+
+
+def max_pool2d_with_index(x, pool_size, stride=None, padding=0):
+    """operators/pool_with_index_op.cc: max pool + flat argmax indices
+    (for unpool). NCHW."""
+    k = (pool_size, pool_size) if isinstance(pool_size, int) else pool_size
+    s = k if stride is None else (
+        (stride, stride) if isinstance(stride, int) else stride)
+    p = (padding, padding) if isinstance(padding, int) else padding
+    n, c, h, w = x.shape
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                 constant_values=neg)
+    flat_idx = jnp.arange(xp.shape[2] * xp.shape[3]).reshape(
+        1, 1, xp.shape[2], xp.shape[3])
+    flat_idx = jnp.broadcast_to(flat_idx, xp.shape)
+    oh = (xp.shape[2] - k[0]) // s[0] + 1
+    ow = (xp.shape[3] - k[1]) // s[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, k, s, "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+    ipatches = jax.lax.conv_general_dilated_patches(
+        flat_idx.astype(jnp.float32), k, s, "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ipatches = ipatches.reshape(n, 1, k[0] * k[1], oh, ow)
+    ipatches = jnp.broadcast_to(ipatches, patches.shape)
+    am = jnp.argmax(patches, axis=2)
+    out = jnp.take_along_axis(patches, am[:, :, None], axis=2)[:, :, 0]
+    idx = jnp.take_along_axis(ipatches, am[:, :, None], axis=2)[:, :, 0]
+    idx = idx.astype(jnp.int32)
+    # translate padded-image flat coords back to the original image so
+    # unpool scatters to the true argmax positions
+    wp = xp.shape[3]
+    orig = (idx // wp - p[0]) * w + (idx % wp - p[1])
+    return out, orig
+
+
+def unpool2d(x, indices, out_hw):
+    """operators/unpool_op.cc: scatter pooled values back to their
+    argmax positions; zeros elsewhere."""
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    flat = jax.vmap(jax.vmap(
+        lambda f, i, v: f.at[i].add(v)))(flat, idx, vals)
+    return flat.reshape(n, c, oh, ow)
+
+
+def squared_l2_distance(x, y):
+    """operators/squared_l2_distance_op.cc: rowwise ||x-y||^2."""
+    d = (x - y).reshape(x.shape[0], -1)
+    return jnp.sum(d * d, axis=1, keepdims=True)
+
+
+def fsp_matrix(a, b):
+    """operators/fsp_op.cc (NCHW form): [N, Ca, Cb] Gram matrix."""
+    n, ca, h, w = a.shape
+    af = a.reshape(n, ca, h * w)
+    bf = b.reshape(n, b.shape[1], h * w)
+    return jnp.einsum("ncs,nds->ncd", af, bf) / (h * w)
+
+
+def hash_embedding_ids(ids, mod, num_hash=1):
+    """operators/hash_op.cc: xxhash-style id remap into [0, mod); we use
+    splittable integer hashing (fmix) — stable across processes."""
+    x = jnp.asarray(ids, jnp.uint32)
+    outs = []
+    for seed in range(num_hash):
+        h = x ^ jnp.uint32(seed * 0x9E3779B9)
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        outs.append((h % jnp.uint32(mod)).astype(jnp.int64
+                    if jax.config.jax_enable_x64 else jnp.int32))
+    return outs[0] if num_hash == 1 else jnp.stack(outs, axis=-1)
+
+
+def cvm(x, use_cvm=True):
+    """operators/cvm_op.cc: CTR show/click feature. Input [B, D] whose
+    first two columns are (show, click); with use_cvm the columns become
+    log(show+1), log(click+1)-log(show+1); else they are dropped."""
+    show = jnp.log(x[:, :1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - show
+    if use_cvm:
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+def tree_conv(nodes, edges, weight, max_depth=2):
+    """operators/tree_conv_op.cc (tree-based convolution, simplified):
+    nodes [B,N,D], edges [B,N,N] adjacency (0/1), weight [K,D,O] with K
+    hops: out = sum_k A^k @ nodes @ W_k."""
+    out = 0.0
+    a = jnp.eye(nodes.shape[1], dtype=nodes.dtype)[None]
+    a = jnp.broadcast_to(a, edges.shape)
+    for k in range(min(weight.shape[0], max_depth + 1)):
+        out = out + jnp.einsum("bnm,bmd,do->bno", a, nodes, weight[k])
+        a = jnp.einsum("bnm,bmk->bnk", a, edges)
+    return out
+
+
+def nce(x, weight, bias, labels, sample_ids, num_total_classes):
+    """operators/nce_op.cc: noise-contrastive estimation loss. x [B,D],
+    weight [C,D], labels [B], sample_ids [S] negative class ids.
+    Uniform noise distribution (the reference's default sampler)."""
+    q = 1.0 / num_total_classes
+    pos_logit = jnp.einsum("bd,bd->b", x, weight[labels]) + bias[labels]
+    neg_logit = x @ weight[sample_ids].T + bias[sample_ids]  # [B,S]
+    s = sample_ids.shape[0]
+    pos = jax.nn.log_sigmoid(pos_logit - jnp.log(s * q))
+    neg = jax.nn.log_sigmoid(-(neg_logit - jnp.log(s * q)))
+    return -(pos + neg.sum(axis=1)) / (1 + s)
+
+
+def hierarchical_sigmoid(x, weight, bias, labels, num_classes):
+    """operators/hierarchical_sigmoid_op.cc with the default complete
+    binary tree (math/matrix_bit_code.h): heap-numbered nodes, leaves
+    are num_classes..2*num_classes-1, internal node k stores
+    weight[k-1]; loss[b] = sum over the leaf→root walk of
+    softplus((1-2*code) * (w . x_b + b)). Leaf depths differ when
+    num_classes is not a power of two, so steps past the root are
+    masked out."""
+    depth = int(np.ceil(np.log2(2 * max(num_classes, 2))))
+    node = jnp.asarray(labels, jnp.int32) + num_classes
+    loss = 0.0
+    for _ in range(depth):
+        active = node > 1
+        code = node % 2          # 0 = left, 1 = right
+        parent = node // 2
+        nid = jnp.maximum(parent - 1, 0)
+        logit = jnp.einsum("bd,bd->b", x, weight[nid]) + bias[nid]
+        sign = 1.0 - 2.0 * code.astype(x.dtype)
+        loss = loss + active.astype(x.dtype) * jax.nn.softplus(sign * logit)
+        node = jnp.where(active, parent, node)
+    return loss
+
+
+def sample_logits(logits, labels, sample_ids):
+    """operators/sample_logits_op.cc: gather the label logit plus
+    sampled-class logits, with the log-uniform correction left to the
+    caller. Returns ([B, 1+S] logits, [B] new labels==0)."""
+    pos = jnp.take_along_axis(logits, labels[:, None], axis=1)
+    neg = logits[:, sample_ids]
+    return jnp.concatenate([pos, neg], axis=1), jnp.zeros(
+        logits.shape[0], jnp.int32)
+
+
+def gru_unit(x, h_prev, w_gates, w_cand, b_gates=None, b_cand=None):
+    """operators/gru_unit_op.cc: one GRU step. x [B, 3H] (pre-projected
+    gates input), h_prev [B,H], w_gates [H,2H], w_cand [H,H]."""
+    hdim = h_prev.shape[1]
+    gi = x[:, :2 * hdim] + h_prev @ w_gates
+    if b_gates is not None:
+        gi = gi + b_gates
+    u, r = jnp.split(jax.nn.sigmoid(gi), 2, axis=1)
+    c = x[:, 2 * hdim:] + (r * h_prev) @ w_cand
+    if b_cand is not None:
+        c = c + b_cand
+    c = jnp.tanh(c)
+    return u * h_prev + (1 - u) * c
+
+
+def lstm_unit(x, h_prev, c_prev):
+    """operators/lstm_unit_op.cc: one LSTM step from pre-projected
+    x [B, 4H] (i,f,c,o order), returns (h, c)."""
+    hdim = h_prev.shape[1]
+    i, f, g, o = jnp.split(x, 4, axis=1)
+    c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+# ---------------------------------------------------------------------------
+# aliases for reference op names whose functionality exists under another
+# name (kept so the fluid surface matches §2.4 one-to-one)
+# ---------------------------------------------------------------------------
+def sum(xs):                                     # noqa: A001
+    """operators/sum_op.cc: elementwise sum of a var list."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def top_k(x, k):
+    """operators/top_k_op.cc."""
+    return jax.lax.top_k(x, k)
+
+
+def arg_max(x, axis=-1):
+    return jnp.argmax(x, axis=axis)
+
+
+def arg_min(x, axis=-1):
+    return jnp.argmin(x, axis=axis)
+
+
+def fill_any_like(x, value):
+    return jnp.full_like(x, value)
+
+
+def fill_zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+def assign_value(shape, dtype, values):
+    return jnp.asarray(np.asarray(values, dtype).reshape(shape))
+
+
+def smooth_l1_loss(x, y, sigma=1.0):
+    from paddle_tpu.ops.loss import smooth_l1
+    return smooth_l1(x, y, sigma=sigma)
+
+
+def lookup_table(ids, table, padding_idx=None):
+    """operators/lookup_table_op.cc — alias of ops/nn.embedding (single
+    implementation so padding_idx/shape semantics cannot diverge)."""
+    from paddle_tpu.ops.nn import embedding
+    return embedding(ids, table, padding_idx=padding_idx)
+
+
+def deformable_conv(x, offset, weight, stride=1, padding=0,
+                    deformable_groups=1, mask=None):
+    """operators/deformable_conv_op.cc (v1; v2 when ``mask`` given —
+    modulated). x [N,Cin,H,W], offset [N, 2*dg*kh*kw, Ho, Wo] in (dy,dx)
+    interleave, weight [Cout,Cin,kh,kw]. Implemented as offset-shifted
+    bilinear gathers + a dense matmul — gathers and the MXU matmul are
+    both XLA-native, mirroring how the CUDA kernel splits im2col+gemm."""
+    s = (stride, stride) if isinstance(stride, int) else stride
+    p = (padding, padding) if isinstance(padding, int) else padding
+    n, cin, h, w = x.shape
+    cout, _, kh, kw = weight.shape
+    oh = (h + 2 * p[0] - kh) // s[0] + 1
+    ow = (w + 2 * p[1] - kw) // s[1] + 1
+    enforce(offset.shape[1] == 2 * deformable_groups * kh * kw,
+            "offset channel mismatch")
+    off = offset.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+    base_y = (jnp.arange(oh) * s[0] - p[0])[:, None]
+    base_x = (jnp.arange(ow) * s[1] - p[1])[None]
+    cols = []
+    cg = cin // deformable_groups
+    for g in range(deformable_groups):
+        for k in range(kh * kw):
+            ky, kx = divmod(k, kw)
+            py = base_y + ky + off[:, g, k, 0]          # [N,Ho,Ow]
+            px = base_x + kx + off[:, g, k, 1]
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+            xs = x[:, g * cg:(g + 1) * cg]
+
+            def gat(yy, xx):
+                valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+                yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+                g_ = jax.vmap(lambda img, a, b: img[:, a, b])(xs, yc, xc)
+                return g_ * valid[:, None].astype(x.dtype)
+
+            v = (gat(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+                 + gat(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+                 + gat(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+                 + gat(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+            if mask is not None:
+                v = v * mask[:, g * kh * kw + k][:, None]
+            cols.append(v)                               # [N,cg,Ho,Ow]
+    col = jnp.stack(cols, axis=2)    # [N, cg, dg*K, Ho, Ow], idx = g*K+k
+    if deformable_groups == 1:
+        col = col.reshape(n, cin * kh * kw, oh, ow)
+    else:
+        # weight flattens channel-major ((g*cg+cc)*K + k): bring dg
+        # outside cg before flattening
+        col = (col.reshape(n, cg, deformable_groups, kh * kw, oh, ow)
+               .transpose(0, 2, 1, 3, 4, 5)
+               .reshape(n, cin * kh * kw, oh, ow))
+    wmat = weight.reshape(cout, cin * kh * kw)
+    return jnp.einsum("ok,nkhw->nohw", wmat, col)
+
+
+def average_accumulates(param, sum_1, sum_2, sum_3, num_accumulates,
+                        old_num_accumulates, num_updates,
+                        average_window=10000, max_average_window=10000,
+                        min_average_window=10000):
+    """operators/average_accumulates_op.cc: the ModelAverage optimizer's
+    rolling accumulator update (sum_1 current window, sum_2 previous
+    windows, sum_3 overflow staging)."""
+    num_updates = num_updates + 1
+    num_accumulates = num_accumulates + 1
+    sum_1 = sum_1 + param
+    roll = num_updates % average_window == 0
+    window_full = num_accumulates >= max_average_window
+    do_shift = jnp.logical_or(roll, window_full)
+
+    sum_2_n = jnp.where(do_shift, sum_2 + sum_1, sum_2)
+    sum_1_n = jnp.where(do_shift, jnp.zeros_like(sum_1), sum_1)
+    old_n = jnp.where(do_shift, old_num_accumulates + num_accumulates,
+                      old_num_accumulates)
+    num_acc_n = jnp.where(do_shift, 0, num_accumulates)
+    overflow = old_n > max_average_window
+    sum_3_n = jnp.where(overflow, sum_2_n, sum_3)
+    sum_2_f = jnp.where(overflow, jnp.zeros_like(sum_2_n), sum_2_n)
+    old_f = jnp.where(overflow, num_acc_n, old_n)
+    return sum_1_n, sum_2_f, sum_3_n, num_acc_n, old_f, num_updates
+
+
+def beam_search(log_probs, pre_scores, pre_ids, beam_size,
+                end_token=None, length_penalty=0.0, step=1):
+    """operators/beam_search_op.cc as a batched functional step:
+    log_probs [B*beam, V] for the current step, pre_scores [B*beam],
+    pre_ids [B*beam, L] prefix. Returns (ids [B*beam, L+1],
+    scores [B*beam], parent [B*beam]) after top-k over beam*V.
+    Finished beams (prefix ends with end_token) keep their score and
+    re-emit end_token."""
+    bb, v = log_probs.shape
+    b = bb // beam_size
+    lp = log_probs
+    if end_token is not None:
+        done = pre_ids[:, -1] == end_token
+        # finished: only end_token continuation at zero added cost
+        neg = jnp.full_like(lp, -1e9)
+        frozen = neg.at[:, end_token].set(0.0)
+        lp = jnp.where(done[:, None], frozen, lp)
+    total = pre_scores[:, None] + lp                       # [B*beam, V]
+    if length_penalty:
+        total = total / ((5.0 + step) / 6.0) ** length_penalty
+    flat = total.reshape(b, beam_size * v)
+    top_val, top_idx = jax.lax.top_k(flat, beam_size)      # [B, beam]
+    parent_in_b = top_idx // v                             # [B, beam]
+    token = top_idx % v
+    parent = (parent_in_b
+              + jnp.arange(b)[:, None] * beam_size).reshape(-1)
+    ids = jnp.concatenate(
+        [pre_ids[parent], token.reshape(-1, 1)], axis=1)
+    return ids, top_val.reshape(-1), parent
+
+
+__all__ += ["deformable_conv", "average_accumulates", "beam_search"]

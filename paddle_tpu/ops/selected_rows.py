@@ -1,0 +1,87 @@
+"""SelectedRows: sparse row-set tensors for embedding gradients.
+
+Parity targets: framework/selected_rows.{h,cc} (rows + value block of a
+conceptually [height, ...] tensor), operators/merge_selected_rows_op.cc
+(sum duplicate rows), split/get ops (operators/split_selected_rows_op.cc,
+get_tensor_from_selected_rows_op.cc), lookup_sparse_table
+(operators/lookup_sparse_table_op.cc) and the sgd kernel's sparse branch
+(operators/optimizers/sgd_op.cc SelectedRows path).
+
+TPU-native shape: a (rows, values, height) triple of device arrays.
+Embedding grads naturally arrive this way (grad of a gather IS a
+row-set); `merge` uses segment_sum so it jits; scatter-apply uses
+.at[].add — XLA lowers both to efficient scatter."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "SelectedRows", "merge_selected_rows", "get_tensor_from_selected_rows",
+    "split_selected_rows", "sparse_sgd_update", "lookup_sparse_table",
+]
+
+
+class SelectedRows(NamedTuple):
+    rows: jnp.ndarray      # [n] int row indices (may repeat before merge)
+    values: jnp.ndarray    # [n, ...] row payloads
+    height: int            # logical dim-0 of the dense tensor
+
+
+def merge_selected_rows(sr):
+    """Sum duplicate rows (merge_selected_rows_op.cc). Jittable: the
+    output keeps first-occurrence order of unique rows."""
+    rows = jnp.asarray(sr.rows)
+    uniq, inv = jnp.unique(rows, return_inverse=True,
+                           size=rows.shape[0], fill_value=-1)
+    summed = jax.ops.segment_sum(sr.values, inv.reshape(-1),
+                                 num_segments=rows.shape[0])
+    valid = uniq >= 0
+    return SelectedRows(jnp.where(valid, uniq, 0), summed, sr.height), valid
+
+
+def get_tensor_from_selected_rows(sr):
+    """Densify (get_tensor_from_selected_rows_op.cc)."""
+    dense = jnp.zeros((sr.height,) + tuple(sr.values.shape[1:]),
+                      sr.values.dtype)
+    return dense.at[sr.rows].add(sr.values)
+
+
+def split_selected_rows(sr, num_splits):
+    """split_selected_rows_op.cc: shard rows by range over pservers —
+    shard i owns rows [i*h/k, (i+1)*h/k)."""
+    bounds = [sr.height * i // num_splits for i in range(num_splits + 1)]
+    rows = np.asarray(sr.rows)
+    vals = np.asarray(sr.values)
+    out = []
+    for i in range(num_splits):
+        m = (rows >= bounds[i]) & (rows < bounds[i + 1])
+        out.append(SelectedRows(jnp.asarray(rows[m] - bounds[i]),
+                                jnp.asarray(vals[m]),
+                                bounds[i + 1] - bounds[i]))
+    return out
+
+
+def sparse_sgd_update(param, sr_grad, lr):
+    """sgd_op.cc SelectedRows branch: scatter-subtract only touched
+    rows."""
+    return param.at[sr_grad.rows].add(-lr * sr_grad.values)
+
+
+def lookup_sparse_table(table_dict, ids, dim, init_fn=None, seed=0):
+    """lookup_sparse_table_op.cc: auto-growing host-side table lookup
+    (python dict of id->row; the distributed twin lives in
+    distributed/ps.py _SparseTable)."""
+    rng = np.random.RandomState(seed)
+    init_fn = init_fn or (
+        lambda r: r.normal(0, 0.01, dim).astype(np.float32))
+    out = np.empty((len(ids), dim), np.float32)
+    for i, x in enumerate(np.asarray(ids).reshape(-1)):
+        row = table_dict.get(int(x))
+        if row is None:
+            row = init_fn(rng)
+            table_dict[int(x)] = row
+        out[i] = row
+    return jnp.asarray(out)

@@ -1,0 +1,184 @@
+"""LoDTensorArray + LoD structural ops.
+
+Parity targets (SURVEY §2.4): array_to_lod_tensor / lod_tensor_to_array
+(operators/array_to_lod_tensor_op.cc, lod_tensor_to_array_op.cc),
+lod_array_length, lod_rank_table (operators/lod_rank_table_op.cc),
+max_sequence_len, lod_reset, reorder_lod_tensor_by_rank,
+split_lod_tensor / merge_lod_tensor (controlflow machinery),
+tensor_array_to_tensor, shrink_rnn_memory — the machinery behind the
+reference's DynamicRNN (layers/control_flow.py:1700).
+
+TPU-native shape: the reference's LoDTensorArray is a runtime vector of
+tensors mutated op-by-op inside While loops; here a TensorArray is an
+immutable [T, ...] stacked array + integer length (scan-carry friendly,
+static shapes), and LoD metadata travels as explicit `lengths` vectors
+(see core/lod.RaggedBatch). DynamicRNN itself is ops/control_flow.scan —
+these ops cover programs that manipulate the array/LoD structure
+directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.lod import RaggedBatch
+
+__all__ = [
+    "TensorArray", "create_array", "array_write", "array_read",
+    "array_length", "tensor_array_to_tensor",
+    "lod_tensor_to_array", "array_to_lod_tensor",
+    "lod_rank_table", "max_sequence_len", "lod_reset",
+    "reorder_lod_tensor_by_rank", "split_lod_tensor", "merge_lod_tensor",
+    "shrink_rnn_memory",
+]
+
+
+class TensorArray:
+    """LoDTensorArray parity, value-semantics: fixed-capacity [T, ...]
+    buffer + current length. Writes return a NEW TensorArray (functional,
+    so it can be a lax.scan/while_loop carry)."""
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    @classmethod
+    def empty(cls, capacity, elem_shape, dtype=jnp.float32):
+        return cls(jnp.zeros((capacity,) + tuple(elem_shape), dtype),
+                   jnp.asarray(0, jnp.int32))
+
+    def write(self, i, value):
+        return TensorArray(self.buffer.at[i].set(value),
+                           jnp.maximum(self.length, i + 1))
+
+    def read(self, i):
+        return self.buffer[i]
+
+    def stack(self):
+        return self.buffer[:int(self.length)] \
+            if not isinstance(self.length, jax.core.Tracer) else self.buffer
+
+    def __len__(self):
+        return int(self.length)
+
+
+jax.tree_util.register_pytree_node(
+    TensorArray,
+    lambda ta: ((ta.buffer, ta.length), None),
+    lambda _, ch: TensorArray(*ch))
+
+
+def create_array(capacity, elem_shape, dtype=jnp.float32):
+    return TensorArray.empty(capacity, elem_shape, dtype)
+
+
+def array_write(array, i, x):
+    return array.write(i, x)
+
+
+def array_read(array, i):
+    return array.read(i)
+
+
+def array_length(array):
+    """operators/lod_array_length_op.cc."""
+    return array.length
+
+
+def tensor_array_to_tensor(array, axis=0):
+    """operators/tensor_array_to_tensor_op.cc: concat/stack the array's
+    valid prefix along ``axis``."""
+    vals = array.stack()
+    if axis == 0:
+        return vals
+    return jnp.moveaxis(vals, 0, axis)
+
+
+def lod_tensor_to_array(ragged):
+    """operators/lod_tensor_to_array_op.cc: split a ragged batch into a
+    per-timestep array ordered by the rank table (longest first) —
+    t-th entry holds step t of every sequence longer than t."""
+    enforce(isinstance(ragged, RaggedBatch), "expects RaggedBatch")
+    order = np.argsort(-np.asarray(ragged.lengths))
+    data = jnp.asarray(ragged.data)[order]
+    lens = np.asarray(ragged.lengths)[order]
+    steps = []
+    for t in range(int(lens.max()) if len(lens) else 0):
+        steps.append(data[: int((lens > t).sum()), t])
+    return steps, order, lens
+
+
+def array_to_lod_tensor(steps, order, lens):
+    """operators/array_to_lod_tensor_op.cc: inverse of the above."""
+    n = len(lens)
+    maxlen = len(steps)
+    feat = steps[0].shape[1:] if steps else ()
+    out = np.zeros((n, maxlen) + tuple(feat),
+                   np.asarray(steps[0]).dtype if steps else np.float32)
+    for t, s in enumerate(steps):
+        out[: s.shape[0], t] = np.asarray(s)
+    inv = np.argsort(order)
+    return RaggedBatch(jnp.asarray(out[inv]),
+                       jnp.asarray(np.asarray(lens)[inv]))
+
+
+def lod_rank_table(ragged, level=0):
+    """operators/lod_rank_table_op.cc: [(seq_index, length)] sorted by
+    descending length (stable)."""
+    lens = np.asarray(ragged.lengths)
+    order = np.argsort(-lens, kind="stable")
+    return [(int(i), int(lens[i])) for i in order]
+
+
+def max_sequence_len(rank_table):
+    """operators/max_sequence_len_op.cc."""
+    return rank_table[0][1] if rank_table else 0
+
+
+def lod_reset(ragged, target_lengths):
+    """operators/lod_reset_op.cc: reinterpret the flat data under new
+    sequence lengths."""
+    flat, _ = ragged.to_lod()
+    return RaggedBatch.from_lod(flat, _lengths_to_lod(target_lengths))
+
+
+def _lengths_to_lod(lengths):
+    off = [0]
+    for n in lengths:
+        off.append(off[-1] + int(n))
+    return off
+
+
+def reorder_lod_tensor_by_rank(ragged, rank_table):
+    """operators/reorder_lod_tensor_by_rank_op.cc."""
+    order = [i for i, _ in rank_table]
+    return RaggedBatch(jnp.asarray(ragged.data)[jnp.asarray(order)],
+                       jnp.asarray(ragged.lengths)[jnp.asarray(order)])
+
+
+def split_lod_tensor(x, mask):
+    """operators/split_lod_tensor_op.cc (IfElse machinery): partition
+    rows by boolean mask -> (true_rows, false_rows, restore_index)."""
+    mask = np.asarray(mask).astype(bool).reshape(-1)
+    ti = np.nonzero(mask)[0]
+    fi = np.nonzero(~mask)[0]
+    restore = np.argsort(np.concatenate([ti, fi]))
+    return (jnp.asarray(x)[jnp.asarray(ti, jnp.int32)] if len(ti) else
+            jnp.zeros((0,) + x.shape[1:], x.dtype),
+            jnp.asarray(x)[jnp.asarray(fi, jnp.int32)] if len(fi) else
+            jnp.zeros((0,) + x.shape[1:], x.dtype),
+            restore)
+
+
+def merge_lod_tensor(true_rows, false_rows, restore_index):
+    """operators/merge_lod_tensor_op.cc: inverse of split_lod_tensor."""
+    allrows = jnp.concatenate([true_rows, false_rows], axis=0)
+    return allrows[jnp.asarray(restore_index, jnp.int32)]
+
+
+def shrink_rnn_memory(mem, rank_table, step):
+    """operators/shrink_rnn_memory_op.cc: keep only the sequences still
+    alive at timestep ``step`` (rank-table-ordered memory)."""
+    alive = sum(1 for _, ln in rank_table if ln > step)
+    return mem[:alive]

@@ -1,0 +1,362 @@
+"""Tests for the long-tail op families: misc, TensorArray/LoD ops,
+SelectedRows, Print/py_func host ops (SURVEY §2.4 checklist)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops as O
+from paddle_tpu.core.lod import RaggedBatch
+
+
+class TestMiscOps:
+    def test_add_position_encoding(self):
+        x = jnp.zeros((2, 5, 8), jnp.float32)
+        out = O.add_position_encoding(x, alpha=1.0, beta=1.0)
+        # PE at t=0: sin(0)=0 for first half, cos(0)=1 for second half
+        np.testing.assert_allclose(np.asarray(out[0, 0, :4]), 0.0,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out[0, 0, 4:]), 1.0,
+                                   atol=1e-6)
+
+    def test_affine_grid_identity(self):
+        theta = jnp.broadcast_to(
+            jnp.asarray([[1.0, 0, 0], [0, 1.0, 0]]), (2, 2, 3))
+        grid = O.affine_grid(theta, (2, 3, 4, 5))
+        assert grid.shape == (2, 4, 5, 2)
+        np.testing.assert_allclose(np.asarray(grid[0, 0, 0]), [-1, -1],
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(grid[0, -1, -1]), [1, 1],
+                                   atol=1e-6)
+
+    def test_grid_sampler_identity(self):
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 2, 4, 4),
+                        jnp.float32)
+        theta = jnp.asarray([[[1.0, 0, 0], [0, 1.0, 0]]])
+        grid = O.affine_grid(theta, (1, 2, 4, 4))
+        out = O.grid_sampler(x, grid)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   atol=1e-5)
+
+    def test_bilinear_tensor_product(self):
+        x = jnp.ones((2, 3))
+        y = jnp.ones((2, 4))
+        w = jnp.ones((5, 3, 4))
+        out = O.bilinear_tensor_product(x, y, w)
+        np.testing.assert_allclose(np.asarray(out), 12.0)
+
+    def test_conv_shift_matches_naive(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 6).astype(np.float32)
+        y = rng.rand(2, 3).astype(np.float32)
+        out = np.asarray(O.conv_shift(jnp.asarray(x), jnp.asarray(y)))
+        ref = np.zeros_like(x)
+        for b in range(2):
+            for i in range(6):
+                for j in range(3):
+                    ref[b, i] += x[b, (i + j - 1) % 6] * y[b, j]
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_row_conv(self):
+        x = jnp.ones((1, 4, 2))
+        w = jnp.ones((2, 2))
+        out = np.asarray(O.row_conv(x, w))
+        # interior steps see 2 frames, the last sees 1 (zero pad)
+        np.testing.assert_allclose(out[0, :3], 2.0)
+        np.testing.assert_allclose(out[0, 3], 1.0)
+
+    def test_im2sequence_shapes(self):
+        x = jnp.asarray(np.random.RandomState(2).rand(2, 3, 6, 6),
+                        jnp.float32)
+        seq = O.im2sequence(x, filter_size=2, stride=2)
+        assert seq.shape == (2, 9, 12)
+
+    def test_spectral_norm_unit_sigma(self):
+        w = jnp.asarray(np.random.RandomState(3).randn(8, 6), jnp.float32)
+        wn, u = O.spectral_norm(w, power_iters=30)
+        s = np.linalg.svd(np.asarray(wn), compute_uv=False)
+        assert abs(s[0] - 1.0) < 1e-3
+
+    def test_spp_output_len(self):
+        x = jnp.asarray(np.random.RandomState(4).rand(2, 3, 8, 8),
+                        jnp.float32)
+        out = O.spp(x, pyramid_height=3)
+        assert out.shape == (2, 3 * (1 + 4 + 16))
+
+    def test_temporal_shift_roundtrip_shape(self):
+        x = jnp.asarray(np.random.RandomState(5).rand(6, 8, 2, 2),
+                        jnp.float32)
+        out = O.temporal_shift(x, seg_num=3, shift_ratio=0.25)
+        assert out.shape == x.shape
+        # untouched channel band identical
+        np.testing.assert_allclose(np.asarray(out[:, 4:]),
+                                   np.asarray(x[:, 4:]))
+
+    def test_pool_with_index_and_unpool(self):
+        x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out, idx = O.max_pool2d_with_index(x, 2)
+        np.testing.assert_allclose(np.asarray(out[0, 0]),
+                                   [[5, 7], [13, 15]])
+        restored = O.unpool2d(out, idx, (4, 4))
+        assert float(restored[0, 0, 1, 1]) == 5.0
+        assert float(restored[0, 0, 0, 0]) == 0.0
+
+    def test_pool_with_index_padding_coords(self):
+        """indices must be in ORIGINAL image coords even with padding."""
+        x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        out, idx = O.max_pool2d_with_index(x, 2, stride=2, padding=1)
+        assert int(idx.max()) <= 15
+        restored = O.unpool2d(out, idx, (4, 4))
+        # the global max (15 at position (3,3)) must survive the roundtrip
+        assert float(restored[0, 0, 3, 3]) == 15.0
+
+    def test_hierarchical_sigmoid_non_pow2(self):
+        """num_classes=3 (non-power-of-two): shallow leaves must not walk
+        past the root and pick up spurious terms."""
+        x = jnp.ones((1, 4))
+        w = jnp.zeros((2, 4))
+        b = jnp.asarray([0.0, -100.0])
+        # label 0 -> leaf node 3: single step through internal node 1
+        loss = O.hierarchical_sigmoid(x, w, b, jnp.asarray([0]), 3)
+        assert float(loss[0]) == pytest.approx(np.log(2.0), rel=1e-4)
+
+    def test_squared_l2_distance(self):
+        x = jnp.ones((2, 3))
+        y = jnp.zeros((2, 3))
+        np.testing.assert_allclose(
+            np.asarray(O.squared_l2_distance(x, y)), [[3.0], [3.0]])
+
+    def test_hash_ids_stable_and_bounded(self):
+        ids = jnp.asarray([1, 2, 3, 1000000], jnp.int32)
+        h1 = O.hash_embedding_ids(ids, mod=97)
+        h2 = O.hash_embedding_ids(ids, mod=97)
+        np.testing.assert_array_equal(np.asarray(h1), np.asarray(h2))
+        assert (np.asarray(h1) >= 0).all() and (np.asarray(h1) < 97).all()
+
+    def test_cvm(self):
+        x = jnp.asarray([[3.0, 1.0, 5.0, 6.0]])
+        out = np.asarray(O.cvm(x, use_cvm=True))
+        np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-5)
+        assert out.shape == (1, 4)
+        assert O.cvm(x, use_cvm=False).shape == (1, 2)
+
+    def test_nce_finite_and_positive(self):
+        rng = np.random.RandomState(6)
+        x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+        w = jnp.asarray(rng.randn(20, 8), jnp.float32)
+        b = jnp.zeros(20, jnp.float32)
+        loss = O.nce(x, w, b, jnp.asarray([1, 2, 3, 4]),
+                     jnp.asarray([7, 8, 9]), 20)
+        assert loss.shape == (4,)
+        assert np.isfinite(np.asarray(loss)).all()
+        assert (np.asarray(loss) > 0).all()
+
+    def test_hierarchical_sigmoid_grad(self):
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+        w = jnp.asarray(rng.randn(16, 8), jnp.float32)
+        b = jnp.zeros(16, jnp.float32)
+        labels = jnp.asarray([0, 3, 7, 11])
+
+        def loss(w):
+            return jnp.mean(O.hierarchical_sigmoid(x, w, b, labels, 12))
+        val, g = jax.value_and_grad(loss)(w)
+        assert np.isfinite(float(val)) and float(val) > 0
+        assert np.isfinite(np.asarray(g)).all()
+
+    def test_units(self):
+        h = jnp.zeros((2, 4))
+        c = jnp.zeros((2, 4))
+        hn, cn = O.lstm_unit(jnp.ones((2, 16)), h, c)
+        assert hn.shape == (2, 4) and np.isfinite(np.asarray(hn)).all()
+        g = O.gru_unit(jnp.ones((2, 12)), h, jnp.zeros((4, 8)),
+                       jnp.zeros((4, 4)))
+        assert g.shape == (2, 4)
+
+    def test_aliases(self):
+        assert float(O.sum([jnp.ones(2), jnp.ones(2)])[0]) == 2.0
+        v, i = O.top_k(jnp.asarray([1.0, 3.0, 2.0]), 2)
+        assert list(np.asarray(i)) == [1, 2]
+        assert int(O.arg_max(jnp.asarray([1.0, 5.0, 2.0]))) == 1
+        tab = jnp.asarray(np.eye(4, 3), jnp.float32)
+        out = O.lookup_table(jnp.asarray([1, 1, 2]), tab)
+        assert out.shape == (3, 3)
+
+
+class TestTensorArray:
+    def test_write_read_stack(self):
+        ta = O.create_array(4, (2,))
+        ta = O.array_write(ta, 0, jnp.asarray([1.0, 2.0]))
+        ta = O.array_write(ta, 1, jnp.asarray([3.0, 4.0]))
+        assert int(O.array_length(ta)) == 2
+        np.testing.assert_allclose(np.asarray(O.array_read(ta, 1)),
+                                   [3, 4])
+        assert O.tensor_array_to_tensor(ta).shape == (2, 2)
+
+    def test_tensorarray_in_scan(self):
+        def body(ta, i):
+            return O.array_write(ta, i, jnp.full((3,), i, jnp.float32)), i
+
+        ta = O.create_array(5, (3,))
+        ta, _ = jax.lax.scan(body, ta, jnp.arange(5))
+        np.testing.assert_allclose(np.asarray(ta.buffer[:, 0]),
+                                   np.arange(5.0))
+
+    def test_lod_array_roundtrip(self):
+        rb = RaggedBatch.from_list(
+            [[1.0, 2.0, 3.0], [4.0], [5.0, 6.0]])
+        steps, order, lens = O.lod_tensor_to_array(rb)
+        assert [s.shape[0] for s in steps] == [3, 2, 1]
+        back = O.array_to_lod_tensor(steps, order, lens)
+        np.testing.assert_allclose(np.asarray(back.lengths),
+                                   np.asarray(rb.lengths))
+        np.testing.assert_allclose(np.asarray(back.data),
+                                   np.asarray(rb.data))
+
+    def test_rank_table_and_shrink(self):
+        rb = RaggedBatch.from_list([[1.0], [2.0, 3.0], [4.0, 5.0, 6.0]])
+        rt = O.lod_rank_table(rb)
+        assert rt[0][1] == 3 and O.max_sequence_len(rt) == 3
+        mem = jnp.zeros((3, 4))
+        assert O.shrink_rnn_memory(mem, rt, step=1).shape[0] == 2
+        assert O.shrink_rnn_memory(mem, rt, step=2).shape[0] == 1
+
+    def test_split_merge_lod_tensor(self):
+        x = jnp.arange(12.0).reshape(4, 3)
+        t, f, restore = O.split_lod_tensor(x, [True, False, True, False])
+        assert t.shape == (2, 3) and f.shape == (2, 3)
+        merged = O.merge_lod_tensor(t, f, restore)
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(x))
+
+
+class TestSelectedRows:
+    def test_merge_and_densify(self):
+        sr = O.SelectedRows(jnp.asarray([1, 3, 1]),
+                            jnp.ones((3, 2)), height=5)
+        merged, valid = O.merge_selected_rows(sr)
+        dense = O.get_tensor_from_selected_rows(merged)
+        d = np.asarray(dense)
+        np.testing.assert_allclose(d[1], 2.0)
+        np.testing.assert_allclose(d[3], 1.0)
+        np.testing.assert_allclose(d[0], 0.0)
+
+    def test_split(self):
+        sr = O.SelectedRows(jnp.asarray([0, 2, 7, 9]),
+                            jnp.ones((4, 2)), height=10)
+        parts = O.split_selected_rows(sr, 2)
+        assert len(parts) == 2
+        assert list(np.asarray(parts[0].rows)) == [0, 2]
+        assert list(np.asarray(parts[1].rows)) == [2, 4]
+
+    def test_sparse_sgd(self):
+        p = jnp.ones((5, 2))
+        sr = O.SelectedRows(jnp.asarray([1, 1]), jnp.ones((2, 2)), 5)
+        out = O.sparse_sgd_update(p, sr, lr=0.5)
+        np.testing.assert_allclose(np.asarray(out[1]), 0.0)
+        np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+
+    def test_lookup_sparse_table_grows(self):
+        table = {}
+        out = O.lookup_sparse_table(table, [5, 5, 9], dim=4)
+        assert out.shape == (3, 4)
+        assert set(table) == {5, 9}
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   np.asarray(out[1]))
+
+
+class TestHostOps:
+    def test_print_passthrough_static(self, capfd):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[3], dtype="float32")
+                y = pt.layers.Print(x, message="dbg")
+                z = pt.layers.scale(y, scale=2.0)
+                exe = pt.static.Executor(pt.CPUPlace())
+                out = exe.run(main, feed={"x": np.ones((2, 3),
+                                                       np.float32)},
+                              fetch_list=[z.name])
+            np.testing.assert_allclose(out[0], 2.0)
+            assert "dbg" in capfd.readouterr().err
+        finally:
+            pt.disable_static()
+
+    def test_print_inside_trained_network_keeps_grads(self):
+        """Print is a device op (jax.debug.callback): inserting it
+        mid-network must not stop upstream layers from training."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                h = pt.layers.fc(x, size=3)
+                first_w = main.global_block().all_parameters()[0].name
+                h = pt.layers.Print(h, message="mid", first_n=1)
+                pred = pt.layers.fc(h, size=1)
+                loss = pt.layers.mean(
+                    pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+                scope = pt.static.Scope()
+                with pt.static.scope_guard(scope):
+                    exe = pt.static.Executor(pt.CPUPlace())
+                    exe.run(startup)
+                    w0 = np.asarray(scope.find_var(first_w)).copy()
+                    feed = {"x": np.random.RandomState(0).rand(8, 4)
+                            .astype(np.float32),
+                            "y": np.ones((8, 1), np.float32)}
+                    for _ in range(2):
+                        exe.run(main, feed=feed, fetch_list=[loss.name])
+                    w1 = np.asarray(scope.find_var(first_w))
+            assert not np.allclose(w0, w1), \
+                "first fc stopped training after Print"
+        finally:
+            pt.disable_static()
+
+    def test_py_func_mid_forward_raises(self):
+        """A host op inside the differentiated prefix must be refused
+        loudly (it would silently zero upstream grads)."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                h = pt.layers.fc(x, size=3)
+                hv = main.global_block().create_var(
+                    shape=(-1, 3), dtype="float32")
+                h = pt.layers.py_func(lambda a: np.asarray(a), h, hv)
+                pred = pt.layers.fc(h, size=1)
+                loss = pt.layers.mean(
+                    pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                with pytest.raises(Exception, match="host op"):
+                    exe.run(main, feed={"x": np.ones((2, 4), np.float32),
+                                        "y": np.ones((2, 1), np.float32)},
+                            fetch_list=[loss.name])
+        finally:
+            pt.disable_static()
+
+    def test_py_func_static(self):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[3], dtype="float32")
+                out_var = main.global_block().create_var(
+                    shape=(-1, 3), dtype="float32")
+                y = pt.layers.py_func(
+                    lambda a: np.asarray(a) * 3.0, x, out_var)
+                z = pt.layers.scale(y, scale=1.0)
+                exe = pt.static.Executor(pt.CPUPlace())
+                out = exe.run(main, feed={"x": np.ones((2, 3),
+                                                       np.float32)},
+                              fetch_list=[z.name])
+            np.testing.assert_allclose(out[0], 3.0)
+        finally:
+            pt.disable_static()

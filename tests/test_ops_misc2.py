@@ -1,0 +1,191 @@
+"""Tests: metrics tail (chunk_eval/precision_recall/pnpair),
+deformable_conv, average_accumulates, generic beam_search op, DLPack,
+AsyncExecutor facade."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import ops as O
+from paddle_tpu.core import dlpack
+
+
+class TestMetricsTail:
+    def test_precision_recall_perfect(self):
+        scores = jnp.asarray([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+        per, macro = O.precision_recall(scores, jnp.asarray([0, 1, 0]), 2)
+        assert macro[0] == pytest.approx(1.0, abs=1e-6)
+        assert macro[1] == pytest.approx(1.0, abs=1e-6)
+
+    def test_chunk_eval_iob(self):
+        # tags: type*2 + pos, IOB (B=0, I=1); one type
+        # gold:  B I O B   -> chunks (0,1) (3,3)
+        # pred:  B I O O   -> chunk  (0,1)
+        gold = [0, 1, -1, 0]
+        pred = [0, 1, -1, -1]
+        p, r, f1, ni, nl, nc = O.chunk_eval(pred, gold, "IOB")
+        assert (ni, nl, nc) == (1, 2, 1)
+        assert p == pytest.approx(1.0, abs=1e-6)
+        assert r == pytest.approx(0.5, abs=1e-6)
+
+    def test_chunk_eval_outside_tag(self):
+        """Paddle encoding: tag >= num_chunk_types*width is 'O' — an
+        all-O sequence has zero chunks, not perfect F1."""
+        seq = [6, 6, 6, 6]           # 3 types, IOB: O tag = 6
+        p, r, f1, ni, nl, nc = O.chunk_eval(seq, seq, "IOB",
+                                            num_chunk_types=3)
+        assert (ni, nl, nc) == (0, 0, 0)
+        assert f1 == pytest.approx(0.0, abs=1e-6)
+        # O splits chunks: B I O I -> (0,1) and stray-I chunk (3,3)
+        gold = [0, 1, 6, 1]
+        p, r, f1, ni, nl, nc = O.chunk_eval(gold, gold, "IOB",
+                                            num_chunk_types=3)
+        assert ni == nl == nc == 2
+
+    def test_chunk_eval_iobes_singleton(self):
+        # IOBES: B,I,E,S = 0..3; S at pos0, B-I-E chunk at 1..3
+        seq = [3, 0, 1, 2]
+        p, r, f1, ni, nl, nc = O.chunk_eval(seq, seq, "IOBES")
+        assert ni == nl == nc == 2
+        assert f1 == pytest.approx(1.0, abs=1e-6)
+
+    def test_positive_negative_pair(self):
+        score = [0.9, 0.1, 0.3, 0.7]
+        label = [1, 0, 0, 1]
+        qid = [0, 0, 1, 1]
+        pos, neg, neu = O.positive_negative_pair(score, label, qid)
+        assert (pos, neg, neu) == (2, 0, 0)
+
+
+class TestDeformableConv:
+    def test_zero_offset_matches_conv(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(1, 2, 5, 5), jnp.float32)
+        w = jnp.asarray(rng.rand(3, 2, 3, 3), jnp.float32)
+        off = jnp.zeros((1, 2 * 9, 3, 3), jnp.float32)
+        out = O.deformable_conv(x, off, w)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4)
+
+    def test_groups2_zero_offset_matches_conv(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.rand(1, 4, 5, 5), jnp.float32)
+        w = jnp.asarray(rng.rand(3, 4, 3, 3), jnp.float32)
+        off = jnp.zeros((1, 2 * 2 * 9, 3, 3), jnp.float32)
+        out = O.deformable_conv(x, off, w, deformable_groups=2)
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4)
+
+    def test_modulated_mask_scales(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.rand(1, 2, 5, 5), jnp.float32)
+        w = jnp.asarray(rng.rand(3, 2, 3, 3), jnp.float32)
+        off = jnp.zeros((1, 18, 3, 3), jnp.float32)
+        mask = jnp.full((1, 9, 3, 3), 0.5, jnp.float32)
+        out_half = O.deformable_conv(x, off, w, mask=mask)
+        out_full = O.deformable_conv(x, off, w)
+        np.testing.assert_allclose(np.asarray(out_half),
+                                   0.5 * np.asarray(out_full), rtol=1e-4)
+
+
+class TestAverageAccumulates:
+    def test_window_roll(self):
+        p = jnp.ones(3)
+        s1 = s2 = s3 = jnp.zeros(3)
+        na = jnp.asarray(0)
+        ona = jnp.asarray(0)
+        nu = jnp.asarray(0)
+        for _ in range(4):
+            s1, s2, s3, na, ona, nu = O.average_accumulates(
+                p, s1, s2, s3, na, ona, nu,
+                average_window=2, max_average_window=100)
+        # window of 2: after 4 updates, two rolls happened
+        assert int(nu) == 4
+        np.testing.assert_allclose(np.asarray(s2), 4.0)
+        np.testing.assert_allclose(np.asarray(s1), 0.0)
+
+
+class TestBeamSearchOp:
+    def test_topk_and_parent_tracking(self):
+        beam = 2
+        # batch=1, two beams with scores 0 and -1; vocab 3
+        logp = jnp.log(jnp.asarray([[0.1, 0.6, 0.3],
+                                    [0.3, 0.3, 0.4]], jnp.float32))
+        pre_scores = jnp.asarray([0.0, -1.0])
+        pre_ids = jnp.asarray([[5], [6]])
+        ids, scores, parent = O.beam_search(logp, pre_scores, pre_ids,
+                                            beam)
+        assert ids.shape == (2, 2)
+        # best continuation comes from beam 0 token 1
+        assert list(np.asarray(ids[0])) == [5, 1]
+        assert int(parent[0]) == 0
+        assert float(scores[0]) == pytest.approx(np.log(0.6), rel=1e-5)
+
+    def test_finished_beam_frozen(self):
+        beam = 2
+        logp = jnp.zeros((2, 3), jnp.float32)
+        pre_scores = jnp.asarray([0.0, -5.0])
+        pre_ids = jnp.asarray([[2], [0]])     # beam 0 ended (end_token=2)
+        ids, scores, parent = O.beam_search(
+            logp, pre_scores, pre_ids, beam, end_token=2)
+        # frozen beam keeps score 0 and re-emits end token
+        assert float(scores[0]) == pytest.approx(0.0, abs=1e-6)
+        assert int(ids[0, -1]) == 2
+
+
+class TestDLPack:
+    def test_roundtrip(self):
+        a = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+        b = dlpack.from_dlpack(a)      # __dlpack__ path
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a))
+
+    def test_torch_interop(self):
+        torch = pytest.importorskip("torch")
+        t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        j = dlpack.from_dlpack(t)
+        np.testing.assert_allclose(np.asarray(j),
+                                   t.numpy())
+
+
+class TestAsyncExecutor:
+    def test_run_from_files(self, tmp_path):
+        from paddle_tpu.dataio import DatasetFactory
+        files = []
+        rng = np.random.RandomState(0)
+        w = np.linspace(-0.5, 0.5, 4)
+        for i in range(2):
+            p = tmp_path / f"f{i}"
+            with open(p, "w") as f:
+                for _ in range(16):
+                    x = rng.rand(4)
+                    f.write("4 " + " ".join(f"{v:.5f}" for v in x)
+                            + f" 1 {float(x @ w):.5f}\n")
+            files.append(str(p))
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                loss = pt.layers.mean(pt.layers.square_error_cost(
+                    pt.layers.fc(x, size=1), y))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                ds = DatasetFactory().create_dataset("QueueDataset")
+                ds.set_batch_size(8)
+                ds.set_use_var([x, y])
+                ae = pt.static.AsyncExecutor(pt.CPUPlace())
+                out = ae.run_from_files(main, ds, files, 2,
+                                        fetch=[loss])
+            assert out and np.isfinite(float(np.asarray(out[0])))
+        finally:
+            pt.disable_static()
