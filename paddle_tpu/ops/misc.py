@@ -543,3 +543,120 @@ def beam_search(log_probs, pre_scores, pre_ids, beam_size,
 
 
 __all__ += ["deformable_conv", "average_accumulates", "beam_search"]
+
+
+def conv2d_fusion(x, weight, bias=None, residual=None, stride=1,
+                  padding=0, dilation=1, groups=1, act="relu"):
+    """operators/conv_fusion_op.cc: conv + bias + (optional residual
+    add) + activation in one op. On TPU the fusion is XLA's job — this
+    exists so fused-graph programs from the reference map one-to-one;
+    the compiler emits the same fused kernel either way."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    out = jax.lax.conv_general_dilated(
+        x, weight, s, [(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    if residual is not None:
+        out = out + residual
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "identity" or act is None:
+        pass
+    else:
+        out = getattr(jax.nn, act)(out)
+    return out
+
+
+def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
+                             pooled_size, part_size=None, spatial_scale=1.0,
+                             sample_per_part=4, trans_std=0.1,
+                             roi_batch_indices=None):
+    """operators/deformable_psroi_pooling_op.cc: position-sensitive RoI
+    pooling with learned per-part offsets (Deformable R-FCN).
+
+    x [N, C, H, W] with C = output_channels*group^2 laid out
+    channel-major like the sibling detection.psroi_pool
+    (channel = (ctop*g + gi)*g + gj); rois [R, 5] (batch_idx, x1, y1,
+    x2, y2) or [R, 4] + roi_batch_indices; trans [R, 2, part, part]
+    (dy, dx planes) or None for the plain PS-RoI case. Fully traceable
+    (vmap over RoIs); samples are BILINEAR so gradients flow into the
+    offsets, out-of-image samples are dropped like the reference kernel.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    rois = jnp.asarray(rois, jnp.float32)
+    n, c, h, w = x.shape
+    k = int(pooled_size)
+    g = int(group_size)
+    oc = int(output_channels)
+    part = int(part_size or k)
+    sp = int(sample_per_part)
+    enforce(c == oc * g * g, "channel/group mismatch")
+    if rois.shape[1] == 5:
+        bidx = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    else:
+        bidx = (jnp.zeros(rois.shape[0], jnp.int32)
+                if roi_batch_indices is None
+                else jnp.asarray(roi_batch_indices, jnp.int32))
+        boxes = rois
+    feat = x.reshape(n, oc, g, g, h, w)
+
+    ii, jj = jnp.meshgrid(jnp.arange(k), jnp.arange(k), indexing="ij")
+    gi = jnp.clip(ii * g // k, 0, g - 1)            # [k,k] channel group
+    gj = jnp.clip(jj * g // k, 0, g - 1)
+    pi = jnp.clip(ii * part // k, 0, part - 1)      # [k,k] offset part
+    pj = jnp.clip(jj * part // k, 0, part - 1)
+    su = (jnp.arange(sp) + 0.5) / sp                # sub-bin sample frac
+
+    def one(box, bi, tr):
+        x1 = box[0] * spatial_scale
+        y1 = box[1] * spatial_scale
+        rw = jnp.maximum((box[2] - box[0]) * spatial_scale, 0.1)
+        rh = jnp.maximum((box[3] - box[1]) * spatial_scale, 0.1)
+        bin_h = rh / k
+        bin_w = rw / k
+        if tr is not None:
+            dy = tr[0, pi, pj] * trans_std * rh     # [k,k]
+            dx = tr[1, pi, pj] * trans_std * rw
+        else:
+            dy = dx = jnp.zeros((k, k), jnp.float32)
+        # sample coords [k,k,sp,sp]
+        ys = (y1 + dy)[..., None, None] \
+            + (ii[..., None, None] + su[None, None, :, None]) \
+            * bin_h
+        xs = (x1 + dx)[..., None, None] \
+            + (jj[..., None, None] + su[None, None, None, :]) \
+            * bin_w
+        inside = ((ys >= 0) & (ys <= h - 1) & (xs >= 0) & (xs <= w - 1))
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+        fmap = feat[bi]                               # [oc,g,g,h,w]
+        GI = gi[:, :, None, None]
+        GJ = gj[:, :, None, None]
+
+        def gat(yy, xx):
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            return fmap[:, GI, GJ, yc, xc]            # [oc,k,k,sp,sp]
+
+        val = (gat(y0, x0) * ((1 - wy) * (1 - wx))
+               + gat(y0, x0 + 1) * ((1 - wy) * wx)
+               + gat(y0 + 1, x0) * (wy * (1 - wx))
+               + gat(y0 + 1, x0 + 1) * (wy * wx))
+        val = val * inside.astype(jnp.float32)
+        cnt = jnp.maximum(inside.sum(axis=(-1, -2)), 1.0)  # [k,k]
+        return val.sum(axis=(-1, -2)) / cnt               # [oc,k,k]
+
+    if trans is None:
+        return jax.vmap(lambda b, bi: one(b, bi, None))(boxes, bidx)
+    tr = jnp.asarray(trans, jnp.float32).reshape(-1, 2, part, part)
+    return jax.vmap(one)(boxes, bidx, tr)
+
+
+__all__ += ["conv2d_fusion", "deformable_psroi_pooling"]

@@ -182,3 +182,8 @@ def shrink_rnn_memory(mem, rank_table, step):
     alive at timestep ``step`` (rank-table-ordered memory)."""
     alive = sum(1 for _, ln in rank_table if ln > step)
     return mem[:alive]
+
+
+# reference op-name alias (lod_array_length_op.cc)
+lod_array_length = array_length
+__all__.append("lod_array_length")

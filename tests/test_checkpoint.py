@@ -1,0 +1,92 @@
+"""Async checkpoint/resume tests (SURVEY §5.3/§5.4 — the elastic loop)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.io_checkpoint import CheckpointManager, auto_checkpoint
+
+
+def _state(v):
+    return {"w": jnp.full((4,), float(v)), "step": jnp.asarray(v)}
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        mgr.save(3, _state(3))
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        tree, step = mgr.restore()
+        assert step == 3
+        np.testing.assert_allclose(np.asarray(tree["w"]), 3.0)
+        mgr.close()
+
+    def test_keep_max_prunes(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_max=2,
+                                save_interval_steps=1)
+        for s in range(5):
+            mgr.save(s, _state(s))
+        mgr.wait()
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".json")]
+        assert len(files) == 2
+        assert mgr.latest_step() == 4
+        mgr.close()
+
+    def test_restore_survives_new_manager(self, tmp_path):
+        m1 = CheckpointManager(str(tmp_path), save_interval_steps=1)
+        m1.save(7, _state(7))
+        m1.close()
+        m2 = CheckpointManager(str(tmp_path))
+        tree, step = m2.restore()
+        assert step == 7 and float(tree["w"][0]) == 7.0
+        m2.close()
+
+    def test_interval_policy(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), save_interval_steps=5)
+        assert mgr.should_save(0) and mgr.should_save(5)
+        assert not mgr.should_save(3)
+        mgr.close()
+
+    def test_sync_mode(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False,
+                                save_interval_steps=1)
+        mgr.save(1, _state(1))
+        assert mgr.latest_step() == 1
+        mgr.close()
+
+
+class TestAutoCheckpoint:
+    def test_full_run(self, tmp_path):
+        out = auto_checkpoint(
+            str(tmp_path), lambda: _state(0), 10,
+            lambda step, st: {"w": st["w"] + 1.0,
+                              "step": jnp.asarray(step)},
+            save_interval_steps=3)
+        np.testing.assert_allclose(np.asarray(out["w"]), 10.0)
+
+    def test_resume_after_crash(self, tmp_path):
+        calls = []
+
+        def crashing_step(step, st):
+            calls.append(step)
+            if step == 6 and len([c for c in calls if c == 6]) == 1:
+                raise RuntimeError("preempted")
+            return {"w": st["w"] + 1.0, "step": jnp.asarray(step)}
+
+        with pytest.raises(RuntimeError):
+            auto_checkpoint(str(tmp_path), lambda: _state(0), 10,
+                            crashing_step, save_interval_steps=2)
+        # resume: must restart from the last completed interval, not 0
+        calls2 = []
+
+        def step2(step, st):
+            calls2.append(step)
+            return {"w": st["w"] + 1.0, "step": jnp.asarray(step)}
+
+        out = auto_checkpoint(str(tmp_path), lambda: _state(0), 10,
+                              step2, save_interval_steps=2)
+        assert calls2[0] > 0, "resumed from scratch"
+        np.testing.assert_allclose(np.asarray(out["w"]), 10.0)

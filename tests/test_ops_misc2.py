@@ -84,6 +84,28 @@ class TestDeformableConv:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-4)
 
+    def test_deformable_psroi_traceable_and_grads(self):
+        rng = np.random.RandomState(5)
+        oc, g, k = 2, 2, 2
+        x = jnp.asarray(rng.rand(1, oc * g * g, 8, 8), jnp.float32)
+        rois = jnp.asarray([[0, 1.0, 1.0, 6.0, 6.0]], jnp.float32)
+        trans = jnp.zeros((1, 2, k, k), jnp.float32)
+
+        f = jax.jit(lambda t: O.deformable_psroi_pooling(
+            x, rois, t, oc, g, k).sum())
+        val = f(trans)                      # jit-traceable
+        assert np.isfinite(float(val))
+        grad = jax.grad(f)(trans)           # bilinear -> offsets train
+        assert float(jnp.abs(grad).sum()) > 0
+
+    def test_deformable_psroi_constant_input(self):
+        oc, g, k = 1, 2, 2
+        x = jnp.full((1, oc * g * g, 8, 8), 3.0, jnp.float32)
+        rois = jnp.asarray([[0, 1.0, 1.0, 6.0, 6.0]], jnp.float32)
+        out = O.deformable_psroi_pooling(x, rois, None, oc, g, k)
+        assert out.shape == (1, oc, k, k)
+        np.testing.assert_allclose(np.asarray(out), 3.0, rtol=1e-5)
+
     def test_modulated_mask_scales(self):
         rng = np.random.RandomState(1)
         x = jnp.asarray(rng.rand(1, 2, 5, 5), jnp.float32)
