@@ -11,6 +11,8 @@ import os
 import sys
 import time
 
+import numpy as np
+
 
 def bench_resnet50():
     """Secondary benchmark (`python bench.py resnet50`): ResNet-50
@@ -38,13 +40,18 @@ def bench_resnet50():
     imgs = jax.device_put(imgs, dsh)
     labels = jax.device_put(labels, dsh)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
+    # warmup + settle; sync by host fetch (see main() for why)
     loss, acc, params, opt_state = step_fn(params, opt_state, imgs, labels)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))
+    for _ in range(3):
+        loss, acc, params, opt_state = step_fn(params, opt_state, imgs,
+                                               labels)
+    float(np.asarray(loss))
     t0 = time.perf_counter()
     for _ in range(steps):
         loss, acc, params, opt_state = step_fn(params, opt_state, imgs,
                                                labels)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))
     dt = time.perf_counter() - t0
     img_per_sec = batch * steps / dt
     peak = 197e12
@@ -76,7 +83,10 @@ def main():
     attn = os.environ.get("BENCH_ATTN", "dense")
     cfg = (bert.bert_base(attention_impl=attn) if on_tpu
            else bert.bert_tiny(attention_impl=attn))
-    batch, seq = (32, 512) if on_tpu else (2, 32)
+    # batch=64 is the tuned single-chip config (highest measured MFU of
+    # {32, 64, 96}); vs_baseline is MFU-based, so it stays comparable
+    # across batch choices
+    batch, seq = (64, 512) if on_tpu else (2, 32)
     steps = 20 if on_tpu else 3
 
     # single-chip benchmark: pin a 1-device mesh whatever the platform
@@ -87,14 +97,21 @@ def main():
     data = bert.synthetic_batch(cfg, batch_size=batch, seq_len=seq)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
 
-    # warmup/compile
+    # warmup/compile; the end-of-region sync is a HOST FETCH of the loss
+    # (the step chain's tail). On the experimental remote-PJRT plugin
+    # this repo benches against, a bare block_until_ready measurably
+    # returned before queued dispatches executed (2 ms/step reported
+    # for a 166 ms/step program); fetching the value cannot lie
     loss, params, opt_state = step_fn(params, opt_state, data)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))
+    for _ in range(3):                       # settle the dispatch pipeline
+        loss, params, opt_state = step_fn(params, opt_state, data)
+    float(np.asarray(loss))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss, params, opt_state = step_fn(params, opt_state, data)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))
     dt = time.perf_counter() - t0
 
     tokens = batch * seq * steps

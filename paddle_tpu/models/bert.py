@@ -266,7 +266,9 @@ def mlm_loss(params, cfg, batch, mesh=None):
         + m["dense_b"].astype(hidden.dtype)
     h = jax.nn.gelu(h, approximate=True)
     h = _layer_norm(h, m["ln_g"], m["ln_b"])
-    # tied output embedding (fp32 logits for a stable softmax)
+    # tied output embedding (fp32 logits for a stable softmax; measured
+    # faster than bf16-in/f32-accum dot_general on this chip — XLA's
+    # fp32 path wins for this [BS,768]x[768,30522] shape)
     logits = (h.astype(jnp.float32)
               @ params["embed"]["word"].T.astype(jnp.float32)
               + m["bias"])

@@ -77,7 +77,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
-        b_blk = bias_ref[0, pl.ds(jk * block_k, block_k)] \
+        b_blk = bias_ref[0, 0, pl.ds(jk * block_k, block_k)] \
             .astype(jnp.float32)                           # [bk]
         s = s + b_blk[None, :]
         if causal:
@@ -109,7 +109,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
     m, l, acc = lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    lse_ref[0, 0, :, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
 
 
 def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k,
@@ -121,26 +121,31 @@ def _flash_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k,
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, block_k=block_k,
         causal=causal, seq_len=s, block_q=block_q)
-    o, lse = pl.pallas_call(
+    # Mosaic tiling constraint: a block's last two dims must be
+    # (8k, 128k)-divisible or equal to the array's — so the per-batch
+    # bias rides as [B, 1, S] (block (1, 1, S)) and lse as [B, H, S, 1]
+    # (block (1, 1, bq, 1)), both satisfying the "equal dimension" rule.
+    o, lse4 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             _vmem_spec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
             _vmem_spec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
             _vmem_spec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
-            _vmem_spec((1, s), lambda ib, ih, iq: (ib, 0)),
+            _vmem_spec((1, 1, s), lambda ib, ih, iq: (ib, 0, 0)),
         ],
         out_specs=[
             _vmem_spec((1, 1, block_q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            _vmem_spec((1, 1, block_q), lambda ib, ih, iq: (ib, ih, iq)),
+            _vmem_spec((1, 1, block_q, 1),
+                       lambda ib, ih, iq: (ib, ih, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, bias)
-    return o, lse
+    )(q, k, v, bias[:, None, :])
+    return o, lse4[..., 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
