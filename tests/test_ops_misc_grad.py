@@ -1,0 +1,138 @@
+"""Numeric-vs-analytic gradient checks for the long-tail op families
+(the reference's universal OpTest bar, SURVEY §4; harness op_test.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import ops as O
+from tests.op_test import check_grad
+
+rng = np.random.RandomState(0)
+
+
+class TestMiscGrads:
+    def test_add_position_encoding(self):
+        check_grad(lambda x: O.add_position_encoding(x),
+                   [rng.rand(2, 4, 8).astype(np.float32)])
+
+    def test_bilinear_tensor_product(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 5).astype(np.float32)
+        w = rng.rand(2, 4, 5).astype(np.float32)
+        check_grad(O.bilinear_tensor_product, [x, y, w], wrt=0)
+        check_grad(O.bilinear_tensor_product, [x, y, w], wrt=2)
+
+    def test_conv_shift(self):
+        x = rng.rand(2, 6).astype(np.float32)
+        y = rng.rand(2, 3).astype(np.float32)
+        check_grad(O.conv_shift, [x, y], wrt=0)
+        check_grad(O.conv_shift, [x, y], wrt=1)
+
+    def test_row_conv(self):
+        x = rng.rand(2, 5, 3).astype(np.float32)
+        w = rng.rand(2, 3).astype(np.float32)
+        check_grad(O.row_conv, [x, w], wrt=0)
+        check_grad(O.row_conv, [x, w], wrt=1)
+
+    def test_grid_sampler(self):
+        x = rng.rand(1, 2, 5, 5).astype(np.float32)
+        # keep grid interior so bilinear is smooth at test points
+        grid = (rng.rand(1, 3, 3, 2).astype(np.float32) - 0.5) * 1.2
+        check_grad(O.grid_sampler, [x, grid], wrt=0)
+        check_grad(O.grid_sampler, [x, grid], wrt=1, rtol=3e-2)
+
+    def test_squared_l2_distance(self):
+        x = rng.rand(3, 4).astype(np.float32)
+        y = rng.rand(3, 4).astype(np.float32)
+        check_grad(O.squared_l2_distance, [x, y], wrt=0)
+
+    def test_nce(self):
+        x = rng.rand(3, 6).astype(np.float32)
+        w = rng.rand(10, 6).astype(np.float32)
+        b = rng.rand(10).astype(np.float32)
+        lab = np.asarray([1, 2, 3])
+        sam = np.asarray([5, 6])
+        f = lambda x_, w_, b_: O.nce(x_, w_, b_, jnp.asarray(lab),
+                                     jnp.asarray(sam), 10)
+        check_grad(f, [x, w, b], wrt=0)
+        check_grad(f, [x, w, b], wrt=1)
+
+    def test_hierarchical_sigmoid(self):
+        x = rng.rand(3, 5).astype(np.float32)
+        w = rng.rand(8, 5).astype(np.float32)
+        b = rng.rand(8).astype(np.float32)
+        f = lambda x_, w_: O.hierarchical_sigmoid(
+            x_, w_, jnp.asarray(b), jnp.asarray([0, 2, 4]), 6)
+        check_grad(f, [x, w], wrt=0)
+        check_grad(f, [x, w], wrt=1)
+
+    def test_tree_conv(self):
+        nodes = rng.rand(1, 4, 3).astype(np.float32)
+        edges = (rng.rand(1, 4, 4) > 0.5).astype(np.float32)
+        w = rng.rand(2, 3, 5).astype(np.float32)
+        check_grad(O.tree_conv, [nodes, edges, w], wrt=0)
+        check_grad(O.tree_conv, [nodes, edges, w], wrt=2)
+
+    def test_temporal_shift(self):
+        x = rng.rand(4, 8, 2, 2).astype(np.float32)
+        check_grad(lambda a: O.temporal_shift(a, seg_num=2), [x])
+
+    def test_deformable_conv(self):
+        x = rng.rand(1, 2, 5, 5).astype(np.float32)
+        # keep sample points strictly fractional: bilinear interpolation
+        # has kinks at integer coords where finite differences disagree
+        # with the (one-sided) analytic derivative
+        off = (rng.rand(1, 18, 3, 3).astype(np.float32) * 0.2 + 0.3)
+        w = rng.rand(2, 2, 3, 3).astype(np.float32)
+        check_grad(O.deformable_conv, [x, off, w], wrt=2)
+        check_grad(O.deformable_conv, [x, off, w], wrt=1, rtol=3e-2,
+                   atol=3e-3)
+
+    def test_deformable_psroi(self):
+        x = rng.rand(1, 4, 8, 8).astype(np.float32)
+        rois = np.asarray([[0, 1.0, 1.0, 6.0, 6.0]], np.float32)
+        tr = (rng.rand(1, 2, 2, 2).astype(np.float32) - 0.5) * 0.2
+        f = lambda x_, t_: O.deformable_psroi_pooling(
+            x_, jnp.asarray(rois), t_, 1, 2, 2)
+        check_grad(f, [x, tr], wrt=0, rtol=3e-2, atol=3e-3)
+        check_grad(f, [x, tr], wrt=1, rtol=3e-2, atol=3e-3)
+
+    def test_spectral_norm_weight_grad(self):
+        w = rng.rand(4, 3).astype(np.float32)
+        u = rng.rand(4).astype(np.float32)
+        f = lambda w_: O.spectral_norm(w_, jnp.asarray(u),
+                                       power_iters=3)[0]
+        check_grad(f, [w], rtol=3e-2, atol=3e-3)
+
+    def test_fsp_matrix(self):
+        a = rng.rand(2, 3, 4, 4).astype(np.float32)
+        b = rng.rand(2, 2, 4, 4).astype(np.float32)
+        check_grad(O.fsp_matrix, [a, b], wrt=0)
+        check_grad(O.fsp_matrix, [a, b], wrt=1)
+
+    def test_conv2d_fusion(self):
+        x = rng.rand(1, 2, 5, 5).astype(np.float32)
+        w = rng.rand(3, 2, 3, 3).astype(np.float32)
+        bias = rng.rand(3).astype(np.float32)
+        f = lambda x_, w_, b_: O.conv2d_fusion(x_, w_, b_, act="relu")
+        check_grad(f, [x, w, bias], wrt=1, rtol=3e-2)
+
+    def test_beam_search_scores_grad(self):
+        logp = np.log(rng.dirichlet(np.ones(5), size=4)
+                      .astype(np.float32))
+        pre_scores = rng.rand(4).astype(np.float32)
+        pre_ids = np.ones((4, 1), np.int64)
+        f = lambda s: O.beam_search(jnp.asarray(logp), s,
+                                    jnp.asarray(pre_ids), 2)[1]
+        check_grad(f, [pre_scores], rtol=3e-2)
+
+    def test_gru_lstm_units(self):
+        x = rng.rand(2, 12).astype(np.float32)
+        h = rng.rand(2, 4).astype(np.float32)
+        wg = rng.rand(4, 8).astype(np.float32)
+        wc = rng.rand(4, 4).astype(np.float32)
+        check_grad(O.gru_unit, [x, h, wg, wc], wrt=0)
+        x4 = rng.rand(2, 16).astype(np.float32)
+        c = rng.rand(2, 4).astype(np.float32)
+        check_grad(O.lstm_unit, [x4, h, c], wrt=0)
