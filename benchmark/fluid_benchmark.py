@@ -1,0 +1,237 @@
+"""Benchmark harness: the reference's fluid_benchmark CLI rebuilt.
+
+Parity: benchmark/fluid/fluid_benchmark.py — models {mnist, resnet, vgg,
+stacked_dynamic_lstm, machine_translation} (benchmark/fluid/models/),
+update methods {local, pserver, nccl2-collective}
+(benchmark/fluid/README.md:14-53), and its throughput print format
+`Total examples: %d, total time: %.5f, %.5f examples/sec`
+(fluid_benchmark.py:297-300).
+
+Update-method mapping (SURVEY §2.5): local = one device; collective =
+SPMD data-parallelism over every visible device (the nccl2 row — XLA
+collectives instead of rings); pserver = the DistributeTranspiler PS
+mode with in-process parameter servers (the sync-PS row; multi-process
+runs use paddle_tpu.distributed.launch instead).
+
+Synthetic data throughout, like the reference's --use_fake_data flag.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _print_result(total_examples, total_time):
+    print("Total examples: %d, total time: %.5f, %.5f examples/sec"
+          % (total_examples, total_time, total_examples / total_time))
+    return total_examples / total_time
+
+
+# ---------------------------------------------------------------------------
+# static-program models (mnist CNN, stacked LSTM) — the fluid path
+# ---------------------------------------------------------------------------
+def _build_mnist(batch_size, lr):
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.static.program_guard(main, startup):
+        img = pt.static.data("img", shape=[1, 28, 28], dtype="float32")
+        label = pt.static.data("label", shape=[1], dtype="int64")
+        conv1 = pt.layers.conv2d(img, 20, 5, act="relu")
+        pool1 = pt.layers.pool2d(conv1, 2, pool_stride=2)
+        conv2 = pt.layers.conv2d(pool1, 50, 5, act="relu")
+        pool2 = pt.layers.pool2d(conv2, 2, pool_stride=2)
+        fc = pt.layers.fc(pt.layers.flatten(pool2, axis=1), size=10)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(fc, label))
+        pt.optimizer.AdamOptimizer(lr).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(batch_size, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (batch_size, 1)).astype(np.int64)}
+    return main, startup, loss, feed
+
+
+def _build_stacked_lstm(batch_size, lr, seq_len=32, hidden=32, layers=2,
+                        vocab=1000):
+    """benchmark/fluid/models/stacked_dynamic_lstm.py analog: embedding →
+    N stacked LSTMs → sequence pooling → binary softmax."""
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.static.program_guard(main, startup):
+        words = pt.static.data("words", shape=[seq_len], dtype="int64")
+        label = pt.static.data("label", shape=[1], dtype="int64")
+        x = pt.layers.embedding(words, size=(vocab, hidden))
+        for i in range(layers):
+            proj = pt.layers.fc(x, size=4 * hidden, num_flatten_dims=2)
+            w_hh = pt.layers.create_parameter(
+                (hidden, 4 * hidden), name=f"lstm_{i}_w_hh")
+            x = pt.layers.dynamic_lstm(proj, w_hh)
+        pooled = pt.layers.reduce_mean(x, dim=1)
+        logits = pt.layers.fc(pooled, size=2)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label))
+        pt.optimizer.AdamOptimizer(lr).minimize(loss)
+    rng = np.random.RandomState(0)
+    feed = {"words": rng.randint(0, vocab, (batch_size, seq_len))
+            .astype(np.int64),
+            "label": rng.randint(0, 2, (batch_size, 1)).astype(np.int64)}
+    return main, startup, loss, feed
+
+
+def _run_static_local(build, args):
+    import paddle_tpu as pt
+    pt.enable_static()
+    try:
+        main, startup, loss, feed = build(args.batch_size,
+                                          args.learning_rate)
+        exe = pt.static.Executor(pt.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=feed, fetch_list=[loss.name])      # compile
+        t0 = time.perf_counter()
+        for _ in range(args.iterations):
+            out = exe.run(main, feed=feed, fetch_list=[loss.name])
+        dt = time.perf_counter() - t0
+        float(np.asarray(out[0]))
+        return _print_result(args.batch_size * args.iterations, dt)
+    finally:
+        pt.disable_static()
+
+
+def _run_static_pserver(build, args):
+    """Sync-PS on one host: in-process servers + this-process trainer
+    (the reference's pserver mode collapsed to a smoke-runnable form;
+    real clusters use paddle_tpu.distributed.launch)."""
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import DistributeTranspiler
+    from paddle_tpu.distributed.launch import find_free_ports
+    from paddle_tpu.distributed.transpiler import reset_clients
+    pt.enable_static()
+    reset_clients()
+    servers = []
+    try:
+        main, startup, loss, feed = build(args.batch_size,
+                                          args.learning_rate)
+        eps = ",".join(f"127.0.0.1:{p}"
+                       for p in find_free_ports(args.pserver_num))
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, pservers=eps, trainers=1,
+                    sync_mode=True, startup_program=startup)
+        for ep in t.endpoints:   # append as started so finally
+            servers.append(          # can stop partial bring-up
+                t.get_pserver_program(ep).build_server().start())
+        tp = t.get_trainer_program()
+        exe = pt.static.Executor(pt.CPUPlace())
+        exe.run(startup)
+        exe.run(tp, feed=feed, fetch_list=[loss.name])        # compile
+        t0 = time.perf_counter()
+        for _ in range(args.iterations):
+            out = exe.run(tp, feed=feed, fetch_list=[loss.name])
+        dt = time.perf_counter() - t0
+        float(np.asarray(out[0]))
+        return _print_result(args.batch_size * args.iterations, dt)
+    finally:
+        for s in servers:
+            s.stop()
+        reset_clients()
+        pt.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# SPMD models (resnet / vgg / machine_translation)
+# ---------------------------------------------------------------------------
+def _run_spmd(model, args, collective):
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, mesh_guard
+
+    devices = jax.devices() if collective else jax.devices()[:1]
+    mesh = make_mesh(MeshConfig(data=len(devices)), devices=devices)
+    opt = pt.optimizer.Momentum(learning_rate=args.learning_rate,
+                                momentum=0.9)
+    with mesh_guard(mesh):
+        if model == "machine_translation":
+            from paddle_tpu.models import transformer as M
+            cfg = (M.transformer_tiny(max_seq=32) if args.smoke
+                   else M.transformer_base())
+            init_fn, step_fn = M.make_train_step(cfg, opt, mesh)
+            batch = M.synthetic_batch(cfg, args.batch_size)
+            params, opt_state = init_fn(jax.random.PRNGKey(0))
+            loss, params, opt_state = step_fn(params, opt_state, batch)
+            float(np.asarray(loss))
+            t0 = time.perf_counter()
+            for _ in range(args.iterations):
+                loss, params, opt_state = step_fn(params, opt_state,
+                                                  batch)
+            float(np.asarray(loss))
+        else:
+            if model == "resnet":
+                from paddle_tpu.models import resnet as M
+                cfg = (M.resnet_cifar10(depth=8, image_size=16)
+                       if args.smoke else M.resnet50())
+            else:
+                from paddle_tpu.models import vgg as M
+                cfg = (M.vgg11(image_size=32, num_classes=10, fc_dim=64)
+                       if args.smoke else M.vgg16())
+            init_fn, step_fn = M.make_train_step(cfg, opt, mesh)
+            imgs, labels = M.synthetic_batch(cfg, args.batch_size)
+            params, opt_state = init_fn(jax.random.PRNGKey(0))
+            out = step_fn(params, opt_state, imgs, labels)
+            loss, params, opt_state = out[0], out[-2], out[-1]
+            float(np.asarray(loss))
+            t0 = time.perf_counter()
+            for _ in range(args.iterations):
+                out = step_fn(params, opt_state, imgs, labels)
+                params, opt_state = out[-2], out[-1]
+            float(np.asarray(out[0]))
+        dt = time.perf_counter() - t0
+    return _print_result(args.batch_size * args.iterations, dt)
+
+
+_VALID_METHODS = {
+    # static-program models train locally or against parameter servers;
+    # SPMD models train locally or data-parallel over the device mesh
+    "mnist": ("local", "pserver"),
+    "stacked_dynamic_lstm": ("local", "pserver"),
+    "resnet": ("local", "collective"),
+    "vgg": ("local", "collective"),
+    "machine_translation": ("local", "collective"),
+}
+
+
+def run_benchmark(args):
+    if args.update_method not in _VALID_METHODS[args.model]:
+        raise ValueError(
+            f"--model {args.model} supports update methods "
+            f"{_VALID_METHODS[args.model]}, not {args.update_method!r}")
+    if args.model in ("mnist", "stacked_dynamic_lstm"):
+        build = (_build_mnist if args.model == "mnist"
+                 else _build_stacked_lstm)
+        if args.update_method == "pserver":
+            return _run_static_pserver(build, args)
+        return _run_static_local(build, args)
+    return _run_spmd(args.model, args,
+                     collective=args.update_method == "collective")
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="fluid_benchmark",
+        description="throughput benchmarks (fluid_benchmark.py parity)")
+    ap.add_argument("--model", required=True,
+                    choices=["mnist", "resnet", "vgg",
+                             "stacked_dynamic_lstm",
+                             "machine_translation"])
+    ap.add_argument("--update_method", default="local",
+                    choices=["local", "collective", "pserver"])
+    ap.add_argument("--batch_size", type=int, default=32)
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--learning_rate", type=float, default=0.01)
+    ap.add_argument("--pserver_num", type=int, default=2)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model configs (CI-sized)")
+    return ap.parse_args(argv)
+
+
+if __name__ == "__main__":
+    run_benchmark(parse_args())

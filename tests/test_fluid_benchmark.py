@@ -1,0 +1,38 @@
+"""Benchmark-harness smoke tests: every VALID model x update-method
+combination runs and reports examples/sec in the reference's format;
+invalid combinations are rejected instead of silently re-labeled."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, ".")  # repo root (benchmark/ package)
+from benchmark.fluid_benchmark import (  # noqa: E402
+    _VALID_METHODS, parse_args, run_benchmark,
+)
+
+ALL_VALID = [(m, u) for m, us in _VALID_METHODS.items() for u in us]
+
+
+def _run(argv, capsys):
+    eps = run_benchmark(parse_args(argv))
+    out = capsys.readouterr().out
+    assert "Total examples:" in out and "examples/sec" in out
+    assert eps > 0
+    return eps
+
+
+@pytest.mark.parametrize("model,method", ALL_VALID)
+def test_model_method_combo(model, method, capsys):
+    _run(["--model", model, "--update_method", method,
+          "--batch_size", "8", "--iterations", "2", "--smoke"], capsys)
+
+
+@pytest.mark.parametrize("model,method", [
+    ("mnist", "collective"),
+    ("resnet", "pserver"),
+])
+def test_invalid_combo_rejected(model, method):
+    with pytest.raises(ValueError, match="supports update methods"):
+        run_benchmark(parse_args(
+            ["--model", model, "--update_method", method, "--smoke"]))
