@@ -27,4 +27,5 @@ from paddle_tpu.static.backward import append_backward, gradients
 from paddle_tpu.static.io import (
     save_inference_model, load_inference_model, save_params,
     load_params, save_persistables, load_persistables,
+    append_save_op, append_load_op,
 )

@@ -101,11 +101,17 @@ class Executor:
         feeds = {k: _as_feed_array(v) for k, v in feed.items()}
         state_names = self._state_names(program, scope)
         state = {n: scope.find_var(n) for n in state_names}
-        missing = [n for n, v in state.items() if v is None]
+        # vars a host op (load_combine, ps_recv…) writes are initialized
+        # BY the program — they may legitimately start uninitialized
+        host_outs = {n for op in program.global_block().ops
+                     if op.attrs.get("_host") for n in op.output_names()}
+        missing = [n for n, v in state.items()
+                   if v is None and n not in host_outs]
         if missing:
             raise EnforceNotMet(
                 f"Persistable vars not initialized: {missing[:5]} — run the "
                 f"startup program first (exe.run(startup_program))")
+        state = {n: v for n, v in state.items() if v is not None}
 
         sig = (id(program), program.version,
                tuple(sorted((k, v.shape, str(v.dtype))
@@ -237,8 +243,11 @@ class Executor:
             roots = set(ops[ad_global].attrs["params"])
             for i in range(ad_global):
                 op = ops[i]
+                outs = set(op.output_names())
+                # a no-output host op (save_combine, barriers) still
+                # splits the differentiated prefix — refuse it too
                 if op.attrs.get("_host") and \
-                        not set(op.output_names()) <= roots:
+                        (not outs or not outs <= roots):
                     raise EnforceNotMet(
                         f"host op {op.type!r} at position {i} feeds the "
                         f"differentiated forward region — gradients cannot "

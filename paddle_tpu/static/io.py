@@ -17,7 +17,7 @@ import numpy as np
 
 from paddle_tpu.static.executor import global_scope
 from paddle_tpu.static.program import (
-    Operator, Parameter, Program, default_main_program,
+    OP_REGISTRY, Operator, Parameter, Program, default_main_program,
 )
 
 PARAMS_FILE = "params.npz"
@@ -135,3 +135,50 @@ def load_inference_model(dirname, executor, model_filename=None,
               scope if scope is not None else global_scope())
     program = meta["program"]
     return program, meta["feed_names"], meta["fetch_names"]
+
+
+# ---------------------------------------------------------------------------
+# save/load as PROGRAM OPS (ref: operators/save_op.cc, load_op.cc,
+# save_combine_op.cc, load_combine_op.cc — §5.4: "save/load are *ops*",
+# so checkpointing can run inside any program). Host ops: the executor
+# runs them eagerly between jitted device segments with real values.
+# ---------------------------------------------------------------------------
+def _save_op_compute(ins, attrs):
+    path = attrs["file_path"]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.savez(path if path.endswith(".npz") else path + ".npz",
+             **{n: np.asarray(v)
+                for n, v in zip(attrs["var_names"], ins["X"])})
+    return {}
+
+
+def _load_op_compute(ins, attrs):
+    path = attrs["file_path"]
+    with np.load(path if path.endswith(".npz") else path + ".npz") as blob:
+        return {"Out": [blob[n] for n in attrs["var_names"]]}
+
+
+OP_REGISTRY["save_combine"] = _save_op_compute
+OP_REGISTRY["load_combine"] = _load_op_compute
+
+
+def append_save_op(program, vars_, file_path):
+    """Append a save_combine op: every run of the program persists the
+    named vars to ``file_path`` (the save_combine_op.cc single-file
+    form). Must come after the vars' last write (e.g. after minimize)."""
+    blk = program.global_block()
+    names = [v if isinstance(v, str) else v.name for v in vars_]
+    return blk.append_op("save_combine", inputs={"X": names}, outputs={},
+                         attrs={"file_path": file_path,
+                                "var_names": names, "_host": True})
+
+
+def append_load_op(program, vars_, file_path):
+    """Append a load_combine op writing the file's values into the named
+    vars when the program runs (load_combine_op.cc)."""
+    blk = program.global_block()
+    names = [v if isinstance(v, str) else v.name for v in vars_]
+    return blk.append_op("load_combine", inputs={},
+                         outputs={"Out": names},
+                         attrs={"file_path": file_path,
+                                "var_names": names, "_host": True})

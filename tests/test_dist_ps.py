@@ -228,18 +228,23 @@ class TestTranspiledTraining:
             progs.append((t, startup, loss))
         server = progs[0][0].get_pserver_program(ep).build_server().start()
         results = [None, None]
+        errors = [None, None]
 
         def run_trainer(tid):
-            t, startup, loss = progs[tid]
-            tp = t.get_trainer_program()
-            scope = pt.static.Scope()
-            with pt.static.scope_guard(scope):
-                exe = pt.static.Executor(pt.CPUPlace())
-                exe.run(startup)
-                results[tid] = [float(np.asarray(
-                    exe.run(tp, feed=_batch(s, tid, 2),
-                            fetch_list=[loss.name])[0]))
-                    for s in range(STEPS)]
+            try:
+                t, startup, loss = progs[tid]
+                tp = t.get_trainer_program()
+                scope = pt.static.Scope()
+                with pt.static.scope_guard(scope):
+                    exe = pt.static.Executor(pt.CPUPlace())
+                    exe.run(startup)
+                    results[tid] = [float(np.asarray(
+                        exe.run(tp, feed=_batch(s, tid, 2),
+                                fetch_list=[loss.name])[0]))
+                        for s in range(STEPS)]
+            except Exception:
+                import traceback
+                errors[tid] = traceback.format_exc()
 
         try:
             threads = [threading.Thread(target=run_trainer, args=(i,))
@@ -247,8 +252,9 @@ class TestTranspiledTraining:
             for th in threads:
                 th.start()
             for th in threads:
-                th.join(timeout=240)
-            assert all(r is not None for r in results)
+                th.join(timeout=600)
+            assert all(r is not None for r in results), \
+                f"trainer errors: {errors}"
             avg = np.mean(results, axis=0)
             np.testing.assert_allclose(avg, local, rtol=1e-4)
         finally:

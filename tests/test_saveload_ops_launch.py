@@ -1,0 +1,149 @@
+"""save/load as program ops (§5.4) + collective-mode launcher env wiring."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestSaveLoadOps:
+    def test_save_op_persists_every_run(self, tmp_path):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            ck = str(tmp_path / "ck")
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                pred = pt.layers.fc(x, size=1)
+                loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+                params = [p.name for p in main.global_block()
+                          .all_parameters()]
+                pt.static.append_save_op(main, params, ck)
+                scope = pt.static.Scope()
+                with pt.static.scope_guard(scope):
+                    exe = pt.static.Executor(pt.CPUPlace())
+                    exe.run(startup)
+                    feed = {"x": np.random.RandomState(0)
+                            .rand(8, 4).astype(np.float32),
+                            "y": np.ones((8, 1), np.float32)}
+                    exe.run(main, feed=feed, fetch_list=[loss.name])
+                    assert os.path.exists(ck + ".npz")
+                    saved = dict(np.load(ck + ".npz"))
+                    # the op runs AFTER the update: saved == new params
+                    for p in params:
+                        np.testing.assert_allclose(
+                            saved[p], np.asarray(scope.find_var(p)),
+                            rtol=1e-6)
+
+                    # load program: restores the checkpoint into a fresh
+                    # scope through a load_combine op
+                    lp = pt.Program()
+                    blk = lp.global_block()
+                    for p in params:
+                        v = main.global_block().var(p)
+                        blk.create_var(name=p, shape=v.shape,
+                                       dtype=v.dtype, persistable=True)
+                    pt.static.append_load_op(lp, params, ck)
+                s2 = pt.static.Scope()
+                with pt.static.scope_guard(s2):
+                    exe.run(lp)
+                    for p in params:
+                        np.testing.assert_allclose(
+                            np.asarray(s2.find_var(p)), saved[p],
+                            rtol=1e-6)
+        finally:
+            pt.disable_static()
+
+
+    def test_load_op_initializes_compiled_path(self, tmp_path):
+        """checkpoint-restore-then-infer: the load op supplies the
+        persistables, so a fed (compiled) program needs no startup."""
+        pt.enable_static()
+        try:
+            ck = str(tmp_path / "w")
+            np.savez(ck + ".npz", w=np.full((4, 1), 2.0, np.float32))
+            prog = pt.Program()
+            with pt.static.program_guard(prog, pt.Program()):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                blk = prog.global_block()
+                blk.create_var(name="w", shape=(4, 1), dtype="float32",
+                               persistable=True)
+                pt.static.append_load_op(prog, ["w"], ck)
+                y = pt.layers.matmul(x, blk.var("w"))
+                scope = pt.static.Scope()
+                with pt.static.scope_guard(scope):
+                    exe = pt.static.Executor(pt.CPUPlace())
+                    out = exe.run(prog,
+                                  feed={"x": np.ones((3, 4), np.float32)},
+                                  fetch_list=[y.name])
+            np.testing.assert_allclose(out[0], 8.0)
+        finally:
+            pt.disable_static()
+
+    def test_save_op_before_backward_refused(self, tmp_path):
+        """a save op appended before minimize would split the
+        differentiated prefix — must be refused loudly."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                pred = pt.layers.fc(x, size=1)
+                pt.static.append_save_op(
+                    main, [main.global_block().all_parameters()[0]],
+                    str(tmp_path / "early"))
+                loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+                scope = pt.static.Scope()
+                with pt.static.scope_guard(scope):
+                    exe = pt.static.Executor(pt.CPUPlace())
+                    exe.run(startup)
+                    with pytest.raises(Exception, match="host op"):
+                        exe.run(main,
+                                feed={"x": np.ones((2, 4), np.float32),
+                                      "y": np.ones((2, 1), np.float32)},
+                                fetch_list=[loss.name])
+        finally:
+            pt.disable_static()
+
+
+class TestLaunchCollective:
+    def test_env_wiring(self, tmp_path):
+        from paddle_tpu.distributed.launch import launch_collective
+        script = tmp_path / "probe.py"
+        script.write_text(
+            "import os, json\n"
+            "print(json.dumps({k: os.environ[k] for k in ("
+            "'PADDLE_TRAINER_ID', 'PADDLE_TRAINERS_NUM', "
+            "'PADDLE_CURRENT_ENDPOINT', 'PADDLE_TRAINER_ENDPOINTS', "
+            "'TRAINING_ROLE')}))\n")
+        logd = str(tmp_path / "logs")
+        rc = launch_collective([str(script)], nproc=2, log_dir=logd)
+        assert rc == 0
+        envs = []
+        for r in range(2):
+            with open(os.path.join(logd, f"workerlog.{r}.log")) as f:
+                envs.append(json.loads(f.read().strip().splitlines()[-1]))
+        assert {e["PADDLE_TRAINER_ID"] for e in envs} == {"0", "1"}
+        assert all(e["PADDLE_TRAINERS_NUM"] == "2" for e in envs)
+        assert all(e["TRAINING_ROLE"] == "TRAINER" for e in envs)
+        eps = envs[0]["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(eps) == 2
+        assert envs[0]["PADDLE_CURRENT_ENDPOINT"] == eps[0]
+        assert envs[1]["PADDLE_CURRENT_ENDPOINT"] == eps[1]
+
+    def test_failure_propagates(self, tmp_path):
+        from paddle_tpu.distributed.launch import launch_collective
+        script = tmp_path / "boom.py"
+        script.write_text("import sys; sys.exit(3)\n")
+        rc = launch_collective([str(script)], nproc=2,
+                               log_dir=str(tmp_path / "logs"))
+        assert rc == 3
