@@ -20,7 +20,7 @@ from paddle_tpu.static.io import (
 __all__ = [
     "save_inference_model", "load_inference_model", "save_params",
     "load_params", "save_persistables", "load_persistables",
-    "save_pytree", "load_pytree",
+    "save_pytree", "load_pytree", "save_dygraph", "load_dygraph",
 ]
 
 
@@ -41,3 +41,15 @@ def load_pytree(path):
     treedef = pickle.loads(blob["treedef"])
     return jax.tree.unflatten(treedef, [jnp.asarray(l)
                                         for l in blob["leaves"]])
+
+
+# dygraph/checkpoint.py name parity (save_dygraph/load_dygraph)
+def save_dygraph(state_dict, model_path):
+    save_pytree(state_dict, model_path + ".pdparams"
+                if not model_path.endswith(".pdparams") else model_path)
+
+
+def load_dygraph(model_path):
+    p = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    return load_pytree(p), None      # (param_dict, optimizer_dict)

@@ -33,6 +33,9 @@ from paddle_tpu.static.program import (
     in_static_mode, data,
 )
 from paddle_tpu.layers import learning_rate_scheduler
+from paddle_tpu.layers.control_flow_classes import (
+    While, Switch, IfElse, StaticRNN, DynamicRNN,
+)
 from paddle_tpu.layers.learning_rate_scheduler import (
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup,

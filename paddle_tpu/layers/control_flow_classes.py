@@ -1,0 +1,244 @@
+"""Class-shaped control flow builders.
+
+Parity targets: python/paddle/fluid/layers/control_flow.py — While:630,
+StaticRNN:280, DynamicRNN:1700, IfElse:1564, Switch:1436.
+
+TPU-first shape: the reference's classes BUILD sub-blocks inside a
+`with` statement and an op replays them; under a tracing regime a
+with-block body executes once and cannot be replayed, so the looping
+builders (While, StaticRNN, DynamicRNN) take the step body as a
+CALLABLE and lower straight to lax.while_loop / lax.scan (SURVEY §3
+"hard parts": control flow under tracing). Switch and IfElse keep the
+reference's with-block surface — they execute each selected branch
+exactly once, which traces fine.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.ops import control_flow as _cf
+
+__all__ = ["While", "Switch", "IfElse", "StaticRNN", "DynamicRNN"]
+
+
+class While:
+    """layers.While parity, callable-body form:
+
+        w = While(cond_fn)               # cond_fn(*loop_vars) -> bool
+        out_vars = w(body_fn, loop_vars) # body_fn(*loop_vars) -> new vars
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        enforce(callable(cond),
+                "While takes the loop condition as a callable "
+                "(cond_fn(*loop_vars) -> bool scalar); a traced block "
+                "cannot be re-executed from a with-statement")
+        self.cond = cond
+
+    def __call__(self, body, loop_vars):
+        return _cf.while_loop(self.cond, body, list(loop_vars))
+
+
+class Switch:
+    """layers.Switch parity:
+
+        with Switch() as switch:
+            with switch.case(cond1): out = a
+            with switch.case(cond2): out = b
+            with switch.default():   out = c
+
+    Branch bodies run once each (building values); the selected value is
+    whichever case's condition is first true — materialized with
+    jnp.where chains so it traces.
+    """
+
+    def __init__(self, name=None):
+        self._cases = []           # (cond, result-holder)
+        self._default = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    class _Case:
+        def __init__(self, parent, cond):
+            self.parent = parent
+            self.cond = cond
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def case(self, condition):
+        c = Switch._Case(self, condition)
+        self._cases.append(c)
+        return c
+
+    def default(self):
+        c = Switch._Case(self, None)
+        self._default = c
+        return c
+
+    def select(self, *values):
+        """Pick the value of the first true case; the last value is the
+        default()'s. A default is REQUIRED (under tracing there is no
+        'no branch taken' — some value must materialize)."""
+        enforce(self._default is not None,
+                "Switch.select needs a default() case: under a tracing "
+                "regime some branch value must always materialize")
+        enforce(len(values) == len(self._cases) + 1,
+                "one value per case, plus the default's")
+        out = values[-1]
+        for c, v in zip(reversed(self._cases), reversed(values[:-1])):
+            out = jax.tree.map(
+                lambda a, b, cond=c.cond: jnp.where(cond, a, b), v, out)
+        return out
+
+
+class IfElse:
+    """layers.IfElse parity:
+
+        ie = IfElse(cond)                  # cond: [N] bool mask
+        with ie.true_block():
+            ie.output(fn_true(ie.input(x)))
+        with ie.false_block():
+            ie.output(fn_false(ie.input(x)))
+        out, = ie()                        # rows re-merged in order
+
+    Row-partitioning semantics like the reference (split_lod_tensor /
+    merge_lod_tensor machinery): each block sees only its rows.
+    """
+
+    def __init__(self, cond, name=None):
+        self.cond = jnp.asarray(cond).reshape(-1).astype(bool)
+        self._in_true = None
+        self._outputs = {True: [], False: []}
+        self._restore = None
+
+    class _Branch:
+        def __init__(self, parent, flag):
+            self.parent = parent
+            self.flag = flag
+
+        def __enter__(self):
+            self.parent._in_true = self.flag
+            return self
+
+        def __exit__(self, *exc):
+            self.parent._in_true = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        """Rows of ``x`` belonging to the current branch."""
+        enforce(self._in_true is not None,
+                "IfElse.input() only inside true_block()/false_block()")
+        from paddle_tpu.ops.tensor_array import split_lod_tensor
+        t, f, restore = split_lod_tensor(jnp.asarray(x), self.cond)
+        self._restore = restore
+        return t if self._in_true else f
+
+    def output(self, *outs):
+        enforce(self._in_true is not None,
+                "IfElse.output() only inside true_block()/false_block()")
+        self._outputs[self._in_true].extend(outs)
+
+    def __call__(self):
+        from paddle_tpu.ops.tensor_array import merge_lod_tensor
+        ts, fs = self._outputs[True], self._outputs[False]
+        enforce(len(ts) == len(fs),
+                "true and false blocks must emit the same outputs")
+        enforce(self._restore is not None,
+                "IfElse blocks must read their rows via ie.input(x) "
+                "before ie.output(...) — outputs built from unpartitioned "
+                "tensors cannot be row-merged")
+        return [merge_lod_tensor(t, f, self._restore)
+                for t, f in zip(ts, fs)]
+
+
+class StaticRNN:
+    """layers.StaticRNN parity, callable-step form:
+
+        rnn = StaticRNN()
+        rnn.step_input(x)                    # [B, T, D] (or several)
+        h = rnn.memory(init=h0)
+        def step(x_t, h_prev):
+            h_new = cell(x_t, h_prev)
+            return {"mem": [h_new], "out": [h_new]}
+        outs = rnn(step)                     # [[B, T, H], ...]
+    """
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._mems = []
+
+    def step_input(self, x):
+        self._inputs.append(jnp.asarray(x))
+        return x
+
+    def memory(self, init=None, shape=None, batch_ref=None, value=0.0,
+               dtype=jnp.float32):
+        if init is None:
+            enforce(batch_ref is not None and shape is not None,
+                    "memory needs init= or (shape=, batch_ref=)")
+            b = jnp.asarray(batch_ref).shape[0]
+            init = jnp.full((b,) + tuple(shape), value, dtype)
+        self._mems.append(jnp.asarray(init))
+        return init
+
+    def __call__(self, step):
+        enforce(bool(self._inputs), "call step_input() first")
+        xs = tuple(jnp.moveaxis(x, 1, 0) for x in self._inputs)  # T-major
+
+        def body(mems, xts):
+            res = step(*xts, *mems)
+            return tuple(res["mem"]), tuple(res.get("out", ()))
+
+        mems, outs = jax.lax.scan(body, tuple(self._mems), xs)
+        return [jnp.moveaxis(o, 0, 1) for o in outs]
+
+
+class DynamicRNN(StaticRNN):
+    """layers.DynamicRNN parity: like StaticRNN but with per-sequence
+    lengths — steps beyond a sequence's length hold its memory and
+    zero its outputs (the LoD semantics, dense-padded)."""
+
+    def __init__(self, lengths=None, name=None):
+        super().__init__(name)
+        self.lengths = None if lengths is None else jnp.asarray(lengths)
+
+    def __call__(self, step):
+        enforce(bool(self._inputs), "call step_input() first")
+        xs = tuple(jnp.moveaxis(x, 1, 0) for x in self._inputs)
+        T = xs[0].shape[0]
+        ts = jnp.arange(T)
+
+        def body(mems, scan_in):
+            t, xts = scan_in
+            res = step(*xts, *mems)
+            new_mems = tuple(res["mem"])
+            outs = tuple(res.get("out", ()))
+            if self.lengths is not None:
+                alive = (t < self.lengths)          # [B]
+                def sel(new, old):
+                    m = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                    return jnp.where(m, new, old)
+                new_mems = tuple(sel(n, o)
+                                 for n, o in zip(new_mems, mems))
+                outs = tuple(o * alive.reshape(
+                    (-1,) + (1,) * (o.ndim - 1)).astype(o.dtype)
+                    for o in outs)
+            return new_mems, outs
+
+        mems, outs = jax.lax.scan(body, tuple(self._mems), (ts, xs))
+        return [jnp.moveaxis(o, 0, 1) for o in outs]
