@@ -31,6 +31,7 @@ from paddle_tpu.core.place import (
 
 from paddle_tpu import ops
 from paddle_tpu import install_check
+from paddle_tpu import transpiler
 from paddle_tpu import layers
 from paddle_tpu import nn
 from paddle_tpu import initializer

@@ -81,3 +81,112 @@ def _imikolov_sample(rng):
 
 
 imikolov = _Synthetic(_imikolov_sample, n_train=512, n_test=128)
+
+
+# -- remaining reference dataset family (python/paddle/dataset/) ----------
+MOVIELENS_USERS, MOVIELENS_MOVIES, MOVIELENS_CATEGORIES = 6040, 3952, 18
+
+
+def _movielens_sample(rng):
+    """movielens.py: (user_id, gender, age, job, movie_id,
+    category-id list, title words, rating)."""
+    user = rng.randint(1, MOVIELENS_USERS + 1)
+    gender = rng.randint(0, 2)
+    age = rng.randint(0, 7)
+    job = rng.randint(0, 21)
+    movie = rng.randint(1, MOVIELENS_MOVIES + 1)
+    # variable-length category-id list (CATEGORIES_DICT indices), like
+    # MovieInfo.value() — NOT a one-hot
+    cats = rng.choice(MOVIELENS_CATEGORIES, size=rng.randint(1, 4),
+                      replace=False).astype(np.int64)
+    title = rng.randint(0, 5175, size=(rng.randint(1, 6),)).astype(np.int64)
+    rating = float(rng.randint(1, 6))
+    return user, gender, age, job, movie, cats, title, rating
+
+
+movielens = _Synthetic(_movielens_sample, n_train=1024, n_test=256)
+
+WMT14_DICT_SIZE = 30000
+WMT16_DICT_SIZE = 10000
+
+
+def _wmt_sample(vocab):
+    def make(rng):
+        """(src ids, tgt ids, tgt-next ids) — the seq2seq triple
+        wmt14/wmt16.py yield (with <s>/<e> at ids 0/1)."""
+        ns = rng.randint(4, 30)
+        nt = rng.randint(4, 30)
+        # src wrapped in <s>=0 ... <e>=1 like the reference
+        src = np.concatenate(
+            [[0], rng.randint(2, vocab, size=(ns,)), [1]]).astype(np.int64)
+        tgt = np.concatenate([[0], rng.randint(2, vocab, size=(nt,))]) \
+            .astype(np.int64)
+        tgt_next = np.concatenate([tgt[1:], [1]]).astype(np.int64)
+        return src, tgt, tgt_next
+    return make
+
+
+wmt14 = _Synthetic(_wmt_sample(WMT14_DICT_SIZE), n_train=512, n_test=128)
+wmt16 = _Synthetic(_wmt_sample(WMT16_DICT_SIZE), n_train=512, n_test=128)
+
+CONLL05_WORD_VOCAB, CONLL05_LABELS = 44068, 59
+
+
+CONLL05_PRED_VOCAB = 3162
+
+
+def _conll05_sample(rng):
+    """conll05.py SRL 9-tuple: (words, ctx_n2, ctx_n1, ctx_0, ctx_p1,
+    ctx_p2, predicate, mark, labels) — length-aligned id sequences."""
+    n = rng.randint(5, 40)
+    seq = lambda hi: rng.randint(0, hi, size=(n,)).astype(np.int64)
+    return (seq(CONLL05_WORD_VOCAB),) \
+        + tuple(seq(CONLL05_WORD_VOCAB) for _ in range(5)) \
+        + (seq(CONLL05_PRED_VOCAB), seq(2), seq(CONLL05_LABELS))
+
+
+conll05 = _Synthetic(_conll05_sample, n_train=512, n_test=128)
+
+
+SENTIMENT_VOCAB = 39768   # NLTK movie_reviews word-dict size order
+
+
+def _sentiment_sample(rng):
+    n = rng.randint(8, 60)
+    return (rng.randint(0, SENTIMENT_VOCAB, size=(n,)).astype(np.int64),
+            rng.randint(0, 2))
+
+
+sentiment = _Synthetic(_sentiment_sample, n_train=512, n_test=128)
+
+
+def _voc2012_sample(rng):
+    """voc2012.py: (image CHW float, segmentation label HW int32)."""
+    img = rng.uniform(0, 1, size=(3, 64, 64)).astype(np.float32)
+    seg = rng.randint(0, 21, size=(64, 64)).astype(np.int32)
+    return img, seg
+
+
+voc2012 = _Synthetic(_voc2012_sample, n_train=128, n_test=32)
+
+
+def _mq2007_sample(rng):
+    """mq2007.py pairwise form: (label, query-doc features a,
+    features b) — label FIRST, like the reference's yield."""
+    fa = rng.uniform(0, 1, size=(46,)).astype(np.float32)
+    fb = rng.uniform(0, 1, size=(46,)).astype(np.float32)
+    return float(rng.randint(0, 2)), fa, fb
+
+
+mq2007 = _Synthetic(_mq2007_sample, n_train=512, n_test=128)
+
+
+def _flowers_sample(rng):
+    img = rng.uniform(0, 1, size=(3, 224, 224)).astype(np.float32)
+    return img, rng.randint(0, 102)
+
+
+flowers = _Synthetic(_flowers_sample, n_train=256, n_test=64)
+
+__all__ += ["movielens", "wmt14", "wmt16", "conll05", "sentiment",
+            "voc2012", "mq2007", "flowers"]

@@ -1,0 +1,29 @@
+"""fluid.transpiler namespace parity (python/paddle/fluid/transpiler/):
+DistributeTranspiler & friends live in paddle_tpu.distributed; the
+memory-optimization transpilers are no-ops here — XLA's buffer
+liveness/reuse (SURVEY §7: memory passes → compiler) does their job."""
+
+import warnings
+
+from paddle_tpu.distributed.transpiler import (          # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, HashName,
+    RoundRobin,
+)
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "HashName", "RoundRobin", "memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program=None, skip_opt_set=None,
+                    print_log=False, level=0, skip_grads=True):
+    """ir/memory_optimize_pass parity — a documented no-op: XLA performs
+    buffer reuse/inplace/liveness analysis on every compiled program."""
+    warnings.warn("memory_optimize is a no-op: XLA already performs "
+                  "buffer reuse and liveness optimization",
+                  stacklevel=2)
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    """eager_deletion_pass parity — no-op (XLA frees dead buffers)."""
+    warnings.warn("release_memory is a no-op: XLA frees dead buffers",
+                  stacklevel=2)
