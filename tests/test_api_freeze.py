@@ -1,0 +1,30 @@
+"""API-freeze guard (tools/diff_api.py parity): the public surface must
+match tools/api_spec.txt; intentional changes regenerate it with
+`python tools/print_signatures.py --update tools/api_spec.txt`."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import print_signatures  # noqa: E402
+
+SPEC = os.path.join(os.path.dirname(__file__), "..", "tools",
+                    "api_spec.txt")
+
+
+def test_public_api_matches_spec():
+    with open(SPEC) as f:
+        want = set(f.read().splitlines())
+    have = set(print_signatures.collect())
+    removed = sorted(want - have)
+    added = sorted(have - want)
+    msg = []
+    if removed:
+        msg.append(f"REMOVED/CHANGED ({len(removed)}): "
+                   + "; ".join(removed[:8]))
+    if added:
+        msg.append(f"ADDED ({len(added)}): " + "; ".join(added[:8]))
+    assert not msg, (
+        "public API drifted from tools/api_spec.txt — if intentional, "
+        "regenerate with `python tools/print_signatures.py --update "
+        "tools/api_spec.txt`. " + " | ".join(msg))

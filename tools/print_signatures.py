@@ -1,0 +1,105 @@
+"""Public-API signature dump (tools/print_signatures.py parity).
+
+Prints one line per public symbol: `module.name(signature)`. The
+companion guard test (tests/test_api_freeze.py, the diff_api.py role)
+compares this output against the committed spec so accidental API
+breaks fail CI — the reference freezes its API the same way
+(ref: tools/print_signatures.py, tools/diff_api.py).
+
+Usage: python tools/print_signatures.py [--update path]
+"""
+
+import argparse
+import inspect
+import sys
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.ops",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.static",
+    "paddle_tpu.io",
+    "paddle_tpu.io_checkpoint",
+    "paddle_tpu.nn",
+    "paddle_tpu.reader",
+    "paddle_tpu.metrics",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.inference",
+    "paddle_tpu.distributions",
+    "paddle_tpu.profiler",
+    "paddle_tpu.amp",
+    "paddle_tpu.backward",
+    "paddle_tpu.distributed",
+    "paddle_tpu.parallel",
+    "paddle_tpu.dataio",
+    "paddle_tpu.contrib.slim",
+    "paddle_tpu.contrib.quant",
+    "paddle_tpu.transpiler",
+]
+
+
+def _sig(obj):
+    import re
+    try:
+        s = str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+    # default-value reprs that embed memory addresses are not stable
+    return re.sub(r" at 0x[0-9a-fA-F]+", " at 0x...", s)
+
+
+def collect():
+    import importlib
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")]
+        for n in sorted(set(names)):
+            obj = getattr(mod, n, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{modname}.{n}{_sig(obj.__init__)}")
+                # dir() not vars(): inherited public methods are part of
+                # the frozen surface too; getattr_static classifies
+                # properties/staticmethods portably
+                for mn in sorted(dir(obj)):
+                    if mn.startswith("_"):
+                        continue
+                    raw = inspect.getattr_static(obj, mn, None)
+                    if isinstance(raw, property):
+                        lines.append(f"{modname}.{n}.{mn} [property]")
+                    elif isinstance(raw, (staticmethod, classmethod)):
+                        lines.append(
+                            f"{modname}.{n}.{mn}{_sig(raw.__func__)}")
+                    elif callable(raw):
+                        lines.append(f"{modname}.{n}.{mn}{_sig(raw)}")
+            elif callable(obj):
+                lines.append(f"{modname}.{n}{_sig(obj)}")
+            else:
+                lines.append(f"{modname}.{n}")
+    return sorted(set(lines))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", default=None,
+                    help="write the spec to this path instead of stdout")
+    args = ap.parse_args(argv)
+    lines = collect()
+    text = "\n".join(lines) + "\n"
+    if args.update:
+        with open(args.update, "w") as f:
+            f.write(text)
+        print(f"wrote {len(lines)} signatures to {args.update}",
+              file=sys.stderr)
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()
