@@ -138,12 +138,20 @@ class _DenseVar:
 
 class _SparseTable:
     """Hosted sparse table (lookup_sparse_table / pserver sparse block
-    parity): rows materialize on first touch, SGD-updated on push."""
+    parity): rows materialize on first touch; pushes apply the table's
+    optimizer rule — "sgd" or "adagrad" (the pserver optimize-block
+    choices the reference runs for sparse params)."""
 
-    def __init__(self, dim, initializer=None, seed=0, lr=1.0):
+    def __init__(self, dim, initializer=None, seed=0, lr=1.0,
+                 optimizer="sgd", eps=1e-6):
+        enforce(optimizer in ("sgd", "adagrad"),
+                f"sparse optimizer must be sgd|adagrad, got {optimizer!r}")
         self.dim = dim
         self.lr = lr
+        self.optimizer = optimizer
+        self.eps = eps
         self.rows = {}
+        self.accum = {}               # adagrad per-row G accumulators
         self._rng = np.random.RandomState(seed)
         self._init = initializer or (
             lambda rng, dim: rng.normal(0, 0.01, dim).astype(np.float32))
@@ -168,7 +176,14 @@ class _SparseTable:
                 row = self.rows.get(x)
                 if row is None:
                     row = self._init(self._rng, self.dim)
-                self.rows[x] = row - lr * g
+                if self.optimizer == "adagrad":
+                    acc = self.accum.get(x)
+                    acc = (g * g if acc is None else acc + g * g)
+                    self.accum[x] = acc
+                    row = row - lr * g / (np.sqrt(acc) + self.eps)
+                else:
+                    row = row - lr * g
+                self.rows[x] = row
 
 
 class ParameterServer:
@@ -195,8 +210,10 @@ class ParameterServer:
         self.dense[name] = _DenseVar(value, optimizer, regularizer,
                                      param_lr)
 
-    def host_sparse(self, name, dim, initializer=None, seed=0, lr=1.0):
-        self.sparse[name] = _SparseTable(dim, initializer, seed, lr)
+    def host_sparse(self, name, dim, initializer=None, seed=0, lr=1.0,
+                    optimizer="sgd"):
+        self.sparse[name] = _SparseTable(dim, initializer, seed, lr,
+                                         optimizer)
 
     # -- request handling (request_handler_impl.cc parity) -----------------
     def _handle(self, msg):
@@ -258,8 +275,12 @@ class ParameterServer:
                 ids = np.fromiter(t.rows, np.int64, len(t.rows))
                 rows = (np.stack([t.rows[int(i)] for i in ids])
                         if len(ids) else np.zeros((0, t.dim), np.float32))
+                accum = (np.stack([t.accum.get(int(i),
+                                               np.zeros(t.dim, np.float32))
+                                   for i in ids])
+                         if len(ids) else np.zeros((0, t.dim), np.float32))
             np.savez(os.path.join(dirname, f"pserver_{tag}_{n}.npz"),
-                     ids=ids, rows=rows)
+                     ids=ids, rows=rows, accum=accum)
 
     def load(self, dirname):
         tag = f"{self.host}_{self.port}".replace(".", "_")
@@ -272,9 +293,14 @@ class ParameterServer:
         for n, t in self.sparse.items():
             p = os.path.join(dirname, f"pserver_{tag}_{n}.npz")
             if os.path.exists(p):
-                blob = np.load(p)
-                t.rows = {int(i): r for i, r in
-                          zip(blob["ids"], blob["rows"])}
+                with np.load(p) as blob:
+                    t.rows = {int(i): r for i, r in
+                              zip(blob["ids"], blob["rows"])}
+                    if "accum" in blob.files:
+                        t.accum = {int(i): a for i, a in
+                                   zip(blob["ids"], blob["accum"])}
+                    else:   # old checkpoint: stale accumulators must not
+                        t.accum = {}    # scale the restored rows
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

@@ -86,6 +86,11 @@ class DistributeTranspilerConfig:
         self.split_method = RoundRobin
         self.sync_mode = True
         self.runtime_split_send_recv = False
+        # async mode: every N steps the Communicator AVERAGES the
+        # buffered grads into one merged push (the reference's
+        # send_queue_size / merge-vars knob, communicator.h:160); flush
+        # trailing partial windows with transpiler.flush_clients()
+        self.merge_steps = 1
 
 
 # ---------------------------------------------------------------------------
@@ -106,8 +111,22 @@ def _get_client(endpoints, var_ep, trainer_id):
     return c
 
 
+def flush_clients():
+    """Push any grads still buffered in async Communicators (the partial
+    trailing merge window). Call at the end of async training — the
+    reference's Communicator flushes on its Stop/barrier path the same
+    way."""
+    for c in _CLIENTS.values():
+        comm = getattr(c, "communicator", None)
+        if comm is not None:
+            comm.flush()
+
+
 def reset_clients():
     for c in _CLIENTS.values():
+        comm = getattr(c, "communicator", None)
+        if comm is not None:
+            comm.stop()           # stop() drains pending sends first
         c.close()
     _CLIENTS.clear()
 
@@ -123,8 +142,24 @@ def _ps_recv_compute(ins, attrs):
 def _ps_send_compute(ins, attrs):
     c = _get_client(attrs["endpoints"], attrs["var_ep"],
                     attrs["trainer_id"])
-    for pname, g in zip(attrs["param_names"], ins["X"]):
-        c.push_grad(pname, np.asarray(g))
+    merge_steps = attrs.get("merge_steps", 1)
+    if not attrs["sync_mode"] and merge_steps > 1:
+        # async mode sends through the background Communicator, which
+        # AVERAGES ``merge_steps`` grads per var into one merged push
+        # (communicator.h:160 MergeVars role); trailing partial windows
+        # flush via flush_clients() / reset_clients()
+        comm = getattr(c, "communicator", None)
+        if comm is not None and comm.merge_steps != merge_steps:
+            comm.stop()           # re-transpiled with a new window size
+            comm = None
+        if comm is None:
+            comm = c.communicator = _ps.Communicator(
+                c, merge_steps=merge_steps).start()
+        for pname, g in zip(attrs["param_names"], ins["X"]):
+            comm.send(pname, np.asarray(g))
+    else:
+        for pname, g in zip(attrs["param_names"], ins["X"]):
+            c.push_grad(pname, np.asarray(g))
     c.step += 1
     return {}
 
@@ -243,7 +278,8 @@ class DistributeTranspiler:
                    if op.type not in ("apply_optimizer", "increment_step")]
         common = dict(endpoints=self.endpoints, var_ep=dict(self.var_ep),
                       trainer_id=self.trainer_id,
-                      sync_mode=self.sync_mode, _host=True)
+                      sync_mode=self.sync_mode,
+                      merge_steps=self.config.merge_steps, _host=True)
         recv = Operator(blk, "ps_recv", inputs={},
                         outputs={"Out": list(param_names)},
                         attrs=dict(common, param_names=list(param_names)))

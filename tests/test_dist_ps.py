@@ -103,6 +103,26 @@ class TestService:
         finally:
             s.stop()
 
+    def test_sparse_adagrad(self):
+        s = ParameterServer("127.0.0.1:0", 1, True)
+        s.host_sparse("emb", dim=2, seed=0, lr=1.0, optimizer="adagrad")
+        s.start()
+        try:
+            c = PSClient([s.endpoint], {"emb": s.endpoint})
+            r0 = c.pull_sparse("emb", [3])
+            g = np.full((1, 2), 2.0, np.float32)
+            c.push_sparse("emb", [3], g)
+            r1 = c.pull_sparse("emb", [3])
+            # adagrad step: g / (sqrt(g^2) + eps) ~= 1.0
+            np.testing.assert_allclose(r1, r0 - 1.0, rtol=1e-4)
+            c.push_sparse("emb", [3], g)
+            r2 = c.pull_sparse("emb", [3])
+            # second step smaller: 2 / (sqrt(8)) ~= 0.707
+            np.testing.assert_allclose(r2, r1 - 2.0 / np.sqrt(8.0),
+                                       rtol=1e-3)
+        finally:
+            s.stop()
+
     def test_communicator_merges(self):
         s = self._server(n_trainers=1, sync=False)
         try:
@@ -209,6 +229,40 @@ class TestTranspiledTraining:
         finally:
             for s in servers:
                 s.stop()
+
+    def test_async_merge_steps_via_communicator(self):
+        """config.merge_steps>1 in async mode routes sends through the
+        background Communicator: pushes arrive merged (server round
+        advances once per merge window)."""
+        from paddle_tpu.distributed import DistributeTranspilerConfig
+        from paddle_tpu.distributed.launch import find_free_ports
+        with unique_name.guard():
+            main, startup, loss = _build()
+        eps = f"127.0.0.1:{find_free_ports(1)[0]}"
+        cfg = DistributeTranspilerConfig()
+        cfg.merge_steps = 4
+        t = DistributeTranspiler(cfg)
+        t.transpile(0, program=main, pservers=eps, trainers=1,
+                    sync_mode=False, startup_program=startup)
+        server = t.get_pserver_program(eps).build_server().start()
+        try:
+            tp = t.get_trainer_program()
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                for s in range(8):
+                    exe.run(tp, feed=_batch(s), fetch_list=[loss.name])
+            from paddle_tpu.distributed.transpiler import flush_clients
+            flush_clients()
+            import time
+            time.sleep(0.3)
+            rounds = {n: v.round for n, v in server.dense.items()}
+            # 8 local steps, merged every 4 (+flush remainder): far
+            # fewer server rounds than steps, but params did move
+            assert all(1 <= r <= 3 for r in rounds.values()), rounds
+        finally:
+            server.stop()
 
     def test_two_trainers_sync_matches_local(self):
         """Two trainer threads on half-batches; averaged per-step losses
