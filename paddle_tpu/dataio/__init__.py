@@ -9,7 +9,7 @@ loaders via the same reader contract).
 
 from paddle_tpu.dataio import dataset
 from paddle_tpu.dataio.feeder import DataFeeder, batch_reader
-from paddle_tpu.dataio.pyreader import PyReader
+from paddle_tpu.dataio.pyreader import PyReader, DataLoader
 from paddle_tpu.dataio.dataloader import FileDataLoader
 from paddle_tpu.dataio.fluid_dataset import (
     DatasetFactory, InMemoryDataset, QueueDataset,

@@ -28,10 +28,15 @@ def batch_reader(reader, batch_size, drop_last=True):
     return batched
 
 
+def feed_names_of(feed_list):
+    """Resolve a feed_list of Variables/strings to names (shared by
+    DataFeeder and DataLoader)."""
+    return [f if isinstance(f, str) else f.name for f in feed_list]
+
+
 class DataFeeder:
     def __init__(self, feed_list, place=None, program=None):
-        self.feed_names = [f if isinstance(f, str) else f.name
-                           for f in feed_list]
+        self.feed_names = feed_names_of(feed_list)
         self.feed_vars = [f for f in feed_list
                           if not isinstance(f, str)]
 

@@ -48,12 +48,91 @@ class PyReader:
                     else:
                         batch = jax.tree.map(jax.device_put, batch)
                     q.put(batch)
-            finally:
                 q.put(_END)
+            except BaseException as e:   # surface reader errors to the
+                q.put(e)                 # consumer, never swallow them
+                                         # as a clean end-of-epoch
 
         threading.Thread(target=worker, daemon=True).start()
         while True:
             b = q.get()
             if b is _END:
                 return
+            if isinstance(b, BaseException):
+                raise b
             yield b
+
+
+class DataLoader:
+    """fluid.io.DataLoader parity (reader.py's 1.5-era successor to
+    PyReader): constructed via from_generator / from_dataset, fed by
+    set_sample_generator / set_sample_list_generator /
+    set_batch_generator, iterated for prefetched feed batches."""
+
+    def __init__(self, feed_list=None, capacity=None, iterable=True,
+                 return_list=False, use_double_buffer=True):
+        if not iterable:
+            raise NotImplementedError(
+                "DataLoader(iterable=False) (start()/reset() protocol) is "
+                "not supported — iterate the loader directly; the executor "
+                "has no program-embedded reader ops to drive")
+        self._inner = PyReader(feed_list=feed_list, capacity=capacity,
+                               iterable=iterable, return_list=return_list)
+        self.feed_list = feed_list
+        self.return_list = return_list
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False):
+        return DataLoader(feed_list=feed_list, capacity=capacity,
+                          iterable=iterable, return_list=return_list,
+                          use_double_buffer=use_double_buffer)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        """Iterate a fluid_dataset (InMemory/Queue) as feed dicts."""
+        dataset.drop_last = drop_last
+        loader = DataLoader()
+        loader._iter_fn = lambda: iter(dataset)
+        return loader
+
+    # -- feeding -----------------------------------------------------------
+    def _need_feed_list(self, api):
+        if self.feed_list is None:
+            raise ValueError(
+                f"{api} needs the DataLoader built with feed_list= "
+                f"(sample tuples are matched to feed names)")
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        self._need_feed_list("set_sample_generator")
+        from paddle_tpu.dataio.feeder import batch_reader
+        self._inner.decorate_sample_list_generator(
+            batch_reader(reader, batch_size, drop_last=drop_last), places)
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        self._need_feed_list("set_sample_list_generator")
+        self._inner.decorate_sample_list_generator(reader, places)
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._inner.decorate_batch_generator(reader, places)
+        return self
+
+    def __iter__(self):
+        it_fn = getattr(self, "_iter_fn", None)
+        if it_fn is not None:
+            return it_fn()
+        it = iter(self._inner)
+        if not self.return_list or self.feed_list is None:
+            return it
+        from paddle_tpu.dataio.feeder import feed_names_of
+        names = feed_names_of(self.feed_list)
+        return ([b[n] for n in names] if isinstance(b, dict) else b
+                for b in it)
+
+
+__all__.append("DataLoader")

@@ -134,8 +134,15 @@ class TestInstallCheckAndDygraphIO:
         env = dict(os.environ,
                    JAX_PLATFORMS="cpu",
                    XLA_FLAGS="--xla_force_host_platform_device_count=8")
-        r = subprocess.run([sys.executable, "-c", code], env=env,
-                           capture_output=True, text=True, timeout=300)
+        # jax's virtual-multi-device CPU collectives occasionally abort
+        # under machine load (observed ~1/20 under the full suite):
+        # retry a couple of times before declaring the install broken
+        for attempt in range(3):
+            r = subprocess.run([sys.executable, "-c", code], env=env,
+                               capture_output=True, text=True,
+                               timeout=300)
+            if r.returncode == 0:
+                break
         assert r.returncode == 0, r.stderr[-800:]
         assert "works" in r.stdout
         assert "data parallel x8: OK" in r.stdout

@@ -1,0 +1,101 @@
+"""fluid.io.DataLoader parity tests (from_generator / from_dataset)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.dataio import DataLoader, DatasetFactory, dataset
+
+
+class TestDataLoader:
+    def test_from_generator_sample_generator_trains(self):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[13], dtype="float32")
+                y = pt.static.data("y", shape=[1], dtype="float32")
+                loss = pt.layers.mean(pt.layers.square_error_cost(
+                    pt.layers.fc(x, size=1), y))
+                pt.optimizer.AdamOptimizer(0.02).minimize(loss)
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                loader = DataLoader.from_generator(
+                    feed_list=[x, y], capacity=16)
+                loader.set_sample_generator(
+                    dataset.uci_housing.train(), batch_size=32)
+                first = last = None
+                for epoch in range(6):
+                    for feed in loader:
+                        (lv,) = exe.run(main, feed=feed,
+                                        fetch_list=[loss.name])
+                        first = first if first is not None else float(lv)
+                        last = float(lv)
+            assert last < first
+        finally:
+            pt.disable_static()
+
+    def test_set_batch_generator(self):
+        loader = DataLoader.from_generator(capacity=4)
+
+        def gen():
+            for i in range(3):
+                yield {"a": np.full((2, 2), i, np.float32)}
+
+        loader.set_batch_generator(gen)
+        batches = list(loader)
+        assert len(batches) == 3
+        assert float(np.asarray(batches[2]["a"])[0, 0]) == 2.0
+
+    def test_return_list_mode(self):
+        loader = DataLoader.from_generator(
+            feed_list=["a", "b"], capacity=4, return_list=True)
+
+        def gen():
+            yield {"a": np.ones(2, np.float32),
+                   "b": np.zeros(2, np.float32)}
+
+        loader.set_batch_generator(gen)
+        (out,) = list(loader)
+        assert isinstance(out, list) and len(out) == 2
+        np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+
+    def test_reader_errors_propagate(self):
+        loader = DataLoader.from_generator(capacity=2)
+
+        def bad():
+            yield {"a": np.ones(2, np.float32)}
+            raise RuntimeError("corrupt record")
+
+        loader.set_batch_generator(bad)
+        with pytest.raises(RuntimeError, match="corrupt record"):
+            list(loader)
+
+    def test_feed_list_required_for_sample_generators(self):
+        loader = DataLoader.from_generator(capacity=2)
+        with pytest.raises(ValueError, match="feed_list"):
+            loader.set_sample_generator(lambda: iter(()), batch_size=2)
+
+    def test_iterable_false_rejected(self):
+        with pytest.raises(NotImplementedError, match="iterable"):
+            DataLoader.from_generator(feed_list=["a"], iterable=False)
+
+    def test_from_dataset(self, tmp_path):
+        files = []
+        rng = np.random.RandomState(0)
+        for i in range(2):
+            p = tmp_path / f"f{i}"
+            with open(p, "w") as f:
+                for _ in range(8):
+                    v = rng.rand(3)
+                    f.write("3 " + " ".join(f"{q:.4f}" for q in v)
+                            + " 1 0.5\n")
+            files.append(str(p))
+        ds = DatasetFactory().create_dataset("QueueDataset")
+        ds.set_filelist(files)
+        ds.set_batch_size(4)
+        ds.set_use_var([("x", "float32"), ("y", "float32")])
+        loader = DataLoader.from_dataset(ds)
+        batches = list(loader)
+        assert len(batches) == 4
+        assert batches[0]["x"].shape == (4, 3)
