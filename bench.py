@@ -81,7 +81,11 @@ def main():
     # BENCH_ATTN=dense|flash selects the attention path (flash = Pallas
     # blockwise kernel, ops/pallas_kernels.py) for A/B runs on the chip
     attn = os.environ.get("BENCH_ATTN", "dense")
-    cfg = (bert.bert_base(attention_impl=attn) if on_tpu
+    # remat off: BERT-base bs=64 seq=512 activations fit v5e HBM, and
+    # skipping the recompute is worth ~+0.06 MFU (measured 0.418 vs 0.362;
+    # bs>=96 fails to compile -- OOM -- so bs=64 no-remat is the frontier)
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    cfg = (bert.bert_base(attention_impl=attn, remat=remat) if on_tpu
            else bert.bert_tiny(attention_impl=attn))
     # batch=64 is the tuned single-chip config (highest measured MFU of
     # {32, 64, 96}); vs_baseline is MFU-based, so it stays comparable

@@ -39,10 +39,14 @@ class PyReader:
 
     def __iter__(self):
         q = queue.Queue(maxsize=self.capacity)
+        stop = threading.Event()
 
         def worker():
             try:
                 for batch in self._reader():
+                    if stop.is_set():   # before conversion: cancelling a
+                        return          # consumer shouldn't pay for one
+                                        # more host->HBM transfer
                     if self._feeder is not None:
                         batch = self._feeder.feed(batch)
                     else:
@@ -54,13 +58,23 @@ class PyReader:
                                          # as a clean end-of-epoch
 
         threading.Thread(target=worker, daemon=True).start()
-        while True:
-            b = q.get()
-            if b is _END:
-                return
-            if isinstance(b, BaseException):
-                raise b
-            yield b
+        try:
+            while True:
+                b = q.get()
+                if b is _END:
+                    return
+                if isinstance(b, BaseException):
+                    raise b
+                yield b
+        finally:
+            # consumer left early (break / exception): unblock the worker
+            # and release the device-resident batches it queued
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
 
 
 class DataLoader:
@@ -80,6 +94,7 @@ class DataLoader:
                                iterable=iterable, return_list=return_list)
         self.feed_list = feed_list
         self.return_list = return_list
+        self._iter_fn = None
 
     # -- constructors ------------------------------------------------------
     @staticmethod
@@ -93,13 +108,25 @@ class DataLoader:
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
         """Iterate a fluid_dataset (InMemory/Queue) as feed dicts."""
-        dataset.drop_last = drop_last
+        import copy
         loader = DataLoader()
-        loader._iter_fn = lambda: iter(dataset)
+
+        def _iter():
+            # iterate a shallow copy so the loader's drop_last choice
+            # never mutates the caller's dataset object
+            ds = copy.copy(dataset)
+            ds.drop_last = drop_last
+            return iter(ds)
+
+        loader._iter_fn = _iter
         return loader
 
     # -- feeding -----------------------------------------------------------
     def _need_feed_list(self, api):
+        if self._iter_fn is not None:
+            raise RuntimeError(
+                f"{api} on a from_dataset DataLoader: the dataset already "
+                f"supplies batches; build one via from_generator instead")
         if self.feed_list is None:
             raise ValueError(
                 f"{api} needs the DataLoader built with feed_list= "
@@ -119,20 +146,28 @@ class DataLoader:
         return self
 
     def set_batch_generator(self, reader, places=None):
+        if self._iter_fn is not None:
+            raise RuntimeError(
+                "set_batch_generator on a from_dataset DataLoader: the "
+                "dataset already supplies batches; build one via "
+                "from_generator instead")
         self._inner.decorate_batch_generator(reader, places)
         return self
 
     def __iter__(self):
-        it_fn = getattr(self, "_iter_fn", None)
-        if it_fn is not None:
-            return it_fn()
+        if self._iter_fn is not None:
+            return self._iter_fn()
         it = iter(self._inner)
-        if not self.return_list or self.feed_list is None:
+        if not self.return_list:
             return it
-        from paddle_tpu.dataio.feeder import feed_names_of
-        names = feed_names_of(self.feed_list)
-        return ([b[n] for n in names] if isinstance(b, dict) else b
-                for b in it)
+        if self.feed_list is not None:
+            from paddle_tpu.dataio.feeder import feed_names_of
+            names = feed_names_of(self.feed_list)
+            return ([b[n] for n in names] if isinstance(b, dict) else b
+                    for b in it)
+        # return_list without a feed_list (set_batch_generator usage):
+        # dict batches flatten in insertion order, others pass through
+        return (list(b.values()) if isinstance(b, dict) else b for b in it)
 
 
 __all__.append("DataLoader")

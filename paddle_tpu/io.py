@@ -16,11 +16,13 @@ from paddle_tpu.static.io import (
     save_inference_model, load_inference_model, save_params, load_params,
     save_persistables, load_persistables,
 )
+from paddle_tpu.dataio.pyreader import DataLoader, PyReader
 
 __all__ = [
     "save_inference_model", "load_inference_model", "save_params",
     "load_params", "save_persistables", "load_persistables",
     "save_pytree", "load_pytree", "save_dygraph", "load_dygraph",
+    "DataLoader", "PyReader",
 ]
 
 

@@ -143,6 +143,13 @@ class TestInstallCheckAndDygraphIO:
                                timeout=300)
             if r.returncode == 0:
                 break
+            # only the known abort mode is flaky: the process dies on a
+            # signal (negative returncode) inside the virtual-device
+            # collective. A python-level failure (returncode 1: import
+            # error, assert, wrong device count) is deterministic - fail
+            # fast instead of masking it behind 3 x 300s retries
+            if r.returncode > 0:
+                break
         assert r.returncode == 0, r.stderr[-800:]
         assert "works" in r.stdout
         assert "data parallel x8: OK" in r.stdout
