@@ -166,8 +166,12 @@ class DataLoader:
             return ([b[n] for n in names] if isinstance(b, dict) else b
                     for b in it)
         # return_list without a feed_list (set_batch_generator usage):
-        # dict batches flatten in insertion order, others pass through
-        return (list(b.values()) if isinstance(b, dict) else b for b in it)
+        # dict batches flatten in sorted-key order — the worker's
+        # jax.tree.map(device_put) already canonicalises dicts to sorted
+        # keys, so sorting here is the only order that is deterministic
+        # end to end; others pass through
+        return ([b[n] for n in sorted(b)] if isinstance(b, dict) else b
+                for b in it)
 
 
 __all__.append("DataLoader")

@@ -59,6 +59,24 @@ def _vmem_spec(*args, **kwargs):
 # flash attention
 # ---------------------------------------------------------------------------
 
+def _masked_scores(qs, k_blk, b_blk, q0, k0, causal):
+    """Scaled scores for one (q-block, k-block) tile: qs is pre-scaled
+    [bq, d], k_blk [bk, d], b_blk [bk] additive key bias; q0/k0 are the
+    tile's absolute row/col offsets for the causal mask. Shared by the
+    forward and both backward kernels so masking/bias can never drift
+    between them."""
+    s = jax.lax.dot_general(
+        qs, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [bq, bk]
+    s = s + b_blk[None, :]
+    if causal:
+        bq, bk = s.shape
+        qi = q0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        ki = k0 + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(ki <= qi, s, _NEG_INF)
+    return s
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
                       sm_scale, block_k, causal, seq_len, block_q):
     """One (batch, head, q-block) cell: stream K/V blocks, keep running
@@ -74,18 +92,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *,
             .astype(jnp.float32)                           # [bk, d]
         v_blk = v_ref[0, 0, pl.ds(jk * block_k, block_k), :] \
             .astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [bq, bk]
         b_blk = bias_ref[0, 0, pl.ds(jk * block_k, block_k)] \
             .astype(jnp.float32)                           # [bk]
-        s = s + b_blk[None, :]
-        if causal:
-            qi = iq * block_q + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            ki = jk * block_k + lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(ki <= qi, s, _NEG_INF)
+        s = _masked_scores(q, k_blk, b_blk, iq * block_q, jk * block_k,
+                           causal)
         m_cur = jnp.max(s, axis=-1)                        # [bq]
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
@@ -163,61 +173,171 @@ def _flash_attention_fwd(q, k, v, bias, sm_scale, causal, block_q, block_k,
     return o, (q, k, v, bias, o, lse)
 
 
+def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                           bias_ref, dk_ref, dv_ref, dbh_ref, *,
+                           sm_scale, block_q, block_k, causal, seq_len):
+    """One (batch, head, k-block) cell: stream Q/dO blocks, recompute the
+    probabilities from the saved logsumexp, accumulate dK/dV (and the
+    per-head key-bias grad) in VMEM — scores never touch HBM."""
+    k_blk = k_ref[0, 0].astype(jnp.float32)                # [bk, d]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    b_blk = bias_ref[0, 0].astype(jnp.float32)             # [bk]
+    bk, d = k_blk.shape
+    ik = pl.program_id(2)
+    nq = seq_len // block_q
+
+    def body(jq, carry):
+        dk_acc, dv_acc, db_acc = carry
+        qs = q_ref[0, 0, pl.ds(jq * block_q, block_q), :] \
+            .astype(jnp.float32) * sm_scale                # [bq, d]
+        do_blk = do_ref[0, 0, pl.ds(jq * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(jq * block_q, block_q), 0]
+        d_blk = delta_ref[0, 0, pl.ds(jq * block_q, block_q), 0]
+        s = _masked_scores(qs, k_blk, b_blk, jq * block_q, ik * block_k,
+                           causal)
+        p = jnp.exp(s - lse_blk[:, None])                  # [bq, bk]
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        dz = p * (dp - d_blk[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            dz, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, d]
+        db_acc = db_acc + jnp.sum(dz, axis=0)              # [bk]
+        return dk_acc, dv_acc, db_acc
+
+    # causal: q-blocks strictly above the diagonal see only masked scores
+    jq0 = (ik * block_k) // block_q if causal else 0
+    dk, dv, db = lax.fori_loop(
+        jq0, nq, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32),
+         jnp.zeros((bk,), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    dbh_ref[0, 0, :, 0] = db
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                         bias_ref, dq_ref, *,
+                         sm_scale, block_q, block_k, causal, seq_len):
+    """One (batch, head, q-block) cell: stream K/V blocks, accumulate dQ."""
+    qs = q_ref[0, 0].astype(jnp.float32) * sm_scale        # [bq, d]
+    do_blk = do_ref[0, 0].astype(jnp.float32)
+    lse_blk = lse_ref[0, 0, :, 0]                          # [bq]
+    d_blk = delta_ref[0, 0, :, 0]
+    bq, d = qs.shape
+    iq = pl.program_id(2)
+    nk = seq_len // block_k
+
+    def body(jk, dq_acc):
+        k_blk = k_ref[0, 0, pl.ds(jk * block_k, block_k), :] \
+            .astype(jnp.float32)                           # [bk, d]
+        v_blk = v_ref[0, 0, pl.ds(jk * block_k, block_k), :] \
+            .astype(jnp.float32)
+        b_blk = bias_ref[0, 0, pl.ds(jk * block_k, block_k)] \
+            .astype(jnp.float32)
+        s = _masked_scores(qs, k_blk, b_blk, iq * block_q, jk * block_k,
+                           causal)
+        p = jnp.exp(s - lse_blk[:, None])
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dz = p * (dp - d_blk[:, None])
+        return dq_acc + jax.lax.dot_general(
+            dz, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        nk_eff = jnp.minimum(
+            nk, ((iq + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk_eff = nk
+    dq = lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = (dq * sm_scale).astype(dq_ref.dtype)
+
+
 def _flash_attention_bwd(sm_scale, causal, block_q, block_k, interpret,
                          res, do):
-    """Blockwise recompute backward (standard flash formulation), written
-    for XLA: scan over q blocks keeps live memory at
-    O(block_q · S) instead of O(S²)."""
+    """Blockwise recompute backward as two Pallas kernels (the standard
+    flash split): dK/dV gridded over key blocks, dQ over query blocks.
+    Live memory stays O(block · S); the [S, S] score matrix never exists."""
     q, k, v, bias, o, lse = res
     b, h, s, d = q.shape
-    bq = min(block_q, s)
-    nblk = s // bq
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1)
-
-    def blocks(t):  # [B,H,S,...] -> [nblk, B,H,bq,...]
-        return jnp.moveaxis(
-            t.reshape(t.shape[:2] + (nblk, bq) + t.shape[3:]), 2, 0)
-
-    qb = blocks(q.astype(jnp.float32))
-    dob = blocks(do.astype(jnp.float32))
-    lseb = blocks(lse)
-    deltab = blocks(delta)
-    q_idx = jnp.arange(s).reshape(nblk, bq)
-    k_idx = jnp.arange(s)
-
-    def step(carry, xs):
-        dk_acc, dv_acc, db_acc = carry
-        q_blk, do_blk, lse_blk, d_blk, qi = xs
-        sres = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kf) * sm_scale
-        sres = sres + bias[:, None, None, :].astype(jnp.float32)
-        if causal:
-            sres = jnp.where(k_idx[None, None, None, :]
-                             <= qi[None, None, :, None], sres, _NEG_INF)
-        p = jnp.exp(sres - lse_blk[..., None])             # [B,H,bq,S]
-        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, do_blk)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do_blk, vf)
-        ds = p * (dp - d_blk[..., None]) * sm_scale
-        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk)
-        db_acc = db_acc + jnp.sum(ds, axis=(1, 2)) / sm_scale
-        return (dk_acc, dv_acc, db_acc), dq_blk
-
-    zero_kv = jnp.zeros((b, h, s, d), jnp.float32)
-    (dk, dv, dbias), dqb = lax.scan(
-        step, (zero_kv, zero_kv, jnp.zeros((b, s), jnp.float32)),
-        (qb, dob, lseb, deltab, q_idx))
-    dq = jnp.moveaxis(dqb, 0, 2).reshape(b, h, s, d)
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            dbias.astype(bias.dtype))
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), -1,
+                    keepdims=True)                         # [B,H,S,1]
+    lse4 = lse[..., None]                                  # [B,H,S,1]
+    bias3 = bias[:, None, :]                               # [B,1,S]
+    kernel_kv = functools.partial(
+        _flash_bwd_dkdv_kernel, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, causal=causal, seq_len=s)
+    dk, dv, dbh = pl.pallas_call(
+        kernel_kv,
+        grid=(b, h, s // block_k),
+        in_specs=[
+            _vmem_spec((1, 1, s, d), lambda ib, ih, ik: (ib, ih, 0, 0)),
+            _vmem_spec((1, 1, s, d), lambda ib, ih, ik: (ib, ih, 0, 0)),
+            _vmem_spec((1, 1, s, 1), lambda ib, ih, ik: (ib, ih, 0, 0)),
+            _vmem_spec((1, 1, s, 1), lambda ib, ih, ik: (ib, ih, 0, 0)),
+            _vmem_spec((1, 1, block_k, d),
+                       lambda ib, ih, ik: (ib, ih, ik, 0)),
+            _vmem_spec((1, 1, block_k, d),
+                       lambda ib, ih, ik: (ib, ih, ik, 0)),
+            _vmem_spec((1, 1, block_k), lambda ib, ih, ik: (ib, 0, ik)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, block_k, d),
+                       lambda ib, ih, ik: (ib, ih, ik, 0)),
+            _vmem_spec((1, 1, block_k, d),
+                       lambda ib, ih, ik: (ib, ih, ik, 0)),
+            _vmem_spec((1, 1, block_k, 1),
+                       lambda ib, ih, ik: (ib, ih, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, do, lse4, delta, k, v, bias3)
+    kernel_q = functools.partial(
+        _flash_bwd_dq_kernel, sm_scale=sm_scale, block_q=block_q,
+        block_k=block_k, causal=causal, seq_len=s)
+    dq = pl.pallas_call(
+        kernel_q,
+        grid=(b, h, s // block_q),
+        in_specs=[
+            _vmem_spec((1, 1, block_q, d),
+                       lambda ib, ih, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, block_q, d),
+                       lambda ib, ih, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, block_q, 1),
+                       lambda ib, ih, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, block_q, 1),
+                       lambda ib, ih, iq: (ib, ih, iq, 0)),
+            _vmem_spec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+            _vmem_spec((1, 1, s, d), lambda ib, ih, iq: (ib, ih, 0, 0)),
+            _vmem_spec((1, 1, s), lambda ib, ih, iq: (ib, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, 1, block_q, d),
+                       lambda ib, ih, iq: (ib, ih, iq, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        interpret=interpret,
+    )(q, do, lse4, delta, k, v, bias3)[0]
+    dbias = jnp.sum(dbh[..., 0], axis=1)                   # [B,S]
+    return dq, dk, dv, dbias.astype(bias.dtype)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
 def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=512, block_k=512, interpret=None):
     """Blockwise (flash) attention.
 
     q, k, v: [B, H, S, D]. bias: optional [B, S] additive key bias
@@ -239,11 +359,21 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
         block_q = block_k = s
         pad = 0
     else:
-        block_q = min(block_q, s)
-        block_k = min(block_k, s)
-        # the grid floors by block_q and the kv loop by block_k — S must
-        # be a multiple of BOTH or trailing keys are silently dropped
-        pad = (-s) % math.lcm(block_q, block_k)
+        # pad only to the 128-lane grain, then shrink each block to the
+        # largest power-of-two (>=128) dividing the padded length — a
+        # S=640 input runs at block 128 with zero pad instead of paying
+        # ~60% masked pad work at block 512
+        pad = (-s) % 128
+        sp = s + pad
+        while block_q > 128 and sp % block_q:
+            block_q //= 2
+        while block_k > 128 and sp % block_k:
+            block_k //= 2
+        if sp % block_q or sp % block_k:
+            # non-power-of-two caller blocks: fall back to lcm padding
+            # (the grid floors by block_q and the kv loops by block_k —
+            # S must be a multiple of BOTH or trailing keys are dropped)
+            pad = (-s) % math.lcm(block_q, block_k)
     if pad:
         zf = ((0, 0), (0, 0), (0, pad), (0, 0))
         q = jnp.pad(q, zf)
