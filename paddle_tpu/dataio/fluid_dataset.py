@@ -30,7 +30,14 @@ __all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
 
 def _parse_multislot(line, slots):
     """MultiSlotDataFeed line format (data_feed.cc CheckFile): for each
-    slot, '<n> v1 ... vn' space-separated; dtype from the slot's var."""
+    slot, '<n> v1 ... vn' space-separated; dtype from the slot's var.
+    Parses through the native C parser when the toolchain is up
+    (native/src/strings.cc pt_parse_multislot — the reference parses in
+    C++ too); pure-Python fallback below keeps identical semantics."""
+    if _native.available():
+        arrs = _native.parse_multislot(line, [dt for _n, dt in slots])
+        return [a if dt in ("int64", "int32") else a.astype(np.float32)
+                for a, (_n, dt) in zip(arrs, slots)]
     toks = line.split()
     out = []
     i = 0

@@ -327,7 +327,9 @@ _EXCLUDE = {"fc_act", "batch_norm", "sequence_mask",
             # directly below, no static-program wrapper
             "rpn_target_assign", "generate_proposal_labels",
             "detection_map", "distribute_fpn_proposals",
-            "collect_fpn_proposals", "retinanet_detection_output"}
+            "collect_fpn_proposals", "retinanet_detection_output",
+            # host/list ops from ops.aliases: no static wrapper either
+            "delete_var", "alloc_continuous_space"}
 _this = globals()
 for _n in dir(_ops):
     if _n.startswith("_") or _n in _EXCLUDE:
@@ -346,6 +348,8 @@ detection_map = _ops.detection_map
 distribute_fpn_proposals = _ops.distribute_fpn_proposals
 collect_fpn_proposals = _ops.collect_fpn_proposals
 retinanet_detection_output = _ops.retinanet_detection_output
+delete_var = _ops.delete_var
+alloc_continuous_space = _ops.alloc_continuous_space
 
 
 # ---------------------------------------------------------------------------

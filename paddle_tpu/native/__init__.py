@@ -15,7 +15,7 @@ import subprocess
 import threading
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
-_SOURCES = ["recordio.cc", "data_pipeline.cc", "arena.cc"]
+_SOURCES = ["recordio.cc", "data_pipeline.cc", "arena.cc", "strings.cc"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None
@@ -81,6 +81,17 @@ def _bind(lib):
     lib.pt_arena_peak.restype = c_long
     lib.pt_arena_peak.argtypes = [c_void_p]
     lib.pt_arena_destroy.argtypes = [c_void_p]
+    c_double_p = ctypes.POINTER(ctypes.c_double)
+    c_long_p = ctypes.POINTER(c_long)
+    lib.pt_parse_multislot.restype = c_long
+    lib.pt_parse_multislot.argtypes = [
+        c_char_p, c_long, c_long, ctypes.POINTER(ctypes.c_byte),
+        c_double_p, ctypes.POINTER(ctypes.c_longlong), c_long, c_long_p]
+    lib.pt_split.restype = c_long
+    lib.pt_split.argtypes = [c_char_p, c_long, ctypes.c_char, c_long_p,
+                             c_long]
+    lib.pt_pretty_log.argtypes = [c_char_p, c_char_p]
+    lib.pt_pretty_log.restype = None
     return lib
 
 
@@ -261,3 +272,84 @@ class HostArena:
         if self._h is not None:
             self._lib.pt_arena_destroy(self._h)
             self._h = None
+
+
+# ---------------------------------------------------------------------------
+# string utils (ref: paddle/fluid/string — SURVEY §2.1 "string utils" row)
+# ---------------------------------------------------------------------------
+def parse_multislot(line, slots, cap=None):
+    """Parse one MultiSlot sample line ('<n> v1 .. vn' per slot) at C
+    speed. ``slots`` is either a slot count (all-float) or a sequence of
+    dtype strings ('int64'/'int32' slots parse exactly via strtoll —
+    never through double, which corrupts ids above 2**53). Returns a
+    list of numpy arrays (int64 for int slots, float64 otherwise).
+    Raises ValueError on malformed lines with the same diagnostics as
+    the Python parser."""
+    import numpy as np
+    lib = get_lib()
+    data = line.encode() if isinstance(line, str) else bytes(line)
+    if isinstance(slots, int):
+        dtypes = ["float32"] * slots
+    else:
+        dtypes = list(slots)
+    n_slots = len(dtypes)
+    is_int = np.asarray([1 if d in ("int64", "int32") else 0
+                         for d in dtypes], np.int8)
+    if cap is None:
+        # every value needs >= 2 bytes ("v ") — this bound can't be hit
+        # by a well-formed line, so no retry loop is needed
+        cap = max(16, len(data) // 2 + 8)
+    fout = np.empty(cap, np.float64)
+    iout = np.empty(cap, np.int64)
+    sizes = np.zeros(n_slots, np.int64)
+    total = lib.pt_parse_multislot(
+        data, len(data), n_slots,
+        is_int.ctypes.data_as(ctypes.POINTER(ctypes.c_byte)),
+        fout.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        iout.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), cap,
+        sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_long)))
+    if total < 0:
+        raise ValueError(_last_error(lib))
+    res, off = [], 0
+    for n, d in zip(sizes, dtypes):
+        buf = iout if d in ("int64", "int32") else fout
+        res.append(buf[off:off + n].copy())
+        off += int(n)
+    return res
+
+
+def split(s, sep=" ", max_tokens=1 << 16):
+    """Native tokenizer (ref: string/split.h). Returns list of str."""
+    import numpy as np
+    lib = get_lib()
+    data = s.encode() if isinstance(s, str) else bytes(s)
+    offs = np.zeros(2 * max_tokens, np.int64)
+    n = lib.pt_split(data, len(data), ctypes.c_char(sep.encode()),
+                     offs.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+                     max_tokens)
+    return [data[offs[2 * i]:offs[2 * i + 1]].decode() for i in range(n)]
+
+
+def pretty_log(tag, msg):
+    """Tagged stderr banner (ref: string/pretty_log.h)."""
+    get_lib().pt_pretty_log(str(tag).encode(), str(msg).encode())
+
+
+def build_train_demo():
+    """Build the C++-only training demo binary (src/train_demo.cc — the
+    paddle/fluid/train/demo analog: native runtime trains a model with
+    no Python in the loop). Returns the binary path."""
+    out_dir = os.path.join(os.path.dirname(__file__), "_build")
+    os.makedirs(out_dir, exist_ok=True)
+    h = hashlib.sha256(_src_fingerprint().encode())
+    with open(os.path.join(_SRC_DIR, "train_demo.cc"), "rb") as f:
+        h.update(f.read())
+    exe = os.path.join(out_dir, f"train_demo_{h.hexdigest()[:16]}")
+    if not os.path.exists(exe):
+        tmp = f"{exe}.{os.getpid()}.tmp"
+        srcs = [os.path.join(_SRC_DIR, s)
+                for s in _SOURCES + ["train_demo.cc"]]
+        subprocess.run(["g++", "-std=c++17", "-O2", "-pthread", *srcs,
+                        "-lz", "-o", tmp], check=True, capture_output=True)
+        os.replace(tmp, exe)
+    return exe

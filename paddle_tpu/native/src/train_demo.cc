@@ -1,0 +1,127 @@
+// C++-only training demo — proof the native runtime slice runs without
+// Python (ref capability: paddle/fluid/train/demo +
+// test_train_recognize_digits.cc, SURVEY §2.10). The TPU compute path
+// is XLA; what stays native here is what the reference keeps native:
+// storage format (recordio.cc), sample parsing (strings.cc
+// pt_parse_multislot), host memory (arena.cc). The model is linear
+// regression trained by plain SGD on the host — the fit_a_line book
+// demo's shape (tests/book/ fit_a_line) end to end in one binary.
+//
+// Usage: train_demo <file.recordio> <n_features> [epochs] [lr]
+// Each record is one MultiSlot text line: "<D> x1..xD 1 y".
+// Prints per-epoch mse and the reference benchmark's throughput line
+// format "Total examples: %d, total time: %.5f, %.5f examples/sec"
+// (ref: benchmark/fluid/fluid_benchmark.py:297-300).
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+// from recordio.cc / arena.cc / strings.cc (linked together)
+void* pt_recordio_scanner_open(const char* path);
+void* pt_recordio_next(void* h, long* size_out);
+void pt_recordio_scanner_close(void* h);
+const char* pt_last_error();
+void* pt_arena_create(long total_bytes, long min_block);
+void* pt_arena_alloc(void* arena, long nbytes);
+void pt_arena_destroy(void* arena);
+long pt_parse_multislot(const char* line, long line_len, long n_slots,
+                        const signed char* is_int, double* fout,
+                        long long* iout, long cap, long* sizes);
+void pt_pretty_log(const char* tag, const char* msg);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <file.recordio> <n_features> [epochs] [lr]\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* path = argv[1];
+  const long d = std::strtol(argv[2], nullptr, 10);
+  const int epochs = argc > 3 ? std::atoi(argv[3]) : 20;
+  const double lr = argc > 4 ? std::atof(argv[4]) : 0.05;
+
+  // ---- load: recordio scan -> multislot parse -> arena-backed matrix
+  void* arena = pt_arena_create(64L << 20, 64);
+  if (!arena) {
+    std::fprintf(stderr, "arena: %s\n", pt_last_error());
+    return 1;
+  }
+  std::vector<double*> xs;
+  std::vector<double> ys;
+  void* sc = pt_recordio_scanner_open(path);
+  if (!sc) {
+    std::fprintf(stderr, "scanner: %s\n", pt_last_error());
+    return 1;
+  }
+  std::vector<double> buf(d + 1);
+  long sizes[2];
+  for (;;) {
+    long n = 0;
+    void* rec = pt_recordio_next(sc, &n);
+    if (n == -1) break;  // EOF
+    if (n == -2) {
+      std::fprintf(stderr, "scan: %s\n", pt_last_error());
+      return 1;
+    }
+    long total = pt_parse_multislot(static_cast<const char*>(rec), n, 2,
+                                    nullptr, buf.data(), nullptr, d + 1,
+                                    sizes);
+    if (total < 0 || sizes[0] != d || sizes[1] != 1) {
+      std::fprintf(stderr, "parse: %s\n", pt_last_error());
+      return 1;
+    }
+    double* row =
+        static_cast<double*>(pt_arena_alloc(arena, d * sizeof(double)));
+    if (!row) {
+      std::fprintf(stderr, "alloc: %s\n", pt_last_error());
+      return 1;
+    }
+    std::memcpy(row, buf.data(), d * sizeof(double));
+    xs.push_back(row);
+    ys.push_back(buf[d]);
+  }
+  pt_recordio_scanner_close(sc);
+  const long n_samples = static_cast<long>(xs.size());
+  if (n_samples == 0) {
+    std::fprintf(stderr, "no samples in %s\n", path);
+    return 1;
+  }
+  pt_pretty_log("train_demo", "data loaded; training w/ host SGD");
+
+  // ---- train: full-batch gradient descent on mse
+  std::vector<double> w(d, 0.0);
+  double b = 0.0;
+  double mse = 0.0;
+  auto t0 = std::chrono::steady_clock::now();
+  for (int e = 0; e < epochs; ++e) {
+    std::vector<double> gw(d, 0.0);
+    double gb = 0.0;
+    mse = 0.0;
+    for (long i = 0; i < n_samples; ++i) {
+      double pred = b;
+      for (long j = 0; j < d; ++j) pred += w[j] * xs[i][j];
+      const double err = pred - ys[i];
+      mse += err * err;
+      for (long j = 0; j < d; ++j) gw[j] += 2.0 * err * xs[i][j];
+      gb += 2.0 * err;
+    }
+    mse /= n_samples;
+    for (long j = 0; j < d; ++j) w[j] -= lr * gw[j] / n_samples;
+    b -= lr * gb / n_samples;
+    std::printf("epoch %d mse %.6f\n", e, mse);
+  }
+  const double dt =
+      std::chrono::duration<double>(std::chrono::steady_clock::now() - t0)
+          .count();
+  const long total_examples = n_samples * epochs;
+  std::printf("Total examples: %ld, total time: %.5f, %.5f examples/sec\n",
+              total_examples, dt, total_examples / (dt > 0 ? dt : 1e-9));
+  pt_arena_destroy(arena);
+  return mse < 1e10 ? 0 : 1;
+}

@@ -27,6 +27,7 @@ from paddle_tpu.ops.ctc import *             # noqa: F401,F403
 from paddle_tpu.ops.detection import *       # noqa: F401,F403
 from paddle_tpu.ops.quantize import *        # noqa: F401,F403
 from paddle_tpu.ops.misc import *            # noqa: F401,F403
+from paddle_tpu.ops.aliases import *         # noqa: F401,F403
 from paddle_tpu.ops.tensor_array import *    # noqa: F401,F403
 from paddle_tpu.ops.selected_rows import *   # noqa: F401,F403
 from paddle_tpu.ops import pallas_kernels    # noqa: F401  (module: perf

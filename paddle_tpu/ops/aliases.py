@@ -1,0 +1,94 @@
+"""Long-tail reference op names that are thin TPU-native primitives.
+
+Each function cites the reference op it covers. These live in their own
+module (not misc.py) because `range` shadows the Python builtin.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["range", "alloc_continuous_space", "rnn_memory_helper",
+           "delete_var", "beam_search_decode"]
+
+
+def range(start, end=None, step=1, dtype="int64"):  # noqa: A001
+    """operators/range_op.cc (fluid.layers.range): arithmetic sequence
+    [start, end) with stride ``step``."""
+    if end is None:
+        start, end = 0, start
+    dt = np.dtype(dtype)
+    if not jax.config.jax_enable_x64:   # canonicalize like the rest of jnp
+        dt = {np.dtype(np.int64): np.dtype(np.int32),
+              np.dtype(np.float64): np.dtype(np.float32)}.get(dt, dt)
+    return jnp.arange(start, end, step).astype(dt)
+
+
+def alloc_continuous_space(inputs, set_constant=None):
+    """operators/alloc_continuous_space_op.cc: coalesce a tensor list
+    into ONE flat buffer and return (flat, views) where views alias the
+    buffer's segments with the originals' shapes. This is the
+    fused-allreduce bucketing primitive (SURVEY §2.5 "Fused allreduce"
+    row); on TPU the flat buffer is what a bucketed collective reduces in
+    one shot, and XLA aliases the views back for free."""
+    shapes = [x.shape for x in inputs]
+    sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]
+    if set_constant is not None:
+        flat = jnp.full((sum(sizes),), set_constant, inputs[0].dtype)
+    else:
+        flat = jnp.concatenate([jnp.ravel(x) for x in inputs])
+    views, off = [], 0
+    for s, sz in zip(shapes, sizes):
+        views.append(flat[off:off + sz].reshape(s))
+        off += sz
+    return flat, views
+
+
+def rnn_memory_helper(x):
+    """operators/rnn_memory_helper_op.cc: identity marker the reference
+    inserts so RNN memory vars get gradient plumbing across recurrent
+    step boundaries. Under functional `lax.scan` the carry IS the memory
+    and autodiff flows through it, so this is the identity."""
+    return jnp.asarray(x)
+
+
+def delete_var(scope, *names):
+    """operators/delete_var_op.cc: drop variables from a Scope. Device
+    buffer lifetime is XLA's job (liveness/DCE + donation — SURVEY §7 GC
+    row); this host op releases the host-side references so a long-lived
+    Scope cannot pin dead arrays."""
+    for n in names:
+        scope.drop_var(n)
+
+
+def beam_search_decode(step_ids, step_parents, end_token=None):
+    """operators/beam_search_decode_op.cc: backtrack per-step beam
+    selections into full sequences. step_ids/step_parents: [T, B*beam]
+    (token chosen at each step, and which beam slot it extended — the
+    outputs of ops.misc.beam_search stacked over steps). Returns
+    [B*beam, T] token sequences, best beam first within each batch
+    group; with ``end_token`` set, every position after a sequence's
+    first end_token is overwritten with end_token (the reference op's
+    truncation, kept static-shape). Jittable: the backtrack is a
+    reverse `lax.scan` of gathers."""
+    step_ids = jnp.asarray(step_ids)
+    step_parents = jnp.asarray(step_parents)
+    t_steps, bb = step_ids.shape
+
+    def back(beam, t):
+        tok = step_ids[t][beam]
+        return step_parents[t][beam], tok
+
+    _, toks = lax.scan(back, jnp.arange(bb),
+                       jnp.arange(t_steps - 1, -1, -1))
+    seqs = toks[::-1].T                                    # [BB, T]
+    if end_token is not None:
+        ended = jnp.cumsum(
+            (seqs == end_token).astype(jnp.int32), axis=1) > 0
+        after_end = jnp.concatenate(
+            [jnp.zeros((bb, 1), bool), ended[:, :-1]], axis=1)
+        seqs = jnp.where(after_end, jnp.asarray(end_token, seqs.dtype),
+                         seqs)
+    return seqs

@@ -401,8 +401,8 @@ def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mu_ref, rstd_ref, *, eps):
     y = xc * rstd * g_ref[:].astype(jnp.float32) + b_ref[:].astype(
         jnp.float32)
     y_ref[:] = y.astype(y_ref.dtype)
-    mu_ref[:] = mu[:, 0]
-    rstd_ref[:] = rstd[:, 0]
+    mu_ref[:, 0] = mu[:, 0]
+    rstd_ref[:, 0] = rstd[:, 0]
 
 
 def _ln_fwd(x2, g, b, eps, block_n, interpret):
@@ -419,17 +419,19 @@ def _ln_fwd(x2, g, b, eps, block_n, interpret):
         ],
         out_specs=[
             _vmem_spec((block_n, hdim), lambda i: (i, 0)),
-            _vmem_spec((block_n,), lambda i: (i,)),
-            _vmem_spec((block_n,), lambda i: (i,)),
+            # stats ride as [n, 1] (bn, 1) blocks: Mosaic's layout for a
+            # bare f32[n] is lane-tiled T(1024) and rejects (bn,) blocks
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(x2.shape, x2.dtype),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
         ],
         interpret=interpret,
     )(x2, g, b)
-    return y, mu, rstd
+    return y, mu[:, 0], rstd[:, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -491,37 +493,39 @@ def fused_layer_norm(x, gamma, beta, eps=1e-12, block_n=256,
 
 def _xent_kernel(logits_ref, labels_ref, loss_ref, lse_ref):
     x = logits_ref[:].astype(jnp.float32)                  # [bn, V]
-    lab = labels_ref[:]                                    # [bn]
+    lab = labels_ref[:, 0]                                 # [bn]
     m = jnp.max(x, axis=-1)
     lse = m + jnp.log(jnp.sum(jnp.exp(x - m[:, None]), axis=-1))
     cols = lax.broadcasted_iota(jnp.int32, x.shape, 1)
     picked = jnp.sum(jnp.where(cols == lab[:, None], x, 0.0), axis=-1)
-    loss_ref[:] = lse - picked
-    lse_ref[:] = lse
+    loss_ref[:, 0] = lse - picked
+    lse_ref[:, 0] = lse
 
 
 def _xent_fwd_call(logits2, labels1, block_n, interpret):
     n, v = logits2.shape
     block_n = min(block_n, n)
     grid = (pl.cdiv(n, block_n),)
+    # 1-D vectors ride as [n, 1] blocks (bn, 1): Mosaic's layout for a
+    # bare s32/f32[n] is lane-tiled T(1024) and rejects (bn,) blocks
     loss, lse = pl.pallas_call(
         _xent_kernel,
         grid=grid,
         in_specs=[
             _vmem_spec((block_n, v), lambda i: (i, 0)),
-            _vmem_spec((block_n,), lambda i: (i,)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
         ],
         out_specs=[
-            _vmem_spec((block_n,), lambda i: (i,)),
-            _vmem_spec((block_n,), lambda i: (i,)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
+            _vmem_spec((block_n, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(logits2, labels1)
-    return loss, lse
+    )(logits2, labels1[:, None])
+    return loss[:, 0], lse[:, 0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -562,7 +566,10 @@ def softmax_cross_entropy(logits, labels, block_n=128, interpret=None):
     labels1 = labels.reshape(-1)
     n = logits2.shape[0]
     interpret = _auto_interpret(interpret)
-    block_n = min(block_n, n)
+    # cap the row block so one (block_n, V) fp32 tile (double-buffered)
+    # stays well under the ~16MB VMEM budget even at LM vocab sizes
+    vmem_rows = max(8, (4 << 20) // max(4 * v, 1) // 8 * 8)
+    block_n = min(block_n, vmem_rows, n)
     pad = (-n) % block_n
     if pad:
         logits2 = jnp.pad(logits2, ((0, pad), (0, 0)))

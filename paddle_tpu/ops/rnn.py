@@ -245,3 +245,56 @@ def bidirectional_lstm(x, fwd_w_ih, fwd_w_hh, bwd_w_ih, bwd_w_hh,
     b, _ = lstm(x, bwd_w_ih, bwd_w_hh, b=bwd_b, lengths=lengths,
                 reverse=True)
     return jnp.concatenate([f, b], axis=-1)
+
+
+def attention_lstm(x, c0, attn_w, lstm_w, attn_b=None, lstm_b=None,
+                   h0=None, lengths=None):
+    """Fused attention + LSTM (ref: operators/attention_lstm_op.cc):
+    at each step an additive attention scores every source position
+    against the previous cell state, the attention-weighted context
+    vector feeds one LSTM step. x [B,T,M]; c0 [B,D]; attn_w [M+D,1];
+    lstm_w [M+D,4D] over concat(context, h), gate order i,f,c,o (the
+    library convention, see lstm above). Returns
+    (hidden [B,T,D], (h_T, c_T)); ``lengths`` masks the attention
+    softmax AND freezes each row's (h, c) past its end with zero output
+    — the same padded-step contract as ``lstm`` above."""
+    B, T, M = x.shape
+    D = c0.shape[-1]
+    dt = x.dtype
+    h = h0 if h0 is not None else jnp.zeros((B, D), dt)
+    c = c0.astype(dt)
+    neg = jnp.asarray(-1e9, jnp.float32)
+    amask = (None if lengths is None
+             else (jnp.arange(T)[None, :] < lengths[:, None]))
+
+    def step(carry, t):
+        h, c = carry
+        ce = jnp.broadcast_to(c[:, None, :], (B, T, D))
+        e = (jnp.concatenate([x, ce], axis=-1) @ attn_w)[..., 0]  # [B,T]
+        if attn_b is not None:
+            e = e + attn_b
+        e32 = e.astype(jnp.float32)
+        if amask is not None:
+            e32 = jnp.where(amask, e32, neg)
+        a = jax.nn.softmax(e32, axis=-1).astype(dt)
+        ctx = jnp.einsum("bt,btm->bm", a, x)
+        gates = jnp.concatenate([ctx, h], axis=-1) @ lstm_w
+        if lstm_b is not None:
+            gates = gates + lstm_b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        if lengths is not None:
+            live = (t < lengths)[:, None]
+            h_new = jnp.where(live, h_new, h)
+            c_new = jnp.where(live, c_new, c)
+            out = jnp.where(live, h_new, jnp.zeros_like(h_new))
+        else:
+            out = h_new
+        return (h_new, c_new), out
+
+    (h, c), hs = jax.lax.scan(step, (h, c), jnp.arange(T))
+    return hs.transpose(1, 0, 2), (h, c)
+
+
+__all__.append("attention_lstm")

@@ -36,3 +36,36 @@ def test_invalid_combo_rejected(model, method):
     with pytest.raises(ValueError, match="supports update methods"):
         run_benchmark(parse_args(
             ["--model", model, "--update_method", method, "--smoke"]))
+
+
+class TestOpTester:
+    def test_op_tester_cli(self, capsys):
+        """tools/op_tester.py — the operators/benchmark/op_tester.cc
+        analog — runs every registered op on the tiny preset and emits
+        one JSON line each."""
+        import json
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        import op_tester
+        rc = op_tester.main(["--all", "--repeat", "1", "--preset", "tiny"])
+        assert rc == 0
+        lines = [l for l in capsys.readouterr().out.splitlines() if l]
+        recs = [json.loads(l) for l in lines]
+        assert {r["op"] for r in recs} >= {"matmul", "conv2d",
+                                           "flash_attention", "layer_norm"}
+        assert all("error" not in r and r["ms"] > 0 for r in recs)
+
+    def test_op_tester_grad_mode(self, capsys):
+        import json
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tools"))
+        import op_tester
+        rc = op_tester.main(["--op", "matmul", "--repeat", "1",
+                             "--preset", "tiny", "--grad"])
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rec["grad"] is True and rec["ms"] > 0

@@ -6,6 +6,8 @@ directly, ref: SURVEY §4)."""
 
 import os
 
+import numpy as np
+
 import pytest
 
 from paddle_tpu import native
@@ -205,3 +207,75 @@ class TestFileDataLoader:
                                  nthreads=1) as ld:
             with pytest.raises(IOError, match="cannot open"):
                 list(ld)
+
+
+class TestNativeStrings:
+    def test_parse_multislot(self):
+        from paddle_tpu import native
+        arrs = native.parse_multislot("3 1 2 3 2 0.5 0.25", 2)
+        np.testing.assert_allclose(arrs[0], [1, 2, 3])
+        np.testing.assert_allclose(arrs[1], [0.5, 0.25])
+
+    def test_parse_multislot_errors(self):
+        from paddle_tpu import native
+        import pytest
+        with pytest.raises(ValueError, match="truncated"):
+            native.parse_multislot("2 1.0", 1)
+        with pytest.raises(ValueError, match="bad"):
+            native.parse_multislot("x 1.0", 1)
+
+    def test_split(self):
+        from paddle_tpu import native
+        assert native.split("a bb  ccc") == ["a", "bb", "ccc"]
+        assert native.split("1,2,3", sep=",") == ["1", "2", "3"]
+
+
+class TestCppOnlyTrainDemo:
+    def test_trains_without_python(self, tmp_path):
+        """The paddle/fluid/train/demo analog: write a recordio dataset,
+        run the pure-C++ binary, assert the loss converged and the
+        reference throughput line printed."""
+        import re
+        import subprocess
+        from paddle_tpu import native
+        rng = np.random.RandomState(0)
+        d = 4
+        w_true = rng.randn(d)
+        path = str(tmp_path / "lin.recordio")
+        with native.RecordIOWriter(path) as wr:
+            for _ in range(256):
+                x = rng.randn(d)
+                y = float(x @ w_true + 0.7)
+                line = (f"{d} " + " ".join(f"{v:.6f}" for v in x)
+                        + f" 1 {y:.6f}")
+                wr.write(line.encode())
+        exe = native.build_train_demo()
+        r = subprocess.run([exe, path, str(d), "60", "0.1"],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        mses = [float(m) for m in re.findall(r"mse (\S+)", r.stdout)]
+        assert mses[-1] < 0.01 * mses[0], mses[-1]
+        assert re.search(r"Total examples: \d+, total time: ", r.stdout)
+
+
+class TestNativeStringsDtypes:
+    def test_int64_exact(self):
+        from paddle_tpu import native
+        big = 9007199254740993  # 2**53 + 1: double would corrupt this
+        arrs = native.parse_multislot(f"1 {big} 2 0.5 1.5",
+                                      ["int64", "float32"])
+        assert arrs[0].dtype == np.int64 and arrs[0][0] == big
+        np.testing.assert_allclose(arrs[1], [0.5, 1.5])
+
+    def test_float_in_int_slot_rejected(self):
+        from paddle_tpu import native
+        import pytest
+        with pytest.raises(ValueError, match="bad value"):
+            native.parse_multislot("1 3.7", ["int64"])
+
+    def test_long_line_over_default_cap(self):
+        from paddle_tpu import native
+        n = (1 << 16) + 100   # more values than the old fixed capacity
+        line = f"{n} " + " ".join("1.0" for _ in range(n))
+        arrs = native.parse_multislot(line, ["float32"])
+        assert arrs[0].size == n

@@ -1,0 +1,200 @@
+"""Functional tests for ops.aliases + attention_lstm (the last SURVEY
+§2.4 long-tail names: range, alloc_continuous_space, rnn_memory_helper,
+delete_var, beam_search_decode, attention_lstm)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.ops import aliases as A
+from paddle_tpu.ops.misc import beam_search
+from paddle_tpu.ops.rnn import attention_lstm
+
+
+class TestRange:
+    def test_basic(self):
+        np.testing.assert_array_equal(np.asarray(A.range(2, 10, 3)),
+                                      [2, 5, 8])
+
+    def test_single_arg_and_dtype(self):
+        out = A.range(4, dtype="float32")
+        assert out.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(out), [0, 1, 2, 3])
+
+    def test_layers_surface(self):
+        np.testing.assert_array_equal(np.asarray(pt.layers.range(3)),
+                                      [0, 1, 2])
+
+
+class TestAllocContinuousSpace:
+    def test_pack_views_roundtrip(self):
+        xs = [jnp.ones((2, 3)), jnp.full((4,), 2.0), jnp.zeros((1, 2, 2))]
+        flat, views = A.alloc_continuous_space(xs)
+        assert flat.shape == (6 + 4 + 4,)
+        for x, v in zip(xs, views):
+            assert v.shape == x.shape
+            np.testing.assert_array_equal(np.asarray(v), np.asarray(x))
+
+    def test_set_constant(self):
+        flat, views = A.alloc_continuous_space(
+            [jnp.ones((2, 2)), jnp.ones((3,))], set_constant=0.5)
+        np.testing.assert_allclose(np.asarray(flat), 0.5)
+        assert views[0].shape == (2, 2) and views[1].shape == (3,)
+
+
+class TestSmallHostOps:
+    def test_rnn_memory_helper_identity_and_grad(self):
+        x = jnp.arange(4.0)
+        np.testing.assert_array_equal(
+            np.asarray(A.rnn_memory_helper(x)), np.asarray(x))
+        g = jax.grad(lambda t: A.rnn_memory_helper(t).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+
+    def test_delete_var(self):
+        scope = pt.static.Scope()
+        scope.set_var("a", 1)
+        scope.set_var("b", 2)
+        A.delete_var(scope, "a")
+        assert scope.find_var("a") is None
+        assert scope.find_var("b") == 2
+
+
+class TestBeamSearchDecode:
+    def test_backtrack_known_path(self):
+        # T=3, BB=2 beams; hand-built parent chain
+        step_ids = jnp.asarray([[5, 6], [7, 8], [9, 10]])
+        # step 1: slot0 extends old slot1, slot1 extends old slot0
+        # step 2: both extend slot0
+        step_parents = jnp.asarray([[0, 1], [1, 0], [0, 0]])
+        seqs = np.asarray(A.beam_search_decode(step_ids, step_parents))
+        # slot0 final: tok 9, parent 0 -> step1 slot0: tok 7, parent 1
+        #   -> step0 slot1: tok 6
+        np.testing.assert_array_equal(seqs[0], [6, 7, 9])
+        np.testing.assert_array_equal(seqs[1], [6, 7, 10])
+
+    def test_consistent_with_beam_search_prefixes(self):
+        # run 3 steps of ops.misc.beam_search, then decode must equal the
+        # prefix rows beam_search itself carried
+        rng = np.random.RandomState(0)
+        b, beam, v = 2, 3, 11
+        ids = jnp.zeros((b * beam, 1), jnp.int32)
+        scores = jnp.asarray(np.where(np.arange(b * beam) % beam == 0,
+                                      0.0, -1e9), jnp.float32)
+        step_ids, step_parents = [], []
+        for t in range(3):
+            lp = jnp.asarray(rng.randn(b * beam, v).astype(np.float32))
+            lp = jax.nn.log_softmax(lp)
+            ids, scores, parent = beam_search(lp, scores, ids, beam,
+                                              step=t + 1)
+            step_ids.append(ids[:, -1])
+            step_parents.append(parent % beam
+                                + (jnp.arange(b * beam) // beam) * 0)
+        # rebuild with absolute parents (beam_search returns absolute)
+        step_parents = []
+        ids = jnp.zeros((b * beam, 1), jnp.int32)
+        scores = jnp.asarray(np.where(np.arange(b * beam) % beam == 0,
+                                      0.0, -1e9), jnp.float32)
+        rng = np.random.RandomState(0)
+        step_ids = []
+        for t in range(3):
+            lp = jnp.asarray(rng.randn(b * beam, v).astype(np.float32))
+            lp = jax.nn.log_softmax(lp)
+            ids, scores, parent = beam_search(lp, scores, ids, beam,
+                                              step=t + 1)
+            step_ids.append(ids[:, -1])
+            step_parents.append(parent)
+        decoded = np.asarray(A.beam_search_decode(
+            jnp.stack(step_ids), jnp.stack(step_parents)))
+        np.testing.assert_array_equal(decoded, np.asarray(ids[:, 1:]))
+
+
+class TestAttentionLSTM:
+    def test_shapes_and_state(self):
+        rng = np.random.RandomState(1)
+        B, T, M, D = 2, 5, 4, 3
+        x = jnp.asarray(rng.randn(B, T, M).astype(np.float32))
+        c0 = jnp.asarray(rng.randn(B, D).astype(np.float32))
+        attn_w = jnp.asarray(rng.randn(M + D, 1).astype(np.float32))
+        lstm_w = jnp.asarray(
+            rng.randn(M + D, 4 * D).astype(np.float32) * 0.1)
+        hs, (h, c) = attention_lstm(x, c0, attn_w, lstm_w)
+        assert hs.shape == (B, T, D)
+        assert h.shape == (B, D) and c.shape == (B, D)
+        np.testing.assert_allclose(np.asarray(hs[:, -1]), np.asarray(h))
+
+    def test_masked_positions_do_not_contribute(self):
+        rng = np.random.RandomState(2)
+        B, T, M, D = 1, 4, 3, 2
+        x = rng.randn(B, T, M).astype(np.float32)
+        c0 = jnp.asarray(rng.randn(B, D).astype(np.float32))
+        attn_w = jnp.asarray(rng.randn(M + D, 1).astype(np.float32))
+        lstm_w = jnp.asarray(
+            rng.randn(M + D, 4 * D).astype(np.float32) * 0.1)
+        lengths = jnp.asarray([2])
+        h1, _ = attention_lstm(jnp.asarray(x), c0, attn_w, lstm_w,
+                               lengths=lengths)
+        x2 = x.copy()
+        x2[:, 2:] = 99.0   # beyond length: must not affect the output
+        h2, _ = attention_lstm(jnp.asarray(x2), c0, attn_w, lstm_w,
+                               lengths=lengths)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                                   atol=1e-6)
+
+    def test_gradcheck(self):
+        rng = np.random.RandomState(3)
+        B, T, M, D = 1, 3, 2, 2
+        x = jnp.asarray(rng.randn(B, T, M).astype(np.float32))
+        c0 = jnp.asarray(rng.randn(B, D).astype(np.float32))
+        attn_w = jnp.asarray(rng.randn(M + D, 1).astype(np.float32))
+        lstm_w = jnp.asarray(
+            rng.randn(M + D, 4 * D).astype(np.float32) * 0.2)
+
+        def loss(w):
+            hs, _ = attention_lstm(x, c0, attn_w, w)
+            return (hs ** 2).sum()
+
+        g = jax.grad(loss)(lstm_w)
+        eps = 1e-3
+        gn = np.zeros_like(np.asarray(lstm_w))
+        for i in range(lstm_w.shape[0]):
+            for j in range(0, lstm_w.shape[1], 3):
+                e = np.zeros(lstm_w.shape, np.float32)
+                e[i, j] = eps
+                gn[i, j] = (float(loss(lstm_w + e))
+                            - float(loss(lstm_w - e))) / (2 * eps)
+        mask = gn != 0
+        np.testing.assert_allclose(np.asarray(g)[mask], gn[mask],
+                                   rtol=2e-2, atol=1e-3)
+
+
+class TestReviewFixes:
+    def test_beam_search_decode_end_token_truncates(self):
+        from paddle_tpu.ops import aliases as A2
+        step_ids = jnp.asarray([[4, 4], [0, 5], [7, 8]])   # 0 = EOS
+        step_parents = jnp.asarray([[0, 1], [0, 1], [0, 1]])
+        seqs = np.asarray(A2.beam_search_decode(step_ids, step_parents,
+                                                end_token=0))
+        np.testing.assert_array_equal(seqs[0], [4, 0, 0])  # truncated
+        np.testing.assert_array_equal(seqs[1], [4, 5, 8])  # never ended
+
+    def test_attention_lstm_freezes_state_past_length(self):
+        rng = np.random.RandomState(7)
+        B, T, M, D = 2, 5, 3, 2
+        x = jnp.asarray(rng.randn(B, T, M).astype(np.float32))
+        c0 = jnp.asarray(rng.randn(B, D).astype(np.float32))
+        attn_w = jnp.asarray(rng.randn(M + D, 1).astype(np.float32))
+        lstm_w = jnp.asarray(
+            rng.randn(M + D, 4 * D).astype(np.float32) * 0.1)
+        lengths = jnp.asarray([2, 5])
+        hs, (h, c) = attention_lstm(x, c0, attn_w, lstm_w,
+                                    lengths=lengths)
+        # row 0 final state == its step-2 hidden; outputs 0 past length
+        np.testing.assert_allclose(np.asarray(h[0]), np.asarray(hs[0, 1]),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(hs[0, 2:]), 0.0)
+        # full-length row unaffected
+        hs_f, (h_f, _) = attention_lstm(x, c0, attn_w, lstm_w)
+        np.testing.assert_allclose(np.asarray(h[1]), np.asarray(h_f[1]),
+                                   atol=1e-6)
