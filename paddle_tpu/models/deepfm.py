@@ -133,6 +133,39 @@ class CTRTrainer:
             self.table_w1.push_async(ids, gfirst)
         return float(loss), np.asarray(logits)
 
+    def train_stream(self, batches, lr=0.01):
+        """Pipelined dataset loop — the DownpourWorker prefetch pattern
+        (ref: framework/downpour_worker.cc pull → compute → async push):
+        batch i+1's host-side embedding pull and batch i's gradient
+        fetch both overlap the device's compute, so the sparse path
+        never stalls the chip (SURVEY §7's design constraint). Grad
+        pushes are steps-behind (async Communicator semantics).
+        Yields float loss per batch."""
+        pending = None          # (ids, gemb_dev, gfirst_dev)
+        for ids, dense, labels in batches:
+            ids = np.asarray(ids)
+            emb = self.table.pull(ids)
+            first = self.table_w1.pull(ids)[..., 0]
+            loss, logits, self.params, gemb, gfirst = _train_step(
+                self.cfg, self.params, jnp.asarray(emb),
+                jnp.asarray(first), jnp.asarray(dense, jnp.float32),
+                jnp.asarray(labels), jnp.float32(lr))
+            if pending is not None:
+                # fetch the PREVIOUS step's grads while the device is
+                # busy with the step just dispatched
+                p_ids, p_gemb, p_gfirst, p_loss = pending
+                self.table.push_async(p_ids, np.asarray(p_gemb))
+                self.table_w1.push_async(
+                    p_ids, np.asarray(p_gfirst)[..., None])
+                yield float(p_loss)
+            pending = (ids, gemb, gfirst, loss)
+        if pending is not None:
+            p_ids, p_gemb, p_gfirst, p_loss = pending
+            self.table.push_async(p_ids, np.asarray(p_gemb))
+            self.table_w1.push_async(p_ids, np.asarray(p_gfirst)[..., None])
+            yield float(p_loss)
+        self.finalize()
+
     def finalize(self):
         self.table.flush()
         self.table_w1.flush()

@@ -29,19 +29,30 @@ def _src_fingerprint():
     return h.hexdigest()[:16]
 
 
+def _compile(srcs, out, extra_flags=()):
+    """g++ with atomic tmp+replace; compiler diagnostics surface in the
+    raised error instead of dying unread in a CalledProcessError."""
+    # per-process tmp: concurrent builders (multi-process loaders on a
+    # shared fs) must not interleave writes into one tmp file
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = ["g++", "-std=c++17", "-O2", "-pthread", *extra_flags,
+           *srcs, "-lz", "-o", tmp]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"g++ failed ({r.returncode}) for {os.path.basename(out)}:\n"
+            f"{r.stderr[-2000:]}")
+    os.replace(tmp, out)
+    return out
+
+
 def _build():
     out_dir = os.path.join(os.path.dirname(__file__), "_build")
     os.makedirs(out_dir, exist_ok=True)
     so = os.path.join(out_dir, f"libpt_native_{_src_fingerprint()}.so")
     if not os.path.exists(so):
-        # per-process tmp: concurrent builders (multi-process loaders on a
-        # shared fs) must not interleave writes into one tmp file
-        tmp = f"{so}.{os.getpid()}.tmp"
-        srcs = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
-        cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
-               *srcs, "-lz", "-o", tmp]
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, so)
+        _compile([os.path.join(_SRC_DIR, s) for s in _SOURCES], so,
+                 extra_flags=("-fPIC", "-shared"))
     return so
 
 
@@ -346,10 +357,6 @@ def build_train_demo():
         h.update(f.read())
     exe = os.path.join(out_dir, f"train_demo_{h.hexdigest()[:16]}")
     if not os.path.exists(exe):
-        tmp = f"{exe}.{os.getpid()}.tmp"
-        srcs = [os.path.join(_SRC_DIR, s)
-                for s in _SOURCES + ["train_demo.cc"]]
-        subprocess.run(["g++", "-std=c++17", "-O2", "-pthread", *srcs,
-                        "-lz", "-o", tmp], check=True, capture_output=True)
-        os.replace(tmp, exe)
+        _compile([os.path.join(_SRC_DIR, s)
+                  for s in _SOURCES + ["train_demo.cc"]], exe)
     return exe

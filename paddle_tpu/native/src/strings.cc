@@ -105,7 +105,10 @@ long pt_parse_multislot(const char* line, long line_len, long n_slots,
     }
     char* q = nullptr;
     long n = std::strtol(p, &q, 10);
-    if (q == p || n < 0) {
+    // the count must be a whole token: '2.5' would otherwise parse as
+    // count 2 and feed '.5' into the first value (the Python fallback
+    // raises on int('2.5'))
+    if (q == p || n < 0 || !at_token_end(q)) {
       pt::set_error("multislot: bad count at slot %ld", s);
       return -1;
     }

@@ -250,14 +250,16 @@ def bidirectional_lstm(x, fwd_w_ih, fwd_w_hh, bwd_w_ih, bwd_w_hh,
 def attention_lstm(x, c0, attn_w, lstm_w, attn_b=None, lstm_b=None,
                    h0=None, lengths=None):
     """Fused attention + LSTM (ref: operators/attention_lstm_op.cc):
-    at each step an additive attention scores every source position
-    against the previous cell state, the attention-weighted context
-    vector feeds one LSTM step. x [B,T,M]; c0 [B,D]; attn_w [M+D,1];
-    lstm_w [M+D,4D] over concat(context, h), gate order i,f,c,o (the
-    library convention, see lstm above). Returns
-    (hidden [B,T,D], (h_T, c_T)); ``lengths`` masks the attention
-    softmax AND freezes each row's (h, c) past its end with zero output
-    — the same padded-step contract as ``lstm`` above."""
+    at each step additive (Bahdanau-style) attention scores every source
+    position against the previous cell state —
+    ``e_j = tanh(x_j . w_x + c . w_c + b)`` — and the attention-weighted
+    context vector feeds one LSTM step. The tanh is essential: with a
+    purely linear score the ``c`` term is a per-row constant and cancels
+    in the softmax. x [B,T,M]; c0 [B,D]; attn_w [M+D,1]; lstm_w [M+D,4D]
+    over concat(context, h), gate order i,f,c,o (the library convention,
+    see lstm above). Returns (hidden [B,T,D], (h_T, c_T)); ``lengths``
+    masks the attention softmax AND freezes each row's (h, c) past its
+    end with zero output — the same padded-step contract as ``lstm``."""
     B, T, M = x.shape
     D = c0.shape[-1]
     dt = x.dtype
@@ -266,13 +268,15 @@ def attention_lstm(x, c0, attn_w, lstm_w, attn_b=None, lstm_b=None,
     neg = jnp.asarray(-1e9, jnp.float32)
     amask = (None if lengths is None
              else (jnp.arange(T)[None, :] < lengths[:, None]))
+    # hoist the step-invariant half of the score out of the scan: one
+    # [B,T,M]x[M,1] matmul instead of a [B,T,M+D] concat+matmul per step
+    x_score = (x @ attn_w[:M])[..., 0]                     # [B, T]
+    if attn_b is not None:
+        x_score = x_score + attn_b
 
     def step(carry, t):
         h, c = carry
-        ce = jnp.broadcast_to(c[:, None, :], (B, T, D))
-        e = (jnp.concatenate([x, ce], axis=-1) @ attn_w)[..., 0]  # [B,T]
-        if attn_b is not None:
-            e = e + attn_b
+        e = jnp.tanh(x_score + c @ attn_w[M:])             # [B, T]
         e32 = e.astype(jnp.float32)
         if amask is not None:
             e32 = jnp.where(amask, e32, neg)

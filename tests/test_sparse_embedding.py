@@ -145,3 +145,16 @@ class TestDeepFM:
         l1, _ = tr.train_step(ids, dense, labels, lr=0.0)
         l2, _ = tr2.train_step(ids, dense, labels, lr=0.0)
         assert l1 == pytest.approx(l2, rel=1e-5)
+
+
+class TestTrainStream:
+    def test_pipelined_stream_converges_like_sync(self):
+        from paddle_tpu.models import deepfm
+        cfg = deepfm.DeepFMConfig(num_slots=5, embed_dim=4, dense_dim=3,
+                                  dnn_sizes=(16,), vocab_per_slot=200)
+        batches = [deepfm.synthetic_ctr_batch(cfg, 128, seed=s)
+                   for s in range(12)]
+        tr = deepfm.CTRTrainer(cfg, seed=0)
+        losses = list(tr.train_stream(iter(batches * 3), lr=0.05))
+        assert len(losses) == 36
+        assert np.mean(losses[-6:]) < np.mean(losses[:6])

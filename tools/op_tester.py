@@ -76,9 +76,13 @@ def run_op(name, fn, args, flops, repeat, grad=False):
     import jax.numpy as jnp
     import numpy as np
 
+    # fi = first inexact (differentiable) argument: grad targets it, and
+    # the scan below nudges it per-iteration to defeat CSE
+    fi = next((i for i, a in enumerate(args)
+               if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)), 0)
     if grad:
         base = jax.grad(lambda *a: jnp.sum(
-            jnp.asarray(fn(*a), jnp.float32)))
+            jnp.asarray(fn(*a), jnp.float32)), argnums=fi)
     else:
         base = fn
 
@@ -88,8 +92,6 @@ def run_op(name, fn, args, flops, repeat, grad=False):
     # The first float arg is nudged by the (traced) iteration index so
     # XLA cannot CSE the iterations into one application; the running
     # sum over output leaves keeps every iteration live.
-    fi = next((i for i, a in enumerate(args)
-               if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)), 0)
 
     def chain(n):
         def body(acc, i):
