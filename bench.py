@@ -119,14 +119,17 @@ def bench_inference():
                     "value": round(ms, 3), "unit": "ms"}))
                 summary[(tag, dtname, mb)] = ms
     if on_tpu:
-        ours = summary.get(("resnet50", "bf16", 128))
-        if ours:
-            # distinct metric name: the per-batch loop already printed
-            # resnet50_bf16_infer_latency_mb128 without vs_baseline
-            print(json.dumps({
-                "metric": "resnet50_bf16_infer_speedup_vs_v100fp16_mb128",
-                "value": round(64.52 / ours, 3), "unit": "x",
-                "vs_baseline": round(64.52 / ours, 3)}))
+        # distinct metric names: the per-batch loop already printed the
+        # raw latencies; these summarize vs the reference's V100 fp16
+        # numbers at each model's largest common batch (jobs[..].ref_ms)
+        for tag, mk, mod, batches, ref_ms in jobs:
+            ours = summary.get((tag, "bf16", batches[-1]))
+            if ours:
+                print(json.dumps({
+                    "metric": (f"{tag}_bf16_infer_speedup_vs_v100fp16_"
+                               f"mb{batches[-1]}"),
+                    "value": round(ref_ms / ours, 3), "unit": "x",
+                    "vs_baseline": round(ref_ms / ours, 3)}))
 
 
 def main():

@@ -48,17 +48,27 @@ def _parse_multislot(line, slots):
     i = 0
     for name, dtype in slots:
         enforce(i < len(toks), f"multislot line truncated at slot {name}")
-        n = int(toks[i])
+        try:
+            n = int(toks[i])
+        except ValueError:
+            n = -1
+        enforce(n >= 0, f"multislot: bad count at slot {name}")
         i += 1
         vals = toks[i:i + n]
         enforce(len(vals) == n,
                 f"multislot line truncated inside slot {name}: "
                 f"declared {n} values, found {len(vals)}")
         i += n
-        if dtype in ("int64", "int32"):
-            out.append(np.asarray([int(v) for v in vals], np.int64))
-        else:
-            out.append(np.asarray([float(v) for v in vals], np.float32))
+        # same exception type (EnforceNotMet) as the native path for bad
+        # values, so callers can catch malformed lines identically
+        try:
+            if dtype in ("int64", "int32"):
+                out.append(np.asarray([int(v) for v in vals], np.int64))
+            else:
+                out.append(np.asarray([float(v) for v in vals],
+                                      np.float32))
+        except ValueError:
+            enforce(False, f"multislot: bad value in slot {name}")
     return out
 
 

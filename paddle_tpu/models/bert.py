@@ -295,7 +295,8 @@ def mlm_loss(params, cfg, batch, mesh=None):
       masked_weights [B, P] (P = max predictions, static) — the
       vocab-size head runs only on the ~15% masked positions, the way
       BERT pretraining defines the objective. Cuts head FLOPs by S/P
-      (measured +21% tokens/sec on the v5e single-chip config).
+      (measured +29% tokens/sec on the v5e single-chip bench config:
+      115.2k -> 149.0k at bs=64, seq=512, P=80).
 
     Both are static-shape (no dynamic-count gather), TPU-friendly."""
     hidden = forward(params, cfg, batch["input_ids"],

@@ -142,29 +142,38 @@ class CTRTrainer:
         pushes are steps-behind (async Communicator semantics).
         Yields float loss per batch."""
         pending = None          # (ids, gemb_dev, gfirst_dev)
-        for ids, dense, labels in batches:
-            ids = np.asarray(ids)
-            emb = self.table.pull(ids)
-            first = self.table_w1.pull(ids)[..., 0]
-            loss, logits, self.params, gemb, gfirst = _train_step(
-                self.cfg, self.params, jnp.asarray(emb),
-                jnp.asarray(first), jnp.asarray(dense, jnp.float32),
-                jnp.asarray(labels), jnp.float32(lr))
-            if pending is not None:
-                # fetch the PREVIOUS step's grads while the device is
-                # busy with the step just dispatched
-                p_ids, p_gemb, p_gfirst, p_loss = pending
-                self.table.push_async(p_ids, np.asarray(p_gemb))
-                self.table_w1.push_async(
-                    p_ids, np.asarray(p_gfirst)[..., None])
-                yield float(p_loss)
-            pending = (ids, gemb, gfirst, loss)
-        if pending is not None:
+
+        def _push_pending():
+            nonlocal pending
             p_ids, p_gemb, p_gfirst, p_loss = pending
+            pending = None
             self.table.push_async(p_ids, np.asarray(p_gemb))
-            self.table_w1.push_async(p_ids, np.asarray(p_gfirst)[..., None])
-            yield float(p_loss)
-        self.finalize()
+            self.table_w1.push_async(
+                p_ids, np.asarray(p_gfirst)[..., None])
+            return float(p_loss)
+
+        try:
+            for ids, dense, labels in batches:
+                ids = np.asarray(ids)
+                emb = self.table.pull(ids)
+                first = self.table_w1.pull(ids)[..., 0]
+                loss, logits, self.params, gemb, gfirst = _train_step(
+                    self.cfg, self.params, jnp.asarray(emb),
+                    jnp.asarray(first), jnp.asarray(dense, jnp.float32),
+                    jnp.asarray(labels), jnp.float32(lr))
+                if pending is not None:
+                    # fetch the PREVIOUS step's grads while the device
+                    # is busy with the step just dispatched
+                    yield _push_pending()
+                pending = (ids, gemb, gfirst, loss)
+            if pending is not None:
+                yield _push_pending()
+        finally:
+            # early consumer exit (break mid-stream): the in-flight
+            # step's grads must still land before tables are read
+            if pending is not None:
+                _push_pending()
+            self.finalize()
 
     def finalize(self):
         self.table.flush()

@@ -360,3 +360,20 @@ def build_train_demo():
         _compile([os.path.join(_SRC_DIR, s)
                   for s in _SOURCES + ["train_demo.cc"]], exe)
     return exe
+
+
+def build_race_check():
+    """Build the TSAN-instrumented concurrency stress binary
+    (src/race_check.cc): loader + arena under -fsanitize=thread. The
+    race-detection CI the reference lacks (SURVEY §5.2)."""
+    out_dir = os.path.join(os.path.dirname(__file__), "_build")
+    os.makedirs(out_dir, exist_ok=True)
+    h = hashlib.sha256(_src_fingerprint().encode())
+    with open(os.path.join(_SRC_DIR, "race_check.cc"), "rb") as f:
+        h.update(f.read())
+    exe = os.path.join(out_dir, f"race_check_{h.hexdigest()[:16]}")
+    if not os.path.exists(exe):
+        _compile([os.path.join(_SRC_DIR, s)
+                  for s in _SOURCES + ["race_check.cc"]], exe,
+                 extra_flags=("-fsanitize=thread", "-g"))
+    return exe

@@ -89,7 +89,6 @@ struct Loader {
   size_t shuffle_buf;      // 0 = no shuffle
   uint64_t seed;
   std::atomic<int> live_workers{0};
-  std::string last;        // buffer returned to Python (single consumer)
   std::mutex err_mu;       // worker errors surface to the consumer
   std::string error;
 
@@ -220,16 +219,19 @@ void* pt_loader_create(const char** files, int nfiles, int nthreads,
   return L;
 }
 
-// Returns pointer valid until the next pt_loader_next call.
+// Returns pointer valid until the next pt_loader_next call FROM THE
+// SAME THREAD (thread_local buffer: concurrent consumers are safe —
+// verified under TSAN by race_check.cc).
 // *len = -1 on end-of-stream; -2 if a worker failed (pt_loader_error).
 const char* pt_loader_next(void* lp, long* len) {
   auto* L = static_cast<Loader*>(lp);
-  if (!L->queue.Pop(&L->last)) {
+  thread_local std::string last;
+  if (!L->queue.Pop(&last)) {
     *len = L->HasError() ? -2 : -1;
     return nullptr;
   }
-  *len = static_cast<long>(L->last.size());
-  return L->last.data();
+  *len = static_cast<long>(last.size());
+  return last.data();
 }
 
 const char* pt_loader_error(void* lp) {

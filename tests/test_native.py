@@ -279,3 +279,24 @@ class TestNativeStringsDtypes:
         line = f"{n} " + " ".join("1.0" for _ in range(n))
         arrs = native.parse_multislot(line, ["float32"])
         assert arrs[0].size == n
+
+
+class TestThreadSanitizer:
+    def test_native_runtime_race_free_under_tsan(self, tmp_path):
+        """SURVEY §5.2: run the threaded loader + arena under
+        ThreadSanitizer; any data race fails the build's CI here (the
+        reference has no sanitizer integration at all)."""
+        import subprocess
+        from paddle_tpu import native
+        files = []
+        for i in range(3):
+            f = tmp_path / f"part-{i}.txt"
+            f.write_text("".join(f"line {i} {j}\n" for j in range(200)))
+            files.append(str(f))
+        exe = native.build_race_check()
+        env = dict(os.environ, TSAN_OPTIONS="halt_on_error=1")
+        r = subprocess.run([exe, *files], capture_output=True, text=True,
+                           timeout=300, env=env)
+        assert "ThreadSanitizer" not in r.stderr, r.stderr[-2000:]
+        assert r.returncode == 0, r.stderr[-1000:]
+        assert "race_check ok" in r.stdout

@@ -104,3 +104,37 @@ def test_survey_op_resolves(name):
     fn = _find(name)
     assert fn is not None, f"SURVEY §2.4 op '{name}' has no covering callable"
     assert callable(fn), name
+
+
+def test_layers_module_never_calls_shadowed_builtins_bare():
+    """The layers auto-wrap loop injects fluid op names (range, abs,
+    pow, round, sum, ...) into the module's globals, shadowing Python
+    builtins for code INSIDE the module. Module code must therefore
+    never call a shadowed builtin bare (the `range` incident: the static
+    builder's `for i in range(n)` silently dispatched the fluid op).
+    This walks the module AST and fails on any bare load of a builtin
+    name that the injection shadows."""
+    import ast
+    import builtins
+
+    import paddle_tpu.layers as L
+
+    shadowed = {n for n in dir(L)
+                if not n.startswith("_") and hasattr(builtins, n)}
+    assert shadowed, "expected some fluid ops to shadow builtins"
+    path = L.__file__
+    tree = ast.parse(open(path).read())
+
+    # names assigned/defined at module level are intentional references
+    # to the op (e.g. `sequence_mask = _dual(...)`); only *loads* that
+    # a reader would assume hit the builtin are the hazard
+    offenders = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in shadowed):
+            offenders.append((node.func.id, node.lineno))
+    assert not offenders, (
+        f"bare calls to builtin names shadowed by op injection in "
+        f"{path}: {offenders}; use a _builtin_-prefixed alias (see "
+        f"_builtin_range)")

@@ -158,3 +158,18 @@ class TestTrainStream:
         losses = list(tr.train_stream(iter(batches * 3), lr=0.05))
         assert len(losses) == 36
         assert np.mean(losses[-6:]) < np.mean(losses[:6])
+
+    def test_stream_early_exit_still_pushes_and_flushes(self):
+        from paddle_tpu.models import deepfm
+        cfg = deepfm.DeepFMConfig(num_slots=4, embed_dim=4, dense_dim=2,
+                                  dnn_sizes=(8,), vocab_per_slot=100)
+        batches = [deepfm.synthetic_ctr_batch(cfg, 64, seed=s)
+                   for s in range(6)]
+        tr = deepfm.CTRTrainer(cfg, seed=0)
+        before = tr.table.pull(batches[0][0]).copy()
+        for i, loss in enumerate(tr.train_stream(iter(batches), lr=0.1)):
+            if i == 1:
+                break   # early stop: pending grads must still land
+        after = tr.table.pull(batches[0][0])
+        assert not np.allclose(before, after), \
+            "early-exit stream dropped the pending sparse pushes"
