@@ -17,5 +17,5 @@ from paddle_tpu.nn.module import (
 from paddle_tpu.nn.layers import (
     Linear, FC, Conv2D, Conv2DTranspose, Pool2D, BatchNorm, LayerNorm,
     GroupNorm, InstanceNorm, Embedding, Dropout, PRelu, GRUUnit, LSTMCell,
-    GRUCell, SpectralNorm, NCE, BilinearTensorProduct,
+    GRUCell, SpectralNorm, NCE, BilinearTensorProduct, RowConv, TreeConv,
 )

@@ -2,7 +2,7 @@
 
 Parity: python/paddle/fluid/dygraph/nn.py (Conv2D, Pool2D, FC, BatchNorm,
 Embedding, GRUUnit, LayerNorm, NCE, PRelu, BilinearTensorProduct,
-Conv2DTranspose, GroupNorm, SpectralNorm, TreeConv) — TreeConv deferred.
+Conv2DTranspose, GroupNorm, SpectralNorm, TreeConv, RowConv).
 """
 
 import jax
@@ -33,9 +33,6 @@ class Linear(Layer):
                                  attr=self.bias_attr)
             out = out + b
         return ops.fc_act(out, self.act)
-
-
-FC = Linear
 
 
 class Conv2D(Layer):
@@ -404,4 +401,80 @@ class BilinearTensorProduct(Layer):
                                  initializer=I.Constant(0.0),
                                  attr=self.bias_attr)
             out = out + b
+        return ops.fc_act(out, self.act)
+
+
+class FC(Layer):
+    """fluid.dygraph.FC parity: flattens trailing dims then Linear
+    (dygraph/nn.py FC — the pre-Linear name; num_flatten_dims semantics
+    of operators/fc_op.cc)."""
+
+    def __init__(self, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("fc")
+        self.size = size
+        self.nfd = num_flatten_dims
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.act, self.dtype = act, dtype
+
+    def forward(self, x):
+        import math
+        lead = x.shape[:self.nfd]
+        flat = x.reshape(math.prod(lead), -1)
+        w = create_parameter("w", (flat.shape[-1], self.size), self.dtype,
+                             attr=self.param_attr)
+        out = flat @ w
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.size,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b
+        return ops.fc_act(out.reshape(*lead, self.size), self.act)
+
+
+class RowConv(Layer):
+    """dygraph RowConv (operators/row_conv_op.cc lookahead conv)."""
+
+    def __init__(self, input_dim, future_context_size, param_attr=None,
+                 act=None, dtype=jnp.float32):
+        super().__init__("row_conv")
+        self.d = input_dim
+        # weight rows = current step + future_context_size lookahead taps
+        # (row_conv_op.cc: filter is [future_context_size + 1, D])
+        self.ctx = future_context_size + 1
+        self.param_attr, self.act, self.dtype = param_attr, act, dtype
+
+    def forward(self, x):
+        w = create_parameter("w", (self.ctx, self.d), self.dtype,
+                             attr=self.param_attr)
+        return ops.fc_act(ops.row_conv(x, w), self.act)
+
+
+class TreeConv(Layer):
+    """dygraph TreeConv (operators/tree_conv_op.cc): hop-indexed tree
+    convolution over (nodes, adjacency)."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None,
+                 bias_attr=None, dtype=jnp.float32):
+        super().__init__("tree_conv")
+        self.d, self.out = feature_size, output_size
+        self.nf = num_filters
+        self.hops = max_depth + 1
+        self.max_depth = max_depth
+        self.param_attr, self.bias_attr = param_attr, bias_attr
+        self.act, self.dtype = act, dtype
+
+    def forward(self, nodes, edges):
+        # per-filter output like tree_conv_op.cc: [B, N, out, nf]
+        w = create_parameter("w", (self.hops, self.d,
+                                   self.out * self.nf),
+                             self.dtype, attr=self.param_attr)
+        out = ops.tree_conv(nodes, edges, w, max_depth=self.max_depth)
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.out * self.nf,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b
+        out = out.reshape(out.shape[:-1] + (self.out, self.nf))
         return ops.fc_act(out, self.act)

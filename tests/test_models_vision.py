@@ -150,3 +150,43 @@ def test_vgg_non_multiple_of_32_image():
     x = jnp.zeros((2, 48, 48, 3), jnp.float32)
     logits, _ = vgg.forward(params, cfg, x, train=False)
     assert logits.shape == (2, 10)
+
+
+class TestDygraphLayerTail:
+    """FC / RowConv / TreeConv dygraph classes (dygraph/nn.py tail)."""
+
+    def _run(self, model, *xs):
+        import paddle_tpu.nn as nn
+        m = nn.transform(model)
+        params, state = m.init(jax.random.PRNGKey(0), *xs)
+        out, _ = m.apply(params, state, jax.random.PRNGKey(1), *xs)
+        return params, out
+
+    def test_fc_flattens(self):
+        import paddle_tpu.nn as nn
+        x = jnp.ones((2, 3, 4))
+        params, out = self._run(lambda x: nn.FC(8, num_flatten_dims=1)(x), x)
+        assert out.shape == (2, 8)
+        assert params["fc/w"].shape == (12, 8)
+
+    def test_row_conv(self):
+        import paddle_tpu.nn as nn
+        x = jnp.ones((2, 5, 3))
+        _, out = self._run(lambda x: nn.RowConv(3, 2)(x), x)
+        assert out.shape == (2, 5, 3)
+
+    def test_tree_conv(self):
+        import paddle_tpu.nn as nn
+        nodes = jnp.ones((1, 4, 3))
+        edges = jnp.eye(4)[None]
+        _, out = self._run(
+            lambda n, e: nn.TreeConv(3, 6, max_depth=1)(n, e),
+            nodes, edges)
+        # reference tree_conv output keeps the filter axis:
+        # [B, N, output_size, num_filters]
+        assert out.shape == (1, 4, 6, 1)
+        _, out2 = self._run(
+            lambda n, e: nn.TreeConv(3, 6, num_filters=3,
+                                     max_depth=1)(n, e),
+            nodes, edges)
+        assert out2.shape == (1, 4, 6, 3)
