@@ -1,7 +1,25 @@
 """Contrib toolkits (parity: python/paddle/fluid/contrib — AMP lives in
-paddle_tpu.amp; quantization/slim here)."""
+paddle_tpu.amp; everything else here: quantization/slim, op-frequency +
+model stats, decoupled weight decay, contrib layers (fused elementwise
+activation, basic_gru/basic_lstm), beam-search decoder helper, HDFS +
+lookup-table utils, and the deprecated Trainer/Inferencer facade)."""
 
+from paddle_tpu.contrib import decoder
+from paddle_tpu.contrib import extend_optimizer
+from paddle_tpu.contrib import layers
+from paddle_tpu.contrib import model_stat
+from paddle_tpu.contrib import op_frequence
 from paddle_tpu.contrib import quant
 from paddle_tpu.contrib import slim
+from paddle_tpu.contrib import trainer
+from paddle_tpu.contrib import utils
+from paddle_tpu.contrib.extend_optimizer import (
+    extend_with_decoupled_weight_decay,
+)
+from paddle_tpu.contrib.model_stat import summary
+from paddle_tpu.contrib.op_frequence import op_freq_statistic
 
-__all__ = ["quant", "slim"]
+__all__ = ["quant", "slim", "decoder", "extend_optimizer", "layers",
+           "model_stat", "op_frequence", "trainer", "utils",
+           "extend_with_decoupled_weight_decay", "summary",
+           "op_freq_statistic"]

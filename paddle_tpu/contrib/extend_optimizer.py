@@ -1,0 +1,56 @@
+"""Decoupled weight decay for any optimizer class.
+
+Parity: contrib/extend_optimizer/extend_optimizer_with_weight_decay.py
+(extend_with_decoupled_weight_decay: wraps a base optimizer so the decay
+is applied to the PARAMETER directly, not folded into the gradient —
+AdamW-style decoupling).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Returns a subclass of ``base_optimizer`` taking an extra
+    ``coeff`` (decay coefficient) and optional
+    ``apply_decay_param_fun(name) -> bool`` filter. After the base
+    update, every selected parameter decays against its pre-update
+    value: ``p <- p - lr * coeff * p_prev`` (decoupled decay — never
+    routed through the gradient/moments, the point of the reference's
+    DecoupledWeightDecay)."""
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        def __init__(self, *args, coeff=0.0,
+                     apply_decay_param_fun=None, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._coeff = float(coeff)
+            self._decay_fun = apply_decay_param_fun
+
+        def apply_gradients(self, params, grads, state, param_meta=None):
+            prev = params
+            params, state = super().apply_gradients(
+                params, grads, state, param_meta=param_meta)
+            if not self._coeff:
+                return params, state
+            lr = self._lr_value(state["step"].astype(jnp.float32))
+            if self._decay_fun is None:
+                params = jax.tree.map(
+                    lambda p, p0: p - lr * self._coeff * p0, params, prev)
+            else:
+                flatp, treedef = jax.tree_util.tree_flatten_with_path(
+                    params)
+                flat0 = jax.tree.leaves(prev)
+                out = []
+                for (path, p), p0 in zip(flatp, flat0):
+                    name = "/".join(str(getattr(k, "key", k))
+                                    for k in path)
+                    out.append(p - lr * self._coeff * p0
+                               if self._decay_fun(name) else p)
+                params = jax.tree_util.tree_unflatten(treedef, out)
+            return params, state
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        f"{base_optimizer.__name__}WithDecoupledWeightDecay")
+    return OptimizerWithDecoupledWeightDecay

@@ -1,0 +1,173 @@
+"""Contrib layers: fused elementwise+activation and the basic RNN API.
+
+Parity: contrib/layers/nn.py (fused_elemwise_activation) and
+contrib/layers/rnn_impl.py (BasicGRUUnit, basic_gru, BasicLSTMUnit,
+basic_lstm — multi-layer, optionally bidirectional RNN stacks).
+TPU-native: the "fusion" is XLA's job; the stacks compose ops.rnn's
+scan-based lstm/gru (one big input projection per layer on the MXU).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import rnn as _rnn
+
+__all__ = ["fused_elemwise_activation", "basic_gru", "basic_lstm",
+           "BasicGRUUnit", "BasicLSTMUnit"]
+
+_BINARY = {
+    "elementwise_add": lambda a, b: a + b,
+    "elementwise_sub": lambda a, b: a - b,
+    "elementwise_mul": lambda a, b: a * b,
+}
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "scale": lambda x, scale=1.0: x * scale,
+    "identity": lambda x: x,
+}
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1,
+                              save_intermediate_out=False):
+    """contrib/layers/nn.py fused_elemwise_activation: compose a binary
+    elementwise op with a unary activation, e.g.
+    ['elementwise_add', 'relu'] → relu(x + y) or ['relu',
+    'elementwise_add'] → relu(x) + y. On TPU the fusion itself is XLA's
+    job — this is the same graph either way."""
+    a, b = functor_list
+    if a in _BINARY:
+        out = _ACTS[b](_BINARY[a](x, y))
+    else:
+        out = _BINARY[b](_ACTS[a](x), y)
+    if save_intermediate_out:
+        inter = _BINARY[a](x, y) if a in _BINARY else _ACTS[a](x)
+        return out, inter
+    return out
+
+
+def _init(rng, shape, scale=0.1):
+    return (scale * jax.random.normal(rng, shape)).astype(jnp.float32)
+
+
+class BasicLSTMUnit:
+    """One LSTM cell step (rnn_impl.py BasicLSTMUnit): call(h, c, x) ->
+    (h', c'). Gate order i, f (with forget_bias), c, o."""
+
+    def __init__(self, hidden_size, input_size, forget_bias=1.0, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(rng)
+        self.w = _init(k1, (input_size + hidden_size, 4 * hidden_size))
+        self.b = jnp.zeros((4 * hidden_size,), jnp.float32)
+        self.forget_bias = forget_bias
+
+    def __call__(self, x, h, c):
+        gates = jnp.concatenate([x, h], -1) @ self.w + self.b
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c2 = (jax.nn.sigmoid(f + self.forget_bias) * c
+              + jax.nn.sigmoid(i) * jnp.tanh(g))
+        h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+        return h2, c2
+
+
+class BasicGRUUnit:
+    """One GRU cell step (rnn_impl.py BasicGRUUnit): call(x, h) -> h'."""
+
+    def __init__(self, hidden_size, input_size, rng=None):
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        k1, k2 = jax.random.split(rng)
+        self.w_ih = _init(k1, (input_size, 3 * hidden_size))
+        self.w_hh = _init(k2, (hidden_size, 3 * hidden_size))
+        self.b = jnp.zeros((3 * hidden_size,), jnp.float32)
+
+    def __call__(self, x, h):
+        out, _ = _rnn.gru(x[:, None, :], self.w_ih, self.w_hh, b=self.b,
+                          h0=h)
+        return out[:, 0]
+
+
+def _stack(cell_fn, input, num_layers, bidirectional, lengths):
+    """Run a layer stack, concatenating directions per layer."""
+    x = input
+    last_h = []
+    for layer in range(num_layers):
+        fwd, hf = cell_fn(x, layer, False, lengths)
+        if bidirectional:
+            bwd, hb = cell_fn(x, layer, True, lengths)
+            x = jnp.concatenate([fwd, bwd], -1)
+            last_h.append((hf, hb))
+        else:
+            x = fwd
+            last_h.append(hf)
+    return x, last_h
+
+
+def _init_state(init, layer, reverse):
+    """Pick the (layer, direction) slice of an initial-state argument:
+    None, a [L*dirs, B, H] array, or a list indexed layer-major
+    (fwd, bwd per layer) — the rnn_impl.py layout."""
+    if init is None:
+        return None
+    idx = layer * 2 + (1 if reverse else 0)
+    if isinstance(init, (list, tuple)):
+        return init[idx] if idx < len(init) else init[layer]
+    return init[idx] if init.ndim == 3 else init
+
+
+def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=128,
+               num_layers=1, sequence_length=None, bidirectional=False,
+               forget_bias=1.0, seed=0):
+    """rnn_impl.py basic_lstm: stacked (optionally bidirectional) LSTM.
+    input [B, T, D]; init_hidden/init_cell: per-(layer, direction)
+    initial states ([L*dirs, B, H] array or list). Returns
+    (output [B, T, H*(2 if bidir)], last_hidden list, last_cell list)."""
+    rng = jax.random.PRNGKey(seed)
+    keys = jax.random.split(rng, num_layers * 2 + 1)
+    last_c = []
+
+    def cell(x, layer, reverse, lengths):
+        d = x.shape[-1]
+        k = keys[layer * 2 + (1 if reverse else 0)]
+        k1, k2 = jax.random.split(k)
+        w_ih = _init(k1, (d, 4 * hidden_size))
+        w_hh = _init(k2, (hidden_size, 4 * hidden_size))
+        b = jnp.full((4 * hidden_size,), 0.0, jnp.float32) \
+            .at[hidden_size:2 * hidden_size].set(forget_bias)
+        out, (h, c) = _rnn.lstm(x, w_ih, w_hh, b=b,
+                                h0=_init_state(init_hidden, layer,
+                                               reverse),
+                                c0=_init_state(init_cell, layer,
+                                               reverse),
+                                lengths=lengths, reverse=reverse)
+        last_c.append(c)
+        return out, h
+
+    out, last_h = _stack(cell, input, num_layers, bidirectional,
+                         sequence_length)
+    return out, last_h, last_c
+
+
+def basic_gru(input, init_hidden=None, hidden_size=128, num_layers=1,
+              sequence_length=None, bidirectional=False, seed=0):
+    """rnn_impl.py basic_gru: stacked (optionally bidirectional) GRU.
+    Returns (output, last_hidden list)."""
+    rng = jax.random.PRNGKey(seed)
+    keys = jax.random.split(rng, num_layers * 2 + 1)
+
+    def cell(x, layer, reverse, lengths):
+        d = x.shape[-1]
+        k = keys[layer * 2 + (1 if reverse else 0)]
+        k1, k2 = jax.random.split(k)
+        w_ih = _init(k1, (d, 3 * hidden_size))
+        w_hh = _init(k2, (hidden_size, 3 * hidden_size))
+        out, h = _rnn.gru(x, w_ih, w_hh,
+                          h0=_init_state(init_hidden, layer, reverse),
+                          lengths=lengths, reverse=reverse)
+        return out, h
+
+    out, last_h = _stack(cell, input, num_layers, bidirectional,
+                         sequence_length)
+    return out, last_h

@@ -1,0 +1,56 @@
+"""Model statistics: parameter and FLOP summary for a static Program.
+
+Parity: python/paddle/fluid/contrib/model_stat.py (summary: per-layer
+table of output shape / param count / FLOPs, plus totals).
+"""
+
+import numpy as np
+
+__all__ = ["summary"]
+
+_MUL_OPS = {"mul", "matmul", "fc"}
+_CONV_OPS = {"conv2d", "conv2d_fusion", "depthwise_conv2d"}
+
+
+def _numel(shape):
+    return int(np.prod([d if d and d > 0 else 1 for d in shape]))
+
+
+def summary(main_program, print_fn=print):
+    """Prints the per-op table and returns (total_params, total_flops).
+    FLOPs counted for matmul/conv ops (2*macs) like the reference;
+    elementwise ops are counted by output size."""
+    total_params = 0
+    total_flops = 0
+    rows = []
+    gb = main_program.global_block()
+    param_names = {v.name for v in gb.vars.values()
+                   if getattr(v, "persistable", False)}
+    for block in main_program.blocks:
+        for op in block.ops:
+            p = 0
+            for name in op.input_names():
+                v = gb.vars.get(name)
+                if v is not None and name in param_names:
+                    p += _numel(v.shape)
+            out_shape = None
+            f = 0
+            outs = op.output_names()
+            if outs:
+                ov = block.vars.get(outs[0]) or gb.vars.get(outs[0])
+                if ov is not None and getattr(ov, "shape", None):
+                    out_shape = tuple(ov.shape)
+                    if op.type in _MUL_OPS or op.type in _CONV_OPS:
+                        f = 2 * p * _numel(out_shape[:1])
+                    else:
+                        f = _numel(out_shape)
+            total_params += p
+            total_flops += f
+            rows.append((op.type, out_shape, p, f))
+    width = max((len(r[0]) for r in rows), default=4) + 2
+    print_fn(f"{'op':<{width}}{'output':<20}{'params':>12}{'flops':>14}")
+    for t, s, p, f in rows:
+        print_fn(f"{t:<{width}}{str(s):<20}{p:>12}{f:>14}")
+    print_fn(f"Total params: {total_params:,}  "
+             f"Total FLOPs (approx): {total_flops:,}")
+    return total_params, total_flops

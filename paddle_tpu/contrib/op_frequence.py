@@ -1,0 +1,29 @@
+"""Op-frequency statistics over a static Program.
+
+Parity: python/paddle/fluid/contrib/op_frequence.py (op_freq_statistic:
+single-op counts plus adjacent-op-pair counts over all blocks).
+"""
+
+from collections import OrderedDict
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns (single_op_count, pair_op_count), both OrderedDicts
+    sorted by descending frequency. Pairs are adjacent (prev, next) op
+    types within a block, keyed "a,b" like the reference."""
+    uni = {}
+    pair = {}
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            t = op.type
+            uni[t] = uni.get(t, 0) + 1
+            if prev is not None:
+                k = f"{prev},{t}"
+                pair[k] = pair.get(k, 0) + 1
+            prev = t
+    order = lambda d: OrderedDict(
+        sorted(d.items(), key=lambda kv: (-kv[1], kv[0])))
+    return order(uni), order(pair)

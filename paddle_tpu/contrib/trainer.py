@@ -1,0 +1,122 @@
+"""The deprecated high-level Trainer/Inferencer API (contrib surface).
+
+Parity: contrib/trainer.py (Trainer: program-building train loop with
+event handlers + checkpointing) and contrib/inferencer.py (Inferencer:
+load params + run). Deprecated in the reference too — kept thin here:
+both are facades over the static Program/Executor/io machinery.
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["Trainer", "Inferencer", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch, self.step = epoch_id, step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch, self.step, self.metrics = epoch_id, step_id, metrics
+
+
+class Trainer:
+    """train_func() builds the loss (and optionally returns [loss, ...]
+    metric vars) inside a fresh program; optimizer_func() returns the
+    optimizer. ``train(...)`` drives epochs of a reader with event
+    handlers — the reference's event protocol (Begin/EndEpoch,
+    Begin/EndStep)."""
+
+    def __init__(self, train_func, optimizer_func, place=None,
+                 param_path=None, parallel=False):
+        import paddle_tpu as pt
+        self._pt = pt
+        self.main = pt.Program()
+        self.startup = pt.Program()
+        # fresh name scope: the Inferencer rebuilds the net later and
+        # must produce the SAME parameter names to load the checkpoint
+        with pt.framework.unique_name.guard(), \
+                pt.static.program_guard(self.main, self.startup):
+            out = train_func()
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            self.loss = outs[0]
+            self.metrics = list(outs)
+            opt = optimizer_func()
+            opt.minimize(self.loss)
+        self.place = place if place is not None else pt.CPUPlace()
+        self.exe = pt.static.Executor(self.place)
+        self.scope = pt.static.Scope()
+        with pt.static.scope_guard(self.scope):
+            self.exe.run(self.startup)
+        if param_path and os.path.isdir(param_path):
+            with pt.static.scope_guard(self.scope):
+                pt.io.load_params(self.exe, param_path,
+                                  main_program=self.main)
+
+    def train(self, num_epochs, event_handler, reader, feed_order):
+        pt = self._pt
+        fetch = [m.name for m in self.metrics]
+        with pt.static.scope_guard(self.scope):
+            for epoch in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch))
+                for step, data in enumerate(reader()):
+                    event_handler(BeginStepEvent(epoch, step))
+                    feed = {n: np.asarray([row[i] for row in data])
+                            for i, n in enumerate(feed_order)}
+                    metrics = self.exe.run(self.main, feed=feed,
+                                           fetch_list=fetch)
+                    event_handler(EndStepEvent(epoch, step, metrics))
+                event_handler(EndEpochEvent(epoch))
+
+    def save_params(self, param_path):
+        pt = self._pt
+        with pt.static.scope_guard(self.scope):
+            pt.io.save_params(self.exe, param_path,
+                              main_program=self.main)
+
+    def stop(self):
+        pass
+
+
+class Inferencer:
+    """infer_func() builds the inference graph in a fresh program;
+    params load from param_path; ``infer(feed)`` runs it."""
+
+    def __init__(self, infer_func, param_path, place=None):
+        import paddle_tpu as pt
+        self._pt = pt
+        self.main = pt.Program()
+        startup = pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.static.program_guard(self.main, startup):
+            out = infer_func()
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            self.fetch = [o.name for o in outs]
+        self.place = place if place is not None else pt.CPUPlace()
+        self.exe = pt.static.Executor(self.place)
+        self.scope = pt.static.Scope()
+        with pt.static.scope_guard(self.scope):
+            self.exe.run(startup)
+            pt.io.load_params(self.exe, param_path,
+                              main_program=self.main)
+
+    def infer(self, inputs):
+        pt = self._pt
+        with pt.static.scope_guard(self.scope):
+            return self.exe.run(self.main, feed=inputs,
+                                fetch_list=self.fetch)

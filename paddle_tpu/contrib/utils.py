@@ -1,0 +1,112 @@
+"""Contrib utils: HDFS shell client + lookup-table checkpoint helpers.
+
+Parity: contrib/utils/hdfs_utils.py (HDFSClient — popen wrappers over the
+``hadoop fs`` CLI, the same shell-pipeline approach as the reference's
+io/fs.cc) and contrib/utils/lookup_table_utils.py (moving distributed
+lookup-table checkpoints between pserver shard layout and inference
+form — here: SparseEmbeddingTable checkpoints ↔ dense numpy arrays).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+
+__all__ = ["HDFSClient", "sparse_table_to_dense",
+           "dense_to_sparse_table"]
+
+
+class HDFSClient:
+    """Thin ``hadoop fs`` wrapper (hdfs_utils.py HDFSClient). Commands
+    shell out like the reference (io/shell popen pipelines); raises
+    RuntimeError with stderr if the binary is missing/fails. The
+    ``hadoop_bin`` is injectable for tests."""
+
+    def __init__(self, hadoop_home=None, configs=None, hadoop_bin=None):
+        self.hadoop_bin = hadoop_bin or (
+            os.path.join(hadoop_home, "bin", "hadoop")
+            if hadoop_home else "hadoop")
+        self.configs = configs or {}
+
+    class BinaryMissing(RuntimeError):
+        pass
+
+    def _run(self, *args):
+        cmd = [self.hadoop_bin, "fs"]
+        for k, v in self.configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise self.BinaryMissing(
+                f"hadoop binary not found: {self.hadoop_bin}") from e
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"hadoop fs {' '.join(args)} failed: {r.stderr[-500:]}")
+        return r.stdout
+
+    def ls(self, path):
+        out = self._run("-ls", path)
+        return [ln.split()[-1] for ln in out.splitlines()
+                if ln and not ln.startswith("Found")]
+
+    def is_exist(self, path):
+        try:
+            self._run("-test", "-e", path)
+            return True
+        except self.BinaryMissing:
+            raise      # a config error must not read as "path absent"
+        except RuntimeError:
+            return False
+
+    def upload(self, hdfs_path, local_path, overwrite=False):
+        args = ["-put"] + (["-f"] if overwrite else []) \
+            + [local_path, hdfs_path]
+        self._run(*args)
+
+    def download(self, hdfs_path, local_path):
+        self._run("-get", hdfs_path, local_path)
+
+    def delete(self, path):
+        self._run("-rm", "-r", path)
+
+    def makedirs(self, path):
+        self._run("-mkdir", "-p", path)
+
+
+def sparse_table_to_dense(dirname, name, vocab_size):
+    """lookup_table_utils parity (convert sparse checkpoint for
+    inference): load a SparseEmbeddingTable checkpoint and materialize
+    the dense [vocab_size, dim] matrix. Ids the table never trained
+    stay ZERO rows — an inference table only serves trained ids."""
+    from paddle_tpu.distributed.sparse_embedding import (
+        SparseEmbeddingTable)
+    t = SparseEmbeddingTable(1)
+    t.load(dirname, name)
+    # (0, dim) empty rows still carry the true dim in shape[1]
+    dim = t.shards[0].rows.shape[1]
+    dense = np.zeros((vocab_size, dim), np.float32)
+    for sh in t.shards:
+        ids, rows, _slot = sh.state()
+        keep = ids < vocab_size
+        dense[ids[keep]] = rows[keep]
+    return dense
+
+
+def dense_to_sparse_table(dense, dirname, name, num_shards=1):
+    """Inverse: seed a sparse table checkpoint from a dense matrix
+    (e.g. converting a single-host embedding into the PS layout)."""
+    from paddle_tpu.distributed.sparse_embedding import (
+        SparseEmbeddingTable)
+    dense = np.asarray(dense, np.float32)
+    t = SparseEmbeddingTable(dense.shape[1], num_shards=num_shards)
+    ids = np.arange(dense.shape[0], dtype=np.int64)
+    from paddle_tpu.distributed.sparse_embedding import _hash_ids
+    sh = _hash_ids(ids, num_shards)
+    for s in range(num_shards):
+        m = sh == s
+        t.shards[s].load(ids[m], dense[m],
+                         np.zeros_like(dense[m]))
+    t.save(dirname, name)
+    return t

@@ -83,5 +83,47 @@ class _Fleet:
         self._strategy = strategy or DistributedStrategy()
         return DistributedOptimizer(optimizer, self._strategy, **kw)
 
+    # -- PS-mode lifecycle (fleet_base.py init_worker/init_server/
+    #    run_server/stop_worker parity; collective mode needs none of
+    #    these — XLA collectives have no server to run) ---------------
+    def init_worker(self):
+        """No-op in collective mode; in PS mode the transpiled trainer
+        program connects lazily on first send/recv."""
+
+    def init_server(self, *model_dirs):
+        self._server_dirs = model_dirs
+
+    def run_server(self, pserver_program):
+        """Build + start the PS from a transpiled pserver program and
+        block serving (get_pserver_program().build_server().start())."""
+        server = pserver_program.build_server()
+        started = server.start()
+        dirs = getattr(self, "_server_dirs", ())
+        if dirs:
+            started.load(dirs[0])
+        return started
+
+    def stop_worker(self):
+        from paddle_tpu.distributed.transpiler import flush_clients
+        flush_clients()
+
+    def barrier_worker(self):
+        """Collective mode: a cross-replica barrier only matters inside
+        a jitted collective program (parallel.collective.barrier); here
+        the host-side analog is flushing outstanding PS sends."""
+        self.stop_worker()
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None):
+        import paddle_tpu as pt
+        return pt.io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program=main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        import paddle_tpu as pt
+        return pt.io.save_persistables(executor, dirname,
+                                       main_program=main_program)
+
 
 fleet = _Fleet()

@@ -250,11 +250,40 @@ class SparseEmbeddingTable:
             ids, rows, slot = shard.state()
             np.savez(os.path.join(dirname, f"{name}.shard{s}.npz"),
                      ids=ids, rows=rows, slot=slot)
+        # manifest: lets load() tell "resharded checkpoint" apart from
+        # "shard files missing" (partial copy)
+        with open(os.path.join(dirname, f"{name}.manifest"), "w") as f:
+            f.write(str(self.num_shards))
 
     def load(self, dirname, name="sparse_table"):
+        """Loads a checkpoint written under ANY shard count: all shard
+        files are merged and repartitioned by id hash into this table's
+        layout (shard-layout invariance — resharding a checkpoint is a
+        pure repartition)."""
+        import glob
+        self.flush()   # stale queued pushes must not land on the
+                       # freshly loaded rows
+        files = sorted(glob.glob(
+            os.path.join(dirname, f"{name}.shard*.npz")))
+        if not files:
+            raise FileNotFoundError(
+                f"no {name}.shard*.npz under {dirname}")
+        manifest = os.path.join(dirname, f"{name}.manifest")
+        if os.path.exists(manifest):
+            with open(manifest) as f:
+                want = int(f.read().strip())
+            if len(files) != want:
+                raise FileNotFoundError(
+                    f"checkpoint {name} incomplete: manifest says "
+                    f"{want} shard files, found {len(files)}")
+        parts = [np.load(f) for f in files]
+        ids = np.concatenate([p["ids"] for p in parts])
+        rows = np.concatenate([p["rows"] for p in parts])
+        slot = np.concatenate([p["slot"] for p in parts])
+        sh = _hash_ids(ids, self.num_shards)
         for s, shard in enumerate(self.shards):
-            z = np.load(os.path.join(dirname, f"{name}.shard{s}.npz"))
-            shard.load(z["ids"], z["rows"], z["slot"])
+            m = sh == s
+            shard.load(ids[m], rows[m], slot[m])
 
     @property
     def size(self):

@@ -1,0 +1,185 @@
+"""Contrib tail: op_frequence, model_stat, extend_optimizer, contrib
+layers, decoder, utils, Trainer/Inferencer."""
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import contrib
+
+
+def _tiny_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.static.program_guard(main, startup):
+        x = pt.static.data("x", shape=[4], dtype="float32")
+        h = pt.layers.fc(x, size=8, act="relu")
+        y = pt.layers.fc(h, size=1)
+        loss = pt.layers.mean(y)
+    return main, startup, loss
+
+
+class TestOpFrequence:
+    def test_counts(self):
+        main, _, _ = _tiny_program()
+        uni, pair = contrib.op_freq_statistic(main)
+        # fc lowers to mul + elementwise_add in the static program
+        assert uni.get("mul", 0) == 2
+        assert sum(uni.values()) == len(main.global_block().ops)
+        assert all("," in k for k in pair)
+
+
+class TestModelStat:
+    def test_summary_totals(self):
+        main, _, _ = _tiny_program()
+        lines = []
+        params, flops = contrib.summary(main, print_fn=lines.append)
+        # fc1: 4*8 + 8; fc2: 8*1 + 1
+        assert params == 4 * 8 + 8 + 8 + 1
+        assert flops > 0
+        assert any("Total params" in ln for ln in lines)
+
+
+class TestExtendOptimizer:
+    def test_decoupled_decay_moves_params(self):
+        AdamW = contrib.extend_with_decoupled_weight_decay(
+            pt.optimizer.Adam)
+        opt = AdamW(learning_rate=0.1, coeff=0.5)
+        params = {"w": jnp.ones((3,)), "b": jnp.ones((2,))}
+        grads = jax.tree.map(jnp.zeros_like, params)
+        state = opt.init(params)
+        new, _ = opt.apply_gradients(params, grads, state)
+        # zero grads: Adam leaves params; the decoupled decay still
+        # shrinks them by lr*coeff*p = 0.05
+        np.testing.assert_allclose(np.asarray(new["w"]), 0.95, atol=1e-6)
+
+    def test_decay_param_filter(self):
+        SGDW = contrib.extend_with_decoupled_weight_decay(
+            pt.optimizer.SGD)
+        opt = SGDW(learning_rate=0.1, coeff=0.5,
+                   apply_decay_param_fun=lambda n: n.endswith("w"))
+        params = {"w": jnp.ones((2,)), "b": jnp.ones((2,))}
+        grads = jax.tree.map(jnp.zeros_like, params)
+        new, _ = opt.apply_gradients(params, grads, opt.init(params))
+        assert float(new["w"][0]) == pytest.approx(0.95)
+        assert float(new["b"][0]) == pytest.approx(1.0)
+
+
+class TestContribLayers:
+    def test_fused_elemwise_activation(self):
+        x = jnp.asarray([-1.0, 2.0])
+        y = jnp.asarray([0.5, 0.5])
+        out = contrib.layers.fused_elemwise_activation(
+            x, y, ["elementwise_add", "relu"])
+        np.testing.assert_allclose(np.asarray(out), [0.0, 2.5])
+        out2 = contrib.layers.fused_elemwise_activation(
+            x, y, ["relu", "elementwise_add"])
+        np.testing.assert_allclose(np.asarray(out2), [0.5, 2.5])
+
+    def test_basic_lstm_shapes(self):
+        x = jnp.ones((2, 5, 3))
+        out, hs, cs = contrib.layers.basic_lstm(
+            x, hidden_size=4, num_layers=2, bidirectional=True)
+        assert out.shape == (2, 5, 8)
+        assert len(hs) == 2 and len(cs) == 4   # cs: per dir per layer
+
+    def test_basic_gru_masks_lengths(self):
+        x = np.random.RandomState(0).randn(2, 6, 3).astype(np.float32)
+        lens = jnp.asarray([3, 6])
+        out, _ = contrib.layers.basic_gru(
+            jnp.asarray(x), hidden_size=4, sequence_length=lens)
+        np.testing.assert_allclose(np.asarray(out[0, 3:]), 0.0, atol=1e-6)
+
+
+class TestBeamSearchDecoder:
+    def test_greedy_agreement_on_peaked_dist(self):
+        V, B, beam = 7, 2, 3
+        rng = np.random.RandomState(0)
+        table = jnp.asarray(rng.randn(V, V).astype(np.float32) * 5)
+
+        def step_fn(state, last_ids):
+            logits = table[last_ids]
+            return jax.nn.log_softmax(logits), state
+
+        dec = contrib.decoder.BeamSearchDecoder(step_fn, beam_size=beam,
+                                                end_token=0, max_len=4)
+        seqs, scores = dec.decode({"dummy": jnp.zeros((B * beam, 1))},
+                                  bos_id=2, batch_size=B)
+        assert seqs.shape == (B * beam, 4)
+        # greedy rollout from bos must equal the top beam of group 0
+        ids = [2]
+        for _ in range(4):
+            ids.append(int(jnp.argmax(table[ids[-1]])))
+        np.testing.assert_array_equal(np.asarray(seqs[0]), ids[1:])
+
+
+class TestUtils:
+    def test_hdfs_client_with_fake_binary(self, tmp_path):
+        fake = tmp_path / "hadoop"
+        fake.write_text("#!/bin/sh\nif [ \"$2\" = '-ls' ]; then\n"
+                        "echo 'Found 1 items'\n"
+                        "echo '-rw-r--r-- 1 u g 0 2026-01-01 00:00 "
+                        "/data/x.txt'\nfi\nexit 0\n")
+        fake.chmod(0o755)
+        c = contrib.utils.HDFSClient(hadoop_bin=str(fake))
+        assert c.ls("/data") == ["/data/x.txt"]
+        assert c.is_exist("/data/x.txt")
+
+    def test_hdfs_client_missing_binary(self):
+        c = contrib.utils.HDFSClient(hadoop_bin="/nonexistent/hadoop")
+        with pytest.raises(RuntimeError, match="not found"):
+            c.ls("/")
+
+    def test_sparse_dense_roundtrip(self, tmp_path):
+        dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+        contrib.utils.dense_to_sparse_table(dense, str(tmp_path), "t",
+                                            num_shards=2)
+        back = contrib.utils.sparse_table_to_dense(str(tmp_path), "t", 4)
+        np.testing.assert_allclose(back, dense)
+
+
+class TestTrainerFacade:
+    def test_train_save_infer(self, tmp_path):
+        rng = np.random.RandomState(0)
+        w_true = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        data = [(rng.randn(4).astype(np.float32),) for _ in range(32)]
+        data = [(x, np.asarray([float(x @ w_true)], np.float32))
+                for (x,) in data]
+
+        def train_func():
+            x = pt.static.data("x", shape=[4], dtype="float32")
+            y = pt.static.data("y", shape=[1], dtype="float32")
+            pred = pt.layers.fc(x, size=1)
+            loss = pt.layers.mean(
+                pt.layers.square_error_cost(pred, y))
+            return loss
+
+        losses = []
+
+        def handler(ev):
+            if isinstance(ev, contrib.trainer.EndStepEvent):
+                losses.append(float(np.asarray(ev.metrics[0])))
+
+        tr = contrib.trainer.Trainer(
+            train_func, lambda: pt.optimizer.SGD(learning_rate=0.05))
+        tr.train(num_epochs=8, event_handler=handler,
+                 reader=lambda: iter([data[i:i + 8]
+                                      for i in range(0, 32, 8)]),
+                 feed_order=["x", "y"])
+        assert losses[-1] < losses[0] * 0.5
+        pdir = str(tmp_path / "params")
+        tr.save_params(pdir)
+
+        def infer_func():
+            x = pt.static.data("x", shape=[4], dtype="float32")
+            return pt.layers.fc(x, size=1)
+
+        inf = contrib.trainer.Inferencer(infer_func, pdir)
+        out = inf.infer({"x": np.stack([d[0] for d in data[:4]])})
+        want = np.stack([d[1] for d in data[:4]])
+        assert np.mean((np.asarray(out[0]) - want) ** 2) < np.mean(
+            want ** 2)

@@ -339,3 +339,32 @@ class TestLaunchPS:
         local = _local_losses()
         avg = np.mean(losses, axis=0)
         np.testing.assert_allclose(avg, local, rtol=1e-4)
+
+
+class TestFleetPSFacade:
+    def test_fleet_run_server_and_worker_roundtrip(self):
+        """fleet_base parity: run_server/stop_worker drive the same PS
+        machinery the transpiler tests use."""
+        from paddle_tpu.distributed.fleet import fleet
+        from paddle_tpu.distributed.launch import find_free_ports
+        ep = f"127.0.0.1:{find_free_ports(1)[0]}"
+        with unique_name.guard():
+            main, startup, loss = _build()
+        t = DistributeTranspiler()
+        t.transpile(0, program=main, pservers=ep, trainers=1,
+                    sync_mode=True, startup_program=startup)
+        server = fleet.run_server(t.get_pserver_program(ep))
+        try:
+            tp = t.get_trainer_program()
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                losses = [float(np.asarray(
+                    exe.run(tp, feed=_batch(s, 0, 1),
+                            fetch_list=[loss.name])[0]))
+                    for s in range(4)]
+            assert losses[-1] < losses[0]
+            fleet.stop_worker()
+        finally:
+            server.stop()

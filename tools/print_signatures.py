@@ -37,6 +37,13 @@ MODULES = [
     "paddle_tpu.dataio",
     "paddle_tpu.contrib.slim",
     "paddle_tpu.contrib.quant",
+    "paddle_tpu.contrib.decoder",
+    "paddle_tpu.contrib.extend_optimizer",
+    "paddle_tpu.contrib.layers",
+    "paddle_tpu.contrib.model_stat",
+    "paddle_tpu.contrib.op_frequence",
+    "paddle_tpu.contrib.trainer",
+    "paddle_tpu.contrib.utils",
     "paddle_tpu.transpiler",
 ]
 
