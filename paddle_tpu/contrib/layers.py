@@ -105,15 +105,15 @@ def _stack(cell_fn, input, num_layers, bidirectional, lengths):
     return x, last_h
 
 
-def _init_state(init, layer, reverse):
+def _init_state(init, layer, reverse, dirs):
     """Pick the (layer, direction) slice of an initial-state argument:
     None, a [L*dirs, B, H] array, or a list indexed layer-major
-    (fwd, bwd per layer) — the rnn_impl.py layout."""
+    (fwd[, bwd] per layer) — the rnn_impl.py layout."""
     if init is None:
         return None
-    idx = layer * 2 + (1 if reverse else 0)
+    idx = layer * dirs + (1 if reverse else 0)
     if isinstance(init, (list, tuple)):
-        return init[idx] if idx < len(init) else init[layer]
+        return init[idx]
     return init[idx] if init.ndim == 3 else init
 
 
@@ -126,6 +126,7 @@ def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=128,
     (output [B, T, H*(2 if bidir)], last_hidden list, last_cell list)."""
     rng = jax.random.PRNGKey(seed)
     keys = jax.random.split(rng, num_layers * 2 + 1)
+    dirs = 2 if bidirectional else 1
     last_c = []
 
     def cell(x, layer, reverse, lengths):
@@ -138,15 +139,19 @@ def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=128,
             .at[hidden_size:2 * hidden_size].set(forget_bias)
         out, (h, c) = _rnn.lstm(x, w_ih, w_hh, b=b,
                                 h0=_init_state(init_hidden, layer,
-                                               reverse),
+                                               reverse, dirs),
                                 c0=_init_state(init_cell, layer,
-                                               reverse),
+                                               reverse, dirs),
                                 lengths=lengths, reverse=reverse)
         last_c.append(c)
         return out, h
 
     out, last_h = _stack(cell, input, num_layers, bidirectional,
                          sequence_length)
+    if bidirectional:
+        # same per-layer (fwd, bwd) grouping as last_h
+        last_c = [(last_c[2 * i], last_c[2 * i + 1])
+                  for i in range(num_layers)]
     return out, last_h, last_c
 
 
@@ -156,6 +161,7 @@ def basic_gru(input, init_hidden=None, hidden_size=128, num_layers=1,
     Returns (output, last_hidden list)."""
     rng = jax.random.PRNGKey(seed)
     keys = jax.random.split(rng, num_layers * 2 + 1)
+    dirs = 2 if bidirectional else 1
 
     def cell(x, layer, reverse, lengths):
         d = x.shape[-1]
@@ -164,7 +170,8 @@ def basic_gru(input, init_hidden=None, hidden_size=128, num_layers=1,
         w_ih = _init(k1, (d, 3 * hidden_size))
         w_hh = _init(k2, (hidden_size, 3 * hidden_size))
         out, h = _rnn.gru(x, w_ih, w_hh,
-                          h0=_init_state(init_hidden, layer, reverse),
+                          h0=_init_state(init_hidden, layer, reverse,
+                                         dirs),
                           lengths=lengths, reverse=reverse)
         return out, h
 

@@ -40,7 +40,12 @@ def summary(main_program, print_fn=print):
                 ov = block.vars.get(outs[0]) or gb.vars.get(outs[0])
                 if ov is not None and getattr(ov, "shape", None):
                     out_shape = tuple(ov.shape)
-                    if op.type in _MUL_OPS or op.type in _CONV_OPS:
+                    if op.type in _CONV_OPS and len(out_shape) >= 3:
+                        # macs per output element = weight size / C_out;
+                        # every [N, C_out, H, W] element costs that many
+                        c_out = max(out_shape[1], 1)
+                        f = 2 * _numel(out_shape) * max(p // c_out, 1)
+                    elif op.type in _MUL_OPS or op.type in _CONV_OPS:
                         f = 2 * p * _numel(out_shape[:1])
                     else:
                         f = _numel(out_shape)

@@ -90,18 +90,18 @@ class _Fleet:
         """No-op in collective mode; in PS mode the transpiled trainer
         program connects lazily on first send/recv."""
 
-    def init_server(self, *model_dirs):
-        self._server_dirs = model_dirs
+    def init_server(self, model_dir=None):
+        self._server_dir = model_dir
 
     def run_server(self, pserver_program):
-        """Build + start the PS from a transpiled pserver program and
-        block serving (get_pserver_program().build_server().start())."""
+        """Build the PS from a transpiled pserver program, restore the
+        init_server checkpoint BEFORE the socket opens (a trainer must
+        never observe pre-checkpoint params), then serve."""
         server = pserver_program.build_server()
-        started = server.start()
-        dirs = getattr(self, "_server_dirs", ())
-        if dirs:
-            started.load(dirs[0])
-        return started
+        d = getattr(self, "_server_dir", None)
+        if d:
+            server.load(d)
+        return server.start()
 
     def stop_worker(self):
         from paddle_tpu.distributed.transpiler import flush_clients

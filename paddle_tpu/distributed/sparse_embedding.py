@@ -244,8 +244,13 @@ class SparseEmbeddingTable:
 
     # -- checkpoint ---------------------------------------------------------
     def save(self, dirname, name="sparse_table"):
+        import glob
         os.makedirs(dirname, exist_ok=True)
         self.flush()
+        # a re-save with fewer shards must not leave stale shard files
+        # behind (load would reject or merge them)
+        for f in glob.glob(os.path.join(dirname, f"{name}.shard*.npz")):
+            os.remove(f)
         for s, shard in enumerate(self.shards):
             ids, rows, slot = shard.state()
             np.savez(os.path.join(dirname, f"{name}.shard{s}.npz"),

@@ -1,0 +1,117 @@
+"""AMP: policies, dynamic loss scaling, mixed-precision optimizer
+(parity: contrib/mixed_precision decorator.py semantics)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import amp
+
+
+class TestPolicyAndCast:
+    def test_cast_tree_floats_only(self):
+        tree = {"w": jnp.ones((2,), jnp.float32),
+                "ids": jnp.ones((2,), jnp.int32)}
+        half = amp.cast_tree(tree, jnp.bfloat16)
+        assert half["w"].dtype == jnp.bfloat16
+        assert half["ids"].dtype == jnp.int32
+
+    def test_policies(self):
+        assert amp.bfloat16_policy().compute_dtype == jnp.bfloat16
+        assert amp.float16_policy().compute_dtype == jnp.float16
+        assert amp.bfloat16_policy().param_dtype == jnp.float32
+
+    def test_lists_exist(self):
+        assert "matmul" in amp.white_list
+        assert "softmax_with_cross_entropy" in amp.black_list
+
+
+class TestLossScaler:
+    def test_overflow_halves_scale_after_n(self):
+        s = amp.LossScaler(init_loss_scaling=1024.0,
+                           decr_every_n_nan_or_inf=2)
+        st = s.init()
+        bad = {"g": jnp.asarray([jnp.inf])}
+        _, finite, st = s.unscale_and_update(bad, st)
+        assert not bool(finite) and float(st["scale"]) == 1024.0
+        _, finite, st = s.unscale_and_update(bad, st)
+        assert float(st["scale"]) == 512.0        # second overflow: halve
+        assert int(st["bad"]) == 0                # counter reset
+
+    def test_growth_after_n_good_steps(self):
+        s = amp.LossScaler(init_loss_scaling=8.0, incr_every_n_steps=3)
+        st = s.init()
+        g = {"g": jnp.asarray([1.0])}
+        for _ in range(3):
+            _, finite, st = s.unscale_and_update(g, st)
+        assert bool(finite) and float(st["scale"]) == 16.0
+
+    def test_unscale_divides(self):
+        s = amp.LossScaler(init_loss_scaling=4.0)
+        st = s.init()
+        g, _, _ = s.unscale_and_update({"g": jnp.asarray([8.0])}, st)
+        np.testing.assert_allclose(np.asarray(g["g"]), [2.0])
+
+    def test_static_mode_keeps_scale(self):
+        s = amp.LossScaler(init_loss_scaling=64.0,
+                           use_dynamic_loss_scaling=False,
+                           decr_every_n_nan_or_inf=1)
+        st = s.init()
+        _, _, st = s.unscale_and_update({"g": jnp.asarray([jnp.inf])}, st)
+        assert float(st["scale"]) == 64.0
+
+
+class TestMixedPrecisionOptimizer:
+    def _train(self, use_bf16, steps=60):
+        rng = np.random.RandomState(0)
+        w_true = jnp.asarray([1.0, -2.0, 0.5])
+        x = jnp.asarray(rng.randn(64, 3).astype(np.float32))
+        y = x @ w_true
+        mp = amp.decorate(pt.optimizer.SGD(learning_rate=0.1),
+                          use_bf16=use_bf16, init_loss_scaling=256.0)
+        params = {"w": jnp.zeros((3,), jnp.float32)}
+        state = mp.init(params)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                half = mp.cast_params(p)
+                pred = (x.astype(half["w"].dtype)
+                        @ half["w"]).astype(jnp.float32)
+                loss = jnp.mean((pred - y) ** 2)
+                return mp.scale_loss(loss, state), loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+            params, state = mp.apply_gradients(params, grads, state)
+            return params, state, loss
+
+        for _ in range(steps):
+            params, state, loss = step(params, state)
+        return params, state, float(loss)
+
+    def test_bf16_policy_no_scaler_converges(self):
+        params, state, loss = self._train(use_bf16=True)
+        assert "loss_scale" not in state
+        assert loss < 1e-2
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   [1.0, -2.0, 0.5], atol=0.05)
+
+    def test_fp16_policy_scaled_converges(self):
+        params, state, loss = self._train(use_bf16=False)
+        assert float(state["loss_scale"]["scale"]) >= 1.0
+        assert loss < 1e-2
+
+    def test_nonfinite_step_skipped(self):
+        mp = amp.OptimizerWithMixedPrecision(
+            pt.optimizer.SGD(learning_rate=0.5),
+            policy=amp.float16_policy())
+        params = {"w": jnp.ones((2,), jnp.float32)}
+        state = mp.init(params)
+        bad = {"w": jnp.asarray([jnp.nan, 1.0], jnp.float32)}
+        new_p, new_s = mp.apply_gradients(params, bad, state)
+        np.testing.assert_allclose(np.asarray(new_p["w"]), 1.0)
+        # opt slots also held back
+        assert int(new_s["opt"]["step"]) == int(state["opt"]["step"]) \
+            or int(new_s["opt"]["step"]) == int(state["opt"]["step"]) + 1

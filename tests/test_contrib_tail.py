@@ -85,7 +85,9 @@ class TestContribLayers:
         out, hs, cs = contrib.layers.basic_lstm(
             x, hidden_size=4, num_layers=2, bidirectional=True)
         assert out.shape == (2, 5, 8)
-        assert len(hs) == 2 and len(cs) == 4   # cs: per dir per layer
+        # hs and cs share the per-layer (fwd, bwd) grouping
+        assert len(hs) == 2 and len(cs) == 2
+        assert all(len(pair) == 2 for pair in cs)
 
     def test_basic_gru_masks_lengths(self):
         x = np.random.RandomState(0).randn(2, 6, 3).astype(np.float32)
@@ -183,3 +185,14 @@ class TestTrainerFacade:
         want = np.stack([d[1] for d in data[:4]])
         assert np.mean((np.asarray(out[0]) - want) ** 2) < np.mean(
             want ** 2)
+
+    def test_basic_lstm_unidir_init_state_per_layer(self):
+        x = jnp.zeros((1, 3, 2))
+        h0 = [jnp.full((1, 4), 0.3), jnp.full((1, 4), -0.8)]
+        c0 = [jnp.zeros((1, 4)), jnp.zeros((1, 4))]
+        out, hs, cs = contrib.layers.basic_lstm(
+            x, init_hidden=h0, init_cell=c0, hidden_size=4, num_layers=2)
+        out0, hs0, _ = contrib.layers.basic_lstm(
+            x, hidden_size=4, num_layers=2)
+        # warm-started stack must differ from the zero-state run
+        assert not np.allclose(np.asarray(out), np.asarray(out0))

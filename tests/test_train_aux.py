@@ -1,0 +1,170 @@
+"""Training auxiliaries: initializers, regularizers, gradient clipping,
+LR schedules (parity: initializer.py, regularizer.py, clip.py,
+layers/learning_rate_scheduler.py)."""
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import clip, initializer as I, regularizer as R
+from paddle_tpu.layers import learning_rate_scheduler as lrs
+
+
+class TestInitializers:
+    KEY = jax.random.PRNGKey(42)
+
+    def test_constant(self):
+        v = I.ConstantInitializer(3.5)(self.KEY, (2, 3))
+        np.testing.assert_allclose(np.asarray(v), 3.5)
+
+    def test_uniform_range(self):
+        v = np.asarray(I.UniformInitializer(-0.25, 0.25)(self.KEY,
+                                                         (1000,)))
+        assert v.min() >= -0.25 and v.max() <= 0.25
+        assert abs(v.mean()) < 0.05
+
+    def test_normal_moments(self):
+        v = np.asarray(I.NormalInitializer(1.0, 2.0)(self.KEY, (4000,)))
+        assert abs(v.mean() - 1.0) < 0.15
+        assert abs(v.std() - 2.0) < 0.2
+
+    def test_truncated_normal_bounded(self):
+        v = np.asarray(I.TruncatedNormalInitializer(0.0, 1.0)(
+            self.KEY, (4000,)))
+        assert np.abs(v).max() <= 2.0 + 1e-5
+
+    def test_xavier_fanin_scale(self):
+        fan_in, fan_out = 64, 32
+        v = np.asarray(I.XavierInitializer(uniform=True)(
+            self.KEY, (fan_in, fan_out)))
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        assert np.abs(v).max() <= limit + 1e-6
+        assert v.std() > limit / 4
+
+    def test_msra_scale(self):
+        v = np.asarray(I.MSRAInitializer(uniform=False)(self.KEY,
+                                                        (128, 64)))
+        assert abs(v.std() - math.sqrt(2.0 / 128)) < 0.05
+
+    def test_bilinear_upsample_kernel(self):
+        # bilinear kernels interpolate: constant input stays constant
+        w = I.BilinearInitializer()(self.KEY, (1, 1, 4, 4))
+        s = np.asarray(w).sum()
+        assert s > 0
+
+    def test_numpy_array(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        v = I.NumpyArrayInitializer(a)(self.KEY, (2, 3))
+        np.testing.assert_array_equal(np.asarray(v), a)
+
+
+class TestRegularizers:
+    def test_l2_adds_coeff_times_param(self):
+        g = R.L2Decay(0.1)(jnp.asarray([2.0, -4.0]),
+                           jnp.asarray([1.0, 1.0]))
+        np.testing.assert_allclose(np.asarray(g), [1.2, 0.6])
+
+    def test_l1_adds_sign(self):
+        g = R.L1Decay(0.5)(jnp.asarray([2.0, -4.0, 0.0]),
+                           jnp.asarray([1.0, 1.0, 1.0]))
+        np.testing.assert_allclose(np.asarray(g), [1.5, 0.5, 1.0])
+
+    def test_through_optimizer(self):
+        opt = pt.optimizer.SGD(learning_rate=1.0,
+                               regularization=R.L2Decay(0.5))
+        params = {"w": jnp.asarray([1.0])}
+        grads = {"w": jnp.asarray([0.0])}
+        new, _ = opt.apply_gradients(params, grads, opt.init(params))
+        # update = lr * (g + 0.5*w) = 0.5 -> w = 0.5
+        np.testing.assert_allclose(np.asarray(new["w"]), [0.5])
+
+
+class TestGradientClip:
+    def test_by_value(self):
+        c = clip.GradientClipByValue(max=1.0)
+        g = c.clip_tree({"a": jnp.asarray([-3.0, 0.5, 2.0])})
+        np.testing.assert_allclose(np.asarray(g["a"]), [-1.0, 0.5, 1.0])
+
+    def test_by_norm_per_leaf(self):
+        c = clip.GradientClipByNorm(clip_norm=1.0)
+        g = c.clip_tree({"a": jnp.asarray([3.0, 4.0]),
+                         "b": jnp.asarray([0.1])})
+        np.testing.assert_allclose(
+            np.asarray(g["a"]), [0.6, 0.8], atol=1e-6)  # norm 5 -> 1
+        np.testing.assert_allclose(np.asarray(g["b"]), [0.1])  # under
+
+    def test_by_global_norm(self):
+        c = clip.GradientClipByGlobalNorm(clip_norm=1.0)
+        g = c.clip_tree({"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])})
+        total = math.sqrt(float(g["a"][0]) ** 2 + float(g["b"][0]) ** 2)
+        assert abs(total - 1.0) < 1e-6
+
+    def test_through_optimizer(self):
+        opt = pt.optimizer.SGD(
+            learning_rate=1.0,
+            grad_clip=clip.GradientClipByGlobalNorm(1.0))
+        params = {"w": jnp.asarray([0.0])}
+        grads = {"w": jnp.asarray([100.0])}
+        new, _ = opt.apply_gradients(params, grads, opt.init(params))
+        np.testing.assert_allclose(np.asarray(new["w"]), [-1.0],
+                                   atol=1e-5)
+
+
+class TestLRSchedules:
+    def _v(self, sched, step):
+        return float(sched(jnp.float32(step)))
+
+    def test_noam(self):
+        s = lrs.noam_decay(d_model=512, warmup_steps=4000)
+        # noam peaks at warmup_steps
+        assert self._v(s, 4000) > self._v(s, 100)
+        assert self._v(s, 4000) > self._v(s, 40000)
+
+    def test_exponential(self):
+        s = lrs.exponential_decay(0.1, decay_steps=10, decay_rate=0.5,
+                                  staircase=True)
+        assert abs(self._v(s, 0) - 0.1) < 1e-6
+        assert abs(self._v(s, 10) - 0.05) < 1e-6
+        assert abs(self._v(s, 25) - 0.025) < 1e-6
+
+    def test_piecewise(self):
+        s = lrs.piecewise_decay([100, 200], [1.0, 0.5, 0.1])
+        assert abs(self._v(s, 50) - 1.0) < 1e-6
+        assert abs(self._v(s, 150) - 0.5) < 1e-6
+        assert abs(self._v(s, 250) - 0.1) < 1e-6
+
+    def test_cosine(self):
+        s = lrs.cosine_decay(0.1, step_each_epoch=10, epochs=10)
+        assert abs(self._v(s, 0) - 0.1) < 1e-6
+        assert self._v(s, 99) < 0.01
+
+    def test_warmup(self):
+        s = lrs.linear_lr_warmup(0.1, warmup_steps=10, start_lr=0.0,
+                                 end_lr=0.1)
+        assert self._v(s, 0) <= 0.011
+        assert abs(self._v(s, 10) - 0.1) < 1e-6
+        assert abs(self._v(s, 100) - 0.1) < 1e-6
+
+    def test_polynomial(self):
+        s = lrs.polynomial_decay(0.1, decay_steps=100,
+                                 end_learning_rate=0.01)
+        assert abs(self._v(s, 0) - 0.1) < 1e-6
+        assert abs(self._v(s, 100) - 0.01) < 1e-6
+
+    def test_schedule_in_optimizer(self):
+        sched = lrs.piecewise_decay([2], [1.0, 0.1])
+        opt = pt.optimizer.SGD(learning_rate=sched)
+        params = {"w": jnp.asarray([10.0])}
+        state = opt.init(params)
+        grads = {"w": jnp.asarray([1.0])}
+        p1, state = opt.apply_gradients(params, grads, state)
+        np.testing.assert_allclose(np.asarray(p1["w"]), [9.0])  # lr 1.0
+        # step 2 reaches the boundary -> lr 0.1 from here on
+        p2, state = opt.apply_gradients(p1, grads, state)
+        np.testing.assert_allclose(np.asarray(p2["w"]), [8.9], atol=1e-5)
+        p3, state = opt.apply_gradients(p2, grads, state)
+        np.testing.assert_allclose(np.asarray(p3["w"]), [8.8], atol=1e-5)
