@@ -14,6 +14,27 @@ import time
 import numpy as np
 
 
+def _timed_steps(step_once, carry, steps, settle=3):
+    """Shared timing harness for every bench mode: 1 compile/warmup
+    step, ``settle`` steps to fill the dispatch pipeline, then ``steps``
+    timed steps. The sync is a HOST FETCH of the step's result — on the
+    remote-PJRT tunnel this repo benches over, a bare block_until_ready
+    measurably returned before queued dispatches executed (2 ms/step
+    reported for a 166 ms/step program); fetching the value cannot lie.
+    step_once(carry) -> (carry, result). Returns (seconds, carry,
+    last_result)."""
+    carry, res = step_once(carry)
+    float(np.ravel(np.asarray(res))[0])
+    for _ in range(settle):
+        carry, res = step_once(carry)
+    float(np.ravel(np.asarray(res))[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        carry, res = step_once(carry)
+    float(np.ravel(np.asarray(res))[0])
+    return time.perf_counter() - t0, carry, res
+
+
 def bench_resnet50():
     """Secondary benchmark (`python bench.py resnet50`): ResNet-50
     images/sec/chip + MFU — BASELINE.json's second headline config."""
@@ -40,19 +61,14 @@ def bench_resnet50():
     imgs = jax.device_put(imgs, dsh)
     labels = jax.device_put(labels, dsh)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
-    # warmup + settle; sync by host fetch (see main() for why)
-    loss, acc, params, opt_state = step_fn(params, opt_state, imgs, labels)
-    float(np.asarray(loss))
-    for _ in range(3):
+
+    def once(carry):
+        params, opt_state = carry
         loss, acc, params, opt_state = step_fn(params, opt_state, imgs,
                                                labels)
-    float(np.asarray(loss))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, acc, params, opt_state = step_fn(params, opt_state, imgs,
-                                               labels)
-    float(np.asarray(loss))
-    dt = time.perf_counter() - t0
+        return (params, opt_state), loss
+
+    dt, _, loss = _timed_steps(once, (params, opt_state), steps)
     img_per_sec = batch * steps / dt
     peak = 197e12
     mfu = img_per_sec * resnet.flops_per_image(cfg) / peak
@@ -107,13 +123,13 @@ def bench_inference():
             for mb in batches:
                 x = jnp.zeros((mb, cfg.image_size, cfg.image_size, 3),
                               jnp.float32)
-                out = fwd(params, x)
-                np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
-                t0 = time.perf_counter()
-                for _ in range(steps):
+
+                def once(carry):
                     out = fwd(params, x)
-                np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
-                ms = (time.perf_counter() - t0) / steps * 1e3
+                    return carry, jax.tree.leaves(out)[0].ravel()[:1]
+
+                dt, _, _ = _timed_steps(once, None, steps, settle=0)
+                ms = dt / steps * 1e3
                 print(json.dumps({
                     "metric": f"{tag}_{dtname}_infer_latency_mb{mb}",
                     "value": round(ms, 3), "unit": "ms"}))
@@ -132,11 +148,63 @@ def bench_inference():
                     "vs_baseline": round(ref_ms / ours, 3)}))
 
 
+def bench_longcontext():
+    """`python bench.py longcontext` — BERT-base training throughput at
+    long sequence lengths on the Pallas flash-attention kernels (the
+    numbers BASELINE.md's long-context claims cite). One JSON line per
+    length; vs_baseline = speedup over XLA dense attention at the same
+    length (both measured here)."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, set_mesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    mesh = set_mesh(make_mesh(MeshConfig(data=1),
+                              devices=jax.devices()[:1]))
+    configs = ([(2048, 8), (4096, 4)] if on_tpu else [(128, 2)])
+    steps = 10 if on_tpu else 2
+
+    def run(seq, batch, impl):
+        # each impl at its best memory-feasible config: flash fits
+        # without remat (O(block.S) attention memory); dense needs remat
+        # at these lengths (the O(S^2) scores blow HBM otherwise)
+        remat = impl == "dense"
+        cfg = (bert.bert_base(max_seq=seq, attention_impl=impl,
+                              remat=remat) if on_tpu
+               else bert.bert_tiny(max_seq=seq, attention_impl=impl))
+        opt = pt.optimizer.Adam(learning_rate=1e-4)
+        init_fn, step_fn = bert.make_train_step(cfg, opt, mesh)
+        data = bert.synthetic_batch(cfg, batch_size=batch, seq_len=seq)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+
+        def once(carry):
+            params, opt_state = carry
+            loss, params, opt_state = step_fn(params, opt_state, data)
+            return (params, opt_state), loss
+
+        dt, _, _ = _timed_steps(once, (params, opt_state), steps,
+                                settle=2)
+        return batch * seq * steps / dt
+
+    for seq, batch in configs:
+        tps_flash = run(seq, batch, "flash")
+        tps_dense = run(seq, batch, "dense")
+        print(json.dumps({
+            "metric": f"bert_base_seq{seq}_flash_tokens_per_sec",
+            "value": round(tps_flash, 2), "unit": "tokens/sec",
+            "vs_baseline": round(tps_flash / tps_dense, 4)}))
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "resnet50":
         return bench_resnet50()
     if len(sys.argv) > 1 and sys.argv[1] == "inference":
         return bench_inference()
+    if len(sys.argv) > 1 and sys.argv[1] == "longcontext":
+        return bench_longcontext()
     import jax
     import jax.numpy as jnp
 
@@ -176,22 +244,12 @@ def main():
                                 max_preds=max_preds)
     params, opt_state = init_fn(jax.random.PRNGKey(0))
 
-    # warmup/compile; the end-of-region sync is a HOST FETCH of the loss
-    # (the step chain's tail). On the experimental remote-PJRT plugin
-    # this repo benches against, a bare block_until_ready measurably
-    # returned before queued dispatches executed (2 ms/step reported
-    # for a 166 ms/step program); fetching the value cannot lie
-    loss, params, opt_state = step_fn(params, opt_state, data)
-    float(np.asarray(loss))
-    for _ in range(3):                       # settle the dispatch pipeline
+    def once(carry):
+        params, opt_state = carry
         loss, params, opt_state = step_fn(params, opt_state, data)
-    float(np.asarray(loss))
+        return (params, opt_state), loss
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, params, opt_state = step_fn(params, opt_state, data)
-    float(np.asarray(loss))
-    dt = time.perf_counter() - t0
+    dt, _, loss = _timed_steps(once, (params, opt_state), steps)
 
     tokens = batch * seq * steps
     tok_per_sec = tokens / dt

@@ -90,19 +90,22 @@ class BasicGRUUnit:
 
 
 def _stack(cell_fn, input, num_layers, bidirectional, lengths):
-    """Run a layer stack, concatenating directions per layer."""
+    """Run a layer stack, concatenating directions per layer. cell_fn
+    returns (outputs, state); states come back grouped per layer —
+    (fwd, bwd) tuples when bidirectional — for every state the cell
+    carries (h for GRU, (h, c) zipped apart by the caller for LSTM)."""
     x = input
-    last_h = []
+    states = []
     for layer in range(num_layers):
-        fwd, hf = cell_fn(x, layer, False, lengths)
+        fwd, sf = cell_fn(x, layer, False, lengths)
         if bidirectional:
-            bwd, hb = cell_fn(x, layer, True, lengths)
+            bwd, sb = cell_fn(x, layer, True, lengths)
             x = jnp.concatenate([fwd, bwd], -1)
-            last_h.append((hf, hb))
+            states.append((sf, sb))
         else:
             x = fwd
-            last_h.append(hf)
-    return x, last_h
+            states.append(sf)
+    return x, states
 
 
 def _init_state(init, layer, reverse, dirs):
@@ -127,7 +130,6 @@ def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=128,
     rng = jax.random.PRNGKey(seed)
     keys = jax.random.split(rng, num_layers * 2 + 1)
     dirs = 2 if bidirectional else 1
-    last_c = []
 
     def cell(x, layer, reverse, lengths):
         d = x.shape[-1]
@@ -143,15 +145,18 @@ def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=128,
                                 c0=_init_state(init_cell, layer,
                                                reverse, dirs),
                                 lengths=lengths, reverse=reverse)
-        last_c.append(c)
-        return out, h
+        return out, (h, c)
 
-    out, last_h = _stack(cell, input, num_layers, bidirectional,
+    out, states = _stack(cell, input, num_layers, bidirectional,
                          sequence_length)
+    # split the per-layer (h, c) states into matching h / c lists,
+    # keeping the (fwd, bwd) grouping when bidirectional
     if bidirectional:
-        # same per-layer (fwd, bwd) grouping as last_h
-        last_c = [(last_c[2 * i], last_c[2 * i + 1])
-                  for i in range(num_layers)]
+        last_h = [(sf[0], sb[0]) for sf, sb in states]
+        last_c = [(sf[1], sb[1]) for sf, sb in states]
+    else:
+        last_h = [s[0] for s in states]
+        last_c = [s[1] for s in states]
     return out, last_h, last_c
 
 

@@ -187,12 +187,31 @@ class TestTrainerFacade:
             want ** 2)
 
     def test_basic_lstm_unidir_init_state_per_layer(self):
-        x = jnp.zeros((1, 3, 2))
-        h0 = [jnp.full((1, 4), 0.3), jnp.full((1, 4), -0.8)]
-        c0 = [jnp.zeros((1, 4)), jnp.zeros((1, 4))]
+        """Each layer must receive ITS OWN initial state: compare the
+        stack against a hand-built reference that feeds layer i state i
+        (catches per-layer misindexing, e.g. layer*2 in unidir mode)."""
+        from paddle_tpu.ops import rnn as _rnn
+        x = jnp.asarray(np.random.RandomState(5)
+                        .randn(1, 3, 2).astype(np.float32))
+        H = 4
+        h0 = [jnp.full((1, H), 0.3), jnp.full((1, H), -0.8)]
+        c0 = [jnp.full((1, H), 0.1), jnp.full((1, H), 0.7)]
         out, hs, cs = contrib.layers.basic_lstm(
-            x, init_hidden=h0, init_cell=c0, hidden_size=4, num_layers=2)
-        out0, hs0, _ = contrib.layers.basic_lstm(
-            x, hidden_size=4, num_layers=2)
-        # warm-started stack must differ from the zero-state run
-        assert not np.allclose(np.asarray(out), np.asarray(out0))
+            x, init_hidden=h0, init_cell=c0, hidden_size=H,
+            num_layers=2, seed=9)
+        # reference: replicate the stack's weight derivation exactly
+        keys = jax.random.split(jax.random.PRNGKey(9), 2 * 2 + 1)
+        cur = x
+        for layer in range(2):
+            k1, k2 = jax.random.split(keys[layer * 2])
+            w_ih = (0.1 * jax.random.normal(
+                k1, (cur.shape[-1], 4 * H))).astype(jnp.float32)
+            w_hh = (0.1 * jax.random.normal(
+                k2, (H, 4 * H))).astype(jnp.float32)
+            b = jnp.zeros((4 * H,), jnp.float32).at[H:2 * H].set(1.0)
+            cur, (h_ref, c_ref) = _rnn.lstm(cur, w_ih, w_hh, b=b,
+                                            h0=h0[layer], c0=c0[layer])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(cur),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(hs[1]), np.asarray(h_ref),
+                                   atol=1e-6)
