@@ -175,6 +175,15 @@ def _run_spmd(model, args, collective):
                        if args.smoke else M.vgg16())
             init_fn, step_fn = M.make_train_step(cfg, opt, mesh)
             imgs, labels = M.synthetic_batch(cfg, args.batch_size)
+            # pre-stage the fixed synthetic batch on device (the
+            # reference's --use_fake_data semantics: data movement is
+            # excluded); step_fn's device_put then no-ops
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from paddle_tpu.parallel.mesh import DATA_AXIS
+            dsh = NamedSharding(mesh, P(DATA_AXIS))
+            imgs = jax.device_put(imgs, dsh)
+            labels = jax.device_put(labels, dsh)
             params, opt_state = init_fn(jax.random.PRNGKey(0))
             out = step_fn(params, opt_state, imgs, labels)
             loss, params, opt_state = out[0], out[-2], out[-1]
