@@ -15,6 +15,7 @@ forward+backward+update, which is the entire point of the TPU design.
 """
 
 import collections
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -50,11 +51,28 @@ class Scope:
 
 
 _global_scope = Scope()
-_scope_stack = [_global_scope]
+
+
+class _ScopeStack(threading.local):
+    """Per-thread scope stack rooted at the shared global scope.
+
+    The stack must be thread-local: concurrent trainer threads (e.g. the
+    in-process two-trainer PS tests, the reference's multi-threaded
+    device workers) each `with scope_guard(their_scope)` — a shared
+    stack would make one thread resolve global_scope() to another
+    thread's scope mid-run (observed as "persistable vars not
+    initialized" races). The root _global_scope itself stays shared, as
+    in the reference (scope.h:45 global scope singleton)."""
+
+    def __init__(self):
+        self.stack = [_global_scope]
+
+
+_scope_tls = _ScopeStack()
 
 
 def global_scope():
-    return _scope_stack[-1]
+    return _scope_tls.stack[-1]
 
 
 class scope_guard:
@@ -62,11 +80,11 @@ class scope_guard:
         self.scope = scope
 
     def __enter__(self):
-        _scope_stack.append(self.scope)
+        _scope_tls.stack.append(self.scope)
         return self.scope
 
     def __exit__(self, *exc):
-        _scope_stack.pop()
+        _scope_tls.stack.pop()
 
 
 def _as_feed_array(v):

@@ -198,6 +198,45 @@ def _local_losses():
                 for s in range(STEPS)]
 
 
+class TestScopeStackThreadLocal:
+    def test_concurrent_scope_guards_stay_isolated(self):
+        """Regression for the two-trainer sync-PS deadlock: the scope
+        stack must be thread-local. With a shared stack, a thread that
+        entered scope_guard after another made BOTH threads resolve
+        global_scope() to ITS scope — the first trainer then saw an
+        uninitialized scope ("persistable vars not initialized"), died,
+        and the second blocked 120 s waiting for its fan-in."""
+        base = pt.static.global_scope()
+        n, iters = 4, 200
+        start = threading.Barrier(n)
+        errors = []
+
+        def worker(tid):
+            try:
+                start.wait(timeout=10)
+                for i in range(iters):
+                    scope = pt.static.Scope()
+                    scope.set_var("who", tid)
+                    with pt.static.scope_guard(scope):
+                        assert pt.static.global_scope() is scope
+                        assert pt.static.global_scope().find_var(
+                            "who") == tid
+                    assert pt.static.global_scope() is base
+            except Exception:
+                import traceback
+                errors.append(traceback.format_exc())
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        assert not errors, errors[0]
+        # new threads still see the shared root scope
+        assert pt.static.global_scope() is base
+
+
 class TestTranspiledTraining:
     def setup_method(self):
         reset_clients()
