@@ -33,18 +33,21 @@ _ACTS = {
 
 def fused_elemwise_activation(x, y, functor_list, axis=-1,
                               save_intermediate_out=False):
-    """contrib/layers/nn.py fused_elemwise_activation: compose a binary
-    elementwise op with a unary activation, e.g.
-    ['elementwise_add', 'relu'] → relu(x + y) or ['relu',
-    'elementwise_add'] → relu(x) + y. On TPU the fusion itself is XLA's
-    job — this is the same graph either way."""
+    """contrib/layers/nn.py fused_elemwise_activation. Reference functor
+    composition (its docstring + test_fused_elemwise_activation_op.py):
+    binary-first ['elementwise_add', 'relu'] → x + relu(y)
+    (out = Binary(x, Unary(y)), intermediate = Unary(y)); unary-first
+    ['relu', 'elementwise_add'] → relu(x + y)
+    (out = Unary(Binary(x, y)), intermediate = Binary(x, y)). On TPU the
+    fusion itself is XLA's job — this is the same graph either way."""
     a, b = functor_list
     if a in _BINARY:
-        out = _ACTS[b](_BINARY[a](x, y))
+        inter = _ACTS[b](y)
+        out = _BINARY[a](x, inter)
     else:
-        out = _BINARY[b](_ACTS[a](x), y)
+        inter = _BINARY[b](x, y)
+        out = _ACTS[a](inter)
     if save_intermediate_out:
-        inter = _BINARY[a](x, y) if a in _BINARY else _ACTS[a](x)
         return out, inter
     return out
 
@@ -57,11 +60,14 @@ class BasicLSTMUnit:
     """One LSTM cell step (rnn_impl.py BasicLSTMUnit): call(h, c, x) ->
     (h', c'). Gate order i, f (with forget_bias), c, o."""
 
-    def __init__(self, hidden_size, input_size, forget_bias=1.0, rng=None):
+    def __init__(self, hidden_size, input_size, forget_bias=1.0, rng=None,
+                 w=None, b=None):
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         k1, k2 = jax.random.split(rng)
-        self.w = _init(k1, (input_size + hidden_size, 4 * hidden_size))
-        self.b = jnp.zeros((4 * hidden_size,), jnp.float32)
+        self.w = w if w is not None else _init(
+            k1, (input_size + hidden_size, 4 * hidden_size))
+        self.b = b if b is not None else jnp.zeros(
+            (4 * hidden_size,), jnp.float32)
         self.forget_bias = forget_bias
 
     def __call__(self, x, h, c):
@@ -76,12 +82,16 @@ class BasicLSTMUnit:
 class BasicGRUUnit:
     """One GRU cell step (rnn_impl.py BasicGRUUnit): call(x, h) -> h'."""
 
-    def __init__(self, hidden_size, input_size, rng=None):
+    def __init__(self, hidden_size, input_size, rng=None, w_ih=None,
+                 w_hh=None, b=None):
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         k1, k2 = jax.random.split(rng)
-        self.w_ih = _init(k1, (input_size, 3 * hidden_size))
-        self.w_hh = _init(k2, (hidden_size, 3 * hidden_size))
-        self.b = jnp.zeros((3 * hidden_size,), jnp.float32)
+        self.w_ih = w_ih if w_ih is not None else _init(
+            k1, (input_size, 3 * hidden_size))
+        self.w_hh = w_hh if w_hh is not None else _init(
+            k2, (hidden_size, 3 * hidden_size))
+        self.b = b if b is not None else jnp.zeros(
+            (3 * hidden_size,), jnp.float32)
 
     def __call__(self, x, h):
         out, _ = _rnn.gru(x[:, None, :], self.w_ih, self.w_hh, b=self.b,
@@ -122,23 +132,38 @@ def _init_state(init, layer, reverse, dirs):
 
 def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=128,
                num_layers=1, sequence_length=None, bidirectional=False,
-               forget_bias=1.0, seed=0):
+               forget_bias=1.0, seed=0, params=None):
     """rnn_impl.py basic_lstm: stacked (optionally bidirectional) LSTM.
     input [B, T, D]; init_hidden/init_cell: per-(layer, direction)
     initial states ([L*dirs, B, H] array or list). Returns
-    (output [B, T, H*(2 if bidir)], last_hidden list, last_cell list)."""
+    (output [B, T, H*(2 if bidir)], last_hidden list, last_cell list).
+
+    With ``params=None`` the weights are FROZEN seed-derived constants —
+    a fixed-weight shim, not trainable (the reference's rnn_impl stacks
+    create trainable parameters). To train, pass ``params``: a
+    layer-major list (fwd[, bwd] per layer, index = layer*dirs + dir) of
+    dicts with "w_ih" [D, 4H], "w_hh" [H, 4H] and optional "b" [4H]
+    (forget_bias is still added to the f-gate slice on top of "b", as
+    BasicLSTMUnit does); gradients flow through them."""
     rng = jax.random.PRNGKey(seed)
     keys = jax.random.split(rng, num_layers * 2 + 1)
     dirs = 2 if bidirectional else 1
 
     def cell(x, layer, reverse, lengths):
         d = x.shape[-1]
-        k = keys[layer * 2 + (1 if reverse else 0)]
-        k1, k2 = jax.random.split(k)
-        w_ih = _init(k1, (d, 4 * hidden_size))
-        w_hh = _init(k2, (hidden_size, 4 * hidden_size))
-        b = jnp.full((4 * hidden_size,), 0.0, jnp.float32) \
-            .at[hidden_size:2 * hidden_size].set(forget_bias)
+        if params is not None:
+            p = params[layer * dirs + (1 if reverse else 0)]
+            w_ih, w_hh = p["w_ih"], p["w_hh"]
+            b = p.get("b")
+            b = jnp.zeros((4 * hidden_size,), jnp.float32) \
+                if b is None else jnp.asarray(b)
+        else:
+            k = keys[layer * 2 + (1 if reverse else 0)]
+            k1, k2 = jax.random.split(k)
+            w_ih = _init(k1, (d, 4 * hidden_size))
+            w_hh = _init(k2, (hidden_size, 4 * hidden_size))
+            b = jnp.zeros((4 * hidden_size,), jnp.float32)
+        b = b.at[hidden_size:2 * hidden_size].add(forget_bias)
         out, (h, c) = _rnn.lstm(x, w_ih, w_hh, b=b,
                                 h0=_init_state(init_hidden, layer,
                                                reverse, dirs),
@@ -161,15 +186,28 @@ def basic_lstm(input, init_hidden=None, init_cell=None, hidden_size=128,
 
 
 def basic_gru(input, init_hidden=None, hidden_size=128, num_layers=1,
-              sequence_length=None, bidirectional=False, seed=0):
+              sequence_length=None, bidirectional=False, seed=0,
+              params=None):
     """rnn_impl.py basic_gru: stacked (optionally bidirectional) GRU.
-    Returns (output, last_hidden list)."""
+    Returns (output, last_hidden list).
+
+    With ``params=None`` the weights are FROZEN seed-derived constants
+    (fixed-weight shim, untrainable); pass ``params`` — a layer-major
+    list (fwd[, bwd] per layer) of dicts with "w_ih" [D, 3H], "w_hh"
+    [H, 3H] and optional "b" [3H] — to train them."""
     rng = jax.random.PRNGKey(seed)
     keys = jax.random.split(rng, num_layers * 2 + 1)
     dirs = 2 if bidirectional else 1
 
     def cell(x, layer, reverse, lengths):
         d = x.shape[-1]
+        if params is not None:
+            p = params[layer * dirs + (1 if reverse else 0)]
+            out, h = _rnn.gru(x, p["w_ih"], p["w_hh"], b=p.get("b"),
+                              h0=_init_state(init_hidden, layer, reverse,
+                                             dirs),
+                              lengths=lengths, reverse=reverse)
+            return out, h
         k = keys[layer * 2 + (1 if reverse else 0)]
         k1, k2 = jax.random.split(k)
         w_ih = _init(k1, (d, 3 * hidden_size))

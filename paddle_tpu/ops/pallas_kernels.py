@@ -355,9 +355,11 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     bias = jnp.asarray(bias, jnp.float32).reshape(b, s)
     interpret = _auto_interpret(interpret)
     if s <= max(block_q, block_k):
-        # short sequences: one block each way
-        block_q = block_k = s
-        pad = 0
+        # short sequences: one block each way — but still pad to the
+        # 128-lane grain so Mosaic never gets an unaligned whole-array
+        # block (e.g. S=300 bf16 must not reach the kernel unpadded)
+        pad = (-s) % 128
+        block_q = block_k = s + pad
     else:
         # pad only to the 128-lane grain, then shrink each block to the
         # largest power-of-two (>=128) dividing the padded length — a

@@ -73,12 +73,17 @@ class TestContribLayers:
     def test_fused_elemwise_activation(self):
         x = jnp.asarray([-1.0, 2.0])
         y = jnp.asarray([0.5, 0.5])
-        out = contrib.layers.fused_elemwise_activation(
-            x, y, ["elementwise_add", "relu"])
-        np.testing.assert_allclose(np.asarray(out), [0.0, 2.5])
-        out2 = contrib.layers.fused_elemwise_activation(
-            x, y, ["relu", "elementwise_add"])
-        np.testing.assert_allclose(np.asarray(out2), [0.5, 2.5])
+        # reference semantics (contrib/layers/nn.py docstring +
+        # test_fused_elemwise_activation_op.py add_relu/relu_add):
+        # binary-first = x + relu(y); unary-first = relu(x + y)
+        out, inter = contrib.layers.fused_elemwise_activation(
+            x, y, ["elementwise_add", "relu"], save_intermediate_out=True)
+        np.testing.assert_allclose(np.asarray(out), [-0.5, 2.5])
+        np.testing.assert_allclose(np.asarray(inter), [0.5, 0.5])
+        out2, inter2 = contrib.layers.fused_elemwise_activation(
+            x, y, ["relu", "elementwise_add"], save_intermediate_out=True)
+        np.testing.assert_allclose(np.asarray(out2), [0.0, 2.5])
+        np.testing.assert_allclose(np.asarray(inter2), [-0.5, 2.5])
 
     def test_basic_lstm_shapes(self):
         x = jnp.ones((2, 5, 3))
@@ -88,6 +93,39 @@ class TestContribLayers:
         # hs and cs share the per-layer (fwd, bwd) grouping
         assert len(hs) == 2 and len(cs) == 2
         assert all(len(pair) == 2 for pair in cs)
+
+    def test_basic_rnn_explicit_params_are_trainable(self):
+        """params= path (ADVICE r1): explicit weight pytrees flow
+        gradients — the seed-only form is a fixed-weight shim."""
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 5, 3).astype(np.float32))
+        H = 4
+        lstm_p = [{"w_ih": jnp.asarray(rng.randn(3, 4 * H) * 0.1,
+                                       jnp.float32),
+                   "w_hh": jnp.asarray(rng.randn(H, 4 * H) * 0.1,
+                                       jnp.float32),
+                   "b": jnp.zeros((4 * H,), jnp.float32)}]
+
+        def loss_lstm(p):
+            out, _, _ = contrib.layers.basic_lstm(
+                x, hidden_size=H, params=p)
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(loss_lstm)(lstm_p)
+        assert float(jnp.abs(g[0]["w_ih"]).sum()) > 0
+        assert float(jnp.abs(g[0]["b"]).sum()) > 0
+
+        gru_p = [{"w_ih": jnp.asarray(rng.randn(3, 3 * H) * 0.1,
+                                      jnp.float32),
+                  "w_hh": jnp.asarray(rng.randn(H, 3 * H) * 0.1,
+                                      jnp.float32)}]
+
+        def loss_gru(p):
+            out, _ = contrib.layers.basic_gru(x, hidden_size=H, params=p)
+            return jnp.sum(out ** 2)
+
+        g2 = jax.grad(loss_gru)(gru_p)
+        assert float(jnp.abs(g2[0]["w_hh"]).sum()) > 0
 
     def test_basic_gru_masks_lengths(self):
         x = np.random.RandomState(0).randn(2, 6, 3).astype(np.float32)

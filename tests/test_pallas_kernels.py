@@ -67,6 +67,20 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5)
 
+    def test_unaligned_seq_single_block_branch_bf16(self):
+        """Odd S in (128, 512] takes the default single-block branch
+        (block_q=block_k=512 default) — it must pad to the 128-lane
+        grain before handing Mosaic a whole-array block (ADVICE r1)."""
+        q, k, v = self._rand(s=300)
+        got = K.flash_attention(q, k, v)  # default blocks: single-block
+        want = _dense_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5)
+        qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+        gotb = K.flash_attention(qb, kb, vb)
+        np.testing.assert_allclose(np.asarray(gotb, np.float32),
+                                   np.asarray(want), atol=2e-2)
+
     def test_gradients_match_dense(self):
         q, k, v = self._rand(b=1, h=2, s=64, d=16, seed=1)
 
