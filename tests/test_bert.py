@@ -36,6 +36,34 @@ class TestBert:
                             n_steps=20)
         assert losses[-1] < losses[0] - 0.3
 
+    def test_steps_per_call_matches_sequential(self):
+        """K scanned steps per dispatch == K sequential dispatches
+        (reused batch and stacked [K, B, S] layouts)."""
+        cfg = bert.bert_tiny()
+        mesh = make_mesh(MeshConfig(data=2, model=1, seq=1, pipe=1))
+        with mesh_guard(mesh):
+            opt = pt.optimizer.Adam(learning_rate=1e-3)
+            init_fn, step1 = bert.make_train_step(cfg, opt, mesh)
+            batch = bert.synthetic_batch(cfg, batch_size=8, seq_len=32)
+            params, opt_state = init_fn(jax.random.PRNGKey(0))
+            for _ in range(3):
+                loss_seq, params, opt_state = step1(params, opt_state,
+                                                    batch)
+
+            _, step3 = bert.make_train_step(cfg, opt, mesh,
+                                            steps_per_call=3)
+            params2, opt2 = init_fn(jax.random.PRNGKey(0))
+            loss_k, params2, opt2 = step3(params2, opt2, batch)
+            np.testing.assert_allclose(float(loss_k), float(loss_seq),
+                                       rtol=1e-4)
+
+            params3, opt3 = init_fn(jax.random.PRNGKey(0))
+            stacked = {k: np.broadcast_to(v, (3,) + np.shape(v)).copy()
+                       for k, v in batch.items()}
+            loss_s, params3, opt3 = step3(params3, opt3, stacked)
+            np.testing.assert_allclose(float(loss_s), float(loss_seq),
+                                       rtol=1e-4)
+
     def test_sharded_matches_single_device(self):
         ref = _run_steps(MeshConfig(data=1, model=1, seq=1, pipe=1))
         tp = _run_steps(MeshConfig(data=2, model=2, seq=2, pipe=1))
