@@ -13,9 +13,6 @@ Falls back to a pure-Python file reader when the native toolchain is
 unavailable (same iterator contract).
 """
 
-import queue as _queue
-import threading
-
 import numpy as np
 
 __all__ = ["FileDataLoader"]
@@ -119,10 +116,9 @@ class FileDataLoader:
     def __iter__(self):
         """Async prefetch pipeline: a worker thread parses/batches/
         device-puts ahead of the consumer (buffered_reader.cc's
-        double-buffering)."""
-        q = _queue.Queue(maxsize=self.prefetch)
-        stop = threading.Event()
-        SENTINEL = object()
+        double-buffering). The thread/queue machinery is the shared
+        background_prefetch helper (static.executor)."""
+        from paddle_tpu.static.executor import background_prefetch
 
         def put(batch):
             if self.device_put:
@@ -130,32 +126,4 @@ class FileDataLoader:
                 batch = jax.device_put(batch)
             return batch
 
-        def worker():
-            try:
-                for b in self._batches():
-                    if stop.is_set():
-                        return
-                    q.put(put(b))
-            except Exception as e:  # surface in consumer
-                q.put(e)
-                return
-            q.put(SENTINEL)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is SENTINEL:
-                    break
-                if isinstance(item, Exception):
-                    raise item
-                yield item
-        finally:
-            stop.set()
-            # drain so the worker's blocked put() can finish
-            try:
-                while True:
-                    q.get_nowait()
-            except _queue.Empty:
-                pass
+        return background_prefetch(self._batches(), put, self.prefetch)

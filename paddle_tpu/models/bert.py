@@ -394,6 +394,11 @@ def make_train_step(cfg, optimizer, mesh=None, steps_per_call=1):
         # per-inner-step batches; otherwise one batch is reused
         stacked = (steps_per_call > 1
                    and np.ndim(batch["input_ids"]) == 3)
+        if stacked and np.shape(batch["input_ids"])[0] != steps_per_call:
+            raise ValueError(
+                f"stacked batch leading axis "
+                f"{np.shape(batch['input_ids'])[0]} != steps_per_call "
+                f"{steps_per_call}")
         k = 1 if stacked else 0
         b_sh, s_sh = ((dshard_bk, dshard_k) if stacked
                       else (dshard_b, dshard))

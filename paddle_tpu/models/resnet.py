@@ -327,6 +327,10 @@ def make_train_step(cfg, optimizer, mesh=None, steps_per_call=1):
 
     def step_fn(params, opt_state, images, labels):
         stacked = np.ndim(images) == 5
+        if stacked and np.shape(images)[0] != steps_per_call:
+            raise ValueError(
+                f"stacked batch leading axis {np.shape(images)[0]} != "
+                f"steps_per_call {steps_per_call}")
         images = jax.device_put(images, dsh_k if stacked else dsh)
         labels = jax.device_put(labels, dsh_k if stacked else dsh)
         return jit_step(params, opt_state, images, labels)

@@ -320,7 +320,12 @@ def make_train_step(cfg, optimizer, mesh=None):
     jit_step = jax.jit(step, donate_argnums=(0, 1))
 
     def step_fn(params, opt_state, batch):
-        batch = {k: jax.device_put(np.asarray(v), dsh)
+        # device-resident feeds pass through (np.asarray on a jax array
+        # would round-trip it to host); device_put no-ops on committed
+        # arrays with matching sharding
+        batch = {k: jax.device_put(
+                     v if isinstance(v, jnp.ndarray) else np.asarray(v),
+                     dsh)
                  for k, v in batch.items()}
         return jit_step(params, opt_state, batch)
 

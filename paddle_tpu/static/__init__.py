@@ -20,7 +20,8 @@ from paddle_tpu.static.program import (
     enable_static, disable_static,
 )
 from paddle_tpu.static.executor import (
-    AsyncExecutor, Executor, Scope, global_scope, scope_guard,
+    AsyncExecutor, Executor, Scope, device_prefetch, global_scope,
+    scope_guard,
 )
 from paddle_tpu.static.debugger import pprint_program, draw_graph, memory_usage
 from paddle_tpu.static.backward import append_backward, gradients

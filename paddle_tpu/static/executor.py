@@ -93,12 +93,81 @@ def _as_feed_array(v):
     return jnp.asarray(np.asarray(v))
 
 
+def background_prefetch(producer, transform, depth=2):
+    """Generic background-thread prefetch pipeline: a worker thread
+    pulls items from ``producer`` (an iterable), applies ``transform``,
+    and queues up to ``depth`` results ahead of the consumer. Producer
+    exceptions re-raise in the consumer; early consumer exit drains the
+    queue so the worker's blocked put can finish. Shared by
+    device_prefetch and dataio's FileDataLoader."""
+    import queue as _queue
+    import threading
+
+    q = _queue.Queue(maxsize=max(int(depth), 1))
+    SENTINEL = object()
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for b in producer:
+                if stop.is_set():
+                    return
+                q.put(transform(b))
+        except Exception as e:           # surface in consumer
+            q.put(e)
+            return
+        q.put(SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is SENTINEL:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except _queue.Empty:
+            pass
+
+
+def device_prefetch(batches, depth=2):
+    """Double-buffered device staging (the role of the reference's
+    operators/reader/buffered_reader.cc): a background thread transfers
+    upcoming feed batches host->device ``depth`` steps ahead, so the
+    H2D hop overlaps the current step's compute instead of serializing
+    with it. ``batches`` yields feed dicts (or tuples/arrays); yields
+    the same structure with device-resident arrays."""
+
+    def stage(b):
+        if isinstance(b, dict):
+            return {k: _as_feed_array(v) for k, v in b.items()}
+        if isinstance(b, (tuple, list)):
+            return type(b)(_as_feed_array(v) for v in b)
+        return _as_feed_array(b)
+
+    return background_prefetch(batches, stage, depth)
+
+
 class Executor:
     """One compiled XLA computation per (program, feed-signature)."""
 
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        self._keys = {}
+
+    def _base_key(self, seed):
+        k = self._keys.get(seed)
+        if k is None:
+            k = self._keys[seed] = jax.random.PRNGKey(seed)
+        return k
 
     # -- public API --------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -141,10 +210,14 @@ class Executor:
                                  sorted(feeds), fetch_names)
             self._cache[sig] = step
 
-        key = jax.random.fold_in(jax.random.PRNGKey(program.random_seed),
-                                 int(np.uint32(scope.find_var("@step@") or 0)))
+        # per-step rng: the base key is staged on device once per seed,
+        # and the step fold happens INSIDE the jitted program (the old
+        # eager PRNGKey+fold_in cost two device round-trips per step on
+        # the remote-PJRT tunnel)
+        base_key = self._base_key(program.random_seed)
+        step_idx = np.uint32(scope.find_var("@step@") or 0)
         scope.set_var("@step@", (scope.find_var("@step@") or 0) + 1)
-        fetches, new_state = step(state, feeds, key)
+        fetches, new_state = step(state, feeds, base_key, step_idx)
         for n, v in new_state.items():
             scope.set_var(n, v)
         if return_numpy:
@@ -169,7 +242,9 @@ class Executor:
         labels = fetch_info or fetch_names
         step = 0
         last = []
-        for batch in dataset:
+        # double-buffered device staging: H2D for batch n+1 overlaps
+        # step n's compute (buffered_reader.cc role)
+        for batch in device_prefetch(dataset):
             last = self.run(program, feed=batch, fetch_list=fetch_names,
                             scope=scope)
             step += 1
@@ -208,11 +283,13 @@ class Executor:
 
     def _run_eager(self, program, scope):
         blk = program.global_block()
-        key = jax.random.PRNGKey(program.random_seed)
+        key = self._base_key(program.random_seed)
         env = dict(getattr(program, "_constants", {}))
         env.update({n: scope.find_var(n) for n in scope.names()})
         for i, op in enumerate(blk.ops):
-            env.update(self._exec_op(op, env, jax.random.fold_in(key, i)))
+            op_key = (jax.random.fold_in(key, i)
+                      if op.attrs.get("_needs_rng") else None)
+            env.update(self._exec_op(op, env, op_key))
         for n, v in env.items():
             if v is not None:
                 scope.set_var(n, v)
@@ -291,11 +368,20 @@ class Executor:
             segs.append((is_host, i, j))
             i = j
 
-        def interpret(env, lo, hi, key):
+        def interpret(env, lo, hi, base_key, step_idx):
+            # lazy fold: host segments run eagerly, and most host ops
+            # (RPC send/recv, save/load) take no rng — folding
+            # unconditionally would cost device round-trips per host op.
+            # Inside jitted segments the folds trace into the program.
+            key = None
             for k in range(lo, hi):
-                env.update(self._exec_op(
-                    ops[k], env,
-                    jax.random.fold_in(key, k - hosts_before[k])))
+                if ops[k].attrs.get("_needs_rng"):
+                    if key is None:
+                        key = jax.random.fold_in(base_key, step_idx)
+                    op_key = jax.random.fold_in(key, k - hosts_before[k])
+                else:
+                    op_key = None
+                env.update(self._exec_op(ops[k], env, op_key))
             return env
 
         def make_device_fn(lo, hi):
@@ -310,13 +396,13 @@ class Executor:
             for k in range(lo, hi):
                 writes.update(ops[k].output_names())
 
-            def seg_fn(donated, rest, key):
+            def seg_fn(donated, rest, base_key, step_idx):
                 # constants enter via closure -> XLA compile-time consts
                 env = dict(constants)
                 env.update(rest)
                 env.update(donated)
                 if ad is None:
-                    env = interpret(env, lo, hi, key)
+                    env = interpret(env, lo, hi, base_key, step_idx)
                 else:
                     adop = ops[ad]
                     loss_name = adop.attrs["loss"]
@@ -327,7 +413,7 @@ class Executor:
                     def fwd(params):
                         e = dict(base)
                         e.update(params)
-                        e = interpret(e, lo, ad, key)
+                        e = interpret(e, lo, ad, base_key, step_idx)
                         return jnp.sum(e[loss_name]), e
 
                     params = {n: env[n] for n in param_names}
@@ -336,7 +422,7 @@ class Executor:
                     env = env2
                     for n in param_names:
                         env[n + "@GRAD"] = grads[n]
-                    env = interpret(env, ad + 1, hi, key)
+                    env = interpret(env, ad + 1, hi, base_key, step_idx)
                 return {k: v for k, v in env.items() if k not in constants}
 
             return jax.jit(seg_fn, donate_argnums=(0,)), writes
@@ -344,13 +430,13 @@ class Executor:
         seg_fns = [None if is_host else make_device_fn(a, b)
                    for is_host, a, b in segs]
 
-        def step(state, feeds, key):
+        def step(state, feeds, base_key, step_idx):
             env = dict(constants)
             env.update(state)
             env.update(feeds)
             for (is_host, a, b), fn_w in zip(segs, seg_fns):
                 if is_host:
-                    env = interpret(env, a, b, key)
+                    env = interpret(env, a, b, base_key, step_idx)
                 else:
                     fn, writes = fn_w
                     # donate only state this segment overwrites (params,
@@ -361,7 +447,7 @@ class Executor:
                                if k in state_set and k in writes}
                     rest = {k: v for k, v in env.items()
                             if k not in constants}
-                    out = fn(donated, rest, key)
+                    out = fn(donated, rest, base_key, step_idx)
                     env = dict(constants)
                     env.update(out)
             fetches = [env[n] for n in fetch_names]
