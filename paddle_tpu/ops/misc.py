@@ -217,9 +217,11 @@ def max_pool2d_with_index(x, pool_size, stride=None, padding=0):
     neg = jnp.finfo(x.dtype).min
     xp = jnp.pad(x, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
                  constant_values=neg)
+    # single-channel index plane (broadcasting it to all C channels
+    # before patch extraction made the 1-channel reshape below fail for
+    # any C > 1)
     flat_idx = jnp.arange(xp.shape[2] * xp.shape[3]).reshape(
         1, 1, xp.shape[2], xp.shape[3])
-    flat_idx = jnp.broadcast_to(flat_idx, xp.shape)
     oh = (xp.shape[2] - k[0]) // s[0] + 1
     ow = (xp.shape[3] - k[1]) // s[1] + 1
     patches = jax.lax.conv_general_dilated_patches(
@@ -228,7 +230,7 @@ def max_pool2d_with_index(x, pool_size, stride=None, padding=0):
     ipatches = jax.lax.conv_general_dilated_patches(
         flat_idx.astype(jnp.float32), k, s, "VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
-    ipatches = ipatches.reshape(n, 1, k[0] * k[1], oh, ow)
+    ipatches = ipatches.reshape(1, 1, k[0] * k[1], oh, ow)
     ipatches = jnp.broadcast_to(ipatches, patches.shape)
     am = jnp.argmax(patches, axis=2)
     out = jnp.take_along_axis(patches, am[:, :, None], axis=2)[:, :, 0]

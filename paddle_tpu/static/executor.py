@@ -6,7 +6,10 @@ machinery) and C++ framework/executor.cc.
 TPU-native redesign: instead of the reference's per-op interpreter hot
 loop (ref: executor.cc:417-421 `for op in ctx->ops_: op->Run`), `run()`
 traces the whole block once through the functional op registry and caches
-a `jax.jit`-compiled step `(state, feeds, key) -> (fetches, new_state)`.
+a `jax.jit`-compiled step
+`(state, feeds, base_key, step_idx) -> (fetches, new_state)` — the
+per-step rng key folds from (base_key, step_idx) INSIDE the compiled
+program, so dispatch costs no eager device ops.
 Persistable vars (parameters, optimizer moments, counters) are the carried
 state pytree (donated, so updates are in-place in HBM). The autodiff
 pseudo-op (see backward.py) is executed as `jax.value_and_grad` over the
@@ -96,14 +99,15 @@ def _as_feed_array(v):
 def background_prefetch(producer, transform, depth=2):
     """Generic background-thread prefetch pipeline: a worker thread
     pulls items from ``producer`` (an iterable), applies ``transform``,
-    and queues up to ``depth`` results ahead of the consumer. Producer
-    exceptions re-raise in the consumer; early consumer exit drains the
-    queue so the worker's blocked put can finish. Shared by
-    device_prefetch and dataio's FileDataLoader."""
+    and queues up to ``depth`` results ahead of the consumer
+    (``depth <= 0`` = unbounded read-ahead). Producer exceptions
+    re-raise in the consumer; early consumer exit drains the queue so
+    the worker's blocked put can finish. Shared by device_prefetch and
+    dataio's FileDataLoader."""
     import queue as _queue
     import threading
 
-    q = _queue.Queue(maxsize=max(int(depth), 1))
+    q = _queue.Queue(maxsize=max(int(depth), 0))
     SENTINEL = object()
     stop = threading.Event()
 

@@ -1,0 +1,472 @@
+"""Numeric-gradient coverage for the FULL SURVEY §2.4 op inventory.
+
+The reference's universal discipline: every op checks its analytic
+gradient against finite differences (ref:
+python/paddle/fluid/tests/unittests/op_test.py:45 get_numeric_gradient,
+:532 check_grad, applied across 422 test files). This sweep makes that
+bar executable against the same 178-name inventory
+tests/test_op_inventory.py audits: every name is EITHER a grad case
+(tiny shapes, central differences vs jax.grad via tests/op_test.py) OR
+an entry in the documented NONDIFF skip list — an exhaustiveness test
+enforces the partition, so a new inventory op cannot silently dodge
+gradient checking.
+
+Inputs are chosen away from kinks (relu/|x|/huber edges) so the
+numeric derivative is valid; piecewise-linear ops (maxout, max-pool)
+use generic random inputs where ties have measure zero.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import layers
+from paddle_tpu.ops import (
+    activation as A, crf, ctc, detection as D, loss as L, math as M,
+    misc, nn, reduce as R, rnn, sequence, tensor_ops as T,
+)
+from tests.op_test import check_grad
+from tests.test_op_inventory import SURVEY_OPS
+
+
+def _r(*shape, seed=0, lo=-1.0, hi=1.0):
+    rng = np.random.RandomState(seed + sum(shape))
+    return (lo + (hi - lo) * rng.rand(*shape)).astype(np.float32)
+
+
+def _pos(*shape, seed=0):
+    return _r(*shape, seed=seed, lo=0.15, hi=0.85)
+
+
+def _away(*shape, seed=0):
+    """Random values bounded away from 0 (for |x|-style kinks)."""
+    x = _r(*shape, seed=seed)
+    return (np.sign(x) * (0.2 + np.abs(x))).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# grad cases: name -> zero-arg builder returning a list of
+# (fn, args, wrt_indices, check_grad kwargs)
+# ---------------------------------------------------------------------------
+def _case(fn, args, wrt=(0,), **kw):
+    return [(fn, args, tuple(wrt), kw)]
+
+
+GRAD_CASES = {
+    # activation family (activation_op.cc): smooth representatives
+    "activation": lambda: _case(A.tanh, [_r(3, 4)]) + _case(
+        A.sigmoid, [_r(3, 4)]) + _case(A.gelu, [_r(3, 4)]),
+    "add_position_encoding": lambda: _case(
+        misc.add_position_encoding, [_r(2, 3, 4)]),
+    "affine_channel": lambda: _case(
+        lambda x, s, b: nn.affine_channel(x, s, b),
+        [_r(2, 3, 2, 2), _r(3), _r(3)], wrt=(0, 1, 2)),
+    "affine_grid": lambda: _case(
+        lambda t: misc.affine_grid(t, (1, 1, 3, 3)), [_r(1, 2, 3)]),
+    "assign": lambda: _case(T.assign, [_r(3, 2)]),
+    "attention_lstm": lambda: _case(
+        lambda x, aw, lw: rnn.attention_lstm(
+            x, jnp.zeros((1, 2), jnp.float32), aw, lw)[0],
+        [_r(1, 3, 2), _r(4, 1), 0.3 * _r(4, 8)], wrt=(0, 1, 2)),
+    "batch_norm": lambda: _case(
+        lambda x, g, b: nn.batch_norm(
+            x, g, b, jnp.zeros(3), jnp.ones(3)),
+        [_r(2, 3, 2, 2), _pos(3), _r(3)], wrt=(0, 1, 2)),
+    "bilinear_tensor_product": lambda: _case(
+        misc.bilinear_tensor_product, [_r(2, 3), _r(2, 4), _r(2, 3, 4)],
+        wrt=(0, 1, 2)),
+    "bpr_loss": lambda: _case(
+        lambda x: L.bpr_loss(x, jnp.asarray([1, 0])), [_r(2, 3)]),
+    "cast": lambda: _case(lambda x: M.cast(x, "float32"), [_r(3)]),
+    "clip": lambda: _case(
+        lambda x: M.clip(x, -2.0, 2.0), [_r(3, 3)]),
+    "clip_by_norm": lambda: _case(
+        lambda x: M.clip_by_norm(x, 0.5), [_r(3, 3)]),
+    "concat": lambda: _case(
+        lambda a, b: T.concat([a, b], axis=1), [_r(2, 2), _r(2, 3)],
+        wrt=(0, 1)),
+    "conv": lambda: _case(
+        lambda x, w: nn.conv2d(x, w, padding=1),
+        [_r(1, 2, 4, 4), 0.5 * _r(3, 2, 3, 3)], wrt=(0, 1)),
+    "conv_fusion": lambda: _case(
+        lambda x, w: misc.conv2d_fusion(x, w, act="identity"),
+        [_r(1, 2, 4, 4), 0.5 * _r(2, 2, 1, 1)], wrt=(0, 1)),
+    "conv_shift": lambda: _case(
+        misc.conv_shift, [_r(2, 5), _r(2, 3)], wrt=(0, 1)),
+    "conv_transpose": lambda: _case(
+        lambda x, w: nn.conv2d_transpose(x, w, stride=2),
+        [_r(1, 2, 3, 3), 0.5 * _r(2, 2, 2, 2)], wrt=(0, 1)),
+    "cos_sim": lambda: _case(
+        L.cos_sim, [_away(2, 4), _away(2, 4, seed=1)], wrt=(0, 1)),
+    "crop": lambda: _case(
+        lambda x: T.crop(x, shape=(2, 2), offsets=(1, 0)), [_r(4, 3)]),
+    "cross_entropy": lambda: _case(
+        lambda p: L.cross_entropy(p / jnp.sum(p, -1, keepdims=True),
+                                  jnp.asarray([0, 2])),
+        [_pos(2, 3)]),
+    "cudnn_lstm": lambda: _case(
+        lambda x: rnn.bidirectional_lstm(
+            x, jnp.asarray(0.4 * _r(2, 12)), jnp.asarray(0.4 * _r(3, 12)),
+            jnp.asarray(0.4 * _r(2, 12, seed=1)),
+            jnp.asarray(0.4 * _r(3, 12, seed=1)))[0],
+        [_r(1, 3, 2)]),
+    "cumsum": lambda: _case(lambda x: M.cumsum(x, axis=1), [_r(2, 4)]),
+    "cvm": lambda: _case(
+        lambda x: misc.cvm(jnp.concatenate(
+            [x[:, :2] + 3.0, x[:, 2:]], 1)),
+        [_r(2, 5)]),
+    "data_norm": lambda: _case(
+        lambda x: nn.data_norm(x, jnp.full((3,), 8.0),
+                               jnp.asarray(_r(3)),
+                               jnp.full((3,), 9.0))[0],
+        [_r(4, 3)]),
+    "deformable_conv": lambda: _case(
+        lambda x, o, w: misc.deformable_conv(x, 0.3 * o, w, padding=1),
+        [_r(1, 2, 4, 4), _r(1, 18, 4, 4), 0.5 * _r(2, 2, 3, 3)],
+        wrt=(0, 2), rtol=3e-2),
+    "deformable_psroi_pooling": lambda: _case(
+        lambda x, t: misc.deformable_psroi_pooling(
+            x, jnp.asarray([[0.5, 0.5, 3.5, 3.5]], jnp.float32),
+            0.2 * t, 2, 1, 2),
+        [_r(1, 2, 5, 5), _r(1, 2, 2, 2)], wrt=(0, 1), rtol=3e-2),
+    "diag": lambda: _case(T.diag, [_r(4)]),
+    "dropout": lambda: _case(
+        lambda x: nn.dropout(x, 0.4, rng=jax.random.PRNGKey(3)),
+        [_r(3, 4)]),
+    "expand": lambda: _case(
+        lambda x: T.expand(x, [2, 3]), [_r(2, 2)]),
+    "fc": lambda: _case(
+        lambda x, w, b: nn.fc_act(x @ w + b, None),
+        [_r(2, 3), _r(3, 4), _r(4)], wrt=(0, 1, 2)),
+    "flatten": lambda: _case(
+        lambda x: T.flatten(x, axis=1), [_r(2, 3, 2)]),
+    "fsp": lambda: _case(
+        misc.fsp_matrix, [_r(1, 2, 3, 3), _r(1, 4, 3, 3)], wrt=(0, 1)),
+    "gather": lambda: _case(
+        lambda x: T.gather(x, jnp.asarray([0, 2, 1])), [_r(3, 2)]),
+    "grid_sampler": lambda: _case(
+        lambda x, g: misc.grid_sampler(x, 0.6 * g),
+        [_r(1, 2, 4, 4), _r(1, 3, 3, 2)], wrt=(0, 1), rtol=3e-2),
+    "group_norm": lambda: _case(
+        lambda x, g, b: nn.group_norm(x, g, b, groups=2),
+        [_r(2, 4, 2, 2), _pos(4), _r(4)], wrt=(0, 1, 2)),
+    "gru": lambda: _case(
+        lambda x, wi, wh: rnn.gru(x, 0.4 * wi, 0.4 * wh)[0],
+        [_r(1, 3, 2), _r(2, 6), _r(2, 6)], wrt=(0, 1, 2)),
+    "gru_unit": lambda: _case(
+        # x is [B, 3H] (pre-projected gates), w_gates [H, 2H], w_cand
+        # [H, H]
+        lambda x, h, wg, wc: misc.gru_unit(x, h, 0.4 * wg, 0.4 * wc),
+        [_r(2, 6), _r(2, 2), _r(2, 4), _r(2, 2)], wrt=(0, 1, 2, 3)),
+    "hierarchical_sigmoid": lambda: _case(
+        lambda x, w: misc.hierarchical_sigmoid(
+            x, w, jnp.asarray(_r(8)), jnp.asarray([0, 2, 4]), 6),
+        [_r(3, 5), _r(8, 5)], wrt=(0, 1)),
+    "hinge_loss": lambda: _case(
+        lambda x: L.hinge_loss(x, jnp.asarray([[1.0], [0.0]])),
+        [_away(2, 1)]),
+    "huber_loss": lambda: _case(
+        lambda x: L.huber_loss(x, jnp.zeros((3, 1)), delta=0.35),
+        [_away(3, 1)]),
+    "im2sequence": lambda: _case(
+        lambda x: misc.im2sequence(x, 2, stride=1), [_r(1, 2, 3, 3)]),
+    "increment": lambda: _case(M.increment, [_r(1)]),
+    "interpolate": lambda: _case(
+        lambda x: nn.interpolate(x, out_shape=(4, 4)), [_r(1, 2, 3, 3)]),
+    "kldiv_loss": lambda: _case(
+        lambda x: L.kldiv_loss(jnp.log(x), jnp.asarray(_pos(2, 3))),
+        [_pos(2, 3)]),
+    "l1_norm": lambda: _case(R.l1_norm, [_away(3, 3)]),
+    "label_smooth": lambda: _case(nn.label_smooth, [_pos(2, 4)]),
+    "layer_norm": lambda: _case(
+        lambda x, g, b: nn.layer_norm(x, g, b),
+        [_r(2, 6), _pos(6), _r(6)], wrt=(0, 1, 2)),
+    "linear_chain_crf": lambda: _case(
+        lambda em, tr: crf.linear_chain_crf(
+            em, tr, jnp.asarray([[0, 2, 1]]),
+            jnp.asarray([3], np.int32)),
+        [_r(1, 3, 3), _r(5, 3)], wrt=(0, 1)),
+    "log_loss": lambda: _case(
+        lambda p: L.log_loss(p, jnp.asarray([[1.0], [0.0]])),
+        [_pos(2, 1)]),
+    "lookup_table": lambda: _case(
+        lambda tbl: misc.lookup_table(jnp.asarray([0, 2, 1]), tbl),
+        [_r(4, 3)]),
+    "lrn": lambda: _case(lambda x: nn.lrn(x, n=3), [_r(1, 4, 2, 2)]),
+    "lstm": lambda: _case(
+        lambda x, wi, wh: rnn.lstm(x, 0.4 * wi, 0.4 * wh)[0],
+        [_r(1, 3, 2), _r(2, 8), _r(2, 8)], wrt=(0, 1, 2)),
+    "lstm_unit": lambda: _case(
+        lambda x, h, c: misc.lstm_unit(x, h, c),
+        [_r(2, 8), _r(2, 2), _r(2, 2)], wrt=(0, 1, 2)),
+    "lstmp": lambda: _case(
+        lambda x, wh, wp: rnn.dynamic_lstmp(x, 0.4 * wh, 0.4 * wp),
+        [_r(1, 3, 8), _r(2, 8), _r(2, 2)], wrt=(0, 1, 2)),
+    "margin_rank_loss": lambda: _case(
+        lambda a, b: L.margin_rank_loss(
+            a, b, jnp.ones((2, 1)), margin=0.1),
+        [1.0 + _pos(2, 1), -1.0 - _pos(2, 1, seed=1)], wrt=(0, 1)),
+    "matmul": lambda: _case(M.matmul, [_r(2, 3), _r(3, 2)], wrt=(0, 1)),
+    "maxout": lambda: _case(
+        lambda x: A.maxout(x, groups=2), [_r(1, 4, 2, 2)]),
+    "mean": lambda: _case(R.mean, [_r(3, 4)]),
+    "minus": lambda: _case(M.minus, [_r(3), _r(3)], wrt=(0, 1)),
+    "modified_huber_loss": lambda: _case(
+        lambda x: L.modified_huber_loss(x, jnp.ones((3, 1))),
+        [np.asarray([[0.3], [-1.6], [-0.4]], np.float32)]),
+    "mul": lambda: _case(M.mul, [_r(2, 3), _r(3, 2)], wrt=(0, 1)),
+    "multiplex": lambda: _case(
+        lambda a, b: T.multiplex([a, b], jnp.asarray([[0], [1]])),
+        [_r(2, 3), _r(2, 3, seed=1)], wrt=(0, 1)),
+    "nce": lambda: _case(
+        lambda x, w, b: misc.nce(x, w, b, jnp.asarray([1, 2]),
+                                 jnp.asarray([5, 6]), 10),
+        [_r(2, 4), _r(10, 4), _r(10)], wrt=(0, 1, 2)),
+    "norm": lambda: _case(
+        lambda x: R.norm(x, axis=1), [_away(2, 3)]),
+    "pad": lambda: _case(
+        lambda x: nn.pad(x, [1, 1, 0, 2]), [_r(2, 3)]),
+    "pad2d": lambda: _case(
+        lambda x: nn.pad2d(x, [1, 0, 1, 0], mode="reflect"),
+        [_r(1, 2, 3, 3)]),
+    "pad_constant_like": lambda: _case(
+        lambda x: nn.pad_constant_like(jnp.zeros((3, 4)), x), [_r(2, 3)]),
+    "pixel_shuffle": lambda: _case(
+        lambda x: nn.pixel_shuffle(x, 2), [_r(1, 4, 2, 2)]),
+    "pool": lambda: _case(
+        lambda x: nn.pool2d(x, 2, pool_type="avg", pool_stride=2),
+        [_r(1, 2, 4, 4)]) + _case(
+        lambda x: nn.pool2d(x, 2, pool_type="max", pool_stride=2),
+        [_r(1, 2, 4, 4)]),
+    "pool_with_index": lambda: _case(
+        lambda x: misc.max_pool2d_with_index(x, 2, stride=2)[0],
+        [_r(1, 2, 4, 4)]),
+    "prelu": lambda: _case(
+        lambda x, a: A.prelu(x, a), [_away(2, 3), _pos(1)], wrt=(0, 1)),
+    "psroi_pool": lambda: _case(
+        lambda x: D.psroi_pool(
+            x, jnp.asarray([[0.5, 0.5, 3.5, 3.5]], jnp.float32),
+            2, 1.0, 2, 2),
+        [_r(1, 8, 5, 5)], rtol=3e-2),
+    "rank_loss": lambda: _case(
+        lambda a, b: L.rank_loss(a, b, jnp.ones((2, 1))),
+        [_r(2, 1), _r(2, 1, seed=1)], wrt=(0, 1)),
+    "reshape": lambda: _case(
+        lambda x: T.reshape(x, (3, 2)), [_r(2, 3)]),
+    "reverse": lambda: _case(
+        lambda x: T.reverse(x, axis=[0]), [_r(3, 2)]),
+    "roi_align": lambda: _case(
+        lambda x: D.roi_align(
+            x, jnp.asarray([[0.6, 0.6, 3.4, 3.4]], jnp.float32),
+            pooled_height=2, pooled_width=2),
+        [_r(1, 2, 5, 5)], rtol=3e-2),
+    "roi_pool": lambda: _case(
+        lambda x: D.roi_pool(
+            x, jnp.asarray([[0.0, 0.0, 4.0, 4.0]], jnp.float32),
+            pooled_height=2, pooled_width=2),
+        [_r(1, 2, 5, 5)]),
+    "row_conv": lambda: _case(
+        misc.row_conv, [_r(2, 4, 3), _r(2, 3)], wrt=(0, 1)),
+    "sample_logits": lambda: _case(
+        lambda lg: misc.sample_logits(lg, jnp.asarray([1, 0]),
+                                      jnp.asarray([3, 4])),
+        [_r(2, 6)]),
+    "scale": lambda: _case(
+        lambda x: layers.scale(x, scale=2.5, bias=0.5), [_r(3, 2)]),
+    "scatter": lambda: _case(
+        lambda x, u: T.scatter(x, jnp.asarray([0, 2]), u),
+        [_r(3, 2), _r(2, 2)], wrt=(0, 1)),
+    "selu": lambda: _case(A.selu, [_away(3, 3)]),
+    "shuffle_channel": lambda: _case(
+        lambda x: nn.shuffle_channel(x, 2), [_r(1, 4, 2, 2)]),
+    "sigmoid_cross_entropy_with_logits": lambda: _case(
+        lambda x: L.sigmoid_cross_entropy_with_logits(
+            x, jnp.asarray([[1.0, 0.0]])),
+        [_r(1, 2)]),
+    "similarity_focus": lambda: _case(
+        lambda x: misc.similarity_focus(x, 1, [0]), [_r(2, 3, 2, 2)]),
+    "slice": lambda: _case(
+        lambda x: T.slice(x, axes=[0, 1], starts=[0, 1], ends=[2, 3]),
+        [_r(3, 4)]),
+    "smooth_l1_loss": lambda: _case(
+        lambda x: misc.smooth_l1_loss(x, jnp.zeros((3, 2))),
+        [_away(3, 2)]),
+    "softmax": lambda: _case(A.softmax, [_r(2, 4)]),
+    "softmax_with_cross_entropy": lambda: _case(
+        lambda x: L.softmax_with_cross_entropy(x, jnp.asarray([[1], [2]])),
+        [_r(2, 4)]),
+    "space_to_depth": lambda: _case(
+        lambda x: nn.space_to_depth(x, 2), [_r(1, 2, 4, 4)]),
+    "spectral_norm": lambda: _case(
+        lambda w: misc.spectral_norm(w, u=jnp.asarray(_r(3, seed=7))),
+        [_r(3, 4)], rtol=3e-2),
+    "split": lambda: _case(
+        lambda x: T.split(x, 2, dim=1)[0], [_r(2, 4)]),
+    "spp": lambda: _case(
+        lambda x: misc.spp(x, pyramid_height=2), [_r(1, 2, 4, 4)]) +
+        _case(lambda x: misc.spp(x, pyramid_height=2, pool_type="avg"),
+              [_r(1, 2, 4, 4)]),
+    "squared_l2_distance": lambda: _case(
+        misc.squared_l2_distance, [_r(3, 4), _r(3, 4, seed=1)],
+        wrt=(0, 1)),
+    "squared_l2_norm": lambda: _case(R.squared_l2_norm, [_r(3, 3)]),
+    "squeeze": lambda: _case(
+        lambda x: T.squeeze(x, axes=[1]), [_r(2, 1, 3)]),
+    "stack": lambda: _case(
+        lambda a, b: T.stack([a, b], axis=0), [_r(2, 2), _r(2, 2)],
+        wrt=(0, 1)),
+    "sum": lambda: _case(
+        lambda a, b: misc.sum([a, b]), [_r(2, 3), _r(2, 3)], wrt=(0, 1)),
+    "sync_batch_norm": lambda: _case(
+        lambda x, g: nn.sync_batch_norm(
+            x, g, jnp.zeros(2), jnp.zeros(2), jnp.ones(2)),
+        [_r(2, 2, 2, 2), _pos(2)], wrt=(0, 1)),
+    "teacher_student_sigmoid_loss": lambda: _case(
+        lambda x: L.teacher_student_sigmoid_loss(x, jnp.asarray(
+            [[0.3], [0.8]])),
+        [_r(2, 1)]),
+    "temporal_shift": lambda: _case(
+        lambda x: misc.temporal_shift(x, seg_num=2), [_r(4, 4, 2, 2)]),
+    "top_k": lambda: _case(
+        # well-separated values: FD perturbation must not flip ranks
+        lambda x: misc.top_k(x, 2)[0],
+        [np.asarray([[0.1, 2.0, -1.0, 4.0, 1.0],
+                     [3.0, -2.0, 0.5, -4.0, 1.5]], np.float32)]),
+    "transpose": lambda: _case(
+        lambda x: T.transpose(x, perm=[1, 0]), [_r(2, 3)]),
+    "tree_conv": lambda: _case(
+        lambda n, w: misc.tree_conv(
+            n, jnp.asarray((np.arange(16).reshape(1, 4, 4) % 3 == 0)
+                           .astype(np.float32)), w),
+        [_r(1, 4, 3), _r(2, 3, 4)], wrt=(0, 1)),
+    "unfold": lambda: _case(
+        lambda x: nn.unfold(x, 2), [_r(1, 2, 3, 3)]),
+    "unpool": lambda: _case(
+        lambda x: misc.unpool2d(x, jnp.asarray([[[[0, 3], [10, 15]]]]),
+                                (4, 4)),
+        [_r(1, 1, 2, 2)]),
+    "unsqueeze": lambda: _case(
+        lambda x: T.reshape(x, (2, 1, 3)), [_r(2, 3)]),
+    "unstack": lambda: _case(
+        lambda x: layers.unstack(x, axis=0)[0], [_r(2, 3)]),
+    "warpctc": lambda: _case(
+        lambda lg: ctc.warpctc(lg, jnp.asarray([[1, 2]]),
+                               jnp.asarray([4], np.int32),
+                               jnp.asarray([2], np.int32)),
+        [_r(1, 4, 4)]),
+}
+
+# ---------------------------------------------------------------------------
+# documented skip list: genuinely non-differentiable / non-tensor ops
+# ---------------------------------------------------------------------------
+NONDIFF = {
+    # integer / boolean / index outputs (no gradient exists)
+    "arg_max": "integer index output",
+    "arg_min": "integer index output",
+    "argsort": "integer index output",
+    "chunk_eval": "integer metric counts",
+    "crf_decoding": "Viterbi decode: integer tag path",
+    "ctc_align": "integer alignment output",
+    "detection_map": "mAP metric (counts)",
+    "edit_distance": "integer string metric",
+    "hash": "integer hashing",
+    "is_empty": "boolean output",
+    "isfinite": "boolean output",
+    "mean_iou": "integer confusion counts",
+    "one_hot": "integer input, constant output",
+    "positive_negative_pair": "ranking metric counts",
+    "shape": "integer shape output",
+    "sign": "derivative is zero a.e. (no information in a grad check)",
+    "size": "integer size output",
+    "unique": "integer index/count outputs",
+    "where": "fluid where_op returns integer indices of true elements",
+    # random sources / stochastic draws (output independent of any
+    # differentiable input, or randomness IS the op)
+    "gaussian_random": "random source, no tensor input",
+    "gaussian_random_batch_size_like": "random source",
+    "random_crop": "stochastic crop selection",
+    "sampling_id": "stochastic index draw",
+    "truncated_gaussian_random": "random source",
+    "uniform_random": "random source",
+    "uniform_random_batch_size_like": "random source",
+    # constant generators (no differentiable input)
+    "assign_value": "constant source",
+    "fill": "constant source",
+    "fill_any_like": "constant output regardless of input values",
+    "fill_constant": "constant source",
+    "fill_constant_batch_size_like": "constant source",
+    "fill_zeros_like": "constant output",
+    "linspace": "constant generator",
+    "range": "constant generator",
+    # quantization: rounding is non-differentiable (reference trains
+    # these with straight-through estimators, not true gradients)
+    "dequantize": "int8 input; rounding pair of quantize",
+    "fake_dequantize": "rounding (STE in training)",
+    "fake_quantize": "rounding (STE in training)",
+    "quantize": "rounding",
+    "requantize": "rounding",
+    # discrete search / control
+    "beam_search": "discrete beam selection",
+    "beam_search_decode": "discrete backtrack",
+    # program/scope/IO plumbing (no tensor math)
+    "delete_var": "scope bookkeeping op",
+    "load": "IO op",
+    "load_combine": "IO op",
+    "print": "identity with host-print side effect",
+    "py_func": "arbitrary host callback boundary",
+    "save": "IO op",
+    "save_combine": "IO op",
+    # LoD/TensorArray structural metadata ops (reference registers them
+    # without gradient or with pass-through identity)
+    "array_to_lod_tensor": "TensorArray structural conversion",
+    "lod_array_length": "integer length",
+    "lod_rank_table": "rank-table metadata",
+    "lod_reset": "LoD metadata rewrite",
+    "lod_tensor_to_array": "TensorArray structural conversion",
+    "max_sequence_len": "integer length",
+    "merge_lod_tensor": "structural merge (mask-driven copy)",
+    "merge_selected_rows": "SelectedRows structural merge",
+    "get_tensor_from_selected_rows": "SelectedRows structural view",
+    "lookup_sparse_table": "host-side sparse table service (dense "
+                           "lookup_table gradient covered above)",
+    "reorder_lod_tensor_by_rank": "structural permutation by rank table",
+    "rnn_memory_helper": "RNN scope plumbing",
+    "shrink_rnn_memory": "RNN scope plumbing",
+    "split_lod_tensor": "structural split (mask-driven copy)",
+    "split_selected_rows": "SelectedRows structural split",
+    "tensor_array_to_tensor": "TensorArray structural conversion",
+    "recurrent": "StaticRNN program builder (scan-based lstm/gru "
+                 "gradients covered above)",
+    # optimizer / training-loop internals (not differentiable layers)
+    "alloc_continuous_space": "buffer-coalescing plumbing (the fused-"
+                              "allreduce bucketing primitive)",
+    "average_accumulates": "ModelAverage state bookkeeping",
+    "dgc": "top-k gradient sparsification transform",
+    "dgc_clip_by_norm": "optimizer-internal (clip_by_norm gradient "
+                        "covered above)",
+    "increment": None,  # replaced below — it IS differentiable
+}
+del NONDIFF["increment"]
+
+
+def test_inventory_partition_is_exhaustive():
+    """Every SURVEY op is exactly one of: grad-checked or documented
+    non-differentiable."""
+    names = set(SURVEY_OPS)
+    cased = set(GRAD_CASES)
+    skipped = set(NONDIFF)
+    assert not cased & skipped, sorted(cased & skipped)
+    missing = names - cased - skipped
+    assert not missing, f"ops with neither grad case nor skip: " \
+                        f"{sorted(missing)}"
+    extra = (cased | skipped) - names
+    assert not extra, f"entries not in the inventory: {sorted(extra)}"
+
+
+@pytest.mark.parametrize("name", sorted(GRAD_CASES))
+def test_inventory_grad(name):
+    for fn, args, wrts, kw in GRAD_CASES[name]():
+        for w in wrts:
+            check_grad(fn, args, wrt=w, **kw)
