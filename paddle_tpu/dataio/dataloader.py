@@ -54,7 +54,10 @@ class FileDataLoader:
     parse_fn(record: bytes) -> tuple/np.ndarray sample;
     samples are stacked per-field into numpy batches. With
     device_put=True (default) batches are transferred to the default
-    device one step ahead of consumption.
+    device one step ahead of consumption. ``prefetch`` bounds the
+    read-ahead queue; ``prefetch <= 0`` means UNBOUNDED read-ahead (the
+    worker may buffer the whole dataset — only use when that fits in
+    host memory).
     """
 
     def __init__(self, files, parse_fn, batch_size, nthreads=2,

@@ -14,11 +14,13 @@ prefetch (distributed/parameter_prefetch.cc), and checkpoint notify
 TPU-native shape: dense data-parallelism belongs to SPMD/XLA collectives
 (paddle_tpu.parallel); the PS path remains for what genuinely needs a
 host-side service — giant/growing sparse tables and asynchronous
-trainers. The transport is a length-prefixed-pickle TCP protocol over
-persistent connections (the role of grpc_client.cc's bytebuffer serde;
-zero external deps), and the "optimize block" the reference executes per
-parameter is the same functional `Optimizer` rule the local executor
-uses, applied server-side.
+trainers. The transport is the fixed-schema framed binary protocol in
+wire.py over persistent connections (the role of grpc_client.cc's
+bytebuffer serde; NO pickle — socket bytes are never evaluated), with
+retry/backoff + per-client request-sequence dedup on the client
+(rpc_client.h:33 contract, grpc_client.cc retry path). The "optimize
+block" the reference executes per parameter is the same functional
+`Optimizer` rule the local executor uses, applied server-side.
 
 Sync semantics (RunSyncLoop parity): each var carries a round counter.
 ``pull(name, min_round)`` blocks until the server has applied that many
@@ -30,39 +32,54 @@ same way).
 """
 
 import os
-import pickle
 import socket
 import socketserver
-import struct
 import threading
+import time
 
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.distributed import wire
 
 __all__ = ["ParameterServer", "PSClient", "Communicator", "run_pserver"]
 
-_LEN = struct.Struct("<Q")
-
-
-def _send_msg(sock, obj):
-    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(blob)) + blob)
-
 
 def _recv_exact(sock, n):
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    """Read exactly n bytes into a preallocated buffer (recv_into is
+    ~3x the bytearray-extend pattern at 64 MB on loopback)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("peer closed")
-        buf.extend(chunk)
-    return bytes(buf)
+        got += r
+    return buf
 
 
-def _recv_msg(sock):
-    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+def _send_frame(sock, kind, fields, client_id=0, seq=0):
+    # writev via sendmsg: large array payloads go out zero-copy
+    parts = [memoryview(p).cast("B")
+             for p in wire.encode_parts(kind, fields, client_id, seq)]
+    while parts:
+        sent = sock.sendmsg(parts)
+        while parts and sent >= len(parts[0]):
+            sent -= len(parts[0])
+            parts.pop(0)
+        if parts and sent:
+            parts[0] = parts[0][sent:]
+
+
+def _recv_frame(sock):
+    """Read one validated frame: (kind, client_id, seq, fields).
+    Raises wire.WireError on malformed bytes — NOTHING from the socket
+    is ever evaluated, only fixed-schema fields are decoded."""
+    kind, client_id, seq, n = wire.decode_header(
+        _recv_exact(sock, wire.HEADER_SIZE))
+    fields = wire.decode_payload(kind, _recv_exact(sock, n))
+    return kind, client_id, seq, fields
 
 
 class _DenseVar:
@@ -140,7 +157,12 @@ class _SparseTable:
     """Hosted sparse table (lookup_sparse_table / pserver sparse block
     parity): rows materialize on first touch; pushes apply the table's
     optimizer rule — "sgd" or "adagrad" (the pserver optimize-block
-    choices the reference runs for sparse params)."""
+    choices the reference runs for sparse params).
+
+    With the default initializer and the native library built, the row
+    store and updates run in C++ (native/src/ps_table.cc — the sparse
+    host path SURVEY §2.6/§7 keeps hand-written C++); a custom Python
+    initializer falls back to the Python store."""
 
     def __init__(self, dim, initializer=None, seed=0, lr=1.0,
                  optimizer="sgd", eps=1e-6):
@@ -150,6 +172,15 @@ class _SparseTable:
         self.lr = lr
         self.optimizer = optimizer
         self.eps = eps
+        self._native = None
+        if initializer is None:
+            try:
+                from paddle_tpu import native
+                if native.available():
+                    self._native = native.NativeSparseTable(
+                        dim, optimizer, lr, eps, seed)
+            except Exception:
+                self._native = None
         self.rows = {}
         self.accum = {}               # adagrad per-row G accumulators
         self._rng = np.random.RandomState(seed)
@@ -157,7 +188,15 @@ class _SparseTable:
             lambda rng, dim: rng.normal(0, 0.01, dim).astype(np.float32))
         self.lock = threading.Lock()
 
+    def __len__(self):
+        if self._native is not None:
+            return len(self._native)
+        with self.lock:
+            return len(self.rows)
+
     def pull(self, ids):
+        if self._native is not None:
+            return self._native.pull(ids)
         with self.lock:
             out = np.empty((len(ids), self.dim), np.float32)
             for i, x in enumerate(ids):
@@ -169,6 +208,9 @@ class _SparseTable:
             return out
 
     def push(self, ids, grads, lr=None):
+        if self._native is not None:
+            self._native.push(ids, grads, lr)
+            return
         lr = self.lr if lr is None else lr
         with self.lock:
             for x, g in zip(ids, grads):
@@ -185,6 +227,35 @@ class _SparseTable:
                     row = row - lr * g
                 self.rows[x] = row
 
+    def snapshot(self):
+        """(ids, rows, accum) arrays for checkpoints."""
+        if self._native is not None:
+            return self._native.snapshot()
+        with self.lock:
+            ids = np.fromiter(self.rows, np.int64, len(self.rows))
+            rows = (np.stack([self.rows[int(i)] for i in ids])
+                    if len(ids) else np.zeros((0, self.dim), np.float32))
+            accum = (np.stack([self.accum.get(int(i),
+                                              np.zeros(self.dim,
+                                                       np.float32))
+                               for i in ids])
+                     if len(ids) else np.zeros((0, self.dim), np.float32))
+            return ids, rows, accum
+
+    def restore(self, ids, rows, accum=None):
+        if self._native is not None:
+            self._native.restore(ids, rows, accum)
+            return
+        with self.lock:
+            self.rows = {int(i): np.asarray(r, np.float32)
+                         for i, r in zip(ids, rows)}
+            self.accum = {}
+            if accum is not None and len(accum):
+                for i, a in zip(ids, accum):
+                    a = np.asarray(a, np.float32)
+                    if np.any(a):
+                        self.accum[int(i)] = a
+
 
 class ParameterServer:
     """listen_and_serv parity: hosts a set of dense vars + sparse tables,
@@ -199,10 +270,22 @@ class ParameterServer:
         self.dense = {}
         self.sparse = {}
         self._barrier_lock = threading.Condition()
-        self._barrier_count = {}
+        self._barrier_waiting = {}    # tag -> set(trainer ids)
         self._barrier_gen = {}
         self._server = None
         self._thread = None
+        # retry dedup for mutating requests (grpc retry-idempotence
+        # role): (client_id, seq) -> cached reply in a bounded LRU,
+        # plus an in-flight set so a retry that races the original
+        # request waits for it instead of re-applying. One entry per
+        # client is NOT enough — PSClient is multi-threaded (user
+        # thread + Communicator send thread share one seq counter), so
+        # replies from different threads interleave.
+        import collections
+        self._dedup = collections.OrderedDict()
+        self._dedup_cap = 1024
+        self._inflight = set()
+        self._dedup_cv = threading.Condition()
 
     # -- hosting -----------------------------------------------------------
     def host_dense(self, name, value, optimizer=None, regularizer=None,
@@ -216,53 +299,89 @@ class ParameterServer:
                                          optimizer)
 
     # -- request handling (request_handler_impl.cc parity) -----------------
-    def _handle(self, msg):
-        kind = msg[0]
-        if kind == "push_grad":
-            _, name, trainer_id, grad = msg
+    def _handle(self, kind, fields):
+        """Dispatch one decoded request; returns (resp_kind, fields)."""
+        if kind == wire.PUSH_GRAD:
+            name, trainer_id, grad = fields
             v = self.dense[name]
             if self.sync_mode:
-                v.push_sync(trainer_id, grad, self.num_trainers)
+                v.push_sync(int(trainer_id), grad, self.num_trainers)
             else:
                 v.push_async(grad)
-            return ("ok",)
-        if kind == "pull_param":
-            _, name, min_round = msg
+            return (wire.OK, ())
+        if kind == wire.PULL_PARAM:
+            name, min_round = fields
             if not self.sync_mode:
                 min_round = 0
-            return ("ok", self.dense[name].pull(min_round))
-        if kind == "pull_sparse":
-            _, name, ids = msg
-            return ("ok", self.sparse[name].pull(ids))
-        if kind == "push_sparse":
-            _, name, ids, grads, lr = msg
+            return (wire.OK_ARR, (self.dense[name].pull(int(min_round)),))
+        if kind == wire.PULL_SPARSE:
+            name, ids = fields
+            return (wire.OK_ARR, (self.sparse[name].pull(ids),))
+        if kind == wire.PUSH_SPARSE:
+            name, ids, grads, lr = fields
             self.sparse[name].push(ids, grads, lr)
-            return ("ok",)
-        if kind == "barrier":
-            _, tag, _trainer_id = msg
+            return (wire.OK, ())
+        if kind == wire.BARRIER:
+            tag, trainer_id = fields
+            trainer_id = int(trainer_id)
             with self._barrier_lock:
                 gen = self._barrier_gen.setdefault(tag, 0)
-                n = self._barrier_count.get(tag, 0) + 1
-                self._barrier_count[tag] = n
-                if n >= self.num_trainers:
-                    self._barrier_count[tag] = 0
+                # set-based fan-in: a retried barrier frame from the
+                # same trainer is idempotent
+                waiting = self._barrier_waiting.setdefault(tag, set())
+                waiting.add(trainer_id)
+                if len(waiting) >= self.num_trainers:
+                    waiting.clear()
                     self._barrier_gen[tag] = gen + 1
                     self._barrier_lock.notify_all()
                 else:
                     ok = self._barrier_lock.wait_for(
                         lambda: self._barrier_gen[tag] > gen, timeout=120.0)
                     enforce(ok, f"barrier {tag!r} timed out")
-            return ("ok",)
-        if kind == "checkpoint_notify":
-            _, dirname = msg
+            return (wire.OK, ())
+        if kind == wire.CHECKPOINT_NOTIFY:
+            (dirname,) = fields
             self.save(dirname)
-            return ("ok",)
-        if kind == "list_vars":
-            return ("ok", sorted(self.dense), sorted(self.sparse))
-        if kind == "stop":
+            return (wire.OK, ())
+        if kind == wire.LIST_VARS:
+            return (wire.OK_NAMES, ("\n".join(sorted(self.dense)),
+                                    "\n".join(sorted(self.sparse))))
+        if kind == wire.STOP:
             threading.Thread(target=self.stop, daemon=True).start()
-            return ("ok",)
-        return ("err", f"unknown request {kind!r}")
+            return (wire.OK, ())
+        return (wire.ERR, (f"unhandled request kind {kind}",))
+
+    def _handle_frame(self, kind, client_id, seq, fields):
+        """Dedup wrapper: retried mutating frames (same client, same
+        seq) are answered from the cached reply, never re-applied; a
+        retry racing the still-running original waits for it."""
+        if kind not in wire.MUTATING or not client_id:
+            return self._handle(kind, fields)
+        key = (client_id, seq)
+        with self._dedup_cv:
+            while True:
+                if key in self._dedup:
+                    self._dedup.move_to_end(key)
+                    return self._dedup[key]
+                if key not in self._inflight:
+                    self._inflight.add(key)
+                    break
+                ok = self._dedup_cv.wait_for(
+                    lambda: key in self._dedup
+                    or key not in self._inflight, timeout=150.0)
+                enforce(ok, f"duplicate frame {key} timed out waiting "
+                            f"for the original")
+        try:
+            resp = self._handle(kind, fields)
+            with self._dedup_cv:
+                self._dedup[key] = resp
+                while len(self._dedup) > self._dedup_cap:
+                    self._dedup.popitem(last=False)
+            return resp
+        finally:
+            with self._dedup_cv:
+                self._inflight.discard(key)
+                self._dedup_cv.notify_all()
 
     # -- checkpoint (kCheckpointBlockId parity) ----------------------------
     def save(self, dirname):
@@ -271,14 +390,7 @@ class ParameterServer:
         dense = {n: v.value for n, v in self.dense.items()}
         np.savez(os.path.join(dirname, f"pserver_{tag}.npz"), **dense)
         for n, t in self.sparse.items():
-            with t.lock:
-                ids = np.fromiter(t.rows, np.int64, len(t.rows))
-                rows = (np.stack([t.rows[int(i)] for i in ids])
-                        if len(ids) else np.zeros((0, t.dim), np.float32))
-                accum = (np.stack([t.accum.get(int(i),
-                                               np.zeros(t.dim, np.float32))
-                                   for i in ids])
-                         if len(ids) else np.zeros((0, t.dim), np.float32))
+            ids, rows, accum = t.snapshot()
             np.savez(os.path.join(dirname, f"pserver_{tag}_{n}.npz"),
                      ids=ids, rows=rows, accum=accum)
 
@@ -294,23 +406,39 @@ class ParameterServer:
             p = os.path.join(dirname, f"pserver_{tag}_{n}.npz")
             if os.path.exists(p):
                 with np.load(p) as blob:
-                    t.rows = {int(i): r for i, r in
-                              zip(blob["ids"], blob["rows"])}
-                    if "accum" in blob.files:
-                        t.accum = {int(i): a for i, a in
-                                   zip(blob["ids"], blob["accum"])}
-                    else:   # old checkpoint: stale accumulators must not
-                        t.accum = {}    # scale the restored rows
+                    # old checkpoints without accum: restore with empty
+                    # accumulators so stale G does not scale the rows
+                    t.restore(blob["ids"], blob["rows"],
+                              blob["accum"] if "accum" in blob.files
+                              else None)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
-        handle = self._handle
+        handle_frame = self._handle_frame
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
                     while True:
-                        _send_msg(self.request, handle(_recv_msg(self.request)))
+                        try:
+                            kind, cid, seq, fields = _recv_frame(
+                                self.request)
+                        except wire.WireError as e:
+                            # malformed frame: reply with a typed error
+                            # and drop the connection — the bytes were
+                            # never evaluated
+                            try:
+                                _send_frame(self.request, wire.ERR,
+                                            (f"malformed frame: {e}",))
+                            except OSError:
+                                pass
+                            return
+                        try:
+                            rk, rf = handle_frame(kind, cid, seq, fields)
+                        except Exception as e:
+                            rk, rf = wire.ERR, (f"{type(e).__name__}: "
+                                                f"{e}",)
+                        _send_frame(self.request, rk, rf)
                 except (ConnectionError, EOFError, OSError):
                     pass
 
@@ -346,12 +474,21 @@ class ParameterServer:
 
 class PSClient:
     """RPCClient parity (rpc_client.h:33): persistent connections to every
-    pserver, var→endpoint routing, send/get/prefetch/barrier/checkpoint."""
+    pserver, var→endpoint routing, send/get/prefetch/barrier/checkpoint.
+    Connection failures retry with exponential backoff (grpc_client.cc
+    retry path); retried mutating frames carry the same (client_id, seq)
+    so the server dedups instead of re-applying."""
+
+    MAX_RETRIES = 5
+    BACKOFF = 0.05          # seconds, doubles per attempt (cap 2 s)
 
     def __init__(self, endpoints, var_ep=None, trainer_id=0):
         self.endpoints = list(endpoints)
         self.var_ep = dict(var_ep or {})
         self.trainer_id = trainer_id
+        self.client_id = int.from_bytes(os.urandom(8), "little") or 1
+        self._seq = 0
+        self._seq_lock = threading.Lock()
         # connections are per-thread: a blocking pull (sync-mode round
         # wait) in one thread must not serialize pushes from another
         # (the Communicator's send thread, grpc_client's channel pool role)
@@ -359,26 +496,55 @@ class PSClient:
         self._all_socks = []
         self._all_lock = threading.Lock()
 
-    def _sock(self, ep):
+    def _next_seq(self):
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def _sock(self, ep, fresh=False):
         socks = getattr(self._tls, "socks", None)
         if socks is None:
             socks = self._tls.socks = {}
         s = socks.get(ep)
+        if fresh and s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+            s = None
         if s is None:
             host, port = ep.rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=120.0)
+            # client timeout > server-side wait timeouts (120 s): the
+            # server's own EnforceNotMet must surface as a typed error
+            # response before the transport gives up
+            s = socket.create_connection((host, int(port)), timeout=150.0)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             socks[ep] = s
             with self._all_lock:
                 self._all_socks.append(s)
         return s
 
-    def _call(self, ep, *msg):
-        s = self._sock(ep)
-        _send_msg(s, msg)
-        resp = _recv_msg(s)
-        enforce(resp[0] == "ok", f"pserver {ep} error: {resp[1:]}")
-        return resp[1] if len(resp) > 1 else None
+    def _call(self, ep, kind, *fields):
+        seq = self._next_seq()
+        delay = self.BACKOFF
+        for attempt in range(self.MAX_RETRIES + 1):
+            try:
+                s = self._sock(ep, fresh=attempt > 0)
+                _send_frame(s, kind, fields, self.client_id, seq)
+                rk, _, _, rf = _recv_frame(s)
+                break
+            except (ConnectionError, socket.timeout, OSError):
+                if attempt == self.MAX_RETRIES:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        enforce(rk != wire.ERR, f"pserver {ep} error: "
+                                f"{rf[0] if rf else '?'}")
+        if rk == wire.OK_ARR:
+            return rf[0]
+        if rk == wire.OK_NAMES:
+            return tuple(t.split("\n") if t else [] for t in rf)
+        return None
 
     def _ep_of(self, name):
         ep = self.var_ep.get(name)
@@ -387,34 +553,38 @@ class PSClient:
 
     # -- dense -------------------------------------------------------------
     def push_grad(self, name, grad):
-        self._call(self._ep_of(name), "push_grad", name, self.trainer_id,
-                   np.asarray(grad))
+        self._call(self._ep_of(name), wire.PUSH_GRAD, name,
+                   self.trainer_id, np.asarray(grad))
 
     def pull_param(self, name, min_round=0):
-        return self._call(self._ep_of(name), "pull_param", name, min_round)
+        return self._call(self._ep_of(name), wire.PULL_PARAM, name,
+                          min_round)
 
     # -- sparse (parameter_prefetch.cc parity) -----------------------------
     def pull_sparse(self, table, ids):
-        return self._call(self._ep_of(table), "pull_sparse", table,
+        return self._call(self._ep_of(table), wire.PULL_SPARSE, table,
                           np.asarray(ids, np.int64))
 
     def push_sparse(self, table, ids, grads, lr=None):
-        self._call(self._ep_of(table), "push_sparse", table,
+        self._call(self._ep_of(table), wire.PUSH_SPARSE, table,
                    np.asarray(ids, np.int64), np.asarray(grads), lr)
 
     # -- control -----------------------------------------------------------
     def barrier(self, tag="global"):
         for ep in self.endpoints:
-            self._call(ep, "barrier", tag, self.trainer_id)
+            self._call(ep, wire.BARRIER, tag, self.trainer_id)
 
     def checkpoint_notify(self, dirname):
         for ep in self.endpoints:
-            self._call(ep, "checkpoint_notify", dirname)
+            self._call(ep, wire.CHECKPOINT_NOTIFY, dirname)
+
+    def list_vars(self, ep=None):
+        return self._call(ep or self.endpoints[0], wire.LIST_VARS)
 
     def stop_servers(self):
         for ep in self.endpoints:
             try:
-                self._call(ep, "stop")
+                self._call(ep, wire.STOP)
             except Exception:
                 pass
 

@@ -15,7 +15,8 @@ import subprocess
 import threading
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
-_SOURCES = ["recordio.cc", "data_pipeline.cc", "arena.cc", "strings.cc"]
+_SOURCES = ["recordio.cc", "data_pipeline.cc", "arena.cc", "strings.cc",
+            "ps_table.cc"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None
@@ -103,6 +104,23 @@ def _bind(lib):
                              c_long]
     lib.pt_pretty_log.argtypes = [c_char_p, c_char_p]
     lib.pt_pretty_log.restype = None
+    c_float_p = ctypes.POINTER(ctypes.c_float)
+    c_int64_p = ctypes.POINTER(ctypes.c_int64)
+    lib.pt_ps_table_new.restype = c_void_p
+    lib.pt_ps_table_new.argtypes = [c_int, c_int, ctypes.c_float,
+                                    ctypes.c_float, ctypes.c_uint64]
+    lib.pt_ps_table_free.argtypes = [c_void_p]
+    lib.pt_ps_table_size.restype = c_long
+    lib.pt_ps_table_size.argtypes = [c_void_p]
+    lib.pt_ps_table_pull.argtypes = [c_void_p, c_int64_p, c_long,
+                                     c_float_p]
+    lib.pt_ps_table_push.argtypes = [c_void_p, c_int64_p, c_float_p,
+                                     c_long, ctypes.c_float]
+    lib.pt_ps_table_export.restype = c_long
+    lib.pt_ps_table_export.argtypes = [c_void_p, c_long, c_int64_p,
+                                       c_float_p, c_float_p]
+    lib.pt_ps_table_import.argtypes = [c_void_p, c_int64_p, c_float_p,
+                                       c_float_p, c_long]
     return lib
 
 
@@ -377,3 +395,89 @@ def build_race_check():
                   for s in _SOURCES + ["race_check.cc"]], exe,
                  extra_flags=("-fsanitize=thread", "-g"))
     return exe
+
+
+class NativeSparseTable:
+    """C++ sparse parameter table (src/ps_table.cc): int64-keyed rows,
+    deterministic per-id N(0, 0.01) init on first touch, vectorized
+    sgd/adagrad row updates — the PS sparse host path kept native (ref
+    capability: operators/lookup_sparse_table_op.cc + fleet pull/push
+    sparse)."""
+
+    _OPTS = {"sgd": 0, "adagrad": 1}
+
+    def __init__(self, dim, optimizer="sgd", lr=1.0, eps=1e-6, seed=0):
+        import numpy as np
+        self._np = np
+        self.dim = int(dim)
+        self._lib = get_lib()
+        self._h = self._lib.pt_ps_table_new(
+            self.dim, self._OPTS[optimizer], float(lr), float(eps),
+            int(seed) & 0xFFFFFFFFFFFFFFFF)
+        if not self._h:
+            raise RuntimeError("pt_ps_table_new failed")
+
+    def __len__(self):
+        return int(self._lib.pt_ps_table_size(self._h))
+
+    def _ptr(self, a, ctype):
+        return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+    def pull(self, ids):
+        np = self._np
+        ids = np.ascontiguousarray(ids, np.int64)
+        out = np.empty((len(ids), self.dim), np.float32)
+        self._lib.pt_ps_table_pull(self._h, self._ptr(ids, ctypes.c_int64),
+                                   len(ids), self._ptr(out, ctypes.c_float))
+        return out
+
+    def push(self, ids, grads, lr=None):
+        np = self._np
+        ids = np.ascontiguousarray(ids, np.int64)
+        grads = np.ascontiguousarray(grads, np.float32)
+        if grads.shape != (len(ids), self.dim):
+            raise ValueError(f"grads shape {grads.shape} != "
+                             f"({len(ids)}, {self.dim})")
+        self._lib.pt_ps_table_push(
+            self._h, self._ptr(ids, ctypes.c_int64),
+            self._ptr(grads, ctypes.c_float), len(ids),
+            -1.0 if lr is None else float(lr))
+
+    def snapshot(self):
+        """(ids [n], rows [n, dim], accum [n, dim]) for checkpoints.
+        Sized-then-filled with a capacity check: a concurrent push that
+        grows the table between the two calls makes the export return a
+        larger count (writing nothing) and we retry with bigger
+        buffers."""
+        np = self._np
+        n = int(self._lib.pt_ps_table_export(self._h, 0, None, None,
+                                             None))
+        while True:
+            cap = n + 64      # slack for concurrent growth
+            ids = np.empty(cap, np.int64)
+            rows = np.empty((cap, self.dim), np.float32)
+            accum = np.empty((cap, self.dim), np.float32)
+            n = int(self._lib.pt_ps_table_export(
+                self._h, cap, self._ptr(ids, ctypes.c_int64),
+                self._ptr(rows, ctypes.c_float),
+                self._ptr(accum, ctypes.c_float)))
+            if n <= cap:
+                return ids[:n].copy(), rows[:n].copy(), accum[:n].copy()
+
+    def restore(self, ids, rows, accum=None):
+        np = self._np
+        ids = np.ascontiguousarray(ids, np.int64)
+        rows = np.ascontiguousarray(rows, np.float32)
+        acc_p = None
+        if accum is not None and len(accum):
+            accum = np.ascontiguousarray(accum, np.float32)
+            acc_p = self._ptr(accum, ctypes.c_float)
+        self._lib.pt_ps_table_import(
+            self._h, self._ptr(ids, ctypes.c_int64),
+            self._ptr(rows, ctypes.c_float), acc_p, len(ids))
+
+    def __del__(self):
+        try:
+            self._lib.pt_ps_table_free(self._h)
+        except Exception:
+            pass

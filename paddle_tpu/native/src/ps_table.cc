@@ -1,0 +1,175 @@
+// Host-side sparse parameter table — the PS sparse host path in C++.
+//
+// Reference capability: the pserver-side sparse tables behind
+// lookup_sparse_table / distributed lookup (ref:
+// paddle/fluid/operators/lookup_sparse_table_op.cc row-materializing
+// SelectedRows store; operators/distributed/parameter_prefetch.cc;
+// framework/fleet/fleet_wrapper.h pull/push sparse). SURVEY §2.6/§7
+// call for the sparse host service to stay hand-written C++ — this is
+// that store: an int64-keyed row map with on-first-touch deterministic
+// initialization and vectorized sgd/adagrad row updates, bound via the
+// C ABI (ctypes) and fronted by paddle_tpu.distributed.ps._SparseTable.
+//
+// Rows initialize N(0, 0.01) deterministically per id (splitmix64 +
+// Box-Muller), so a given (seed, id) always materializes the same row
+// regardless of touch order — unlike a sequential RNG, restarts and
+// multi-client interleavings reproduce.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PsTable {
+  int dim;
+  int opt;  // 0 = sgd, 1 = adagrad
+  float lr;
+  float eps;
+  uint64_t seed;
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;
+  std::unordered_map<int64_t, std::vector<float>> accum;  // adagrad G
+};
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+void init_row(const PsTable* t, int64_t id, float* out) {
+  uint64_t s =
+      splitmix64(t->seed ^ (static_cast<uint64_t>(id) * 0x2545F4914F6CDD1Dull));
+  for (int j = 0; j < t->dim; ++j) {
+    s = splitmix64(s);
+    // (0, 1]: avoid log(0)
+    double u1 = ((s >> 11) + 1.0) * (1.0 / 9007199254740993.0);
+    s = splitmix64(s);
+    double u2 = (s >> 11) * (1.0 / 9007199254740992.0);
+    out[j] = static_cast<float>(0.01 * std::sqrt(-2.0 * std::log(u1)) *
+                                std::cos(2.0 * M_PI * u2));
+  }
+}
+
+std::vector<float>& materialize(PsTable* t, int64_t id) {
+  auto it = t->rows.find(id);
+  if (it != t->rows.end()) return it->second;
+  std::vector<float> row(t->dim);
+  init_row(t, id, row.data());
+  return t->rows.emplace(id, std::move(row)).first->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ps_table_new(int dim, int optimizer, float lr, float eps,
+                      uint64_t seed) {
+  if (dim <= 0 || (optimizer != 0 && optimizer != 1)) return nullptr;
+  auto* t = new PsTable();
+  t->dim = dim;
+  t->opt = optimizer;
+  t->lr = lr;
+  t->eps = eps;
+  t->seed = seed;
+  return t;
+}
+
+void pt_ps_table_free(void* h) { delete static_cast<PsTable*>(h); }
+
+long pt_ps_table_size(void* h) {
+  auto* t = static_cast<PsTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  return static_cast<long>(t->rows.size());
+}
+
+// out: [n, dim] float32, caller-allocated
+void pt_ps_table_pull(void* h, const int64_t* ids, long n, float* out) {
+  auto* t = static_cast<PsTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  for (long i = 0; i < n; ++i) {
+    const auto& row = materialize(t, ids[i]);
+    std::memcpy(out + i * t->dim, row.data(), t->dim * sizeof(float));
+  }
+}
+
+// grads: [n, dim]; lr < 0 means "use the table's lr". Duplicate ids in
+// one batch apply sequentially, matching the per-row update loop the
+// pserver optimize block runs.
+void pt_ps_table_push(void* h, const int64_t* ids, const float* grads,
+                      long n, float lr) {
+  auto* t = static_cast<PsTable*>(h);
+  float rate = lr < 0 ? t->lr : lr;
+  std::lock_guard<std::mutex> g(t->mu);
+  for (long i = 0; i < n; ++i) {
+    auto& row = materialize(t, ids[i]);
+    const float* gi = grads + i * t->dim;
+    if (t->opt == 1) {
+      auto& acc = t->accum[ids[i]];
+      if (acc.empty()) acc.assign(t->dim, 0.f);
+      for (int j = 0; j < t->dim; ++j) {
+        acc[j] += gi[j] * gi[j];
+        row[j] -= rate * gi[j] / (std::sqrt(acc[j]) + t->eps);
+      }
+    } else {
+      for (int j = 0; j < t->dim; ++j) row[j] -= rate * gi[j];
+    }
+  }
+}
+
+// Snapshot for checkpoints: pass cap=0/nullptrs to size the buffers,
+// then call again with [cap] ids / [cap, dim] rows / [cap, dim] accum.
+// Returns the CURRENT row count; writes nothing when it exceeds cap —
+// a concurrent push between the sizing and filling calls must make the
+// caller retry with bigger buffers, never overflow them.
+long pt_ps_table_export(void* h, long cap, int64_t* ids_out,
+                        float* rows_out, float* accum_out) {
+  auto* t = static_cast<PsTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  long n = static_cast<long>(t->rows.size());
+  if (ids_out == nullptr || n > cap) return n;
+  long i = 0;
+  for (const auto& kv : t->rows) {
+    ids_out[i] = kv.first;
+    std::memcpy(rows_out + i * t->dim, kv.second.data(),
+                t->dim * sizeof(float));
+    if (accum_out != nullptr) {
+      auto it = t->accum.find(kv.first);
+      if (it != t->accum.end()) {
+        std::memcpy(accum_out + i * t->dim, it->second.data(),
+                    t->dim * sizeof(float));
+      } else {
+        std::memset(accum_out + i * t->dim, 0, t->dim * sizeof(float));
+      }
+    }
+    ++i;
+  }
+  return n;
+}
+
+void pt_ps_table_import(void* h, const int64_t* ids, const float* rows,
+                        const float* accum, long n) {
+  auto* t = static_cast<PsTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  t->rows.clear();
+  t->accum.clear();
+  for (long i = 0; i < n; ++i) {
+    t->rows[ids[i]] =
+        std::vector<float>(rows + i * t->dim, rows + (i + 1) * t->dim);
+    if (accum != nullptr) {
+      const float* a = accum + i * t->dim;
+      bool nonzero = false;
+      for (int j = 0; j < t->dim; ++j) {
+        if (a[j] != 0.f) { nonzero = true; break; }
+      }
+      if (nonzero) t->accum[ids[i]] = std::vector<float>(a, a + t->dim);
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,213 @@
+"""PS wire-protocol tests: fixed-schema codec, malformed-frame safety
+(no byte from the socket is ever evaluated — the pickle-RCE class of
+bug is structurally impossible), max-message validation, client
+retry/backoff, and retry dedup of mutating requests.
+
+Reference contract: operators/distributed/rpc_client.h:33 (+ retry in
+grpc_client.cc); wire schema role: send_recv.proto.in +
+sendrecvop_utils.cc.
+"""
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import ParameterServer, PSClient, wire
+
+
+def _server(n_trainers=1, sync=True):
+    s = ParameterServer("127.0.0.1:0", n_trainers, sync)
+    s.host_dense("w", np.ones(4, np.float32),
+                 pt.optimizer.SGDOptimizer(0.5))
+    s.host_sparse("emb", dim=3, seed=0, lr=1.0)
+    s.start()
+    return s
+
+
+class TestCodec:
+    def test_roundtrip_all_kinds(self):
+        cases = [
+            (wire.PUSH_GRAD, ("w", 3, np.arange(6, dtype=np.float32)
+                              .reshape(2, 3))),
+            (wire.PULL_PARAM, ("w", 7)),
+            (wire.PULL_SPARSE, ("emb", np.asarray([1, 5], np.int64))),
+            (wire.PUSH_SPARSE, ("emb", np.asarray([2], np.int64),
+                                np.ones((1, 3), np.float32), 0.5)),
+            (wire.PUSH_SPARSE, ("emb", np.asarray([2], np.int64),
+                                np.ones((1, 3), np.float32), None)),
+            (wire.BARRIER, ("init", 0)),
+            (wire.CHECKPOINT_NOTIFY, ("/tmp/x",)),
+            (wire.LIST_VARS, ()),
+            (wire.STOP, ()),
+            (wire.OK, ()),
+            (wire.OK_ARR, (np.zeros((0, 2), np.float64),)),
+            (wire.OK_NAMES, ("a\nb", "")),
+            (wire.ERR, ("boom",)),
+        ]
+        for kind, fields in cases:
+            blob = wire.encode(kind, fields, client_id=9, seq=42)
+            k2, cid, seq, n = wire.decode_header(blob[:wire.HEADER_SIZE])
+            assert (k2, cid, seq) == (kind, 9, 42)
+            out = wire.decode_payload(k2, blob[wire.HEADER_SIZE:])
+            assert len(out) == len(fields)
+            for a, b in zip(out, fields):
+                if isinstance(b, np.ndarray):
+                    assert a.dtype == b.dtype and a.shape == b.shape
+                    np.testing.assert_array_equal(a, b)
+                elif b is None:
+                    assert a is None
+                elif isinstance(b, int):
+                    assert a == b
+                else:
+                    assert a == b
+
+    def test_header_validation(self):
+        with pytest.raises(wire.WireError, match="magic"):
+            wire.decode_header(b"XX" + bytes(wire.HEADER_SIZE - 2))
+        bad_ver = wire.encode(wire.OK, ())
+        bad_ver = bad_ver[:2] + bytes([99]) + bad_ver[3:]
+        with pytest.raises(wire.WireError, match="version"):
+            wire.decode_header(bad_ver[:wire.HEADER_SIZE])
+        bad_kind = bytearray(wire.encode(wire.OK, ()))
+        bad_kind[3] = 250
+        with pytest.raises(wire.WireError, match="kind"):
+            wire.decode_header(bytes(bad_kind[:wire.HEADER_SIZE]))
+
+    def test_payload_validation(self):
+        blob = wire.encode(wire.PUSH_GRAD,
+                           ("w", 1, np.ones(3, np.float32)))
+        # truncated payload
+        with pytest.raises(wire.WireError, match="truncated"):
+            wire.decode_payload(wire.PUSH_GRAD,
+                                blob[wire.HEADER_SIZE:-2])
+        # trailing bytes
+        with pytest.raises(wire.WireError, match="trailing"):
+            wire.decode_payload(wire.PUSH_GRAD,
+                                blob[wire.HEADER_SIZE:] + b"x")
+        # oversized declared array
+        huge = struct.pack("<H", 1) + b"w" + struct.pack("<Q", 1) \
+            + struct.pack("<BB", 1, 1) + struct.pack("<I", 1 << 30)
+        with pytest.raises(wire.WireError, match="too large|truncated"):
+            wire.decode_payload(wire.PUSH_GRAD, huge)
+
+    def test_dim_overflow_cannot_bypass_size_guard(self):
+        """Attacker-chosen u32 dims whose product wraps a fixed-width
+        accumulator must still be rejected as WireError (not escape as
+        a numpy ValueError past the size guard)."""
+        payload = (struct.pack("<H", 1) + b"w" + struct.pack("<Q", 1)
+                   + struct.pack("<BB", 1, 4)
+                   + struct.pack("<IIII", 1 << 31, 1 << 31, 1 << 31,
+                                 1 << 31))
+        with pytest.raises(wire.WireError):
+            wire.decode_payload(wire.PUSH_GRAD, payload)
+
+    def test_max_message_flag(self):
+        pt.set_flags({"FLAGS_ps_max_message_bytes": 64})
+        try:
+            with pytest.raises(wire.WireError, match="too large"):
+                wire.encode(wire.OK_ARR, (np.zeros(1024, np.float32),))
+        finally:
+            pt.set_flags({"FLAGS_ps_max_message_bytes": 1 << 31})
+
+
+class TestServerSafety:
+    def test_malformed_frame_gets_typed_error_and_close(self):
+        """Attacker bytes (a pickle, garbage, wrong magic) are answered
+        with a typed ERR frame and a closed connection — never
+        evaluated. With the old pickle transport this payload would
+        have executed on the server."""
+        import pickle
+
+        s = _server()
+        try:
+            # a pickle that would run `raise SystemExit` if unpickled
+            evil = pickle.dumps(SystemExit("pwned"))
+            for payload in (b"garbage!", evil,
+                            b"PT" + bytes([9]) + evil):
+                c = socket.create_connection((s.host, s.port),
+                                             timeout=10)
+                c.sendall(struct.pack("<Q", len(payload)) + payload)
+                try:
+                    c.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass        # server already dropped us — also fine
+                resp = b""
+                try:
+                    while True:
+                        chunk = c.recv(4096)
+                        if not chunk:
+                            break
+                        resp += chunk
+                except OSError:
+                    pass
+                c.close()
+                # either an ERR frame or an immediate close; the server
+                # must still be alive and serving afterwards
+                if resp:
+                    kind, _, _, n = wire.decode_header(
+                        resp[:wire.HEADER_SIZE])
+                    assert kind == wire.ERR
+            cl = PSClient([s.endpoint], {"w": s.endpoint})
+            np.testing.assert_array_equal(cl.pull_param("w"),
+                                          np.ones(4, np.float32))
+            cl.close()
+        finally:
+            s.stop()
+
+    def test_oversized_frame_rejected_before_allocation(self):
+        s = _server()
+        try:
+            c = socket.create_connection((s.host, s.port), timeout=10)
+            hdr = struct.Struct("<2sBBQQQ").pack(
+                b"PT", wire.VERSION, wire.PUSH_GRAD, 1, 1, 1 << 62)
+            c.sendall(hdr)
+            resp = c.recv(4096)
+            kind, _, _, _ = wire.decode_header(resp[:wire.HEADER_SIZE])
+            assert kind == wire.ERR
+            c.close()
+        finally:
+            s.stop()
+
+
+class TestRetry:
+    def test_client_retries_after_connection_loss(self):
+        """Kill the client's socket between requests: the next call
+        reconnects with backoff and succeeds."""
+        s = _server()
+        try:
+            cl = PSClient([s.endpoint], {"w": s.endpoint})
+            np.testing.assert_array_equal(cl.pull_param("w"),
+                                          np.ones(4, np.float32))
+            # sever the cached connection under the client
+            for sock in cl._all_socks:
+                sock.close()
+            out = cl.pull_param("w")
+            np.testing.assert_array_equal(out, np.ones(4, np.float32))
+            cl.close()
+        finally:
+            s.stop()
+
+    def test_mutating_retry_dedups(self):
+        """A re-sent PUSH_GRAD frame with the same (client_id, seq) must
+        not re-apply: the server answers from its dedup cache."""
+        s = _server()
+        try:
+            grad = np.full(4, 2.0, np.float32)
+            blob = wire.encode(wire.PUSH_GRAD, ("w", 0, grad),
+                               client_id=77, seq=5)
+            c = socket.create_connection((s.host, s.port), timeout=10)
+            for _ in range(3):          # original + 2 retries
+                c.sendall(blob)
+                kind, _, _, n = wire.decode_header(
+                    c.recv(wire.HEADER_SIZE))
+                assert kind == wire.OK
+            c.close()
+            # exactly ONE sgd step applied: 1 - 0.5*2 = 0
+            np.testing.assert_allclose(s.dense["w"].value,
+                                       np.zeros(4, np.float32))
+            assert s.dense["w"].round == 1
+        finally:
+            s.stop()
