@@ -14,13 +14,163 @@ sizes pays compilation once each. "Zero copy" here is jax.device_put
 into the executable's donated input layout.
 """
 
+import hashlib
+import json
+import os
+
 import numpy as np
 
+from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.place import CPUPlace
-from paddle_tpu.static.executor import Executor, Scope
+from paddle_tpu.static.executor import Executor, Scope, exec_op
 from paddle_tpu.static import io as static_io
 
-__all__ = ["Config", "Predictor", "create_predictor", "ZeroCopyTensor"]
+__all__ = ["Config", "Predictor", "create_predictor", "ZeroCopyTensor",
+           "export_aot"]
+
+AOT_DIR = "__aot__"
+AOT_INDEX = "index.json"
+
+
+def _build_pure_fn(program, feed_names, fetch_names):
+    """A jittable fn(params_tuple, feeds_tuple) -> fetches_tuple over a
+    frozen (host-op-free) inference program. Param/feed orders are the
+    sorted state names / the given feed order — recorded in the AOT
+    index so a loader binds buffers without re-reading the program."""
+    import jax
+
+    blk = program.global_block()
+    ops = list(blk.ops)
+    enforce(not any(op.attrs.get("_host") for op in ops),
+            "AOT export requires a host-op-free inference program")
+    constants = dict(getattr(program, "_constants", {}))
+    state_names = sorted(n for n, v in blk.vars.items()
+                         if v.persistable and n not in constants)
+    seed = program.random_seed
+
+    def fn(params, feeds):
+        env = dict(constants)
+        env.update(zip(state_names, params))
+        env.update(zip(feed_names, feeds))
+        key = None
+        for i, op in enumerate(ops):
+            if op.attrs.get("_needs_rng"):
+                if key is None:
+                    # match the Executor's derivation at its first run
+                    # (fold_in(base, step_idx=0) then per-op index; no
+                    # host ops here, so no index adjustment). Inference
+                    # is stateless: every AOT call draws step-0 keys.
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(seed), 0)
+                k = jax.random.fold_in(key, i)
+            else:
+                k = None
+            env.update(exec_op(op, env, k))
+        return tuple(env[n] for n in fetch_names)
+
+    return fn, state_names
+
+
+def _program_hash(program):
+    """Fingerprint of the frozen program: AOT index entries are valid
+    only for the exact graph they were compiled from."""
+    import pickle
+
+    return hashlib.sha256(pickle.dumps(program)).hexdigest()[:16]
+
+
+def _sig_of(feed_names, shaped):
+    """Signature entry for one shape bucket: [[name, shape, dtype]...]
+    in feed order. ``shaped``: {name: array-or-(shape, dtype)}."""
+    sig = []
+    for n in feed_names:
+        v = shaped[n]
+        if isinstance(v, tuple):
+            shape, dtype = v
+        else:
+            shape, dtype = np.shape(v), np.asarray(v).dtype
+        sig.append([n, [int(d) for d in shape], np.dtype(dtype).name])
+    return sig
+
+
+def _sig_key(sig):
+    return hashlib.sha256(json.dumps(sig).encode()).hexdigest()[:16]
+
+
+def export_aot(dirname, program, feed_names, fetch_names, scope,
+               shape_buckets, platforms=("cpu", "tpu")):
+    """Compile the frozen program per shape bucket and serialize BOTH
+    artifacts (the VERDICT-r1 'inference artifact export' gap; ref
+    capability: inference/io.cc + analysis_predictor.h:46 serialize an
+    optimized deployable model):
+
+    - <h>.xla — the platform-native compiled executable
+      (jax.experimental.serialize_executable): loading skips tracing
+      AND XLA compilation, but pins platform + jax version;
+    - <h>.shlo — portable StableHLO (jax.export): loading skips Python
+      retracing/program analysis; XLA compiles once at load.
+
+    ``shape_buckets``: list of {feed name: (shape, dtype)} (or example
+    arrays). ``platforms`` lowers the portable export for each named
+    platform (default cpu+tpu) so the .shlo artifact really is
+    cross-platform. Returns the index entries."""
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    fn, state_names = _build_pure_fn(program, feed_names, fetch_names)
+    raw = [scope.find_var(n) for n in state_names]
+    missing = [n for n, v in zip(state_names, raw) if v is None]
+    enforce(not missing,
+            f"scope missing persistables for AOT export: {missing[:5]}")
+    params = tuple(np.asarray(v) for v in raw)
+    param_sds = tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                      for p in params)
+    out_dir = os.path.join(dirname, AOT_DIR)
+    os.makedirs(out_dir, exist_ok=True)
+    jitted = jax.jit(fn)
+    entries = []
+    platform = jax.devices()[0].platform
+    prog_hash = _program_hash(program)
+    for bucket in shape_buckets:
+        sig = _sig_of(feed_names, bucket)
+        feed_sds = tuple(
+            jax.ShapeDtypeStruct(tuple(s), np.dtype(dt))
+            for _, s, dt in sig)
+        # the key covers the PROGRAM too: a re-saved model must never
+        # serve a stale graph from a surviving shape bucket
+        h = _sig_key(sig + [["__program__", [], prog_hash]])
+        compiled = jitted.lower(param_sds, feed_sds).compile()
+        # the unsharded jit above compiles single-device; recorded so
+        # the loader binds the executable to exactly that many devices
+        entry = {"sig": sig, "key": h, "platform": platform,
+                 "jax_version": jax.__version__,
+                 "program_hash": prog_hash,
+                 "state_names": state_names, "num_devices": 1}
+        payload, in_tree, out_tree = se.serialize(compiled)
+        import pickle
+        with open(os.path.join(out_dir, f"{h}.xla"), "wb") as f:
+            pickle.dump({"payload": payload, "in_tree": in_tree,
+                         "out_tree": out_tree}, f)
+        entry["xla"] = f"{h}.xla"
+        exported = jax.export.export(jitted,
+                                     platforms=list(platforms))(
+            param_sds, feed_sds)
+        with open(os.path.join(out_dir, f"{h}.shlo"), "wb") as f:
+            f.write(exported.serialize())
+        entry["shlo"] = f"{h}.shlo"
+        entries.append(entry)
+    index_path = os.path.join(out_dir, AOT_INDEX)
+    existing = []
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            # drop superseded buckets AND any entry for a different
+            # (stale) program
+            existing = [e for e in json.load(f)
+                        if e["key"] not in {x["key"] for x in entries}
+                        and e.get("program_hash") == prog_hash]
+    with open(index_path, "w") as f:
+        json.dump(existing + entries, f, indent=1)
+    return entries
 
 
 class Config:
@@ -92,6 +242,9 @@ class Predictor:
             config.model_dir, self._exe,
             model_filename=config.prog_file,
             params_filename=config.params_file, scope=self._scope)
+        # hash the program AS SAVED (before any local re-prune): the
+        # AOT index was written against exactly this graph
+        loaded_hash = _program_hash(prog)
         if config.ir_optim():
             # re-prune to the fetch-reachable subgraph (idempotent on
             # save_inference_model artifacts, which prune at save; covers
@@ -102,6 +255,83 @@ class Predictor:
         self._fetch_names = fetches
         self._feeds = {}
         self._outputs = {}
+        # AOT artifacts (export_aot): signature key -> index entry;
+        # loaded (callable, params) cache per key. Entries for a
+        # different program hash are ignored — stale artifacts must
+        # never serve an old graph.
+        self._aot_index = {}
+        self._aot_loaded = {}
+        self._prog_hash = None
+        idx = os.path.join(config.model_dir or "", AOT_DIR, AOT_INDEX)
+        if config.model_dir and os.path.exists(idx):
+            self._prog_hash = loaded_hash
+            with open(idx) as f:
+                for e in json.load(f):
+                    if e.get("program_hash") == self._prog_hash:
+                        self._aot_index[e["key"]] = e
+
+    # -- AOT path ----------------------------------------------------------
+    def _aot_fn(self, feeds):
+        """Return a loaded AOT callable for this feed signature, or
+        None. Prefers the platform-native executable (no retrace, no
+        compile); falls back to the portable StableHLO export (no
+        retrace; XLA compiles once); returns None when neither loads
+        (wrong platform/version) so the caller re-traces."""
+        if not self._aot_index:
+            return None
+        sig = _sig_of(self._feed_names,
+                      {n: feeds[n] for n in self._feed_names})
+        h = _sig_key(sig + [["__program__", [], self._prog_hash]])
+        if h in self._aot_loaded:
+            return self._aot_loaded[h]
+        entry = self._aot_index.get(h)
+        if entry is None:
+            self._aot_loaded[h] = None
+            return None
+        import jax
+
+        aot_dir = os.path.join(self.config.model_dir, AOT_DIR)
+        fn = None
+        params = None
+        try:
+            # per-entry params (state_names may differ across entries);
+            # any failure — e.g. a stale entry naming a var the scope
+            # no longer holds — degrades to the retrace path
+            raw = [self._scope.find_var(n) for n in entry["state_names"]]
+            if not any(v is None for v in raw):
+                params = tuple(jax.device_put(np.asarray(v))
+                               for v in raw)
+        except Exception:
+            params = None
+        if (params is not None and entry.get("xla")
+                and entry["platform"] == jax.devices()[0].platform
+                and entry["jax_version"] == jax.__version__):
+            try:
+                import pickle
+
+                from jax.experimental import serialize_executable as se
+                with open(os.path.join(aot_dir, entry["xla"]),
+                          "rb") as f:
+                    blob = pickle.load(f)
+                fn = se.deserialize_and_load(
+                    blob["payload"], blob["in_tree"], blob["out_tree"],
+                    execution_devices=jax.devices()[
+                        :entry.get("num_devices", 1)])
+            except Exception:
+                fn = None
+        if params is not None and fn is None and entry.get("shlo"):
+            try:
+                with open(os.path.join(aot_dir, entry["shlo"]),
+                          "rb") as f:
+                    exported = jax.export.deserialize(f.read())
+                # jit the exported call: compile once, then cached —
+                # eager exported.call re-traces per request
+                fn = jax.jit(exported.call)
+            except Exception:
+                fn = None
+        loaded = None if fn is None else (fn, params)
+        self._aot_loaded[h] = loaded
+        return loaded
 
     # -- introspection (AnalysisPredictor::GetInputNames parity) -----------
     def get_input_names(self):
@@ -126,9 +356,16 @@ class Predictor:
         missing = [n for n in self._feed_names if n not in self._feeds]
         if missing:
             raise KeyError(f"missing inputs: {missing}")
-        outs = self._exe.run(self._program, feed=dict(self._feeds),
-                             fetch_list=list(self._fetch_names),
-                             scope=self._scope)
+        aot = self._aot_fn(self._feeds)
+        if aot is not None:
+            fn, params = aot
+            outs = fn(params,
+                      tuple(self._feeds[n] for n in self._feed_names))
+            outs = [np.asarray(o) for o in outs]
+        else:
+            outs = self._exe.run(self._program, feed=dict(self._feeds),
+                                 fetch_list=list(self._fetch_names),
+                                 scope=self._scope)
         self._outputs = dict(zip(self._fetch_names, outs))
         return outs
 

@@ -159,6 +159,25 @@ def device_prefetch(batches, depth=2):
     return background_prefetch(batches, stage, depth)
 
 
+def exec_op(op, env, key):
+    """Run one program op through the functional registry: bind inputs
+    from env, return {output name: value}. ``key`` is the op's rng key
+    (None for ops without `_needs_rng`)."""
+    fn = OP_REGISTRY[op.type]
+    ins = {slot: [env[n] for n in names]
+           for slot, names in op.inputs.items()}
+    attrs = dict(op.attrs)
+    if attrs.pop("_needs_rng", False):
+        attrs["rng"] = key
+    outs = fn(ins, attrs)
+    bound = {}
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for n, v in zip(names, vals):
+            bound[n] = v
+    return bound
+
+
 class Executor:
     """One compiled XLA computation per (program, feed-signature)."""
 
@@ -299,19 +318,7 @@ class Executor:
                 scope.set_var(n, v)
 
     def _exec_op(self, op, env, key):
-        fn = OP_REGISTRY[op.type]
-        ins = {slot: [env[n] for n in names]
-               for slot, names in op.inputs.items()}
-        attrs = dict(op.attrs)
-        if attrs.pop("_needs_rng", False):
-            attrs["rng"] = key
-        outs = fn(ins, attrs)
-        bound = {}
-        for slot, names in op.outputs.items():
-            vals = outs.get(slot, [])
-            for n, v in zip(names, vals):
-                bound[n] = v
-        return bound
+        return exec_op(op, env, key)
 
     def _compile(self, program, state_names, feed_names, fetch_names):
         """Partition the block into maximal device runs, each jitted as

@@ -106,7 +106,12 @@ def _prune(program, feed_names, fetch_names):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, aot_shapes=None):
+    """Freeze + prune + save. With ``aot_shapes`` (a list of
+    {feed name: (shape, dtype)} buckets) the compiled executables are
+    also serialized next to the model (paddle_tpu.inference.export_aot;
+    ref capability: inference/io.cc serializes the optimized deployable
+    model) so a Predictor loads without retracing or recompiling."""
     main_program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
     fetch_names = [t if isinstance(t, str) else t.name for t in target_vars]
@@ -123,6 +128,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     vals = _collect(inference_program, global_scope(),
                     lambda v: v.persistable)
     np.savez(os.path.join(dirname, params_filename or PARAMS_FILE), **vals)
+    if aot_shapes:
+        from paddle_tpu import inference as _inf
+        _inf.export_aot(dirname, inference_program,
+                        list(feeded_var_names), fetch_names,
+                        global_scope(), aot_shapes)
     return fetch_names
 
 

@@ -129,3 +129,111 @@ class TestProfilerTimeline:
         report = pt.profiler.stop_profiler()
         assert "op" in report
         pt.profiler.reset_profiler()
+
+
+class TestAOTExport:
+    """AOT artifact round-trip (export_aot / Predictor): serialized
+    executables load WITHOUT retracing the program (ref capability:
+    inference/io.cc serialized deployable model)."""
+
+    @pytest.fixture
+    def aot_model(self, tmp_path):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4], dtype="float32")
+                h = pt.layers.fc(x, size=8, act="relu")
+                pred = pt.layers.fc(h, size=1)
+                exe = pt.static.Executor(pt.CPUPlace())
+                exe.run(startup)
+                xv = np.random.RandomState(0).rand(16, 4) \
+                    .astype(np.float32)
+                expected = exe.run(main, feed={"x": xv},
+                                   fetch_list=[pred])[0]
+                pt.static.io.save_inference_model(
+                    str(tmp_path), ["x"], [pred], exe,
+                    main_program=main,
+                    aot_shapes=[{"x": ((16, 4), "float32")},
+                                {"x": ((2, 4), "float32")}])
+            return str(tmp_path), xv, expected
+        finally:
+            pt.disable_static()
+
+    def test_artifacts_written(self, aot_model):
+        d, _, _ = aot_model
+        aot = os.path.join(d, "__aot__")
+        idx = json.load(open(os.path.join(aot, "index.json")))
+        assert len(idx) == 2
+        for e in idx:
+            assert os.path.exists(os.path.join(aot, e["xla"]))
+            assert os.path.exists(os.path.join(aot, e["shlo"]))
+            assert e["state_names"]
+
+    def test_aot_path_matches_retrace_path(self, aot_model):
+        d, xv, expected = aot_model
+        p = create_predictor(Config(d))
+        out = p.run({"x": xv})[0]
+        # the matching bucket loads an AOT artifact — no retrace
+        assert any(v is not None for v in p._aot_loaded.values())
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+        # second bucket shape also served AOT
+        out2 = p.run({"x": xv[:2]})[0]
+        np.testing.assert_allclose(out2, expected[:2], rtol=1e-5,
+                                   atol=1e-6)
+        assert sum(v is not None
+                   for v in p._aot_loaded.values()) == 2
+
+    def test_unmatched_shape_falls_back_to_retrace(self, aot_model):
+        d, xv, expected = aot_model
+        p = create_predictor(Config(d))
+        out = p.run({"x": xv[:7]})[0]      # no 7-row bucket exported
+        np.testing.assert_allclose(out, expected[:7], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_stablehlo_fallback_when_executable_unusable(self, aot_model):
+        d, xv, expected = aot_model
+        # corrupt the native executable: loader must fall back to the
+        # portable StableHLO artifact, same results
+        aot = os.path.join(d, "__aot__")
+        idx = json.load(open(os.path.join(aot, "index.json")))
+        for e in idx:
+            with open(os.path.join(aot, e["xla"]), "wb") as f:
+                f.write(b"corrupt")
+        p = create_predictor(Config(d))
+        out = p.run({"x": xv})[0]
+        assert any(v is not None for v in p._aot_loaded.values())
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+    def test_resave_never_serves_stale_program(self, tmp_path):
+        """Re-saving a CHANGED model into the same dirname must not
+        serve the old graph from a surviving AOT shape bucket (keys and
+        index entries are program-hash scoped)."""
+        pt.enable_static()
+        try:
+            def build_and_save(act):
+                main, startup = pt.Program(), pt.Program()
+                with pt.static.program_guard(main, startup):
+                    x = pt.static.data("x", shape=[4], dtype="float32")
+                    h = pt.layers.fc(x, size=8, act=act)
+                    pred = pt.layers.fc(h, size=1)
+                    exe = pt.static.Executor(pt.CPUPlace())
+                    exe.run(startup)
+                    xv = np.random.RandomState(0).rand(16, 4) \
+                        .astype(np.float32)
+                    expected = exe.run(main, feed={"x": xv},
+                                       fetch_list=[pred])[0]
+                    pt.static.io.save_inference_model(
+                        str(tmp_path), ["x"], [pred], exe,
+                        main_program=main,
+                        aot_shapes=[{"x": ((16, 4), "float32")}])
+                return xv, expected
+
+            build_and_save("relu")
+            xv, expected2 = build_and_save("tanh")   # changed arch
+            p = create_predictor(Config(str(tmp_path)))
+            out = p.run({"x": xv})[0]
+            np.testing.assert_allclose(out, expected2, rtol=1e-4,
+                                       atol=1e-5)
+        finally:
+            pt.disable_static()
