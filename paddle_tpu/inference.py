@@ -163,11 +163,28 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
     existing = []
     if os.path.exists(index_path):
         with open(index_path) as f:
-            # drop superseded buckets AND any entry for a different
-            # (stale) program
-            existing = [e for e in json.load(f)
-                        if e["key"] not in {x["key"] for x in entries}
-                        and e.get("program_hash") == prog_hash]
+            old = json.load(f)
+        # drop superseded buckets AND any entry for a different
+        # (stale) program — and unlink their artifact files, or a
+        # periodically re-exported serving dir grows without bound
+        keep, dropped = [], []
+        new_keys = {x["key"] for x in entries}
+        for e in old:
+            if (e["key"] not in new_keys
+                    and e.get("program_hash") == prog_hash):
+                keep.append(e)
+            else:
+                dropped.append(e)
+        existing = keep
+        for e in dropped:
+            if e["key"] in new_keys:
+                continue   # same key: this export just rewrote the files
+            for name in (e.get("xla"), e.get("shlo")):
+                if name:
+                    try:
+                        os.unlink(os.path.join(out_dir, name))
+                    except OSError:
+                        pass
     with open(index_path, "w") as f:
         json.dump(existing + entries, f, indent=1)
     return entries
@@ -242,9 +259,15 @@ class Predictor:
             config.model_dir, self._exe,
             model_filename=config.prog_file,
             params_filename=config.params_file, scope=self._scope)
-        # hash the program AS SAVED (before any local re-prune): the
-        # AOT index was written against exactly this graph
-        loaded_hash = _program_hash(prog)
+        # AOT index present? Only then hash the program AS SAVED
+        # (before any local re-prune — the index was written against
+        # exactly that graph); hashing pickles the whole program, so
+        # skip it for the common artifact without AOT exports
+        self._aot_idx_path = os.path.join(
+            config.model_dir or "", AOT_DIR, AOT_INDEX)
+        loaded_hash = (_program_hash(prog)
+                       if config.model_dir
+                       and os.path.exists(self._aot_idx_path) else None)
         if config.ir_optim():
             # re-prune to the fetch-reachable subgraph (idempotent on
             # save_inference_model artifacts, which prune at save; covers
@@ -261,11 +284,9 @@ class Predictor:
         # never serve an old graph.
         self._aot_index = {}
         self._aot_loaded = {}
-        self._prog_hash = None
-        idx = os.path.join(config.model_dir or "", AOT_DIR, AOT_INDEX)
-        if config.model_dir and os.path.exists(idx):
-            self._prog_hash = loaded_hash
-            with open(idx) as f:
+        self._prog_hash = loaded_hash
+        if loaded_hash is not None:
+            with open(self._aot_idx_path) as f:
                 for e in json.load(f):
                     if e.get("program_hash") == self._prog_hash:
                         self._aot_index[e["key"]] = e
@@ -286,7 +307,9 @@ class Predictor:
             return self._aot_loaded[h]
         entry = self._aot_index.get(h)
         if entry is None:
-            self._aot_loaded[h] = None
+            # no negative caching: the probe is one sha256 over the
+            # signature, and dynamic shapes would grow the cache
+            # unboundedly in a long-lived server
             return None
         import jax
 

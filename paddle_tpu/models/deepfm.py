@@ -75,11 +75,18 @@ def loss_fn(params, cfg, emb, first, dense, labels):
     return jnp.mean(loss), logits
 
 
-@functools.partial(jax.jit, static_argnums=(0,))
-def _train_step(cfg, params, emb, first, dense, labels, lr):
+@functools.partial(jax.jit, static_argnums=(0, 7))
+def _train_step(cfg, params, emb, first, dense, labels, lr,
+                wire_dtype="float32"):
     """One jitted step: loss + grads for dense params AND the pulled
     embedding slices (the slice grads leave the device for the async
-    sparse push)."""
+    sparse push). ``wire_dtype`` != float32 quantizes the OUTGOING
+    embedding grads on device (and accepts reduced-precision incoming
+    embeddings) — host tables still accumulate fp32; on a slow
+    host<->device link this halves the sparse path's wire bytes."""
+    emb = emb.astype(jnp.float32)
+    first = first.astype(jnp.float32)
+
     def wrapped(params, emb, first):
         l, logits = loss_fn(params, cfg, emb, first, dense, labels)
         return l, logits
@@ -88,6 +95,9 @@ def _train_step(cfg, params, emb, first, dense, labels, lr):
         wrapped, argnums=(0, 1, 2), has_aux=True)(params, emb, first)
     gp, gemb, gfirst = grads
     params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, gp)
+    if wire_dtype != "float32":
+        gemb = gemb.astype(wire_dtype)
+        gfirst = gfirst.astype(wire_dtype)
     return loss, logits, params, gemb, gfirst
 
 
@@ -102,9 +112,11 @@ class CTRTrainer:
     top by pulling the next batch before finalizing the current one.
     """
 
-    def __init__(self, cfg, seed=0, sync_push=False):
+    def __init__(self, cfg, seed=0, sync_push=False,
+                 wire_dtype="float32"):
         self.cfg = cfg
         self.sync_push = sync_push
+        self.wire_dtype = wire_dtype
         self.table = SparseEmbeddingTable(
             cfg.embed_dim, num_shards=cfg.num_shards, seed=seed,
             optimizer=cfg.sparse_optimizer, learning_rate=cfg.sparse_lr)
@@ -122,7 +134,7 @@ class CTRTrainer:
         loss, logits, self.params, gemb, gfirst = _train_step(
             self.cfg, self.params, jnp.asarray(emb), jnp.asarray(first),
             jnp.asarray(dense, jnp.float32),
-            jnp.asarray(labels), jnp.float32(lr))
+            jnp.asarray(labels), jnp.float32(lr), self.wire_dtype)
         gemb = np.asarray(gemb)
         gfirst = np.asarray(gfirst)[..., None]
         if self.sync_push:
@@ -133,46 +145,80 @@ class CTRTrainer:
             self.table_w1.push_async(ids, gfirst)
         return float(loss), np.asarray(logits)
 
-    def train_stream(self, batches, lr=0.01):
-        """Pipelined dataset loop — the DownpourWorker prefetch pattern
-        (ref: framework/downpour_worker.cc pull → compute → async push):
-        batch i+1's host-side embedding pull and batch i's gradient
-        fetch both overlap the device's compute, so the sparse path
-        never stalls the chip (SURVEY §7's design constraint). Grad
-        pushes are steps-behind (async Communicator semantics).
-        Yields float loss per batch."""
-        pending = None          # (ids, gemb_dev, gfirst_dev)
+    def _stage(self, batch):
+        """Host pull + H2D of one batch (runs on the staging thread).
+        With a reduced wire_dtype the embeddings cross the link at half
+        width and widen back to fp32 on device."""
+        ids, dense, labels = batch
+        ids = np.asarray(ids)
+        wd = np.dtype(self.wire_dtype)
+        emb = self.table.pull(ids).astype(wd, copy=False)
+        first = self.table_w1.pull(ids)[..., 0].astype(wd, copy=False)
+        return (ids, jnp.asarray(emb), jnp.asarray(first),
+                jnp.asarray(np.asarray(dense), jnp.float32),
+                jnp.asarray(np.asarray(labels)))
 
-        def _push_pending():
-            nonlocal pending
-            p_ids, p_gemb, p_gfirst, p_loss = pending
-            pending = None
-            self.table.push_async(p_ids, np.asarray(p_gemb))
-            self.table_w1.push_async(
-                p_ids, np.asarray(p_gfirst)[..., None])
-            return float(p_loss)
+    def _drain(self, ids, gemb, gfirst, loss):
+        """D2H of one step's grads + table push (drain thread)."""
+        self.table.push_async(ids, np.asarray(gemb))
+        self.table_w1.push_async(ids, np.asarray(gfirst)[..., None])
+        return float(loss)
+
+    def train_stream(self, batches, lr=0.01, prefetch=2):
+        """Three-stage pipelined dataset loop — the DownpourWorker
+        pattern (ref: framework/downpour_worker.cc pull → compute →
+        async push), stretched for a high-latency host<->device link:
+        a staging thread runs batch i+k's host pull + H2D while the
+        device computes step i and a drain thread fetches step i-1's
+        grads and pushes them. Embeddings are therefore up to
+        ``prefetch`` steps stale relative to pushes — the reference's
+        async Communicator / steps-behind semantics (communicator.h:160)
+        with a deeper window. Yields float loss per batch, in order."""
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        stage_pool = ThreadPoolExecutor(1)
+        drain_pool = ThreadPoolExecutor(1)
+        staged = collections.deque()
+        drains = collections.deque()
+        it = iter(batches)
+
+        def fill():
+            while len(staged) < max(prefetch, 1):
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                staged.append(stage_pool.submit(self._stage, b))
 
         try:
-            for ids, dense, labels in batches:
-                ids = np.asarray(ids)
-                emb = self.table.pull(ids)
-                first = self.table_w1.pull(ids)[..., 0]
+            fill()
+            while staged:
+                ids, emb, first, dense, labels = \
+                    staged.popleft().result()
+                fill()      # stage the next batch behind the compute
                 loss, logits, self.params, gemb, gfirst = _train_step(
-                    self.cfg, self.params, jnp.asarray(emb),
-                    jnp.asarray(first), jnp.asarray(dense, jnp.float32),
-                    jnp.asarray(labels), jnp.float32(lr))
-                if pending is not None:
-                    # fetch the PREVIOUS step's grads while the device
-                    # is busy with the step just dispatched
-                    yield _push_pending()
-                pending = (ids, gemb, gfirst, loss)
-            if pending is not None:
-                yield _push_pending()
+                    self.cfg, self.params, emb, first, dense, labels,
+                    jnp.float32(lr), self.wire_dtype)
+                drains.append(drain_pool.submit(
+                    self._drain, ids, gemb, gfirst, loss))
+                while len(drains) > 1:
+                    yield drains.popleft().result()
+            while drains:
+                yield drains.popleft().result()
         finally:
-            # early consumer exit (break mid-stream): the in-flight
-            # step's grads must still land before tables are read
-            if pending is not None:
-                _push_pending()
+            # early consumer exit: in-flight grads must still land
+            # before tables are read
+            while drains:
+                try:
+                    drains.popleft().result()
+                except Exception:
+                    pass
+            # wait=True: an in-flight _stage pull materializes ids into
+            # the tables; returning while it runs would race a
+            # subsequent save()/pull() against that mutation
+            stage_pool.shutdown(wait=True, cancel_futures=True)
+            drain_pool.shutdown(wait=True)
             self.finalize()
 
     def finalize(self):
