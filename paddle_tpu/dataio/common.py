@@ -1,0 +1,168 @@
+"""Dataset download + md5 cache (python/paddle/dataset/common.py parity).
+
+The builtin dataset family stays SYNTHETIC by default (hermetic CI);
+real corpora are opt-in via ``PT_DATASET_REAL=1`` (or passing
+``source="real"``), which routes mnist/cifar10 through this module's
+`download` — url fetch with md5 verification, retries, and a local
+cache under ``$PT_DATA_HOME`` (default ~/.cache/paddle_tpu/dataset),
+exactly the reference's DATA_HOME + download(url, module, md5) contract
+(ref: python/paddle/dataset/common.py `DATA_HOME`, `download`,
+`md5file`).
+"""
+
+import gzip
+import hashlib
+import os
+import shutil
+import time
+
+import numpy as np
+
+__all__ = ["DATA_HOME", "data_home", "download", "md5file",
+           "real_data_enabled"]
+
+DATA_HOME = os.environ.get(
+    "PT_DATA_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                 "dataset"))
+
+
+def data_home(module_name=""):
+    d = os.path.join(DATA_HOME, module_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def real_data_enabled():
+    """Opt-in switch: real corpora only when PT_DATASET_REAL=1."""
+    return os.environ.get("PT_DATASET_REAL", "0") in ("1", "true", "on")
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum=None, save_name=None, retries=3):
+    """Fetch ``url`` into the module's cache dir; verify md5; reuse the
+    cached file when it already matches (the reference's download()).
+    Raises RuntimeError after ``retries`` failed attempts."""
+    import urllib.request
+
+    d = data_home(module_name)
+    fname = os.path.join(d, save_name or url.split("/")[-1])
+    if os.path.exists(fname) and (md5sum is None
+                                  or md5file(fname) == md5sum):
+        return fname
+    last = None
+    tmp = f"{fname}.{os.getpid()}.part"
+    for attempt in range(retries):
+        try:
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+            if md5sum is not None and md5file(tmp) != md5sum:
+                raise RuntimeError(f"md5 mismatch for {url}")
+            os.replace(tmp, fname)
+            return fname
+        except Exception as e:
+            last = e
+            # never leave a truncated .part behind
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            time.sleep(min(2 ** attempt, 5))
+    raise RuntimeError(f"download failed after {retries} attempts: "
+                       f"{url}: {last}")
+
+
+# ---------------------------------------------------------------------------
+# real-corpus readers (mnist idx / cifar-10 python pickle formats)
+# ---------------------------------------------------------------------------
+MNIST_URLS = {
+    # Yann LeCun's original host frequently 403s; ossci mirror carries
+    # the same idx files (same md5s the reference pins,
+    # ref: python/paddle/dataset/mnist.py TRAIN_IMAGE_MD5 etc.)
+    "train_images": ("https://ossci-datasets.s3.amazonaws.com/mnist/"
+                     "train-images-idx3-ubyte.gz",
+                     "f68b3c2dcbeaaa9fbdd348bbdeb94873"),
+    "train_labels": ("https://ossci-datasets.s3.amazonaws.com/mnist/"
+                     "train-labels-idx1-ubyte.gz",
+                     "d53e105ee54ea40749a09fcbcd1e9432"),
+    "test_images": ("https://ossci-datasets.s3.amazonaws.com/mnist/"
+                    "t10k-images-idx3-ubyte.gz",
+                    "9fb629c4189551a2d022fa330f9573f3"),
+    "test_labels": ("https://ossci-datasets.s3.amazonaws.com/mnist/"
+                    "t10k-labels-idx1-ubyte.gz",
+                    "ec29112dd5afa0611ce80d1b7f02629c"),
+}
+
+CIFAR10_URL = ("https://www.cs.toronto.edu/~kriz/"
+               "cifar-10-python.tar.gz",
+               "c58f30108f718f92721af3b95e74349a")
+
+
+def _read_idx_images(path):
+    with gzip.open(path, "rb") as f:
+        data = f.read()
+    n = int.from_bytes(data[4:8], "big")
+    rows = int.from_bytes(data[8:12], "big")
+    cols = int.from_bytes(data[12:16], "big")
+    imgs = np.frombuffer(data, np.uint8, offset=16).reshape(
+        n, rows * cols)
+    return imgs
+
+
+def _read_idx_labels(path):
+    with gzip.open(path, "rb") as f:
+        data = f.read()
+    n = int.from_bytes(data[4:8], "big")
+    return np.frombuffer(data, np.uint8, offset=8, count=n)
+
+
+def mnist_reader(split="train"):
+    """Zero-arg reader factory over the REAL mnist idx files (the
+    reference's dataset.mnist normalization: float32 in [-1, 1])."""
+    img_url, img_md5 = MNIST_URLS[f"{split}_images"]
+    lab_url, lab_md5 = MNIST_URLS[f"{split}_labels"]
+    img_path = download(img_url, "mnist", img_md5)
+    lab_path = download(lab_url, "mnist", lab_md5)
+
+    def reader():
+        imgs = _read_idx_images(img_path)
+        labels = _read_idx_labels(lab_path)
+        for i in range(len(labels)):
+            yield (imgs[i].astype(np.float32) / 127.5 - 1.0,
+                   int(labels[i]))
+
+    return reader
+
+
+def cifar10_reader(split="train"):
+    """Zero-arg reader factory over the REAL cifar-10 python batches
+    (float32 in [0, 1], flattened 3*32*32 — the reference's layout)."""
+    import pickle
+    import tarfile
+
+    url, md5 = CIFAR10_URL
+    path = download(url, "cifar", md5)
+    names = ([f"data_batch_{i}" for i in range(1, 6)]
+             if split == "train" else ["test_batch"])
+
+    def reader():
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    # trusted artifact pinned by md5 above (the
+                    # reference unpickles these batches the same way)
+                    blob = pickle.load(tf.extractfile(m),
+                                       encoding="bytes")
+                    data = blob[b"data"].astype(np.float32) / 255.0
+                    for row, lab in zip(data, blob[b"labels"]):
+                        yield row, int(lab)
+
+    return reader

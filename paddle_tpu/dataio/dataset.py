@@ -1,10 +1,15 @@
-"""Builtin datasets (synthetic, reference-shaped).
+"""Builtin datasets (synthetic by default, reference-shaped).
 
 Parity: python/paddle/dataset/{mnist,cifar,uci_housing,imdb,imikolov,
 movielens,…}.py — same reader contract (`train()`/`test()` return
 zero-arg callables yielding tuples), same sample shapes/ranges, but
 deterministic synthetic data so tests are hermetic (the reference
 downloads with md5 caching, dataset/common.py).
+
+Real corpora are OPT-IN: set ``PT_DATASET_REAL=1`` (or pass
+``source="real"``) and mnist/cifar10 route through
+paddle_tpu.dataio.common's download+md5 cache (the reference's
+dataset/common.py contract, same md5 pins).
 """
 
 import numpy as np
@@ -34,13 +39,48 @@ class _Synthetic:
         return reader
 
 
+class _MaybeReal(_Synthetic):
+    """Synthetic by default; ``source="real"`` (or PT_DATASET_REAL=1)
+    switches to the downloaded corpus via ``real_factory(split)``."""
+
+    def __init__(self, make_sample, n_train, n_test, real_factory,
+                 seed=7):
+        super().__init__(make_sample, n_train, n_test, seed)
+        self._real_factory = real_factory
+
+    def _use_real(self, source):
+        if source is None:
+            from paddle_tpu.dataio.common import real_data_enabled
+            return real_data_enabled()
+        if source not in ("synthetic", "real"):
+            raise ValueError(f"source must be synthetic|real, "
+                             f"got {source!r}")
+        return source == "real"
+
+    def train(self, source=None):
+        if self._use_real(source):
+            return self._real_factory("train")
+        return super().train()
+
+    def test(self, source=None):
+        if self._use_real(source):
+            return self._real_factory("test")
+        return super().test()
+
+
 def _mnist_sample(rng):
     img = rng.uniform(-1, 1, size=(784,)).astype(np.float32)
     label = rng.randint(0, 10)
     return img, label
 
 
-mnist = _Synthetic(_mnist_sample, n_train=1024, n_test=256)
+def _mnist_real(split):
+    from paddle_tpu.dataio import common
+    return common.mnist_reader(split)
+
+
+mnist = _MaybeReal(_mnist_sample, n_train=1024, n_test=256,
+                   real_factory=_mnist_real)
 
 
 def _cifar_sample(rng):
@@ -49,7 +89,13 @@ def _cifar_sample(rng):
     return img.reshape(-1), label
 
 
-cifar10 = _Synthetic(_cifar_sample, n_train=1024, n_test=256)
+def _cifar_real(split):
+    from paddle_tpu.dataio import common
+    return common.cifar10_reader(split)
+
+
+cifar10 = _MaybeReal(_cifar_sample, n_train=1024, n_test=256,
+                     real_factory=_cifar_real)
 
 
 def _housing_sample(rng):

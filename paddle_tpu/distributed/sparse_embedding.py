@@ -115,7 +115,14 @@ class _Shard:
             self._ensure(ids)
             rix = np.fromiter((self.index[i] for i in ids), np.int64,
                               len(ids))
-            self.optimizer(self.rows, self.slot, rix, grads, lr)
+            # the table merges to unique ids before dispatching to
+            # shards — tell the builtin rules so they skip the
+            # uniqueness sort; custom optimizers keep the old signature
+            if self.optimizer in (sparse_sgd, sparse_adagrad):
+                self.optimizer(self.rows, self.slot, rix, grads, lr,
+                               unique=True)
+            else:
+                self.optimizer(self.rows, self.slot, rix, grads, lr)
 
     def state(self):
         with self.lock:
@@ -138,20 +145,21 @@ def _rix_unique(rix):
     return bool(np.all(s[1:] != s[:-1]))
 
 
-def sparse_sgd(rows, slot, rix, grads, lr):
+def sparse_sgd(rows, slot, rix, grads, lr, unique=None):
     """Sparse SGD row update (pserver sgd optimize block parity).
-    Unique row indices (the table's merge guarantees this) take the
-    vectorized fancy-indexing path; ufunc.at only for duplicates."""
-    if _rix_unique(rix):
+    Unique row indices (the table's merge guarantees this, passed as
+    unique=True so the hot path skips the O(n log n) confirmation) take
+    the vectorized fancy-indexing path; ufunc.at only for duplicates."""
+    if _rix_unique(rix) if unique is None else unique:
         rows[rix] -= lr * grads
     else:
         np.subtract.at(rows, rix, lr * grads)
 
 
-def sparse_adagrad(rows, slot, rix, grads, lr, eps=1e-6):
+def sparse_adagrad(rows, slot, rix, grads, lr, eps=1e-6, unique=None):
     """Sparse Adagrad (operators/optimizers/adagrad_op.cc SelectedRows
     kernel parity): accumulate g² per row, scale update."""
-    if _rix_unique(rix):
+    if _rix_unique(rix) if unique is None else unique:
         slot[rix] += grads * grads
         rows[rix] -= lr * grads / (np.sqrt(slot[rix]) + eps)
     else:

@@ -104,12 +104,14 @@ def _train_step(cfg, params, emb, first, dense, labels, lr,
 class CTRTrainer:
     """Train loop glue: pull → jit step → async push.
 
-    The sparse push of step N's gradients runs on a background thread and
-    overlaps step N+1's pull + compute; the pull itself is synchronous
-    (each step reads the freshest rows, the sync-PS semantics). A
-    fully-async double-buffered pull (steps-behind embeddings, the
-    reference's async Communicator mode) is a policy choice layered on
-    top by pulling the next batch before finalizing the current one.
+    Two loops with different staleness semantics: ``train_step`` pulls
+    synchronously (each step reads the freshest rows — sync-PS
+    semantics) and pushes sync or async; ``train_stream`` is the
+    three-stage pipeline whose staging thread pulls up to ``prefetch``
+    steps ahead, so embeddings are steps-behind relative to pushes (the
+    reference's async Communicator mode). ``wire_dtype`` quantizes the
+    embeddings/grads crossing the host<->device link in BOTH loops;
+    host tables accumulate fp32 either way.
     """
 
     def __init__(self, cfg, seed=0, sync_push=False,
@@ -129,8 +131,11 @@ class CTRTrainer:
     def train_step(self, ids, dense, labels, lr=0.01):
         """ids [B, slots] int64; dense [B, dense_dim]; labels [B]."""
         ids = np.asarray(ids)
-        emb = self.table.pull(ids)                      # [B, slots, D]
-        first = self.table_w1.pull(ids)[..., 0]         # [B, slots]
+        wd = np.dtype(self.wire_dtype)
+        # same wire quantization as the pipelined _stage: pulled
+        # embeddings cross the link at wire_dtype in BOTH loops
+        emb = self.table.pull(ids).astype(wd, copy=False)
+        first = self.table_w1.pull(ids)[..., 0].astype(wd, copy=False)
         loss, logits, self.params, gemb, gfirst = _train_step(
             self.cfg, self.params, jnp.asarray(emb), jnp.asarray(first),
             jnp.asarray(dense, jnp.float32),

@@ -2,6 +2,8 @@
 reader contract: train()/test() return zero-arg callables yielding
 tuples with the reference's shapes)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -67,3 +69,85 @@ def test_transpiler_namespace():
         pt.transpiler.memory_optimize()
         pt.transpiler.release_memory()
     assert len(w) == 2
+
+
+class TestRealDataOptIn:
+    """Opt-in real-corpus path (dataset/common.py parity): synthetic
+    stays default; PT_DATASET_REAL / source="real" route through the
+    download+md5 cache; idx/cifar parsers verified on crafted local
+    files so CI needs no network."""
+
+    def test_synthetic_is_default(self, monkeypatch):
+        monkeypatch.delenv("PT_DATASET_REAL", raising=False)
+        from paddle_tpu.dataio import dataset
+        img, lab = next(dataset.mnist.train()())
+        assert img.shape == (784,) and img.dtype == np.float32
+
+    def test_source_real_routes_through_factory(self, monkeypatch):
+        from paddle_tpu.dataio import dataset
+        called = {}
+
+        def fake(split):
+            called["split"] = split
+            return lambda: iter([(np.zeros(784, np.float32), 3)])
+
+        ds = dataset._MaybeReal(dataset._mnist_sample, 4, 2,
+                                real_factory=fake)
+        out = list(ds.train(source="real")())
+        assert called["split"] == "train" and out[0][1] == 3
+        # env flag routes too
+        monkeypatch.setenv("PT_DATASET_REAL", "1")
+        list(ds.test()())
+        assert called["split"] == "test"
+        with pytest.raises(ValueError):
+            ds.train(source="bogus")
+
+    def test_md5_and_cache(self, tmp_path, monkeypatch):
+        from paddle_tpu.dataio import common
+        monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+        blob = b"hello dataset"
+        src = tmp_path / "src.bin"
+        src.write_bytes(blob)
+        import hashlib
+        md5 = hashlib.md5(blob).hexdigest()
+        url = "file://" + str(src)
+        p1 = common.download(url, "m", md5)
+        assert open(p1, "rb").read() == blob
+        # cached: a second call must not re-fetch (delete the source)
+        src.unlink()
+        assert common.download(url, "m", md5) == p1
+        # wrong md5 -> fails (no silent corruption)
+        with pytest.raises(RuntimeError):
+            common.download(url + ".gone", "m", "0" * 32, retries=1)
+
+    def test_idx_parsers_on_crafted_files(self, tmp_path):
+        import gzip
+        from paddle_tpu.dataio import common
+        # 2 images of 2x2, labels [7, 1] in idx format
+        imgs = (b"\x00\x00\x08\x03"
+                + (2).to_bytes(4, "big") + (2).to_bytes(4, "big")
+                + (2).to_bytes(4, "big")
+                + bytes([0, 255, 128, 64, 1, 2, 3, 4]))
+        labs = (b"\x00\x00\x08\x01" + (2).to_bytes(4, "big")
+                + bytes([7, 1]))
+        pi = tmp_path / "imgs.gz"
+        pl = tmp_path / "labs.gz"
+        with gzip.open(pi, "wb") as f:
+            f.write(imgs)
+        with gzip.open(pl, "wb") as f:
+            f.write(labs)
+        out = common._read_idx_images(str(pi))
+        assert out.shape == (2, 4) and out[0, 1] == 255
+        labels = common._read_idx_labels(str(pl))
+        assert list(labels) == [7, 1]
+
+    @pytest.mark.skipif(
+        not __import__("paddle_tpu.dataio.common",
+                       fromlist=["real_data_enabled"]
+                       ).real_data_enabled(),
+        reason="real-corpus download is opt-in (PT_DATASET_REAL) and "
+               "needs network")
+    def test_real_mnist_downloads(self):
+        from paddle_tpu.dataio import dataset
+        img, lab = next(dataset.mnist.train(source="real")())
+        assert img.shape == (784,) and 0 <= lab <= 9
