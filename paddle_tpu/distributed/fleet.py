@@ -26,8 +26,16 @@ class DistributedStrategy:
 
     def __init__(self):
         self.nccl_comm_num = 1          # kept for API compat; no-op
+        # hierarchical allreduce = reduce over ("dcn_data", "data") on a
+        # MeshConfig(dcn_data=N) hybrid mesh (mesh.data_axes); ICI
+        # within each slice, one DCN hop across
         self.use_hierarchical_allreduce = False
         self.fuse_all_reduce_ops = True  # XLA buckets automatically
+        # bucket size for EXPLICIT (shard_map) gradient allreduce —
+        # collective.bucketed_all_reduce consumes it; under pjit
+        # sharding annotations XLA owns bucketing and this is unused
+        # (reference knob: DistributedStrategy.fuse_grad_size_in_MB)
+        self.fuse_grad_size_in_MB = 32
         self.gradient_scale = "avg"      # avg|sum
 
 
@@ -44,9 +52,27 @@ class DistributedOptimizer:
 
     def apply_gradients(self, params, grads, state):
         if not self.in_spmd:
+            # explicit (shard_map) path: the strategy knobs act here.
+            # fuse_grad_size_in_MB buckets the tree into fused
+            # collectives; use_hierarchical_allreduce reduces over the
+            # hybrid mesh's ("dcn_data", "data") axes (ICI within a
+            # slice, one DCN hop across). Under pjit annotations
+            # (in_spmd=True) XLA owns both decisions.
+            from paddle_tpu.parallel.collective import bucketed_all_reduce
             op = "avg" if self.strategy.gradient_scale == "avg" else "sum"
-            grads = jax.tree.map(
-                lambda g: all_reduce(g, op=op, axis_name=self.axis), grads)
+            axis = self.axis
+            if self.strategy.use_hierarchical_allreduce:
+                from paddle_tpu.parallel.mesh import DCN_AXIS
+                if not isinstance(axis, (tuple, list)):
+                    axis = (DCN_AXIS, axis)
+            if self.strategy.fuse_all_reduce_ops:
+                grads = bucketed_all_reduce(
+                    grads, axis_name=axis,
+                    bucket_mb=self.strategy.fuse_grad_size_in_MB, op=op)
+            else:
+                grads = jax.tree.map(
+                    lambda g: all_reduce(g, op=op, axis_name=axis),
+                    grads)
         return self.opt.apply_gradients(params, grads, state)
 
     def __getattr__(self, k):

@@ -27,6 +27,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
+DCN_AXIS = "dcn_data"     # cross-slice data parallelism (rides DCN)
 
 
 @dataclass
@@ -35,13 +36,21 @@ class MeshConfig:
     model: int = 1
     pipe: int = 1
     seq: int = 1
+    # cross-slice (DCN) data-parallel degree. > 1 prepends an OUTERMOST
+    # "dcn_data" axis: gradient sync over ("dcn_data", "data") is then
+    # hierarchical — XLA reduces within each slice over ICI first and
+    # crosses DCN once per slice, the TPU-native form of the
+    # reference's inter/exter two-level rings
+    # (nccl_helper.h:179 NCCLCommunicator, build_strategy.h:132-138
+    # use_hierarchical_allreduce).
+    dcn_data: int = 1
     axis_order: tuple = (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS)
 
 
 def mesh_shape_for(n_devices, cfg):
     sizes = {DATA_AXIS: cfg.data, MODEL_AXIS: cfg.model,
              PIPE_AXIS: cfg.pipe, SEQ_AXIS: cfg.seq}
-    fixed = 1
+    fixed = max(getattr(cfg, "dcn_data", 1), 1)
     for a, s in sizes.items():
         if s != -1:
             fixed *= s
@@ -54,18 +63,53 @@ def mesh_shape_for(n_devices, cfg):
 def make_mesh(config=None, devices=None):
     """Build a Mesh over the given (default: all) devices.
 
-    Axis layout note: the innermost mesh axis maps to adjacent devices,
-    so put the highest-bandwidth-demand axis ("model") innermost — the
-    analog of the reference's hierarchical inter/exter ring split
-    (parallel_executor.cc:158-180)."""
+    Axis layout policy (the DCN-vs-ICI placement the reference tunes
+    with hierarchical/multi-ring knobs, build_strategy.h:129-138):
+    - the OUTERMOST axis strides across the largest device distances —
+      config.dcn_data puts cross-slice data parallelism there, so only
+      that axis's collectives cross DCN;
+    - the INNERMOST mesh axis maps to adjacent devices, so the
+      highest-bandwidth-demand axis ("model", default axis_order) sits
+      innermost on the tightest ICI ring (the inter/exter ring split of
+      parallel_executor.cc:158-180).
+    On real multi-slice TPU fleets the hybrid layout is taken from the
+    platform topology (mesh_utils.create_hybrid_device_mesh) when
+    available; virtual/CPU platforms use the order of jax.devices().
+    """
     devices = devices if devices is not None else jax.devices()
     config = config or MeshConfig()
+    dcn = max(getattr(config, "dcn_data", 1), 1)
     shape = mesh_shape_for(len(devices), config)
+    names = config.axis_order
+    if dcn > 1:
+        names = (DCN_AXIS,) + tuple(config.axis_order)
+        per_slice = tuple(shape)
+        slice_ids = {getattr(d, "slice_index", None) for d in devices}
+        if len(slice_ids - {None}) > 1:
+            # real multi-slice fleet: the hybrid layout must respect
+            # slice boundaries (errors here are config errors and must
+            # surface — a silent reshape would route intra-slice
+            # collectives over DCN)
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_hybrid_device_mesh(
+                (1,) + per_slice,
+                dcn_mesh_shape=(dcn,) + (1,) * len(per_slice),
+                devices=devices)
+            return Mesh(arr, names)
+        # single-slice / virtual platforms: outermost-axis reshape
+        shape = (dcn,) + per_slice
     used = 1
     for s in shape:
         used *= s
     arr = np.array(devices[:used]).reshape(shape)
-    return Mesh(arr, config.axis_order)
+    return Mesh(arr, names)
+
+
+def data_axes(mesh):
+    """The data-parallel axes present in the mesh, DCN-outermost:
+    gradient psum over this tuple is the hierarchical allreduce."""
+    return tuple(a for a in (DCN_AXIS, DATA_AXIS)
+                 if a in mesh.shape)
 
 
 _current_mesh = [None]
