@@ -62,8 +62,13 @@ class DistributedOptimizer:
             op = "avg" if self.strategy.gradient_scale == "avg" else "sum"
             axis = self.axis
             if self.strategy.use_hierarchical_allreduce:
-                from paddle_tpu.parallel.mesh import DCN_AXIS
-                if not isinstance(axis, (tuple, list)):
+                # widen to the hybrid mesh's DCN axis only when the
+                # ambient mesh actually has one — like the reference's
+                # knob, this changes the reduction structure, never
+                # breaks a flat topology
+                from paddle_tpu.parallel.mesh import DCN_AXIS, get_mesh
+                if (not isinstance(axis, (tuple, list))
+                        and DCN_AXIS in get_mesh().shape):
                     axis = (DCN_AXIS, axis)
             if self.strategy.fuse_all_reduce_ops:
                 grads = bucketed_all_reduce(

@@ -129,7 +129,7 @@ def bucketed_all_reduce(tree, axis_name=DATA_AXIS, bucket_mb=32.0,
         red = all_reduce(flat, op=op, axis_name=axis_name)
         off = 0
         for i in idxs:
-            n = leaves[i].size
+            n = jnp.asarray(leaves[i]).size   # leaves may be scalars
             out[i] = red[off:off + n].reshape(jnp.shape(leaves[i]))
             off += n
     return jax.tree.unflatten(treedef, out)

@@ -41,9 +41,12 @@ class TestHybridMesh:
         the outermost (DCN) axis takes the largest strides."""
         mesh = make_mesh(MeshConfig(data=2, model=2, dcn_data=2))
         ids = np.vectorize(lambda d: d.id)(mesh.devices)
-        # innermost axis: stride 1
-        inner = np.diff(ids, axis=-1)
-        assert np.all(inner == 1), ids
+        # innermost NON-TRIVIAL axis (trailing axes here are size 1, a
+        # diff over them would be vacuous): adjacent device ids
+        nontrivial = np.squeeze(ids)     # (dcn, data, model) = (2,2,2)
+        assert nontrivial.shape == (2, 2, 2), ids.shape
+        inner = np.diff(nontrivial, axis=-1)
+        assert inner.size > 0 and np.all(inner == 1), ids
         # outermost (DCN) axis: the largest stride in the mesh
         outer_stride = ids[1].min() - ids[0].min()
         assert outer_stride == ids.size // 2, ids
@@ -125,28 +128,29 @@ class TestBucketedAllReduce:
             assert got[k].shape == tree[k].shape
 
     def test_bucket_partitioning_respects_knob(self):
-        """The size knob actually changes the grouping (the
-        fuse_grad_size_in_MB contract)."""
-        leaves = [np.zeros(100, np.float32) for _ in range(6)]
-        cap_all = 32.0                      # one bucket
-        cap_each = 100 * 4 / (1 << 20)      # exactly one leaf per bucket
+        """The size knob changes the PRODUCTION grouping: count the
+        psum collectives bucketed_all_reduce actually emits (jaxpr
+        inspection, not a reimplementation of the loop)."""
+        tree = {f"g{i}": np.zeros(100, np.float32) for i in range(6)}
 
-        def count_buckets(cap):
-            n = 0
-            cur_bytes = 0
-            capb = max(int(cap * (1 << 20)), 1)
-            cur = []
-            for leaf in leaves:
-                nb = leaf.size * leaf.dtype.itemsize
-                if cur and cur_bytes + nb > capb:
-                    n += 1
-                    cur, cur_bytes = [], 0
-                cur.append(leaf)
-                cur_bytes += nb
-            return n + (1 if cur else 0)
+        def count_psums(cap):
+            jaxpr = jax.make_jaxpr(
+                lambda t: C.bucketed_all_reduce(t, bucket_mb=cap),
+                axis_env=[(DATA_AXIS, 8)])(tree)
+            return sum(1 for eqn in jaxpr.jaxpr.eqns
+                       if "psum" in str(eqn.primitive))
 
-        assert count_buckets(cap_all) == 1
-        assert count_buckets(cap_each) == 6
+        assert count_psums(32.0) == 1               # one fused bucket
+        assert count_psums(100 * 4 / (1 << 20)) == 6  # one per leaf
+        # mixed dtypes never share a bucket
+        mixed = {"a": np.zeros(4, np.float32),
+                 "b": np.zeros(4, np.float16)}
+        jaxpr = jax.make_jaxpr(
+            lambda t: C.bucketed_all_reduce(t, bucket_mb=32.0),
+            axis_env=[(DATA_AXIS, 8)])(mixed)
+        n = sum(1 for eqn in jaxpr.jaxpr.eqns
+                if "psum" in str(eqn.primitive))
+        assert n == 2
 
     def test_hierarchical_bucketed(self):
         """bucketed_all_reduce over the hybrid mesh's data axes."""
@@ -179,6 +183,7 @@ class TestFleetKnobs:
             DistributedOptimizer, DistributedStrategy,
         )
 
+        from paddle_tpu.parallel.mesh import mesh_guard
         mesh = make_mesh(MeshConfig(data=2, model=2, dcn_data=2))
         strategy = DistributedStrategy()
         strategy.use_hierarchical_allreduce = True
@@ -194,14 +199,47 @@ class TestFleetKnobs:
             return new_p
 
         specs = jax.tree.map(lambda _: P(), params)
-        new_p = jax.jit(lambda p, s, g: shard_map(
-            local, mesh=mesh,
-            in_specs=(specs, jax.tree.map(lambda _: P(), opt_state),
-                      specs),
-            out_specs=specs, check_rep=False)(p, s, g))(
-                params, opt_state, grads)
+        with mesh_guard(mesh):   # the hierarchical knob reads get_mesh
+            new_p = jax.jit(lambda p, s, g: shard_map(
+                local, mesh=mesh,
+                in_specs=(specs, jax.tree.map(lambda _: P(), opt_state),
+                          specs),
+                out_specs=specs, check_rep=False)(p, s, g))(
+                    params, opt_state, grads)
         # avg over replicas of identical grads == plain sgd step
         np.testing.assert_allclose(np.asarray(new_p["w"]),
                                    1.0 - 0.5 * 2.0, rtol=1e-6)
         np.testing.assert_allclose(np.asarray(new_p["b"]), -0.5,
                                    rtol=1e-6)
+
+    def test_hierarchical_knob_degrades_on_flat_mesh(self):
+        """use_hierarchical_allreduce on a mesh WITHOUT a dcn axis must
+        degrade to the flat reduction (reference-knob semantics), not
+        crash on an unbound axis name."""
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.mesh import mesh_guard
+        from paddle_tpu.distributed.fleet import (
+            DistributedOptimizer, DistributedStrategy,
+        )
+
+        mesh = make_mesh(MeshConfig(data=8))
+        strategy = DistributedStrategy()
+        strategy.use_hierarchical_allreduce = True
+        opt = DistributedOptimizer(pt.optimizer.SGD(0.5),
+                                   strategy=strategy, in_spmd=False)
+        params = {"w": jnp.ones((2,))}
+        opt_state = opt.init(params)
+        grads = {"w": jnp.ones((2,))}
+
+        def local(p, s, g):
+            return opt.apply_gradients(p, g, s)[0]
+
+        with mesh_guard(mesh):
+            new_p = jax.jit(lambda p, s, g: shard_map(
+                local, mesh=mesh,
+                in_specs=({"w": P()}, jax.tree.map(lambda _: P(),
+                                                   opt_state),
+                          {"w": P()}),
+                out_specs={"w": P()}, check_rep=False)(p, s, g))(
+                    params, opt_state, grads)
+        np.testing.assert_allclose(np.asarray(new_p["w"]), 0.5)
