@@ -7,8 +7,10 @@
 // exit non-zero (halt_on_error).
 //
 // Exercises: the threaded file loader (reader threads -> shuffle
-// buffer -> blocking queue, consumed here from multiple threads) and
-// the host arena (concurrent alloc/free).
+// buffer -> blocking queue, consumed here from multiple threads), the
+// host arena (concurrent alloc/free), and the PS sparse table
+// (concurrent pull/push/snapshot — the checkpoint-while-training
+// interleaving the parameter server actually runs).
 //
 // Usage: race_check <file1> [file2 ...]
 
@@ -28,6 +30,15 @@ const char* pt_loader_error(void* h);
 void pt_loader_close(void* h);
 void* pt_arena_create(long total_bytes, long min_block);
 void* pt_arena_alloc(void* arena, long nbytes);
+void* pt_ps_table_new(int dim, int optimizer, float lr, float eps,
+                      unsigned long long seed);
+void pt_ps_table_free(void* h);
+long pt_ps_table_size(void* h);
+void pt_ps_table_pull(void* h, const long long* ids, long n, float* out);
+void pt_ps_table_push(void* h, const long long* ids, const float* grads,
+                      long n, float lr);
+long pt_ps_table_export(void* h, long cap, long long* ids_out,
+                        float* rows_out, float* accum_out);
 int pt_arena_free(void* arena, void* ptr);
 long pt_arena_in_use(void* arena);
 void pt_arena_destroy(void* arena);
@@ -107,6 +118,52 @@ int main(int argc, char** argv) {
     std::fprintf(stderr, "arena free failures: %d\n", fail.load());
     return 1;
   }
-  std::printf("race_check ok: consumed=%ld\n", consumed.load());
+  // ---- PS sparse table: pullers + pushers + a snapshotter
+  const int DIM = 8;
+  void* tbl = pt_ps_table_new(DIM, 1 /*adagrad*/, 0.1f, 1e-6f, 7);
+  if (!tbl) {
+    std::fprintf(stderr, "ps table create failed\n");
+    return 1;
+  }
+  std::atomic<int> tfail{0};
+  auto worker = [&](int tid) {
+    std::vector<long long> ids(256);
+    std::vector<float> buf(256 * DIM, 0.5f);
+    for (int it = 0; it < 200; ++it) {
+      for (int i = 0; i < 256; ++i)
+        ids[i] = (tid * 131 + it * 17 + i * 7) % 4096;
+      pt_ps_table_pull(tbl, ids.data(), 256, buf.data());
+      pt_ps_table_push(tbl, ids.data(), buf.data(), 256, 0.01f);
+    }
+  };
+  std::atomic<bool> snap_done{false};
+  auto snapshotter = [&]() {
+    while (!snap_done.load(std::memory_order_acquire)) {
+      long n = pt_ps_table_export(tbl, 0, nullptr, nullptr, nullptr);
+      std::vector<long long> ids(n + 64);
+      std::vector<float> rows((n + 64) * DIM), accum((n + 64) * DIM);
+      long m = pt_ps_table_export(tbl, n + 64, ids.data(), rows.data(),
+                                  accum.data());
+      if (m < 0) tfail.fetch_add(1);
+      // m > cap means concurrent growth: the retry contract — caller
+      // loops; here we just verify nothing was written out of bounds
+    }
+  };
+  std::thread snap(snapshotter);
+  std::vector<std::thread> tws;
+  for (int t = 0; t < 4; ++t) tws.emplace_back(worker, t);
+  for (auto& t : tws) t.join();
+  snap_done.store(true, std::memory_order_release);
+  snap.join();
+  long nrows = pt_ps_table_size(tbl);
+  pt_ps_table_free(tbl);
+  if (tfail.load() != 0 || nrows <= 0) {
+    std::fprintf(stderr, "ps table stress failures: %d rows=%ld\n",
+                 tfail.load(), nrows);
+    return 1;
+  }
+
+  std::printf("race_check ok: consumed=%ld rows=%ld\n", consumed.load(),
+              nrows);
   return 0;
 }
