@@ -75,7 +75,8 @@ def download(url, module_name, md5sum=None, save_name=None, retries=3):
                 os.unlink(tmp)
             except OSError:
                 pass
-            time.sleep(min(2 ** attempt, 5))
+            if attempt < retries - 1:   # no backoff after the last try
+                time.sleep(min(2 ** attempt, 5))
     raise RuntimeError(f"download failed after {retries} attempts: "
                        f"{url}: {last}")
 

@@ -208,27 +208,34 @@ def _attention(lp, x, mask_bias, cfg, mesh=None, key_padding_mask=None):
         return ctx @ lp["out_w"].astype(x.dtype) \
             + lp["out_b"].astype(x.dtype)
 
-    def heads(t):
-        return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-
-    q, k, v = heads(q), heads(k), heads(v)
-
     if impl == "flash":
         # Pallas blockwise kernel: [S, S] scores never hit HBM
-        # (paddle_tpu/ops/pallas_kernels.py). mask_bias [B,1,1,S] is a
-        # key-padding bias → [B, S].
+        # (paddle_tpu/ops/pallas_kernels.py); the kernel wants [B,N,S,D].
+        # mask_bias [B,1,1,S] is a key-padding bias → [B, S].
         from paddle_tpu.ops import pallas_kernels as _pk
+
+        def heads(t):
+            return t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+
         bias = mask_bias.reshape(B, S).astype(jnp.float32)
-        ctx = _pk.flash_attention(q, k, v, bias=bias)
+        ctx = _pk.flash_attention(heads(q), heads(k), heads(v), bias=bias)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H).astype(x.dtype)
         return ctx @ lp["out_w"].astype(x.dtype) \
             + lp["out_b"].astype(x.dtype)
 
-    scores = jnp.einsum("bnqd,bnkd->bnqk", q, k) / math.sqrt(hd)
+    # dense path stays in [B, S, N, D]: the head dim rides dot_general
+    # as a batch dimension, so XLA never materializes the [B,N,S,D]
+    # transposes (they showed up as ~7 GB/step of "data formatting" on
+    # the profile at bs=64 s=512)
+    def heads(t):
+        return t.reshape(B, S, nh, hd)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = jnp.einsum("bqnd,bknd->bnqk", q, k) / math.sqrt(hd)
     scores = scores + mask_bias  # [B,1,1,S] additive
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
+    ctx = jnp.einsum("bnqk,bknd->bqnd", probs, v)
+    ctx = ctx.reshape(B, S, H)
     return ctx @ lp["out_w"].astype(x.dtype) + lp["out_b"].astype(x.dtype)
 
 

@@ -139,14 +139,20 @@ int main(int argc, char** argv) {
   std::atomic<bool> snap_done{false};
   auto snapshotter = [&]() {
     while (!snap_done.load(std::memory_order_acquire)) {
+      // full retry contract: size, fill with slack, retry on growth;
+      // then validate what the export wrote (ids in range, count sane,
+      // canary beyond m untouched)
       long n = pt_ps_table_export(tbl, 0, nullptr, nullptr, nullptr);
-      std::vector<long long> ids(n + 64);
-      std::vector<float> rows((n + 64) * DIM), accum((n + 64) * DIM);
-      long m = pt_ps_table_export(tbl, n + 64, ids.data(), rows.data(),
+      long cap = n + 64;
+      std::vector<long long> ids(cap + 1, -7);     // +1 canary slot
+      std::vector<float> rows(cap * DIM), accum(cap * DIM);
+      long m = pt_ps_table_export(tbl, cap, ids.data(), rows.data(),
                                   accum.data());
-      if (m < 0) tfail.fetch_add(1);
-      // m > cap means concurrent growth: the retry contract — caller
-      // loops; here we just verify nothing was written out of bounds
+      if (m > cap) continue;                       // grew: retry
+      if (m < n || m > 4096) tfail.fetch_add(1);   // ids are % 4096
+      for (long i = 0; i < m; ++i)
+        if (ids[i] < 0 || ids[i] >= 4096) tfail.fetch_add(1);
+      if (ids[cap] != -7) tfail.fetch_add(1);      // wrote past cap
     }
   };
   std::thread snap(snapshotter);
