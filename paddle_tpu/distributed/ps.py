@@ -275,15 +275,15 @@ class ParameterServer:
         self._server = None
         self._thread = None
         # retry dedup for mutating requests (grpc retry-idempotence
-        # role): (client_id, seq) -> cached reply in a bounded LRU,
-        # plus an in-flight set so a retry that races the original
-        # request waits for it instead of re-applying. One entry per
-        # client is NOT enough — PSClient is multi-threaded (user
-        # thread + Communicator send thread share one seq counter), so
-        # replies from different threads interleave.
+        # role): per-client bounded LRU of seq -> cached reply, plus an
+        # in-flight set so a retry that races the original request
+        # waits for it instead of re-applying. Scoped PER CLIENT — a
+        # single global LRU would let one chatty client evict another
+        # client's in-retry entry and silently re-apply its mutation.
         import collections
-        self._dedup = collections.OrderedDict()
-        self._dedup_cap = 1024
+        self._dedup = collections.OrderedDict()   # client -> LRU
+        self._dedup_clients_cap = 256
+        self._dedup_per_client_cap = 128
         self._inflight = set()
         self._dedup_cv = threading.Condition()
 
@@ -357,25 +357,42 @@ class ParameterServer:
         retry racing the still-running original waits for it."""
         if kind not in wire.MUTATING or not client_id:
             return self._handle(kind, fields)
+        import collections
         key = (client_id, seq)
+
+        def cached():
+            lru = self._dedup.get(client_id)
+            if lru is not None and seq in lru:
+                lru.move_to_end(seq)
+                self._dedup.move_to_end(client_id)
+                return lru[seq]
+            return None
+
         with self._dedup_cv:
             while True:
-                if key in self._dedup:
-                    self._dedup.move_to_end(key)
-                    return self._dedup[key]
+                resp = cached()
+                if resp is not None:
+                    return resp
                 if key not in self._inflight:
                     self._inflight.add(key)
                     break
                 ok = self._dedup_cv.wait_for(
-                    lambda: key in self._dedup
+                    lambda: cached() is not None
                     or key not in self._inflight, timeout=150.0)
                 enforce(ok, f"duplicate frame {key} timed out waiting "
                             f"for the original")
         try:
             resp = self._handle(kind, fields)
             with self._dedup_cv:
-                self._dedup[key] = resp
-                while len(self._dedup) > self._dedup_cap:
+                lru = self._dedup.get(client_id)
+                if lru is None:
+                    lru = self._dedup[client_id] = \
+                        collections.OrderedDict()
+                lru[seq] = resp
+                self._dedup.move_to_end(client_id)
+                while len(lru) > self._dedup_per_client_cap:
+                    lru.popitem(last=False)
+                while len(self._dedup) > self._dedup_clients_cap:
                     self._dedup.popitem(last=False)
             return resp
         finally:
@@ -438,7 +455,11 @@ class ParameterServer:
                         except Exception as e:
                             rk, rf = wire.ERR, (f"{type(e).__name__}: "
                                                 f"{e}",)
-                        _send_frame(self.request, rk, rf)
+                        # echo (client_id, seq): the client rejects a
+                        # reply whose seq does not match its request
+                        # (a late reply to a timed-out call must never
+                        # be consumed as the next call's answer)
+                        _send_frame(self.request, rk, rf, cid, seq)
                 except (ConnectionError, EOFError, OSError):
                     pass
 
@@ -524,6 +545,21 @@ class PSClient:
                 self._all_socks.append(s)
         return s
 
+    def _drop_sock(self, ep):
+        """Close + forget the cached connection: a socket whose stream
+        position is unknown (timeout, stale reply) must never be
+        reused — a late reply would be consumed by the next call."""
+        socks = getattr(self._tls, "socks", None)
+        s = socks.pop(ep, None) if socks else None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+            with self._all_lock:
+                if s in self._all_socks:
+                    self._all_socks.remove(s)
+
     def _call(self, ep, kind, *fields):
         seq = self._next_seq()
         delay = self.BACKOFF
@@ -531,9 +567,14 @@ class PSClient:
             try:
                 s = self._sock(ep, fresh=attempt > 0)
                 _send_frame(s, kind, fields, self.client_id, seq)
-                rk, _, _, rf = _recv_frame(s)
+                rk, _, rseq, rf = _recv_frame(s)
+                if rseq != seq:
+                    raise ConnectionError(
+                        f"stale reply on {ep}: seq {rseq} != {seq}")
                 break
-            except (ConnectionError, socket.timeout, OSError):
+            except (ConnectionError, socket.timeout, OSError,
+                    wire.WireError):
+                self._drop_sock(ep)
                 if attempt == self.MAX_RETRIES:
                     raise
                 time.sleep(delay)

@@ -67,8 +67,11 @@ SCHEMAS = {
     ERR: (STR,),
 }
 
-# kinds whose server-side effect must not re-apply on a retried frame
-MUTATING = {PUSH_GRAD, PUSH_SPARSE, CHECKPOINT_NOTIFY, STOP}
+# kinds whose server-side effect must not re-apply on a retried frame.
+# BARRIER is here because its set-based fan-in is only idempotent
+# within an unreleased round: a retry landing after the release would
+# enroll the trainer in the NEXT generation and desynchronize rounds.
+MUTATING = {PUSH_GRAD, PUSH_SPARSE, CHECKPOINT_NOTIFY, STOP, BARRIER}
 
 _HDR = struct.Struct("<2sBBQQQ")
 _U16 = struct.Struct("<H")

@@ -185,8 +185,12 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
                         os.unlink(os.path.join(out_dir, name))
                     except OSError:
                         pass
-    with open(index_path, "w") as f:
+    # atomic replace: a reader (or a killed exporter) must never see a
+    # truncated index
+    tmp = f"{index_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
         json.dump(existing + entries, f, indent=1)
+    os.replace(tmp, index_path)
     return entries
 
 
@@ -286,10 +290,16 @@ class Predictor:
         self._aot_loaded = {}
         self._prog_hash = loaded_hash
         if loaded_hash is not None:
-            with open(self._aot_idx_path) as f:
-                for e in json.load(f):
-                    if e.get("program_hash") == self._prog_hash:
-                        self._aot_index[e["key"]] = e
+            try:
+                with open(self._aot_idx_path) as f:
+                    for e in json.load(f):
+                        if e.get("program_hash") == self._prog_hash:
+                            self._aot_index[e["key"]] = e
+            except (OSError, ValueError, KeyError, TypeError):
+                # corrupt/unreadable index: the model+params are fine —
+                # degrade to the retrace path like any other AOT
+                # artifact failure
+                self._aot_index = {}
 
     # -- AOT path ----------------------------------------------------------
     def _aot_fn(self, feeds):

@@ -237,3 +237,11 @@ class TestAOTExport:
                                        atol=1e-5)
         finally:
             pt.disable_static()
+
+    def test_corrupt_aot_index_degrades_to_retrace(self, aot_model):
+        d, xv, expected = aot_model
+        with open(os.path.join(d, "__aot__", "index.json"), "w") as f:
+            f.write('{"truncated": ')
+        p = create_predictor(Config(d))
+        out = p.run({"x": xv})[0]
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)

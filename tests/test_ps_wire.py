@@ -211,3 +211,45 @@ class TestRetry:
             assert s.dense["w"].round == 1
         finally:
             s.stop()
+
+    def test_barrier_retry_after_release_is_deduped(self):
+        """A BARRIER retry landing AFTER its round released must answer
+        from the dedup cache, not enroll the trainer into the next
+        generation (which would desynchronize every later round)."""
+        s = _server(n_trainers=1)
+        try:
+            blob = wire.encode(wire.BARRIER, ("sync", 0),
+                               client_id=42, seq=9)
+            c = socket.create_connection((s.host, s.port), timeout=10)
+            for _ in range(2):              # original + late retry
+                c.sendall(blob)
+                kind, _, rseq, n = wire.decode_header(
+                    c.recv(wire.HEADER_SIZE))
+                assert kind == wire.OK and rseq == 9
+            c.close()
+            # the retry did not pre-enroll anyone into the next round
+            assert not s._barrier_waiting.get("sync")
+            assert s._barrier_gen["sync"] == 1
+        finally:
+            s.stop()
+
+    def test_reply_seq_mismatch_poisons_socket(self):
+        """A reply whose seq does not match the request must never be
+        consumed: the client drops the connection and retries."""
+        s = _server()
+        try:
+            cl = PSClient([s.endpoint], {"w": s.endpoint})
+            np.testing.assert_array_equal(cl.pull_param("w"),
+                                          np.ones(4, np.float32))
+            # inject a stale unread reply onto the cached socket by
+            # sending a raw frame the client never reads
+            sock = cl._tls.socks[s.endpoint]
+            sock.sendall(wire.encode(wire.LIST_VARS, (),
+                                     cl.client_id, 0))
+            # next call reads the stale LIST reply first -> seq
+            # mismatch -> reconnect -> correct answer
+            out = cl.pull_param("w")
+            np.testing.assert_array_equal(out, np.ones(4, np.float32))
+            cl.close()
+        finally:
+            s.stop()
