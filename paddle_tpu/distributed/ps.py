@@ -31,6 +31,7 @@ identical parameters (the reference broadcasts startup from pserver the
 same way).
 """
 
+import collections
 import os
 import socket
 import socketserver
@@ -280,10 +281,13 @@ class ParameterServer:
         # waits for it instead of re-applying. Scoped PER CLIENT — a
         # single global LRU would let one chatty client evict another
         # client's in-retry entry and silently re-apply its mutation.
-        import collections
+        # The per-client window must cover a multi-threaded client's
+        # worst case: one thread backing off through retries while the
+        # Communicator thread streams mutations on the shared seq
+        # counter — hence 1024, not a handful.
         self._dedup = collections.OrderedDict()   # client -> LRU
         self._dedup_clients_cap = 256
-        self._dedup_per_client_cap = 128
+        self._dedup_per_client_cap = 1024
         self._inflight = set()
         self._dedup_cv = threading.Condition()
 
@@ -357,7 +361,6 @@ class ParameterServer:
         retry racing the still-running original waits for it."""
         if kind not in wire.MUTATING or not client_id:
             return self._handle(kind, fields)
-        import collections
         key = (client_id, seq)
 
         def cached():
@@ -437,16 +440,31 @@ class ParameterServer:
             def handle(self):
                 try:
                     while True:
+                        # header and payload decode separately so a
+                        # payload-malformed reply can still echo
+                        # (cid, seq) — the client's stale-reply check
+                        # would otherwise reject the typed error
                         try:
-                            kind, cid, seq, fields = _recv_frame(
-                                self.request)
+                            kind, cid, seq, n = wire.decode_header(
+                                _recv_exact(self.request,
+                                            wire.HEADER_SIZE))
                         except wire.WireError as e:
-                            # malformed frame: reply with a typed error
-                            # and drop the connection — the bytes were
-                            # never evaluated
                             try:
                                 _send_frame(self.request, wire.ERR,
                                             (f"malformed frame: {e}",))
+                            except OSError:
+                                pass
+                            return
+                        try:
+                            fields = wire.decode_payload(
+                                kind, _recv_exact(self.request, n))
+                        except wire.WireError as e:
+                            # bytes were never evaluated; typed error,
+                            # drop the connection
+                            try:
+                                _send_frame(self.request, wire.ERR,
+                                            (f"malformed frame: {e}",),
+                                            cid, seq)
                             except OSError:
                                 pass
                             return
@@ -569,6 +587,14 @@ class PSClient:
                 _send_frame(s, kind, fields, self.client_id, seq)
                 rk, _, rseq, rf = _recv_frame(s)
                 if rseq != seq:
+                    if rk == wire.ERR and rseq == 0:
+                        # header-level rejection (bad magic/version/
+                        # size): the server could not echo our seq —
+                        # surface the typed error, don't burn retries
+                        # re-sending the same bad frame
+                        self._drop_sock(ep)
+                        enforce(False, f"pserver {ep} error: "
+                                       f"{rf[0] if rf else '?'}")
                     raise ConnectionError(
                         f"stale reply on {ep}: seq {rseq} != {seq}")
                 break

@@ -161,9 +161,21 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
         entries.append(entry)
     index_path = os.path.join(out_dir, AOT_INDEX)
     existing = []
+    old = []
     if os.path.exists(index_path):
-        with open(index_path) as f:
-            old = json.load(f)
+        try:
+            with open(index_path) as f:
+                old = json.load(f)
+            if not isinstance(old, list):
+                old = []
+            old = [e for e in old
+                   if isinstance(e, dict) and "key" in e]
+        except (OSError, ValueError):
+            # corrupt index from an interrupted export: re-exporting
+            # must self-heal (we lose only this run's stale-artifact
+            # GC), not crash on the recovery path
+            old = []
+    if old:
         # drop superseded buckets AND any entry for a different
         # (stale) program — and unlink their artifact files, or a
         # periodically re-exported serving dir grows without bound
@@ -295,10 +307,10 @@ class Predictor:
                     for e in json.load(f):
                         if e.get("program_hash") == self._prog_hash:
                             self._aot_index[e["key"]] = e
-            except (OSError, ValueError, KeyError, TypeError):
-                # corrupt/unreadable index: the model+params are fine —
-                # degrade to the retrace path like any other AOT
-                # artifact failure
+            except Exception:
+                # corrupt/unreadable/wrong-shape index: the
+                # model+params are fine — degrade to the retrace path
+                # like any other AOT artifact failure
                 self._aot_index = {}
 
     # -- AOT path ----------------------------------------------------------
