@@ -27,6 +27,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"    # MoE expert parallelism (all_to_all routing)
 DCN_AXIS = "dcn_data"     # cross-slice data parallelism (rides DCN)
 
 
@@ -44,20 +45,33 @@ class MeshConfig:
     # (nccl_helper.h:179 NCCLCommunicator, build_strategy.h:132-138
     # use_hierarchical_allreduce).
     dcn_data: int = 1
+    # MoE expert parallelism; > 1 appends an "expert" axis to
+    # axis_order (kept out of the default order so non-MoE meshes are
+    # unchanged)
+    expert: int = 1
     axis_order: tuple = (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+
+def _effective_order(cfg):
+    order = tuple(cfg.axis_order)
+    if max(getattr(cfg, "expert", 1), 1) > 1 and EXPERT_AXIS not in order:
+        order = order + (EXPERT_AXIS,)
+    return order
 
 
 def mesh_shape_for(n_devices, cfg):
     sizes = {DATA_AXIS: cfg.data, MODEL_AXIS: cfg.model,
-             PIPE_AXIS: cfg.pipe, SEQ_AXIS: cfg.seq}
+             PIPE_AXIS: cfg.pipe, SEQ_AXIS: cfg.seq,
+             EXPERT_AXIS: max(getattr(cfg, "expert", 1), 1)}
+    order = _effective_order(cfg)
     fixed = max(getattr(cfg, "dcn_data", 1), 1)
-    for a, s in sizes.items():
-        if s != -1:
-            fixed *= s
+    for a in order:
+        if sizes.get(a, 1) != -1:
+            fixed *= sizes.get(a, 1)
     for a in sizes:
         if sizes[a] == -1:
             sizes[a] = n_devices // fixed
-    return tuple(sizes[a] for a in cfg.axis_order)
+    return tuple(sizes.get(a, 1) for a in order)
 
 
 def make_mesh(config=None, devices=None):
@@ -80,9 +94,9 @@ def make_mesh(config=None, devices=None):
     config = config or MeshConfig()
     dcn = max(getattr(config, "dcn_data", 1), 1)
     shape = mesh_shape_for(len(devices), config)
-    names = config.axis_order
+    names = _effective_order(config)
     if dcn > 1:
-        names = (DCN_AXIS,) + tuple(config.axis_order)
+        names = (DCN_AXIS,) + names
         per_slice = tuple(shape)
         slice_ids = {getattr(d, "slice_index", None) for d in devices}
         if len(slice_ids - {None}) > 1:

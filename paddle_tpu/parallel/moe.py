@@ -1,0 +1,144 @@
+"""Expert-parallel Mixture-of-Experts FFN (GShard/Switch-style).
+
+Beyond the reference (the 2019 codebase has no MoE — SURVEY §2.5 lists
+EP alongside TP/SP as TPU-build stretch): a top-k gated expert FFN
+whose experts shard over the mesh's "expert" axis
+(MeshConfig(expert=N)). Routing uses the dense-dispatch formulation —
+one-hot dispatch/combine einsums over a capacity-bucketed layout — so
+under pjit/GSPMD the token exchange lowers to all_to_all collectives
+on ICI, the TPU-native shape of expert parallelism; there is no
+host-side router.
+
+Semantics (Switch/GShard defaults): softmax gate over experts, top-k
+(k=1 or 2) selection, per-expert capacity
+C = ceil(k * tokens * capacity_factor / num_experts); tokens beyond an
+expert's capacity are dropped (their combine weight is zero, the
+residual path carries them); combine weights renormalize over the
+selected experts. An auxiliary load-balancing loss (mean gate fraction
+x mean dispatch fraction x num_experts, Switch eq. 4) is returned for
+the caller to add.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import EXPERT_AXIS
+
+__all__ = ["MoEConfig", "init_moe_params", "moe_ffn",
+           "moe_param_specs"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_hidden: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: object = jnp.float32
+
+    def capacity(self, tokens):
+        return max(int(np.ceil(self.top_k * tokens
+                               * self.capacity_factor
+                               / self.num_experts)), 1)
+
+
+def init_moe_params(rng, cfg):
+    kg, k1, k2 = jax.random.split(rng, 3)
+    s1 = 1.0 / np.sqrt(cfg.d_model)
+    s2 = 1.0 / np.sqrt(cfg.d_hidden)
+    return {
+        "gate_w": (jax.random.normal(kg, (cfg.d_model, cfg.num_experts))
+                   * s1).astype(jnp.float32),
+        "w1": (jax.random.normal(
+            k1, (cfg.num_experts, cfg.d_model, cfg.d_hidden))
+            * s1).astype(jnp.float32),
+        "b1": jnp.zeros((cfg.num_experts, cfg.d_hidden), jnp.float32),
+        "w2": (jax.random.normal(
+            k2, (cfg.num_experts, cfg.d_hidden, cfg.d_model))
+            * s2).astype(jnp.float32),
+        "b2": jnp.zeros((cfg.num_experts, cfg.d_model), jnp.float32),
+    }
+
+
+def moe_param_specs():
+    """PartitionSpecs: experts shard over the "expert" axis; the gate
+    replicates (every token scores every expert)."""
+    return {
+        "gate_w": P(),
+        "w1": P(EXPERT_AXIS, None, None),
+        "b1": P(EXPERT_AXIS, None),
+        "w2": P(EXPERT_AXIS, None, None),
+        "b2": P(EXPERT_AXIS, None),
+    }
+
+
+def _top_k_mask(gates, k):
+    """[T, E] gate probs -> (positions [T, k] int, onehot [T, k, E])."""
+    _, idx = jax.lax.top_k(gates, k)
+    onehot = jax.nn.one_hot(idx, gates.shape[-1], dtype=gates.dtype)
+    return idx, onehot
+
+
+def moe_ffn(params, cfg, x, mesh=None):
+    """x: [..., T, d_model] (leading dims flattened as tokens).
+    Returns (y, aux_loss). Under a mesh with an "expert" axis and
+    params placed per moe_param_specs, the ecd/ted einsums lower to
+    all_to_all dispatch/combine over ICI."""
+    shape = x.shape
+    t = int(np.prod(shape[:-1]))
+    xt = x.reshape(t, cfg.d_model).astype(jnp.float32)
+    e, c = cfg.num_experts, cfg.capacity(t)
+
+    gates = jax.nn.softmax(xt @ params["gate_w"], axis=-1)     # [T, E]
+    _, sel = _top_k_mask(gates, cfg.top_k)                     # [T,K,E]
+
+    # position of each (token, k) inside its expert's capacity bucket:
+    # cumulative count of prior claims on that expert, over the
+    # flattened (k-major) claim order
+    claims = sel.reshape(t * cfg.top_k, e)                 # [T*K, E]
+    pos = (jnp.cumsum(claims, axis=0) - claims)            # claims before
+    pos = jnp.sum(pos * claims, axis=-1).reshape(t, cfg.top_k)
+    within = (pos < c).astype(gates.dtype)                 # capacity drop
+    kept = sel * within[..., None]                         # [T, K, E]
+
+    # dispatch tensor [T, E, C]: claim -> capacity slot one-hot
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), c,
+                          dtype=gates.dtype)               # [T, K, C]
+    dispatch = jnp.einsum("tke,tkc->tec", kept, slot)      # [T, E, C]
+
+    # combine weights: gate prob of each kept claim, renormalized over
+    # the token's kept experts
+    gk = jnp.einsum("te,tke->tk", gates, kept)             # [T, K]
+    denom = jnp.maximum(jnp.sum(gk, axis=-1, keepdims=True), 1e-9)
+    gk = gk / denom
+    combine = jnp.einsum("tk,tke,tkc->tec", gk, kept, slot)
+
+    # route -> expert FFN -> return (all_to_all under GSPMD)
+    xin = jnp.einsum("tec,td->ecd", dispatch, xt)          # [E, C, D]
+    if mesh is not None and EXPERT_AXIS in mesh.shape:
+        xin = jax.lax.with_sharding_constraint(
+            xin, NamedSharding(mesh, P(EXPERT_AXIS, None, None)))
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", xin, params["w1"])
+                    + params["b1"][:, None, :])
+    out = jnp.einsum("ech,ehd->ecd", h, params["w2"]) \
+        + params["b2"][:, None, :]
+    if mesh is not None and EXPERT_AXIS in mesh.shape:
+        out = jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, P(EXPERT_AXIS, None, None)))
+    y = jnp.einsum("tec,ecd->td", combine, out)            # [T, D]
+
+    # Switch aux loss: num_experts * sum_e (gate fraction * dispatch
+    # fraction). The dispatch fraction uses the PRE-drop assignment
+    # (`sel`, as Switch/GShard define it) — computing it post-drop
+    # caps the overloaded expert's fraction at C/T, which masks (and
+    # slightly rewards) collapse exactly when drops begin.
+    frac_gates = jnp.mean(gates, axis=0)                   # [E]
+    frac_tokens = jnp.mean(jnp.sum(sel, axis=1), axis=0)   # [E]
+    aux = e * jnp.sum(frac_gates * frac_tokens) / cfg.top_k
+
+    return y.reshape(shape).astype(x.dtype), aux
