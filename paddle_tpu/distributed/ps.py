@@ -32,6 +32,7 @@ same way).
 """
 
 import collections
+import logging
 import os
 import socket
 import socketserver
@@ -290,6 +291,18 @@ class ParameterServer:
         self._dedup_per_client_cap = 1024
         self._inflight = set()
         self._dedup_cv = threading.Condition()
+        # highest seq handled per client — outlives the reply LRU (own
+        # larger cap, FIFO), so a retry whose cached reply was evicted
+        # is detectable: its seq is well below last_seen yet absent
+        # from the LRU. Such a frame is re-applied (we can't answer
+        # from cache) but counted + logged so silent double-apply is at
+        # least observable. The tolerance below keeps legitimately
+        # out-of-order first-time frames (threads sharing one seq
+        # counter over separate connections) from tripping it.
+        self._dedup_last_seen = collections.OrderedDict()
+        self._dedup_last_seen_cap = 16384
+        self._replay_seq_tolerance = 8
+        self.possible_replays = 0
 
     # -- hosting -----------------------------------------------------------
     def host_dense(self, name, value, optimizer=None, regularizer=None,
@@ -377,6 +390,17 @@ class ParameterServer:
                 if resp is not None:
                     return resp
                 if key not in self._inflight:
+                    last = self._dedup_last_seen.get(client_id, -1)
+                    if seq <= last - self._replay_seq_tolerance:
+                        # known client, seq far behind its high-water
+                        # mark, and no cached reply: this apply is a
+                        # probable double-apply of a retry whose dedup
+                        # entry was LRU-evicted.
+                        self.possible_replays += 1
+                        logging.getLogger("paddle_tpu.ps").warning(
+                            "retry-dedup cache miss for %s seq=%d "
+                            "(last_seen=%d): mutating frame will be "
+                            "re-applied", client_id, seq, last)
                     self._inflight.add(key)
                     break
                 ok = self._dedup_cv.wait_for(
@@ -392,6 +416,12 @@ class ParameterServer:
                     lru = self._dedup[client_id] = \
                         collections.OrderedDict()
                 lru[seq] = resp
+                if seq > self._dedup_last_seen.get(client_id, -1):
+                    self._dedup_last_seen[client_id] = seq
+                    self._dedup_last_seen.move_to_end(client_id)
+                    while (len(self._dedup_last_seen)
+                           > self._dedup_last_seen_cap):
+                        self._dedup_last_seen.popitem(last=False)
                 self._dedup.move_to_end(client_id)
                 while len(lru) > self._dedup_per_client_cap:
                     lru.popitem(last=False)

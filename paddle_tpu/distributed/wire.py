@@ -205,8 +205,17 @@ def _dec_field(ftype, r):
         if nbytes > max_message_bytes():
             raise WireError(f"array too large ({nbytes} bytes)")
         raw = r.take(nbytes)
-        # zero-copy (read-only) view over the received payload buffer
-        return np.frombuffer(raw, dtype=dt).reshape(dims)
+        # zero-copy (read-only) view over the received payload buffer.
+        # STR fields precede ARR fields in several schemas, so the view
+        # can start at an arbitrary byte offset; when that offset is not
+        # a multiple of the itemsize the array is copied to an aligned
+        # buffer — these arrays are handed by pointer into the native
+        # table, and misaligned loads are UB off x86-64/ARM64 and a
+        # hazard for SIMD C++ code.
+        arr = np.frombuffer(raw, dtype=dt)
+        if not arr.flags.aligned:
+            arr = arr.copy()
+        return arr.reshape(dims)
     raise WireError(f"unknown field type {ftype!r}")  # pragma: no cover
 
 

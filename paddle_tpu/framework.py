@@ -6,6 +6,7 @@ ref: python/paddle/fluid/dygraph/base.py).
 """
 
 import contextlib
+import functools
 import threading
 
 import jax
@@ -104,11 +105,54 @@ def to_variable(value, name=None, zero_copy=None):
     return jnp.asarray(np.asarray(value))
 
 
-@contextlib.contextmanager
-def no_grad():
-    """dygraph.no_grad parity. Eager JAX doesn't build tapes, so this is a
-    semantic no-op context; provided for API compatibility."""
-    yield
+_no_grad_state = threading.local()
+
+
+def in_no_grad():
+    """True inside a ``no_grad()`` region (thread-local)."""
+    return getattr(_no_grad_state, "depth", 0) > 0
+
+
+class _NoGrad:
+    """dygraph.no_grad parity (ref python/paddle/fluid/dygraph/base.py).
+
+    Real semantics, not a no-op: inside the region every ``nn.Layer``
+    call wraps its outputs in ``lax.stop_gradient``, so parameters used
+    only under ``no_grad`` receive exactly-zero gradients. Works as a
+    context manager and as a decorator (both forms exist in the
+    reference). Raw jnp math outside any Layer is functional and cannot
+    be intercepted — wrap such code with ``stop_gradient`` explicitly.
+
+    TRACE-TIME semantics (like every Python-level flag under jit): the
+    flag is read while a function is being traced and is baked into the
+    compiled computation; it is NOT part of jax.jit's cache key. Do not
+    call one jitted function both inside and outside a ``no_grad``
+    region — whichever call traces first wins for all later cached
+    calls. Enter ``no_grad`` inside the function being jitted (or use
+    separate jitted callables for frozen/unfrozen passes), exactly as
+    with flax-style ``deterministic`` flags.
+    """
+
+    def __enter__(self):
+        _no_grad_state.depth = getattr(_no_grad_state, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _no_grad_state.depth -= 1
+        return False
+
+    def __call__(self, fn=None):
+        if fn is None:           # ``with no_grad():`` form
+            return self
+
+        @functools.wraps(fn)     # ``@no_grad`` decorator form
+        def inner(*a, **k):
+            with self:
+                return jax.tree.map(jax.lax.stop_gradient, fn(*a, **k))
+        return inner
+
+
+no_grad = _NoGrad()
 
 
 def stop_gradient(x):

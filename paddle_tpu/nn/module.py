@@ -146,7 +146,11 @@ class Layer:
                 f"{type(self).__name__} called outside a module context — "
                 f"use .init(rng, ...) then .apply(params, state, ...)")
         with _frame().scope(self._scope_name):
-            return self.forward(*args, **kwargs)
+            out = self.forward(*args, **kwargs)
+        from paddle_tpu.framework import in_no_grad
+        if in_no_grad():
+            out = jax.tree.map(jax.lax.stop_gradient, out)
+        return out
 
     # -- functional entry points ------------------------------------------
     def init(self, rng, *args, **kwargs):

@@ -52,7 +52,13 @@ def all_reduce(x, op="sum", ring_id=None, axis_name=DATA_AXIS):
     if op == "min":
         return lax.pmin(x, axis)
     if op == "prod":
-        return jnp.exp(lax.psum(jnp.log(x), axis))
+        # Exact product (c_allreduce_prod, collective/c_allreduce_op.h:33):
+        # all-gather the shards and reduce locally. An exp(psum(log))
+        # formulation is NaN for negatives and loses precision; the gather
+        # costs N× transient memory but matches the reference bit-for-bit
+        # semantics (zeros, negatives, infs all behave like jnp.prod).
+        return jnp.prod(lax.all_gather(x, axis, axis=0, tiled=False),
+                        axis=0)
     raise ValueError(f"unknown allreduce op {op}")
 
 

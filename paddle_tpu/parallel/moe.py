@@ -98,11 +98,14 @@ def moe_ffn(params, cfg, x, mesh=None):
     _, sel = _top_k_mask(gates, cfg.top_k)                     # [T,K,E]
 
     # position of each (token, k) inside its expert's capacity bucket:
-    # cumulative count of prior claims on that expert, over the
-    # flattened (k-major) claim order
-    claims = sel.reshape(t * cfg.top_k, e)                 # [T*K, E]
+    # cumulative count of prior claims on that expert. GShard/Switch
+    # priority order: ALL top-1 claims outrank any top-2 claim, so the
+    # flatten must be k-major ([K,T,E]) before the cumsum — a
+    # token-major flatten would let an early token's 2nd choice evict a
+    # later token's 1st choice.
+    claims = sel.transpose(1, 0, 2).reshape(cfg.top_k * t, e)  # [K*T, E]
     pos = (jnp.cumsum(claims, axis=0) - claims)            # claims before
-    pos = jnp.sum(pos * claims, axis=-1).reshape(t, cfg.top_k)
+    pos = jnp.sum(pos * claims, axis=-1).reshape(cfg.top_k, t).T
     within = (pos < c).astype(gates.dtype)                 # capacity drop
     kept = sel * within[..., None]                         # [T, K, E]
 
