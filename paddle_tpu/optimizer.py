@@ -44,6 +44,10 @@ __all__ = [
 
 class Optimizer:
     _slot_defaults = {}  # name -> init value
+    # update rule touches each param element independently (true for
+    # every rule here except Lars/Lamb trust ratios) — required by the
+    # kReduce/ZeRO sharded layout in parallel/data_parallel.py
+    _elementwise = True
 
     def __init__(self, learning_rate=0.001, regularization=None,
                  grad_clip=None, name=None):
@@ -236,6 +240,7 @@ class MomentumOptimizer(Optimizer):
 class LarsMomentumOptimizer(Optimizer):
     """lars_momentum_op.cc: layer-wise adaptive rate scaling."""
     _slot_defaults = {"velocity": 0.0}
+    _elementwise = False     # trust ratio needs whole-param norms
 
     def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, **kw):
@@ -400,6 +405,7 @@ class FtrlOptimizer(Optimizer):
 class LambOptimizer(Optimizer):
     """lamb_op.cc: layer-adaptive Adam with weight decay."""
     _slot_defaults = {"moment1": 0.0, "moment2": 0.0}
+    _elementwise = False     # trust ratio needs whole-param norms
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6,

@@ -1,0 +1,161 @@
+"""Reduce/ZeRO strategy tests (VERDICT-r2 Missing #1 / Weak #2;
+ref build_strategy.h:38-57 ReduceStrategy::kReduce,
+details/reduce_op_handle.cc, details/broadcast_op_handle.cc).
+
+Done-criteria from the verdict, all on the 8-device virtual CPU mesh:
+- sharded-vs-replicated loss equality over >=10 steps,
+- reduce-scatter appears in the compiled step's HLO,
+- per-device optimizer-state bytes ~= 1/N of the replicated footprint,
+- (dryrun phase lives in __graft_entry__.dryrun_multichip).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.parallel.data_parallel import (
+    DataParallelTrainer, zero_param_specs,
+)
+from paddle_tpu.parallel.mesh import (
+    DATA_AXIS, DCN_AXIS, MeshConfig, data_axes, make_mesh,
+)
+
+D = 16            # all dims divisible by 8 so every param shards
+
+
+def _loss_fn(params, state, rng, batch):
+    x, y = batch["x"], batch["y"]
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    out = h @ params["w2"] + params["b2"]
+    return jnp.mean((out - y) ** 2), state
+
+
+def _init_fn(rng, batch):
+    k1, k2 = jax.random.split(rng)
+    params = {
+        "w1": jax.random.normal(k1, (D, D)) * 0.3,
+        "b1": jnp.zeros((D,)),
+        "w2": jax.random.normal(k2, (D, 8)) * 0.3,
+        "b2": jnp.zeros((8,)),
+    }
+    return params, {}
+
+
+def _batch(step=0):
+    rng = np.random.RandomState(100 + step)
+    x = rng.randn(32, D).astype(np.float32)
+    y = rng.randn(32, 8).astype(np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def _train(param_sharding, optimizer, steps=12, fixed_batch=False):
+    mesh = make_mesh(MeshConfig(data=8))
+    tr = DataParallelTrainer(_loss_fn, optimizer, mesh=mesh,
+                             param_sharding=param_sharding, donate=False)
+    params, opt_state, state = tr.init(
+        _init_fn, jax.random.PRNGKey(0), _batch())
+    losses = []
+    for i in range(steps):
+        loss, params, opt_state, state = tr.step(
+            params, opt_state, state, jax.random.PRNGKey(1),
+            _batch(0 if fixed_batch else i))
+        losses.append(float(loss))
+    return tr, params, opt_state, losses
+
+
+class TestZeroSpecs:
+    def test_policy_shards_largest_divisible_dim(self):
+        mesh = make_mesh(MeshConfig(data=8))
+        params = {"w": jnp.zeros((24, 8)), "v": jnp.zeros((4, 3)),
+                  "s": jnp.zeros(())}
+        specs = zero_param_specs(mesh, params)
+        assert specs["w"] == P(DATA_AXIS, None)      # 24 > 8
+        assert specs["v"] == P()                     # nothing divisible
+        assert specs["s"] == P()
+
+    def test_hybrid_mesh_uses_both_data_axes(self):
+        mesh = make_mesh(MeshConfig(data=2, model=2, dcn_data=2))
+        specs = zero_param_specs(mesh, {"w": jnp.zeros((16, 4))})
+        assert specs["w"] == P((DCN_AXIS, DATA_AXIS), None)
+
+
+class TestZeroParity:
+    @pytest.mark.parametrize("opt_cls", [pt.optimizer.Momentum,
+                                         pt.optimizer.Adam])
+    def test_loss_parity_sharded_vs_replicated(self, opt_cls):
+        """kReduce must be a LAYOUT choice, not a numeric one: the loss
+        trajectory matches the replicated (kAllReduce) run step for
+        step (ref parallel_executor_test_base.py pattern)."""
+        kw = {"momentum": 0.9} if opt_cls is pt.optimizer.Momentum else {}
+        _, p_rep, _, l_rep = _train(None, opt_cls(0.05, **kw))
+        _, p_sh, _, l_sh = _train("reduce", opt_cls(0.05, **kw))
+        np.testing.assert_allclose(l_rep, l_sh, rtol=2e-4)
+        for k in p_rep:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(p_rep[k])),
+                np.asarray(jax.device_get(p_sh[k])), atol=2e-5)
+
+    def test_losses_decrease(self):
+        # overfit one fixed batch — convergence, not noise-chasing
+        _, _, _, losses = _train("zero", pt.optimizer.SGD(0.1), steps=30,
+                                 fixed_batch=True)
+        assert losses[-1] < losses[0] * 0.5, losses
+
+
+class TestZeroLayout:
+    def test_params_and_slots_actually_sharded(self):
+        tr, params, opt_state, _ = _train("reduce",
+                                          pt.optimizer.Adam(0.01), steps=2)
+        n = 8
+        for k, v in params.items():
+            shard = v.addressable_shards[0].data
+            assert shard.size == v.size // n, (k, v.sharding)
+        for k, slot in opt_state["slots"].items():
+            for sname, sv in slot.items():
+                shard = sv.addressable_shards[0].data
+                assert shard.size == sv.size // n, (k, sname, sv.sharding)
+
+    def test_opt_state_bytes_one_over_n(self):
+        """Per-device optimizer-state bytes ~= 1/N of replicated."""
+        _, _, st_rep, _ = _train(None, pt.optimizer.Adam(0.01), steps=1)
+        _, _, st_sh, _ = _train("reduce", pt.optimizer.Adam(0.01), steps=1)
+
+        def per_device_bytes(state):
+            total = 0
+            for leaf in jax.tree.leaves(state["slots"]):
+                total += (leaf.addressable_shards[0].data.size
+                          * leaf.dtype.itemsize)
+            return total
+
+        rep_b, sh_b = per_device_bytes(st_rep), per_device_bytes(st_sh)
+        assert sh_b * 8 == rep_b, (sh_b, rep_b)
+
+    def test_reduce_scatter_in_hlo(self):
+        """The compiled sharded step must reduce-scatter gradients
+        (reduce_op_handle.cc's role), not just all-reduce: assert the
+        collective appears in the optimized HLO, and that the
+        replicated run has none."""
+        mesh = make_mesh(MeshConfig(data=8))
+
+        def compiled_text(param_sharding):
+            tr = DataParallelTrainer(_loss_fn, pt.optimizer.SGD(0.1),
+                                     mesh=mesh,
+                                     param_sharding=param_sharding,
+                                     donate=False)
+            params, opt_state, state = tr.init(
+                _init_fn, jax.random.PRNGKey(0), _batch())
+            from paddle_tpu.parallel.data_parallel import shard_batch
+            batch = shard_batch(mesh, _batch())
+            return tr._step.lower(
+                params, opt_state, state, jax.random.PRNGKey(1),
+                batch).compile().as_text()
+
+        sharded = compiled_text("reduce")
+        assert "reduce-scatter" in sharded, \
+            "kReduce step compiled without a reduce-scatter"
+        replicated = compiled_text(None)
+        assert "reduce-scatter" not in replicated
