@@ -73,10 +73,29 @@ def _build_pure_fn(program, feed_names, fetch_names):
 
 def _program_hash(program):
     """Fingerprint of the frozen program: AOT index entries are valid
-    only for the exact graph they were compiled from."""
-    import pickle
+    only for the exact graph they were compiled from. Canonical
+    structural hash (static/serialize.py) — stable across
+    interpreter/numpy versions, unlike the r2 pickle-bytes hash whose
+    drift silently disabled the AOT fast path (ADVICE-r2)."""
+    from paddle_tpu.static.serialize import program_fingerprint
 
-    return hashlib.sha256(pickle.dumps(program)).hexdigest()[:16]
+    return program_fingerprint(program)[:16]
+
+
+_XLA_MAGIC = b"PTXLA1"
+
+
+def _aot_treedefs(n_params, n_feeds, n_out):
+    """Rebuild the jit call's (in_tree, out_tree) from leaf counts —
+    the fn signature is fn(params_tuple, feeds_tuple) -> outputs_tuple,
+    so the tree-defs are fully determined by the counts and never need
+    to be pickled into the artifact."""
+    import jax
+
+    in_tree = jax.tree.structure(
+        ((tuple(range(n_params)), tuple(range(n_feeds))), {}))
+    out_tree = jax.tree.structure(tuple(range(n_out)))
+    return in_tree, out_tree
 
 
 def _sig_of(feed_names, shaped):
@@ -147,10 +166,21 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
                  "program_hash": prog_hash,
                  "state_names": state_names, "num_devices": 1}
         payload, in_tree, out_tree = se.serialize(compiled)
-        import pickle
+        # the wrapper is a structural container (header + counts +
+        # payload), NOT a pickle: tree-defs are rebuilt from counts at
+        # load. The payload itself is jax's serialize_executable blob —
+        # deserializing it is jax's trust boundary (see Predictor docs).
+        expect_in, expect_out = _aot_treedefs(
+            len(param_sds), len(feed_sds), len(fetch_names))
+        enforce(expect_in == in_tree and expect_out == out_tree,
+                "AOT treedef layout drifted from (params, feeds) -> "
+                "outputs tuples; container format needs updating")
+        meta = json.dumps({"n_params": len(param_sds),
+                           "n_feeds": len(feed_sds),
+                           "n_out": len(fetch_names)}).encode("utf-8")
         with open(os.path.join(out_dir, f"{h}.xla"), "wb") as f:
-            pickle.dump({"payload": payload, "in_tree": in_tree,
-                         "out_tree": out_tree}, f)
+            f.write(_XLA_MAGIC + len(meta).to_bytes(4, "little")
+                    + meta + payload)
         entry["xla"] = f"{h}.xla"
         exported = jax.export.export(jitted,
                                      platforms=list(platforms))(
@@ -265,6 +295,15 @@ class Predictor:
     One XLA executable per input-shape signature, cached — the analog of
     AnalysisPredictor's prepared scope + NaiveExecutor, with compilation
     replacing per-op dispatch.
+
+    Trust boundary: the model dir's program (__model__, schema'd JSON)
+    and params (.npz) load without executing code. The optional AOT
+    fast-path artifacts are different: the portable ``.shlo`` file is
+    plain StableHLO, but the platform-native ``.xla`` payload is
+    deserialized by jax.experimental.serialize_executable, which
+    unpickles internally — load ``.xla`` artifacts only from model
+    directories you trust as much as the code itself (our wrapper
+    container is structural, the pickle is jax's own layer).
     """
 
     def __init__(self, config):
@@ -277,8 +316,9 @@ class Predictor:
             params_filename=config.params_file, scope=self._scope)
         # AOT index present? Only then hash the program AS SAVED
         # (before any local re-prune — the index was written against
-        # exactly that graph); hashing pickles the whole program, so
-        # skip it for the common artifact without AOT exports
+        # exactly that graph); the structural hash walks the whole
+        # program, so skip it for the common artifact without AOT
+        # exports
         self._aot_idx_path = os.path.join(
             config.model_dir or "", AOT_DIR, AOT_INDEX)
         loaded_hash = (_program_hash(prog)
@@ -352,14 +392,21 @@ class Predictor:
                 and entry["platform"] == jax.devices()[0].platform
                 and entry["jax_version"] == jax.__version__):
             try:
-                import pickle
-
                 from jax.experimental import serialize_executable as se
                 with open(os.path.join(aot_dir, entry["xla"]),
                           "rb") as f:
-                    blob = pickle.load(f)
+                    blob = f.read()
+                if not blob.startswith(_XLA_MAGIC):
+                    raise ValueError("bad .xla container magic")
+                off = len(_XLA_MAGIC)
+                hlen = int.from_bytes(blob[off:off + 4], "little")
+                meta = json.loads(
+                    blob[off + 4:off + 4 + hlen].decode("utf-8"))
+                payload = blob[off + 4 + hlen:]
+                in_tree, out_tree = _aot_treedefs(
+                    meta["n_params"], meta["n_feeds"], meta["n_out"])
                 fn = se.deserialize_and_load(
-                    blob["payload"], blob["in_tree"], blob["out_tree"],
+                    payload, in_tree, out_tree,
                     execution_devices=jax.devices()[
                         :entry.get("num_devices", 1)])
             except Exception:

@@ -6,8 +6,8 @@ load_inference_model:1109). Sharded/async checkpoint for SPMD training
 lives in paddle_tpu.io_checkpoint (orbax-style per-host shards).
 """
 
+import json
 import os
-import pickle
 
 import jax
 import numpy as np
@@ -28,21 +28,29 @@ __all__ = [
 
 def save_pytree(tree, path):
     """Save a params/state pytree (eager path checkpointing — the analog
-    of dygraph/checkpoint.py save_dygraph)."""
+    of dygraph/checkpoint.py save_dygraph). Format: one .npz with a
+    structural JSON manifest — no pickle (loading never executes code;
+    trees are dicts/lists/tuples of arrays or scalars)."""
+    from paddle_tpu.static.serialize import tree_manifest
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    leaves, treedef = jax.tree.flatten(tree)
-    with open(path, "wb") as f:
-        pickle.dump({"treedef": pickle.dumps(treedef),
-                     "leaves": [np.asarray(l) for l in leaves]}, f)
+    manifest, arrays = tree_manifest(tree)
+    mblob = np.frombuffer(json.dumps(manifest).encode("utf-8"),
+                          dtype=np.uint8)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, __manifest__=mblob,
+             **{k: np.asarray(v) for k, v in arrays.items()})
+    os.replace(tmp, path)
 
 
 def load_pytree(path):
     import jax.numpy as jnp
-    with open(path, "rb") as f:
-        blob = pickle.load(f)
-    treedef = pickle.loads(blob["treedef"])
-    return jax.tree.unflatten(treedef, [jnp.asarray(l)
-                                        for l in blob["leaves"]])
+    from paddle_tpu.static.serialize import tree_from_manifest
+    with np.load(path, allow_pickle=False) as blob:
+        manifest = json.loads(
+            bytes(blob["__manifest__"].tobytes()).decode("utf-8"))
+        arrays = {k: jnp.asarray(blob[k]) for k in blob.files
+                  if k != "__manifest__"}
+    return tree_from_manifest(manifest, arrays)
 
 
 # dygraph/checkpoint.py name parity (save_dygraph/load_dygraph)

@@ -13,13 +13,15 @@ the TPU build's recovery story is "checkpoint often, restart anywhere"
 - `auto_checkpoint`: wrap a training loop body so any crash/preemption
   resumes from the last completed interval.
 
-Checkpoint payloads are pytrees (params, optimizer state, data-position
-counters — anything jax.tree can flatten).
+Checkpoint payloads are pytrees of dicts/lists/tuples with array or
+scalar leaves (params, optimizer state, data-position counters). Shards
+are single .npz files carrying a structural JSON manifest — zero pickle
+anywhere (VERDICT-r2 Weak #7: a checkpoint must never be arbitrary code
+execution; ref save_combine_op.cc writes raw tensors the same way).
 """
 
 import json
 import os
-import pickle
 import queue
 import threading
 import time
@@ -28,6 +30,7 @@ import jax
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.static.serialize import tree_from_manifest, tree_manifest
 
 __all__ = ["CheckpointManager", "auto_checkpoint"]
 
@@ -72,7 +75,7 @@ class CheckpointManager:
     # -- paths -------------------------------------------------------------
     def _shard_path(self, step, proc=None):
         p = self._proc if proc is None else proc
-        return os.path.join(self.dirname, f"ckpt_{step}.shard{p}.pkl")
+        return os.path.join(self.dirname, f"ckpt_{step}.shard{p}.npz")
 
     def _meta_path(self, step):
         return os.path.join(self.dirname, f"ckpt_{step}.json")
@@ -88,9 +91,9 @@ class CheckpointManager:
     def save(self, step, tree):
         """Snapshot now (device→host), write later. Returns immediately
         when async."""
-        leaves, treedef = jax.tree.flatten(tree)
-        host_leaves = [np.asarray(l) for l in leaves]   # sync d2h copy
-        payload = (int(step), pickle.dumps(treedef), host_leaves)
+        manifest, arrays = tree_manifest(tree)
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}  # d2h copy
+        payload = (int(step), manifest, arrays)
         self._last_save_time = time.monotonic()
         if self._thread is None:
             self._write(payload)
@@ -105,12 +108,14 @@ class CheckpointManager:
         return False
 
     def _write(self, payload):
-        step, treedef_blob, host_leaves = payload
+        step, manifest, arrays = payload
         shard = self._shard_path(step)
-        tmp = shard + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump({"treedef": treedef_blob, "leaves": host_leaves,
-                         "proc": self._proc, "nproc": self._nproc}, f)
+        tmp = shard + ".tmp.npz"
+        manifest = dict(manifest,
+                        proc=self._proc, nproc=self._nproc)
+        mblob = np.frombuffer(
+            json.dumps(manifest).encode("utf-8"), dtype=np.uint8)
+        np.savez(tmp, __manifest__=mblob, **arrays)
         os.replace(tmp, shard)                    # atomic publish
         # host 0 publishes the meta marker only after EVERY host's shard
         # is durable (restore trusts only steps whose meta exists, so a
@@ -207,11 +212,12 @@ class CheckpointManager:
             # replicated (single-host) checkpoint restored on a larger
             # topology: every host reads the one shard
             path = self._shard_path(step, 0)
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
-        treedef = pickle.loads(blob["treedef"])
-        tree = jax.tree.unflatten(
-            treedef, [jnp.asarray(l) for l in blob["leaves"]])
+        with np.load(path, allow_pickle=False) as blob:
+            manifest = json.loads(
+                bytes(blob["__manifest__"].tobytes()).decode("utf-8"))
+            arrays = {k: jnp.asarray(blob[k]) for k in blob.files
+                      if k != "__manifest__"}
+        tree = tree_from_manifest(manifest, arrays)
         return tree, step
 
     def close(self):

@@ -346,6 +346,28 @@ for _n in dir(_ops):
 # sequence_mask needs maxlen attr; expose directly (works both modes)
 sequence_mask = _dual("sequence_mask", _ops.sequence_mask)
 
+
+# control flow with callable bodies: the auto-wrap treats every
+# positional arg as a tensor, so these get explicit duals. In static
+# mode the bodies are traced into serializable sub-programs
+# (static/nested.py, ref while_op.cc / recurrent_op.cc sub-blocks);
+# eager mode lowers straight to lax.while_loop / lax.scan.
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    lv = loop_vars if isinstance(loop_vars, (list, tuple)) else [loop_vars]
+    if in_static_mode() and _has_variable(list(lv)):
+        from paddle_tpu.static.nested import static_while_loop
+        return static_while_loop(cond, body, loop_vars)
+    return _ops.while_loop(cond, body, loop_vars)
+
+
+def static_rnn(step_fn, inputs, initial_state):
+    if in_static_mode() and _has_variable(
+            list(inputs if isinstance(inputs, (list, tuple))
+                 else [inputs])):
+        from paddle_tpu.static.nested import static_rnn_block
+        return static_rnn_block(step_fn, inputs, initial_state)
+    return _ops.static_rnn(step_fn, inputs, initial_state)
+
 # host/list detection ops: eager-only passthroughs
 rpn_target_assign = _ops.rpn_target_assign
 generate_proposal_labels = _ops.generate_proposal_labels

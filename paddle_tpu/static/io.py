@@ -6,12 +6,13 @@ load_inference_model:1109) and the save/load ops
 (operators/save_op.cc, load_op.cc, save_combine_op.cc).
 
 Format: params in a single .npz (the reference's save_combine "one file"
-form); program IR pickled (the reference serializes ProgramDesc proto —
-our IR is plain data: op type/slots/attrs).
+form); program IR as a schema'd JSON document (static/serialize.py —
+the analog of the reference's ProgramDesc proto,
+framework/framework.proto:184: loading a model never executes code;
+pickle is banned from model artifacts, VERDICT-r2 Weak #7).
 """
 
 import os
-import pickle
 
 import numpy as np
 
@@ -100,6 +101,12 @@ def _prune(program, feed_names, fetch_names):
         new.inputs = {k: list(v) for k, v in op.inputs.items()}
         new.outputs = {k: list(v) for k, v in op.outputs.items()}
         pb.ops.append(new)
+    # carry the referenced program literals (fill_constant et al. record
+    # concrete values in _constants; kept ops still read them by name)
+    consts = getattr(program, "_constants", None)
+    if consts:
+        pruned._constants = {n: v for n, v in consts.items()
+                             if n in needed}
     pruned._bump()
     return pruned
 
@@ -117,14 +124,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     fetch_names = [t if isinstance(t, str) else t.name for t in target_vars]
     inference_program = _prune(main_program.clone(for_test=True),
                                feeded_var_names, fetch_names)
-    meta = {
+    from paddle_tpu.static.serialize import dumps_program
+    text = dumps_program(inference_program, extra={
         "feed_names": list(feeded_var_names),
         "fetch_names": fetch_names,
-        "program": inference_program,
-    }
+    })
     with open(os.path.join(dirname, model_filename or PROGRAM_FILE),
-              "wb") as f:
-        pickle.dump(meta, f)
+              "w") as f:
+        f.write(text)
     vals = _collect(inference_program, global_scope(),
                     lambda v: v.persistable)
     np.savez(os.path.join(dirname, params_filename or PARAMS_FILE), **vals)
@@ -138,13 +145,13 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None, scope=None):
+    from paddle_tpu.static.serialize import loads_program
     with open(os.path.join(dirname, model_filename or PROGRAM_FILE),
-              "rb") as f:
-        meta = pickle.load(f)
+              "r") as f:
+        program, doc = loads_program(f.read())
     _load_npz(os.path.join(dirname, params_filename or PARAMS_FILE),
               scope if scope is not None else global_scope())
-    program = meta["program"]
-    return program, meta["feed_names"], meta["fetch_names"]
+    return program, doc["feed_names"], doc["fetch_names"]
 
 
 # ---------------------------------------------------------------------------
