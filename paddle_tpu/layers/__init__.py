@@ -89,6 +89,7 @@ _NARGS = {
     "multiclass_nms": 2, "detection_output": 4, "ssd_loss": 5,
     "yolo_box": 2, "yolov3_loss": 3, "box_clip": 2,
     "sigmoid_focal_loss": 3, "roi_align": 2, "roi_pool": 2,
+    "roi_perspective_transform": 2,
     "psroi_pool": 2, "generate_proposals": 5, "box_decoder_and_assign": 4,
 }
 
@@ -114,7 +115,8 @@ _MULTI_OUT = {"topk": 2, "argsort": 2, "ctc_align": 2, "edit_distance": 2,
               "prior_box": 2,
               "density_prior_box": 2, "anchor_generator": 2,
               "bipartite_match": 2, "yolo_box": 2, "target_assign": 2,
-              "generate_proposals": 3}
+              "generate_proposals": 3,
+              "roi_perspective_transform": 3}
 
 
 def _bind_tensor_params(tparams, xs):
@@ -333,6 +335,7 @@ _EXCLUDE = {"fc_act", "batch_norm", "sequence_mask",
             "rpn_target_assign", "generate_proposal_labels",
             "detection_map", "distribute_fpn_proposals",
             "collect_fpn_proposals", "retinanet_detection_output",
+            "retinanet_target_assign", "generate_mask_labels",
             # host/list ops from ops.aliases: no static wrapper either
             "delete_var", "alloc_continuous_space"}
 _this = globals()
@@ -375,6 +378,8 @@ detection_map = _ops.detection_map
 distribute_fpn_proposals = _ops.distribute_fpn_proposals
 collect_fpn_proposals = _ops.collect_fpn_proposals
 retinanet_detection_output = _ops.retinanet_detection_output
+retinanet_target_assign = _ops.retinanet_target_assign
+generate_mask_labels = _ops.generate_mask_labels
 delete_var = _ops.delete_var
 alloc_continuous_space = _ops.alloc_continuous_space
 
@@ -814,3 +819,93 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         return outs if isinstance(out, (list, tuple)) else outs[0]
     res = _py_func_compute({"X": list(xs)}, {"func": func})["Out"]
     return res if isinstance(out, (list, tuple)) else res[0]
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multi-box head (ref python/paddle/fluid/layers/detection.py:1737):
+    a composite over prior_box + conv2d + transpose/flatten/concat —
+    per feature map, priors are generated and two convs predict
+    locations (P*4 channels) and confidences (P*num_classes channels);
+    everything concatenates across maps. Works in both modes like every
+    other layer (the convs create parameters).
+
+    Returns (mbox_locs [N, B, 4], mbox_confs [N, B, num_classes],
+    boxes [B, 4], variances [B, 4]) with B = total prior count.
+    """
+    import math as _math
+    if not isinstance(inputs, (list, tuple)):
+        raise EnforceNotMet("inputs should be a list or tuple")
+    num_layer = len(inputs)
+    if num_layer <= 2:
+        if min_sizes is None or max_sizes is None or \
+                len(min_sizes) != num_layer or len(max_sizes) != num_layer:
+            raise EnforceNotMet(
+                "with <=2 input layers, min_sizes/max_sizes must be "
+                "given per layer")
+    elif min_sizes is None and max_sizes is None:
+        min_sizes, max_sizes = [], []
+        step = int(_math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in _builtin_range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+    if steps:
+        step_w = step_h = steps
+
+    mbox_locs, mbox_confs, box_results, var_results = [], [], [], []
+    for i, inp in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i]
+        if not isinstance(min_size, (list, tuple)):
+            min_size = [min_size]
+        if not isinstance(max_size, (list, tuple)):
+            max_size = [max_size]
+        ar = aspect_ratios[i] if aspect_ratios is not None else []
+        if not isinstance(ar, (list, tuple)):
+            ar = [ar]
+        step = (step_w[i] if step_w else 0.0,
+                step_h[i] if step_h else 0.0)
+        box, var = prior_box(inp, image, list(min_size), list(max_size),
+                             list(ar), list(variance), flip, clip,
+                             step, offset,
+                             min_max_aspect_ratios_order)
+        box_results.append(box)
+        var_results.append(var)
+        num_boxes = box.shape[2]           # priors per cell
+
+        # explicit per-map param names: repeated bare conv2d calls in
+        # one scope would otherwise share a single parameter
+        tag = name or "multi_box_head"
+        loc = conv2d(inp, num_boxes * 4, kernel_size, stride=stride,
+                     padding=pad,
+                     param_attr=ParamAttr(name=f"{tag}_loc{i}_w"),
+                     bias_attr=ParamAttr(name=f"{tag}_loc{i}_b"))
+        loc = transpose(loc, perm=[0, 2, 3, 1])
+        mbox_locs.append(flatten(loc, axis=1))
+        conf = conv2d(inp, num_boxes * num_classes, kernel_size,
+                      stride=stride, padding=pad,
+                      param_attr=ParamAttr(name=f"{tag}_conf{i}_w"),
+                      bias_attr=ParamAttr(name=f"{tag}_conf{i}_b"))
+        conf = transpose(conf, perm=[0, 2, 3, 1])
+        mbox_confs.append(flatten(conf, axis=1))
+
+    if len(box_results) == 1:
+        box, var = box_results[0], var_results[0]
+        locs_concat = mbox_locs[0]
+        confs_concat = mbox_confs[0]
+    else:
+        box = concat([flatten(b, axis=3) for b in box_results])
+        var = concat([flatten(v, axis=3) for v in var_results])
+        locs_concat = concat(mbox_locs, axis=1)
+        confs_concat = concat(mbox_confs, axis=1)
+    box = reshape(box, shape=[-1, 4])
+    var = reshape(var, shape=[-1, 4])
+    locs_concat = reshape(locs_concat, shape=[0, -1, 4])
+    confs_concat = reshape(confs_concat, shape=[0, -1, num_classes])
+    return locs_concat, confs_concat, box, var

@@ -14,7 +14,9 @@ Sequence inputs are `RaggedBatch` (data [B, T, ...], lengths [B]) or a
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.lod import RaggedBatch, sequence_mask
 
 __all__ = [
@@ -22,6 +24,7 @@ __all__ = [
     "sequence_pad", "sequence_unpad", "sequence_concat", "sequence_reverse",
     "sequence_first_step", "sequence_last_step", "sequence_slice",
     "sequence_scatter", "sequence_expand_as", "sequence_conv",
+    "sequence_reshape", "sequence_enumerate", "sequence_erase",
 ]
 
 
@@ -212,3 +215,79 @@ def sequence_conv(input, filter, context_length, context_start=None,
     if lengths is not None:
         return RaggedBatch(out * _mask(out, lengths), lengths)
     return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    """sequence_reshape_op parity (ref
+    sequence_ops/sequence_reshape_op.cc): re-chunk each sequence's
+    flattened (length_i * M) elements into rows of ``new_dim``. Ragged
+    form: data [B, T, M] -> [B, T*M/new_dim, new_dim] with
+    lengths' = lengths * M / new_dim — valid because each row's payload
+    is a row-major prefix of the flattened [T*M] buffer, so the reshape
+    moves padding only at the tail. Requires (T*M) % new_dim == 0
+    statically; each length_i * M must be divisible by new_dim for
+    exact parity (the reference enforces it at runtime).
+    """
+    data, lengths = _unpack(input)
+    enforce(data.ndim == 3,
+            "sequence_reshape expects ragged [B, T, M] input")
+    b, t, m = data.shape
+    nd = int(new_dim)
+    # the reference enforces (length_i * M) % new_dim == 0 per sequence
+    # at runtime; do the same whenever lengths are concrete (trace-time
+    # lengths can't raise — indivisible payloads would silently
+    # truncate, so refuse only what we can see)
+    if not isinstance(lengths, jax.core.Tracer):
+        ln = np.asarray(lengths)
+        bad = ln[(ln * m) % nd != 0]
+        enforce(bad.size == 0,
+                f"sequence payloads {bad.tolist()[:4]} * M={m} not "
+                f"divisible by new_dim={nd} "
+                f"(sequence_reshape_op.cc contract)")
+    # PADDED T*M need not divide new_dim: pad the flat buffer so the
+    # reshape always exists; valid payloads are row-major prefixes, so
+    # only tail padding moves
+    total = t * m
+    pad = (-total) % nd
+    flat = data.reshape(b, total)
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    out = flat.reshape(b, (total + pad) // nd, nd)
+    new_len = (lengths * m) // nd
+    return RaggedBatch(out, new_len.astype(jnp.int32))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """sequence_enumerate_op parity: every position emits the window of
+    ``win_size`` consecutive token ids starting there; positions past a
+    sequence's end (including window overhang) read ``pad_value``.
+    Ragged [B, T] int -> RaggedBatch([B, T, win_size], lengths)."""
+    data, lengths = _unpack(input)
+    enforce(data.ndim == 2, "sequence_enumerate expects ragged [B, T]")
+    b, t = data.shape
+    idx = jnp.arange(t)[:, None] + jnp.arange(int(win_size))[None, :]
+    gathered = jnp.take(data, jnp.minimum(idx, t - 1), axis=1)  # [B,T,W]
+    valid = idx[None] < lengths[:, None, None]                  # [B,T,W]
+    out = jnp.where(valid, gathered, pad_value).astype(data.dtype)
+    return RaggedBatch(out, lengths)
+
+
+def sequence_erase(input, tokens, name=None):
+    """sequence_erase_op parity: delete every occurrence of ``tokens``
+    from each sequence, compacting survivors to the front (padding keeps
+    the dense [B, T] shape; lengths shrink). TPU-first: the compaction
+    is a stable argsort on the keep mask — no dynamic shapes."""
+    data, lengths = _unpack(input)
+    enforce(data.ndim == 2, "sequence_erase expects ragged [B, T]")
+    b, t = data.shape
+    toks = jnp.asarray(list(tokens), data.dtype).reshape(-1)
+    in_range = jnp.arange(t)[None, :] < lengths[:, None]
+    erase = jnp.any(data[:, :, None] == toks[None, None, :], axis=-1)
+    keep = in_range & ~erase
+    # stable order: kept tokens (0) before dropped/padding (1)
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    out = jnp.take_along_axis(data, order, axis=1)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    mask = jnp.arange(t)[None, :] < new_len[:, None]
+    return RaggedBatch(jnp.where(mask, out, 0).astype(data.dtype),
+                       new_len)

@@ -73,7 +73,12 @@ def unsqueeze(input, axes, name=None):
 
 
 def reshape(x, shape, inplace=False, name=None):
-    return jnp.reshape(jnp.asarray(x), shape)
+    """reshape_op.cc parity incl. the 0-entry rule: a 0 in ``shape``
+    copies the input's dim at that position (-1 infers as usual)."""
+    x = jnp.asarray(x)
+    shape = [x.shape[i] if s == 0 else s
+             for i, s in enumerate(shape)]
+    return jnp.reshape(x, shape)
 
 
 def flatten(x, axis=1, name=None):

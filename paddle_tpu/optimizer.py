@@ -37,8 +37,9 @@ __all__ = [
     "Adagrad", "AdagradOptimizer", "Adam", "AdamOptimizer", "Adamax",
     "AdamaxOptimizer", "DecayedAdagrad", "DecayedAdagradOptimizer",
     "Adadelta", "AdadeltaOptimizer", "RMSProp", "RMSPropOptimizer", "Ftrl",
-    "FtrlOptimizer", "Lamb", "LambOptimizer", "ModelAverage",
-    "ExponentialMovingAverage",
+    "FtrlOptimizer", "Lamb", "LambOptimizer", "ProximalGD",
+    "ProximalGDOptimizer", "ProximalAdagrad", "ProximalAdagradOptimizer",
+    "ModelAverage", "ExponentialMovingAverage",
 ]
 
 
@@ -402,6 +403,35 @@ class FtrlOptimizer(Optimizer):
         return new_p, {"squared": new_sq, "linear": new_lin}
 
 
+class ProximalGDOptimizer(Optimizer):
+    """proximal_gd_op.cc: forward-backward splitting —
+    prox_param = p - lr*g; p = sign(prox)*max(|prox| - lr*l1, 0)
+    / (1 + lr*l2)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2 = l1, l2
+
+    def _prox(self, prox, lr):
+        return (jnp.sign(prox)
+                * jnp.maximum(jnp.abs(prox) - lr * self.l1, 0.0)
+                / (1.0 + lr * self.l2))
+
+    def _update(self, p, g, slots, lr, t):
+        return self._prox(p - lr * g, lr), slots
+
+
+class ProximalAdagradOptimizer(ProximalGDOptimizer):
+    """proximal_adagrad_op.cc: adagrad-scaled proximal step —
+    m += g^2; prox = p - lr*g/sqrt(m); then the l1/l2 shrink."""
+    _slot_defaults = {"moment": 0.0}
+
+    def _update(self, p, g, slots, lr, t):
+        m = slots["moment"] + jnp.square(g)
+        prox = p - lr * g / jnp.sqrt(jnp.maximum(m, 1e-12))
+        return self._prox(prox, lr), {"moment": m}
+
+
 class LambOptimizer(Optimizer):
     """lamb_op.cc: layer-adaptive Adam with weight decay."""
     _slot_defaults = {"moment1": 0.0, "moment2": 0.0}
@@ -486,3 +516,5 @@ Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

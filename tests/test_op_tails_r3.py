@@ -1,0 +1,265 @@
+"""r3 tail ops (VERDICT-r2 Missing #3/#5/#6): detection tail, sequence
+tail, proximal optimizers — numeric checks against hand-computed or
+reference-formula expectations.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.core.lod import RaggedBatch
+from paddle_tpu.ops import detection as D
+from paddle_tpu.ops import sequence as S
+
+
+class TestSequenceTail:
+    def test_sequence_reshape(self):
+        # ref sequence_reshape_op.cc doc example: one sequence [4, 2],
+        # new_dim 4 -> [2, 4]
+        rb = RaggedBatch(
+            jnp.arange(8, dtype=jnp.float32).reshape(1, 4, 2),
+            jnp.asarray([4], jnp.int32))
+        out = S.sequence_reshape(rb, 4)
+        assert out.data.shape == (1, 2, 4)
+        np.testing.assert_array_equal(np.asarray(out.lengths), [2])
+        np.testing.assert_allclose(
+            np.asarray(out.data[0]),
+            np.arange(8, dtype=np.float32).reshape(2, 4))
+
+    def test_sequence_enumerate(self):
+        rb = RaggedBatch(jnp.asarray([[1, 2, 3, 0]], jnp.int32),
+                         jnp.asarray([3], jnp.int32))
+        out = S.sequence_enumerate(rb, 2, pad_value=0)
+        np.testing.assert_array_equal(
+            np.asarray(out.data[0]),
+            [[1, 2], [2, 3], [3, 0], [0, 0]])
+
+    def test_sequence_erase(self):
+        rb = RaggedBatch(jnp.asarray([[2, 2, 6, 1, 3, 9, 6, 1],
+                                      [1, 0, 2, 8, 0, 0, 0, 0]],
+                                     jnp.int32),
+                         jnp.asarray([8, 4], jnp.int32))
+        out = S.sequence_erase(rb, [2, 3, 5])
+        # ref doc: erase {2,3,5} from [2,2,6,1,3,9,6,1] -> [6,1,9,6,1]
+        np.testing.assert_array_equal(np.asarray(out.lengths), [5, 3])
+        np.testing.assert_array_equal(np.asarray(out.data[0][:5]),
+                                      [6, 1, 9, 6, 1])
+        np.testing.assert_array_equal(np.asarray(out.data[1][:3]),
+                                      [1, 0, 8])
+
+
+class TestProximalOptimizers:
+    def test_proximal_gd_rule(self):
+        # reference formula: prox = p - lr*g;
+        # p' = sign(prox)*max(|prox| - lr*l1, 0) / (1 + lr*l2)
+        opt = pt.optimizer.ProximalGD(0.1, l1=0.2, l2=0.5)
+        p = jnp.asarray([1.0, -1.0, 0.015])
+        g = jnp.asarray([0.5, -0.5, 0.1])
+        new_p, _ = opt.step(p, g)
+        prox = np.array([0.95, -0.95, 0.005])
+        want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.2, 0) \
+            / (1 + 0.1 * 0.5)
+        np.testing.assert_allclose(np.asarray(new_p), want, rtol=1e-5)
+        assert float(new_p[2]) == 0.0     # l1 shrinkage zeroes small prox
+
+    def test_proximal_adagrad_rule(self):
+        opt = pt.optimizer.ProximalAdagrad(0.1, l1=0.0, l2=0.0)
+        p = jnp.asarray([1.0, 2.0])
+        g = jnp.asarray([0.5, -1.0])
+        new_p, st = opt.step(p, g)
+        m = np.array([0.25, 1.0])
+        want = np.asarray(p) - 0.1 * np.asarray(g) / np.sqrt(m)
+        np.testing.assert_allclose(np.asarray(new_p), want, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(st["slots"]["moment"]), m, rtol=1e-6)
+
+    def test_proximal_converges(self):
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(4).astype(np.float32)
+        X = rng.randn(64, 4).astype(np.float32)
+        y = X @ w_true
+
+        def loss(w):
+            return jnp.mean((X @ w - y) ** 2)
+
+        for opt in (pt.optimizer.ProximalGD(0.05, l1=1e-4),
+                    pt.optimizer.ProximalAdagrad(0.5, l1=1e-4)):
+            w = jnp.zeros(4)
+            st = None
+            for _ in range(200):
+                g = jax.grad(loss)(w)
+                w, st = opt.step(w, g, st)
+            assert float(loss(w)) < 0.05, type(opt).__name__
+
+
+class TestRetinanetTargetAssign:
+    def test_assignment_rules(self):
+        anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                            [100, 100, 110, 110]], np.float32)
+        gts = np.array([[1, 1, 9, 9], [21, 21, 29, 29]], np.float32)
+        glab = np.array([3, 7], np.int32)
+        scores, loc, tlab, tbox, inw, fg_num = D.retinanet_target_assign(
+            np.zeros((1, 3, 4), np.float32),
+            np.zeros((1, 3, 9), np.float32),
+            anchors, None, gts, glab, None,
+            np.array([200, 200, 1.0]), num_classes=9)
+        assert int(fg_num[0]) == 2
+        # anchors 0/1 are fg with their gt's class; anchor 2 is bg
+        assert sorted(tlab.ravel().tolist()) == [0, 3, 7]
+        assert inw.shape == (2, 4) and np.all(inw == 1.0)
+
+    def test_fake_foreground(self):
+        anchors = np.array([[0, 0, 1, 1]], np.float32)
+        scores, loc, tlab, tbox, inw, fg_num = D.retinanet_target_assign(
+            np.zeros((1, 1, 4), np.float32),
+            np.zeros((1, 1, 2), np.float32),
+            anchors, None, np.zeros((0, 4), np.float32),
+            np.zeros((0,), np.int32), None,
+            np.array([10, 10, 1.0]), num_classes=2)
+        assert int(fg_num[0]) == 1
+        assert np.all(inw == 0.0)         # fake fg contributes no loc loss
+
+
+class TestRoiPerspectiveTransform:
+    def test_axis_aligned_identity_like(self):
+        """An axis-aligned square ROI must behave like crop+resample:
+        output corners hit the quad corners (homography maps the
+        output grid onto the quad)."""
+        x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+        rois = np.array([[1, 1, 6, 1, 6, 6, 1, 6]], np.float32)
+        out, mask, mats = D.roi_perspective_transform(x, rois, 6, 6, 1.0)
+        assert out.shape == (1, 1, 6, 6)
+        assert mats.shape == (1, 9)
+        # top-left output pixel samples (1,1) = 9.0
+        np.testing.assert_allclose(float(out[0, 0, 0, 0]),
+                                   x[0, 0, 1, 1], rtol=1e-5)
+        # interior is valid, mask is 1 there
+        assert int(mask[0, 0, 2, 2]) == 1
+
+    def test_gradients_flow(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 2, 8, 8),
+                        jnp.float32)
+        rois = jnp.asarray([[1, 1, 6, 1, 6, 6, 1, 6]], jnp.float32)
+
+        def f(x):
+            out, _, _ = D.roi_perspective_transform(x, rois, 4, 4)
+            return jnp.sum(out ** 2)
+
+        g = jax.grad(f)(x)
+        assert float(jnp.abs(g).max()) > 0
+
+
+class TestGenerateMaskLabels:
+    def test_full_roi_polygon(self):
+        segs = [[[2, 2, 8, 2, 8, 8, 2, 8]]]   # square covering the roi
+        rois = np.array([[2, 2, 8, 8]], np.float32)
+        mr, has_mask, mi = D.generate_mask_labels(
+            np.array([10, 10, 1.0]), np.array([1]), np.array([0]),
+            segs, rois, np.array([1]), num_classes=2, resolution=4)
+        assert mr.shape == (1, 4)
+        assert has_mask.ravel().tolist() == [0]
+        m = mi.reshape(1, 2, 4, 4)
+        assert np.all(m[0, 0] == -1)          # non-target class ignored
+        assert np.all(m[0, 1] == 1)           # target class fully inside
+
+    def test_half_covered_roi(self):
+        segs = [[[0, 0, 4, 0, 4, 8, 0, 8]]]   # left half of the roi
+        rois = np.array([[0, 0, 8, 8]], np.float32)
+        _, _, mi = D.generate_mask_labels(
+            np.array([8, 8, 1.0]), np.array([2]), None, segs, rois,
+            np.array([2]), num_classes=3, resolution=8)
+        m = mi.reshape(1, 3, 8, 8)[0, 2]
+        assert np.all(m[:, :4] == 1) and np.all(m[:, 4:] == 0)
+
+    def test_no_foreground(self):
+        mr, has_mask, mi = D.generate_mask_labels(
+            np.array([8, 8, 1.0]), np.array([1]), None,
+            [[[0, 0, 4, 0, 4, 4]]], np.array([[0, 0, 4, 4]], np.float32),
+            np.array([0]), num_classes=2, resolution=4)
+        assert np.all(mi == -1)               # ignore-only mask
+
+
+class TestMineHardExamples:
+    def test_neg_pos_ratio(self):
+        loss = np.array([[0.9, 0.8, 0.7, 0.6, 0.5]], np.float32)
+        mi = np.array([[2, -1, -1, -1, -1]])
+        dist = np.full((1, 5), 0.1, np.float32)
+        neg, out_mi = D.mine_hard_examples(loss, None, mi, dist,
+                                           neg_pos_ratio=2.0)
+        # 1 positive -> 2 negatives, the highest-loss unmatched ones
+        np.testing.assert_array_equal(np.asarray(neg),
+                                      [[0, 1, 1, 0, 0]])
+        np.testing.assert_array_equal(np.asarray(out_mi),
+                                      [[2, -1, -1, -1, -1]])
+
+
+class TestMultiBoxHead:
+    def test_eager_shapes(self):
+        from paddle_tpu import layers, nn
+
+        class Head(nn.Layer):
+            def forward(self, feats, image):
+                return layers.multi_box_head(
+                    feats, image, base_size=32, num_classes=4,
+                    aspect_ratios=[[2.0], [2.0]],
+                    min_sizes=[8.0, 16.0], max_sizes=[16.0, 32.0],
+                    flip=True, offset=0.5)
+
+        m = Head()
+        feats = [jnp.ones((2, 3, 8, 8)), jnp.ones((2, 3, 4, 4))]
+        image = jnp.ones((2, 3, 32, 32))
+        params, state = m.init(jax.random.PRNGKey(0), feats, image)
+        (locs, confs, box, var), _ = m.apply(
+            params, state, jax.random.PRNGKey(1), feats, image)
+        b = box.shape[0]
+        assert box.shape == (b, 4) and var.shape == (b, 4)
+        assert locs.shape == (2, b, 4)
+        assert confs.shape == (2, b, 4)
+        # priors per cell: 1 min + 1 max + 2 flipped ratios = 4
+        assert b == 8 * 8 * 4 + 4 * 4 * 4
+
+
+class TestReviewRegressions:
+    def test_sequence_reshape_rejects_indivisible_payload(self):
+        from paddle_tpu.core.enforce import EnforceNotMet
+        rb = RaggedBatch(jnp.zeros((1, 2, 2), jnp.float32),
+                         jnp.asarray([1], jnp.int32))     # payload 2
+        with pytest.raises(EnforceNotMet, match="divisible"):
+            S.sequence_reshape(rb, 4)
+
+    def test_sequence_reshape_padded_t_not_divisible_ok(self):
+        # payload (2*2=4) divides new_dim, padded T*M (3*2=6) does not —
+        # must still work (the old static check wrongly rejected this)
+        rb = RaggedBatch(
+            jnp.arange(6, dtype=jnp.float32).reshape(1, 3, 2),
+            jnp.asarray([2], jnp.int32))
+        out = S.sequence_reshape(rb, 4)
+        np.testing.assert_array_equal(np.asarray(out.lengths), [1])
+        np.testing.assert_allclose(np.asarray(out.data[0, 0]),
+                                   [0, 1, 2, 3])
+
+    def test_mine_hard_example_mode_ignores_pos_count(self):
+        loss = np.array([[0.9, 0.8, 0.7, 0.6, 0.5]], np.float32)
+        mi = np.array([[2, -1, -1, -1, -1]])
+        dist = np.full((1, 5), 0.1, np.float32)
+        neg, _ = D.mine_hard_examples(
+            loss, loss, mi, dist, neg_pos_ratio=3.0, sample_size=4,
+            mining_type="hard_example")
+        # hard_example: min(sample_size=4, candidates=4), not 3*num_pos
+        assert int(np.asarray(neg).sum()) == 4
+
+    def test_retinanet_no_gt_no_double_count(self):
+        anchors = np.array([[0, 0, 1, 1], [5, 5, 6, 6]], np.float32)
+        scores, loc, tlab, tbox, inw, fg_num = D.retinanet_target_assign(
+            np.zeros((1, 2, 4), np.float32),
+            np.zeros((1, 2, 3), np.float32),
+            anchors, None, np.zeros((0, 4), np.float32),
+            np.zeros((0,), np.int32), None,
+            np.array([10, 10, 1.0]), num_classes=3)
+        # every anchor is bg exactly once in the score rows; the fake fg
+        # pads only the location rows
+        assert scores.shape[0] == 2 and tlab.shape[0] == 2
+        assert loc.shape[0] == 1 and int(fg_num[0]) == 1
