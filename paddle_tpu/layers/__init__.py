@@ -89,7 +89,7 @@ _NARGS = {
     "multiclass_nms": 2, "detection_output": 4, "ssd_loss": 5,
     "yolo_box": 2, "yolov3_loss": 3, "box_clip": 2,
     "sigmoid_focal_loss": 3, "roi_align": 2, "roi_pool": 2,
-    "roi_perspective_transform": 2,
+    "roi_perspective_transform": 2, "mine_hard_examples": 4,
     "psroi_pool": 2, "generate_proposals": 5, "box_decoder_and_assign": 4,
 }
 
@@ -116,7 +116,8 @@ _MULTI_OUT = {"topk": 2, "argsort": 2, "ctc_align": 2, "edit_distance": 2,
               "density_prior_box": 2, "anchor_generator": 2,
               "bipartite_match": 2, "yolo_box": 2, "target_assign": 2,
               "generate_proposals": 3,
-              "roi_perspective_transform": 3}
+              "roi_perspective_transform": 3,
+              "mine_hard_examples": 2}
 
 
 def _bind_tensor_params(tparams, xs):
@@ -858,6 +859,32 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     if steps:
         step_w = step_h = steps
 
+    # uniqueness of default param names across multiple heads: in the
+    # eager module context the FRAME scope uniquifies deterministically
+    # (resets every init/apply, so names line up between the two); in
+    # static mode the program-level unique_name counter does it
+    if _module.in_module_ctx():
+        _mbh_scope = _module._frame().scope("multi_box_head")
+        _mbh_tag = "mbh"
+    else:
+        import contextlib as _ctxlib
+        _mbh_scope = _ctxlib.nullcontext()
+        _mbh_tag = name or unique_name.generate("multi_box_head")
+    _mbh_scope.__enter__()
+    try:
+        return _multi_box_head_body(
+            inputs, image, num_classes, aspect_ratios, min_sizes,
+            max_sizes, step_w, step_h, offset, variance, flip, clip,
+            kernel_size, pad, stride, min_max_aspect_ratios_order,
+            name, _mbh_tag)
+    finally:
+        _mbh_scope.__exit__(None, None, None)
+
+
+def _multi_box_head_body(inputs, image, num_classes, aspect_ratios,
+                         min_sizes, max_sizes, step_w, step_h, offset,
+                         variance, flip, clip, kernel_size, pad, stride,
+                         min_max_aspect_ratios_order, name, _mbh_tag):
     mbox_locs, mbox_confs, box_results, var_results = [], [], [], []
     for i, inp in enumerate(inputs):
         min_size = min_sizes[i]
@@ -880,8 +907,9 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         num_boxes = box.shape[2]           # priors per cell
 
         # explicit per-map param names: repeated bare conv2d calls in
-        # one scope would otherwise share a single parameter
-        tag = name or "multi_box_head"
+        # one scope would otherwise share a single parameter (and two
+        # heads in one network must not share either -> unique default)
+        tag = name or _mbh_tag
         loc = conv2d(inp, num_boxes * 4, kernel_size, stride=stride,
                      padding=pad,
                      param_attr=ParamAttr(name=f"{tag}_loc{i}_w"),

@@ -263,3 +263,34 @@ class TestReviewRegressions:
         # pads only the location rows
         assert scores.shape[0] == 2 and tlab.shape[0] == 2
         assert loc.shape[0] == 1 and int(fg_num[0]) == 1
+
+    def test_mine_hard_examples_static_mode_two_outputs(self):
+        """Regression: static-mode wrapper must declare 2 outputs."""
+        pt.enable_static()
+        try:
+            from paddle_tpu import layers
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.static.program_guard(main, startup):
+                cl = pt.static.data("cl", shape=[2, 5],
+                                    append_batch_size=False)
+                mi_ = pt.static.data("mi", shape=[2, 5], dtype="int32",
+                                     append_batch_size=False)
+                d_ = pt.static.data("d", shape=[2, 5],
+                                    append_batch_size=False)
+                # static mode needs tensor slots filled; loc_loss is
+                # unused under max_negative mining
+                neg, mi2 = layers.mine_hard_examples(cl, cl, mi_, d_)
+            exe = pt.static.Executor()
+            scope = pt.static.Scope()
+            loss = np.array([[0.9, 0.8, 0.7, 0.6, 0.5]] * 2, np.float32)
+            midx = np.array([[2, -1, -1, -1, -1]] * 2, np.int32)
+            dist = np.full((2, 5), 0.1, np.float32)
+            with pt.static.scope_guard(scope):
+                got_neg, got_mi = exe.run(
+                    main, feed={"cl": loss, "mi": midx, "d": dist},
+                    fetch_list=[neg, mi2])
+            np.testing.assert_array_equal(got_neg,
+                                          [[0, 1, 1, 1, 0]] * 2)
+            np.testing.assert_array_equal(got_mi, midx)
+        finally:
+            pt.disable_static()
