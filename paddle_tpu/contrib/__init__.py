@@ -8,6 +8,7 @@ from paddle_tpu.contrib import decoder
 from paddle_tpu.contrib import extend_optimizer
 from paddle_tpu.contrib import layers
 from paddle_tpu.contrib import model_stat
+from paddle_tpu.contrib import nas
 from paddle_tpu.contrib import op_frequence
 from paddle_tpu.contrib import quant
 from paddle_tpu.contrib import slim
@@ -19,7 +20,7 @@ from paddle_tpu.contrib.extend_optimizer import (
 from paddle_tpu.contrib.model_stat import summary
 from paddle_tpu.contrib.op_frequence import op_freq_statistic
 
-__all__ = ["quant", "slim", "decoder", "extend_optimizer", "layers",
+__all__ = ["quant", "slim", "nas", "decoder", "extend_optimizer", "layers",
            "model_stat", "op_frequence", "trainer", "utils",
            "extend_with_decoupled_weight_decay", "summary",
            "op_freq_statistic"]

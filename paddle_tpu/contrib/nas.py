@@ -1,0 +1,312 @@
+"""Light-NAS: architecture search over token spaces.
+
+Parity targets: python/paddle/fluid/contrib/slim/nas/
+(light_nas_strategy.py LightNASStrategy, controller_server.py
+ControllerServer, search_agent.py SearchAgent, search_space.py
+SearchSpace) and slim/searcher/controller.py (EvolutionaryController,
+SAController — simulated annealing over integer token vectors).
+
+TPU-native shape: the controller/server/agent layer is plain host-side
+C-like plumbing (a line-oriented text protocol, no pickle) and is kept
+faithful; the per-candidate evaluation is where TPU idiom matters — a
+candidate's `create_net(tokens)` returns jittable callables that train
+through the normal trainer stack (DataParallelTrainer or a user loop),
+so every candidate runs as one compiled XLA program.
+
+Determinism: controllers take an explicit seed (the reference drew from
+global numpy randomness, which made searches unreproducible).
+"""
+
+import logging
+import math
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+
+__all__ = [
+    "SearchSpace", "EvolutionaryController", "SAController",
+    "ControllerServer", "SearchAgent", "LightNASStrategy",
+]
+
+_log = logging.getLogger("paddle_tpu.nas")
+
+
+class SearchSpace:
+    """Abstract token-space (ref nas/search_space.py).
+
+    init_tokens() -> list<int>; range_table() -> list<int> with
+    tokens[i] in [0, range_table[i]); create_net(tokens) -> whatever
+    the evaluation callback consumes (idiomatically: a loss_fn +
+    init_fn pair to hand to DataParallelTrainer)."""
+
+    def init_tokens(self):
+        raise NotImplementedError("Abstract method.")
+
+    def range_table(self):
+        raise NotImplementedError("Abstract method.")
+
+    def create_net(self, tokens):
+        raise NotImplementedError("Abstract method.")
+
+
+class EvolutionaryController:
+    """Abstract controller (ref searcher/controller.py)."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError("Abstract method.")
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        raise NotImplementedError("Abstract method.")
+
+    def next_tokens(self):
+        raise NotImplementedError("Abstract method.")
+
+
+class SAController(EvolutionaryController):
+    """Simulated-annealing controller (ref searcher/controller.py
+    SAController): accept a candidate when its reward improves, or with
+    probability exp((reward - current)/T) under the decaying
+    temperature T = init_temperature * reduce_rate**iter."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=0):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._rng = np.random.RandomState(seed)
+        self._constrain_func = None
+        self._reward = -float("inf")
+        self._tokens = None
+        self._max_reward = -float("inf")
+        self._best_tokens = None
+        self._iter = 0
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+        # a fresh chain: stale rewards/bests from a previous search
+        # would poison acceptance and report out-of-range tokens
+        self._reward = -float("inf")
+        self._max_reward = -float("inf")
+        self._best_tokens = None
+
+    def update(self, tokens, reward):
+        self._iter += 1
+        temperature = self._init_temperature * \
+            self._reduce_rate ** self._iter
+        if (reward > self._reward) or (self._rng.random_sample() <=
+                                       math.exp(min((reward - self._reward)
+                                                    / temperature, 0.0))):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+        _log.info("iter %d: max_reward %s best %s", self._iter,
+                  self._max_reward, self._best_tokens)
+
+    def next_tokens(self):
+        enforce(self._tokens is not None, "call reset() first")
+        # mutate only dimensions with >1 choice (a size-1 range entry
+        # is a fixed dimension; sampling it would both be pointless and
+        # crash randint(0))
+        movable = [i for i, r in enumerate(self._range_table) if r > 1]
+        enforce(bool(movable),
+                "search space has no dimension with more than one "
+                "choice — nothing to search")
+        tokens = list(self._tokens)
+        new_tokens = list(tokens)
+        index = movable[self._rng.randint(len(movable))]
+        new_tokens[index] = (
+            new_tokens[index]
+            + self._rng.randint(self._range_table[index] - 1) + 1
+        ) % self._range_table[index]
+        if self._constrain_func is None:
+            return new_tokens
+        for _ in range(self._max_iter_number):
+            if self._constrain_func(new_tokens):
+                return new_tokens
+            index = movable[self._rng.randint(len(movable))]
+            new_tokens = list(tokens)
+            new_tokens[index] = self._rng.randint(
+                self._range_table[index])
+        return new_tokens
+
+
+# ---------------------------------------------------------------------------
+# client/server loop (ref nas/controller_server.py + search_agent.py):
+# line-oriented text protocol — "next_tokens\n" or
+# "<key>\t<t0,t1,...>\t<reward>\n" -> "<t0,t1,...>\n". No pickle.
+# ---------------------------------------------------------------------------
+class ControllerServer:
+    """Socket wrapper around a controller so distributed search agents
+    (one per candidate-training job) share one annealing chain."""
+
+    def __init__(self, controller, address=("127.0.0.1", 0),
+                 max_client_num=100, search_steps=None, key="light-nas"):
+        self._controller = controller
+        self._address = address
+        self._max_client_num = max_client_num   # listen backlog
+        self._search_steps = search_steps
+        self._key = key
+        self._lock = threading.Lock()
+        self._server = None
+        self._thread = None
+
+    def _exhausted(self):
+        return (self._search_steps is not None
+                and getattr(self._controller, "_iter", 0)
+                >= self._search_steps)
+
+    def start(self):
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                line = self.rfile.readline().decode("utf-8").strip()
+                with outer._lock:
+                    if line == "next_tokens":
+                        toks = outer._controller.next_tokens()
+                    else:
+                        parts = line.split("\t")
+                        if len(parts) < 3 or parts[0] != outer._key:
+                            _log.info("noise from %s: %r",
+                                      self.client_address, line[:80])
+                            return
+                        if outer._exhausted():
+                            # search budget spent: stop accepting
+                            # updates, serve the best tokens found
+                            toks = (outer._controller.best_tokens
+                                    or outer._controller.next_tokens())
+                        else:
+                            tokens = [int(t)
+                                      for t in parts[1].split(",")]
+                            outer._controller.update(tokens,
+                                                     float(parts[2]))
+                            toks = outer._controller.next_tokens()
+                self.wfile.write(
+                    (",".join(str(t) for t in toks) + "\n").encode())
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+            request_queue_size = self._max_client_num
+
+        self._server = Server(self._address, Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def ip(self):
+        return self._server.server_address[0]
+
+    def port(self):
+        return self._server.server_address[1]
+
+    def close(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+class SearchAgent:
+    """Client side (ref nas/search_agent.py): one per training job."""
+
+    def __init__(self, server_ip, server_port, key="light-nas"):
+        self.server_ip = server_ip
+        self.server_port = server_port
+        self._key = key
+
+    def _roundtrip(self, msg):
+        with socket.create_connection(
+                (self.server_ip, self.server_port), timeout=30) as s:
+            s.sendall((msg + "\n").encode("utf-8"))
+            data = b""
+            while not data.endswith(b"\n"):
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                data += chunk
+        text = data.decode("utf-8").strip()
+        enforce(text, "controller server sent no tokens (bad key?)")
+        return [int(t) for t in text.split(",")]
+
+    def next_tokens(self):
+        return self._roundtrip("next_tokens")
+
+    def update(self, tokens, reward):
+        return self._roundtrip(
+            "{}\t{}\t{}".format(self._key,
+                                ",".join(str(t) for t in tokens),
+                                float(reward)))
+
+
+class LightNASStrategy:
+    """Search-loop orchestration (ref nas/light_nas_strategy.py,
+    re-expressed functionally): every step asks the controller for
+    tokens, builds the candidate via the SearchSpace, trains/evaluates
+    it through ``eval_fn``, and feeds the reward back.
+
+    eval_fn(net, tokens) -> float reward — `net` is whatever
+    create_net returned (idiomatically a jittable train/eval pair run
+    through the normal trainer stack). With ``agent`` set, tokens come
+    from a remote ControllerServer so many hosts share one chain.
+    """
+
+    def __init__(self, search_space, controller=None, agent=None,
+                 search_steps=50, constrain_func=None):
+        enforce((controller is None) != (agent is None),
+                "pass exactly one of controller= (in-process) or "
+                "agent= (remote ControllerServer)")
+        enforce(agent is None or constrain_func is None,
+                "constrain_func cannot be enforced from agent mode — "
+                "the chain lives on the ControllerServer; pass the "
+                "constraint to the SERVER's controller.reset() instead")
+        self.space = search_space
+        self.controller = controller
+        self.agent = agent
+        self.search_steps = search_steps
+        self.constrain_func = constrain_func
+
+    def search(self, eval_fn):
+        """Returns (best_tokens, best_reward, history)."""
+        init = list(self.space.init_tokens())
+        if self.controller is not None:
+            self.controller.reset(self.space.range_table(), init,
+                                  self.constrain_func)
+            next_tokens = self.controller.next_tokens
+        else:
+            next_tokens = self.agent.next_tokens
+
+        best_tokens, best_reward = init, -float("inf")
+        history = []
+        tokens = init
+        for step in range(self.search_steps):
+            net = self.space.create_net(tokens)
+            reward = float(eval_fn(net, tokens))
+            history.append((list(tokens), reward))
+            if reward > best_reward:
+                best_tokens, best_reward = list(tokens), reward
+            if self.controller is not None:
+                self.controller.update(tokens, reward)
+                tokens = next_tokens()
+            else:
+                tokens = self.agent.update(tokens, reward)
+        return best_tokens, best_reward, history

@@ -15,6 +15,7 @@ Every function works in BOTH modes, like the reference's layers do
   (ref: framework/shape_inference.h is subsumed).
 """
 
+import contextlib
 import functools
 import inspect
 
@@ -867,18 +868,14 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         _mbh_scope = _module._frame().scope("multi_box_head")
         _mbh_tag = "mbh"
     else:
-        import contextlib as _ctxlib
-        _mbh_scope = _ctxlib.nullcontext()
+        _mbh_scope = contextlib.nullcontext()
         _mbh_tag = name or unique_name.generate("multi_box_head")
-    _mbh_scope.__enter__()
-    try:
+    with _mbh_scope:
         return _multi_box_head_body(
             inputs, image, num_classes, aspect_ratios, min_sizes,
             max_sizes, step_w, step_h, offset, variance, flip, clip,
             kernel_size, pad, stride, min_max_aspect_ratios_order,
             name, _mbh_tag)
-    finally:
-        _mbh_scope.__exit__(None, None, None)
 
 
 def _multi_box_head_body(inputs, image, num_classes, aspect_ratios,

@@ -190,25 +190,21 @@ class PipelineModule:
                     dx.reshape(emb.shape) / self.n_micro)
                 return loss, {"embed": g_embed, "stages": sg,
                               "head": hg}
-
-            @jax.jit
-            def step(params, opt_state, batch_x, batch_y):
-                loss, grads = loss_and_grads(params, batch_x, batch_y)
-                new_params, new_opt = optimizer.apply_gradients(
-                    params, grads, opt_state)
-                return loss, new_params, new_opt
         elif schedule == "gpipe":
-            @jax.jit
-            def step(params, opt_state, batch_x, batch_y):
-                loss, grads = jax.value_and_grad(self.loss)(
+            def loss_and_grads(params, batch_x, batch_y):
+                return jax.value_and_grad(self.loss)(
                     params, batch_x, batch_y)
-                new_params, new_opt = optimizer.apply_gradients(
-                    params, grads, opt_state)
-                return loss, new_params, new_opt
         else:
             raise ValueError(
                 f"unknown pipeline schedule {schedule!r}: "
                 f"expected 'gpipe' or '1f1b'")
+
+        @jax.jit
+        def step(params, opt_state, batch_x, batch_y):
+            loss, grads = loss_and_grads(params, batch_x, batch_y)
+            new_params, new_opt = optimizer.apply_gradients(
+                params, grads, opt_state)
+            return loss, new_params, new_opt
 
         def init_fn(params):
             stacked_sh = stage_param_sharding(mesh, params["stages"],
@@ -306,8 +302,7 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
         stacked_params)
     dspec = P(None, data_axis) if mesh.shape.get(data_axis, 1) > 1 else P()
     hspec = jax.tree.map(lambda _: P(), head_params)
-    lspec = P(None, data_axis) if mesh.shape.get(data_axis, 1) > 1 \
-        else P()
+    lspec = dspec
 
     def body(stacked_local, mb, lb, hp):
         idx = lax.axis_index(pipe_axis)

@@ -1,0 +1,180 @@
+"""Light-NAS (VERDICT-r2 Missing #2; ref contrib/slim/nas/ +
+slim/searcher/controller.py): SA controller finds the known-best config
+in a tiny space, the client/server loop works over a real socket, and a
+candidate trains through the normal jitted stack.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.contrib import nas
+
+
+class QuadraticSpace(nas.SearchSpace):
+    """Toy space with a known optimum: tokens [a, b] in [0,8)x[0,8),
+    reward peaks at (5, 2)."""
+
+    def init_tokens(self):
+        return [0, 0]
+
+    def range_table(self):
+        return [8, 8]
+
+    def create_net(self, tokens):
+        return tuple(tokens)
+
+
+def _reward(net, tokens):
+    a, b = net
+    return -((a - 5) ** 2 + (b - 2) ** 2)
+
+
+class TestSAController:
+    def test_finds_known_best(self):
+        ctrl = nas.SAController(reduce_rate=0.9, init_temperature=8.0,
+                                seed=0)
+        strat = nas.LightNASStrategy(QuadraticSpace(), controller=ctrl,
+                                     search_steps=120)
+        best_tokens, best_reward, history = strat.search(_reward)
+        assert best_tokens == [5, 2], (best_tokens, best_reward)
+        assert best_reward == 0.0
+        assert len(history) == 120
+        assert ctrl.best_tokens == [5, 2]
+
+    def test_deterministic_given_seed(self):
+        def run():
+            ctrl = nas.SAController(seed=7)
+            strat = nas.LightNASStrategy(QuadraticSpace(),
+                                         controller=ctrl,
+                                         search_steps=30)
+            return strat.search(_reward)
+        assert run() == run()
+
+    def test_constraint_respected(self):
+        # forbid a > 3: best reachable is (3, 2)
+        ctrl = nas.SAController(init_temperature=8.0, seed=1)
+        strat = nas.LightNASStrategy(
+            QuadraticSpace(), controller=ctrl, search_steps=150,
+            constrain_func=lambda t: t[0] <= 3)
+        best_tokens, best_reward, history = strat.search(_reward)
+        # the evaluated candidates (post-init) honor the constraint
+        for toks, _ in history[1:]:
+            assert toks[0] <= 3, toks
+        assert best_tokens == [3, 2], best_tokens
+
+    def test_acceptance_is_annealed(self):
+        """A worse candidate can be accepted early (hot) — the SA
+        escape hatch — but the chain still tracks max separately."""
+        ctrl = nas.SAController(init_temperature=1e6, reduce_rate=1.0,
+                                seed=0)
+        ctrl.reset([8, 8], [5, 2])
+        ctrl.update([5, 2], 0.0)
+        ctrl.update([0, 0], -29.0)      # hot chain accepts the drop
+        assert ctrl._tokens == [0, 0]
+        assert ctrl.best_tokens == [5, 2] and ctrl.max_reward == 0.0
+
+
+class TestControllerServer:
+    def test_client_server_search(self):
+        ctrl = nas.SAController(reduce_rate=0.9, init_temperature=8.0,
+                                seed=0)
+        ctrl.reset([8, 8], [0, 0])
+        server = nas.ControllerServer(ctrl, search_steps=None).start()
+        try:
+            agent = nas.SearchAgent(server.ip(), server.port())
+            strat = nas.LightNASStrategy(QuadraticSpace(), agent=agent,
+                                         search_steps=120)
+            best_tokens, best_reward, _ = strat.search(_reward)
+            assert best_tokens == [5, 2], (best_tokens, best_reward)
+        finally:
+            server.close()
+
+    def test_bad_key_rejected(self):
+        ctrl = nas.SAController(seed=0)
+        ctrl.reset([4], [0])
+        server = nas.ControllerServer(ctrl, key="secret").start()
+        try:
+            bad = nas.SearchAgent(server.ip(), server.port(),
+                                  key="wrong")
+            with pytest.raises(Exception):
+                bad.update([1], 1.0)
+            good = nas.SearchAgent(server.ip(), server.port(),
+                                   key="secret")
+            toks = good.update([1], 1.0)
+            assert len(toks) == 1
+        finally:
+            server.close()
+
+
+class TinyMLPSpace(nas.SearchSpace):
+    """A real (if tiny) NAS: choose hidden width + activation for a
+    regression MLP; candidates train as one jitted program."""
+
+    WIDTHS = [1, 2, 16, 32]
+    ACTS = [jnp.tanh, jax.nn.relu]
+
+    def init_tokens(self):
+        return [0, 0]
+
+    def range_table(self):
+        return [len(self.WIDTHS), len(self.ACTS)]
+
+    def create_net(self, tokens):
+        width = self.WIDTHS[tokens[0]]
+        act = self.ACTS[tokens[1]]
+
+        def init_fn(rng):
+            k1, k2 = jax.random.split(rng)
+            return {"w1": jax.random.normal(k1, (4, width)) * 0.5,
+                    "w2": jax.random.normal(k2, (width, 1)) * 0.5}
+
+        def loss_fn(params, x, y):
+            h = act(x @ params["w1"])
+            return jnp.mean((h @ params["w2"] - y) ** 2)
+
+        return init_fn, loss_fn
+
+
+class TestNASTrainsCandidates:
+    def test_search_finds_brute_force_optimum(self):
+        """Candidates really train (jitted SGD) and the search lands on
+        the config brute-force enumeration says is best."""
+        rng = np.random.RandomState(0)
+        X = jnp.asarray(rng.randn(64, 4).astype(np.float32))
+        Y = jnp.asarray(
+            np.tanh(rng.randn(4, 1).astype(np.float32).T @ np.asarray(X).T
+                    ).T.astype(np.float32))
+        space = TinyMLPSpace()
+
+        def eval_fn(net, tokens):
+            init_fn, loss_fn = net
+
+            @jax.jit
+            def step(p):
+                l, g = jax.value_and_grad(loss_fn)(p, X, Y)
+                return l, jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+            p = init_fn(jax.random.PRNGKey(0))
+            for _ in range(40):
+                l, p = step(p)
+            return -float(l)
+
+        # ground truth: enumerate the whole (tiny) space
+        truth = {}
+        for t0 in range(len(space.WIDTHS)):
+            for t1 in range(len(space.ACTS)):
+                toks = [t0, t1]
+                truth[tuple(toks)] = eval_fn(space.create_net(toks),
+                                             toks)
+        best_true = max(truth, key=truth.get)
+
+        ctrl = nas.SAController(reduce_rate=0.9, init_temperature=1.0,
+                                seed=0)
+        strat = nas.LightNASStrategy(space, controller=ctrl,
+                                     search_steps=16)
+        best_tokens, best_reward, _ = strat.search(eval_fn)
+        assert tuple(best_tokens) == best_true, (best_tokens, truth)
+        assert best_reward == pytest.approx(truth[best_true])
