@@ -107,8 +107,10 @@ class SAController(EvolutionaryController):
 
     def update(self, tokens, reward):
         self._iter += 1
-        temperature = self._init_temperature * \
-            self._reduce_rate ** self._iter
+        # floor keeps exp() well-defined when the geometric decay
+        # underflows to 0.0 on very long (unbounded-server) searches
+        temperature = max(self._init_temperature *
+                          self._reduce_rate ** self._iter, 1e-300)
         if (reward > self._reward) or (self._rng.random_sample() <=
                                        math.exp(min((reward - self._reward)
                                                     / temperature, 0.0))):
@@ -194,10 +196,16 @@ class ControllerServer:
                             toks = (outer._controller.best_tokens
                                     or outer._controller.next_tokens())
                         else:
-                            tokens = [int(t)
-                                      for t in parts[1].split(",")]
-                            outer._controller.update(tokens,
-                                                     float(parts[2]))
+                            try:
+                                tokens = [int(t)
+                                          for t in parts[1].split(",")]
+                                reward = float(parts[2])
+                            except ValueError:
+                                _log.warning(
+                                    "malformed update from %s: %r",
+                                    self.client_address, line[:80])
+                                return
+                            outer._controller.update(tokens, reward)
                             toks = outer._controller.next_tokens()
                 self.wfile.write(
                     (",".join(str(t) for t in toks) + "\n").encode())
@@ -291,9 +299,6 @@ class LightNASStrategy:
         if self.controller is not None:
             self.controller.reset(self.space.range_table(), init,
                                   self.constrain_func)
-            next_tokens = self.controller.next_tokens
-        else:
-            next_tokens = self.agent.next_tokens
 
         best_tokens, best_reward = init, -float("inf")
         history = []
@@ -306,7 +311,7 @@ class LightNASStrategy:
                 best_tokens, best_reward = list(tokens), reward
             if self.controller is not None:
                 self.controller.update(tokens, reward)
-                tokens = next_tokens()
+                tokens = self.controller.next_tokens()
             else:
                 tokens = self.agent.update(tokens, reward)
         return best_tokens, best_reward, history

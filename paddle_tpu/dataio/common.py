@@ -167,3 +167,30 @@ def cifar10_reader(split="train"):
                         yield row, int(lab)
 
     return reader
+
+
+def digits_reader(split="train", test_fraction=0.2, seed=42):
+    """Zero-arg reader factory over the REAL scikit-learn digits corpus
+    (1,797 8x8 handwritten digits, UCI Optical Recognition of
+    Handwritten Digits — bundled with sklearn, so it works with zero
+    network egress). The OFFLINE stand-in for the recognize_digits
+    convergence run when the mnist idx download is unreachable: same
+    task shape (images in [-1, 1], integer labels 0-9), deterministic
+    train/test split.
+    """
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    imgs = (d.images.reshape(len(d.images), -1)
+            .astype(np.float32) / 8.0 - 1.0)      # pixel range 0..16
+    labels = d.target.astype(np.int64)
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(labels))
+    n_test = int(len(labels) * test_fraction)
+    idx = order[n_test:] if split == "train" else order[:n_test]
+
+    def reader():
+        for i in idx:
+            yield imgs[i], int(labels[i])
+
+    return reader

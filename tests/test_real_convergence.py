@@ -1,0 +1,267 @@
+"""Real-data convergence (VERDICT-r2 Weak #5 / next-step #6): models
+trained on REAL corpora to reference-comparable quality, with held-out
+evaluation — not one memorized synthetic batch.
+
+Offline reality of the driver environment (zero network egress): the
+mnist idx / cifar tarball downloads are unreachable, so
+- recognize_digits runs on the real sklearn digits corpus (1,797 UCI
+  handwritten digits, bundled offline) through the STATIC fluid path to
+  >= 97% held-out accuracy — the book-test acceptance bar;
+- BERT-tiny MLM trains on real text (this repo's own docs + the
+  reference's markdown — a genuine corpus) with every step on a fresh
+  batch and evaluation on a held-out text region;
+- the mnist/cifar harnesses stay as network-gated tests (they execute
+  in any environment where PT_DATASET_REAL=1 can download).
+"""
+
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.dataio.common import digits_reader, real_data_enabled
+from paddle_tpu.dataio import text_corpus as TC
+
+
+def _have_network():
+    try:
+        socket.create_connection(
+            ("ossci-datasets.s3.amazonaws.com", 443), timeout=3).close()
+        return True
+    except OSError:
+        return False
+
+
+class TestDigitsStatic:
+    def test_digits_mlp_97pct_heldout(self):
+        """recognize_digits acceptance (ref tests/book pattern: mnist
+        >= 97%) on the offline real digits corpus, via the static
+        program path end to end."""
+        train = list(digits_reader("train")())
+        test = list(digits_reader("test")())
+        Xtr = np.stack([x for x, _ in train])
+        Ytr = np.array([y for _, y in train], np.int64).reshape(-1, 1)
+        Xte = np.stack([x for x, _ in test])
+        Yte = np.array([y for _, y in test], np.int64).reshape(-1, 1)
+
+        pt.enable_static()
+        try:
+            main, startup = pt.static.Program(), pt.static.Program()
+            with pt.static.program_guard(main, startup):
+                img = pt.static.data("img", shape=[64],
+                                     append_batch_size=True)
+                lab = pt.static.data("lab", shape=[1], dtype="int64",
+                                     append_batch_size=True)
+                h = layers.fc(img, 128, act="relu")
+                h = layers.fc(h, 64, act="relu")
+                logits = layers.fc(h, 10)
+                loss = layers.mean(
+                    layers.softmax_with_cross_entropy(logits, lab))
+                opt = pt.optimizer.Adam(1e-3)
+                opt.minimize(loss)
+
+                test_prog = main.clone(for_test=True)
+
+            exe = pt.static.Executor()
+            scope = pt.static.Scope()
+            rng = np.random.RandomState(0)
+            with pt.static.scope_guard(scope):
+                exe.run(startup)
+                bs = 64
+                for epoch in range(30):
+                    order = rng.permutation(len(Xtr))
+                    for i in range(0, len(order) - bs + 1, bs):
+                        sel = order[i:i + bs]
+                        exe.run(main, feed={"img": Xtr[sel],
+                                            "lab": Ytr[sel]},
+                                fetch_list=[loss])
+                out, = exe.run(test_prog, feed={"img": Xte, "lab": Yte},
+                               fetch_list=[logits])
+            acc = float((np.argmax(out, -1) == Yte.ravel()).mean())
+            assert acc >= 0.97, f"held-out accuracy {acc:.4f} < 0.97"
+        finally:
+            pt.disable_static()
+
+
+class TestBertTinyRealText:
+    def test_mlm_loss_falls_on_fresh_real_batches(self):
+        """BERT-tiny MLM on a real text corpus: every training step
+        sees a fresh batch (region [0, 0.8) of the stream); eval is on
+        the held-out region [0.8, 1]. Loss must fall well below the
+        uniform baseline AND below its starting value on both."""
+        from paddle_tpu.models import bert
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        corpus_root = "/root/reference" \
+            if os.path.isdir("/root/reference") else repo_root
+        ids, vocab = TC.build_corpus(corpus_root, vocab_size=2048,
+                                     max_bytes=4 << 20,
+                                     exts=(".md", ".rst", ".py"))
+        assert len(ids) > 50_000, "corpus too small to train on"
+
+        from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        cfg = bert.bert_tiny(vocab_size=2048)
+        opt = pt.optimizer.Adam(1e-3)
+        # single-device mesh: this test proves CONVERGENCE on real
+        # text; sharding is covered elsewhere, and XLA-CPU's 8-thread
+        # collective rendezvous is flaky under pytest's runner
+        mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+        init_fn, step_fn = bert.make_train_step(cfg, opt, mesh=mesh)
+        params, opt_state = init_fn(jax.random.PRNGKey(0))
+
+        B, S = 32, 64
+        train_stream = TC.mlm_batch_stream(ids, 2048, B, S, seed=1,
+                                           region=(0.0, 0.8))
+        eval_stream = TC.mlm_batch_stream(ids, 2048, B, S, seed=2,
+                                          region=(0.8, 1.0))
+
+        def eval_loss(params, n=8):
+            tot = 0.0
+            for _ in range(n):
+                b = next(eval_stream)
+                tot += float(bert.mlm_loss(params, cfg, b))
+            return tot / n
+
+        loss0 = eval_loss(params)
+        first_train = None
+        for step in range(600):
+            l, params, opt_state = step_fn(params, opt_state,
+                                           next(train_stream))
+            if first_train is None:
+                first_train = float(l)
+        loss1 = eval_loss(params)
+
+        uniform = float(np.log(2048))
+        assert loss0 == pytest.approx(uniform, rel=0.15), \
+            (loss0, uniform)
+        # generalization, not memorization: held-out loss improves a lot
+        assert loss1 < loss0 * 0.60, (loss0, loss1)
+        assert loss1 < first_train, (first_train, loss1)
+
+
+needs_net = pytest.mark.skipif(
+    not (real_data_enabled() and _have_network()),
+    reason="mnist/cifar corpora need PT_DATASET_REAL=1 + network "
+           "egress (unavailable in the zero-egress driver env); the "
+           "offline real-data convergence runs are TestDigitsStatic + "
+           "TestBertTinyRealText above")
+
+
+@needs_net
+def test_mnist_full_97pct():
+    from paddle_tpu.dataio.common import mnist_reader
+    train = list(mnist_reader("train")())
+    test = list(mnist_reader("test")())
+    Xtr = np.stack([x for x, _ in train])
+    Ytr = np.array([y for _, y in train])[:, None]
+    Xte = np.stack([x for x, _ in test])
+    Yte = np.array([y for _, y in test])
+
+    from paddle_tpu import nn
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1 = nn.Linear(784, 256)
+            self.l2 = nn.Linear(256, 10)
+
+        def forward(self, x):
+            return self.l2(jax.nn.relu(self.l1(x)))
+
+    m = MLP()
+    params, state = m.init(jax.random.PRNGKey(0), jnp.ones((2, 784)))
+    opt = pt.optimizer.Adam(1e-3)
+    ost = opt.init(params)
+
+    @jax.jit
+    def step(p, o, x, y):
+        def lf(p):
+            lg, _ = m.apply(p, state, jax.random.PRNGKey(0), x)
+            oh = jax.nn.one_hot(y.ravel(), 10)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(lg) * oh, -1))
+        l, g = jax.value_and_grad(lf)(p)
+        p, o = opt.apply_gradients(p, g, o)
+        return l, p, o
+
+    rng = np.random.RandomState(0)
+    for epoch in range(3):
+        order = rng.permutation(len(Xtr))
+        for i in range(0, len(order) - 128 + 1, 128):
+            sel = order[i:i + 128]
+            _, params, ost = step(params, ost,
+                                  jnp.asarray(Xtr[sel]),
+                                  jnp.asarray(Ytr[sel]))
+    logits, _ = m.apply(params, state, jax.random.PRNGKey(0),
+                        jnp.asarray(Xte))
+    acc = float((np.argmax(np.asarray(logits), -1) == Yte).mean())
+    assert acc >= 0.97, acc
+
+
+@needs_net
+def test_cifar_conv_learns_one_epoch():
+    """The cifar acceptance path (ref book image_classification; the
+    full >= 70% run belongs on TPU hardware via bench.py — hours on
+    CPU). Where the tarball is downloadable this trains a small conv
+    net for ONE epoch and requires held-out accuracy > 35% — proof the
+    real-data pipeline learns, not just that the file parses."""
+    from paddle_tpu import nn
+    from paddle_tpu.dataio.common import cifar10_reader
+
+    train = list(cifar10_reader("train")())
+    test = list(cifar10_reader("test")())
+    Xtr = np.stack([x for x, _ in train]).reshape(-1, 3, 32, 32)
+    Ytr = np.array([y for _, y in train])
+    Xte = np.stack([x for x, _ in test]).reshape(-1, 3, 32, 32)
+    Yte = np.array([y for _, y in test])
+    assert len(Xtr) == 50000 and Ytr.max() == 9
+
+    from paddle_tpu import layers as L
+
+    class Conv(nn.Layer):
+        def forward(self, x):
+            h = L.conv2d(x, 32, 3, padding=1, act="relu")
+            h = L.pool2d(h, 2, pool_type="max", pool_stride=2)
+            h = L.conv2d(h, 64, 3, padding=1, act="relu")
+            h = L.pool2d(h, 2, pool_type="max", pool_stride=2)
+            h = h.reshape(h.shape[0], -1)
+            return L.fc(h, 10)
+
+    m = Conv()
+    params, state = m.init(jax.random.PRNGKey(0),
+                           jnp.ones((2, 3, 32, 32)))
+    opt = pt.optimizer.Adam(1e-3)
+    ost = opt.init(params)
+
+    @jax.jit
+    def step(p, o, x, y):
+        def lf(p):
+            lg, _ = m.apply(p, state, jax.random.PRNGKey(0), x)
+            oh = jax.nn.one_hot(y, 10)
+            return -jnp.mean(jnp.sum(jax.nn.log_softmax(lg) * oh, -1))
+        l, g = jax.value_and_grad(lf)(p)
+        p, o = opt.apply_gradients(p, g, o)
+        return l, p, o
+
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(Xtr))
+    for i in range(0, len(order) - 128 + 1, 128):
+        sel = order[i:i + 128]
+        _, params, ost = step(params, ost, jnp.asarray(Xtr[sel]),
+                              jnp.asarray(Ytr[sel]))
+    correct = 0
+    for i in range(0, len(Xte), 500):
+        lg, _ = m.apply(params, state, jax.random.PRNGKey(0),
+                        jnp.asarray(Xte[i:i + 500]))
+        correct += int((np.argmax(np.asarray(lg), -1)
+                        == Yte[i:i + 500]).sum())
+    acc = correct / len(Xte)
+    assert acc > 0.35, acc
