@@ -205,9 +205,67 @@ def bench_longcontext():
             "vs_baseline": round(tps_flash / tps_dense, 4)}))
 
 
+def bench_nmt():
+    """`python bench.py nmt`: Transformer-big WMT shape (bs=32, s=256)
+    train tokens/sec + MFU, plus beam-search decode latency (the
+    reference's stress test, operators/beam_search_op.cc)."""
+    import functools
+
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, set_mesh
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    mesh = set_mesh(make_mesh(MeshConfig(data=1),
+                              devices=jax.devices()[:1]))
+    bs, s = (32, 256) if on_tpu else (2, 16)
+    cfg = (T.transformer_big(max_seq=s) if on_tpu
+           else T.transformer_tiny(max_seq=s))
+    opt = pt.optimizer.Adam(1e-4)
+    init_fn, step_fn = T.make_train_step(cfg, opt, mesh)
+    batch = T.synthetic_batch(cfg, bs, src_len=s, tgt_len=s)
+    params, opt_state = init_fn(jax.random.PRNGKey(0))
+    steps = 20 if on_tpu else 2
+
+    def once(carry):
+        params, opt_state = carry
+        loss, params, opt_state = step_fn(params, opt_state, batch)
+        return (params, opt_state), loss
+
+    dt, (params, _), _ = _timed_steps(once, (params, opt_state), steps)
+    tok_s = bs * s * steps / dt
+    mfu = (T.flops_per_step(cfg, bs, s, s) * steps / dt) / 197e12
+    print(json.dumps({
+        "metric": "transformer_big_train_target_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1), "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.35, 4)}))
+
+    # beam-search decode latency
+    max_len = 64 if on_tpu else 8
+    bsd = jax.jit(functools.partial(T.beam_search_decode, cfg=cfg,
+                                    beam_size=4, max_len=max_len))
+
+    def decode_once(carry):
+        out = bsd(params, src_ids=batch["src_ids"],
+                  src_mask=batch["src_mask"])
+        return carry, jax.tree.leaves(out)[0]
+
+    reps = 5 if on_tpu else 1
+    dt, _, _ = _timed_steps(decode_once, None, reps, settle=1)
+    print(json.dumps({
+        "metric": "transformer_big_beam4_decode_latency_ms",
+        "value": round(dt / reps * 1e3, 1), "unit": "ms",
+        "decode_tokens_per_sec": round(bs * max_len * reps / dt, 1)}))
+
+
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "resnet50":
         return bench_resnet50()
+    if len(sys.argv) > 1 and sys.argv[1] == "nmt":
+        return bench_nmt()
     if len(sys.argv) > 1 and sys.argv[1] == "inference":
         return bench_inference()
     if len(sys.argv) > 1 and sys.argv[1] == "longcontext":
