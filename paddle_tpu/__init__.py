@@ -26,7 +26,8 @@ from paddle_tpu.core.enforce import EnforceNotMet, enforce, enforce_eq
 from paddle_tpu.core.flags import flags, get_flag, set_flags
 from paddle_tpu.core.place import (
     CPUPlace, TPUPlace, Place, default_place, is_compiled_with_tpu,
-    device_count, set_device, get_device,
+    device_count, set_device, get_device, cpu_places, cuda_places,
+    tpu_places,
 )
 
 from paddle_tpu import ops

@@ -93,3 +93,26 @@ def get_device():
 @functools.lru_cache(maxsize=None)
 def local_device_count():
     return jax.local_device_count()
+
+
+def cpu_places(device_count=None):
+    """fluid.cpu_places parity (the get_places op's python surface,
+    ref operators/controlflow/get_places_op.cc): one CPUPlace per
+    requested device (default: all visible)."""
+    import jax
+    n = device_count or max(
+        len([d for d in jax.devices() if d.platform == "cpu"]), 1)
+    return [CPUPlace(i) for i in range(n)]
+
+
+def tpu_places(device_ids=None):
+    """TPU analog of fluid.cuda_places: one TPUPlace per chip."""
+    import jax
+    if device_ids is None:
+        device_ids = [d.id for d in jax.devices()
+                      if d.platform != "cpu"] or [0]
+    return [TPUPlace(i) for i in device_ids]
+
+
+# fluid.cuda_places compat: on this framework the accelerator is a TPU
+cuda_places = tpu_places

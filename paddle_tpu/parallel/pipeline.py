@@ -45,6 +45,24 @@ def stack_stage_params(stage_params):
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
 
 
+def _bind_stage_fn(stage_fn, idx):
+    """Per-stage heterogeneity (the section_worker.cc stretch): a
+    stage_fn may take (params, x) — homogeneous — or (params, x,
+    stage_idx), where stage_idx is this device's traced pipe-axis
+    index. A 3-arg fn can lax.switch on the index to run different
+    computation per stage (activation shapes must still match across
+    stages — the SPMD constraint). Truly device-heterogeneous CPU/TPU
+    sections live outside the trunk as the embed/head split."""
+    try:
+        import inspect
+        n = len(inspect.signature(stage_fn).parameters)
+    except (TypeError, ValueError):
+        n = 2
+    if n >= 3:
+        return lambda p, x: stage_fn(p, x, idx)
+    return stage_fn
+
+
 def stage_param_sharding(mesh, stacked, pipe_axis=PIPE_AXIS):
     """NamedShardings placing each stage's slice on its pipe-axis device."""
     def sh(x):
@@ -60,6 +78,7 @@ def _pipeline_local(stage_fn, stacked_local, mb, n_micro, axis_name):
     (replicated via final collective)."""
     n_stages = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
+    stage_fn = _bind_stage_fn(stage_fn, idx)
     my_params = jax.tree.map(lambda x: x[0], stacked_local)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
@@ -306,6 +325,7 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
 
     def body(stacked_local, mb, lb, hp):
         idx = lax.axis_index(pipe_axis)
+        fn = _bind_stage_fn(stage_fn, idx)
         params = jax.tree.map(lambda x: x[0], stacked_local)
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
@@ -340,7 +360,7 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
             x_feed = lax.dynamic_index_in_dim(
                 mb, jnp.clip(mf, 0, n_micro - 1), keepdims=False)
             x = jnp.where(idx == 0, x_feed, c["fwd_in"])
-            y = stage_fn(params, x)
+            y = fn(params, x)
             resid = lax.dynamic_update_index_in_dim(
                 c["resid"], x, jnp.clip(mf, 0, n_micro - 1) % resid_len,
                 axis=0)
@@ -367,7 +387,7 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
             # on the last stage fwd and bwd of a microbatch share the
             # tick, so the residual for mbk is this tick's x
             x_for_bwd = jnp.where(is_last, x, x_saved)
-            _, vjp_fn = jax.vjp(stage_fn, params, x_for_bwd)
+            _, vjp_fn = jax.vjp(fn, params, x_for_bwd)
             gp, gx = vjp_fn(g_in)
             grad_acc = jax.tree.map(
                 lambda a, g: a + jnp.where(bwd_valid, g, 0.0),

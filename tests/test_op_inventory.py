@@ -191,3 +191,67 @@ def test_optimizer_family_resolves(name):
     import paddle_tpu.optimizer as PO
     assert hasattr(PO, OPTIMIZER_FAMILY[name]), \
         f"optimizers/ family rule '{name}' missing"
+
+
+# remaining §2.4 subdirectory families (r3): elementwise / reduce_ops /
+# controlflow / metrics, plus the fused/ family's documented mapping
+# (XLA owns kernel fusion, SURVEY §7: the fusion_* CPU-inference
+# kernels are subsumed; the three surviving surfaces are real).
+ELEMENTWISE_FAMILY = """elementwise_add elementwise_div
+elementwise_floordiv elementwise_max elementwise_min elementwise_mod
+elementwise_mul elementwise_pow elementwise_sub""".split()
+
+REDUCE_FAMILY = """reduce_all reduce_any reduce_max reduce_mean
+reduce_min reduce_prod reduce_sum""".split()
+
+CONTROLFLOW_FAMILY = {
+    "conditional_block": "paddle_tpu.ops.control_flow.cond",
+    "while": "paddle_tpu.layers.while_loop",
+    "get_places": "paddle_tpu.cpu_places",
+    "logical_and": None, "logical_or": None, "logical_not": None,
+    "logical_xor": None, "equal": None, "not_equal": None,
+    "less_than": None, "less_equal": None, "greater_than": None,
+    "greater_equal": None,
+}
+
+METRICS_FAMILY = "accuracy auc precision_recall".split()
+
+FUSED_FAMILY = {
+    # the residual hand-fused surfaces; every fusion_* CPU kernel is
+    # XLA's job (SURVEY §7 translation table)
+    "fused_elemwise_activation":
+        "paddle_tpu.contrib.layers.fused_elemwise_activation",
+    "conv2d_fusion": "paddle_tpu.layers.conv2d_fusion",
+    "flash_attention": "paddle_tpu.ops.pallas_kernels.flash_attention",
+}
+
+
+@pytest.mark.parametrize("name", ELEMENTWISE_FAMILY)
+def test_elementwise_family_resolves(name):
+    fn = _find(name)
+    assert fn is not None and callable(fn), name
+
+
+@pytest.mark.parametrize("name", REDUCE_FAMILY)
+def test_reduce_family_resolves(name):
+    fn = _find(name)
+    assert fn is not None and callable(fn), name
+
+
+@pytest.mark.parametrize("name", sorted(CONTROLFLOW_FAMILY))
+def test_controlflow_family_resolves(name):
+    path = CONTROLFLOW_FAMILY[name]
+    fn = _resolve(path) if path else _find(name)
+    assert fn is not None and callable(fn), name
+
+
+@pytest.mark.parametrize("name", METRICS_FAMILY)
+def test_metrics_family_resolves(name):
+    fn = _find(name)
+    assert fn is not None and callable(fn), name
+
+
+@pytest.mark.parametrize("name", sorted(FUSED_FAMILY))
+def test_fused_family_resolves(name):
+    fn = _resolve(FUSED_FAMILY[name])
+    assert callable(fn), name

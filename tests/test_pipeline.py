@@ -256,3 +256,29 @@ def test_unknown_schedule_raises():
     mod, _ = _mod_and_params()
     with pytest.raises(ValueError, match="unknown pipeline schedule"):
         mod.make_train_step(SGDOptimizer(0.1), schedule="1F1B")
+
+
+def test_heterogeneous_stage_fn_by_index():
+    """Per-stage heterogeneity: a 3-arg stage_fn receives its pipe-axis
+    index and can run different computation per stage (here: stage 0
+    uses tanh, later stages relu). Both schedules must agree with the
+    sequential reference."""
+    d, n_stages, n_micro, mb = 8, 4, 4, 2
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages)
+    stages = [_mk_stage(k, d) for k in keys]
+    stacked = pl.stack_stage_params(stages)
+    mesh = _pipe_mesh(n_stages)
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro, mb, d))
+
+    def het_stage(sp, x, idx):
+        h = x @ sp["w"] + sp["b"]
+        return jnp.where(idx == 0, jnp.tanh(h), jax.nn.relu(h))
+
+    got = pl.pipeline_apply(mesh, het_stage, stacked, x)
+
+    want = x
+    for i, sp in enumerate(stages):
+        h = want @ sp["w"] + sp["b"]
+        want = jnp.tanh(h) if i == 0 else jax.nn.relu(h)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
