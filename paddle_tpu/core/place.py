@@ -99,7 +99,6 @@ def cpu_places(device_count=None):
     """fluid.cpu_places parity (the get_places op's python surface,
     ref operators/controlflow/get_places_op.cc): one CPUPlace per
     requested device (default: all visible)."""
-    import jax
     n = device_count or max(
         len([d for d in jax.devices() if d.platform == "cpu"]), 1)
     return [CPUPlace(i) for i in range(n)]
@@ -107,7 +106,6 @@ def cpu_places(device_count=None):
 
 def tpu_places(device_ids=None):
     """TPU analog of fluid.cuda_places: one TPUPlace per chip."""
-    import jax
     if device_ids is None:
         device_ids = [d.id for d in jax.devices()
                       if d.platform != "cpu"] or [0]

@@ -55,7 +55,13 @@ def _bind_stage_fn(stage_fn, idx):
     sections live outside the trunk as the embed/head split."""
     try:
         import inspect
-        n = len(inspect.signature(stage_fn).parameters)
+        params = inspect.signature(stage_fn).parameters.values()
+        # only REQUIRED positional params count — **kwargs or an
+        # optional keyword must not be mistaken for the index slot
+        n = sum(1 for p in params
+                if p.kind in (p.POSITIONAL_ONLY,
+                              p.POSITIONAL_OR_KEYWORD)
+                and p.default is p.empty)
     except (TypeError, ValueError):
         n = 2
     if n >= 3:

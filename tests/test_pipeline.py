@@ -282,3 +282,72 @@ def test_heterogeneous_stage_fn_by_index():
         want = jnp.tanh(h) if i == 0 else jax.nn.relu(h)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_heterogeneous_stage_fn_1f1b_matches_gpipe():
+    """The 3-arg stage_fn path must work in BOTH the GPipe autodiff
+    schedule and the hand-scheduled 1F1B (forward AND vjp bindings)."""
+    B, n_stages, n_micro, d = 16, 4, 4, 8
+    mesh = _pipe_mesh(n_stages)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages + 2)
+    params = {
+        "embed": {"w": jax.random.normal(keys[0], (4, d)) * 0.3},
+        "stages": pl.stack_stage_params(
+            [_mk_stage(k, d) for k in keys[1:-1]]),
+        "head": {"w": jax.random.normal(keys[-1], (d, 1)) * 0.3},
+    }
+
+    def het_stage(sp, x, idx, scale=1.0):
+        # the optional kwarg must NOT swallow the index (regression for
+        # the arg-count heuristic)
+        h = (x @ sp["w"] + sp["b"]) * scale
+        return jnp.where(idx == 0, jnp.tanh(h), jax.nn.relu(h))
+
+    def embed_fn(ep, x):
+        return x @ ep["w"]
+
+    def loss_fn(hp, a, y):
+        return jnp.mean((a @ hp["w"] - y) ** 2)
+
+    rng = np.random.RandomState(5)
+    xb = jnp.asarray(rng.randn(B, 4).astype(np.float32))
+    yb = jnp.asarray(rng.randn(B, 1).astype(np.float32))
+
+    results = {}
+    for sched in ("gpipe", "1f1b"):
+        mod = pl.PipelineModule(mesh, embed_fn, het_stage, loss_fn,
+                                n_micro)
+        init_fn, step = mod.make_train_step(SGDOptimizer(0.1),
+                                            schedule=sched)
+        p, o = init_fn({k: jax.tree.map(jnp.array, v)
+                        for k, v in params.items()})
+        l, p, o = step(p, o, xb, yb)
+        results[sched] = (float(l), p)
+    np.testing.assert_allclose(results["gpipe"][0], results["1f1b"][0],
+                               rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(results["gpipe"][1]),
+                    jax.tree.leaves(results["1f1b"][1])):
+        np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                   np.asarray(jax.device_get(b)),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_stage_fn_optional_kwarg_not_miscounted():
+    """def stage(params, x, dropout_rate=0.1) must be treated as 2-arg
+    (no index injected into the kwarg slot)."""
+    d, n_stages, n_micro, mb = 4, 2, 2, 2
+    mesh = _pipe_mesh(n_stages)
+    keys = jax.random.split(jax.random.PRNGKey(0), n_stages)
+    stages = [_mk_stage(k, d) for k in keys]
+    stacked = pl.stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(1), (n_micro, mb, d))
+
+    def stage_with_kwarg(sp, x, scale=1.0):
+        return jnp.tanh((x @ sp["w"] + sp["b"]) * scale)
+
+    got = pl.pipeline_apply(mesh, stage_with_kwarg, stacked, x)
+    want = x
+    for sp in stages:
+        want = jnp.tanh(want @ sp["w"] + sp["b"])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
