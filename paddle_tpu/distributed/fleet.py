@@ -37,6 +37,23 @@ class DistributedStrategy:
         # (reference knob: DistributedStrategy.fuse_grad_size_in_MB)
         self.fuse_grad_size_in_MB = 32
         self.gradient_scale = "avg"      # avg|sum
+        # BuildStrategy.reduce_strategy parity (build_strategy.h:38-57):
+        # "all_reduce" (kAllReduce, params replicated) or "reduce"
+        # (kReduce realized as the ZeRO layout —
+        # DataParallelTrainer(param_sharding=...) consumes it via
+        # param_sharding_arg())
+        self.reduce_strategy = "all_reduce"
+
+    def param_sharding_arg(self):
+        """Maps the reduce_strategy knob to DataParallelTrainer's
+        param_sharding argument."""
+        if self.reduce_strategy in ("all_reduce", None):
+            return None
+        if self.reduce_strategy in ("reduce", "zero"):
+            return "reduce"
+        raise ValueError(
+            f"reduce_strategy={self.reduce_strategy!r}: expected "
+            f"'all_reduce' or 'reduce'")
 
 
 class DistributedOptimizer:

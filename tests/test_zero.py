@@ -159,3 +159,29 @@ class TestZeroLayout:
             "kReduce step compiled without a reduce-scatter"
         replicated = compiled_text(None)
         assert "reduce-scatter" not in replicated
+
+
+class TestFleetKnob:
+    def test_reduce_strategy_maps_to_param_sharding(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        s = DistributedStrategy()
+        assert s.param_sharding_arg() is None          # kAllReduce
+        s.reduce_strategy = "reduce"
+        assert s.param_sharding_arg() == "reduce"      # kReduce/ZeRO
+        s.reduce_strategy = "nope"
+        with pytest.raises(ValueError):
+            s.param_sharding_arg()
+
+    def test_knob_drives_trainer_end_to_end(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        s = DistributedStrategy()
+        s.reduce_strategy = "reduce"
+        mesh = make_mesh(MeshConfig(data=8))
+        tr = DataParallelTrainer(_loss_fn, pt.optimizer.SGD(0.1),
+                                 mesh=mesh,
+                                 param_sharding=s.param_sharding_arg(),
+                                 donate=False)
+        params, opt_state, state = tr.init(
+            _init_fn, jax.random.PRNGKey(0), _batch())
+        for k, v in params.items():
+            assert v.addressable_shards[0].data.size == v.size // 8
