@@ -236,3 +236,30 @@ flowers = _Synthetic(_flowers_sample, n_train=256, n_test=64)
 
 __all__ += ["movielens", "wmt14", "wmt16", "conll05", "sentiment",
             "voc2012", "mq2007", "flowers"]
+
+
+class _RealOnly:
+    """Dataset whose train()/test() always serve a REAL local corpus
+    (no network, no synthetic fallback needed)."""
+
+    def __init__(self, factory):
+        self._factory = factory
+
+    def train(self):
+        return self._factory("train")
+
+    def test(self):
+        return self._factory("test")
+
+
+def _digits_factory(split):
+    from paddle_tpu.dataio.common import digits_reader
+    return digits_reader(split)
+
+
+# real handwritten digits, available offline (sklearn bundle) — the
+# zero-egress stand-in for dataset.mnist in convergence runs
+# (BASELINE.md "Real-data convergence")
+digits = _RealOnly(_digits_factory)
+
+__all__ += ["digits"]
