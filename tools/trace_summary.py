@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Summarize a jax.profiler trace directory by device-time.
+
+The tools/timeline.py analog (ref: tools/timeline.py:131 converts the
+reference's profiler proto to chrome tracing): jax already emits
+chrome-trace JSON; this tool aggregates the device lanes into the
+per-HLO-category / per-op table used for the roofline and residue
+analyses in BASELINE.md (r2 ResNet roofline, r3 Transformer-big bound,
+r3 residue attribution).
+
+Usage:
+    python tools/trace_summary.py TRACE_DIR [--steps N] [--top K]
+
+where TRACE_DIR is the directory passed to jax.profiler.trace(...).
+--steps divides totals to per-step figures.
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import sys
+
+
+def summarize(trace_dir, steps=1, top=15):
+    paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        raise SystemExit(f"no *.trace.json.gz under {trace_dir}")
+    by_cat, by_name = {}, {}
+    total = 0.0
+    for p in paths:
+        with gzip.open(p, "rt") as f:
+            doc = json.load(f)
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") != "X":
+                continue
+            args = e.get("args") or {}
+            cat = args.get("hlo_category")
+            if cat is None:      # host lanes have no hlo_category
+                continue
+            dur = e.get("dur", 0)
+            by_cat[cat] = by_cat.get(cat, 0.0) + dur
+            key = e.get("name", "").split(".")[0][:48]
+            by_name[key] = by_name.get(key, 0.0) + dur
+            total += dur
+    if not total:
+        raise SystemExit("no device events with hlo_category found")
+
+    def table(d, title, k):
+        print(f"== {title} ==")
+        for name, us in sorted(d.items(), key=lambda kv: -kv[1])[:k]:
+            print(f"{name:48s} {us / steps / 1000:9.2f} ms/step "
+                  f"{us / total * 100:5.1f}%")
+
+    table(by_cat, "device time by HLO category", top)
+    table(by_name, "device time by op name", top)
+    print(f"device busy total: {total / steps / 1000:.2f} ms/step "
+          f"({len(paths)} trace file(s), steps={steps})")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("trace_dir")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="profiled step count (divides totals)")
+    ap.add_argument("--top", type=int, default=15)
+    a = ap.parse_args(argv)
+    summarize(a.trace_dir, a.steps, a.top)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
