@@ -185,6 +185,8 @@ class _SparseTable:
                 self._native = None
         self.rows = {}
         self.accum = {}               # adagrad per-row G accumulators
+        self._step = 0                # pull/push call counter (shrink)
+        self._touch = {}              # row id -> last touching step
         self._rng = np.random.RandomState(seed)
         self._init = initializer or (
             lambda rng, dim: rng.normal(0, 0.01, dim).astype(np.float32))
@@ -200,12 +202,14 @@ class _SparseTable:
         if self._native is not None:
             return self._native.pull(ids)
         with self.lock:
+            self._step += 1
             out = np.empty((len(ids), self.dim), np.float32)
             for i, x in enumerate(ids):
                 row = self.rows.get(int(x))
                 if row is None:
                     row = self._init(self._rng, self.dim)
                     self.rows[int(x)] = row
+                self._touch[int(x)] = self._step
                 out[i] = row
             return out
 
@@ -215,6 +219,7 @@ class _SparseTable:
             return
         lr = self.lr if lr is None else lr
         with self.lock:
+            self._step += 1
             for x, g in zip(ids, grads):
                 x = int(x)
                 row = self.rows.get(x)
@@ -228,6 +233,22 @@ class _SparseTable:
                 else:
                     row = row - lr * g
                 self.rows[x] = row
+                self._touch[x] = self._step
+
+    def shrink(self, max_age):
+        """Evict rows untouched for more than ``max_age`` pull/push
+        calls (FleetWrapper::ShrinkSparseTable parity,
+        fleet_wrapper.h:141). Returns evicted count."""
+        if self._native is not None:
+            return self._native.shrink(max_age)
+        with self.lock:
+            stale = [x for x in self.rows
+                     if self._step - self._touch.get(x, 0) > max_age]
+            for x in stale:
+                self.rows.pop(x, None)
+                self.accum.pop(x, None)
+                self._touch.pop(x, None)
+            return len(stale)
 
     def snapshot(self):
         """(ids, rows, accum) arrays for checkpoints."""
@@ -252,6 +273,11 @@ class _SparseTable:
             self.rows = {int(i): np.asarray(r, np.float32)
                          for i, r in zip(ids, rows)}
             self.accum = {}
+            # mirror the native import (ps_table.cc): restored rows are
+            # freshly touched, else the next shrink would evict the
+            # whole just-loaded table
+            self._step += 1
+            self._touch = {int(i): self._step for i in ids}
             if accum is not None and len(accum):
                 for i, a in zip(ids, accum):
                     a = np.asarray(a, np.float32)
@@ -360,6 +386,11 @@ class ParameterServer:
             (dirname,) = fields
             self.save(dirname)
             return (wire.OK, ())
+        if kind == wire.SHRINK_TABLE:
+            name, max_age = fields
+            removed = self.sparse[name].shrink(int(max_age))
+            return (wire.OK_ARR,
+                    (np.asarray([removed], np.int64),))
         if kind == wire.LIST_VARS:
             return (wire.OK_NAMES, ("\n".join(sorted(self.dense)),
                                     "\n".join(sorted(self.sparse))))
@@ -665,6 +696,14 @@ class PSClient:
     def push_sparse(self, table, ids, grads, lr=None):
         self._call(self._ep_of(table), wire.PUSH_SPARSE, table,
                    np.asarray(ids, np.int64), np.asarray(grads), lr)
+
+    def shrink_table(self, table, max_age):
+        """FleetWrapper::ShrinkSparseTable parity: evict rows untouched
+        for more than ``max_age`` pull/push calls. Returns evicted
+        count."""
+        out = self._call(self._ep_of(table), wire.SHRINK_TABLE, table,
+                         int(max_age))
+        return int(np.asarray(out).ravel()[0])
 
     # -- control -----------------------------------------------------------
     def barrier(self, tag="global"):

@@ -44,6 +44,7 @@ BARRIER = 5            # tag, trainer_id u64
 CHECKPOINT_NOTIFY = 6  # dirname
 LIST_VARS = 7          # -
 STOP = 8               # -
+SHRINK_TABLE = 9       # name, max_age u64
 # responses
 OK = 100               # -
 OK_ARR = 101           # arr
@@ -61,6 +62,7 @@ SCHEMAS = {
     CHECKPOINT_NOTIFY: (STR,),
     LIST_VARS: (),
     STOP: (),
+    SHRINK_TABLE: (STR, U64),
     OK: (),
     OK_ARR: (ARR,),
     OK_NAMES: (STR, STR),
@@ -71,7 +73,8 @@ SCHEMAS = {
 # BARRIER is here because its set-based fan-in is only idempotent
 # within an unreleased round: a retry landing after the release would
 # enroll the trainer in the NEXT generation and desynchronize rounds.
-MUTATING = {PUSH_GRAD, PUSH_SPARSE, CHECKPOINT_NOTIFY, STOP, BARRIER}
+MUTATING = {PUSH_GRAD, PUSH_SPARSE, CHECKPOINT_NOTIFY, STOP, BARRIER,
+            SHRINK_TABLE}
 
 _HDR = struct.Struct("<2sBBQQQ")
 _U16 = struct.Struct("<H")

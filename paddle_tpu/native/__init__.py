@@ -121,6 +121,8 @@ def _bind(lib):
                                        c_float_p, c_float_p]
     lib.pt_ps_table_import.argtypes = [c_void_p, c_int64_p, c_float_p,
                                        c_float_p, c_long]
+    lib.pt_ps_table_shrink.restype = c_long
+    lib.pt_ps_table_shrink.argtypes = [c_void_p, ctypes.c_uint64]
     return lib
 
 
@@ -442,6 +444,12 @@ class NativeSparseTable:
             self._h, self._ptr(ids, ctypes.c_int64),
             self._ptr(grads, ctypes.c_float), len(ids),
             -1.0 if lr is None else float(lr))
+
+    def shrink(self, max_age):
+        """Evict rows not pulled/pushed within the last ``max_age``
+        table calls (FleetWrapper::ShrinkSparseTable parity,
+        fleet_wrapper.h:141). Returns evicted row count."""
+        return int(self._lib.pt_ps_table_shrink(self._h, int(max_age)))
 
     def snapshot(self):
         """(ids [n], rows [n, dim], accum [n, dim]) for checkpoints.

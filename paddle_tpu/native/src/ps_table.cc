@@ -33,6 +33,11 @@ struct PsTable {
   std::mutex mu;
   std::unordered_map<int64_t, std::vector<float>> rows;
   std::unordered_map<int64_t, std::vector<float>> accum;  // adagrad G
+  // staleness tracking for shrink (FleetWrapper::ShrinkSparseTable
+  // parity): step bumps once per pull/push call, rows record the step
+  // that last touched them
+  uint64_t step = 0;
+  std::unordered_map<int64_t, uint64_t> last_touch;
 };
 
 inline uint64_t splitmix64(uint64_t x) {
@@ -92,8 +97,10 @@ long pt_ps_table_size(void* h) {
 void pt_ps_table_pull(void* h, const int64_t* ids, long n, float* out) {
   auto* t = static_cast<PsTable*>(h);
   std::lock_guard<std::mutex> g(t->mu);
+  ++t->step;
   for (long i = 0; i < n; ++i) {
     const auto& row = materialize(t, ids[i]);
+    t->last_touch[ids[i]] = t->step;
     std::memcpy(out + i * t->dim, row.data(), t->dim * sizeof(float));
   }
 }
@@ -106,8 +113,10 @@ void pt_ps_table_push(void* h, const int64_t* ids, const float* grads,
   auto* t = static_cast<PsTable*>(h);
   float rate = lr < 0 ? t->lr : lr;
   std::lock_guard<std::mutex> g(t->mu);
+  ++t->step;
   for (long i = 0; i < n; ++i) {
     auto& row = materialize(t, ids[i]);
+    t->last_touch[ids[i]] = t->step;
     const float* gi = grads + i * t->dim;
     if (t->opt == 1) {
       auto& acc = t->accum[ids[i]];
@@ -158,9 +167,12 @@ void pt_ps_table_import(void* h, const int64_t* ids, const float* rows,
   std::lock_guard<std::mutex> g(t->mu);
   t->rows.clear();
   t->accum.clear();
+  t->last_touch.clear();
+  ++t->step;
   for (long i = 0; i < n; ++i) {
     t->rows[ids[i]] =
         std::vector<float>(rows + i * t->dim, rows + (i + 1) * t->dim);
+    t->last_touch[ids[i]] = t->step;
     if (accum != nullptr) {
       const float* a = accum + i * t->dim;
       bool nonzero = false;
@@ -170,6 +182,28 @@ void pt_ps_table_import(void* h, const int64_t* ids, const float* rows,
       if (nonzero) t->accum[ids[i]] = std::vector<float>(a, a + t->dim);
     }
   }
+}
+
+// FleetWrapper::ShrinkSparseTable parity (fleet_wrapper.h:141): evict
+// rows not touched (pulled or pushed) within the last ``max_age``
+// pull/push calls. Returns the number of evicted rows.
+long pt_ps_table_shrink(void* h, uint64_t max_age) {
+  auto* t = static_cast<PsTable*>(h);
+  std::lock_guard<std::mutex> g(t->mu);
+  long removed = 0;
+  for (auto it = t->rows.begin(); it != t->rows.end();) {
+    auto lt = t->last_touch.find(it->first);
+    uint64_t touched = lt == t->last_touch.end() ? 0 : lt->second;
+    if (t->step - touched > max_age) {
+      t->accum.erase(it->first);
+      t->last_touch.erase(it->first);
+      it = t->rows.erase(it);
+      ++removed;
+    } else {
+      ++it;
+    }
+  }
+  return removed;
 }
 
 }  // extern "C"

@@ -198,3 +198,76 @@ class TestTrainStream:
         np.testing.assert_allclose(runs["float16"], runs["float32"],
                                    rtol=5e-2, atol=5e-3)
         assert runs["float16"][-1] < runs["float16"][0]
+
+
+class TestShrink:
+    """FleetWrapper::ShrinkSparseTable parity (fleet_wrapper.h:141):
+    stale-row eviction on both table backends and over the wire."""
+
+    def _exercise(self, table):
+        import numpy as np
+        # touch rows 1..4, then keep touching only 1..2
+        table.push(np.array([1, 2, 3, 4]), np.zeros((4, 4), np.float32))
+        for _ in range(5):
+            table.pull(np.array([1, 2]))
+        removed = table.shrink(max_age=3)
+        assert removed == 2, removed
+        assert len(table) == 2
+        # evicted rows re-materialize fresh on next touch
+        out = table.pull(np.array([3]))
+        assert out.shape == (1, 4)
+        assert len(table) == 3
+        # max_age larger than history: nothing evicted
+        assert table.shrink(max_age=10_000) == 0
+
+    def test_python_table_shrink(self):
+        from paddle_tpu.distributed.ps import _SparseTable
+        t = _SparseTable(4, initializer=lambda rng, d: rng.normal(
+            0, 0.01, d).astype("float32"))
+        assert t._native is None      # forced python path
+        self._exercise(t)
+
+    def test_native_table_shrink(self):
+        from paddle_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        from paddle_tpu.distributed.ps import _SparseTable
+        t = _SparseTable(4)
+        if t._native is None:
+            pytest.skip("native table not active")
+        self._exercise(t)
+
+    def test_shrink_over_the_wire(self):
+        import numpy as np
+        from paddle_tpu.distributed.ps import ParameterServer, PSClient
+        srv = ParameterServer("127.0.0.1:0")
+        srv.host_sparse("emb", dim=4)
+        srv.start()
+        try:
+            ep = f"127.0.0.1:{srv.port}"
+            cl = PSClient([ep], var_ep={"emb": ep}, trainer_id=0)
+            cl.push_sparse("emb", np.array([7, 8, 9]),
+                           np.zeros((3, 4), np.float32))
+            for _ in range(4):
+                cl.pull_sparse("emb", np.array([7]))
+            removed = cl.shrink_table("emb", max_age=2)
+            assert removed == 2
+            assert len(srv.sparse["emb"]) == 1
+        finally:
+            srv.stop()
+
+    def test_restore_then_shrink_keeps_rows(self):
+        """Regression: restored rows must count as freshly touched on
+        the python backend too (the native import already did)."""
+        import numpy as np
+        from paddle_tpu.distributed.ps import _SparseTable
+        t = _SparseTable(4, initializer=lambda rng, d: rng.normal(
+            0, 0.01, d).astype("float32"))
+        # age the table: many touches
+        for _ in range(20):
+            t.pull(np.array([1]))
+        ids, rows, accum = t.snapshot()
+        t.restore(np.array([5, 6], np.int64),
+                  np.zeros((2, 4), np.float32))
+        assert t.shrink(max_age=3) == 0       # freshly restored survive
+        assert len(t) == 2

@@ -65,6 +65,8 @@ def main(argv=None):
                     help="profiled step count (divides totals)")
     ap.add_argument("--top", type=int, default=15)
     a = ap.parse_args(argv)
+    if a.steps <= 0:
+        ap.error("--steps must be positive")
     summarize(a.trace_dir, a.steps, a.top)
 
 
