@@ -17,6 +17,9 @@ from paddle_tpu.parallel.collective import (
 from paddle_tpu.parallel.data_parallel import (
     DataParallelTrainer, shard_batch, replicate,
 )
-from paddle_tpu.parallel.env import ParallelEnv, get_rank, get_world_size
+from paddle_tpu.parallel.env import (
+    DataParallel, ParallelEnv, ParallelStrategy, get_rank,
+    get_world_size, prepare_context,
+)
 from paddle_tpu.parallel.local_sgd import LocalSGDTrainer
 from paddle_tpu.parallel import dgc

@@ -65,3 +65,72 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
         jax.distributed.initialize(coordinator_address, num_processes,
                                    process_id)
     return ParallelEnv()
+
+
+class ParallelStrategy:
+    """dygraph.parallel.ParallelStrategy parity (the prepare_context
+    product): carries world size + endpoints."""
+
+    def __init__(self, nranks=1, local_rank=0, trainer_endpoints=(),
+                 current_endpoint=""):
+        self.nranks = nranks
+        self.local_rank = local_rank
+        self.trainer_endpoints = list(trainer_endpoints)
+        self.current_endpoint = current_endpoint
+
+
+def prepare_context(strategy=None):
+    """dygraph.parallel.prepare_context parity (ref
+    dygraph/parallel.py:30): assemble the ParallelStrategy from the
+    process env. On TPU there is no NCCL context to initialize — the
+    runtime owns topology — so this is pure bookkeeping."""
+    if strategy is not None:
+        return strategy
+    env = ParallelEnv()
+    return ParallelStrategy(env.nranks, env.local_rank,
+                            env.trainer_endpoints, env.current_endpoint)
+
+
+class DataParallel:
+    """dygraph.parallel.DataParallel parity (ref dygraph/parallel.py:84)
+    in functional form: wraps an nn.Layer; ``scale_loss`` divides by the
+    replica count and ``apply_collective_grads`` mean-reduces a GRADIENT
+    TREE across replicas (the reference mutates grads in place; grads
+    are values here). scale_loss + psum == pmean, matching the
+    reference's scale-then-allreduce pair.
+
+    Inside SPMD (shard_map over the data axis) the reduction is
+    lax.pmean over ``axis_name``; outside any mapped context with
+    nranks == 1 both calls are identity — the reference's
+    non-data-parallel fallback.
+    """
+
+    def __init__(self, layers, strategy=None, axis_name="data"):
+        self._layers = layers
+        self._strategy = strategy or prepare_context()
+        self._axis = axis_name
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):       # delegate init/apply/sublayers
+        if name.startswith("_"):        # incl. unpickle probing before
+            raise AttributeError(name)  # __dict__ exists — no recursion
+        return getattr(self.__dict__["_layers"], name)
+
+    def scale_loss(self, loss):
+        n = max(self._strategy.nranks, 1)
+        return loss / n if n > 1 else loss
+
+    def apply_collective_grads(self, grads):
+        """grads tree -> psum'd tree over the data axis (use inside
+        shard_map/pmap; with scale_loss applied first the result is the
+        cross-replica mean, ref parallel.py:150,171)."""
+        if max(self._strategy.nranks, 1) == 1:
+            return grads
+        from paddle_tpu.parallel.collective import psum
+        return jax.tree.map(
+            lambda g: psum(g, axis_name=self._axis), grads)
+
+
+__all__ += ["ParallelStrategy", "prepare_context", "DataParallel"]
