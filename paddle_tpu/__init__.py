@@ -56,6 +56,7 @@ from paddle_tpu.framework import (
     ParamAttr, Variable, to_variable, no_grad, grad,
 )
 from paddle_tpu import backward
+from paddle_tpu import nets
 from paddle_tpu import distributions
 from paddle_tpu import contrib
 from paddle_tpu import inference

@@ -9,3 +9,6 @@ from paddle_tpu.core import enforce
 from paddle_tpu.core import flags
 from paddle_tpu.core import place
 from paddle_tpu.core import lod
+from paddle_tpu.core.enforce import EnforceNotMet, EOFException  # noqa: F401
+# fluid.core.EOFException is the reader-protocol loop terminator; users
+# catch it as core.EOFException, so expose it here

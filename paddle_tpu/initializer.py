@@ -148,3 +148,29 @@ TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
+
+
+# force_init_on_cpu / init_on_cpu (ref python/paddle/fluid/initializer.py):
+# on TPU, XLA owns initial placement — the flag is kept for API parity and
+# honored by host-side consumers that check it (dataio staging).
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu():
+    return _force_init_on_cpu_
+
+
+class _InitOnCPU:
+    def __enter__(self):
+        global _force_init_on_cpu_
+        self._prev = _force_init_on_cpu_
+        _force_init_on_cpu_ = True
+
+    def __exit__(self, *a):
+        global _force_init_on_cpu_
+        _force_init_on_cpu_ = self._prev
+
+
+def init_on_cpu():
+    """Context manager: initializers inside run on host (parity shim)."""
+    return _InitOnCPU()

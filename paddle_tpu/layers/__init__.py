@@ -92,6 +92,9 @@ _NARGS = {
     "sigmoid_focal_loss": 3, "roi_align": 2, "roi_pool": 2,
     "roi_perspective_transform": 2, "mine_hard_examples": 4,
     "psroi_pool": 2, "generate_proposals": 5, "box_decoder_and_assign": 4,
+    "dice_loss": 2, "sampled_softmax_with_cross_entropy": 2,
+    "deformable_roi_pooling": 3, "conv3d_transpose": 2,
+    "create_tensor": 0, "hierarchical_sigmoid": 4,
 }
 
 # ops whose first arg is a LIST of tensors
@@ -102,7 +105,8 @@ _NEEDS_RNG = {"dropout", "gaussian_random", "uniform_random",
               "truncated_gaussian_random", "randint", "sampling_id",
               "random_crop", "shuffle_batch",
               "uniform_random_batch_size_like",
-              "gaussian_random_batch_size_like"}
+              "gaussian_random_batch_size_like",
+              "sampled_softmax_with_cross_entropy"}
 
 _MULTI_OUT = {"topk": 2, "argsort": 2, "ctc_align": 2, "edit_distance": 2,
               "fake_quantize_abs_max": 2,
@@ -118,7 +122,8 @@ _MULTI_OUT = {"topk": 2, "argsort": 2, "ctc_align": 2, "edit_distance": 2,
               "bipartite_match": 2, "yolo_box": 2, "target_assign": 2,
               "generate_proposals": 3,
               "roi_perspective_transform": 3,
-              "mine_hard_examples": 2}
+              "mine_hard_examples": 2,
+              "ctc_greedy_decoder": 2, "unique": 2}
 
 
 def _bind_tensor_params(tparams, xs):
@@ -528,11 +533,39 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     return _apply_act(out, act)
 
 
+def _infer_transpose_fs(input, output_size, stride, padding, dilation,
+                        nd):
+    """conv_transpose filter-size inference when only output_size is
+    given (ref layers/nn.py conv2d_transpose: filter_size =
+    (output + 2*pad - (in-1)*stride + stride - 1) // dilation, per dim,
+    with dilation-adjusted rounding)."""
+    outs = output_size if isinstance(output_size, (list, tuple)) \
+        else (output_size,) * nd
+    sts = stride if isinstance(stride, (list, tuple)) else (stride,) * nd
+    pds = padding if isinstance(padding, (list, tuple)) else (padding,) * nd
+    dls = dilation if isinstance(dilation, (list, tuple)) \
+        else (dilation,) * nd
+    fs = []
+    for i in _builtin_range(nd):
+        in_sz = int(input.shape[2 + i])
+        k = (int(outs[i]) + 2 * pds[i] - (in_sz - 1) * sts[i]
+             + dls[i] - 1) // dls[i]
+        fs.append(k)
+    return tuple(fs)
+
+
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      stride=1, padding=0, dilation=1, groups=1,
                      param_attr=None, bias_attr=None, act=None,
                      use_cudnn=True, name=None):
     c_in = int(input.shape[1])
+    if filter_size is None:
+        if output_size is None:
+            raise EnforceNotMet(
+                "conv2d_transpose: one of output_size or filter_size "
+                "is required (layers/nn.py conv2d_transpose)")
+        filter_size = _infer_transpose_fs(input, output_size, stride,
+                                          padding, dilation, 2)
     fs = filter_size if isinstance(filter_size, (list, tuple)) \
         else (filter_size, filter_size)
     w = _make_param("conv2dT_w", (c_in, num_filters // groups) + tuple(fs),
@@ -934,3 +967,126 @@ def _multi_box_head_body(inputs, image, num_classes, aspect_ratios,
     locs_concat = reshape(locs_concat, shape=[0, -1, 4])
     confs_concat = reshape(confs_concat, shape=[0, -1, num_classes])
     return locs_concat, confs_concat, box, var
+
+
+# ---------------------------------------------------------------------------
+# remaining fluid.layers.nn surface (r3 tail): parameterized 3-D convs,
+# hsigmoid, hash, cvm alias, step counter
+# ---------------------------------------------------------------------------
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           use_cudnn=True, name=None):
+    """fluid.layers.conv3d parity (conv_op.cc 3-D); NCDHW."""
+    c_in = int(input.shape[1])
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = _make_param("conv3d_w", (num_filters, c_in // groups) + tuple(fs),
+                    jnp.float32, param_attr, I.MSRA(uniform=False))
+    out = _conv_dispatch("conv3d", _ops.conv3d, input, w,
+                         dict(stride=stride, padding=padding,
+                              dilation=dilation, groups=groups))
+    if bias_attr is not False:
+        b = _make_param("conv3d_b", (num_filters,), jnp.float32, bias_attr,
+                        I.Constant(0.0))
+        out = elementwise_add(out, b, axis=1)
+    return _apply_act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     use_cudnn=True, name=None):
+    """fluid.layers.conv3d_transpose parity (conv_transpose_op.cc 3-D);
+    weight layout IODHW like the reference."""
+    c_in = int(input.shape[1])
+    if filter_size is None:
+        if output_size is None:
+            raise EnforceNotMet(
+                "conv3d_transpose: one of output_size or filter_size "
+                "is required")
+        filter_size = _infer_transpose_fs(input, output_size, stride,
+                                          padding, dilation, 3)
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size,) * 3
+    w = _make_param("conv3dT_w", (c_in, num_filters // groups) + tuple(fs),
+                    jnp.float32, param_attr, I.Xavier())
+    out = _conv_dispatch("conv3d_transpose", _ops.conv3d_transpose, input, w,
+                         dict(stride=stride, padding=padding,
+                              dilation=dilation, groups=groups))
+    if bias_attr is not False:
+        b = _make_param("conv3dT_b", (num_filters,), jnp.float32, bias_attr,
+                        I.Constant(0.0))
+        out = elementwise_add(out, b, axis=1)
+    return _apply_act(out, act)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    """fluid.layers.hsigmoid parity (hierarchical_sigmoid_op.cc): creates
+    the internal-node weight/bias like the reference layer, then runs the
+    complete-binary-tree walk in ops.misc.hierarchical_sigmoid. Custom
+    trees (path_table/path_code) are not supported on this path."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError("hsigmoid: default complete tree only")
+    dim = int(input.shape[-1])
+    w = _make_param("hsigmoid_w", (num_classes - 1, dim), jnp.float32,
+                    param_attr, I.Xavier())
+    b = (_make_param("hsigmoid_b", (num_classes - 1,), jnp.float32,
+                     bias_attr, I.Constant(0.0))
+         if bias_attr is not False else jnp.zeros((num_classes - 1,)))
+    lab = reshape(label, shape=[-1])      # op walks flat [B] leaf ids
+    out = hierarchical_sigmoid(input, w, b, lab, num_classes)
+    return reshape(out, shape=[-1, 1])
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001 (fluid name)
+    """fluid.layers.hash parity over ops.misc.hash_embedding_ids
+    (hash_op.cc): num_hash independent hashes of the id sequence modulo
+    hash_size."""
+    return hash_embedding_ids(input, hash_size, num_hash=num_hash)
+
+
+def continuous_value_model(input, cvm_input=None, use_cvm=True):
+    """fluid.layers.continuous_value_model parity (cvm_op.cc). The
+    second argument (the raw show/click columns) is part of the input's
+    first two columns in this implementation, matching the op kernel."""
+    return cvm(input, use_cvm=use_cvm)      # wrapped op: works both modes
+
+
+def _increment_inplace_compute(ins, attrs):
+    return {"Out": [jnp.asarray(ins["X"][0])
+                    + jnp.asarray(attrs.get("value", 1)).astype(
+                        jnp.asarray(ins["X"][0]).dtype)]}
+
+
+OP_REGISTRY["increment_inplace"] = _increment_inplace_compute
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """fluid.layers.autoincreased_step_counter parity (layers/nn.py):
+    a persistable int64 counter incremented once per executor run (the
+    output var IS the counter var, so the whole-block jit writes it back
+    to the scope — the in-place semantics of the reference's increment
+    op)."""
+    name = counter_name or "@STEP_COUNTER@"
+    blk = default_main_program().global_block()
+    if blk.has_var(name):
+        counter = blk.var(name)
+    else:
+        # reference init is Constant(begin - 1) then increment-by-step,
+        # so the first read is begin - 1 + step (layers/nn.py)
+        counter = create_global_var([1], float(begin - 1), dtype="int64",
+                                    persistable=True, name=name)
+    blk.append_op(type="increment_inplace", inputs={"X": [name]},
+                  outputs={"Out": [name]}, attrs={"value": step})
+    return counter
+
+
+# fluid.layers.io surface (reader builders; see layers/io.py)
+from paddle_tpu.layers import io as io                       # noqa: E402
+from paddle_tpu.layers.io import (                           # noqa: E402
+    py_reader, create_py_reader_by_data, read_file, double_buffer,
+    batch, shuffle, load, open_files, random_data_generator, Preprocessor,
+)

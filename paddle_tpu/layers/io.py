@@ -1,0 +1,365 @@
+"""fluid.layers.io parity surface.
+
+Parity: python/paddle/fluid/layers/io.py (data, py_reader,
+create_py_reader_by_data, double_buffer, batch, shuffle, read_file,
+load, open_files, random_data_generator, Preprocessor) over the
+reference's reader-op machinery (operators/reader/create_py_reader_op.cc,
+double_buffer, shuffle/batch readers, open_files; Preprocessor sub-block).
+
+TPU-native shape: the reference builds a chain of *reader ops* inside the
+program, drained by a blocking queue; here a reader is a host-side object
+that yields ready feed dicts (device transfer is double-buffered by the
+dataio.PyReader thread — buffered_reader.cc's role). Two protocols, like
+the reference:
+
+- iterable: ``for feed in reader: exe.run(main, feed=feed)``
+- start/reset (the reference's non-iterable mode): ``reader.start()``
+  then ``exe.run(main)`` with NO feed — the executor pulls the next
+  batch from every started reader attached to the program — until
+  ``core.EOFException`` is raised; then ``reader.reset()``.
+"""
+
+import numpy as np
+
+from paddle_tpu.core.enforce import EnforceNotMet, EOFException
+from paddle_tpu.core.dtypes import convert_dtype
+from paddle_tpu.framework import unique_name
+from paddle_tpu.static.program import (
+    data, default_main_program, in_static_mode, Program, program_guard,
+)
+
+__all__ = [
+    "data", "py_reader", "create_py_reader_by_data", "read_file",
+    "double_buffer", "batch", "shuffle", "load", "open_files",
+    "random_data_generator", "Preprocessor",
+]
+
+
+class StaticPyReader:
+    """The object `layers.py_reader` returns: owns the program's data
+    vars and a host-side source; yields feed dicts with async
+    device-transfer (dataio.PyReader worker thread)."""
+
+    def __init__(self, vars_, capacity, use_double_buffer=True,
+                 program=None):
+        self.vars = list(vars_)
+        self.capacity = capacity
+        self.use_double_buffer = use_double_buffer
+        self._source = None          # callable -> iterator of feed dicts
+        self._started = False
+        self._it = None
+        prog = program or default_main_program()
+        if not hasattr(prog, "_py_readers"):
+            prog._py_readers = []
+        prog._py_readers.append(self)
+
+    # -- decoration (fluid PyReader surface) ------------------------------
+    def decorate_paddle_reader(self, reader, places=None):
+        """reader yields BATCHES as lists of sample tuples (the
+        fluid idiom: decorate_paddle_reader(paddle.batch(...)))."""
+        names = [v.name for v in self.vars]
+
+        def src():
+            from paddle_tpu.dataio.feeder import DataFeeder
+            feeder = DataFeeder(names)
+            for samples in reader():
+                yield feeder.feed(samples)
+        self._source = src
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader, places=None):
+        """reader yields tuples of already-batched arrays."""
+        names = [v.name for v in self.vars]
+
+        def src():
+            for arrays in reader():
+                if not isinstance(arrays, (tuple, list)):
+                    arrays = (arrays,)
+                yield {n: np.asarray(a) for n, a in zip(names, arrays)}
+        self._source = src
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # -- iterable protocol -------------------------------------------------
+    def _iter_feeds(self):
+        if self._source is None:
+            raise EnforceNotMet(
+                "py_reader has no data source: call "
+                "decorate_paddle_reader / decorate_tensor_provider first")
+        if not self.use_double_buffer:
+            yield from self._source()
+            return
+        # async prefetch: stage batches ahead on a worker thread
+        from paddle_tpu.dataio.pyreader import PyReader as _AsyncReader
+        r = _AsyncReader(capacity=self.capacity)
+        r.decorate_batch_generator(self._source)
+        yield from iter(r)
+
+    def __iter__(self):
+        return self._iter_feeds()
+
+    # -- start/reset protocol (non-iterable fluid mode) -------------------
+    def start(self):
+        self._it = self._iter_feeds()
+        self._started = True
+
+    def reset(self):
+        self._it = None
+        self._started = False
+
+    def _next_feed(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._started = False
+            raise EOFException(
+                "py_reader exhausted — call reader.reset()") from None
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """fluid.layers.py_reader parity: creates one data var per
+    (shape, dtype) and returns the reader object (the reference returns
+    a reader Variable; read_file() recovers the vars either way)."""
+    base = name or unique_name.generate("py_reader")
+    vars_ = []
+    for i, (shp, dt) in enumerate(zip(shapes, dtypes)):
+        shp = list(shp)
+        # fluid passes batch-full shapes; keep them verbatim
+        vars_.append(data(f"{base}_{i}", shp, dtype=convert_dtype(dt),
+                          append_batch_size=False))
+    return StaticPyReader(vars_, capacity, use_double_buffer)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """fluid.layers.create_py_reader_by_data parity: like py_reader but
+    reuses existing data vars."""
+    return StaticPyReader(feed_list, capacity, use_double_buffer)
+
+
+def read_file(reader):
+    """fluid.layers.read_file parity: the vars a reader feeds."""
+    vars_ = reader.vars
+    return vars_[0] if len(vars_) == 1 else list(vars_)
+
+
+def double_buffer(reader, place=None, name=None):
+    """fluid.layers.double_buffer parity. The dataio.PyReader worker
+    thread IS the double buffer (host→HBM transfer overlapped with the
+    step — buffered_reader.cc's role); this just forces it on."""
+    if isinstance(reader, StaticPyReader):
+        reader.use_double_buffer = True
+        return reader
+    from paddle_tpu import reader as _rdr
+    return _rdr.buffered(reader, 2)
+
+
+class _TransformedReader:
+    """A reader-op chain link (batch/shuffle applied to a py_reader /
+    open_files reader): keeps the StaticPyReader interface — ``vars``,
+    iteration, start()/reset() — while transforming the feed stream,
+    the way the reference chains create_batch_reader /
+    create_shuffle_reader ops over an underlying file reader."""
+
+    def __init__(self, underlying, transform):
+        self.underlying = underlying
+        self._transform = transform
+        self._started = False
+        self._it = None
+        prog = default_main_program()
+        if not hasattr(prog, "_py_readers"):
+            prog._py_readers = []
+        prog._py_readers.append(self)
+
+    @property
+    def vars(self):
+        return self.underlying.vars
+
+    def __iter__(self):
+        return self._transform(iter(self.underlying))
+
+    def start(self):
+        self._it = iter(self)
+        self._started = True
+
+    def reset(self):
+        self._it = None
+        self._started = False
+
+    def _next_feed(self):
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._started = False
+            raise EOFException(
+                "reader exhausted — call reader.reset()") from None
+
+
+def batch(reader, batch_size):
+    """fluid.layers.batch parity (create_batch_reader op). Accepts
+    either a reader object from this module (open_files / py_reader —
+    stacks each var's per-record arrays into a batch axis) or a plain
+    sample-yielding callable (returns a callable yielding lists of
+    sample tuples, the decorate_paddle_reader format)."""
+    if hasattr(reader, "vars"):          # reader-op chain form
+        def transform(feeds):
+            buf = []
+            for feed in feeds:
+                buf.append(feed)
+                if len(buf) == batch_size:
+                    yield {k: np.stack([np.asarray(f[k]) for f in buf])
+                           for k in buf[0]}
+                    buf = []
+            if buf:
+                yield {k: np.stack([np.asarray(f[k]) for f in buf])
+                       for k in buf[0]}
+        return _TransformedReader(reader, transform)
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample if isinstance(sample, tuple) else (sample,))
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+    return batched
+
+
+def shuffle(reader, buffer_size):
+    """fluid.layers.shuffle parity (create_shuffle_reader op): buffered
+    shuffle over a reader object or a plain reader callable."""
+    if hasattr(reader, "vars"):          # reader-op chain form
+        rng = np.random.RandomState(0)
+
+        def transform(feeds):
+            buf = []
+            for feed in feeds:
+                buf.append(feed)
+                if len(buf) >= buffer_size:
+                    rng.shuffle(buf)
+                    while buf:
+                        yield buf.pop()
+            rng.shuffle(buf)
+            while buf:
+                yield buf.pop()
+        return _TransformedReader(reader, transform)
+    from paddle_tpu import reader as _rdr
+    return _rdr.shuffle(reader, buffer_size)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """fluid.layers.load parity (load_op.cc): append a load op writing
+    ``file_path``'s value into var ``out`` when the program runs."""
+    from paddle_tpu.static.io import append_load_op
+    return append_load_op(default_main_program(), [out], file_path)
+
+
+def open_files(filenames, shapes, dtypes, thread_num=None,
+               buffer_size=None, pass_num=1, is_test=None, name=None):
+    """fluid.layers.open_files parity (open_files_op): a py_reader fed
+    from RecordIO files. Record format: each record is an ``np.savez``
+    archive holding arrays ``f0..fN`` for the N slots (the TPU-native
+    stand-in for the reference's LoDTensor wire records)."""
+    import io as _io
+    rdr = py_reader(buffer_size or 64, shapes, dtypes, name=name)
+
+    def source():
+        from paddle_tpu import native
+        for _ in range(pass_num):
+            for path in filenames:
+                with native.RecordIOScanner(path) as scan:
+                    for rec in scan:
+                        with np.load(_io.BytesIO(rec)) as z:
+                            yield tuple(z[f"f{i}"]
+                                        for i in range(len(shapes)))
+    rdr.decorate_tensor_provider(source)
+    return rdr
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+    """fluid.layers.random_data_generator parity: a reader producing
+    uniform floats in [low, high) with the given shapes (test-data
+    generator, create_random_data_generator_op)."""
+    rdr = py_reader(8, shapes, ["float32"] * len(shapes))
+    rng = np.random.RandomState(0)
+
+    def source():
+        while True:
+            yield tuple(rng.uniform(low, high, size=s).astype(np.float32)
+                        for s in shapes)
+    rdr.decorate_tensor_provider(source)
+    return rdr
+
+
+class Preprocessor:
+    """fluid.layers.Preprocessor parity: a per-batch transform expressed
+    as a sub-program (the reference builds a sub-block executed by the
+    preprocessing reader op; here the block is traced into its own
+    Program and run — jit-compiled and cached — over each batch before
+    it is fed).
+
+    Usage (same as fluid)::
+
+        p = Preprocessor(reader)
+        with p.block():
+            x, y = p.inputs()
+            p.outputs(x / 255., y)
+        out_vars = fluid.layers.read_file(p)
+        for feed in p: exe.run(main, feed=feed)
+    """
+
+    def __init__(self, reader, name=None):
+        self.underlying = reader
+        self.sub_program = Program()
+        self._in_vars = None
+        self._out_vars = None
+        self.vars = None             # main-program output vars
+        self._guard = None
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            with program_guard(self.sub_program, Program()):
+                yield
+            self._finalize()
+        return guard()
+
+    def inputs(self):
+        self._in_vars = [
+            data(f"_pp_in_{i}", list(v.shape), dtype=str(np.dtype(v.dtype)),
+                 append_batch_size=False)
+            for i, v in enumerate(self.underlying.vars)]
+        return list(self._in_vars)
+
+    def outputs(self, *outs):
+        self._out_vars = list(outs)
+
+    def _finalize(self):
+        if not self._out_vars:
+            raise EnforceNotMet("Preprocessor.block set no outputs()")
+        # declare main-program vars carrying the transformed batches
+        self.vars = [
+            data(f"_pp_out_{i}", list(v.shape),
+                 dtype=str(np.dtype(v.dtype)), append_batch_size=False)
+            for i, v in enumerate(self._out_vars)]
+
+    def __iter__(self):
+        from paddle_tpu.static.executor import Executor
+        exe = Executor()
+        in_names = [v.name for v in self._in_vars]
+        out_names = [v.name for v in self._out_vars]
+        new_names = [v.name for v in self.vars]
+        for feed in self.underlying:
+            vals = list(feed.values()) if isinstance(feed, dict) else feed
+            sub_feed = {n: np.asarray(v) for n, v in zip(in_names, vals)}
+            outs = exe.run(self.sub_program, feed=sub_feed,
+                           fetch_list=out_names)
+            yield {n: o for n, o in zip(new_names, outs)}

@@ -9,7 +9,7 @@ import numpy as np
 
 __all__ = [
     "MetricBase", "Accuracy", "Precision", "Recall", "Auc",
-    "CompositeMetric", "ChunkEvaluator", "EditDistance",
+    "CompositeMetric", "ChunkEvaluator", "EditDistance", "DetectionMAP",
 ]
 
 
@@ -187,3 +187,38 @@ class EditDistance(MetricBase):
         avg = self.total / max(self.count, 1)
         acc = self.correct / max(self.count, 1)
         return avg, acc
+
+
+class DetectionMAP(MetricBase):
+    """fluid.metrics.DetectionMAP parity: accumulates per-batch
+    detections + ground truth and evaluates mean average precision via
+    ops.detection.detection_map (detection_map_op.cc)."""
+
+    def __init__(self, name=None, class_num=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__(name)
+        self.class_num = class_num
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []
+        self._gt_labels = []
+        self._gt_boxes = []
+
+    def update(self, detect_res, gt_label, gt_box):
+        self._dets.append(np.asarray(detect_res))
+        self._gt_labels.append(np.asarray(gt_label))
+        self._gt_boxes.append(np.asarray(gt_box))
+
+    def eval(self):
+        from paddle_tpu.ops.detection import detection_map
+        if self.class_num is None:
+            raise ValueError("DetectionMAP needs class_num")
+        return detection_map(
+            self._dets, self._gt_labels, self._gt_boxes, self.class_num,
+            overlap_threshold=self.overlap_threshold,
+            evaluate_difficult=self.evaluate_difficult,
+            ap_type=self.ap_version)

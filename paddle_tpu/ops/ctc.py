@@ -11,7 +11,8 @@ autodiff (the reference needed a hand-written CUDA gradient).
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ctc_loss", "warpctc", "ctc_align", "edit_distance"]
+__all__ = ["ctc_loss", "warpctc", "ctc_align", "ctc_greedy_decoder",
+           "edit_distance"]
 
 _NEG = -1e30
 
@@ -188,3 +189,22 @@ def edit_distance(input, label, input_length=None, label_length=None,
 
     dists = jax.vmap(one)(hyp, ref, hlen, rlen)
     return dists, jnp.asarray(b, jnp.int32)
+
+
+def ctc_greedy_decoder(input, blank=None, input_length=None,
+                       padding_value=0, name=None):
+    """fluid.layers.ctc_greedy_decoder parity (layers/nn.py
+    ctc_greedy_decoder): argmax over classes per frame, then the
+    merge-repeats/drop-blanks collapse — i.e. ctc_align over the argmax
+    path. ``blank`` defaults to num_classes-1 like the reference.
+
+    Returns (decoded [B, T] padded with ``padding_value``, lengths [B]).
+    """
+    input = jnp.asarray(input)
+    if input.ndim != 3:
+        raise ValueError("ctc_greedy_decoder expects [batch, time, classes]")
+    if blank is None:
+        blank = input.shape[-1] - 1
+    path = jnp.argmax(input, axis=-1)
+    return ctc_align(path, input_length=input_length, blank=blank,
+                     padding_value=padding_value)

@@ -17,7 +17,7 @@ __all__ = [
     "smooth_l1", "huber_loss", "log_loss", "hinge_loss",
     "margin_rank_loss", "rank_loss", "kldiv_loss", "bpr_loss", "cos_sim",
     "modified_huber_loss", "mse_loss", "teacher_student_sigmoid_loss",
-    "npair_loss",
+    "npair_loss", "dice_loss", "sampled_softmax_with_cross_entropy",
 ]
 
 
@@ -185,3 +185,59 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1)
     l2 = jnp.mean(jnp.sum(jnp.square(anchor) + jnp.square(positive), axis=1))
     return jnp.mean(ce) + l2_reg * l2 * 0.25
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """fluid.layers.dice_loss parity (python/paddle/fluid/layers/nn.py
+    dice_loss): input is per-class probabilities [..., C], label holds
+    class indices [..., 1]; loss = 1 - 2*|X∩Y| / (|X|+|Y|)."""
+    input = jnp.asarray(input)
+    lab = _squeeze_label(label).astype(jnp.int32)
+    one_hot = jax.nn.one_hot(lab, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inse = jnp.sum(input * one_hot, axis=reduce_dims)
+    dice_denom = (jnp.sum(input, axis=reduce_dims)
+                  + jnp.sum(one_hot, axis=reduce_dims))
+    dice = (2.0 * inse + epsilon) / (dice_denom + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       remove_accidental_hits=True,
+                                       seed=0, rng=None, name=None):
+    """fluid.layers.sampled_softmax_with_cross_entropy parity
+    (sample_logits_op.cc + softmax_with_cross_entropy): softmax CE
+    evaluated over {true class} ∪ {num_samples uniform negatives}
+    instead of the full vocabulary.
+
+    TPU-first shape discipline: the sampled class set is a static
+    [B, 1+num_samples] gather, so the op stays jit-compatible (no
+    dynamic vocab-sized scatter). Sampling is uniform over the vocab
+    (the reference's default sampler is log-uniform over *shuffled*
+    ids, which is uniform in distribution).
+    """
+    from paddle_tpu.core import random as ptrandom
+    logits = jnp.asarray(logits)
+    lab = _squeeze_label(label).astype(jnp.int32)
+    b, v = logits.shape
+    if use_customized_samples:
+        samples = jnp.asarray(customized_samples).astype(jnp.int32)
+        if samples.ndim == 1:
+            samples = jnp.broadcast_to(samples[None, :], (b, samples.shape[0]))
+    else:
+        if rng is None:
+            rng = ptrandom.key_for(seed)
+        samples = jax.random.randint(rng, (b, num_samples), 0, v)
+    classes = jnp.concatenate([lab[:, None], samples], axis=1)  # [B, 1+S]
+    picked = jnp.take_along_axis(logits, classes, axis=1)
+    if remove_accidental_hits:
+        # a sampled negative equal to the true class would cancel the
+        # true logit; push it to -inf like the reference's kernel
+        hit = classes[:, 1:] == lab[:, None]
+        picked = picked.at[:, 1:].set(
+            jnp.where(hit, jnp.finfo(picked.dtype).min, picked[:, 1:]))
+    loss = -jax.nn.log_softmax(picked, axis=1)[:, 0]
+    return loss[:, None]

@@ -661,4 +661,31 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
     return jax.vmap(one)(boxes, bidx, tr)
 
 
-__all__ += ["conv2d_fusion", "deformable_psroi_pooling"]
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=1,
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """fluid.layers.deformable_roi_pooling parity (layers/nn.py
+    deformable_roi_pooling over deformable_psroi_pooling_op.cc): the
+    user-facing wrapper. position_sensitive=False pools each input
+    channel (group 1); True is the R-FCN position-sensitive layout."""
+    x = jnp.asarray(input)
+    if pooled_height != pooled_width:
+        raise NotImplementedError(
+            "deformable_roi_pooling: square pooled output only")
+    g = group_size[0] if isinstance(group_size, (list, tuple)) else group_size
+    if position_sensitive:
+        oc = x.shape[1] // (g * g)
+    else:
+        g, oc = 1, x.shape[1]
+    if isinstance(part_size, (list, tuple)):
+        part_size = part_size[0]
+    return deformable_psroi_pooling(
+        x, rois, None if no_trans else trans, oc, g, pooled_height,
+        part_size=part_size, spatial_scale=spatial_scale,
+        sample_per_part=sample_per_part, trans_std=trans_std)
+
+
+__all__ += ["conv2d_fusion", "deformable_psroi_pooling",
+            "deformable_roi_pooling"]

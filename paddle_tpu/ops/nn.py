@@ -19,12 +19,15 @@ from jax import lax
 from paddle_tpu.core import random as ptrandom
 
 __all__ = [
-    "conv2d", "conv2d_transpose", "conv3d", "depthwise_conv2d", "pool2d",
-    "pool3d", "adaptive_pool2d", "batch_norm", "layer_norm", "group_norm",
+    "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "depthwise_conv2d", "pool2d",
+    "pool3d", "adaptive_pool2d", "adaptive_pool3d",
+    "batch_norm", "layer_norm", "group_norm",
     "instance_norm", "data_norm", "sync_batch_norm", "dropout",
     "embedding", "one_hot",
     "label_smooth", "lrn", "pad", "pad2d", "pad_constant_like",
-    "interpolate", "resize_nearest", "resize_bilinear", "pixel_shuffle",
+    "interpolate", "resize_nearest", "resize_bilinear", "image_resize",
+    "image_resize_short", "pixel_shuffle",
     "affine_channel", "unfold", "space_to_depth", "shuffle_channel",
     "fc_act",
 ]
@@ -72,6 +75,35 @@ def conv3d(x, weight, stride=1, padding=0, dilation=1, groups=1, name=None):
     return lax.conv_general_dilated(
         x, weight, window_strides=_pair(stride, 3),
         padding=_conv_padding(padding, 3), rhs_dilation=_pair(dilation, 3),
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+def conv3d_transpose(x, weight, stride=1, padding=0, dilation=1, groups=1,
+                     name=None):
+    """conv_transpose_op.cc 3-D parity. Weight layout IODHW
+    (in, out/groups, kd, kh, kw), same filter convention as
+    conv2d_transpose; lowered as the gradient-of-conv formulation
+    (lhs-dilation) so XLA maps it onto the MXU like a forward conv."""
+    stride, dilation = _pair(stride, 3), _pair(dilation, 3)
+    pads = _pair(padding, 3)
+    kd, kh, kw = weight.shape[2], weight.shape[3], weight.shape[4]
+    dn = lax.conv_dimension_numbers(
+        x.shape,
+        (weight.shape[1] * groups, weight.shape[0] // groups, kd, kh, kw),
+        ("NCDHW", "OIDHW", "NCDHW"))
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    cin, cog = weight.shape[0], weight.shape[1]
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        w = w.reshape(groups, cin // groups, cog, kd, kh, kw)
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            groups * cog, cin // groups, kd, kh, kw)
+    pad = [(dilation[i] * (k - 1) - pads[i],) * 2
+           for i, k in enumerate((kd, kh, kw))]
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pad,
+        lhs_dilation=stride, rhs_dilation=dilation,
         dimension_numbers=dn, feature_group_count=groups)
 
 
@@ -158,6 +190,20 @@ def adaptive_pool2d(x, pool_size, pool_type="avg", name=None):
         x = x.reshape(n, c, oh, h // oh, ow, w // ow)
         return (jnp.max if pool_type == "max" else jnp.mean)(x, axis=(3, 5))
     raise NotImplementedError("adaptive_pool2d needs divisible sizes")
+
+
+def adaptive_pool3d(x, pool_size, pool_type="avg", name=None):
+    """Adaptive 3-D pooling (pool_op.cc adaptive=True over NCDHW; ref
+    python/paddle/fluid/layers/nn.py adaptive_pool3d). Static-shape TPU
+    form: requires output sizes that divide the input (the common case;
+    XLA cannot tile data-dependent windows onto the MXU anyway)."""
+    n, c, d, h, w = x.shape
+    od, oh, ow = _pair(pool_size, 3)
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        return (jnp.max if pool_type == "max" else jnp.mean)(
+            x, axis=(3, 5, 7))
+    raise NotImplementedError("adaptive_pool3d needs divisible sizes")
 
 
 def batch_norm(x, scale, bias, mean, variance, epsilon=1e-5, momentum=0.9,
@@ -383,6 +429,27 @@ def resize_nearest(x, out_shape=None, scale=None, align_corners=True, name=None)
 
 def resize_bilinear(x, out_shape=None, scale=None, align_corners=True, name=None):
     return interpolate(x, out_shape, scale, "BILINEAR", align_corners)
+
+
+def image_resize(x, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, name=None):
+    """fluid.layers.image_resize parity (layers/nn.py image_resize):
+    the user-facing dispatcher over interpolate_op.cc."""
+    if resample.upper() not in ("BILINEAR", "NEAREST"):
+        raise ValueError(
+            f"image_resize: resample must be BILINEAR or NEAREST, "
+            f"got {resample}")
+    return interpolate(x, out_shape, scale, resample.upper(), align_corners)
+
+
+def image_resize_short(x, out_short_len, resample="BILINEAR", name=None):
+    """fluid.layers.image_resize_short parity: resize so the SHORT edge
+    becomes out_short_len, keeping aspect ratio."""
+    n, c, h, w = x.shape
+    short = min(h, w)
+    oh = int(round(h * out_short_len / short))
+    ow = int(round(w * out_short_len / short))
+    return image_resize(x, (oh, ow), None, resample)  # shares validation
 
 
 def pixel_shuffle(x, upscale_factor, name=None):

@@ -28,7 +28,8 @@ __all__ = [
     "tile", "shape", "size", "fill_constant", "fill_constant_batch_size_like",
     "zeros", "ones", "zeros_like", "ones_like", "full_like", "assign",
     "argmax", "argmin", "argsort", "topk", "where", "where_index", "diag",
-    "linspace", "arange", "reverse", "unique_with_counts", "is_empty",
+    "linspace", "arange", "reverse", "unique", "unique_with_counts",
+    "is_empty", "has_inf", "has_nan", "rank", "create_tensor",
     "multiplex", "crop", "roll", "flip", "meshgrid", "eye",
 ]
 
@@ -304,3 +305,34 @@ def meshgrid(*args):
 
 def eye(num_rows, num_columns=None, dtype="float32"):
     return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))
+
+
+def unique(x, dtype="int32", name=None):
+    """unique_op.cc / fluid.layers.unique parity: returns (out, index)
+    where ``index`` maps each element of x to its position in ``out``
+    (eager only: dynamic output shape, same constraint as the
+    reference's LoD-producing form)."""
+    out, index, _ = unique_with_counts(x, dtype=dtype)
+    return out, index
+
+
+def has_inf(x, name=None):
+    """isfinite family (fluid.layers.has_inf): scalar "any inf"."""
+    return jnp.any(jnp.isinf(jnp.asarray(x)))
+
+
+def has_nan(x, name=None):
+    """fluid.layers.has_nan: scalar "any nan"."""
+    return jnp.any(jnp.isnan(jnp.asarray(x)))
+
+
+def rank(input, name=None):
+    """fluid.layers.rank: 0-D int tensor holding the number of
+    dimensions. Static under jit (shape is trace-time constant)."""
+    return jnp.asarray(jnp.asarray(input).ndim, jnp.int32)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """fluid.layers.tensor.create_tensor parity: an empty typed tensor
+    to be filled by assign/fill ops later (eager: 0-size array)."""
+    return jnp.zeros((0,), convert_dtype(dtype))

@@ -197,6 +197,15 @@ class Executor:
             return_numpy=True):
         program = program or default_main_program()
         feed = feed or {}
+        if not feed:
+            # non-iterable reader protocol (fluid.layers.py_reader
+            # start()/reset()): pull the next batch from every started
+            # reader attached to this program; they raise EOFException
+            # when exhausted (reader op EOF → core.EOFException parity)
+            for r in getattr(program, "_py_readers", []):
+                if getattr(r, "_started", False):
+                    feed = dict(feed)
+                    feed.update(r._next_feed())
         fetch_list = fetch_list or []
         scope = scope or global_scope()
         fetch_names = [f if isinstance(f, str) else f.name
