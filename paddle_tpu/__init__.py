@@ -57,6 +57,11 @@ from paddle_tpu.framework import (
 )
 from paddle_tpu import backward
 from paddle_tpu import nets
+from paddle_tpu import lod_tensor
+from paddle_tpu.lod_tensor import (
+    create_lod_tensor, create_random_int_lodtensor,
+)
+from paddle_tpu import recordio_writer
 from paddle_tpu import distributions
 from paddle_tpu import contrib
 from paddle_tpu import inference

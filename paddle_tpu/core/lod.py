@@ -35,11 +35,21 @@ class RaggedBatch:
 
     # -- pytree protocol ---------------------------------------------------
     def tree_flatten(self):
-        return (self.data, self.lengths), None
+        # multi-level LoD structure (set by fluid.create_lod_tensor)
+        # rides in aux so jit/grad/device_put don't drop it; a different
+        # LoD structure is a different treedef — which is right, since
+        # it describes different batch structure
+        rsl = getattr(self, "recursive_seq_lens", None)
+        aux = (tuple(tuple(l) for l in rsl)
+               if rsl is not None else None)
+        return (self.data, self.lengths), aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        rb = cls(*children)
+        if aux is not None:
+            rb.recursive_seq_lens = [list(l) for l in aux]
+        return rb
 
     # -- construction ------------------------------------------------------
     @classmethod

@@ -8,6 +8,7 @@ loaders via the same reader contract).
 """
 
 from paddle_tpu.dataio import dataset
+from paddle_tpu.dataio import image
 from paddle_tpu.dataio.feeder import DataFeeder, batch_reader
 from paddle_tpu.dataio.pyreader import PyReader, DataLoader
 from paddle_tpu.dataio.dataloader import FileDataLoader

@@ -17,7 +17,13 @@ import threading
 __all__ = [
     "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
     "xmap_readers", "cache", "multiprocess_reader",
+    "ComposeNotAligned", "PipeReader", "Fake",
 ]
+
+
+class ComposeNotAligned(ValueError):
+    """compose() inputs ended at different lengths with
+    check_alignment=True (ref: python/paddle/reader/decorator.py)."""
 
 
 def map_readers(func, *readers):
@@ -56,8 +62,16 @@ def compose(*readers, check_alignment=True):
 
     def reader():
         rs = [r() for r in readers]
-        it = zip(*rs) if check_alignment else itertools.zip_longest(*rs)
-        for outputs in it:
+        _end = object()           # sentinel: None is a legal sample value
+        if not check_alignment:
+            for outputs in itertools.zip_longest(*rs, fillvalue=_end):
+                yield sum((make_tuple(o) for o in outputs
+                           if o is not _end), ())
+            return
+        for outputs in itertools.zip_longest(*rs, fillvalue=_end):
+            if any(o is _end for o in outputs):
+                raise ComposeNotAligned(
+                    "readers have different lengths")
             yield sum((make_tuple(o) for o in outputs), ())
     return reader
 
@@ -184,3 +198,92 @@ def _interleave(readers):
                     pass
             its = nxt
     return reader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (the reference reads
+    HDFS cat pipes this way; ref python/paddle/reader/decorator.py
+    PipeReader)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError(f"file_type must be plain or gzip, "
+                            f"got {file_type!r}")
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import subprocess
+        proc = subprocess.Popen(self.command, shell=True,
+                                stdout=subprocess.PIPE)
+        try:
+            if self.file_type == "gzip":
+                import zlib
+
+                def new_decomp():
+                    return zlib.decompressobj(32 + zlib.MAX_WBITS)
+                decomp = new_decomp()
+
+                def inflate(chunk):
+                    # `hadoop fs -cat dir/*.gz` concatenates gzip
+                    # MEMBERS: restart a decompressor on each member's
+                    # trailing bytes or all shards after the first are
+                    # silently dropped
+                    nonlocal decomp
+                    out = b""
+                    while chunk:
+                        out += decomp.decompress(chunk)
+                        if not decomp.eof:
+                            break
+                        chunk = decomp.unused_data
+                        decomp = new_decomp()
+                    return out
+            remained = b""
+            while True:
+                buf = proc.stdout.read(self.bufsize)
+                if not buf:
+                    break
+                if self.file_type == "gzip":
+                    buf = inflate(buf)
+                if not cut_lines:
+                    yield buf
+                    continue
+                buf = remained + buf
+                lines = buf.split(line_break.encode())
+                remained = lines.pop()
+                for ln in lines:
+                    yield ln.decode("utf-8", "replace")
+            if cut_lines and remained:
+                yield remained.decode("utf-8", "replace")
+        finally:
+            proc.stdout.close()
+            rc = proc.wait()
+            if rc != 0:
+                raise RuntimeError(
+                    f"PipeReader command failed (exit {rc}): "
+                    f"{self.command}")
+
+
+class Fake:
+    """Caches the first batch of the decorated reader and replays it
+    forever — the reference's IO-free benchmarking reader (ref
+    decorator.py Fake)."""
+
+    def __init__(self):
+        self.data = None
+
+    def __call__(self, reader, length):
+        def fake_reader():
+            if self.data is None:
+                _empty = object()
+                first = next(reader(), _empty)   # PEP 479: no bare next
+                if first is _empty:
+                    raise ValueError(
+                        "Fake: decorated reader yielded no samples")
+                self.data = first
+            for _ in range(length):
+                yield self.data
+        return fake_reader

@@ -1,0 +1,225 @@
+"""Tests for the fluid helper-module tails: lod_tensor constructors,
+recordio_writer converters, dataset.image utilities, and the reader
+decorator stragglers (ComposeNotAligned / PipeReader / Fake).
+
+Parity refs: python/paddle/fluid/lod_tensor.py,
+python/paddle/fluid/recordio_writer.py, python/paddle/dataset/image.py,
+python/paddle/reader/decorator.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import reader as R
+from paddle_tpu.core.lod import RaggedBatch
+
+
+class TestLodTensorHelpers:
+    def test_create_lod_tensor_from_array(self):
+        flat = np.arange(10, dtype=np.float32).reshape(10, 1)
+        rb = pt.create_lod_tensor(flat, [[3, 2, 5]])
+        assert isinstance(rb, RaggedBatch)
+        assert rb.batch_size == 3
+        assert list(np.asarray(rb.lengths)) == [3, 2, 5]
+        np.testing.assert_allclose(np.asarray(rb.data[2, :5, 0]),
+                                   flat[5:, 0])
+        assert rb.recursive_seq_lens == [[3, 2, 5]]
+
+    def test_create_lod_tensor_from_list(self):
+        rb = pt.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]])
+        assert rb.batch_size == 2
+        assert list(np.asarray(rb.lengths)) == [2, 3]
+
+    def test_create_lod_tensor_multilevel_uses_innermost(self):
+        flat = np.zeros((6, 2), np.float32)
+        rb = pt.create_lod_tensor(flat, [[2, 1], [2, 1, 3]])
+        assert rb.batch_size == 3
+        assert rb.recursive_seq_lens == [[2, 1], [2, 1, 3]]
+
+    def test_mismatch_raises(self):
+        with pytest.raises(pt.EnforceNotMet):
+            pt.create_lod_tensor(np.zeros((4, 1)), [[3, 2]])
+
+    def test_create_random_int(self):
+        rb = pt.create_random_int_lodtensor([[2, 4]], base_shape=[1],
+                                            low=0, high=5, seed=0)
+        assert rb.batch_size == 2
+        vals = np.asarray(rb.data)
+        assert vals.min() >= 0 and vals.max() <= 5
+
+
+class TestRecordIOConverters:
+    @pytest.fixture(autouse=True)
+    def _native(self):
+        native = pytest.importorskip("paddle_tpu.native")
+        if not native.available():
+            pytest.skip("no native toolchain")
+
+    def test_convert_and_read_back(self, tmp_path):
+        path = str(tmp_path / "c.recordio")
+        rs = np.random.RandomState(0)
+        samples = [(rs.randn(3).astype(np.float32),
+                    np.int64(i)) for i in range(7)]
+        n = pt.recordio_writer.convert_reader_to_recordio_file(
+            path, lambda: iter(samples))
+        assert n == 7
+        # read back through the layers.open_files surface
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                rdr = pt.layers.open_files([path], shapes=[[3], []],
+                                           dtypes=["float32", "int64"])
+                pt.layers.read_file(rdr)
+            got = list(iter(rdr))
+            assert len(got) == 7
+            np.testing.assert_allclose(
+                np.asarray(list(got[0].values())[0]), samples[0][0],
+                rtol=1e-6)
+        finally:
+            pt.disable_static()
+
+    def test_convert_to_files_splits(self, tmp_path):
+        base = str(tmp_path / "s.recordio")
+        samples = [(np.float32(i),) for i in range(10)]
+        paths = pt.recordio_writer.convert_reader_to_recordio_files(
+            base, 4, lambda: iter(samples))
+        assert len(paths) == 3          # 4 + 4 + 2
+        from paddle_tpu import native
+        counts = []
+        for p in paths:
+            with native.RecordIOScanner(p) as s:
+                counts.append(sum(1 for _ in s))
+        assert counts == [4, 4, 2]
+
+
+class TestImageUtils:
+    def _img(self, h=8, w=12, c=3):
+        rs = np.random.RandomState(0)
+        return rs.randint(0, 256, (h, w, c), np.uint8)
+
+    def test_resize_short(self):
+        from paddle_tpu.dataio import image
+        out = image.resize_short(self._img(8, 12), 4)
+        assert out.shape == (4, 6, 3)
+        out2 = image.resize_short(self._img(12, 8), 4)
+        assert out2.shape == (6, 4, 3)
+        # constant image stays constant under bilinear resize
+        const = np.full((8, 8, 3), 37, np.uint8)
+        assert np.all(image.resize_short(const, 4) == 37)
+
+    def test_crops_flip_chw(self):
+        from paddle_tpu.dataio import image
+        im = self._img(8, 8)
+        assert image.center_crop(im, 4).shape == (4, 4, 3)
+        assert image.random_crop(im, 4,
+                                 rng=np.random.RandomState(0)).shape == \
+            (4, 4, 3)
+        np.testing.assert_array_equal(image.left_right_flip(im),
+                                      im[:, ::-1])
+        assert image.to_chw(im).shape == (3, 8, 8)
+
+    def test_simple_transform(self):
+        from paddle_tpu.dataio import image
+        im = self._img(16, 20)
+        out = image.simple_transform(im, 10, 8, is_train=False,
+                                     mean=[1.0, 2.0, 3.0])
+        assert out.shape == (3, 8, 8)
+        assert out.dtype == np.float32
+        out_tr = image.simple_transform(im, 10, 8, is_train=True,
+                                        rng=np.random.RandomState(0))
+        assert out_tr.shape == (3, 8, 8)
+
+    def test_batch_images_from_tar(self, tmp_path):
+        import tarfile
+        from paddle_tpu.dataio import image
+        tar_path = str(tmp_path / "imgs.tar")
+        with tarfile.open(tar_path, "w") as tf:
+            for i in range(5):
+                p = tmp_path / f"im{i}.bin"
+                p.write_bytes(bytes([i]) * 10)
+                tf.add(str(p), arcname=f"im{i}.bin")
+        out = image.batch_images_from_tar(
+            tar_path, "train", {f"im{i}.bin": i for i in range(5)},
+            num_per_batch=2)
+        import os, pickle
+        names = open(os.path.join(out, "batch_names.txt")).read().split()
+        assert len(names) == 3
+        with open(names[0], "rb") as f:
+            b0 = pickle.load(f)
+        assert b0["label"] == [0, 1] and len(b0["data"]) == 2
+
+
+class TestReaderDecoratorTails:
+    def test_compose_not_aligned(self):
+        def a():
+            yield from [1, 2, 3]
+
+        def b():
+            yield from [4, 5]
+        with pytest.raises(R.ComposeNotAligned):
+            list(R.compose(a, b)())
+        out = list(R.compose(a, b, check_alignment=False)())
+        assert out == [(1, 4), (2, 5), (3,)]
+
+    def test_fake(self):
+        def a():
+            yield from [("x", 1), ("y", 2)]
+        fake = R.Fake()(a, 5)
+        out = list(fake())
+        assert len(out) == 5 and all(o == ("x", 1) for o in out)
+
+    def test_pipe_reader(self):
+        pr = R.PipeReader("printf 'a\\nbb\\nccc\\n'")
+        assert list(pr.get_line()) == ["a", "bb", "ccc"]
+        with pytest.raises(TypeError):
+            R.PipeReader(["not", "a", "string"])
+        with pytest.raises(TypeError):
+            R.PipeReader("cat x", file_type="snappy")
+
+    def test_pipe_reader_failure_surfaces(self):
+        with pytest.raises(RuntimeError, match="exit 3"):
+            list(R.PipeReader("exit 3").get_line())
+
+    def test_pipe_reader_concatenated_gzip_members(self, tmp_path):
+        # `hadoop fs -cat dir/*.gz` concatenates gzip members; every
+        # shard after the first must still decode
+        import gzip
+        for name, content in [("a", "one\ntwo\n"), ("b", "three\n")]:
+            with gzip.open(tmp_path / f"{name}.gz", "wb") as f:
+                f.write(content.encode())
+        pr = R.PipeReader(f"cat {tmp_path}/a.gz {tmp_path}/b.gz",
+                          file_type="gzip")
+        assert list(pr.get_line()) == ["one", "two", "three"]
+
+    def test_compose_preserves_none_samples(self):
+        def a():
+            yield from [None, 2]
+
+        def b():
+            yield from [5, 6]
+        out = list(R.compose(a, b, check_alignment=False)())
+        assert out == [(None, 5), (2, 6)]
+
+    def test_fake_empty_reader_raises(self):
+        with pytest.raises(ValueError, match="no samples"):
+            list(R.Fake()(lambda: iter([]), 3)())
+
+
+class TestLodTensorEdgeCases:
+    def test_empty_sequence_allowed(self):
+        rb = pt.create_lod_tensor([[1, 2], []], [[2, 0]])
+        assert rb.batch_size == 2
+        assert list(np.asarray(rb.lengths)) == [2, 0]
+
+    def test_invalid_cross_level_rejected(self):
+        with pytest.raises(pt.EnforceNotMet, match="recursive_seq_lens"):
+            pt.create_lod_tensor(np.zeros((6, 2)), [[5], [2, 1, 3]])
+
+    def test_recursive_seq_lens_survive_jax_transforms(self):
+        import jax
+        rb = pt.create_lod_tensor(np.zeros((6, 1), np.float32),
+                                  [[2, 1], [2, 1, 3]])
+        rb2 = jax.tree_util.tree_map(lambda x: x, rb)
+        assert rb2.recursive_seq_lens == [[2, 1], [2, 1, 3]]
