@@ -26,8 +26,9 @@ from paddle_tpu.core.enforce import EnforceNotMet, enforce, enforce_eq
 from paddle_tpu.core.flags import flags, get_flag, set_flags
 from paddle_tpu.core.place import (
     CPUPlace, TPUPlace, Place, default_place, is_compiled_with_tpu,
-    device_count, set_device, get_device, cpu_places, cuda_places,
-    tpu_places,
+    is_compiled_with_cuda, device_count, set_device, get_device,
+    cpu_places, cuda_places, cuda_pinned_places, tpu_places,
+    CUDAPlace, CUDAPinnedPlace,
 )
 
 from paddle_tpu import ops
@@ -57,6 +58,10 @@ from paddle_tpu.framework import (
 )
 from paddle_tpu import backward
 from paddle_tpu import nets
+from paddle_tpu import dygraph
+in_dygraph_mode = dygraph.enabled   # fluid.in_dygraph_mode parity
+from paddle_tpu.dataio.feeder import DataFeeder
+from paddle_tpu.framework import WeightNormParamAttr
 from paddle_tpu import lod_tensor
 from paddle_tpu.lod_tensor import (
     create_lod_tensor, create_random_int_lodtensor,

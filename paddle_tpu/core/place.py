@@ -114,3 +114,11 @@ def tpu_places(device_ids=None):
 
 # fluid.cuda_places compat: on this framework the accelerator is a TPU
 cuda_places = tpu_places
+CUDAPlace = TPUPlace        # fluid.CUDAPlace scripts get the accelerator
+
+
+def cuda_pinned_places(device_count=None):
+    """fluid.cuda_pinned_places parity: pinned host staging places
+    (host memory is the staging tier on TPU, CUDAPinnedPlace analog)."""
+    n = device_count or max(len(jax.devices()), 1)
+    return [CUDAPinnedPlace(i) for i in range(n)]

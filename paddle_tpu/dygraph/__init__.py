@@ -1,0 +1,164 @@
+"""fluid.dygraph namespace.
+
+Parity: python/paddle/fluid/dygraph/ (base.py guard/enabled/to_variable,
+nn.py layer classes, checkpoint.py save/load_persistables,
+learning_rate_scheduler.py decay classes, parallel.py
+prepare_context/DataParallel).
+
+Eager execution is JAX's DEFAULT here (SURVEY §2.8: the reference's
+tracer/autograd C++ stack collapses into "ops dispatch eagerly, grad()
+transforms"), so ``guard()`` simply ensures static-program mode is off
+for its scope — the inverse of the reference, where dygraph was the
+opt-in mode.
+"""
+
+import contextlib
+
+from paddle_tpu.framework import to_variable, no_grad, grad  # noqa: F401
+from paddle_tpu.nn.module import Layer                       # noqa: F401
+from paddle_tpu.nn import layers as nn                       # noqa: F401
+from paddle_tpu.nn.layers import (                           # noqa: F401
+    Linear, Conv2D, Conv3D, Conv2DTranspose, Conv3DTranspose, Pool2D, FC,
+    BatchNorm, Embedding, GRUUnit, LayerNorm, NCE, PRelu,
+    BilinearTensorProduct, GroupNorm, SpectralNorm, TreeConv, RowConv,
+)
+from paddle_tpu.parallel.env import (                        # noqa: F401
+    prepare_context, DataParallel, ParallelEnv,
+)
+from paddle_tpu.static.program import in_static_mode
+from paddle_tpu.layers import learning_rate_scheduler as _sched
+
+__all__ = [
+    "enabled", "guard", "to_variable", "no_grad", "grad", "Layer",
+    "save_persistables", "load_persistables", "prepare_context",
+    "DataParallel",
+    "Linear", "Conv2D", "Conv3D", "Pool2D", "FC", "BatchNorm",
+    "Embedding", "GRUUnit", "LayerNorm", "NCE", "PRelu",
+    "BilinearTensorProduct", "Conv2DTranspose", "Conv3DTranspose",
+    "GroupNorm", "SpectralNorm", "TreeConv", "RowConv",
+    "NoamDecay", "PiecewiseDecay", "NaturalExpDecay", "ExponentialDecay",
+    "InverseTimeDecay", "PolynomialDecay", "CosineDecay",
+]
+
+
+def enabled():
+    """dygraph.enabled parity: True when NOT building a static program
+    (eager is the default execution model here)."""
+    return not in_static_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """dygraph.guard parity. Eager is the default, so the guard only
+    needs to suspend static-program mode for its scope (and restore it
+    after) — mirror image of the reference's opt-in tracer."""
+    from paddle_tpu.static import program as _prog
+    was_static = in_static_mode()
+    if was_static:
+        _prog.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            _prog.enable_static()
+
+
+def save_persistables(model_dict, dirname="save_dir", optimizers=None):
+    """dygraph/checkpoint.py save_persistables parity: a Layer's
+    state_dict (or a plain param pytree) to ``dirname``."""
+    import os
+    from paddle_tpu import io as _io
+    if hasattr(model_dict, "state_dict"):
+        model_dict = model_dict.state_dict()
+    os.makedirs(dirname, exist_ok=True)
+    _io.save_dygraph(model_dict, os.path.join(dirname, "model"))
+    if optimizers is not None:
+        _io.save_dygraph(optimizers, os.path.join(dirname, "optimizers"))
+
+
+def load_persistables(dirname="save_dir"):
+    """dygraph/checkpoint.py load_persistables parity: always a
+    (param_dict, optimizer_dict_or_None) pair like the reference —
+    a shape that depends on directory contents would break callers."""
+    import os
+    from paddle_tpu import io as _io
+    params, _ = _io.load_dygraph(os.path.join(dirname, "model"))
+    opt_path = os.path.join(dirname, "optimizers.pdparams")
+    opt = None
+    if os.path.exists(opt_path):
+        opt, _ = _io.load_dygraph(os.path.join(dirname, "optimizers"))
+    return params, opt
+
+
+class LearningRateDecay:
+    """dygraph/learning_rate_scheduler.py LearningRateDecay parity: a
+    stateful step counter over the functional schedules. Works directly
+    as an optimizer ``learning_rate=`` (optimizers call schedules with
+    an explicit step), and standalone via step()/__call__()."""
+
+    def __init__(self, schedule, begin=0, step_size=1):
+        self._schedule = schedule
+        self.step_num = begin
+        self.step_size = step_size
+
+    def __call__(self, step=None):
+        s = self.step_num if step is None else step
+        return self._schedule(s)
+
+    def step(self):
+        """Advance the internal counter (the reference advances once
+        per optimizer.minimize)."""
+        self.step_num += self.step_size
+        return self._schedule(self.step_num)
+
+
+class NoamDecay(LearningRateDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 learning_rate=1.0):
+        super().__init__(_sched.noam_decay(d_model, warmup_steps,
+                                           learning_rate), begin, step)
+
+
+class PiecewiseDecay(LearningRateDecay):
+    def __init__(self, boundaries, values, begin=0, step=1):
+        super().__init__(_sched.piecewise_decay(boundaries, values),
+                         begin, step)
+
+
+class NaturalExpDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1):
+        super().__init__(_sched.natural_exp_decay(
+            learning_rate, decay_steps, decay_rate, staircase),
+            begin, step)
+
+
+class ExponentialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1):
+        super().__init__(_sched.exponential_decay(
+            learning_rate, decay_steps, decay_rate, staircase),
+            begin, step)
+
+
+class InverseTimeDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1):
+        super().__init__(_sched.inverse_time_decay(
+            learning_rate, decay_steps, decay_rate, staircase),
+            begin, step)
+
+
+class PolynomialDecay(LearningRateDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1):
+        super().__init__(_sched.polynomial_decay(
+            learning_rate, decay_steps, end_learning_rate, power, cycle),
+            begin, step)
+
+
+class CosineDecay(LearningRateDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1):
+        super().__init__(_sched.cosine_decay(
+            learning_rate, step_each_epoch, epochs), begin, step)

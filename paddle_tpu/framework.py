@@ -55,6 +55,13 @@ class _UniqueNameModule:
         finally:
             self._gen = old
 
+    def switch(self, new_generator=None):
+        """fluid.unique_name.switch parity: swap the generator and
+        return the previous one (pair with a later switch(old))."""
+        old = self._gen
+        self._gen = new_generator or _UniqueNameGenerator()
+        return old
+
 
 unique_name = _UniqueNameModule()
 

@@ -14,13 +14,14 @@ import numpy as np
 
 from paddle_tpu.static.io import (
     save_inference_model, load_inference_model, save_params, load_params,
-    save_persistables, load_persistables,
+    save_persistables, load_persistables, save_vars, load_vars,
 )
 from paddle_tpu.dataio.pyreader import DataLoader, PyReader
 
 __all__ = [
     "save_inference_model", "load_inference_model", "save_params",
     "load_params", "save_persistables", "load_persistables",
+    "save_vars", "load_vars", "batch",
     "save_pytree", "load_pytree", "save_dygraph", "load_dygraph",
     "DataLoader", "PyReader",
 ]
@@ -63,3 +64,10 @@ def load_dygraph(model_path):
     p = model_path if model_path.endswith(".pdparams") \
         else model_path + ".pdparams"
     return load_pytree(p), None      # (param_dict, optimizer_dict)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """fluid.io.batch parity: sample reader -> reader of sample lists
+    (delegates to the shared dataio batching decorator)."""
+    from paddle_tpu.dataio.feeder import batch_reader
+    return batch_reader(reader, batch_size, drop_last)

@@ -32,7 +32,7 @@ from paddle_tpu import initializer as I
 from paddle_tpu import ops as _ops
 from paddle_tpu.core.dtypes import convert_dtype
 from paddle_tpu.core.enforce import EnforceNotMet
-from paddle_tpu.framework import ParamAttr, unique_name
+from paddle_tpu.framework import ParamAttr, WeightNormParamAttr, unique_name
 from paddle_tpu.nn import module as _module
 from paddle_tpu.static.program import (
     OP_REGISTRY, Variable, default_main_program, default_startup_program,
@@ -398,6 +398,9 @@ def _make_param(prefix, shape, dtype, attr, default_init, trainable=True):
     """Create a parameter in whichever context is active (static program
     or nn module frame)."""
     attr = ParamAttr.to_attr(attr) if attr is not None else ParamAttr()
+    if isinstance(attr, WeightNormParamAttr):
+        return _make_weight_norm_param(prefix, shape, dtype, attr,
+                                       default_init, trainable)
     init = attr.initializer or default_init
     if in_static_mode():
         blk = default_main_program().global_block()
@@ -423,6 +426,99 @@ def _make_param(prefix, shape, dtype, attr, default_init, trainable=True):
     raise EnforceNotMet(
         f"parameterized layer needs a Program (use program_guard) or a "
         f"module context (nn.transform / Layer.init)")
+
+
+def _make_weight_norm_param(prefix, shape, dtype, attr, default_init,
+                            trainable):
+    """Weight normalization (WeightNormParamAttr, ref param_attr.py +
+    layers/__init__ weight-norm rewrite): reparameterize w = g * v/||v||
+    with the norm over every axis except ``dim``. v carries the
+    direction, g the magnitude; g is initialized to ||v_init|| so the
+    initial effective weight equals the plain initialization."""
+    base = attr.name or unique_name.generate(prefix + "_wn")
+    init = attr.initializer or default_init
+    plain = ParamAttr(name=base + "_v", initializer=init,
+                      learning_rate=attr.learning_rate,
+                      regularizer=attr.regularizer,
+                      trainable=attr.trainable and trainable,
+                      gradient_clip=attr.gradient_clip)
+    v = _make_param(prefix + "_v", shape, dtype, plain, init, trainable)
+    dim = attr.dim
+    # dim=None: one scalar g (norm over everything). dim=k: per-slice g
+    # over axis k; when the param is 1-D that means per-element (norm of
+    # each slice is just |v_i|) — keep the two cases distinct, an empty
+    # axes tuple is NOT the same as "reduce all".
+    norm_axes = (None if dim is None else
+                 tuple(i for i in _builtin_range(len(shape)) if i != dim))
+    g_shape = (shape[dim],) if dim is not None else (1,)
+
+    if in_static_mode():
+        gname = base + "_g"
+        blk = default_main_program().global_block()
+        gp = blk.create_parameter(
+            gname, g_shape, dtype, trainable=attr.trainable and trainable,
+            optimize_attr={"learning_rate": attr.learning_rate},
+            initializer=I.Constant(1.0))
+        sblk = default_startup_program().global_block()
+        if not sblk.has_var(gname):
+            sblk.create_parameter(gname, g_shape, dtype,
+                                  initializer=I.Constant(1.0))
+            # g starts at ||v_init||: computed FROM v in the startup
+            # program (the reference appends norm ops the same way)
+            sblk.append_op(type="weight_norm_init_g",
+                           inputs={"X": [base + "_v"]},
+                           outputs={"Out": [gname]},
+                           attrs={"dim": dim})
+        g = gp
+    else:
+        # g starts at ||v_init||; the initializer closure is only CALLED
+        # at parameter creation (module frame mode == "init"), so apply/
+        # grad never touch it — and it uses jnp ops, because under
+        # nn.transform's init v may be a tracer (np.asarray would crash)
+        class _GInit(I.Initializer):
+            def __call__(self, key, gshape_, gdtype=jnp.float32):
+                return _wn_norm_jnp(v, dim).reshape(gshape_) \
+                    .astype(gdtype)
+        g = _make_param(prefix + "_g", g_shape, dtype,
+                        ParamAttr(name=base + "_g",
+                                  initializer=_GInit(),
+                                  learning_rate=attr.learning_rate,
+                                  trainable=attr.trainable and trainable),
+                        I.Constant(1.0), trainable)
+
+    # w = g * v / ||v||, built from wrapped ops so it works in BOTH
+    # modes (static: appends square/reduce/scale/rsqrt/mul ops)
+    if norm_axes is None:
+        sq = reduce_sum(square(v), keep_dim=True)
+    elif norm_axes:
+        sq = reduce_sum(square(v), dim=list(norm_axes), keep_dim=True)
+    else:
+        sq = square(v)            # 1-D with dim set: per-element norm
+    inv = rsqrt(scale(sq, scale=1.0, bias=1e-12))
+    gshape = [1] * len(shape)
+    if dim is not None:
+        gshape[dim] = shape[dim]
+    gb = reshape(g, shape=gshape)
+    return elementwise_mul(elementwise_mul(v, inv), gb)
+
+
+def _wn_norm_jnp(v, dim):
+    """||v|| over all axes but ``dim`` (all axes when dim is None;
+    per-element when v is 1-D and dim is set)."""
+    v = jnp.asarray(v)
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v))).reshape(1)
+    axes = tuple(i for i in _builtin_range(v.ndim) if i != dim)
+    if not axes:
+        return jnp.abs(v)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes))
+
+
+def _weight_norm_init_g_compute(ins, attrs):
+    return {"Out": [_wn_norm_jnp(ins["X"][0], attrs.get("dim"))]}
+
+
+OP_REGISTRY["weight_norm_init_g"] = _weight_norm_init_g_compute
 
 
 def register_op_init_param():

@@ -91,6 +91,66 @@ class Conv2DTranspose(Layer):
         return ops.fc_act(out, self.act)
 
 
+class Conv3D(Layer):
+    """dygraph/nn.py Conv3D parity (NCDHW)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("conv3d")
+        self.num_channels, self.num_filters = num_channels, num_filters
+        self.filter_size = filter_size if isinstance(
+            filter_size, (tuple, list)) else (filter_size,) * 3
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.param_attr, self.bias_attr, self.act = param_attr, bias_attr, act
+        self.dtype = dtype
+
+    def forward(self, x):
+        w = create_parameter(
+            "w", (self.num_filters, self.num_channels // self.groups)
+            + tuple(self.filter_size), self.dtype,
+            initializer=I.MSRA(uniform=False), attr=self.param_attr)
+        out = ops.conv3d(x, w, self.stride, self.padding, self.dilation,
+                         self.groups)
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.num_filters,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b.reshape(1, -1, 1, 1, 1)
+        return ops.fc_act(out, self.act)
+
+
+class Conv3DTranspose(Layer):
+    """dygraph/nn.py Conv3DTranspose parity (IODHW filters)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype=jnp.float32):
+        super().__init__("conv3d_transpose")
+        self.num_channels, self.num_filters = num_channels, num_filters
+        self.filter_size = filter_size if isinstance(
+            filter_size, (tuple, list)) else (filter_size,) * 3
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.param_attr, self.bias_attr, self.act = param_attr, bias_attr, act
+        self.dtype = dtype
+
+    def forward(self, x):
+        w = create_parameter(
+            "w", (self.num_channels, self.num_filters // self.groups)
+            + tuple(self.filter_size), self.dtype,
+            initializer=I.Xavier(), attr=self.param_attr)
+        out = ops.conv3d_transpose(x, w, self.stride, self.padding,
+                                   self.dilation, self.groups)
+        if self.bias_attr is not False:
+            b = create_parameter("b", (self.num_filters,), self.dtype,
+                                 initializer=I.Constant(0.0),
+                                 attr=self.bias_attr)
+            out = out + b.reshape(1, -1, 1, 1, 1)
+        return ops.fc_act(out, self.act)
+
+
 class Pool2D(Layer):
     def __init__(self, pool_size=2, pool_type="max", pool_stride=1,
                  pool_padding=0, global_pooling=False, ceil_mode=False,

@@ -123,3 +123,16 @@ def profiler(state="All", sorted_key="total", profile_path=None,
         yield
     finally:
         print(stop_profiler(sorted_key, profile_path))
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """fluid.profiler.cuda_profiler parity shim: the reference drives
+    nvprof; on TPU device tracing is jax.profiler (use profiler()/
+    start_profiler with a trace_dir instead). Kept as a working span so
+    fluid scripts run unchanged — it records a host span and warns."""
+    import warnings
+    warnings.warn("cuda_profiler is a no-op on TPU; use "
+                  "profiler.profiler(trace_dir=...) for device traces")
+    with RecordEvent("cuda_profiler"):
+        yield

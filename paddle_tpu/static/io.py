@@ -16,6 +16,7 @@ import os
 
 import numpy as np
 
+from paddle_tpu.core.enforce import EnforceNotMet
 from paddle_tpu.static.executor import global_scope
 from paddle_tpu.static.program import (
     OP_REGISTRY, Operator, Parameter, Program, default_main_program,
@@ -199,3 +200,43 @@ def append_load_op(program, vars_, file_path):
                          outputs={"Out": names},
                          attrs={"file_path": file_path,
                                 "var_names": names, "_host": True})
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """fluid.io.save_vars parity (io.py:108): save an explicit var list
+    or every var matching ``predicate``."""
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    if vars is not None:
+        names = [v if isinstance(v, str) else v.name for v in vars]
+        vals = {}
+        for n in names:
+            val = scope.find_var(n)
+            if val is None:
+                raise EnforceNotMet(f"save_vars: var '{n}' not in scope")
+            vals[n] = np.asarray(val)
+    else:
+        vals = _collect(main_program, scope,
+                        predicate or (lambda v: v.persistable))
+    np.savez(os.path.join(dirname, filename or PARAMS_FILE), **vals)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """fluid.io.load_vars parity (io.py:242): restore an explicit var
+    list (or everything in the file when vars is None)."""
+    import jax.numpy as jnp
+    path = os.path.join(dirname, filename or PARAMS_FILE)
+    scope = global_scope()
+    want = None
+    if vars is not None:
+        want = {v if isinstance(v, str) else v.name for v in vars}
+    with np.load(path, allow_pickle=False) as data:
+        missing = (want or set()) - set(data.files)
+        if missing:
+            raise EnforceNotMet(f"load_vars: not in file: {sorted(missing)}")
+        for name in data.files:
+            if want is None or name in want:
+                scope.set_var(name, jnp.asarray(data[name]))

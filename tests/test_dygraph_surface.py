@@ -1,0 +1,265 @@
+"""fluid.dygraph namespace + top-level fluid surface tails.
+
+Parity refs: python/paddle/fluid/dygraph/{base,nn,checkpoint,
+learning_rate_scheduler,parallel}.py, fluid/framework.py __all__,
+fluid/io.py save_vars/load_vars/batch, fluid/param_attr.py
+WeightNormParamAttr, fluid/unique_name.py switch, profiler
+cuda_profiler.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph
+
+
+TOP_LEVEL = """Executor global_scope scope_guard Program
+default_startup_program default_main_program program_guard name_scope
+cuda_places cpu_places cuda_pinned_places in_dygraph_mode
+is_compiled_with_cuda ParamAttr WeightNormParamAttr DataFeeder CPUPlace
+CUDAPlace CUDAPinnedPlace""".split()
+
+DYGRAPH = """enabled no_grad guard to_variable Layer Conv2D Conv3D
+Pool2D FC BatchNorm Embedding GRUUnit LayerNorm NCE PRelu
+BilinearTensorProduct Conv2DTranspose Conv3DTranspose GroupNorm
+SpectralNorm TreeConv save_persistables load_persistables NoamDecay
+PiecewiseDecay NaturalExpDecay ExponentialDecay InverseTimeDecay
+PolynomialDecay CosineDecay prepare_context DataParallel""".split()
+
+
+class TestSurfaces:
+    @pytest.mark.parametrize("name", TOP_LEVEL)
+    def test_fluid_top_level(self, name):
+        assert hasattr(pt, name) or hasattr(pt.static, name)
+
+    @pytest.mark.parametrize("name", DYGRAPH)
+    def test_dygraph_name(self, name):
+        assert hasattr(dygraph, name)
+
+    def test_io_names(self):
+        for n in ["save_vars", "load_vars", "batch"]:
+            assert hasattr(pt.io, n)
+        assert hasattr(pt.profiler, "cuda_profiler")
+        assert hasattr(pt.framework.unique_name, "switch")
+
+
+class TestDygraphBasics:
+    def test_enabled_and_guard(self):
+        assert dygraph.enabled()
+        pt.enable_static()
+        try:
+            assert not dygraph.enabled()
+            with dygraph.guard():
+                assert dygraph.enabled()       # guard suspends static
+            assert not dygraph.enabled()
+        finally:
+            pt.disable_static()
+
+    def test_layer_classes_run(self):
+        import jax
+        from paddle_tpu import nn
+
+        class Net(dygraph.Layer):
+            def __init__(self):
+                super().__init__("net")
+                self.c3 = dygraph.Conv3D(2, 4, 3, padding=1)
+                self.c3t = dygraph.Conv3DTranspose(4, 2, 2, stride=2)
+
+            def forward(self, x):
+                return self.c3t(self.c3(x))
+
+        tr = nn.transform(lambda x: Net()(x))
+        x = np.ones((1, 2, 4, 4, 4), np.float32)
+        params, state = tr.init(jax.random.PRNGKey(0), x)
+        out = tr.apply(params, state, None, x)
+        out = out[0] if isinstance(out, tuple) else out
+        assert np.asarray(out).shape == (1, 2, 8, 8, 8)
+
+    def test_lr_decay_classes(self):
+        d = dygraph.ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5,
+                                     staircase=True)
+        assert float(d(0)) == pytest.approx(0.1)
+        assert float(d(10)) == pytest.approx(0.05)
+        # stateful stepping
+        for _ in range(10):
+            lr = d.step()
+        assert float(lr) == pytest.approx(0.05)
+        nd = dygraph.NoamDecay(512, 4000)
+        assert float(nd(1)) < float(nd(4000))
+        pw = dygraph.PiecewiseDecay([5, 10], [1.0, 0.5, 0.1])
+        assert float(pw(0)) == 1.0 and float(pw(7)) == 0.5 \
+            and float(pw(20)) == pytest.approx(0.1)
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                  "b": np.zeros(3, np.float32)}
+        dygraph.save_persistables(params, str(tmp_path / "ck"))
+        back, opt = dygraph.load_persistables(str(tmp_path / "ck"))
+        assert opt is None                  # fixed 2-tuple like the ref
+        np.testing.assert_allclose(np.asarray(back["w"]), params["w"])
+        dygraph.save_persistables(params, str(tmp_path / "ck2"),
+                                  optimizers={"lr": np.float32(0.1)})
+        back2, opt2 = dygraph.load_persistables(str(tmp_path / "ck2"))
+        assert float(opt2["lr"]) == pytest.approx(0.1)
+
+    def test_data_parallel_single_rank_identity(self):
+        ctx = dygraph.prepare_context()
+        dp = dygraph.DataParallel(lambda x: x, ctx)
+        assert float(dp.scale_loss(np.float32(2.0))) in (2.0, 2.0 / max(
+            ctx.nranks, 1))
+
+
+class TestWeightNorm:
+    def test_static_reparameterization_and_training(self):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[8, 5],
+                                   append_batch_size=False)
+                t = pt.static.data("t", shape=[8, 3],
+                                   append_batch_size=False)
+                y = pt.layers.fc(
+                    x, size=3, bias_attr=False,
+                    param_attr=pt.WeightNormParamAttr(
+                        dim=1, name="wn",
+                        initializer=pt.initializer.Xavier()))
+                loss = pt.layers.mean(
+                    pt.layers.square_error_cost(y, t))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+            exe = pt.static.Executor()
+            exe.run(startup)
+            scope = pt.static.global_scope()
+            # read BEFORE any training run: g must equal ||v_init|| so
+            # the initial effective weight matches the plain init
+            g = np.asarray(scope.find_var("wn_g")).copy()
+            v = np.asarray(scope.find_var("wn_v")).copy()
+            np.testing.assert_allclose(g, np.sqrt((v ** 2).sum(0)),
+                                       rtol=1e-5)
+            rs = np.random.RandomState(0)
+            xb = rs.randn(8, 5).astype(np.float32)
+            tb = rs.randn(8, 3).astype(np.float32)
+            (out,) = exe.run(main, feed={"x": xb, "t": tb},
+                             fetch_list=[y])
+            w = g * v / np.sqrt((v ** 2).sum(0, keepdims=True))
+            # env default matmul precision is reduced; loose tolerance
+            np.testing.assert_allclose(out, xb @ w, rtol=5e-2, atol=5e-2)
+            # both g and v train
+            first = [np.asarray(g).copy(), np.asarray(v).copy()]
+            for _ in range(5):
+                exe.run(main, feed={"x": xb, "t": tb}, fetch_list=[loss])
+            g2, v2 = exe.run(main, feed={"x": xb, "t": tb},
+                             fetch_list=["wn_g", "wn_v"])[:2]
+            assert np.abs(np.asarray(g2) - first[0]).max() > 0
+            assert np.abs(np.asarray(v2) - first[1]).max() > 0
+        finally:
+            pt.disable_static()
+
+    def test_eager_trains_under_jit_and_grad(self):
+        """Weight-norm layers must survive jit/grad (the g initializer
+        runs only at creation, never at apply)."""
+        import jax
+        from paddle_tpu import nn
+
+        def net(x):
+            return pt.layers.fc(
+                x, size=3, bias_attr=False,
+                param_attr=pt.WeightNormParamAttr(dim=1, name="wn"))
+        tr = nn.transform(net)
+        xb = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        params, state = tr.init(jax.random.PRNGKey(0), xb)
+
+        def loss(p):
+            out = tr.apply(p, state, None, xb)
+            out = out[0] if isinstance(out, tuple) else out
+            return (out ** 2).mean()
+        g = jax.jit(jax.grad(loss))(params)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert leaves and all(np.all(np.isfinite(np.asarray(l)))
+                              for l in leaves)
+
+    def test_weight_norm_1d_dim0(self):
+        """dim covering every axis of a 1-D param: per-element g."""
+        import jax
+        from paddle_tpu import nn
+
+        def net(x):
+            from paddle_tpu.layers import _make_param
+            w = _make_param("w1d", (4,), np.float32,
+                            pt.WeightNormParamAttr(dim=0, name="wn1"),
+                            pt.initializer.Xavier())
+            return x * w
+        tr = nn.transform(net)
+        xb = np.ones((4,), np.float32)
+        params, state = tr.init(jax.random.PRNGKey(0), xb)
+        gkey = [k for k in params if "_g" in k][0]
+        assert np.asarray(params[gkey]).shape == (4,)
+
+    def test_eager_module_ctx(self):
+        import jax
+        from paddle_tpu import nn
+
+        def net(x):
+            return pt.layers.fc(
+                x, size=3, bias_attr=False,
+                param_attr=pt.WeightNormParamAttr(dim=1, name="wn"))
+        tr = nn.transform(net)
+        xb = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        params, state = tr.init(jax.random.PRNGKey(0), xb)
+        flat = {k: v for k, v in params.items()}
+        assert any(k.endswith("_v") or "_v" in k for k in flat), flat.keys()
+        out = tr.apply(params, state, None, xb)
+        out = out[0] if isinstance(out, tuple) else out
+        assert np.asarray(out).shape == (4, 3)
+
+
+class TestIoTails:
+    def test_save_load_vars(self, tmp_path):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[2, 4],
+                                   append_batch_size=False)
+                pt.layers.fc(x, size=3, param_attr="sv_w",
+                             bias_attr="sv_b")
+            exe = pt.static.Executor()
+            exe.run(startup)
+            scope = pt.static.global_scope()
+            w0 = np.asarray(scope.find_var("sv_w")).copy()
+            pt.io.save_vars(exe, str(tmp_path), main, vars=["sv_w"])
+            scope.set_var("sv_w", np.zeros_like(w0))
+            pt.io.load_vars(exe, str(tmp_path), main, vars=["sv_w"])
+            np.testing.assert_allclose(
+                np.asarray(scope.find_var("sv_w")), w0)
+            with pytest.raises(pt.EnforceNotMet):
+                pt.io.load_vars(exe, str(tmp_path), main, vars=["nope"])
+        finally:
+            pt.disable_static()
+
+    def test_io_batch(self):
+        out = list(pt.io.batch(lambda: iter(range(5)), 2)())
+        assert out == [[0, 1], [2, 3], [4]]
+        out = list(pt.io.batch(lambda: iter(range(5)), 2,
+                               drop_last=True)())
+        assert out == [[0, 1], [2, 3]]
+
+    def test_unique_name_switch(self):
+        un = pt.framework.unique_name
+        a = un.generate("x")
+        old = un.switch()
+        b = un.generate("x")
+        un.switch(old)
+        c = un.generate("x")
+        assert a != c and b.startswith("x")
+
+    def test_cuda_profiler_shim(self):
+        with pytest.warns(UserWarning):
+            with pt.profiler.cuda_profiler():
+                pass
+
+    def test_places(self):
+        assert isinstance(pt.cuda_pinned_places(2)[1], pt.CUDAPinnedPlace)
+        assert pt.CUDAPlace is pt.TPUPlace
+        assert isinstance(pt.is_compiled_with_cuda(), bool)
