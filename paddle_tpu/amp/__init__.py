@@ -21,6 +21,7 @@ import jax.numpy as jnp
 __all__ = [
     "Policy", "bfloat16_policy", "float16_policy", "cast_tree",
     "LossScaler", "decorate", "black_list", "white_list",
+    "AutoMixedPrecisionLists",
 ]
 
 # fp16_lists.py parity: ops that must stay fp32 under half policies
@@ -146,3 +147,25 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
         scaler = LossScaler(init_loss_scaling,
                             use_dynamic_loss_scaling=use_dynamic_loss_scaling)
     return OptimizerWithMixedPrecision(optimizer, policy, scaler)
+
+
+class AutoMixedPrecisionLists:
+    """contrib.mixed_precision.fp16_lists.AutoMixedPrecisionLists
+    parity: merge user-custom white/black lists into the defaults (an op
+    custom-listed white is removed from black, and vice versa)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set()
+        if custom_white_list:
+            for op in custom_white_list:
+                self.black_list.discard(op)
+                self.white_list.add(op)
+        if custom_black_list:
+            for op in custom_black_list:
+                if op in (custom_white_list or ()):
+                    raise ValueError(
+                        f"op {op} in both custom white and black lists")
+                self.white_list.discard(op)
+                self.black_list.add(op)

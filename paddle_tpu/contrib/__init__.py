@@ -11,6 +11,7 @@ from paddle_tpu.contrib import model_stat
 from paddle_tpu.contrib import nas
 from paddle_tpu.contrib import op_frequence
 from paddle_tpu.contrib import quant
+from paddle_tpu.contrib import reader
 from paddle_tpu.contrib import slim
 from paddle_tpu.contrib import trainer
 from paddle_tpu.contrib import utils
@@ -21,6 +22,7 @@ from paddle_tpu.contrib.model_stat import summary
 from paddle_tpu.contrib.op_frequence import op_freq_statistic
 
 __all__ = ["quant", "slim", "nas", "decoder", "extend_optimizer", "layers",
+           "reader",
            "model_stat", "op_frequence", "trainer", "utils",
            "extend_with_decoupled_weight_decay", "summary",
            "op_freq_statistic"]

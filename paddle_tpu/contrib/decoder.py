@@ -55,3 +55,138 @@ class BeamSearchDecoder:
                                   jnp.stack(step_parents),
                                   end_token=self.end_token)
         return seqs, scores
+
+
+class InitState:
+    """contrib/decoder/beam_search_decoder.py InitState parity: the
+    initial value of one decoder hidden state. ``init=`` uses the
+    tensor directly; ``init_boot=`` + shape/value builds a value-filled
+    state batch-sized like init_boot (the reference's
+    fill_constant_batch_size_like form)."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self.value = init
+        elif init_boot is not None:
+            boot = jnp.asarray(init_boot)
+            tail = tuple(int(s) for s in (shape or boot.shape[1:])
+                         if s not in (-1, None))
+            self.value = jnp.full((boot.shape[0],) + tail, value,
+                                  boot.dtype if dtype is None else dtype)
+        else:
+            raise ValueError("InitState needs init= (or init_boot=)")
+        self.need_reorder = need_reorder
+
+
+class StateCell:
+    """StateCell parity: named states + named step inputs + a
+    registered @state_cell.state_updater callable that maps
+    (inputs, states) -> new states. The updater is a real callable, so
+    it replays under lax.scan — the same adaptation this framework uses
+    for While/StaticRNN (a traced with-block cannot be re-executed)."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._input_names = list(inputs)
+        self._init_states = {k: v for k, v in states.items()}
+        self.out_state = out_state
+        self._updater = None
+        self._cur_inputs = {}
+        self._cur_states = {}
+        self._new_states = {}
+
+    def state_updater(self, fn):
+        self._updater = fn
+        return fn
+
+    # -- accessors used inside the updater --------------------------------
+    def get_input(self, name):
+        return self._cur_inputs[name]
+
+    def get_state(self, name):
+        return self._cur_states[name]
+
+    def set_state(self, name, value):
+        self._new_states[name] = value
+
+    def compute_state(self, inputs):
+        """Run the registered updater on this step's inputs."""
+        if self._updater is None:
+            raise ValueError("no @state_cell.state_updater registered")
+        self._cur_inputs = dict(inputs)
+        self._new_states = {}
+        self._updater(self)
+
+    def update_states(self):
+        self._cur_states = {**self._cur_states, **self._new_states}
+        self._new_states = {}
+
+    def initial_states(self):
+        return {k: jnp.asarray(v.value) for k, v in
+                self._init_states.items()}
+
+    def out_value(self):
+        return self._cur_states[self.out_state]
+
+
+class TrainingDecoder:
+    """TrainingDecoder parity in this framework's callable-block form
+    (the reference's `with decoder.block():` builds a sub-block an op
+    replays; under tracing the body must be a callable — the documented
+    StaticRNN adaptation, layers/control_flow_classes.py):
+
+        decoder = TrainingDecoder(state_cell)
+        decoder.step_input(trg_embedding)          # [B, T, D]
+        @decoder.block
+        def _(decoder, current_word):
+            decoder.state_cell.compute_state(inputs={'x': current_word})
+            score = layers.fc(...)                 # any per-step layers
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        out = decoder()                            # [B, T, V]
+    """
+
+    def __init__(self, state_cell, name=None):
+        self.state_cell = state_cell
+        self._seqs = []
+        self._block = None
+        self._step_outputs = None
+
+    def step_input(self, seq):
+        self._seqs.append(jnp.asarray(seq))
+        return seq
+
+    def block(self, fn):
+        """Register the per-step body (decorator)."""
+        self._block = fn
+        return fn
+
+    def output(self, *outs):
+        self._step_outputs = outs
+
+    def __call__(self):
+        import jax
+        if self._block is None or not self._seqs:
+            raise ValueError("TrainingDecoder needs step_input() and a "
+                             "@decoder.block body")
+        xs = tuple(jnp.moveaxis(s, 1, 0) for s in self._seqs)  # T-major
+
+        def body(states, xts):
+            self.state_cell._cur_states = dict(states)
+            self._step_outputs = None
+            self._block(self, *xts)
+            outs = self._step_outputs or (self.state_cell.out_value(),)
+            return dict(self.state_cell._cur_states), tuple(outs)
+
+        init = self.state_cell.initial_states()
+        # dry step OUTSIDE the scan so module parameters are created in
+        # the enclosing frame (creating them inside the scan body would
+        # leak its tracers into the param store); its state/output
+        # changes are discarded
+        body(dict(init), tuple(x[0] for x in xs))
+        _, outs = jax.lax.scan(body, init, xs)
+        outs = [jnp.moveaxis(o, 0, 1) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+__all__ += ["InitState", "StateCell", "TrainingDecoder"]

@@ -1,0 +1,110 @@
+"""contrib.reader parity.
+
+Parity: python/paddle/fluid/contrib/reader/{distributed_reader.py
+(distributed_batch_reader), ctr_reader.py (ctr_reader)}. The reference's
+ctr_reader is a C++ reader op pipeline (operators/reader/ctr_reader);
+here it is the native threaded loader (native/src/data_pipeline.cc) +
+per-line parsing, yielding ready feed batches.
+"""
+
+import os
+
+import numpy as np
+
+__all__ = ["distributed_batch_reader", "ctr_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader across trainers by round-robin on batch
+    index (PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID env contract, ref
+    distributed_reader.py)."""
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if trainer_id >= trainers_num:
+        raise ValueError(f"PADDLE_TRAINER_ID {trainer_id} >= "
+                         f"PADDLE_TRAINERS_NUM {trainers_num}")
+
+    def sharded():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers_num == trainer_id:
+                yield batch
+    return sharded
+
+
+def _parse_csv(line, dense_slot_index, sparse_slot_index):
+    cols = line.strip().split(",")
+    label = np.int64(cols[0])
+    dense = [np.float32(cols[i]) for i in dense_slot_index]
+    sparse = [np.int64(cols[i]) for i in sparse_slot_index]
+    return label, dense, sparse
+
+
+def _parse_svm(line, slots):
+    # "label slot:feasign slot:feasign ..." — grouped per slot id
+    parts = line.strip().split()
+    label = np.int64(parts[0])
+    by_slot = {s: [] for s in slots}
+    for tok in parts[1:]:
+        sid, val = tok.split(":", 1)
+        if sid in by_slot:
+            by_slot[sid].append(np.int64(val))
+    return label, by_slot
+
+
+def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
+               sparse_slot_index, capacity, thread_num, batch_size,
+               file_list, slots, name=None):
+    """contrib.reader.ctr_reader parity: a batched reader over CTR
+    text shards. file_type: plain|gzip; file_format: csv|svm.
+
+    Returns a reader callable yielding
+    (label [B,1], dense [B, n_dense] float32,
+     sparse: one [B, max_per_slot] int64 array per sparse slot padded
+     with -1) — the dense-padded TPU form of the reference's
+    LoDTensor outputs.
+    """
+    if file_type not in ("plain", "gzip"):
+        raise ValueError(f"file_type must be plain|gzip, got {file_type}")
+    if file_format not in ("csv", "svm"):
+        raise ValueError(f"file_format must be csv|svm, got {file_format}")
+
+    def lines():
+        import gzip
+        for path in file_list:
+            opener = gzip.open if file_type == "gzip" else open
+            with opener(path, "rt") as f:
+                yield from f
+
+    def reader():
+        buf = []
+        for line in lines():
+            if not line.strip():
+                continue
+            buf.append(line)
+            if len(buf) == batch_size:
+                yield _batch(buf)
+                buf = []
+        if buf:
+            yield _batch(buf)
+
+    def _batch(lines_):
+        if file_format == "csv":
+            parsed = [_parse_csv(l, dense_slot_index, sparse_slot_index)
+                      for l in lines_]
+            label = np.array([p[0] for p in parsed], np.int64)[:, None]
+            dense = np.array([p[1] for p in parsed], np.float32)
+            sparse = np.array([p[2] for p in parsed], np.int64)
+            return label, dense, sparse
+        parsed = [_parse_svm(l, slots) for l in lines_]
+        label = np.array([p[0] for p in parsed], np.int64)[:, None]
+        outs = [label]
+        for s in slots:
+            maxn = max(max((len(p[1][s]) for p in parsed), default=1), 1)
+            arr = np.full((len(parsed), maxn), -1, np.int64)
+            for i, p in enumerate(parsed):
+                vals = p[1][s]
+                arr[i, :len(vals)] = vals
+            outs.append(arr)
+        return tuple(outs)
+
+    return reader

@@ -435,7 +435,17 @@ def _make_weight_norm_param(prefix, shape, dtype, attr, default_init,
     with the norm over every axis except ``dim``. v carries the
     direction, g the magnitude; g is initialized to ||v_init|| so the
     initial effective weight equals the plain initialization."""
-    base = attr.name or unique_name.generate(prefix + "_wn")
+    if attr.name:
+        base = attr.name
+    elif in_static_mode():
+        base = unique_name.generate(prefix + "_wn")
+    else:
+        # module ctx: init AND apply both execute this code, so the name
+        # must be deterministic — name by prefix and let the module
+        # frame scope it (the rule plain unnamed params follow);
+        # unique_name's global counter would diverge between the two
+        # passes and apply would miss the param
+        base = prefix + "_wn"
     init = attr.initializer or default_init
     plain = ParamAttr(name=base + "_v", initializer=init,
                       learning_rate=attr.learning_rate,
@@ -457,6 +467,8 @@ def _make_weight_norm_param(prefix, shape, dtype, attr, default_init,
         blk = default_main_program().global_block()
         gp = blk.create_parameter(
             gname, g_shape, dtype, trainable=attr.trainable and trainable,
+            regularizer=attr.regularizer,
+            gradient_clip=attr.gradient_clip,
             optimize_attr={"learning_rate": attr.learning_rate},
             initializer=I.Constant(1.0))
         sblk = default_startup_program().global_block()
@@ -483,6 +495,8 @@ def _make_weight_norm_param(prefix, shape, dtype, attr, default_init,
                         ParamAttr(name=base + "_g",
                                   initializer=_GInit(),
                                   learning_rate=attr.learning_rate,
+                                  regularizer=attr.regularizer,
+                                  gradient_clip=attr.gradient_clip,
                                   trainable=attr.trainable and trainable),
                         I.Constant(1.0), trainable)
 

@@ -233,6 +233,9 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     want = None
     if vars is not None:
         want = {v if isinstance(v, str) else v.name for v in vars}
+    elif predicate is not None:
+        blk = (main_program or default_main_program()).global_block()
+        want = {n for n, v in blk.vars.items() if predicate(v)}
     with np.load(path, allow_pickle=False) as data:
         missing = (want or set()) - set(data.files)
         if missing:

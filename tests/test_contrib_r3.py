@@ -1,0 +1,160 @@
+"""contrib tails: decoder InitState/StateCell/TrainingDecoder,
+contrib.reader (ctr_reader, distributed_batch_reader), and
+amp.AutoMixedPrecisionLists.
+
+Parity refs: python/paddle/fluid/contrib/decoder/beam_search_decoder.py
+(usage mirrored from fluid/tests/test_beam_search_decoder.py),
+contrib/reader/{ctr_reader,distributed_reader}.py,
+contrib/mixed_precision/fp16_lists.py.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.contrib.decoder import (
+    InitState, StateCell, TrainingDecoder, BeamSearchDecoder,
+)
+from paddle_tpu.contrib.reader import ctr_reader, distributed_batch_reader
+from paddle_tpu.amp import AutoMixedPrecisionLists
+
+
+class TestTrainingDecoder:
+    """The seq2seq decoder shape from the reference's
+    test_beam_search_decoder.py, in this framework's callable-block
+    form."""
+
+    B, T, D, H, V = 4, 6, 8, 8, 11
+
+    def _model(self, ctx, trg):
+        h0 = InitState(init=ctx, need_reorder=True)
+        cell = StateCell(inputs={"x": None}, states={"h": h0},
+                         out_state="h")
+
+        @cell.state_updater
+        def updater(sc):
+            x = sc.get_input("x")
+            prev = sc.get_state("h")
+            sc.set_state("h", pt.layers.fc(
+                [prev, x], size=self.H, act="tanh",
+                param_attr=["w1", "w2"], bias_attr="b1"))
+
+        dec = TrainingDecoder(cell)
+        dec.step_input(trg)
+
+        @dec.block
+        def _(d, x_t):
+            d.state_cell.compute_state(inputs={"x": x_t})
+            score = pt.layers.fc(d.state_cell.get_state("h"),
+                                 size=self.V, act="softmax",
+                                 param_attr="wv", bias_attr="bv")
+            d.state_cell.update_states()
+            d.output(score)
+        return dec()
+
+    def test_forward_shape_and_normalization(self):
+        import jax
+        rs = np.random.RandomState(0)
+        ctx = rs.randn(self.B, self.H).astype(np.float32)
+        trg = rs.randn(self.B, self.T, self.D).astype(np.float32)
+        tr = nn.transform(self._model)
+        params, state = tr.init(jax.random.PRNGKey(0), ctx, trg)
+        out = tr.apply(params, state, None, ctx, trg)
+        out = out[0] if isinstance(out, tuple) else out
+        assert np.asarray(out).shape == (self.B, self.T, self.V)
+        np.testing.assert_allclose(np.asarray(out).sum(-1),
+                                   np.ones((self.B, self.T)), rtol=1e-4)
+
+    def test_trains_under_jit_grad(self):
+        import jax
+        rs = np.random.RandomState(0)
+        ctx = rs.randn(self.B, self.H).astype(np.float32)
+        trg = rs.randn(self.B, self.T, self.D).astype(np.float32)
+        tr = nn.transform(self._model)
+        params, state = tr.init(jax.random.PRNGKey(0), ctx, trg)
+
+        def loss(p):
+            o = tr.apply(p, state, None, ctx, trg)
+            o = o[0] if isinstance(o, tuple) else o
+            return (o ** 2).mean()
+        g = jax.jit(jax.grad(loss))(params)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert leaves and all(np.all(np.isfinite(np.asarray(l)))
+                              for l in leaves)
+
+    def test_init_state_boot_fill(self):
+        import jax.numpy as jnp
+        boot = jnp.ones((3, 7))
+        st = InitState(init_boot=boot, shape=[-1, 5], value=0.5)
+        assert np.asarray(st.value).shape == (3, 5)
+        np.testing.assert_allclose(np.asarray(st.value), 0.5)
+
+    def test_state_cell_errors(self):
+        with pytest.raises(ValueError):
+            InitState()
+        cell = StateCell(inputs={"x": None},
+                         states={"h": InitState(init=np.zeros(2))},
+                         out_state="h")
+        with pytest.raises(ValueError, match="state_updater"):
+            cell.compute_state({"x": np.zeros(2)})
+
+    def test_beam_search_decoder_still_exported(self):
+        assert BeamSearchDecoder is not None
+
+
+class TestCtrReader:
+    def test_csv(self, tmp_path):
+        p = tmp_path / "a.csv"
+        p.write_text("1,0.5,0.25,7,9\n0,0.1,0.2,3,4\n1,0.9,0.8,5,6\n")
+        r = ctr_reader({}, "plain", "csv", [1, 2], [3, 4], 8, 1, 2,
+                       [str(p)], None)
+        batches = list(r())
+        assert len(batches) == 2
+        label, dense, sparse = batches[0]
+        assert label.shape == (2, 1) and dense.shape == (2, 2)
+        np.testing.assert_allclose(dense[0], [0.5, 0.25])
+        assert sparse[0].tolist() == [7, 9]
+
+    def test_svm_and_gzip(self, tmp_path):
+        import gzip
+        p = tmp_path / "b.svm.gz"
+        with gzip.open(p, "wt") as f:
+            f.write("1 s1:4 s1:5 s2:9\n0 s2:3\n")
+        r = ctr_reader({}, "gzip", "svm", [], [], 8, 1, 2, [str(p)],
+                       ["s1", "s2"])
+        label, s1, s2 = list(r())[0]
+        assert label.ravel().tolist() == [1, 0]
+        assert s1.tolist() == [[4, 5], [-1, -1]]   # -1 pad
+        assert s2.tolist() == [[9], [3]]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ctr_reader({}, "snappy", "csv", [], [], 8, 1, 2, [], None)
+        with pytest.raises(ValueError):
+            ctr_reader({}, "plain", "tsv", [], [], 8, 1, 2, [], None)
+
+    def test_distributed_batch_reader(self):
+        os.environ["PADDLE_TRAINERS_NUM"] = "2"
+        os.environ["PADDLE_TRAINER_ID"] = "1"
+        try:
+            sh = distributed_batch_reader(lambda: iter([0, 1, 2, 3, 4]))
+            assert list(sh()) == [1, 3]
+        finally:
+            del os.environ["PADDLE_TRAINERS_NUM"]
+            del os.environ["PADDLE_TRAINER_ID"]
+
+
+class TestAmpLists:
+    def test_custom_lists_merge(self):
+        l = AutoMixedPrecisionLists(custom_white_list={"mean"},
+                                    custom_black_list={"conv2d"})
+        assert "mean" in l.white_list and "mean" not in l.black_list
+        assert "conv2d" in l.black_list and "conv2d" not in l.white_list
+
+    def test_conflicting_lists_rejected(self):
+        with pytest.raises(ValueError):
+            AutoMixedPrecisionLists(custom_white_list={"x"},
+                                    custom_black_list={"x"})

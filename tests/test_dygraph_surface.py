@@ -179,6 +179,41 @@ class TestWeightNorm:
         assert leaves and all(np.all(np.isfinite(np.asarray(l)))
                               for l in leaves)
 
+    def test_weight_norm_unnamed_attr(self):
+        """Unnamed WeightNormParamAttr must resolve to the SAME param
+        names at init and apply (no global-counter names in module
+        ctx)."""
+        import jax
+        from paddle_tpu import nn
+
+        def net(x):
+            return pt.layers.fc(
+                x, size=3, bias_attr=False,
+                param_attr=pt.WeightNormParamAttr(dim=1))   # no name=
+        tr = nn.transform(net)
+        xb = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        params, state = tr.init(jax.random.PRNGKey(0), xb)
+        out = tr.apply(params, state, None, xb)     # must not KeyError
+        out = out[0] if isinstance(out, tuple) else out
+        assert np.asarray(out).shape == (4, 3)
+
+    def test_weight_norm_g_inherits_regularizer(self):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[2, 5],
+                                   append_batch_size=False)
+                reg = pt.regularizer.L2Decay(1e-3)
+                pt.layers.fc(x, size=3, bias_attr=False,
+                             param_attr=pt.WeightNormParamAttr(
+                                 dim=1, name="wnr", regularizer=reg))
+            blk = main.global_block()
+            assert blk.var("wnr_g").regularizer is reg
+            assert blk.var("wnr_v").regularizer is reg
+        finally:
+            pt.disable_static()
+
     def test_weight_norm_1d_dim0(self):
         """dim covering every axis of a 1-D param: per-element g."""
         import jax
@@ -235,6 +270,34 @@ class TestIoTails:
                 np.asarray(scope.find_var("sv_w")), w0)
             with pytest.raises(pt.EnforceNotMet):
                 pt.io.load_vars(exe, str(tmp_path), main, vars=["nope"])
+        finally:
+            pt.disable_static()
+
+    def test_load_vars_predicate(self, tmp_path):
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[2, 4],
+                                   append_batch_size=False)
+                pt.layers.fc(x, size=3, param_attr="enc_w",
+                             bias_attr="dec_b")
+            exe = pt.static.Executor()
+            exe.run(startup)
+            scope = pt.static.global_scope()
+            w0 = np.asarray(scope.find_var("enc_w")).copy()
+            b0 = np.asarray(scope.find_var("dec_b")).copy()
+            pt.io.save_vars(exe, str(tmp_path), main)
+            scope.set_var("enc_w", np.zeros_like(w0))
+            scope.set_var("dec_b", np.full_like(b0, 7.0))
+            pt.io.load_vars(exe, str(tmp_path), main,
+                            predicate=lambda v: v.name.startswith("enc_"))
+            np.testing.assert_allclose(
+                np.asarray(scope.find_var("enc_w")), w0)
+            # dec_b NOT restored: predicate excluded it
+            np.testing.assert_allclose(
+                np.asarray(scope.find_var("dec_b")),
+                np.full_like(b0, 7.0))
         finally:
             pt.disable_static()
 
