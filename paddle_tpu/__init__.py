@@ -61,6 +61,13 @@ from paddle_tpu import nets
 from paddle_tpu import dygraph
 in_dygraph_mode = dygraph.enabled   # fluid.in_dygraph_mode parity
 from paddle_tpu.dataio.feeder import DataFeeder
+# the two most common top-level paddle.* calls in fluid scripts:
+# paddle.batch(reader, bs) and paddle.dataset.mnist.train().
+# io.batch keeps paddle.batch's drop_last=False default (the raw
+# batch_reader helper defaults True, which would silently drop the
+# final partial batch of a migrated eval loop)
+from paddle_tpu.io import batch
+from paddle_tpu.dataio import dataset
 from paddle_tpu.framework import WeightNormParamAttr
 from paddle_tpu import lod_tensor
 from paddle_tpu.lod_tensor import (
