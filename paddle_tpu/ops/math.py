@@ -23,7 +23,8 @@ __all__ = [
     "matmul", "mul", "bmm", "dot", "scale", "sums", "cumsum",
     "clip", "clip_by_norm", "cast", "increment", "isfinite",
     "abs", "ceil", "floor", "round", "exp", "log", "sqrt", "rsqrt",
-    "square", "reciprocal", "sign", "cos", "sin", "pow",
+    "square", "reciprocal", "sign", "cos", "sin", "atan", "acos",
+    "asin", "pow",
     "logical_and", "logical_or", "logical_xor", "logical_not",
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "minus",
@@ -180,6 +181,9 @@ def reciprocal(x, name=None): return 1.0 / jnp.asarray(x)        # noqa: E704
 def sign(x, name=None): return jnp.sign(jnp.asarray(x))          # noqa: E704
 def cos(x, name=None): return jnp.cos(jnp.asarray(x))            # noqa: E704
 def sin(x, name=None): return jnp.sin(jnp.asarray(x))            # noqa: E704
+def atan(x, name=None): return jnp.arctan(jnp.asarray(x))        # noqa: E704
+def acos(x, name=None): return jnp.arccos(jnp.asarray(x))        # noqa: E704
+def asin(x, name=None): return jnp.arcsin(jnp.asarray(x))        # noqa: E704
 
 
 def pow(x, factor=1.0, name=None):

@@ -40,6 +40,7 @@ __all__ = [
     "FtrlOptimizer", "Lamb", "LambOptimizer", "ProximalGD",
     "ProximalGDOptimizer", "ProximalAdagrad", "ProximalAdagradOptimizer",
     "ModelAverage", "ExponentialMovingAverage",
+    "PipelineOptimizer",
 ]
 
 
@@ -518,3 +519,60 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 ProximalGD = ProximalGDOptimizer
 ProximalAdagrad = ProximalAdagradOptimizer
+
+
+class PipelineOptimizer:
+    """fluid.optimizer.PipelineOptimizer parity facade (ref
+    optimizer.py:2664: wraps an inner optimizer; PipelineTrainer runs
+    program sections over ScopeQueues).
+
+    TPU-native pipelining is the SPMD "pipe" mesh axis —
+    parallel.pipeline.PipelineModule(mesh, embed_fn, stage_fn, loss_fn,
+    n_micro).make_train_step(inner_opt, schedule="gpipe"|"1f1b") — and
+    ``make_train_step`` here delegates straight to it. In the static
+    single-program path ``minimize`` applies the inner optimizer over
+    the whole (un-cut) program: a one-stage pipeline IS plain training,
+    the same collapse the reference performs when cut_list is empty.
+    The cut/place/concurrency/queue knobs configure thread pipelines
+    over scope queues in the reference; on a TPU mesh their roles are
+    played by the pipe-axis size and microbatch count, so they are
+    accepted and recorded for inspection only.
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=None):
+        self._inner = optimizer
+        self.cut_list = cut_list or []
+        self.place_list = place_list or []
+        self.concurrency_list = concurrency_list or []
+        self.queue_size = queue_size
+        self.sync_steps = sync_steps
+        self.start_cpu_core_id = start_cpu_core_id
+        self.num_microbatches = num_microbatches or max(
+            len(self.concurrency_list), 1)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self.cut_list:
+            import warnings
+            warnings.warn(
+                "PipelineOptimizer: program cuts run un-pipelined in the "
+                "static path; use parallel.pipeline.PipelineModule over a "
+                "MeshConfig(pipe=N) mesh for real pipeline parallelism")
+        return self._inner.minimize(loss, startup_program,
+                                    parameter_list, no_grad_set)
+
+    def make_train_step(self, pipeline_module, schedule="gpipe"):
+        """The real (mesh) pipeline path: delegate to PipelineModule.
+        The module's own n_micro governs; a conflicting explicit
+        num_microbatches here is an error, not a silent no-op."""
+        mod_micro = getattr(pipeline_module, "n_micro", None)
+        if (self.num_microbatches not in (1, None, mod_micro)
+                and mod_micro is not None):
+            raise ValueError(
+                f"PipelineOptimizer(num_microbatches="
+                f"{self.num_microbatches}) conflicts with the "
+                f"PipelineModule's n_micro={mod_micro}")
+        return pipeline_module.make_train_step(self._inner,
+                                               schedule=schedule)

@@ -80,6 +80,12 @@ LR_SCHED_ALL = """cosine_decay exponential_decay inverse_time_decay
 linear_lr_warmup natural_exp_decay noam_decay piecewise_decay
 polynomial_decay""".split()
 
+# layers/ops.py __activations_noattr__ + uniform_random (the generated
+# activation surface)
+OPS_ALL = """sigmoid logsigmoid exp tanh atan tanh_shrink softshrink
+sqrt rsqrt abs ceil floor cos acos asin sin round reciprocal square
+softplus softsign uniform_random""".split()
+
 NETS_ALL = """glu img_conv_group scaled_dot_product_attention
 sequence_conv_pool simple_img_conv_pool""".split()
 
@@ -100,7 +106,7 @@ DISTRIBUTIONS_ALL = ["Normal", "Uniform"]
 class TestSurfaceComplete:
     @pytest.mark.parametrize("name", sorted(set(
         NN_ALL + TENSOR_ALL + CONTROL_FLOW_ALL + IO_ALL + DETECTION_ALL
-        + LR_SCHED_ALL)))
+        + LR_SCHED_ALL + OPS_ALL)))
     def test_layers_name(self, name):
         assert hasattr(L, name), f"fluid.layers.{name} missing"
 

@@ -351,3 +351,61 @@ def test_stage_fn_optional_kwarg_not_miscounted():
         want = jnp.tanh(want @ sp["w"] + sp["b"])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+class TestPipelineOptimizerFacade:
+    """fluid.optimizer.PipelineOptimizer parity (optimizer.py:2664):
+    the wrapper delegates to PipelineModule on a pipe mesh and to the
+    inner optimizer on the static single-program path."""
+
+    def test_make_train_step_delegates_to_module(self):
+        from paddle_tpu.optimizer import PipelineOptimizer
+        mod, params = _mod_and_params()
+        popt = PipelineOptimizer(SGDOptimizer(learning_rate=0.2),
+                                 num_microbatches=4,
+                                 start_cpu_core_id=2)
+        assert popt.start_cpu_core_id == 2     # knob recorded
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="n_micro"):
+            PipelineOptimizer(SGDOptimizer(learning_rate=0.2),
+                              num_microbatches=8).make_train_step(mod)
+        init_fn, step = popt.make_train_step(mod, schedule="1f1b")
+        params, opt_state = init_fn(params)
+        rng = np.random.RandomState(0)
+        xb = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+        yb = jnp.asarray(xb[:, :1] * 0.8 + xb[:, 1:2] * 0.3)
+        losses = []
+        for _ in range(40):
+            loss, params, opt_state = step(params, opt_state, xb, yb)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+    def test_static_minimize_collapses_to_inner(self):
+        import paddle_tpu as pt
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[8, 4],
+                                   append_batch_size=False)
+                t = pt.static.data("t", shape=[8, 1],
+                                   append_batch_size=False)
+                loss = pt.layers.mean(pt.layers.square_error_cost(
+                    pt.layers.fc(x, size=1), t))
+                popt = pt.optimizer.PipelineOptimizer(
+                    pt.optimizer.AdamOptimizer(0.05))
+                popt.minimize(loss)
+            exe = pt.static.Executor()
+            exe.run(startup)
+            rs = np.random.RandomState(0)
+            xb = rs.randn(8, 4).astype(np.float32)
+            tb = rs.randn(8, 1).astype(np.float32)
+            first = last = None
+            for _ in range(40):
+                (lv,) = exe.run(main, feed={"x": xb, "t": tb},
+                                fetch_list=[loss])
+                first = first if first is not None else float(lv)
+                last = float(lv)
+            assert last < first * 0.2
+        finally:
+            pt.disable_static()
