@@ -11,7 +11,7 @@ from enum import Enum
 import jax
 
 __all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
-           "UserDefinedRoleMaker"]
+           "UserDefinedRoleMaker", "MPISymetricRoleMaker"]
 
 
 class Role(Enum):
@@ -82,3 +82,73 @@ class UserDefinedRoleMaker(RoleMakerBase):
         self._role = role
         self._worker_num = worker_num
         self._server_endpoints = server_endpoints or []
+
+
+class MPISymetricRoleMaker(RoleMakerBase):
+    """role_maker.py MPISymetricRoleMaker parity: one worker + one
+    server per physical node, ranks interleaved (even rank = worker,
+    odd = server). The reference derives ranks from MPI; here they come
+    from the same env contract the launcher sets (PADDLE_TRAINER_ID as
+    the global rank, PADDLE_TRAINERS_NUM as the world size) — the MPI
+    runtime's role is played by the TPU scheduler / launcher
+    (SURVEY §2.5 Downpour row)."""
+
+    def __init__(self):
+        super().__init__()
+        self._proc_per_node = 2
+        self._generated = False
+
+    def generate_role(self):
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", 2))
+        if world % 2 != 0:
+            raise ValueError(
+                f"MPISymetricRoleMaker needs an even world size (one "
+                f"worker + one server per node); got {world}")
+        self._rank = rank
+        self._size = world
+        self._role = Role.WORKER if rank % 2 == 0 else Role.SERVER
+        self._current_id = rank // 2
+        self._worker_num = world // 2
+        eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS", "")
+        self._server_endpoints = eps.split(",") if eps else []
+        self._generated = True
+
+    def _check_role_generation(self):
+        if not self._generated:
+            raise NameError("generate_role() should be called first")
+        return True
+
+    # every role query requires generation — silently returning the
+    # base-class defaults would shard data over 1 phantom worker
+    def is_worker(self):
+        self._check_role_generation()
+        return super().is_worker()
+
+    def is_server(self):
+        self._check_role_generation()
+        return super().is_server()
+
+    def worker_num(self):
+        self._check_role_generation()
+        return super().worker_num()
+
+    def worker_index(self):
+        self._check_role_generation()
+        return super().worker_index()
+
+    def server_index(self):
+        self._check_role_generation()
+        return super().server_index()
+
+    def get_pserver_endpoints(self):
+        self._check_role_generation()
+        return super().get_pserver_endpoints()
+
+    def get_size(self):
+        self._check_role_generation()
+        return self._size
+
+    def server_num(self):
+        self._check_role_generation()
+        return self._size // self._proc_per_node

@@ -1,0 +1,11 @@
+"""incubate namespace.
+
+Parity: python/paddle/fluid/incubate/ — fleet (re-exported from
+paddle_tpu.distributed) and data_generator (MultiSlot dataset-file
+writers).
+"""
+
+from paddle_tpu.incubate import data_generator      # noqa: F401
+from paddle_tpu.distributed import fleet            # noqa: F401
+
+__all__ = ["data_generator", "fleet"]

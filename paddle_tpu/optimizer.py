@@ -549,6 +549,9 @@ class PipelineOptimizer:
         self.queue_size = queue_size
         self.sync_steps = sync_steps
         self.start_cpu_core_id = start_cpu_core_id
+        # only an EXPLICIT num_microbatches is a user contract the mesh
+        # path enforces; concurrency_list stays inspection-only
+        self._explicit_micro = num_microbatches is not None
         self.num_microbatches = num_microbatches or max(
             len(self.concurrency_list), 1)
 
@@ -568,8 +571,8 @@ class PipelineOptimizer:
         The module's own n_micro governs; a conflicting explicit
         num_microbatches here is an error, not a silent no-op."""
         mod_micro = getattr(pipeline_module, "n_micro", None)
-        if (self.num_microbatches not in (1, None, mod_micro)
-                and mod_micro is not None):
+        if (self._explicit_micro and mod_micro is not None
+                and self.num_microbatches != mod_micro):
             raise ValueError(
                 f"PipelineOptimizer(num_microbatches="
                 f"{self.num_microbatches}) conflicts with the "

@@ -1,0 +1,145 @@
+"""incubate namespace: data_generator (MultiSlot dataset writers) and
+the MPI symmetric role maker.
+
+Parity refs: python/paddle/fluid/incubate/data_generator/__init__.py
+(DataGenerator:21, MultiSlotDataGenerator:282; behavior mirrored from
+incubate/data_generator/test_data_generator.py),
+incubate/fleet/base/role_maker.py MPISymetricRoleMaker:226.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.data_generator import (
+    DataGenerator, MultiSlotDataGenerator,
+)
+from paddle_tpu.distributed.role_maker import MPISymetricRoleMaker
+
+
+class _WordsLabel(MultiSlotDataGenerator):
+    def generate_sample(self, line):
+        def it():
+            toks = [int(x) for x in line.split()]
+            yield [("words", toks), ("label", [toks[0] % 2])]
+        return it
+
+
+class TestMultiSlotDataGenerator:
+    def test_gen_str_format(self):
+        g = MultiSlotDataGenerator()
+        s = g._gen_str([("words", [1926, 8, 17]), ("label", [1])])
+        assert s == "3 1926 8 17 1 1\n"
+        assert g._proto_info == [("words", "uint64"), ("label", "uint64")]
+        # float promotes the slot dtype
+        g._gen_str([("words", [1.5, 2.0, 3.0]), ("label", [0])])
+        assert g._proto_info[0] == ("words", "float")
+
+    def test_gen_str_validation(self):
+        g = MultiSlotDataGenerator()
+        with pytest.raises(ValueError):
+            g._gen_str("not a list")
+        with pytest.raises(ValueError):
+            g._gen_str([("words", [])])            # empty slot
+        g._gen_str([("a", [1]), ("b", [2])])
+        with pytest.raises(ValueError, match="inconsistent"):
+            g._gen_str([("a", [1])])               # field count changed
+        with pytest.raises(ValueError, match="mismatch"):
+            g._gen_str([("a", [1]), ("c", [2])])   # name changed
+
+    def test_run_from_stdin(self):
+        g = _WordsLabel()
+        out = io.StringIO()
+        g.run_from_stdin(io.StringIO("1 2 3\n4 5 6\n"), out)
+        assert out.getvalue() == "3 1 2 3 1 1\n3 4 5 6 1 0\n"
+
+    def test_line_limit(self):
+        g = _WordsLabel()
+        g._set_line_limit(1)
+        out = io.StringIO()
+        g.run_from_stdin(io.StringIO("1 2 3\n4 5 6\n"), out)
+        assert out.getvalue() == "3 1 2 3 1 1\n"
+        with pytest.raises(ValueError):
+            g._set_line_limit(0)
+
+    def test_run_from_memory_and_generate_batch(self):
+        class MemGen(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    for i in range(3):
+                        yield [("x", [i])]
+                return it
+
+            def generate_batch(self, samples):
+                def it():
+                    # batch hook sees the buffered samples
+                    for s in samples:
+                        yield [("x", [s[0][1][0] * 10])]
+                return it
+        g = MemGen()
+        g.set_batch(2)
+        out = io.StringIO()
+        g.run_from_memory(out)
+        assert out.getvalue() == "1 0\n1 10\n1 20\n"
+
+    def test_round_trip_through_dataset(self, tmp_path):
+        """Generated MultiSlot text feeds the fluid Dataset parser."""
+        g = _WordsLabel()
+        out = io.StringIO()
+        g.run_from_stdin(io.StringIO("1 2 3\n4 5 6\n"), out)
+        p = tmp_path / "part-0"
+        p.write_text(out.getvalue())
+        ds = pt.dataio.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_use_var([("words", "int64"), ("label", "int64")])
+        ds.set_batch_size(2)
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        batch = next(iter(ds))
+        assert np.asarray(batch["words"]).tolist() == [[1, 2, 3], [4, 5, 6]]
+        assert np.asarray(batch["label"]).ravel().tolist() == [1, 0]
+
+    def test_base_class_requires_overrides(self):
+        g = DataGenerator()
+        with pytest.raises(NotImplementedError):
+            g.generate_sample("x")
+        with pytest.raises(NotImplementedError):
+            g._gen_str([("a", [1])])
+
+
+class TestMPISymetricRoleMaker:
+    def test_queries_require_generation_and_even_world(self):
+        os.environ["PADDLE_TRAINER_ID"] = "0"
+        os.environ["PADDLE_TRAINERS_NUM"] = "5"
+        try:
+            m = MPISymetricRoleMaker()
+            with pytest.raises(NameError):
+                m.is_worker()              # no silent default roles
+            with pytest.raises(ValueError, match="even"):
+                m.generate_role()          # odd world size rejected
+        finally:
+            del os.environ["PADDLE_TRAINER_ID"]
+            del os.environ["PADDLE_TRAINERS_NUM"]
+
+    def test_interleaved_roles(self):
+        os.environ["PADDLE_TRAINER_ID"] = "3"
+        os.environ["PADDLE_TRAINERS_NUM"] = "4"
+        try:
+            m = MPISymetricRoleMaker()
+            with pytest.raises(NameError):
+                m.get_size()               # before generate_role
+            m.generate_role()
+            assert m.is_server() and not m.is_worker()
+            assert m.server_index() == 1
+            assert m.worker_num() == 2 and m.server_num() == 2
+            assert m.get_size() == 4
+
+            os.environ["PADDLE_TRAINER_ID"] = "2"
+            w = MPISymetricRoleMaker()
+            w.generate_role()
+            assert w.is_worker() and w.worker_index() == 1
+        finally:
+            del os.environ["PADDLE_TRAINER_ID"]
+            del os.environ["PADDLE_TRAINERS_NUM"]

@@ -365,8 +365,7 @@ class TestPipelineOptimizerFacade:
                                  num_microbatches=4,
                                  start_cpu_core_id=2)
         assert popt.start_cpu_core_id == 2     # knob recorded
-        import pytest as _pytest
-        with _pytest.raises(ValueError, match="n_micro"):
+        with pytest.raises(ValueError, match="n_micro"):
             PipelineOptimizer(SGDOptimizer(learning_rate=0.2),
                               num_microbatches=8).make_train_step(mod)
         init_fn, step = popt.make_train_step(mod, schedule="1f1b")
