@@ -11,7 +11,9 @@ ids** with static shapes:
 - masks/segment ids derived on demand (``sequence_mask``) and fused by XLA
   into the consuming op.
 - bucketing-by-length (the padding-waste mitigation) lives in the data
-  pipeline, not the type.
+  pipeline, not the type: ``paddle_tpu.reader.bucketed_batch`` pads each
+  batch to its bucket's boundary, so jit compiles one program per
+  bucket instead of retracing per length.
 
 A RaggedBatch is a JAX pytree, so it flows through jit/grad/shard_map.
 """

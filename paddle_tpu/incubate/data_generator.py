@@ -121,6 +121,12 @@ class MultiSlotDataGenerator(DataGenerator):
                     f"<{proto[idx][0]}>, got <{name}>")
             parts.append(str(len(elements)))
             for elem in elements:
+                if isinstance(elem, bool):
+                    # bool IS an int subclass but str(True) would write
+                    # the literal 'True' into the MultiSlot file
+                    raise ValueError(
+                        f"slot '{name}': bool elements are not valid "
+                        "MultiSlot values — cast to int")
                 if isinstance(elem, float):
                     proto[idx] = (name, "float")
                 elif not isinstance(elem, int):

@@ -17,7 +17,7 @@ import threading
 __all__ = [
     "map_readers", "shuffle", "chain", "compose", "buffered", "firstn",
     "xmap_readers", "cache", "multiprocess_reader",
-    "ComposeNotAligned", "PipeReader", "Fake",
+    "ComposeNotAligned", "PipeReader", "Fake", "bucketed_batch",
 ]
 
 
@@ -287,3 +287,88 @@ class Fake:
             for _ in range(length):
                 yield self.data
         return fake_reader
+
+
+def bucketed_batch(reader, bucket_boundaries, batch_size, pad_value=0,
+                   length_fn=None, drop_last=False):
+    """Bucketing-by-length — the TPU-native mitigation for LoD's
+    "no padding" efficiency claim (SURVEY §7 hard part; core/lod.py
+    points here). Samples are grouped into buckets by sequence length
+    and every batch is padded to its BUCKET BOUNDARY, not the batch
+    max, so under jit the shape set stays small and quantized:
+    one shape per bucket, plus — for lengths beyond the last
+    boundary — one shape per multiple of the last boundary actually
+    observed, plus (when drop_last=False) the tail batches' ragged
+    leading dims. With drop_last=True and lengths within the
+    boundaries the count is exactly len(bucket_boundaries).
+
+    reader: yields sample tuples of arrays; a field is padded iff its
+    leading dim equals the sample's length for EVERY sample in the
+    batch (fixed-size side fields are stacked unchanged).
+    length_fn: sample -> int (default: len of the first field).
+
+    Yields (fields..., lengths) — each padded field [B, boundary, ...],
+    lengths [B] int32 (RaggedBatch(field, lengths) reassembles LoD
+    semantics downstream).
+    """
+    import numpy as np
+    bounds = sorted(int(b) for b in bucket_boundaries)
+    if not bounds:
+        raise ValueError("bucket_boundaries must be non-empty")
+    lf = length_fn or (lambda s: len(s[0]))
+
+    def pad_batch(buf, boundary):
+        n_fields = len(buf[0][1])
+        lengths = np.array([l for l, _ in buf], np.int32)
+        out = []
+        for i in range(n_fields):
+            fields = [np.asarray(s[i]) for _, s in buf]
+            # a field is length-like only if it tracks the length in
+            # EVERY sample — judging from one sample would misclassify
+            # fixed-size fields that coincide with it (order-dependent
+            # crashes mid-epoch)
+            ragged = all(f.ndim >= 1 and f.shape[0] == l
+                         for f, (l, _) in zip(fields, buf))
+            if ragged:
+                tail = fields[0].shape[1:]
+                arr = np.full((len(buf), boundary) + tail, pad_value,
+                              fields[0].dtype)
+                for j, (l, _) in enumerate(buf):
+                    arr[j, :l] = fields[j][:boundary]
+                out.append(arr)
+            else:
+                out.append(np.stack(fields))
+        out.append(lengths)
+        return tuple(out)
+
+    def overflow_boundary(buf):
+        m = max(l for l, _ in buf)
+        q = bounds[-1]
+        return ((m + q - 1) // q) * q            # quantized shape set
+
+    def bucketed():
+        buckets = {}                     # boundary -> [(len, sample)]
+        overflow = []
+        for sample in reader():
+            if not isinstance(sample, tuple):
+                sample = (sample,)
+            n = int(lf(sample))
+            b = next((bd for bd in bounds if n <= bd), None)
+            if b is None:
+                overflow.append((n, sample))
+                if len(overflow) == batch_size:
+                    yield pad_batch(overflow, overflow_boundary(overflow))
+                    overflow = []
+                continue
+            buf = buckets.setdefault(b, [])
+            buf.append((n, sample))
+            if len(buf) == batch_size:
+                yield pad_batch(buf, b)
+                buckets[b] = []
+        if not drop_last:
+            for b, buf in sorted(buckets.items()):
+                if buf:
+                    yield pad_batch(buf, b)
+            if overflow:
+                yield pad_batch(overflow, overflow_boundary(overflow))
+    return bucketed

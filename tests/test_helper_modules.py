@@ -223,3 +223,83 @@ class TestLodTensorEdgeCases:
                                   [[2, 1], [2, 1, 3]])
         rb2 = jax.tree_util.tree_map(lambda x: x, rb)
         assert rb2.recursive_seq_lens == [[2, 1], [2, 1, 3]]
+
+
+class TestBucketedBatch:
+    """Bucketing-by-length (SURVEY §7 hard part: LoD's no-padding
+    efficiency on static-shape TPU). core/lod.py points at the data
+    pipeline for this; paddle_tpu.reader.bucketed_batch is it."""
+
+    def _samples(self, n=100, max_len=200, seed=0):
+        rs = np.random.RandomState(seed)
+        def gen():
+            for _ in range(n):
+                ln = rs.randint(1, max_len)
+                yield (np.arange(ln, dtype=np.int32), np.int64(ln % 2))
+        return gen
+
+    def test_shapes_quantized_and_contents_preserved(self):
+        r = R.bucketed_batch(self._samples(), [32, 64, 128], 8)
+        shapes, total_tok, total_cells, n_samples = set(), 0, 0, 0
+        for seq, lab, lens in r():
+            shapes.add(seq.shape[1])
+            total_tok += int(lens.sum())
+            total_cells += seq.shape[0] * seq.shape[1]
+            n_samples += len(lens)
+            for i in range(len(lens)):
+                np.testing.assert_array_equal(
+                    seq[i, :lens[i]], np.arange(lens[i]))
+                assert (seq[i, lens[i]:] == 0).all()
+        assert n_samples == 100                  # nothing dropped
+        # a handful of static shapes, all quantized to boundaries
+        assert shapes <= {32, 64, 128, 256}
+        # padding waste strictly better than pad-to-global-max
+        waste = 1 - total_tok / total_cells
+        naive = 1 - total_tok / (100 * 200)
+        assert waste < naive
+
+    def test_compiles_once_per_bucket(self):
+        import jax
+        import jax.numpy as jnp
+        traces = []
+
+        @jax.jit
+        def step(seq, lens):
+            traces.append(seq.shape)             # records RETRACES only
+            from paddle_tpu.ops.sequence import sequence_pool
+            from paddle_tpu.core.lod import RaggedBatch
+            return sequence_pool(RaggedBatch(seq, lens), "sum")
+
+        # drop_last: every batch is full, so shapes are exactly
+        # (batch, boundary) — one compile per bucket
+        r = R.bucketed_batch(self._samples(), [32, 64, 128], 8,
+                             drop_last=True)
+        for seq, lab, lens in r():
+            out = step(jnp.asarray(seq[..., None], jnp.float32),
+                       jnp.asarray(lens))
+            # masked sum == sum of 0..l-1 == l(l-1)/2 per row
+            expect = lens.astype(np.int64) * (lens - 1) // 2
+            np.testing.assert_allclose(np.asarray(out).ravel(), expect)
+        assert len(traces) <= 4                  # one compile per bucket
+
+    def test_fixed_field_coinciding_with_length(self):
+        """A fixed-size side field whose size equals some sample's
+        length must still be stacked, not padded (order-dependent
+        misclassification guard)."""
+        def gen():
+            # first sample length == side-field size (7)
+            for ln in [7, 3, 12]:
+                yield (np.arange(ln, dtype=np.int32),
+                       np.ones(7, np.float32))
+        (seq, side, lens), = list(R.bucketed_batch(gen, [16], 3)())
+        assert side.shape == (3, 7)              # stacked unchanged
+        assert seq.shape == (3, 16)
+        assert list(lens) == [7, 3, 12]
+
+    def test_drop_last_and_overflow(self):
+        r = R.bucketed_batch(self._samples(16, 50), [8, 16], 4,
+                             drop_last=True)
+        for seq, lab, lens in r():
+            assert len(lens) == 4                # only full batches
+        with pytest.raises(ValueError):
+            R.bucketed_batch(self._samples(), [], 4)

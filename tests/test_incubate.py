@@ -49,6 +49,8 @@ class TestMultiSlotDataGenerator:
             g._gen_str([("a", [1])])               # field count changed
         with pytest.raises(ValueError, match="mismatch"):
             g._gen_str([("a", [1]), ("c", [2])])   # name changed
+        with pytest.raises(ValueError, match="bool"):
+            g._gen_str([("a", [True]), ("b", [2])])
 
     def test_run_from_stdin(self):
         g = _WordsLabel()
