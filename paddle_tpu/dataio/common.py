@@ -194,3 +194,96 @@ def digits_reader(split="train", test_fraction=0.2, seed=42):
             yield imgs[i], int(labels[i])
 
     return reader
+
+
+# --- dataset-to-file utilities (ref python/paddle/dataset/common.py:
+# split, cluster_files_reader, convert) --------------------------------
+
+def _npz_dump(obj, f):
+    """Default dumper: np.savez of the sample list (structural, no
+    pickle — the repo's artifact discipline; pass your own dumper for
+    the reference's pickle format)."""
+    import io as _io
+    import numpy as np
+    arrays = {}
+    for i, sample in enumerate(obj):
+        if not isinstance(sample, (tuple, list)):
+            sample = (sample,)
+        for j, field in enumerate(sample):
+            arr = np.asarray(field)
+            if arr.dtype == object:
+                # np.savez would PICKLE object arrays — and the paired
+                # loader (allow_pickle=False) could never read them
+                # back; fail at dump time with a usable message
+                raise TypeError(
+                    f"split: sample {i} field {j} is object-dtype "
+                    "(ragged/non-numeric); convert fields to rectangular "
+                    "arrays, or pass a custom dumper/loader pair")
+            arrays[f"s{i}_f{j}"] = arr
+        arrays[f"s{i}_n"] = np.asarray(len(sample))
+    buf = _io.BytesIO()
+    np.savez(buf, n=np.asarray(len(obj)), **arrays)
+    f.write(buf.getvalue())
+
+
+def _npz_load(f):
+    import io as _io
+    import numpy as np
+    with np.load(_io.BytesIO(f.read())) as z:
+        n = int(z["n"])
+        out = []
+        for i in range(n):
+            k = int(z[f"s{i}_n"])
+            out.append(tuple(z[f"s{i}_f{j}"] for j in range(k)))
+        return out
+
+
+def split(reader, line_count, suffix="%05d.npz", dumper=None):
+    """dataset.common.split parity: dump a reader into numbered chunk
+    files of line_count samples (dumper(obj, f); default: structural
+    npz)."""
+    dumper = dumper or _npz_dump
+    if not callable(dumper):
+        raise TypeError("dumper should be callable")
+    lines, idx, written = [], 0, []
+    for d in reader():
+        lines.append(d)
+        if len(lines) == line_count:
+            path = suffix % idx
+            with open(path, "wb") as f:
+                dumper(lines, f)
+            written.append(path)
+            lines, idx = [], idx + 1
+    if lines:
+        path = suffix % idx
+        with open(path, "wb") as f:
+            dumper(lines, f)
+        written.append(path)
+    return written
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """dataset.common.cluster_files_reader parity: round-robin the
+    sorted file list over trainers, yield this trainer's samples."""
+    loader = loader or _npz_load
+
+    def reader():
+        import glob
+        if not callable(loader):
+            raise TypeError("loader should be callable")
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, "rb") as f:
+                    yield from loader(f)
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """dataset.common.convert parity: reader -> RecordIO shard files
+    (the np.savez record format layers.open_files reads)."""
+    import os
+    from paddle_tpu.recordio_writer import convert_reader_to_recordio_files
+    return convert_reader_to_recordio_files(
+        os.path.join(output_path, name_prefix), line_count, reader)

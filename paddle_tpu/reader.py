@@ -290,7 +290,7 @@ class Fake:
 
 
 def bucketed_batch(reader, bucket_boundaries, batch_size, pad_value=0,
-                   length_fn=None, drop_last=False):
+                   length_fn=None, drop_last=False, ragged_fields=None):
     """Bucketing-by-length — the TPU-native mitigation for LoD's
     "no padding" efficiency claim (SURVEY §7 hard part; core/lod.py
     points here). Samples are grouped into buckets by sequence length
@@ -302,9 +302,13 @@ def bucketed_batch(reader, bucket_boundaries, batch_size, pad_value=0,
     leading dims. With drop_last=True and lengths within the
     boundaries the count is exactly len(bucket_boundaries).
 
-    reader: yields sample tuples of arrays; a field is padded iff its
-    leading dim equals the sample's length for EVERY sample in the
-    batch (fixed-size side fields are stacked unchanged).
+    reader: yields sample tuples of arrays. ragged_fields names the
+    field indices to pad; when None the classification is inferred
+    from the FIRST assembled batch (a field whose leading dim tracks
+    the length in every sample) and then held fixed for the whole
+    stream, so shapes never flip mid-epoch — pass ragged_fields
+    explicitly when a fixed-size field's size could coincide with all
+    lengths of the first batch.
     length_fn: sample -> int (default: len of the first field).
 
     Yields (fields..., lengths) — each padded field [B, boundary, ...],
@@ -316,20 +320,29 @@ def bucketed_batch(reader, bucket_boundaries, batch_size, pad_value=0,
     if not bounds:
         raise ValueError("bucket_boundaries must be non-empty")
     lf = length_fn or (lambda s: len(s[0]))
+    ragged_set = set(ragged_fields) if ragged_fields is not None else None
+
+    def classify(buf):
+        # sticky auto-classification from the first assembled batch:
+        # a field is length-like if it tracks the length in EVERY
+        # sample; held fixed afterwards so shapes never flip mid-epoch
+        nonlocal ragged_set
+        ragged_set = set()
+        for i in range(len(buf[0][1])):
+            fields = [np.asarray(s[i]) for _, s in buf]
+            if all(f.ndim >= 1 and f.shape[0] == l
+                   for f, (l, _) in zip(fields, buf)):
+                ragged_set.add(i)
 
     def pad_batch(buf, boundary):
         n_fields = len(buf[0][1])
         lengths = np.array([l for l, _ in buf], np.int32)
+        if ragged_set is None:
+            classify(buf)
         out = []
         for i in range(n_fields):
             fields = [np.asarray(s[i]) for _, s in buf]
-            # a field is length-like only if it tracks the length in
-            # EVERY sample — judging from one sample would misclassify
-            # fixed-size fields that coincide with it (order-dependent
-            # crashes mid-epoch)
-            ragged = all(f.ndim >= 1 and f.shape[0] == l
-                         for f, (l, _) in zip(fields, buf))
-            if ragged:
+            if i in ragged_set:
                 tail = fields[0].shape[1:]
                 arr = np.full((len(buf), boundary) + tail, pad_value,
                               fields[0].dtype)

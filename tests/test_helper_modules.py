@@ -296,6 +296,25 @@ class TestBucketedBatch:
         assert seq.shape == (3, 16)
         assert list(lens) == [7, 3, 12]
 
+    def test_classification_is_sticky_across_batches(self):
+        """A 1-sample tail batch whose length coincides with a fixed
+        field's size must keep the field classification from the first
+        batch (no mid-epoch shape flip)."""
+        def gen():
+            for ln in [3, 5, 7]:                 # tail batch: len 7 == 7
+                yield (np.arange(ln, dtype=np.int32),
+                       np.ones(7, np.float32))
+        side_shapes = [side.shape for _, side, _ in
+                       R.bucketed_batch(gen, [16], 2)()]
+        assert side_shapes == [(2, 7), (1, 7)]   # never padded
+
+    def test_explicit_ragged_fields(self):
+        def gen():
+            yield (np.arange(7, dtype=np.int32), np.ones(7, np.float32))
+        (seq, side, lens), = list(R.bucketed_batch(
+            gen, [16], 1, ragged_fields=[0])())
+        assert seq.shape == (1, 16) and side.shape == (1, 7)
+
     def test_drop_last_and_overflow(self):
         r = R.bucketed_batch(self._samples(16, 50), [8, 16], 4,
                              drop_last=True)
@@ -303,3 +322,46 @@ class TestBucketedBatch:
             assert len(lens) == 4                # only full batches
         with pytest.raises(ValueError):
             R.bucketed_batch(self._samples(), [], 4)
+
+
+class TestDatasetCommonUtils:
+    """dataset.common split/cluster_files_reader/convert parity."""
+
+    def test_split_and_cluster_reader(self, tmp_path):
+        from paddle_tpu.dataio import common
+        samples = [(np.full((2,), i, np.float32), np.int64(i))
+                   for i in range(10)]
+        paths = common.split(lambda: iter(samples), 4,
+                             suffix=str(tmp_path / "part-%05d.npz"))
+        assert len(paths) == 3                   # 4+4+2
+        # two trainers see a disjoint, complete partition of the files
+        got = []
+        for tid in range(2):
+            r = common.cluster_files_reader(
+                str(tmp_path / "part-*.npz"), 2, tid)
+            got.append([int(s[1]) for s in r()])
+        assert sorted(got[0] + got[1]) == list(range(10))
+        assert not (set(got[0]) & set(got[1]))
+
+    def test_split_rejects_object_dtype(self, tmp_path):
+        from paddle_tpu.dataio import common
+        ragged = [(np.asarray([[1], [2, 3]], dtype=object),)]
+        with pytest.raises(TypeError, match="object-dtype"):
+            common.split(lambda: iter(ragged), 2,
+                         suffix=str(tmp_path / "bad-%05d.npz"))
+
+    def test_convert_roundtrip(self, tmp_path):
+        native = pytest.importorskip("paddle_tpu.native")
+        if not native.available():
+            pytest.skip("no native toolchain")
+        from paddle_tpu.dataio import common
+        samples = [(np.float32(i),) for i in range(6)]
+        paths = common.convert(str(tmp_path), lambda: iter(samples), 3,
+                               "shard")
+        assert len(paths) == 2
+        from paddle_tpu import native as nat
+        total = 0
+        for p in paths:
+            with nat.RecordIOScanner(p) as s:
+                total += sum(1 for _ in s)
+        assert total == 6
