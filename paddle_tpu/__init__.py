@@ -60,6 +60,10 @@ from paddle_tpu import backward
 from paddle_tpu import nets
 from paddle_tpu import dygraph
 from paddle_tpu import incubate
+from paddle_tpu import compiler
+from paddle_tpu.compiler import (
+    CompiledProgram, ExecutionStrategy, BuildStrategy,
+)
 in_dygraph_mode = dygraph.enabled   # fluid.in_dygraph_mode parity
 from paddle_tpu.dataio.feeder import DataFeeder
 # the two most common top-level paddle.* calls in fluid scripts:

@@ -1,5 +1,8 @@
 """fluid.backward parity — re-exports the static autodiff entry points."""
 
-from paddle_tpu.static.backward import append_backward, gradients, GRAD_SUFFIX
+from paddle_tpu.static.backward import (
+    append_backward, gradients, calc_gradient, GRAD_SUFFIX,
+)
 
-__all__ = ["append_backward", "gradients", "GRAD_SUFFIX"]
+__all__ = ["append_backward", "gradients", "calc_gradient",
+           "GRAD_SUFFIX"]

@@ -1,0 +1,121 @@
+"""fluid.compiler parity — CompiledProgram.with_data_parallel.
+
+Parity: python/paddle/fluid/compiler.py (CompiledProgram:48,
+with_data_parallel:116) + the strategy structs crossing pybind
+(details/execution_strategy.h:22, details/build_strategy.h:36).
+
+TPU-native redesign (SURVEY §3.2, the north-star path): the reference
+clones the graph per device and inserts NCCL allreduce per gradient
+(multi_devices_graph_pass.cc). Here the SAME single-program step the
+Executor already compiles is partitioned by GSPMD: feed arrays are
+sharded over the "data" mesh axis (batch dim), persistable state stays
+replicated, and XLA inserts the gradient all-reduce where the batch-mean
+loss meets the replicated parameters — no graph rewrite, no per-gradient
+plumbing. `exe.run(compiled_program, ...)` is the same call as the
+reference.
+"""
+
+from enum import Enum
+
+from paddle_tpu.core.enforce import EnforceNotMet
+
+__all__ = ["CompiledProgram", "ExecutionStrategy", "BuildStrategy",
+           "ReduceStrategy"]
+
+
+class ReduceStrategy(Enum):
+    """build_strategy.h:38-57. AllReduce replicates params; Reduce is
+    realized as the ZeRO-style sharded layout (the functional trainer
+    consumes it via DataParallelTrainer(param_sharding="reduce"); the
+    static path trains AllReduce-style either way — XLA's partitioner
+    owns placement)."""
+    AllReduce = 0
+    Reduce = 1
+
+
+class ExecutionStrategy:
+    """execution_strategy.h:22 — thread/scope knobs for the SSA
+    executors. XLA owns scheduling and buffer lifetime, so these are
+    recorded for API compatibility and inspection only."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.allow_op_delay = False
+        self.use_thread_barrier = True
+
+
+class BuildStrategy:
+    """build_strategy.h:36 — multi-device graph-build knobs. The rows
+    XLA subsumes (fusion, memory planning, inplace) are recorded only;
+    reduce_strategy maps to the ZeRO layout on the functional path
+    (fleet.DistributedStrategy.param_sharding_arg) and
+    gradient_scale_strategy is honored by the batch-mean loss
+    convention (scale 1/N == averaging over the full global batch)."""
+
+    ReduceStrategy = ReduceStrategy
+
+    def __init__(self):
+        self.reduce_strategy = ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = "CoeffNumDevice"
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.memory_optimize = None
+        self.enable_inplace = True
+        self.sync_batch_norm = False
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+
+
+class CompiledProgram:
+    """compiler.py CompiledProgram parity. Wrap a Program; after
+    ``with_data_parallel()`` the Executor runs its one fused XLA step
+    SPMD over the data mesh (feeds batch-sharded, state replicated).
+    Without it, behaves exactly like the wrapped program."""
+
+    def __init__(self, program, build_strategy=None):
+        from paddle_tpu.static.program import Program
+        if isinstance(program, CompiledProgram):
+            raise EnforceNotMet("program is already a CompiledProgram")
+        if not isinstance(program, Program):
+            raise EnforceNotMet(
+                f"CompiledProgram wraps a Program, got {type(program)}")
+        self._program = program
+        self._build_strategy = build_strategy
+        self._exec_strategy = None
+        self._dp = False
+        self._mesh = None
+        self._loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """compiler.py:116 parity. places: a device list or count; the
+        default is every visible device on one "data" mesh axis."""
+        import jax
+        from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
+        self._dp = True
+        self._loss_name = (loss_name if isinstance(loss_name, str)
+                           or loss_name is None else loss_name.name)
+        self._build_strategy = build_strategy or self._build_strategy \
+            or BuildStrategy()
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        if places is None:
+            devices = jax.devices()
+        elif isinstance(places, int):
+            devices = jax.devices()[:places]
+        else:
+            devices = [p.jax_device() if hasattr(p, "jax_device") else p
+                       for p in places]
+        self._mesh = make_mesh(MeshConfig(data=len(devices)),
+                               devices=devices)
+        return self
+
+    # the Executor reads program attributes through the wrapper
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.__dict__["_program"], name)

@@ -263,3 +263,8 @@ def _digits_factory(split):
 digits = _RealOnly(_digits_factory)
 
 __all__ += ["digits"]
+
+
+# fluid namespace parity: paddle.dataset.common (download cache +
+# split/cluster_files_reader/convert file sharding)
+from paddle_tpu.dataio import common  # noqa: E402,F401

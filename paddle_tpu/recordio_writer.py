@@ -25,8 +25,18 @@ def _serialize_sample(sample, feeder=None):
         sample = tuple(sample)
     if not isinstance(sample, (tuple, list)):
         sample = (sample,)
+    arrays = {}
+    for i, v in enumerate(sample):
+        arr = np.asarray(v)
+        if arr.dtype == object:
+            # np.savez would pickle object arrays and layers.open_files
+            # (allow_pickle=False) could never read the record back
+            raise TypeError(
+                f"recordio sample field {i} is object-dtype (ragged/"
+                "non-numeric); convert fields to rectangular arrays")
+        arrays[f"f{i}"] = arr
     buf = _io.BytesIO()
-    np.savez(buf, **{f"f{i}": np.asarray(v) for i, v in enumerate(sample)})
+    np.savez(buf, **arrays)
     return buf.getvalue()
 
 

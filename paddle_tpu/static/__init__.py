@@ -33,3 +33,7 @@ from paddle_tpu.static.io import (
     load_params, save_persistables, load_persistables,
     append_save_op, append_load_op,
 )
+
+from paddle_tpu.compiler import (            # noqa: E402,F401
+    CompiledProgram, ExecutionStrategy, BuildStrategy,
+)

@@ -58,3 +58,7 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         i if isinstance(i, str) else i.name
         for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])])
     return [g for _, g in pg]
+
+
+# fluid name for the same entry point (backward.py:695)
+calc_gradient = gradients

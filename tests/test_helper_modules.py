@@ -350,6 +350,17 @@ class TestDatasetCommonUtils:
             common.split(lambda: iter(ragged), 2,
                          suffix=str(tmp_path / "bad-%05d.npz"))
 
+    def test_common_reachable_at_dataset_namespace(self):
+        import paddle_tpu as _pt
+        assert hasattr(_pt.dataset, "common")
+        assert _pt.dataset.common.split is not None
+
+    def test_convert_rejects_object_dtype(self, tmp_path):
+        ragged = [(np.asarray([[1], [2, 3]], dtype=object),)]
+        with pytest.raises(TypeError, match="object-dtype"):
+            pt.recordio_writer.convert_reader_to_recordio_file(
+                str(tmp_path / "bad.recordio"), lambda: iter(ragged))
+
     def test_convert_roundtrip(self, tmp_path):
         native = pytest.importorskip("paddle_tpu.native")
         if not native.available():
