@@ -298,9 +298,11 @@ def main():
     mesh = set_mesh(make_mesh(MeshConfig(data=1),
                               devices=jax.devices()[:1]))
     opt = pt.optimizer.Adam(learning_rate=1e-4)
-    # 4 scanned steps per dispatch (train_from_dataset pattern):
-    # amortizes the remote-PJRT dispatch gap, same batch per inner step
-    spc = 4 if on_tpu else 1
+    # 8 scanned steps per dispatch (train_from_dataset pattern):
+    # amortizes the remote-PJRT dispatch gap, same batch per inner step.
+    # r3 A/B on-chip: spc=8 153.2k tok/s (x2 runs) vs spc=4 152.0-152.7k
+    # — the residual dispatch gap halves again. BENCH_SPC overrides.
+    spc = int(os.environ.get("BENCH_SPC", "8" if on_tpu else "1"))
     init_fn, step_fn = bert.make_train_step(cfg, opt, mesh,
                                             steps_per_call=spc)
     # gathered MLM head: predict only max_predictions_per_seq positions
