@@ -32,6 +32,26 @@ def data():
     return xb, (xb[:, :1] * 0.7).astype(np.float32)
 
 
+def _loss_parity(build_fn, xb, yb, steps=10, rtol=2e-4):
+    """ref-vs-dp loss-trajectory parity harness (the reference's
+    parallel_executor_test_base pattern). Assumes deterministic
+    startup init (per-op-index rng), so rebuilding gives identical
+    initial params for both runs."""
+    exe = pt.static.Executor()
+    main1, start1, loss1 = build_fn()
+    exe.run(start1)
+    ref = [float(exe.run(main1, feed={"x": xb, "y": yb},
+                         fetch_list=[loss1])[0]) for _ in range(steps)]
+    main2, start2, loss2 = build_fn()
+    exe.run(start2)
+    compiled = pt.CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name)
+    dp = [float(exe.run(compiled, feed={"x": xb, "y": yb},
+                        fetch_list=[loss2])[0]) for _ in range(steps)]
+    np.testing.assert_allclose(ref, dp, rtol=rtol, atol=1e-5)
+    return ref, dp
+
+
 class TestCompiledProgramDP:
     def test_dp_loss_equals_local_loss(self, data):
         """The reference's ParallelExecutor-vs-Executor parity check:
@@ -39,21 +59,7 @@ class TestCompiledProgramDP:
         xb, yb = data
         pt.enable_static()
         try:
-            exe = pt.static.Executor()
-            main1, start1, loss1 = _build()
-            exe.run(start1)
-            ref = [float(exe.run(main1, feed={"x": xb, "y": yb},
-                                 fetch_list=[loss1])[0])
-                   for _ in range(10)]
-
-            main2, start2, loss2 = _build()
-            exe.run(start2)
-            compiled = pt.CompiledProgram(main2).with_data_parallel(
-                loss_name=loss2.name)
-            dp = [float(exe.run(compiled, feed={"x": xb, "y": yb},
-                                fetch_list=[loss2])[0])
-                  for _ in range(10)]
-            np.testing.assert_allclose(ref, dp, rtol=2e-4, atol=1e-5)
+            _, dp = _loss_parity(_build, xb, yb, steps=10)
             assert dp[-1] < dp[0] * 0.5          # and it trains
         finally:
             pt.disable_static()
@@ -152,5 +158,39 @@ class TestCompiledProgramDP:
             (lv,) = exe.run(c, feed={"x": xb, "y": yb},
                             fetch_list=[loss])
             assert np.isfinite(float(lv))
+        finally:
+            pt.disable_static()
+
+
+class TestBatchNormUnderDP:
+    def test_bn_stats_are_global_batch(self, data):
+        """Under GSPMD the batch_norm reduction spans the SHARDED batch
+        axis, so dp training computes GLOBAL batch statistics — the
+        reference needs a separate sync_batch_norm op + build_strategy
+        knob for this (build_strategy.h:102); here it holds by
+        construction. Proof: dp loss trajectory == local trajectory for
+        a BN model (any per-replica stats would diverge immediately,
+        since each replica sees a different batch slice)."""
+        xb, yb = data
+
+        def build_bn():
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                x = pt.static.data("x", shape=[13])
+                y = pt.static.data("y", shape=[1])
+                h = pt.layers.fc(x, size=8, param_attr="w1",
+                                 bias_attr="b1")
+                h = pt.layers.batch_norm(h, param_attr="bn_s",
+                                         bias_attr="bn_b")
+                pred = pt.layers.fc(h, size=1, param_attr="w2",
+                                    bias_attr="b2")
+                loss = pt.layers.mean(
+                    pt.layers.square_error_cost(pred, y))
+                pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+            return main, startup, loss
+
+        pt.enable_static()
+        try:
+            _loss_parity(build_bn, xb, yb, steps=8, rtol=5e-4)
         finally:
             pt.disable_static()
