@@ -93,8 +93,12 @@ def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
                       for l in lines_]
             label = np.array([p[0] for p in parsed], np.int64)[:, None]
             dense = np.array([p[1] for p in parsed], np.float32)
+            # one [B, 1] int64 array PER sparse slot, matching the SVM
+            # branch and the reference's per-slot LoDTensor outputs
+            # (ref: operators/reader/ctr_reader.h one tensor per slot)
             sparse = np.array([p[2] for p in parsed], np.int64)
-            return label, dense, sparse
+            return (label, dense) + tuple(
+                sparse[:, i:i + 1] for i in range(sparse.shape[1]))
         parsed = [_parse_svm(l, slots) for l in lines_]
         label = np.array([p[0] for p in parsed], np.int64)[:, None]
         outs = [label]

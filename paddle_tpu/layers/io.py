@@ -103,10 +103,18 @@ class StaticPyReader:
 
     # -- start/reset protocol (non-iterable fluid mode) -------------------
     def start(self):
+        self.reset()     # close any abandoned iterator (+ its worker)
         self._it = self._iter_feeds()
         self._started = True
 
     def reset(self):
+        # close the generator explicitly: with use_double_buffer the
+        # underlying dataio.PyReader prefetch worker is blocked on
+        # queue.put holding device-staged batches — generator close
+        # runs the consumer's finally block, which signals it to stop
+        # (otherwise start()/reset() cycles accumulate live threads)
+        if self._it is not None and hasattr(self._it, "close"):
+            self._it.close()
         self._it = None
         self._started = False
 
@@ -183,10 +191,16 @@ class _TransformedReader:
         return self._transform(iter(self.underlying))
 
     def start(self):
+        self.reset()     # close any abandoned iterator (+ its worker)
         self._it = iter(self)
         self._started = True
 
     def reset(self):
+        # close the transform generator so the underlying reader's
+        # prefetch machinery (if any) is torn down, mirroring
+        # StaticPyReader.reset
+        if self._it is not None and hasattr(self._it, "close"):
+            self._it.close()
         self._it = None
         self._started = False
 
@@ -231,11 +245,16 @@ def batch(reader, batch_size):
     return batched
 
 
-def shuffle(reader, buffer_size):
+def shuffle(reader, buffer_size, seed=None):
     """fluid.layers.shuffle parity (create_shuffle_reader op): buffered
-    shuffle over a reader object or a plain reader callable."""
+    shuffle over a reader object or a plain reader callable.
+
+    ``seed`` varies the shuffle order across workers/epochs (the
+    reference's create_shuffle_reader is randomly seeded,
+    reader_op_registry.cc); default keeps the repo's deterministic-key
+    convention (seed 0)."""
     if hasattr(reader, "vars"):          # reader-op chain form
-        rng = np.random.RandomState(0)
+        rng = np.random.RandomState(0 if seed is None else seed)
 
         def transform(feeds):
             buf = []
@@ -250,7 +269,7 @@ def shuffle(reader, buffer_size):
                 yield buf.pop()
         return _TransformedReader(reader, transform)
     from paddle_tpu import reader as _rdr
-    return _rdr.shuffle(reader, buffer_size)
+    return _rdr.shuffle(reader, buffer_size, seed=seed)
 
 
 def load(out, file_path, load_as_fp16=None):
@@ -282,12 +301,15 @@ def open_files(filenames, shapes, dtypes, thread_num=None,
     return rdr
 
 
-def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=True, seed=None):
     """fluid.layers.random_data_generator parity: a reader producing
     uniform floats in [low, high) with the given shapes (test-data
-    generator, create_random_data_generator_op)."""
+    generator, create_random_data_generator_op). ``seed`` varies the
+    stream across workers; default keeps the deterministic-key
+    convention (seed 0)."""
     rdr = py_reader(8, shapes, ["float32"] * len(shapes))
-    rng = np.random.RandomState(0)
+    rng = np.random.RandomState(0 if seed is None else seed)
 
     def source():
         while True:

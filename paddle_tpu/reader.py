@@ -34,17 +34,23 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size):
+def shuffle(reader, buf_size, seed=None):
+    """Buffered shuffle. ``seed`` gives a private, reproducible RNG so
+    workers can decorrelate deterministically; default keeps the
+    reference's behavior (process-global random module,
+    python/paddle/reader/decorator.py shuffle)."""
+    rng = pyrandom.Random(seed) if seed is not None else pyrandom
+
     def shuffled():
         buf = []
         for e in reader():
             buf.append(e)
             if len(buf) >= buf_size:
-                pyrandom.shuffle(buf)
+                rng.shuffle(buf)
                 yield from buf
                 buf = []
         if buf:
-            pyrandom.shuffle(buf)
+            rng.shuffle(buf)
             yield from buf
     return shuffled
 

@@ -186,6 +186,19 @@ class Executor:
         self._cache = {}
         self._keys = {}
 
+    @staticmethod
+    def _program_read_names(program):
+        """Names of all vars the program's ops read, memoized on the
+        program keyed by op count (the reader-protocol hot path calls
+        run() in a tight loop and ops only ever get appended)."""
+        ops = program.global_block().ops
+        cached = getattr(program, "_read_names_cache", None)
+        if cached is not None and cached[0] == len(ops):
+            return cached[1]
+        names = {n for op in ops for n in op.input_names()}
+        program._read_names_cache = (len(ops), names)
+        return names
+
     def _base_key(self, seed):
         k = self._keys.get(seed)
         if k is None:
@@ -208,13 +221,33 @@ class Executor:
         feed = feed or {}
         if not feed:
             # non-iterable reader protocol (fluid.layers.py_reader
-            # start()/reset()): pull the next batch from every started
-            # reader attached to this program; they raise EOFException
-            # when exhausted (reader op EOF → core.EOFException parity)
-            for r in getattr(program, "_py_readers", []):
-                if getattr(r, "_started", False):
-                    feed = dict(feed)
-                    feed.update(r._next_feed())
+            # start()/reset()): pull the next batch from started readers
+            # attached to this program; they raise EOFException when
+            # exhausted (reader op EOF → core.EOFException parity).
+            # Only readers whose vars the program actually reads are
+            # pulled, and two started readers feeding the same var is an
+            # error — a chained reader (open_files → batch) registers
+            # both itself and its underlying py_reader, and silently
+            # advancing both would skip data (ADVICE r3 #4).
+            started = [r for r in getattr(program, "_py_readers", [])
+                       if getattr(r, "_started", False)]
+            read_names = (self._program_read_names(program) if started
+                          else set())
+            fed_by = {}
+            for r in started:
+                rnames = {v.name for v in r.vars}
+                if read_names and not (rnames & read_names):
+                    continue
+                for n in rnames:
+                    if n in fed_by:
+                        raise EnforceNotMet(
+                            f"two started readers would both feed var "
+                            f"'{n}' — start only the outermost reader "
+                            f"of a chain (e.g. the batch reader, not "
+                            f"its underlying py_reader)")
+                    fed_by[n] = r
+                feed = dict(feed)
+                feed.update(r._next_feed())
         fetch_list = fetch_list or []
         scope = scope or global_scope()
         fetch_names = [f if isinstance(f, str) else f.name

@@ -113,10 +113,13 @@ class TestCtrReader:
                        [str(p)], None)
         batches = list(r())
         assert len(batches) == 2
-        label, dense, sparse = batches[0]
+        # one [B, 1] int64 array per sparse slot (matches the SVM
+        # branch / the reference's per-slot LoDTensor outputs)
+        label, dense, sp0, sp1 = batches[0]
         assert label.shape == (2, 1) and dense.shape == (2, 2)
         np.testing.assert_allclose(dense[0], [0.5, 0.25])
-        assert sparse[0].tolist() == [7, 9]
+        assert sp0.shape == (2, 1) and sp1.shape == (2, 1)
+        assert [sp0[0, 0], sp1[0, 0]] == [7, 9]
 
     def test_svm_and_gzip(self, tmp_path):
         import gzip

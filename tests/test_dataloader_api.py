@@ -161,3 +161,83 @@ class TestDevicePrefetch:
                 assert float(np.asarray(last[0])) < first
         finally:
             pt.disable_static()
+
+
+class TestReaderAdviceR3Fixes:
+    """Regression tests for the ADVICE r3 reader findings."""
+
+    def test_double_started_reader_raises(self):
+        """Starting both a chained reader and its underlying py_reader
+        must raise, not silently advance both streams (ADVICE r3 #4)."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                rdr = pt.layers.py_reader(
+                    capacity=4, shapes=[[2, 3]], dtypes=["float32"],
+                    use_double_buffer=False)
+                chained = pt.layers.io.batch(rdr, batch_size=1)
+                x = pt.layers.read_file(rdr)
+                y = pt.layers.reduce_sum(x)
+            data = [(np.ones((2, 3), np.float32),)] * 4
+            rdr.decorate_tensor_provider(lambda: iter(data))
+            rdr.start()
+            chained.start()
+            exe = pt.static.Executor(pt.CPUPlace())
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe.run(startup)
+                with pytest.raises(pt.core.EnforceNotMet,
+                                   match="two started readers"):
+                    exe.run(main, fetch_list=[y.name])
+        finally:
+            pt.disable_static()
+
+    def test_unrelated_started_reader_not_pulled(self):
+        """A started reader whose vars the program never reads must not
+        be drained by run() (ADVICE r3 #4)."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                used = pt.layers.py_reader(
+                    capacity=4, shapes=[[2, 3]], dtypes=["float32"],
+                    name="used_r", use_double_buffer=False)
+                other = pt.layers.py_reader(
+                    capacity=4, shapes=[[2, 3]], dtypes=["float32"],
+                    name="other_r", use_double_buffer=False)
+                x = pt.layers.read_file(used)
+                y = pt.layers.reduce_sum(x)
+            used.decorate_tensor_provider(
+                lambda: iter([(np.ones((2, 3), np.float32),)] * 3))
+            pulls = []
+
+            def other_src():
+                for i in range(3):
+                    pulls.append(i)
+                    yield (np.zeros((2, 3), np.float32),)
+            other.decorate_tensor_provider(other_src)
+            used.start()
+            other.start()
+            exe = pt.static.Executor(pt.CPUPlace())
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe.run(startup)
+                out = exe.run(main, fetch_list=[y.name])[0]
+            assert float(np.asarray(out)) == 6.0
+            assert pulls == []          # untouched
+        finally:
+            pt.disable_static()
+
+    def test_shuffle_seed_kwarg(self):
+        """layers.shuffle(seed=...) varies the order deterministically
+        (ADVICE r3 #3): same seed -> same order, different seeds ->
+        different orders, for the plain-callable form."""
+        def src():
+            return iter([(i,) for i in range(50)])
+        a1 = list(pt.layers.shuffle(src, 50, seed=1)())
+        a2 = list(pt.layers.shuffle(src, 50, seed=1)())
+        b = list(pt.layers.shuffle(src, 50, seed=2)())
+        assert a1 == a2
+        assert a1 != b
+        assert sorted(a1) == sorted(b) == [(i,) for i in range(50)]
