@@ -219,6 +219,9 @@ class Executor:
             dp_mesh = program._mesh if program._dp else None
             program = program._program
         feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [f if isinstance(f, str) else f.name
+                       for f in fetch_list]
         if not feed:
             # non-iterable reader protocol (fluid.layers.py_reader
             # start()/reset()): pull the next batch from started readers
@@ -231,9 +234,11 @@ class Executor:
             # advancing both would skip data (ADVICE r3 #4).
             started = [r for r in getattr(program, "_py_readers", [])
                        if getattr(r, "_started", False)]
-            read_names = (self._program_read_names(program) if started
-                          else set())
-            fed_by = {}
+            read_names = (self._program_read_names(program)
+                          | set(fetch_names) if started else set())
+            # validate BEFORE pulling anything: raising mid-loop would
+            # have already consumed a batch from an earlier reader
+            pull, fed_by = [], {}
             for r in started:
                 rnames = {v.name for v in r.vars}
                 if read_names and not (rnames & read_names):
@@ -246,12 +251,11 @@ class Executor:
                             f"of a chain (e.g. the batch reader, not "
                             f"its underlying py_reader)")
                     fed_by[n] = r
+                pull.append(r)
+            for r in pull:
                 feed = dict(feed)
                 feed.update(r._next_feed())
-        fetch_list = fetch_list or []
         scope = scope or global_scope()
-        fetch_names = [f if isinstance(f, str) else f.name
-                       for f in fetch_list]
 
         # startup-style programs (initializers only, no feeds) run eagerly
         if not feed and self._is_startup_like(program):

@@ -241,3 +241,63 @@ class TestReaderAdviceR3Fixes:
         assert a1 == a2
         assert a1 != b
         assert sorted(a1) == sorted(b) == [(i,) for i in range(50)]
+
+    def test_fetch_only_reader_still_pulled(self):
+        """A started reader whose var is consumed only via fetch_list
+        (no op reads it) must still be drained by run()."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                rdr = pt.layers.py_reader(
+                    capacity=4, shapes=[[2, 3]], dtypes=["float32"],
+                    name="fetch_only_r", use_double_buffer=False)
+                x = pt.layers.read_file(rdr)
+                w = pt.layers.create_parameter([1], "float32", name="w0")
+                y = pt.layers.reduce_sum(w)   # ops never read x
+            rdr.decorate_tensor_provider(
+                lambda: iter([(np.full((2, 3), 2.0, np.float32),)]))
+            rdr.start()
+            exe = pt.static.Executor(pt.CPUPlace())
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe.run(startup)
+                vals = exe.run(main, fetch_list=[x.name, y.name])
+            assert vals[0] is not None
+            np.testing.assert_allclose(np.asarray(vals[0]),
+                                       np.full((2, 3), 2.0))
+        finally:
+            pt.disable_static()
+
+    def test_collision_raises_before_any_pull(self):
+        """The same-var collision check must fire before ANY started
+        reader is advanced (no silently consumed batch)."""
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.static.program_guard(main, startup):
+                rdr = pt.layers.py_reader(
+                    capacity=4, shapes=[[2, 3]], dtypes=["float32"],
+                    name="coll_r", use_double_buffer=False)
+                chained = pt.layers.io.batch(rdr, batch_size=1)
+                x = pt.layers.read_file(rdr)
+                y = pt.layers.reduce_sum(x)
+            pulls = []
+
+            def src():
+                for i in range(4):
+                    pulls.append(i)
+                    yield (np.ones((2, 3), np.float32),)
+            rdr.decorate_tensor_provider(src)
+            rdr.start()
+            chained.start()
+            exe = pt.static.Executor(pt.CPUPlace())
+            scope = pt.static.Scope()
+            with pt.static.scope_guard(scope):
+                exe.run(startup)
+                with pytest.raises(pt.core.EnforceNotMet,
+                                   match="two started readers"):
+                    exe.run(main, fetch_list=[y.name])
+            assert pulls == []      # nothing consumed before the raise
+        finally:
+            pt.disable_static()
