@@ -117,7 +117,60 @@ def _imdb_sample(rng):
     return words, label
 
 
-imdb = _Synthetic(_imdb_sample, n_train=512, n_test=128)
+class _Downloadable:
+    """Shared download tier for the real-corpus datasets: subclasses
+    pin URL/MD5/MODULE (the reference's per-module constants) and
+    ``path`` overrides the download — that is how CI proves the
+    parsers on in-tree fixtures in zero-egress environments."""
+
+    URL = MD5 = MODULE = None
+
+    def _archive(self, path):
+        if path is not None:
+            return path
+        from paddle_tpu.dataio.common import download
+        return download(self.URL, self.MODULE, self.MD5)
+
+
+class _Imdb(_Downloadable, _Synthetic):
+    """paddle.dataset.imdb parity: no-arg train()/test() serve the
+    synthetic tier; passing ``word_idx`` (and optionally ``path`` to a
+    local aclImdb-format tarball) runs the REAL parser
+    (ref: dataset/imdb.py:96-138). Downloads stay network-gated."""
+
+    URL = ("http://ai.stanford.edu/%7Eamaas/data/sentiment/"
+           "aclImdb_v1.tar.gz")
+    MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+    MODULE = "imdb"
+
+    def build_dict(self, pattern, cutoff, path=None):
+        from paddle_tpu.dataio import parsers
+        return parsers.imdb_build_dict(self._archive(path), pattern,
+                                       cutoff)
+
+    def word_dict(self, path=None, cutoff=150):
+        return self.build_dict(
+            r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$",
+            cutoff, path)
+
+    def train(self, word_idx=None, path=None):
+        if word_idx is None:
+            return super().train()
+        from paddle_tpu.dataio import parsers
+        return parsers.imdb_reader(
+            self._archive(path), r"aclImdb/train/pos/.*\.txt$",
+            r"aclImdb/train/neg/.*\.txt$", word_idx)
+
+    def test(self, word_idx=None, path=None):
+        if word_idx is None:
+            return super().test()
+        from paddle_tpu.dataio import parsers
+        return parsers.imdb_reader(
+            self._archive(path), r"aclImdb/test/pos/.*\.txt$",
+            r"aclImdb/test/neg/.*\.txt$", word_idx)
+
+
+imdb = _Imdb(_imdb_sample, n_train=512, n_test=128)
 
 IMIKOLOV_VOCAB = 2074
 
@@ -126,7 +179,38 @@ def _imikolov_sample(rng):
     return tuple(rng.randint(0, IMIKOLOV_VOCAB) for _ in range(5))
 
 
-imikolov = _Synthetic(_imikolov_sample, n_train=512, n_test=128)
+class _Imikolov(_Downloadable, _Synthetic):
+    """paddle.dataset.imikolov parity (ref: dataset/imikolov.py): real
+    PTB n-gram/seq readers when ``word_idx`` is given."""
+
+    URL = "http://www.fit.vutbr.cz/~imikolov/rnnlm/simple-examples.tgz"
+    MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+    MODULE = "imikolov"
+    NGRAM, SEQ = "ngram", "seq"
+
+    def build_dict(self, min_word_freq=50, path=None):
+        from paddle_tpu.dataio import parsers
+        return parsers.imikolov_build_dict(self._archive(path),
+                                           min_word_freq)
+
+    def train(self, word_idx=None, n=5, data_type="ngram", path=None):
+        if word_idx is None:
+            return super().train()
+        from paddle_tpu.dataio import parsers
+        return parsers.imikolov_reader(
+            self._archive(path), parsers.IMIKOLOV_TRAIN, word_idx, n,
+            data_type)
+
+    def test(self, word_idx=None, n=5, data_type="ngram", path=None):
+        if word_idx is None:
+            return super().test()
+        from paddle_tpu.dataio import parsers
+        return parsers.imikolov_reader(
+            self._archive(path), parsers.IMIKOLOV_VALID, word_idx, n,
+            data_type)
+
+
+imikolov = _Imikolov(_imikolov_sample, n_train=512, n_test=128)
 
 
 # -- remaining reference dataset family (python/paddle/dataset/) ----------
@@ -150,7 +234,68 @@ def _movielens_sample(rng):
     return user, gender, age, job, movie, cats, title, rating
 
 
-movielens = _Synthetic(_movielens_sample, n_train=1024, n_test=256)
+class _Movielens(_Downloadable, _Synthetic):
+    """paddle.dataset.movielens parity (ref: dataset/movielens.py):
+    ``path`` to an ml-1m.zip-format archive enables the real parser;
+    meta queries (max ids, dicts) come from one cached parse."""
+
+    URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+    MD5 = "c4d9eecfca2ab87c1945afe126590906"
+    MODULE = "movielens"
+    _meta_cache = None
+
+    @property
+    def age_table(self):
+        from paddle_tpu.dataio import parsers
+        return tuple(parsers.MOVIELENS_AGE_TABLE)
+
+    def _meta(self, path):
+        archive = self._archive(path)
+        if self._meta_cache is None or self._meta_cache[0] != archive:
+            from paddle_tpu.dataio import parsers
+            self._meta_cache = (archive,
+                                parsers.movielens_meta(archive))
+        return self._meta_cache[1]
+
+    def _real_reader(self, path, is_test):
+        from paddle_tpu.dataio import parsers
+        archive = self._archive(path)
+        return parsers.movielens_reader(archive, is_test=is_test,
+                                        meta=self._meta(path))
+
+    def train(self, path=None):
+        if path is None:
+            return super().train()
+        return self._real_reader(path, is_test=False)
+
+    def test(self, path=None):
+        if path is None:
+            return super().test()
+        return self._real_reader(path, is_test=True)
+
+    def get_movie_title_dict(self, path=None):
+        return self._meta(path)[3]
+
+    def movie_categories(self, path=None):
+        return self._meta(path)[2]
+
+    def max_movie_id(self, path=None):
+        return max(self._meta(path)[0])
+
+    def max_user_id(self, path=None):
+        return max(self._meta(path)[1])
+
+    def max_job_id(self, path=None):
+        return max(u[3] for u in self._meta(path)[1].values())
+
+    def movie_info(self, path=None):
+        return self._meta(path)[0]
+
+    def user_info(self, path=None):
+        return self._meta(path)[1]
+
+
+movielens = _Movielens(_movielens_sample, n_train=1024, n_test=256)
 
 WMT14_DICT_SIZE = 30000
 WMT16_DICT_SIZE = 10000
@@ -172,8 +317,85 @@ def _wmt_sample(vocab):
     return make
 
 
-wmt14 = _Synthetic(_wmt_sample(WMT14_DICT_SIZE), n_train=512, n_test=128)
-wmt16 = _Synthetic(_wmt_sample(WMT16_DICT_SIZE), n_train=512, n_test=128)
+class _Wmt14(_Downloadable, _Synthetic):
+    """paddle.dataset.wmt14 parity (ref: dataset/wmt14.py): real
+    parallel-corpus reader when ``dict_size`` is given."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+    MD5 = "0791583d57d5beb693b9414c5b36798c"
+    MODULE = "wmt14"
+
+    def train(self, dict_size=None, path=None):
+        if dict_size is None:
+            return super().train()
+        from paddle_tpu.dataio import parsers
+        return parsers.wmt14_reader(self._archive(path), "train/train",
+                                    dict_size)
+
+    def test(self, dict_size=None, path=None):
+        if dict_size is None:
+            return super().test()
+        from paddle_tpu.dataio import parsers
+        return parsers.wmt14_reader(self._archive(path), "test/test",
+                                    dict_size)
+
+    def get_dict(self, dict_size, reverse=False, path=None):
+        from paddle_tpu.dataio import parsers
+        src, trg = parsers.wmt14_dicts(self._archive(path), dict_size)
+        if reverse:
+            src = {v: k for k, v in src.items()}
+            trg = {v: k for k, v in trg.items()}
+        return src, trg
+
+
+class _Wmt16(_Downloadable, _Synthetic):
+    """paddle.dataset.wmt16 parity (ref: dataset/wmt16.py): dicts built
+    from the train split with <s>/<e>/<unk> pinned at 0/1/2."""
+
+    URL = ("http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz")
+    MD5 = "0c38be43600334966403524a40dcd81e"
+    MODULE = "wmt16"
+
+    def _reader(self, split, src_dict_size, trg_dict_size, src_lang,
+                path):
+        from paddle_tpu.dataio import parsers
+        # an omitted trg size mirrors src (never None: a None size
+        # would silently build the full target vocab and hand a model
+        # sized to src_dict_size out-of-range ids)
+        if trg_dict_size is None:
+            trg_dict_size = src_dict_size
+        return parsers.wmt16_reader(self._archive(path),
+                                    f"wmt16/{split}", src_dict_size,
+                                    trg_dict_size, src_lang)
+
+    def train(self, src_dict_size=None, trg_dict_size=None,
+              src_lang="en", path=None):
+        if src_dict_size is None:
+            return super().train()
+        return self._reader("train", src_dict_size, trg_dict_size,
+                            src_lang, path)
+
+    def test(self, src_dict_size=None, trg_dict_size=None,
+             src_lang="en", path=None):
+        if src_dict_size is None:
+            return super().test()
+        return self._reader("test", src_dict_size, trg_dict_size,
+                            src_lang, path)
+
+    def validation(self, src_dict_size, trg_dict_size, src_lang="en",
+                   path=None):
+        return self._reader("val", src_dict_size, trg_dict_size,
+                            src_lang, path)
+
+    def get_dict(self, lang, dict_size, reverse=False, path=None):
+        from paddle_tpu.dataio import parsers
+        d = parsers.wmt16_build_dict(self._archive(path), dict_size,
+                                     lang)
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+wmt14 = _Wmt14(_wmt_sample(WMT14_DICT_SIZE), n_train=512, n_test=128)
+wmt16 = _Wmt16(_wmt_sample(WMT16_DICT_SIZE), n_train=512, n_test=128)
 
 CONLL05_WORD_VOCAB, CONLL05_LABELS = 44068, 59
 
@@ -191,7 +413,37 @@ def _conll05_sample(rng):
         + (seq(CONLL05_PRED_VOCAB), seq(2), seq(CONLL05_LABELS))
 
 
-conll05 = _Synthetic(_conll05_sample, n_train=512, n_test=128)
+class _Conll05(_Synthetic):
+    """paddle.dataset.conll05 parity (ref: dataset/conll05.py): real
+    SRL readers over a conll05st tarball + dict files."""
+
+    def get_dict(self, word_dict_path, verb_dict_path,
+                 label_dict_path):
+        from paddle_tpu.dataio import parsers
+        return (parsers.conll05_load_dict(word_dict_path),
+                parsers.conll05_load_dict(verb_dict_path),
+                parsers.conll05_load_label_dict(label_dict_path))
+
+    def reader(self, tar_path, words_name, props_name, word_dict,
+               verb_dict, label_dict):
+        from paddle_tpu.dataio import parsers
+        corpus = parsers.conll05_corpus_reader(tar_path, words_name,
+                                               props_name)
+        return parsers.conll05_reader(corpus, word_dict, verb_dict,
+                                      label_dict)
+
+    def test(self, tar_path=None, word_dict=None, verb_dict=None,
+             label_dict=None, words_name=("conll05st-release/test.wsj/"
+                                          "words/test.wsj.words.gz"),
+             props_name=("conll05st-release/test.wsj/props/"
+                         "test.wsj.props.gz")):
+        if tar_path is None:
+            return super().test()
+        return self.reader(tar_path, words_name, props_name,
+                           word_dict, verb_dict, label_dict)
+
+
+conll05 = _Conll05(_conll05_sample, n_train=512, n_test=128)
 
 
 SENTIMENT_VOCAB = 39768   # NLTK movie_reviews word-dict size order
@@ -203,7 +455,28 @@ def _sentiment_sample(rng):
             rng.randint(0, 2))
 
 
-sentiment = _Synthetic(_sentiment_sample, n_train=512, n_test=128)
+class _Sentiment(_Synthetic):
+    """paddle.dataset.sentiment parity (ref: dataset/sentiment.py):
+    real NLTK movie_reviews-layout readers when ``root`` is given."""
+
+    def get_word_dict(self, root):
+        from paddle_tpu.dataio import parsers
+        return parsers.sentiment_word_dict(root)
+
+    def train(self, root=None):
+        if root is None:
+            return super().train()
+        from paddle_tpu.dataio import parsers
+        return parsers.sentiment_reader(root, "train")
+
+    def test(self, root=None):
+        if root is None:
+            return super().test()
+        from paddle_tpu.dataio import parsers
+        return parsers.sentiment_reader(root, "test")
+
+
+sentiment = _Sentiment(_sentiment_sample, n_train=512, n_test=128)
 
 
 def _voc2012_sample(rng):
@@ -213,7 +486,29 @@ def _voc2012_sample(rng):
     return img, seg
 
 
-voc2012 = _Synthetic(_voc2012_sample, n_train=128, n_test=32)
+class _Voc2012(_Synthetic):
+    """paddle.dataset.voc2012 parity (ref: dataset/voc2012.py): real
+    VOC-tar segmentation readers when ``path`` is given; same
+    split->set-file mapping (train:trainval, test:train, val:val)."""
+
+    def train(self, path=None):
+        if path is None:
+            return super().train()
+        from paddle_tpu.dataio import parsers
+        return parsers.voc2012_reader(path, "trainval")
+
+    def test(self, path=None):
+        if path is None:
+            return super().test()
+        from paddle_tpu.dataio import parsers
+        return parsers.voc2012_reader(path, "train")
+
+    def val(self, path):
+        from paddle_tpu.dataio import parsers
+        return parsers.voc2012_reader(path, "val")
+
+
+voc2012 = _Voc2012(_voc2012_sample, n_train=128, n_test=32)
 
 
 def _mq2007_sample(rng):
@@ -224,7 +519,25 @@ def _mq2007_sample(rng):
     return float(rng.randint(0, 2)), fa, fb
 
 
-mq2007 = _Synthetic(_mq2007_sample, n_train=512, n_test=128)
+class _Mq2007(_Synthetic):
+    """paddle.dataset.mq2007 parity (ref: dataset/mq2007.py): real
+    LETOR readers (pointwise/pairwise/listwise) when ``path`` is
+    given."""
+
+    def train(self, path=None, fmt="pairwise"):
+        if path is None:
+            return super().train()
+        from paddle_tpu.dataio import parsers
+        return parsers.mq2007_reader(path, fmt)
+
+    def test(self, path=None, fmt="pairwise"):
+        if path is None:
+            return super().test()
+        from paddle_tpu.dataio import parsers
+        return parsers.mq2007_reader(path, fmt)
+
+
+mq2007 = _Mq2007(_mq2007_sample, n_train=512, n_test=128)
 
 
 def _flowers_sample(rng):
@@ -232,7 +545,35 @@ def _flowers_sample(rng):
     return img, rng.randint(0, 102)
 
 
-flowers = _Synthetic(_flowers_sample, n_train=256, n_test=64)
+class _Flowers(_Synthetic):
+    """paddle.dataset.flowers parity (ref: dataset/flowers.py): real
+    102flowers readers when the three archive paths are given."""
+
+    def _reader(self, data_tar, label_mat, setid_mat, split, mapper):
+        from paddle_tpu.dataio import parsers
+        return parsers.flowers_reader(data_tar, label_mat, setid_mat,
+                                      split, mapper)
+
+    def train(self, data_tar=None, label_mat=None, setid_mat=None,
+              mapper=None):
+        if data_tar is None:
+            return super().train()
+        return self._reader(data_tar, label_mat, setid_mat, "trnid",
+                            mapper)
+
+    def test(self, data_tar=None, label_mat=None, setid_mat=None,
+             mapper=None):
+        if data_tar is None:
+            return super().test()
+        return self._reader(data_tar, label_mat, setid_mat, "tstid",
+                            mapper)
+
+    def valid(self, data_tar, label_mat, setid_mat, mapper=None):
+        return self._reader(data_tar, label_mat, setid_mat, "valid",
+                            mapper)
+
+
+flowers = _Flowers(_flowers_sample, n_train=256, n_test=64)
 
 __all__ += ["movielens", "wmt14", "wmt16", "conll05", "sentiment",
             "voc2012", "mq2007", "flowers"]

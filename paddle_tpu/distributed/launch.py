@@ -48,13 +48,22 @@ def _spawn(cmd, env, log_prefix, log_dir):
     return subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out
 
 
-def _wait(procs, logs):
+def _wait(procs, logs, timeout=None):
     """Wait for all; on first failure terminate the rest (launch.py's
-    terminate_local_procs role). Returns the worst returncode."""
+    terminate_local_procs role). Returns the worst returncode.
+    ``timeout`` (seconds) kills all survivors and returns 124 — a hung
+    rendezvous must not hang the caller forever."""
+    deadline = None if timeout is None else time.time() + timeout
     try:
         rc = 0
         alive = dict(procs)
         while alive:
+            if deadline is not None and time.time() > deadline:
+                print(f"[launch] timeout after {timeout}s; killing "
+                      f"{list(alive)}", file=sys.stderr)
+                for q in alive.values():
+                    q.kill()
+                return 124
             for name, p in list(alive.items()):
                 r = p.poll()
                 if r is None:
@@ -79,7 +88,7 @@ def _wait(procs, logs):
 
 
 def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
-                      log_dir=None, env_extra=None):
+                      log_dir=None, env_extra=None, timeout=None):
     host = ips.split(",")[0]
     ports = (find_free_ports(nproc, host) if started_port is None
              else list(range(started_port, started_port + nproc)))
@@ -98,7 +107,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                       f"workerlog.{rank}", log_dir)
         procs[f"trainer {rank}"] = p
         logs.append(f)
-    return _wait(procs, logs)
+    return _wait(procs, logs, timeout=timeout)
 
 
 def launch_ps(script_args, server_num, worker_num, started_port=None,

@@ -253,7 +253,6 @@ class Executor:
                     fed_by[n] = r
                 pull.append(r)
             for r in pull:
-                feed = dict(feed)
                 feed.update(r._next_feed())
         scope = scope or global_scope()
 

@@ -1,0 +1,297 @@
+"""Regenerate the real-format dataset fixtures under
+tests/fixtures/datasets/.
+
+Each fixture is a SMALL archive/file in the EXACT on-disk format the
+reference framework downloads (aclImdb tar.gz layout, PTB
+simple-examples tgz, ml-1m.zip '::'-separated .dat files, WMT parallel
+tars, CoNLL-2005 gzip'd column files, NLTK movie_reviews directory,
+LETOR text, VOC tar, 102flowers tgz + .mat) so
+``paddle_tpu.dataio.parsers`` is proven on the real formats in CI
+without network access. The writer code here is independent of the
+parsers (plain tarfile/zipfile/scipy writes) — regeneration is
+deterministic.
+
+Run: python tests/fixtures/make_dataset_fixtures.py
+"""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "datasets")
+
+
+def _add_bytes(tar, name, data, mtime=0):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = mtime
+    tar.addfile(info, io.BytesIO(data))
+
+
+def make_imdb():
+    """aclImdb_v1.tar.gz layout: aclImdb/{train,test}/{pos,neg}/*.txt"""
+    reviews = {
+        "aclImdb/train/pos/0_9.txt":
+            b"A wonderful film, truly moving and beautifully acted. "
+            b"The story keeps you engaged, and the ending is perfect.",
+        "aclImdb/train/pos/1_8.txt":
+            b"Great movie! The cast is excellent and the story is "
+            b"engaging from start to finish. A wonderful experience.",
+        "aclImdb/train/neg/0_2.txt":
+            b"Terrible film. The plot makes no sense, the acting is "
+            b"wooden, and the ending is awful. A complete waste.",
+        "aclImdb/train/neg/1_1.txt":
+            b"Awful movie, boring story and terrible acting. I could "
+            b"not wait for the ending. A waste of time.",
+        "aclImdb/test/pos/0_10.txt":
+            b"Beautifully acted and a wonderful, engaging story.",
+        "aclImdb/test/neg/0_3.txt":
+            b"Boring, terrible plot and awful acting. A waste.",
+    }
+    path = os.path.join(OUT, "aclImdb_fixture.tar.gz")
+    with tarfile.open(path, "w:gz") as tar:
+        for name, text in reviews.items():
+            _add_bytes(tar, name, text)
+    return path
+
+
+def make_imikolov():
+    """simple-examples.tgz layout: ./simple-examples/data/ptb.*.txt"""
+    train = (b"the cat sat on the mat\n"
+             b"the dog sat on the log\n"
+             b"a cat and a dog sat together\n"
+             b"the cat chased the dog around the house\n")
+    valid = (b"the dog chased the cat\n"
+             b"a cat sat on the log\n")
+    path = os.path.join(OUT, "simple-examples_fixture.tgz")
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "./simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tar, "./simple-examples/data/ptb.valid.txt", valid)
+    return path
+
+
+def make_movielens():
+    """ml-1m.zip layout: movies.dat/users.dat/ratings.dat, '::' fields,
+    latin-1 text, title with (year), categories '|'-joined."""
+    movies = ("1::Toy Story (1995)::Animation|Children's|Comedy\n"
+              "2::Jumanji (1995)::Adventure|Children's|Fantasy\n"
+              "3::Heat (1995)::Action|Crime|Thriller\n"
+              "4::Caf\xe9 Society (1995)::Comedy|Drama\n")
+    users = ("1::F::1::10::48067\n"
+             "2::M::56::16::70072\n"
+             "3::M::25::15::55117\n"
+             "4::F::45::7::02460\n")
+    ratings = ("1::1::5::978300760\n"
+               "1::2::3::978302109\n"
+               "2::3::4::978301968\n"
+               "2::1::4::978300275\n"
+               "3::4::5::978824291\n"
+               "3::2::2::978302268\n"
+               "4::3::3::978302039\n"
+               "4::4::1::978300719\n"
+               "1::3::4::978302268\n"
+               "2::4::2::978299026\n"
+               "3::1::3::978301753\n"
+               "4::1::5::978300055\n")
+    path = os.path.join(OUT, "ml-1m_fixture.zip")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies.encode("latin-1"))
+        z.writestr("ml-1m/users.dat", users.encode("latin-1"))
+        z.writestr("ml-1m/ratings.dat", ratings.encode("latin-1"))
+    return path
+
+
+WMT_EN = ["the house is small", "the cat is black",
+          "a dog runs fast", "the house is big",
+          "the black cat sleeps"]
+WMT_DE = ["das haus ist klein", "die katze ist schwarz",
+          "ein hund rennt schnell", "das haus ist gross",
+          "die schwarze katze schlaeft"]
+
+
+def make_wmt14():
+    """wmt14.tgz layout: {dir}/src.dict, {dir}/trg.dict + train/train,
+    test/test tab-separated parallel files."""
+    def vocab(sents):
+        words, seen = [], set()
+        for s in sents:
+            for w in s.split():
+                if w not in seen:
+                    seen.add(w)
+                    words.append(w)
+        return ["<s>", "<e>", "<unk>"] + words
+
+    src_dict = "\n".join(vocab(WMT_EN)).encode() + b"\n"
+    trg_dict = "\n".join(vocab(WMT_DE)).encode() + b"\n"
+    pairs = [f"{e}\t{d}\n" for e, d in zip(WMT_EN, WMT_DE)]
+    train = "".join(pairs[:4]).encode()
+    test = "".join(pairs[4:]).encode()
+    path = os.path.join(OUT, "wmt14_fixture.tgz")
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "wmt14/src.dict", src_dict)
+        _add_bytes(tar, "wmt14/trg.dict", trg_dict)
+        _add_bytes(tar, "wmt14/train/train", train)
+        _add_bytes(tar, "wmt14/test/test", test)
+    return path
+
+
+def make_wmt16():
+    """wmt16 tar layout: wmt16/{train,val,test} tab-separated en\\tde."""
+    pairs = [f"{e}\t{d}\n" for e, d in zip(WMT_EN, WMT_DE)]
+    path = os.path.join(OUT, "wmt16_fixture.tar.gz")
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "wmt16/train", "".join(pairs[:3]).encode())
+        _add_bytes(tar, "wmt16/val", "".join(pairs[3:4]).encode())
+        _add_bytes(tar, "wmt16/test", "".join(pairs[4:]).encode())
+    return path
+
+
+def make_conll05():
+    """conll05st-tests.tar.gz layout: gzip'd words + props column files
+    (props: lemma column + one bracket-label column per predicate),
+    plus the word/verb/target dict text files."""
+    words1 = ["The", "cat", "chased", "the", "dog"]
+    props1 = ["-      (A0*", "-      *)", "chase  (V*)",
+              "-      (A1*", "-      *)"]
+    words2 = ["A", "dog", "sat", "on", "the", "mat"]
+    props2 = ["-    (A0*", "-    *)", "sit  (V*)",
+              "-    (AM-LOC*", "-    *", "-    *)"]
+    words = "\n".join(words1) + "\n\n" + "\n".join(words2) + "\n\n"
+    props = "\n".join(props1) + "\n\n" + "\n".join(props2) + "\n\n"
+    path = os.path.join(OUT, "conll05st_fixture.tar.gz")
+    with tarfile.open(path, "w:gz") as tar:
+        _add_bytes(tar, "conll05st-release/test.wsj/words/"
+                   "test.wsj.words.gz", gzip.compress(words.encode()))
+        _add_bytes(tar, "conll05st-release/test.wsj/props/"
+                   "test.wsj.props.gz", gzip.compress(props.encode()))
+    vocab = sorted({w.lower() for w in words1 + words2})
+    with open(os.path.join(OUT, "conll05_wordDict.txt"), "w") as f:
+        f.write("\n".join(vocab) + "\n")
+    with open(os.path.join(OUT, "conll05_verbDict.txt"), "w") as f:
+        f.write("chase\nsit\n")
+    with open(os.path.join(OUT, "conll05_targetDict.txt"), "w") as f:
+        f.write("B-A0\nI-A0\nB-A1\nI-A1\nB-AM-LOC\nI-AM-LOC\n"
+                "B-V\nI-V\nO\n")
+    return path
+
+
+def make_sentiment():
+    """NLTK movie_reviews directory layout: {neg,pos}/*.txt,
+    pre-tokenized text."""
+    root = os.path.join(OUT, "movie_reviews")
+    docs = {
+        "neg/cv000_1.txt": "a dull , boring film . terrible acting "
+                           "and an awful plot . a waste of time .",
+        "neg/cv001_2.txt": "the worst movie of the year . boring "
+                           "story , terrible cast , awful ending .",
+        "pos/cv000_3.txt": "a wonderful film with great acting and "
+                           "an engaging story . truly moving .",
+        "pos/cv001_4.txt": "great movie ! excellent cast , engaging "
+                           "plot and a perfect ending . wonderful .",
+    }
+    for rel, text in docs.items():
+        p = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "w") as f:
+            f.write(text + "\n")
+    return root
+
+
+def make_mq2007():
+    """LETOR 4.0 text: 'rel qid:q 1:v .. 46:v #docid = x'."""
+    import numpy as np
+    rng = np.random.RandomState(3)
+    lines = []
+    for qid in (10, 11, 12):
+        for doc in range(4):
+            rel = int(rng.randint(0, 3))
+            feats = " ".join(f"{i + 1}:{rng.rand():.6f}"
+                             for i in range(46))
+            lines.append(f"{rel} qid:{qid} {feats} #docid = "
+                         f"GX{qid}-{doc:02d}\n")
+    path = os.path.join(OUT, "mq2007_fixture.txt")
+    with open(path, "w") as f:
+        f.writelines(lines)
+    return path
+
+
+def make_voc2012():
+    """VOCtrainval tar layout: ImageSets/Segmentation/{split}.txt +
+    JPEGImages/*.jpg + SegmentationClass/*.png."""
+    import numpy as np
+    from PIL import Image
+
+    def jpg_bytes(seed):
+        rng = np.random.RandomState(seed)
+        arr = rng.randint(0, 255, size=(24, 32, 3), dtype=np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        return buf.getvalue()
+
+    def png_bytes(seed):
+        rng = np.random.RandomState(seed)
+        arr = rng.randint(0, 21, size=(24, 32), dtype=np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr, mode="L").save(buf, format="PNG")
+        return buf.getvalue()
+
+    ids = ["2007_000032", "2007_000039", "2007_000063"]
+    path = os.path.join(OUT, "voc2012_fixture.tar")
+    with tarfile.open(path, "w") as tar:
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                   "trainval.txt", ("\n".join(ids) + "\n").encode())
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                   "train.txt", ("\n".join(ids[:2]) + "\n").encode())
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                   "val.txt", (ids[2] + "\n").encode())
+        for i, name in enumerate(ids):
+            _add_bytes(tar, f"VOCdevkit/VOC2012/JPEGImages/{name}.jpg",
+                       jpg_bytes(i))
+            _add_bytes(tar,
+                       f"VOCdevkit/VOC2012/SegmentationClass/{name}.png",
+                       png_bytes(100 + i))
+    return path
+
+
+def make_flowers():
+    """102flowers.tgz (jpg/image_%05d.jpg) + imagelabels.mat +
+    setid.mat."""
+    import numpy as np
+    import scipy.io as scio
+    from PIL import Image
+
+    def jpg_bytes(seed):
+        rng = np.random.RandomState(seed)
+        arr = rng.randint(0, 255, size=(32, 32, 3), dtype=np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        return buf.getvalue()
+
+    n = 6
+    path = os.path.join(OUT, "102flowers_fixture.tgz")
+    with tarfile.open(path, "w:gz") as tar:
+        for i in range(1, n + 1):
+            _add_bytes(tar, "jpg/image_%05d.jpg" % i, jpg_bytes(i))
+    labels = (np.arange(n) % 3 + 1).reshape(1, -1)   # 1-based classes
+    scio.savemat(os.path.join(OUT, "flowers_imagelabels.mat"),
+                 {"labels": labels})
+    scio.savemat(os.path.join(OUT, "flowers_setid.mat"),
+                 {"trnid": np.array([[1, 2, 3, 4]]),
+                  "tstid": np.array([[5, 6]]),
+                  "valid": np.array([[5]])})
+    return path
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for fn in (make_imdb, make_imikolov, make_movielens, make_wmt14,
+               make_wmt16, make_conll05, make_sentiment, make_mq2007,
+               make_voc2012, make_flowers):
+        print(fn())
+
+
+if __name__ == "__main__":
+    main()

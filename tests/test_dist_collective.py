@@ -48,7 +48,7 @@ class TestTwoProcessCollective:
         }
         rc = launch_collective(
             [WORKER, str(out)], nproc=2, log_dir=str(tmp_path / "logs"),
-            env_extra=env_extra)
+            env_extra=env_extra, timeout=240)
         if rc != 0:
             logs = ""
             logdir = tmp_path / "logs"
