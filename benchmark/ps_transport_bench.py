@@ -1,0 +1,91 @@
+"""PS transport loopback benchmark (the BASELINE.md "PS transport"
+numbers): dense push/pull of a 64 MB fp32 parameter and the native
+dense optimize-block kernels, one JSON line each.
+
+Run: python benchmark/ps_transport_bench.py [--size MB] [--reps N]
+
+The dense push measures the full server-side path the reference runs
+in C++ (recv -> decode -> optimize block -> reply; ref:
+operators/distributed/request_handler_impl.cc): with the native
+library built, the optimizer step runs in
+native/src/ps_table.cc pt_dense_* kernels. BENCH_PS_JNP=1 forces the
+Python/jnp fallback step for A/B comparison.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import ps as psmod
+    from paddle_tpu.distributed.launch import find_free_ports
+    from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--size", type=int, default=64, help="param MB")
+    ap.add_argument("--reps", type=int, default=8)
+    args = ap.parse_args()
+    n = args.size * 1024 * 1024 // 4
+    grad = np.ones(n, np.float32)
+
+    if os.environ.get("BENCH_PS_JNP") == "1":
+        psmod._DenseVar._native_kind = lambda self: (None, None)
+
+    def run(optimizer):
+        port = find_free_ports(1)[0]
+        srv = ParameterServer(f"127.0.0.1:{port}", num_trainers=1,
+                              sync_mode=False)
+        srv.host_dense("w", np.zeros(n, np.float32),
+                       optimizer=optimizer)
+        srv.start()
+        c = PSClient([f"127.0.0.1:{port}"],
+                     var_ep={"w": f"127.0.0.1:{port}"}, trainer_id=0)
+        c.push_grad("w", grad)           # warmup (lazy slots/native)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            c.push_grad("w", grad)
+        push_dt = (time.perf_counter() - t0) / args.reps
+        c.pull_param("w")
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            c.pull_param("w")
+        pull_dt = (time.perf_counter() - t0) / args.reps
+        srv.stop()
+        return push_dt, pull_dt
+
+    gb = n * 4 / 1e9
+    native = "jnp" if os.environ.get("BENCH_PS_JNP") == "1" else "native"
+    for name, opt in (("sgd", pt.optimizer.SGDOptimizer(0.01)),
+                      ("adam", pt.optimizer.AdamOptimizer(1e-3))):
+        push_dt, pull_dt = run(opt)
+        print(json.dumps({
+            "metric": f"ps_dense_push_{name}_{native}_gbps",
+            "value": round(gb / push_dt, 3), "unit": "GB/s",
+            "ms_per_req": round(push_dt * 1e3, 1),
+            "size_mb": args.size, "cpus": os.cpu_count()}))
+        if name == "sgd":
+            print(json.dumps({
+                "metric": "ps_dense_pull_gbps",
+                "value": round(gb / pull_dt, 3), "unit": "GB/s",
+                "ms_per_req": round(pull_dt * 1e3, 1),
+                "size_mb": args.size, "cpus": os.cpu_count()}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -549,22 +549,23 @@ VOC_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
 
 def voc2012_reader(tar_path, sub_name):
     """(HWC image array, HW label array) per id in the split's set file
-    (ref: voc2012.py:44-66)."""
+    (ref: voc2012.py:44-66; the tar opens lazily inside reader() so an
+    unconsumed creator does not hold a file descriptor)."""
     from PIL import Image
-    tarobject = tarfile.open(tar_path)
-    name2mem = {m.name: m for m in tarobject.getmembers()}
 
     def reader():
-        sets = tarobject.extractfile(name2mem[VOC_SET_FILE
-                                              .format(sub_name)])
-        for line in sets:
-            line = line.decode().strip()
-            data = tarobject.extractfile(
-                name2mem[VOC_DATA_FILE.format(line)]).read()
-            label = tarobject.extractfile(
-                name2mem[VOC_LABEL_FILE.format(line)]).read()
-            yield (np.array(Image.open(io.BytesIO(data))),
-                   np.array(Image.open(io.BytesIO(label))))
+        with tarfile.open(tar_path) as tarobject:
+            name2mem = {m.name: m for m in tarobject.getmembers()}
+            sets = tarobject.extractfile(name2mem[VOC_SET_FILE
+                                                  .format(sub_name)])
+            for line in sets:
+                line = line.decode().strip()
+                data = tarobject.extractfile(
+                    name2mem[VOC_DATA_FILE.format(line)]).read()
+                label = tarobject.extractfile(
+                    name2mem[VOC_LABEL_FILE.format(line)]).read()
+                yield (np.array(Image.open(io.BytesIO(data))),
+                       np.array(Image.open(io.BytesIO(label))))
     return reader
 
 

@@ -63,6 +63,8 @@ def _wait(procs, logs, timeout=None):
                       f"{list(alive)}", file=sys.stderr)
                 for q in alive.values():
                     q.kill()
+                for q in alive.values():
+                    q.wait()        # reap: no zombies, ports released
                 return 124
             for name, p in list(alive.items()):
                 r = p.poll()

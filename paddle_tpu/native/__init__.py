@@ -123,6 +123,25 @@ def _bind(lib):
                                        c_float_p, c_long]
     lib.pt_ps_table_shrink.restype = c_long
     lib.pt_ps_table_shrink.argtypes = [c_void_p, ctypes.c_uint64]
+    c_float = ctypes.c_float
+    lib.pt_dense_sgd.argtypes = [c_float_p, c_float_p, c_float_p,
+                                 c_long, c_float]
+    lib.pt_dense_momentum.argtypes = [c_float_p, c_float_p, c_float_p,
+                                      c_float_p, c_long, c_float,
+                                      c_float, c_int]
+    lib.pt_dense_adam.argtypes = [c_float_p, c_float_p, c_float_p,
+                                  c_float_p, c_float_p, c_long,
+                                  c_float, c_float, c_float, c_float,
+                                  c_long]
+    lib.pt_dense_accum.argtypes = [c_float_p, c_float_p, c_long]
+    lib.pt_dense_l2_decay.argtypes = [c_float_p, c_float_p, c_long,
+                                      c_float]
+    lib.pt_dense_l1_decay.argtypes = [c_float_p, c_float_p, c_long,
+                                      c_float]
+    for f in (lib.pt_dense_sgd, lib.pt_dense_momentum,
+              lib.pt_dense_adam, lib.pt_dense_accum,
+              lib.pt_dense_l2_decay, lib.pt_dense_l1_decay):
+        f.restype = None
     return lib
 
 
