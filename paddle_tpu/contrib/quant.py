@@ -19,9 +19,24 @@ from paddle_tpu.ops import quantize as Q
 from paddle_tpu.static.program import Operator
 
 __all__ = ["QuantizeTranspiler", "fake_quant_params",
-           "post_training_quantize", "dequantize_params"]
+           "post_training_quantize", "dequantize_params",
+           "calibrate_activations", "QuantizationFreezePass",
+           "ConvertToInt8Pass", "quantize_program_int8"]
 
 _QUANTIZABLE = ("mul", "matmul", "conv2d", "depthwise_conv2d")
+
+
+def _quantize_weight_in_scope(scope, name, bits):
+    """abs-max quantize a scope weight to integer storage in place;
+    returns the fp32 scale (shared by freeze + convert passes)."""
+    var = scope.find_var(name)
+    if var is None:
+        raise KeyError(f"weight {name!r} not initialized in scope")
+    w = np.asarray(var, np.float32)
+    scale = float(np.max(np.abs(w))) if w.size else 0.0
+    scope.set_var(name, np.asarray(Q.quantize_linear(
+        w, max(scale, 1e-12), bit_length=bits)))
+    return scale
 
 
 class QuantizeTranspiler:
@@ -112,3 +127,225 @@ def dequantize_params(quantized, treedef, bit_length=8):
                                            bit_length=bit_length))
             for q, s in quantized]
     return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+def calibrate_activations(exe, program, feed_batches, scope=None,
+                          quantizable_op_type=_QUANTIZABLE,
+                          strategy="abs_max", moving_rate=0.9):
+    """Activation-range calibration from sample batches — the role of
+    the reference's int8 calibrator (inference/tensorrt/
+    trt_int8_calibrator.cc feeding scale ranges to the engine, and
+    slim PTQ's activation pass). Runs the program over the calibration
+    feeds, fetching every ACTIVATION var that feeds a quantizable op,
+    and returns {var name: scale}.
+
+    strategy 'abs_max' takes the max |x| over all batches;
+    'moving_average_abs_max' follows the reference's EMA
+    (quantization_pass.py moving-average scale) for outlier-robust
+    ranges."""
+    from paddle_tpu.static.executor import global_scope
+    scope = scope or global_scope()
+    blk = program.global_block()
+    act_names = []
+    for op in blk.ops:
+        if op.type not in quantizable_op_type:
+            continue
+        for names in op.inputs.values():
+            for name in names:
+                base = name.split(".quant_dequant")[0]
+                var = blk.vars.get(base)
+                if var is not None and getattr(var, "persistable", False):
+                    continue          # weights calibrate from values
+                if base not in act_names:
+                    act_names.append(base)
+    scales = {}
+    for feed in feed_batches:
+        vals = exe.run(program, feed=feed, fetch_list=act_names,
+                       scope=scope)
+        for name, v in zip(act_names, vals):
+            m = float(np.max(np.abs(np.asarray(v)))) if np.asarray(
+                v).size else 0.0
+            if strategy == "moving_average_abs_max":
+                prev = scales.get(name)
+                scales[name] = m if prev is None else (
+                    moving_rate * prev + (1 - moving_rate) * m)
+            else:
+                scales[name] = max(scales.get(name, 0.0), m)
+    return scales
+
+
+class QuantizationFreezePass:
+    """Freeze a fake-quant (QAT) program into an int8 inference
+    program (ref: contrib/slim/quantization/quantization_pass.py
+    QuantizationFreezePass): strips the fake quant-dequant ops,
+    quantizes every trained weight to integers IN THE SCOPE (abs-max
+    of the trained value — the reference reads the same from its
+    quantized var), and rewrites each quantizable op into its integer
+    kernel (quantized_mul / quantized_conv2d) carrying the weight
+    scale and the calibrated activation scale as attributes.
+
+    ``act_scales`` maps ORIGINAL activation var names to calibrated
+    ranges (see calibrate_activations). Activations quantize on the
+    fly inside the integer kernels at those scales, so the frozen
+    program is a pure static Program that the Executor / inference
+    Predictor runs like any other."""
+
+    _REWRITE = {"mul": "quantized_mul", "matmul": "quantized_mul",
+                "conv2d": "quantized_conv2d",
+                "depthwise_conv2d": "quantized_conv2d"}
+    # attrs each integer kernel accepts: anything else on the op means
+    # semantics the kernel cannot express — the op stays float
+    _KERNEL_ATTRS = {
+        "quantized_mul": {"x_num_col_dims"},
+        "quantized_conv2d": {"stride", "padding", "dilation", "groups",
+                             "data_format"},
+    }
+    # attr values that are semantically the kernel's default: safe to
+    # drop rather than refuse (matmul's wrapper records these even
+    # when unused)
+    _DROPPABLE_DEFAULTS = {"y_num_col_dims": 1, "transpose_x": False,
+                           "transpose_y": False, "alpha": 1.0,
+                           "name": None}
+
+    def __init__(self, scope=None, weight_bits=8, activation_bits=8,
+                 act_scales=None):
+        self.scope = scope
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_scales = dict(act_scales or {})
+        self.weight_scales = {}
+
+    def _base(self, name):
+        return name.split(".quant_dequant")[0]
+
+    def apply(self, program):
+        from paddle_tpu.static.executor import global_scope
+        scope = self.scope or global_scope()
+        blk = program.global_block()
+        new_ops = []
+        for op in blk.ops:
+            if op.type == "fake_quantize_dequantize_abs_max":
+                continue              # stripped: scales fold below
+            if op.type in self._REWRITE:
+                kernel = self._REWRITE[op.type]
+                attrs, unsupported = {}, False
+                for k, v in op.attrs.items():
+                    if k in self._KERNEL_ATTRS[kernel]:
+                        attrs[k] = v
+                    elif (k in self._DROPPABLE_DEFAULTS
+                          and v == self._DROPPABLE_DEFAULTS[k]):
+                        pass          # recorded default: fold away
+                    else:
+                        unsupported = True   # e.g. transpose_y=True
+                act_name, w_name = None, None
+                for slot, names in op.inputs.items():
+                    rewritten = []
+                    for name in names:
+                        base = self._base(name)
+                        var = blk.vars.get(base)
+                        if var is not None and getattr(
+                                var, "persistable", False):
+                            w_name = base
+                        else:
+                            act_name = base
+                        rewritten.append(base)
+                    op.inputs[slot] = rewritten
+                if w_name is None or unsupported:
+                    # param-less matmul / semantics the integer kernel
+                    # cannot express (transposes, alpha): stay float
+                    new_ops.append(op)
+                    continue
+                if op.type == "depthwise_conv2d":
+                    # the float op injects feature_group_count=C
+                    # internally; the frozen op must carry it. Only the
+                    # multiplier-1 layout (C, 1, kh, kw) is derivable
+                    # from the filter alone — otherwise stay float.
+                    w_shape = np.asarray(scope.find_var(w_name)).shape
+                    if len(w_shape) == 4 and w_shape[1] == 1:
+                        attrs["groups"] = int(w_shape[0])
+                    else:
+                        new_ops.append(op)
+                        continue
+                if act_name not in self.act_scales:
+                    raise KeyError(
+                        f"no calibrated scale for activation "
+                        f"{act_name!r} feeding {op.type} — run "
+                        f"calibrate_activations over sample batches "
+                        f"first")
+                w_scale = self._freeze_weight(scope, w_name)
+                attrs["x_scale"] = float(self.act_scales[act_name])
+                attrs["w_scale"] = float(w_scale)
+                attrs["bit_length"] = self.activation_bits
+                if self.weight_bits != self.activation_bits:
+                    attrs["w_bit_length"] = self.weight_bits
+                new_ops.append(Operator(
+                    blk, kernel,
+                    inputs={"X": [act_name, w_name]},
+                    outputs=dict(op.outputs), attrs=attrs))
+            else:
+                # rewire any stray .quant_dequant reads back to base
+                for slot, names in op.inputs.items():
+                    op.inputs[slot] = [self._base(n) for n in names]
+                new_ops.append(op)
+        blk.ops = new_ops
+        program._bump()
+        return program
+
+    def _freeze_weight(self, scope, name):
+        if name in self.weight_scales:
+            return self.weight_scales[name]
+        scale = _quantize_weight_in_scope(scope, name,
+                                          self.weight_bits)
+        self.weight_scales[name] = scale
+        return scale
+
+
+class ConvertToInt8Pass:
+    """Storage-only conversion (ref: quantization_pass.py
+    ConvertToInt8Pass): quantize every persistable weight consumed by
+    a quantizable op to int8 in the scope WITHOUT rewriting ops — used
+    when the runtime dequantizes on load. Returns {weight: scale}."""
+
+    def __init__(self, scope=None, weight_bits=8,
+                 quantizable_op_type=_QUANTIZABLE):
+        self.scope = scope
+        self.weight_bits = weight_bits
+        self.op_types = tuple(quantizable_op_type)
+
+    def apply(self, program):
+        from paddle_tpu.static.executor import global_scope
+        scope = self.scope or global_scope()
+        blk = program.global_block()
+        scales = {}
+        for op in blk.ops:
+            if op.type not in self.op_types:
+                continue
+            for names in op.inputs.values():
+                for name in names:
+                    var = blk.vars.get(name)
+                    if var is None or not getattr(var, "persistable",
+                                                  False):
+                        continue
+                    if name in scales:
+                        continue
+                    scales[name] = _quantize_weight_in_scope(
+                        scope, name, self.weight_bits)
+        return scales
+
+
+def quantize_program_int8(exe, program, feed_batches, scope=None,
+                          weight_bits=8, activation_bits=8,
+                          quantizable_op_type=_QUANTIZABLE,
+                          strategy="abs_max"):
+    """One-call post-training int8 quantization: calibrate activation
+    ranges from ``feed_batches``, then freeze the program (weights ->
+    int8 in scope, quantizable ops -> integer kernels). Works on a
+    plain fp32 program (PTQ) or a QAT-transpiled one after training
+    (the fake ops are stripped and their role folds into the scales).
+    Returns the frozen program (rewritten in place)."""
+    scales = calibrate_activations(
+        exe, program, feed_batches, scope=scope,
+        quantizable_op_type=quantizable_op_type, strategy=strategy)
+    return QuantizationFreezePass(
+        scope=scope, weight_bits=weight_bits,
+        activation_bits=activation_bits, act_scales=scales).apply(program)

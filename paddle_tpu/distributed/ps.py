@@ -232,6 +232,25 @@ class _DenseVar:
         new_p, self.slots = opt._update(p, g, self.slots, lr, t)
         self.value = np.asarray(new_p)
 
+    def _accumulate(self, grad):
+        """Sync fan-in accumulation (listen_and_serv's grad
+        aggregation): first push owns a fresh float32 buffer,
+        subsequent pushes add in place via the native kernel when
+        available (numpy otherwise)."""
+        if self.accum is None:
+            self.accum = np.array(grad, np.float32, copy=True)
+            return
+        lib, _ = self._native_kind()
+        if (lib is not None and self.accum.flags.c_contiguous
+                and grad.dtype == np.float32):
+            import ctypes
+            fp = ctypes.POINTER(ctypes.c_float)
+            g = np.ascontiguousarray(grad, np.float32)
+            lib.pt_dense_accum(self.accum.ctypes.data_as(fp),
+                               g.ctypes.data_as(fp), self.accum.size)
+        else:
+            self.accum = self.accum + grad
+
     def push_sync(self, trainer_id, grad, num_trainers, timeout=120.0):
         with self.cv:
             if trainer_id in self.pushed:
@@ -240,7 +259,7 @@ class _DenseVar:
                     lambda: trainer_id not in self.pushed, timeout=timeout)
                 enforce(ok, f"duplicate push from trainer {trainer_id} "
                             f"timed out waiting for round fan-in")
-            self.accum = grad if self.accum is None else self.accum + grad
+            self._accumulate(grad)
             self.pushed.add(trainer_id)
             if len(self.pushed) >= num_trainers:
                 self._step(self.accum / max(num_trainers, 1))

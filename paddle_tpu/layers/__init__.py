@@ -81,6 +81,7 @@ _NARGS = {
     "moving_average_abs_max_scale": 3,
     "fake_dequantize_max_abs": 2, "quantize_linear": 2,
     "dequantize_linear": 2, "fake_channel_wise_dequantize_max_abs": 1,
+    "quantized_mul": 2, "quantized_conv2d": 2,
     # crf / ctc families (optional trailing tensors promote dynamically)
     "linear_chain_crf": 3, "crf_decoding": 2, "ctc_loss": 2,
     "warpctc": 2, "edit_distance": 2,

@@ -30,6 +30,7 @@ __all__ = [
     "moving_average_abs_max_scale",
     "fake_dequantize_max_abs", "fake_channel_wise_dequantize_max_abs",
     "quantize_linear", "dequantize_linear",
+    "quantized_mul", "quantized_conv2d",
 ]
 
 
@@ -200,3 +201,54 @@ def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
     s = jnp.maximum(scale, 1e-12).reshape(shape)
     out = _ste_round(x / s * bins) * s / bins
     return out, scale
+
+
+# -- int8 inference execution (the frozen-graph kernels) ------------------
+
+def quantized_mul(x, w_q, x_scale, w_scale, x_num_col_dims=1,
+                  bit_length=8, w_bit_length=None):
+    """Int8 matmul with int32 accumulation — what a frozen QAT / PTQ
+    'mul' executes (ref: the int8 kernels behind
+    QuantizationFreezePass + trt int8 engine subgraphs). The activation
+    quantizes on the fly at its calibrated scale; the weight arrives
+    already integer. On TPU the int8xint8->int32 dot maps onto the MXU.
+    """
+    import math as _math
+    x_bins = _bin_cnt(bit_length)
+    w_bins = _bin_cnt(bit_length if w_bit_length is None
+                      else w_bit_length)
+    x = jnp.asarray(x)
+    xs = x.reshape((_math.prod(x.shape[:x_num_col_dims]), -1))
+    q_x = quantize_linear(xs, x_scale, bit_length=bit_length)
+    acc = jax.lax.dot_general(
+        q_x, jnp.asarray(w_q),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (
+        jnp.float32(x_scale) * jnp.float32(w_scale) / (x_bins * w_bins))
+    return out.reshape(x.shape[:x_num_col_dims] + (out.shape[-1],))
+
+
+def quantized_conv2d(x, w_q, x_scale, w_scale, stride=1, padding=0,
+                     dilation=1, groups=1, data_format="NCHW",
+                     bit_length=8, w_bit_length=None):
+    """Int8 conv with int32 accumulation (frozen conv2d). Weight layout
+    OIHW like ops.nn.conv2d."""
+    from paddle_tpu.ops.nn import _conv_padding, _pair
+    x_bins = _bin_cnt(bit_length)
+    w_bins = _bin_cnt(bit_length if w_bit_length is None
+                      else w_bit_length)
+    x = jnp.asarray(x)
+    q_x = quantize_linear(x, x_scale, bit_length=bit_length)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w_q.shape, (data_format, "OIHW", data_format))
+    acc = jax.lax.conv_general_dilated(
+        q_x, jnp.asarray(w_q),
+        window_strides=_pair(stride),
+        padding=_conv_padding(padding, 2),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (
+        jnp.float32(x_scale) * jnp.float32(w_scale) / (x_bins * w_bins))
