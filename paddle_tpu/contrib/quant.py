@@ -218,60 +218,70 @@ class QuantizationFreezePass:
     def _base(self, name):
         return name.split(".quant_dequant")[0]
 
+    def _plan_op(self, op, blk, scope):
+        """Decide how one quantizable op freezes WITHOUT mutating
+        anything. Returns (kernel, attrs, act_name, w_name) for a
+        rewrite, or None to leave the op float."""
+        kernel = self._REWRITE[op.type]
+        attrs, unsupported = {}, False
+        for k, v in op.attrs.items():
+            if k in self._KERNEL_ATTRS[kernel]:
+                attrs[k] = v
+            elif (k in self._DROPPABLE_DEFAULTS
+                  and v == self._DROPPABLE_DEFAULTS[k]):
+                pass                  # recorded default: fold away
+            else:
+                unsupported = True    # e.g. transpose_y=True
+        bases = [self._base(n) for names in op.inputs.values()
+                 for n in names]
+
+        def is_weight(base):
+            var = blk.vars.get(base)
+            return var is not None and getattr(var, "persistable",
+                                               False)
+        # the integer kernels compute act @ weight: only the standard
+        # [activation, weight] operand order is expressible — a
+        # weight-first matmul (w @ x) must stay float, NOT be silently
+        # reordered
+        if (unsupported or len(bases) != 2 or is_weight(bases[0])
+                or not is_weight(bases[1])):
+            return None
+        act_name, w_name = bases
+        if op.type == "depthwise_conv2d":
+            # the float op injects feature_group_count=C internally;
+            # the frozen op must carry it. Only the multiplier-1
+            # layout (C, 1, kh, kw) is derivable from the filter
+            # alone — otherwise stay float.
+            w_shape = np.asarray(scope.find_var(w_name)).shape
+            if len(w_shape) == 4 and w_shape[1] == 1:
+                attrs["groups"] = int(w_shape[0])
+            else:
+                return None
+        if act_name not in self.act_scales:
+            raise KeyError(
+                f"no calibrated scale for activation {act_name!r} "
+                f"feeding {op.type} — run calibrate_activations over "
+                f"sample batches first")
+        return kernel, attrs, act_name, w_name
+
     def apply(self, program):
         from paddle_tpu.static.executor import global_scope
         scope = self.scope or global_scope()
         blk = program.global_block()
+        # PLAN first (validates every op incl. calibrated scales),
+        # mutate second: a missing scale must raise before any weight
+        # in the scope has been converted to integers — a partial
+        # freeze would leave a float program over int8 weights
+        plans = {}
+        for i, op in enumerate(blk.ops):
+            if op.type in self._REWRITE:
+                plans[i] = self._plan_op(op, blk, scope)
         new_ops = []
-        for op in blk.ops:
+        for i, op in enumerate(blk.ops):
             if op.type == "fake_quantize_dequantize_abs_max":
                 continue              # stripped: scales fold below
-            if op.type in self._REWRITE:
-                kernel = self._REWRITE[op.type]
-                attrs, unsupported = {}, False
-                for k, v in op.attrs.items():
-                    if k in self._KERNEL_ATTRS[kernel]:
-                        attrs[k] = v
-                    elif (k in self._DROPPABLE_DEFAULTS
-                          and v == self._DROPPABLE_DEFAULTS[k]):
-                        pass          # recorded default: fold away
-                    else:
-                        unsupported = True   # e.g. transpose_y=True
-                act_name, w_name = None, None
-                for slot, names in op.inputs.items():
-                    rewritten = []
-                    for name in names:
-                        base = self._base(name)
-                        var = blk.vars.get(base)
-                        if var is not None and getattr(
-                                var, "persistable", False):
-                            w_name = base
-                        else:
-                            act_name = base
-                        rewritten.append(base)
-                    op.inputs[slot] = rewritten
-                if w_name is None or unsupported:
-                    # param-less matmul / semantics the integer kernel
-                    # cannot express (transposes, alpha): stay float
-                    new_ops.append(op)
-                    continue
-                if op.type == "depthwise_conv2d":
-                    # the float op injects feature_group_count=C
-                    # internally; the frozen op must carry it. Only the
-                    # multiplier-1 layout (C, 1, kh, kw) is derivable
-                    # from the filter alone — otherwise stay float.
-                    w_shape = np.asarray(scope.find_var(w_name)).shape
-                    if len(w_shape) == 4 and w_shape[1] == 1:
-                        attrs["groups"] = int(w_shape[0])
-                    else:
-                        new_ops.append(op)
-                        continue
-                if act_name not in self.act_scales:
-                    raise KeyError(
-                        f"no calibrated scale for activation "
-                        f"{act_name!r} feeding {op.type} — run "
-                        f"calibrate_activations over sample batches "
-                        f"first")
+            if plans.get(i) is not None:
+                kernel, attrs, act_name, w_name = plans[i]
                 w_scale = self._freeze_weight(scope, w_name)
                 attrs["x_scale"] = float(self.act_scales[act_name])
                 attrs["w_scale"] = float(w_scale)
@@ -283,7 +293,8 @@ class QuantizationFreezePass:
                     inputs={"X": [act_name, w_name]},
                     outputs=dict(op.outputs), attrs=attrs))
             else:
-                # rewire any stray .quant_dequant reads back to base
+                # float op (incl. unplanned quantizable ops): rewire
+                # any stray .quant_dequant reads back to base
                 for slot, names in op.inputs.items():
                     op.inputs[slot] = [self._base(n) for n in names]
                 new_ops.append(op)

@@ -591,10 +591,19 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
     x = jnp.asarray(x, jnp.float32)
     rois = jnp.asarray(rois, jnp.float32)
     n, c, h, w = x.shape
-    k = int(pooled_size)
+    # rectangular pooled outputs supported (deformable_psroi_pooling_op
+    # takes independent pooled_height/pooled_width)
+    kh, kw = ((int(pooled_size[0]), int(pooled_size[1]))
+              if isinstance(pooled_size, (list, tuple))
+              else (int(pooled_size), int(pooled_size)))
     g = int(group_size)
     oc = int(output_channels)
-    part = int(part_size or k)
+    if part_size is None:
+        part_h, part_w = kh, kw
+    elif isinstance(part_size, (list, tuple)):
+        part_h, part_w = int(part_size[0]), int(part_size[1])
+    else:
+        part_h = part_w = int(part_size)
     sp = int(sample_per_part)
     enforce(c == oc * g * g, "channel/group mismatch")
     if rois.shape[1] == 5:
@@ -607,11 +616,11 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
         boxes = rois
     feat = x.reshape(n, oc, g, g, h, w)
 
-    ii, jj = jnp.meshgrid(jnp.arange(k), jnp.arange(k), indexing="ij")
-    gi = jnp.clip(ii * g // k, 0, g - 1)            # [k,k] channel group
-    gj = jnp.clip(jj * g // k, 0, g - 1)
-    pi = jnp.clip(ii * part // k, 0, part - 1)      # [k,k] offset part
-    pj = jnp.clip(jj * part // k, 0, part - 1)
+    ii, jj = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
+    gi = jnp.clip(ii * g // kh, 0, g - 1)          # [kh,kw] channel group
+    gj = jnp.clip(jj * g // kw, 0, g - 1)
+    pi = jnp.clip(ii * part_h // kh, 0, part_h - 1)  # [kh,kw] offset part
+    pj = jnp.clip(jj * part_w // kw, 0, part_w - 1)
     su = (jnp.arange(sp) + 0.5) / sp                # sub-bin sample frac
 
     def one(box, bi, tr):
@@ -619,13 +628,13 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
         y1 = box[1] * spatial_scale
         rw = jnp.maximum((box[2] - box[0]) * spatial_scale, 0.1)
         rh = jnp.maximum((box[3] - box[1]) * spatial_scale, 0.1)
-        bin_h = rh / k
-        bin_w = rw / k
+        bin_h = rh / kh
+        bin_w = rw / kw
         if tr is not None:
             dy = tr[0, pi, pj] * trans_std * rh     # [k,k]
             dx = tr[1, pi, pj] * trans_std * rw
         else:
-            dy = dx = jnp.zeros((k, k), jnp.float32)
+            dy = dx = jnp.zeros((kh, kw), jnp.float32)
         # sample coords [k,k,sp,sp]
         ys = (y1 + dy)[..., None, None] \
             + (ii[..., None, None] + su[None, None, :, None]) \
@@ -657,7 +666,7 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
 
     if trans is None:
         return jax.vmap(lambda b, bi: one(b, bi, None))(boxes, bidx)
-    tr = jnp.asarray(trans, jnp.float32).reshape(-1, 2, part, part)
+    tr = jnp.asarray(trans, jnp.float32).reshape(-1, 2, part_h, part_w)
     return jax.vmap(one)(boxes, bidx, tr)
 
 
@@ -671,20 +680,16 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
     user-facing wrapper. position_sensitive=False pools each input
     channel (group 1); True is the R-FCN position-sensitive layout."""
     x = jnp.asarray(input)
-    if pooled_height != pooled_width:
-        raise NotImplementedError(
-            "deformable_roi_pooling: square pooled output only")
     g = group_size[0] if isinstance(group_size, (list, tuple)) else group_size
     if position_sensitive:
         oc = x.shape[1] // (g * g)
     else:
         g, oc = 1, x.shape[1]
-    if isinstance(part_size, (list, tuple)):
-        part_size = part_size[0]
     return deformable_psroi_pooling(
-        x, rois, None if no_trans else trans, oc, g, pooled_height,
-        part_size=part_size, spatial_scale=spatial_scale,
-        sample_per_part=sample_per_part, trans_std=trans_std)
+        x, rois, None if no_trans else trans, oc, g,
+        (pooled_height, pooled_width), part_size=part_size,
+        spatial_scale=spatial_scale, sample_per_part=sample_per_part,
+        trans_std=trans_std)
 
 
 __all__ += ["conv2d_fusion", "deformable_psroi_pooling",

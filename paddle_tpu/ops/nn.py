@@ -140,29 +140,37 @@ def conv2d_transpose(x, weight, stride=1, padding=0, dilation=1, groups=1,
 def pool2d(x, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
            global_pooling=False, ceil_mode=False, exclusive=True,
            data_format="NCHW", name=None):
-    """pool_op.cc parity (max/avg, exclusive avg-padding semantics)."""
-    if data_format != "NCHW":
-        raise NotImplementedError("pool2d: NCHW only for now")
+    """pool_op.cc parity (max/avg, exclusive avg-padding semantics,
+    NCHW or NHWC layout — pool_op.cc handles both via data_format)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"pool2d: data_format must be NCHW|NHWC, "
+                         f"got {data_format!r}")
+    sp = (2, 3) if data_format == "NCHW" else (1, 2)
     if global_pooling:
-        axis = (2, 3)
         if pool_type == "max":
-            return jnp.max(x, axis=axis, keepdims=True)
-        return jnp.mean(x, axis=axis, keepdims=True)
+            return jnp.max(x, axis=sp, keepdims=True)
+        return jnp.mean(x, axis=sp, keepdims=True)
     ks = _pair(pool_size)
     st = _pair(pool_stride)
     pd = _pair(pool_padding)
-    window = (1, 1) + ks
-    strides = (1, 1) + st
-    pads = ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]))
-    if ceil_mode:
-        pads = ((0, 0), (0, 0),
-                (pd[0], pd[0] + st[0] - 1), (pd[1], pd[1] + st[1] - 1))
+
+    def lay(h, w, one=1):
+        # place the spatial entries at the layout's H/W positions
+        out = [one, one, one, one]
+        out[sp[0]], out[sp[1]] = h, w
+        return tuple(out)
+
+    window = lay(ks[0], ks[1])
+    strides = lay(st[0], st[1])
+    ph = (pd[0], pd[0] + (st[0] - 1 if ceil_mode else 0))
+    pw = (pd[1], pd[1] + (st[1] - 1 if ceil_mode else 0))
+    pads = lay(ph, pw, one=(0, 0))
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
     s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
     if exclusive:
-        ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+        ones = jnp.ones(x.shape, x.dtype)
         cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
         return s / cnt
     return s / (ks[0] * ks[1])
@@ -182,28 +190,68 @@ def pool3d(x, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
     return s / (ks[0] * ks[1] * ks[2])
 
 
+def _adaptive_masks(size, out):
+    """[out, size] 0/1 membership mask of pool_op.h's adaptive windows:
+    cell i covers [floor(i*size/out), ceil((i+1)*size/out))
+    (AdaptiveStartIndex/AdaptiveEndIndex). Shapes are static, so the
+    mask is a compile-time-constant matrix — the avg reduction becomes
+    a (normalized) matmul the MXU tiles, the max a masked reduce."""
+    import numpy as _np
+    idx = _np.arange(size)
+    starts = _np.floor(_np.arange(out) * size / out).astype(int)
+    ends = _np.ceil((_np.arange(out) + 1) * size / out).astype(int)
+    return jnp.asarray(
+        (idx[None, :] >= starts[:, None]) & (idx[None, :] < ends[:, None]),
+        jnp.float32)
+
+
+def _adaptive_reduce(x, axes, outs, pool_type):
+    """Adaptive pooling over the given axes to the given output sizes
+    via per-axis membership masks (axes reduced one at a time)."""
+    for ax, out in zip(axes, outs):
+        size = x.shape[ax]
+        m = _adaptive_masks(size, out)                   # [out, size]
+        xm = jnp.moveaxis(x, ax, -1)                     # [..., size]
+        if pool_type == "max":
+            big = jnp.finfo(x.dtype).min if jnp.issubdtype(
+                x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            # [..., out, size] masked -> max over size
+            r = jnp.max(jnp.where(m.astype(bool), xm[..., None, :], big),
+                        axis=-1)
+        else:
+            # highest precision: the mask matmul must reproduce the
+            # exact per-cell mean (the divisible reshape path is exact,
+            # and pool parity tests compare at tight tolerances)
+            r = jnp.einsum("...s,os->...o", xm, m,
+                           precision=jax.lax.Precision.HIGHEST) / m.sum(-1)
+        x = jnp.moveaxis(r, -1, ax)
+    return x
+
+
 def adaptive_pool2d(x, pool_size, pool_type="avg", name=None):
-    """Adaptive pooling (pool_op.cc adaptive=True)."""
+    """Adaptive pooling (pool_op.cc adaptive=True): arbitrary output
+    sizes via the reference's per-cell start/end windows
+    (pool_op.h AdaptiveStartIndex/AdaptiveEndIndex); the divisible case
+    keeps the cheap reshape-reduce."""
     n, c, h, w = x.shape
     oh, ow = _pair(pool_size)
     if h % oh == 0 and w % ow == 0:
         x = x.reshape(n, c, oh, h // oh, ow, w // ow)
         return (jnp.max if pool_type == "max" else jnp.mean)(x, axis=(3, 5))
-    raise NotImplementedError("adaptive_pool2d needs divisible sizes")
+    return _adaptive_reduce(x, (2, 3), (oh, ow), pool_type)
 
 
 def adaptive_pool3d(x, pool_size, pool_type="avg", name=None):
     """Adaptive 3-D pooling (pool_op.cc adaptive=True over NCDHW; ref
-    python/paddle/fluid/layers/nn.py adaptive_pool3d). Static-shape TPU
-    form: requires output sizes that divide the input (the common case;
-    XLA cannot tile data-dependent windows onto the MXU anyway)."""
+    python/paddle/fluid/layers/nn.py adaptive_pool3d). Arbitrary output
+    sizes; divisible sizes keep the reshape-reduce fast path."""
     n, c, d, h, w = x.shape
     od, oh, ow = _pair(pool_size, 3)
     if d % od == 0 and h % oh == 0 and w % ow == 0:
         x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
         return (jnp.max if pool_type == "max" else jnp.mean)(
             x, axis=(3, 5, 7))
-    raise NotImplementedError("adaptive_pool3d needs divisible sizes")
+    return _adaptive_reduce(x, (2, 3, 4), (od, oh, ow), pool_type)
 
 
 def batch_norm(x, scale, bias, mean, variance, epsilon=1e-5, momentum=0.9,

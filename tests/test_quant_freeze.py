@@ -249,3 +249,54 @@ class TestFreezeEdgeCases:
             groups=3))
         ref = np.asarray(depthwise_conv2d(x, w, stride=1, padding=1))
         assert np.max(np.abs(out - ref)) < 0.05 * np.abs(ref).max()
+
+    def test_weight_first_matmul_stays_float(self):
+        """matmul(W, x) — weight as FIRST operand — cannot be expressed
+        by quantized_mul and must stay float with identical outputs
+        (regression: silent operand swap)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32",
+                               append_batch_size=False)
+            w = layers.create_parameter([6, 2], "float32", name="wf")
+            out = layers.matmul(w, x)       # [6,2] @ [2,4]... shapes:
+        scope = pt.static.Scope()
+        feed = {"x": np.ones((2, 4), np.float32)}
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            before, = exe.run(main, feed=feed, fetch_list=[out])
+            QuantizationFreezePass(
+                scope=scope, act_scales={"x": 1.0}).apply(main)
+            types = [op.type for op in main.global_block().ops]
+            assert "quantized_mul" not in types
+            after, = exe.run(main, feed=feed, fetch_list=[out])
+            np.testing.assert_allclose(np.asarray(after),
+                                       np.asarray(before))
+            assert np.asarray(scope.find_var("wf")).dtype == np.float32
+
+    def test_missing_scale_raises_before_any_mutation(self):
+        """A missing calibrated scale must fail BEFORE any weight has
+        been converted — no partially-frozen corrupt program."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [8], dtype="float32")
+            h = layers.fc(x, 6, act="relu")
+            out = layers.fc(h, 2)
+        scope = pt.static.Scope()
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            # only the FIRST fc's activation is calibrated
+            with pytest.raises(KeyError, match="calibrated"):
+                QuantizationFreezePass(
+                    scope=scope, act_scales={"x": 1.0}).apply(main)
+            types = [op.type for op in main.global_block().ops]
+            assert "quantized_mul" not in types
+            for op in main.global_block().ops:
+                if op.type != "mul":
+                    continue
+                for name in op.input_names():
+                    v = scope.find_var(name)
+                    if v is not None:
+                        assert np.asarray(v).dtype == np.float32, name
