@@ -382,7 +382,12 @@ def main():
     # skipping the recompute is worth ~+0.06 MFU (measured 0.418 vs 0.362;
     # bs>=96 fails to compile -- OOM -- so bs=64 no-remat is the frontier)
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
-    cfg = (bert.bert_base(attention_impl=attn, remat=remat) if on_tpu
+    # bf16 softmax: r4 on-chip A/B measured 154.2k vs 152.2k tok/s at
+    # spc=8 with matching loss curves (full experiment matrix in
+    # BASELINE.md "BERT MFU experiments"); BENCH_SOFTMAX=fp32 reverts
+    smax = os.environ.get("BENCH_SOFTMAX", "bf16" if on_tpu else "fp32")
+    cfg = (bert.bert_base(attention_impl=attn, remat=remat,
+                          softmax_dtype=smax) if on_tpu
            else bert.bert_tiny(attention_impl=attn))
     # batch=64 is the tuned single-chip config (highest measured MFU of
     # {32, 64, 96}); vs_baseline is MFU-based, so it stays comparable
@@ -394,11 +399,12 @@ def main():
     mesh = set_mesh(make_mesh(MeshConfig(data=1),
                               devices=jax.devices()[:1]))
     opt = pt.optimizer.Adam(learning_rate=1e-4)
-    # 8 scanned steps per dispatch (train_from_dataset pattern):
+    # 16 scanned steps per dispatch (train_from_dataset pattern):
     # amortizes the remote-PJRT dispatch gap, same batch per inner step.
-    # r3 A/B on-chip: spc=8 153.2k tok/s (x2 runs) vs spc=4 152.0-152.7k
-    # — the residual dispatch gap halves again. BENCH_SPC overrides.
-    spc = int(os.environ.get("BENCH_SPC", "8" if on_tpu else "1"))
+    # r4 A/B on-chip: spc=16 155.1k tok/s vs spc=8 154.2k (with bf16
+    # softmax; r3: spc=8 153.2k vs spc=4 152.0-152.7k). BENCH_SPC
+    # overrides.
+    spc = int(os.environ.get("BENCH_SPC", "16" if on_tpu else "1"))
     init_fn, step_fn = bert.make_train_step(cfg, opt, mesh,
                                             steps_per_call=spc)
     # gathered MLM head: predict only max_predictions_per_seq positions

@@ -240,18 +240,18 @@ class _DenseVar:
         if self.accum is None:
             self.accum = np.array(grad, np.float32, copy=True)
             return
+        enforce(np.shape(grad) == self.accum.shape,
+                f"grad shape {np.shape(grad)} does not match hosted "
+                f"var shape {self.accum.shape}")
         lib, _ = self._native_kind()
         if (lib is not None and self.accum.flags.c_contiguous
-                and grad.dtype == np.float32
-                and grad.shape == self.accum.shape):
+                and grad.dtype == np.float32):
             import ctypes
             fp = ctypes.POINTER(ctypes.c_float)
             g = np.ascontiguousarray(grad, np.float32)
             lib.pt_dense_accum(self.accum.ctypes.data_as(fp),
                                g.ctypes.data_as(fp), self.accum.size)
         else:
-            # shape mismatches land here too: numpy raises the typed
-            # broadcast error instead of the kernel reading OOB
             self.accum = self.accum + grad
 
     def push_sync(self, trainer_id, grad, num_trainers, timeout=120.0):

@@ -596,7 +596,9 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
     kh, kw = ((int(pooled_size[0]), int(pooled_size[1]))
               if isinstance(pooled_size, (list, tuple))
               else (int(pooled_size), int(pooled_size)))
-    g = int(group_size)
+    gh, gw = ((int(group_size[0]), int(group_size[1]))
+              if isinstance(group_size, (list, tuple))
+              else (int(group_size), int(group_size)))
     oc = int(output_channels)
     if part_size is None:
         part_h, part_w = kh, kw
@@ -605,7 +607,7 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
     else:
         part_h = part_w = int(part_size)
     sp = int(sample_per_part)
-    enforce(c == oc * g * g, "channel/group mismatch")
+    enforce(c == oc * gh * gw, "channel/group mismatch")
     if rois.shape[1] == 5:
         bidx = rois[:, 0].astype(jnp.int32)
         boxes = rois[:, 1:]
@@ -614,11 +616,11 @@ def deformable_psroi_pooling(x, rois, trans, output_channels, group_size,
                 if roi_batch_indices is None
                 else jnp.asarray(roi_batch_indices, jnp.int32))
         boxes = rois
-    feat = x.reshape(n, oc, g, g, h, w)
+    feat = x.reshape(n, oc, gh, gw, h, w)
 
     ii, jj = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw), indexing="ij")
-    gi = jnp.clip(ii * g // kh, 0, g - 1)          # [kh,kw] channel group
-    gj = jnp.clip(jj * g // kw, 0, g - 1)
+    gi = jnp.clip(ii * gh // kh, 0, gh - 1)        # [kh,kw] channel group
+    gj = jnp.clip(jj * gw // kw, 0, gw - 1)
     pi = jnp.clip(ii * part_h // kh, 0, part_h - 1)  # [kh,kw] offset part
     pj = jnp.clip(jj * part_w // kw, 0, part_w - 1)
     su = (jnp.arange(sp) + 0.5) / sp                # sub-bin sample frac
@@ -680,13 +682,17 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,
     user-facing wrapper. position_sensitive=False pools each input
     channel (group 1); True is the R-FCN position-sensitive layout."""
     x = jnp.asarray(input)
-    g = group_size[0] if isinstance(group_size, (list, tuple)) else group_size
-    if position_sensitive:
-        oc = x.shape[1] // (g * g)
+    if isinstance(group_size, (list, tuple)):
+        gh, gw = int(group_size[0]), int(group_size[1])
     else:
-        g, oc = 1, x.shape[1]
+        gh = gw = int(group_size)
+    if position_sensitive:
+        oc = x.shape[1] // (gh * gw)
+    else:
+        gh = gw = 1
+        oc = x.shape[1]
     return deformable_psroi_pooling(
-        x, rois, None if no_trans else trans, oc, g,
+        x, rois, None if no_trans else trans, oc, (gh, gw),
         (pooled_height, pooled_width), part_size=part_size,
         spatial_scale=spatial_scale, sample_per_part=sample_per_part,
         trans_std=trans_std)

@@ -170,7 +170,9 @@ def pool2d(x, pool_size=2, pool_type="max", pool_stride=1, pool_padding=0,
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
     s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
     if exclusive:
-        ones = jnp.ones(x.shape, x.dtype)
+        # ones only over the spatial plane (singleton batch/channel):
+        # the count is layout-independent and broadcasts in the divide
+        ones = jnp.ones(lay(x.shape[sp[0]], x.shape[sp[1]]), x.dtype)
         cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
         return s / cnt
     return s / (ks[0] * ks[1])
@@ -221,9 +223,12 @@ def _adaptive_reduce(x, axes, outs, pool_type):
         else:
             # highest precision: the mask matmul must reproduce the
             # exact per-cell mean (the divisible reshape path is exact,
-            # and pool parity tests compare at tight tolerances)
-            r = jnp.einsum("...s,os->...o", xm, m,
-                           precision=jax.lax.Precision.HIGHEST) / m.sum(-1)
+            # and pool parity tests compare at tight tolerances); the
+            # f32 mask promotes the accumulation — cast back so bf16
+            # inputs keep bf16 outputs like the sibling paths
+            r = (jnp.einsum("...s,os->...o", xm, m,
+                            precision=jax.lax.Precision.HIGHEST)
+                 / m.sum(-1)).astype(x.dtype)
         x = jnp.moveaxis(r, -1, ax)
     return x
 

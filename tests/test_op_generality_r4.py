@@ -178,3 +178,34 @@ class TestDeformableRoiRectangular:
             np.testing.assert_allclose(ana, num, atol=2e-3)
         finally:
             jax.config.update("jax_enable_x64", False)
+
+    def test_rect_group_size(self):
+        """Rectangular group_size (gh, gw) maps channels to groups per
+        axis independently (previously silently truncated to gh)."""
+        rng = np.random.RandomState(7)
+        oc, gh, gw = 2, 2, 3
+        x = rng.rand(1, oc * gh * gw, 8, 12).astype(np.float32)
+        rois = np.array([[0, 1.0, 1.0, 10.0, 6.0]], np.float32)
+        out = np.asarray(deformable_psroi_pooling(
+            x, rois, None, output_channels=oc, group_size=(gh, gw),
+            pooled_size=(2, 3), sample_per_part=2))
+        assert out.shape == (1, oc, 2, 3)
+        # square still equivalent through the wrapper path
+        xs = rng.rand(1, oc * 4, 8, 8).astype(np.float32)
+        a = np.asarray(deformable_roi_pooling(
+            xs, rois, None, no_trans=True, pooled_height=2,
+            pooled_width=2, group_size=2, position_sensitive=True,
+            sample_per_part=2))
+        b = np.asarray(deformable_roi_pooling(
+            xs, rois, None, no_trans=True, pooled_height=2,
+            pooled_width=2, group_size=(2, 2), position_sensitive=True,
+            sample_per_part=2))
+        np.testing.assert_allclose(a, b)
+
+    def test_adaptive_avg_preserves_dtype(self):
+        """bf16 in -> bf16 out on the non-divisible avg path (f32 only
+        for the internal accumulation)."""
+        import jax.numpy as jnp
+        x = jnp.ones((1, 2, 7, 5), jnp.bfloat16)
+        out = nn_ops.adaptive_pool2d(x, (3, 2), "avg")
+        assert out.dtype == jnp.bfloat16
