@@ -16,7 +16,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.ops import quantize as Q
-from paddle_tpu.static.program import Operator
+from paddle_tpu.static.passes import (BlockRewriter, ProgramPass,
+                                      match_ops)
 
 __all__ = ["QuantizeTranspiler", "fake_quant_params",
            "post_training_quantize", "dequantize_params",
@@ -39,10 +40,14 @@ def _quantize_weight_in_scope(scope, name, bits):
     return scale
 
 
-class QuantizeTranspiler:
+class QuantizeTranspiler(ProgramPass):
     """Insert fake quant-dequant ops before every quantizable op's tensor
     inputs in a static Program (QuantizationTransformPass parity —
-    weight_quantize_type/activation_quantize_type 'abs_max')."""
+    weight_quantize_type/activation_quantize_type 'abs_max').
+    Expressed on the pass framework (static/passes.py): match
+    quantizable ops, queue fake-quant insertions, rewire, commit."""
+
+    name = "quantize_transform"
 
     def __init__(self, weight_bits=8, activation_bits=8,
                  quantizable_op_type=_QUANTIZABLE):
@@ -50,43 +55,41 @@ class QuantizeTranspiler:
         self.activation_bits = activation_bits
         self.op_types = tuple(quantizable_op_type)
 
-    def transpile(self, program):
-        blk = program.global_block()
-        new_ops = []
+    def apply(self, program):
+        rw = BlockRewriter(program)
+        blk = rw.block
         quantized = {}       # var name -> quant-dequant output name
-        for op in blk.ops:
-            if op.type in self.op_types:
-                for slot, names in op.inputs.items():
-                    rewritten = []
-                    for name in names:
-                        if name not in quantized:
-                            var = blk.vars.get(name)
-                            is_w = var is not None and getattr(
-                                var, "persistable", False)
-                            bits = (self.weight_bits if is_w
-                                    else self.activation_bits)
-                            qname = f"{name}.quant_dequant"
-                            blk.create_var(
-                                name=qname,
-                                shape=var.shape if var is not None else None,
-                                dtype=var.dtype if var is not None
-                                else "float32")
-                            sname = f"{name}.quant_scale"
-                            blk.create_var(name=sname, shape=[],
-                                           dtype="float32")
-                            qop = Operator(
-                                blk, "fake_quantize_dequantize_abs_max",
-                                inputs={"X": [name]},
-                                outputs={"Out": [qname, sname]},
-                                attrs={"bit_length": bits})
-                            new_ops.append(qop)
-                            quantized[name] = qname
-                        rewritten.append(quantized[name])
-                    op.inputs[slot] = rewritten
-            new_ops.append(op)
-        blk.ops = new_ops
-        program._bump()
-        return program
+        for i, op in match_ops(program, self.op_types):
+            for slot, names in op.inputs.items():
+                rewritten = []
+                for name in names:
+                    if name not in quantized:
+                        var = blk.vars.get(name)
+                        is_w = var is not None and getattr(
+                            var, "persistable", False)
+                        bits = (self.weight_bits if is_w
+                                else self.activation_bits)
+                        qname = f"{name}.quant_dequant"
+                        rw.create_var(
+                            qname,
+                            shape=var.shape if var is not None else None,
+                            dtype=var.dtype if var is not None
+                            else "float32")
+                        rw.create_var(f"{name}.quant_scale", shape=[],
+                                      dtype="float32")
+                        rw.insert_before(i, rw.make_op(
+                            "fake_quantize_dequantize_abs_max",
+                            inputs={"X": [name]},
+                            outputs={"Out": [qname,
+                                             f"{name}.quant_scale"]},
+                            attrs={"bit_length": bits}))
+                        quantized[name] = qname
+                    rewritten.append(quantized[name])
+                op.inputs[slot] = rewritten
+        return rw.commit()
+
+    # original API name, kept
+    transpile = apply
 
 
 def fake_quant_params(params, bit_length=8, channel_wise=False):
@@ -174,7 +177,7 @@ def calibrate_activations(exe, program, feed_batches, scope=None,
     return scales
 
 
-class QuantizationFreezePass:
+class QuantizationFreezePass(ProgramPass):
     """Freeze a fake-quant (QAT) program into an int8 inference
     program (ref: contrib/slim/quantization/quantization_pass.py
     QuantizationFreezePass): strips the fake quant-dequant ops,
@@ -190,6 +193,7 @@ class QuantizationFreezePass:
     program is a pure static Program that the Executor / inference
     Predictor runs like any other."""
 
+    name = "quantization_freeze"
     _REWRITE = {"mul": "quantized_mul", "matmul": "quantized_mul",
                 "conv2d": "quantized_conv2d",
                 "depthwise_conv2d": "quantized_conv2d"}
@@ -267,20 +271,19 @@ class QuantizationFreezePass:
     def apply(self, program):
         from paddle_tpu.static.executor import global_scope
         scope = self.scope or global_scope()
-        blk = program.global_block()
+        rw = BlockRewriter(program)
+        blk = rw.block
         # PLAN first (validates every op incl. calibrated scales),
         # mutate second: a missing scale must raise before any weight
         # in the scope has been converted to integers — a partial
         # freeze would leave a float program over int8 weights
         plans = {}
-        for i, op in enumerate(blk.ops):
-            if op.type in self._REWRITE:
-                plans[i] = self._plan_op(op, blk, scope)
-        new_ops = []
+        for i, op in match_ops(program, tuple(self._REWRITE)):
+            plans[i] = self._plan_op(op, blk, scope)
         for i, op in enumerate(blk.ops):
             if op.type == "fake_quantize_dequantize_abs_max":
-                continue              # stripped: scales fold below
-            if plans.get(i) is not None:
+                rw.remove(i)          # stripped: scales fold below
+            elif plans.get(i) is not None:
                 kernel, attrs, act_name, w_name = plans[i]
                 w_scale = self._freeze_weight(scope, w_name)
                 attrs["x_scale"] = float(self.act_scales[act_name])
@@ -288,19 +291,15 @@ class QuantizationFreezePass:
                 attrs["bit_length"] = self.activation_bits
                 if self.weight_bits != self.activation_bits:
                     attrs["w_bit_length"] = self.weight_bits
-                new_ops.append(Operator(
-                    blk, kernel,
-                    inputs={"X": [act_name, w_name]},
+                rw.replace(i, rw.make_op(
+                    kernel, inputs={"X": [act_name, w_name]},
                     outputs=dict(op.outputs), attrs=attrs))
             else:
                 # float op (incl. unplanned quantizable ops): rewire
                 # any stray .quant_dequant reads back to base
                 for slot, names in op.inputs.items():
                     op.inputs[slot] = [self._base(n) for n in names]
-                new_ops.append(op)
-        blk.ops = new_ops
-        program._bump()
-        return program
+        return rw.commit()
 
     def _freeze_weight(self, scope, name):
         if name in self.weight_scales:
@@ -311,11 +310,13 @@ class QuantizationFreezePass:
         return scale
 
 
-class ConvertToInt8Pass:
+class ConvertToInt8Pass(ProgramPass):
     """Storage-only conversion (ref: quantization_pass.py
     ConvertToInt8Pass): quantize every persistable weight consumed by
     a quantizable op to int8 in the scope WITHOUT rewriting ops — used
     when the runtime dequantizes on load. Returns {weight: scale}."""
+
+    name = "convert_to_int8"
 
     def __init__(self, scope=None, weight_bits=8,
                  quantizable_op_type=_QUANTIZABLE):
@@ -328,9 +329,7 @@ class ConvertToInt8Pass:
         scope = self.scope or global_scope()
         blk = program.global_block()
         scales = {}
-        for op in blk.ops:
-            if op.type not in self.op_types:
-                continue
+        for _, op in match_ops(program, self.op_types):
             for names in op.inputs.values():
                 for name in names:
                     var = blk.vars.get(name)

@@ -19,7 +19,7 @@ import numpy as np
 from paddle_tpu.core.enforce import EnforceNotMet
 from paddle_tpu.static.executor import global_scope
 from paddle_tpu.static.program import (
-    OP_REGISTRY, Operator, Parameter, Program, default_main_program,
+    OP_REGISTRY, Parameter, default_main_program,
 )
 
 PARAMS_FILE = "params.npz"
@@ -77,39 +77,14 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 
 def _prune(program, feed_names, fetch_names):
     """Backward-reachability prune from fetches, stopping at feeds —
-    io.py:921's prune+inference_optimize analog."""
+    io.py:921's prune+inference_optimize analog, expressed on the pass
+    framework's slice+extract primitives (static/passes.py)."""
+    from paddle_tpu.static.passes import backward_slice, extract_subprogram
     blk = program.global_block()
-    needed = set(fetch_names)
-    kept = []
-    for op in reversed(blk.ops):
-        if op.type == "autodiff":
-            continue
-        if any(n in needed for n in op.output_names()):
-            kept.append(op)
-            needed.update(op.input_names())
-    kept.reverse()
-
-    pruned = Program()
-    pb = pruned.global_block()
-    for name, var in blk.vars.items():
-        if name in needed or name in fetch_names:
-            import copy
-            nv = copy.copy(var)
-            nv.block = pb
-            pb.vars[name] = nv
-    for op in kept:
-        new = Operator(pb, op.type, None, None, dict(op.attrs))
-        new.inputs = {k: list(v) for k, v in op.inputs.items()}
-        new.outputs = {k: list(v) for k, v in op.outputs.items()}
-        pb.ops.append(new)
-    # carry the referenced program literals (fill_constant et al. record
-    # concrete values in _constants; kept ops still read them by name)
-    consts = getattr(program, "_constants", None)
-    if consts:
-        pruned._constants = {n: v for n, v in consts.items()
-                             if n in needed}
-    pruned._bump()
-    return pruned
+    kept, needed = backward_slice(blk, fetch_names,
+                                  skip_types=("autodiff",))
+    return extract_subprogram(program, kept, needed,
+                              extra_vars=fetch_names)
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,

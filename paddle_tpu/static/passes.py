@@ -1,0 +1,239 @@
+"""Program-level pass framework: Pass / PassManager + pattern-match
+and rewrite utilities.
+
+Parity: the reference's IR pass infrastructure —
+framework/ir/pass.h (Pass::Apply over a Graph), framework/ir/
+graph_pattern_detector.h (PDPattern/PDNode subgraph matching), and
+framework/ir/pass_builder.h (ordered pass pipelines). Here the Program
+IS the IR (SURVEY §7: compile-level passes belong to XLA; program-level
+rewrites operate on the op list), so a Pass transforms a Program and
+the "pattern detector" matches over the op sequence with
+producer/consumer indices instead of a graph object.
+
+The rewrite utilities capture what every transpiler in this tree was
+re-implementing by hand (walk ops, build a new list, insert/replace/
+drop, rewire inputs): QuantizeTranspiler, QuantizationFreezePass and
+the inference prune are expressed on these primitives (see
+contrib/quant.py, static/io.py), and new rewrites (fusion experiments,
+future freeze variants) compose the same way.
+"""
+
+import copy
+
+from paddle_tpu.static.program import Operator, Program
+
+__all__ = ["ProgramPass", "PassManager", "producers", "consumers",
+           "match_ops", "match_chain", "backward_slice",
+           "extract_subprogram", "BlockRewriter"]
+
+
+class ProgramPass:
+    """Base pass (framework/ir/pass.h Pass parity): ``apply`` takes a
+    Program and returns it (rewritten in place or replaced)."""
+
+    name = None
+
+    def apply(self, program):
+        raise NotImplementedError
+
+    def __call__(self, program):
+        return self.apply(program)
+
+
+class PassManager:
+    """Ordered pass pipeline (pass_builder.h parity). ``applied``
+    records pass names for inspection/debugging."""
+
+    def __init__(self, passes=()):
+        self.passes = list(passes)
+        self.applied = []
+
+    def add(self, p):
+        self.passes.append(p)
+        return self
+
+    def apply(self, program):
+        for p in self.passes:
+            out = p.apply(program) if hasattr(p, "apply") else p(program)
+            program = out if out is not None else program
+            self.applied.append(getattr(p, "name", None)
+                                or getattr(p, "__name__", None)
+                                or type(p).__name__)
+        return program
+
+
+# -- pattern matching ------------------------------------------------------
+
+def producers(block):
+    """{var name: (op index, op)} of the op that writes each var (last
+    writer wins, matching execution order)."""
+    out = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_names():
+            out[n] = (i, op)
+    return out
+
+
+def consumers(block):
+    """{var name: [(op index, op), ...]} of the ops reading each var."""
+    out = {}
+    for i, op in enumerate(block.ops):
+        for n in op.input_names():
+            out.setdefault(n, []).append((i, op))
+    return out
+
+
+def _matches(op, spec):
+    """spec: an op type string, a tuple of types, or a predicate."""
+    if callable(spec) and not isinstance(spec, str):
+        return bool(spec(op))
+    if isinstance(spec, (tuple, list, set, frozenset)):
+        return op.type in spec
+    return op.type == spec
+
+
+def match_ops(program_or_block, spec):
+    """[(index, op)] of ops matching ``spec`` in the global block (or
+    the given block)."""
+    blk = (program_or_block.global_block()
+           if hasattr(program_or_block, "global_block")
+           else program_or_block)
+    return [(i, op) for i, op in enumerate(blk.ops)
+            if _matches(op, spec)]
+
+
+def match_chain(program_or_block, specs):
+    """Producer->consumer chains (graph_pattern_detector's linked
+    PDNodes): returns a list of op tuples (o1, ..., oN) where each
+    o[k]'s output feeds o[k+1]'s input and o[k+1] matches specs[k+1].
+    A var consumed by several matching ops yields one tuple each."""
+    blk = (program_or_block.global_block()
+           if hasattr(program_or_block, "global_block")
+           else program_or_block)
+    cons = consumers(blk)
+    chains = [(op,) for _, op in match_ops(blk, specs[0])]
+    for spec in specs[1:]:
+        nxt = []
+        for chain in chains:
+            last = chain[-1]
+            seen = set()
+            for n in last.output_names():
+                for _, op in cons.get(n, []):
+                    if id(op) not in seen and _matches(op, spec):
+                        seen.add(id(op))
+                        nxt.append(chain + (op,))
+        chains = nxt
+    return chains
+
+
+def backward_slice(block, target_names, stop_at=(), skip_types=()):
+    """Ops needed (in order) to produce ``target_names``, walking
+    backward from the targets and stopping at ``stop_at`` vars — the
+    reachability core of prune/backward passes (ref: framework/
+    prune.cc). Returns (kept ops list, needed var names set)."""
+    needed = set(target_names)
+    stop = set(stop_at)
+    kept = []
+    for op in reversed(block.ops):
+        if op.type in skip_types:
+            continue
+        if any(n in needed for n in op.output_names()):
+            kept.append(op)
+            needed.update(n for n in op.input_names() if n not in stop)
+    kept.reverse()
+    return kept, needed
+
+
+def extract_subprogram(program, kept_ops, needed_vars, extra_vars=()):
+    """New Program holding copies of ``kept_ops`` and the var table
+    entries they reference (the prune/clone tail every extraction pass
+    repeats). Carries referenced program literals (_constants)."""
+    blk = program.global_block()
+    out = Program()
+    ob = out.global_block()
+    keep = set(needed_vars) | set(extra_vars)
+    for name, var in blk.vars.items():
+        if name in keep:
+            nv = copy.copy(var)
+            nv.block = ob
+            ob.vars[name] = nv
+    for op in kept_ops:
+        new = Operator(ob, op.type, None, None, dict(op.attrs))
+        new.inputs = {k: list(v) for k, v in op.inputs.items()}
+        new.outputs = {k: list(v) for k, v in op.outputs.items()}
+        ob.ops.append(new)
+    consts = getattr(program, "_constants", None)
+    if consts:
+        out._constants = {n: v for n, v in consts.items()
+                          if n in keep}
+    out._bump()
+    return out
+
+
+# -- rewriting -------------------------------------------------------------
+
+class BlockRewriter:
+    """Queued rewrite over a block's op list, committed in one pass —
+    the insert/replace/drop loop every transpiler hand-rolled.
+
+    Usage::
+
+        rw = BlockRewriter(program)
+        for i, op in match_ops(program, "mul"):
+            rw.insert_before(i, new_op)      # or replace(i, ...) etc.
+        rw.commit()                          # rebuilds ops, bumps
+    """
+
+    def __init__(self, program):
+        self.program = program
+        self.block = program.global_block()
+        self._before = {}      # index -> [ops]
+        self._after = {}
+        self._replace = {}     # index -> [ops] ([] means drop)
+
+    def insert_before(self, index, *ops):
+        self._before.setdefault(index, []).extend(ops)
+        return self
+
+    def insert_after(self, index, *ops):
+        self._after.setdefault(index, []).extend(ops)
+        return self
+
+    def replace(self, index, *ops):
+        self._replace[index] = list(ops)
+        return self
+
+    def remove(self, index):
+        self._replace[index] = []
+        return self
+
+    def make_op(self, type, inputs=None, outputs=None, attrs=None):
+        """Operator bound to this block WITHOUT appending (the raw
+        Operator constructor's contract here)."""
+        return Operator(self.block, type, inputs, outputs, attrs)
+
+    def create_var(self, name, shape=None, dtype="float32", **kw):
+        return self.block.create_var(name=name, shape=shape,
+                                     dtype=dtype, **kw)
+
+    def commit(self):
+        n = len(self.block.ops)
+        # insert_before(n) is the natural append form; anything beyond
+        # (or any edit on a nonexistent index) is a pass bug that must
+        # not vanish silently
+        stray = {i for d in (self._before, self._after, self._replace)
+                 for i in d if i > n or (i == n and d is not self._before)}
+        if stray:
+            raise IndexError(
+                f"BlockRewriter: edits queued at out-of-range op "
+                f"indices {sorted(stray)} (block has {n} ops)")
+        new_ops = []
+        for i, op in enumerate(self.block.ops):
+            new_ops.extend(self._before.get(i, ()))
+            new_ops.extend(self._replace.get(i, (op,)))
+            new_ops.extend(self._after.get(i, ()))
+        new_ops.extend(self._before.get(n, ()))
+        self.block.ops = new_ops
+        self._before, self._after, self._replace = {}, {}, {}
+        self.program._bump()
+        return self.program
