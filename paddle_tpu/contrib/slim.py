@@ -170,3 +170,233 @@ def fsp_distill_loss(student_pair, teacher_pair):
     gs = fsp_matrix(*student_pair)
     gt = fsp_matrix(*teacher_pair)
     return jnp.mean((gs - gt) ** 2)
+
+
+# ---------------------------------------------------------------------------
+# Compressor: epoch-driven compression sessions
+# ---------------------------------------------------------------------------
+class Context:
+    """Mutable session state threaded through strategy callbacks
+    (ref: slim/core/compressor.py Context — epoch counter, graph,
+    eval history; here the functional analogs: params pytree, masks,
+    per-epoch eval results)."""
+
+    def __init__(self, params, optimizer):
+        self.params = params
+        self.optimizer = optimizer
+        self.opt_state = None
+        self.epoch = 0
+        self.masks = None            # active prune masks (pytree)
+        self.loss_wrappers = []      # applied in order around base loss
+        self.eval_history = []
+
+
+class Strategy:
+    """Strategy base (ref: slim/core/strategy.py): callbacks fire by
+    epoch window [start_epoch, end_epoch]."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+
+class PruneStrategy(Strategy):
+    """Scheduled magnitude pruning inside the train loop (ref:
+    slim/prune/prune_strategy.py SensitivePruneStrategy's
+    epoch-scheduled ratio ramp): the prune ratio ramps linearly from 0
+    at ``start_epoch`` to ``target_ratio`` at ``end_epoch``; each epoch
+    recomputes masks at the scheduled ratio and the Compressor
+    re-applies them after every optimizer step (the reference's
+    backup+mask mechanism, functionally)."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=5,
+                 target_ratio=0.5, select=None):
+        super().__init__(start_epoch, end_epoch)
+        self.target_ratio = target_ratio
+        self.select = select or (lambda kp, w: getattr(w, "ndim", 0) >= 2)
+        self.pruner = pruner
+        self.ratios = []
+
+    def _ratio_at(self, epoch):
+        if epoch < self.start_epoch:
+            return 0.0
+        span = max(self.end_epoch - self.start_epoch, 1)
+        frac = min((epoch - self.start_epoch) / span, 1.0)
+        return self.target_ratio * frac
+
+    def on_epoch_begin(self, context):
+        ratio = self._ratio_at(context.epoch)
+        self.ratios.append(ratio)
+        if ratio <= 0.0:
+            return
+        if self.pruner is not None:
+            # honor the user's Pruner config (structured/axis/select)
+            # at this epoch's scheduled ratio
+            self.pruner.ratio = ratio
+            mine = self.pruner.compute_masks(context.params)
+        else:
+            def mask_one(kp, w):
+                if getattr(w, "ndim", 0) >= 2 and self.select(kp, w):
+                    return magnitude_prune_mask(np.asarray(w), ratio)
+                return None         # unselected: no mask (None leaf)
+            mine = jax.tree_util.tree_map_with_path(
+                mask_one, context.params)
+        # MERGE with masks other strategies may have installed this
+        # epoch (two windows pruning different param subsets compose);
+        # None means unmasked on either side
+        if context.masks is None:
+            context.masks = mine
+        else:
+            def merge(old, new):
+                if old is None:
+                    return new
+                if new is None:
+                    return old
+                return old * new
+            context.masks = jax.tree.map(
+                merge, context.masks, mine,
+                is_leaf=lambda x: x is None)
+        context.params = apply_masks(context.params, context.masks)
+
+
+class DistillationStrategy(Strategy):
+    """Teacher-student distillation window (ref: slim/distillation/
+    distillation_strategy.py + distiller.py): within
+    [start_epoch, end_epoch) the train loss becomes
+    base + distill_weight * distill(student_logits, teacher_logits).
+    ``teacher_fn(batch) -> teacher outputs`` runs OUTSIDE the grad
+    (stop-gradient teacher, like the reference's merged frozen teacher
+    graph); ``distill_loss(student_out, teacher_out)`` defaults to
+    soft-label distillation."""
+
+    def __init__(self, teacher_fn, student_out_fn, start_epoch=0,
+                 end_epoch=1000, distill_loss=None, distill_weight=1.0):
+        super().__init__(start_epoch, end_epoch)
+        self.teacher_fn = teacher_fn
+        self.student_out_fn = student_out_fn
+        self.distill_loss = distill_loss or soft_label_distill_loss
+        self.distill_weight = distill_weight
+        self._active = False
+
+    def on_compression_begin(self, context):
+        strategy = self
+
+        def wrap(base_loss_fn):
+            def loss_fn(params, batch):
+                loss = base_loss_fn(params, batch)
+                if not strategy._active:
+                    return loss
+                t_out = jax.lax.stop_gradient(strategy.teacher_fn(batch))
+                s_out = strategy.student_out_fn(params, batch)
+                return loss + strategy.distill_weight * \
+                    strategy.distill_loss(s_out, t_out)
+            return loss_fn
+        context.loss_wrappers.append(wrap)
+
+    def on_epoch_begin(self, context):
+        self._active = (self.start_epoch <= context.epoch
+                        < self.end_epoch)
+
+
+class Compressor:
+    """Config-driven compression session (ref: slim/core/compressor.py
+    Compressor.run): an epoch loop owning the jitted train step, with
+    strategies hooked at compression/epoch boundaries. Functional
+    eager tier: ``loss_fn(params, batch) -> scalar`` and
+    ``batches()`` (a fresh iterator per epoch) define training;
+    ``eval_fn(params) -> float`` records per-epoch metrics.
+
+    Pruning strategies set ``context.masks``; the step re-applies them
+    after every optimizer update so pruned weights stay exactly zero
+    (the reference re-masks via its backup mechanism). Distillation
+    strategies wrap the loss. ``run()`` returns (params, context).
+    """
+
+    def __init__(self, params, optimizer, loss_fn, batches, eval_fn=None,
+                 strategies=(), epochs=1):
+        self.params = params
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.batches = batches
+        self.eval_fn = eval_fn
+        self.strategies = list(strategies)
+        self.epochs = epochs
+
+    def add_strategy(self, s):
+        self.strategies.append(s)
+        return self
+
+    def _make_step(self, loss_fn, masked):
+        opt = self.optimizer
+        if masked:
+            @jax.jit
+            def step(params, opt_state, masks, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                params, opt_state = opt.apply_gradients(params, grads,
+                                                        opt_state)
+                return loss, apply_masks(params, masks), opt_state
+        else:
+            @jax.jit
+            def step(params, opt_state, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                params, opt_state = opt.apply_gradients(params, grads,
+                                                        opt_state)
+                return loss, params, opt_state
+        return step
+
+    def run(self):
+        ctx = Context(self.params, self.optimizer)
+        for s in self.strategies:
+            s.on_compression_begin(ctx)
+        base_loss = self.loss_fn
+        for wrap in ctx.loss_wrappers:
+            base_loss = wrap(base_loss)
+        ctx.opt_state = self.optimizer.init(ctx.params)
+        # strategy activation flags (e.g. a distillation window) are
+        # Python state the traced loss closes over — steps are cached
+        # PER activation signature so a flag flip retraces instead of
+        # silently running the stale trace
+        step_cache = {}
+        for epoch in range(self.epochs):
+            ctx.epoch = epoch
+            for s in self.strategies:
+                s.on_epoch_begin(ctx)
+            # keyed by POSITION, not sorted: two strategies of one
+            # class with swapped activation states must not collide
+            key = (tuple(bool(getattr(s, "_active", False))
+                         for s in self.strategies),
+                   ctx.masks is not None)
+            if key not in step_cache:
+                step_cache[key] = self._make_step(base_loss,
+                                                  ctx.masks is not None)
+            step = step_cache[key]
+            for batch in self.batches():
+                if ctx.masks is None:
+                    loss, ctx.params, ctx.opt_state = step(
+                        ctx.params, ctx.opt_state, batch)
+                else:
+                    loss, ctx.params, ctx.opt_state = step(
+                        ctx.params, ctx.opt_state, ctx.masks, batch)
+            for s in self.strategies:
+                s.on_epoch_end(ctx)
+            if self.eval_fn is not None:
+                ctx.eval_history.append(float(self.eval_fn(ctx.params)))
+        for s in self.strategies:
+            s.on_compression_end(ctx)
+        return ctx.params, ctx
+
+
+__all__ += ["Context", "Strategy", "PruneStrategy",
+            "DistillationStrategy", "Compressor"]
