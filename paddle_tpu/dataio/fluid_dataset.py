@@ -11,12 +11,13 @@ TPU-first shape: file reading/shuffling runs in the native C++ pipeline
 pool); parsed samples batch into dense padded arrays (LoD → padding) and
 feed the SAME compiled program the feed/fetch path uses — the per-thread
 hogwild loop (hogwild_worker.cc) collapses into batched device compute.
-global_shuffle hash-partitions samples by trainer id, mirroring
-Dataset::GlobalShuffle's trainer-to-trainer exchange without the RPC hop
-(in-process trainers see disjoint hash buckets).
+global_shuffle redistributes samples ACROSS trainer processes over the
+wire protocol when the fleet has trainer endpoints (the
+Dataset::GlobalShuffle trainer-to-trainer exchange,
+dataio/sample_exchange.py), and hash-partitions locally otherwise.
 """
 
-import hashlib
+import logging
 
 import numpy as np
 
@@ -181,23 +182,46 @@ class InMemoryDataset(_DatasetBase):
         np.random.RandomState(seed).shuffle(self._samples)
 
     def global_shuffle(self, fleet=None, thread_num=None, seed=0):
-        """Hash-partition samples to this trainer then shuffle
-        (Dataset::GlobalShuffle data_set.h:92: every trainer ends with a
-        disjoint, hash-determined subset). The hash keys on sample
-        *content*, not load position — the threaded loader's line order
-        is nondeterministic, and all trainers must agree on which bucket
-        a sample belongs to."""
+        """Redistribute samples across trainers by content hash, then
+        shuffle locally (Dataset::GlobalShuffle data_set.h:82-92).
+
+        With a fleet whose trainers have real endpoints
+        (PADDLE_TRAINER_ENDPOINTS, the launcher's contract), samples
+        are EXCHANGED over the wire protocol — each trainer ships every
+        sample it loaded to the hash-owning trainer and collects its
+        own (the reference's trainer-to-trainer SendRequest path in
+        data_set.cc GlobalShuffle). Without endpoints (single process /
+        pre-partitioned filelists) it falls back to hash-partitioning
+        the locally loaded lines, which matches the reference's
+        OUTCOME when every trainer loaded the full dataset. The hash
+        keys on sample content, not load position — the threaded
+        loader's line order is nondeterministic, and all trainers must
+        agree on ownership."""
+        endpoints = []
         if fleet is not None:
             self._trainer_id = fleet.worker_index()
             self._trainer_num = fleet.worker_num()
-        if self._trainer_num > 1:
-            keep = []
-            for s in self._samples:
-                key = b"|".join(a.tobytes() for a in s)
-                h = int(hashlib.md5(key).hexdigest(), 16)
-                if h % self._trainer_num == self._trainer_id:
-                    keep.append(s)
-            self._samples = keep
+            eps = fleet.worker_endpoints()
+            if len(eps) == self._trainer_num and self._trainer_num > 1:
+                endpoints = eps
+            elif eps and self._trainer_num > 1:
+                logging.getLogger(__name__).warning(
+                    "global_shuffle: %d trainer endpoints for %d "
+                    "workers — falling back to local hash "
+                    "partitioning, which DROPS non-owned samples "
+                    "(correct only when every trainer loaded the full "
+                    "dataset)", len(eps), self._trainer_num)
+        if endpoints:
+            from paddle_tpu.dataio.sample_exchange import \
+                exchange_samples
+            self._samples = exchange_samples(
+                self._samples, endpoints, self._trainer_id)
+        elif self._trainer_num > 1:
+            from paddle_tpu.dataio.sample_exchange import sample_hash
+            self._samples = [
+                s for s in self._samples
+                if sample_hash(s) % self._trainer_num
+                == self._trainer_id]
         self.local_shuffle(seed)
 
     def release_memory(self):

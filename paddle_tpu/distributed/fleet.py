@@ -127,6 +127,11 @@ class _Fleet:
     def worker_num(self):
         return self._role_maker.worker_num() if self._role_maker else 1
 
+    def worker_endpoints(self):
+        if self._role_maker is None:
+            return []
+        return self._role_maker.worker_endpoints()
+
     def distributed_optimizer(self, optimizer, strategy=None, **kw):
         self._strategy = strategy or DistributedStrategy()
         return DistributedOptimizer(optimizer, self._strategy, **kw)

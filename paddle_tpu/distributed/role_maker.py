@@ -44,6 +44,13 @@ class RoleMakerBase:
     def worker_num(self):
         return self._worker_num
 
+    def worker_endpoints(self):
+        """Trainer endpoints — the addresses global_shuffle's sample
+        exchange and other trainer-to-trainer traffic ride. Populated
+        by generate_role (env-driven role makers) or the constructor;
+        role makers with no endpoint wiring return []."""
+        return list(getattr(self, "_worker_endpoints", []))
+
     def get_pserver_endpoints(self):
         return self._server_endpoints
 
@@ -59,6 +66,10 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         self.is_collective = is_collective
 
     def generate_role(self):
+        # trainer endpoints ride the launcher's env contract in both
+        # modes (launch.py wires PADDLE_TRAINER_ENDPOINTS)
+        teps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = teps.split(",") if teps else []
         if self.is_collective:
             self._role = Role.WORKER
             self._current_id = int(os.environ.get(

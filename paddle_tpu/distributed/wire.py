@@ -45,6 +45,8 @@ CHECKPOINT_NOTIFY = 6  # dirname
 LIST_VARS = 7          # -
 STOP = 8               # -
 SHRINK_TABLE = 9       # name, max_age u64
+SHUFFLE_PUSH = 10      # from_trainer u64, npz-packed sample blob arr
+SHUFFLE_DONE = 11      # from_trainer u64, sent-count u64
 # responses
 OK = 100               # -
 OK_ARR = 101           # arr
@@ -63,6 +65,8 @@ SCHEMAS = {
     LIST_VARS: (),
     STOP: (),
     SHRINK_TABLE: (STR, U64),
+    SHUFFLE_PUSH: (U64, ARR),
+    SHUFFLE_DONE: (U64, U64),
     OK: (),
     OK_ARR: (ARR,),
     OK_NAMES: (STR, STR),
