@@ -181,7 +181,8 @@ class InMemoryDataset(_DatasetBase):
     def local_shuffle(self, seed=0):
         np.random.RandomState(seed).shuffle(self._samples)
 
-    def global_shuffle(self, fleet=None, thread_num=None, seed=0):
+    def global_shuffle(self, fleet=None, thread_num=None, seed=0,
+                       timeout=120.0):
         """Redistribute samples across trainers by content hash, then
         shuffle locally (Dataset::GlobalShuffle data_set.h:82-92).
 
@@ -212,10 +213,34 @@ class InMemoryDataset(_DatasetBase):
                     "(correct only when every trainer loaded the full "
                     "dataset)", len(eps), self._trainer_num)
         if endpoints:
-            from paddle_tpu.dataio.sample_exchange import \
-                exchange_samples
+            from paddle_tpu.dataio.sample_exchange import (
+                exchange_samples, sample_hash)
             self._samples = exchange_samples(
-                self._samples, endpoints, self._trainer_id)
+                self._samples, endpoints, self._trainer_id,
+                timeout=timeout)
+            # overlap detection: with DISJOINT per-trainer filelists
+            # (the exchange contract, like the reference's split
+            # filelists) the post-exchange set has ~no duplicates; a
+            # full-filelist-on-every-trainer load arrives n_trainers
+            # times over. Only a LARGE duplicate fraction (>1/3) is
+            # treated as that misuse and deduplicated with a warning —
+            # small duplicate counts are legitimate repeated corpus
+            # lines and are kept.
+            seen, uniq = set(), []
+            for s in self._samples:
+                h = sample_hash(s)
+                if h not in seen:
+                    seen.add(h)
+                    uniq.append(s)
+            dups = len(self._samples) - len(uniq)
+            if dups > len(self._samples) / 3:
+                logging.getLogger(__name__).warning(
+                    "global_shuffle: dropped %d duplicate samples "
+                    "after the exchange (of %d) — trainers appear to "
+                    "have loaded overlapping filelists; give each "
+                    "trainer a disjoint shard (dataset.common.split / "
+                    "cluster_files_reader)", dups, len(self._samples))
+                self._samples = uniq
         elif self._trainer_num > 1:
             from paddle_tpu.dataio.sample_exchange import sample_hash
             self._samples = [

@@ -47,28 +47,12 @@ def _unpack(blob):
 
 
 def _send_frame(sock, kind, fields):
-    parts = [memoryview(p).cast("B")
-             for p in wire.encode_parts(kind, fields)]
-    for p in parts:
-        sock.sendall(p)
-
-
-def _recv_exact(sock, n):
-    buf = np.empty(n, np.uint8)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:])
-        if not r:
-            raise ConnectionError("peer closed")
-        got += r
-    return buf.data
+    wire.send_frame(sock, kind, fields)
 
 
 def _recv_frame(sock):
-    kind, _, _, n = wire.decode_header(
-        _recv_exact(sock, wire.HEADER_SIZE))
-    return kind, wire.decode_payload(kind, _recv_exact(sock, n))
+    kind, _, _, fields = wire.recv_frame(sock)
+    return kind, fields
 
 
 class _Listener:

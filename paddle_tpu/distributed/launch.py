@@ -118,6 +118,12 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     ports = (find_free_ports(server_num, host) if started_port is None
              else list(range(started_port, started_port + server_num)))
     server_eps = ",".join(f"{host}:{p}" for p in ports)
+    # trainers also get their own endpoints: trainer-to-trainer traffic
+    # (global_shuffle's sample exchange) rides these in PS mode too
+    wports = (find_free_ports(worker_num, host) if started_port is None
+              else list(range(started_port + server_num,
+                              started_port + server_num + worker_num)))
+    worker_eps = ",".join(f"{host}:{p}" for p in wports)
     procs, logs = {}, []
     for i in range(server_num):
         env = dict(os.environ, **(env_extra or {}))
@@ -139,6 +145,8 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
             "PADDLE_TRAINER_ID": str(i),
             "PADDLE_TRAINERS_NUM": str(worker_num),
             "PADDLE_PSERVER_ENDPOINTS": server_eps,
+            "PADDLE_CURRENT_ENDPOINT": f"{host}:{wports[i]}",
+            "PADDLE_TRAINER_ENDPOINTS": worker_eps,
         })
         p, f = _spawn([sys.executable, "-u"] + script_args, env,
                       f"workerlog.{i}", log_dir)

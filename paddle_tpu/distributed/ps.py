@@ -47,45 +47,10 @@ from paddle_tpu.distributed import wire
 __all__ = ["ParameterServer", "PSClient", "Communicator", "run_pserver"]
 
 
-def _recv_exact(sock, n):
-    """Read exactly n bytes into a preallocated buffer (recv_into is
-    ~3x the bytearray-extend pattern at 64 MB on loopback). The buffer
-    is an UNINITIALIZED np.empty, not bytearray(n): bytearray zeroes
-    its memory, a full extra pass over a 64 MB frame that recv_into
-    immediately overwrites (measured ~50 ms/req on a 1.3 GB/s-memcpy
-    host)."""
-    buf = np.empty(n, np.uint8)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:])
-        if not r:
-            raise ConnectionError("peer closed")
-        got += r
-    return buf.data
-
-
-def _send_frame(sock, kind, fields, client_id=0, seq=0):
-    # writev via sendmsg: large array payloads go out zero-copy
-    parts = [memoryview(p).cast("B")
-             for p in wire.encode_parts(kind, fields, client_id, seq)]
-    while parts:
-        sent = sock.sendmsg(parts)
-        while parts and sent >= len(parts[0]):
-            sent -= len(parts[0])
-            parts.pop(0)
-        if parts and sent:
-            parts[0] = parts[0][sent:]
-
-
-def _recv_frame(sock):
-    """Read one validated frame: (kind, client_id, seq, fields).
-    Raises wire.WireError on malformed bytes — NOTHING from the socket
-    is ever evaluated, only fixed-schema fields are decoded."""
-    kind, client_id, seq, n = wire.decode_header(
-        _recv_exact(sock, wire.HEADER_SIZE))
-    fields = wire.decode_payload(kind, _recv_exact(sock, n))
-    return kind, client_id, seq, fields
+# framing delegates to the single shared implementation in wire.py
+_recv_exact = wire.recv_exact
+_send_frame = wire.send_frame
+_recv_frame = wire.recv_frame
 
 
 class _DenseVar:

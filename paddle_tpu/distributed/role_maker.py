@@ -66,8 +66,10 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         self.is_collective = is_collective
 
     def generate_role(self):
-        # trainer endpoints ride the launcher's env contract in both
-        # modes (launch.py wires PADDLE_TRAINER_ENDPOINTS)
+        # trainer endpoints ride the launcher's env contract
+        # (launch.py wires PADDLE_TRAINER_ENDPOINTS in collective AND
+        # ps mode — trainer-to-trainer traffic like global_shuffle's
+        # sample exchange needs them in both)
         teps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
         self._worker_endpoints = teps.split(",") if teps else []
         if self.is_collective:

@@ -259,3 +259,45 @@ def decode_payload(kind, payload):
 
 
 HEADER_SIZE = _HDR.size
+
+
+# -- shared socket framing (one implementation for every wire user) -------
+
+def send_frame(sock, kind, fields, client_id=0, seq=0):
+    """writev via sendmsg: large array payloads go out zero-copy."""
+    parts = [memoryview(p).cast("B")
+             for p in encode_parts(kind, fields, client_id, seq)]
+    while parts:
+        sent = sock.sendmsg(parts)
+        while parts and sent >= len(parts[0]):
+            sent -= len(parts[0])
+            parts.pop(0)
+        if parts and sent:
+            parts[0] = parts[0][sent:]
+
+
+def recv_exact(sock, n):
+    """Read exactly n bytes into a preallocated buffer. The buffer is
+    an UNINITIALIZED np.empty, not bytearray(n): bytearray zeroes its
+    memory, a full extra pass over a 64 MB frame that recv_into
+    immediately overwrites."""
+    import numpy as _np
+    buf = _np.empty(n, _np.uint8)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
+            raise ConnectionError("peer closed")
+        got += r
+    return buf.data
+
+
+def recv_frame(sock):
+    """Read one validated frame: (kind, client_id, seq, fields).
+    Raises WireError on malformed bytes — NOTHING from the socket is
+    ever evaluated, only fixed-schema fields are decoded."""
+    kind, client_id, seq, n = decode_header(
+        recv_exact(sock, HEADER_SIZE))
+    fields = decode_payload(kind, recv_exact(sock, n))
+    return kind, client_id, seq, fields

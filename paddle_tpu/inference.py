@@ -425,6 +425,24 @@ class Predictor:
         self._aot_loaded[h] = loaded
         return loaded
 
+    # -- multi-thread serving (AnalysisPredictor::Clone parity) ------------
+    def clone(self):
+        """A predictor sharing this one's loaded weights, program,
+        executor compile cache and AOT executables, but owning its
+        per-request feed/fetch state — the multi-thread serving
+        contract (ref: inference/api/analysis_predictor.h:46 Clone:
+        'Create a new predictor sharing the weights'). One clone per
+        serving thread; run() on different clones is concurrency-safe
+        because the shared pieces are read-only after load and XLA
+        executable invocation is thread-safe, while the mutable
+        request state (_feeds/_outputs and the zero-copy handles bound
+        to them) is per-clone."""
+        c = object.__new__(Predictor)
+        c.__dict__.update(self.__dict__)
+        c._feeds = {}
+        c._outputs = {}
+        return c
+
     # -- introspection (AnalysisPredictor::GetInputNames parity) -----------
     def get_input_names(self):
         return list(self._feed_names)

@@ -175,3 +175,78 @@ class TestEagerModelZooParity:
         a = np.asarray(fwd(params, batch["input_ids"]), np.float32)
         b = np.asarray(fwd(params, batch["input_ids"]), np.float32)
         np.testing.assert_array_equal(a, b)
+
+
+class TestPredictorClone:
+    """AnalysisPredictor::Clone parity: clones share weights +
+    compiled executables and serve concurrently from threads."""
+
+    def _save_model(self, tmp_path):
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+        from paddle_tpu.framework import unique_name
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [8], dtype="float32")
+            out = layers.fc(layers.fc(x, 16, act="relu"), 4,
+                            act="softmax")
+        scope = pt.static.Scope()
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            pt.io.save_inference_model(str(tmp_path), ["x"], [out],
+                                       exe, main_program=main)
+        return str(tmp_path)
+
+    def test_clone_shares_weights_and_serves_concurrently(self, tmp_path):
+        import threading
+        from paddle_tpu.inference import Config, create_predictor
+        model_dir = self._save_model(tmp_path / "m")
+        base = create_predictor(Config(model_dir))
+        rng = np.random.RandomState(0)
+        inputs = [rng.rand(4, 8).astype(np.float32) for _ in range(6)]
+        want = [np.asarray(base.run({"x": x})[0]) for x in inputs]
+
+        clones = [base.clone() for _ in range(3)]
+        # shared: scope (weights), program, executor cache
+        for c in clones:
+            assert c._scope is base._scope
+            assert c._program is base._program
+            assert c._exe is base._exe
+            assert c._feeds is not base._feeds
+        results = {}
+        errors = []
+
+        def serve(tid, c):
+            try:
+                outs = []
+                for i in range(tid, len(inputs), 3):
+                    outs.append((i, np.asarray(c.run(
+                        {"x": inputs[i]})[0])))
+                results[tid] = outs
+            except Exception as e:      # pragma: no cover
+                errors.append(e)
+
+        ts = [threading.Thread(target=serve, args=(t, c))
+              for t, c in enumerate(clones)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errors, errors
+        for tid, outs in results.items():
+            for i, got in outs:
+                np.testing.assert_allclose(got, want[i], rtol=1e-5)
+
+    def test_clone_request_state_isolated(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        model_dir = self._save_model(tmp_path / "m2")
+        base = create_predictor(Config(model_dir))
+        c = base.clone()
+        x1 = np.ones((2, 8), np.float32)
+        x2 = np.zeros((3, 8), np.float32)
+        base.get_input_handle("x").copy_from_cpu(x1)
+        c.get_input_handle("x").copy_from_cpu(x2)
+        o1 = np.asarray(base.run()[0])
+        o2 = np.asarray(c.run()[0])
+        assert o1.shape[0] == 2 and o2.shape[0] == 3
