@@ -273,7 +273,24 @@ class QueueDataset(_DatasetBase):
                            "use InMemoryDataset")
 
     def __iter__(self):
-        return self._batches_from(
+        # full C++ pipeline when possible: threaded read + MultiSlot
+        # parse + zero-padded batch assembly in native code (the
+        # MultiSlotDataFeed worker path, data_feed.cc), one Python call
+        # per batch; custom pipe commands keep the Python path
+        if (self._parse_fn is None and self.slots
+                and _native.available()):
+            enforce(bool(self.filelist), "set_filelist first")
+            batcher = _native.NativeBatcher(
+                self.filelist, self.slots, self.batch_size,
+                read_threads=max(self.thread_num // 2, 1),
+                parse_threads=self.thread_num,
+                drop_last=self.drop_last)
+            try:
+                yield from batcher
+            finally:
+                batcher.close()
+            return
+        yield from self._batches_from(
             self._parse(ln) for ln in self._iter_lines() if ln.strip())
 
 

@@ -16,7 +16,7 @@ import threading
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _SOURCES = ["recordio.cc", "data_pipeline.cc", "arena.cc", "strings.cc",
-            "ps_table.cc"]
+            "ps_table.cc", "batcher.cc"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None
@@ -142,6 +142,18 @@ def _bind(lib):
               lib.pt_dense_adam, lib.pt_dense_accum,
               lib.pt_dense_l2_decay, lib.pt_dense_l1_decay):
         f.restype = None
+    lib.pt_batcher_create.restype = c_void_p
+    lib.pt_batcher_create.argtypes = [
+        ctypes.POINTER(c_char_p), c_int, c_int, c_int, c_long, c_long,
+        c_long, c_int, c_int, ctypes.POINTER(ctypes.c_byte), c_int,
+        c_long, c_int]
+    lib.pt_batcher_next.restype = c_long
+    lib.pt_batcher_next.argtypes = [c_void_p, c_long_p, c_long_p]
+    lib.pt_batcher_fill.restype = c_int
+    lib.pt_batcher_fill.argtypes = [c_void_p, c_int, c_void_p]
+    lib.pt_batcher_error.restype = c_char_p
+    lib.pt_batcher_error.argtypes = [c_void_p]
+    lib.pt_batcher_close.argtypes = [c_void_p]
     return lib
 
 
@@ -508,3 +520,66 @@ class NativeSparseTable:
             self._lib.pt_ps_table_free(self._h)
         except Exception:
             pass
+
+
+class NativeBatcher:
+    """Threaded read -> C++ MultiSlot parse -> zero-padded batch
+    assembly (the MultiSlotDataFeed worker pipeline, data_feed.cc
+    ReadThread + PutToFeedVec, in C++). Yields {name: array} batches —
+    one ctypes round-trip per BATCH, with reading, parsing and
+    consumption overlapped across threads."""
+
+    def __init__(self, files, slots, batch_size, read_threads=1,
+                 parse_threads=2, queue_capacity=4096, shuffle_buffer=0,
+                 seed=0, epochs=1, mode="lines", drop_last=True):
+        self._lib = get_lib()
+        self.slots = list(slots)             # [(name, dtype_str)]
+        self._is_int = [1 if dt in ("int64", "int32") else 0
+                        for _n, dt in self.slots]
+        enc = [os.fsencode(f) for f in files]
+        arr = (ctypes.c_char_p * len(enc))(*enc)
+        flags = (ctypes.c_byte * len(self._is_int))(*self._is_int)
+        self._h = self._lib.pt_batcher_create(
+            arr, len(enc), read_threads, parse_threads, queue_capacity,
+            shuffle_buffer, seed, epochs,
+            {"lines": 0, "recordio": 1}[mode], flags,
+            len(self.slots), batch_size, 1 if drop_last else 0)
+        if not self._h:
+            raise IOError(_last_error(self._lib))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import numpy as np
+        if self._h is None:
+            raise StopIteration
+        rows = ctypes.c_long()
+        maxlens = (ctypes.c_long * len(self.slots))()
+        rc = self._lib.pt_batcher_next(self._h, ctypes.byref(rows),
+                                       maxlens)
+        if rc == -1:
+            raise IOError(
+                self._lib.pt_batcher_error(self._h).decode(
+                    "utf-8", "replace"))
+        if rc == 0:
+            raise StopIteration
+        batch = {}
+        for k, (name, dt) in enumerate(self.slots):
+            dtype = np.int64 if self._is_int[k] else np.float32
+            out = np.empty((rows.value, maxlens[k]), dtype)
+            self._lib.pt_batcher_fill(
+                self._h, k, out.ctypes.data_as(ctypes.c_void_p))
+            batch[name] = out
+        return batch
+
+    def close(self):
+        if self._h is not None:
+            self._lib.pt_batcher_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

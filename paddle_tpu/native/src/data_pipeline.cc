@@ -244,6 +244,14 @@ long pt_loader_queue_size(void* lp) {
   return static_cast<long>(static_cast<Loader*>(lp)->queue.Size());
 }
 
+// Close the queue WITHOUT destroying the loader: wakes every blocked
+// producer and consumer. Consumers layered on top (batcher.cc) call
+// this, join their own threads, then pt_loader_close — the Loader must
+// outlive every thread still inside pt_loader_next.
+void pt_loader_stop(void* lp) {
+  static_cast<Loader*>(lp)->queue.Close();
+}
+
 void pt_loader_close(void* lp) {
   auto* L = static_cast<Loader*>(lp);
   L->queue.Close();
