@@ -583,3 +583,9 @@ class NativeBatcher:
 
     def __exit__(self, *a):
         self.close()
+
+    def __del__(self):          # safety net: joins threads, frees C++
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -30,6 +30,8 @@
 #include <thread>
 #include <vector>
 
+#include "enforce.h"
+
 extern "C" {
 void* pt_loader_create(const char** files, int nfiles, int nthreads,
                        long queue_cap, long shuffle_buf, long seed,
@@ -162,17 +164,20 @@ void parser_main(Batcher* B) {
     s.sizes.assign(sizes.begin(), sizes.end());
     s.f.resize(B->nslots);
     s.i.resize(B->nslots);
-    long foff = 0, ioff = 0;
+    // pt_parse_multislot writes BOTH buffers at one GLOBAL offset
+    // (fout[total+i]/iout[total+i] share the accumulated `total`
+    // across all slots) — unpack with the same single offset, exactly
+    // like the Python wrapper (native/__init__.py parse_multislot)
+    long off = 0;
     for (long k = 0; k < B->nslots; ++k) {
       if (B->is_int[k]) {
-        s.i[k].assign(ibuf.begin() + ioff,
-                      ibuf.begin() + ioff + sizes[k]);
-        ioff += sizes[k];
+        s.i[k].assign(ibuf.begin() + off,
+                      ibuf.begin() + off + sizes[k]);
       } else {
-        s.f[k].assign(fbuf.begin() + foff,
-                      fbuf.begin() + foff + sizes[k]);
-        foff += sizes[k];
+        s.f[k].assign(fbuf.begin() + off,
+                      fbuf.begin() + off + sizes[k]);
       }
+      off += sizes[k];
     }
     if (!B->queue.Push(std::move(s))) break;
   }
@@ -189,7 +194,11 @@ void* pt_batcher_create(const char** files, int nfiles,
                         int epochs, int mode,
                         const signed char* is_int, int nslots,
                         long batch_size, int drop_last) {
-  if (nfiles <= 0 || nslots <= 0 || batch_size <= 0) return nullptr;
+  if (nfiles <= 0 || nslots <= 0 || batch_size <= 0) {
+    pt::set_error(
+        "batcher: need nfiles > 0, nslots > 0, batch_size > 0");
+    return nullptr;
+  }
   void* loader = pt_loader_create(files, nfiles,
                                   read_threads > 0 ? read_threads : 1,
                                   queue_cap > 0 ? queue_cap : 1024,

@@ -32,6 +32,15 @@ void* pt_arena_create(long total_bytes, long min_block);
 void* pt_arena_alloc(void* arena, long nbytes);
 void* pt_ps_table_new(int dim, int optimizer, float lr, float eps,
                       unsigned long long seed);
+void* pt_batcher_create(const char** files, int nfiles, int read_threads,
+                        int parse_threads, long queue_cap, long shuffle_buf,
+                        long seed, int epochs, int mode,
+                        const signed char* is_int, int nslots,
+                        long batch_size, int drop_last);
+long pt_batcher_next(void* h, long* rows, long* maxlens);
+int pt_batcher_fill(void* h, int slot, void* dst);
+const char* pt_batcher_error(void* h);
+void pt_batcher_close(void* h);
 void pt_ps_table_free(void* h);
 long pt_ps_table_size(void* h);
 void pt_ps_table_pull(void* h, const long long* ids, long n, float* out);
@@ -84,6 +93,42 @@ int main(int argc, char** argv) {
     return 1;
   }
   pt_loader_close(ld);
+
+  // ---- batcher: 2 read + 3 parse threads; consume a few batches then
+  // abandon mid-stream and close (the early-exit teardown interleaving
+  // that layered pt_loader_stop exists for)
+  {
+    signed char is_int[2] = {0, 1};
+    for (int round = 0; round < 3; ++round) {
+      void* bt = pt_batcher_create(files.data(),
+                                   static_cast<int>(files.size()),
+                                   /*read_threads=*/2,
+                                   /*parse_threads=*/3,
+                                   /*queue_cap=*/64, /*shuffle_buf=*/0,
+                                   /*seed=*/1, /*epochs=*/1, /*mode=*/0,
+                                   is_int, 2, /*batch_size=*/8,
+                                   /*drop_last=*/0);
+      if (!bt) {
+        std::fprintf(stderr, "batcher: %s\n", pt_last_error());
+        return 1;
+      }
+      long rows = 0;
+      long maxlens[2] = {0, 0};
+      // consume only the first 2 batches, then tear down live. The
+      // stress input is NOT MultiSlot text, so rc==-1 (parse error) is
+      // expected — exactly the error-path teardown worth racing; the
+      // close below must still join every thread cleanly.
+      for (int b = 0; b < 2; ++b) {
+        long rc = pt_batcher_next(bt, &rows, maxlens);
+        if (rc <= 0) break;
+        std::vector<float> f(rows * (maxlens[0] > 0 ? maxlens[0] : 1));
+        std::vector<long long> iv(rows * (maxlens[1] > 0 ? maxlens[1] : 1));
+        pt_batcher_fill(bt, 0, f.data());
+        pt_batcher_fill(bt, 1, iv.data());
+      }
+      pt_batcher_close(bt);
+    }
+  }
 
   // ---- arena: 4 threads alloc/free concurrently
   void* ar = pt_arena_create(8L << 20, 64);

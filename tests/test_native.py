@@ -511,7 +511,8 @@ class TestNativeBatcher:
             for _ in range(n):
                 d = " ".join(f"{v:.4f}" for v in rng.rand(4))
                 k = rng.randint(1, 4)
-                ids = " ".join(str(x) for x in rng.randint(0, 100, k))
+                # ids >= 1: zero-padding stays distinguishable
+                ids = " ".join(str(x) for x in rng.randint(1, 100, k))
                 f.write(f"4 {d} {k} {ids}\n")
 
     def test_batches_match_python_parse(self, tmp_path):
@@ -532,6 +533,17 @@ class TestNativeBatcher:
         # threaded order is nondeterministic: compare as multisets
         assert (sorted(map(tuple, np.round(got, 4)))
                 == sorted(map(tuple, np.round(want, 4))))
+        # int slot CONTENTS must match too (regression: the parser
+        # writes both dtype buffers at one global offset — per-kind
+        # offsets read garbage for mixed schemas). _write emits ids
+        # >= 1, so stripping zero padding recovers exact row values.
+        got_ids = sorted(tuple(int(v) for v in row if v != 0)
+                         for x in batches for row in x["ids"])
+        with open(p) as f:
+            want_ids = sorted(tuple(int(v) for v in
+                                    _parse_multislot(l, slots)[1])
+                              for l in f if l.strip())
+        assert got_ids == want_ids
         for x in batches:
             assert x["x"].dtype == np.float32
             assert x["ids"].dtype == np.int64
