@@ -151,6 +151,20 @@ class _DatasetBase:
         else:
             yield from _py_record_iter(self.filelist, epochs=1, mode="lines")
 
+    def _native_batcher(self, batch_size, drop_last):
+        """Configured NativeBatcher for this dataset, or None when the
+        C++ path is ineligible (custom pipe command / no slots / no
+        toolchain). Shared by the streaming iterator and
+        load_into_memory so their tuning cannot drift."""
+        if not (self._parse_fn is None and self.slots
+                and _native.available()):
+            return None
+        enforce(bool(self.filelist), "set_filelist first")
+        return _native.NativeBatcher(
+            self.filelist, self.slots, batch_size,
+            read_threads=max(self.thread_num // 2, 1),
+            parse_threads=self.thread_num, drop_last=drop_last)
+
     def _batches_from(self, sample_iter):
         buf = []
         for s in sample_iter:
@@ -175,6 +189,22 @@ class InMemoryDataset(_DatasetBase):
         self._trainer_num = 1
 
     def load_into_memory(self):
+        # per-sample parse through the C++ pipeline when possible
+        # (batcher with batch_size=1: threaded read + parse, one
+        # ctypes call per sample instead of per line + python parse)
+        batcher = self._native_batcher(batch_size=1, drop_last=False)
+        if batcher is not None:
+            names = [n for n, _ in self.slots]
+            try:
+                self._samples = [tuple(b[n][0] for n in names)
+                                 for b in batcher]
+            except IOError as e:
+                # exception parity with the Python parse path: a
+                # malformed line raises EnforceNotMet on BOTH paths
+                enforce(False, str(e))
+            finally:
+                batcher.close()
+            return
         self._samples = [self._parse(ln) for ln in self._iter_lines()
                          if ln.strip()]
 
@@ -277,16 +307,13 @@ class QueueDataset(_DatasetBase):
         # parse + zero-padded batch assembly in native code (the
         # MultiSlotDataFeed worker path, data_feed.cc), one Python call
         # per batch; custom pipe commands keep the Python path
-        if (self._parse_fn is None and self.slots
-                and _native.available()):
-            enforce(bool(self.filelist), "set_filelist first")
-            batcher = _native.NativeBatcher(
-                self.filelist, self.slots, self.batch_size,
-                read_threads=max(self.thread_num // 2, 1),
-                parse_threads=self.thread_num,
-                drop_last=self.drop_last)
+        batcher = self._native_batcher(self.batch_size, self.drop_last)
+        if batcher is not None:
             try:
                 yield from batcher
+            except IOError as e:
+                # exception parity with the Python parse path
+                enforce(False, str(e))
             finally:
                 batcher.close()
             return

@@ -220,3 +220,24 @@ class TestGlobalShuffleExchange:
         for tid, res in enumerate(results):
             for s in res:
                 assert sample_hash(s) % 2 == tid
+
+
+class TestNativePathExceptionParity:
+    def test_malformed_line_raises_enforce_not_met(self, tmp_path):
+        """Both parse paths raise EnforceNotMet on malformed lines —
+        caller `except` blocks behave identically with and without the
+        native toolchain."""
+        import paddle_tpu as pt
+        p = tmp_path / "bad.txt"
+        p.write_text("4 0.1 0.2 0.3 0.4 1 7\nnot a multislot line\n")
+        for kind in ("InMemoryDataset", "QueueDataset"):
+            ds = DatasetFactory().create_dataset(kind)
+            ds.set_filelist([str(p)])
+            ds.set_batch_size(2)
+            ds.set_use_var([("x", "float32"), ("ids", "int64")])
+            ds.drop_last = False
+            with pytest.raises(pt.core.EnforceNotMet):
+                if kind == "InMemoryDataset":
+                    ds.load_into_memory()
+                else:
+                    list(ds)
