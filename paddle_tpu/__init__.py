@@ -83,4 +83,4 @@ from paddle_tpu import distributions
 from paddle_tpu import contrib
 from paddle_tpu import inference
 
-__version__ = "0.1.0"
+from paddle_tpu.version import __version__  # noqa: E402

@@ -165,6 +165,18 @@ class _DatasetBase:
             read_threads=max(self.thread_num // 2, 1),
             parse_threads=self.thread_num, drop_last=drop_last)
 
+    def _native_batches(self, batcher):
+        """Iterate a NativeBatcher with the module's exception-parity
+        contract: malformed lines raise EnforceNotMet (as the Python
+        parse path does), teardown always runs. One wrapper for every
+        native consumer so the parity behavior cannot drift."""
+        try:
+            yield from batcher
+        except IOError as e:
+            enforce(False, str(e))
+        finally:
+            batcher.close()
+
     def _batches_from(self, sample_iter):
         buf = []
         for s in sample_iter:
@@ -195,15 +207,8 @@ class InMemoryDataset(_DatasetBase):
         batcher = self._native_batcher(batch_size=1, drop_last=False)
         if batcher is not None:
             names = [n for n, _ in self.slots]
-            try:
-                self._samples = [tuple(b[n][0] for n in names)
-                                 for b in batcher]
-            except IOError as e:
-                # exception parity with the Python parse path: a
-                # malformed line raises EnforceNotMet on BOTH paths
-                enforce(False, str(e))
-            finally:
-                batcher.close()
+            self._samples = [tuple(b[n][0] for n in names)
+                             for b in self._native_batches(batcher)]
             return
         self._samples = [self._parse(ln) for ln in self._iter_lines()
                          if ln.strip()]
@@ -309,13 +314,7 @@ class QueueDataset(_DatasetBase):
         # per batch; custom pipe commands keep the Python path
         batcher = self._native_batcher(self.batch_size, self.drop_last)
         if batcher is not None:
-            try:
-                yield from batcher
-            except IOError as e:
-                # exception parity with the Python parse path
-                enforce(False, str(e))
-            finally:
-                batcher.close()
+            yield from self._native_batches(batcher)
             return
         yield from self._batches_from(
             self._parse(ln) for ln in self._iter_lines() if ln.strip())

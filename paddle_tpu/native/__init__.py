@@ -24,6 +24,10 @@ _build_error = None
 
 def _src_fingerprint():
     h = hashlib.sha256()
+    # platform in the fingerprint: a wheel may ship a .so prebuilt on a
+    # different machine; same-source-different-ABI must not collide
+    import platform
+    h.update(f"{os.uname().sysname}-{platform.machine()}".encode())
     for s in _SOURCES + ["enforce.h"]:
         with open(os.path.join(_SRC_DIR, s), "rb") as f:
             h.update(f.read())
@@ -167,6 +171,16 @@ def get_lib():
             raise _build_error
         try:
             _lib = _bind(ctypes.CDLL(_build()))
+        except OSError:
+            # a shipped/prebuilt .so can be ABI-incompatible with this
+            # host (different glibc/compiler): rebuild locally once
+            try:
+                so = _build()
+                os.remove(so)
+                _lib = _bind(ctypes.CDLL(_build()))
+            except Exception as e:
+                _build_error = RuntimeError(f"native build failed: {e}")
+                raise _build_error
         except Exception as e:  # toolchain missing / build failed
             _build_error = RuntimeError(f"native build failed: {e}")
             raise _build_error

@@ -1,0 +1,13 @@
+"""Version metadata (the fluid/framework commit-stamp analog —
+paddle/fluid/platform/init.cc prints its own; tools/print_signatures
+freezes the API per version)."""
+
+__version__ = "0.4.0"          # bumped per build round
+
+full_version = __version__
+major, minor, patch = (int(x) for x in __version__.split("."))
+
+
+def show():
+    """paddle.version.show() parity."""
+    print(f"paddle-tpu {__version__}")

@@ -376,3 +376,21 @@ class TestDatasetCommonUtils:
             with nat.RecordIOScanner(p) as s:
                 total += sum(1 for _ in s)
         assert total == 6
+
+
+class TestVersionAndPackaging:
+    def test_version_module(self):
+        import paddle_tpu
+        from paddle_tpu import version
+        assert paddle_tpu.__version__ == version.__version__
+        assert (version.major, version.minor, version.patch) == tuple(
+            int(x) for x in version.__version__.split("."))
+        version.show()
+
+    def test_pyproject_declares_native_sources(self):
+        import os
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        text = open(os.path.join(root, "pyproject.toml")).read()
+        assert "src/*.cc" in text          # sources ship in the wheel
+        assert 'attr = "paddle_tpu.version.__version__"' in text
