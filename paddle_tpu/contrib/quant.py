@@ -280,6 +280,25 @@ class QuantizationFreezePass(ProgramPass):
         plans = {}
         for i, op in match_ops(program, tuple(self._REWRITE)):
             plans[i] = self._plan_op(op, blk, scope)
+        # A persistable weight may also feed ops that stay float (an
+        # unplanned matmul, a non-quantizable consumer, a save op):
+        # integer storage in the scope would hand those consumers
+        # ~2^(bits-1)x-magnitude values with no dequantize. A weight
+        # freezes only when EVERY surviving consumer freezes with it.
+        float_read = set()
+        for i, op in enumerate(blk.ops):
+            if op.type == "fake_quantize_dequantize_abs_max":
+                continue              # stripped below, not a consumer
+            plan = plans.get(i)
+            frozen_w = plan[3] if plan is not None else None
+            for names in op.inputs.values():
+                for n in names:
+                    base = self._base(n)
+                    if base != frozen_w:
+                        float_read.add(base)
+        for i, plan in list(plans.items()):
+            if plan is not None and plan[3] in float_read:
+                plans[i] = None       # shared with a float reader
         for i, op in enumerate(blk.ops):
             if op.type == "fake_quantize_dequantize_abs_max":
                 rw.remove(i)          # stripped: scales fold below

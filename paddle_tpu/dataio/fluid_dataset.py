@@ -249,10 +249,14 @@ class InMemoryDataset(_DatasetBase):
                     "dataset)", len(eps), self._trainer_num)
         if endpoints:
             from paddle_tpu.dataio.sample_exchange import (
-                exchange_samples, sample_hash)
+                exchange_samples, resolve_exchange_endpoints,
+                sample_hash)
+            # collective mode's trainer endpoints double as the
+            # jax.distributed rendezvous — bind the launcher's
+            # dedicated exchange ports instead when wired
             self._samples = exchange_samples(
-                self._samples, endpoints, self._trainer_id,
-                timeout=timeout)
+                self._samples, resolve_exchange_endpoints(endpoints),
+                self._trainer_id, timeout=timeout)
             # overlap detection: with DISJOINT per-trainer filelists
             # (the exchange contract, like the reference's split
             # filelists) the post-exchange set has ~no duplicates; a

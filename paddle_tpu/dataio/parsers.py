@@ -466,10 +466,19 @@ def sentiment_word_dict(root):
     return [(w, i) for i, (w, _) in enumerate(ordered)]
 
 
-def sentiment_reader(root, split="train", train_fraction=0.8):
-    """Interleaved neg/pos file stream -> (ids, label 0|1); the
-    reference slices the first NUM_TRAINING_INSTANCES for train
-    (ref: sentiment.py:77-132)."""
+def sentiment_reader(root, split="train", train_fraction=0.8,
+                     seed=2718):
+    """Neg/pos corpus -> (ids, label 0|1) with a randomized
+    train/test split: the reference shuffles the combined corpus
+    (random.shuffle — UNSEEDED, so its membership differs run to run)
+    before slicing the first NUM_TRAINING_INSTANCES for train
+    (ref: sentiment.py:77-132). Here the shuffle uses a FIXED seed:
+    split membership is a random mix like the reference's, but stable
+    across runs and processes (exact membership parity with the
+    reference is impossible by construction — its shuffle is
+    unseeded). Interleaving neg/pos before the shuffle keeps the
+    stream label-balanced for any seed."""
+    import random as _random
     word_ids = dict(sentiment_word_dict(root))
     neg = sorted(os.listdir(os.path.join(root, "neg")))
     pos = sorted(os.listdir(os.path.join(root, "pos")))
@@ -481,6 +490,7 @@ def sentiment_reader(root, split="train", train_fraction=0.8):
         label = 0 if fileid.startswith("neg") else 1
         data.append(([word_ids[w.lower()]
                       for w in _sentiment_words(root, fileid)], label))
+    _random.Random(seed).shuffle(data)
     n_train = int(len(data) * train_fraction)
     part = data[:n_train] if split == "train" else data[n_train:]
 

@@ -12,15 +12,18 @@ collects until every peer's DONE arrived.
 """
 
 import io
+import os
 import socket
 import threading
+import time
 
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.distributed import wire
 
-__all__ = ["exchange_samples", "sample_hash"]
+__all__ = ["exchange_samples", "sample_hash",
+           "resolve_exchange_endpoints"]
 
 _CHUNK = 512            # samples per SHUFFLE_PUSH frame
 
@@ -55,9 +58,30 @@ def _recv_frame(sock):
     return kind, fields
 
 
+def resolve_exchange_endpoints(worker_endpoints):
+    """The endpoints the sample exchange should BIND. In collective
+    mode the trainer endpoints double as the jax.distributed
+    rendezvous (rank 0's is the coordinator — a long-lived bound
+    port), so binding them again would EADDRINUSE; the launcher wires
+    dedicated exchange ports as PADDLE_EXCHANGE_ENDPOINTS (launch.py,
+    both modes eventually — PS mode's worker endpoints are already
+    dedicated). Falls back to the worker endpoints when the env is
+    absent or inconsistent."""
+    env = os.environ.get("PADDLE_EXCHANGE_ENDPOINTS", "")
+    eps = [e for e in env.split(",") if e]
+    if len(eps) == len(worker_endpoints):
+        return eps
+    return list(worker_endpoints)
+
+
 class _Listener:
     """Accept SHUFFLE_PUSH/DONE frames from peer trainers until every
-    expected peer has sent DONE."""
+    expected peer trainer id has delivered SHUFFLE_DONE.
+
+    Completion is counted by DISTINCT trainer ids that sent DONE — not
+    by raw accepted connections: a stray connection (port scanner,
+    health check) or a peer reconnecting after a transient drop must
+    not consume a peer slot and stall the exchange."""
 
     def __init__(self, endpoint, n_peers, timeout=120.0):
         host, port = endpoint.rsplit(":", 1)
@@ -65,78 +89,107 @@ class _Listener:
         self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.srv.bind((host, int(port)))
         self.srv.listen(max(n_peers, 1))
-        self.srv.settimeout(timeout)
+        # short accept timeout: the accept loop re-checks completion
+        # between accepts instead of blocking the full deadline
+        self.srv.settimeout(0.25)
         self.n_peers = n_peers
         self.timeout = timeout
         self.received = []
-        self.counts = {}            # from_trainer -> claimed count
-        self.errors = []
-        self._threads = []
+        self.counts = {}            # from_trainer -> received count
+        self.done_ids = set()       # trainer ids that sent DONE
+        self.errors = []            # fatal: integrity violations
+        self.conn_errors = []       # soft: per-connection transport
         self._lock = threading.Lock()
+        # INACTIVITY deadline, not absolute: steady frame traffic (a
+        # large exchange legitimately outlasting `timeout` wall-clock)
+        # keeps the listener alive; only `timeout`s of silence ends it
+        self._last_activity = time.time()
         self._accept_thread = threading.Thread(target=self._accept,
                                                daemon=True)
         self._accept_thread.start()
 
+    def _touch(self):
+        self._last_activity = time.time()
+
+    def _finished(self):
+        """Accept loop exit condition: all peers DONE, or a fatal
+        integrity error (no point waiting out the timeout on those)."""
+        with self._lock:
+            return (len(self.done_ids) >= self.n_peers
+                    or bool(self.errors))
+
     def _accept(self):
-        done = 0
-        try:
-            while done < self.n_peers:
+        while not self._finished():
+            if time.time() - self._last_activity > self.timeout:
+                return
+            try:
                 conn, _ = self.srv.accept()
-                conn.settimeout(self.timeout)
-                t = threading.Thread(target=self._serve_conn,
-                                     args=(conn,), daemon=True)
-                t.start()
-                self._threads.append(t)
-                done += 1
-        except Exception as e:      # pragma: no cover - timeout path
-            self.errors.append(e)
+            except socket.timeout:
+                continue
+            except OSError:         # pragma: no cover - closed socket
+                return
+            self._touch()
+            conn.settimeout(self.timeout)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
 
     def _serve_conn(self, conn):
+        staged = []                 # pushes buffered until DONE
         try:
             with conn:
                 while True:
                     kind, fields = _recv_frame(conn)
+                    self._touch()
                     if kind == wire.SHUFFLE_PUSH:
-                        _, blob = fields
-                        samples = _unpack(blob)
-                        with self._lock:
-                            self.received.extend(samples)
-                            tid = int(fields[0])
-                            self.counts[tid] = self.counts.get(tid, 0) \
-                                + len(samples)
+                        staged.extend(_unpack(fields[1]))
                     elif kind == wire.SHUFFLE_DONE:
                         tid, total = int(fields[0]), int(fields[1])
                         with self._lock:
-                            got = self.counts.get(tid, 0)
+                            got = self.counts.get(tid, 0) + len(staged)
                             if got != total:
                                 self.errors.append(RuntimeError(
                                     f"trainer {tid} claimed {total} "
                                     f"samples, received {got}"))
-                            self.counts.setdefault(tid, 0)
+                                return
+                            self.received.extend(staged)
+                            self.counts[tid] = got
+                            self.done_ids.add(tid)
                         return
                     else:
                         self.errors.append(RuntimeError(
                             f"unexpected frame kind {kind}"))
                         return
         except Exception as e:
-            self.errors.append(e)
+            # a dropped/garbled connection is only fatal if its peer
+            # never completes (it may reconnect and resend the whole
+            # bucket); its staged pushes die with this frame, so a
+            # resend cannot double-count
+            with self._lock:
+                self.conn_errors.append(e)
 
     def wait(self):
-        self._accept_thread.join(self.timeout)
-        stuck = self._accept_thread.is_alive()
-        for t in self._threads:
-            t.join(self.timeout)
-            stuck = stuck or t.is_alive()
+        # the accept thread exits on completion, fatal error, or
+        # `timeout` of inactivity — join without a cap of our own so
+        # an active transfer extends the wait (progress, not wall
+        # clock, is the liveness signal)
+        while self._accept_thread.is_alive():
+            self._accept_thread.join(1.0)
         self.srv.close()
-        if stuck:
-            # a join timing out means a peer is still mid-transfer —
-            # returning now would hand back a partial (and still
-            # mutating) sample set
+        with self._lock:
+            if self.errors:
+                raise self.errors[0]
+            complete = len(self.done_ids) >= self.n_peers
+        if not complete:
+            err = (f"; first transport error: {self.conn_errors[0]!r}"
+                   if self.conn_errors else "")
             raise TimeoutError(
-                f"sample exchange incomplete after {self.timeout}s: "
-                f"a peer transfer is still in flight")
-        if self.errors:
-            raise self.errors[0]
+                f"sample exchange incomplete after {self.timeout}s of "
+                f"inactivity: {len(self.done_ids)}/{self.n_peers} "
+                f"peers finished (done ids {sorted(self.done_ids)})"
+                f"{err}")
+        # all peers DONE: their serve threads have returned (DONE is
+        # the last frame on the connection); stray connections hold
+        # staged samples only in their own frames, so the set is final
         return self.received
 
 

@@ -92,9 +92,19 @@ def _wait(procs, logs, timeout=None):
 def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                       log_dir=None, env_extra=None, timeout=None):
     host = ips.split(",")[0]
-    ports = (find_free_ports(nproc, host) if started_port is None
-             else list(range(started_port, started_port + nproc)))
+    # trainer endpoints double as the jax.distributed rendezvous in
+    # collective mode (rank 0's is the coordinator, a long-lived bound
+    # port) — trainer-to-trainer traffic like global_shuffle's sample
+    # exchange gets its own dedicated ports, as launch_ps does. One
+    # find_free_ports call for both sets: all 2*nproc sockets are
+    # bound simultaneously, so the sets are guaranteed disjoint.
+    if started_port is None:
+        allp = find_free_ports(2 * nproc, host)
+    else:
+        allp = list(range(started_port, started_port + 2 * nproc))
+    ports, xports = allp[:nproc], allp[nproc:]
     endpoints = ",".join(f"{host}:{p}" for p in ports)
+    exchange_eps = ",".join(f"{host}:{p}" for p in xports)
     procs, logs = {}, []
     for rank in range(nproc):
         env = dict(os.environ, **(env_extra or {}))
@@ -103,6 +113,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
             "PADDLE_TRAINERS_NUM": str(nproc),
             "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[rank]}",
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_EXCHANGE_ENDPOINTS": exchange_eps,
             "TRAINING_ROLE": "TRAINER",
         })
         p, f = _spawn([sys.executable, "-u"] + script_args, env,

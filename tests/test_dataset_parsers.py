@@ -222,8 +222,10 @@ class TestSentiment:
         train = list(dataset.sentiment.train(self.ROOT)())
         test = list(dataset.sentiment.test(self.ROOT)())
         assert len(train) + len(test) == 4
-        # interleaved neg/pos
-        assert [s[1] for s in train] == [0, 1, 0][:len(train)]
+        # randomized split (reference shuffles before slicing) but
+        # FIXED seed: membership is identical on a second read
+        assert train == list(dataset.sentiment.train(self.ROOT)())
+        assert {s[1] for s in train + test} == {0, 1}
         for ids, label in train + test:
             assert label in (0, 1) and all(isinstance(i, int)
                                            for i in ids)

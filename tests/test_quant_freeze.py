@@ -275,6 +275,35 @@ class TestFreezeEdgeCases:
                                        np.asarray(before))
             assert np.asarray(scope.find_var("wf")).dtype == np.float32
 
+    def test_shared_weight_with_float_consumer_stays_float(self):
+        """A weight feeding both a quantizable matmul AND an op that
+        stays float (here a transpose_y=True matmul) must NOT be
+        converted to integer storage — the float consumer would read
+        ~127x-magnitude values with no dequantize (ADVICE r4)."""
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [6], dtype="float32")
+            w = layers.create_parameter([6, 6], "float32",
+                                        name="w_shared")
+            a = layers.matmul(x, w)                   # quantizable
+            b = layers.matmul(x, w, transpose_y=True)  # stays float
+            out = layers.elementwise_add(a, b)
+        scope = pt.static.Scope()
+        feed = {"x": np.ones((2, 6), np.float32)}
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            before, = exe.run(main, feed=feed, fetch_list=[out])
+            QuantizationFreezePass(
+                scope=scope, act_scales={"x": 1.0}).apply(main)
+            types = [op.type for op in main.global_block().ops]
+            assert "quantized_mul" not in types
+            assert np.asarray(
+                scope.find_var("w_shared")).dtype == np.float32
+            after, = exe.run(main, feed=feed, fetch_list=[out])
+            np.testing.assert_allclose(np.asarray(after),
+                                       np.asarray(before))
+
     def test_missing_scale_raises_before_any_mutation(self):
         """A missing calibrated scale must fail BEFORE any weight has
         been converted — no partially-frozen corrupt program."""
