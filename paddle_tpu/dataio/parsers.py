@@ -358,55 +358,70 @@ def conll05_load_label_dict(path):
     return d
 
 
+def _bracket_spans_to_bio(column):
+    """Convert one predicate's props column of bracket annotations
+    ('(A0*' opens a span, '*' continues, '*)' closes, '(V*)' is a
+    one-token span) into BIO tags. Tokens outside any span are 'O';
+    the opening token is 'B-<tag>'; tokens inside (including the
+    closer) are 'I-<tag>'. Anything else in the column is malformed.
+    Behavioral parity with the reference's per-label branch logic
+    (ref: conll05.py:76-147), including the degenerate cases: a '*)'
+    with no open span repeats the most recent span's tag (initially
+    'O')."""
+    bio = []
+    open_span = False             # inside an unclosed bracket?
+    last_tag = "O"                # most recent span tag — STICKY
+    # across closes, so a degenerate '*)' with no open span repeats
+    # the previous tag exactly as the reference automaton does
+    for cell in column:
+        if cell.startswith("("):
+            last_tag = cell[1:cell.index("*")]
+            bio.append("B-" + last_tag)
+            open_span = not cell.endswith(")")
+        elif cell == "*":
+            bio.append("I-" + last_tag if open_span else "O")
+        elif cell == "*)":
+            bio.append("I-" + last_tag)
+            open_span = False
+        else:
+            raise RuntimeError(f"unexpected props cell: {cell!r}")
+    return bio
+
+
 def conll05_corpus_reader(data_path, words_name, props_name):
-    """Parse the CoNLL-2005 column format: words file + props file with
-    '-'-or-verb first column and '(A0*'/'*'/'*)' bracket labels per
-    predicate column. Yields (sentence words, predicate, BIO labels)
-    per predicate (ref: conll05.py:76-147, exact bracket automaton)."""
+    """Parse the CoNLL-2005 column format: a words file (one token per
+    line) zipped against a props file whose first column holds the
+    predicate lemma ('-' for non-predicates) and whose remaining
+    columns carry one bracket annotation per predicate. Rows
+    accumulate until a blank props line, then transpose: column 0
+    lists the sentence's predicates in order, and each later column
+    converts to a BIO sequence via _bracket_spans_to_bio. Yields
+    (tokens, predicate, bio_labels) once per predicate
+    (ref: conll05.py:76-147, same yielded tuples)."""
     def reader():
-        with tarfile.open(data_path) as tf:
-            wf = tf.extractfile(words_name)
-            pf = tf.extractfile(props_name)
-            with gzip.GzipFile(fileobj=wf) as words_file, \
-                    gzip.GzipFile(fileobj=pf) as props_file:
-                sentences, labels, one_seg = [], [], []
-                for word, label in zip(words_file, props_file):
-                    word = word.decode().strip()
-                    label = label.decode().strip().split()
-                    if len(label) == 0:     # sentence boundary
-                        for i in range(len(one_seg[0]) if one_seg
-                                       else 0):
-                            labels.append([x[i] for x in one_seg])
-                        if len(labels) >= 1:
-                            verb_list = [x for x in labels[0]
-                                         if x != "-"]
-                            for i, lbl in enumerate(labels[1:]):
-                                cur_tag, in_bracket = "O", False
-                                lbl_seq = []
-                                for l in lbl:
-                                    if l == "*" and not in_bracket:
-                                        lbl_seq.append("O")
-                                    elif l == "*" and in_bracket:
-                                        lbl_seq.append("I-" + cur_tag)
-                                    elif l == "*)":
-                                        lbl_seq.append("I-" + cur_tag)
-                                        in_bracket = False
-                                    elif "(" in l and ")" in l:
-                                        cur_tag = l[1:l.find("*")]
-                                        lbl_seq.append("B-" + cur_tag)
-                                        in_bracket = False
-                                    elif "(" in l and ")" not in l:
-                                        cur_tag = l[1:l.find("*")]
-                                        lbl_seq.append("B-" + cur_tag)
-                                        in_bracket = True
-                                    else:
-                                        raise RuntimeError(
-                                            f"Unexpected label: {l}")
-                                yield sentences, verb_list[i], lbl_seq
-                        sentences, labels, one_seg = [], [], []
-                    else:
-                        sentences.append(word)
-                        one_seg.append(label)
+        with tarfile.open(data_path) as archive:
+            w_member = archive.extractfile(words_name)
+            p_member = archive.extractfile(props_name)
+            with gzip.GzipFile(fileobj=w_member) as w_stream, \
+                    gzip.GzipFile(fileobj=p_member) as p_stream:
+                tokens, prop_rows = [], []
+                for w_line, p_line in zip(w_stream, p_stream):
+                    cells = p_line.decode().strip().split()
+                    if cells:
+                        tokens.append(w_line.decode().strip())
+                        prop_rows.append(cells)
+                        continue
+                    if prop_rows:   # blank line: sentence boundary
+                        columns = list(zip(*prop_rows))
+                        predicates = [lemma for lemma in columns[0]
+                                      if lemma != "-"]
+                        # predicates[i] (not zip): a corrupt file with
+                        # more annotation columns than predicate
+                        # lemmas must fail loudly, not silently drop
+                        for i, col in enumerate(columns[1:]):
+                            yield (tokens, predicates[i],
+                                   _bracket_spans_to_bio(col))
+                    tokens, prop_rows = [], []
     return reader
 
 

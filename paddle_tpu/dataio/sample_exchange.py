@@ -100,6 +100,7 @@ class _Listener:
         self.errors = []            # fatal: integrity violations
         self.conn_errors = []       # soft: per-connection transport
         self._lock = threading.Lock()
+        self._active_conns = 0      # serve threads currently running
         # INACTIVITY deadline, not absolute: steady frame traffic (a
         # large exchange legitimately outlasting `timeout` wall-clock)
         # keeps the listener alive; only `timeout`s of silence ends it
@@ -120,7 +121,16 @@ class _Listener:
 
     def _accept(self):
         while not self._finished():
-            if time.time() - self._last_activity > self.timeout:
+            # the inactivity clock only advances per COMPLETED frame,
+            # so a single large frame mid-transfer must not trip it:
+            # while any serve thread runs, its socket's own timeout
+            # (recv raises after `timeout` of zero bytes) is the
+            # liveness bound, and the thread's exit re-checks here
+            with self._lock:
+                quiet = (self._active_conns == 0
+                         and time.time() - self._last_activity
+                         > self.timeout)
+            if quiet:
                 return
             try:
                 conn, _ = self.srv.accept()
@@ -135,6 +145,8 @@ class _Listener:
 
     def _serve_conn(self, conn):
         staged = []                 # pushes buffered until DONE
+        with self._lock:
+            self._active_conns += 1
         try:
             with conn:
                 while True:
@@ -166,6 +178,10 @@ class _Listener:
             # resend cannot double-count
             with self._lock:
                 self.conn_errors.append(e)
+        finally:
+            with self._lock:
+                self._active_conns -= 1
+            self._touch()       # thread exit restarts the quiet clock
 
     def wait(self):
         # the accept thread exits on completion, fatal error, or

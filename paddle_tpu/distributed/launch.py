@@ -98,6 +98,9 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     # exchange gets its own dedicated ports, as launch_ps does. One
     # find_free_ports call for both sets: all 2*nproc sockets are
     # bound simultaneously, so the sets are guaranteed disjoint.
+    # NOTE: with an explicit started_port the claimed range is
+    # 2*nproc consecutive ports (trainers, then exchange) — see the
+    # --started_port help text.
     if started_port is None:
         allp = find_free_ports(2 * nproc, host)
     else:
@@ -174,7 +177,11 @@ def _parse_args(argv):
                     help="collective mode: trainers on this node "
                          "(default: local device count)")
     ap.add_argument("--ips", default="127.0.0.1")
-    ap.add_argument("--started_port", type=int, default=None)
+    ap.add_argument("--started_port", type=int, default=None,
+                    help="first port of the claimed range; collective "
+                         "mode claims 2*nproc consecutive ports "
+                         "(trainer endpoints, then global_shuffle "
+                         "exchange endpoints)")
     ap.add_argument("--server_num", type=int, default=0,
                     help="ps mode: pserver process count")
     ap.add_argument("--worker_num", type=int, default=0,

@@ -1,0 +1,129 @@
+"""TPU-pod job manifest generator (tools/pod_launch.py).
+
+Parity: the reference's kubernetes job generator
+(benchmark/fluid/kube_gen_job.py — pserver/nccl2/local disttypes with
+PADDLE_* env wiring). Golden tests: the emitted YAML must match the
+committed fixtures structurally, and the env contract must be exactly
+what role_maker.PaddleCloudRoleMaker.generate_role consumes."""
+
+import os
+import sys
+
+import yaml
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+import pod_launch  # noqa: E402
+
+FIX = os.path.join(os.path.dirname(__file__), "fixtures", "pod_launch")
+
+
+def _build(argv):
+    return pod_launch.build_manifests(pod_launch.parse_args(argv))
+
+
+def _env_of(job):
+    env = job["spec"]["template"]["spec"]["containers"][0]["env"]
+    return {e["name"]: e.get("value", e.get("valueFrom"))
+            for e in env}
+
+
+class TestGolden:
+    def test_collective_matches_fixture(self):
+        got = _build(["--jobname", "bert", "--trainers", "4",
+                      "--disttype", "collective", "--topology", "4x4"])
+        with open(os.path.join(FIX, "collective_bert_4.yaml")) as f:
+            want = list(yaml.safe_load_all(f))
+        assert got == want
+
+    def test_pserver_matches_fixture(self):
+        got = _build(["--jobname", "ctr", "--trainers", "2",
+                      "--pservers", "2", "--disttype", "pserver"])
+        with open(os.path.join(FIX, "pserver_ctr_2x2.yaml")) as f:
+            want = list(yaml.safe_load_all(f))
+        assert got == want
+
+    def test_yaml_round_trips(self):
+        manifests = _build(["--trainers", "3"])
+        text = pod_launch.to_yaml(manifests)
+        assert list(yaml.safe_load_all(text)) == manifests
+
+
+class TestCollectiveContract:
+    def setup_method(self):
+        svc, self.job = _build(["--jobname", "j", "--trainers", "4"])
+        self.svc = svc
+        self.env = _env_of(self.job)
+
+    def test_indexed_job_shape(self):
+        spec = self.job["spec"]
+        assert spec["completionMode"] == "Indexed"
+        assert spec["parallelism"] == spec["completions"] == 4
+        # headless service + subdomain pairing gives per-pod DNS
+        assert self.svc["spec"]["clusterIP"] == "None"
+        assert (self.job["spec"]["template"]["spec"]["subdomain"]
+                == self.svc["metadata"]["name"])
+
+    def test_role_maker_env_contract(self):
+        # exactly what PaddleCloudRoleMaker.generate_role reads in
+        # collective mode, plus the launcher's exchange-port contract
+        env = self.env
+        assert env["PADDLE_TRAINERS_NUM"] == "4"
+        assert env["TRAINING_ROLE"] == "TRAINER"
+        assert "job-completion-index" in str(
+            env["PADDLE_TRAINER_ID"]["fieldRef"]["fieldPath"])
+        eps = env["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        xeps = env["PADDLE_EXCHANGE_ENDPOINTS"].split(",")
+        assert len(eps) == len(xeps) == 4
+        assert eps[0] == "j-0.j:6170"
+        # exchange ports are DISJOINT from the rendezvous ports
+        # (the r5 EADDRINUSE fix, mirrored into the pod contract)
+        assert not set(eps) & set(xeps)
+        assert env["PADDLE_CURRENT_ENDPOINT"] == \
+            "j-$(PADDLE_TRAINER_ID).j:6170"
+
+    def test_tpu_resources(self):
+        tmpl = self.job["spec"]["template"]["spec"]
+        sel = tmpl["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] \
+            == "tpu-v5-lite-podslice"
+        res = tmpl["containers"][0]["resources"]
+        assert res["requests"]["google.com/tpu"] == "4"
+        assert res["limits"]["google.com/tpu"] == "4"
+
+
+class TestPserverContract:
+    def setup_method(self):
+        out = _build(["--jobname", "c", "--trainers", "2",
+                      "--pservers", "3", "--disttype", "pserver"])
+        self.ps_svc, self.tr_svc, self.ps_job, self.tr_job = out
+
+    def test_two_process_groups(self):
+        assert self.ps_job["spec"]["completions"] == 3
+        assert self.tr_job["spec"]["completions"] == 2
+        ps_env, tr_env = _env_of(self.ps_job), _env_of(self.tr_job)
+        assert ps_env["TRAINING_ROLE"] == "PSERVER"
+        assert tr_env["TRAINING_ROLE"] == "TRAINER"
+        # both groups agree on the pserver endpoint list
+        assert (ps_env["PADDLE_PSERVER_ENDPOINTS"]
+                == tr_env["PADDLE_PSERVER_ENDPOINTS"])
+        assert len(ps_env["PADDLE_PSERVER_ENDPOINTS"].split(",")) == 3
+
+    def test_tpu_only_on_trainers(self):
+        ps_res = self.ps_job["spec"]["template"]["spec"][
+            "containers"][0]["resources"]
+        tr_res = self.tr_job["spec"]["template"]["spec"][
+            "containers"][0]["resources"]
+        assert "google.com/tpu" not in ps_res["requests"]
+        assert "google.com/tpu" in tr_res["requests"]
+        assert "nodeSelector" not in self.ps_job["spec"]["template"][
+            "spec"]
+
+
+class TestLocal:
+    def test_single_job(self):
+        (job,) = _build(["--disttype", "local"])
+        env = _env_of(job)
+        assert env["PADDLE_TRAINER_ID"] == "0"
+        assert env["PADDLE_TRAINERS_NUM"] == "1"
+        assert job["spec"]["completions"] == 1

@@ -1,0 +1,220 @@
+"""TPU-pod job manifest generator.
+
+The reference ships a kubernetes job generator for its benchmark
+cluster runs (`benchmark/fluid/kube_gen_job.py` — pserver / nccl2 /
+local disttypes, env-wired pods built from `kube_templates/`). This is
+the TPU-native counterpart: it emits GKE-style Kubernetes manifests
+for the SAME launch contract `paddle_tpu.distributed.launch` wires
+locally (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_EXCHANGE_ENDPOINTS /
+TRAINING_ROLE, and PADDLE_PSERVER_ENDPOINTS in ps mode), which
+`role_maker.PaddleCloudRoleMaker.generate_role` consumes unchanged.
+
+Design notes (TPU-first, not a port):
+- collective mode is ONE indexed Job (completionMode: Indexed,
+  completions == parallelism == num_hosts) plus a headless Service:
+  pod DNS names are deterministic (`<job>-<i>.<job>`), so the full
+  endpoint list is static env — no gen_nccl_id-style rendezvous
+  bootstrap is needed, and rank 0's endpoint doubles as the
+  jax.distributed coordinator exactly like launch.py's local
+  contract. PADDLE_TRAINER_ID rides the downward JOB_COMPLETION_INDEX.
+- TPU resources are requested as `google.com/tpu` chips with the GKE
+  TPU nodeSelectors (accelerator type + topology).
+- ps mode emits a pserver Job (no TPU) + a trainer Job (TPU),
+  mirroring launch_ps's two process groups.
+
+Usage:
+  python tools/pod_launch.py --jobname bert --trainers 4 \
+      --tpu-type tpu-v5-lite-podslice --topology 4x4 --chips-per-host 4 \
+      --entry "python -u train.py" > job.yaml
+"""
+
+import argparse
+import sys
+
+__all__ = ["build_manifests", "to_yaml", "parse_args"]
+
+_BASE_PORT = 6170
+
+
+def _endpoints(name, n, port):
+    """Endpoint list for job `name` behind its same-named headless
+    service: indexed-pod DNS is `<name>-<i>.<name>` (pod hostname is
+    `<job>-<index>`, subdomain is the service)."""
+    return ",".join(f"{name}-{i}.{name}:{port}" for i in range(n))
+
+
+def _headless_service(name, port, extra_port=None):
+    ports = [{"name": "trainer", "port": port}]
+    if extra_port is not None:
+        ports.append({"name": "exchange", "port": extra_port})
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name},
+        "spec": {
+            "clusterIP": "None",        # the literal string — headless
+            "selector": {"job-name": name},
+            "ports": ports,
+        },
+    }
+
+
+def _container(args, env, with_tpu):
+    resources = {"requests": {"cpu": str(args.cpu),
+                              "memory": f"{args.memory}Gi"},
+                 "limits": {}}
+    if with_tpu:
+        resources["requests"]["google.com/tpu"] = str(args.chips_per_host)
+        resources["limits"]["google.com/tpu"] = str(args.chips_per_host)
+    return {
+        "name": "main",
+        "image": args.image,
+        "command": ["/bin/sh", "-c", args.entry],
+        "env": [{"name": k, "value": v} if not isinstance(v, dict)
+                else {"name": k, **v} for k, v in env],
+        "ports": [{"containerPort": args.port}],
+        "resources": resources,
+    }
+
+
+def _indexed_job(name, replicas, args, env, with_tpu):
+    spec = {
+        "parallelism": replicas,
+        "completions": replicas,
+        "completionMode": "Indexed",
+        "backoffLimit": 0,
+        "template": {
+            "metadata": {"labels": {"job-name": name}},
+            "spec": {
+                "subdomain": name,      # pairs with headless Service
+                "restartPolicy": "Never",
+                "containers": [_container(args, env, with_tpu)],
+            },
+        },
+    }
+    if with_tpu:
+        spec["template"]["spec"]["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": args.tpu_type,
+            "cloud.google.com/gke-tpu-topology": args.topology,
+        }
+    return {"apiVersion": "batch/v1", "kind": "Job",
+            "metadata": {"name": name}, "spec": spec}
+
+
+_INDEX_REF = {"valueFrom": {"fieldRef": {"fieldPath":
+    "metadata.annotations['batch.kubernetes.io/job-completion-index']"}}}
+
+
+def _identity_env(job, svc, n_trainers, port):
+    # rank rides the indexed-Job downward annotation; the pod's own
+    # endpoint expands from it (an indexed pod's stable hostname is
+    # `<job>-<index>`, NOT its pod name, which carries a random
+    # suffix); the rest is static because headless-service DNS is
+    # deterministic
+    return [
+        ("PADDLE_TRAINER_ID", _INDEX_REF),
+        ("PADDLE_TRAINERS_NUM", str(n_trainers)),
+        ("PADDLE_CURRENT_ENDPOINT",
+         f"{job}-$(PADDLE_TRAINER_ID).{svc}:{port}"),
+    ]
+
+
+def build_manifests(args):
+    """Return the manifest dicts for the requested disttype."""
+    port, xport = args.port, args.port + 1
+    if args.disttype == "local":
+        env = [("PADDLE_TRAINER_ID", "0"), ("PADDLE_TRAINERS_NUM", "1"),
+               ("TRAINING_ROLE", "TRAINER")]
+        return [_indexed_job(args.jobname, 1, args, env, with_tpu=True)]
+    if args.disttype == "collective":
+        eps = _endpoints(args.jobname, args.trainers, port)
+        xeps = _endpoints(args.jobname, args.trainers, xport)
+        env = _identity_env(args.jobname, args.jobname, args.trainers,
+                            port) + [
+            ("PADDLE_TRAINER_ENDPOINTS", eps),
+            ("PADDLE_EXCHANGE_ENDPOINTS", xeps),
+            ("TRAINING_ROLE", "TRAINER"),
+        ]
+        return [
+            _headless_service(args.jobname, port, xport),
+            _indexed_job(args.jobname, args.trainers, args, env,
+                         with_tpu=True),
+        ]
+    if args.disttype == "pserver":
+        ps_name = args.jobname + "-pserver"
+        tr_name = args.jobname + "-trainer"
+        ps_eps = _endpoints(ps_name, args.pservers, port)
+        tr_eps = _endpoints(tr_name, args.trainers, port)
+        ps_env = [
+            ("PADDLE_TRAINER_ID", _INDEX_REF),
+            ("PADDLE_TRAINERS_NUM", str(args.trainers)),
+            ("PADDLE_PSERVER_ENDPOINTS", ps_eps),
+            ("PADDLE_CURRENT_ENDPOINT",
+             f"{ps_name}-$(PADDLE_TRAINER_ID).{ps_name}:{port}"),
+            ("TRAINING_ROLE", "PSERVER"),
+        ]
+        tr_env = _identity_env(tr_name, tr_name, args.trainers,
+                               port) + [
+            ("PADDLE_PSERVER_ENDPOINTS", ps_eps),
+            ("PADDLE_TRAINER_ENDPOINTS", tr_eps),
+            ("TRAINING_ROLE", "TRAINER"),
+        ]
+        return [
+            _headless_service(ps_name, port),
+            _headless_service(tr_name, port),
+            _indexed_job(ps_name, args.pservers, args, ps_env,
+                         with_tpu=False),
+            _indexed_job(tr_name, args.trainers, args, tr_env,
+                         with_tpu=True),
+        ]
+    raise ValueError(f"unknown disttype {args.disttype!r}")
+
+
+def to_yaml(manifests):
+    import yaml
+    return yaml.safe_dump_all(manifests, sort_keys=False,
+                              default_flow_style=False)
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="pod_launch",
+        description="generate TPU-pod kubernetes job manifests "
+                    "(kube_gen_job.py parity, GKE TPU form)")
+    ap.add_argument("--jobname", default="paddlejob")
+    ap.add_argument("--image", default="paddle-tpu:latest")
+    ap.add_argument("--entry", default="python -u train.py")
+    ap.add_argument("--disttype", default="collective",
+                    choices=["collective", "pserver", "local"])
+    ap.add_argument("--trainers", type=int, default=1,
+                    help="trainer hosts (one process per TPU host)")
+    ap.add_argument("--pservers", type=int, default=1,
+                    help="ps mode: pserver pod count")
+    ap.add_argument("--tpu-type", default="tpu-v5-lite-podslice",
+                    help="GKE TPU accelerator nodeSelector value")
+    ap.add_argument("--topology", default="2x4",
+                    help="GKE TPU topology nodeSelector value")
+    ap.add_argument("--chips-per-host", type=int, default=4)
+    ap.add_argument("--cpu", type=int, default=8,
+                    help="CPU cores per pod")
+    ap.add_argument("--memory", type=int, default=32,
+                    help="memory per pod, GiB")
+    ap.add_argument("--port", type=int, default=_BASE_PORT)
+    ap.add_argument("-o", "--output", default=None,
+                    help="write here instead of stdout")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    text = to_yaml(build_manifests(args))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()
