@@ -412,6 +412,15 @@ def conll05_corpus_reader(data_path, words_name, props_name):
                         prop_rows.append(cells)
                         continue
                     if prop_rows:   # blank line: sentence boundary
+                        # rectangular check first: zip() would silently
+                        # truncate a ragged (corrupt) sentence to its
+                        # shortest row and drop annotation columns
+                        width = len(prop_rows[0])
+                        if any(len(row) != width for row in prop_rows):
+                            raise ValueError(
+                                "ragged props sentence: rows carry "
+                                f"{sorted({len(r) for r in prop_rows})}"
+                                " columns")
                         columns = list(zip(*prop_rows))
                         predicates = [lemma for lemma in columns[0]
                                       if lemma != "-"]

@@ -42,15 +42,58 @@ import time
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.core.flags import define_flag, get_flag
 from paddle_tpu.distributed import wire
 
-__all__ = ["ParameterServer", "PSClient", "Communicator", "run_pserver"]
+__all__ = ["ParameterServer", "NativeParameterServer", "PSClient",
+           "Communicator", "run_pserver", "make_parameter_server"]
+
+define_flag("ps_transport", "auto",
+            "PS server transport: auto (C++ when the hosted state is "
+            "expressible, else Python), native (require C++), python")
 
 
 # framing delegates to the single shared implementation in wire.py
 _recv_exact = wire.recv_exact
 _send_frame = wire.send_frame
 _recv_frame = wire.recv_frame
+
+
+def _ps_checkpoint_save(dirname, host, port, dense_values,
+                        sparse_tables):
+    """The pserver checkpoint artifact contract, shared by BOTH
+    transports (cross-transport restore depends on it):
+    `pserver_<host>_<port>.npz` holding {name: value} plus one
+    `..._<table>.npz` per sparse table with ids/rows/accum
+    (kCheckpointBlockId parity, listen_and_serv_op.cc:345)."""
+    os.makedirs(dirname, exist_ok=True)
+    tag = f"{host}_{port}".replace(".", "_")
+    np.savez(os.path.join(dirname, f"pserver_{tag}.npz"),
+             **dense_values)
+    for n, t in sparse_tables.items():
+        ids, rows, accum = t.snapshot()
+        np.savez(os.path.join(dirname, f"pserver_{tag}_{n}.npz"),
+                 ids=ids, rows=rows, accum=accum)
+
+
+def _ps_checkpoint_load(dirname, host, port, set_dense, sparse_tables):
+    """Counterpart of _ps_checkpoint_save: calls ``set_dense(name,
+    value)`` per hosted dense var found in the artifact and restores
+    each sparse table (old artifacts without accum restore with empty
+    accumulators so stale G cannot scale the rows)."""
+    tag = f"{host}_{port}".replace(".", "_")
+    path = os.path.join(dirname, f"pserver_{tag}.npz")
+    if os.path.exists(path):
+        blob = np.load(path)
+        for n in blob.files:
+            set_dense(n, blob[n])
+    for n, t in sparse_tables.items():
+        p = os.path.join(dirname, f"pserver_{tag}_{n}.npz")
+        if os.path.exists(p):
+            with np.load(p) as blob:
+                t.restore(blob["ids"], blob["rows"],
+                          blob["accum"] if "accum" in blob.files
+                          else None)
 
 
 class _DenseVar:
@@ -561,8 +604,6 @@ class ParameterServer:
 
     # -- checkpoint (kCheckpointBlockId parity) ----------------------------
     def save(self, dirname):
-        os.makedirs(dirname, exist_ok=True)
-        tag = f"{self.host}_{self.port}".replace(".", "_")
         # snapshot each var under its cv: the native step mutates slot
         # buffers in place, and a mid-step serialization must not see a
         # half-updated state
@@ -570,29 +611,16 @@ class ParameterServer:
         for n, v in self.dense.items():
             with v.cv:
                 dense[n] = np.array(v.value, copy=True)
-        np.savez(os.path.join(dirname, f"pserver_{tag}.npz"), **dense)
-        for n, t in self.sparse.items():
-            ids, rows, accum = t.snapshot()
-            np.savez(os.path.join(dirname, f"pserver_{tag}_{n}.npz"),
-                     ids=ids, rows=rows, accum=accum)
+        _ps_checkpoint_save(dirname, self.host, self.port, dense,
+                            self.sparse)
 
     def load(self, dirname):
-        tag = f"{self.host}_{self.port}".replace(".", "_")
-        path = os.path.join(dirname, f"pserver_{tag}.npz")
-        if os.path.exists(path):
-            blob = np.load(path)
-            for n in blob.files:
-                if n in self.dense:
-                    self.dense[n].value = blob[n]
-        for n, t in self.sparse.items():
-            p = os.path.join(dirname, f"pserver_{tag}_{n}.npz")
-            if os.path.exists(p):
-                with np.load(p) as blob:
-                    # old checkpoints without accum: restore with empty
-                    # accumulators so stale G does not scale the rows
-                    t.restore(blob["ids"], blob["rows"],
-                              blob["accum"] if "accum" in blob.files
-                              else None)
+        def set_dense(n, val):
+            if n in self.dense:
+                self.dense[n].value = val
+
+        _ps_checkpoint_load(dirname, self.host, self.port, set_dense,
+                            self.sparse)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -671,6 +699,246 @@ class ParameterServer:
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+
+
+class NativeUnsupported(Exception):
+    """Hosted state not expressible by the C++ server (exotic
+    optimizer/regularizer/schedule, non-f32 dtype, custom sparse
+    initializer) — callers fall back to the Python ParameterServer."""
+
+
+class _NativeDenseView:
+    """Read-through view of a dense var hosted in the C++ server:
+    `.value` and `.round` read the authoritative native state (the
+    surface tests and checkpoints use)."""
+
+    def __init__(self, server, name, shape, dtype):
+        self._server = server
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def value(self):
+        import ctypes
+        srv = self._server
+        out = np.empty(self.shape, np.float32)
+        rc = srv._lib.pt_pss_dense_get(
+            srv._h, self.name.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        enforce(rc == 0, f"no hosted dense var {self.name!r}")
+        return out
+
+    @value.setter
+    def value(self, v):
+        import ctypes
+        srv = self._server
+        v = np.ascontiguousarray(v, np.float32)
+        rc = srv._lib.pt_pss_dense_set(
+            srv._h, self.name.encode(),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), v.size)
+        enforce(rc == 0, f"cannot set dense var {self.name!r} "
+                         f"(size mismatch?)")
+
+    @property
+    def round(self):
+        return int(self._server._lib.pt_pss_dense_round(
+            self._server._h, self.name.encode()))
+
+
+class NativeParameterServer:
+    """The C++ control-plane transport (native/src/ps_server.cc):
+    listen_and_serv parity with the SAME wire protocol and observable
+    semantics as ParameterServer, but the accept loop, frame codec,
+    request dispatch, dedup, and optimize kernels all run in C++ — a
+    request never touches Python (SURVEY §5.8's hand-written-C++
+    commitment; ref: operators/distributed/grpc/grpc_server.cc,
+    request_handler_impl.cc). Checkpoint-notify calls back into Python
+    to write the same npz artifacts as the Python server.
+
+    Hosting raises NativeUnsupported for state the C++ side cannot
+    express (callable LR schedules, exotic optimizers/regularizers,
+    non-float32 params, custom sparse initializers); callers
+    (make_parameter_server, PServerProgram.build_server) fall back to
+    the Python server then."""
+
+    _OPT_KINDS = {"none": 0, "sgd": 1, "momentum": 2, "adam": 3}
+
+    def __init__(self, endpoint, num_trainers=1, sync_mode=True):
+        from paddle_tpu import native
+        self.host, port = endpoint.rsplit(":", 1)
+        self.port = int(port)
+        self.num_trainers = num_trainers
+        self.sync_mode = sync_mode
+        self._lib = native.get_lib()
+        self._native_mod = native
+        self._h = self._lib.pt_pss_new(
+            self.host.encode(), self.port, num_trainers,
+            1 if sync_mode else 0, wire.max_message_bytes())
+        enforce(bool(self._h), "pt_pss_new failed")
+        self.dense = {}            # name -> _NativeDenseView
+        self.sparse = {}           # name -> NativeSparseTable view
+        self._started = False
+        self._stopped = False
+        # the ctypes callback object must outlive the server
+        self._ckpt_cb = native.PS_CKPT_CB(self._on_checkpoint)
+        self._lib.pt_pss_set_checkpoint_cb(self._h, self._ckpt_cb)
+
+    # -- expressibility ---------------------------------------------------
+    @staticmethod
+    def _opt_config(optimizer, regularizer, param_lr):
+        """(kind, lr, mu_or_b1, b2, eps, nesterov, decay, coeff) or
+        raises NativeUnsupported."""
+        from paddle_tpu import optimizer as po
+        if optimizer is None:
+            return (0, 0.0, 0.0, 0.0, 0.0, 0, 0, 0.0)
+        if callable(optimizer.learning_rate):
+            raise NativeUnsupported("callable LR schedule")
+        lr = float(optimizer.learning_rate)
+        # exact type, not isinstance: subclasses define different rules
+        if type(optimizer) is po.SGDOptimizer:
+            cfg = (1, lr, 0.0, 0.0, 0.0, 0)
+        elif type(optimizer) is po.MomentumOptimizer:
+            cfg = (2, lr, float(optimizer.momentum), 0.0, 0.0,
+                   int(bool(getattr(optimizer, "use_nesterov", False))))
+        elif type(optimizer) is po.AdamOptimizer:
+            cfg = (3, lr, float(optimizer.beta1), float(optimizer.beta2),
+                   float(optimizer.epsilon), 0)
+        else:
+            raise NativeUnsupported(
+                f"optimizer {type(optimizer).__name__}")
+        reg = regularizer or optimizer.regularization
+        if reg is None:
+            decay = (0, 0.0)
+        else:
+            from paddle_tpu.regularizer import (L1DecayRegularizer,
+                                                L2DecayRegularizer)
+            if type(reg) is L2DecayRegularizer:
+                decay = (1, float(reg.coeff))
+            elif type(reg) is L1DecayRegularizer:
+                decay = (2, float(reg.coeff))
+            else:
+                raise NativeUnsupported(
+                    f"regularizer {type(reg).__name__}")
+        return cfg + decay
+
+    # -- hosting ----------------------------------------------------------
+    def host_dense(self, name, value, optimizer=None, regularizer=None,
+                   param_lr=1.0):
+        import ctypes
+        enforce(not self._started, "host_dense before start()")
+        value = np.asarray(value)
+        if value.dtype != np.float32:
+            raise NativeUnsupported(f"dtype {value.dtype}")
+        kind, lr, b1, b2, eps, nesterov, decay, coeff = \
+            self._opt_config(optimizer, regularizer, param_lr)
+        v = np.ascontiguousarray(value, np.float32)
+        dims = np.asarray(v.shape or (1,), np.uint32)
+        rc = self._lib.pt_pss_host_dense(
+            self._h, name.encode(),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            dims.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            len(dims), kind, lr, b1, b2, eps, nesterov, decay, coeff,
+            float(param_lr))
+        enforce(rc == 0, "pt_pss_host_dense failed")
+        self.dense[name] = _NativeDenseView(self, name,
+                                            v.shape or (1,), v.dtype)
+
+    def host_sparse(self, name, dim, initializer=None, seed=0, lr=1.0,
+                    optimizer="sgd"):
+        if initializer is not None:
+            raise NativeUnsupported("custom sparse initializer")
+        enforce(not self._started, "host_sparse before start()")
+        enforce(optimizer in ("sgd", "adagrad"),
+                f"sparse optimizer must be sgd|adagrad, got {optimizer!r}")
+        rc = self._lib.pt_pss_host_sparse(
+            self._h, name.encode(), int(dim),
+            {"sgd": 0, "adagrad": 1}[optimizer], float(lr), 1e-6,
+            int(seed) & 0xFFFFFFFFFFFFFFFF)
+        enforce(rc == 0, "pt_pss_host_sparse failed")
+        handle = self._lib.pt_pss_sparse_table(self._h, name.encode())
+        self.sparse[name] = self._native_mod.NativeSparseTable \
+            .from_handle(handle, dim)
+
+    # -- checkpoint (same artifacts as ParameterServer.save/load) ---------
+    def _on_checkpoint(self, dirname):
+        try:
+            self.save(os.fsdecode(dirname))
+        except Exception:
+            logging.getLogger("paddle_tpu.ps").exception(
+                "checkpoint-notify save failed")
+
+    def save(self, dirname):
+        dense = {n: v.value for n, v in self.dense.items()}
+        _ps_checkpoint_save(dirname, self.host, self.port, dense,
+                            self.sparse)
+
+    def load(self, dirname):
+        def set_dense(n, val):
+            if n in self.dense:
+                self.dense[n].value = val
+
+        _ps_checkpoint_load(dirname, self.host, self.port, set_dense,
+                            self.sparse)
+
+    # -- observability ----------------------------------------------------
+    @property
+    def possible_replays(self):
+        return int(self._lib.pt_pss_possible_replays(self._h))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        enforce(not self._started, "already started")
+        port = self._lib.pt_pss_start(self._h)
+        enforce(port > 0, f"native PS server failed to start: "
+                          f"{self._lib.pt_pss_error(self._h).decode()}")
+        self.port = port
+        self._started = True
+        return self
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def run(self):
+        """Blocking serve (listen_and_serv RunImpl): waits inside the
+        C++ server until a STOP frame or stop() — ctypes releases the
+        GIL for the duration."""
+        if not self._started:
+            self.start()
+        self._lib.pt_pss_join(self._h)
+        self.stop()
+
+    def stop(self):
+        if self._started and not self._stopped:
+            self._lib.pt_pss_stop(self._h)
+            self._stopped = True
+
+    def __del__(self):
+        try:
+            self.stop()
+            self._lib.pt_pss_free(self._h)
+        except Exception:
+            pass
+
+
+def make_parameter_server(endpoint, num_trainers=1, sync_mode=True,
+                          transport=None):
+    """Factory honoring FLAGS_ps_transport: the C++ server when the
+    toolchain is present (hosting may still fall back — see
+    PServerProgram.build_server), the Python server otherwise."""
+    transport = transport or get_flag("ps_transport")
+    enforce(transport in ("auto", "native", "python"),
+            f"FLAGS_ps_transport must be auto|native|python, "
+            f"got {transport!r}")
+    if transport == "python":
+        return ParameterServer(endpoint, num_trainers, sync_mode)
+    try:
+        return NativeParameterServer(endpoint, num_trainers, sync_mode)
+    except Exception:
+        if transport == "native":
+            raise
+        return ParameterServer(endpoint, num_trainers, sync_mode)
 
 
 class PSClient:

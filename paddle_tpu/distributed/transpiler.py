@@ -191,24 +191,56 @@ class PServerProgram:
                                 param_lr=param_lr)
 
     def build_server(self):
-        """Materialize the ParameterServer: init each hosted param with
+        """Materialize the parameter server: init each hosted param with
         the SAME rng the local startup run would use
         (executor._run_eager: fold_in(PRNGKey(seed), op_index)) so
-        distributed training starts from the local-run weights."""
+        distributed training starts from the local-run weights.
+
+        Transport selection (FLAGS_ps_transport): the C++ server
+        (native/src/ps_server.cc — wire parse, dispatch, dedup and
+        optimize kernels all native) when the hosted state is
+        expressible there; the Python ParameterServer otherwise (and
+        always under transport=python / no toolchain)."""
         import jax
 
         from paddle_tpu.core.dtypes import convert_dtype
-        server = _ps.ParameterServer(self.endpoint, self.num_trainers,
-                                     self.sync_mode)
-        base = jax.random.PRNGKey(self.startup_seed)
-        for name, spec in self.dense.items():
-            key = jax.random.fold_in(base, spec["op_idx"])
-            val = np.asarray(spec["initializer"](
-                key, spec["shape"], convert_dtype(spec["dtype"])))
-            server.host_dense(name, val, spec["optimizer"],
-                              regularizer=spec["regularizer"],
-                              param_lr=spec["param_lr"])
-        return server
+
+        def host_all(server):
+            base = jax.random.PRNGKey(self.startup_seed)
+            for name, spec in self.dense.items():
+                key = jax.random.fold_in(base, spec["op_idx"])
+                val = np.asarray(spec["initializer"](
+                    key, spec["shape"], convert_dtype(spec["dtype"])))
+                server.host_dense(name, val, spec["optimizer"],
+                                  regularizer=spec["regularizer"],
+                                  param_lr=spec["param_lr"])
+            return server
+
+        import logging
+
+        from paddle_tpu.core.flags import get_flag
+        transport = get_flag("ps_transport")
+        enforce(transport in ("auto", "native", "python"),
+                f"FLAGS_ps_transport must be auto|native|python, "
+                f"got {transport!r}")
+        if transport != "python":
+            try:
+                return host_all(_ps.NativeParameterServer(
+                    self.endpoint, self.num_trainers, self.sync_mode))
+            except Exception as e:
+                if transport == "native":
+                    raise
+                # auto: inexpressible state (NativeUnsupported) and a
+                # missing toolchain fall back silently by design; any
+                # OTHER failure is a native-path bug that must not hide
+                # behind the ~2x-slower Python transport unannounced
+                if not isinstance(e, _ps.NativeUnsupported):
+                    logging.getLogger("paddle_tpu.ps").warning(
+                        "native PS transport failed unexpectedly "
+                        "(%s: %s) — falling back to the Python server",
+                        type(e).__name__, e)
+        return host_all(_ps.ParameterServer(
+            self.endpoint, self.num_trainers, self.sync_mode))
 
 
 # ---------------------------------------------------------------------------

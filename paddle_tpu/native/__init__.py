@@ -15,8 +15,11 @@ import subprocess
 import threading
 
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
+# checkpoint-notify callback signature for the C++ PS server (the
+# callback object must outlive the server: keep a reference per wrapper)
+PS_CKPT_CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p)
 _SOURCES = ["recordio.cc", "data_pipeline.cc", "arena.cc", "strings.cc",
-            "ps_table.cc", "batcher.cc"]
+            "ps_table.cc", "ps_server.cc", "batcher.cc"]
 _lock = threading.Lock()
 _lib = None
 _build_error = None
@@ -146,6 +149,45 @@ def _bind(lib):
               lib.pt_dense_adam, lib.pt_dense_accum,
               lib.pt_dense_l2_decay, lib.pt_dense_l1_decay):
         f.restype = None
+    c_uint32_p = ctypes.POINTER(ctypes.c_uint32)
+    lib.pt_pss_new.restype = c_void_p
+    lib.pt_pss_new.argtypes = [c_char_p, c_int, c_int, c_int,
+                               ctypes.c_uint64]
+    lib.pt_pss_free.argtypes = [c_void_p]
+    lib.pt_pss_error.restype = c_char_p
+    lib.pt_pss_error.argtypes = [c_void_p]
+    lib.pt_pss_host_dense.restype = c_int
+    lib.pt_pss_host_dense.argtypes = [
+        c_void_p, c_char_p, c_float_p, c_uint32_p, c_int, c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, c_int, c_int, ctypes.c_double, ctypes.c_double]
+    lib.pt_pss_host_sparse.restype = c_int
+    lib.pt_pss_host_sparse.argtypes = [c_void_p, c_char_p, c_int, c_int,
+                                       ctypes.c_float, ctypes.c_float,
+                                       ctypes.c_uint64]
+    lib.pt_pss_start.restype = c_int
+    lib.pt_pss_start.argtypes = [c_void_p]
+    lib.pt_pss_stop.argtypes = [c_void_p]
+    lib.pt_pss_join.argtypes = [c_void_p]
+    lib.pt_pss_dense_size.restype = c_long
+    lib.pt_pss_dense_size.argtypes = [c_void_p, c_char_p]
+    lib.pt_pss_dense_round.restype = ctypes.c_uint64
+    lib.pt_pss_dense_round.argtypes = [c_void_p, c_char_p]
+    lib.pt_pss_dense_get.restype = c_int
+    lib.pt_pss_dense_get.argtypes = [c_void_p, c_char_p, c_float_p]
+    lib.pt_pss_dense_set.restype = c_int
+    lib.pt_pss_dense_set.argtypes = [c_void_p, c_char_p, c_float_p,
+                                     c_long]
+    lib.pt_pss_sparse_table.restype = c_void_p
+    lib.pt_pss_sparse_table.argtypes = [c_void_p, c_char_p]
+    lib.pt_pss_set_checkpoint_cb.argtypes = [c_void_p, PS_CKPT_CB]
+    lib.pt_pss_possible_replays.restype = ctypes.c_uint64
+    lib.pt_pss_possible_replays.argtypes = [c_void_p]
+    lib.pt_ps_bench_push.restype = ctypes.c_double
+    lib.pt_ps_bench_push.argtypes = [c_char_p, c_int, c_char_p, c_long,
+                                     c_int]
+    lib.pt_ps_bench_pull.restype = ctypes.c_double
+    lib.pt_ps_bench_pull.argtypes = [c_char_p, c_int, c_char_p, c_int]
     lib.pt_batcher_create.restype = c_void_p
     lib.pt_batcher_create.argtypes = [
         ctypes.POINTER(c_char_p), c_int, c_int, c_int, c_long, c_long,
@@ -458,11 +500,26 @@ class NativeSparseTable:
         self._np = np
         self.dim = int(dim)
         self._lib = get_lib()
+        self._owned = True
         self._h = self._lib.pt_ps_table_new(
             self.dim, self._OPTS[optimizer], float(lr), float(eps),
             int(seed) & 0xFFFFFFFFFFFFFFFF)
         if not self._h:
             raise RuntimeError("pt_ps_table_new failed")
+
+    @classmethod
+    def from_handle(cls, handle, dim):
+        """View over a table owned elsewhere (the C++ PS server's
+        sparse store): same pull/push/snapshot surface, no free on
+        __del__."""
+        import numpy as np
+        self = cls.__new__(cls)
+        self._np = np
+        self.dim = int(dim)
+        self._lib = get_lib()
+        self._owned = False
+        self._h = handle
+        return self
 
     def __len__(self):
         return int(self._lib.pt_ps_table_size(self._h))
@@ -531,7 +588,8 @@ class NativeSparseTable:
 
     def __del__(self):
         try:
-            self._lib.pt_ps_table_free(self._h)
+            if getattr(self, "_owned", False):
+                self._lib.pt_ps_table_free(self._h)
         except Exception:
             pass
 

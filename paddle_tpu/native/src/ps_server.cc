@@ -1,0 +1,1218 @@
+// Parameter-server control-plane transport — the listen/parse/dispatch
+// loop in C++.
+//
+// Reference capability: the RPC substrate the reference keeps
+// hand-written C++ (SURVEY §5.8): gRPC server + zero-copy serde
+// (operators/distributed/grpc/grpc_server.cc, grpc_serde.cc,
+// sendrecvop_utils.cc), threaded request handlers running the pserver
+// optimize blocks (operators/distributed/request_handler_impl.cc), and
+// the listen_and_serv accept loop (distributed_ops/listen_and_serv_op.cc:330
+// RunSyncLoop). Here a PS request travels
+//     wire -> C++ frame parse -> dense/sparse kernel -> writev reply
+// with no Python in the path; the Python server loop in
+// distributed/ps.py remains the documented no-toolchain fallback.
+//
+// The wire format is EXACTLY distributed/wire.py's framed binary
+// protocol (magic "PT" | version u8 | kind u8 | client u64 | seq u64 |
+// payload_len u64; fields STR/U64/F64/ARR) — one codec, two
+// implementations, locked together by the cross-transport parity tests
+// (tests/test_ps_native.py runs the Python client suite against this
+// server). Server semantics mirror ps.py: sync-round fan-in with
+// per-var condition variables, per-client retry dedup of mutating
+// frames (rpc retry-idempotence, grpc_client.cc role), set-based
+// barriers, checkpoint-notify via a registered callback.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+// ---- shared kernels / sparse store (same .so, ps_table.cc) ----------
+extern "C" {
+void* pt_ps_table_new(int dim, int optimizer, float lr, float eps,
+                      uint64_t seed);
+void pt_ps_table_free(void* h);
+void pt_ps_table_pull(void* h, const int64_t* ids, long n, float* out);
+void pt_ps_table_push(void* h, const int64_t* ids, const float* grads,
+                      long n, float lr);
+long pt_ps_table_shrink(void* h, uint64_t max_age);
+void pt_dense_sgd(float* p_out, const float* p_in, const float* g,
+                  long n, float lr);
+void pt_dense_momentum(float* p_out, const float* p_in, float* v,
+                       const float* g, long n, float lr, float mu,
+                       int nesterov);
+void pt_dense_adam(float* p_out, const float* p_in, float* m1, float* m2,
+                   const float* g, long n, float lr, float beta1,
+                   float beta2, float eps, long t);
+void pt_dense_accum(float* acc, const float* g, long n);
+void pt_dense_scale(float* g, long n, float s);
+void pt_dense_l2_decay(float* g, const float* p, long n, float coeff);
+void pt_dense_l1_decay(float* g, const float* p, long n, float coeff);
+}
+
+namespace psrv {
+
+// ---- wire constants (must match distributed/wire.py) ----------------
+constexpr uint8_t kVersion = 1;
+enum Kind : uint8_t {
+  kPushGrad = 1, kPullParam = 2, kPullSparse = 3, kPushSparse = 4,
+  kBarrier = 5, kCkptNotify = 6, kListVars = 7, kStop = 8, kShrink = 9,
+  kShufflePush = 10, kShuffleDone = 11,
+  kOk = 100, kOkArr = 101, kOkNames = 102, kErr = 103,
+};
+constexpr size_t kHeaderSize = 28;  // 2s B B Q Q Q little-endian
+enum Dt : uint8_t { kF32 = 1, kF64 = 2, kI32 = 3, kI64 = 4, kU8 = 5,
+                    kBool = 6 };
+
+inline bool known_kind(uint8_t k) {
+  return (k >= 1 && k <= 11) || (k >= 100 && k <= 103);
+}
+inline bool mutating_kind(uint8_t k) {  // wire.MUTATING
+  return k == kPushGrad || k == kPushSparse || k == kCkptNotify ||
+         k == kStop || k == kBarrier || k == kShrink;
+}
+
+// ---- little-endian loads (alignment-safe) ---------------------------
+template <class T>
+inline T load_le(const uint8_t* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;  // this build targets little-endian hosts (x86-64/arm64)
+}
+template <class T>
+inline void store_le(uint8_t* p, T v) { std::memcpy(&p[0], &v, sizeof(T)); }
+
+// ---- payload reader -------------------------------------------------
+struct WireErr { std::string msg; };
+
+struct ArrView {
+  uint8_t dtype;
+  std::vector<uint32_t> dims;
+  const uint8_t* data;
+  size_t nbytes;
+  size_t count;    // element count
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* p, size_t n, uint64_t max_bytes)
+      : p_(p), n_(n), max_(max_bytes) {}
+  std::string str() {
+    need(2);
+    uint16_t len = load_le<uint16_t>(p_ + off_);
+    off_ += 2;
+    need(len);
+    std::string s(reinterpret_cast<const char*>(p_ + off_), len);
+    off_ += len;
+    return s;
+  }
+  uint64_t u64() {
+    need(8);
+    uint64_t v = load_le<uint64_t>(p_ + off_);
+    off_ += 8;
+    return v;
+  }
+  double f64() {
+    need(8);
+    double v = load_le<double>(p_ + off_);
+    off_ += 8;
+    return v;
+  }
+  ArrView arr() {
+    need(2);
+    ArrView a;
+    a.dtype = p_[off_];
+    uint8_t ndim = p_[off_ + 1];
+    off_ += 2;
+    size_t itemsize;
+    switch (a.dtype) {
+      case kF32: case kI32: itemsize = 4; break;
+      case kF64: case kI64: itemsize = 8; break;
+      case kU8: case kBool: itemsize = 1; break;
+      default: throw WireErr{"unknown dtype code"};
+    }
+    unsigned __int128 count = 1;  // u32 dims cannot wrap this
+    for (uint8_t i = 0; i < ndim; ++i) {
+      need(4);
+      uint32_t d = load_le<uint32_t>(p_ + off_);
+      off_ += 4;
+      a.dims.push_back(d);
+      count *= d;
+    }
+    unsigned __int128 nbytes = count * itemsize;
+    if (nbytes > max_) throw WireErr{"array too large"};
+    a.count = static_cast<size_t>(count);
+    a.nbytes = static_cast<size_t>(nbytes);
+    need(a.nbytes);
+    a.data = p_ + off_;
+    off_ += a.nbytes;
+    return a;
+  }
+  void done() const {
+    if (off_ != n_) throw WireErr{"trailing bytes in payload"};
+  }
+
+ private:
+  void need(size_t k) const {
+    if (off_ + k > n_) throw WireErr{"truncated payload"};
+  }
+  const uint8_t* p_;
+  size_t n_, off_ = 0;
+  uint64_t max_;
+};
+
+// Return the array as aligned float32[expect] (converting f64, copying
+// when misaligned — STR fields put arrays at arbitrary byte offsets).
+// Scratch buffers live per CONNECTION and only ever grow: a fresh
+// 64 MB vector per request costs an allocation + page-fault-zeroing
+// pass that dwarfs the copy itself, and a shrink-then-grow resize
+// value-initializes (zero-fills) everything it re-adds.
+struct Scratch {
+  std::vector<float> f32;
+  std::vector<int64_t> i64;
+};
+
+template <class T>
+T* ensure(std::vector<T>& v, size_t n) {
+  if (v.size() < n) v.resize(n);
+  return v.data();
+}
+
+const float* as_f32(const ArrView& a, std::vector<float>& scratch) {
+  if (a.dtype == kF32) {
+    if (reinterpret_cast<uintptr_t>(a.data) % alignof(float) == 0)
+      return reinterpret_cast<const float*>(a.data);
+    float* s = ensure(scratch, a.count);
+    std::memcpy(s, a.data, a.nbytes);
+    return s;
+  }
+  if (a.dtype == kF64) {
+    ensure(scratch, a.count);
+    for (size_t i = 0; i < a.count; ++i)
+      scratch[i] = static_cast<float>(load_le<double>(a.data + 8 * i));
+    return scratch.data();
+  }
+  throw WireErr{"expected a float array"};
+}
+
+const int64_t* as_i64(const ArrView& a, std::vector<int64_t>& scratch) {
+  if (a.dtype == kI64) {
+    if (reinterpret_cast<uintptr_t>(a.data) % alignof(int64_t) == 0)
+      return reinterpret_cast<const int64_t*>(a.data);
+    int64_t* s = ensure(scratch, a.count);
+    std::memcpy(s, a.data, a.nbytes);
+    return s;
+  }
+  if (a.dtype == kI32) {
+    ensure(scratch, a.count);
+    for (size_t i = 0; i < a.count; ++i)
+      scratch[i] = load_le<int32_t>(a.data + 4 * i);
+    return scratch.data();
+  }
+  throw WireErr{"expected an int array"};
+}
+
+// ---- reply encoding -------------------------------------------------
+struct Reply {
+  std::vector<uint8_t> head;       // header + small fields
+  const void* big = nullptr;       // optional zero-copy tail
+  size_t big_len = 0;
+  std::shared_ptr<void> keepalive; // owns `big` until sent
+  std::vector<uint8_t> flat() const {
+    std::vector<uint8_t> out = head;
+    if (big_len) {
+      out.insert(out.end(), static_cast<const uint8_t*>(big),
+                 static_cast<const uint8_t*>(big) + big_len);
+    }
+    return out;
+  }
+};
+
+void put_header(std::vector<uint8_t>& o, uint8_t kind, uint64_t cid,
+                uint64_t seq, uint64_t payload_len) {
+  o.resize(kHeaderSize);
+  o[0] = 'P'; o[1] = 'T'; o[2] = kVersion; o[3] = kind;
+  store_le<uint64_t>(&o[4], cid);
+  store_le<uint64_t>(&o[12], seq);
+  store_le<uint64_t>(&o[20], payload_len);
+}
+
+void put_str(std::vector<uint8_t>& o, const std::string& s) {
+  size_t at = o.size();
+  o.resize(at + 2 + s.size());
+  store_le<uint16_t>(&o[at], static_cast<uint16_t>(s.size()));
+  std::memcpy(&o[at + 2], s.data(), s.size());
+}
+
+Reply make_ok(uint64_t cid, uint64_t seq) {
+  Reply r;
+  put_header(r.head, kOk, cid, seq, 0);
+  return r;
+}
+
+Reply make_err(uint64_t cid, uint64_t seq, const std::string& msg) {
+  Reply r;
+  put_header(r.head, kErr, cid, seq, 2 + msg.size());
+  put_str(r.head, msg);
+  return r;
+}
+
+Reply make_names(uint64_t cid, uint64_t seq, const std::string& a,
+                 const std::string& b) {
+  Reply r;
+  put_header(r.head, kOkNames, cid, seq, 4 + a.size() + b.size());
+  put_str(r.head, a);
+  put_str(r.head, b);
+  return r;
+}
+
+// OK_ARR with a zero-copy data tail (`owner` keeps it alive past the
+// handler — the pull path sends the live param buffer, swap-protected)
+Reply make_arr(uint64_t cid, uint64_t seq, uint8_t dtype,
+               const std::vector<uint32_t>& dims, const void* data,
+               size_t nbytes, std::shared_ptr<void> owner) {
+  Reply r;
+  put_header(r.head, kOkArr, cid, seq, 2 + 4 * dims.size() + nbytes);
+  size_t at = r.head.size();
+  r.head.resize(at + 2 + 4 * dims.size());
+  r.head[at] = dtype;
+  r.head[at + 1] = static_cast<uint8_t>(dims.size());
+  for (size_t i = 0; i < dims.size(); ++i)
+    store_le<uint32_t>(&r.head[at + 2 + 4 * i], dims[i]);
+  r.big = data;
+  r.big_len = nbytes;
+  r.keepalive = std::move(owner);
+  return r;
+}
+
+// ---- socket helpers -------------------------------------------------
+bool recv_exact(int fd, uint8_t* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, p + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, const Reply& r) {
+  if (!r.big_len) return send_all(fd, r.head.data(), r.head.size());
+  struct iovec iov[2];
+  iov[0].iov_base = const_cast<uint8_t*>(r.head.data());
+  iov[0].iov_len = r.head.size();
+  iov[1].iov_base = const_cast<void*>(r.big);
+  iov[1].iov_len = r.big_len;
+  struct msghdr msg;
+  std::memset(&msg, 0, sizeof(msg));
+  msg.msg_iov = iov;
+  msg.msg_iovlen = 2;
+  size_t total = r.head.size() + r.big_len;
+  size_t sent = 0;
+  while (sent < total) {
+    ssize_t w = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    sent += static_cast<size_t>(w);
+    size_t skip = static_cast<size_t>(w);
+    while (msg.msg_iovlen && skip >= msg.msg_iov[0].iov_len) {
+      skip -= msg.msg_iov[0].iov_len;
+      ++msg.msg_iov;
+      --msg.msg_iovlen;
+    }
+    if (msg.msg_iovlen && skip) {
+      msg.msg_iov[0].iov_base =
+          static_cast<uint8_t*>(msg.msg_iov[0].iov_base) + skip;
+      msg.msg_iov[0].iov_len -= skip;
+    }
+  }
+  return true;
+}
+
+// ---- hosted dense var -----------------------------------------------
+struct DenseVar {
+  // Buffer lifecycle: `value` swaps to a fresh buffer every step so a
+  // puller encoding the previous value zero-copy (sendmsg outside the
+  // lock) never sees a torn vector. Retired buffers come back through
+  // a custom shared_ptr deleter that pushes them into `free_pool`
+  // UNDER mu — a real happens-before edge with the reader's last
+  // access (a relaxed use_count() probe is not one; TSAN rightly
+  // flagged that as a data race between the recycled-buffer write and
+  // the late sendmsg read).
+  //
+  // Member order matters for ~DenseVar: `value` is declared LAST so
+  // its deleter (which locks mu and touches free_pool) runs while
+  // both are still alive.
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<std::unique_ptr<std::vector<float>>> free_pool;
+  std::vector<uint32_t> dims;
+  long n = 0;
+  // optimize-block config (request_handler_impl.cc role):
+  // opt 0=none 1=sgd 2=momentum 3=adam; decay 0=none 1=l2 2=l1
+  int opt = 0, decay = 0, nesterov = 0;
+  double lr = 0, mu_or_b1 = 0, b2 = 0, eps = 0, decay_coeff = 0,
+         param_lr = 1.0;
+  std::vector<float> vslot, m1, m2;   // slot buffers (lock-protected)
+  std::vector<float> accum;           // sync fan-in
+  bool accum_live = false;
+  std::set<uint64_t> pushed;
+  uint64_t round = 0;
+  long step_count = 0;
+  std::shared_ptr<std::vector<float>> value;
+
+  std::shared_ptr<std::vector<float>> pooled(
+      std::unique_ptr<std::vector<float>> buf) {
+    std::vector<float>* raw = buf.release();
+    return std::shared_ptr<std::vector<float>>(
+        raw, [this](std::vector<float>* p) {
+          std::lock_guard<std::mutex> lk(mu);
+          if (free_pool.size() < 2)
+            free_pool.emplace_back(p);
+          else
+            delete p;
+        });
+  }
+
+  // Caller holds mu; `g` is writable scratch (decay mutates it).
+  // Returns the RETIRED value buffer — the caller must destroy it
+  // AFTER releasing mu (its deleter locks mu; dropping it under the
+  // lock would self-deadlock when no puller still holds a reference).
+  std::shared_ptr<std::vector<float>> step(float* g) {
+    if (opt == 0) return nullptr;
+    ++step_count;
+    if (decay == 1)
+      pt_dense_l2_decay(g, value->data(), n, (float)decay_coeff);
+    else if (decay == 2)
+      pt_dense_l1_decay(g, value->data(), n, (float)decay_coeff);
+    float lr_eff = static_cast<float>(lr * param_lr);
+    std::unique_ptr<std::vector<float>> out;
+    if (!free_pool.empty() &&
+        free_pool.back()->size() == static_cast<size_t>(n)) {
+      out = std::move(free_pool.back());
+      free_pool.pop_back();
+    } else {
+      out = std::make_unique<std::vector<float>>(n);
+    }
+    if (opt == 1) {
+      pt_dense_sgd(out->data(), value->data(), g, n, lr_eff);
+    } else if (opt == 2) {
+      if (vslot.empty()) vslot.assign(n, 0.f);
+      pt_dense_momentum(out->data(), value->data(), vslot.data(), g, n,
+                        lr_eff, (float)mu_or_b1, nesterov);
+    } else {
+      if (m1.empty()) { m1.assign(n, 0.f); m2.assign(n, 0.f); }
+      pt_dense_adam(out->data(), value->data(), m1.data(), m2.data(), g,
+                    n, lr_eff, (float)mu_or_b1, (float)b2, (float)eps,
+                    step_count);
+    }
+    auto retired = std::move(value);
+    value = pooled(std::move(out));
+    return retired;
+  }
+};
+
+// ---- per-client retry dedup (grpc retry-idempotence role) -----------
+struct ClientLru {
+  std::list<uint64_t> order;                       // seqs, LRU first
+  std::unordered_map<uint64_t,
+      std::pair<std::list<uint64_t>::iterator, std::vector<uint8_t>>>
+      entries;
+  uint64_t last_seen = 0;
+  bool has_last = false;
+};
+
+// ---- the server -----------------------------------------------------
+struct Server {
+  std::string host;
+  int port;
+  int num_trainers;
+  bool sync_mode;
+  uint64_t max_msg;
+
+  std::map<std::string, std::unique_ptr<DenseVar>> dense;
+  std::map<std::string, void*> sparse;             // PsTable*
+
+  // barriers (set-based fan-in, listen_and_serv barrier role)
+  std::mutex barrier_mu;
+  std::condition_variable barrier_cv;
+  std::map<std::string, std::pair<std::set<uint64_t>, uint64_t>> barriers;
+
+  // dedup
+  std::mutex dd_mu;
+  std::condition_variable dd_cv;
+  std::list<uint64_t> dd_client_order;
+  std::unordered_map<uint64_t, ClientLru> dd_clients;
+  std::set<std::pair<uint64_t, uint64_t>> dd_inflight;
+  std::atomic<uint64_t> possible_replays{0};
+  static constexpr size_t kPerClientCap = 1024;
+  static constexpr size_t kClientsCap = 256;
+  static constexpr uint64_t kReplayTolerance = 8;
+
+  // lifecycle (listen_fd is atomic: stop() rewrites it while the
+  // accept loop reads it for accept()/shutdown())
+  std::atomic<int> listen_fd{-1};
+  std::thread accept_thread;
+  std::atomic<bool> stopping{false};
+  std::mutex conn_mu;
+  std::condition_variable conn_cv;
+  std::set<int> conn_fds;
+  long active_conns = 0;
+
+  void (*ckpt_cb)(const char*) = nullptr;
+  std::string last_error;
+
+  ~Server() {
+    stop();
+    for (auto& kv : sparse) pt_ps_table_free(kv.second);
+  }
+
+  // ---- request handlers ---------------------------------------------
+  Reply handle(uint8_t kind, uint64_t cid, uint64_t seq,
+               const uint8_t* payload, size_t n, Scratch& sc) {
+    Reader r(payload, n, max_msg);
+    switch (kind) {
+      case kPushGrad: {
+        std::string name = r.str();
+        uint64_t tid = r.u64();
+        ArrView g = r.arr();
+        r.done();
+        auto it = dense.find(name);
+        if (it == dense.end())
+          return make_err(cid, seq, "KeyError: '" + name + "'");
+        DenseVar& v = *it->second;
+        if (static_cast<long>(g.count) != v.n)
+          return make_err(cid, seq, "grad size " +
+                          std::to_string(g.count) + " != var size " +
+                          std::to_string(v.n));
+        const float* gp = as_f32(g, sc.f32);
+        // declared BEFORE the lock: step() hands back the retired
+        // value buffer, whose pool deleter locks v.mu — it must
+        // destruct after `lk` releases
+        std::shared_ptr<std::vector<float>> retired;
+        std::unique_lock<std::mutex> lk(v.mu);
+        if (sync_mode) {
+          if (v.pushed.count(tid)) {
+            // stale duplicate racing a round: wait for the release.
+            // EVERY long wait in this file is stop-interruptible — a
+            // thread parked past stop() would outlive the Server and
+            // touch freed state when its timeout fires.
+            v.cv.wait_for(lk, std::chrono::seconds(120), [&] {
+              return !v.pushed.count(tid) || stopping.load();
+            });
+            if (stopping.load())
+              return make_err(cid, seq, "server stopping");
+            if (v.pushed.count(tid))
+              return make_err(cid, seq,
+                              "duplicate push timed out waiting for "
+                              "round fan-in");
+          }
+          if (!v.accum_live) {
+            v.accum.assign(gp, gp + v.n);
+            v.accum_live = true;
+          } else {
+            pt_dense_accum(v.accum.data(), gp, v.n);
+          }
+          v.pushed.insert(tid);
+          if (static_cast<int>(v.pushed.size()) >= num_trainers) {
+            if (num_trainers > 1)
+              pt_dense_scale(v.accum.data(), v.n, 1.f / num_trainers);
+            retired = v.step(v.accum.data());
+            v.accum_live = false;
+            v.pushed.clear();
+            ++v.round;
+            v.cv.notify_all();
+          }
+        } else {
+          // async step writes decay into the grad in place; both the
+          // recv buffer and the scratch are this connection's own and
+          // not reused until the NEXT frame decode, which is after
+          // the step returns (no extra 64 MB copy pass)
+          retired = v.step(const_cast<float*>(gp));
+          ++v.round;
+          v.cv.notify_all();
+        }
+        lk.unlock();        // retired's deleter may lock v.mu
+        return make_ok(cid, seq);
+      }
+      case kPullParam: {
+        std::string name = r.str();
+        uint64_t min_round = r.u64();
+        r.done();
+        auto it = dense.find(name);
+        if (it == dense.end())
+          return make_err(cid, seq, "KeyError: '" + name + "'");
+        DenseVar& v = *it->second;
+        if (!sync_mode) min_round = 0;
+        std::shared_ptr<std::vector<float>> val;
+        {
+          std::unique_lock<std::mutex> lk(v.mu);
+          v.cv.wait_for(lk, std::chrono::seconds(120), [&] {
+            return v.round >= min_round || stopping.load();
+          });
+          if (v.round < min_round) {
+            return make_err(cid, seq,
+                            stopping.load()
+                                ? "server stopping"
+                                : "pull timed out waiting for round " +
+                                      std::to_string(min_round));
+          }
+          val = v.value;   // swap semantics: encode outside the lock
+        }
+        return make_arr(cid, seq, kF32, v.dims, val->data(),
+                        val->size() * 4, val);
+      }
+      case kPullSparse: {
+        std::string name = r.str();
+        ArrView ids = r.arr();
+        r.done();
+        auto it = sparse.find(name);
+        if (it == sparse.end())
+          return make_err(cid, seq, "KeyError: '" + name + "'");
+        const int64_t* ip = as_i64(ids, sc.i64);
+        // dim is fixed at host time; recover it from the table config
+        int dim = sparse_dim.at(name);
+        auto out = std::make_shared<std::vector<float>>(
+            ids.count * static_cast<size_t>(dim));
+        pt_ps_table_pull(it->second, ip, ids.count, out->data());
+        return make_arr(cid, seq, kF32,
+                        {static_cast<uint32_t>(ids.count),
+                         static_cast<uint32_t>(dim)},
+                        out->data(), out->size() * 4, out);
+      }
+      case kPushSparse: {
+        std::string name = r.str();
+        ArrView ids = r.arr();
+        ArrView grads = r.arr();
+        double lr = r.f64();   // NaN = use table lr
+        r.done();
+        auto it = sparse.find(name);
+        if (it == sparse.end())
+          return make_err(cid, seq, "KeyError: '" + name + "'");
+        int dim = sparse_dim.at(name);
+        if (grads.count != ids.count * static_cast<size_t>(dim))
+          return make_err(cid, seq, "grads shape does not match (n, dim)");
+        const int64_t* ip = as_i64(ids, sc.i64);
+        const float* gp = as_f32(grads, sc.f32);
+        pt_ps_table_push(it->second, ip, gp, ids.count,
+                         lr != lr ? -1.f : static_cast<float>(lr));
+        return make_ok(cid, seq);
+      }
+      case kBarrier: {
+        std::string tag = r.str();
+        uint64_t tid = r.u64();
+        r.done();
+        std::unique_lock<std::mutex> lk(barrier_mu);
+        auto& st = barriers[tag];       // (waiting, gen)
+        uint64_t gen = st.second;
+        st.first.insert(tid);
+        if (static_cast<int>(st.first.size()) >= num_trainers) {
+          st.first.clear();
+          st.second = gen + 1;
+          barrier_cv.notify_all();
+        } else {
+          barrier_cv.wait_for(lk, std::chrono::seconds(120), [&] {
+            return st.second > gen || stopping.load();
+          });
+          if (st.second <= gen)
+            return make_err(cid, seq,
+                            stopping.load()
+                                ? "server stopping"
+                                : "barrier '" + tag + "' timed out");
+        }
+        return make_ok(cid, seq);
+      }
+      case kCkptNotify: {
+        std::string dirname = r.str();
+        r.done();
+        if (ckpt_cb) ckpt_cb(dirname.c_str());
+        return make_ok(cid, seq);
+      }
+      case kShrink: {
+        std::string name = r.str();
+        uint64_t max_age = r.u64();
+        r.done();
+        auto it = sparse.find(name);
+        if (it == sparse.end())
+          return make_err(cid, seq, "KeyError: '" + name + "'");
+        int64_t removed = pt_ps_table_shrink(it->second, max_age);
+        auto out = std::make_shared<std::vector<int64_t>>(1, removed);
+        return make_arr(cid, seq, kI64, {1}, out->data(), 8, out);
+      }
+      case kListVars: {
+        r.done();
+        std::string d, s;
+        for (auto& kv : dense) {
+          if (!d.empty()) d += "\n";
+          d += kv.first;
+        }
+        for (auto& kv : sparse) {
+          if (!s.empty()) s += "\n";
+          s += kv.first;
+        }
+        return make_names(cid, seq, d, s);
+      }
+      case kStop: {
+        r.done();
+        // serve_conn calls request_stop() AFTER the OK reply is on the
+        // wire (never from a detached thread — an untracked thread
+        // could outlive the Server and touch freed state); only the
+        // LISTENER closes here, live connections drain as clients
+        // close (ps.py parity)
+        return make_ok(cid, seq);
+      }
+      default:
+        return make_err(cid, seq, "unhandled request kind " +
+                        std::to_string(static_cast<int>(kind)));
+    }
+  }
+
+  std::map<std::string, int> sparse_dim;
+
+  // ---- dedup wrapper -------------------------------------------------
+  Reply handle_frame(uint8_t kind, uint64_t cid, uint64_t seq,
+                     const uint8_t* payload, size_t n, Scratch& sc) {
+    if (!mutating_kind(kind) || cid == 0)
+      return handle(kind, cid, seq, payload, n, sc);
+    std::pair<uint64_t, uint64_t> key{cid, seq};
+    {
+      std::unique_lock<std::mutex> lk(dd_mu);
+      for (;;) {
+        auto ci = dd_clients.find(cid);
+        if (ci != dd_clients.end()) {
+          auto ei = ci->second.entries.find(seq);
+          if (ei != ci->second.entries.end()) {
+            ci->second.order.splice(ci->second.order.end(),
+                                    ci->second.order, ei->second.first);
+            Reply r;
+            r.head = ei->second.second;  // cached fully-encoded reply
+            return r;
+          }
+        }
+        if (!dd_inflight.count(key)) {
+          if (ci != dd_clients.end() && ci->second.has_last &&
+              seq + kReplayTolerance <= ci->second.last_seen) {
+            // probable double-apply: the retry's cache entry was
+            // LRU-evicted (observable, ps.py parity)
+            possible_replays.fetch_add(1);
+          }
+          dd_inflight.insert(key);
+          break;
+        }
+        dd_cv.wait_for(lk, std::chrono::seconds(150), [&] {
+          auto cj = dd_clients.find(cid);
+          return (cj != dd_clients.end() &&
+                  cj->second.entries.count(seq)) ||
+                 !dd_inflight.count(key) || stopping.load();
+        });
+        if (stopping.load())
+          return make_err(cid, seq, "server stopping");
+        {
+          auto cj = dd_clients.find(cid);
+          bool cached_now = cj != dd_clients.end() &&
+                            cj->second.entries.count(seq);
+          if (!cached_now && dd_inflight.count(key))
+            return make_err(cid, seq,
+                            "duplicate frame timed out waiting for "
+                            "the original");
+        }
+      }
+    }
+    Reply resp;
+    try {
+      resp = handle(kind, cid, seq, payload, n, sc);
+    } catch (...) {
+      // the in-flight marker must not leak: a waiting retry would
+      // block its full timeout on a request that already died
+      std::lock_guard<std::mutex> lk(dd_mu);
+      dd_inflight.erase(key);
+      dd_cv.notify_all();
+      throw;
+    }
+    {
+      std::lock_guard<std::mutex> lk(dd_mu);
+      ClientLru& lru = dd_clients[cid];
+      if (lru.order.empty() && lru.entries.empty()) {
+        dd_client_order.push_back(cid);
+        while (dd_client_order.size() > kClientsCap) {
+          dd_clients.erase(dd_client_order.front());
+          dd_client_order.pop_front();
+        }
+      }
+      auto oit = lru.order.insert(lru.order.end(), seq);
+      lru.entries[seq] = {oit, resp.flat()};
+      if (!lru.has_last || seq > lru.last_seen) {
+        lru.last_seen = seq;
+        lru.has_last = true;
+      }
+      while (lru.order.size() > kPerClientCap) {
+        lru.entries.erase(lru.order.front());
+        lru.order.pop_front();
+      }
+      dd_inflight.erase(key);
+      dd_cv.notify_all();
+    }
+    return resp;
+  }
+
+  // ---- connection loop ----------------------------------------------
+  void serve_conn(int fd) {
+    std::vector<uint8_t> payload;
+    Scratch sc;
+    for (;;) {
+      uint8_t hdr[kHeaderSize];
+      if (!recv_exact(fd, hdr, kHeaderSize)) break;
+      uint64_t cid = load_le<uint64_t>(hdr + 4);
+      uint64_t seq = load_le<uint64_t>(hdr + 12);
+      uint64_t plen = load_le<uint64_t>(hdr + 20);
+      std::string herr;
+      if (hdr[0] != 'P' || hdr[1] != 'T') herr = "bad magic";
+      else if (hdr[2] != kVersion) herr = "unsupported protocol version";
+      else if (!known_kind(hdr[3])) herr = "unknown message kind";
+      else if (plen > max_msg) herr = "oversized frame";
+      if (!herr.empty()) {
+        // header-level rejection cannot trust cid/seq (ps.py echoes 0s)
+        send_reply(fd, make_err(0, 0, "malformed frame: " + herr));
+        break;
+      }
+      // Aligned recv for the array-carrying kinds (PUSH_GRAD /
+      // PULL_SPARSE / PUSH_SPARSE, whose payload leads with the var
+      // name STR): land the payload at an offset chosen so the FIRST
+      // array's data is 8-byte aligned, making the as_f32/as_i64 copy
+      // a no-op on the hot path regardless of the name's length. The
+      // first array starts at name_len + 16 (PUSH_GRAD: u16 len +
+      // name + u64 tid + dtype/ndim + one u32 dim) or name_len + 8
+      // (sparse kinds) — congruent mod 8, so one pad serves all
+      // three. Costs one extra 2-byte recv on large frames only.
+      size_t pad = 0;
+      bool two_phase = plen > 4096 && plen >= 2 &&
+                       (hdr[3] == kPushGrad || hdr[3] == kPullSparse ||
+                        hdr[3] == kPushSparse);
+      try {
+        if (two_phase) {
+          uint8_t l2[2];
+          if (!recv_exact(fd, l2, 2)) break;
+          uint16_t name_len = load_le<uint16_t>(l2);
+          pad = (8 - ((name_len + 16) % 8)) % 8;
+          payload.resize(pad + plen);
+          std::memcpy(payload.data() + pad, l2, 2);
+          if (!recv_exact(fd, payload.data() + pad + 2, plen - 2))
+            break;
+        } else {
+          payload.resize(plen);
+          if (plen && !recv_exact(fd, payload.data(), plen)) break;
+        }
+      } catch (const std::bad_alloc&) {
+        send_reply(fd, make_err(cid, seq,
+                                "malformed frame: allocation failed"));
+        break;
+      }
+      Reply resp;
+      try {
+        resp = handle_frame(hdr[3], cid, seq, payload.data() + pad,
+                            plen, sc);
+      } catch (const WireErr& e) {
+        send_reply(fd, make_err(cid, seq, "malformed frame: " + e.msg));
+        break;
+      } catch (const std::exception& e) {
+        resp = make_err(cid, seq, std::string("internal: ") + e.what());
+      }
+      if (!send_reply(fd, resp)) break;
+      if (hdr[3] == kStop) request_stop();
+    }
+    ::close(fd);
+    {
+      // notify UNDER the mutex: stop() may destroy this cv the moment
+      // it observes active_conns == 0, and it can only observe that
+      // after we release conn_mu — notifying after the release would
+      // race the destruction
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conn_fds.erase(fd);
+      --active_conns;
+      conn_cv.notify_all();
+    }
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd.load(), nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) return;
+        if (errno == EINTR) continue;
+        if (errno == EMFILE || errno == ENFILE || errno == ECONNABORTED) {
+          // transient resource pressure must not kill the listener
+          std::this_thread::sleep_for(std::chrono::milliseconds(10));
+          continue;
+        }
+        return;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        if (stopping.load()) {
+          ::close(fd);
+          return;
+        }
+        conn_fds.insert(fd);
+        ++active_conns;
+      }
+      std::thread(&Server::serve_conn, this, fd).detach();
+    }
+  }
+
+  int start() {
+    int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) { last_error = "socket() failed"; return -1; }
+    int one = 1;
+    ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      last_error = "bad host '" + host + "' (IPv4 literal required)";
+      ::close(lfd);
+      return -1;
+    }
+    if (::bind(lfd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0) {
+      last_error = "bind failed: " + std::string(std::strerror(errno));
+      ::close(lfd);
+      return -1;
+    }
+    if (::listen(lfd, 128) != 0) {
+      last_error = "listen failed: " + std::string(std::strerror(errno));
+      ::close(lfd);
+      return -1;
+    }
+    socklen_t alen = sizeof(addr);
+    ::getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port = ntohs(addr.sin_port);
+    listen_fd.store(lfd);
+    accept_thread = std::thread(&Server::accept_loop, this);
+    return port;
+  }
+
+  void request_stop() {
+    bool expected = false;
+    if (!stopping.compare_exchange_strong(expected, true)) return;
+    int lfd = listen_fd.load();
+    if (lfd >= 0) ::shutdown(lfd, SHUT_RDWR);
+    // notify under stop_mu: join() checks `stopping` while holding it,
+    // so an unlocked notify could land in the window between its check
+    // and its wait — a lost wakeup the CAS guard would make permanent
+    {
+      std::lock_guard<std::mutex> lk(stop_mu);
+    }
+    stop_cv.notify_all();
+  }
+
+  std::mutex stop_mu;
+  std::condition_variable stop_cv;
+
+  // blocking serve (the listen_and_serv RunImpl role): returns once a
+  // STOP frame (or pt_pss_stop) lands — ctypes releases the GIL around
+  // this call, so a pserver process can just sit in it
+  void join() {
+    std::unique_lock<std::mutex> lk(stop_mu);
+    stop_cv.wait(lk, [&] { return stopping.load(); });
+  }
+
+  void wake_all_waiters() {
+    for (auto& kv : dense) {
+      std::lock_guard<std::mutex> lk(kv.second->mu);
+      kv.second->cv.notify_all();
+    }
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu);
+      barrier_cv.notify_all();
+    }
+    {
+      std::lock_guard<std::mutex> lk(dd_mu);
+      dd_cv.notify_all();
+    }
+  }
+
+  void stop() {
+    request_stop();
+    if (accept_thread.joinable()) accept_thread.join();
+    // close only AFTER the accept thread exited: it reads the fd
+    int lfd = listen_fd.exchange(-1);
+    if (lfd >= 0) ::close(lfd);
+    // Unblock EVERY in-flight connection — socket reads via shutdown,
+    // condition waits via notify (their predicates check `stopping`) —
+    // then wait until all serve threads exited. The wait is unbounded
+    // on purpose: returning while a detached thread still runs would
+    // let the caller free this Server under it (use-after-free); every
+    // blocking path above is stop-interruptible, so the drain is
+    // prompt. Re-notify each tick to catch threads that entered a wait
+    // after the first pass.
+    for (;;) {
+      {
+        std::lock_guard<std::mutex> lk(conn_mu);
+        for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+      }
+      wake_all_waiters();
+      std::unique_lock<std::mutex> lk(conn_mu);
+      if (conn_cv.wait_for(lk, std::chrono::milliseconds(200),
+                           [&] { return active_conns == 0; }))
+        return;
+    }
+  }
+};
+
+}  // namespace psrv
+
+// ---- C ABI ----------------------------------------------------------
+extern "C" {
+
+void* pt_pss_new(const char* host, int port, int num_trainers,
+                 int sync_mode, uint64_t max_msg_bytes) {
+  auto* s = new psrv::Server();
+  s->host = host;
+  s->port = port;
+  s->num_trainers = num_trainers < 1 ? 1 : num_trainers;
+  s->sync_mode = sync_mode != 0;
+  s->max_msg = max_msg_bytes ? max_msg_bytes : (1ull << 31);
+  return s;
+}
+
+void pt_pss_free(void* h) { delete static_cast<psrv::Server*>(h); }
+
+const char* pt_pss_error(void* h) {
+  return static_cast<psrv::Server*>(h)->last_error.c_str();
+}
+
+// opt_kind 0=none 1=sgd 2=momentum 3=adam; decay_kind 0=none 1=l2 2=l1
+int pt_pss_host_dense(void* h, const char* name, const float* value,
+                      const uint32_t* dims, int ndim, int opt_kind,
+                      double lr, double mu_or_b1, double b2, double eps,
+                      int nesterov, int decay_kind, double decay_coeff,
+                      double param_lr) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto v = std::make_unique<psrv::DenseVar>();
+  long n = 1;
+  for (int i = 0; i < ndim; ++i) {
+    v->dims.push_back(dims[i]);
+    n *= dims[i];
+  }
+  v->n = n;
+  v->value = std::make_shared<std::vector<float>>(value, value + n);
+  v->opt = opt_kind;
+  v->lr = lr;
+  v->mu_or_b1 = mu_or_b1;
+  v->b2 = b2;
+  v->eps = eps;
+  v->nesterov = nesterov;
+  v->decay = decay_kind;
+  v->decay_coeff = decay_coeff;
+  v->param_lr = param_lr;
+  s->dense[name] = std::move(v);
+  return 0;
+}
+
+int pt_pss_host_sparse(void* h, const char* name, int dim, int optimizer,
+                       float lr, float eps, uint64_t seed) {
+  auto* s = static_cast<psrv::Server*>(h);
+  void* t = pt_ps_table_new(dim, optimizer, lr, eps, seed);
+  if (!t) return -1;
+  auto it = s->sparse.find(name);
+  if (it != s->sparse.end()) pt_ps_table_free(it->second);
+  s->sparse[name] = t;
+  s->sparse_dim[name] = dim;
+  return 0;
+}
+
+int pt_pss_start(void* h) { return static_cast<psrv::Server*>(h)->start(); }
+
+void pt_pss_stop(void* h) { static_cast<psrv::Server*>(h)->stop(); }
+
+void pt_pss_join(void* h) { static_cast<psrv::Server*>(h)->join(); }
+
+long pt_pss_dense_size(void* h, const char* name) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->dense.find(name);
+  return it == s->dense.end() ? -1 : it->second->n;
+}
+
+uint64_t pt_pss_dense_round(void* h, const char* name) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->dense.find(name);
+  if (it == s->dense.end()) return 0;
+  std::lock_guard<std::mutex> lk(it->second->mu);
+  return it->second->round;
+}
+
+int pt_pss_dense_get(void* h, const char* name, float* out) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->dense.find(name);
+  if (it == s->dense.end()) return -1;
+  std::lock_guard<std::mutex> lk(it->second->mu);
+  std::memcpy(out, it->second->value->data(), it->second->n * 4);
+  return 0;
+}
+
+int pt_pss_dense_set(void* h, const char* name, const float* in, long n) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->dense.find(name);
+  if (it == s->dense.end() || it->second->n != n) return -1;
+  // the old value's pool deleter locks mu: release it after unlocking
+  std::shared_ptr<std::vector<float>> retired;
+  {
+    std::lock_guard<std::mutex> lk(it->second->mu);
+    retired = std::move(it->second->value);
+    it->second->value =
+        std::make_shared<std::vector<float>>(in, in + n);
+  }
+  return 0;
+}
+
+void* pt_pss_sparse_table(void* h, const char* name) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->sparse.find(name);
+  return it == s->sparse.end() ? nullptr : it->second;
+}
+
+typedef void (*pt_pss_ckpt_cb_t)(const char*);
+void pt_pss_set_checkpoint_cb(void* h, pt_pss_ckpt_cb_t cb) {
+  static_cast<psrv::Server*>(h)->ckpt_cb = cb;
+}
+
+uint64_t pt_pss_possible_replays(void* h) {
+  return static_cast<psrv::Server*>(h)->possible_replays.load();
+}
+
+// ---- bench-only loopback client -------------------------------------
+// A C-speed client for the transport benchmark: isolates SERVER-side
+// capacity from the Python client's encode/decode cost (which shares
+// the CPU on 1-core hosts). Speaks the same wire protocol, so it runs
+// against either transport. Returns elapsed seconds for `reps`
+// request/reply cycles, or -1 on error. cid=0 bypasses dedup.
+
+static int bench_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      ::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static bool bench_read_reply(int fd, std::vector<uint8_t>& payload) {
+  uint8_t hdr[psrv::kHeaderSize];
+  if (!psrv::recv_exact(fd, hdr, psrv::kHeaderSize)) return false;
+  uint64_t plen = psrv::load_le<uint64_t>(hdr + 20);
+  payload.resize(plen);
+  if (plen && !psrv::recv_exact(fd, payload.data(), plen)) return false;
+  return hdr[3] != psrv::kErr;
+}
+
+double pt_ps_bench_push(const char* host, int port, const char* name,
+                        long n, int reps) {
+  int fd = bench_connect(host, port);
+  if (fd < 0) return -1.0;
+  // one PUSH_GRAD frame, reused: name | tid u64 | arr f32 [n]
+  size_t name_len = std::strlen(name);
+  std::vector<uint8_t> frame;
+  uint64_t plen = 2 + name_len + 8 + 2 + 4 + 4ull * n;
+  psrv::put_header(frame, psrv::kPushGrad, 0, 0, plen);
+  psrv::put_str(frame, name);
+  size_t at = frame.size();
+  frame.resize(at + 8 + 2 + 4 + 4ull * n, 0);
+  psrv::store_le<uint64_t>(&frame[at], 0);            // trainer_id
+  frame[at + 8] = psrv::kF32;
+  frame[at + 9] = 1;
+  psrv::store_le<uint32_t>(&frame[at + 10],
+                           static_cast<uint32_t>(n));
+  float* data = reinterpret_cast<float*>(&frame[at + 14]);
+  for (long i = 0; i < n; ++i) data[i] = 1.0f;
+  std::vector<uint8_t> reply;
+  // warmup
+  if (!psrv::send_all(fd, frame.data(), frame.size()) ||
+      !bench_read_reply(fd, reply)) {
+    ::close(fd);
+    return -1.0;
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  for (int r = 0; r < reps; ++r) {
+    if (!psrv::send_all(fd, frame.data(), frame.size()) ||
+        !bench_read_reply(fd, reply)) {
+      ::close(fd);
+      return -1.0;
+    }
+  }
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0).count();
+  ::close(fd);
+  return dt;
+}
+
+double pt_ps_bench_pull(const char* host, int port, const char* name,
+                        int reps) {
+  int fd = bench_connect(host, port);
+  if (fd < 0) return -1.0;
+  std::vector<uint8_t> frame;
+  size_t name_len = std::strlen(name);
+  psrv::put_header(frame, psrv::kPullParam, 0, 0, 2 + name_len + 8);
+  psrv::put_str(frame, name);
+  size_t at = frame.size();
+  frame.resize(at + 8, 0);               // min_round = 0
+  std::vector<uint8_t> reply;
+  if (!psrv::send_all(fd, frame.data(), frame.size()) ||
+      !bench_read_reply(fd, reply)) {
+    ::close(fd);
+    return -1.0;
+  }
+  auto t0 = std::chrono::steady_clock::now();
+  for (int r = 0; r < reps; ++r) {
+    if (!psrv::send_all(fd, frame.data(), frame.size()) ||
+        !bench_read_reply(fd, reply)) {
+      ::close(fd);
+      return -1.0;
+    }
+  }
+  double dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0).count();
+  ::close(fd);
+  return dt;
+}
+
+}  // extern "C"

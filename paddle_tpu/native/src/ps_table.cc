@@ -291,6 +291,13 @@ void pt_dense_accum(float* acc, const float* g, long n) {
   });
 }
 
+// g *= s — the fan-in mean (accum / num_trainers) before the rule
+void pt_dense_scale(float* g, long n, float s) {
+  parallel_for(n, [=](long lo, long hi) {
+    for (long i = lo; i < hi; ++i) g[i] *= s;
+  });
+}
+
 // g += coeff * p (L2Decay) / g += coeff * sign(p) (L1Decay) — the
 // append_regularization_ops role, applied before the rule
 void pt_dense_l2_decay(float* g, const float* p, long n, float coeff) {
