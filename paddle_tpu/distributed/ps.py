@@ -786,9 +786,10 @@ class NativeParameterServer:
 
     # -- expressibility ---------------------------------------------------
     @staticmethod
-    def _opt_config(optimizer, regularizer, param_lr):
+    def _opt_config(optimizer, regularizer):
         """(kind, lr, mu_or_b1, b2, eps, nesterov, decay, coeff) or
-        raises NativeUnsupported."""
+        raises NativeUnsupported. (param_lr is NOT folded in here — it
+        passes to the C++ side separately and scales lr per step.)"""
         from paddle_tpu import optimizer as po
         if optimizer is None:
             return (0, 0.0, 0.0, 0.0, 0.0, 0, 0, 0.0)
@@ -831,7 +832,7 @@ class NativeParameterServer:
         if value.dtype != np.float32:
             raise NativeUnsupported(f"dtype {value.dtype}")
         kind, lr, b1, b2, eps, nesterov, decay, coeff = \
-            self._opt_config(optimizer, regularizer, param_lr)
+            self._opt_config(optimizer, regularizer)
         v = np.ascontiguousarray(value, np.float32)
         dims = np.asarray(v.shape or (1,), np.uint32)
         rc = self._lib.pt_pss_host_dense(
@@ -935,9 +936,19 @@ def make_parameter_server(endpoint, num_trainers=1, sync_mode=True,
         return ParameterServer(endpoint, num_trainers, sync_mode)
     try:
         return NativeParameterServer(endpoint, num_trainers, sync_mode)
-    except Exception:
+    except Exception as e:
         if transport == "native":
             raise
+        # auto: a missing toolchain falls back silently by design; any
+        # OTHER failure is a native-path bug that must not hide behind
+        # the ~2x-slower Python transport unannounced
+        if not isinstance(e, NativeUnsupported) and not (
+                isinstance(e, RuntimeError)
+                and "native build failed" in str(e)):
+            logging.getLogger("paddle_tpu.ps").warning(
+                "native PS transport failed unexpectedly (%s: %s) — "
+                "falling back to the Python server",
+                type(e).__name__, e)
         return ParameterServer(endpoint, num_trainers, sync_mode)
 
 

@@ -446,8 +446,6 @@ struct ClientLru {
   std::unordered_map<uint64_t,
       std::pair<std::list<uint64_t>::iterator, std::vector<uint8_t>>>
       entries;
-  uint64_t last_seen = 0;
-  bool has_last = false;
 };
 
 // ---- the server -----------------------------------------------------
@@ -472,9 +470,16 @@ struct Server {
   std::list<uint64_t> dd_client_order;
   std::unordered_map<uint64_t, ClientLru> dd_clients;
   std::set<std::pair<uint64_t, uint64_t>> dd_inflight;
+  // highest seq handled per client — OUTLIVES the reply LRU (own
+  // larger FIFO cap, ps.py _dedup_last_seen parity) so a retry whose
+  // cached reply was evicted, or whose whole client entry was, is
+  // still detectable as a probable double-apply
+  std::list<uint64_t> dd_seen_order;
+  std::unordered_map<uint64_t, uint64_t> dd_last_seen;
   std::atomic<uint64_t> possible_replays{0};
   static constexpr size_t kPerClientCap = 1024;
   static constexpr size_t kClientsCap = 256;
+  static constexpr size_t kLastSeenCap = 16384;
   static constexpr uint64_t kReplayTolerance = 8;
 
   // lifecycle (listen_fd is atomic: stop() rewrites it while the
@@ -718,8 +723,9 @@ struct Server {
           }
         }
         if (!dd_inflight.count(key)) {
-          if (ci != dd_clients.end() && ci->second.has_last &&
-              seq + kReplayTolerance <= ci->second.last_seen) {
+          auto si = dd_last_seen.find(cid);
+          if (si != dd_last_seen.end() &&
+              seq + kReplayTolerance <= si->second) {
             // probable double-apply: the retry's cache entry was
             // LRU-evicted (observable, ps.py parity)
             possible_replays.fetch_add(1);
@@ -769,9 +775,16 @@ struct Server {
       }
       auto oit = lru.order.insert(lru.order.end(), seq);
       lru.entries[seq] = {oit, resp.flat()};
-      if (!lru.has_last || seq > lru.last_seen) {
-        lru.last_seen = seq;
-        lru.has_last = true;
+      auto si = dd_last_seen.find(cid);
+      if (si == dd_last_seen.end()) {
+        dd_last_seen[cid] = seq;
+        dd_seen_order.push_back(cid);
+        while (dd_seen_order.size() > kLastSeenCap) {
+          dd_last_seen.erase(dd_seen_order.front());
+          dd_seen_order.pop_front();
+        }
+      } else if (seq > si->second) {
+        si->second = seq;
       }
       while (lru.order.size() > kPerClientCap) {
         lru.entries.erase(lru.order.front());
@@ -872,6 +885,12 @@ struct Server {
           std::this_thread::sleep_for(std::chrono::milliseconds(10));
           continue;
         }
+        // unexpected accept failure: record it and unblock join() —
+        // a silently-dead listener would leave run() hanging forever
+        // while trainers time out with no server-side diagnostic
+        last_error = std::string("accept failed: ") +
+                     std::strerror(errno);
+        request_stop();
         return;
       }
       int one = 1;

@@ -29,8 +29,9 @@ def main():
     from paddle_tpu.dataio import DatasetFactory
     from paddle_tpu.distributed import fleet
     fleet.init()       # PaddleCloudRoleMaker reads the launcher env
-    assert fleet.worker_num() == 2
-    assert len(fleet.worker_endpoints()) == 2
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert fleet.worker_num() == world
+    assert len(fleet.worker_endpoints()) == world
 
     ds = DatasetFactory().create_dataset("InMemoryDataset")
     # DISJOINT per-trainer filelist: the exchange must move samples

@@ -22,8 +22,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_collective_worker.py")
 
 
-def _run_single_process():
-    """The local baseline: same problem, same trainer, one process."""
+def _run_single_process(n=2):
+    """The local baseline: same problem, same trainer, one process
+    with an n-device virtual mesh."""
     import jax
     sys.path.insert(0, os.path.join(REPO, "tests"))
     import dist_collective_worker as w
@@ -31,13 +32,17 @@ def _run_single_process():
     import paddle_tpu  # noqa: F401  (mesh helpers import chain)
     from paddle_tpu.parallel.data_parallel import DataParallelTrainer
     from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
-    mesh = make_mesh(MeshConfig(data=2), devices=jax.devices("cpu")[:2])
+    mesh = make_mesh(MeshConfig(data=n), devices=jax.devices("cpu")[:n])
     return w.train(DataParallelTrainer, mesh)
 
 
-class TestTwoProcessCollective:
-    def test_loss_matches_single_process(self, tmp_path):
-        """2 real processes through jax.distributed == 1-process DP."""
+class TestMultiProcessCollective:
+    @pytest.mark.parametrize("nproc", [2, 4])
+    def test_loss_matches_single_process(self, tmp_path, nproc):
+        """n real processes through jax.distributed == 1-process DP.
+        n=2 is the reference's scale (test_dist_base.py:618); n=4
+        exercises coordinator bootstrap and rank/endpoint wiring past
+        the pair case (VERDICT r4 #5)."""
         from paddle_tpu.distributed.launch import launch_collective
         out = tmp_path / "dist.json"
         env_extra = {
@@ -47,8 +52,9 @@ class TestTwoProcessCollective:
                 "PYTHONPATH", ""),
         }
         rc = launch_collective(
-            [WORKER, str(out)], nproc=2, log_dir=str(tmp_path / "logs"),
-            env_extra=env_extra, timeout=240)
+            [WORKER, str(out)], nproc=nproc,
+            log_dir=str(tmp_path / "logs"),
+            env_extra=env_extra, timeout=300)
         if rc != 0:
             logs = ""
             logdir = tmp_path / "logs"
@@ -56,8 +62,8 @@ class TestTwoProcessCollective:
                 logs += f"\n--- {p.name} ---\n" + p.read_text()[-2000:]
             pytest.fail(f"launch_collective rc={rc}{logs}")
         dist = json.loads(out.read_text())
-        assert dist["world"] == 2
-        local = _run_single_process()
+        assert dist["world"] == nproc
+        local = _run_single_process(nproc)
         # same math: cross-process psum(grad)/N == single-process mean
         np.testing.assert_allclose(dist["losses"], local, rtol=1e-5)
         # and it actually trained

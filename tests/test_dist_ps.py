@@ -358,12 +358,18 @@ class TestTranspiledTraining:
 # real multi-process run through the launcher
 # ---------------------------------------------------------------------------
 class TestLaunchPS:
-    def test_two_servers_two_trainers(self, tmp_path):
+    @pytest.mark.parametrize("worker_num", [2, 4])
+    def test_two_servers_n_trainers(self, tmp_path, worker_num):
+        """2 pservers x n trainers through the launcher; the averaged
+        trainer loss stream must match the local full-batch run. n=2
+        is the reference's scale (test_dist_base.py:519); n=4
+        exercises many-trainer fan-in rounds and barrier generations
+        (VERDICT r4 #5)."""
         from paddle_tpu.distributed.launch import launch_ps
         script = os.path.join(os.path.dirname(__file__),
                               "dist_ps_linear.py")
         result = str(tmp_path / "losses")
-        rc = launch_ps([script], server_num=2, worker_num=2,
+        rc = launch_ps([script], server_num=2, worker_num=worker_num,
                        log_dir=str(tmp_path / "logs"),
                        env_extra={"PT_DIST_RESULT": result,
                                   "PYTHONPATH": os.pathsep.join(
@@ -372,7 +378,7 @@ class TestLaunchPS:
                                       + sys.path)})
         assert rc == 0, "distributed run failed; see logs"
         losses = []
-        for tid in range(2):
+        for tid in range(worker_num):
             with open(result + f".{tid}") as f:
                 losses.append(json.load(f))
         local = _local_losses()
