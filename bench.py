@@ -233,6 +233,274 @@ def bench_inference():
                 print(json.dumps(line))
 
 
+def bench_int8():
+    """`python bench.py int8` — int8 vs bf16 inference latency on the
+    chip (VERDICT r4 #2; the reference's int8 story is perf-motivated:
+    trt int8 engine + calibrator, inference/tensorrt/engine.h:43,
+    trt_int8_calibrator.cc, measured with the float16_benchmark.md
+    discipline). Three model shapes at 2-3 batch sizes each:
+
+      mlp        — digits-style fc stack (quantized_mul)
+      resnet50   — the three dominant ResNet-50 conv shapes chained
+                   (quantized_conv2d)
+      bert_layer — one BERT-base encoder layer's matmuls at S=128
+                   (quantized_mul for QKV/proj/FFN)
+
+    Each row prints int8 ms, bf16 ms, and speedup; v5e's MXU runs
+    s8xs8->s32 at 2x the bf16 rate (394 vs 197 TOPS peak), so a row
+    materially above 1.0x means XLA mapped the dot/conv onto int8 MXU
+    passes; below 1.0x means the quantize/dequantize elementwise
+    traffic dominates at that shape (an honest negative, recorded)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.quantize import (quantize_linear, quantized_conv2d,
+                                         quantized_mul)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    steps = 30 if on_tpu else 3
+    rng = np.random.RandomState(0)
+
+    # Each candidate fn(*args, jit_c) -> scalar runs ITERS times
+    # inside ONE jitted fori_loop (the scalar carry perturbs the input
+    # so iterations cannot be CSE'd): at these shapes a single
+    # application is ~0.1 ms of device time against the ~4-5 ms
+    # remote-PJRT dispatch floor, which would swamp any int8-vs-bf16
+    # difference. Reported ms is per INNER iteration.
+    ITERS = 100
+
+    def timed(fn, *args):
+        def looped(*a):
+            def body(i, c):
+                return fn(*a, c * 1e-12)
+            return jax.lax.fori_loop(0, ITERS, body, jnp.float32(0.0))
+
+        jfn = jax.jit(looped)
+
+        def once(carry):
+            return carry, jnp.ravel(jfn(*args))[:1]
+
+        tr = _timed_steps(once, None, steps, settle=2)
+        return tr.dt / steps / ITERS * 1e3, tr.contention_suspected
+
+    def report(tag, mb, int8_ms, bf16_ms, contended):
+        line = {"metric": f"int8_{tag}_mb{mb}_speedup_vs_bf16",
+                "value": round(bf16_ms / int8_ms, 3), "unit": "x",
+                "int8_ms": round(int8_ms, 3),
+                "bf16_ms": round(bf16_ms, 3)}
+        if contended:
+            line["contention_suspected"] = True
+        print(json.dumps(line))
+
+    # -- mlp: 784 -> 512 -> 512 -> 10 (digits-style, scaled up) ----------
+    dims = [784, 512, 512, 10]
+    ws = [rng.randn(a, b).astype(np.float32) * 0.05
+          for a, b in zip(dims, dims[1:])]
+    w_scales = [float(np.abs(w).max()) for w in ws]
+    wq = [np.asarray(quantize_linear(w, s)) for w, s in zip(ws, w_scales)]
+    wb = [jnp.asarray(w, jnp.bfloat16) for w in ws]
+
+    def mlp_int8(x, c):
+        h = x + c
+        for q, s in zip(wq, w_scales):
+            h = jnp.maximum(quantized_mul(h, q, 4.0, s), 0.0)
+        return h.sum()
+
+    def mlp_bf16(x, c):
+        h = (x + c).astype(jnp.bfloat16)
+        for w in wb:
+            h = jnp.maximum(h @ w, 0.0)
+        return h.sum(dtype=jnp.float32)
+
+    for mb in ([64, 512, 4096] if on_tpu else [8]):
+        x = jnp.asarray(rng.rand(mb, dims[0]).astype(np.float32))
+        i_ms, c1 = timed(mlp_int8, x)
+        b_ms, c2 = timed(mlp_bf16, x)
+        report("mlp", mb, i_ms, b_ms, c1 or c2)
+
+    # -- resnet50 conv shapes: the three layer archetypes chained --------
+    # (1x1 expand, 3x3 mid-stage, 1x1 reduce — where ResNet-50's conv
+    # FLOPs live; chaining keeps intermediate activations on device)
+    conv_shapes = [  # (cin, cout, k, hw, stride)
+        (256, 64, 1, 56, 1),
+        (128, 128, 3, 28, 1),
+        (1024, 256, 1, 14, 1),
+    ]
+    cw = [rng.randn(co, ci, k, k).astype(np.float32) * 0.05
+          for ci, co, k, hw, st in conv_shapes]
+    cw_scales = [float(np.abs(w).max()) for w in cw]
+    cwq = [np.asarray(quantize_linear(w, s))
+           for w, s in zip(cw, cw_scales)]
+    cwb = [jnp.asarray(w, jnp.bfloat16) for w in cw]
+
+    def convs_int8(*xs_c):
+        *xs, c = xs_c
+        out = jnp.float32(0.0)
+        for x, q, s, (ci, co, k, hw, st) in zip(xs, cwq, cw_scales,
+                                                conv_shapes):
+            out += quantized_conv2d(x + c, q, 4.0, s, stride=st,
+                                    padding=k // 2).sum()
+        return out
+
+    def convs_bf16(*xs_c):
+        *xs, c = xs_c
+        out = jnp.float32(0.0)
+        for x, w, (ci, co, k, hw, st) in zip(xs, cwb, conv_shapes):
+            dn = jax.lax.conv_dimension_numbers(
+                x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+            out += jax.lax.conv_general_dilated(
+                (x + c).astype(jnp.bfloat16), w, (st, st),
+                [(k // 2, k // 2)] * 2,
+                dimension_numbers=dn).sum(dtype=jnp.float32)
+        return out
+
+    for mb in ([8, 32, 128] if on_tpu else [2]):
+        xs = [jnp.asarray(rng.rand(mb, ci, hw, hw).astype(np.float32))
+              for ci, co, k, hw, st in conv_shapes]
+        i_ms, c1 = timed(convs_int8, *xs)
+        b_ms, c2 = timed(convs_bf16, *xs)
+        report("resnet50convs", mb, i_ms, b_ms, c1 or c2)
+
+    # -- bert encoder layer matmuls (h=768, ffn=3072, S=128) -------------
+    H, F, S = 768, 3072, 128
+    bw = {"qkv": rng.randn(H, 3 * H), "proj": rng.randn(H, H),
+          "up": rng.randn(H, F), "down": rng.randn(F, H)}
+    bw = {k: (v * 0.02).astype(np.float32) for k, v in bw.items()}
+    b_scales = {k: float(np.abs(v).max()) for k, v in bw.items()}
+    bq = {k: np.asarray(quantize_linear(v, b_scales[k]))
+          for k, v in bw.items()}
+    bb = {k: jnp.asarray(v, jnp.bfloat16) for k, v in bw.items()}
+
+    def bert_int8(x, c):
+        qkv = quantized_mul(x + c, bq["qkv"], 8.0, b_scales["qkv"],
+                            x_num_col_dims=2)
+        h = quantized_mul(qkv[..., :H], bq["proj"], 8.0,
+                          b_scales["proj"], x_num_col_dims=2)
+        u = jnp.maximum(quantized_mul(h, bq["up"], 8.0, b_scales["up"],
+                                      x_num_col_dims=2), 0.0)
+        return quantized_mul(u, bq["down"], 8.0, b_scales["down"],
+                             x_num_col_dims=2).sum()
+
+    def bert_bf16(x, c):
+        xb = (x + c).astype(jnp.bfloat16)
+        qkv = xb @ bb["qkv"]
+        h = qkv[..., :H] @ bb["proj"]
+        u = jnp.maximum(h @ bb["up"], 0)
+        return (u @ bb["down"]).sum(dtype=jnp.float32)
+
+    for mb in ([8, 32] if on_tpu else [2]):
+        x = jnp.asarray(rng.rand(mb, S, H).astype(np.float32))
+        i_ms, c1 = timed(bert_int8, x)
+        b_ms, c2 = timed(bert_bf16, x)
+        report("bert_layer", mb, i_ms, b_ms, c1 or c2)
+
+
+def bench_serving():
+    """`python bench.py serving` — multi-thread concurrent serving from
+    Predictor.clone() (VERDICT r4 #3; the reference's harness runs
+    multi-thread inference as a first-class mode,
+    inference/tests/api/tester_helper.h TestMultiThreadPrediction).
+    One model, N=1/4/16 clones each on its own thread hammering run();
+    reports per-thread latency percentiles + aggregate QPS per N."""
+    import tempfile
+    import threading
+
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.inference import Config, create_predictor
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    reqs_per_thread = 200 if on_tpu else 30
+
+    # a 3-conv-block ImageNet-ish CNN head — the AOT cold-start model
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [3, 64, 64], dtype="float32")
+        h = layers.conv2d(x, 32, 3, padding=1, act="relu")
+        h = layers.pool2d(h, 2, pool_stride=2)
+        h = layers.conv2d(h, 64, 3, padding=1, act="relu")
+        h = layers.pool2d(h, 2, pool_stride=2)
+        h = layers.fc(h, 128, act="relu")
+        out = layers.fc(h, 10)
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        d = tempfile.mkdtemp()
+        pt.io.save_inference_model(d, ["x"], [out], exe,
+                                   main_program=main)
+    base = create_predictor(Config(d))
+    rng = np.random.RandomState(0)
+    feed = rng.rand(1, 3, 64, 64).astype(np.float32)
+    np.asarray(base.run({"x": feed})[0])    # compile once, shared
+
+    single_qps = None
+    for n_threads in (1, 4, 16):
+        clones = [base.clone() for _ in range(n_threads)]
+        lat = [[] for _ in range(n_threads)]
+        errs = []
+        start = threading.Barrier(n_threads + 1)
+
+        def serve(tid, c):
+            try:
+                my = rng.rand(1, 3, 64, 64).astype(np.float32)
+                np.asarray(c.run({"x": my})[0])   # warm this clone
+                start.wait()
+                for _ in range(reqs_per_thread):
+                    t0 = time.perf_counter()
+                    np.asarray(c.run({"x": my})[0])
+                    lat[tid].append(time.perf_counter() - t0)
+            except Exception as e:    # pragma: no cover
+                errs.append(e)
+                # a pre-barrier failure must not strand the main
+                # thread's start.wait() forever
+                start.abort()
+
+        ts = [threading.Thread(target=serve, args=(t, c),
+                               daemon=True)
+              for t, c in enumerate(clones)]
+        for t in ts:
+            t.start()
+        try:
+            start.wait()
+        except threading.BrokenBarrierError:
+            pass
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join(600)
+        wall = time.perf_counter() - t0
+        if errs or any(t.is_alive() for t in ts):
+            # a stalled thread means wall/lat are not trustworthy —
+            # emit an error metric, never a confidently wrong QPS line
+            print(json.dumps({
+                "metric": f"serving_{n_threads}t_error",
+                "value": str(errs[0]) if errs
+                else "thread stalled past join timeout"}))
+            continue
+        alls = np.sort(np.concatenate(lat))
+        qps = n_threads * reqs_per_thread / wall
+        line = {
+            "metric": f"serving_qps_{n_threads}_threads",
+            "value": round(qps, 1), "unit": "req/s",
+            "p50_ms": round(float(np.percentile(alls, 50)) * 1e3, 2),
+            "p95_ms": round(float(np.percentile(alls, 95)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(alls, 99)) * 1e3, 2),
+        }
+        if n_threads == 1:
+            single_qps = qps
+        elif single_qps is not None:
+            # only against a MEASURED 1-thread baseline: if that round
+            # errored, later rounds must not fake the scaling metric
+            line["scaling_vs_1_thread"] = round(qps / single_qps, 2)
+        print(json.dumps(line))
+
+
 def bench_longcontext():
     """`python bench.py longcontext` — BERT-base training throughput at
     long sequence lengths on the Pallas flash-attention kernels (the
@@ -366,6 +634,10 @@ def main():
         return bench_inference()
     if len(sys.argv) > 1 and sys.argv[1] == "longcontext":
         return bench_longcontext()
+    if len(sys.argv) > 1 and sys.argv[1] == "int8":
+        return bench_int8()
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        return bench_serving()
     import jax
     import jax.numpy as jnp
 

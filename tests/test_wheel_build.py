@@ -21,21 +21,18 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _have_pip():
-    r = subprocess.run([sys.executable, "-m", "pip", "--version"],
-                       capture_output=True)
-    return r.returncode == 0
-
-
-pytestmark = [
-    pytest.mark.skipif(shutil.which("g++") is None,
-                       reason="no C++ toolchain"),
-    pytest.mark.skipif(not _have_pip(), reason="pip unavailable"),
-]
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no C++ toolchain")
 
 
 @pytest.fixture(scope="module")
 def wheel_path(tmp_path_factory):
+    # pip probed lazily here, not at collection time — a module-level
+    # subprocess would tax EVERY pytest invocation
+    r = subprocess.run([sys.executable, "-m", "pip", "--version"],
+                       capture_output=True)
+    if r.returncode != 0:
+        pytest.skip("pip unavailable")
     out = tmp_path_factory.mktemp("wheelhouse")
     r = subprocess.run(
         [sys.executable, "-m", "pip", "wheel", ".", "--no-deps",
