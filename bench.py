@@ -116,8 +116,14 @@ def bench_resnet50():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    cfg = resnet.resnet50() if on_tpu else resnet.resnet_cifar10(
-        depth=8, image_size=16)
+    # BENCH_RESNET_REMAT=block A/Bs the conv-outputs-only remat
+    # experiment (models/resnet.py ResNetConfig.remat; BASELINE.md
+    # "ResNet-50 remat experiment")
+    rm = os.environ.get("BENCH_RESNET_REMAT", "none")
+    assert rm in ("none", "block"), \
+        f"BENCH_RESNET_REMAT must be none|block, got {rm!r}"
+    cfg = (resnet.resnet50(remat=rm) if on_tpu
+           else resnet.resnet_cifar10(depth=8, image_size=16, remat=rm))
     batch = 256 if on_tpu else 8
     steps = 20 if on_tpu else 3
     mesh = set_mesh(make_mesh(MeshConfig(data=1), devices=jax.devices()[:1]))

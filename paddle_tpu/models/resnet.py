@@ -58,6 +58,19 @@ class ResNetConfig:
     bn_momentum: float = 0.9
     bn_eps: float = 1e-5
     label_smoothing: float = 0.1
+    # HBM-traffic experiment (r5, VERDICT r4 #4): "block" wraps each
+    # residual block in jax.checkpoint saving ONLY conv outputs + BN
+    # statistics — backward recomputes the BN-apply/ReLU elementwise
+    # chain instead of reading stored post-activation tensors, trading
+    # (cheap, fusable) recompute FLOPs for stored-activation reads on
+    # a model the roofline note shows is HBM-bound. Measured numbers
+    # in BASELINE.md "ResNet-50 remat experiment".
+    remat: str = "none"              # "none" | "block"
+
+    def __post_init__(self):
+        if self.remat not in ("none", "block"):
+            raise ValueError(
+                f"remat must be 'none' or 'block', got {self.remat!r}")
 
     @property
     def block(self):
@@ -185,10 +198,15 @@ def _conv(x, w, stride=1, dilation=1):
 def _bn(x, bn, train, momentum, eps):
     """Returns (y, new_stats|None). Batch stats in fp32; under pjit the
     batch-axis mean is a global (cross-replica) mean — sync BN."""
+    from jax.ad_checkpoint import checkpoint_name
     x32 = x.astype(jnp.float32)
     if train:
         mean = jnp.mean(x32, axis=(0, 1, 2))
         var = jnp.mean(jnp.square(x32), axis=(0, 1, 2)) - jnp.square(mean)
+        # tiny per-channel vectors: naming them keeps the remat-block
+        # policy from re-reducing the whole activation in backward
+        mean = checkpoint_name(mean, "bn_stat")
+        var = checkpoint_name(var, "bn_stat")
         new = {"g": bn["g"], "b": bn["b"],
                "mean": momentum * bn["mean"] + (1 - momentum) * mean,
                "var": momentum * bn["var"] + (1 - momentum) * var}
@@ -214,11 +232,56 @@ def _maxpool(x, window=3, stride=2):
         (1, window, window, 1), (1, stride, stride, 1), "SAME")
 
 
+def _block_fwd(x, blk, cfg, stride, train):
+    """One residual block, PURE: returns (out, {bn_key: new_stats}).
+    Purity (updates as return values, not closure mutation) is what
+    lets cfg.remat wrap it in jax.checkpoint."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    def conv(h, w, s=1):
+        return checkpoint_name(_conv(h, w, stride=s), "conv_out")
+
+    upds = {}
+
+    def bn_apply(h, bn, key):
+        y, upd = _bn(h, bn, train, cfg.bn_momentum, cfg.bn_eps)
+        if upd is not None:
+            upds[key] = upd
+        return y
+
+    sc = x
+    if "proj" in blk:
+        sc = bn_apply(conv(x, blk["proj"], stride), blk["proj_bn"],
+                      "proj_bn")
+    if "conv3" in blk:   # bottleneck
+        y = jax.nn.relu(bn_apply(conv(x, blk["conv1"]), blk["bn1"],
+                                 "bn1"))
+        y = jax.nn.relu(bn_apply(conv(y, blk["conv2"], stride),
+                                 blk["bn2"], "bn2"))
+        y = bn_apply(conv(y, blk["conv3"]), blk["bn3"], "bn3")
+    else:                # basic
+        y = jax.nn.relu(bn_apply(conv(x, blk["conv1"], stride),
+                                 blk["bn1"], "bn1"))
+        y = bn_apply(conv(y, blk["conv2"]), blk["bn2"], "bn2")
+    return jax.nn.relu(y + sc), upds
+
+
 def forward(params, cfg, images, train=True):
     """images: [B, H, W, 3] float. Returns (logits fp32, new_params with
     updated BN stats when train else params)."""
     x = images.astype(cfg.dtype)
     new = jax.tree.map(lambda v: v, params)  # shallow-ish structural copy
+
+    block_fn = _block_fwd
+    if cfg.remat == "block" and train:
+        # save only conv outputs + (tiny) BN stats; backward recomputes
+        # the BN-apply/ReLU elementwise chain instead of reading stored
+        # post-activation tensors — an HBM-traffic experiment on a
+        # model the roofline shows is bandwidth-bound (BASELINE.md)
+        block_fn = jax.checkpoint(
+            _block_fwd, static_argnums=(2, 3, 4),
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "conv_out", "bn_stat"))
 
     def bn_apply(x, bn, path):
         y, upd = _bn(x, bn, train, cfg.bn_momentum, cfg.bn_eps)
@@ -237,26 +300,9 @@ def forward(params, cfg, images, train=True):
         _, _, stage_stride = _stages(cfg)[si]
         for bi, blk in enumerate(stage):
             s = stage_stride if bi == 0 else 1
-            sc = x
-            if "proj" in blk:
-                sc = _conv(x, blk["proj"], stride=s)
-                sc = bn_apply(sc, blk["proj_bn"],
-                              ("stages", si, bi, "proj_bn"))
-            if "conv3" in blk:   # bottleneck
-                y = jax.nn.relu(bn_apply(_conv(x, blk["conv1"]), blk["bn1"],
-                                         ("stages", si, bi, "bn1")))
-                y = jax.nn.relu(bn_apply(_conv(y, blk["conv2"], stride=s),
-                                         blk["bn2"],
-                                         ("stages", si, bi, "bn2")))
-                y = bn_apply(_conv(y, blk["conv3"]), blk["bn3"],
-                             ("stages", si, bi, "bn3"))
-            else:                # basic
-                y = jax.nn.relu(bn_apply(_conv(x, blk["conv1"], stride=s),
-                                         blk["bn1"],
-                                         ("stages", si, bi, "bn1")))
-                y = bn_apply(_conv(y, blk["conv2"]), blk["bn2"],
-                             ("stages", si, bi, "bn2"))
-            x = jax.nn.relu(y + sc)
+            x, upds = block_fn(x, blk, cfg, s, train)
+            for key, upd in upds.items():
+                new["stages"][si][bi][key] = upd
     x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global avg pool
     logits = x @ params["head"]["w"] + params["head"]["b"]
     return logits, (new if train else params)
