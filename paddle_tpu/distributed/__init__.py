@@ -10,7 +10,8 @@ from paddle_tpu.distributed.role_maker import (
 from paddle_tpu.distributed.fleet import fleet, DistributedStrategy
 from paddle_tpu.distributed.sparse_embedding import SparseEmbeddingTable
 from paddle_tpu.distributed.ps import (
-    ParameterServer, PSClient, Communicator, run_pserver,
+    ParameterServer, NativeParameterServer, PSClient, Communicator,
+    run_pserver, make_parameter_server,
 )
 from paddle_tpu.distributed.transpiler import (
     DistributeTranspiler, DistributeTranspilerConfig, PServerProgram,

@@ -53,6 +53,20 @@ define_flag("ps_transport", "auto",
             "expressible, else Python), native (require C++), python")
 
 
+def _stop_grace_seconds():
+    """How long a server keeps accepting after a STOP frame before the
+    listener closes. The trainer that sends STOP has finished, but
+    another trainer's final-barrier reply may still be in flight; a
+    client needing a retry in that window must be able to reconnect —
+    immediate listener close turns the race into ECONNREFUSED at the
+    end of an otherwise-successful run. PT_PS_STOP_GRACE overrides
+    (seconds)."""
+    try:
+        return float(os.environ.get("PT_PS_STOP_GRACE", "0.5"))
+    except ValueError:
+        return 0.5
+
+
 # framing delegates to the single shared implementation in wire.py
 _recv_exact = wire.recv_exact
 _send_frame = wire.send_frame
@@ -533,7 +547,15 @@ class ParameterServer:
             return (wire.OK_NAMES, ("\n".join(sorted(self.dense)),
                                     "\n".join(sorted(self.sparse))))
         if kind == wire.STOP:
-            threading.Thread(target=self.stop, daemon=True).start()
+            def stop_after_grace():
+                # only a multi-trainer job has the in-flight-reply
+                # race the grace exists for
+                if self.num_trainers > 1:
+                    time.sleep(_stop_grace_seconds())
+                self.stop()
+
+            threading.Thread(target=stop_after_grace,
+                             daemon=True).start()
             return (wire.OK, ())
         return (wire.ERR, (f"unhandled request kind {kind}",))
 
@@ -776,6 +798,8 @@ class NativeParameterServer:
             self.host.encode(), self.port, num_trainers,
             1 if sync_mode else 0, wire.max_message_bytes())
         enforce(bool(self._h), "pt_pss_new failed")
+        self._lib.pt_pss_set_stop_grace_ms(
+            self._h, int(_stop_grace_seconds() * 1000))
         self.dense = {}            # name -> _NativeDenseView
         self.sparse = {}           # name -> NativeSparseTable view
         self._started = False

@@ -169,6 +169,7 @@ def _bind(lib):
     lib.pt_pss_start.argtypes = [c_void_p]
     lib.pt_pss_stop.argtypes = [c_void_p]
     lib.pt_pss_join.argtypes = [c_void_p]
+    lib.pt_pss_set_stop_grace_ms.argtypes = [c_void_p, ctypes.c_uint64]
     lib.pt_pss_dense_size.restype = c_long
     lib.pt_pss_dense_size.argtypes = [c_void_p, c_char_p]
     lib.pt_pss_dense_round.restype = ctypes.c_uint64

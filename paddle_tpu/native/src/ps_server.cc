@@ -455,6 +455,12 @@ struct Server {
   int num_trainers;
   bool sync_mode;
   uint64_t max_msg;
+  // STOP-frame grace: the trainer that sends STOP has finished, but
+  // ANOTHER trainer's final-barrier reply may still be in flight — if
+  // that client needs a retry it must be able to reconnect. Closing
+  // the listener immediately turns that race into ECONNREFUSED at the
+  // end of an otherwise-successful run (observed ~1/7 under load).
+  uint64_t stop_grace_ms = 500;
 
   std::map<std::string, std::unique_ptr<DenseVar>> dense;
   std::map<std::string, void*> sparse;             // PsTable*
@@ -859,7 +865,14 @@ struct Server {
         resp = make_err(cid, seq, std::string("internal: ") + e.what());
       }
       if (!send_reply(fd, resp)) break;
-      if (hdr[3] == kStop) request_stop();
+      if (hdr[3] == kStop) {
+        // only a multi-trainer job has the in-flight-reply race the
+        // grace exists for; single-trainer teardown stays immediate
+        if (stop_grace_ms && num_trainers > 1 && !stopping.load())
+          std::this_thread::sleep_for(
+              std::chrono::milliseconds(stop_grace_ms));
+        request_stop();
+      }
     }
     ::close(fd);
     {
@@ -1023,6 +1036,10 @@ void* pt_pss_new(const char* host, int port, int num_trainers,
   s->sync_mode = sync_mode != 0;
   s->max_msg = max_msg_bytes ? max_msg_bytes : (1ull << 31);
   return s;
+}
+
+void pt_pss_set_stop_grace_ms(void* h, uint64_t ms) {
+  static_cast<psrv::Server*>(h)->stop_grace_ms = ms;
 }
 
 void pt_pss_free(void* h) { delete static_cast<psrv::Server*>(h); }

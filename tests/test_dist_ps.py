@@ -376,7 +376,11 @@ class TestLaunchPS:
                                       [os.path.dirname(
                                           os.path.dirname(__file__))]
                                       + sys.path)})
-        assert rc == 0, "distributed run failed; see logs"
+        if rc != 0:
+            logs = ""
+            for p in sorted((tmp_path / "logs").glob("*.log")):
+                logs += f"\n--- {p.name} ---\n" + p.read_text()[-2000:]
+            pytest.fail(f"distributed run failed rc={rc}{logs}")
         losses = []
         for tid in range(worker_num):
             with open(result + f".{tid}") as f:
