@@ -274,7 +274,9 @@ def bench_int8():
     # application is ~0.1 ms of device time against the ~4-5 ms
     # remote-PJRT dispatch floor, which would swamp any int8-vs-bf16
     # difference. Reported ms is per INNER iteration.
-    ITERS = 100
+    ITERS = 100 if on_tpu else 2   # CPU smoke: the loop exists to
+    # amortize the TPU tunnel; on CPU 100 conv iterations would take
+    # minutes and measure nothing
 
     def timed(fn, *args):
         def looped(*a):

@@ -62,9 +62,17 @@ def _stop_grace_seconds():
     end of an otherwise-successful run. PT_PS_STOP_GRACE overrides
     (seconds)."""
     try:
-        return float(os.environ.get("PT_PS_STOP_GRACE", "0.5"))
+        v = float(os.environ.get("PT_PS_STOP_GRACE", "0.5"))
     except ValueError:
         return 0.5
+    # clamp: a negative value must mean 'no grace', and inf/nan would
+    # turn shutdown into a hang (sleep(-1) raises in the daemon thread
+    # on the Python path; a negative cast through c_uint64 wraps to
+    # ~forever on the native path)
+    import math as _math
+    if not _math.isfinite(v):
+        return 0.5
+    return min(max(v, 0.0), 60.0)
 
 
 # framing delegates to the single shared implementation in wire.py

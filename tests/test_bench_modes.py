@@ -1,0 +1,54 @@
+"""Smoke tests for the r5 bench modes (int8, serving): each mode must
+run end-to-end on the CPU backend and emit well-formed JSON metric
+lines. Guards the bench CLI against API drift — the driver runs these
+modes on the real chip, where an import error or renamed kwarg would
+otherwise only surface at capture time."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_mode(mode, timeout=600):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "BENCH_WINDOWS": "2",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), mode],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, r.stdout[-500:]
+    return lines
+
+
+class TestBenchModes:
+    def test_int8_mode_emits_speedup_rows(self):
+        lines = _run_mode("int8")
+        metrics = {ln["metric"] for ln in lines}
+        assert any(m.startswith("int8_mlp") for m in metrics)
+        assert any(m.startswith("int8_resnet50convs") for m in metrics)
+        assert any(m.startswith("int8_bert_layer") for m in metrics)
+        for ln in lines:
+            assert ln["unit"] == "x" and ln["value"] > 0
+            assert ln["int8_ms"] > 0 and ln["bf16_ms"] > 0
+
+    def test_serving_mode_emits_qps_rows(self):
+        lines = _run_mode("serving")
+        by_threads = {ln["metric"]: ln for ln in lines}
+        for n in (1, 4, 16):
+            row = by_threads.get(f"serving_qps_{n}_threads")
+            assert row is not None, by_threads.keys()
+            assert row["value"] > 0
+            assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
+        assert "scaling_vs_1_thread" in by_threads[
+            "serving_qps_16_threads"]

@@ -379,7 +379,8 @@ class TestLaunchPS:
         if rc != 0:
             logs = ""
             for p in sorted((tmp_path / "logs").glob("*.log")):
-                logs += f"\n--- {p.name} ---\n" + p.read_text()[-2000:]
+                logs += (f"\n--- {p.name} ---\n"
+                         + p.read_text(errors="replace")[-2000:])
             pytest.fail(f"distributed run failed rc={rc}{logs}")
         losses = []
         for tid in range(worker_num):
