@@ -127,7 +127,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
 
 
 def launch_ps(script_args, server_num, worker_num, started_port=None,
-              log_dir=None, env_extra=None):
+              log_dir=None, env_extra=None, timeout=None):
     host = "127.0.0.1"
     ports = (find_free_ports(server_num, host) if started_port is None
              else list(range(started_port, started_port + server_num)))
@@ -166,7 +166,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                       f"workerlog.{i}", log_dir)
         procs[f"trainer {i}"] = p
         logs.append(f)
-    return _wait(procs, logs)
+    return _wait(procs, logs, timeout=timeout)
 
 
 def _parse_args(argv):

@@ -370,7 +370,7 @@ class TestLaunchPS:
                               "dist_ps_linear.py")
         result = str(tmp_path / "losses")
         rc = launch_ps([script], server_num=2, worker_num=worker_num,
-                       log_dir=str(tmp_path / "logs"),
+                       log_dir=str(tmp_path / "logs"), timeout=300,
                        env_extra={"PT_DIST_RESULT": result,
                                   "PYTHONPATH": os.pathsep.join(
                                       [os.path.dirname(
