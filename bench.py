@@ -457,7 +457,11 @@ def bench_serving():
 
         def serve(tid, c):
             try:
-                my = rng.rand(1, 3, 64, 64).astype(np.float32)
+                # per-thread RandomState: the shared instance is not
+                # thread-safe, and racing draws would make the feed
+                # nondeterministic across runs
+                my_rng = np.random.RandomState(seed=tid)
+                my = my_rng.rand(1, 3, 64, 64).astype(np.float32)
                 np.asarray(c.run({"x": my})[0])   # warm this clone
                 start.wait()
                 for _ in range(reqs_per_thread):

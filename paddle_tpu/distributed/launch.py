@@ -13,6 +13,27 @@ propagate the first failure. Two modes, like the reference:
   TRAINING_ROLE=PSERVER + PADDLE_PSERVER_ENDPOINTS; workers get
   TRAINING_ROLE=TRAINER. Matches the reference's test_dist_base.py:429
   env contract, which role_maker.PaddleCloudRoleMaker consumes.
+
+Beyond the reference (elastic supervision — SURVEY §5.3 pairs
+re-schedulable pod jobs with `io_checkpoint`'s "checkpoint often,
+restart anywhere"): the launcher is a supervisor, not just a spawner.
+
+- `--max_restarts N`: a failed or hung rank triggers a restart with
+  exponential backoff. Collective mode restarts the whole *gang*
+  (survivors would deadlock in the next collective against a dead
+  peer); ps mode restarts individual workers while pservers stay up.
+- `--hang_timeout S`: hang watchdog. Children touch per-rank heartbeat
+  files (see `health.py`; `auto_checkpoint` does it automatically); a
+  rank that beat and then stopped for S seconds is *hung* and its gang
+  is killed + restarted. A rank that never beat is only logged as
+  *slow* — the watchdog never kills workers that don't opt in.
+- `--grace_period S`: SIGTERM to the launcher (the TPU-pod preemption
+  signal) is forwarded to children, which get S seconds to flush
+  (`CheckpointManager.wait()` drains pending async shards) before
+  SIGKILL. The launcher then exits 143 without restarting.
+
+Each child additionally sees PADDLE_RESTART_COUNT (0 on the first
+incarnation) and PADDLE_HEARTBEAT_DIR.
 """
 
 import argparse
@@ -21,9 +42,17 @@ import signal
 import socket
 import subprocess
 import sys
+import shutil
+import tempfile
+import threading
 import time
 
-__all__ = ["launch_collective", "launch_ps", "find_free_ports"]
+from paddle_tpu.distributed import health
+
+__all__ = ["launch_collective", "launch_ps", "find_free_ports",
+           "backoff_delay", "probe_port_range"]
+
+PREEMPTED_RC = 143          # 128 + SIGTERM, the conventional code
 
 
 def find_free_ports(n, host="127.0.0.1"):
@@ -39,49 +68,141 @@ def find_free_ports(n, host="127.0.0.1"):
     return ports
 
 
-def _spawn(cmd, env, log_prefix, log_dir):
+def probe_port_range(host, start, n, claim_desc):
+    """Bind-check every port in the explicitly claimed range
+    [start, start+n) and fail fast naming the full range — an explicit
+    --started_port is never probed by find_free_ports, and a silent
+    collision with an unrelated service surfaces as an inscrutable
+    rendezvous failure much later."""
+    busy = []
+    for port in range(start, start + n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((host, port))
+        except OSError:
+            busy.append(port)
+        finally:
+            s.close()
+    if busy:
+        raise RuntimeError(
+            f"--started_port {start}: port(s) {busy} in the claimed "
+            f"range {start}..{start + n - 1} are already in use; "
+            f"{claim_desc}")
+
+
+def backoff_delay(attempt, base=1.0, cap=30.0):
+    """Exponential restart backoff: base * 2**attempt, capped."""
+    return min(cap, base * (2.0 ** max(attempt, 0)))
+
+
+def _spawn(cmd, env, log_prefix, log_dir, append=False):
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-        out = open(os.path.join(log_dir, f"{log_prefix}.log"), "wb")
+        out = open(os.path.join(log_dir, f"{log_prefix}.log"),
+                   "ab" if append else "wb")
     else:
         out = None
     return subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out
 
 
-def _wait(procs, logs, timeout=None):
-    """Wait for all; on first failure terminate the rest (launch.py's
-    terminate_local_procs role). Returns the worst returncode.
-    ``timeout`` (seconds) kills all survivors and returns 124 — a hung
-    rendezvous must not hang the caller forever."""
-    deadline = None if timeout is None else time.time() + timeout
+def _drain(procs, grace_period, sig=signal.SIGTERM):
+    """Signal every live proc, give them ``grace_period`` seconds to
+    exit, SIGKILL the stragglers; reap everything (no zombies, ports
+    released). Returns True if no SIGKILL was needed."""
+    procs = [p for p in procs if p.poll() is None]
+    for p in procs:
+        try:
+            p.send_signal(sig)
+        except OSError:
+            pass
+    deadline = time.monotonic() + max(grace_period, 0.0)
+    clean = True
+    for p in procs:
+        try:
+            p.wait(timeout=max(deadline - time.monotonic(), 0.0))
+        except subprocess.TimeoutExpired:
+            clean = False
+            p.kill()
+            p.wait()
+    return clean
+
+
+def _install_term_handler(term):
+    """Route SIGTERM (pod preemption) into ``term``; only possible from
+    the main thread (in-process test callers on other threads simply
+    don't get preemption forwarding). Returns an undo callable."""
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+    prev = signal.signal(signal.SIGTERM, lambda s, f: term.set())
+    return lambda: signal.signal(signal.SIGTERM, prev)
+
+
+def _log(msg):
+    print(f"[launch] {msg}", file=sys.stderr, flush=True)
+
+
+def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
+               grace_period):
+    """Poll one gang incarnation to completion.
+
+    ``procs``: name -> Popen; ``ranks``: name -> heartbeat rank (absent
+    = unwatched, e.g. pservers). Returns (status, rc) with status one of
+    "ok" | "fail" | "hung" | "timeout" | "preempted". On every status
+    but "ok" the whole gang has already been torn down and reaped.
+    """
+    start = time.time()
+    warned_slow = False
     try:
-        rc = 0
         alive = dict(procs)
         while alive:
-            if deadline is not None and time.time() > deadline:
-                print(f"[launch] timeout after {timeout}s; killing "
-                      f"{list(alive)}", file=sys.stderr)
-                for q in alive.values():
-                    q.kill()
-                for q in alive.values():
-                    q.wait()        # reap: no zombies, ports released
-                return 124
+            if term.is_set():
+                _log(f"SIGTERM: forwarding to {sorted(alive)} with "
+                     f"{grace_period}s grace for checkpoint flush")
+                if not _drain(alive.values(), grace_period):
+                    _log("grace period expired; SIGKILLed stragglers")
+                return "preempted", PREEMPTED_RC
+            if deadline is not None and time.monotonic() > deadline:
+                _log(f"timeout; killing {sorted(alive)}")
+                _drain(alive.values(), grace_period)
+                return "timeout", 124
             for name, p in list(alive.items()):
                 r = p.poll()
                 if r is None:
                     continue
                 del alive[name]
                 if r != 0:
-                    print(f"[launch] {name} exited with code {r}",
-                          file=sys.stderr)
-                    rc = rc or r
-                    for q in alive.values():
-                        q.terminate()
+                    _log(f"{name} exited with code {r}")
+                    _drain(alive.values(), grace_period)
+                    return "fail", r
+            if hang_timeout is not None and alive:
+                watched = {ranks[n] for n in alive if n in ranks}
+                stale = [(r, age) for r, age in health.stale_ranks(
+                    hb_dir, max(watched, default=-1) + 1, hang_timeout)
+                    if r in watched]
+                if stale:
+                    r0, age = stale[0]
+                    _log(f"watchdog: rank {r0} hung — last heartbeat "
+                         f"{age:.1f}s ago (hang_timeout={hang_timeout}s); "
+                         f"killing gang")
+                    _drain(alive.values(), grace_period)
+                    return "hung", 1
+                if not warned_slow and time.time() - start > hang_timeout:
+                    silent = [r for r in health.silent_ranks(
+                        hb_dir, max(watched, default=-1) + 1)
+                        if r in watched]
+                    if silent:
+                        _log(f"watchdog: rank(s) {silent} slow — no "
+                             f"heartbeat yet {time.time() - start:.1f}s "
+                             f"after gang start (not killed: only a rank "
+                             f"that beat then stopped counts as hung)")
+                    warned_slow = True
             time.sleep(0.2)
-        return rc
+        return "ok", 0
     except KeyboardInterrupt:
         for p in procs.values():
-            p.send_signal(signal.SIGINT)
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
         raise
     finally:
         for f in logs:
@@ -89,8 +210,21 @@ def _wait(procs, logs, timeout=None):
                 f.close()
 
 
+def _make_hb_dir(log_dir):
+    """(dir, is_tmp): a launcher-owned heartbeat dir. With a log_dir it
+    lives there (inspectable, reused); otherwise a tempdir the caller
+    must remove when the launch ends."""
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        d = os.path.join(log_dir, "heartbeat")
+        os.makedirs(d, exist_ok=True)
+        return d, False
+    return tempfile.mkdtemp(prefix="pt_heartbeat_"), True
+
+
 def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
-                      log_dir=None, env_extra=None, timeout=None):
+                      log_dir=None, env_extra=None, timeout=None,
+                      max_restarts=0, hang_timeout=None, grace_period=10.0):
     host = ips.split(",")[0]
     # trainer endpoints double as the jax.distributed rendezvous in
     # collective mode (rank 0's is the coordinator, a long-lived bound
@@ -98,48 +232,108 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     # exchange gets its own dedicated ports, as launch_ps does. One
     # find_free_ports call for both sets: all 2*nproc sockets are
     # bound simultaneously, so the sets are guaranteed disjoint.
-    # NOTE: with an explicit started_port the claimed range is
-    # 2*nproc consecutive ports (trainers, then exchange) — see the
-    # --started_port help text.
     if started_port is None:
         allp = find_free_ports(2 * nproc, host)
     else:
+        probe_port_range(
+            host, started_port, 2 * nproc,
+            f"collective mode claims 2*nproc = {2 * nproc} consecutive "
+            f"ports (nproc trainer endpoints, then nproc global_shuffle "
+            f"exchange endpoints)")
         allp = list(range(started_port, started_port + 2 * nproc))
     ports, xports = allp[:nproc], allp[nproc:]
     endpoints = ",".join(f"{host}:{p}" for p in ports)
     exchange_eps = ",".join(f"{host}:{p}" for p in xports)
-    procs, logs = {}, []
-    for rank in range(nproc):
-        env = dict(os.environ, **(env_extra or {}))
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(nproc),
-            "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[rank]}",
-            "PADDLE_TRAINER_ENDPOINTS": endpoints,
-            "PADDLE_EXCHANGE_ENDPOINTS": exchange_eps,
-            "TRAINING_ROLE": "TRAINER",
-        })
-        p, f = _spawn([sys.executable, "-u"] + script_args, env,
-                      f"workerlog.{rank}", log_dir)
-        procs[f"trainer {rank}"] = p
-        logs.append(f)
-    return _wait(procs, logs, timeout=timeout)
+    hb_dir, hb_tmp = _make_hb_dir(log_dir)
+
+    def spawn_gang(attempt):
+        procs, ranks, logs = {}, {}, []
+        try:
+            for rank in range(nproc):
+                env = dict(os.environ, **(env_extra or {}))
+                env.update({
+                    "PADDLE_TRAINER_ID": str(rank),
+                    "PADDLE_TRAINERS_NUM": str(nproc),
+                    "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[rank]}",
+                    "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                    "PADDLE_EXCHANGE_ENDPOINTS": exchange_eps,
+                    "TRAINING_ROLE": "TRAINER",
+                    "PADDLE_HEARTBEAT_DIR": hb_dir,
+                    "PADDLE_RESTART_COUNT": str(attempt),
+                })
+                p, f = _spawn([sys.executable, "-u"] + script_args, env,
+                              f"workerlog.{rank}", log_dir,
+                              append=attempt > 0)
+                procs[f"trainer {rank}"] = p
+                ranks[f"trainer {rank}"] = rank
+                logs.append(f)
+        except Exception:
+            # a spawn failure mid-gang must not leak the ranks already
+            # started (nor their log handles)
+            _drain(procs.values(), grace_period)
+            for f in logs:
+                if f:
+                    f.close()
+            raise
+        return procs, ranks, logs
+
+    deadline = None if timeout is None else time.monotonic() + timeout
+    term = threading.Event()
+    undo = _install_term_handler(term)
+    try:
+        attempt = 0
+        while True:
+            health.reset(hb_dir, nproc)
+            procs, ranks, logs = spawn_gang(attempt)
+            status, rc = _wait_gang(procs, ranks, logs, deadline,
+                                    hang_timeout, hb_dir, term,
+                                    grace_period)
+            if status in ("ok", "timeout", "preempted"):
+                return rc
+            if attempt >= max_restarts:
+                if max_restarts:
+                    _log(f"gang {status} (rc={rc}); restart budget "
+                         f"{max_restarts} exhausted, giving up")
+                return rc
+            delay = backoff_delay(attempt)
+            attempt += 1
+            # gang restart, not per-rank: surviving ranks would deadlock
+            # in their next collective against the dead peer
+            _log(f"gang {status} (rc={rc}); restarting gang "
+                 f"{attempt}/{max_restarts} after {delay:.1f}s backoff")
+            if term.wait(delay):
+                return PREEMPTED_RC
+            if deadline is not None and time.monotonic() > deadline:
+                _log("timeout expired during restart backoff")
+                return 124
+    finally:
+        undo()
+        if hb_tmp:
+            shutil.rmtree(hb_dir, ignore_errors=True)
 
 
 def launch_ps(script_args, server_num, worker_num, started_port=None,
-              log_dir=None, env_extra=None, timeout=None):
+              log_dir=None, env_extra=None, timeout=None, max_restarts=0,
+              hang_timeout=None, grace_period=10.0):
     host = "127.0.0.1"
-    ports = (find_free_ports(server_num, host) if started_port is None
-             else list(range(started_port, started_port + server_num)))
+    if started_port is None:
+        ports = find_free_ports(server_num, host)
+        wports = find_free_ports(worker_num, host)
+    else:
+        n = server_num + worker_num
+        probe_port_range(
+            host, started_port, n,
+            f"ps mode claims server_num+worker_num = {n} consecutive "
+            f"ports (pserver endpoints, then trainer exchange endpoints)")
+        ports = list(range(started_port, started_port + server_num))
+        wports = list(range(started_port + server_num, started_port + n))
     server_eps = ",".join(f"{host}:{p}" for p in ports)
     # trainers also get their own endpoints: trainer-to-trainer traffic
     # (global_shuffle's sample exchange) rides these in PS mode too
-    wports = (find_free_ports(worker_num, host) if started_port is None
-              else list(range(started_port + server_num,
-                              started_port + server_num + worker_num)))
     worker_eps = ",".join(f"{host}:{p}" for p in wports)
-    procs, logs = {}, []
-    for i in range(server_num):
+    hb_dir, hb_tmp = _make_hb_dir(log_dir)
+
+    def spawn_server(i):
         env = dict(os.environ, **(env_extra or {}))
         env.update({
             "TRAINING_ROLE": "PSERVER",
@@ -148,11 +342,10 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
             "PADDLE_PSERVER_ENDPOINTS": server_eps,
             "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[i]}",
         })
-        p, f = _spawn([sys.executable, "-u"] + script_args, env,
+        return _spawn([sys.executable, "-u"] + script_args, env,
                       f"serverlog.{i}", log_dir)
-        procs[f"pserver {i}"] = p
-        logs.append(f)
-    for i in range(worker_num):
+
+    def spawn_worker(i, attempt):
         env = dict(os.environ, **(env_extra or {}))
         env.update({
             "TRAINING_ROLE": "TRAINER",
@@ -161,18 +354,164 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
             "PADDLE_PSERVER_ENDPOINTS": server_eps,
             "PADDLE_CURRENT_ENDPOINT": f"{host}:{wports[i]}",
             "PADDLE_TRAINER_ENDPOINTS": worker_eps,
+            # only workers heartbeat: pservers share the same
+            # PADDLE_TRAINER_ID numbering, and their request loop has no
+            # natural beat cadence — the watchdog watches trainers
+            "PADDLE_HEARTBEAT_DIR": hb_dir,
+            "PADDLE_RESTART_COUNT": str(attempt),
         })
-        p, f = _spawn([sys.executable, "-u"] + script_args, env,
-                      f"workerlog.{i}", log_dir)
-        procs[f"trainer {i}"] = p
-        logs.append(f)
-    return _wait(procs, logs, timeout=timeout)
+        return _spawn([sys.executable, "-u"] + script_args, env,
+                      f"workerlog.{i}", log_dir, append=attempt > 0)
+
+    servers, workers, logs = {}, {}, []
+    restarts = [0] * worker_num
+    health.reset(hb_dir, worker_num)    # a reused log_dir must not
+                                        # vouch for the new run
+    deadline = None if timeout is None else time.monotonic() + timeout
+    term = threading.Event()
+    # handler first, spawning inside the try: a spawn failure mid-gang
+    # or a SIGTERM in the spawn window must still drain the children
+    # already running
+    undo = _install_term_handler(term)
+    started = time.time()
+    warned_slow = False
+
+    def all_procs():
+        return list(servers.values()) + list(workers.values())
+
+    # worker idx -> monotonic respawn time: backoff never blocks the
+    # supervision loop (a sleeping supervisor would miss pserver
+    # deaths, other workers' faults, preemption, and the global
+    # deadline for up to the backoff cap)
+    pending_respawn = {}
+
+    def fail_worker(i, why):
+        """Individual-worker restart policy: respawn worker i after
+        backoff while the pservers (whose hosted state would be lost in
+        a gang restart) stay up; give up once the budget is spent."""
+        if restarts[i] >= max_restarts:
+            if max_restarts:
+                _log(f"trainer {i} {why}; restart budget {max_restarts} "
+                     f"exhausted, tearing down the job")
+            _drain(all_procs(), grace_period)
+            return False
+        delay = backoff_delay(restarts[i])
+        restarts[i] += 1
+        _log(f"trainer {i} {why}; restarting worker "
+             f"{restarts[i]}/{max_restarts} after {delay:.1f}s backoff "
+             f"(pservers stay up)")
+        pending_respawn[i] = time.monotonic() + delay
+        return True
+
+    try:
+        try:
+            for i in range(server_num):
+                p, f = spawn_server(i)
+                servers[f"pserver {i}"] = p
+                logs.append(f)
+            for i in range(worker_num):
+                p, f = spawn_worker(i, 0)
+                workers[i] = p
+                logs.append(f)
+        except Exception:
+            _drain(all_procs(), grace_period)
+            raise
+        rc = 0
+        done_workers = set()
+        while servers or (set(workers) - done_workers):
+            if term.is_set():
+                live = [n for n, p in servers.items() if p.poll() is None]
+                live += [f"trainer {i}" for i, p in workers.items()
+                         if p.poll() is None]
+                _log(f"SIGTERM: forwarding to {live} with "
+                     f"{grace_period}s grace for checkpoint flush")
+                if not _drain(all_procs(), grace_period):
+                    _log("grace period expired; SIGKILLed stragglers")
+                return PREEMPTED_RC
+            if deadline is not None and time.monotonic() > deadline:
+                _log("timeout; killing survivors")
+                _drain(all_procs(), grace_period)
+                return 124
+            for name, p in list(servers.items()):
+                r = p.poll()
+                if r is None:
+                    continue
+                del servers[name]
+                if r != 0:
+                    # a dead pserver loses hosted state no worker
+                    # restart can recover — fail fast
+                    _log(f"{name} exited with code {r}")
+                    _drain(all_procs(), grace_period)
+                    return r
+            for i, due in list(pending_respawn.items()):
+                if time.monotonic() < due:
+                    continue
+                del pending_respawn[i]
+                try:
+                    os.remove(health.heartbeat_path(hb_dir, i))
+                except OSError:
+                    pass
+                p, f = spawn_worker(i, restarts[i])
+                workers[i] = p
+                logs.append(f)
+            for i, p in list(workers.items()):
+                if i in done_workers or i in pending_respawn:
+                    continue
+                r = p.poll()
+                if r is None:
+                    continue
+                if r == 0:
+                    done_workers.add(i)
+                    continue
+                _log(f"trainer {i} exited with code {r}")
+                if not fail_worker(i, f"failed (rc={r})"):
+                    return r
+            if hang_timeout is not None:
+                alive_w = [i for i, p in workers.items()
+                           if p.poll() is None and i not in done_workers]
+                stale = [(r, age) for r, age in health.stale_ranks(
+                    hb_dir, worker_num, hang_timeout) if r in alive_w]
+                if stale:
+                    i, age = stale[0]
+                    _log(f"watchdog: trainer {i} hung — last heartbeat "
+                         f"{age:.1f}s ago (hang_timeout={hang_timeout}s); "
+                         f"killing worker")
+                    # no grace: a hung worker won't act on SIGTERM, and
+                    # waiting would stall the supervision of everyone
+                    # else (the invariant pending_respawn preserves)
+                    _drain([workers[i]], 0.0)
+                    if not fail_worker(i, f"hung ({age:.1f}s without "
+                                          f"heartbeat)"):
+                        return 1
+                elif not warned_slow and time.time() - started > hang_timeout:
+                    silent = [r for r in health.silent_ranks(
+                        hb_dir, worker_num) if r in alive_w]
+                    if silent:
+                        _log(f"watchdog: trainer(s) {silent} slow — no "
+                             f"heartbeat yet (not killed: only a rank "
+                             f"that beat then stopped counts as hung)")
+                    warned_slow = True
+            time.sleep(0.2)
+        return rc
+    except KeyboardInterrupt:
+        for p in all_procs():
+            if p.poll() is None:
+                p.send_signal(signal.SIGINT)
+        raise
+    finally:
+        undo()
+        if hb_tmp:
+            shutil.rmtree(hb_dir, ignore_errors=True)
+        for f in logs:
+            if f:
+                f.close()
 
 
 def _parse_args(argv):
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.distributed.launch",
-        description="spawn one training process per rank (launch.py parity)")
+        description="spawn one training process per rank (launch.py "
+                    "parity) with elastic supervision")
     ap.add_argument("--nproc_per_node", type=int, default=None,
                     help="collective mode: trainers on this node "
                          "(default: local device count)")
@@ -181,12 +520,33 @@ def _parse_args(argv):
                     help="first port of the claimed range; collective "
                          "mode claims 2*nproc consecutive ports "
                          "(trainer endpoints, then global_shuffle "
-                         "exchange endpoints)")
+                         "exchange endpoints). The full range is "
+                         "bind-probed up front and the launch fails "
+                         "fast on any collision.")
     ap.add_argument("--server_num", type=int, default=0,
                     help="ps mode: pserver process count")
     ap.add_argument("--worker_num", type=int, default=0,
                     help="ps mode: trainer process count")
     ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--max_restarts", type=int, default=0,
+                    help="restart budget for failed/hung ranks with "
+                         "exponential backoff: collective mode restarts "
+                         "the whole gang, ps mode restarts individual "
+                         "workers (per-worker budget) while pservers "
+                         "stay up")
+    ap.add_argument("--hang_timeout", type=float, default=None,
+                    help="hang watchdog: kill+restart a gang whose rank "
+                         "heartbeat once and then stopped for this many "
+                         "seconds (see distributed/health.py; "
+                         "auto_checkpoint heartbeats automatically)")
+    ap.add_argument("--grace_period", type=float, default=10.0,
+                    help="seconds between SIGTERM (forwarded on "
+                         "launcher preemption, or sent before any "
+                         "teardown) and SIGKILL — the window for "
+                         "CheckpointManager.wait() to flush")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="global wall-clock budget across all restarts; "
+                         "exceeded -> kill everything, exit 124")
     ap.add_argument("training_script")
     ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return ap.parse_args(argv)
@@ -197,7 +557,11 @@ def main(argv=None):
     script = [args.training_script] + args.training_script_args
     if args.server_num or args.worker_num:
         rc = launch_ps(script, args.server_num, max(args.worker_num, 1),
-                       args.started_port, args.log_dir)
+                       args.started_port, args.log_dir,
+                       timeout=args.timeout,
+                       max_restarts=args.max_restarts,
+                       hang_timeout=args.hang_timeout,
+                       grace_period=args.grace_period)
     else:
         nproc = args.nproc_per_node
         if nproc is None:
@@ -207,7 +571,10 @@ def main(argv=None):
             except Exception:
                 nproc = 1
         rc = launch_collective(script, nproc, args.started_port, args.ips,
-                               args.log_dir)
+                               args.log_dir, timeout=args.timeout,
+                               max_restarts=args.max_restarts,
+                               hang_timeout=args.hang_timeout,
+                               grace_period=args.grace_period)
     sys.exit(rc)
 
 

@@ -891,7 +891,7 @@ class NativeParameterServer:
         enforce(rc == 0, "pt_pss_host_sparse failed")
         handle = self._lib.pt_pss_sparse_table(self._h, name.encode())
         self.sparse[name] = self._native_mod.NativeSparseTable \
-            .from_handle(handle, dim)
+            .from_handle(handle, dim, owner=self)
 
     # -- checkpoint (same artifacts as ParameterServer.save/load) ---------
     def _on_checkpoint(self, dirname):
@@ -955,6 +955,15 @@ class NativeParameterServer:
             pass
 
 
+def _is_missing_toolchain(e):
+    """True for the RuntimeError the lazy native build raises when no
+    C++ toolchain is present (native/_build) — the one native-transport
+    failure that auto mode swallows silently by design. Shared by
+    make_parameter_server and PServerProgram.build_server so the two
+    fallback sites can't drift."""
+    return isinstance(e, RuntimeError) and "native build failed" in str(e)
+
+
 def make_parameter_server(endpoint, num_trainers=1, sync_mode=True,
                           transport=None):
     """Factory honoring FLAGS_ps_transport: the C++ server when the
@@ -974,9 +983,8 @@ def make_parameter_server(endpoint, num_trainers=1, sync_mode=True,
         # auto: a missing toolchain falls back silently by design; any
         # OTHER failure is a native-path bug that must not hide behind
         # the ~2x-slower Python transport unannounced
-        if not isinstance(e, NativeUnsupported) and not (
-                isinstance(e, RuntimeError)
-                and "native build failed" in str(e)):
+        if not isinstance(e, NativeUnsupported) \
+                and not _is_missing_toolchain(e):
             logging.getLogger("paddle_tpu.ps").warning(
                 "native PS transport failed unexpectedly (%s: %s) — "
                 "falling back to the Python server",

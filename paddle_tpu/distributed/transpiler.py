@@ -234,7 +234,8 @@ class PServerProgram:
                 # missing toolchain fall back silently by design; any
                 # OTHER failure is a native-path bug that must not hide
                 # behind the ~2x-slower Python transport unannounced
-                if not isinstance(e, _ps.NativeUnsupported):
+                if not isinstance(e, _ps.NativeUnsupported) \
+                        and not _ps._is_missing_toolchain(e):
                     logging.getLogger("paddle_tpu.ps").warning(
                         "native PS transport failed unexpectedly "
                         "(%s: %s) — falling back to the Python server",

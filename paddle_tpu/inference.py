@@ -134,6 +134,7 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
     platform (default cpu+tpu) so the .shlo artifact really is
     cross-platform. Returns the index entries."""
     import jax
+    import jax.export  # not in the jax namespace by default on this pin
     from jax.experimental import serialize_executable as se
 
     fn, state_names = _build_pure_fn(program, feed_names, fetch_names)
@@ -374,6 +375,7 @@ class Predictor:
             # unboundedly in a long-lived server
             return None
         import jax
+        import jax.export  # not in the jax namespace by default here
 
         aot_dir = os.path.join(self.config.model_dir, AOT_DIR)
         fn = None

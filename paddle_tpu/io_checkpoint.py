@@ -21,8 +21,10 @@ execution; ref save_combine_op.cc writes raw tensors the same way).
 """
 
 import json
+import logging
 import os
 import queue
+import signal
 import threading
 import time
 
@@ -55,10 +57,21 @@ class CheckpointManager:
     should_save(step)           -> interval policy check
     """
 
+    #: transient disk-error policy: a failed shard write is retried
+    #: ``disk_retries`` times with doubling backoff (capped) before the
+    #: error is surfaced on the next save()/wait() — an NFS blip or
+    #: ENOSPC race must not silently cost a checkpoint interval
+    disk_retries = 3
+    retry_backoff = 0.1
+    retry_backoff_cap = 2.0
+
     def __init__(self, dirname, keep_max=3, save_interval_steps=100,
-                 save_interval_secs=None, async_save=True):
+                 save_interval_secs=None, async_save=True,
+                 disk_retries=None):
         self.dirname = dirname
         self.keep_max = keep_max
+        if disk_retries is not None:
+            self.disk_retries = disk_retries
         self.save_interval_steps = save_interval_steps
         self.save_interval_secs = save_interval_secs
         self._last_save_time = time.monotonic()
@@ -96,7 +109,7 @@ class CheckpointManager:
         payload = (int(step), manifest, arrays)
         self._last_save_time = time.monotonic()
         if self._thread is None:
-            self._write(payload)
+            self._write_durable(payload)
         else:
             self._raise_pending()
             self._q.put(payload)
@@ -106,6 +119,24 @@ class CheckpointManager:
             self.save(step, tree)
             return True
         return False
+
+    def _write_durable(self, payload):
+        """_write with capped-backoff retry on transient disk errors
+        (OSError only: the peer-shard timeout RuntimeError is not a
+        disk fault and is never retried)."""
+        delay = self.retry_backoff
+        for attempt in range(self.disk_retries + 1):
+            try:
+                return self._write(payload)
+            except OSError as e:
+                if attempt == self.disk_retries:
+                    raise
+                logging.getLogger("paddle_tpu.checkpoint").warning(
+                    "checkpoint step %s write failed (%s: %s); retry "
+                    "%d/%d in %.2fs", payload[0], type(e).__name__, e,
+                    attempt + 1, self.disk_retries, delay)
+                time.sleep(delay)
+                delay = min(delay * 2.0, self.retry_backoff_cap)
 
     def _write(self, payload):
         step, manifest, arrays = payload
@@ -146,7 +177,7 @@ class CheckpointManager:
                 payload.set()               # wait() barrier
                 continue
             try:
-                self._write(payload)
+                self._write_durable(payload)
             except Exception as e:          # surfaced on next save/wait
                 self._err = e
 
@@ -236,10 +267,27 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
 
     The elastic-recovery loop the reference lacks (SURVEY §5.3): kill the
     process at any point and re-invoking continues from the last saved
-    step.
+    step. Two supervisor hookups when run under
+    ``paddle_tpu.distributed.launch`` (each a no-op otherwise):
+
+    - every step touches this rank's heartbeat file
+      (PADDLE_HEARTBEAT_DIR, see distributed/health.py) so the
+      launcher's --hang_timeout watchdog can tell hung from slow;
+    - SIGTERM (pod preemption, forwarded by the launcher with a
+      --grace_period window) checkpoints the current state, waits for
+      the async writer to publish it, and exits 143 — preemption never
+      loses more than the in-flight step.
     """
+    from paddle_tpu.distributed.health import Heartbeat
     mgr = CheckpointManager(dirname, keep_max=keep_max,
                             save_interval_steps=save_interval_steps)
+    hb = Heartbeat.from_env()
+    preempted = threading.Event()
+    restore_handler = None
+    if threading.current_thread() is threading.main_thread():
+        prev = signal.signal(signal.SIGTERM,
+                             lambda s, f: preempted.set())
+        restore_handler = lambda: signal.signal(signal.SIGTERM, prev)
     try:
         latest = mgr.latest_step()
         if latest is not None:
@@ -249,8 +297,22 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
             state, start = init_state_fn(), 0
         for step in range(start, total_steps):
             state = step_fn(step, state)
-            mgr.maybe_save(step, state)
+            if hb is not None:
+                hb.beat()
+            saved = mgr.maybe_save(step, state)
+            if preempted.is_set():
+                # flush inside the launcher's grace window: save the
+                # completed step (unless the interval policy just did —
+                # a second identical write would eat into the scarce
+                # grace budget), drain the async writer (meta published
+                # = checkpoint complete), then report SIGTERM death
+                if not saved:
+                    mgr.save(step, state)
+                mgr.wait()
+                raise SystemExit(143)
         mgr.save(total_steps - 1, state)
         return state
     finally:
+        if restore_handler is not None:
+            restore_handler()
         mgr.close()

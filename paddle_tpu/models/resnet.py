@@ -392,7 +392,9 @@ def make_train_step(cfg, optimizer, mesh=None, steps_per_call=1):
 
 def _merge_bn_stats(params, bn_params):
     """Take mean/var leaves from bn_params, everything else from params."""
-    flat_p, treedef = jax.tree.flatten_with_path(params)
+    # tree_util spelling: jax.tree.flatten_with_path only exists in
+    # newer jax than this pin (same situation as the shard_map import)
+    flat_p, treedef = jax.tree_util.tree_flatten_with_path(params)
     flat_b = jax.tree.leaves(bn_params)
 
     def pick(item, bleaf):

@@ -502,6 +502,7 @@ class NativeSparseTable:
         self.dim = int(dim)
         self._lib = get_lib()
         self._owned = True
+        self._owner = None
         self._h = self._lib.pt_ps_table_new(
             self.dim, self._OPTS[optimizer], float(lr), float(eps),
             int(seed) & 0xFFFFFFFFFFFFFFFF)
@@ -509,16 +510,19 @@ class NativeSparseTable:
             raise RuntimeError("pt_ps_table_new failed")
 
     @classmethod
-    def from_handle(cls, handle, dim):
+    def from_handle(cls, handle, dim, owner=None):
         """View over a table owned elsewhere (the C++ PS server's
         sparse store): same pull/push/snapshot surface, no free on
-        __del__."""
+        __del__. ``owner`` is the object whose destructor frees the
+        handle (e.g. the NativeParameterServer): the view retains it so
+        a view outliving the server is never a use-after-free."""
         import numpy as np
         self = cls.__new__(cls)
         self._np = np
         self.dim = int(dim)
         self._lib = get_lib()
         self._owned = False
+        self._owner = owner
         self._h = handle
         return self
 

@@ -41,6 +41,7 @@
 #include <mutex>
 #include <set>
 #include <string>
+#include <system_error>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -917,7 +918,22 @@ struct Server {
         conn_fds.insert(fd);
         ++active_conns;
       }
-      std::thread(&Server::serve_conn, this, fd).detach();
+      try {
+        std::thread(&Server::serve_conn, this, fd).detach();
+      } catch (const std::system_error&) {
+        // thread-resource exhaustion (EAGAIN) must not std::terminate
+        // the pserver: drop this connection like the EMFILE branch,
+        // rolling back the bookkeeping the failed thread will never
+        // release
+        ::close(fd);
+        {
+          std::lock_guard<std::mutex> lk(conn_mu);
+          conn_fds.erase(fd);
+          --active_conns;
+          conn_cv.notify_all();
+        }
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+      }
     }
   }
 

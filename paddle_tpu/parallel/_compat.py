@@ -1,0 +1,31 @@
+"""jax version-compat shims shared by the parallel modules.
+
+Two renames this codebase has to straddle (the container pin is older
+than the APIs some call sites were written against):
+
+- ``jax.shard_map`` is top-level only in newer jax; older jax ships it
+  as ``jax.experimental.shard_map.shard_map``.
+- jax>=0.8 renamed shard_map's ``check_rep`` kwarg to ``check_vma``;
+  the kwarg name is probed once, at import.
+
+Import from here instead of re-probing per module — five drifting
+copies of version detection is how compat bugs are born.
+"""
+
+import inspect as _inspect
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+SHARD_MAP_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(shard_map).parameters
+    else "check_rep")
+
+#: splat into a shard_map call to disable replication checking under
+#: either kwarg spelling: ``shard_map(f, ..., **CHECK_DISABLED)``
+CHECK_DISABLED = {SHARD_MAP_CHECK_KW: False}
+
+__all__ = ["shard_map", "SHARD_MAP_CHECK_KW", "CHECK_DISABLED"]

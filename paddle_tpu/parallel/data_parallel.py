@@ -28,18 +28,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from paddle_tpu.core.enforce import EnforceNotMet
 from paddle_tpu.parallel.mesh import DATA_AXIS, data_axes, get_mesh
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
-
-import inspect as _inspect
-
-# jax>=0.8 renamed check_rep -> check_vma; probe once, at import
-_SHARD_MAP_CHECK_KW = (
-    "check_vma"
-    if "check_vma" in _inspect.signature(shard_map).parameters
-    else "check_rep")
+from paddle_tpu.parallel._compat import (
+    SHARD_MAP_CHECK_KW as _SHARD_MAP_CHECK_KW, shard_map,
+)
 
 __all__ = ["shard_batch", "replicate", "zero_param_specs",
            "DataParallelTrainer"]

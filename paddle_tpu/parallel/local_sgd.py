@@ -17,9 +17,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from paddle_tpu.parallel._compat import CHECK_DISABLED as _CHECK_KW
+from paddle_tpu.parallel._compat import shard_map
 from paddle_tpu.parallel.mesh import DATA_AXIS, get_mesh
 
 __all__ = ["LocalSGDTrainer"]
@@ -60,7 +61,7 @@ class LocalSGDTrainer:
         @functools.partial(
             shard_map, mesh=mesh,
             in_specs=(pspec, P(), bspec), out_specs=(P(ax), P()),
-            check_vma=False)
+            **_CHECK_KW)
         def step(params, stepno, local_batch):
             p = jax.tree.map(lambda t: t[0], params)   # this replica's
             loss, grads = jax.value_and_grad(loss_fn)(p, local_batch)

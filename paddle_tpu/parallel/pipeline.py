@@ -29,7 +29,10 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from paddle_tpu.parallel._compat import CHECK_DISABLED as _CHECK_KW
+from paddle_tpu.parallel._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import DATA_AXIS, PIPE_AXIS
@@ -142,7 +145,7 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
 
     return shard_map(f, mesh=mesh,
                      in_specs=(pspec, dspec), out_specs=dspec,
-                     check_vma=False)(stacked_params, microbatches)
+                     **_CHECK_KW)(stacked_params, microbatches)
 
 
 class PipelineModule:
@@ -448,5 +451,5 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
         in_specs=(pspec, dspec,
                   jax.tree.map(lambda _: lspec, labels), hspec),
         out_specs=(P(), pspec, hspec, dspec),
-        check_vma=False)(stacked_params, microbatches, labels,
-                         head_params)
+        **_CHECK_KW)(stacked_params, microbatches, labels,
+                     head_params)

@@ -1,0 +1,4 @@
+"""Test-support utilities shipped with the package (fault injection for
+elastic-supervision tests — see `paddle_tpu.testing.faults`)."""
+
+from paddle_tpu.testing import faults  # noqa: F401

@@ -1,0 +1,102 @@
+"""Env-driven fault injection for elastic-supervision tests.
+
+Production code never imports this module: a test's worker script opts
+in by calling ``maybe_fault(step)`` inside its training loop (and
+``install_slow_write()`` once at startup), and the *test* selects the
+fault through the environment — which crosses the launcher's process
+boundary for free:
+
+- ``PT_FAULT_CRASH_AT_STEP=N``  — hard-exit (``os._exit``, code 23) when
+  the loop reaches step N: a rank crash.
+- ``PT_FAULT_HANG_AT_STEP=N``   — stop making progress at step N while
+  staying alive (and not heartbeating): a hang, for the watchdog.
+- ``PT_FAULT_SLOW_WRITE=S``     — ``install_slow_write()`` patches
+  ``CheckpointManager._write`` to sleep S seconds first: an in-flight
+  async checkpoint, for preemption tests.
+- ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
+  (default: every rank).
+- ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
+  per incarnation: the first firing drops a marker file in ``dir``, and
+  a restarted process that sees the marker runs clean. Without it a
+  crash-at-step fault would re-kill every restart and the job could
+  never finish.
+
+Exit code 23 is deliberately distinct from the launcher's own codes
+(124 timeout, 143 preemption) so tests can assert who died and why.
+"""
+
+import os
+import sys
+import time
+
+__all__ = ["maybe_fault", "install_slow_write", "CRASH_EXIT_CODE"]
+
+CRASH_EXIT_CODE = 23
+
+
+def _int_env(name):
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else None
+
+
+def _applies_to_rank():
+    want = os.environ.get("PT_FAULT_RANK")
+    if want in (None, ""):
+        return True
+    return os.environ.get("PADDLE_TRAINER_ID", "0") == want
+
+
+def _fire_once(tag):
+    """True exactly once per (tag, PT_FAULT_ONCE_DIR) across process
+    incarnations; always True when no once-dir is configured."""
+    d = os.environ.get("PT_FAULT_ONCE_DIR")
+    if not d:
+        return True
+    os.makedirs(d, exist_ok=True)
+    marker = os.path.join(d, f"{tag}.fired")
+    try:
+        # O_EXCL: two racing ranks can't both claim the firing
+        fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    os.write(fd, f"pid={os.getpid()} time={time.time()}\n".encode())
+    os.close(fd)
+    return True
+
+
+def maybe_fault(step):
+    """Call from the training-loop body; injects whatever fault the
+    environment configures for this rank at this step."""
+    if not _applies_to_rank():
+        return
+    crash_at = _int_env("PT_FAULT_CRASH_AT_STEP")
+    if crash_at is not None and step == crash_at and _fire_once("crash"):
+        sys.stderr.write(f"[faults] injected crash at step {step}\n")
+        sys.stderr.flush()
+        os._exit(CRASH_EXIT_CODE)       # no atexit, no flush: a crash
+    hang_at = _int_env("PT_FAULT_HANG_AT_STEP")
+    if hang_at is not None and step == hang_at and _fire_once("hang"):
+        sys.stderr.write(f"[faults] injected hang at step {step}\n")
+        sys.stderr.flush()
+        while True:                     # alive but silent: heartbeats
+            time.sleep(3600)            # stop, SIGKILL is the only exit
+
+
+def install_slow_write():
+    """If PT_FAULT_SLOW_WRITE is set, patch CheckpointManager._write to
+    sleep that many seconds before writing (models a slow disk / large
+    shard, keeping an async checkpoint in flight when SIGTERM lands).
+    Returns True if the patch was installed."""
+    v = os.environ.get("PT_FAULT_SLOW_WRITE")
+    if v in (None, ""):
+        return False
+    secs = float(v)
+    from paddle_tpu.io_checkpoint import CheckpointManager
+    orig = CheckpointManager._write
+
+    def slow_write(self, payload):
+        time.sleep(secs)
+        return orig(self, payload)
+
+    CheckpointManager._write = slow_write
+    return True

@@ -1,0 +1,63 @@
+"""Training worker for the elastic-supervision end-to-end tests.
+
+Deterministic toy training: w starts at 0 and each step moves halfway to
+10, so after n steps w = 10*(1 - 0.5**n) — the final value is a pure
+function of the step count. An interrupted-and-resumed run must
+therefore end bit-identical to an uninterrupted one, which is exactly
+the checkpoint-resume guarantee the tests assert.
+
+Runs under ``paddle_tpu.distributed.launch`` via ``auto_checkpoint``
+(heartbeating and SIGTERM flush come for free) with
+``paddle_tpu.testing.faults`` injecting the failure the test selected
+through the environment.
+
+argv: out_prefix ckpt_root total_steps [step_secs] [save_interval]
+
+Each rank checkpoints under <ckpt_root>/rank<id> (ranks are independent:
+these tests exercise the supervisor, not collectives) and reports to
+<out_prefix>.rank<id>.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    out_prefix, ckpt_root = sys.argv[1], sys.argv[2]
+    total_steps = int(sys.argv[3])
+    step_secs = float(sys.argv[4]) if len(sys.argv) > 4 else 0.05
+    save_interval = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+
+    from paddle_tpu.io_checkpoint import auto_checkpoint
+    from paddle_tpu.testing import faults
+    faults.install_slow_write()
+
+    first_step = []
+
+    def init_state():
+        return {"w": 0.0}
+
+    def step_fn(step, state):
+        if not first_step:
+            first_step.append(step)
+        faults.maybe_fault(step)
+        time.sleep(step_secs)
+        return {"w": state["w"] + 0.5 * (10.0 - state["w"])}
+
+    final = auto_checkpoint(os.path.join(ckpt_root, f"rank{rank}"),
+                            init_state, total_steps, step_fn,
+                            save_interval_steps=save_interval)
+    with open(f"{out_prefix}.rank{rank}.json", "w") as f:
+        json.dump({
+            "w": float(final["w"]),
+            "first_step": first_step[0] if first_step else total_steps,
+            "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                "0")),
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

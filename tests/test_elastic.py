@@ -1,0 +1,438 @@
+"""Elastic supervision tests: restartable launch, hang watchdog,
+preemption-safe shutdown, disk-error retry, and the fault-injection
+harness driving the end-to-end kill/resume runs.
+
+The subprocess-heavy end-to-end runs (gang restart with loss match,
+watchdog hang recovery, launcher-level SIGTERM) carry the `slow`
+marker; everything else is tier-1 fast.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed import health
+from paddle_tpu.distributed.launch import (
+    backoff_delay, launch_collective, launch_ps, probe_port_range,
+)
+from paddle_tpu.io_checkpoint import CheckpointManager, auto_checkpoint
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+SUBPROC_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+
+def _expected_w(n_steps):
+    """The uninterrupted run's final value: w <- w + 0.5*(10-w) from 0."""
+    w = 0.0
+    for _ in range(n_steps):
+        w = w + 0.5 * (10.0 - w)
+    return w
+
+
+def _gang_logs(tmp_path):
+    logs = ""
+    for p in sorted((tmp_path / "logs").glob("*.log")):
+        logs += f"\n--- {p.name} ---\n" + p.read_text()[-2000:]
+    return logs
+
+
+# ---------------------------------------------------------------------------
+class TestBackoff:
+    def test_exponential_schedule(self):
+        assert [backoff_delay(a) for a in range(6)] == \
+            [1.0, 2.0, 4.0, 8.0, 16.0, 30.0]
+
+    def test_cap(self):
+        assert backoff_delay(50) == 30.0
+        assert backoff_delay(3, base=0.5, cap=3.0) == 3.0
+        assert backoff_delay(1, base=0.5) == 1.0
+
+    def test_negative_attempt_clamped(self):
+        assert backoff_delay(-3) == 1.0
+
+
+# ---------------------------------------------------------------------------
+class TestPortProbe:
+    def test_busy_port_named_in_error(self):
+        hold = socket.socket()
+        hold.bind(("127.0.0.1", 0))
+        port = hold.getsockname()[1]
+        try:
+            with pytest.raises(RuntimeError) as ei:
+                probe_port_range("127.0.0.1", port, 4, "test claims 4")
+            msg = str(ei.value)
+            assert str(port) in msg and f"{port}..{port + 3}" in msg
+        finally:
+            hold.close()
+
+    def test_launch_collective_fails_fast_naming_doubled_range(self):
+        """Explicit --started_port: the full 2*nproc claimed range is
+        probed before any spawn, and the error names the doubling."""
+        hold = socket.socket()
+        hold.bind(("127.0.0.1", 0))
+        port = hold.getsockname()[1]
+        try:
+            with pytest.raises(RuntimeError) as ei:
+                launch_collective(["nonexistent.py"], nproc=2,
+                                  started_port=port)
+            assert "2*nproc" in str(ei.value)
+            assert f"{port}..{port + 3}" in str(ei.value)
+        finally:
+            hold.close()
+
+    def test_free_range_probe_passes(self):
+        from paddle_tpu.distributed.launch import find_free_ports
+        # a freshly freed port is overwhelmingly likely still free
+        start = find_free_ports(1)[0]
+        probe_port_range("127.0.0.1", start, 1, "ok")
+
+
+# ---------------------------------------------------------------------------
+class TestHeartbeat:
+    def test_beat_creates_file_and_staleness(self, tmp_path):
+        d = str(tmp_path)
+        hb = health.Heartbeat(d, 0, interval=0.0)
+        assert hb.beat()
+        assert health.last_beat(d, 0) is not None
+        assert health.stale_ranks(d, 1, timeout=5.0) == []
+        # backdate the beat: now it is stale
+        old = time.time() - 60
+        os.utime(hb.path, (old, old))
+        stale = health.stale_ranks(d, 1, timeout=5.0)
+        assert len(stale) == 1 and stale[0][0] == 0
+        assert stale[0][1] > 55
+
+    def test_silent_vs_stale_distinction(self, tmp_path):
+        """A rank that never beat is 'slow' (silent), not 'hung'
+        (stale) — the watchdog only kills the latter."""
+        d = str(tmp_path)
+        health.Heartbeat(d, 0, interval=0.0).beat()
+        assert health.silent_ranks(d, 2) == [1]
+        old = time.time() - 60
+        os.utime(health.heartbeat_path(d, 0), (old, old))
+        assert [r for r, _ in health.stale_ranks(d, 2, 5.0)] == [0]
+        assert health.silent_ranks(d, 2) == [1]
+
+    def test_reset_clears(self, tmp_path):
+        d = str(tmp_path)
+        health.Heartbeat(d, 0, interval=0.0).beat()
+        health.Heartbeat(d, 1, interval=0.0).beat()
+        health.reset(d, 2)
+        assert health.silent_ranks(d, 2) == [0, 1]
+
+    def test_rate_limit(self, tmp_path):
+        hb = health.Heartbeat(str(tmp_path), 0, interval=3600)
+        assert hb.beat()
+        assert not hb.beat()
+        assert hb.beat(force=True)
+
+    def test_from_env(self, tmp_path):
+        assert health.Heartbeat.from_env(env={}) is None
+        hb = health.Heartbeat.from_env(env={
+            health.ENV_DIR: str(tmp_path), health.ENV_RANK: "3"})
+        assert hb is not None and hb.rank == 3
+        hb.beat()
+        assert health.last_beat(str(tmp_path), 3) is not None
+
+    def test_background_thread(self, tmp_path):
+        with health.Heartbeat(str(tmp_path), 0, interval=0.02) as hb:
+            hb.start()
+            time.sleep(0.1)
+        assert health.last_beat(str(tmp_path), 0) is not None
+
+
+# ---------------------------------------------------------------------------
+class _FlakyDisk(CheckpointManager):
+    retry_backoff = 0.01
+    fail_times = 2
+
+    def __init__(self, *a, **kw):
+        self.write_attempts = 0
+        super().__init__(*a, **kw)
+
+    def _write(self, payload):
+        self.write_attempts += 1
+        if self.write_attempts <= self.fail_times:
+            raise OSError(28, "injected ENOSPC")
+        return super()._write(payload)
+
+
+class TestDiskErrorRetry:
+    def test_transient_error_retried_sync(self, tmp_path):
+        mgr = _FlakyDisk(str(tmp_path), async_save=False,
+                         save_interval_steps=1)
+        mgr.save(5, {"w": 1.0})
+        assert mgr.write_attempts == 3
+        assert mgr.latest_step() == 5
+        tree, step = mgr.restore()
+        assert step == 5 and float(tree["w"]) == 1.0
+        mgr.close()
+
+    def test_transient_error_retried_async(self, tmp_path):
+        mgr = _FlakyDisk(str(tmp_path), save_interval_steps=1)
+        mgr.save(7, {"w": 2.0})
+        mgr.wait()
+        assert mgr.latest_step() == 7
+        mgr.close()
+
+    def test_exhausted_retries_surface_sync(self, tmp_path):
+        mgr = _FlakyDisk(str(tmp_path), async_save=False,
+                         disk_retries=1)
+        mgr.fail_times = 99
+        with pytest.raises(OSError):
+            mgr.save(1, {"w": 0.0})
+        assert mgr.write_attempts == 2      # 1 try + 1 retry
+        mgr.close()
+
+    def test_exhausted_retries_surface_async(self, tmp_path):
+        mgr = _FlakyDisk(str(tmp_path), disk_retries=1)
+        mgr.fail_times = 99
+        mgr.save(1, {"w": 0.0})
+        with pytest.raises(OSError):
+            mgr.wait()
+        mgr._err = None                     # let close() drain cleanly
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+class TestSigtermGraceFlush:
+    def test_preemption_saves_then_exits_143(self, tmp_path):
+        """SIGTERM mid-loop: auto_checkpoint saves the completed step,
+        drains the async writer (meta published), exits 143 — and a
+        re-invocation resumes from that checkpoint."""
+
+        def step_fn(step, state):
+            if step == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(0.05)        # let the handler run
+            return {"w": state["w"] + 1.0}
+
+        with pytest.raises(SystemExit) as ei:
+            auto_checkpoint(str(tmp_path), lambda: {"w": 0.0}, 100,
+                            step_fn, save_interval_steps=1000)
+        assert ei.value.code == 143
+        # the flush left a complete, meta-published checkpoint
+        mgr = CheckpointManager(str(tmp_path))
+        assert mgr.latest_step() == 3
+        tree, step = mgr.restore()
+        assert float(tree["w"]) == 4.0
+        mgr.close()
+        # and resume continues from it, not from scratch
+        out = auto_checkpoint(str(tmp_path), lambda: {"w": 0.0}, 6,
+                              lambda s, st: {"w": st["w"] + 1.0},
+                              save_interval_steps=1000)
+        assert float(out["w"]) == 6.0
+
+    def test_handler_restored(self, tmp_path):
+        before = signal.getsignal(signal.SIGTERM)
+        auto_checkpoint(str(tmp_path), lambda: {"w": 0.0}, 2,
+                        lambda s, st: st, save_interval_steps=1)
+        assert signal.getsignal(signal.SIGTERM) == before
+
+
+# ---------------------------------------------------------------------------
+class TestFaultHarness:
+    def test_fire_once_semantics(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_ONCE_DIR", str(tmp_path))
+        assert faults._fire_once("crash")
+        assert not faults._fire_once("crash")       # second incarnation
+        assert faults._fire_once("hang")            # independent tags
+
+    def test_rank_scoping(self, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_RANK", "1")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        assert not faults._applies_to_rank()
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        assert faults._applies_to_rank()
+
+    def test_no_fault_env_is_noop(self, monkeypatch):
+        for k in ("PT_FAULT_CRASH_AT_STEP", "PT_FAULT_HANG_AT_STEP",
+                  "PT_FAULT_RANK", "PT_FAULT_ONCE_DIR"):
+            monkeypatch.delenv(k, raising=False)
+        faults.maybe_fault(0)                       # must not raise
+
+    def test_slow_write_patch(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PT_FAULT_SLOW_WRITE", "0.2")
+        orig = CheckpointManager._write
+        try:
+            assert faults.install_slow_write()
+            mgr = CheckpointManager(str(tmp_path), async_save=False,
+                                    save_interval_steps=1)
+            t0 = time.monotonic()
+            mgr.save(1, {"w": 0.0})
+            assert time.monotonic() - t0 >= 0.2
+            mgr.close()
+        finally:
+            CheckpointManager._write = orig
+
+    def test_slow_write_not_installed_without_env(self, monkeypatch):
+        monkeypatch.delenv("PT_FAULT_SLOW_WRITE", raising=False)
+        assert not faults.install_slow_write()
+
+
+# ---------------------------------------------------------------------------
+class TestPSWorkerRestart:
+    """PS-mode restart policy: a crashed worker is respawned
+    individually; the pservers are never restarted. The worker script is
+    dependency-free so this stays tier-1 fast."""
+
+    SCRIPT = """\
+import os, sys, time
+out = sys.argv[1]
+role = os.environ["TRAINING_ROLE"]
+rank = os.environ["PADDLE_TRAINER_ID"]
+if role == "PSERVER":
+    with open(os.path.join(out, f"pserver{rank}.pids"), "a") as f:
+        f.write(f"{os.getpid()}\\n")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if os.path.exists(os.path.join(out, "done")):
+            sys.exit(0)
+        time.sleep(0.05)
+    sys.exit(7)     # pserver never saw the worker finish
+else:
+    marker = os.path.join(out, "crashed")
+    if not os.path.exists(marker):
+        open(marker, "w").close()
+        sys.exit(23)                    # first incarnation crashes
+    with open(os.path.join(out, "done"), "w"):
+        pass
+    sys.exit(0)
+"""
+
+    def test_worker_restarts_pserver_stays_up(self, tmp_path):
+        script = tmp_path / "ps_worker.py"
+        script.write_text(self.SCRIPT)
+        out = tmp_path / "out"
+        out.mkdir()
+        rc = launch_ps([str(script), str(out)], server_num=1,
+                       worker_num=1, log_dir=str(tmp_path / "logs"),
+                       timeout=90, max_restarts=2, grace_period=2.0)
+        assert rc == 0, _gang_logs(tmp_path)
+        assert (out / "crashed").exists() and (out / "done").exists()
+        pids = (out / "pserver0.pids").read_text().splitlines()
+        assert len(pids) == 1, f"pserver was restarted: pids={pids}"
+
+    def test_no_restart_budget_fails_fast(self, tmp_path):
+        script = tmp_path / "ps_worker.py"
+        script.write_text(self.SCRIPT)
+        out = tmp_path / "out"
+        out.mkdir()
+        rc = launch_ps([str(script), str(out)], server_num=1,
+                       worker_num=1, log_dir=str(tmp_path / "logs"),
+                       timeout=60, max_restarts=0, grace_period=2.0)
+        assert rc == 23                 # the injected crash code
+        assert not (out / "done").exists()
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestElasticEndToEnd:
+    """The acceptance runs: fault-injected crash/hang mid-training ->
+    supervisor restarts -> job resumes from the last complete checkpoint
+    and finishes with the same final loss as an uninterrupted run."""
+
+    TOTAL = 8
+
+    def _launch(self, tmp_path, tag, fault_env, **kw):
+        prefix = tmp_path / f"{tag}.out"
+        ckpt = tmp_path / f"{tag}.ckpt"
+        env = dict(SUBPROC_ENV, **fault_env)
+        if fault_env:
+            env.setdefault("PT_FAULT_ONCE_DIR", str(tmp_path / f"{tag}.once"))
+        rc = launch_collective(
+            [WORKER, str(prefix), str(ckpt), str(self.TOTAL), "0.05"],
+            log_dir=str(tmp_path / "logs"), env_extra=env,
+            timeout=240, **kw)
+        return rc, prefix
+
+    def _report(self, prefix, rank):
+        with open(f"{prefix}.rank{rank}.json") as f:
+            return json.load(f)
+
+    def test_crash_restart_resumes_matching_loss(self, tmp_path):
+        rc, prefix = self._launch(
+            tmp_path, "faulted",
+            {"PT_FAULT_CRASH_AT_STEP": "4", "PT_FAULT_RANK": "1"},
+            nproc=2, max_restarts=2)
+        assert rc == 0, _gang_logs(tmp_path)
+        faulted = self._report(prefix, 1)
+        # the restarted rank resumed mid-training from the last
+        # *complete* checkpoint: the crash at step 4 may race the async
+        # publish of step 3's shard, so resume lands on 3 or 4 — but
+        # never back at 0, and never past the crash
+        assert faulted["restart_count"] == 1
+        assert 0 < faulted["first_step"] <= 4
+        # same final loss as an uninterrupted run
+        rc0, clean_prefix = self._launch(tmp_path, "clean", {}, nproc=2)
+        assert rc0 == 0, _gang_logs(tmp_path)
+        clean = self._report(clean_prefix, 1)
+        assert faulted["w"] == clean["w"] == _expected_w(self.TOTAL)
+        assert self._report(prefix, 0)["w"] == _expected_w(self.TOTAL)
+
+    def test_hang_watchdog_detects_and_recovers(self, tmp_path, capfd):
+        rc, prefix = self._launch(
+            tmp_path, "hung",
+            {"PT_FAULT_HANG_AT_STEP": "3", "PT_FAULT_RANK": "0"},
+            nproc=1, max_restarts=2, hang_timeout=2.0, grace_period=2.0)
+        err = capfd.readouterr().err
+        assert rc == 0, err + _gang_logs(tmp_path)
+        assert "hung" in err        # the watchdog named the cause
+        rep = self._report(prefix, 0)
+        assert rep["restart_count"] == 1
+        assert 0 < rep["first_step"] <= 3
+        assert rep["w"] == _expected_w(self.TOTAL)
+
+    def test_sigterm_flushes_inflight_async_checkpoint(self, tmp_path):
+        """Launcher-level preemption: SIGTERM to the launcher CLI while
+        the worker's async writer is artificially slow leaves a
+        complete (meta-published) checkpoint on disk; launcher exits
+        143."""
+        prefix = tmp_path / "term.out"
+        ckpt = tmp_path / "term.ckpt"
+        env = dict(os.environ, **SUBPROC_ENV,
+                   PT_FAULT_SLOW_WRITE="0.5")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "1", "--grace_period", "30",
+             "--log_dir", str(tmp_path / "logs"),
+             WORKER, str(prefix), str(ckpt), "2000", "0.02", "10"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        rank_dir = ckpt / "rank0"
+        deadline = time.time() + 120
+        # preempt only once training is underway (first shard visible)
+        while time.time() < deadline:
+            if rank_dir.exists() and any(
+                    f.endswith(".npz") or f.endswith(".json")
+                    for f in os.listdir(rank_dir)):
+                break
+            if p.poll() is not None:
+                pytest.fail(f"launcher died early: "
+                            f"{p.stderr.read().decode()[-2000:]}")
+            time.sleep(0.1)
+        else:
+            p.kill()
+            pytest.fail("worker never started checkpointing")
+        time.sleep(0.5)                 # let writes queue up in flight
+        p.send_signal(signal.SIGTERM)
+        out, errb = p.communicate(timeout=120)
+        assert p.returncode == 143, errb.decode()[-2000:]
+        mgr = CheckpointManager(str(rank_dir))
+        step = mgr.latest_step()
+        assert step is not None
+        tree, got = mgr.restore()       # complete: meta + shard readable
+        assert got == step and "w" in tree
+        mgr.close()

@@ -54,7 +54,7 @@ class TestResNet:
         # torchvision resnet50: 25,557,032 params; ours differs only in
         # BN stat bookkeeping (mean/var counted as params here)
         n_stats = sum(int(np.prod(l.shape))
-                      for p, l in jax.tree.flatten_with_path(params)[0]
+                      for p, l in jax.tree_util.tree_flatten_with_path(params)[0]
                       if p[-1].key in ("mean", "var"))
         assert n - n_stats == pytest.approx(25_557_032, rel=0.01)
 

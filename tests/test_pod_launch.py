@@ -127,3 +127,41 @@ class TestLocal:
         assert env["PADDLE_TRAINER_ID"] == "0"
         assert env["PADDLE_TRAINERS_NUM"] == "1"
         assert job["spec"]["completions"] == 1
+
+
+class TestRestartPolicy:
+    """Elastic restart policy in the manifests (mirrors the local
+    launcher's --max_restarts / --grace_period contract)."""
+
+    def _pod_spec(self, argv):
+        jobs = [m for m in _build(argv) if m["kind"] == "Job"]
+        return [j["spec"] for j in jobs]
+
+    def test_default_is_fail_fast(self):
+        for spec in self._pod_spec(["--trainers", "2"]):
+            assert spec["backoffLimit"] == 0
+            assert spec["template"]["spec"]["restartPolicy"] == "Never"
+
+    def test_max_restarts_emits_per_index_onfailure(self):
+        for spec in self._pod_spec(["--trainers", "2",
+                                    "--max-restarts", "3"]):
+            # per-index budget, like the launcher's per-worker
+            # restarts — and backoffLimit must be unset alongside it
+            assert spec["backoffLimitPerIndex"] == 3
+            assert "backoffLimit" not in spec
+            assert (spec["template"]["spec"]["restartPolicy"]
+                    == "OnFailure")
+
+    def test_grace_period_window(self):
+        (spec,) = self._pod_spec(["--disttype", "local",
+                                  "--grace-period", "90"])
+        assert (spec["template"]["spec"]
+                ["terminationGracePeriodSeconds"] == 90)
+
+    def test_ps_mode_both_jobs_get_policy(self):
+        specs = self._pod_spec(["--disttype", "pserver", "--trainers",
+                                "2", "--pservers", "1",
+                                "--max-restarts", "2"])
+        assert len(specs) == 2
+        for spec in specs:
+            assert spec["backoffLimitPerIndex"] == 2

@@ -79,20 +79,38 @@ def _container(args, env, with_tpu):
 
 
 def _indexed_job(name, replicas, args, env, with_tpu):
+    # elastic restart policy mirrors the local launcher's --max_restarts
+    # (see paddle_tpu/distributed/launch.py): with a restart budget the
+    # kubelet restarts failed containers in place (OnFailure) — pod IP
+    # and indexed hostname survive, so the PADDLE_* endpoint env stays
+    # valid — and backoffLimitPerIndex gives each indexed pod its OWN
+    # budget, matching the launcher's per-worker restarts (a job-wide
+    # backoffLimit would let N transient failures spread across
+    # different workers kill the whole job). The checkpoint-resume
+    # guarantee (io_checkpoint.auto_checkpoint) makes the restarted
+    # container continue, not start over.
+    # terminationGracePeriodSeconds is the SIGTERM->SIGKILL window the
+    # in-pod CheckpointManager.wait() flush relies on at preemption.
     spec = {
         "parallelism": replicas,
         "completions": replicas,
         "completionMode": "Indexed",
-        "backoffLimit": 0,
         "template": {
             "metadata": {"labels": {"job-name": name}},
             "spec": {
                 "subdomain": name,      # pairs with headless Service
-                "restartPolicy": "Never",
+                "restartPolicy": ("OnFailure" if args.max_restarts
+                                  else "Never"),
+                "terminationGracePeriodSeconds": args.grace_period,
                 "containers": [_container(args, env, with_tpu)],
             },
         },
     }
+    if args.max_restarts:
+        # backoffLimit must be unset when backoffLimitPerIndex is used
+        spec["backoffLimitPerIndex"] = args.max_restarts
+    else:
+        spec["backoffLimit"] = 0
     if with_tpu:
         spec["template"]["spec"]["nodeSelector"] = {
             "cloud.google.com/gke-tpu-accelerator": args.tpu_type,
@@ -201,6 +219,18 @@ def parse_args(argv=None):
     ap.add_argument("--memory", type=int, default=32,
                     help="memory per pod, GiB")
     ap.add_argument("--port", type=int, default=_BASE_PORT)
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    dest="max_restarts",
+                    help="per-worker restart budget: >0 emits "
+                         "restartPolicy OnFailure (in-place container "
+                         "restarts, endpoints preserved) with this "
+                         "backoffLimitPerIndex; 0 keeps the fail-fast "
+                         "Never/backoffLimit-0 policy")
+    ap.add_argument("--grace-period", type=int, default=30,
+                    dest="grace_period",
+                    help="terminationGracePeriodSeconds: the "
+                         "SIGTERM->SIGKILL window for the checkpoint "
+                         "flush on preemption")
     ap.add_argument("-o", "--output", default=None,
                     help="write here instead of stdout")
     return ap.parse_args(argv)
