@@ -638,6 +638,12 @@ def bench_nmt():
 
 
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "dispatch":
+        # executor host-overhead microbench (small model: the step time
+        # IS the dispatch); lives in bench_dispatch.py, reuses this
+        # module's _timed_steps harness
+        import bench_dispatch
+        return bench_dispatch.main()
     if len(sys.argv) > 1 and sys.argv[1] == "resnet50":
         return bench_resnet50()
     if len(sys.argv) > 1 and sys.argv[1] == "nmt":

@@ -120,13 +120,17 @@ class FileDataLoader:
         """Async prefetch pipeline: a worker thread parses/batches/
         device-puts ahead of the consumer (buffered_reader.cc's
         double-buffering). The thread/queue machinery is the shared
-        background_prefetch helper (static.executor)."""
+        background_prefetch helper (static.executor): a parse_fn
+        exception re-raises HERE with the worker's traceback intact,
+        and abandoning the iterator early (break / close) shuts the
+        worker down."""
         from paddle_tpu.static.executor import background_prefetch
 
-        def put(batch):
-            if self.device_put:
-                import jax
-                batch = jax.device_put(batch)
-            return batch
+        if self.device_put:
+            import jax
+            put = jax.device_put
+        else:
+            def put(batch):
+                return batch
 
         return background_prefetch(self._batches(), put, self.prefetch)

@@ -47,12 +47,28 @@ import tempfile
 import threading
 import time
 
+from paddle_tpu.core.compile_cache import ENV_VAR as CACHE_ENV_VAR
 from paddle_tpu.distributed import health
 
 __all__ = ["launch_collective", "launch_ps", "find_free_ports",
            "backoff_delay", "probe_port_range"]
 
 PREEMPTED_RC = 143          # 128 + SIGTERM, the conventional code
+
+
+def _cache_dir_env(log_dir, env_extra):
+    """Default the workers' persistent XLA compilation-cache dir under
+    the log dir (one shared dir per job: cache keys are content hashes,
+    so ranks and *restarted incarnations* share entries safely). This is
+    what makes elastic restarts cheap — the respawned worker's step
+    compiles replay from disk instead of redoing XLA. An explicit
+    PADDLE_TPU_CACHE_DIR (ambient or via env_extra) wins; no log_dir
+    means no cache (nowhere durable to put it)."""
+    if not log_dir or os.environ.get(CACHE_ENV_VAR) \
+            or (env_extra and env_extra.get(CACHE_ENV_VAR)):
+        return {}
+    return {CACHE_ENV_VAR: os.path.join(os.path.abspath(log_dir),
+                                        "xla_cache")}
 
 
 def find_free_ports(n, host="127.0.0.1"):
@@ -245,12 +261,13 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     endpoints = ",".join(f"{host}:{p}" for p in ports)
     exchange_eps = ",".join(f"{host}:{p}" for p in xports)
     hb_dir, hb_tmp = _make_hb_dir(log_dir)
+    cache_env = _cache_dir_env(log_dir, env_extra)
 
     def spawn_gang(attempt):
         procs, ranks, logs = {}, {}, []
         try:
             for rank in range(nproc):
-                env = dict(os.environ, **(env_extra or {}))
+                env = dict(os.environ, **(env_extra or {}), **cache_env)
                 env.update({
                     "PADDLE_TRAINER_ID": str(rank),
                     "PADDLE_TRAINERS_NUM": str(nproc),
@@ -332,9 +349,10 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     # (global_shuffle's sample exchange) rides these in PS mode too
     worker_eps = ",".join(f"{host}:{p}" for p in wports)
     hb_dir, hb_tmp = _make_hb_dir(log_dir)
+    cache_env = _cache_dir_env(log_dir, env_extra)
 
     def spawn_server(i):
-        env = dict(os.environ, **(env_extra or {}))
+        env = dict(os.environ, **(env_extra or {}), **cache_env)
         env.update({
             "TRAINING_ROLE": "PSERVER",
             "PADDLE_TRAINER_ID": str(i),
@@ -346,7 +364,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                       f"serverlog.{i}", log_dir)
 
     def spawn_worker(i, attempt):
-        env = dict(os.environ, **(env_extra or {}))
+        env = dict(os.environ, **(env_extra or {}), **cache_env)
         env.update({
             "TRAINING_ROLE": "TRAINER",
             "PADDLE_TRAINER_ID": str(i),

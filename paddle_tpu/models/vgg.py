@@ -123,10 +123,19 @@ def loss_fn(params, cfg, images, labels, train=True, rng=None):
     return loss, (new_params, logits)
 
 
-def make_train_step(cfg, optimizer, mesh=None):
+def make_train_step(cfg, optimizer, mesh=None, steps_per_call=1):
+    """(init_fn, step_fn): data-parallel over the "data" axis.
+
+    steps_per_call > 1 scans that many optimizer steps inside ONE
+    jitted dispatch (models/resnet.py's train_from_dataset pattern —
+    amortizes the per-dispatch host gap; see docs/PERFORMANCE.md).
+    step_fn then accepts one batch (reused every inner step) or
+    stacked batches with a leading [steps_per_call] axis; dropout rng
+    splits per inner step so masks stay fresh inside the scan."""
     mesh = mesh or get_mesh()
     rep = NamedSharding(mesh, P())
     dsh = NamedSharding(mesh, P(DATA_AXIS))
+    dsh_k = NamedSharding(mesh, P(None, DATA_AXIS))
 
     def init_fn(rng):
         params = jax.jit(functools.partial(init_params, cfg=cfg),
@@ -145,7 +154,24 @@ def make_train_step(cfg, optimizer, mesh=None):
         acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
         return loss, acc, new_params, new_opt
 
-    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    def multi(params, opt_state, images, labels, rng):
+        stacked = images.ndim == 5      # [K, B, H, W, 3]
+
+        def body(carry, xs):
+            p, o, k = carry
+            im, lb = xs if stacked else (images, labels)
+            k, sub = jax.random.split(k)
+            loss, acc, p, o = step(p, o, im, lb, sub)
+            return (p, o, k), (loss, acc)
+
+        (p, o, _), (losses, accs) = jax.lax.scan(
+            body, (params, opt_state, rng),
+            (images, labels) if stacked else None,
+            length=None if stacked else steps_per_call)
+        return losses[-1], accs[-1], p, o
+
+    jit_step = jax.jit(step if steps_per_call == 1 else multi,
+                       donate_argnums=(0, 1))
 
     step_counter = [0]
 
@@ -155,8 +181,13 @@ def make_train_step(cfg, optimizer, mesh=None):
         if rng is None:
             rng = jax.random.fold_in(jax.random.PRNGKey(0), step_counter[0])
             step_counter[0] += 1
-        images = jax.device_put(images, dsh)
-        labels = jax.device_put(labels, dsh)
+        stacked = np.ndim(images) == 5
+        if stacked and np.shape(images)[0] != steps_per_call:
+            raise ValueError(
+                f"stacked batch leading axis {np.shape(images)[0]} != "
+                f"steps_per_call {steps_per_call}")
+        images = jax.device_put(images, dsh_k if stacked else dsh)
+        labels = jax.device_put(labels, dsh_k if stacked else dsh)
         return jit_step(params, opt_state, images, labels, rng)
 
     return init_fn, step_fn

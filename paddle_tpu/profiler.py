@@ -19,6 +19,7 @@ import jax
 __all__ = [
     "profiler", "start_profiler", "stop_profiler", "reset_profiler",
     "RecordEvent", "record_memory_event", "export_chrome_trace",
+    "compilation_cache_stats",
 ]
 
 _events = []          # (name, start_s, dur_s, tid)
@@ -98,6 +99,15 @@ def reset_profiler():
     _mem_events.clear()
 
 
+def compilation_cache_stats():
+    """Persistent XLA compilation-cache counters
+    ({'hits','misses','requests'}) — fed by jax's monitoring events via
+    core/compile_cache.py. hits > 0 on a restarted worker is the proof
+    of a warm restart (the XLA compile came off disk, no recompile)."""
+    from paddle_tpu.core import compile_cache
+    return compile_cache.stats()
+
+
 def summary(sorted_key="total", profile_path=None):
     agg = {}
     for name, _, dur, _tid in _events:
@@ -108,6 +118,12 @@ def summary(sorted_key="total", profile_path=None):
     for name, (tot, cnt) in rows:
         lines.append(f"{name:<40}{cnt:>8}{tot * 1e3:>12.3f}"
                      f"{tot / cnt * 1e3:>12.3f}")
+    from paddle_tpu.core import compile_cache
+    if compile_cache.is_enabled():
+        cc = compile_cache.stats()
+        lines.append(f"compilation cache: {cc['hits']} hits / "
+                     f"{cc['misses']} misses "
+                     f"({compile_cache.cache_dir()})")
     report = "\n".join(lines)
     if profile_path:
         with open(profile_path, "w") as f:

@@ -15,38 +15,76 @@ state pytree (donated, so updates are in-place in HBM). The autodiff
 pseudo-op (see backward.py) is executed as `jax.value_and_grad` over the
 prefix of the block — one fused XLA computation for
 forward+backward+update, which is the entire point of the TPU design.
+
+Dispatch hot path: the block compiles once, but the eager Python AROUND
+the compiled step must not become the bottleneck either (ROADMAP: "as
+fast as the hardware allows" — on a host-overhead-dominated model the
+old per-step program rescans and DP re-`device_put`s WERE the step
+time). `run()` therefore memoizes a prepared runner per
+(program, feed-signature): state-name/host-out scans and signature
+sorting happen once, DP-mode state stays resident on the mesh
+(no re-put once placed), and `return_numpy=False` returns jax's async
+device arrays so steps N+1.. dispatch while step N computes. The
+prepared step also AOT warm-starts: `Executor.prepare()` lowers and
+compiles eagerly, so with the persistent compilation cache
+(core/compile_cache.py) a restarted worker replays the XLA compile from
+disk. `FLAGS_executor_fast_path=0` restores the legacy per-step rescans
+(the A/B lever bench_dispatch.py measures against).
 """
 
-import collections
 import threading
+import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.enforce import EnforceNotMet, enforce
+from paddle_tpu.core.flags import define_flag, get_flag
+from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.static.program import (
     OP_REGISTRY, Parameter, default_main_program, default_startup_program,
 )
 
+define_flag("executor_fast_path", True,
+            "Memoize a prepared runner per (program, feed-signature) so "
+            "the steady-state step skips per-step state rescans and DP "
+            "re-device_puts (0 = legacy per-step preparation)")
+
 
 class Scope:
     """Name → value store (framework/scope.h parity, flattened: XLA owns
-    device memory, so a scope is just the host-side name table)."""
+    device memory, so a scope is just the host-side name table).
+
+    ``version`` counts NAME-SET changes only (a var created or dropped),
+    not value updates — the executor's prepared runners key on it to
+    notice a scope gaining vars (lazily created optimizer state, host-op
+    outputs) without rescanning the program every step."""
 
     def __init__(self):
         self._vars = {}
+        self._version = 0
+
+    @property
+    def version(self):
+        return self._version
 
     def var(self, name):
+        if name not in self._vars:
+            self._version += 1
         return self._vars.setdefault(name, None)
 
     def find_var(self, name):
         return self._vars.get(name)
 
     def set_var(self, name, value):
+        if name not in self._vars:
+            self._version += 1
         self._vars[name] = value
 
     def drop_var(self, name):
+        if name in self._vars:
+            self._version += 1
         self._vars.pop(name, None)
 
     def names(self):
@@ -96,14 +134,29 @@ def _as_feed_array(v):
     return jnp.asarray(np.asarray(v))
 
 
+class _PrefetchFailure:
+    """Carrier for a producer-thread exception: the worker wraps instead
+    of enqueueing the bare exception so (a) an Exception legitimately
+    yielded as DATA is never mis-raised, and (b) the original traceback
+    rides along explicitly and re-raises in the consumer with the
+    producer frames intact."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def background_prefetch(producer, transform, depth=2):
     """Generic background-thread prefetch pipeline: a worker thread
     pulls items from ``producer`` (an iterable), applies ``transform``,
     and queues up to ``depth`` results ahead of the consumer
     (``depth <= 0`` = unbounded read-ahead). Producer exceptions
-    re-raise in the consumer; early consumer exit drains the queue so
-    the worker's blocked put can finish. Shared by device_prefetch and
-    dataio's FileDataLoader."""
+    re-raise in the consumer with the producer's traceback; early
+    consumer exit (break / .close()) stops and unblocks the worker —
+    its puts time-slice against the stop flag, so it can never stay
+    parked on a full queue after the consumer is gone. Shared by
+    device_prefetch and dataio's FileDataLoader."""
     import queue as _queue
     import threading
 
@@ -111,26 +164,40 @@ def background_prefetch(producer, transform, depth=2):
     SENTINEL = object()
     stop = threading.Event()
 
+    def put(item):
+        # never block forever: the consumer may have exited (its drain
+        # can race with a worker still inside transform), so a plain
+        # q.put could park this thread on a full queue for good
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
     def worker():
         try:
             for b in producer:
                 if stop.is_set():
                     return
-                q.put(transform(b))
-        except Exception as e:           # surface in consumer
-            q.put(e)
+                if not put(transform(b)):
+                    return
+        except BaseException as e:       # surface in consumer
+            put(_PrefetchFailure(e))
             return
-        q.put(SENTINEL)
+        put(SENTINEL)
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, daemon=True,
+                         name="pt-prefetch-worker")
     t.start()
     try:
         while True:
             item = q.get()
             if item is SENTINEL:
                 break
-            if isinstance(item, Exception):
-                raise item
+            if isinstance(item, _PrefetchFailure):
+                raise item.exc.with_traceback(item.exc.__traceback__)
             yield item
     finally:
         stop.set()
@@ -178,13 +245,158 @@ def exec_op(op, env, key):
     return bound
 
 
+_ABSENT = object()
+
+
+def _spec_of(v):
+    """jax.ShapeDtypeStruct for an array-like / (shape, dtype) pair /
+    existing spec — the currency of AOT warm-start."""
+    if isinstance(v, jax.ShapeDtypeStruct):
+        return v
+    if isinstance(v, tuple) and len(v) == 2 and not hasattr(v, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(v[0]), np.dtype(v[1]))
+    return jax.ShapeDtypeStruct(np.shape(v), np.asarray(v).dtype
+                                if not hasattr(v, "dtype") else v.dtype)
+
+
+class _CompiledStep:
+    """One compiled (program, signature) step: the block partitioned
+    into host/device segments with each device segment jitted. Callable
+    as (state, feeds, base_key, step_idx) -> (fetches, new_state); also
+    exposes the segment structure so `aot_compile` can lower+compile
+    eagerly (warm-starting the persistent compilation cache)."""
+
+    __slots__ = ("segs", "seg_fns", "constants", "state_set",
+                 "state_names", "fetch_names", "interpret",
+                 "_donate_names", "donated_fetch_idx")
+
+    def __init__(self, segs, seg_fns, constants, state_names,
+                 fetch_names, interpret):
+        self.segs = segs
+        self.seg_fns = seg_fns
+        self.constants = constants
+        self.state_set = set(state_names)
+        self.state_names = state_names
+        self.fetch_names = fetch_names
+        self.interpret = interpret
+        # per device segment: the state names it overwrites, frozen at
+        # compile so the hot path does set-membership over a LIST of
+        # candidates instead of scanning the whole env every step
+        self._donate_names = [
+            None if fn_w is None
+            else [n for n in state_names if n in fn_w[1]]
+            for fn_w in seg_fns]
+        # fetches that alias DONATED state: the returned array is the
+        # same buffer the next step donates, so an async caller
+        # (return_numpy=False) must receive a copy or materialize-later
+        # hits a deleted buffer
+        donated = {n for d in self._donate_names if d for n in d}
+        self.donated_fetch_idx = [i for i, n in enumerate(fetch_names)
+                                  if n in donated]
+
+    def _split(self, env, donate_names):
+        # donate only state this segment overwrites (params, opt
+        # slots): feeds/constants may be reused by the caller, and
+        # donated pass-through state comes back as deleted buffers
+        donated = {}
+        for k in donate_names:
+            v = env.pop(k, _ABSENT)
+            if v is not _ABSENT:
+                donated[k] = v
+        if self.constants:
+            rest = {k: v for k, v in env.items()
+                    if k not in self.constants}
+        else:
+            rest = env
+        return donated, rest
+
+    def __call__(self, state, feeds, base_key, step_idx):
+        env = dict(self.constants) if self.constants else {}
+        env.update(state)
+        env.update(feeds)
+        for (is_host, a, b), fn_w, donate in zip(
+                self.segs, self.seg_fns, self._donate_names):
+            if is_host:
+                env = self.interpret(env, a, b, base_key, step_idx)
+            else:
+                fn, _writes = fn_w
+                donated, rest = self._split(env, donate)
+                out = fn(donated, rest, base_key, step_idx)
+                env = dict(self.constants) if self.constants else {}
+                env.update(out)
+        fetches = [env[n] for n in self.fetch_names]
+        new_state = {n: env[n] for n in self.state_names}
+        return fetches, new_state
+
+    def aot_compile(self, state, feeds, base_key, step_idx):
+        """Eagerly .lower().compile() device segments with abstract
+        inputs (``state``/``feeds`` values may be arrays, ShapeDtype-
+        Structs, or (shape, dtype) pairs). With the persistent
+        compilation cache enabled this writes the on-disk entries the
+        first real step (and every restarted process) then compiles
+        from. Host segments cannot run abstractly, so AOT stops at the
+        first one; returns (compiled, total_device_segments)."""
+        env = {k: _spec_of(v) for k, v in self.constants.items()}
+        env.update({k: _spec_of(v) for k, v in state.items()})
+        env.update({k: _spec_of(v) for k, v in feeds.items()})
+        compiled = 0
+        total = sum(1 for is_host, _, _ in self.segs if not is_host)
+        for (is_host, a, b), fn_w, donate in zip(
+                self.segs, self.seg_fns, self._donate_names):
+            if is_host:
+                break
+            fn, _writes = fn_w
+            donated, rest = self._split(env, donate)
+            fn.lower(donated, rest, base_key, step_idx).compile()
+            out = jax.eval_shape(fn, donated, rest, base_key, step_idx)
+            compiled += 1
+            env = {k: _spec_of(v) for k, v in self.constants.items()}
+            env.update(out)
+        return compiled, total
+
+
+class _PreparedRunner:
+    """Everything `Executor.run` needs per (program, feed-signature)
+    that is invariant step to step — the product of the one-time scans
+    the legacy path redid every call."""
+
+    __slots__ = ("step", "state_names", "host_outs", "scope_ref",
+                 "scope_version", "rep", "ok_shardings", "ndev")
+
+    def __init__(self, step, state_names, host_outs, scope, rep, ndev):
+        self.step = step
+        self.state_names = state_names
+        self.host_outs = host_outs
+        self.scope_ref = weakref.ref(scope)
+        self.scope_version = scope.version
+        self.rep = rep                    # replicated sharding (DP) or None
+        # shardings proven equivalent to rep, memoized BY IDENTITY with
+        # the object held alive: id alone could be recycled by a new,
+        # non-equivalent sharding after GC
+        self.ok_shardings = {}            # id(s) -> s
+        self.ndev = ndev
+
+    def fresh_for(self, scope):
+        return (self.scope_ref() is scope
+                and self.scope_version == scope.version)
+
+
 class Executor:
     """One compiled XLA computation per (program, feed-signature)."""
 
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
+        self._cache = {}                  # full sig -> _CompiledStep
+        self._runners = {}                # dispatch sig -> _PreparedRunner
         self._keys = {}
+        self._trace_count = 0             # bumps per device-segment trace
+
+    @property
+    def trace_count(self):
+        """Number of device-segment traces this executor performed —
+        steady-state steps with an unchanged feed signature must not
+        move it (the executor-caching tests pin exactly that)."""
+        return self._trace_count
 
     @staticmethod
     def _program_read_names(program):
@@ -205,9 +417,38 @@ class Executor:
             k = self._keys[seed] = jax.random.PRNGKey(seed)
         return k
 
+    @staticmethod
+    def _dispatch_sig(program, dp_mesh, feeds, fetch_names, scope):
+        """Prepared-runner cache key. The PROGRAM OBJECT itself (not
+        id()) rides in the key: the dict entry then keeps it alive, so
+        a dead program's id can never be recycled into a silent stale
+        hit (dict hashing is identity-based for Program). The scope is
+        keyed by id() only — a recycled scope id is caught at use time
+        by _PreparedRunner.fresh_for's weakref identity check, NOT by
+        this key. feeds values may be arrays or ShapeDtypeStructs."""
+        return (program, program.version, id(dp_mesh),
+                tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                             for k, v in feeds.items())),
+                tuple(fetch_names), id(scope))
+
+    def _store_runner(self, dsig, runner):
+        # dead-scope eviction: a scope-per-request caller would
+        # otherwise accumulate one unreachable runner per request; the
+        # sweep is O(runners) and only runs when the table has grown
+        if len(self._runners) > 32:
+            self._runners = {k: r for k, r in self._runners.items()
+                             if r.scope_ref() is not None}
+        self._runners[dsig] = runner
+
     # -- public API --------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True):
+        """Run one step. ``return_numpy=False`` returns jax device
+        arrays WITHOUT synchronizing — dispatch is async, so the caller
+        can issue steps N+1..N+k while step N is still computing and
+        only pay the sync when a value is materialized
+        (``np.asarray``). ``return_numpy=True`` keeps the blocking
+        fluid-parity contract."""
         program = program or default_main_program()
         # CompiledProgram.with_data_parallel: unwrap and remember the
         # data mesh; the same compiled step runs SPMD over it (GSPMD
@@ -262,7 +503,126 @@ class Executor:
             return [] if not fetch_names else [
                 self._fetch_value(scope, n, return_numpy) for n in fetch_names]
 
-        feeds = {k: _as_feed_array(v) for k, v in feed.items()}
+        with RecordEvent("executor.run/prepare"):
+            feeds = {k: _as_feed_array(v) for k, v in feed.items()}
+            dsig = self._dispatch_sig(program, dp_mesh, feeds,
+                                      fetch_names, scope)
+            fast = bool(get_flag("executor_fast_path"))
+            runner = self._runners.get(dsig) if fast else None
+            if runner is None or not runner.fresh_for(scope):
+                runner = self._prepare_runner(program, feeds, fetch_names,
+                                              scope, dp_mesh)
+                if fast:
+                    self._store_runner(dsig, runner)
+            state = self._gather_state(runner, scope)
+            if state is None:             # scope changed under us
+                runner = self._prepare_runner(program, feeds, fetch_names,
+                                              scope, dp_mesh)
+                if fast:
+                    self._store_runner(dsig, runner)
+                state = self._gather_state(runner, scope)
+
+            if dp_mesh is not None:
+                feeds = self._shard_feeds(feeds, dp_mesh)
+                state = self._ensure_resident(state, runner, fast)
+
+        # per-step rng: the base key is staged on device once per seed,
+        # and the step fold happens INSIDE the jitted program (the old
+        # eager PRNGKey+fold_in cost two device round-trips per step on
+        # the remote-PJRT tunnel)
+        base_key = self._base_key(program.random_seed)
+        step_idx = np.uint32(scope.find_var("@step@") or 0)
+        scope.set_var("@step@", (scope.find_var("@step@") or 0) + 1)
+        with RecordEvent("executor.run/dispatch"):
+            fetches, new_state = runner.step(state, feeds, base_key,
+                                             step_idx)
+            for n, v in new_state.items():
+                scope.set_var(n, v)
+        if return_numpy:
+            with RecordEvent("executor.run/fetch"):
+                fetches = [np.asarray(f) for f in fetches]
+        elif runner.step.donated_fetch_idx:
+            # async contract: a fetched var that is also donated state
+            # (e.g. fetch_list=[some_param]) would have its buffer
+            # deleted by the NEXT step's donation before the caller
+            # materializes it — hand back an (async) device copy
+            for i in runner.step.donated_fetch_idx:
+                fetches[i] = jnp.array(fetches[i], copy=True)
+        return fetches
+
+    def prepare(self, program=None, feed=None, fetch_list=None,
+                scope=None):
+        """AOT warm-start (jit .lower().compile() done eagerly): build
+        the prepared runner for (program, feed-signature) and compile
+        its device segments BEFORE the first step. ``feed`` maps names
+        to sample arrays, (shape, dtype) pairs, or jax.ShapeDtypeStructs
+        — only shapes/dtypes matter. Requires the startup program to
+        have run (state shapes come from the scope).
+
+        With the persistent compilation cache enabled
+        (core/compile_cache.py, PADDLE_TPU_CACHE_DIR) the compiled
+        executables land on disk, so the first real step — and every
+        restarted worker process — replays the XLA compile as a disk
+        read instead of recompiling. Returns True when every device
+        segment was AOT-compiled (programs with host segments warm up
+        to the first host boundary only)."""
+        program = program or default_main_program()
+        dp_mesh = None
+        from paddle_tpu.compiler import CompiledProgram
+        if isinstance(program, CompiledProgram):
+            dp_mesh = program._mesh if program._dp else None
+            program = program._program
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [f if isinstance(f, str) else f.name
+                       for f in fetch_list]
+        scope = scope or global_scope()
+        specs = {k: _spec_of(v if not isinstance(v, (list,))
+                             else np.asarray(v))
+                 for k, v in feed.items()}
+        runner = self._prepare_runner(program, specs, fetch_names, scope,
+                                      dp_mesh)
+        if bool(get_flag("executor_fast_path")):
+            dsig = self._dispatch_sig(program, dp_mesh, specs,
+                                      fetch_names, scope)
+            self._store_runner(dsig, runner)
+        state = {}
+        for n in runner.state_names:
+            v = scope.find_var(n)
+            if v is None:                 # host-written: materializes at
+                continue                  # step time, can't be spec'd
+            state[n] = v
+        if dp_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from paddle_tpu.parallel.mesh import DATA_AXIS
+            rep = NamedSharding(dp_mesh, PartitionSpec())
+            state = {n: jax.ShapeDtypeStruct(np.shape(v), v.dtype,
+                                             sharding=rep)
+                     for n, v in state.items()}
+            specs = {
+                k: jax.ShapeDtypeStruct(
+                    s.shape, s.dtype,
+                    sharding=NamedSharding(
+                        dp_mesh,
+                        PartitionSpec() if len(s.shape) == 0
+                        else PartitionSpec(DATA_AXIS)))
+                for k, s in specs.items()}
+        base_key = self._base_key(program.random_seed)
+        compiled, total = runner.step.aot_compile(
+            state, specs, base_key, np.uint32(0))
+        return compiled == total
+
+    # -- internals ---------------------------------------------------------
+    def _prepare_runner(self, program, feeds, fetch_names, scope, dp_mesh):
+        """The one-time (per feed-signature) preparation the legacy path
+        performed every step: state-name/host-out scans, the
+        initialization check, and the compiled-step lookup."""
+        # pre-create the step counter: creating it AFTER this prepare
+        # (on the first run) would bump scope.version and force one
+        # spurious re-prepare — and drop the DP residency memo — at
+        # step 2
+        if scope.find_var("@step@") is None:
+            scope.set_var("@step@", 0)
         state_names = self._state_names(program, scope)
         state = {n: scope.find_var(n) for n in state_names}
         # vars a host op (load_combine, ps_recv…) writes are initialized
@@ -275,33 +635,17 @@ class Executor:
             raise EnforceNotMet(
                 f"Persistable vars not initialized: {missing[:5]} — run the "
                 f"startup program first (exe.run(startup_program))")
-        state = {n: v for n, v in state.items() if v is not None}
-
+        rep = None
+        ndev = 0
         if dp_mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
-            from paddle_tpu.parallel.mesh import DATA_AXIS
-            ndev = dp_mesh.size
             rep = NamedSharding(dp_mesh, PartitionSpec())
-
-            def shard_leaf(v):
-                if getattr(v, "ndim", 0) == 0:
-                    return jax.device_put(v, rep)
-                if v.shape[0] % ndev != 0:
-                    raise EnforceNotMet(
-                        f"data-parallel feed batch {v.shape[0]} is not "
-                        f"divisible by the {ndev}-device data mesh")
-                return jax.device_put(
-                    v, NamedSharding(dp_mesh, PartitionSpec(DATA_AXIS)))
-            feeds = {k: jax.tree.map(shard_leaf, v)
-                     for k, v in feeds.items()}
-            # persistable state rides replicated on the SAME mesh —
-            # mixing single-device state with mesh-sharded feeds in one
-            # jit is an error; re-put is a no-op once resident
-            state = {k: jax.tree.map(lambda v: jax.device_put(v, rep), v)
-                     for k, v in state.items()}
-
-        sig = (id(program), program.version, id(dp_mesh),
-               tuple(sorted((k, v.shape, str(v.dtype))
+            ndev = dp_mesh.size
+        # program OBJECT in the key (see _dispatch_sig): identity hash
+        # plus a live reference — id() alone could be recycled by a new
+        # program after GC and silently serve the stale compiled step
+        sig = (program, program.version, id(dp_mesh),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feeds.items())),
                tuple(fetch_names), tuple(sorted(state_names)))
         step = self._cache.get(sig)
@@ -309,22 +653,72 @@ class Executor:
             step = self._compile(program, sorted(state_names),
                                  sorted(feeds), fetch_names)
             self._cache[sig] = step
+        return _PreparedRunner(step, state_names, host_outs, scope, rep,
+                               ndev)
 
-        # per-step rng: the base key is staged on device once per seed,
-        # and the step fold happens INSIDE the jitted program (the old
-        # eager PRNGKey+fold_in cost two device round-trips per step on
-        # the remote-PJRT tunnel)
-        base_key = self._base_key(program.random_seed)
-        step_idx = np.uint32(scope.find_var("@step@") or 0)
-        scope.set_var("@step@", (scope.find_var("@step@") or 0) + 1)
-        fetches, new_state = step(state, feeds, base_key, step_idx)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
-        if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
-        return fetches
+    def _gather_state(self, runner, scope):
+        """Pull the current state values for a prepared runner. Returns
+        None when a state var has vanished from the scope (the caller
+        re-prepares, which re-raises the proper diagnostic)."""
+        state = {}
+        host_outs = runner.host_outs
+        for n in runner.state_names:
+            v = scope.find_var(n)
+            if v is None:
+                if n not in host_outs:
+                    return None
+                continue
+            state[n] = v
+        return state
 
-    # -- internals ---------------------------------------------------------
+    def _shard_feeds(self, feeds, dp_mesh):
+        from jax.sharding import NamedSharding, PartitionSpec
+        from paddle_tpu.parallel.mesh import DATA_AXIS
+        ndev = dp_mesh.size
+        rep = NamedSharding(dp_mesh, PartitionSpec())
+        data = NamedSharding(dp_mesh, PartitionSpec(DATA_AXIS))
+
+        def shard_leaf(v):
+            if getattr(v, "ndim", 0) == 0:
+                return jax.device_put(v, rep)
+            if v.shape[0] % ndev != 0:
+                raise EnforceNotMet(
+                    f"data-parallel feed batch {v.shape[0]} is not "
+                    f"divisible by the {ndev}-device data mesh")
+            return jax.device_put(v, data)
+        return {k: jax.tree.map(shard_leaf, v) for k, v in feeds.items()}
+
+    def _ensure_resident(self, state, runner, fast):
+        """Persistable state rides replicated on the SAME mesh as the
+        feeds — mixing single-device state with mesh-sharded feeds in
+        one jit is an error. Fast path: once the step has run, its
+        outputs are already replicated on the mesh, so re-putting every
+        leaf every step (the legacy behavior, one eager dispatch per
+        parameter per step) is pure overhead — leaves whose sharding is
+        provably equivalent to the target pass through untouched, and
+        the equivalence check memoizes on the sharding object (stable
+        across steps: executables reuse their output shardings)."""
+        rep = runner.rep
+        ok = runner.ok_shardings
+
+        def place_leaf(v):
+            if fast:
+                s = getattr(v, "sharding", None)
+                if s is not None:
+                    if ok.get(id(s)) is s:
+                        return v
+                    try:
+                        same = s == rep or s.is_equivalent_to(
+                            rep, getattr(v, "ndim", 0))
+                    except Exception:
+                        same = False
+                    if same:
+                        ok[id(s)] = s
+                        return v
+            return jax.device_put(v, rep)
+
+        return {k: jax.tree.map(place_leaf, v) for k, v in state.items()}
+
     def train_from_dataset(self, program=None, dataset=None,
                            fetch_list=None, fetch_info=None,
                            print_period=100, scope=None, debug=False):
@@ -332,7 +726,13 @@ class Executor:
         stack SURVEY §3.4): iterate the dataset's batches, feed each into
         the compiled program, print fetches every ``print_period`` steps
         (the FetchConfig/LodTensorPrinter role). The reference's
-        per-thread hogwild workers collapse into batched device steps."""
+        per-thread hogwild workers collapse into batched device steps.
+
+        Steps run with ``return_numpy=False`` and fetches only
+        materialize (→ host sync) at ``print_period`` boundaries, so up
+        to ``print_period`` steps stay in flight on the device queue
+        while the host races ahead dispatching — pairing with
+        ``device_prefetch``'s H2D double-buffering on the input side."""
         enforce(dataset is not None, "dataset is required")
         fetch_list = fetch_list or []
         fetch_names = [f if isinstance(f, str) else f.name
@@ -346,13 +746,16 @@ class Executor:
         # step n's compute (buffered_reader.cc role)
         for batch in device_prefetch(dataset):
             last = self.run(program, feed=batch, fetch_list=fetch_names,
-                            scope=scope)
+                            scope=scope, return_numpy=False)
             step += 1
             if fetch_names and step % print_period == 0:
+                # the ONLY sync point in the steady loop
+                last = [np.asarray(v) for v in last]
                 msg = ", ".join(f"{l}={np.asarray(v).mean():.6f}"
                                 for l, v in zip(labels, last))
                 print(f"step {step}: {msg}")
-        return last
+        # materialize the tail so callers keep the numpy contract
+        return [np.asarray(v) for v in last]
 
     def infer_from_dataset(self, program=None, dataset=None,
                            fetch_list=None, fetch_info=None,
@@ -485,6 +888,10 @@ class Executor:
                 writes.update(ops[k].output_names())
 
             def seg_fn(donated, rest, base_key, step_idx):
+                # python executes at trace time only: the counter is the
+                # retrace probe the caching tests (and bench_dispatch's
+                # sanity check) read
+                self._trace_count += 1
                 # constants enter via closure -> XLA compile-time consts
                 env = dict(constants)
                 env.update(rest)
@@ -518,31 +925,8 @@ class Executor:
         seg_fns = [None if is_host else make_device_fn(a, b)
                    for is_host, a, b in segs]
 
-        def step(state, feeds, base_key, step_idx):
-            env = dict(constants)
-            env.update(state)
-            env.update(feeds)
-            for (is_host, a, b), fn_w in zip(segs, seg_fns):
-                if is_host:
-                    env = interpret(env, a, b, base_key, step_idx)
-                else:
-                    fn, writes = fn_w
-                    # donate only state this segment overwrites (params,
-                    # opt slots): feeds/constants may be reused by the
-                    # caller, and donated pass-through state comes back
-                    # as deleted buffers
-                    donated = {k: env.pop(k) for k in list(env)
-                               if k in state_set and k in writes}
-                    rest = {k: v for k, v in env.items()
-                            if k not in constants}
-                    out = fn(donated, rest, base_key, step_idx)
-                    env = dict(constants)
-                    env.update(out)
-            fetches = [env[n] for n in fetch_names]
-            new_state = {n: env[n] for n in state_names}
-            return fetches, new_state
-
-        return step
+        return _CompiledStep(segs, seg_fns, constants, state_names,
+                             fetch_names, interpret)
 
     def _fetch_value(self, scope, name, return_numpy):
         v = scope.find_var(name)
@@ -550,6 +934,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._runners.clear()
 
 
 class AsyncExecutor:

@@ -130,6 +130,64 @@ class TestDevicePrefetch:
         with pytest.raises(ValueError, match="producer failed"):
             next(it)
 
+    def test_producer_exception_keeps_original_traceback(self):
+        """The carrier re-raises with the producer frames intact, so
+        the user sees WHERE in their reader it blew up — not just a
+        bare exception rethrown from the queue."""
+        import traceback
+
+        from paddle_tpu.static.executor import background_prefetch
+
+        def exploding_parser():
+            yield 1
+            raise KeyError("bad record in shard 3")
+
+        it = background_prefetch(exploding_parser(), lambda b: b)
+        next(it)
+        with pytest.raises(KeyError) as ei:
+            next(it)
+        frames = "".join(traceback.format_tb(ei.value.__traceback__))
+        assert "exploding_parser" in frames
+
+    def test_exception_yielded_as_data_passes_through(self):
+        """An Exception INSTANCE produced as a legitimate item must be
+        delivered, not raised (the carrier-vs-bare-item distinction)."""
+        from paddle_tpu.static.executor import background_prefetch
+
+        payload = [ValueError("i am data"), 42]
+        out = list(background_prefetch(iter(payload), lambda b: b))
+        assert isinstance(out[0], ValueError) and out[1] == 42
+
+    def test_early_consumer_exit_shuts_worker_down(self):
+        """Consumer breaks after one item: the worker thread must exit
+        (not stay parked on a full queue) and stop consuming the
+        producer shortly after."""
+        import threading
+        import time
+
+        from paddle_tpu.static.executor import background_prefetch
+
+        produced = []
+
+        def producer():
+            for i in range(10_000):
+                produced.append(i)
+                yield i
+
+        it = background_prefetch(producer(), lambda b: b, depth=1)
+        next(it)
+        it.close()                    # early exit: generator finalizes
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and any(
+                t.name == "pt-prefetch-worker" and t.is_alive()
+                for t in threading.enumerate()):
+            time.sleep(0.01)
+        assert not any(t.name == "pt-prefetch-worker" and t.is_alive()
+                       for t in threading.enumerate())
+        n = len(produced)
+        time.sleep(0.2)               # a live worker would keep pulling
+        assert len(produced) == n
+
     def test_train_from_dataset_uses_prefetch(self):
         """train_from_dataset still trains (now through the prefetch
         pipeline)."""

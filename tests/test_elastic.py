@@ -340,6 +340,9 @@ else:
 
 # ---------------------------------------------------------------------------
 @pytest.mark.slow
+@pytest.mark.timeout(600)     # two launch_collective runs at up to
+                              # 240s each — above the conftest per-test
+                              # guard's 300s default
 class TestElasticEndToEnd:
     """The acceptance runs: fault-injected crash/hang mid-training ->
     supervisor restarts -> job resumes from the last complete checkpoint

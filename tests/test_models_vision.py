@@ -159,22 +159,62 @@ class TestResNet:
 
 class TestVGG:
     def test_forward_and_train(self):
+        # lr/steps/threshold derived from a 5-seed sweep (init keys
+        # 0..4): lr=0.05 diverges transiently on some seeds (momentum
+        # overshoot, loss 4.4 -> 9.6 at step 5), while lr=0.01 reaches
+        # <= 0.03 from starts of 3.1-4.8 by step 10 on every seed —
+        # worst ratio 0.007, so 0.25 carries a ~35x margin
         cfg = vgg.vgg11(num_classes=10, image_size=32, fc_dim=64,
                         dropout=0.0)
         mesh = make_mesh(MeshConfig(data=-1))
         with mesh_guard(mesh):
-            opt = pt.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+            opt = pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
             init_fn, step_fn = vgg.make_train_step(cfg, opt, mesh)
             params, opt_state = init_fn(jax.random.PRNGKey(0))
             imgs, labels = vgg.synthetic_batch(cfg, 8)
             losses = []
-            for i in range(6):
+            for i in range(10):
                 loss, acc, params, opt_state = step_fn(
                     params, opt_state, imgs, labels,
                     jax.random.PRNGKey(i))
                 losses.append(float(loss))
         assert np.isfinite(losses).all()
-        assert losses[-1] < losses[0]
+        assert losses[-1] < losses[0] * 0.25, losses
+
+    def test_steps_per_call_matches_sequential(self):
+        """K scanned VGG steps per dispatch == K sequential dispatches
+        (dropout off so the rng path doesn't enter the comparison)."""
+        cfg = vgg.vgg11(num_classes=10, image_size=32, fc_dim=64,
+                        dropout=0.0)
+        mesh = make_mesh(MeshConfig(data=-1))
+        with mesh_guard(mesh):
+            opt = pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+            init_fn, step1 = vgg.make_train_step(cfg, opt, mesh)
+            imgs, labels = vgg.synthetic_batch(cfg, 8)
+            params, opt_state = init_fn(jax.random.PRNGKey(0))
+            for i in range(3):
+                loss_seq, _, params, opt_state = step1(
+                    params, opt_state, imgs, labels,
+                    jax.random.PRNGKey(i))
+
+            _, step3 = vgg.make_train_step(cfg, opt, mesh,
+                                           steps_per_call=3)
+            params2, opt2 = init_fn(jax.random.PRNGKey(0))
+            loss_k, _, params2, opt2 = step3(params2, opt2, imgs,
+                                             labels,
+                                             jax.random.PRNGKey(0))
+            np.testing.assert_allclose(float(loss_k), float(loss_seq),
+                                       rtol=3e-3)
+            np.testing.assert_allclose(
+                np.asarray(jax.tree.leaves(params2)[0]),
+                np.asarray(jax.tree.leaves(params)[0]), rtol=2e-2,
+                atol=1e-3)
+
+            # stacked per-step batches: leading-axis mismatch raises
+            with pytest.raises(ValueError, match="steps_per_call"):
+                bad = np.broadcast_to(imgs, (2,) + imgs.shape).copy()
+                step3(params2, opt2, bad,
+                      np.broadcast_to(labels, (2,) + labels.shape).copy())
 
 
 def test_vgg_non_multiple_of_32_image():

@@ -400,11 +400,21 @@ class TestPipelineOptimizerFacade:
             xb = rs.randn(8, 4).astype(np.float32)
             tb = rs.randn(8, 1).astype(np.float32)
             first = last = None
-            for _ in range(40):
+            for _ in range(100):
                 (lv,) = exe.run(main, feed={"x": xb, "t": tb},
                                 fetch_list=[loss])
                 first = first if first is not None else float(lv)
                 last = float(lv)
-            assert last < first * 0.2
+            # the targets are noise, so the achievable loss is the
+            # least-squares residual — asserting a fixed ratio of the
+            # first loss was a lucky-seed artifact (floor/first spans
+            # 0.07-0.42 over 5 seeds). Assert convergence to the
+            # analytic floor instead: every seed sits within 0.1% of it
+            # by step ~80 (Adam 0.05), so 5% is both tight and robust.
+            A = np.hstack([xb, np.ones((8, 1), np.float32)])
+            resid = tb - A @ np.linalg.lstsq(A, tb, rcond=None)[0]
+            floor = float((resid ** 2).mean())
+            assert last < first
+            assert last <= floor * 1.05 + 1e-4, (last, floor)
         finally:
             pt.disable_static()
