@@ -100,9 +100,19 @@ def _timed_steps(step_once, carry, steps, settle=3, windows=None,
     while len(dts) < max_windows and best_spread(dts) > spread_threshold:
         dts.append(one_window())
     spread = best_spread(dts)
-    return TimedResult(dts, steps, carry, res,
-                       contention=spread > spread_threshold,
-                       decision_spread=spread, sub_steps=sub_steps)
+    tr = TimedResult(dts, steps, carry, res,
+                     contention=spread > spread_threshold,
+                     decision_spread=spread, sub_steps=sub_steps)
+    # the ad-hoc windows dict also lands in the unified metrics
+    # registry, so a bench run's numbers ride the same snapshot pipeline
+    # as production telemetry (monitor/exporter.py; BENCH_METRICS_OUT
+    # below writes the Prometheus file)
+    from paddle_tpu.monitor.registry import histogram
+    h = histogram("bench_window_ms_per_step",
+                  "Per-step wall ms of each timed bench window")
+    for v in tr.ms_per_step():
+        h.observe(v)
+    return tr
 
 
 def bench_resnet50():
@@ -637,7 +647,35 @@ def bench_nmt():
     print(json.dumps(line))
 
 
+def _emit_registry_snapshot():
+    """End-of-run metrics emission: the registry (bench windows +
+    whatever executor/prefetch/checkpoint counters the run touched) as
+    Prometheus text — to the BENCH_METRICS_OUT path when set, else a
+    compact dump on stderr. Never fatal: a bench must not fail on its
+    own telemetry."""
+    try:
+        from paddle_tpu.monitor import exporter
+        out = os.environ.get("BENCH_METRICS_OUT")
+        if out:
+            exporter.write_snapshot(out)
+            print(f"# metrics registry snapshot -> {out}",
+                  file=sys.stderr)
+        else:
+            print("# --- metrics registry snapshot ---",
+                  file=sys.stderr)
+            print(exporter.render_text(), file=sys.stderr, end="")
+    except Exception as e:   # pragma: no cover - telemetry-only path
+        print(f"# metrics snapshot failed: {e}", file=sys.stderr)
+
+
 def main():
+    try:
+        return _dispatch_mode()
+    finally:
+        _emit_registry_snapshot()
+
+
+def _dispatch_mode():
     if len(sys.argv) > 1 and sys.argv[1] == "dispatch":
         # executor host-overhead microbench (small model: the step time
         # IS the dispatch); lives in bench_dispatch.py, reuses this

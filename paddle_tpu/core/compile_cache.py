@@ -26,6 +26,8 @@ restart is verifiable (hits > 0), not vibes.
 import os
 import threading
 
+from paddle_tpu.monitor.registry import counter as _counter
+
 __all__ = ["enable", "disable", "is_enabled", "cache_dir", "stats",
            "reset_stats", "ENV_VAR"]
 
@@ -34,6 +36,20 @@ ENV_VAR = "PADDLE_TPU_CACHE_DIR"
 _lock = threading.Lock()
 _state = {"dir": None, "listening": False}
 _counters = {"hits": 0, "misses": 0, "requests": 0}
+
+# registry mirrors of the jax-monitoring-fed counters, so /metrics and
+# the per-rank snapshots carry warm-restart evidence too
+_m_counters = {
+    "hits": _counter("compile_cache_hits_total",
+                     "XLA compiles served from the persistent "
+                     "compilation cache (disk)"),
+    "misses": _counter("compile_cache_misses_total",
+                       "XLA compiles that missed the persistent cache "
+                       "and compiled for real"),
+    "requests": _counter("compile_cache_requests_total",
+                         "Compile requests eligible for the persistent "
+                         "cache"),
+}
 
 # jax monitoring event suffixes -> our counter keys (the full names are
 # '/jax/compilation_cache/cache_hits' etc.; matched by suffix so a jax
@@ -50,6 +66,7 @@ def _on_event(event, **kw):
     if key is not None:
         with _lock:
             _counters[key] += 1
+        _m_counters[key].inc()
 
 
 def _ensure_listener():
@@ -74,6 +91,15 @@ def enable(dirname):
     cache — the warm-restart win scales with compile time, and caching
     a tiny program costs one small file."""
     import jax
+    if _mid_process():
+        # once per process, not per enable(): retry loops and tests
+        # re-point the cache freely and must not spam the log
+        from paddle_tpu.core.enforce import warn_once
+        warn_once(
+            "compile_cache_mid_process",
+            "compilation cache enabled mid-process: computations "
+            "compiled before enable() were not cached (jax's one-shot "
+            "cache state is reset so later compiles are)")
     dirname = os.path.abspath(dirname)
     os.makedirs(dirname, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", dirname)
@@ -91,6 +117,17 @@ def enable(dirname):
     with _lock:
         _state["dir"] = dirname
     return dirname
+
+
+def _mid_process():
+    """True when a jax backend already initialized — i.e. something may
+    already have compiled, so this enable() is the 'mid-process' path
+    whose earlier compiles the cache can never cover."""
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
 
 
 def _reset_jax_cache_state():

@@ -15,7 +15,12 @@ unavailable (same iterator contract).
 
 import numpy as np
 
+from paddle_tpu.monitor.registry import counter as _counter
+
 __all__ = ["FileDataLoader"]
+
+_m_batches = _counter("dataio_batches_total",
+                      "Batches parsed and stacked by FileDataLoader")
 
 
 def _py_record_iter(files, epochs, mode, shuffle_buffer=0, seed=0):
@@ -101,9 +106,11 @@ class FileDataLoader:
             for rec in records:
                 buf.append(self.parse_fn(rec))
                 if len(buf) == self.batch_size:
+                    _m_batches.inc()
                     yield self._stack(buf)
                     buf = []
             if buf and not self.drop_last:
+                _m_batches.inc()
                 yield self._stack(buf)
         finally:
             if hasattr(records, "close"):

@@ -20,8 +20,8 @@ import os
 import threading
 import time
 
-__all__ = ["Heartbeat", "heartbeat_path", "last_beat", "stale_ranks",
-           "silent_ranks", "reset", "ENV_DIR", "ENV_RANK"]
+__all__ = ["Heartbeat", "heartbeat_path", "metrics_path", "last_beat",
+           "stale_ranks", "silent_ranks", "reset", "ENV_DIR", "ENV_RANK"]
 
 ENV_DIR = "PADDLE_HEARTBEAT_DIR"
 ENV_RANK = "PADDLE_TRAINER_ID"
@@ -29,6 +29,15 @@ ENV_RANK = "PADDLE_TRAINER_ID"
 
 def heartbeat_path(dirname, rank):
     return os.path.join(dirname, f"rank{int(rank)}.hb")
+
+
+def metrics_path(dirname, rank):
+    """Where a rank's Prometheus snapshot lives: next to its heartbeat
+    file, so the launcher finds both liveness and metrics in one place
+    (written atomically by monitor.exporter.RankExporter; deliberately
+    NOT cleared by reset() — a dead incarnation's last snapshot is
+    evidence, not a liveness vouch)."""
+    return os.path.join(dirname, f"rank{int(rank)}.prom")
 
 
 class Heartbeat:

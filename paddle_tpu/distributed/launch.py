@@ -49,11 +49,71 @@ import time
 
 from paddle_tpu.core.compile_cache import ENV_VAR as CACHE_ENV_VAR
 from paddle_tpu.distributed import health
+from paddle_tpu.monitor import exporter as _exporter
+from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor.registry import REGISTRY as _REGISTRY
+from paddle_tpu.monitor.registry import counter as _counter
 
 __all__ = ["launch_collective", "launch_ps", "find_free_ports",
            "backoff_delay", "probe_port_range"]
 
 PREEMPTED_RC = 143          # 128 + SIGTERM, the conventional code
+
+#: seconds between job-status log lines / job-level metric snapshots
+STATUS_INTERVAL = 15.0
+
+# launcher-side telemetry (the supervisor's own registry; aggregated
+# with the per-rank snapshots into <log_dir>/metrics.prom)
+_m_restarts = _counter(
+    "restarts_total",
+    "Restarts: the launcher counts restarts it performed; a rank "
+    "reports its own incarnation index")
+_m_watchdog = _counter(
+    "watchdog_trips_total",
+    "Hang-watchdog kills (a rank heartbeat, then went silent past "
+    "--hang_timeout)")
+
+
+def _postmortem_env(log_dir):
+    """Arm workers' flight recorders: PADDLE_POSTMORTEM_DIR under the
+    log dir. A killed/crashed rank dumps its recent spans there (see
+    monitor/flight_recorder.py); no log_dir means nowhere durable."""
+    if not log_dir:
+        return {}
+    d = os.path.join(os.path.abspath(log_dir), "postmortem")
+    os.makedirs(d, exist_ok=True)
+    return {_flight.ENV_DIR: d}
+
+
+def _report_postmortems(log_dir, why):
+    if not log_dir:
+        return
+    d = os.path.join(os.path.abspath(log_dir), "postmortem")
+    try:
+        dumps = sorted(f for f in os.listdir(d) if f.endswith(".json"))
+    except OSError:
+        return
+    if dumps:
+        _log(f"postmortem ({why}): {len(dumps)} dump(s) in {d} "
+             f"(newest: {dumps[-1]})")
+
+
+def _status_tick(hb_dir, log_dir, restarts):
+    """One supervision-loop status beat: log the aggregated job line
+    and refresh <log_dir>/metrics.prom from the rank snapshots. Never
+    raises — a telemetry hiccup (disk error, a malformed snapshot a
+    dying rank half-wrote) must not tear down the supervisor."""
+    try:
+        line = _exporter.job_status_line(hb_dir, restarts=restarts)
+        if line:
+            _log("status " + line)
+        if log_dir:
+            _exporter.write_job_snapshot(
+                hb_dir, os.path.join(os.path.abspath(log_dir),
+                                     "metrics.prom"),
+                registry=_REGISTRY)
+    except Exception as e:
+        _log(f"status tick failed (ignored): {type(e).__name__}: {e}")
 
 
 def _cache_dir_env(log_dir, env_extra):
@@ -159,19 +219,25 @@ def _log(msg):
 
 
 def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
-               grace_period):
+               grace_period, log_dir=None, restarts=0):
     """Poll one gang incarnation to completion.
 
     ``procs``: name -> Popen; ``ranks``: name -> heartbeat rank (absent
     = unwatched, e.g. pservers). Returns (status, rc) with status one of
     "ok" | "fail" | "hung" | "timeout" | "preempted". On every status
     but "ok" the whole gang has already been torn down and reaped.
+    Every STATUS_INTERVAL the loop logs the aggregated job status line
+    and refreshes <log_dir>/metrics.prom from the rank snapshots.
     """
     start = time.time()
     warned_slow = False
+    next_status = time.monotonic() + STATUS_INTERVAL
     try:
         alive = dict(procs)
         while alive:
+            if time.monotonic() >= next_status:
+                next_status = time.monotonic() + STATUS_INTERVAL
+                _status_tick(hb_dir, log_dir, restarts)
             if term.is_set():
                 _log(f"SIGTERM: forwarding to {sorted(alive)} with "
                      f"{grace_period}s grace for checkpoint flush")
@@ -198,6 +264,7 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
                     if r in watched]
                 if stale:
                     r0, age = stale[0]
+                    _m_watchdog.inc()
                     _log(f"watchdog: rank {r0} hung — last heartbeat "
                          f"{age:.1f}s ago (hang_timeout={hang_timeout}s); "
                          f"killing gang")
@@ -262,12 +329,14 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     exchange_eps = ",".join(f"{host}:{p}" for p in xports)
     hb_dir, hb_tmp = _make_hb_dir(log_dir)
     cache_env = _cache_dir_env(log_dir, env_extra)
+    pm_env = _postmortem_env(log_dir)
 
     def spawn_gang(attempt):
         procs, ranks, logs = {}, {}, []
         try:
             for rank in range(nproc):
-                env = dict(os.environ, **(env_extra or {}), **cache_env)
+                env = dict(os.environ, **(env_extra or {}), **cache_env,
+                           **pm_env)
                 env.update({
                     "PADDLE_TRAINER_ID": str(rank),
                     "PADDLE_TRAINERS_NUM": str(nproc),
@@ -304,9 +373,14 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
             procs, ranks, logs = spawn_gang(attempt)
             status, rc = _wait_gang(procs, ranks, logs, deadline,
                                     hang_timeout, hb_dir, term,
-                                    grace_period)
+                                    grace_period, log_dir=log_dir,
+                                    restarts=attempt)
+            _status_tick(hb_dir, log_dir, attempt)
             if status in ("ok", "timeout", "preempted"):
                 return rc
+            # the killed gang's flight-recorder dumps are the evidence
+            # the restart would otherwise erase — surface them
+            _report_postmortems(log_dir, f"gang {status}")
             if attempt >= max_restarts:
                 if max_restarts:
                     _log(f"gang {status} (rc={rc}); restart budget "
@@ -314,6 +388,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                 return rc
             delay = backoff_delay(attempt)
             attempt += 1
+            _m_restarts.inc()
             # gang restart, not per-rank: surviving ranks would deadlock
             # in their next collective against the dead peer
             _log(f"gang {status} (rc={rc}); restarting gang "
@@ -350,6 +425,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     worker_eps = ",".join(f"{host}:{p}" for p in wports)
     hb_dir, hb_tmp = _make_hb_dir(log_dir)
     cache_env = _cache_dir_env(log_dir, env_extra)
+    pm_env = _postmortem_env(log_dir)
 
     def spawn_server(i):
         env = dict(os.environ, **(env_extra or {}), **cache_env)
@@ -364,7 +440,8 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                       f"serverlog.{i}", log_dir)
 
     def spawn_worker(i, attempt):
-        env = dict(os.environ, **(env_extra or {}), **cache_env)
+        env = dict(os.environ, **(env_extra or {}), **cache_env,
+                   **pm_env)
         env.update({
             "TRAINING_ROLE": "TRAINER",
             "PADDLE_TRAINER_ID": str(i),
@@ -415,6 +492,8 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
             return False
         delay = backoff_delay(restarts[i])
         restarts[i] += 1
+        _m_restarts.inc()
+        _report_postmortems(log_dir, f"trainer {i} {why}")
         _log(f"trainer {i} {why}; restarting worker "
              f"{restarts[i]}/{max_restarts} after {delay:.1f}s backoff "
              f"(pservers stay up)")
@@ -436,7 +515,11 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
             raise
         rc = 0
         done_workers = set()
+        next_status = time.monotonic() + STATUS_INTERVAL
         while servers or (set(workers) - done_workers):
+            if time.monotonic() >= next_status:
+                next_status = time.monotonic() + STATUS_INTERVAL
+                _status_tick(hb_dir, log_dir, sum(restarts))
             if term.is_set():
                 live = [n for n, p in servers.items() if p.poll() is None]
                 live += [f"trainer {i}" for i, p in workers.items()
@@ -491,6 +574,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                     hb_dir, worker_num, hang_timeout) if r in alive_w]
                 if stale:
                     i, age = stale[0]
+                    _m_watchdog.inc()
                     _log(f"watchdog: trainer {i} hung — last heartbeat "
                          f"{age:.1f}s ago (hang_timeout={hang_timeout}s); "
                          f"killing worker")
@@ -510,6 +594,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                              f"that beat then stopped counts as hung)")
                     warned_slow = True
             time.sleep(0.2)
+        _status_tick(hb_dir, log_dir, sum(restarts))
         return rc
     except KeyboardInterrupt:
         for p in all_procs():

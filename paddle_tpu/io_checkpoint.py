@@ -32,9 +32,24 @@ import jax
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.monitor.registry import counter as _counter
+from paddle_tpu.monitor.registry import histogram as _histogram
 from paddle_tpu.static.serialize import tree_from_manifest, tree_manifest
 
 __all__ = ["CheckpointManager", "auto_checkpoint"]
+
+_m_saves = _counter("checkpoint_saves_total",
+                    "Checkpoints made durable (shard written, retries "
+                    "resolved)")
+_m_save_ms = _histogram("checkpoint_save_ms",
+                        "Wall ms to make one checkpoint durable "
+                        "(serialize + write + atomic publish)")
+_m_bytes = _counter("checkpoint_bytes_total",
+                    "Array bytes snapshotted into checkpoints "
+                    "(device->host copies at save())")
+_m_retries = _counter("checkpoint_retries_total",
+                      "Transient-disk-error retries of checkpoint "
+                      "shard writes")
 
 
 def _host_tag():
@@ -106,6 +121,7 @@ class CheckpointManager:
         when async."""
         manifest, arrays = tree_manifest(tree)
         arrays = {k: np.asarray(v) for k, v in arrays.items()}  # d2h copy
+        _m_bytes.inc(sum(a.nbytes for a in arrays.values()))
         payload = (int(step), manifest, arrays)
         self._last_save_time = time.monotonic()
         if self._thread is None:
@@ -125,12 +141,17 @@ class CheckpointManager:
         (OSError only: the peer-shard timeout RuntimeError is not a
         disk fault and is never retried)."""
         delay = self.retry_backoff
+        t0 = time.perf_counter()
         for attempt in range(self.disk_retries + 1):
             try:
-                return self._write(payload)
+                out = self._write(payload)
+                _m_saves.inc()
+                _m_save_ms.observe((time.perf_counter() - t0) * 1e3)
+                return out
             except OSError as e:
                 if attempt == self.disk_retries:
                     raise
+                _m_retries.inc()
                 logging.getLogger("paddle_tpu.checkpoint").warning(
                     "checkpoint step %s write failed (%s: %s); retry "
                     "%d/%d in %.2fs", payload[0], type(e).__name__, e,
@@ -276,9 +297,19 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
     - SIGTERM (pod preemption, forwarded by the launcher with a
       --grace_period window) checkpoints the current state, waits for
       the async writer to publish it, and exits 143 — preemption never
-      loses more than the in-flight step.
+      loses more than the in-flight step;
+    - the flight recorder is armed (PADDLE_POSTMORTEM_DIR) and a
+      metrics snapshot is exported next to the heartbeat file
+      (monitor/exporter.py) — a supervised job leaves telemetry and
+      postmortems without any per-script wiring.
     """
     from paddle_tpu.distributed.health import Heartbeat
+    from paddle_tpu.monitor import flight_recorder
+    from paddle_tpu.monitor.exporter import RankExporter
+    flight_recorder.install_from_env()
+    exp = RankExporter.from_env()
+    if exp is not None:
+        exp.start()
     mgr = CheckpointManager(dirname, keep_max=keep_max,
                             save_interval_steps=save_interval_steps)
     hb = Heartbeat.from_env()
@@ -309,10 +340,19 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
                 if not saved:
                     mgr.save(step, state)
                 mgr.wait()
+                # this handler shadows the flight recorder's SIGTERM
+                # hook while the loop runs, so dump explicitly: a
+                # preempted rank leaves evidence too (SystemExit
+                # bypasses sys.excepthook)
+                if flight_recorder.is_enabled():
+                    flight_recorder.dump(reason="preempted")
                 raise SystemExit(143)
         mgr.save(total_steps - 1, state)
         return state
     finally:
         if restore_handler is not None:
             restore_handler()
-        mgr.close()
+        mgr.close()             # drain the async writer FIRST, so the
+        if exp is not None:     # exporter's final snapshot sees every
+            exp.stop()          # checkpoint counter increment
+

@@ -1,0 +1,51 @@
+"""Unified runtime telemetry (the role of the reference's two-layer
+host+device profiler plus the monitoring glue it never had).
+
+Four pieces, one API:
+
+- ``monitor.registry`` — process-wide Counter/Gauge/Histogram with
+  labels; the write path is lock-free (thread-local shards merged on
+  read) so hot loops (``Executor.run``, prefetch workers) can record
+  per-step without contention.
+- ``monitor.exporter`` — Prometheus text-format snapshots written
+  atomically next to each rank's heartbeat file, an optional stdlib
+  ``http.server`` ``/metrics`` endpoint, and the launcher-side
+  aggregation of per-rank snapshots into a job-level view + one-line
+  status log.
+- ``monitor.flight_recorder`` — a bounded ring of recent spans/steps
+  that dumps a postmortem JSON on crash or SIGTERM (the elastic
+  launcher's watchdog kill included), so a hang finally leaves
+  evidence.
+- ``monitor.cost`` — per-compiled-segment FLOPs/bytes from XLA's cost
+  analysis, combined with the step-time histogram into an MFU estimate
+  (surfaced by ``profiler.summary()``).
+
+Everything importable here is stdlib-only at module level (jax is
+touched lazily inside ``cost``): the elastic launcher — which must
+supervise workers whose jax is wedged — can use the exporter and
+recorder freely.
+
+Metrics catalogue: docs/OBSERVABILITY.md (kept in sync by
+tools/check_metrics.py, a tier-1 CI check).
+"""
+
+from paddle_tpu.monitor import cost
+from paddle_tpu.monitor import exporter
+from paddle_tpu.monitor import flight_recorder
+from paddle_tpu.monitor import registry
+from paddle_tpu.monitor.exporter import (
+    MetricsServer, RankExporter, render_text, write_snapshot,
+)
+from paddle_tpu.monitor.flight_recorder import RECORDER, FlightRecorder
+from paddle_tpu.monitor.registry import (
+    REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,
+    histogram,
+)
+
+__all__ = [
+    "registry", "exporter", "flight_recorder", "cost",
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "counter", "gauge", "histogram",
+    "RankExporter", "MetricsServer", "render_text", "write_snapshot",
+    "FlightRecorder", "RECORDER",
+]

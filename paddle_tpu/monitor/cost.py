@@ -1,0 +1,150 @@
+"""XLA cost analytics: per-compiled-segment FLOPs/bytes and MFU.
+
+The reference's profiler answers "where did the time go"; this module
+answers "how much of the hardware did we use". Sources:
+
+- ``analyze_lowered(lowered)`` reads jax's
+  ``lowered.cost_analysis()`` (XLA's HLO cost model — analytical
+  FLOPs/bytes, not measured) for each device segment the executor
+  compiles; the executor records them here (``record_segment``) both at
+  AOT-compile time (``Executor.prepare``) and lazily on a compiled
+  step's first real call.
+- ``flops_per_step()`` sums the most recently recorded compiled step's
+  segments (older compiled steps — other feed signatures, pre-retrace
+  shapes — are superseded, not accumulated: summing two compiles of the
+  same program would double-count).
+- ``estimate_mfu()`` divides achieved FLOP/s (flops_per_step over the
+  ``executor_step_ms`` histogram's mean) by ``peak_flops()``.
+
+``peak_flops()`` is ``PADDLE_TPU_PEAK_FLOPS`` when set, else the v5e
+bf16 peak (197 TFLOP/s). On a CPU host that denominator is fiction —
+the MFU line is for TPU runs; docs/OBSERVABILITY.md spells out the
+caveats. jax is only imported inside functions: this module loads under
+the stdlib-only launcher.
+"""
+
+import os
+import threading
+
+from paddle_tpu.monitor.registry import gauge
+
+__all__ = [
+    "analyze_lowered", "record_segment", "segments", "flops_per_step",
+    "bytes_per_step", "estimate_mfu", "peak_flops", "reset",
+]
+
+#: v5e bf16 peak, the chip this repo benches on (bench.py uses the same
+#: constant); override with PADDLE_TPU_PEAK_FLOPS for other hardware
+DEFAULT_PEAK_FLOPS = 197e12
+
+_lock = threading.Lock()
+_segments = {}                  # group -> {index: {"flops","bytes"}}
+_latest_group = None
+
+_g_flops = gauge(
+    "segment_flops",
+    "Analytical FLOPs per execution of each compiled device segment "
+    "(XLA cost model via lowered.cost_analysis)", labels=("segment",))
+_g_bytes = gauge(
+    "segment_bytes",
+    "Analytical bytes accessed per execution of each compiled device "
+    "segment", labels=("segment",))
+
+
+def analyze_lowered(lowered):
+    """{'flops': float, 'bytes': float} from a ``jax.stages.Lowered``
+    (or compiled) object, or None when the backend offers no cost
+    model. Handles both the dict and the [dict] return shapes jax has
+    used across versions."""
+    try:
+        ca = lowered.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    return {"flops": float(ca.get("flops", 0.0) or 0.0),
+            "bytes": float(ca.get("bytes accessed", 0.0) or 0.0)}
+
+
+def record_segment(group, index, analysis):
+    """Record one device segment's cost under ``group`` (an identity
+    for the compiled step, e.g. ``id(step)``); the latest group becomes
+    the per-step total ``flops_per_step`` reports. The gauges mirror
+    ONLY the latest group: when a new compiled step starts recording,
+    the superseded step's series are dropped — otherwise a retrace from
+    2 segments down to 1 would leave a stale ``segment="1"`` series
+    inflating every consumer that sums the gauge (the launcher's MFU
+    status line does)."""
+    global _latest_group
+    if not analysis:
+        return
+    with _lock:
+        if group != _latest_group:
+            _g_flops.clear()
+            _g_bytes.clear()
+        _segments.setdefault(group, {})[int(index)] = dict(analysis)
+        _latest_group = group
+    _g_flops.set(analysis["flops"], segment=str(index))
+    _g_bytes.set(analysis["bytes"], segment=str(index))
+
+
+def segments(group=None):
+    """{segment index: {"flops","bytes"}} for ``group`` (default: the
+    most recently recorded compiled step)."""
+    with _lock:
+        g = _latest_group if group is None else group
+        return {i: dict(a) for i, a in _segments.get(g, {}).items()}
+
+
+def _total(key):
+    with _lock:
+        segs = _segments.get(_latest_group, {})
+        return sum(a.get(key, 0.0) for a in segs.values())
+
+
+def flops_per_step():
+    return _total("flops")
+
+
+def bytes_per_step():
+    return _total("bytes")
+
+
+def peak_flops():
+    v = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    try:
+        return float(v) if v else DEFAULT_PEAK_FLOPS
+    except ValueError:
+        return DEFAULT_PEAK_FLOPS
+
+
+def estimate_mfu(ms_per_step=None):
+    """Model FLOPs utilization in [0, 1], or None when either side of
+    the ratio is missing. ``ms_per_step`` defaults to the mean of the
+    ``executor_step_ms`` histogram (wall time around dispatch — on a
+    host-overhead-bound model this UNDERSTATES device utilization;
+    see docs/OBSERVABILITY.md)."""
+    flops = flops_per_step()
+    if not flops:
+        return None
+    if ms_per_step is None:
+        from paddle_tpu.monitor.registry import REGISTRY
+        h = REGISTRY.get("executor_step_ms")
+        if h is None or h.count() == 0:
+            return None
+        ms_per_step = h.sum() / h.count()
+    if ms_per_step <= 0:
+        return None
+    return flops / (ms_per_step / 1e3) / peak_flops()
+
+
+def reset():
+    """Forget recorded segments and their gauge series (tests)."""
+    global _latest_group
+    with _lock:
+        _segments.clear()
+        _latest_group = None
+    _g_flops.clear()
+    _g_bytes.clear()

@@ -6,8 +6,18 @@ host+CUPTI tracer (ref: platform/profiler.h, platform/device_tracer.h,
 tools/timeline.py). TPU-native: host spans recorded here; device tracing
 delegates to jax.profiler (XPlane → TensorBoard/Perfetto), which plays
 the CUPTI role.
+
+Event storage is a BOUNDED ring with thread-local shards (the
+monitor-registry sharding pattern): appends touch only the calling
+thread's deque — no lock, no cross-thread race on a shared list — and a
+long run can no longer grow host memory without bound (cap via
+``set_max_events``, default 1e6 per thread, env
+``PADDLE_TPU_PROFILER_MAX_EVENTS``). When the flight recorder
+(monitor/flight_recorder.py) is armed, ``RecordEvent`` also feeds it, so
+a postmortem names the span a dying rank was stuck inside.
 """
 
+import collections
 import contextlib
 import json
 import os
@@ -16,38 +26,116 @@ import time
 
 import jax
 
+from paddle_tpu.core.enforce import warn_once
+from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor.registry import _ThreadShards
+
 __all__ = [
     "profiler", "start_profiler", "stop_profiler", "reset_profiler",
     "RecordEvent", "record_memory_event", "export_chrome_trace",
-    "compilation_cache_stats",
+    "compilation_cache_stats", "set_max_events",
 ]
 
-_events = []          # (name, start_s, dur_s, tid)
-_mem_events = []      # (name, ts_s, bytes, place)
+_DEFAULT_MAX_EVENTS = int(os.environ.get(
+    "PADDLE_TPU_PROFILER_MAX_EVENTS", str(1_000_000)))
+
+
+class _ShardedRing:
+    """Bounded event store, one deque per writer thread (the shared
+    monitor-registry shard idiom: registered under a lock once per
+    thread, appended lock-free after; dead threads' deques fold into
+    one bounded retired ring so thread churn cannot pin memory). The
+    cap is read at every append, so ``set_max_events`` takes effect
+    live; it bounds EACH live thread's shard — the reference's profiler
+    grows one vector per thread the same way (profiler.cc thread-local
+    EventList)."""
+
+    def __init__(self, cap):
+        self.cap = int(cap)
+        self._retired = collections.deque()
+        self._shards = _ThreadShards(collections.deque, self._retire)
+
+    def _retire(self, d):
+        self._retired.extend(d)
+        self._trim(self._retired)
+
+    def _trim(self, d):
+        while len(d) > self.cap:
+            try:
+                d.popleft()
+            except IndexError:
+                # a concurrent clear() emptied the deque between the
+                # length check and the pop — exactly the state the trim
+                # wanted, so done
+                break
+
+    def append(self, item):
+        d = self._shards.get()
+        d.append(item)
+        self._trim(d)
+
+    def _all(self):
+        return [self._retired] + self._shards.shards()
+
+    def snapshot(self):
+        out = []
+        for d in self._all():
+            out.extend(list(d))
+        return out
+
+    def clear(self):
+        for d in self._all():
+            d.clear()
+
+    def __iter__(self):
+        return iter(self.snapshot())
+
+    def __len__(self):
+        return sum(len(d) for d in self._all())
+
+
+_events = _ShardedRing(_DEFAULT_MAX_EVENTS)      # (name, t0, dur, tid)
+_mem_events = _ShardedRing(_DEFAULT_MAX_EVENTS)  # (name, ts, bytes, place)
 _active = {"on": False, "jax_dir": None}
 
 
+def set_max_events(n):
+    """Cap the profiler's per-thread event rings (oldest events drop
+    first). Returns the previous cap."""
+    prev = _events.cap
+    _events.cap = _mem_events.cap = max(int(n), 1)
+    return prev
+
+
 class RecordEvent:
-    """RAII span (ref: platform/profiler.h:81 RecordEvent)."""
+    """RAII span (ref: platform/profiler.h:81 RecordEvent). Feeds the
+    profiler ring when profiling is on AND the flight recorder when it
+    is armed — a postmortem can name in-flight spans even when the
+    profiler was never started."""
 
     def __init__(self, name):
         self.name = name
 
     def __enter__(self):
         self.t0 = time.perf_counter()
+        if _flight._enabled:
+            _flight.RECORDER.span_push(self.name)
         return self
 
     def __exit__(self, *exc):
+        dur = time.perf_counter() - self.t0
         if _active["on"]:
-            _events.append((self.name, self.t0,
-                            time.perf_counter() - self.t0,
+            _events.append((self.name, self.t0, dur,
                             threading.get_ident()))
+        if _flight._enabled:
+            _flight.RECORDER.span_pop(self.name, dur)
 
 
 def record_memory_event(name, nbytes, place="host"):
     """Memory event (ref: platform/profiler.h:44-57 MemEvent)."""
     if _active["on"]:
-        _mem_events.append((name, time.perf_counter(), int(nbytes), place))
+        _mem_events.append((name, time.perf_counter(), int(nbytes),
+                            place))
 
 
 def export_chrome_trace(path):
@@ -55,23 +143,66 @@ def export_chrome_trace(path):
     tracing JSON (chrome://tracing / Perfetto) — tools/timeline.py:131
     parity. Device-side traces come from jax.profiler's XPlane dump
     (start_profiler(trace_dir=...)); this export covers the host runtime
-    the way the reference's host profiler layer does."""
+    the way the reference's host profiler layer does.
+
+    Beyond the bare spans: per-tid thread metadata, FLOW arrows linking
+    each ``executor.run/dispatch`` slice to the ``executor.run/fetch``
+    that materializes it (under async dispatch they are separated in
+    time — the arrow shows which fetch paid for which dispatch), and a
+    ``steps/s`` counter track derived from consecutive dispatch
+    starts."""
+    spans = sorted(_events.snapshot(), key=lambda e: e[1])
     events = []
     tids = {}
-    for name, t0, dur, tid in _events:
+    for name, t0, dur, tid in spans:
         tids.setdefault(tid, len(tids))
         events.append({
             "name": name, "ph": "X", "cat": "host",
             "ts": t0 * 1e6, "dur": dur * 1e6,
             "pid": 0, "tid": tids[tid],
         })
-    for name, ts, nbytes, place in _mem_events:
+    # flow arrows: dispatch -> the next fetch on the same thread (FIFO:
+    # with k steps in flight, fetch N still pairs with dispatch N)
+    flow_id = 0
+    pending = {}                      # tid -> deque of (id, end ts)
+    prev_dispatch = {}                # tid -> previous dispatch start
+    for name, t0, dur, tid in spans:
+        t = tids[tid]
+        if name == "executor.run/dispatch":
+            flow_id += 1
+            pending.setdefault(t, collections.deque()).append(
+                (flow_id, (t0 + dur) * 1e6))
+            events.append({
+                "name": "dispatch->fetch", "ph": "s", "cat": "flow",
+                "id": flow_id, "ts": (t0 + dur * 0.5) * 1e6,
+                "pid": 0, "tid": t,
+            })
+            last = prev_dispatch.get(t)
+            prev_dispatch[t] = t0
+            if last is not None and t0 > last:
+                events.append({
+                    "name": "steps/s", "ph": "C", "ts": t0 * 1e6,
+                    "pid": 0, "args": {"steps/s":
+                                       round(1.0 / (t0 - last), 3)},
+                })
+        elif name == "executor.run/fetch" and pending.get(t):
+            fid, _end = pending[t].popleft()
+            events.append({
+                "name": "dispatch->fetch", "ph": "f", "bp": "e",
+                "cat": "flow", "id": fid,
+                "ts": (t0 + dur * 0.5) * 1e6, "pid": 0, "tid": t,
+            })
+    for name, ts, nbytes, place in sorted(_mem_events.snapshot(),
+                                          key=lambda e: e[1]):
         events.append({
             "name": f"mem:{place}", "ph": "C", "ts": ts * 1e6,
             "pid": 0, "args": {name: nbytes},
         })
     meta = [{"name": "process_name", "ph": "M", "pid": 0,
              "args": {"name": "paddle_tpu host"}}]
+    for tid, t in sorted(tids.items(), key=lambda kv: kv[1]):
+        meta.append({"name": "thread_name", "ph": "M", "pid": 0,
+                     "tid": t, "args": {"name": f"host thread {tid}"}})
     trace = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as f:
@@ -110,7 +241,7 @@ def compilation_cache_stats():
 
 def summary(sorted_key="total", profile_path=None):
     agg = {}
-    for name, _, dur, _tid in _events:
+    for name, _, dur, _tid in _events.snapshot():
         tot, cnt = agg.get(name, (0.0, 0))
         agg[name] = (tot + dur, cnt + 1)
     rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
@@ -124,6 +255,17 @@ def summary(sorted_key="total", profile_path=None):
         lines.append(f"compilation cache: {cc['hits']} hits / "
                      f"{cc['misses']} misses "
                      f"({compile_cache.cache_dir()})")
+    from paddle_tpu.monitor import cost as _cost
+    mfu = _cost.estimate_mfu()
+    if mfu is not None:
+        from paddle_tpu.monitor.registry import REGISTRY
+        h = REGISTRY.get("executor_step_ms")
+        ms = h.sum() / h.count() if h is not None and h.count() else 0.0
+        lines.append(
+            f"MFU estimate: {mfu * 100:.2f}% "
+            f"(flops/step={_cost.flops_per_step():.3e}, "
+            f"ms/step={ms:.3f}, peak={_cost.peak_flops():.3e} FLOP/s "
+            f"-- see docs/OBSERVABILITY.md for CPU-host caveats)")
     report = "\n".join(lines)
     if profile_path:
         with open(profile_path, "w") as f:
@@ -146,9 +288,10 @@ def cuda_profiler(output_file=None, output_mode=None, config=None):
     """fluid.profiler.cuda_profiler parity shim: the reference drives
     nvprof; on TPU device tracing is jax.profiler (use profiler()/
     start_profiler with a trace_dir instead). Kept as a working span so
-    fluid scripts run unchanged — it records a host span and warns."""
-    import warnings
-    warnings.warn("cuda_profiler is a no-op on TPU; use "
-                  "profiler.profiler(trace_dir=...) for device traces")
+    fluid scripts run unchanged — it records a host span and warns ONCE
+    per process (a per-epoch shim invocation must not spam the log)."""
+    warn_once("cuda_profiler",
+              "cuda_profiler is a no-op on TPU; use "
+              "profiler.profiler(trace_dir=...) for device traces")
     with RecordEvent("cuda_profiler"):
         yield

@@ -33,6 +33,7 @@ disk. `FLAGS_executor_fast_path=0` restores the legacy per-step rescans
 """
 
 import threading
+import time
 import weakref
 
 import jax
@@ -41,6 +42,10 @@ import numpy as np
 
 from paddle_tpu.core.enforce import EnforceNotMet, enforce
 from paddle_tpu.core.flags import define_flag, get_flag
+from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor.registry import counter as _counter
+from paddle_tpu.monitor.registry import gauge as _gauge
+from paddle_tpu.monitor.registry import histogram as _histogram
 from paddle_tpu.profiler import RecordEvent
 from paddle_tpu.static.program import (
     OP_REGISTRY, Parameter, default_main_program, default_startup_program,
@@ -50,6 +55,33 @@ define_flag("executor_fast_path", True,
             "Memoize a prepared runner per (program, feed-signature) so "
             "the steady-state step skips per-step state rescans and DP "
             "re-device_puts (0 = legacy per-step preparation)")
+define_flag("monitor_cost", True,
+            "Record per-compiled-segment FLOPs/bytes (XLA cost "
+            "analysis) into the metrics registry on first execution "
+            "(0 = skip the one-time extra lowering)")
+
+# unified telemetry (monitor/registry.py): the hot-loop counters every
+# layer above reads — catalogued in docs/OBSERVABILITY.md
+_m_steps = _counter("executor_steps_total",
+                    "Executor.run calls that dispatched a step")
+_m_step_ms = _histogram("executor_step_ms",
+                        "Wall ms per Executor.run call (prepare + "
+                        "dispatch + fetch)")
+_m_fetch_ms = _histogram("executor_fetch_ms",
+                         "Wall ms blocked materializing fetches "
+                         "(host sync) per Executor.run call")
+_m_retraces = _counter("executor_retraces_total",
+                       "Device-segment traces performed (mirrors "
+                       "Executor.trace_count across all executors)")
+_m_q_depth = _gauge("prefetch_queue_depth",
+                    "Items currently buffered in the background "
+                    "prefetch queue")
+_m_q_wait = _counter("prefetch_producer_wait_ms_total",
+                     "Wall ms prefetch producers spent handing items "
+                     "to the queue (blocked time on a full queue)")
+_m_q_items = _counter("prefetch_items_total",
+                      "Items produced by background prefetch pipelines")
+
 
 
 class Scope:
@@ -164,16 +196,21 @@ def background_prefetch(producer, transform, depth=2):
     SENTINEL = object()
     stop = threading.Event()
 
-    def put(item):
+    def put(item, count=True):
         # never block forever: the consumer may have exited (its drain
         # can race with a worker still inside transform), so a plain
         # q.put could park this thread on a full queue for good
+        t0 = time.perf_counter()
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.1)
-                return True
             except _queue.Full:
                 continue
+            if count:       # data items only, not sentinel/failure
+                _m_q_items.inc()
+                _m_q_wait.inc((time.perf_counter() - t0) * 1e3)
+            _m_q_depth.set(q.qsize())
+            return True
         return False
 
     def worker():
@@ -184,9 +221,9 @@ def background_prefetch(producer, transform, depth=2):
                 if not put(transform(b)):
                     return
         except BaseException as e:       # surface in consumer
-            put(_PrefetchFailure(e))
+            put(_PrefetchFailure(e), count=False)
             return
-        put(SENTINEL)
+        put(SENTINEL, count=False)
 
     t = threading.Thread(target=worker, daemon=True,
                          name="pt-prefetch-worker")
@@ -194,6 +231,7 @@ def background_prefetch(producer, transform, depth=2):
     try:
         while True:
             item = q.get()
+            _m_q_depth.set(q.qsize())
             if item is SENTINEL:
                 break
             if isinstance(item, _PrefetchFailure):
@@ -268,10 +306,11 @@ class _CompiledStep:
 
     __slots__ = ("segs", "seg_fns", "constants", "state_set",
                  "state_names", "fetch_names", "interpret",
-                 "_donate_names", "donated_fetch_idx")
+                 "_donate_names", "donated_fetch_idx", "_cost_done")
 
     def __init__(self, segs, seg_fns, constants, state_names,
                  fetch_names, interpret):
+        self._cost_done = False
         self.segs = segs
         self.seg_fns = seg_fns
         self.constants = constants
@@ -314,6 +353,9 @@ class _CompiledStep:
         env = dict(self.constants) if self.constants else {}
         env.update(state)
         env.update(feeds)
+        record_cost = not self._cost_done and \
+            bool(get_flag("monitor_cost"))
+        dev_i = 0
         for (is_host, a, b), fn_w, donate in zip(
                 self.segs, self.seg_fns, self._donate_names):
             if is_host:
@@ -321,12 +363,39 @@ class _CompiledStep:
             else:
                 fn, _writes = fn_w
                 donated, rest = self._split(env, donate)
+                if record_cost:
+                    # BEFORE executing: donation deletes these buffers
+                    self._record_cost(dev_i, fn, donated, rest,
+                                      base_key, step_idx)
                 out = fn(donated, rest, base_key, step_idx)
                 env = dict(self.constants) if self.constants else {}
                 env.update(out)
+                dev_i += 1
+        if record_cost:
+            # only latch when the probe actually ran: a step executed
+            # under FLAGS_monitor_cost=0 can still record cost later
+            # when the flag is flipped back on
+            self._cost_done = True
         fetches = [env[n] for n in self.fetch_names]
         new_state = {n: env[n] for n in self.state_names}
         return fetches, new_state
+
+    def _record_cost(self, dev_i, fn, donated, rest, base_key,
+                     step_idx):
+        """One-time per segment: read XLA's analytical FLOPs/bytes off
+        ``fn.lower(...)`` and publish them as segment_flops/
+        segment_bytes gauges — the raw material of the MFU estimate.
+        The lowering shares jit's tracing cache, so it IS the first
+        call's trace (trace_count moves exactly as without the probe)
+        and the immediately following execution reuses it. Never
+        fatal."""
+        from paddle_tpu.monitor import cost as _cost
+        try:
+            lowered = fn.lower(donated, rest, base_key, step_idx)
+        except Exception:
+            return
+        _cost.record_segment(id(self), dev_i,
+                             _cost.analyze_lowered(lowered))
 
     def aot_compile(self, state, feeds, base_key, step_idx):
         """Eagerly .lower().compile() device segments with abstract
@@ -341,17 +410,26 @@ class _CompiledStep:
         env.update({k: _spec_of(v) for k, v in feeds.items()})
         compiled = 0
         total = sum(1 for is_host, _, _ in self.segs if not is_host)
+        record_cost = not self._cost_done and \
+            bool(get_flag("monitor_cost"))
         for (is_host, a, b), fn_w, donate in zip(
                 self.segs, self.seg_fns, self._donate_names):
             if is_host:
                 break
             fn, _writes = fn_w
             donated, rest = self._split(env, donate)
-            fn.lower(donated, rest, base_key, step_idx).compile()
+            lowered = fn.lower(donated, rest, base_key, step_idx)
+            lowered.compile()
+            if record_cost:
+                from paddle_tpu.monitor import cost as _cost
+                _cost.record_segment(id(self), compiled,
+                                     _cost.analyze_lowered(lowered))
             out = jax.eval_shape(fn, donated, rest, base_key, step_idx)
             compiled += 1
             env = {k: _spec_of(v) for k, v in self.constants.items()}
             env.update(out)
+        if record_cost and compiled == total:
+            self._cost_done = True
         return compiled, total
 
 
@@ -503,6 +581,7 @@ class Executor:
             return [] if not fetch_names else [
                 self._fetch_value(scope, n, return_numpy) for n in fetch_names]
 
+        t_run = time.perf_counter()
         with RecordEvent("executor.run/prepare"):
             feeds = {k: _as_feed_array(v) for k, v in feed.items()}
             dsig = self._dispatch_sig(program, dp_mesh, feeds,
@@ -540,7 +619,10 @@ class Executor:
                 scope.set_var(n, v)
         if return_numpy:
             with RecordEvent("executor.run/fetch"):
+                t_fetch = time.perf_counter()
                 fetches = [np.asarray(f) for f in fetches]
+                _m_fetch_ms.observe(
+                    (time.perf_counter() - t_fetch) * 1e3)
         elif runner.step.donated_fetch_idx:
             # async contract: a fetched var that is also donated state
             # (e.g. fetch_list=[some_param]) would have its buffer
@@ -548,6 +630,11 @@ class Executor:
             # materializes it — hand back an (async) device copy
             for i in runner.step.donated_fetch_idx:
                 fetches[i] = jnp.array(fetches[i], copy=True)
+        _m_steps.inc()
+        _m_step_ms.observe((time.perf_counter() - t_run) * 1e3)
+        if _flight._enabled:
+            _flight.RECORDER.note("step", "executor.run",
+                                  step=int(step_idx))
         return fetches
 
     def prepare(self, program=None, feed=None, fetch_list=None,
@@ -892,6 +979,7 @@ class Executor:
                 # retrace probe the caching tests (and bench_dispatch's
                 # sanity check) read
                 self._trace_count += 1
+                _m_retraces.inc()
                 # constants enter via closure -> XLA compile-time consts
                 env = dict(constants)
                 env.update(rest)

@@ -1,0 +1,89 @@
+"""Training worker for the unified-telemetry end-to-end tests.
+
+A real Executor training loop (tiny fc regressor) instrumented the way
+a production worker should be:
+
+- flight recorder armed from the launcher's env FIRST (so even a crash
+  during jax import would dump),
+- per-rank metrics snapshots via ``RankExporter.from_env`` (written
+  next to the heartbeat file the watchdog reads),
+- heartbeats each step, ``faults.maybe_fault`` inside the
+  ``train/step`` span — a hang therefore dies with that span IN FLIGHT,
+  which is exactly what its postmortem must name.
+
+argv: out_prefix total_steps [step_secs]
+
+Reports to <out_prefix>.rank<id>.json: first losses, the profiler
+summary (the test asserts its MFU line), and the restart count.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    out_prefix = sys.argv[1]
+    total_steps = int(sys.argv[2])
+    step_secs = float(sys.argv[3]) if len(sys.argv) > 3 else 0.05
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+
+    from paddle_tpu.monitor import flight_recorder
+    flight_recorder.install_from_env()
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed.health import Heartbeat
+    from paddle_tpu.monitor.exporter import RankExporter
+    from paddle_tpu.testing import faults
+
+    hb = Heartbeat.from_env(interval=0.1)
+    exp = RankExporter.from_env(interval=0.5)
+    if exp is not None:
+        exp.start()
+
+    pt.enable_static()
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        x = pt.static.data("x", [4], dtype="float32")
+        y = pt.static.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = pt.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True).astype(np.float32)
+    # AOT warm-up: also records the per-segment XLA cost gauges
+    exe.prepare(main_p, feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+    losses = []
+    for step in range(total_steps):
+        with profiler.RecordEvent("train/step"):
+            faults.maybe_fault(step)
+            (lv,) = exe.run(main_p, feed={"x": xv, "y": yv},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+            if hb is not None:
+                hb.beat()
+            time.sleep(step_secs)
+
+    summary = profiler.summary()
+    if exp is not None:
+        exp.stop()              # final snapshot covers every step
+    with open(f"{out_prefix}.rank{rank}.json", "w") as f:
+        json.dump({
+            "losses": losses[:3],
+            "steps": len(losses),
+            "summary": summary,
+            "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                "0")),
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Metrics-catalogue lint: code and docs must agree.
+
+Every metric registered in the tree (a ``counter("name", ...)`` /
+``gauge(`` / ``histogram(`` call in ``paddle_tpu/`` or ``bench.py``)
+must have a row in docs/OBSERVABILITY.md's catalogue table, and every
+row must correspond to a registered metric — an undocumented metric is
+invisible to operators, and a documented-but-gone metric silently
+breaks their dashboards. Run as a tier-1 test (tests/test_monitor.py)
+and standalone:
+
+    python tools/check_metrics.py        # exit 1 on any drift
+"""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+# a registration is a lowercase factory call with a literal first-arg
+# name (possibly on the next line); \s* crosses newlines on purpose
+_REG_RE = re.compile(
+    r"(?:counter|gauge|histogram)\(\s*[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']")
+# catalogue rows: | `name` | type | ...
+_DOC_RE = re.compile(r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|",
+                     re.MULTILINE)
+
+
+def code_metrics(repo=REPO):
+    """Metric names registered anywhere in paddle_tpu/ or bench.py."""
+    names = set()
+    roots = [os.path.join(repo, "paddle_tpu")]
+    files = [os.path.join(repo, "bench.py")]
+    for root in roots:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__",)]
+            files.extend(os.path.join(dirpath, f) for f in filenames
+                         if f.endswith(".py"))
+    for path in files:
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        names.update(_REG_RE.findall(src))
+    return names
+
+
+def doc_metrics(path=DOCS):
+    with open(path) as f:
+        return set(_DOC_RE.findall(f.read()))
+
+
+def main():
+    code = code_metrics()
+    docs = doc_metrics()
+    undocumented = sorted(code - docs)
+    stale = sorted(docs - code)
+    if undocumented:
+        print(f"metrics registered in code but missing from "
+              f"docs/OBSERVABILITY.md catalogue: {undocumented}")
+    if stale:
+        print(f"metrics documented in docs/OBSERVABILITY.md but not "
+              f"registered anywhere: {stale}")
+    if undocumented or stale:
+        return 1
+    print(f"metrics catalogue in sync ({len(code)} metrics)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

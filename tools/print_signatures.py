@@ -30,6 +30,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.distributions",
     "paddle_tpu.profiler",
+    "paddle_tpu.monitor",
     "paddle_tpu.amp",
     "paddle_tpu.backward",
     "paddle_tpu.distributed",
