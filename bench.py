@@ -647,6 +647,92 @@ def bench_nmt():
     print(json.dumps(line))
 
 
+def bench_numerics():
+    """`python bench.py numerics` — step-time overhead of the
+    FLAGS_check_nan_inf in-graph sentinels (monitor/numerics.py),
+    measured the bench_dispatch way: check-on and check-off windows
+    INTERLEAVE (adjacent windows see the same ambient host load on a
+    shared box), and the headline is the median of per-pair on/off
+    ratios, which a load drift cannot bias. The model is the
+    deep-and-narrow dispatch-bound stack — the worst case for the
+    sentinel, whose reduction cost is trivial but whose per-step
+    scalar sync and no-donation policy hit exactly the host-bound
+    regime. Prints one JSON line; windows also land in the registry
+    snapshot every bench mode emits."""
+    import time as _time
+
+    import paddle_tpu as pt
+    from paddle_tpu.static.executor import Scope, scope_guard
+
+    steps = int(os.environ.get("BENCH_NUMERICS_STEPS", "150"))
+    # mode-specific knob: BENCH_WINDOWS means "timed windows" in every
+    # other mode, and silently reading it as PAIRS here would double
+    # this mode's runtime under the shared CI knob
+    pairs = max(2, int(os.environ.get("BENCH_NUMERICS_PAIRS", "5")))
+    DEPTH, HIDDEN, BATCH = 24, 16, 16
+
+    pt.enable_static()
+    rs = np.random.RandomState(0)
+    xb = rs.randn(BATCH, HIDDEN).astype(np.float32)
+    yb = rs.randn(BATCH, 1).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.static.data("x", shape=[HIDDEN])
+        y = pt.static.data("y", shape=[1])
+        h = x
+        for i in range(DEPTH):
+            h = pt.layers.fc(h, size=HIDDEN, param_attr=f"w{i}",
+                             bias_attr=f"b{i}", act="relu")
+        pred = pt.layers.fc(h, size=1, param_attr="w_out",
+                            bias_attr="b_out")
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.Momentum(0.02, momentum=0.9).minimize(loss)
+    scope = Scope()
+
+    def window(check, n):
+        pt.set_flags({"check_nan_inf": check})
+        try:
+            with scope_guard(scope):
+                t0 = _time.perf_counter()
+                for _ in range(n):
+                    exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+                return _time.perf_counter() - t0
+        finally:
+            pt.set_flags({"check_nan_inf": False})
+
+    with scope_guard(scope):
+        exe = pt.static.Executor()
+        exe.run(startup)
+    window(False, 4)            # compile + warm both variants: the
+    window(True, 4)             # checked jit is its own trace/compile
+    on_ms, off_ms, ratios = [], [], []
+    from paddle_tpu.monitor.registry import histogram
+    h_win = histogram("bench_window_ms_per_step",
+                      "Per-step wall ms of each timed bench window")
+    for w in range(pairs):
+        first_on = w % 2 == 0   # alternate order within each pair
+        a = window(first_on, steps)
+        b = window(not first_on, steps)
+        on, off = (a, b) if first_on else (b, a)
+        on_ms.append(on / steps * 1e3)
+        off_ms.append(off / steps * 1e3)
+        ratios.append(on / off)
+        h_win.observe(on / steps * 1e3)
+        h_win.observe(off / steps * 1e3)
+    med = float(np.median(ratios))
+    print(json.dumps({
+        "metric": "numerics_check_overhead_ratio",
+        "value": round(med, 4), "unit": "x",
+        "check_on_ms_per_step": round(float(np.median(on_ms)), 4),
+        "check_off_ms_per_step": round(float(np.median(off_ms)), 4),
+        "pair_ratios": [round(r, 4) for r in ratios],
+    }))
+    print(f"# numerics sentinel overhead: median pair ratio "
+          f"{med:.4f}x over {pairs} interleaved pairs x {steps} steps",
+          file=sys.stderr)
+
+
 def _emit_registry_snapshot():
     """End-of-run metrics emission: the registry (bench windows +
     whatever executor/prefetch/checkpoint counters the run touched) as
@@ -694,6 +780,8 @@ def _dispatch_mode():
         return bench_int8()
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         return bench_serving()
+    if len(sys.argv) > 1 and sys.argv[1] == "numerics":
+        return bench_numerics()
     import jax
     import jax.numpy as jnp
 

@@ -137,6 +137,21 @@ class OptimizerWithMixedPrecision:
         new_p, new_o = self.opt.apply_gradients(params, grads, state["opt"])
         return new_p, {"opt": new_o}
 
+    def monitor_state(self, state, step=None):
+        """Publish the loss-scale state to monitor.tensorwatch: the
+        ``loss_scale`` gauge plus a ``loss_scale_decrements_total``
+        count for each observed decrement (= a non-finite fp16
+        gradient event the scaler absorbed). Call between steps with
+        the MATERIALIZED state — the scale is a scalar the caller's
+        next dispatch already waits on, so this adds no extra device
+        round-trip. Returns the float scale (None without a scaler:
+        bf16 needs no scaling, so there is nothing to watch)."""
+        if not self.scaler or "loss_scale" not in state:
+            return None
+        from paddle_tpu.monitor import tensorwatch
+        return tensorwatch.record_loss_scale(
+            state["loss_scale"]["scale"], step=step)
+
 
 def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
              use_dynamic_loss_scaling=True, use_bf16=True):

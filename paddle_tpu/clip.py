@@ -11,8 +11,19 @@ import jax.numpy as jnp
 
 __all__ = [
     "GradientClipByValue", "GradientClipByNorm", "GradientClipByGlobalNorm",
-    "ErrorClipByValue", "set_gradient_clip",
+    "ErrorClipByValue", "set_gradient_clip", "global_norm",
 ]
+
+
+def global_norm(tree):
+    """sqrt(sum of squares) over every leaf of a pytree — the tree-wide
+    norm GradientClipByGlobalNorm clips by. monitor/tensorwatch.py's
+    watch ops build the SAME subgraph, so when both run in one fused
+    step XLA's CSE computes the reduction once."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
 
 
 class GradientClipBase:
@@ -46,8 +57,7 @@ class GradientClipByGlobalNorm(GradientClipBase):
         self.clip_norm = clip_norm
 
     def clip_tree(self, grads):
-        leaves = jax.tree.leaves(grads)
-        gn = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+        gn = global_norm(grads)
         scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
         return jax.tree.map(lambda g: g * scale, grads)
 

@@ -79,3 +79,19 @@ def warn_once(key, message, category=UserWarning, stacklevel=3):
         _warned_keys.add(key)
     warnings.warn(message, category, stacklevel=stacklevel)
     return True
+
+
+def _reset_warn_once(key=None):
+    """TESTS ONLY: forget that ``key`` (or, with None, every key) has
+    warned, so a ``pytest.warns`` assertion no longer depends on being
+    the process's first caller of the shim under test (the ordering
+    flake CHANGES.md PR 3 noted). Production code must not call this —
+    once-per-process is the contract."""
+    with _warn_lock:
+        if key is None:
+            _warned_keys.clear()
+        else:
+            _warned_keys.discard(key)
+
+
+warn_once.reset_for_tests = _reset_warn_once

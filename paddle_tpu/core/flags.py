@@ -69,8 +69,11 @@ define_flag("paddle_num_threads", os.cpu_count() or 1,
             "Host threads for the data pipeline "
             "(ref: platform/init.cc:39 FLAGS_paddle_num_threads)")
 define_flag("check_nan_inf", False,
-            "Check outputs for nan/inf after each step "
-            "(ref: framework/operator.cc FLAGS_check_nan_inf)")
+            "Fuse isfinite sentinels into every compiled device "
+            "segment and, on a trip, localize the first non-finite "
+            "tensor/op by eager per-op replay (monitor/numerics.py, "
+            "docs/DEBUGGING.md; ref: framework/operator.cc "
+            "FLAGS_check_nan_inf)")
 define_flag("benchmark", False, "Print per-step timing")
 define_flag("reader_queue_capacity", 64,
             "Capacity of async feeding queues "

@@ -34,16 +34,28 @@ def _iter_files(root, exts=(".md", ".txt", ".rst", ".py")):
 
 
 def build_corpus(root, vocab_size=2048, max_bytes=8 << 20,
-                 exts=(".md", ".txt", ".rst", ".py")):
+                 exts=(".md", ".txt", ".rst", ".py"), files=None):
     """Tokenize local files into one id stream.
 
     Returns (ids int32 [N], word->id dict). ids use the RESERVED
     prefix (0 pad, 1 unk, 2 mask, 3 cls, 4 sep); the vocab keeps the
     (vocab_size - RESERVED) most frequent words.
+
+    ``files`` pins the corpus to an explicit ORDERED list of paths
+    (relative to ``root`` or absolute; missing entries are skipped,
+    ``exts`` ignored) instead of walking ``root``. Convergence tests
+    pass a committed manifest here so a growing tree no longer shifts
+    their training data (tests/fixtures/bert_corpus_manifest.txt).
     """
+    if files is not None:
+        paths = [p if os.path.isabs(p) else os.path.join(root, p)
+                 for p in files]
+        paths = [p for p in paths if os.path.isfile(p)]
+    else:
+        paths = _iter_files(root, exts)
     words = []
     budget = max_bytes
-    for path in _iter_files(root, exts):
+    for path in paths:
         try:
             with open(path, "r", encoding="utf-8", errors="ignore") as f:
                 text = f.read(budget)

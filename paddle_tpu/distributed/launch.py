@@ -49,6 +49,7 @@ import time
 
 from paddle_tpu.core.compile_cache import ENV_VAR as CACHE_ENV_VAR
 from paddle_tpu.distributed import health
+from paddle_tpu.monitor import anomaly as _anomaly
 from paddle_tpu.monitor import exporter as _exporter
 from paddle_tpu.monitor import flight_recorder as _flight
 from paddle_tpu.monitor.registry import REGISTRY as _REGISTRY
@@ -72,6 +73,10 @@ _m_watchdog = _counter(
     "watchdog_trips_total",
     "Hang-watchdog kills (a rank heartbeat, then went silent past "
     "--hang_timeout)")
+_m_stragglers = _counter(
+    "straggler_trips_total",
+    "Ranks newly flagged as stragglers by the launcher (mean step "
+    "time above the skew threshold vs the median rank)")
 
 
 def _postmortem_env(log_dir):
@@ -98,20 +103,41 @@ def _report_postmortems(log_dir, why):
              f"(newest: {dumps[-1]})")
 
 
-def _status_tick(hb_dir, log_dir, restarts):
+def _status_tick(hb_dir, log_dir, restarts, flagged_stragglers=None):
     """One supervision-loop status beat: log the aggregated job line
-    and refresh <log_dir>/metrics.prom from the rank snapshots. Never
-    raises — a telemetry hiccup (disk error, a malformed snapshot a
-    dying rank half-wrote) must not tear down the supervisor."""
+    (now carrying a ``health=`` field — anomaly trips + straggler
+    skew, see monitor/anomaly.py) and refresh <log_dir>/metrics.prom
+    from the rank snapshots. A rank newly entering straggler-hood gets
+    its own log line and bumps ``straggler_trips_total``;
+    ``flagged_stragglers`` is the PER-LAUNCH already-reported set (a
+    module-global here would suppress reporting across sequential
+    launches in one supervisor process). Never raises — a telemetry
+    hiccup (disk error, a malformed snapshot a dying rank half-wrote)
+    must not tear down the supervisor."""
     try:
-        line = _exporter.job_status_line(hb_dir, restarts=restarts)
+        snaps = _exporter.read_rank_snapshots(hb_dir)
+        # one job_health judgment feeds BOTH the health= field and the
+        # straggler bookkeeping: two computations could disagree about
+        # who is a straggler within a single tick
+        health, stragglers = _anomaly.job_health(snaps)
+        line = _exporter.job_status_line(hb_dir, restarts=restarts,
+                                         snaps=snaps, health=health)
         if line:
             _log("status " + line)
+        if flagged_stragglers is not None:
+            new = set(stragglers) - flagged_stragglers
+            if new:
+                _m_stragglers.inc(len(new))
+                _log(f"straggler: rank(s) {sorted(new)} mean step "
+                     f"time exceeds the skew threshold vs the median "
+                     f"rank (see the health= field / "
+                     f"docs/DEBUGGING.md)")
+            flagged_stragglers.update(new)
         if log_dir:
             _exporter.write_job_snapshot(
                 hb_dir, os.path.join(os.path.abspath(log_dir),
                                      "metrics.prom"),
-                registry=_REGISTRY)
+                registry=_REGISTRY, snaps=snaps)
     except Exception as e:
         _log(f"status tick failed (ignored): {type(e).__name__}: {e}")
 
@@ -219,7 +245,8 @@ def _log(msg):
 
 
 def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
-               grace_period, log_dir=None, restarts=0):
+               grace_period, log_dir=None, restarts=0,
+               flagged_stragglers=None):
     """Poll one gang incarnation to completion.
 
     ``procs``: name -> Popen; ``ranks``: name -> heartbeat rank (absent
@@ -237,7 +264,8 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
         while alive:
             if time.monotonic() >= next_status:
                 next_status = time.monotonic() + STATUS_INTERVAL
-                _status_tick(hb_dir, log_dir, restarts)
+                _status_tick(hb_dir, log_dir, restarts,
+                             flagged_stragglers)
             if term.is_set():
                 _log(f"SIGTERM: forwarding to {sorted(alive)} with "
                      f"{grace_period}s grace for checkpoint flush")
@@ -366,6 +394,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     deadline = None if timeout is None else time.monotonic() + timeout
     term = threading.Event()
     undo = _install_term_handler(term)
+    flagged_stragglers = set()          # per-launch straggler memory
     try:
         attempt = 0
         while True:
@@ -374,8 +403,9 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
             status, rc = _wait_gang(procs, ranks, logs, deadline,
                                     hang_timeout, hb_dir, term,
                                     grace_period, log_dir=log_dir,
-                                    restarts=attempt)
-            _status_tick(hb_dir, log_dir, attempt)
+                                    restarts=attempt,
+                                    flagged_stragglers=flagged_stragglers)
+            _status_tick(hb_dir, log_dir, attempt, flagged_stragglers)
             if status in ("ok", "timeout", "preempted"):
                 return rc
             # the killed gang's flight-recorder dumps are the evidence
@@ -460,6 +490,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
 
     servers, workers, logs = {}, {}, []
     restarts = [0] * worker_num
+    flagged_stragglers = set()          # per-launch straggler memory
     health.reset(hb_dir, worker_num)    # a reused log_dir must not
                                         # vouch for the new run
     deadline = None if timeout is None else time.monotonic() + timeout
@@ -519,7 +550,8 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
         while servers or (set(workers) - done_workers):
             if time.monotonic() >= next_status:
                 next_status = time.monotonic() + STATUS_INTERVAL
-                _status_tick(hb_dir, log_dir, sum(restarts))
+                _status_tick(hb_dir, log_dir, sum(restarts),
+                             flagged_stragglers)
             if term.is_set():
                 live = [n for n, p in servers.items() if p.poll() is None]
                 live += [f"trainer {i}" for i, p in workers.items()
@@ -594,7 +626,8 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                              f"that beat then stopped counts as hung)")
                     warned_slow = True
             time.sleep(0.2)
-        _status_tick(hb_dir, log_dir, sum(restarts))
+        _status_tick(hb_dir, log_dir, sum(restarts),
+                     flagged_stragglers)
         return rc
     except KeyboardInterrupt:
         for p in all_procs():

@@ -20,32 +20,53 @@ Four pieces, one API:
   analysis, combined with the step-time histogram into an MFU estimate
   (surfaced by ``profiler.summary()``).
 
-Everything importable here is stdlib-only at module level (jax is
-touched lazily inside ``cost``): the elastic launcher — which must
-supervise workers whose jax is wedged — can use the exporter and
-recorder freely.
+Training-health observability (the "has the run gone wrong" half,
+docs/DEBUGGING.md):
+
+- ``monitor.numerics`` — in-graph isfinite sentinels fused into the
+  executor's compiled segments under ``FLAGS_check_nan_inf``, plus the
+  bisecting localizer that names the first non-finite tensor and op.
+- ``monitor.tensorwatch`` — opt-in grad/param-norm, update-ratio and
+  AMP loss-scale watch riding the step's existing fetch.
+- ``monitor.anomaly`` — windowed anomaly detector (loss spike, grad
+  explosion, step stall, non-finite) that dumps the flight recorder
+  with the anomaly named, and the launcher-side straggler/health
+  readout over the per-rank snapshots.
+
+Everything importable here is stdlib-only at module level (jax/numpy
+are touched lazily inside ``cost``/``numerics``/``tensorwatch``): the
+elastic launcher — which must supervise workers whose jax is wedged —
+can use the exporter, recorder and anomaly readers freely.
 
 Metrics catalogue: docs/OBSERVABILITY.md (kept in sync by
 tools/check_metrics.py, a tier-1 CI check).
 """
 
+from paddle_tpu.monitor import anomaly
 from paddle_tpu.monitor import cost
 from paddle_tpu.monitor import exporter
 from paddle_tpu.monitor import flight_recorder
+from paddle_tpu.monitor import numerics
 from paddle_tpu.monitor import registry
+from paddle_tpu.monitor import tensorwatch
+from paddle_tpu.monitor.anomaly import AnomalyDetector
 from paddle_tpu.monitor.exporter import (
     MetricsServer, RankExporter, render_text, write_snapshot,
 )
 from paddle_tpu.monitor.flight_recorder import RECORDER, FlightRecorder
+from paddle_tpu.monitor.numerics import NonFiniteError
 from paddle_tpu.monitor.registry import (
     REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,
     histogram,
 )
+from paddle_tpu.monitor.tensorwatch import TensorMonitor
 
 __all__ = [
-    "registry", "exporter", "flight_recorder", "cost",
+    "registry", "exporter", "flight_recorder", "cost", "numerics",
+    "tensorwatch", "anomaly",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram",
     "RankExporter", "MetricsServer", "render_text", "write_snapshot",
     "FlightRecorder", "RECORDER",
+    "NonFiniteError", "TensorMonitor", "AnomalyDetector",
 ]

@@ -178,13 +178,21 @@ def _base_name(name, types):
 #: ranks as N+1 restarts
 _MAX_MERGE_NAMES = frozenset({"restarts_total"})
 
+#: gauges that take MIN across snapshots: train_health is 1=healthy /
+#: 0=tripped, and the job is only as healthy as its sickest rank — the
+#: default max-merge would report a job with one anomalous rank as
+#: healthy in <log_dir>/metrics.prom
+_MIN_MERGE_NAMES = frozenset({"train_health"})
+
 
 def aggregate(parsed):
     """Merge a list of ``(types, samples)`` into one job-level view:
     counters and histogram series SUM across ranks; gauges — and the
     restart count, which every party reports for the same events — take
     the MAX (per-rank FLOPs/queue-depth summed over replicas would read
-    as more work than any rank did)."""
+    as more work than any rank did); health-style gauges where the job
+    is only as good as its worst rank (``train_health``) take the
+    MIN."""
     types, samples = {}, {}
     for t, s in parsed:
         types.update(t)
@@ -193,6 +201,8 @@ def aggregate(parsed):
             kind = types.get(_base_name(key[0], types), "counter")
             if key not in samples:
                 samples[key] = v
+            elif key[0] in _MIN_MERGE_NAMES:
+                samples[key] = min(samples[key], v)
             elif kind == "gauge" or key[0] in _MAX_MERGE_NAMES:
                 samples[key] = max(samples[key], v)
             else:
@@ -239,11 +249,16 @@ def read_rank_snapshots(dirname):
     return out
 
 
-def write_job_snapshot(hb_dir, out_path, registry=None):
+def write_job_snapshot(hb_dir, out_path, registry=None, snaps=None):
     """Aggregate every rank's snapshot (plus ``registry`` — the
     launcher's own restart/watchdog counters) into one atomic file.
-    Returns ``out_path``, or None when there is nothing to write."""
-    parsed = list(read_rank_snapshots(hb_dir).values())
+    Returns ``out_path``, or None when there is nothing to write.
+    Pass pre-read ``snaps`` to reuse one directory scan and keep the
+    written aggregate consistent with whatever the caller just judged
+    (the launcher's status tick does)."""
+    if snaps is None:
+        snaps = read_rank_snapshots(hb_dir)
+    parsed = list(snaps.values())
     if registry is not None:
         parsed.append(parse_text(render_text(registry)))
     if not parsed:
@@ -255,16 +270,24 @@ def _sum_matching(samples, name):
     return sum(v for (n, _), v in samples.items() if n == name)
 
 
-def job_status_line(hb_dir, restarts=0):
+def job_status_line(hb_dir, restarts=0, snaps=None, health=None):
     """The launcher's periodic one-liner:
-    ``step=… ms/step=… mfu=… ranks=… restarts=…`` computed from the
-    rank snapshots in ``hb_dir``; None when no rank has exported yet.
+    ``step=… ms/step=… mfu=… health=… ranks=… restarts=…`` computed
+    from the rank snapshots in ``hb_dir``; None when no rank has
+    exported yet.
 
     ``step`` is the max across ranks (they advance together in data
     parallel); ms/step pools every rank's histogram; mfu uses the
     max-across-ranks per-step FLOPs (see ``monitor.cost`` for the
-    peak-FLOPs source and its CPU-host caveats)."""
-    snaps = read_rank_snapshots(hb_dir)
+    peak-FLOPs source and its CPU-host caveats); ``health`` comes from
+    ``monitor.anomaly.job_health`` — anomaly trips any rank exported
+    plus step-time-skew straggler detection over the same snapshots.
+    Pass pre-read ``snaps`` and a pre-computed ``health`` string to
+    reuse one directory scan / one job_health judgment (the launcher's
+    status tick does, so its log line and straggler bookkeeping judge
+    the SAME snapshot state with the SAME skew threshold)."""
+    if snaps is None:
+        snaps = read_rank_snapshots(hb_dir)
     if not snaps:
         return None
     step = 0
@@ -282,6 +305,10 @@ def job_status_line(hb_dir, restarts=0):
         from paddle_tpu.monitor.cost import peak_flops
         mfu = flops / (ms / 1e3) / peak_flops()
         parts.append(f"mfu={mfu:.4f}")
+    if health is None:
+        from paddle_tpu.monitor import anomaly as _anomaly
+        health, _stragglers = _anomaly.job_health(snaps)
+    parts.append(f"health={health}")
     parts.append(f"ranks={len(snaps)}")
     parts.append(f"restarts={restarts}")
     return " ".join(parts)

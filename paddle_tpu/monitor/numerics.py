@@ -1,0 +1,273 @@
+"""In-graph numerics sentinels + the non-finite localizer.
+
+The reference ships runtime nan/inf checking as a first-class switch:
+``FLAGS_check_nan_inf`` makes ``framework/operator.cc`` scan every
+op's outputs after every kernel launch. A per-op host-side scan is
+exactly what the TPU design cannot afford — the whole block is ONE
+fused XLA computation, and a host check per op would both break the
+fusion and serialize the dispatch pipeline. The TPU-native shape of
+the same switch, implemented here and wired through
+``static/executor.py``:
+
+- **Sentinels, fused in-graph**: with ``FLAGS_check_nan_inf`` on, each
+  compiled device segment also computes ``sentinel()`` — one fused
+  ``isfinite``-reduction over every tensor the segment writes
+  (outputs, grads, optimizer state), yielding ONE boolean scalar per
+  segment. The reduction rides the same XLA computation (no extra
+  dispatch); the only host cost is materializing that scalar once per
+  step, which the executor does at the point it would block anyway.
+- **Bisecting localizer**: a tripped sentinel says "this segment went
+  non-finite", not where. ``localize()`` re-runs the offending step
+  EAGERLY per-op from the (un-donated, still-live) pre-step state,
+  recording a device-side cumulative finiteness flag after every op —
+  still no host syncs — then BISECTS the cumulative flags (monotone:
+  once False, stays False) with O(log n_ops) host syncs to the first
+  op whose outputs went non-finite, and names the first non-finite
+  output tensor with nan/inf counts. For the ``autodiff`` pseudo-op
+  the per-gradient leaves are checked individually, so a bad
+  ``<param>@GRAD`` is named precisely.
+- **Postmortem**: ``handle_trip`` records the trip in the metrics
+  registry, routes it through ``monitor.anomaly`` (flight-recorder
+  dump with the localizer's report attached, when armed), and raises
+  ``NonFiniteError`` carrying the report.
+
+Costs, so the trade is explicit: under the flag the executor skips
+buffer donation (the pre-step state must survive for the replay), so
+peak memory roughly doubles and each step syncs on one scalar per
+segment. ``bench.py numerics`` measures the step-time side of that on
+interleaved A/B windows. Everything jax is imported lazily — the
+stdlib-only launcher can import ``paddle_tpu.monitor`` freely.
+
+Docs: docs/DEBUGGING.md.
+"""
+
+from paddle_tpu.core.enforce import EnforceNotMet
+from paddle_tpu.monitor.registry import counter
+
+__all__ = ["NonFiniteError", "sentinel", "localize", "handle_trip",
+           "SENTINEL_KEY"]
+
+#: key the checked segment functions return their fused flag under —
+#: "@" keeps it out of any legal program var namespace
+SENTINEL_KEY = "@sentinel@"
+
+_m_trips = counter(
+    "nonfinite_trips_total",
+    "In-graph isfinite-sentinel trips (FLAGS_check_nan_inf): steps "
+    "whose compiled segment produced a nan/inf tensor")
+
+
+class NonFiniteError(EnforceNotMet):
+    """A step produced nan/inf under FLAGS_check_nan_inf. ``report``
+    carries the localizer's findings (first bad tensor/op, counts,
+    postmortem path) as a dict — the same dict the postmortem JSON
+    embeds under ``anomaly``."""
+
+    def __init__(self, msg, report=None):
+        super().__init__(msg)
+        self.report = dict(report or {})
+
+
+def _finite_flag(v):
+    """0-d device bool: all elements finite — or None for values the
+    check cannot apply to (ints, bools, non-arrays)."""
+    import jax.numpy as jnp
+    if not hasattr(v, "dtype"):
+        return None
+    try:
+        if not jnp.issubdtype(v.dtype, jnp.inexact):
+            return None
+        return jnp.all(jnp.isfinite(v))
+    except (TypeError, ValueError):
+        return None
+
+
+def sentinel(values):
+    """ONE fused scalar: True iff every float element of every value is
+    finite. Traced inside the compiled segment, so the reductions fuse
+    into the step's own XLA computation."""
+    import jax.numpy as jnp
+    flags = []
+    for v in values:
+        f = _finite_flag(v)
+        if f is not None:
+            flags.append(f)
+    if not flags:
+        return jnp.asarray(True)
+    if len(flags) == 1:
+        return flags[0]
+    return jnp.all(jnp.stack(flags))
+
+
+def _replay_records(step, state, feeds, base_key, step_idx, end_seg,
+                    want_outputs_of=None):
+    """Eagerly re-run segments [0, end_seg] per-op, returning
+    ``(records, wanted_outputs)``. ``records`` holds one
+    ``(op_idx, op_type, [(name, flag)], cum)`` per executed op, where
+    ``flag``/``cum`` are device-side 0-d booleans (cum = AND of all
+    flags so far — the monotone signal the bisection needs). No host
+    syncs happen here, and records hold only those scalars — NOT the
+    output tensors, whose superseded versions (pre-update params,
+    every intermediate) would otherwise all stay live at once on a
+    model already near its memory limit. ``want_outputs_of=k`` makes
+    the replay return op k's output dict and STOP there (the second,
+    bounded pass after the bisection has identified the culprit)."""
+    import jax
+    import jax.numpy as jnp
+
+    env = dict(step.constants)
+    env.update(state)
+    env.update(feeds)
+    records = []
+    cum = jnp.asarray(True)
+    ops = step.ops
+    for (is_host, lo, hi) in step.segs[:end_seg + 1]:
+        seg_start_env = dict(env)
+        for k in range(lo, hi):
+            op = ops[k]
+            if op.type == "autodiff":
+                pnames = op.attrs["params"]
+                loss_name = op.attrs["loss"]
+                base = {n: v for n, v in seg_start_env.items()
+                        if n not in pnames}
+
+                def fwd(params, _base=base, _lo=lo, _k=k,
+                        _loss=loss_name):
+                    e = dict(_base)
+                    e.update(params)
+                    e = step.interpret(e, _lo, _k, base_key, step_idx)
+                    return jnp.sum(e[_loss]), e
+
+                params = {n: seg_start_env[n] for n in pnames}
+                (_, env2), grads = jax.value_and_grad(
+                    fwd, has_aux=True)(params)
+                env.update(env2)
+                outs = {n + "@GRAD": grads[n] for n in pnames}
+                env.update(outs)
+            else:
+                env = step.interpret(env, k, k + 1, base_key, step_idx)
+                outs = {n: env[n] for n in op.output_names()
+                        if n in env}
+            if want_outputs_of == k:
+                return records, outs
+            flags = []
+            for name, v in sorted(outs.items()):
+                f = _finite_flag(v)
+                if f is not None:
+                    flags.append((name, f))
+            if flags:
+                cum = jnp.logical_and(
+                    cum, jnp.all(jnp.stack([f for _, f in flags])))
+            records.append((k, op.type, flags, cum))
+    return records, None
+
+
+def localize(step, state, feeds, base_key, step_idx, bad_dev_index):
+    """Name the first non-finite tensor and its producing op by eager
+    replay + bisection (module docstring). Returns a report dict, or
+    one with ``localized=False`` when replay is unsafe (the program
+    has host ops — RPC sends, saves — whose re-execution would repeat
+    side effects) or found nothing (the trip did not reproduce)."""
+    import numpy as np
+
+    # map the tripped device-segment index to its segment, refusing to
+    # replay across host ops
+    dev = -1
+    end_seg = None
+    for si, (is_host, _a, _b) in enumerate(step.segs):
+        if is_host:
+            return {"localized": False, "segment": int(bad_dev_index),
+                    "why": "program contains host ops (RPC/save); "
+                           "eager replay would repeat their side "
+                           "effects"}
+        dev += 1
+        if dev == bad_dev_index:
+            end_seg = si
+            break
+    if end_seg is None:
+        return {"localized": False, "segment": int(bad_dev_index),
+                "why": "tripped segment index out of range"}
+    try:
+        records, _ = _replay_records(step, state, feeds, base_key,
+                                     step_idx, end_seg)
+    except Exception as e:      # the replay must never mask the trip
+        return {"localized": False, "segment": int(bad_dev_index),
+                "why": f"eager replay failed: "
+                       f"{type(e).__name__}: {e}"}
+    if not records or bool(np.asarray(records[-1][3])):
+        return {"localized": False, "segment": int(bad_dev_index),
+                "why": "sentinel tripped but the eager replay stayed "
+                       "finite (non-deterministic op or stale state?)"}
+    # bisect the monotone cumulative flags: O(log n_ops) host syncs
+    lo_i, hi_i = 0, len(records) - 1
+    while lo_i < hi_i:
+        mid = (lo_i + hi_i) // 2
+        if bool(np.asarray(records[mid][3])):
+            lo_i = mid + 1
+        else:
+            hi_i = mid
+    op_idx, op_type, flags, _ = records[lo_i]
+    # second bounded replay: fetch ONLY the culprit op's outputs (the
+    # first pass deliberately dropped tensors to keep memory flat)
+    try:
+        _, outs = _replay_records(step, state, feeds, base_key,
+                                  step_idx, end_seg,
+                                  want_outputs_of=op_idx)
+    except Exception as e:
+        return {"localized": False, "segment": int(bad_dev_index),
+                "op_index": int(op_idx), "op_type": op_type,
+                "why": f"culprit-op re-execution failed: "
+                       f"{type(e).__name__}: {e}"}
+    outs = outs or {}
+    for name, f in flags:
+        if bool(np.asarray(f)) or name not in outs:
+            continue
+        arr = np.asarray(outs[name])
+        nan = int(np.isnan(arr).sum())
+        inf = int(np.isinf(arr).sum())
+        return {
+            "localized": True,
+            "tensor": name,
+            "op_type": op_type,
+            "op_index": int(op_idx),
+            "segment": int(bad_dev_index),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "nan_count": nan,
+            "inf_count": inf,
+            "size": int(arr.size),
+        }
+    return {"localized": False, "segment": int(bad_dev_index),
+            "why": "bad op found but no single non-finite output "
+                   "(flag/value mismatch)"}
+
+
+def handle_trip(step, state, feeds, base_key, step_idx, bad_dev_index):
+    """The executor's trip path: count it, localize it, leave a
+    postmortem (via monitor.anomaly, when the flight recorder is
+    armed), raise NonFiniteError. Never returns."""
+    from paddle_tpu.monitor import anomaly
+
+    _m_trips.inc()
+    report = localize(step, state, feeds, base_key, step_idx,
+                      bad_dev_index)
+    report["step"] = int(step_idx)
+    path = anomaly.trip("non_finite", report=report,
+                        step=int(step_idx))
+    if path:
+        report["postmortem"] = path
+    if report.get("localized"):
+        where = (f"first non-finite tensor {report['tensor']!r} "
+                 f"(shape {tuple(report['shape'])}, "
+                 f"{report['nan_count']} nan / {report['inf_count']} "
+                 f"inf of {report['size']}) produced by op "
+                 f"{report['op_type']!r} at position "
+                 f"{report['op_index']}")
+    else:
+        where = (f"in device segment {report['segment']} "
+                 f"(not localized: {report.get('why')})")
+    raise NonFiniteError(
+        f"FLAGS_check_nan_inf: step {int(step_idx)} produced "
+        f"nan/inf — {where}"
+        + (f"; postmortem: {path}" if path else ""),
+        report=report)

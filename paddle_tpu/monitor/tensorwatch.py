@@ -1,0 +1,213 @@
+"""Opt-in tensor/grad watch: grad global-norm, param-norm,
+update-ratio, and AMP loss-scale events in the metrics registry.
+
+The reference debugs training health by printing tensors from inside
+the per-op loop; on TPU the step is one fused XLA program, so the
+watch statistics are computed IN-GRAPH and ride the step's existing
+fetch, costing no device round-trip of their own:
+
+- With ``tensorwatch.enable()`` active at ``Optimizer.minimize()``
+  time, the optimizer brackets its update ops with two watch ops:
+  ``tensor_watch_pre`` (before clipping: pre-clip grad global norm +
+  param global norm — the SAME ``clip.global_norm`` subgraph
+  ``GradientClipByGlobalNorm`` builds, so XLA CSE folds the two into
+  one reduction) and ``tensor_watch_post`` (after the updates:
+  ``‖new − old‖ / ‖old‖`` — the update ratio, the "is my LR sane"
+  number). The old params are threaded through as pass-through
+  outputs, which keeps them alive across the update inside the XLA
+  program: the watch costs one extra param-sized liveness range while
+  enabled, nothing when off.
+- The stats land in one tiny ``@watch@stats`` vector the executor
+  fetches alongside the user's fetch list and publishes here
+  (``on_step``) as gauges/histograms. In async mode
+  (``return_numpy=False``) publication is one step delayed so the
+  watch never adds a sync.
+- AMP: ``record_loss_scale`` turns the materialized loss-scale state
+  into a ``loss_scale`` gauge and a ``loss_scale_decrements_total``
+  counter (each decrement is an overflow event — the fp16 canary);
+  ``amp.OptimizerWithMixedPrecision.monitor_state`` is the hookup.
+
+Grad norms also feed ``monitor.anomaly``'s grad-explosion window when
+the detector is enabled. jax/numpy are imported lazily: the
+stdlib-only launcher can import ``paddle_tpu.monitor`` freely.
+
+Docs: docs/DEBUGGING.md; metric catalogue: docs/OBSERVABILITY.md.
+"""
+
+import threading
+
+from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor.registry import counter, gauge, histogram
+
+__all__ = [
+    "TensorMonitor", "enable", "disable", "is_enabled", "on_step",
+    "flush", "record_loss_scale", "STATS_VAR", "PRE_VAR",
+]
+
+#: program var the watch ops write / the executor auto-fetches
+STATS_VAR = "@watch@stats"
+PRE_VAR = "@watch@prenorms"
+
+_g_grad = gauge(
+    "grad_global_norm",
+    "Last published step's PRE-CLIP global gradient norm (tensor "
+    "watch; the norm GradientClipByGlobalNorm computes)")
+_h_grad = histogram(
+    "grad_global_norm_per_step",
+    "Distribution of the pre-clip global gradient norm across "
+    "published steps",
+    buckets=(1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 100.0, 1e3, 1e4))
+_g_param = gauge(
+    "param_global_norm",
+    "Last published step's global parameter norm (pre-update)")
+_g_ratio = gauge(
+    "update_ratio",
+    "Last published step's ||new_params - old_params|| / "
+    "||old_params|| (tensor watch)")
+_g_scale = gauge(
+    "loss_scale",
+    "Current AMP dynamic loss scale (record_loss_scale)")
+_c_scale_dec = counter(
+    "loss_scale_decrements_total",
+    "AMP loss-scale decrements observed — each one is a non-finite "
+    "fp16 gradient event the scaler absorbed")
+
+_enabled = False
+_lock = threading.Lock()
+_pending = None               # (stats vector, step) awaiting publish
+_last_scale = None
+
+
+def enable():
+    """Arm the watch. Programs built (``minimize()``d) while enabled
+    carry the watch ops; publication is also gated on this flag. Also
+    forgets the loss-scale baseline: a new run starting from its init
+    scale must not read as a decrement of the previous run's grown
+    scale."""
+    global _enabled, _last_scale
+    _enabled = True
+    _last_scale = None
+
+
+def disable():
+    global _enabled, _last_scale
+    _enabled = False
+    _last_scale = None
+    flush()
+
+
+def is_enabled():
+    return _enabled
+
+
+# -- in-graph op computes (registered by optimizer.py, which owns the
+# -- program layout; traced inside the executor's fused step) --------------
+def _watch_pre_compute(ins, attrs):
+    import jax.numpy as jnp
+
+    from paddle_tpu import clip as clip_mod
+    grads = list(ins.get("Grads", []))
+    params = list(ins.get("Params", []))
+    gn = clip_mod.global_norm(grads)
+    pn = clip_mod.global_norm(params)
+    # params pass through: keeps the pre-update values alive for the
+    # post op's update-ratio without a second device_put or fetch
+    return {"Norms": [jnp.stack([gn, pn])], "PreParams": params}
+
+
+def _watch_post_compute(ins, attrs):
+    import jax.numpy as jnp
+
+    from paddle_tpu import clip as clip_mod
+    new = list(ins.get("Params", []))
+    old = list(ins.get("PreParams", []))
+    pre = ins["PreNorms"][0]
+    un = clip_mod.global_norm([n - o for n, o in zip(new, old)])
+    ratio = un / jnp.maximum(pre[1], 1e-12)
+    return {"Out": [jnp.stack([pre[0], pre[1], un, ratio])]}
+
+
+# -- host-side publication --------------------------------------------------
+def _publish(vec, step=None):
+    import numpy as np
+    v = np.asarray(vec, dtype=np.float64).ravel()
+    if v.size < 4:
+        return
+    gn, pn, un, ratio = (float(x) for x in v[:4])
+    _g_grad.set(gn)
+    _h_grad.observe(gn)
+    _g_param.set(pn)
+    _g_ratio.set(ratio)
+    if _flight._enabled:
+        _flight.RECORDER.note("watch", "tensorwatch", step=step,
+                              grad_norm=round(gn, 6),
+                              update_ratio=round(ratio, 8))
+    from paddle_tpu.monitor import anomaly
+    if anomaly._enabled:
+        anomaly.DETECTOR.observe(step=step, grad_norm=gn)
+
+
+def on_step(stats, step=None, sync=True):
+    """The executor's hookup: hand over one step's ``@watch@stats``
+    vector. ``sync=True`` publishes immediately (the caller is about
+    to block on fetches anyway); ``sync=False`` (async dispatch)
+    defers to the NEXT call — by then the device has long finished the
+    value, so materializing it cannot stall the pipeline."""
+    global _pending
+    with _lock:
+        prev, _pending = _pending, (None if sync else (stats, step))
+    if prev is not None:
+        _publish(prev[0], prev[1])
+    if sync:
+        _publish(stats, step)
+
+
+def flush():
+    """Publish any deferred async-mode stats (end of a training run)."""
+    global _pending
+    with _lock:
+        prev, _pending = _pending, None
+    if prev is not None:
+        _publish(prev[0], prev[1])
+
+
+def record_loss_scale(scale, step=None):
+    """Publish the AMP dynamic loss scale; count decrements (each is an
+    absorbed non-finite-gradient event). Call with the MATERIALIZED
+    scale between steps — amp.OptimizerWithMixedPrecision
+    .monitor_state does."""
+    global _last_scale
+    s = float(scale)
+    _g_scale.set(s)
+    if _last_scale is not None and s < _last_scale:
+        _c_scale_dec.inc()
+        if _flight._enabled:
+            _flight.RECORDER.note("watch", "loss_scale_decrement",
+                                  step=step, scale=s)
+    _last_scale = s
+    return s
+
+
+class TensorMonitor:
+    """Eager/functional-path watch: compute the same stats from
+    (params, grads[, new_params]) pytrees and publish them. This DOES
+    cost extra device work (the static path's watch ops ride the fused
+    step instead) — it is the convenience wrapper for eager loops that
+    already materialize their state."""
+
+    def observe(self, params, grads, new_params=None, step=None):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu import clip as clip_mod
+        gn = clip_mod.global_norm(grads)
+        pn = clip_mod.global_norm(params)
+        if new_params is not None:
+            deltas = jax.tree.map(jnp.subtract, new_params, params)
+            un = clip_mod.global_norm(deltas)
+            ratio = un / jnp.maximum(pn, 1e-12)
+        else:
+            un = jnp.zeros(())
+            ratio = jnp.zeros(())
+        _publish(jnp.stack([gn, pn, un, ratio]), step)
+        return float(gn)

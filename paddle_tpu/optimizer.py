@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from paddle_tpu import clip as clip_mod
 from paddle_tpu import initializer as I
 from paddle_tpu.core.enforce import EnforceNotMet
+from paddle_tpu.monitor import tensorwatch as _tensorwatch
 from paddle_tpu.static.program import (
     OP_REGISTRY, default_main_program, default_startup_program,
     in_static_mode,
@@ -147,6 +148,30 @@ class Optimizer:
         blk.append_op(type="increment_step", inputs={"X": [step_name]},
                       outputs={"Out": [step_name]}, attrs={})
 
+        # tensor watch (monitor/tensorwatch.py): bracket the update with
+        # two in-graph stats ops — pre-clip grad/param global norms
+        # before, update-ratio after. Pre-update params thread through
+        # as pass-through outputs so ||new - old|| is computable without
+        # a host round-trip; the norms reuse clip.global_norm's exact
+        # subgraph, so under GradientClipByGlobalNorm XLA CSEs the two.
+        watching = _tensorwatch.is_enabled() and p_g
+        pre_names = []
+        if watching:
+            pre_names = [f"@watch@pre@{p.name}" for p, _ in p_g]
+            for (p, _g), pn in zip(p_g, pre_names):
+                if not blk.has_var(pn):
+                    blk.create_var(name=pn, shape=p.shape, dtype=p.dtype)
+            if not blk.has_var(_tensorwatch.PRE_VAR):
+                blk.create_var(name=_tensorwatch.PRE_VAR, shape=(2,),
+                               dtype="float32")
+            blk.append_op(
+                type="tensor_watch_pre",
+                inputs={"Params": [p.name for p, _ in p_g],
+                        "Grads": [g.name for _, g in p_g]},
+                outputs={"Norms": [_tensorwatch.PRE_VAR],
+                         "PreParams": pre_names},
+                attrs={})
+
         clip = self.grad_clip or clip_mod.get_gradient_clip(program)
         if clip is not None:
             gnames = [g.name for _, g in p_g]
@@ -180,6 +205,17 @@ class Optimizer:
                        "regularizer": p.regularizer,
                        "param_lr": p.optimize_attr.get("learning_rate", 1.0)})
             ops.append(op)
+        if watching:
+            if not blk.has_var(_tensorwatch.STATS_VAR):
+                blk.create_var(name=_tensorwatch.STATS_VAR, shape=(4,),
+                               dtype="float32")
+            blk.append_op(
+                type="tensor_watch_post",
+                inputs={"Params": [p.name for p, _ in p_g],
+                        "PreParams": pre_names,
+                        "PreNorms": [_tensorwatch.PRE_VAR]},
+                outputs={"Out": [_tensorwatch.STATS_VAR]},
+                attrs={})
         return ops, p_g
 
 
@@ -208,6 +244,10 @@ def _clip_grads_compute(ins, attrs):
 
 
 OP_REGISTRY["clip_grads"] = _clip_grads_compute
+# in-graph tensor-watch stats (computed in monitor/tensorwatch.py,
+# appended by minimize() when the watch is enabled)
+OP_REGISTRY["tensor_watch_pre"] = _tensorwatch._watch_pre_compute
+OP_REGISTRY["tensor_watch_post"] = _tensorwatch._watch_post_compute
 
 
 # ---------------------------------------------------------------------------

@@ -255,6 +255,17 @@ def summary(sorted_key="total", profile_path=None):
         lines.append(f"compilation cache: {cc['hits']} hits / "
                      f"{cc['misses']} misses "
                      f"({compile_cache.cache_dir()})")
+    from paddle_tpu.monitor.registry import REGISTRY as _REG
+    trips = _REG.get("anomaly_trips_total")
+    trip_samples = trips.samples() if trips is not None else {}
+    n_trips = sum(trip_samples.values())
+    if n_trips:
+        kinds = ",".join(sorted(k[0] for k, v in trip_samples.items()
+                                if v > 0))
+        lines.append(
+            f"health: {int(n_trips)} anomaly trip(s) [{kinds}] -- "
+            f"postmortems under PADDLE_POSTMORTEM_DIR "
+            f"(docs/DEBUGGING.md)")
     from paddle_tpu.monitor import cost as _cost
     mfu = _cost.estimate_mfu()
     if mfu is not None:

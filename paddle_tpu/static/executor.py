@@ -30,8 +30,18 @@ compiles eagerly, so with the persistent compilation cache
 (core/compile_cache.py) a restarted worker replays the XLA compile from
 disk. `FLAGS_executor_fast_path=0` restores the legacy per-step rescans
 (the A/B lever bench_dispatch.py measures against).
+
+Training-health hooks (docs/DEBUGGING.md): under `FLAGS_check_nan_inf`
+each device segment also returns one fused isfinite-sentinel scalar,
+verified before the step's new state reaches the scope — a trip runs
+the eager bisecting localizer (monitor/numerics.py) and raises with
+the first non-finite tensor/op named. Tensor-watch programs
+(monitor/tensorwatch.py) get their `@watch@stats` vector auto-fetched
+alongside the user's fetch list, and step wall time feeds the anomaly
+detector (monitor/anomaly.py) when it is enabled.
 """
 
+import itertools
 import threading
 import time
 import weakref
@@ -42,7 +52,10 @@ import numpy as np
 
 from paddle_tpu.core.enforce import EnforceNotMet, enforce
 from paddle_tpu.core.flags import define_flag, get_flag
+from paddle_tpu.monitor import anomaly as _anomaly
 from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor import tensorwatch as _tensorwatch
+from paddle_tpu.monitor.numerics import SENTINEL_KEY as _SENTINEL_KEY
 from paddle_tpu.monitor.registry import counter as _counter
 from paddle_tpu.monitor.registry import gauge as _gauge
 from paddle_tpu.monitor.registry import histogram as _histogram
@@ -300,17 +313,27 @@ def _spec_of(v):
 class _CompiledStep:
     """One compiled (program, signature) step: the block partitioned
     into host/device segments with each device segment jitted. Callable
-    as (state, feeds, base_key, step_idx) -> (fetches, new_state); also
-    exposes the segment structure so `aot_compile` can lower+compile
-    eagerly (warm-starting the persistent compilation cache)."""
+    as (state, feeds, base_key, step_idx) -> (fetches, new_state)
+    (plus the per-segment numerics sentinels when called with
+    ``check=True`` — see monitor/numerics.py); also exposes the segment
+    structure so `aot_compile` can lower+compile eagerly (warm-starting
+    the persistent compilation cache) and the op list so the non-finite
+    localizer can replay the step eagerly per-op."""
 
     __slots__ = ("segs", "seg_fns", "constants", "state_set",
-                 "state_names", "fetch_names", "interpret",
-                 "_donate_names", "donated_fetch_idx", "_cost_done")
+                 "state_names", "fetch_names", "interpret", "ops",
+                 "_donate_names", "donated_fetch_idx", "_cost_done",
+                 "uid")
+
+    #: process-unique compiled-step ids — the anomaly detector keys
+    #: stall baselines on this, and a recycled id() of a GC'd step
+    #: would hand a new program a dead program's baseline
+    _uid_counter = itertools.count()
 
     def __init__(self, segs, seg_fns, constants, state_names,
-                 fetch_names, interpret):
+                 fetch_names, interpret, ops):
         self._cost_done = False
+        self.uid = next(_CompiledStep._uid_counter)
         self.segs = segs
         self.seg_fns = seg_fns
         self.constants = constants
@@ -318,12 +341,13 @@ class _CompiledStep:
         self.state_names = state_names
         self.fetch_names = fetch_names
         self.interpret = interpret
+        self.ops = ops
         # per device segment: the state names it overwrites, frozen at
         # compile so the hot path does set-membership over a LIST of
         # candidates instead of scanning the whole env every step
         self._donate_names = [
             None if fn_w is None
-            else [n for n in state_names if n in fn_w[1]]
+            else [n for n in state_names if n in fn_w[2]]
             for fn_w in seg_fns]
         # fetches that alias DONATED state: the returned array is the
         # same buffer the next step donates, so an async caller
@@ -349,25 +373,35 @@ class _CompiledStep:
             rest = env
         return donated, rest
 
-    def __call__(self, state, feeds, base_key, step_idx):
+    def __call__(self, state, feeds, base_key, step_idx, check=False):
+        """``check=True`` (FLAGS_check_nan_inf) runs the CHECKED jit
+        variant of each device segment — same program plus one fused
+        isfinite-reduction scalar — and donates nothing, so the
+        pre-step state stays alive for the localizer's eager replay.
+        Returns (fetches, new_state, sentinels) then; the plain
+        2-tuple otherwise."""
         env = dict(self.constants) if self.constants else {}
         env.update(state)
         env.update(feeds)
         record_cost = not self._cost_done and \
             bool(get_flag("monitor_cost"))
+        sentinels = []
         dev_i = 0
         for (is_host, a, b), fn_w, donate in zip(
                 self.segs, self.seg_fns, self._donate_names):
             if is_host:
                 env = self.interpret(env, a, b, base_key, step_idx)
             else:
-                fn, _writes = fn_w
-                donated, rest = self._split(env, donate)
+                fn, checked_fn, _writes = fn_w
+                use = checked_fn if check else fn
+                donated, rest = self._split(env, () if check else donate)
                 if record_cost:
                     # BEFORE executing: donation deletes these buffers
-                    self._record_cost(dev_i, fn, donated, rest,
+                    self._record_cost(dev_i, use, donated, rest,
                                       base_key, step_idx)
-                out = fn(donated, rest, base_key, step_idx)
+                out = use(donated, rest, base_key, step_idx)
+                if check:
+                    sentinels.append(out.pop(_SENTINEL_KEY))
                 env = dict(self.constants) if self.constants else {}
                 env.update(out)
                 dev_i += 1
@@ -378,6 +412,8 @@ class _CompiledStep:
             self._cost_done = True
         fetches = [env[n] for n in self.fetch_names]
         new_state = {n: env[n] for n in self.state_names}
+        if check:
+            return fetches, new_state, sentinels
         return fetches, new_state
 
     def _record_cost(self, dev_i, fn, donated, rest, base_key,
@@ -416,7 +452,7 @@ class _CompiledStep:
                 self.segs, self.seg_fns, self._donate_names):
             if is_host:
                 break
-            fn, _writes = fn_w
+            fn, _checked_fn, _writes = fn_w
             donated, rest = self._split(env, donate)
             lowered = fn.lower(donated, rest, base_key, step_idx)
             lowered.compile()
@@ -439,14 +475,17 @@ class _PreparedRunner:
     the legacy path redid every call."""
 
     __slots__ = ("step", "state_names", "host_outs", "scope_ref",
-                 "scope_version", "rep", "ok_shardings", "ndev")
+                 "scope_version", "rep", "ok_shardings", "ndev",
+                 "watch_idx")
 
-    def __init__(self, step, state_names, host_outs, scope, rep, ndev):
+    def __init__(self, step, state_names, host_outs, scope, rep, ndev,
+                 watch_idx=None):
         self.step = step
         self.state_names = state_names
         self.host_outs = host_outs
         self.scope_ref = weakref.ref(scope)
         self.scope_version = scope.version
+        self.watch_idx = watch_idx        # auto-appended @watch@stats
         self.rep = rep                    # replicated sharding (DP) or None
         # shardings proven equivalent to rep, memoized BY IDENTITY with
         # the object held alive: id alone could be recycled by a new,
@@ -612,11 +651,32 @@ class Executor:
         base_key = self._base_key(program.random_seed)
         step_idx = np.uint32(scope.find_var("@step@") or 0)
         scope.set_var("@step@", (scope.find_var("@step@") or 0) + 1)
+        check = bool(get_flag("check_nan_inf"))
         with RecordEvent("executor.run/dispatch"):
-            fetches, new_state = runner.step(state, feeds, base_key,
-                                             step_idx)
-            for n, v in new_state.items():
-                scope.set_var(n, v)
+            if check:
+                fetches, new_state, sentinels = runner.step(
+                    state, feeds, base_key, step_idx, check=True)
+            else:
+                fetches, new_state = runner.step(state, feeds, base_key,
+                                                 step_idx)
+        if check:
+            # the one deliberate host sync of the checked mode: a
+            # scalar per segment, verified BEFORE the new state reaches
+            # the scope so a trip leaves the pre-step params intact for
+            # inspection. handle_trip localizes + raises.
+            for seg_i, s in enumerate(sentinels):
+                if not bool(np.asarray(s)):
+                    from paddle_tpu.monitor import numerics as _numerics
+                    _numerics.handle_trip(runner.step, state, feeds,
+                                          base_key, step_idx, seg_i)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        watch_v = None
+        if runner.watch_idx is not None:
+            # @watch@stats rides last in the fetch list (auto-appended
+            # by _prepare_runner) — peel it off before the user sees
+            # fetches; published after the step-time observation below
+            watch_v = fetches.pop(runner.watch_idx)
         if return_numpy:
             with RecordEvent("executor.run/fetch"):
                 t_fetch = time.perf_counter()
@@ -631,7 +691,17 @@ class Executor:
             for i in runner.step.donated_fetch_idx:
                 fetches[i] = jnp.array(fetches[i], copy=True)
         _m_steps.inc()
-        _m_step_ms.observe((time.perf_counter() - t_run) * 1e3)
+        step_ms = (time.perf_counter() - t_run) * 1e3
+        _m_step_ms.observe(step_ms)
+        if watch_v is not None and _tensorwatch._enabled:
+            _tensorwatch.on_step(watch_v, int(step_idx),
+                                 sync=return_numpy)
+        if _anomaly._enabled:
+            # keyed by compiled-step identity: train and eval programs
+            # through one executor get separate stall baselines
+            _anomaly.DETECTOR.observe(step=int(step_idx),
+                                      step_ms=step_ms,
+                                      step_ms_key=runner.step.uid)
         if _flight._enabled:
             _flight.RECORDER.note("step", "executor.run",
                                   step=int(step_idx))
@@ -710,6 +780,14 @@ class Executor:
         # step 2
         if scope.find_var("@step@") is None:
             scope.set_var("@step@", 0)
+        # tensor-watch programs (minimize() under tensorwatch.enable())
+        # carry an @watch@stats var: auto-fetch it so the stats ride the
+        # step's existing materialization instead of a second dispatch
+        watch_idx = None
+        if program.global_block().has_var(_tensorwatch.STATS_VAR) \
+                and _tensorwatch.STATS_VAR not in fetch_names:
+            fetch_names = list(fetch_names) + [_tensorwatch.STATS_VAR]
+            watch_idx = len(fetch_names) - 1
         state_names = self._state_names(program, scope)
         state = {n: scope.find_var(n) for n in state_names}
         # vars a host op (load_combine, ps_recv…) writes are initialized
@@ -741,7 +819,7 @@ class Executor:
                                  sorted(feeds), fetch_names)
             self._cache[sig] = step
         return _PreparedRunner(step, state_names, host_outs, scope, rep,
-                               ndev)
+                               ndev, watch_idx=watch_idx)
 
     def _gather_state(self, runner, scope):
         """Pull the current state values for a prepared runner. Returns
@@ -973,8 +1051,11 @@ class Executor:
             writes = set()
             for k in range(lo, hi):
                 writes.update(ops[k].output_names())
+            # the sentinel's fixed scan order over everything this
+            # segment writes (outputs, grads, optimizer state)
+            watch_names = sorted(writes)
 
-            def seg_fn(donated, rest, base_key, step_idx):
+            def seg_fn(donated, rest, base_key, step_idx, check=False):
                 # python executes at trace time only: the counter is the
                 # retrace probe the caching tests (and bench_dispatch's
                 # sanity check) read
@@ -1006,15 +1087,31 @@ class Executor:
                     for n in param_names:
                         env[n + "@GRAD"] = grads[n]
                     env = interpret(env, ad + 1, hi, base_key, step_idx)
-                return {k: v for k, v in env.items() if k not in constants}
+                res = {k: v for k, v in env.items()
+                       if k not in constants}
+                if check:
+                    # FLAGS_check_nan_inf: one fused isfinite reduction
+                    # over every tensor this segment writes — a single
+                    # extra scalar output, no extra dispatch
+                    from paddle_tpu.monitor import numerics as _numerics
+                    res[_SENTINEL_KEY] = _numerics.sentinel(
+                        [env[n] for n in watch_names if n in env])
+                return res
 
-            return jax.jit(seg_fn, donate_argnums=(0,)), writes
+            fast = jax.jit(seg_fn, donate_argnums=(0,))
+            # checked variant: separate jit (its own trace/compile,
+            # first checked step pays it once), NO donation — the
+            # localizer replays from the still-live pre-step state
+            checked = jax.jit(
+                lambda donated, rest, base_key, step_idx: seg_fn(
+                    donated, rest, base_key, step_idx, True))
+            return fast, checked, writes
 
         seg_fns = [None if is_host else make_device_fn(a, b)
                    for is_host, a, b in segs]
 
         return _CompiledStep(segs, seg_fns, constants, state_names,
-                             fetch_names, interpret)
+                             fetch_names, interpret, ops)
 
     def _fetch_value(self, scope, name, return_numpy):
         v = scope.find_var(name)

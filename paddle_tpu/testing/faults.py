@@ -13,6 +13,9 @@ boundary for free:
 - ``PT_FAULT_SLOW_WRITE=S``     — ``install_slow_write()`` patches
   ``CheckpointManager._write`` to sleep S seconds first: an in-flight
   async checkpoint, for preemption tests.
+- ``PT_FAULT_NAN_AT_STEP=N``    — ``poison_feed(step, feed)`` writes a
+  NaN into the first float array of the feed at step N: a numerics
+  blow-up, for the FLAGS_check_nan_inf sentinel/localizer tests.
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -29,7 +32,8 @@ import os
 import sys
 import time
 
-__all__ = ["maybe_fault", "install_slow_write", "CRASH_EXIT_CODE"]
+__all__ = ["maybe_fault", "poison_feed", "install_slow_write",
+           "CRASH_EXIT_CODE"]
 
 CRASH_EXIT_CODE = 23
 
@@ -80,6 +84,36 @@ def maybe_fault(step):
         sys.stderr.flush()
         while True:                     # alive but silent: heartbeats
             time.sleep(3600)            # stop, SIGKILL is the only exit
+
+
+def poison_feed(step, feed):
+    """Return ``feed`` with a NaN written into the first float array
+    when PT_FAULT_NAN_AT_STEP selects this (rank, step); the original
+    dict is never mutated. Call on the feed just before
+    ``Executor.run`` — with FLAGS_check_nan_inf on, the sentinel must
+    trip within this very step."""
+    nan_at = _int_env("PT_FAULT_NAN_AT_STEP")
+    if nan_at is None or step != nan_at or not _applies_to_rank():
+        return feed
+    import numpy as np
+    out = dict(feed)
+    for name in sorted(out):
+        arr = np.asarray(out[name])
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        # claim the once-per-job marker only now that injection will
+        # actually happen: a float-less feed at the target step must
+        # not silently consume the fault
+        if not _fire_once("nan"):
+            return feed
+        arr = arr.copy()
+        arr.flat[0] = np.nan
+        out[name] = arr
+        sys.stderr.write(f"[faults] injected NaN into feed "
+                         f"{name!r} at step {step}\n")
+        sys.stderr.flush()
+        return out
+    return feed
 
 
 def install_slow_write():

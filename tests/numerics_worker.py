@@ -1,0 +1,93 @@
+"""Training worker for the numerics-sentinel end-to-end test.
+
+A real Executor training loop wired the way docs/DEBUGGING.md's
+"30-second recipe" says a health-instrumented worker should be:
+flight recorder armed from the launcher env FIRST, anomaly detector +
+tensor watch enabled, FLAGS_check_nan_inf on (via env), per-rank
+RankExporter snapshots, heartbeats each step. The test injects a NaN
+into one rank's feed via the PT_FAULT_NAN_AT_STEP env hook
+(testing/faults.py): that rank's sentinel must trip WITHIN the
+poisoned step, leave an anomaly postmortem naming the first non-finite
+tensor and op, and its final metrics snapshot must carry the health
+gauges (train_health 0, nonfinite_trips_total, the watch gauges).
+
+argv: out_prefix total_steps
+
+Reports to <out_prefix>.rank<id>.json: steps completed, and — when the
+sentinel tripped — the NonFiniteError message + report dict. Exits
+NAN_EXIT_CODE (17) on a trip so the launcher-level test can assert who
+died and why (distinct from faults.py's crash 23 / timeout 124 /
+preemption 143).
+"""
+
+import json
+import os
+import sys
+
+NAN_EXIT_CODE = 17
+
+
+def main():
+    out_prefix = sys.argv[1]
+    total_steps = int(sys.argv[2])
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+
+    from paddle_tpu.monitor import flight_recorder
+    flight_recorder.install_from_env()
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.health import Heartbeat
+    from paddle_tpu.monitor import anomaly, numerics, tensorwatch
+    from paddle_tpu.monitor.exporter import RankExporter
+    from paddle_tpu.testing import faults
+
+    anomaly.enable()
+    tensorwatch.enable()
+    hb = Heartbeat.from_env(interval=0.1)
+    exp = RankExporter.from_env(interval=0.5)
+    if exp is not None:
+        exp.start()
+
+    pt.enable_static()
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        x = pt.static.data("x", [4], dtype="float32")
+        y = pt.static.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = pt.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True).astype(np.float32)
+
+    def report(doc):
+        with open(f"{out_prefix}.rank{rank}.json", "w") as f:
+            json.dump(doc, f, default=str)
+
+    steps = 0
+    for step in range(total_steps):
+        feed = faults.poison_feed(step, {"x": xv, "y": yv})
+        try:
+            (lv,) = exe.run(main_p, feed=feed, fetch_list=[loss])
+        except numerics.NonFiniteError as e:
+            report({"steps": steps, "tripped_at": step,
+                    "error": str(e), "report": e.report})
+            if exp is not None:
+                exp.stop()          # final snapshot carries the trip
+            sys.exit(NAN_EXIT_CODE)
+        anomaly.DETECTOR.observe(step=step, loss=float(lv))
+        steps += 1
+        if hb is not None:
+            hb.beat()
+
+    report({"steps": steps, "tripped_at": None})
+    if exp is not None:
+        exp.stop()
+
+
+if __name__ == "__main__":
+    main()

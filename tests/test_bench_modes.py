@@ -12,7 +12,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_mode(mode, timeout=600):
+def _run_mode(mode, timeout=600, extra_env=None):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
@@ -20,6 +20,7 @@ def _run_mode(mode, timeout=600):
         "BENCH_WINDOWS": "2",
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
     })
+    env.update(extra_env or {})
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), mode],
         capture_output=True, text=True, timeout=timeout, env=env,
@@ -52,3 +53,19 @@ class TestBenchModes:
             assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
         assert "scaling_vs_1_thread" in by_threads[
             "serving_qps_16_threads"]
+
+    def test_numerics_mode_emits_overhead_ratio(self):
+        """`bench.py numerics` must A/B the check_nan_inf sentinels on
+        interleaved windows and emit a well-formed ratio line (the
+        real overhead measurement runs with full windows; this is the
+        CLI/shape smoke)."""
+        lines = _run_mode("numerics",
+                          extra_env={"BENCH_NUMERICS_STEPS": "15",
+                                     "BENCH_NUMERICS_PAIRS": "2"})
+        (row,) = [ln for ln in lines
+                  if ln["metric"] == "numerics_check_overhead_ratio"]
+        assert row["unit"] == "x" and row["value"] > 0
+        assert row["check_on_ms_per_step"] > 0
+        assert row["check_off_ms_per_step"] > 0
+        assert len(row["pair_ratios"]) == 2
+        assert all(r > 0 for r in row["pair_ratios"])

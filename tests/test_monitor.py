@@ -283,6 +283,85 @@ class TestExporter:
         finally:
             srv.stop()
 
+    def test_read_rank_snapshots_skips_broken_files(self, tmp_path):
+        """Missing / zero-byte / torn (partial) rank files must be
+        skipped, not poison the job view (the next exporter tick
+        replaces them)."""
+        good = Registry()
+        good.counter("executor_steps_total").inc(9)
+        h = good.histogram("executor_step_ms")
+        h.observe(4.0)
+        exporter.write_snapshot(
+            health.metrics_path(str(tmp_path), 0), good)
+        # rank1: zero-byte (a crashed writer's empty file)
+        open(health.metrics_path(str(tmp_path), 1), "w").close()
+        # rank2: torn — valid prefix, no # EOF marker
+        full = exporter.render_text(good)
+        with open(health.metrics_path(str(tmp_path), 2), "w") as f:
+            f.write(full[:len(full) // 2])
+        # rank3: binary junk
+        with open(health.metrics_path(str(tmp_path), 3), "wb") as f:
+            f.write(b"\x00\xffnot prometheus")
+        # a non-rank file that must not be picked up at all
+        (tmp_path / "metrics.prom").write_text("junk")
+        snaps = exporter.read_rank_snapshots(str(tmp_path))
+        assert sorted(snaps) == [0]
+        # and the aggregate/status built on them still works
+        line = exporter.job_status_line(str(tmp_path))
+        assert "step=9" in line and "ranks=1" in line
+        out = exporter.write_job_snapshot(
+            str(tmp_path), str(tmp_path / "job.prom"))
+        _, samples = exporter.parse_text(
+            (tmp_path / "job.prom").read_text())
+        assert samples[("executor_steps_total", ())] == 9.0
+        assert out == str(tmp_path / "job.prom")
+
+    def test_write_job_snapshot_no_ranks_no_registry(self, tmp_path):
+        assert exporter.write_job_snapshot(
+            str(tmp_path / "empty"), str(tmp_path / "out.prom")) is None
+        assert not (tmp_path / "out.prom").exists()
+
+    def test_metrics_server_concurrent_scrapes(self):
+        """N threads hammering /metrics while a writer mutates the
+        registry: every response parses complete (ThreadingHTTPServer
+        + GIL-atomic shard reads — no torn scrape)."""
+        r = Registry()
+        c = r.counter("t_scrape_total")
+        srv = exporter.MetricsServer(port=0, registry=r).start()
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            while not stop.is_set():
+                c.inc()
+
+        def scraper():
+            for _ in range(25):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{srv.port}/metrics",
+                            timeout=10) as resp:
+                        assert resp.status == 200
+                        _, samples = exporter.parse_text(
+                            resp.read().decode())
+                        assert ("t_scrape_total", ()) in samples
+                except Exception as e:      # pragma: no cover
+                    errors.append(e)
+
+        wt = threading.Thread(target=writer)
+        wt.start()
+        try:
+            ts = [threading.Thread(target=scraper) for _ in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            stop.set()
+            wt.join()
+            srv.stop()
+        assert not errors
+
     def test_rank_exporter_writes_and_final_snapshot(self, tmp_path):
         env = {health.ENV_DIR: str(tmp_path), health.ENV_RANK: "2",
                "PADDLE_RESTART_COUNT": "1"}
@@ -626,30 +705,48 @@ class TestProfilerSatellites:
         from paddle_tpu.core.enforce import warn_once
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
+            warn_once.reset_for_tests("t_key_a")
+            warn_once.reset_for_tests("t_key_b")
             assert warn_once("t_key_a", "first")
             assert not warn_once("t_key_a", "second")
             assert warn_once("t_key_b", "other")
         assert [str(x.message) for x in w] == ["first", "other"]
 
+    def test_warn_once_reset_for_tests(self):
+        """The test-visible reset hook: after reset, the same key warns
+        again — so pytest.warns assertions on once-per-process shims no
+        longer depend on being the process's first caller."""
+        import warnings
+
+        from paddle_tpu.core.enforce import warn_once
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert warn_once("t_reset_key", "one")
+            assert not warn_once("t_reset_key", "suppressed")
+            warn_once.reset_for_tests("t_reset_key")
+            assert warn_once("t_reset_key", "again")
+            # keyless reset clears everything
+            warn_once.reset_for_tests()
+            assert warn_once("t_reset_key", "third")
+        assert [str(x.message) for x in w] == ["one", "again", "third"]
+
     def test_once_only_shims_route_through_warn_once(self):
         """cuda_profiler and the compile-cache mid-process path dedupe
-        via warn_once keys (asserting on key registration, not warning
-        emission: another test may legitimately have fired them first
-        in this process)."""
+        via warn_once keys; the reset hook makes the firing assertable
+        regardless of which test invoked the shim first."""
         import warnings
 
         from paddle_tpu.core import compile_cache, enforce
-        fired_before = "cuda_profiler" in enforce._warned_keys
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
+        from paddle_tpu.core.enforce import warn_once
+        warn_once.reset_for_tests("cuda_profiler")
+        with pytest.warns(UserWarning, match="cuda_profiler is a no-op"):
             with profiler.cuda_profiler():
                 pass
         assert "cuda_profiler" in enforce._warned_keys
-        if not fired_before:
-            # give the per-process firing back: another test in this
-            # process (test_dygraph_surface's shim test) legitimately
-            # pytest.warns on the first invocation
-            enforce._warned_keys.discard("cuda_profiler")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with profiler.cuda_profiler():     # second call: silent
+                pass
         assert compile_cache._mid_process()  # jax backend is up here
 
     def test_chrome_trace_invariants_and_flows(self, tmp_path):
@@ -738,14 +835,22 @@ class TestMetricsCatalogueLint:
         pkg = tmp_path / "paddle_tpu"
         pkg.mkdir()
         (pkg / "m.py").write_text(
-            'c = counter(\n    "t_undocumented_total", "x")\n')
+            'c = counter(\n    "t_undocumented_total", "x")\n'
+            'g = _gauge("t_aliased", "x")\n'
+            'x = counter("t_conflicted", "x")\n'
+            'y = gauge("t_conflicted", "x")\n')
         (tmp_path / "bench.py").write_text("")
         names = check_metrics.code_metrics(repo=str(tmp_path))
-        assert names == {"t_undocumented_total"}
+        # name -> kinds seen: aliased factories (_gauge) included, and
+        # two sites disagreeing on a kind surface as a 2-element set
+        assert names == {"t_undocumented_total": {"counter"},
+                         "t_aliased": {"gauge"},
+                         "t_conflicted": {"counter", "gauge"}}
         doc = tmp_path / "doc.md"
-        doc.write_text("| `t_documented_total` | counter | – | x |\n")
+        doc.write_text("| `t_documented_total` | counter | – | x |\n"
+                       "| `t_aliased` | histogram | – | wrong kind |\n")
         assert check_metrics.doc_metrics(str(doc)) == \
-            {"t_documented_total"}
+            {"t_documented_total": "counter", "t_aliased": "histogram"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,538 @@
+"""Training-health observability tests: in-graph numerics sentinels +
+the bisecting non-finite localizer (FLAGS_check_nan_inf), the
+tensor/grad watch, the anomaly detector and its postmortems, and the
+launcher-side straggler / health readout.
+
+The subprocess end-to-end run (NaN injected via the faults env hook ->
+sentinel trip -> anomaly postmortem + health gauges in the rank
+snapshot) carries the `slow` marker; everything else is tier-1 fast.
+Metrics are process-global and cumulative, so tests assert DELTAS."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.monitor import (anomaly, exporter, flight_recorder,
+                                numerics, tensorwatch)
+from paddle_tpu.monitor.registry import REGISTRY, Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "numerics_worker.py")
+
+
+@pytest.fixture
+def check_flag():
+    """FLAGS_check_nan_inf on for the test body, always off after."""
+    pt.set_flags({"check_nan_inf": True})
+    try:
+        yield
+    finally:
+        pt.set_flags({"check_nan_inf": False})
+
+
+@pytest.fixture
+def postmortem_dir(tmp_path, monkeypatch):
+    """Point the process recorder's dump dir at tmp (no signal/hook
+    installation) and allow a fresh once-per-kind dump."""
+    monkeypatch.setattr(flight_recorder.RECORDER, "_dir", str(tmp_path))
+    monkeypatch.setattr(anomaly, "_dumped_kinds", set())
+    return tmp_path
+
+
+def _build(with_opt=True, lr=0.05, clip=None):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.static.data("x", [4], dtype="float32")
+        y = pt.static.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        if with_opt:
+            pt.optimizer.SGDOptimizer(lr, grad_clip=clip).minimize(loss)
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+class TestSentinel:
+    def test_sentinel_scalar_semantics(self):
+        import jax.numpy as jnp
+        ok = numerics.sentinel([jnp.ones((3,)), jnp.zeros((2, 2))])
+        assert bool(np.asarray(ok))
+        bad = numerics.sentinel([jnp.ones((3,)),
+                                 jnp.asarray([1.0, np.nan])])
+        assert not bool(np.asarray(bad))
+        inf = numerics.sentinel([jnp.asarray([np.inf])])
+        assert not bool(np.asarray(inf))
+        # int/bool tensors are not checkable and never trip
+        ints = numerics.sentinel([jnp.arange(3),
+                                  jnp.asarray([True, False])])
+        assert bool(np.asarray(ints))
+        assert bool(np.asarray(numerics.sentinel([])))
+
+
+# ---------------------------------------------------------------------------
+class TestCheckNanInf:
+    def test_nan_feed_trips_and_names_tensor_and_op(
+            self, fresh_programs, check_flag, postmortem_dir):
+        main, startup, loss = _build()
+        exe = pt.static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        trips0 = REGISTRY.get("nonfinite_trips_total").value()
+        # a clean checked step works and matches normal numerics
+        (l1,) = exe.run(main, feed={"x": xv, "y": yv},
+                        fetch_list=[loss])
+        xbad = xv.copy()
+        xbad[0, 0] = np.nan
+        with pytest.raises(numerics.NonFiniteError) as ei:
+            exe.run(main, feed={"x": xbad, "y": yv},
+                    fetch_list=[loss])
+        r = ei.value.report
+        assert r["localized"] and r["tensor"] and r["op_type"]
+        assert r["nan_count"] >= 1
+        assert REGISTRY.get("nonfinite_trips_total").value() \
+            == trips0 + 1
+        # the trip was verified BEFORE committing the step: params in
+        # the scope are still finite
+        scope = pt.static.global_scope()
+        for n in ("fc_w_0", "fc_b_0"):
+            if scope.find_var(n) is not None:
+                assert np.isfinite(np.asarray(
+                    scope.find_var(n))).all()
+        # anomaly postmortem written, naming the same tensor/op
+        dumps = [f for f in os.listdir(postmortem_dir)
+                 if "anomaly-non-finite" in f]
+        assert len(dumps) == 1
+        doc = json.load(open(postmortem_dir / dumps[0]))
+        assert doc["anomaly"]["tensor"] == r["tensor"]
+        assert doc["anomaly"]["op_type"] == r["op_type"]
+        assert doc["anomaly"]["kind"] == "non_finite"
+
+    def test_localizer_bisects_to_mid_graph_op(self, fresh_programs,
+                                               check_flag):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.static.data("x", [4], dtype="float32")
+            h = pt.layers.fc(x, size=4, act="relu")
+            bad = pt.layers.log(h - 10.0)     # log of negative -> nan
+            out = pt.layers.mean(bad)
+        exe = pt.static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        with pytest.raises(numerics.NonFiniteError) as ei:
+            exe.run(main, feed={"x": xv}, fetch_list=[out])
+        r = ei.value.report
+        assert r["op_type"] == "log"
+        assert r["op_index"] > 0              # not the first op
+        assert r["nan_count"] == r["size"]
+
+    def test_localizer_names_bad_gradient_leaf(self, fresh_programs,
+                                               check_flag):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = pt.static.data("x", [4], dtype="float32")
+            pred = pt.layers.fc(x, size=1, bias_attr=False)
+            loss = pt.layers.mean(pt.layers.sqrt(pt.layers.abs(pred)))
+            pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+        exe = pt.static.Executor()
+        exe.run(startup)
+        # pred == 0 -> d sqrt|p| / dp is infinite: forward is finite,
+        # only the GRADIENT blows up — the localizer must name the
+        # specific @GRAD leaf off the autodiff pseudo-op
+        xv = np.zeros((8, 4), np.float32)
+        with pytest.raises(numerics.NonFiniteError) as ei:
+            exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        r = ei.value.report
+        assert r["op_type"] == "autodiff"
+        assert r["tensor"].endswith("@GRAD")
+
+    def test_check_off_lets_nan_flow(self, fresh_programs):
+        main, startup, loss = _build()
+        exe = pt.static.Executor()
+        exe.run(startup)
+        xbad = np.full((8, 4), np.nan, np.float32)
+        yv = np.ones((8, 1), np.float32)
+        (lv,) = exe.run(main, feed={"x": xbad, "y": yv},
+                        fetch_list=[loss])
+        assert np.isnan(lv).any()             # flag off: no error
+
+    def test_checked_step_matches_unchecked_numerics(
+            self, fresh_programs):
+        main, startup, loss = _build()
+        exe = pt.static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+        yv = xv.sum(1, keepdims=True).astype(np.float32)
+        (a,) = exe.run(main, feed={"x": xv, "y": yv},
+                       fetch_list=[loss])
+        pt.set_flags({"check_nan_inf": True})
+        try:
+            (b,) = exe.run(main, feed={"x": xv, "y": yv},
+                           fetch_list=[loss])
+        finally:
+            pt.set_flags({"check_nan_inf": False})
+        (c,) = exe.run(main, feed={"x": xv, "y": yv},
+                       fetch_list=[loss])
+        # steps 2 and 3 of the same deterministic descent, one checked:
+        # the checked variant is the same program + a sentinel scalar
+        assert b < a and c < b
+
+    def test_faults_env_hook_poisons_feed(self, monkeypatch, tmp_path):
+        from paddle_tpu.testing import faults
+        monkeypatch.setenv("PT_FAULT_NAN_AT_STEP", "2")
+        monkeypatch.setenv("PT_FAULT_ONCE_DIR", str(tmp_path))
+        monkeypatch.delenv("PT_FAULT_RANK", raising=False)
+        feed = {"x": np.ones((2, 2), np.float32),
+                "y": np.ones((2, 1), np.float32)}
+        assert faults.poison_feed(1, feed) is feed      # wrong step
+        out = faults.poison_feed(2, feed)
+        assert out is not feed
+        assert np.isnan(out["x"]).sum() == 1
+        assert not np.isnan(feed["x"]).any()            # original safe
+        # once-per-job: a restarted incarnation runs clean
+        assert faults.poison_feed(2, feed) is feed
+
+
+# ---------------------------------------------------------------------------
+class TestTensorWatch:
+    def test_static_watch_publishes_norms_and_ratio(
+            self, fresh_programs):
+        from paddle_tpu.clip import GradientClipByGlobalNorm
+        tensorwatch.enable()
+        try:
+            main, startup, loss = _build(
+                lr=0.05, clip=GradientClipByGlobalNorm(1e6))
+            exe = pt.static.Executor()
+            exe.run(startup)
+            xv = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+            yv = xv.sum(1, keepdims=True).astype(np.float32)
+            h0 = REGISTRY.get("grad_global_norm_per_step").count()
+            fetched = exe.run(main, feed={"x": xv, "y": yv},
+                              fetch_list=[loss])
+            assert len(fetched) == 1          # stats var peeled off
+            gn = REGISTRY.get("grad_global_norm").value()
+            pn = REGISTRY.get("param_global_norm").value()
+            ratio = REGISTRY.get("update_ratio").value()
+            assert gn > 0 and pn > 0 and ratio > 0
+            # SGD with a non-binding clip: ||delta|| = lr * ||g||, so
+            # update_ratio must equal lr * grad_norm / param_norm
+            assert ratio == pytest.approx(0.05 * gn / pn, rel=1e-4)
+            assert REGISTRY.get("grad_global_norm_per_step").count() \
+                == h0 + 1
+        finally:
+            tensorwatch.disable()
+
+    def test_watch_off_program_has_no_watch_ops(self, fresh_programs):
+        assert not tensorwatch.is_enabled()
+        main, startup, loss = _build()
+        types = [op.type for op in main.global_block().ops]
+        assert "tensor_watch_pre" not in types
+        assert "tensor_watch_post" not in types
+
+    def test_eager_tensor_monitor(self):
+        import jax.numpy as jnp
+        from paddle_tpu.monitor import TensorMonitor
+        params = {"w": jnp.ones((3,))}
+        grads = {"w": jnp.full((3,), 2.0)}
+        new = {"w": jnp.full((3,), 0.9)}
+        gn = TensorMonitor().observe(params, grads, new)
+        assert gn == pytest.approx(float(np.sqrt(12.0)))
+        assert REGISTRY.get("update_ratio").value() == pytest.approx(
+            np.sqrt(3 * 0.01) / np.sqrt(3.0), rel=1e-5)
+
+    def test_loss_scale_decrements_counted(self):
+        import jax.numpy as jnp
+        from paddle_tpu import amp
+        dec0 = REGISTRY.get("loss_scale_decrements_total").value()
+        tensorwatch.record_loss_scale(1024.0)
+        tensorwatch.record_loss_scale(1024.0)      # flat: no decrement
+        tensorwatch.record_loss_scale(512.0)       # decrement
+        tensorwatch.record_loss_scale(1024.0)      # increment: none
+        assert REGISTRY.get("loss_scale_decrements_total").value() \
+            == dec0 + 1
+        assert REGISTRY.get("loss_scale").value() == 1024.0
+        # the amp hookup: a non-finite grad halves the dynamic scale,
+        # and monitor_state publishes the decrement
+        opt = amp.OptimizerWithMixedPrecision(
+            pt.optimizer.SGD(0.1), amp.float16_policy(),
+            amp.LossScaler(init_loss_scaling=1024.0,
+                           decr_every_n_nan_or_inf=1))
+        params = {"w": jnp.ones((2,))}
+        state = opt.init(params)
+        assert opt.monitor_state(state) == 1024.0
+        bad = {"w": jnp.asarray([np.inf, 1.0])}
+        _, state = opt.apply_gradients(params, bad, state)
+        assert opt.monitor_state(state) == 512.0
+        assert REGISTRY.get("loss_scale_decrements_total").value() \
+            == dec0 + 2
+        # a NEW run (enable() resets the baseline) starting below the
+        # old run's grown scale is not a decrement event
+        tensorwatch.enable()
+        try:
+            tensorwatch.record_loss_scale(64.0)
+            assert REGISTRY.get(
+                "loss_scale_decrements_total").value() == dec0 + 2
+        finally:
+            tensorwatch.disable()
+
+
+# ---------------------------------------------------------------------------
+class TestAnomalyDetector:
+    def test_loss_spike_trips_once_with_cooldown(self, postmortem_dir):
+        det = anomaly.AnomalyDetector(window=16, min_samples=4,
+                                      loss_spike_factor=3.0,
+                                      cooldown=50)
+        t0 = REGISTRY.get("anomaly_trips_total").value(
+            kind="loss_spike")
+        for i in range(8):
+            assert det.observe(step=i, loss=1.0 + 0.01 * i) == []
+        assert det.observe(step=8, loss=50.0) == ["loss_spike"]
+        # cooldown: the persisting condition does not re-trip per step
+        assert det.observe(step=9, loss=60.0) == []
+        assert REGISTRY.get("anomaly_trips_total").value(
+            kind="loss_spike") == t0 + 1
+        assert REGISTRY.get("train_health").value() == 0.0
+        assert REGISTRY.get("last_anomaly_step").value() == 8.0
+        dumps = [f for f in os.listdir(postmortem_dir)
+                 if "anomaly-loss-spike" in f]
+        assert len(dumps) == 1
+        doc = json.load(open(postmortem_dir / dumps[0]))
+        assert doc["anomaly"]["kind"] == "loss_spike"
+        assert doc["anomaly"]["value"] == 50.0
+
+    def test_non_finite_loss_and_stall_kinds(self, postmortem_dir):
+        det = anomaly.AnomalyDetector(window=16, min_samples=4,
+                                      stall_factor=5.0)
+        nf0 = REGISTRY.get("anomaly_trips_total").value(
+            kind="non_finite")
+        assert det.observe(step=0, loss=float("nan")) == ["non_finite"]
+        assert REGISTRY.get("anomaly_trips_total").value(
+            kind="non_finite") == nf0 + 1
+        for i in range(6):
+            det.observe(step=i, step_ms=10.0)
+        # a stall must be SUSTAINED: 2 breaching steps are a hiccup,
+        # the 3rd consecutive one trips — and an intervening normal
+        # step resets the streak
+        assert det.observe(step=7, step_ms=500.0) == []
+        assert det.observe(step=8, step_ms=500.0) == []
+        assert det.observe(step=9, step_ms=500.0) == ["step_stall"]
+        det2 = anomaly.AnomalyDetector(window=16, min_samples=4,
+                                       stall_factor=5.0)
+        for i in range(6):
+            det2.observe(step=i, step_ms=10.0)
+        det2.observe(step=7, step_ms=500.0)
+        det2.observe(step=8, step_ms=500.0)
+        det2.observe(step=9, step_ms=10.0)       # streak broken
+        assert det2.observe(step=10, step_ms=500.0) == []
+
+    def test_non_finite_signal_trips_without_polluting_window(
+            self, postmortem_dir):
+        """A NaN grad norm must trip non_finite even without
+        FLAGS_check_nan_inf — and must never join a window, where one
+        NaN would poison the median baseline for `window` steps."""
+        det = anomaly.AnomalyDetector(window=16, min_samples=4)
+        nf0 = REGISTRY.get("anomaly_trips_total").value(
+            kind="non_finite")
+        assert det.observe(step=0, grad_norm=float("inf")) \
+            == ["non_finite"]
+        assert det.observe(step=1, loss=float("nan"),
+                           grad_norm=1.0) == []   # non_finite cooling
+        assert REGISTRY.get("anomaly_trips_total").value(
+            kind="non_finite") == nf0 + 1
+        assert len(det.window("grad_explosion")) == 1     # only the 1.0
+        assert all(v == v for v in det.window("grad_explosion"))
+
+    def test_enable_resets_health_and_detector(self):
+        anomaly.enable(window=8)
+        try:
+            assert anomaly.is_enabled()
+            assert REGISTRY.get("train_health").value() == 1.0
+        finally:
+            anomaly.disable()
+
+    def test_executor_feeds_step_time_when_enabled(
+            self, fresh_programs):
+        # a detector with an absurd stall factor never trips, but its
+        # window must fill from Executor.run's automatic step_ms feed
+        det = anomaly.enable(stall_factor=1e9)
+        try:
+            main, startup, loss = _build()
+            exe = pt.static.Executor()
+            exe.run(startup)
+            xv = np.zeros((4, 4), np.float32)
+            yv = np.zeros((4, 1), np.float32)
+            for _ in range(3):
+                exe.run(main, feed={"x": xv, "y": yv},
+                        fetch_list=[loss])
+            # keyed by the compiled-step identity (train/eval programs
+            # get separate stall baselines)
+            stall = [w for (k, key), w in det._windows.items()
+                     if k == "step_stall" and key is not None]
+            assert len(stall) == 1 and len(stall[0]) == 3
+        finally:
+            anomaly.disable()
+
+
+# ---------------------------------------------------------------------------
+class TestStragglerAndHealth:
+    def _snaps(self, ms_by_rank, extra=None):
+        out = {}
+        for rank, ms in ms_by_rank.items():
+            r = Registry()
+            h = r.histogram("executor_step_ms")
+            for v in ms:
+                h.observe(v)
+            r.counter("executor_steps_total").inc(len(ms))
+            if extra and rank in extra:
+                extra[rank](r)
+            out[rank] = exporter.parse_text(exporter.render_text(r))
+        return out
+
+    def test_straggler_needs_quorum_and_flags_slow_rank(self):
+        two = self._snaps({0: [10.0] * 5, 1: [100.0] * 5})
+        assert anomaly.straggler_ranks(two) == []     # no quorum at 2
+        four = self._snaps({0: [10.0] * 5, 1: [11.0] * 5,
+                            2: [10.5] * 5, 3: [95.0] * 5})
+        assert anomaly.straggler_ranks(four) == [3]
+        health, stragglers = anomaly.job_health(four)
+        assert health == "straggler:r3" and stragglers == [3]
+
+    def test_job_health_reports_anomaly_kinds(self):
+        def mark(r):
+            r.counter("anomaly_trips_total", labels=("kind",)).inc(
+                kind="loss_spike")
+            r.gauge("train_health").set(0.0)
+
+        snaps = self._snaps({0: [10.0] * 4, 1: [10.0] * 4},
+                            extra={1: mark})
+        health, _ = anomaly.job_health(snaps)
+        assert health == "anomaly:loss_spike"
+        clean = self._snaps({0: [10.0] * 4, 1: [10.0] * 4})
+        assert anomaly.job_health(clean) == ("ok", [])
+
+    def test_job_aggregate_min_merges_train_health(self):
+        """The job is only as healthy as its sickest rank: a healthy
+        rank's train_health 1 must not max-merge over an anomalous
+        rank's 0 in the job-level snapshot."""
+        parsed = []
+        for v in (1.0, 0.0, 1.0):
+            r = Registry()
+            r.gauge("train_health").set(v)
+            r.gauge("segment_flops").set(10.0 * (v + 1))
+            parsed.append(exporter.parse_text(exporter.render_text(r)))
+        _, samples = exporter.aggregate(parsed)
+        assert samples[("train_health", ())] == 0.0
+        assert samples[("segment_flops", ())] == 20.0   # gauges: max
+
+    def test_cooldown_ticks_per_observation_not_per_breach(self):
+        """A rare recurring anomaly must re-trip once the cooldown's
+        worth of OBSERVATIONS has passed — not be swallowed for
+        cooldown x (breach spacing) steps."""
+        det = anomaly.AnomalyDetector(window=64, min_samples=4,
+                                      loss_spike_factor=3.0,
+                                      cooldown=10)
+        t0 = REGISTRY.get("anomaly_trips_total").value(
+            kind="loss_spike")
+        for i in range(8):
+            det.observe(step=i, loss=1.0)
+        assert det.observe(step=8, loss=50.0) == ["loss_spike"]
+        # 12 quiet observations tick the 10-observation cooldown away
+        # (the spike joined the window, but the median stays 1.0)
+        for i in range(12):
+            det.observe(step=9 + i, loss=1.0)
+        assert det.observe(step=30, loss=50.0) == ["loss_spike"]
+        assert REGISTRY.get("anomaly_trips_total").value(
+            kind="loss_spike") == t0 + 2
+
+    def test_status_line_carries_health_field(self, tmp_path):
+        from paddle_tpu.distributed import health as dhealth
+        for rank in (0, 1):
+            r = Registry()
+            r.counter("executor_steps_total").inc(5)
+            h = r.histogram("executor_step_ms")
+            for _ in range(5):
+                h.observe(4.0)
+            if rank == 1:
+                r.counter("nonfinite_trips_total").inc()
+            exporter.write_snapshot(
+                dhealth.metrics_path(str(tmp_path), rank), r)
+        line = exporter.job_status_line(str(tmp_path))
+        assert "health=anomaly:non_finite" in line, line
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+class TestNumericsEndToEnd:
+    """Acceptance: 2 ranks under the launcher, rank 1's feed is
+    NaN-poisoned at step 3 via the faults env hook; with
+    FLAGS_check_nan_inf on the sentinel must trip within that step,
+    the anomaly postmortem must name the first non-finite tensor and
+    op, and the rank's final snapshot must carry the health gauges."""
+
+    TOTAL = 10
+
+    def test_injected_nan_trips_detector_with_postmortem(
+            self, tmp_path, capfd):
+        from numerics_worker import NAN_EXIT_CODE
+
+        from paddle_tpu.distributed.launch import launch_collective
+        prefix = tmp_path / "num.out"
+        log_dir = tmp_path / "logs"
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+            "FLAGS_check_nan_inf": "1",
+            "PT_FAULT_NAN_AT_STEP": "3",
+            "PT_FAULT_RANK": "1",
+            "PT_FAULT_ONCE_DIR": str(tmp_path / "once"),
+        }
+        rc = launch_collective(
+            [WORKER, str(prefix), str(self.TOTAL)],
+            nproc=2, log_dir=str(log_dir), env_extra=env,
+            timeout=240, max_restarts=0, grace_period=5.0)
+        err = capfd.readouterr().err
+
+        def logs():
+            out = err
+            for p in sorted(log_dir.glob("*.log")):
+                out += f"\n--- {p.name} ---\n" + p.read_text()[-2000:]
+            return out
+
+        assert rc == NAN_EXIT_CODE, logs()
+
+        # the worker's own report: tripped within the poisoned step
+        rep = json.loads(
+            (tmp_path / "num.out.rank1.json").read_text())
+        assert rep["tripped_at"] == 3, rep
+        assert rep["report"]["localized"] in (True, "True"), rep
+        assert rep["report"]["tensor"] and rep["report"]["op_type"]
+
+        # anomaly postmortem names the same tensor/op
+        pm = log_dir / "postmortem"
+        dumps = sorted(pm.glob("rank1.*anomaly-non-finite*.json"))
+        assert dumps, f"no anomaly postmortem in {pm}: " \
+            f"{sorted(os.listdir(pm))}\n{logs()}"
+        doc = json.loads(dumps[0].read_text())
+        assert doc["anomaly"]["kind"] == "non_finite"
+        assert doc["anomaly"]["tensor"] == rep["report"]["tensor"]
+        assert doc["anomaly"]["op_type"] == rep["report"]["op_type"]
+
+        # the rank's final snapshot carries the new health gauges
+        snap = (log_dir / "heartbeat" / "rank1.prom").read_text()
+        _types, samples = exporter.parse_text(snap)
+        assert samples[("nonfinite_trips_total", ())] == 1.0
+        assert samples[("train_health", ())] == 0.0
+        assert samples[("anomaly_trips_total",
+                        (("kind", "non_finite"),))] == 1.0
+        assert samples[("grad_global_norm", ())] > 0   # tensor watch
+        # the healthy rank ran its steps with checking ON and clean
+        rep0 = json.loads(
+            (tmp_path / "num.out.rank0.json").read_text())
+        assert rep0["tripped_at"] is None
+        assert rep0["steps"] == self.TOTAL

@@ -101,9 +101,21 @@ class TestBertTinyRealText:
             os.path.abspath(__file__)))
         corpus_root = "/root/reference" \
             if os.path.isdir("/root/reference") else repo_root
+        files = None
+        if corpus_root == repo_root:
+            # the fallback corpus is PINNED to a committed manifest:
+            # without it, every PR that adds docs or code shifted the
+            # training data and wobbled the held-out bound below
+            # (0.609 observed after one docs-only change)
+            manifest = os.path.join(repo_root, "tests", "fixtures",
+                                    "bert_corpus_manifest.txt")
+            with open(manifest) as f:
+                files = [ln.strip() for ln in f
+                         if ln.strip() and not ln.startswith("#")]
         ids, vocab = TC.build_corpus(corpus_root, vocab_size=2048,
                                      max_bytes=4 << 20,
-                                     exts=(".md", ".rst", ".py"))
+                                     exts=(".md", ".rst", ".py"),
+                                     files=files)
         assert len(ids) > 50_000, "corpus too small to train on"
 
         from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -143,12 +155,11 @@ class TestBertTinyRealText:
         assert loss0 == pytest.approx(uniform, rel=0.15), \
             (loss0, uniform)
         # generalization, not memorization: held-out loss improves a
-        # lot. The bound must be robust to CORPUS DRIFT: without
-        # /root/reference the corpus is this repo's own .md/.py files,
-        # so every PR that adds code or docs shifts the data — a 0.60
-        # ratio sat one observed run under the line (0.609 after one
-        # docs-only change). 0.65 still demands a ~2.7-nat drop from
-        # the uniform baseline in 600 steps while surviving data shifts.
+        # lot. The fallback corpus is pinned to the committed manifest
+        # (new files can no longer shift the data), so only edits to
+        # the pinned files themselves move this number now; 0.65 keeps
+        # margin for that and still demands a ~2.7-nat drop from the
+        # uniform baseline in 600 steps.
         assert loss1 < loss0 * 0.65, (loss0, loss1)
         assert loss1 < first_train, (first_train, loss1)
 

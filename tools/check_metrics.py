@@ -1,12 +1,14 @@
-"""Metrics-catalogue lint: code and docs must agree.
+"""Metrics-catalogue lint: code and docs must agree — names AND kinds.
 
 Every metric registered in the tree (a ``counter("name", ...)`` /
 ``gauge(`` / ``histogram(`` call in ``paddle_tpu/`` or ``bench.py``)
-must have a row in docs/OBSERVABILITY.md's catalogue table, and every
-row must correspond to a registered metric — an undocumented metric is
-invisible to operators, and a documented-but-gone metric silently
-breaks their dashboards. Run as a tier-1 test (tests/test_monitor.py)
-and standalone:
+must have a row in docs/OBSERVABILITY.md's catalogue table, every row
+must correspond to a registered metric, and the row's *type* column
+must match the factory that registered it — an undocumented metric is
+invisible to operators, a documented-but-gone metric silently breaks
+their dashboards, and a gauge documented as a counter makes operators
+``rate()`` a value that is not monotone. Run as a tier-1 test
+(tests/test_monitor.py) and standalone:
 
     python tools/check_metrics.py        # exit 1 on any drift
 """
@@ -19,17 +21,24 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
 # a registration is a lowercase factory call with a literal first-arg
-# name (possibly on the next line); \s* crosses newlines on purpose
+# name (possibly on the next line); \s* crosses newlines on purpose,
+# and the factory match is a bare substring so aliased imports
+# (``histogram as _histogram``) still count
 _REG_RE = re.compile(
-    r"(?:counter|gauge|histogram)\(\s*[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']")
+    r"(counter|gauge|histogram)\(\s*[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']")
 # catalogue rows: | `name` | type | ...
-_DOC_RE = re.compile(r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|",
-                     re.MULTILINE)
+_DOC_RE = re.compile(
+    r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|\s*([a-z]+)\s*\|",
+    re.MULTILINE)
 
 
 def code_metrics(repo=REPO):
-    """Metric names registered anywhere in paddle_tpu/ or bench.py."""
-    names = set()
+    """{name: sorted set of kinds} for every metric registered in
+    paddle_tpu/ or bench.py. More than one kind for a name means two
+    registration sites disagree (the registry would raise at runtime,
+    but only when both import) — the lint flags it statically rather
+    than letting the last os.walk hit win."""
+    out = {}
     roots = [os.path.join(repo, "paddle_tpu")]
     files = [os.path.join(repo, "bench.py")]
     for root in roots:
@@ -44,29 +53,44 @@ def code_metrics(repo=REPO):
                 src = f.read()
         except OSError:
             continue
-        names.update(_REG_RE.findall(src))
-    return names
+        for kind, name in _REG_RE.findall(src):
+            out.setdefault(name, set()).add(kind)
+    return out
 
 
 def doc_metrics(path=DOCS):
+    """{name: documented type} from the catalogue table rows."""
     with open(path) as f:
-        return set(_DOC_RE.findall(f.read()))
+        return {name: kind for name, kind in _DOC_RE.findall(f.read())}
 
 
 def main():
     code = code_metrics()
     docs = doc_metrics()
-    undocumented = sorted(code - docs)
-    stale = sorted(docs - code)
+    undocumented = sorted(set(code) - set(docs))
+    stale = sorted(set(docs) - set(code))
+    conflicted = sorted((n, sorted(ks)) for n, ks in code.items()
+                        if len(ks) > 1)
+    mismatched = sorted(
+        (n, next(iter(code[n])), docs[n])
+        for n in set(code) & set(docs)
+        if len(code[n]) == 1 and docs[n] not in code[n])
     if undocumented:
         print(f"metrics registered in code but missing from "
               f"docs/OBSERVABILITY.md catalogue: {undocumented}")
     if stale:
         print(f"metrics documented in docs/OBSERVABILITY.md but not "
               f"registered anywhere: {stale}")
-    if undocumented or stale:
+    for name, kinds in conflicted:
+        print(f"metric {name!r} is registered with conflicting kinds "
+              f"across sites: {kinds}")
+    for name, ck, dk in mismatched:
+        print(f"metric {name!r} is registered as a {ck} but "
+              f"documented as a {dk}")
+    if undocumented or stale or conflicted or mismatched:
         return 1
-    print(f"metrics catalogue in sync ({len(code)} metrics)")
+    print(f"metrics catalogue in sync ({len(code)} metrics, "
+          f"kinds verified)")
     return 0
 
 
