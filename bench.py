@@ -733,6 +733,86 @@ def bench_numerics():
           file=sys.stderr)
 
 
+def bench_ckpt():
+    """`python bench.py ckpt` — checkpoint durability-path timings:
+    save (serialize + CRC + fsync + atomic publish) and restore with
+    digest verification ON vs OFF, so the integrity overhead is
+    measured, not assumed. Verify-on and verify-off restore windows
+    INTERLEAVE (the bench_dispatch discipline: adjacent windows see
+    the same ambient disk/host load on a shared box) and the headline
+    is the median of per-pair on/off ratios. BENCH_CKPT_MB sets the
+    payload size, BENCH_CKPT_PAIRS the pair count. Three JSON lines:
+    ckpt_save_ms, ckpt_restore_ms, ckpt_verify_overhead_ratio."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from paddle_tpu.io_checkpoint import CheckpointManager
+
+    mb = float(os.environ.get("BENCH_CKPT_MB", "64"))
+    pairs = max(2, int(os.environ.get("BENCH_CKPT_PAIRS", "5")))
+    n_arrays = 16
+    per = max(int(mb * 1e6 / 4 / n_arrays), 1)
+    rs = np.random.RandomState(0)
+    tree = {"params": {f"w{i}": rs.randn(per).astype(np.float32)
+                       for i in range(n_arrays)},
+            "opt": {f"m{i}": rs.randn(per).astype(np.float32)
+                    for i in range(2)}}
+    nbytes = sum(a.nbytes for g in tree.values() for a in g.values())
+    d = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        mgr = CheckpointManager(d, async_save=False,
+                                save_interval_steps=1, keep_max=2)
+        mgr.save(0, tree)               # warmup (dir entries, caches)
+        save_ms = []
+        for i in range(1, pairs + 1):
+            t0 = _time.perf_counter()
+            mgr.save(i, tree)
+            save_ms.append((_time.perf_counter() - t0) * 1e3)
+        step = mgr.latest_step()
+        mgr.restore(step)               # warmup both restore paths
+        mgr.restore(step, verify=False)
+        on_ms, off_ms, ratios = [], [], []
+        for w in range(pairs):
+            first_on = w % 2 == 0       # alternate order within pairs
+
+            def timed(verify):
+                t0 = _time.perf_counter()
+                mgr.restore(step, verify=verify)
+                return (_time.perf_counter() - t0) * 1e3
+
+            a = timed(first_on)
+            b = timed(not first_on)
+            on, off = (a, b) if first_on else (b, a)
+            on_ms.append(on)
+            off_ms.append(off)
+            ratios.append(on / off)
+        mgr.close()
+        med = float(np.median(ratios))
+        save_med = float(np.median(save_ms))
+        print(json.dumps({
+            "metric": "ckpt_save_ms", "value": round(save_med, 2),
+            "unit": "ms", "payload_mb": round(nbytes / 1e6, 1),
+            "save_mb_per_sec": round(nbytes / 1e6 / (save_med / 1e3), 1),
+        }))
+        print(json.dumps({
+            "metric": "ckpt_restore_ms",
+            "value": round(float(np.median(on_ms)), 2), "unit": "ms",
+            "verify_on_ms": round(float(np.median(on_ms)), 2),
+            "verify_off_ms": round(float(np.median(off_ms)), 2),
+        }))
+        print(json.dumps({
+            "metric": "ckpt_verify_overhead_ratio",
+            "value": round(med, 4), "unit": "x",
+            "pair_ratios": [round(r, 4) for r in ratios],
+        }))
+        print(f"# checkpoint verify overhead: median pair ratio "
+              f"{med:.4f}x over {pairs} interleaved pairs, "
+              f"{nbytes / 1e6:.0f} MB payload", file=sys.stderr)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _emit_registry_snapshot():
     """End-of-run metrics emission: the registry (bench windows +
     whatever executor/prefetch/checkpoint counters the run touched) as
@@ -782,6 +862,8 @@ def _dispatch_mode():
         return bench_serving()
     if len(sys.argv) > 1 and sys.argv[1] == "numerics":
         return bench_numerics()
+    if len(sys.argv) > 1 and sys.argv[1] == "ckpt":
+        return bench_ckpt()
     import jax
     import jax.numpy as jnp
 

@@ -11,7 +11,22 @@ prefetched one batch ahead so the accelerator never waits on feed.
 
 Falls back to a pure-Python file reader when the native toolchain is
 unavailable (same iterator contract).
+
+Exactly-once resume (``stateful=True``): the loader carries a cursor —
+(epoch, file index, byte offset, records consumed, and a shuffle RNG
+re-derived from ``(seed, epoch)``) — exposed as ``state()`` /
+``set_state()``. A state snapshot rides with every batch through the
+prefetch queue and is committed only when the *consumer* receives that
+batch, so read-ahead the process never consumed is not counted; saving
+``state()`` in a checkpoint (``auto_checkpoint(data_state=loader)``)
+and resuming yields bit-identical batches to an uninterrupted run.
+Stateful mode always uses the deterministic single-threaded Python
+reader — the native loader's multi-threaded record order is
+nondeterministic, so there is no sequence a resumed run could rejoin
+(the documented fallback).
 """
+
+import os
 
 import numpy as np
 
@@ -21,36 +36,171 @@ __all__ = ["FileDataLoader"]
 
 _m_batches = _counter("dataio_batches_total",
                       "Batches parsed and stacked by FileDataLoader")
+_m_records = _counter("data_records_consumed_total",
+                      "Records consumed by the training process via "
+                      "FileDataLoader (counted at batch delivery, not "
+                      "read-ahead)")
+
+STATE_VERSION = 1
+
+
+class _PyRecordReader:
+    """Deterministic, resumable record reader (the contract behind
+    ``NativeLoader``, single-threaded).
+
+    Iteration order is a pure function of (files, seed, shuffle_buffer):
+    the shuffle RNG is re-seeded per epoch from ``(seed, epoch)`` and
+    the reservoir buffer drains at each epoch end, so any position is
+    re-derivable. ``state()`` returns the cursor after the last record
+    yielded; constructing with ``start_state=`` resumes exactly there —
+    by seeking (no shuffle: file index + byte offset) or by replaying
+    the epoch's already-emitted records without yielding them (shuffle:
+    the reservoir's content is history-dependent, so the skip replay is
+    what makes resume bit-identical)."""
+
+    def __init__(self, files, epochs, mode="lines", shuffle_buffer=0,
+                 seed=0, start_state=None):
+        if mode != "lines":
+            raise RuntimeError(
+                f"the pure-Python reader only supports mode='lines' "
+                f"(got {mode!r}); RecordIO needs the native library")
+        self.files = list(files)
+        self.epochs = epochs
+        self.shuffle_buffer = shuffle_buffer
+        self.seed = seed
+        # identity of the stream the cursor addresses: a swapped or
+        # rewritten file of the same count would make the saved
+        # offset/skip-replay land on different records with no error
+        self._files_fp = [[os.path.basename(f), os.path.getsize(f)]
+                          for f in self.files]
+        self._epoch = 0
+        self._file_index = 0
+        self._offset = 0            # byte offset into the current file
+        self._epoch_records = 0     # records yielded this epoch
+        self._consumed = 0          # records yielded since epoch 0
+        if start_state is not None:
+            self.set_state(start_state)
+
+    # -- cursor ------------------------------------------------------------
+    def state(self):
+        return {
+            "version": STATE_VERSION,
+            "epoch": self._epoch,
+            "file_index": self._file_index,
+            "offset": self._offset,
+            "epoch_records": self._epoch_records,
+            "records_consumed": self._consumed,
+            "seed": self.seed,
+            "shuffle_buffer": self.shuffle_buffer,
+            "nfiles": len(self.files),
+            "files": [list(fp) for fp in self._files_fp],
+        }
+
+    def set_state(self, state):
+        if not isinstance(state, dict) or \
+                state.get("version") != STATE_VERSION:
+            raise ValueError(
+                f"unsupported reader state {state!r:.80} (want a dict "
+                f"with version={STATE_VERSION})")
+        for knob in ("seed", "shuffle_buffer"):
+            if state.get(knob) != getattr(self, knob):
+                raise ValueError(
+                    f"reader state was captured with {knob}="
+                    f"{state.get(knob)!r} but this reader has {knob}="
+                    f"{getattr(self, knob)!r} — resuming would change "
+                    f"the record sequence")
+        if state.get("nfiles") != len(self.files):
+            raise ValueError(
+                f"reader state was captured over {state.get('nfiles')} "
+                f"file(s) but this reader has {len(self.files)} — the "
+                f"saved cursor does not address this file list")
+        want_fp = [list(fp) for fp in self._files_fp]
+        got_fp = state.get("files")
+        if got_fp is not None and got_fp != want_fp:
+            changed = [w[0] for w, g in zip(want_fp, got_fp) if w != g]
+            raise ValueError(
+                f"reader state was captured over different file "
+                f"contents (changed: {changed[:3]}) — a swapped or "
+                f"rewritten file would silently shift the record "
+                f"sequence the cursor addresses")
+        self._epoch = int(state["epoch"])
+        self._file_index = int(state["file_index"])
+        self._offset = int(state["offset"])
+        self._epoch_records = int(state["epoch_records"])
+        self._consumed = int(state["records_consumed"])
+
+    # -- iteration ---------------------------------------------------------
+    def _epoch_rng(self):
+        import random
+        # string seed: stable across processes/interpreters (int hash
+        # of a tuple would be, too, but Random() rejects tuples)
+        return random.Random(f"{self.seed}:{self._epoch}")
+
+    def _raw_epoch(self, start_file=0, start_offset=0):
+        """(file_index, end_offset, record) over one epoch in file
+        order, starting at the given seek position."""
+        for i in range(start_file, len(self.files)):
+            off = start_offset if i == start_file else 0
+            with open(self.files[i], "rb") as fh:
+                if off:
+                    fh.seek(off)
+                for line in fh:
+                    off += len(line)
+                    yield i, off, line.rstrip(b"\n")
+
+    def _iter_epoch(self):
+        if self.shuffle_buffer <= 0:
+            # seekable: resume jumps straight to (file_index, offset)
+            for i, off, rec in self._raw_epoch(self._file_index,
+                                               self._offset):
+                self._file_index, self._offset = i, off
+                self._epoch_records += 1
+                self._consumed += 1
+                yield rec
+            return
+        # shuffled: deterministic given (seed, epoch); resume replays
+        # the first ``epoch_records`` outputs without yielding them
+        rng = self._epoch_rng()
+        skip = self._epoch_records
+        buf = []
+        for i, off, rec in self._raw_epoch():
+            self._file_index, self._offset = i, off
+            if len(buf) < self.shuffle_buffer:
+                buf.append(rec)
+                continue
+            j = rng.randrange(len(buf))
+            out, buf[j] = buf[j], rec
+            if skip > 0:
+                skip -= 1
+                continue
+            self._epoch_records += 1
+            self._consumed += 1
+            yield out
+        rng.shuffle(buf)
+        for out in buf:
+            if skip > 0:
+                skip -= 1
+                continue
+            self._epoch_records += 1
+            self._consumed += 1
+            yield out
+
+    def __iter__(self):
+        while self.epochs < 0 or self._epoch < self.epochs:
+            yield from self._iter_epoch()
+            self._epoch += 1
+            self._file_index = 0
+            self._offset = 0
+            self._epoch_records = 0
 
 
 def _py_record_iter(files, epochs, mode, shuffle_buffer=0, seed=0):
     """Fallback reader: same contract as NativeLoader incl. the
-    reservoir-style shuffle buffer (single-threaded)."""
-    import random
-    rng = random.Random(seed)
-    buf = []
-
-    def raw():
-        ep = 0
-        while epochs < 0 or ep < epochs:  # epochs=-1: cycle forever
-            ep += 1
-            for f in files:
-                with open(f, "rb") as fh:
-                    for line in fh:
-                        yield line.rstrip(b"\n")
-
-    if shuffle_buffer <= 0:
-        yield from raw()
-        return
-    for rec in raw():
-        if len(buf) < shuffle_buffer:
-            buf.append(rec)
-            continue
-        j = rng.randrange(len(buf))
-        out, buf[j] = buf[j], rec
-        yield out
-    rng.shuffle(buf)
-    yield from buf
+    shuffle buffer (single-threaded). Kept as the module's plain-
+    iterator face; ``_PyRecordReader`` is the stateful object."""
+    return iter(_PyRecordReader(files, epochs, mode,
+                                shuffle_buffer=shuffle_buffer,
+                                seed=seed))
 
 
 class FileDataLoader:
@@ -63,11 +213,17 @@ class FileDataLoader:
     read-ahead queue; ``prefetch <= 0`` means UNBOUNDED read-ahead (the
     worker may buffer the whole dataset — only use when that fits in
     host memory).
+
+    ``stateful=True`` enables ``state()``/``set_state()`` for
+    exactly-once resume (see the module docstring); it forces the
+    deterministic Python reader even when the native library is
+    present, and is incompatible with mode='recordio'.
     """
 
     def __init__(self, files, parse_fn, batch_size, nthreads=2,
                  shuffle_buffer=0, seed=0, epochs=1, mode="lines",
-                 drop_last=True, device_put=True, prefetch=2):
+                 drop_last=True, device_put=True, prefetch=2,
+                 stateful=False):
         self.files = list(files)
         self.parse_fn = parse_fn
         self.batch_size = batch_size
@@ -79,11 +235,71 @@ class FileDataLoader:
         self.drop_last = drop_last
         self.device_put = device_put
         self.prefetch = prefetch
+        self.stateful = stateful
+        if stateful and mode == "recordio":
+            raise RuntimeError(
+                "stateful=True needs the deterministic Python reader, "
+                "which has no RecordIO scanner — use mode='lines' or a "
+                "non-stateful loader")
+        self._pending_state = None      # applied at next __iter__
+        self._delivered_state = None    # after the last consumed batch
 
+    # -- resume cursor -----------------------------------------------------
+    def state(self):
+        """The cursor after the last batch the CONSUMER received (not
+        the worker's read-ahead). Save it with a checkpoint; a new
+        loader ``set_state()``-ed with it continues the exact record
+        sequence. Before any batch is delivered this returns the
+        pending (restored) state, or the start-of-stream cursor."""
+        if not self.stateful:
+            raise RuntimeError(
+                "state() on a non-stateful FileDataLoader — construct "
+                "with stateful=True (exactly-once resume needs the "
+                "deterministic reader)")
+        if self._delivered_state is not None:
+            return self._delivered_state
+        if self._pending_state is not None:
+            return self._pending_state
+        return _PyRecordReader(self.files, self.epochs, self.mode,
+                               self.shuffle_buffer, self.seed).state()
+
+    def set_state(self, state):
+        """Resume from a ``state()`` snapshot: takes effect on the next
+        ``__iter__`` (create iterators AFTER calling this)."""
+        if not self.stateful:
+            raise RuntimeError(
+                "set_state() on a non-stateful FileDataLoader — "
+                "construct with stateful=True")
+        # validate eagerly (a bad cursor should fail at restore time,
+        # not steps later inside the prefetch worker)
+        _PyRecordReader(self.files, self.epochs, self.mode,
+                        self.shuffle_buffer, self.seed,
+                        start_state=state)
+        self._pending_state = dict(state)
+        self._delivered_state = None
+
+    # -- reading -----------------------------------------------------------
     def _records(self):
         if self.mode not in ("lines", "recordio"):
             raise ValueError(f"mode must be 'lines' or 'recordio', "
                              f"got {self.mode!r}")
+        if self.stateful:
+            # documented fallback: exactly-once needs a deterministic
+            # record order, which the multi-threaded native loader
+            # cannot give — stateful always reads in Python
+            from paddle_tpu import native
+            if native.available():
+                from paddle_tpu.core.enforce import warn_once
+                warn_once(
+                    "dataloader-stateful-py",
+                    "FileDataLoader(stateful=True) uses the "
+                    "single-threaded Python reader even though the "
+                    "native loader is available: resumable "
+                    "exactly-once ingest requires a deterministic "
+                    "record order")
+            return _PyRecordReader(self.files, self.epochs, self.mode,
+                                   self.shuffle_buffer, self.seed,
+                                   start_state=self._pending_state)
         from paddle_tpu import native
         if self.mode == "recordio" and not native.available():
             raise RuntimeError(
@@ -100,18 +316,22 @@ class FileDataLoader:
                                self.shuffle_buffer, self.seed)
 
     def _batches(self):
+        """(batch, n_records, cursor-after-those-records) triples; the
+        cursor is None for non-stateful readers."""
         buf = []
         records = self._records()
+        snap = records.state if isinstance(records, _PyRecordReader) \
+            else (lambda: None)
         try:
             for rec in records:
                 buf.append(self.parse_fn(rec))
                 if len(buf) == self.batch_size:
                     _m_batches.inc()
-                    yield self._stack(buf)
+                    yield self._stack(buf), len(buf), snap()
                     buf = []
             if buf and not self.drop_last:
                 _m_batches.inc()
-                yield self._stack(buf)
+                yield self._stack(buf), len(buf), snap()
         finally:
             if hasattr(records, "close"):
                 records.close()
@@ -130,7 +350,9 @@ class FileDataLoader:
         background_prefetch helper (static.executor): a parse_fn
         exception re-raises HERE with the worker's traceback intact,
         and abandoning the iterator early (break / close) shuts the
-        worker down."""
+        worker down. The state cursor riding with each batch commits
+        only here, at delivery — read-ahead batches the consumer never
+        pulled are not "consumed" and resume re-reads them."""
         from paddle_tpu.static.executor import background_prefetch
 
         if self.device_put:
@@ -140,4 +362,22 @@ class FileDataLoader:
             def put(batch):
                 return batch
 
-        return background_prefetch(self._batches(), put, self.prefetch)
+        def stage(item):
+            batch, n, cursor = item
+            return put(batch), n, cursor
+
+        inner = background_prefetch(self._batches(), stage,
+                                    self.prefetch)
+
+        def deliver():
+            try:
+                for batch, n, cursor in inner:
+                    _m_records.inc(n)
+                    if cursor is not None:
+                        self._delivered_state = cursor
+                    yield batch
+            finally:
+                inner.close()   # deterministic worker shutdown when
+                                # the consumer abandons THIS wrapper
+
+        return deliver()

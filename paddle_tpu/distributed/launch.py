@@ -60,6 +60,26 @@ __all__ = ["launch_collective", "launch_ps", "find_free_ports",
 
 PREEMPTED_RC = 143          # 128 + SIGTERM, the conventional code
 
+#: the process exit-code vocabulary (docs/DEBUGGING.md table): naming
+#: the cause in the supervisor log turns "code 29" into something an
+#: operator can act on without grepping the test harness
+EXIT_CODE_LABELS = {
+    17: "non-finite trip (NonFiniteError)",
+    23: "injected crash (testing.faults)",
+    29: "checkpoint-corruption fault (testing.faults)",
+    124: "timeout",
+    137: "SIGKILLed (OOM killer or kill -9)",
+    139: "segfault",
+    143: "preempted (SIGTERM)",
+}
+
+
+def _rc_label(rc):
+    # Popen returncodes for signal deaths are NEGATIVE (-9, -11, -15);
+    # the operator-facing table speaks shell convention (128+signum)
+    label = EXIT_CODE_LABELS.get(128 - rc if rc < 0 else rc)
+    return f" [{label}]" if label else ""
+
 #: seconds between job-status log lines / job-level metric snapshots
 STATUS_INTERVAL = 15.0
 
@@ -282,7 +302,7 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
                     continue
                 del alive[name]
                 if r != 0:
-                    _log(f"{name} exited with code {r}")
+                    _log(f"{name} exited with code {r}{_rc_label(r)}")
                     _drain(alive.values(), grace_period)
                     return "fail", r
             if hang_timeout is not None and alive:
@@ -573,7 +593,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                 if r != 0:
                     # a dead pserver loses hosted state no worker
                     # restart can recover — fail fast
-                    _log(f"{name} exited with code {r}")
+                    _log(f"{name} exited with code {r}{_rc_label(r)}")
                     _drain(all_procs(), grace_period)
                     return r
             for i, due in list(pending_respawn.items()):
@@ -596,7 +616,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                 if r == 0:
                     done_workers.add(i)
                     continue
-                _log(f"trainer {i} exited with code {r}")
+                _log(f"trainer {i} exited with code {r}{_rc_label(r)}")
                 if not fail_worker(i, f"failed (rc={r})"):
                     return r
             if hang_timeout is not None:

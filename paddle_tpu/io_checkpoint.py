@@ -18,15 +18,41 @@ scalar leaves (params, optimizer state, data-position counters). Shards
 are single .npz files carrying a structural JSON manifest — zero pickle
 anywhere (VERDICT-r2 Weak #7: a checkpoint must never be arbitrary code
 execution; ref save_combine_op.cc writes raw tensors the same way).
+
+Crash consistency and integrity (the recovery-correctness half of the
+elastic story — the launcher half is PR 1's supervisor):
+
+- every shard records a per-array CRC32 plus a whole-shard digest in
+  its ``__manifest__`` blob, and the tmp file is fsynced before the
+  atomic publish (an ``os.replace`` of unsynced pages can survive a
+  process kill but not a host crash);
+- ``restore()`` verifies digests on load; a torn/bit-rotted/zero-byte
+  shard raises ``CheckpointCorruptError`` when a ``step=`` was asked
+  for explicitly, and otherwise is **quarantined** (shard and meta
+  renamed ``*.corrupt``, ``corrupt_checkpoints_total`` bumped, a
+  flight-recorder note left) while restore walks back to the newest
+  step that verifies — one bad file must never brick the job;
+- ``latest_step()`` only counts steps whose meta *and* shards are all
+  present (a stray ``ckpt_N.json`` used to brick restore), ``_prune``
+  never deletes the last step verified on read, and stale write temps
+  from a killed writer are swept on manager init;
+- ``save(..., data_state=...)`` carries the input pipeline's resume
+  cursor (``FileDataLoader.state()``) in the shard manifest and the
+  meta JSON, and ``auto_checkpoint(data_state=loader)`` restores it
+  before the loop — a killed-and-resumed run consumes the same record
+  sequence as an uninterrupted one (exactly-once ingest).
 """
 
 import json
 import logging
 import os
 import queue
+import re
 import signal
+import tempfile
 import threading
 import time
+import zlib
 
 import jax
 import numpy as np
@@ -36,7 +62,22 @@ from paddle_tpu.monitor.registry import counter as _counter
 from paddle_tpu.monitor.registry import histogram as _histogram
 from paddle_tpu.static.serialize import tree_from_manifest, tree_manifest
 
-__all__ = ["CheckpointManager", "auto_checkpoint"]
+__all__ = ["CheckpointManager", "CheckpointCorruptError", "auto_checkpoint",
+           "verify_shard"]
+
+_log = logging.getLogger("paddle_tpu.checkpoint")
+
+#: the on-disk filename grammar, in ONE place — testing/faults and
+#: tools/fsck_checkpoint parse the same names _shard_path/_meta_path
+#: write, and a format change must not silently strand them
+SHARD_NAME_RE = re.compile(r"^ckpt_(\d+)\.shard(\d+)\.npz$")
+META_NAME_RE = re.compile(r"^ckpt_(\d+)\.json$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint shard (or its meta) failed integrity verification:
+    unreadable file, CRC mismatch, missing/extra array, or digest
+    drift. The message names the file and the first bad array."""
 
 _m_saves = _counter("checkpoint_saves_total",
                     "Checkpoints made durable (shard written, retries "
@@ -50,6 +91,158 @@ _m_bytes = _counter("checkpoint_bytes_total",
 _m_retries = _counter("checkpoint_retries_total",
                       "Transient-disk-error retries of checkpoint "
                       "shard writes")
+_m_corrupt = _counter("corrupt_checkpoints_total",
+                      "Checkpoint steps quarantined after failing "
+                      "integrity verification (shard/meta renamed "
+                      "*.corrupt, restore fell back)")
+_m_verify_fail = _counter("checkpoint_verify_failures_total",
+                          "Individual shard integrity-verification "
+                          "failures: unreadable file, CRC mismatch, "
+                          "missing array, or digest drift")
+
+
+def _crc32(arr):
+    """CRC32 of an array's canonical (C-contiguous) byte image."""
+    a = np.ascontiguousarray(arr)
+    return zlib.crc32(memoryview(a).cast("B")) & 0xFFFFFFFF
+
+
+def _canon_json(obj):
+    return json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def _integrity_block(body, arrays):
+    """The self-check record embedded in a shard manifest: per-array
+    CRC32s, a whole-shard digest over the sorted (key, crc, nbytes)
+    entries (catches a missing or extra array even when every present
+    one checks out), and a CRC of the rest of the manifest itself
+    (``body`` — the tree structure and data_state aren't covered by
+    the array CRCs)."""
+    entries = {k: {"crc32": _crc32(a), "nbytes": int(a.nbytes)}
+               for k, a in arrays.items()}
+    return {
+        "algo": "crc32",
+        "arrays": entries,
+        "digest": zlib.crc32(_canon_json(entries)) & 0xFFFFFFFF,
+        "manifest_crc32": zlib.crc32(_canon_json(body)) & 0xFFFFFFFF,
+    }
+
+
+def _key_paths(manifest):
+    """npz key -> human tree path (e.g. 'a3' -> '/opt/m/w0'), for
+    naming the first bad array in errors. Best-effort: a malformed
+    tree yields {} rather than masking the real corruption report."""
+    out = {}
+
+    def rec(node, path):
+        if not isinstance(node, dict):
+            return
+        if "__d__" in node:
+            for k, v in node["__d__"].items():
+                rec(v, f"{path}/{k}")
+        elif "__l__" in node or "__t__" in node:
+            for i, v in enumerate(node.get("__l__") or node.get("__t__")):
+                rec(v, f"{path}[{i}]")
+        elif "__array__" in node:
+            out[node["__array__"]] = path or "/"
+
+    try:
+        rec(manifest.get("tree", {}), "")
+    except Exception:
+        return {}
+    return out
+
+
+def _natural_key(k):
+    return (len(k), k)       # a0, a1, ... a10 in numeric order
+
+
+def verify_shard(path, verify=True):
+    """Read one checkpoint shard, verifying its integrity record.
+
+    Returns ``(manifest, {npz key: np.ndarray})``. Raises
+    ``CheckpointCorruptError`` naming ``path`` and the first bad array
+    on any unreadable/torn/bit-rotted content. Shards written before
+    the integrity format (no ``integrity`` block in the manifest) are
+    accepted structurally — old checkpoints stay restorable.
+    ``verify=False`` skips the CRC pass (bench A/B; the structural
+    parse still runs). Shared by ``CheckpointManager.restore`` and
+    ``tools/fsck_checkpoint.py``."""
+
+    def bad(detail):
+        _m_verify_fail.inc()
+        return CheckpointCorruptError(
+            f"checkpoint shard {path}: {detail}")
+
+    try:
+        with np.load(path, allow_pickle=False) as blob:
+            if "__manifest__" not in blob.files:
+                raise bad("no __manifest__ member (not a checkpoint "
+                          "shard, or header torn)")
+            manifest = json.loads(
+                bytes(blob["__manifest__"].tobytes()).decode("utf-8"))
+            arrays = {k: blob[k] for k in blob.files
+                      if k != "__manifest__"}
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:      # zipfile.BadZipFile, OSError, EOFError,
+        # ValueError (torn npy header), UnicodeDecodeError/JSON errors
+        raise bad(f"unreadable ({type(e).__name__}: {e})") from e
+    if not verify:
+        return manifest, arrays
+    integ = manifest.get("integrity")
+    if integ is None:           # pre-integrity format: nothing to check
+        return manifest, arrays
+    paths = _key_paths(manifest)
+
+    def name(key):
+        p = paths.get(key)
+        return f"array {key!r} ({p})" if p else f"array {key!r}"
+
+    expected = integ.get("arrays", {})
+    for key in sorted(expected, key=_natural_key):
+        if key not in arrays:
+            raise bad(f"{name(key)} missing from shard")
+        got = _crc32(arrays[key])
+        want = expected[key]["crc32"]
+        if got != want:
+            raise bad(f"first bad {name(key)}: crc32 {got:#010x} != "
+                      f"recorded {want:#010x}")
+    extra = sorted(set(arrays) - set(expected), key=_natural_key)
+    if extra:
+        raise bad(f"unrecorded array(s) {extra} present in shard")
+    digest = zlib.crc32(_canon_json(expected)) & 0xFFFFFFFF
+    if digest != integ.get("digest"):
+        raise bad(f"shard digest {digest:#010x} != recorded "
+                  f"{integ.get('digest'):#010x}")
+    body = {k: v for k, v in manifest.items() if k != "integrity"}
+    mcrc = zlib.crc32(_canon_json(body)) & 0xFFFFFFFF
+    if mcrc != integ.get("manifest_crc32"):
+        raise bad(f"manifest crc32 {mcrc:#010x} != recorded "
+                  f"{integ.get('manifest_crc32'):#010x} (tree "
+                  f"structure or data_state bit-rotted)")
+    return manifest, arrays
+
+
+def _fsync_file(f):
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(dirname):
+    """Make a just-published rename durable: fsync the directory entry.
+    Best-effort — some filesystems refuse O_RDONLY dir fsync."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _host_tag():
@@ -64,11 +257,19 @@ def _host_tag():
 class CheckpointManager:
     """Step-tagged async checkpoints in ``dirname``.
 
-    save(step, tree)            -> enqueue (device->host copy now, disk
+    save(step, tree, data_state=None)
+                                -> enqueue (device->host copy now, disk
                                    write in background)
     wait()                      -> block until writes are durable
-    latest_step()               -> newest complete step or None
-    restore(step=None)          -> (tree, step)
+    latest_step()               -> newest complete step (meta present
+                                   AND every saved shard present) or
+                                   None — not necessarily verified
+    restore(step=None)          -> (tree, step); step=None verifies and
+                                   falls back past corrupt steps,
+                                   quarantining them; an explicit step
+                                   raises CheckpointCorruptError
+    restore_data_state(step)    -> the data-pipeline cursor saved with
+                                   that step (None if none was saved)
     should_save(step)           -> interval policy check
     """
 
@@ -82,16 +283,25 @@ class CheckpointManager:
 
     def __init__(self, dirname, keep_max=3, save_interval_steps=100,
                  save_interval_secs=None, async_save=True,
-                 disk_retries=None):
+                 disk_retries=None, verify_restore=True):
         self.dirname = dirname
         self.keep_max = keep_max
         if disk_retries is not None:
             self.disk_retries = disk_retries
         self.save_interval_steps = save_interval_steps
         self.save_interval_secs = save_interval_secs
+        #: default for restore(verify=): CRC-check shards on load
+        self.verify_restore = verify_restore
         self._last_save_time = time.monotonic()
         os.makedirs(dirname, exist_ok=True)
         self._proc, self._nproc = _host_tag()
+        #: newest step this manager has verified on READ (a restore
+        #: that checked out) — _prune never deletes it. Writes are not
+        #: "verified": fsync'd+CRC'd at write time, but disk rot after
+        #: the fact is exactly what verification exists to catch.
+        self._last_verified = None
+        self._restored_data_state = None        # (step, state) cache
+        self._sweep_stale_tmps()
         self._q = queue.Queue()
         self._err = None
         self._thread = None
@@ -99,6 +309,23 @@ class CheckpointManager:
             self._thread = threading.Thread(target=self._writer,
                                             daemon=True)
             self._thread.start()
+
+    def _sweep_stale_tmps(self):
+        """Remove write temps a killed previous incarnation left
+        behind. Scoped to THIS host's shard temps (plus meta temps on
+        host 0): another live host's in-flight temp must not be
+        yanked out from under its writer."""
+        tag = f".shard{self._proc}."
+        for f in os.listdir(self.dirname):
+            mine = (f.endswith(".tmp.npz") and tag in f) or \
+                   (self._proc == 0 and f.endswith(".json.tmp"))
+            if not mine:
+                continue
+            try:
+                os.remove(os.path.join(self.dirname, f))
+                _log.info("swept stale checkpoint temp %s", f)
+            except OSError:
+                pass
 
     # -- paths -------------------------------------------------------------
     def _shard_path(self, step, proc=None):
@@ -116,13 +343,16 @@ class CheckpointManager:
         return step % max(self.save_interval_steps, 1) == 0
 
     # -- save --------------------------------------------------------------
-    def save(self, step, tree):
+    def save(self, step, tree, data_state=None):
         """Snapshot now (device→host), write later. Returns immediately
-        when async."""
+        when async. ``data_state`` is an optional JSON-able input-
+        pipeline cursor (``FileDataLoader.state()``) stored in the
+        shard manifest (per-host, CRC-covered) and mirrored into the
+        meta JSON for operator visibility."""
         manifest, arrays = tree_manifest(tree)
         arrays = {k: np.asarray(v) for k, v in arrays.items()}  # d2h copy
         _m_bytes.inc(sum(a.nbytes for a in arrays.values()))
-        payload = (int(step), manifest, arrays)
+        payload = (int(step), manifest, arrays, data_state)
         self._last_save_time = time.monotonic()
         if self._thread is None:
             self._write_durable(payload)
@@ -130,9 +360,9 @@ class CheckpointManager:
             self._raise_pending()
             self._q.put(payload)
 
-    def maybe_save(self, step, tree):
+    def maybe_save(self, step, tree, data_state=None):
         if self.should_save(step):
-            self.save(step, tree)
+            self.save(step, tree, data_state=data_state)
             return True
         return False
 
@@ -160,15 +390,37 @@ class CheckpointManager:
                 delay = min(delay * 2.0, self.retry_backoff_cap)
 
     def _write(self, payload):
-        step, manifest, arrays = payload
+        step, manifest, arrays, data_state = payload
         shard = self._shard_path(step)
-        tmp = shard + ".tmp.npz"
-        manifest = dict(manifest,
-                        proc=self._proc, nproc=self._nproc)
+        body = dict(manifest, proc=self._proc, nproc=self._nproc)
+        if data_state is not None:
+            body["data_state"] = data_state
+        manifest = dict(body,
+                        integrity=_integrity_block(body, arrays))
         mblob = np.frombuffer(
             json.dumps(manifest).encode("utf-8"), dtype=np.uint8)
-        np.savez(tmp, __manifest__=mblob, **arrays)
-        os.replace(tmp, shard)                    # atomic publish
+        # mkstemp (not a fixed name): two incarnations racing on the
+        # same step can't interleave writes into one temp, and a
+        # killed writer's leftover is unambiguous to sweep on init
+        fd, tmp = tempfile.mkstemp(
+            dir=self.dirname, suffix=".tmp.npz",
+            prefix=f".ckpt_{step}.shard{self._proc}.")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, __manifest__=mblob, **arrays)
+                # fsync BEFORE the rename: os.replace orders the
+                # directory entry, not the data pages — unsynced
+                # pages + a host crash can leave the published name
+                # pointing at torn content
+                _fsync_file(f)
+            os.replace(tmp, shard)                # atomic publish
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        _fsync_dir(self.dirname)
         # host 0 publishes the meta marker only after EVERY host's shard
         # is durable (restore trusts only steps whose meta exists, so a
         # preemption mid-save can never yield a half-checkpoint)
@@ -183,10 +435,14 @@ class CheckpointManager:
                 time.sleep(0.05)
             meta = {"step": step, "nproc": self._nproc,
                     "time": time.time()}
+            if data_state is not None:
+                meta["data_state"] = data_state
             mtmp = self._meta_path(step) + ".tmp"
             with open(mtmp, "w") as f:
                 json.dump(meta, f)
+                _fsync_file(f)
             os.replace(mtmp, self._meta_path(step))
+            _fsync_dir(self.dirname)
         self._prune()
 
     def _writer(self):
@@ -216,10 +472,20 @@ class CheckpointManager:
         self._raise_pending()
 
     def _prune(self):
+        """keep_max newest complete steps survive — plus the newest
+        step this manager VERIFIED on read. Quarantined (.corrupt) and
+        incomplete (meta-without-shard) steps never count against
+        keep_max: a quarantine must not silently shrink the budget of
+        restorable history below keep_max good steps."""
         if not self.keep_max:
             return
         steps = self._complete_steps()
-        for s in steps[:-self.keep_max]:
+        keep = set(steps[-self.keep_max:])
+        if self._last_verified is not None:
+            keep.add(self._last_verified)
+        for s in steps:
+            if s in keep:
+                continue
             for p in range(self._nproc):
                 try:
                     os.remove(self._shard_path(s, p))
@@ -231,7 +497,7 @@ class CheckpointManager:
                 pass
 
     # -- restore -----------------------------------------------------------
-    def _complete_steps(self):
+    def _meta_steps(self):
         steps = []
         for f in os.listdir(self.dirname):
             if f.startswith("ckpt_") and f.endswith(".json"):
@@ -241,19 +507,65 @@ class CheckpointManager:
                     pass
         return sorted(steps)
 
+    def _step_complete(self, step):
+        """Meta readable AND every shard it promises present. A stray
+        or torn ckpt_N.json (shards pruned by hand, meta half-written
+        by a dying host) must not be offered for restore."""
+        try:
+            with open(self._meta_path(step)) as f:
+                nproc = int(json.load(f).get("nproc", 1))
+        except (OSError, ValueError, TypeError):
+            return False
+        return all(os.path.exists(self._shard_path(step, p))
+                   for p in range(nproc))
+
+    def _complete_steps(self):
+        return [s for s in self._meta_steps() if self._step_complete(s)]
+
     def latest_step(self):
+        """Newest complete step or None. Complete = meta readable and
+        all its shards on disk; NOT necessarily verified — restore()
+        is where CRCs are checked (and where fallback happens)."""
         steps = self._complete_steps()
         return steps[-1] if steps else None
 
-    def restore(self, step=None):
-        """Returns (tree, step). Under multi-process, each host reads its
-        own shard (the sharding that was saved)."""
+    def _quarantine(self, step, err):
+        """Move a corrupt step out of the restore path, keeping the
+        evidence: shard -> *.corrupt, meta -> *.corrupt. Counted in
+        corrupt_checkpoints_total and noted in the flight recorder."""
+        _m_corrupt.inc()
+        _log.warning("checkpoint step %s quarantined: %s (renaming "
+                     "shard/meta to *.corrupt)", step, err)
+        renamed = []
+        for path in (self._shard_path(step), self._meta_path(step)):
+            try:
+                os.replace(path, path + ".corrupt")
+                renamed.append(os.path.basename(path) + ".corrupt")
+            except OSError:
+                pass
+        try:
+            from paddle_tpu.monitor import flight_recorder
+            flight_recorder.note("checkpoint", "quarantined", step=step,
+                                 error=str(err), renamed=renamed)
+        except Exception:
+            pass
+
+    def _load_step(self, step, verify):
+        """(tree, manifest) for one step, CRC-verified. Raises
+        CheckpointCorruptError on unreadable meta/shard."""
         import jax.numpy as jnp
-        if step is None:
-            step = self.latest_step()
-        enforce(step is not None, f"no checkpoint in {self.dirname}")
-        with open(self._meta_path(step)) as f:
-            saved_nproc = json.load(f).get("nproc", 1)
+        meta_path = self._meta_path(step)
+        try:
+            with open(meta_path) as f:
+                saved_nproc = json.load(f).get("nproc", 1)
+        except FileNotFoundError:
+            enforce(False, f"no checkpoint meta for step {step} in "
+                           f"{self.dirname}")
+        except (OSError, ValueError) as e:
+            _m_verify_fail.inc()
+            raise CheckpointCorruptError(
+                f"checkpoint meta {meta_path} unreadable "
+                f"({type(e).__name__}: {e})") from e
         path = self._shard_path(step)
         if not os.path.exists(path):
             enforce(saved_nproc == 1,
@@ -264,13 +576,83 @@ class CheckpointManager:
             # replicated (single-host) checkpoint restored on a larger
             # topology: every host reads the one shard
             path = self._shard_path(step, 0)
-        with np.load(path, allow_pickle=False) as blob:
-            manifest = json.loads(
-                bytes(blob["__manifest__"].tobytes()).decode("utf-8"))
-            arrays = {k: jnp.asarray(blob[k]) for k in blob.files
-                      if k != "__manifest__"}
-        tree = tree_from_manifest(manifest, arrays)
-        return tree, step
+        manifest, arrays = verify_shard(path, verify=verify)
+        tree = tree_from_manifest(
+            manifest, {k: jnp.asarray(v) for k, v in arrays.items()})
+        return tree, manifest
+
+    def restore(self, step=None, verify=None):
+        """Returns (tree, step). Under multi-process, each host reads
+        its own shard (the sharding that was saved).
+
+        With ``step=None`` the newest *verifying* step is restored:
+        corrupt/torn steps are quarantined (shard+meta renamed
+        ``*.corrupt``) and the walk continues backwards — the
+        last-good fallback. An explicit ``step=`` that fails
+        verification raises ``CheckpointCorruptError`` naming the file
+        and first bad array. ``verify=False`` skips CRC checks
+        (default: the manager's ``verify_restore``)."""
+        if verify is None:
+            verify = self.verify_restore
+        if step is not None:
+            tree, manifest = self._load_step(step, verify)
+            if verify:
+                self._last_verified = step
+            self._restored_data_state = (step,
+                                         manifest.get("data_state"))
+            return tree, step
+        steps = self._complete_steps()
+        enforce(steps, f"no checkpoint in {self.dirname}")
+        newest = steps[-1]
+        quarantined = 0
+        for s in reversed(steps):
+            try:
+                tree, manifest = self._load_step(s, verify)
+            except CheckpointCorruptError as e:
+                self._quarantine(s, e)
+                quarantined += 1
+                continue
+            if verify:
+                self._last_verified = s
+            self._restored_data_state = (s, manifest.get("data_state"))
+            if s != newest:
+                # the restart-from-fallback line (docs/DEBUGGING.md's
+                # exit-code/recovery table points at it)
+                _log.warning(
+                    "restored from last-good checkpoint step %s after "
+                    "quarantining %d corrupt newer step(s)",
+                    s, quarantined)
+            return tree, s
+        raise CheckpointCorruptError(
+            f"every checkpoint step in {self.dirname} failed "
+            f"verification ({quarantined} quarantined); nothing left "
+            f"to restore")
+
+    def restore_data_state(self, step):
+        """The data-pipeline cursor saved with ``step`` (this host's
+        shard manifest), or None when the step predates data_state /
+        none was saved. Cached from the restore() that just loaded the
+        step, so the common path rereads nothing."""
+        cached = self._restored_data_state
+        if cached is not None and cached[0] == step:
+            return cached[1]
+        # cold path (restore() didn't just load this step): same shard
+        # resolution as _load_step — shard0 substitutes only for a
+        # replicated single-host save (another host's cursor would be
+        # the wrong host's position)
+        path = self._shard_path(step)
+        if not os.path.exists(path):
+            try:
+                with open(self._meta_path(step)) as f:
+                    saved_nproc = json.load(f).get("nproc", 1)
+            except (OSError, ValueError):
+                saved_nproc = None
+            enforce(saved_nproc == 1,
+                    f"checkpoint step {step}: no shard for host "
+                    f"{self._proc} to read data_state from")
+            path = self._shard_path(step, 0)
+        manifest, _ = verify_shard(path, verify=self.verify_restore)
+        return manifest.get("data_state")
 
     def close(self):
         if self._thread is not None:
@@ -281,10 +663,20 @@ class CheckpointManager:
 
 
 def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
-                    save_interval_steps=100, keep_max=3):
+                    save_interval_steps=100, keep_max=3,
+                    data_state=None):
     """Run ``state = step_fn(step, state)`` for steps [resume..total),
-    checkpointing every interval and resuming from the newest complete
-    checkpoint if one exists. Returns the final state.
+    checkpointing every interval and resuming from the newest
+    *verified* checkpoint if one exists (corrupt newer steps are
+    quarantined and walked past — see ``CheckpointManager.restore``).
+    Returns the final state.
+
+    ``data_state``: an object with ``state()``/``set_state(s)``
+    (``FileDataLoader(stateful=True)`` qualifies). Its cursor is saved
+    with every checkpoint and restored *before* the loop, so a
+    killed-and-resumed run consumes exactly the record sequence an
+    uninterrupted run would — create the loader's iterator inside
+    ``step_fn`` (first use), after the restore has applied the state.
 
     The elastic-recovery loop the reference lacks (SURVEY §5.3): kill the
     process at any point and re-invoking continues from the last saved
@@ -319,10 +711,29 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
         prev = signal.signal(signal.SIGTERM,
                              lambda s, f: preempted.set())
         restore_handler = lambda: signal.signal(signal.SIGTERM, prev)
+    def _ds():
+        return data_state.state() if data_state is not None else None
+
     try:
-        latest = mgr.latest_step()
-        if latest is not None:
-            state, start = mgr.restore(latest)
+        restored = False
+        if mgr.latest_step() is not None:
+            try:
+                # walk-back restore: a corrupt newest step is
+                # quarantined and the previous verified one loads
+                state, start = mgr.restore()
+                restored = True
+            except CheckpointCorruptError as e:
+                # EVERY step failed verification. Starting over is the
+                # only move left — and strictly better than the
+                # supervisor burning its restart budget re-crashing
+                # into the same bad file
+                _log.error("all checkpoints in %s corrupt (%s); "
+                           "starting from scratch", dirname, e)
+        if restored:
+            if data_state is not None:
+                ds = mgr.restore_data_state(start)
+                if ds is not None:
+                    data_state.set_state(ds)
             start += 1
         else:
             state, start = init_state_fn(), 0
@@ -330,7 +741,7 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
             state = step_fn(step, state)
             if hb is not None:
                 hb.beat()
-            saved = mgr.maybe_save(step, state)
+            saved = mgr.maybe_save(step, state, data_state=_ds())
             if preempted.is_set():
                 # flush inside the launcher's grace window: save the
                 # completed step (unless the interval policy just did —
@@ -338,7 +749,7 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
                 # grace budget), drain the async writer (meta published
                 # = checkpoint complete), then report SIGTERM death
                 if not saved:
-                    mgr.save(step, state)
+                    mgr.save(step, state, data_state=_ds())
                 mgr.wait()
                 # this handler shadows the flight recorder's SIGTERM
                 # hook while the loop runs, so dump explicitly: a
@@ -347,7 +758,7 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
                 if flight_recorder.is_enabled():
                     flight_recorder.dump(reason="preempted")
                 raise SystemExit(143)
-        mgr.save(total_steps - 1, state)
+        mgr.save(total_steps - 1, state, data_state=_ds())
         return state
     finally:
         if restore_handler is not None:

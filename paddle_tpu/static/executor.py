@@ -236,6 +236,17 @@ def background_prefetch(producer, transform, depth=2):
         except BaseException as e:       # surface in consumer
             put(_PrefetchFailure(e), count=False)
             return
+        finally:
+            # close the producer HERE, deterministically: a generator
+            # holding file handles (dataio's record readers) would
+            # otherwise keep them until GC when the consumer abandons
+            # the pipeline early
+            close = getattr(producer, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:
+                    pass
         put(SENTINEL, count=False)
 
     t = threading.Thread(target=worker, daemon=True,

@@ -16,6 +16,16 @@ boundary for free:
 - ``PT_FAULT_NAN_AT_STEP=N``    — ``poison_feed(step, feed)`` writes a
   NaN into the first float array of the feed at step N: a numerics
   blow-up, for the FLAGS_check_nan_inf sentinel/localizer tests.
+- ``PT_FAULT_TORN_CKPT=N``      — at step N, truncate the newest
+  published checkpoint shard to half its size (a torn write / torn
+  replication) and hard-exit with code 29: the restarted rank must
+  quarantine it and fall back to the previous verified step.
+- ``PT_FAULT_BITFLIP_CKPT=N``   — at step N, flip one byte in the
+  middle of the newest shard's last array member (bit rot the zip
+  layer can't mask) and hard-exit 29. The checkpoint dir comes from
+  ``maybe_fault(step, ckpt_dir=...)`` or ``PT_FAULT_CKPT_DIR``; if no
+  shard has been published yet the fault stays armed for a later step
+  (the once-marker is only claimed when a shard actually got hit).
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -24,8 +34,10 @@ boundary for free:
   crash-at-step fault would re-kill every restart and the job could
   never finish.
 
-Exit code 23 is deliberately distinct from the launcher's own codes
-(124 timeout, 143 preemption) so tests can assert who died and why.
+Exit codes 23 (plain crash) and 29 (checkpoint corruption + crash) are
+deliberately distinct from each other and from the launcher's own codes
+(124 timeout, 143 preemption) and the numerics trip (17) so tests can
+assert who died and why.
 """
 
 import os
@@ -33,9 +45,11 @@ import sys
 import time
 
 __all__ = ["maybe_fault", "poison_feed", "install_slow_write",
-           "CRASH_EXIT_CODE"]
+           "corrupt_checkpoint", "corrupt_newest_checkpoint",
+           "CRASH_EXIT_CODE", "CKPT_FAULT_EXIT_CODE"]
 
 CRASH_EXIT_CODE = 23
+CKPT_FAULT_EXIT_CODE = 29
 
 
 def _int_env(name):
@@ -68,11 +82,113 @@ def _fire_once(tag):
     return True
 
 
-def maybe_fault(step):
+def corrupt_checkpoint(path, mode):
+    """Deterministically damage one shard file. ``torn`` truncates to
+    half (a torn write); ``bitflip`` flips one byte in the middle of
+    the LAST zip member's data region — guaranteed inside array/npy
+    payload, never in ignorable zip metadata, so verification MUST
+    trip. Reading the zip layout to aim the flip is fine: this is a
+    test tool, not a model of where cosmic rays land."""
+    if mode == "torn":
+        os.truncate(path, max(os.path.getsize(path) // 2, 1))
+        return
+    if mode != "bitflip":
+        raise ValueError(f"mode must be 'torn' or 'bitflip', got {mode!r}")
+    import struct
+    import zipfile
+    with zipfile.ZipFile(path) as zf:
+        info = max(zf.infolist(), key=lambda i: i.header_offset)
+    with open(path, "r+b") as f:
+        # the LOCAL header's name/extra lengths (offsets 26/28) — the
+        # central directory's can differ, and np.savez pads npy
+        # members through the local extra field
+        f.seek(info.header_offset + 26)
+        name_len, extra_len = struct.unpack("<HH", f.read(4))
+        target = (info.header_offset + 30 + name_len + extra_len
+                  + max(info.compress_size // 2, 0))
+        f.seek(target)
+        b = f.read(1)
+        f.seek(target)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _newest_shard(ckpt_dir):
+    # the writer's own filename grammar, not a re-guessed copy (a
+    # format change must break loudly here, not no-op the fault);
+    # lazy import: this module stays importable without jax on path
+    from paddle_tpu.io_checkpoint import SHARD_NAME_RE
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return None
+    best, best_step = None, -1
+    for f in names:
+        m = SHARD_NAME_RE.match(f)
+        if m and int(m.group(1)) > best_step:
+            best_step, best = int(m.group(1)), f
+    return os.path.join(ckpt_dir, best) if best else None
+
+
+def _already_fired(tag):
+    """Marker peek WITHOUT claiming (unlike _fire_once): restarted
+    incarnations must decide to run clean before doing any damage."""
+    d = os.environ.get("PT_FAULT_ONCE_DIR")
+    if not d:
+        return False
+    return os.path.exists(os.path.join(d, f"{tag}.fired"))
+
+
+def corrupt_newest_checkpoint(ckpt_dir, mode):
+    """Damage the newest published ``ckpt_<step>.shard*.npz`` under
+    ``ckpt_dir``. Returns the path, or None when no shard exists yet
+    (nothing to corrupt — the caller's fault stays armed)."""
+    path = _newest_shard(ckpt_dir)
+    if path is None:
+        return None
+    try:
+        corrupt_checkpoint(path, mode)
+    except FileNotFoundError:
+        return None         # pruned between listdir and open
+    return path
+
+
+def _maybe_ckpt_fault(step, ckpt_dir):
+    for env_name, mode in (("PT_FAULT_TORN_CKPT", "torn"),
+                           ("PT_FAULT_BITFLIP_CKPT", "bitflip")):
+        at = _int_env(env_name)
+        if at is None or step < at:
+            continue
+        tag = f"{mode}_ckpt"
+        if _already_fired(tag):
+            continue        # restarted incarnation runs clean
+        d = ckpt_dir or os.environ.get("PT_FAULT_CKPT_DIR")
+        if not d:
+            continue
+        # probe BEFORE claiming the once-marker: no shard published yet
+        # means the fault stays armed for a later step (>= above) —
+        # mirroring poison_feed's claim-on-injection rule
+        if _newest_shard(d) is None:
+            continue
+        if not _fire_once(tag):
+            return
+        path = corrupt_newest_checkpoint(d, mode)
+        if path is None:
+            return          # shard vanished under us (prune race)
+        sys.stderr.write(f"[faults] {mode}-corrupted {path} at step "
+                         f"{step}; exiting {CKPT_FAULT_EXIT_CODE}\n")
+        sys.stderr.flush()
+        os._exit(CKPT_FAULT_EXIT_CODE)
+
+
+def maybe_fault(step, ckpt_dir=None):
     """Call from the training-loop body; injects whatever fault the
-    environment configures for this rank at this step."""
+    environment configures for this rank at this step. ``ckpt_dir``
+    (this rank's checkpoint directory) is only needed for the
+    checkpoint-corruption faults; PT_FAULT_CKPT_DIR is the env
+    fallback."""
     if not _applies_to_rank():
         return
+    _maybe_ckpt_fault(step, ckpt_dir)
     crash_at = _int_env("PT_FAULT_CRASH_AT_STEP")
     if crash_at is not None and step == crash_at and _fire_once("crash"):
         sys.stderr.write(f"[faults] injected crash at step {step}\n")

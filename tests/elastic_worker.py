@@ -9,15 +9,27 @@ the checkpoint-resume guarantee the tests assert.
 Runs under ``paddle_tpu.distributed.launch`` via ``auto_checkpoint``
 (heartbeating and SIGTERM flush come for free) with
 ``paddle_tpu.testing.faults`` injecting the failure the test selected
-through the environment.
+through the environment — including the checkpoint-corruption faults
+(PT_FAULT_TORN_CKPT / PT_FAULT_BITFLIP_CKPT), which get this rank's
+checkpoint dir via ``maybe_fault(step, ckpt_dir=...)``.
 
 argv: out_prefix ckpt_root total_steps [step_secs] [save_interval]
+      [data_dir]
+
+With ``data_dir`` set, each step consumes one batch from a
+``FileDataLoader(stateful=True)`` over the dir's ``*.txt`` files wired
+into ``auto_checkpoint(data_state=...)``, and the per-step batch sums
+are recorded in ``<out_prefix>.rank<id>.batches.json`` (merged across
+incarnations, keyed by step — a re-executed step overwrites its slot).
+Comparing that map between a faulted and a clean run proves the resumed
+run consumed the same record sequence (exactly-once ingest).
 
 Each rank checkpoints under <ckpt_root>/rank<id> (ranks are independent:
 these tests exercise the supervisor, not collectives) and reports to
 <out_prefix>.rank<id>.json.
 """
 
+import glob
 import json
 import os
 import sys
@@ -29,13 +41,32 @@ def main():
     total_steps = int(sys.argv[3])
     step_secs = float(sys.argv[4]) if len(sys.argv) > 4 else 0.05
     save_interval = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+    data_dir = sys.argv[6] if len(sys.argv) > 6 else None
     rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    ckpt_dir = os.path.join(ckpt_root, f"rank{rank}")
 
     from paddle_tpu.io_checkpoint import auto_checkpoint
     from paddle_tpu.testing import faults
     faults.install_slow_write()
 
+    loader = None
+    batches_path = f"{out_prefix}.rank{rank}.batches.json"
+    batch_log = {}
+    if data_dir:
+        import numpy as np
+
+        from paddle_tpu.dataio.dataloader import FileDataLoader
+        if os.path.exists(batches_path):
+            with open(batches_path) as f:
+                batch_log = json.load(f)
+        loader = FileDataLoader(
+            sorted(glob.glob(os.path.join(data_dir, "*.txt"))),
+            lambda rec: np.float32(rec), batch_size=4,
+            shuffle_buffer=32, seed=5, epochs=-1, device_put=False,
+            stateful=True)
+
     first_step = []
+    box = {}
 
     def init_state():
         return {"w": 0.0}
@@ -43,13 +74,25 @@ def main():
     def step_fn(step, state):
         if not first_step:
             first_step.append(step)
-        faults.maybe_fault(step)
+        faults.maybe_fault(step, ckpt_dir=ckpt_dir)
+        if loader is not None:
+            if "it" not in box:
+                box["it"] = iter(loader)    # AFTER data-state restore
+            b = next(box["it"])
+            batch_log[str(step)] = [float(v) for v in b]
+            # flush EVERY step: an os._exit fault skips finally blocks,
+            # and the steps only this incarnation executed must still
+            # be comparable against the clean run
+            tmp = batches_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(batch_log, f)
+            os.replace(tmp, batches_path)
         time.sleep(step_secs)
         return {"w": state["w"] + 0.5 * (10.0 - state["w"])}
 
-    final = auto_checkpoint(os.path.join(ckpt_root, f"rank{rank}"),
-                            init_state, total_steps, step_fn,
-                            save_interval_steps=save_interval)
+    final = auto_checkpoint(ckpt_dir, init_state, total_steps,
+                            step_fn, save_interval_steps=save_interval,
+                            data_state=loader)
     with open(f"{out_prefix}.rank{rank}.json", "w") as f:
         json.dump({
             "w": float(final["w"]),

@@ -69,3 +69,21 @@ class TestBenchModes:
         assert row["check_off_ms_per_step"] > 0
         assert len(row["pair_ratios"]) == 2
         assert all(r > 0 for r in row["pair_ratios"])
+
+    def test_ckpt_mode_emits_save_restore_and_verify_ratio(self):
+        """`bench.py ckpt` must time save/restore on a real
+        CheckpointManager and A/B digest verification on interleaved
+        restore windows (small payload: CLI/shape smoke; the real
+        overhead number runs with the default 64 MB)."""
+        lines = _run_mode("ckpt", extra_env={"BENCH_CKPT_MB": "4",
+                                             "BENCH_CKPT_PAIRS": "2"})
+        by = {ln["metric"]: ln for ln in lines}
+        save = by["ckpt_save_ms"]
+        assert save["value"] > 0 and save["save_mb_per_sec"] > 0
+        assert save["payload_mb"] > 3
+        restore = by["ckpt_restore_ms"]
+        assert restore["verify_on_ms"] > 0
+        assert restore["verify_off_ms"] > 0
+        ratio = by["ckpt_verify_overhead_ratio"]
+        assert ratio["unit"] == "x" and ratio["value"] > 0
+        assert len(ratio["pair_ratios"]) == 2

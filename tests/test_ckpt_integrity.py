@@ -1,0 +1,559 @@
+"""Crash-consistent checkpoints: integrity verification, quarantine +
+last-good fallback, checkpoint-dir pathologies, the offline fsck tool,
+the corruption fault modes, and the slow end-to-end acceptance run
+(bitflip the newest checkpoint, kill the rank, assert the gang restarts
+from the previous verified step with the same record sequence)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io_checkpoint import (
+    CheckpointCorruptError, CheckpointManager, auto_checkpoint,
+    verify_shard,
+)
+from paddle_tpu.monitor.registry import REGISTRY
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+SUBPROC_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+
+def _state(v):
+    return {"w": np.full((4,), float(v)), "opt": [np.ones(3), float(v)]}
+
+
+def _mgr(path, **kw):
+    kw.setdefault("async_save", False)
+    kw.setdefault("save_interval_steps", 1)
+    return CheckpointManager(str(path), **kw)
+
+
+def _shard(path, step, proc=0):
+    return os.path.join(str(path), f"ckpt_{step}.shard{proc}.npz")
+
+
+def _meta(path, step):
+    return os.path.join(str(path), f"ckpt_{step}.json")
+
+
+def _tamper_array(path, key, manifest_too=False):
+    """Rewrite a shard with one array's data changed but the recorded
+    CRCs untouched — bit rot the zip layer cannot see (zip CRCs are
+    rewritten consistent), only the manifest's recorded digests can."""
+    with np.load(path, allow_pickle=False) as blob:
+        arrays = {k: blob[k].copy() for k in blob.files
+                  if k != "__manifest__"}
+        mblob = blob["__manifest__"].copy()
+    arrays[key] = arrays[key] + 1
+    if manifest_too:
+        m = json.loads(bytes(mblob.tobytes()).decode())
+        m["data_state"] = {"rotted": True}
+        mblob = np.frombuffer(json.dumps(m).encode(), dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=mblob, **arrays)
+
+
+class TestVerifyShard:
+    def test_roundtrip_records_and_passes_integrity(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        manifest, arrays = verify_shard(_shard(tmp_path, 1))
+        integ = manifest["integrity"]
+        assert integ["algo"] == "crc32"
+        assert set(integ["arrays"]) == set(arrays)
+        mgr.close()
+
+    def test_zip_level_bitflip_detected(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        faults.corrupt_checkpoint(_shard(tmp_path, 1), "bitflip")
+        with pytest.raises(CheckpointCorruptError) as ei:
+            verify_shard(_shard(tmp_path, 1))
+        assert "ckpt_1.shard0.npz" in str(ei.value)
+
+    def test_torn_shard_detected(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        faults.corrupt_checkpoint(_shard(tmp_path, 1), "torn")
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(_shard(tmp_path, 1))
+
+    def test_recorded_crc_mismatch_names_first_bad_array(self, tmp_path):
+        """Zip-consistent rot: the manifest's recorded CRC is the only
+        witness, and the error names the file, the npz key, AND the
+        tree path of the first bad array."""
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        _tamper_array(_shard(tmp_path, 1), "a0")
+        with pytest.raises(CheckpointCorruptError) as ei:
+            verify_shard(_shard(tmp_path, 1))
+        msg = str(ei.value)
+        assert "ckpt_1.shard0.npz" in msg
+        assert "'a0'" in msg and "/w" in msg and "crc32" in msg
+
+    def test_manifest_rot_detected(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1), data_state={"epoch": 0})
+        mgr.close()
+        path = _shard(tmp_path, 1)
+        with np.load(path, allow_pickle=False) as blob:
+            arrays = {k: blob[k].copy() for k in blob.files
+                      if k != "__manifest__"}
+            m = json.loads(bytes(blob["__manifest__"].tobytes()).decode())
+        m["data_state"] = {"epoch": 999}        # rot the resume cursor
+        mblob = np.frombuffer(json.dumps(m).encode(), dtype=np.uint8)
+        with open(path, "wb") as f:
+            np.savez(f, __manifest__=mblob, **arrays)
+        with pytest.raises(CheckpointCorruptError) as ei:
+            verify_shard(path)
+        assert "manifest" in str(ei.value)
+
+    def test_verify_false_skips_crc(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        _tamper_array(_shard(tmp_path, 1), "a0")
+        manifest, arrays = verify_shard(_shard(tmp_path, 1),
+                                        verify=False)
+        assert "a0" in arrays
+
+    def test_legacy_shard_without_integrity_accepted(self, tmp_path):
+        """Pre-integrity checkpoints (no integrity block) must stay
+        restorable."""
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        path = _shard(tmp_path, 1)
+        with np.load(path, allow_pickle=False) as blob:
+            arrays = {k: blob[k].copy() for k in blob.files
+                      if k != "__manifest__"}
+            m = json.loads(bytes(blob["__manifest__"].tobytes()).decode())
+        del m["integrity"]
+        mblob = np.frombuffer(json.dumps(m).encode(), dtype=np.uint8)
+        with open(path, "wb") as f:
+            np.savez(f, __manifest__=mblob, **arrays)
+        tree, step = _mgr(tmp_path).restore()
+        assert step == 1 and float(tree["w"][0]) == 1.0
+
+
+class TestLastGoodFallback:
+    def _saved(self, tmp_path, steps=(1, 2, 3)):
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in steps:
+            mgr.save(s, _state(s), data_state={"records_consumed": s})
+        mgr.close()
+
+    def test_corrupt_newest_falls_back_and_quarantines(self, tmp_path):
+        self._saved(tmp_path)
+        faults.corrupt_checkpoint(_shard(tmp_path, 3), "bitflip")
+        before = REGISTRY.get("corrupt_checkpoints_total").value()
+        mgr = _mgr(tmp_path)
+        tree, step = mgr.restore()
+        assert step == 2 and float(tree["w"][0]) == 2.0
+        assert REGISTRY.get("corrupt_checkpoints_total").value() \
+            == before + 1
+        assert os.path.exists(_shard(tmp_path, 3) + ".corrupt")
+        assert os.path.exists(_meta(tmp_path, 3) + ".corrupt")
+        assert not os.path.exists(_shard(tmp_path, 3))
+        # the quarantined step is gone from the restore path for good
+        assert mgr.latest_step() == 2
+        # and the fallback's data cursor is served, not the corrupt one
+        assert mgr.restore_data_state(step) == {"records_consumed": 2}
+        mgr.close()
+
+    def test_two_corrupt_steps_walks_back_twice(self, tmp_path):
+        self._saved(tmp_path)
+        faults.corrupt_checkpoint(_shard(tmp_path, 3), "torn")
+        faults.corrupt_checkpoint(_shard(tmp_path, 2), "bitflip")
+        mgr = _mgr(tmp_path)
+        tree, step = mgr.restore()
+        assert step == 1
+        mgr.close()
+
+    def test_zero_byte_shard_falls_back(self, tmp_path):
+        self._saved(tmp_path)
+        open(_shard(tmp_path, 3), "w").close()
+        mgr = _mgr(tmp_path)
+        tree, step = mgr.restore()
+        assert step == 2
+        mgr.close()
+
+    def test_explicit_step_raises_not_quarantines(self, tmp_path):
+        self._saved(tmp_path)
+        faults.corrupt_checkpoint(_shard(tmp_path, 3), "torn")
+        mgr = _mgr(tmp_path)
+        with pytest.raises(CheckpointCorruptError) as ei:
+            mgr.restore(step=3)
+        assert "ckpt_3.shard0.npz" in str(ei.value)
+        # explicit-step failure leaves the evidence in place untouched
+        assert os.path.exists(_shard(tmp_path, 3))
+        mgr.close()
+
+    def test_all_corrupt_raises_checkpoint_corrupt(self, tmp_path):
+        self._saved(tmp_path, steps=(1, 2))
+        faults.corrupt_checkpoint(_shard(tmp_path, 1), "torn")
+        faults.corrupt_checkpoint(_shard(tmp_path, 2), "torn")
+        with pytest.raises(CheckpointCorruptError):
+            _mgr(tmp_path).restore()
+
+    def test_auto_checkpoint_restarts_from_scratch_when_all_corrupt(
+            self, tmp_path):
+        """The bricked-job scenario from the issue: every checkpoint
+        rotted. auto_checkpoint must start over, not crash-loop."""
+        self._saved(tmp_path, steps=(1, 2))
+        faults.corrupt_checkpoint(_shard(tmp_path, 1), "torn")
+        faults.corrupt_checkpoint(_shard(tmp_path, 2), "torn")
+        seen = []
+        out = auto_checkpoint(
+            str(tmp_path), lambda: {"w": 0.0}, 4,
+            lambda s, st: (seen.append(s), {"w": st["w"] + 1.0})[1],
+            save_interval_steps=100)
+        assert seen[0] == 0 and float(out["w"]) == 4.0
+
+
+class TestDirPathologies:
+    def test_meta_without_shard_ignored(self, tmp_path):
+        mgr = _mgr(tmp_path, keep_max=10)
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+        os.remove(_shard(tmp_path, 2))
+        assert mgr.latest_step() == 1       # stray meta doesn't brick
+        tree, step = mgr.restore()
+        assert step == 1
+        mgr.close()
+
+    def test_stray_meta_alone_means_no_checkpoint(self, tmp_path):
+        with open(_meta(tmp_path, 5), "w") as f:
+            json.dump({"step": 5, "nproc": 1}, f)
+        mgr = _mgr(tmp_path)
+        assert mgr.latest_step() is None
+        mgr.close()
+
+    def test_torn_meta_json_ignored(self, tmp_path):
+        mgr = _mgr(tmp_path, keep_max=10)
+        mgr.save(1, _state(1))
+        with open(_meta(tmp_path, 2), "w") as f:
+            f.write('{"step": 2, "npro')      # killed mid-write
+        assert mgr.latest_step() == 1
+        mgr.close()
+
+    def test_stale_tmps_swept_on_init(self, tmp_path):
+        for f in (".ckpt_5.shard0.abc123.tmp.npz",
+                  "ckpt_5.shard0.npz.tmp.npz",       # pre-mkstemp name
+                  "ckpt_5.json.tmp"):
+            open(os.path.join(str(tmp_path), f), "w").close()
+        mgr = _mgr(tmp_path)
+        left = [f for f in os.listdir(str(tmp_path))
+                if ".tmp" in f]
+        assert left == []
+        mgr.close()
+
+    def test_sweep_leaves_other_hosts_tmps(self, tmp_path):
+        other = os.path.join(str(tmp_path),
+                             ".ckpt_5.shard1.xyz.tmp.npz")
+        open(other, "w").close()
+        mgr = _mgr(tmp_path)            # this host is shard0
+        assert os.path.exists(other)
+        mgr.close()
+
+    def test_quarantined_step_excluded_from_keep_max(self, tmp_path):
+        """A quarantined step must not eat a keep_max slot: after the
+        quarantine, keep_max GOOD steps survive pruning."""
+        mgr = _mgr(tmp_path, keep_max=2)
+        for s in (1, 2, 3):
+            mgr.save(s, _state(s))
+        faults.corrupt_checkpoint(_shard(tmp_path, 3), "bitflip")
+        tree, step = mgr.restore()          # quarantines 3
+        assert step == 2
+        mgr.save(4, _state(4))              # complete: {1, 2, 4}
+        steps = mgr._complete_steps()
+        assert steps == [2, 4], steps       # 2 kept, .corrupt not counted
+        assert os.path.exists(_shard(tmp_path, 3) + ".corrupt")
+        mgr.close()
+
+    def test_prune_keeps_last_verified_step(self, tmp_path):
+        m1 = _mgr(tmp_path, keep_max=3)
+        for s in (1, 2, 3):
+            m1.save(s, _state(s))
+        m1.close()
+        m2 = _mgr(tmp_path, keep_max=1)
+        tree, step = m2.restore()           # verifies 3 on read
+        assert step == 3
+        m2.save(10, _state(10))
+        m2.save(11, _state(11))
+        steps = m2._complete_steps()
+        # keep_max=1 would leave only 11 — but 3 is the newest step
+        # PROVEN restorable, and pruning it would bet the job on an
+        # unverified write
+        assert steps == [3, 11], steps
+        m2.close()
+
+
+class TestDataStatePlumbing:
+    def test_data_state_in_shard_and_meta(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        ds = {"epoch": 2, "records_consumed": 640}
+        mgr.save(7, _state(7), data_state=ds)
+        assert mgr.restore_data_state(7) == ds
+        with open(_meta(tmp_path, 7)) as f:
+            assert json.load(f)["data_state"] == ds
+        mgr.close()
+        # a fresh manager (restarted process) reads it too
+        m2 = _mgr(tmp_path)
+        tree, step = m2.restore()
+        assert m2.restore_data_state(step) == ds
+        m2.close()
+
+    def test_no_data_state_returns_none(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        assert mgr.restore_data_state(1) is None
+        with open(_meta(tmp_path, 1)) as f:
+            assert "data_state" not in json.load(f)
+        mgr.close()
+
+
+class TestFsckTool:
+    def _populated(self, tmp_path):
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2, 3, 4):
+            mgr.save(s, _state(s))
+        mgr.close()
+        faults.corrupt_checkpoint(_shard(tmp_path, 3), "bitflip")
+        os.remove(_shard(tmp_path, 4))              # incomplete
+        open(os.path.join(str(tmp_path),
+                          ".ckpt_9.shard0.x.tmp.npz"), "w").close()
+
+    def test_fsck_dir_statuses(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fsck_checkpoint
+        self._populated(tmp_path)
+        steps, extras = fsck_checkpoint.fsck_dir(str(tmp_path))
+        by = {r["step"]: r["status"] for r in steps}
+        assert by == {1: "ok", 2: "ok", 3: "corrupt", 4: "incomplete"}
+        assert extras["tmp"] == [".ckpt_9.shard0.x.tmp.npz"]
+        corrupt = next(r for r in steps if r["step"] == 3)
+        assert "ckpt_3.shard0.npz" in corrupt["detail"]
+
+    def test_cli_reports_and_exit_codes(self, tmp_path):
+        self._populated(tmp_path)
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "fsck_checkpoint.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, env=dict(os.environ,
+                                                     **SUBPROC_ENV))
+        assert r.returncode == 1, r.stderr
+        assert "step 3: corrupt" in r.stdout
+        assert "step 4: incomplete" in r.stdout
+        assert "newest restorable: 2" in r.stdout
+
+    def test_cli_clean_dir_exits_zero(self, tmp_path):
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "fsck_checkpoint.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, env=dict(os.environ,
+                                                     **SUBPROC_ENV))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "step 1: ok" in r.stdout
+
+    def test_cli_quarantine_flag(self, tmp_path):
+        self._populated(tmp_path)
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "fsck_checkpoint.py"),
+             str(tmp_path), "--quarantine"],
+            capture_output=True, text=True, env=dict(os.environ,
+                                                     **SUBPROC_ENV))
+        assert r.returncode == 1
+        assert os.path.exists(_shard(tmp_path, 3) + ".corrupt")
+        # quarantined steps no longer offered: a fresh manager restores
+        # the newest good step with zero walk-back
+        mgr = _mgr(tmp_path)
+        tree, step = mgr.restore()
+        assert step == 2
+        mgr.close()
+
+
+class TestCkptFaultModes:
+    def test_corrupt_newest_picks_highest_step(self, tmp_path):
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (3, 12):
+            mgr.save(s, _state(s))
+        mgr.close()
+        path = faults.corrupt_newest_checkpoint(str(tmp_path),
+                                                "bitflip")
+        assert path.endswith("ckpt_12.shard0.npz")
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(path)
+        manifest, _ = verify_shard(_shard(tmp_path, 3))  # untouched
+
+    def test_corrupt_newest_empty_dir_returns_none(self, tmp_path):
+        assert faults.corrupt_newest_checkpoint(str(tmp_path),
+                                                "torn") is None
+
+    def test_maybe_fault_bitflip_corrupts_and_exits_29(
+            self, tmp_path, monkeypatch):
+        mgr = _mgr(tmp_path)
+        mgr.save(2, _state(2))
+        mgr.close()
+        monkeypatch.setenv("PT_FAULT_BITFLIP_CKPT", "5")
+        monkeypatch.delenv("PT_FAULT_RANK", raising=False)
+        monkeypatch.delenv("PT_FAULT_ONCE_DIR", raising=False)
+        exits = []
+        monkeypatch.setattr(faults.os, "_exit",
+                            lambda code: exits.append(code))
+        faults.maybe_fault(4, ckpt_dir=str(tmp_path))   # not yet
+        assert exits == []
+        faults.maybe_fault(5, ckpt_dir=str(tmp_path))
+        assert exits == [faults.CKPT_FAULT_EXIT_CODE]
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(_shard(tmp_path, 2))
+
+    def test_fault_stays_armed_until_a_shard_exists(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_TORN_CKPT", "3")
+        monkeypatch.setenv("PT_FAULT_ONCE_DIR",
+                           str(tmp_path / "once"))
+        monkeypatch.delenv("PT_FAULT_RANK", raising=False)
+        exits = []
+        monkeypatch.setattr(faults.os, "_exit",
+                            lambda code: exits.append(code))
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        faults.maybe_fault(3, ckpt_dir=str(ckpt))   # no shard yet
+        assert exits == [] and not faults._already_fired("torn_ckpt")
+        mgr = _mgr(ckpt)
+        mgr.save(4, _state(4))
+        mgr.close()
+        faults.maybe_fault(4, ckpt_dir=str(ckpt))   # >= at: still armed
+        assert exits == [faults.CKPT_FAULT_EXIT_CODE]
+        assert faults._already_fired("torn_ckpt")
+        # a restarted incarnation runs clean and corrupts nothing
+        exits.clear()
+        mgr2 = _mgr(ckpt)
+        mgr2.save(9, _state(9))
+        mgr2.close()
+        faults.maybe_fault(9, ckpt_dir=str(ckpt))
+        assert exits == []
+        verify_shard(_shard(ckpt, 9))       # still intact
+
+    def test_rc_label_names_new_exit_code(self):
+        from paddle_tpu.distributed.launch import _rc_label
+        assert "checkpoint" in _rc_label(29)
+        assert _rc_label(0) == "" and _rc_label(42) == ""
+
+    def test_rc_label_normalizes_signal_deaths(self):
+        """Popen returncodes for signal deaths are NEGATIVE; the table
+        speaks shell convention (128+signum) — both must label."""
+        from paddle_tpu.distributed.launch import _rc_label
+        assert "SIGKILL" in _rc_label(-9) and "SIGKILL" in _rc_label(137)
+        assert "segfault" in _rc_label(-11)
+        assert "preempted" in _rc_label(-15)
+
+    def test_fault_shard_regex_matches_writer_names(self, tmp_path):
+        """faults/fsck parse the filenames io_checkpoint writes via the
+        shared SHARD_NAME_RE — a drifted copy would no-op the fault."""
+        from paddle_tpu.io_checkpoint import SHARD_NAME_RE
+        mgr = _mgr(tmp_path)
+        mgr.save(3, _state(3))
+        mgr.close()
+        names = [f for f in os.listdir(str(tmp_path))
+                 if SHARD_NAME_RE.match(f)]
+        assert names == ["ckpt_3.shard0.npz"]
+        assert faults._newest_shard(str(tmp_path)).endswith(names[0])
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+class TestCorruptionEndToEnd:
+    """Acceptance: PT_FAULT_BITFLIP_CKPT corrupts the newest checkpoint
+    and kills rank 0 (exit 29, distinct from crash 23 / preempt 143);
+    the supervised 2-rank job must restart, fall back to the previous
+    verified step, converge — and consume the exact record sequence an
+    uninterrupted run does, with corrupt_checkpoints_total >= 1 in
+    rank0.prom."""
+
+    TOTAL = 8
+
+    def _launch(self, tmp_path, tag, fault_env, data_dir, **kw):
+        prefix = tmp_path / f"{tag}.out"
+        ckpt = tmp_path / f"{tag}.ckpt"
+        env = dict(SUBPROC_ENV, **fault_env)
+        if fault_env:
+            env.setdefault("PT_FAULT_ONCE_DIR",
+                           str(tmp_path / f"{tag}.once"))
+        from paddle_tpu.distributed.launch import launch_collective
+        rc = launch_collective(
+            [WORKER, str(prefix), str(ckpt), str(self.TOTAL), "0.05",
+             "1", str(data_dir)],
+            log_dir=str(tmp_path / f"{tag}.logs"), env_extra=env,
+            timeout=240, **kw)
+        return rc, prefix
+
+    def _report(self, prefix, rank):
+        with open(f"{prefix}.rank{rank}.json") as f:
+            return json.load(f)
+
+    def _batches(self, prefix, rank):
+        with open(f"{prefix}.rank{rank}.batches.json") as f:
+            return json.load(f)
+
+    def test_bitflip_restart_falls_back_and_matches_clean_run(
+            self, tmp_path, capfd):
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        with open(data_dir / "d.txt", "w") as f:
+            for i in range(4000):
+                f.write(f"{i}\n")
+        rc, prefix = self._launch(
+            tmp_path, "faulted",
+            {"PT_FAULT_BITFLIP_CKPT": "5", "PT_FAULT_RANK": "0"},
+            data_dir, nproc=2, max_restarts=2)
+        err = capfd.readouterr().err
+        assert rc == 0, err[-4000:]
+        # the supervisor named the new exit path — not 23, not 143
+        assert "exited with code 29" in err
+        faulted = self._report(prefix, 0)
+        assert faulted["restart_count"] == 1
+        # resumed from a verified step: past 0, never past the fault
+        assert 0 < faulted["first_step"] <= 5
+        # rank 0 quarantined the corrupt step on restore
+        prom = (tmp_path / "faulted.logs" / "heartbeat"
+                / "rank0.prom").read_text()
+        corrupt = [ln for ln in prom.splitlines()
+                   if ln.startswith("corrupt_checkpoints_total")]
+        assert corrupt and float(corrupt[0].split()[-1]) >= 1, prom
+        # clean comparison run
+        rc0, clean_prefix = self._launch(tmp_path, "clean", {},
+                                         data_dir, nproc=2)
+        assert rc0 == 0
+        clean = self._report(clean_prefix, 0)
+        assert faulted["w"] == clean["w"]
+        # exactly-once ingest: the same per-step batches, bit-identical,
+        # on both the faulted rank and the undisturbed rank
+        for rank in (0, 1):
+            fb = self._batches(prefix, rank)
+            cb = self._batches(clean_prefix, rank)
+            assert set(fb) == set(cb) == {str(s)
+                                          for s in range(self.TOTAL)}
+            assert fb == cb, f"rank {rank} record sequence diverged"

@@ -1,0 +1,271 @@
+"""Exactly-once resumable data pipeline: the deterministic record
+reader's cursor, FileDataLoader(stateful=True) through the prefetch
+queue, and the auto_checkpoint data_state hook — a killed-and-resumed
+run must consume bit-identical batches to an uninterrupted one."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataio.dataloader import (
+    FileDataLoader, _PyRecordReader, _py_record_iter,
+)
+from paddle_tpu.io_checkpoint import auto_checkpoint
+from paddle_tpu.monitor.registry import REGISTRY
+
+
+@pytest.fixture
+def data_files(tmp_path):
+    files = []
+    for fi in range(3):
+        p = tmp_path / f"f{fi}.txt"
+        with open(p, "w") as f:
+            for i in range(40):
+                f.write(f"{fi * 100 + i}\n")
+        files.append(str(p))
+    return files
+
+
+class TestPyRecordReader:
+    @pytest.mark.parametrize("shuffle_buffer", [0, 16])
+    def test_resume_exact_at_any_cut(self, data_files, shuffle_buffer):
+        full = list(_PyRecordReader(data_files, epochs=2,
+                                    shuffle_buffer=shuffle_buffer,
+                                    seed=7))
+        assert len(full) == 240
+        for k in (0, 1, 39, 40, 41, 119, 120, 121, 239, 240):
+            r1 = _PyRecordReader(data_files, epochs=2,
+                                 shuffle_buffer=shuffle_buffer, seed=7)
+            it = iter(r1)
+            head = [next(it) for _ in range(k)]
+            r2 = _PyRecordReader(data_files, epochs=2,
+                                 shuffle_buffer=shuffle_buffer, seed=7,
+                                 start_state=r1.state())
+            assert head + list(r2) == full, f"cut at {k}"
+
+    def test_shuffle_actually_shuffles_and_is_seeded(self, data_files):
+        plain = list(_PyRecordReader(data_files, epochs=1))
+        s1 = list(_PyRecordReader(data_files, epochs=1,
+                                  shuffle_buffer=16, seed=1))
+        s1b = list(_PyRecordReader(data_files, epochs=1,
+                                   shuffle_buffer=16, seed=1))
+        s2 = list(_PyRecordReader(data_files, epochs=1,
+                                  shuffle_buffer=16, seed=2))
+        assert sorted(s1) == sorted(plain)
+        assert s1 == s1b and s1 != plain and s1 != s2
+
+    def test_epochs_reshuffle_differently(self, data_files):
+        """Per-epoch RNG derivation: epoch 2 is not a replay of epoch
+        1 (and both are re-derivable from (seed, epoch) — the property
+        resume leans on)."""
+        two = list(_PyRecordReader(data_files, epochs=2,
+                                   shuffle_buffer=16, seed=3))
+        assert two[:120] != two[120:]
+        assert sorted(two[:120]) == sorted(two[120:])
+
+    def test_state_knob_mismatch_rejected(self, data_files):
+        r = _PyRecordReader(data_files, epochs=1, shuffle_buffer=8,
+                            seed=1)
+        st = r.state()
+        with pytest.raises(ValueError, match="seed"):
+            _PyRecordReader(data_files, epochs=1, shuffle_buffer=8,
+                            seed=2, start_state=st)
+        with pytest.raises(ValueError, match="shuffle_buffer"):
+            _PyRecordReader(data_files, epochs=1, shuffle_buffer=4,
+                            seed=1, start_state=st)
+        with pytest.raises(ValueError, match="file"):
+            _PyRecordReader(data_files[:2], epochs=1, shuffle_buffer=8,
+                            seed=1, start_state=st)
+        with pytest.raises(ValueError, match="version"):
+            _PyRecordReader(data_files, epochs=1, start_state={"v": 9})
+
+    def test_swapped_file_contents_rejected(self, data_files):
+        """Same file COUNT, different contents: the cursor's byte
+        offset / skip-replay would silently address different records
+        — the fingerprint (name+size) must catch it."""
+        r = _PyRecordReader(data_files, epochs=1, seed=1)
+        st = r.state()
+        with open(data_files[1], "a") as f:
+            f.write("99999\n")          # rewritten between runs
+        with pytest.raises(ValueError, match="f1.txt"):
+            _PyRecordReader(data_files, epochs=1, seed=1,
+                            start_state=st)
+
+    def test_legacy_iter_wrapper_contract(self, data_files):
+        recs = list(_py_record_iter(data_files, 1, "lines"))
+        assert recs[0] == b"0" and len(recs) == 120
+
+    def test_recordio_mode_rejected(self, data_files):
+        with pytest.raises(RuntimeError, match="recordio|RecordIO"):
+            _PyRecordReader(data_files, epochs=1, mode="recordio")
+
+
+class TestStatefulLoader:
+    def _loader(self, files, **kw):
+        kw.setdefault("batch_size", 8)
+        kw.setdefault("device_put", False)
+        kw.setdefault("stateful", True)
+        return FileDataLoader(files, lambda r: np.float32(r), **kw)
+
+    @pytest.mark.parametrize("shuffle_buffer", [0, 16])
+    def test_resume_bit_identical_batches(self, data_files,
+                                          shuffle_buffer):
+        full = list(self._loader(data_files, epochs=2, seed=3,
+                                 shuffle_buffer=shuffle_buffer))
+        ld = self._loader(data_files, epochs=2, seed=3,
+                          shuffle_buffer=shuffle_buffer)
+        head = []
+        for i, b in enumerate(ld):
+            head.append(b)
+            if i == 6:
+                break
+        st = ld.state()
+        ld2 = self._loader(data_files, epochs=2, seed=3,
+                           shuffle_buffer=shuffle_buffer)
+        ld2.set_state(st)
+        tail = list(ld2)
+        got = np.concatenate(head + tail)
+        want = np.concatenate(full)
+        assert np.array_equal(got, want)
+
+    def test_state_commits_at_delivery_not_read_ahead(self, data_files):
+        """The worker prefetches past what the consumer pulled; the
+        cursor must track the consumer. After 1 delivered batch of 8,
+        the state says 8 records — whatever the read-ahead did."""
+        ld = self._loader(data_files, epochs=1, prefetch=4)
+        it = iter(ld)
+        next(it)
+        assert ld.state()["records_consumed"] == 8
+        it.close()
+
+    def test_state_before_iteration_is_start_cursor(self, data_files):
+        ld = self._loader(data_files, epochs=1)
+        st = ld.state()
+        assert st["records_consumed"] == 0 and st["epoch"] == 0
+
+    def test_set_state_validates_eagerly(self, data_files):
+        ld = self._loader(data_files, epochs=1)
+        with pytest.raises(ValueError):
+            ld.set_state({"version": 99})
+
+    def test_non_stateful_state_raises_with_guidance(self, data_files):
+        ld = self._loader(data_files, stateful=False)
+        with pytest.raises(RuntimeError, match="stateful=True"):
+            ld.state()
+        with pytest.raises(RuntimeError, match="stateful=True"):
+            ld.set_state({})
+
+    def test_stateful_recordio_rejected(self, data_files):
+        with pytest.raises(RuntimeError, match="stateful"):
+            self._loader(data_files, mode="recordio")
+
+    def test_stateful_uses_python_reader_even_with_native(
+            self, data_files):
+        """The documented fallback: the native loader's multi-threaded
+        order is nondeterministic, so stateful always reads in
+        Python."""
+        from paddle_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable; nothing to fall "
+                        "back from")
+        ld = self._loader(data_files)
+        assert isinstance(ld._records(), _PyRecordReader)
+
+    def test_records_consumed_metric(self, data_files):
+        before = REGISTRY.get("data_records_consumed_total").value()
+        list(self._loader(data_files, epochs=1))
+        assert REGISTRY.get("data_records_consumed_total").value() \
+            == before + 120
+
+    def test_device_put_path_resumes_too(self, data_files):
+        import jax.numpy as jnp
+        ld = self._loader(data_files, epochs=1, device_put=True)
+        it = iter(ld)
+        first = next(it)
+        assert isinstance(first, jnp.ndarray)
+        it.close()
+        ld2 = self._loader(data_files, epochs=1, device_put=True)
+        ld2.set_state(ld.state())
+        second = next(iter(ld2))
+        full = list(self._loader(data_files, epochs=1))
+        assert np.array_equal(np.asarray(second), full[1])
+
+
+class TestAutoCheckpointDataState:
+    def _run(self, ckpt_dir, files, crash_at=None, total=20):
+        seq = {}
+        ld = FileDataLoader(files, lambda r: np.float32(r),
+                            batch_size=4, shuffle_buffer=32, seed=5,
+                            epochs=-1, device_put=False, stateful=True)
+        box = {}
+
+        def step_fn(step, state):
+            if "it" not in box:
+                box["it"] = iter(ld)        # after data-state restore
+            b = next(box["it"])
+            seq[step] = b.tolist()
+            if crash_at is not None and step == crash_at:
+                raise RuntimeError("injected")
+            return {"w": state["w"] + float(b.sum())}
+
+        out = auto_checkpoint(ckpt_dir, lambda: {"w": 0.0}, total,
+                              step_fn, save_interval_steps=3,
+                              data_state=ld)
+        return float(out["w"]), seq
+
+    def test_crash_resume_consumes_same_sequence(self, tmp_path,
+                                                 data_files):
+        w_clean, seq_clean = self._run(str(tmp_path / "clean"),
+                                       data_files)
+        with pytest.raises(RuntimeError, match="injected"):
+            self._run(str(tmp_path / "crash"), data_files, crash_at=13)
+        w_resumed, seq_resumed = self._run(str(tmp_path / "crash"),
+                                           data_files)
+        assert seq_resumed.keys() == seq_clean.keys() or \
+            set(seq_resumed) <= set(seq_clean)
+        for step, batch in seq_resumed.items():
+            assert batch == seq_clean[step], f"step {step} diverged"
+        assert w_resumed == w_clean
+
+    def test_resume_skips_consumed_records_without_data_state(
+            self, tmp_path, data_files):
+        """Control: WITHOUT the hook the resumed run re-reads from the
+        start of the stream — the silent replay the issue describes.
+        (Guards against the hook accidentally becoming a no-op.)"""
+        seq = {}
+
+        def mk_step(ld, box):
+            def step_fn(step, state):
+                if "it" not in box:
+                    box["it"] = iter(ld)
+                b = next(box["it"])
+                seq[step] = b.tolist()
+                if step == 7 and not os.environ.get("_resumed"):
+                    os.environ["_resumed"] = "1"
+                    raise RuntimeError("kill")
+                return state
+            return step_fn
+
+        os.environ.pop("_resumed", None)
+        try:
+            ld1 = FileDataLoader(data_files, lambda r: np.float32(r),
+                                 batch_size=4, epochs=-1,
+                                 device_put=False, stateful=True)
+            with pytest.raises(RuntimeError):
+                auto_checkpoint(str(tmp_path / "c"), lambda: {"w": 0.0},
+                                12, mk_step(ld1, {}),
+                                save_interval_steps=3)
+            first_replay = dict(seq)
+            ld2 = FileDataLoader(data_files, lambda r: np.float32(r),
+                                 batch_size=4, epochs=-1,
+                                 device_put=False, stateful=True)
+            auto_checkpoint(str(tmp_path / "c"), lambda: {"w": 0.0},
+                            12, mk_step(ld2, {}),
+                            save_interval_steps=3)
+            # the resumed incarnation (restored step 6, resumes at 7)
+            # started the FILE over: step 7 saw the records step 0
+            # already consumed — data replayed
+            assert seq[7] == first_replay[0]
+        finally:
+            os.environ.pop("_resumed", None)
