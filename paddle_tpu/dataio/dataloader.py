@@ -20,6 +20,9 @@ prefetch queue and is committed only when the *consumer* receives that
 batch, so read-ahead the process never consumed is not counted; saving
 ``state()`` in a checkpoint (``auto_checkpoint(data_state=loader)``)
 and resuming yields bit-identical batches to an uninterrupted run.
+Iterators are cursors into ONE stream: a second ``__iter__`` continues
+after the last delivered batch rather than replaying from the restored
+snapshot (re-consuming records would break exactly-once silently).
 Stateful mode always uses the deterministic single-threaded Python
 reader — the native loader's multi-threaded record order is
 nondeterministic, so there is no sequence a resumed run could rejoin
@@ -27,6 +30,7 @@ nondeterministic, so there is no sequence a resumed run could rejoin
 """
 
 import os
+import weakref
 
 import numpy as np
 
@@ -243,6 +247,12 @@ class FileDataLoader:
                 "non-stateful loader")
         self._pending_state = None      # applied at next __iter__
         self._delivered_state = None    # after the last consumed batch
+        self._live_iter = None          # stateful: weakref to the one
+        # live iterator. WEAK on purpose: a strong ref would close the
+        # (loader -> generator -> loader-closure) cycle, deferring an
+        # abandoned iterator's finalization — and its prefetch
+        # worker's shutdown — from refcount-immediate to whenever the
+        # cyclic GC next runs
 
     # -- resume cursor -----------------------------------------------------
     def state(self):
@@ -265,7 +275,11 @@ class FileDataLoader:
 
     def set_state(self, state):
         """Resume from a ``state()`` snapshot: takes effect on the next
-        ``__iter__`` (create iterators AFTER calling this)."""
+        ``__iter__`` (create iterators AFTER calling this). Without a
+        fresh ``set_state``, each subsequent iterator CONTINUES from
+        the last delivered batch — the loader is a stream with a
+        cursor, so re-iterating never replays consumed records (an
+        exhausted finite stream yields nothing)."""
         if not self.stateful:
             raise RuntimeError(
                 "set_state() on a non-stateful FileDataLoader — "
@@ -275,8 +289,17 @@ class FileDataLoader:
         _PyRecordReader(self.files, self.epochs, self.mode,
                         self.shuffle_buffer, self.seed,
                         start_state=state)
+        # a still-live iterator delivering after this call would stomp
+        # the snapshot with its own cursor — supersede it now
+        self._close_live_iter()
         self._pending_state = dict(state)
         self._delivered_state = None
+
+    def _close_live_iter(self):
+        ref, self._live_iter = self._live_iter, None
+        it = ref() if ref is not None else None
+        if it is not None:
+            it.close()
 
     # -- reading -----------------------------------------------------------
     def _records(self):
@@ -297,9 +320,17 @@ class FileDataLoader:
                     "native loader is available: resumable "
                     "exactly-once ingest requires a deterministic "
                     "record order")
+            # a later iterator continues from the last DELIVERED batch
+            # (falling back to the restored snapshot before anything
+            # was delivered): re-seeding from _pending_state would
+            # silently replay already-consumed records on the second
+            # __iter__ — the exactly-once violation, not a rewind
+            start = self._delivered_state \
+                if self._delivered_state is not None \
+                else self._pending_state
             return _PyRecordReader(self.files, self.epochs, self.mode,
                                    self.shuffle_buffer, self.seed,
-                                   start_state=self._pending_state)
+                                   start_state=start)
         from paddle_tpu import native
         if self.mode == "recordio" and not native.available():
             raise RuntimeError(
@@ -355,6 +386,14 @@ class FileDataLoader:
         pulled are not "consumed" and resume re-reads them."""
         from paddle_tpu.static.executor import background_prefetch
 
+        # stateful: ONE live cursor. Superseding (closing) any previous
+        # iterator before the new reader seeds from _delivered_state
+        # makes the one-stream contract enforced, not advisory — two
+        # concurrently-live iterators would double-deliver records and
+        # let the older one regress the committed cursor
+        if self.stateful:
+            self._close_live_iter()
+
         if self.device_put:
             import jax
             put = jax.device_put
@@ -379,5 +418,14 @@ class FileDataLoader:
             finally:
                 inner.close()   # deterministic worker shutdown when
                                 # the consumer abandons THIS wrapper
+                # NOTE: deliver() must not reference its own generator
+                # (e.g. to clear _live_iter) — the closure cell would
+                # be a self-cycle keeping an abandoned iterator, and
+                # its prefetch worker, alive until a cyclic GC pass.
+                # A stale _live_iter weakref is harmless: re-closing a
+                # finished generator is a no-op.
 
-        return deliver()
+        gen = deliver()
+        if self.stateful:
+            self._live_iter = weakref.ref(gen)
+        return gen

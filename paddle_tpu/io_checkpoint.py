@@ -28,10 +28,18 @@ elastic story — the launcher half is PR 1's supervisor):
   process kill but not a host crash);
 - ``restore()`` verifies digests on load; a torn/bit-rotted/zero-byte
   shard raises ``CheckpointCorruptError`` when a ``step=`` was asked
-  for explicitly, and otherwise is **quarantined** (shard and meta
-  renamed ``*.corrupt``, ``corrupt_checkpoints_total`` bumped, a
-  flight-recorder note left) while restore walks back to the newest
-  step that verifies — one bad file must never brick the job;
+  for explicitly, and otherwise is **quarantined** (every host's
+  shard and the meta renamed ``*.corrupt``,
+  ``corrupt_checkpoints_total`` bumped, a flight-recorder note left)
+  while restore walks back to the newest step that verifies — one bad
+  file must never brick the job. Transient I/O errors (``OSError``)
+  are retried and then re-raised, NOT treated as corruption: an NFS
+  blip at restart must not demote a good checkpoint;
+- under multi-process with a shared checkpoint dir,
+  ``restore(step=None)`` is a collective: hosts exchange verdict
+  files and host 0 publishes the newest step every host verified
+  (nonce-echoed decision), so ranks can never silently resume from
+  different steps;
 - ``latest_step()`` only counts steps whose meta *and* shards are all
   present (a stray ``ckpt_N.json`` used to brick restore), ``_prune``
   never deletes the last step verified on read, and stale write temps
@@ -72,6 +80,12 @@ _log = logging.getLogger("paddle_tpu.checkpoint")
 #: write, and a format change must not silently strand them
 SHARD_NAME_RE = re.compile(r"^ckpt_(\d+)\.shard(\d+)\.npz$")
 META_NAME_RE = re.compile(r"^ckpt_(\d+)\.json$")
+
+#: multi-host restore coordination files (shared checkpoint dir):
+#: host 0's round announcement, per-host round-tagged verdicts, and
+#: host 0's nonce-echoed decision
+_ROUND_NAME = ".restore.round.json"
+_DECISION_NAME = ".restore.decision.json"
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -158,13 +172,60 @@ def _natural_key(k):
     return (len(k), k)       # a0, a1, ... a10 in numeric order
 
 
-def verify_shard(path, verify=True):
+def _retry_transient(fn, what, retries=2, delay=0.05):
+    """Run ``fn()``, retrying a transient ``OSError`` with doubling
+    backoff and then re-raising it unchanged — the single home of the
+    PR's blip-is-not-corruption rule (shared by ``verify_shard``,
+    ``_step_complete`` and ``tools/fsck_checkpoint``).
+    ``FileNotFoundError`` is never transient (callers own existence
+    checks), and every other exception propagates immediately:
+    classifying content damage as corruption is the caller's job."""
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except FileNotFoundError:
+            raise
+        except OSError as e:
+            if attempt == retries:
+                raise
+            _log.warning("%s failed (%s: %s); retry %d/%d in %.2fs",
+                         what, type(e).__name__, e, attempt + 1,
+                         retries, delay)
+            time.sleep(delay)
+            delay *= 2.0
+
+
+def _stat_exists(path, retries=2, delay=0.05):
+    """``os.path.exists`` with the blip-is-not-corruption rule:
+    ``exists()`` swallows EVERY OSError into False, so a transient
+    stat failure (EIO, ESTALE) would silently classify a present
+    shard as missing. Here FileNotFoundError means False, any other
+    OSError is retried and then raised."""
+
+    def probe():
+        try:
+            os.stat(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    return _retry_transient(probe, f"checkpoint stat {path}",
+                            retries=retries, delay=delay)
+
+
+def verify_shard(path, verify=True, read_retries=2, retry_delay=0.05):
     """Read one checkpoint shard, verifying its integrity record.
 
     Returns ``(manifest, {npz key: np.ndarray})``. Raises
     ``CheckpointCorruptError`` naming ``path`` and the first bad array
-    on any unreadable/torn/bit-rotted content. Shards written before
-    the integrity format (no ``integrity`` block in the manifest) are
+    on positive corruption evidence: torn/bit-rotted zip content, CRC
+    mismatch, missing/extra array, digest drift. A transient I/O error
+    (``OSError`` — an NFS hiccup, EIO) is NOT corruption: the read is
+    retried ``read_retries`` times with doubling backoff and then the
+    ``OSError`` re-raises unchanged, so callers crash-and-retry (the
+    supervisor's restart budget) instead of quarantining a checkpoint
+    that is merely unreachable right now. Shards written before the
+    integrity format (no ``integrity`` block in the manifest) are
     accepted structurally — old checkpoints stay restorable.
     ``verify=False`` skips the CRC pass (bench A/B; the structural
     parse still runs). Shared by ``CheckpointManager.restore`` and
@@ -175,7 +236,7 @@ def verify_shard(path, verify=True):
         return CheckpointCorruptError(
             f"checkpoint shard {path}: {detail}")
 
-    try:
+    def read():
         with np.load(path, allow_pickle=False) as blob:
             if "__manifest__" not in blob.files:
                 raise bad("no __manifest__ member (not a checkpoint "
@@ -184,10 +245,17 @@ def verify_shard(path, verify=True):
                 bytes(blob["__manifest__"].tobytes()).decode("utf-8"))
             arrays = {k: blob[k] for k in blob.files
                       if k != "__manifest__"}
-    except CheckpointCorruptError:
-        raise
-    except Exception as e:      # zipfile.BadZipFile, OSError, EOFError,
-        # ValueError (torn npy header), UnicodeDecodeError/JSON errors
+        return manifest, arrays
+
+    try:
+        manifest, arrays = _retry_transient(
+            read, f"checkpoint shard {path} read",
+            retries=read_retries, delay=retry_delay)
+    except (CheckpointCorruptError, OSError):
+        raise               # corruption verdict / transient I-O resp.
+    except Exception as e:  # zipfile.BadZipFile, EOFError,
+        # ValueError (torn npy header), UnicodeDecodeError/JSON
+        # errors — the file's CONTENT is wrong, not the disk
         raise bad(f"unreadable ({type(e).__name__}: {e})") from e
     if not verify:
         return manifest, arrays
@@ -280,6 +348,10 @@ class CheckpointManager:
     disk_retries = 3
     retry_backoff = 0.1
     retry_backoff_cap = 2.0
+    #: multi-host restore coordination: how long each host waits for
+    #: peer verdicts / host 0's decision before giving up (RuntimeError
+    #: -> the supervisor's restart budget, never a silent divergence)
+    coord_timeout = 120.0
 
     def __init__(self, dirname, keep_max=3, save_interval_steps=100,
                  save_interval_secs=None, async_save=True,
@@ -311,14 +383,34 @@ class CheckpointManager:
             self._thread.start()
 
     def _sweep_stale_tmps(self):
-        """Remove write temps a killed previous incarnation left
-        behind. Scoped to THIS host's shard temps (plus meta temps on
-        host 0): another live host's in-flight temp must not be
-        yanked out from under its writer."""
+        """Remove write temps and coordination leftovers a killed
+        previous incarnation left behind. Scoped to THIS host's shard
+        temps, its own restore verdict, and its own verdict temps
+        (``.restore.v<P>.*`` — host-tagged precisely so no other host
+        can mistake them for its own); host 0 additionally sweeps meta
+        temps (mkstemp ``.ckpt_N.meta.*.json.tmp`` plus the legacy
+        fixed ``ckpt_N.json.tmp`` name no current writer uses), its
+        round/decision temps (``.restore.r.*`` / ``.restore.d.*``),
+        and the round + decision files. Another live host's in-flight
+        temp is never yanked out from under its writer — a peer may
+        be mid-``_publish_json`` of its verdict while this host
+        inits; the supervisor guarantees the previous incarnation of
+        THIS host is dead before a restart, so same-host temps are
+        stale by construction."""
         tag = f".shard{self._proc}."
+        verdict = os.path.basename(self._verdict_path(self._proc))
+        vtmp = f".restore.v{self._proc}."
         for f in os.listdir(self.dirname):
-            mine = (f.endswith(".tmp.npz") and tag in f) or \
-                   (self._proc == 0 and f.endswith(".json.tmp"))
+            mine = ((f.endswith(".tmp.npz") and tag in f)
+                    or f == verdict
+                    or (f.endswith(".json.tmp") and f.startswith(vtmp)))
+            if self._proc == 0:
+                mine = mine or (f.endswith(".json.tmp") and
+                                (f.startswith(".ckpt_") or
+                                 f.startswith(".restore.r.") or
+                                 f.startswith(".restore.d.") or
+                                 f.startswith("ckpt_")))
+                mine = mine or f in (_ROUND_NAME, _DECISION_NAME)
             if not mine:
                 continue
             try:
@@ -334,6 +426,33 @@ class CheckpointManager:
 
     def _meta_path(self, step):
         return os.path.join(self.dirname, f"ckpt_{step}.json")
+
+    def _verdict_path(self, proc):
+        return os.path.join(self.dirname, f".restore.h{proc}.json")
+
+    def _round_path(self):
+        return os.path.join(self.dirname, _ROUND_NAME)
+
+    def _decision_path(self):
+        return os.path.join(self.dirname, _DECISION_NAME)
+
+    def _publish_json(self, path, obj, prefix):
+        """fsync'd atomic JSON publish via an mkstemp temp in the
+        checkpoint dir (``prefix`` names the temp recognizably for the
+        init sweep)."""
+        fd, tmp = tempfile.mkstemp(dir=self.dirname,
+                                   suffix=".json.tmp", prefix=prefix)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(obj, f)
+                _fsync_file(f)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
 
     # -- policy ------------------------------------------------------------
     def should_save(self, step):
@@ -437,11 +556,11 @@ class CheckpointManager:
                     "time": time.time()}
             if data_state is not None:
                 meta["data_state"] = data_state
-            mtmp = self._meta_path(step) + ".tmp"
-            with open(mtmp, "w") as f:
-                json.dump(meta, f)
-                _fsync_file(f)
-            os.replace(mtmp, self._meta_path(step))
+            # mkstemp like the shards: a fixed temp name would let two
+            # incarnations racing on the same step interleave writes
+            # into one file
+            self._publish_json(self._meta_path(step), meta,
+                               prefix=f".ckpt_{step}.meta.")
             _fsync_dir(self.dirname)
         self._prune()
 
@@ -507,16 +626,35 @@ class CheckpointManager:
                     pass
         return sorted(steps)
 
-    def _step_complete(self, step):
+    def _step_complete(self, step, read_retries=2, retry_delay=0.05):
         """Meta readable AND every shard it promises present. A stray
         or torn ckpt_N.json (shards pruned by hand, meta half-written
-        by a dying host) must not be offered for restore."""
-        try:
-            with open(self._meta_path(step)) as f:
-                nproc = int(json.load(f).get("nproc", 1))
-        except (OSError, ValueError, TypeError):
+        by a dying host) must not be offered for restore. A transient
+        I/O error reading the meta is NOT incompleteness: silently
+        returning False would drop the newest good step from
+        _complete_steps and restore an older one with no fallback
+        warning — so, like verify_shard, the read is retried and then
+        the OSError re-raises (crash-and-retry via the supervisor, or
+        _write_durable's retry loop when called from _prune)."""
+        def read_nproc():
+            try:
+                with open(self._meta_path(step)) as f:
+                    return int(json.load(f).get("nproc", 1))
+            except FileNotFoundError:
+                return None
+            except (ValueError, TypeError):
+                return None     # torn/garbage content, not a blip
+
+        nproc = _retry_transient(
+            read_nproc, f"checkpoint meta for step {step} read",
+            retries=read_retries, delay=retry_delay)
+        if nproc is None:
             return False
-        return all(os.path.exists(self._shard_path(step, p))
+        # _stat_exists, not os.path.exists: exists() swallows a stat
+        # blip into "missing", silently dropping the newest good step
+        return all(_stat_exists(self._shard_path(step, p),
+                                retries=read_retries,
+                                delay=retry_delay)
                    for p in range(nproc))
 
     def _complete_steps(self):
@@ -531,16 +669,28 @@ class CheckpointManager:
 
     def _quarantine(self, step, err):
         """Move a corrupt step out of the restore path, keeping the
-        evidence: shard -> *.corrupt, meta -> *.corrupt. Counted in
+        evidence: EVERY host's shard -> *.corrupt (an un-renamed peer
+        shard would leak forever once the meta is gone — no meta means
+        the step never reaches _complete_steps, so _prune never
+        collects it) plus meta -> *.corrupt. Counted in
         corrupt_checkpoints_total and noted in the flight recorder."""
         _m_corrupt.inc()
         _log.warning("checkpoint step %s quarantined: %s (renaming "
-                     "shard/meta to *.corrupt)", step, err)
+                     "shards/meta to *.corrupt)", step, err)
+        targets = [os.path.basename(self._meta_path(step))]
+        try:
+            for f in os.listdir(self.dirname):
+                m = SHARD_NAME_RE.match(f)
+                if m and int(m.group(1)) == step:
+                    targets.append(f)
+        except OSError:
+            targets.append(os.path.basename(self._shard_path(step)))
         renamed = []
-        for path in (self._shard_path(step), self._meta_path(step)):
+        for f in targets:
+            path = os.path.join(self.dirname, f)
             try:
                 os.replace(path, path + ".corrupt")
-                renamed.append(os.path.basename(path) + ".corrupt")
+                renamed.append(f + ".corrupt")
             except OSError:
                 pass
         try:
@@ -550,18 +700,24 @@ class CheckpointManager:
         except Exception:
             pass
 
-    def _load_step(self, step, verify):
-        """(tree, manifest) for one step, CRC-verified. Raises
-        CheckpointCorruptError on unreadable meta/shard."""
-        import jax.numpy as jnp
+    def _read_own_shard(self, step, verify):
+        """(manifest, arrays) for this host's shard of one step,
+        CRC-verified. Raises CheckpointCorruptError on positive
+        corruption evidence (torn meta JSON, bad shard content);
+        transient OSErrors propagate unchanged (see verify_shard)."""
         meta_path = self._meta_path(step)
-        try:
+
+        def read_meta():
             with open(meta_path) as f:
-                saved_nproc = json.load(f).get("nproc", 1)
+                return json.load(f).get("nproc", 1)
+
+        try:
+            saved_nproc = _retry_transient(
+                read_meta, f"checkpoint meta {meta_path} read")
         except FileNotFoundError:
             enforce(False, f"no checkpoint meta for step {step} in "
                            f"{self.dirname}")
-        except (OSError, ValueError) as e:
+        except ValueError as e:         # torn/garbage JSON: corruption
             _m_verify_fail.inc()
             raise CheckpointCorruptError(
                 f"checkpoint meta {meta_path} unreadable "
@@ -576,7 +732,12 @@ class CheckpointManager:
             # replicated (single-host) checkpoint restored on a larger
             # topology: every host reads the one shard
             path = self._shard_path(step, 0)
-        manifest, arrays = verify_shard(path, verify=verify)
+        return verify_shard(path, verify=verify)
+
+    def _load_step(self, step, verify):
+        """(tree, manifest) for one step, CRC-verified."""
+        import jax.numpy as jnp
+        manifest, arrays = self._read_own_shard(step, verify)
         tree = tree_from_manifest(
             manifest, {k: jnp.asarray(v) for k, v in arrays.items()})
         return tree, manifest
@@ -586,12 +747,17 @@ class CheckpointManager:
         its own shard (the sharding that was saved).
 
         With ``step=None`` the newest *verifying* step is restored:
-        corrupt/torn steps are quarantined (shard+meta renamed
-        ``*.corrupt``) and the walk continues backwards — the
-        last-good fallback. An explicit ``step=`` that fails
-        verification raises ``CheckpointCorruptError`` naming the file
-        and first bad array. ``verify=False`` skips CRC checks
-        (default: the manager's ``verify_restore``)."""
+        corrupt/torn steps are quarantined (every host's shard + meta
+        renamed ``*.corrupt``) and the walk continues backwards — the
+        last-good fallback. Under multi-process with a shared
+        checkpoint dir this is a COLLECTIVE: hosts exchange per-host
+        verdict files and host 0 publishes the newest step EVERY host
+        verified, so no two ranks can silently resume from different
+        steps (one host's corrupt shard walks the whole gang back).
+        An explicit ``step=`` that fails verification raises
+        ``CheckpointCorruptError`` naming the file and first bad
+        array. ``verify=False`` skips CRC checks (default: the
+        manager's ``verify_restore``)."""
         if verify is None:
             verify = self.verify_restore
         if step is not None:
@@ -601,6 +767,8 @@ class CheckpointManager:
             self._restored_data_state = (step,
                                          manifest.get("data_state"))
             return tree, step
+        if self._nproc > 1:
+            return self._restore_coordinated(verify)
         steps = self._complete_steps()
         enforce(steps, f"no checkpoint in {self.dirname}")
         newest = steps[-1]
@@ -627,6 +795,305 @@ class CheckpointManager:
             f"every checkpoint step in {self.dirname} failed "
             f"verification ({quarantined} quarantined); nothing left "
             f"to restore")
+
+    # -- multi-host restore coordination ------------------------------------
+    # restore(step=None) on a SHARED checkpoint dir must be a
+    # collective: if host 1's shard of step N is rotted but host 0's
+    # verifies, independent walk-backs would resume the ranks from
+    # DIFFERENT steps — silent data-parallel corruption. Protocol:
+    # host 0 announces a fresh ROUND (.restore.round.json: id + mode);
+    # every host verifies its own shards per the round's mode and
+    # publishes a verdict file tagged with that round id plus a fresh
+    # nonce; host 0 accepts only current-round verdicts, picks the
+    # newest step every host verified, quarantines positively-corrupt
+    # steps, and publishes a decision echoing each host's nonce; a
+    # host accepts only a decision that echoes the nonce it just
+    # published, and while waiting re-checks the round file — a NEW
+    # round id (host 0 died and restarted mid-protocol, or an
+    # escalation) re-publishes the verdict under it.
+    #
+    # Two round modes keep the healthy path cheap: mode "first" (the
+    # opening round) verifies newest-first and STOPS at the first good
+    # step — one shard read+CRC per host per restart, not keep_max of
+    # them — marking the verdict partial when older steps were left
+    # unverified. When the partial ok-sets don't intersect (some
+    # host's newest good step isn't everyone's), host 0 escalates
+    # once to a mode "full" round under a fresh id: every host
+    # verifies every step and republishes, and agreement proceeds as
+    # before. The escalation costs one extra handshake only in the
+    # already-rare corrupt-shard case.
+    #
+    # A stale verdict left by a dead incarnation carries a stale round
+    # id, so host 0 never decides on it (the live peer republishes as
+    # soon as it sees the fresh round — no repeatable timeout loop); a
+    # stale decision fails the nonce echo. Worst case is timeout ->
+    # RuntimeError -> supervisor gang restart, never a cross-host
+    # divergence.
+
+    def _await(self, poll, what, deadline_box=None):
+        """Poll until ``poll()`` returns non-None or ``coord_timeout``
+        elapses. ``deadline_box`` (a dict) lets the poll closure RESET
+        the deadline on observed protocol progress — a follower that
+        just saw a new round id is mid-handshake, not abandoned, and
+        must get a full budget for the (possibly full-mode) verify
+        pass that round demands; without the reset, first-pass time
+        already spent would make a large-shard escalation a
+        deterministic timeout -> gang-restart loop."""
+        box = deadline_box if deadline_box is not None else {}
+        box.setdefault("deadline", time.monotonic() + self.coord_timeout)
+        while True:
+            got = poll()
+            if got is not None:
+                return got
+            if time.monotonic() > box["deadline"]:
+                raise RuntimeError(
+                    f"checkpoint restore coordination timed out after "
+                    f"{self.coord_timeout}s waiting for {what} in "
+                    f"{self.dirname} (a peer host died or never "
+                    f"entered restore); dying so the supervisor "
+                    f"restarts the gang")
+            time.sleep(0.05)
+
+    def _publish_verdict(self, round_id, nonce, ok, bad, partial):
+        self._publish_json(self._verdict_path(self._proc),
+                           {"round": round_id, "nonce": nonce,
+                            "ok": ok, "bad": bad, "partial": partial},
+                           prefix=f".restore.v{self._proc}.")
+
+    def _read_round(self):
+        """The current round announcement {"round": id, "mode": m} or
+        None. A pre-mode round file (dead older incarnation) reads as
+        mode "full" — over-verifying is always safe."""
+        try:
+            with open(self._round_path()) as f:
+                rnd = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(rnd, dict) or rnd.get("round") is None:
+            return None
+        rnd.setdefault("mode", "full")
+        return rnd
+
+    def _verify_own(self, steps, verify, stop_at_first_ok):
+        """Walk ``steps`` NEWEST-FIRST verifying this host's shard of
+        each. Returns ``(ok, bad, cache)``: verified step list, {step:
+        error} for positive corruption, and the newest verified step's
+        ``(step, manifest, arrays)`` — ONE copy retained (keeping every
+        verified step's arrays would hold keep_max model copies in
+        host RAM at once, OOMing a host that trains fine; the decision
+        is overwhelmingly the newest ok step, so keep just that and
+        re-read on the rare older pick). With ``stop_at_first_ok`` the
+        walk stops at the first verifying step — the healthy-path
+        restore reads ONE shard, not keep_max of them. Transient
+        OSError propagates: crash-and-retry, don't vote."""
+        from paddle_tpu.core.enforce import EnforceNotMet
+        ok, bad = [], {}
+        cache = None
+        for s in sorted(steps, reverse=True):
+            try:
+                manifest, arrays = self._read_own_shard(s, verify)
+            except CheckpointCorruptError as e:
+                bad[s] = str(e)
+                continue
+            except EnforceNotMet:
+                # the step vanished under us — quarantined by host 0
+                # (whose prior incarnation died before publishing its
+                # decision) or pruned by a peer. Neither verified nor
+                # positive corruption evidence: skip it, so the stale
+                # entry in our steps list can't crash the protocol
+                continue
+            ok.append(s)
+            if cache is None:
+                cache = (s, manifest, arrays)
+            if stop_at_first_ok:
+                break
+        return ok, bad, cache
+
+    @staticmethod
+    def _is_partial(steps, ok, bad):
+        return len(ok) + len(bad) < len(steps)
+
+    def _collect_verdicts(self, round_id, own):
+        """Host 0: every host's CURRENT-ROUND verdict (own included).
+        A verdict tagged with another round id is a dead incarnation's
+        leftover (or a pre-escalation one): keep waiting for the live
+        peer — it republishes once it sees this round's
+        announcement."""
+        def poll():
+            verdicts = {0: own}
+            for p in range(1, self._nproc):
+                try:
+                    with open(self._verdict_path(p)) as f:
+                        v = json.load(f)
+                except (OSError, ValueError):
+                    return None         # not published (or mid-write)
+                if v.get("round") != round_id:
+                    return None         # stale: wait for a fresh one
+                verdicts[p] = v
+            return verdicts
+
+        return self._await(
+            poll, f"peer restore verdicts (.restore.h*.json from "
+                  f"{self._nproc} hosts, round {round_id})")
+
+    @staticmethod
+    def _common_ok(verdicts):
+        common = None
+        for v in verdicts.values():
+            s = set(int(x) for x in v.get("ok", []))
+            common = s if common is None else (common & s)
+        return common or set()
+
+    def _lead(self, steps, verify, nonce):
+        """Host 0: announce a newest-first "first" round, collect
+        verdicts, and — only if the partial ok-sets don't intersect —
+        escalate once to a "full" round before agreeing. Quarantines
+        the positively-corrupt steps and publishes the nonce-echoed
+        decision. Returns (decision, own shard cache, own ok, bad).
+        The announcement goes out BEFORE host 0's own CRC pass (the
+        escalated round already works this way): followers verify in
+        parallel instead of burning their coord_timeout budget idle
+        while host 0 reads multi-GB shards."""
+        round_id = nonce
+        self._publish_json(self._round_path(),
+                           {"round": round_id, "mode": "first"},
+                           prefix=".restore.r.")
+        ok, bad, cache = self._verify_own(steps, verify,
+                                          stop_at_first_ok=True)
+        verdicts = self._collect_verdicts(
+            round_id, {"nonce": nonce, "ok": ok, "bad": bad,
+                       "partial": self._is_partial(steps, ok, bad)})
+        common = self._common_ok(verdicts)
+        if not common and any(v.get("partial")
+                              for v in verdicts.values()):
+            # disagreement with unverified steps in play: one FULL
+            # round under a fresh id (followers see the new round and
+            # republish after verifying everything)
+            round_id = os.urandom(8).hex()
+            self._publish_json(self._round_path(),
+                               {"round": round_id, "mode": "full"},
+                               prefix=".restore.r.")
+            ok, bad, cache = self._verify_own(steps, verify,
+                                              stop_at_first_ok=False)
+            verdicts = self._collect_verdicts(
+                round_id, {"nonce": nonce, "ok": ok, "bad": bad,
+                           "partial": False})
+            common = self._common_ok(verdicts)
+        chosen = max(common) if common else None
+        all_bad = {}
+        for p, v in verdicts.items():
+            for s, msg in v.get("bad", {}).items():
+                all_bad.setdefault(int(s), f"host {p}: {msg}")
+        for s in sorted(all_bad, reverse=True):
+            self._quarantine(s, all_bad[s])
+        decision = {"step": chosen,
+                    "nonces": {str(p): v.get("nonce")
+                               for p, v in verdicts.items()},
+                    "quarantined": sorted(all_bad)}
+        self._publish_json(self._decision_path(), decision,
+                           prefix=".restore.d.")
+        return decision, cache, ok, bad
+
+    def _read_decision(self, nonce):
+        try:
+            with open(self._decision_path()) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if d.get("nonces", {}).get(str(self._proc)) != nonce:
+            return None     # stale decision from a dead incarnation
+        return d
+
+    def _follow(self, steps, verify, nonce):
+        """Non-zero hosts: wait for host 0's round announcement,
+        verify own shards per its mode ("first": newest-first, stop at
+        the first good step; "full": every step), publish a
+        round-tagged verdict, await the nonce-echoed decision. The
+        round file is re-read every poll: a CHANGED round id means
+        host 0 escalated to a full round — or died and a new
+        incarnation started fresh — and the verdict republishes under
+        it instead of leaving host 0 waiting on one tagged for a dead
+        round (which would repeat timeout -> restart until the budget
+        ran out). Verification work is never repeated: a first-mode
+        re-announcement reuses the computed verdict, and full-mode
+        verification runs at most once. Returns (decision, own shard
+        cache, own ok, bad)."""
+        state = {"round": None, "ok": [], "bad": {}, "cache": None,
+                 "mode": None}
+        box = {}
+
+        def poll():
+            rnd = self._read_round()
+            if rnd is None:
+                return None
+            rid = rnd["round"]
+            if rid != state["round"]:
+                # protocol progress: a fresh round means host 0 is
+                # alive and driving — restart the budget so time spent
+                # on the FIRST pass can't starve the full-mode verify
+                # this round may demand
+                box["deadline"] = (time.monotonic()
+                                   + self.coord_timeout)
+                mode = rnd["mode"]
+                if mode == "full" and state["mode"] != "full":
+                    state["ok"], state["bad"], state["cache"] = \
+                        self._verify_own(steps, verify,
+                                         stop_at_first_ok=False)
+                    state["mode"] = "full"
+                elif state["mode"] is None:
+                    state["ok"], state["bad"], state["cache"] = \
+                        self._verify_own(steps, verify,
+                                         stop_at_first_ok=True)
+                    state["mode"] = "first"
+                partial = (state["mode"] != "full" and
+                           self._is_partial(steps, state["ok"],
+                                            state["bad"]))
+                self._publish_verdict(rid, nonce, state["ok"],
+                                      state["bad"], partial)
+                state["round"] = rid
+            return self._read_decision(nonce)
+
+        decision = self._await(
+            poll, "host 0's restore round + decision "
+                  "(.restore.round.json / .restore.decision.json)",
+            deadline_box=box)
+        return decision, state["cache"], state["ok"], state["bad"]
+
+    def _restore_coordinated(self, verify):
+        import jax.numpy as jnp
+        steps = self._complete_steps()
+        enforce(steps, f"no checkpoint in {self.dirname}")
+        newest = steps[-1]
+        nonce = os.urandom(8).hex()
+        if self._proc == 0:
+            decision, cache, ok, bad = self._lead(steps, verify, nonce)
+        else:
+            decision, cache, ok, bad = self._follow(steps, verify,
+                                                    nonce)
+        chosen = decision.get("step")
+        if chosen is None:
+            raise CheckpointCorruptError(
+                f"no checkpoint step in {self.dirname} verified on "
+                f"every host (this host: {len(ok)} ok, {len(bad)} "
+                f"bad); nothing safe to restore")
+        chosen = int(chosen)
+        if cache is not None and cache[0] == chosen:
+            manifest, arrays = cache[1], cache[2]
+        else:
+            manifest, arrays = self._read_own_shard(chosen, verify)
+        tree = tree_from_manifest(
+            manifest, {k: jnp.asarray(v) for k, v in arrays.items()})
+        if verify:
+            self._last_verified = chosen
+        self._restored_data_state = (chosen, manifest.get("data_state"))
+        if chosen != newest:
+            # the restart-from-fallback line (docs/DEBUGGING.md)
+            _log.warning(
+                "restored from last-good checkpoint step %s after "
+                "cross-host agreement (newest complete step was %s, "
+                "%d corrupt step(s) quarantined)", chosen, newest,
+                len(decision.get("quarantined", [])))
+        return tree, chosen
 
     def restore_data_state(self, step):
         """The data-pipeline cursor saved with ``step`` (this host's

@@ -17,15 +17,31 @@ boundary for free:
   NaN into the first float array of the feed at step N: a numerics
   blow-up, for the FLAGS_check_nan_inf sentinel/localizer tests.
 - ``PT_FAULT_TORN_CKPT=N``      — at step N, truncate the newest
-  published checkpoint shard to half its size (a torn write / torn
-  replication) and hard-exit with code 29: the restarted rank must
-  quarantine it and fall back to the previous verified step.
+  *complete* (meta-published) checkpoint's shard to half its size (a
+  torn write / torn replication) and hard-exit with code 29: the
+  restarted rank must quarantine it and fall back to the previous
+  verified step.
 - ``PT_FAULT_BITFLIP_CKPT=N``   — at step N, flip one byte in the
-  middle of the newest shard's last array member (bit rot the zip
-  layer can't mask) and hard-exit 29. The checkpoint dir comes from
-  ``maybe_fault(step, ckpt_dir=...)`` or ``PT_FAULT_CKPT_DIR``; if no
-  shard has been published yet the fault stays armed for a later step
-  (the once-marker is only claimed when a shard actually got hit).
+  middle of that shard's last array member (bit rot the zip layer
+  can't mask) and hard-exit 29. The checkpoint dir comes from
+  ``maybe_fault(step, ckpt_dir=...)`` or ``PT_FAULT_CKPT_DIR``.
+  Both corruption faults wait (bounded, ``PT_FAULT_CKPT_WAIT``
+  seconds, default 30) for the dir to hold TWO complete steps, then
+  FREEZE the in-process async writer (``_write`` patched to a no-op,
+  plus a bounded grace for one already-in-flight publish to land) and
+  corrupt the newest complete step PLUS every newer already-published
+  shard, re-probing until stable — the quarantine-and-fall-back path
+  they exist to exercise needs a verified predecessor to land on, and
+  a healthy newer step published between the probe and ``os._exit``
+  would mask the corruption entirely (restore() stops at the first
+  verifying step). Corrupting the ONLY complete step (nothing to fall
+  back to) would test a different — wrong — path. If the wait times
+  out the fault stays armed for a later step (the once-marker is only
+  claimed when a shard actually got hit).
+- ``PT_FAULT_AWAIT_CKPTS=K``    — before a crash/hang fault fires,
+  wait (same bounded wait) until the rank's checkpoint dir holds K
+  complete steps, so "restarts resume from a checkpoint" assertions
+  never race the async writer; fires anyway after the timeout.
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -138,6 +154,112 @@ def _already_fired(tag):
     return os.path.exists(os.path.join(d, f"{tag}.fired"))
 
 
+def _complete_ckpt_steps(ckpt_dir):
+    """Steps with a parseable meta AND every shard it promises on
+    disk — the steps restore() will actually consider. Mirrors
+    CheckpointManager._complete_steps through the shared filename
+    grammar."""
+    import json
+
+    from paddle_tpu.io_checkpoint import META_NAME_RE, SHARD_NAME_RE
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    shards = {}
+    for f in names:
+        m = SHARD_NAME_RE.match(f)
+        if m:
+            shards.setdefault(int(m.group(1)), set()).add(
+                int(m.group(2)))
+    steps = []
+    for f in names:
+        m = META_NAME_RE.match(f)
+        if not m:
+            continue
+        step = int(m.group(1))
+        try:
+            with open(os.path.join(ckpt_dir, f)) as fh:
+                nproc = int(json.load(fh).get("nproc", 1))
+        except (OSError, ValueError, TypeError):
+            continue
+        if shards.get(step, set()) >= set(range(nproc)):
+            steps.append(step)
+    return sorted(steps)
+
+
+def _corrupt_newest_and_newer(ckpt_dir, mode):
+    """Corrupt the newest COMPLETE step's shard plus every
+    already-published shard of any NEWER step, re-probing until a pass
+    finds nothing new. The async writer lives in this same process and
+    keeps draining its queue while the fault runs: corrupting only the
+    step probed a moment ago would let the writer publish a healthy
+    newer step before ``os._exit``, and restore() (newest-first, stops
+    at the first verifying step) would then succeed without
+    quarantining anything — the exact path the fault exists to deny.
+    A meta-less newer shard is corrupted too: if its meta lands before
+    the exit the step becomes complete-but-corrupt (quarantined, same
+    outcome); if not, it stays invisible to restore. Returns the list
+    of corrupted paths (empty if nothing could be hit)."""
+    from paddle_tpu.io_checkpoint import SHARD_NAME_RE
+    hit, tried = [], set()
+    while True:
+        steps = _complete_ckpt_steps(ckpt_dir)
+        if not steps:
+            return hit
+        target = steps[-1]
+        try:
+            names = os.listdir(ckpt_dir)
+        except OSError:
+            return hit
+        fresh = []
+        for f in sorted(names):
+            m = SHARD_NAME_RE.match(f)
+            path = os.path.join(ckpt_dir, f)
+            if m and int(m.group(1)) >= target and path not in tried:
+                fresh.append(path)
+        if not fresh:
+            return hit
+        for path in fresh:
+            # a path is "tried" whether or not the damage landed —
+            # re-selecting one that raises persistently (EACCES, a
+            # sick mount) would spin this loop forever
+            tried.add(path)
+            try:
+                corrupt_checkpoint(path, mode)
+            except OSError:
+                continue    # pruned/unwritable between listdir and open
+            hit.append(path)
+
+
+def _touch_heartbeat():
+    """Keep the launcher's hang watchdog quiet while a fault WAITS on
+    the async writer — the wait is harness machinery, not a hang."""
+    try:
+        from paddle_tpu.distributed.health import Heartbeat
+        hb = Heartbeat.from_env()
+        if hb is not None:
+            hb.beat(force=True)
+    except Exception:
+        pass
+
+
+def _await_complete_steps(ckpt_dir, k):
+    """Poll until ``ckpt_dir`` holds >= k complete checkpoint steps or
+    PT_FAULT_CKPT_WAIT seconds (default 30) elapse; returns the step
+    list either way. A fault that fires before anything is durable
+    tests start-from-scratch, not the resume/fallback path the test
+    meant to exercise."""
+    timeout = float(os.environ.get("PT_FAULT_CKPT_WAIT") or 30.0)
+    deadline = time.monotonic() + timeout
+    while True:
+        steps = _complete_ckpt_steps(ckpt_dir)
+        if len(steps) >= k or time.monotonic() >= deadline:
+            return steps
+        _touch_heartbeat()
+        time.sleep(0.05)
+
+
 def corrupt_newest_checkpoint(ckpt_dir, mode):
     """Damage the newest published ``ckpt_<step>.shard*.npz`` under
     ``ckpt_dir``. Returns the path, or None when no shard exists yet
@@ -152,6 +274,15 @@ def corrupt_newest_checkpoint(ckpt_dir, mode):
     return path
 
 
+#: checkpoint-fault tags whose bounded _await_complete_steps already
+#: timed out once this process: later maybe_fault calls probe cheaply
+#: instead of re-paying the full PT_FAULT_CKPT_WAIT every step (a dir
+#: that can never hold two complete steps — keep_max=1 — would
+#: otherwise stall the loop ~30s/step with no error until the
+#: harness's own timeout)
+_ckpt_wait_spent = set()
+
+
 def _maybe_ckpt_fault(step, ckpt_dir):
     for env_name, mode in (("PT_FAULT_TORN_CKPT", "torn"),
                            ("PT_FAULT_BITFLIP_CKPT", "bitflip")):
@@ -164,20 +295,50 @@ def _maybe_ckpt_fault(step, ckpt_dir):
         d = ckpt_dir or os.environ.get("PT_FAULT_CKPT_DIR")
         if not d:
             continue
-        # probe BEFORE claiming the once-marker: no shard published yet
-        # means the fault stays armed for a later step (>= above) —
-        # mirroring poison_feed's claim-on-injection rule
-        if _newest_shard(d) is None:
+        # wait (bounded, ONCE) for a FALLBACK, then corrupt the newest
+        # complete step — restore() must quarantine it and land on the
+        # verified predecessor. Probe BEFORE claiming the once-marker:
+        # fewer than two complete steps means the fault stays armed
+        # for a later step (>= above) — mirroring poison_feed's
+        # claim-on-injection rule
+        if tag in _ckpt_wait_spent:
+            steps = _complete_ckpt_steps(d)
+        else:
+            steps = _await_complete_steps(d, 2)
+            if len(steps) < 2:
+                _ckpt_wait_spent.add(tag)
+        if len(steps) < 2:
             continue
         if not _fire_once(tag):
             return
-        path = corrupt_newest_checkpoint(d, mode)
-        if path is None:
-            return          # shard vanished under us (prune race)
-        sys.stderr.write(f"[faults] {mode}-corrupted {path} at step "
-                         f"{step}; exiting {CKPT_FAULT_EXIT_CODE}\n")
+        # FREEZE the async writer before corrupting: it shares this
+        # process, and a step it publishes between the sweep's final
+        # probe and os._exit would hand restore() a healthy newer
+        # step, masking the corruption entirely. Any _write starting
+        # after this point is a no-op; the bounded grace lets one
+        # already past the patch point finish publishing so the sweep
+        # below sees (and corrupts) its step. os._exit never returns
+        # in production — the restore after it only runs under tests
+        # that stub _exit, and un-breaks their later checkpoints.
+        from paddle_tpu.io_checkpoint import CheckpointManager
+        orig_write = CheckpointManager._write
+        CheckpointManager._write = lambda self, payload: None
+        grace = min(1.0, float(os.environ.get("PT_FAULT_CKPT_WAIT")
+                               or 30.0))
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            _touch_heartbeat()
+            time.sleep(0.05)
+        hit = _corrupt_newest_and_newer(d, mode)
+        if not hit:         # shards vanished under us (prune race)
+            CheckpointManager._write = orig_write
+            return
+        sys.stderr.write(f"[faults] {mode}-corrupted "
+                         f"{', '.join(hit)} at step {step}; exiting "
+                         f"{CKPT_FAULT_EXIT_CODE}\n")
         sys.stderr.flush()
         os._exit(CKPT_FAULT_EXIT_CODE)
+        CheckpointManager._write = orig_write
 
 
 def maybe_fault(step, ckpt_dir=None):
@@ -189,13 +350,26 @@ def maybe_fault(step, ckpt_dir=None):
     if not _applies_to_rank():
         return
     _maybe_ckpt_fault(step, ckpt_dir)
+
+    def gate(tag):
+        # peek (no claim) first so restarted incarnations never wait;
+        # then optionally await K durable checkpoints (the test is
+        # about to assert "the restart resumed from one"), then claim
+        if _already_fired(tag):
+            return False
+        k = _int_env("PT_FAULT_AWAIT_CKPTS")
+        d = ckpt_dir or os.environ.get("PT_FAULT_CKPT_DIR")
+        if k and d:
+            _await_complete_steps(d, k)     # fire anyway on timeout
+        return _fire_once(tag)
+
     crash_at = _int_env("PT_FAULT_CRASH_AT_STEP")
-    if crash_at is not None and step == crash_at and _fire_once("crash"):
+    if crash_at is not None and step == crash_at and gate("crash"):
         sys.stderr.write(f"[faults] injected crash at step {step}\n")
         sys.stderr.flush()
         os._exit(CRASH_EXIT_CODE)       # no atexit, no flush: a crash
     hang_at = _int_env("PT_FAULT_HANG_AT_STEP")
-    if hang_at is not None and step == hang_at and _fire_once("hang"):
+    if hang_at is not None and step == hang_at and gate("hang"):
         sys.stderr.write(f"[faults] injected hang at step {step}\n")
         sys.stderr.flush()
         while True:                     # alive but silent: heartbeats

@@ -225,6 +225,170 @@ class TestLastGoodFallback:
         assert seen[0] == 0 and float(out["w"]) == 4.0
 
 
+class TestTransientIO:
+    """A transient OSError (NFS hiccup, EIO) is NOT corruption: the
+    read retries and then the OSError re-raises, so the supervisor's
+    restart budget handles it — the newest good checkpoint must never
+    be quarantined over a disk blip."""
+
+    def test_flaky_read_retried_then_verifies(self, tmp_path,
+                                              monkeypatch):
+        import paddle_tpu.io_checkpoint as ioc
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        real_load = np.load
+        calls = []
+
+        def flaky(path, **kw):
+            calls.append(path)
+            if len(calls) <= 2:
+                raise OSError(5, "Input/output error")
+            return real_load(path, **kw)
+
+        monkeypatch.setattr(ioc.np, "load", flaky)
+        manifest, arrays = verify_shard(_shard(tmp_path, 1),
+                                        retry_delay=0.001)
+        assert "integrity" in manifest and len(calls) == 3
+
+    def test_persistent_oserror_raises_oserror_not_corrupt(
+            self, tmp_path, monkeypatch):
+        import paddle_tpu.io_checkpoint as ioc
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+
+        def dead(path, **kw):
+            raise OSError(5, "Input/output error")
+
+        monkeypatch.setattr(ioc.np, "load", dead)
+        with pytest.raises(OSError) as ei:
+            verify_shard(_shard(tmp_path, 1), retry_delay=0.001)
+        assert not isinstance(ei.value, CheckpointCorruptError)
+
+    def test_restore_does_not_quarantine_on_transient_error(
+            self, tmp_path, monkeypatch):
+        """restore(step=None) must crash-and-retry on I/O errors, not
+        demote the newest (good!) checkpoint to *.corrupt."""
+        import paddle_tpu.io_checkpoint as ioc
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2):
+            mgr.save(s, _state(s))
+        mgr.close()
+        before = REGISTRY.get("corrupt_checkpoints_total").value()
+
+        def dead(path, **kw):
+            raise OSError(5, "Input/output error")
+
+        monkeypatch.setattr(ioc.np, "load", dead)
+        with pytest.raises(OSError):
+            _mgr(tmp_path).restore()
+        monkeypatch.undo()
+        assert REGISTRY.get("corrupt_checkpoints_total").value() \
+            == before
+        assert os.path.exists(_shard(tmp_path, 2))      # untouched
+        assert not os.path.exists(_shard(tmp_path, 2) + ".corrupt")
+        # and once the disk heals, the same dir restores the newest
+        tree, step = _mgr(tmp_path).restore()
+        assert step == 2
+
+    def test_step_complete_shard_stat_blip_retried(self, tmp_path,
+                                                   monkeypatch):
+        """os.path.exists swallows EVERY OSError into False — a stat
+        blip (ESTALE) on the newest step's shard would silently drop
+        it from _complete_steps. The presence probe must retry the
+        blip and still count the step."""
+        import paddle_tpu.io_checkpoint as ioc
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2):
+            mgr.save(s, _state(s))
+        mgr.close()
+        shard2 = _shard(tmp_path, 2)
+        real_stat = os.stat
+        calls = {"n": 0}
+
+        def flaky(path, *a, **kw):
+            if os.fspath(path) == shard2:
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    raise OSError(116, "Stale file handle")
+            return real_stat(path, *a, **kw)
+
+        monkeypatch.setattr(ioc.os, "stat", flaky)
+        assert _mgr(tmp_path).latest_step() == 2
+        monkeypatch.undo()
+        assert calls["n"] == 3
+
+    def test_restore_meta_blip_retried_not_fatal(self, tmp_path,
+                                                 monkeypatch):
+        """The META read on the restore path (_read_own_shard) gets
+        the same transient-retry treatment as every other read: one
+        NFS blip on ckpt_N.json must not crash the host (multi-host,
+        it would also burn every peer's coord_timeout mid-protocol)."""
+        import builtins
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        meta = _meta(tmp_path, 1)
+        real_open = builtins.open
+        calls = {"n": 0}
+
+        def flaky_open(path, *a, **kw):
+            if os.fspath(path) == meta:
+                calls["n"] += 1
+                if calls["n"] <= 2:
+                    raise OSError(5, "Input/output error")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr(builtins, "open", flaky_open)
+        m2 = _mgr(tmp_path)
+        tree, step = m2.restore(step=1)     # explicit: no walk-back
+        m2.close()
+        assert step == 1 and calls["n"] == 3
+        assert float(tree["w"][0]) == 1.0
+
+    def test_step_complete_meta_blip_retried_not_dropped(
+            self, tmp_path, monkeypatch):
+        """A transient I/O error reading ckpt_N.json must not silently
+        classify the step as incomplete — restore would quietly fall
+        back past the newest GOOD step with no warning. The read
+        retries like shard reads do."""
+        import paddle_tpu.io_checkpoint as ioc
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        m2 = _mgr(tmp_path)
+        real = ioc.json.load
+        calls = []
+
+        def flaky(f, **kw):
+            calls.append(1)
+            if len(calls) <= 2:
+                raise OSError(5, "Input/output error")
+            return real(f, **kw)
+
+        monkeypatch.setattr(ioc.json, "load", flaky)
+        assert m2._step_complete(1, retry_delay=0.001)
+        assert len(calls) == 3
+        m2.close()
+
+    def test_step_complete_persistent_meta_error_raises(
+            self, tmp_path, monkeypatch):
+        import paddle_tpu.io_checkpoint as ioc
+        mgr = _mgr(tmp_path)
+        mgr.save(1, _state(1))
+        mgr.close()
+        m2 = _mgr(tmp_path)
+
+        def dead(f, **kw):
+            raise OSError(5, "Input/output error")
+
+        monkeypatch.setattr(ioc.json, "load", dead)
+        with pytest.raises(OSError):
+            m2._step_complete(1, retry_delay=0.001)
+        m2.close()
+
+
 class TestDirPathologies:
     def test_meta_without_shard_ignored(self, tmp_path):
         mgr = _mgr(tmp_path, keep_max=10)
@@ -254,20 +418,34 @@ class TestDirPathologies:
     def test_stale_tmps_swept_on_init(self, tmp_path):
         for f in (".ckpt_5.shard0.abc123.tmp.npz",
                   "ckpt_5.shard0.npz.tmp.npz",       # pre-mkstemp name
-                  "ckpt_5.json.tmp"):
+                  "ckpt_5.json.tmp",                 # legacy meta temp
+                  ".ckpt_5.meta.abc123.json.tmp",    # mkstemp meta temp
+                  ".restore.v0.xyz.json.tmp",        # own verdict temp
+                  ".restore.r.xyz.json.tmp",         # round temp
+                  ".restore.d.xyz.json.tmp",         # decision temp
+                  ".restore.h0.json",                # own stale verdict
+                  ".restore.round.json",             # stale round
+                  ".restore.decision.json"):         # stale decision
             open(os.path.join(str(tmp_path), f), "w").close()
         mgr = _mgr(tmp_path)
         left = [f for f in os.listdir(str(tmp_path))
-                if ".tmp" in f]
+                if ".tmp" in f or f.startswith(".restore.")]
         assert left == []
         mgr.close()
 
     def test_sweep_leaves_other_hosts_tmps(self, tmp_path):
-        other = os.path.join(str(tmp_path),
-                             ".ckpt_5.shard1.xyz.tmp.npz")
-        open(other, "w").close()
+        others = [os.path.join(str(tmp_path), f)
+                  for f in (".ckpt_5.shard1.xyz.tmp.npz",
+                            ".restore.h1.json",      # host 1's verdict
+                            ".restore.v1.xyz.json.tmp")]  # and its
+        # in-flight verdict temp: host 1 may be mid-_publish_json
+        # while this host inits — yanking it would crash its
+        # os.replace and cost a gang restart
+        for f in others:
+            open(f, "w").close()
         mgr = _mgr(tmp_path)            # this host is shard0
-        assert os.path.exists(other)
+        for f in others:
+            assert os.path.exists(f), f
         mgr.close()
 
     def test_quarantined_step_excluded_from_keep_max(self, tmp_path):
@@ -301,6 +479,287 @@ class TestDirPathologies:
         # unverified write
         assert steps == [3, 11], steps
         m2.close()
+
+
+def _host_mgr(path, proc, nproc, **kw):
+    """A manager impersonating host ``proc`` of ``nproc`` (CPU tests
+    have no real multi-process jax; the coordination protocol is pure
+    files, so forcing the host tag exercises it faithfully). The tag
+    is forced DURING __init__ so the stale-temp sweep runs as that
+    host — sweeping as host 0 would delete a live protocol round."""
+    import paddle_tpu.io_checkpoint as ioc
+    orig = ioc._host_tag
+    ioc._host_tag = lambda: (proc, nproc)
+    try:
+        return _mgr(path, **kw)
+    finally:
+        ioc._host_tag = orig
+
+
+class TestSharedDirMultiHost:
+    """restore(step=None) on a SHARED checkpoint dir is a collective:
+    hosts must agree on ONE step, or ranks silently resume from
+    different steps and data-parallel training corrupts."""
+
+    def _save_two_host(self, tmp_path, steps):
+        m1 = _host_mgr(tmp_path, 1, 2, keep_max=10)
+        m0 = _host_mgr(tmp_path, 0, 2, keep_max=10)
+        for s in steps:
+            m1.save(s, _state(s))       # shard1 first: host 0 waits
+            m0.save(s, _state(s))       # for peers before the meta
+        m1.close()
+        m0.close()
+
+    def _restore_both(self, m0, m1, timeout=30.0):
+        import threading
+        m0.coord_timeout = m1.coord_timeout = timeout
+        res, errs = {}, {}
+
+        def run(tag, m):
+            try:
+                res[tag] = m.restore()
+            except Exception as e:      # noqa: BLE001 — re-asserted
+                errs[tag] = e
+
+        t = threading.Thread(target=run, args=(1, m1), daemon=True)
+        t.start()
+        run(0, m0)
+        t.join(timeout)
+        assert not t.is_alive(), "host 1 restore hung"
+        return res, errs
+
+    def test_one_hosts_corrupt_shard_walks_both_hosts_back(
+            self, tmp_path):
+        """The divergence bug: host 1's shard of step 3 is rotted,
+        host 0's verifies. Without coordination host 0 restores 3 and
+        host 1 restores 2. Both must restore 2."""
+        self._save_two_host(tmp_path, (1, 2, 3))
+        faults.corrupt_checkpoint(_shard(tmp_path, 3, proc=1),
+                                  "bitflip")
+        before = REGISTRY.get("corrupt_checkpoints_total").value()
+        res, errs = self._restore_both(_host_mgr(tmp_path, 0, 2),
+                                       _host_mgr(tmp_path, 1, 2))
+        assert not errs, errs
+        assert res[0][1] == res[1][1] == 2
+        assert float(res[0][0]["w"][0]) == 2.0
+        assert float(res[1][0]["w"][0]) == 2.0
+        # the WHOLE step is quarantined — host 0's healthy shard too,
+        # else it leaks forever once the meta is renamed
+        assert os.path.exists(_shard(tmp_path, 3, 0) + ".corrupt")
+        assert os.path.exists(_shard(tmp_path, 3, 1) + ".corrupt")
+        assert os.path.exists(_meta(tmp_path, 3) + ".corrupt")
+        assert not os.path.exists(_shard(tmp_path, 3, 0))
+        assert REGISTRY.get("corrupt_checkpoints_total").value() \
+            == before + 1
+
+    def test_healthy_shared_dir_restores_newest_on_both(self, tmp_path):
+        self._save_two_host(tmp_path, (1, 2))
+        res, errs = self._restore_both(_host_mgr(tmp_path, 0, 2),
+                                       _host_mgr(tmp_path, 1, 2))
+        assert not errs, errs
+        assert res[0][1] == res[1][1] == 2
+
+    def test_healthy_restore_reads_one_shard_per_host(
+            self, tmp_path, monkeypatch):
+        """The opening round verifies newest-first and STOPS at the
+        first good step: a healthy keep_max-deep dir costs ONE shard
+        read+CRC per host per restart, not keep_max of them."""
+        import paddle_tpu.io_checkpoint as ioc
+        self._save_two_host(tmp_path, (1, 2, 3))
+        m0 = _host_mgr(tmp_path, 0, 2)
+        m1 = _host_mgr(tmp_path, 1, 2)
+        reads = []
+        orig = ioc.verify_shard
+
+        def counting(path, **kw):
+            reads.append(os.path.basename(path))
+            return orig(path, **kw)
+
+        monkeypatch.setattr(ioc, "verify_shard", counting)
+        res, errs = self._restore_both(m0, m1)
+        assert not errs, errs
+        assert res[0][1] == res[1][1] == 3
+        assert sorted(reads) == ["ckpt_3.shard0.npz",
+                                 "ckpt_3.shard1.npz"]
+
+    def test_lead_announces_round_before_verifying(self, tmp_path):
+        """Host 0 publishes the round announcement BEFORE its own CRC
+        pass (like the escalated full round always did): followers
+        verify in parallel instead of idling their coord_timeout away
+        while host 0 reads large shards."""
+        import types
+        self._save_two_host(tmp_path, (1, 2))
+        m0 = _host_mgr(tmp_path, 0, 2)
+        m1 = _host_mgr(tmp_path, 1, 2)
+        round_up_at_verify = []
+        orig = m0._verify_own
+
+        def spying(self, steps, verify, stop_at_first_ok):
+            round_up_at_verify.append(
+                os.path.exists(self._round_path()))
+            return orig(steps, verify,
+                        stop_at_first_ok=stop_at_first_ok)
+
+        m0._verify_own = types.MethodType(spying, m0)
+        res, errs = self._restore_both(m0, m1)
+        assert not errs, errs
+        assert res[0][1] == res[1][1] == 2
+        assert round_up_at_verify and all(round_up_at_verify)
+
+    def test_verify_own_skips_step_quarantined_under_it(
+            self, tmp_path):
+        """A step quarantined (or pruned) out from under a host mid-
+        protocol — host 0's prior incarnation renamed it *.corrupt
+        and died before publishing the decision — must read as
+        neither ok nor bad, not crash the follower with EnforceNotMet
+        on the vanished meta."""
+        self._save_two_host(tmp_path, (1, 2))
+        m1 = _host_mgr(tmp_path, 1, 2)
+        m1._quarantine(2, "peer incarnation found rot")
+        ok, bad, cache = m1._verify_own([1, 2], True,
+                                        stop_at_first_ok=False)
+        assert ok == [1]
+        assert 2 not in bad         # no positive corruption evidence
+        assert cache is not None and cache[0] == 1
+
+    def test_follower_budget_resets_on_new_round(self, tmp_path):
+        """A follower's coord_timeout is a per-ROUND budget, not a
+        whole-protocol one: observing a fresh round id (host 0 alive,
+        escalating) restarts the clock. Without the reset, first-pass
+        time already spent would make a slow escalated full pass a
+        deterministic timeout -> gang-restart loop. Here host 0 is
+        scripted by hand with gaps each UNDER the budget but totalling
+        OVER it — only a reset-on-progress follower survives."""
+        import json as _json
+        import threading
+        import time as _time
+        self._save_two_host(tmp_path, (1, 2))
+        m0 = _host_mgr(tmp_path, 0, 2)
+        m1 = _host_mgr(tmp_path, 1, 2)
+        m1.coord_timeout = 2.0
+        gap = 1.4
+
+        def host0():
+            m0._publish_json(m0._round_path(),
+                             {"round": "r1", "mode": "first"},
+                             prefix=".restore.r.")
+            _time.sleep(gap)
+            m0._publish_json(m0._round_path(),
+                             {"round": "r2", "mode": "full"},
+                             prefix=".restore.r.")
+            _time.sleep(gap)
+            with open(m0._verdict_path(1)) as f:
+                nonce = _json.load(f)["nonce"]
+            m0._publish_json(m0._decision_path(),
+                             {"step": 2, "nonces": {"1": nonce},
+                              "quarantined": []},
+                             prefix=".restore.d.")
+
+        t = threading.Thread(target=host0, daemon=True)
+        t.start()
+        tree, step = m1.restore()       # total wait ~2.8s > 2.0 budget
+        t.join(10)
+        assert step == 2
+        assert float(tree["w"][0]) == 2.0
+
+    def test_no_commonly_verified_step_raises_on_both(self, tmp_path):
+        self._save_two_host(tmp_path, (1, 2))
+        faults.corrupt_checkpoint(_shard(tmp_path, 2, proc=0), "torn")
+        faults.corrupt_checkpoint(_shard(tmp_path, 1, proc=1),
+                                  "bitflip")
+        res, errs = self._restore_both(_host_mgr(tmp_path, 0, 2),
+                                       _host_mgr(tmp_path, 1, 2))
+        assert not res, res
+        assert isinstance(errs[0], CheckpointCorruptError)
+        assert isinstance(errs[1], CheckpointCorruptError)
+
+    def test_missing_peer_verdict_times_out_loudly(self, tmp_path):
+        """A peer that never enters restore must produce a loud
+        RuntimeError (supervisor restart), not a unilateral pick."""
+        self._save_two_host(tmp_path, (1,))
+        m0 = _host_mgr(tmp_path, 0, 2)
+        m0.coord_timeout = 0.4
+        with pytest.raises(RuntimeError, match="coordination"):
+            m0.restore()
+        m0.close()
+
+    def test_stale_decision_from_dead_incarnation_ignored(
+            self, tmp_path):
+        """A leftover round + decision pair must not be trusted: the
+        decision's nonce echo is not the one this host just published,
+        so the host keeps waiting (and times out) instead of restoring
+        a stale — possibly since-pruned — step."""
+        self._save_two_host(tmp_path, (1, 2))
+        m1 = _host_mgr(tmp_path, 1, 2)      # init BEFORE the stale
+        m1.coord_timeout = 0.4              # files: past the sweep,
+        # the nonce echo is the only defense
+        with open(os.path.join(str(tmp_path),
+                               ".restore.round.json"), "w") as f:
+            json.dump({"round": "stale-round"}, f)
+        with open(os.path.join(str(tmp_path),
+                               ".restore.decision.json"), "w") as f:
+            json.dump({"step": 1, "nonces": {"1": "stale"}}, f)
+        with pytest.raises(RuntimeError, match="coordination"):
+            m1.restore()
+        m1.close()
+
+    def test_stale_peer_verdict_not_trusted_by_host0(self, tmp_path):
+        """A dead incarnation's verdict file for host 1 is on disk
+        when host 0 enters restore first. Host 0 must NOT decide on
+        it (its round tag is stale): it waits, the live host 1
+        republishes under the fresh round, and both hosts agree —
+        one clean handshake, not a timeout->restart loop."""
+        import threading
+        import time as _time
+        self._save_two_host(tmp_path, (1, 2))
+        with open(os.path.join(str(tmp_path),
+                               ".restore.h1.json"), "w") as f:
+            json.dump({"round": "dead-round", "nonce": "dead",
+                       "ok": [1], "bad": {}}, f)   # stale: only step 1
+        m0 = _host_mgr(tmp_path, 0, 2)
+        m0.coord_timeout = 30.0
+        res, errs = {}, {}
+
+        def run0():
+            try:
+                res[0] = m0.restore()
+            except Exception as e:      # noqa: BLE001 — re-asserted
+                errs[0] = e
+
+        t = threading.Thread(target=run0, daemon=True)
+        t.start()
+        _time.sleep(0.5)        # host 0 must still be WAITING, not
+        assert 0 not in res     # returned with the stale verdict's
+        assert 0 not in errs    # step-1 pick
+        m1 = _host_mgr(tmp_path, 1, 2)  # live host 1 arrives late;
+        m1.coord_timeout = 30.0         # its init swept the stale
+        res[1] = m1.restore()           # verdict, fresh one republishes
+        t.join(30)
+        assert not t.is_alive() and not errs, errs
+        assert res[0][1] == res[1][1] == 2
+        m0.close()
+        m1.close()
+
+    def test_quarantine_renames_every_hosts_shard(self, tmp_path):
+        """Single-host walk-back over a dir holding a multi-host step:
+        quarantining must rename ALL shardP files, not just its own
+        (orphan shards of a meta-less step are invisible to _prune)."""
+        import shutil
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2, 3):
+            mgr.save(s, _state(s))
+        mgr.close()
+        shutil.copyfile(_shard(tmp_path, 3, 0), _shard(tmp_path, 3, 1))
+        with open(_meta(tmp_path, 3), "w") as f:
+            json.dump({"step": 3, "nproc": 2}, f)
+        faults.corrupt_checkpoint(_shard(tmp_path, 3, 0), "bitflip")
+        mgr2 = _mgr(tmp_path)
+        tree, step = mgr2.restore()
+        assert step == 2
+        for p in (0, 1):
+            assert os.path.exists(_shard(tmp_path, 3, p) + ".corrupt")
+            assert not os.path.exists(_shard(tmp_path, 3, p))
+        mgr2.close()
 
 
 class TestDataStatePlumbing:
@@ -392,6 +851,96 @@ class TestFsckTool:
         assert step == 2
         mgr.close()
 
+    def test_quarantine_spares_unreadable_steps(self, tmp_path,
+                                                monkeypatch, capsys):
+        """--quarantine must act only on POSITIVE corruption evidence:
+        a step that is merely unreadable (I/O error through retries —
+        maybe a sick NFS mount in front of a perfectly good
+        checkpoint) is reported but never renamed *.corrupt."""
+        import paddle_tpu.io_checkpoint as ioc
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fsck_checkpoint
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2):
+            mgr.save(s, _state(s))
+        mgr.close()
+        sick = _shard(tmp_path, 2)
+        real_load = np.load
+
+        def flaky(path, **kw):
+            if os.fspath(path) == sick:
+                raise OSError(5, "Input/output error")
+            return real_load(path, **kw)
+
+        monkeypatch.setattr(ioc.np, "load", flaky)
+        rc = fsck_checkpoint.main([str(tmp_path), "--quarantine"])
+        monkeypatch.undo()
+        out = capsys.readouterr().out
+        assert rc == 1 and "step 2: unreadable" in out
+        assert os.path.exists(sick)                 # untouched
+        assert not os.path.exists(sick + ".corrupt")
+        assert os.path.exists(_meta(tmp_path, 2))
+        # once the mount heals, the newest step restores intact
+        m2 = _mgr(tmp_path)
+        tree, step = m2.restore()
+        assert step == 2
+        m2.close()
+
+    def test_fsck_meta_io_error_is_unreadable_never_renamed(
+            self, tmp_path, capsys):
+        """The transient-I/O-is-not-corruption rule covers the META
+        read too: an OSError reading ckpt_N.json reports the step
+        `unreadable` (retried first), and --quarantine must NOT
+        rename it — the shards behind a sick mount may be perfectly
+        good."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fsck_checkpoint
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2):
+            mgr.save(s, _state(s))
+        mgr.close()
+        # a persistent not-FileNotFound OSError on every read: the
+        # meta path is a DIRECTORY (IsADirectoryError)
+        os.remove(_meta(tmp_path, 2))
+        os.mkdir(_meta(tmp_path, 2))
+        rc = fsck_checkpoint.main([str(tmp_path), "--quarantine"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "step 2: unreadable" in out
+        assert "step 1: ok" in out
+        assert os.path.exists(_shard(tmp_path, 2))      # untouched
+        assert not os.path.exists(_shard(tmp_path, 2) + ".corrupt")
+
+    def test_fsck_shard_stat_error_unreadable_never_renamed(
+            self, tmp_path, monkeypatch, capsys):
+        """A persistent stat error probing a shard's presence must
+        read as `unreadable`, not `incomplete`: incomplete steps ARE
+        renamed by --quarantine, and a sick mount in front of a
+        present shard is not evidence the step cannot restore."""
+        import paddle_tpu.io_checkpoint as ioc
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fsck_checkpoint
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2):
+            mgr.save(s, _state(s))
+        mgr.close()
+        shard2 = _shard(tmp_path, 2)
+        real_stat = os.stat
+
+        def dead(path, *a, **kw):
+            if os.fspath(path) == shard2:
+                raise OSError(5, "Input/output error")
+            return real_stat(path, *a, **kw)
+
+        monkeypatch.setattr(ioc.os, "stat", dead)
+        rc = fsck_checkpoint.main([str(tmp_path), "--quarantine"])
+        monkeypatch.undo()
+        out = capsys.readouterr().out
+        assert rc == 1 and "step 2: unreadable" in out
+        assert "incomplete" not in out
+        assert os.path.exists(shard2)                   # untouched
+        assert not os.path.exists(shard2 + ".corrupt")
+        assert os.path.exists(_meta(tmp_path, 2))
+
 
 class TestCkptFaultModes:
     def test_corrupt_newest_picks_highest_step(self, tmp_path):
@@ -412,10 +961,12 @@ class TestCkptFaultModes:
 
     def test_maybe_fault_bitflip_corrupts_and_exits_29(
             self, tmp_path, monkeypatch):
-        mgr = _mgr(tmp_path)
+        mgr = _mgr(tmp_path, keep_max=10)
+        mgr.save(1, _state(1))
         mgr.save(2, _state(2))
         mgr.close()
         monkeypatch.setenv("PT_FAULT_BITFLIP_CKPT", "5")
+        monkeypatch.setenv("PT_FAULT_CKPT_WAIT", "0")
         monkeypatch.delenv("PT_FAULT_RANK", raising=False)
         monkeypatch.delenv("PT_FAULT_ONCE_DIR", raising=False)
         exits = []
@@ -423,14 +974,26 @@ class TestCkptFaultModes:
                             lambda code: exits.append(code))
         faults.maybe_fault(4, ckpt_dir=str(tmp_path))   # not yet
         assert exits == []
+        import paddle_tpu.io_checkpoint as ioc
+        write_before = ioc.CheckpointManager._write
         faults.maybe_fault(5, ckpt_dir=str(tmp_path))
         assert exits == [faults.CKPT_FAULT_EXIT_CODE]
+        # the newest COMPLETE step is hit; the fallback stays intact
         with pytest.raises(CheckpointCorruptError):
             verify_shard(_shard(tmp_path, 2))
+        verify_shard(_shard(tmp_path, 1))
+        # the fault froze the async writer (no healthy step can
+        # publish between its final probe and os._exit) and, since
+        # our stubbed _exit returned, un-froze it again
+        assert ioc.CheckpointManager._write is write_before
 
-    def test_fault_stays_armed_until_a_shard_exists(
+    def test_fault_stays_armed_until_fallback_exists(
             self, tmp_path, monkeypatch):
+        """The corruption faults fire only once TWO complete steps
+        exist: corrupting the only checkpoint would test start-from-
+        scratch, not the quarantine-and-fall-back path."""
         monkeypatch.setenv("PT_FAULT_TORN_CKPT", "3")
+        monkeypatch.setenv("PT_FAULT_CKPT_WAIT", "0")
         monkeypatch.setenv("PT_FAULT_ONCE_DIR",
                            str(tmp_path / "once"))
         monkeypatch.delenv("PT_FAULT_RANK", raising=False)
@@ -439,22 +1002,192 @@ class TestCkptFaultModes:
                             lambda code: exits.append(code))
         ckpt = tmp_path / "ckpt"
         ckpt.mkdir()
-        faults.maybe_fault(3, ckpt_dir=str(ckpt))   # no shard yet
+        faults.maybe_fault(3, ckpt_dir=str(ckpt))   # nothing yet
         assert exits == [] and not faults._already_fired("torn_ckpt")
-        mgr = _mgr(ckpt)
+        mgr = _mgr(ckpt, keep_max=10)
         mgr.save(4, _state(4))
         mgr.close()
-        faults.maybe_fault(4, ckpt_dir=str(ckpt))   # >= at: still armed
+        # one complete step: STILL armed (no fallback to land on)
+        faults.maybe_fault(4, ckpt_dir=str(ckpt))
+        assert exits == [] and not faults._already_fired("torn_ckpt")
+        mgr = _mgr(ckpt, keep_max=10)
+        mgr.save(5, _state(5))
+        mgr.close()
+        faults.maybe_fault(5, ckpt_dir=str(ckpt))
         assert exits == [faults.CKPT_FAULT_EXIT_CODE]
         assert faults._already_fired("torn_ckpt")
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(_shard(ckpt, 5))
+        verify_shard(_shard(ckpt, 4))       # fallback untouched
         # a restarted incarnation runs clean and corrupts nothing
         exits.clear()
-        mgr2 = _mgr(ckpt)
+        mgr2 = _mgr(ckpt, keep_max=10)
         mgr2.save(9, _state(9))
         mgr2.close()
         faults.maybe_fault(9, ckpt_dir=str(ckpt))
         assert exits == []
         verify_shard(_shard(ckpt, 9))       # still intact
+
+    def test_fault_hits_newest_complete_and_newer_shards(
+            self, tmp_path, monkeypatch):
+        """The newest COMPLETE step is corrupted (that's what restore
+        will look at), and so is any already-published NEWER shard:
+        the async writer lives in the faulted process and can publish
+        that shard's meta between the fault's probe and os._exit — a
+        healthy newer step would let restore succeed with no
+        quarantine, the exact path the fault exists to deny. The
+        fallback predecessor stays intact."""
+        mgr = _mgr(tmp_path, keep_max=10)
+        mgr.save(1, _state(1))
+        mgr.save(2, _state(2))
+        mgr.close()
+        # a step-7 shard with NO ckpt_7.json yet: in-flight async save
+        import shutil
+        shutil.copy(_shard(tmp_path, 2), _shard(tmp_path, 7))
+        monkeypatch.setenv("PT_FAULT_BITFLIP_CKPT", "5")
+        monkeypatch.setenv("PT_FAULT_CKPT_WAIT", "0")
+        monkeypatch.delenv("PT_FAULT_RANK", raising=False)
+        monkeypatch.delenv("PT_FAULT_ONCE_DIR", raising=False)
+        exits = []
+        monkeypatch.setattr(faults.os, "_exit",
+                            lambda code: exits.append(code))
+        faults.maybe_fault(5, ckpt_dir=str(tmp_path))
+        assert exits == [faults.CKPT_FAULT_EXIT_CODE]
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(_shard(tmp_path, 2))   # newest COMPLETE hit
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(_shard(tmp_path, 7))   # newer in-flight hit
+        verify_shard(_shard(tmp_path, 1))       # fallback untouched
+
+    def test_fault_sweep_catches_step_published_mid_corruption(
+            self, tmp_path, monkeypatch):
+        """The corrupt-then-re-probe loop: a step that becomes
+        complete WHILE the fault is corrupting (writer drained its
+        queue concurrently) is caught on the next pass instead of
+        surviving as healthy fallback-masking material."""
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2):
+            mgr.save(s, _state(s))
+        mgr.close()
+        published = {"done": False}
+        real_corrupt = faults.corrupt_checkpoint
+
+        def corrupt_and_publish(path, mode):
+            real_corrupt(path, mode)
+            if not published["done"]:
+                published["done"] = True        # writer publishes 3
+                m2 = _mgr(tmp_path, keep_max=10)
+                m2.save(3, _state(3))
+                m2.close()
+
+        monkeypatch.setattr(faults, "corrupt_checkpoint",
+                            corrupt_and_publish)
+        hit = faults._corrupt_newest_and_newer(str(tmp_path),
+                                               "bitflip")
+        assert any(p.endswith("ckpt_2.shard0.npz") for p in hit)
+        assert any(p.endswith("ckpt_3.shard0.npz") for p in hit)
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(_shard(tmp_path, 3))
+        verify_shard(_shard(tmp_path, 1))       # fallback untouched
+        # restore now MUST walk back to step 1, quarantining 2 and 3
+        m3 = _mgr(tmp_path, keep_max=10)
+        _, step = m3.restore()
+        assert step == 1
+        m3.close()
+
+    def test_corrupt_sweep_bounded_when_shard_uncorruptible(
+            self, tmp_path, monkeypatch):
+        """A shard whose corruption attempt raises persistently
+        (EACCES, sick mount) is tried ONCE and skipped — re-selecting
+        it every re-probe pass would spin the sweep forever with no
+        timeout, hanging the faulted rank in harness machinery."""
+        mgr = _mgr(tmp_path, keep_max=10)
+        for s in (1, 2):
+            mgr.save(s, _state(s))
+        mgr.close()
+        calls = []
+
+        def failing(path, mode):
+            calls.append(path)
+            raise OSError(13, "Permission denied")
+
+        monkeypatch.setattr(faults, "corrupt_checkpoint", failing)
+        hit = faults._corrupt_newest_and_newer(str(tmp_path),
+                                               "bitflip")
+        assert hit == []
+        assert len(calls) == 1          # newest complete, tried once
+
+    def test_armed_fault_pays_bounded_wait_once(self, tmp_path,
+                                                monkeypatch):
+        """A dir that never reaches two complete steps (keep_max=1
+        pruning) must not stall the training loop PT_FAULT_CKPT_WAIT
+        per step: the bounded wait is paid ONCE, later armed calls
+        probe cheaply — and the fault still fires the moment a
+        fallback exists."""
+        import time as _time
+        monkeypatch.setenv("PT_FAULT_TORN_CKPT", "1")
+        monkeypatch.setenv("PT_FAULT_CKPT_WAIT", "0.3")
+        monkeypatch.delenv("PT_FAULT_RANK", raising=False)
+        monkeypatch.delenv("PT_FAULT_ONCE_DIR", raising=False)
+        faults._ckpt_wait_spent.discard("torn_ckpt")
+        exits = []
+        monkeypatch.setattr(faults.os, "_exit",
+                            lambda code: exits.append(code))
+        mgr = _mgr(tmp_path, keep_max=1)
+        mgr.save(1, _state(1))
+        mgr.close()
+        t0 = _time.monotonic()
+        faults.maybe_fault(1, ckpt_dir=str(tmp_path))
+        first = _time.monotonic() - t0
+        t0 = _time.monotonic()
+        for s in (2, 3, 4):
+            faults.maybe_fault(s, ckpt_dir=str(tmp_path))
+        later = _time.monotonic() - t0
+        assert exits == []
+        assert first >= 0.25, "bounded wait never paid"
+        assert later < 0.25, "armed fault re-paid the wait per step"
+        mgr = _mgr(tmp_path, keep_max=10)
+        mgr.save(5, _state(5))
+        mgr.close()
+        faults.maybe_fault(5, ckpt_dir=str(tmp_path))
+        assert exits == [faults.CKPT_FAULT_EXIT_CODE]
+        with pytest.raises(CheckpointCorruptError):
+            verify_shard(_shard(tmp_path, 5))
+        verify_shard(_shard(tmp_path, 1))       # fallback untouched
+
+    def test_crash_await_ckpts_gate(self, tmp_path, monkeypatch):
+        """PT_FAULT_AWAIT_CKPTS delays a crash fault until K complete
+        checkpoints exist (fires anyway after PT_FAULT_CKPT_WAIT)."""
+        monkeypatch.setenv("PT_FAULT_CRASH_AT_STEP", "2")
+        monkeypatch.setenv("PT_FAULT_AWAIT_CKPTS", "1")
+        monkeypatch.setenv("PT_FAULT_CKPT_WAIT", "0")
+        monkeypatch.delenv("PT_FAULT_RANK", raising=False)
+        monkeypatch.delenv("PT_FAULT_ONCE_DIR", raising=False)
+        exits = []
+        monkeypatch.setattr(faults.os, "_exit",
+                            lambda code: exits.append(code))
+        mgr = _mgr(tmp_path)
+        mgr.save(0, _state(0))
+        mgr.close()
+        faults.maybe_fault(2, ckpt_dir=str(tmp_path))
+        assert exits == [faults.CRASH_EXIT_CODE]
+        # timeout=0 + empty dir: the gate can't block, still fires
+        exits.clear()
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        faults.maybe_fault(2, ckpt_dir=str(empty))
+        assert exits == [faults.CRASH_EXIT_CODE]
+
+    def test_complete_ckpt_steps_ignores_partial(self, tmp_path):
+        mgr = _mgr(tmp_path, keep_max=10)
+        mgr.save(1, _state(1))
+        mgr.save(3, _state(3))
+        mgr.close()
+        # meta without shard + shard without meta: both incomplete
+        (tmp_path / "ckpt_5.json").write_text('{"step":5,"nproc":1}')
+        import shutil
+        shutil.copy(_shard(tmp_path, 1), _shard(tmp_path, 8))
+        assert faults._complete_ckpt_steps(str(tmp_path)) == [1, 3]
 
     def test_rc_label_names_new_exit_code(self):
         from paddle_tpu.distributed.launch import _rc_label
@@ -503,6 +1236,11 @@ class TestCorruptionEndToEnd:
             env.setdefault("PT_FAULT_ONCE_DIR",
                            str(tmp_path / f"{tag}.once"))
         from paddle_tpu.distributed.launch import launch_collective
+        # the ckpt fault waits for TWO complete checkpoints and then
+        # corrupts the newest — deterministic fallback material even
+        # under this host's 50-300ms v9fs fsync stalls, which let the
+        # async writer lag the loop by whole steps (wall-clock step
+        # widening was a coin flip against that)
         rc = launch_collective(
             [WORKER, str(prefix), str(ckpt), str(self.TOTAL), "0.05",
              "1", str(data_dir)],

@@ -172,6 +172,75 @@ class TestStatefulLoader:
         ld = self._loader(data_files)
         assert isinstance(ld._records(), _PyRecordReader)
 
+    @pytest.mark.parametrize("shuffle_buffer", [0, 16])
+    def test_second_iterator_continues_not_replays(self, data_files,
+                                                   shuffle_buffer):
+        """The loader is ONE stream with a cursor: a fresh __iter__
+        (e.g. a per-epoch loop) continues after the last delivered
+        batch. Replaying from the restored snapshot would re-consume
+        records — the silent exactly-once violation."""
+        full = list(self._loader(data_files, epochs=2, seed=3,
+                                 shuffle_buffer=shuffle_buffer))
+        ld = self._loader(data_files, epochs=2, seed=3,
+                          shuffle_buffer=shuffle_buffer)
+        head = []
+        for i, b in enumerate(ld):
+            head.append(b)
+            if i == 4:
+                break
+        tail = list(ld)                 # SECOND iterator, same loader
+        got = np.concatenate(head + tail)
+        assert np.array_equal(got, np.concatenate(full))
+
+    def test_exhausted_stream_reiterates_empty(self, data_files):
+        ld = self._loader(data_files, epochs=1)
+        assert len(list(ld)) == 15
+        assert list(ld) == []           # consumed: nothing replays
+
+    def test_second_iter_supersedes_live_first(self, data_files):
+        """Two concurrently-live iterators would double-deliver
+        records (and the older one would regress the committed
+        cursor); __iter__ closes any live predecessor, so the
+        one-stream contract is enforced, not advisory."""
+        ld = self._loader(data_files, epochs=1)
+        it1 = iter(ld)
+        head = [next(it1) for _ in range(3)]
+        it2 = iter(ld)                  # supersedes it1
+        with pytest.raises(StopIteration):
+            next(it1)                   # it1 is dead: no double batch
+        rest = list(it2)
+        full = list(self._loader(data_files, epochs=1))
+        assert np.array_equal(np.concatenate(head + rest),
+                              np.concatenate(full))
+
+    def test_set_state_supersedes_live_iterator(self, data_files):
+        """A batch delivered by a stale live iterator AFTER set_state
+        would stomp the restored snapshot; set_state closes it."""
+        ld = self._loader(data_files, epochs=1)
+        it = iter(ld)
+        next(it)
+        st = ld.state()
+        ld.set_state(st)
+        with pytest.raises(StopIteration):
+            next(it)
+        full = list(self._loader(data_files, epochs=1))
+        assert np.array_equal(next(iter(ld)), full[1])
+
+    def test_set_state_overrides_delivered_cursor(self, data_files):
+        """An explicit set_state after delivery wins over
+        continuation: the next iterator starts from the snapshot."""
+        ld = self._loader(data_files, epochs=1)
+        it = iter(ld)
+        first = next(it)
+        st = ld.state()                 # cursor after batch 0
+        next(it)
+        it.close()
+        ld.set_state(st)
+        resumed = next(iter(ld))
+        full = list(self._loader(data_files, epochs=1))
+        assert np.array_equal(resumed, full[1])
+        assert not np.array_equal(resumed, first)
+
     def test_records_consumed_metric(self, data_files):
         before = REGISTRY.get("data_records_consumed_total").value()
         list(self._loader(data_files, epochs=1))

@@ -356,6 +356,12 @@ class TestElasticEndToEnd:
         env = dict(SUBPROC_ENV, **fault_env)
         if fault_env:
             env.setdefault("PT_FAULT_ONCE_DIR", str(tmp_path / f"{tag}.once"))
+            # the resume assertions (first_step > 0) need ≥1 COMPLETE
+            # checkpoint durable at fault time; this host's v9fs shows
+            # 50-300ms fsync stalls, so the async writer can lag the
+            # loop by whole steps — gate the fault on the writer, not
+            # on wall-clock step width (which made this a coin flip)
+            env.setdefault("PT_FAULT_AWAIT_CKPTS", "1")
         rc = launch_collective(
             [WORKER, str(prefix), str(ckpt), str(self.TOTAL), "0.05"],
             log_dir=str(tmp_path / "logs"), env_extra=env,

@@ -16,7 +16,11 @@ Per-step statuses:
 - ``ok``          meta + all shards present, every digest verifies
 - ``legacy``      verifies structurally but predates the integrity
                   format (no CRCs recorded) — restorable, not provable
-- ``corrupt``     a shard is unreadable or fails digest verification
+- ``corrupt``     a shard's (or the meta's) content is torn/rotted or
+                  fails digest verification
+- ``unreadable``  an I/O error (shard or meta) persisted through
+                  retries — retry the fsck before trusting the
+                  verdict (NOT proven corrupt)
 - ``incomplete``  meta exists but a shard it promises is missing
 
 Also reported: quarantined steps already renamed ``*.corrupt``, and
@@ -54,7 +58,8 @@ def fsck_dir(dirname):
     "orphan_shards": [...]}`` (shards with no meta — an interrupted
     save whose meta never published, or a hand-deleted meta)."""
     from paddle_tpu.io_checkpoint import (
-        CheckpointCorruptError, verify_shard,
+        CheckpointCorruptError, _retry_transient, _stat_exists,
+        verify_shard,
     )
     meta_re, shard_re = _name_res()
     names = sorted(os.listdir(dirname))
@@ -80,19 +85,49 @@ def fsck_dir(dirname):
     for s in sorted(metas):
         rec = {"step": s, "status": "ok", "detail": "", "shards": {}}
         steps.append(rec)
+        def read_nproc(fname=metas[s]):
+            with open(os.path.join(dirname, fname)) as f:
+                return int(json.load(f).get("nproc", 1))
+
         try:
-            with open(os.path.join(dirname, metas[s])) as f:
-                nproc = int(json.load(f).get("nproc", 1))
-        except (OSError, ValueError, TypeError) as e:
+            nproc = _retry_transient(read_nproc,
+                                     f"checkpoint meta {metas[s]} read")
+        except (ValueError, TypeError) as e:
+            # garbage CONTENT: positive corruption evidence
             rec["status"] = "corrupt"
             rec["detail"] = (f"meta {metas[s]} unreadable "
                              f"({type(e).__name__}: {e})")
+            continue
+        except OSError as e:
+            # persistent I/O failure through retries — same rule as
+            # the shard read below: never proven corrupt, never
+            # renamed by --quarantine (a sick mount must not demote a
+            # good checkpoint)
+            rec["status"] = "unreadable"
+            rec["detail"] = (f"I/O error reading meta {metas[s]} "
+                             f"({type(e).__name__}: {e}) — retry "
+                             f"before trusting this verdict")
             continue
         legacy = False
         for p in range(nproc):
             fname = f"ckpt_{s}.shard{p}.npz"
             path = os.path.join(dirname, fname)
-            if not os.path.exists(path):
+            try:
+                # _stat_exists, not os.path.exists: exists() swallows
+                # a stat blip into "missing", and 'incomplete' steps
+                # ARE renamed by --quarantine — an I/O error must
+                # surface as unreadable (never renamed) instead
+                present = _stat_exists(path)
+            except OSError as e:
+                rec["shards"][fname] = "unreadable"
+                if rec["status"] == "ok":
+                    rec["status"] = "unreadable"
+                    rec["detail"] = (f"I/O error probing {fname} "
+                                     f"({type(e).__name__}: {e}) — "
+                                     f"retry before trusting this "
+                                     f"verdict")
+                continue
+            if not present:
                 rec["shards"][fname] = "missing"
                 rec["status"] = "incomplete"
                 rec["detail"] = (f"meta promises {nproc} shard(s) but "
@@ -105,6 +140,18 @@ def fsck_dir(dirname):
                 if rec["status"] != "incomplete":
                     rec["status"] = "corrupt"
                     rec["detail"] = str(e)
+                continue
+            except OSError as e:
+                # persistent I/O failure even after verify_shard's
+                # retries — report it, but as unreadable-now rather
+                # than proven-corrupt
+                rec["shards"][fname] = "unreadable"
+                if rec["status"] == "ok":
+                    rec["status"] = "unreadable"
+                    rec["detail"] = (f"I/O error reading {fname} "
+                                     f"({type(e).__name__}: {e}) — "
+                                     f"retry before trusting this "
+                                     f"verdict")
                 continue
             if manifest.get("integrity") is None:
                 rec["shards"][fname] = "legacy"
@@ -142,7 +189,9 @@ def main(argv=None):
     ap.add_argument("--quarantine", action="store_true",
                     help="rename corrupt/incomplete steps *.corrupt so "
                          "restore() skips them without paying the "
-                         "verify-and-walk-back at job start")
+                         "verify-and-walk-back at job start (unreadable "
+                         "steps are NEVER renamed: an I/O error is not "
+                         "proof of corruption)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.ckpt_dir):
         print(f"fsck_checkpoint: {args.ckpt_dir}: not a directory",
@@ -159,7 +208,12 @@ def main(argv=None):
             print(f"  {fname}: {st}")
         if rec["status"] not in ("ok", "legacy"):
             bad += 1
-            if args.quarantine:
+            # quarantine needs POSITIVE corruption evidence; an
+            # `unreadable` step (I/O error through retries) may be a
+            # perfectly good checkpoint behind a sick mount — renaming
+            # it would lose progress exactly like restore() quarantining
+            # on a transient OSError would
+            if args.quarantine and rec["status"] != "unreadable":
                 for r in quarantine_step(args.ckpt_dir, rec["step"]):
                     print(f"  quarantined -> {r}")
     for kind, files in sorted(extras.items()):
