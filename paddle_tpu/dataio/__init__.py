@@ -11,7 +11,7 @@ from paddle_tpu.dataio import dataset
 from paddle_tpu.dataio import image
 from paddle_tpu.dataio.feeder import DataFeeder, batch_reader
 from paddle_tpu.dataio.pyreader import PyReader, DataLoader
-from paddle_tpu.dataio.dataloader import FileDataLoader
+from paddle_tpu.dataio.dataloader import FileDataLoader, merge_rank_states
 from paddle_tpu.dataio.fluid_dataset import (
     DatasetFactory, InMemoryDataset, QueueDataset,
 )

@@ -27,8 +27,24 @@ Stateful mode always uses the deterministic single-threaded Python
 reader — the native loader's multi-threaded record order is
 nondeterministic, so there is no sequence a resumed run could rejoin
 (the documented fallback).
+
+Data-parallel slicing and topology-elastic resume (``world_size=`` /
+``rank=``): every rank runs the SAME deterministic job-level stream
+(same files, seed, shuffle) in global batches of ``batch_size`` and
+keeps its contiguous row slice of each batch. Because the job-level
+record order is a pure function of the data — not of the rank count —
+the per-step global batch is identical at any world size, the per-rank
+cursors are positions in one shared stream, and a restart at a
+different rank count resumes exactly: ``merge_rank_states`` folds the
+saved per-rank cursors into one job-level frontier (refusing loudly if
+they diverge), and ``set_state`` on the new topology's loaders
+re-partitions it — no record dropped, none double-consumed. With a
+shuffle buffer the underlying reader resumes by replay-and-skip
+(reservoir history can't be seeked); the rescale logs that, and the
+delivered sequence stays bit-identical.
 """
 
+import logging
 import os
 import weakref
 
@@ -36,7 +52,9 @@ import numpy as np
 
 from paddle_tpu.monitor.registry import counter as _counter
 
-__all__ = ["FileDataLoader"]
+__all__ = ["FileDataLoader", "merge_rank_states"]
+
+_log = logging.getLogger("paddle_tpu.dataio")
 
 _m_batches = _counter("dataio_batches_total",
                       "Batches parsed and stacked by FileDataLoader")
@@ -207,6 +225,58 @@ def _py_record_iter(files, epochs, mode, shuffle_buffer=0, seed=0):
                                 seed=seed))
 
 
+def merge_rank_states(states):
+    """Fold per-rank ``FileDataLoader.state()`` snapshots (taken at
+    the same step) into ONE job-level frontier for topology-elastic
+    resume.
+
+    Data-parallel ranks are row-slices of one deterministic job-level
+    stream, so their cursors MUST agree on every stream field — the
+    merge validates that and strips the per-rank identity (``dp`` rank)
+    rather than inventing a new position. Raises ``ValueError`` naming
+    the diverging fields when they don't: per-rank streams that were
+    not slices of one job-level stream have no exact re-partitioning,
+    and guessing one would silently drop or double-consume records
+    (``io_checkpoint`` turns that into a ``CheckpointTopologyError``).
+    The frontier is a valid ``set_state()`` input for a loader at ANY
+    world size with the same files/seed/shuffle/global batch."""
+    if not states:
+        raise ValueError("no rank states to merge")
+    stripped, dps = [], []
+    for i, s in enumerate(states):
+        if not isinstance(s, dict):
+            raise ValueError(f"rank {i} data state is not a dict "
+                             f"({type(s).__name__})")
+        s = dict(s)
+        dps.append(s.pop("dp", None))
+        stripped.append(s)
+    base = stripped[0]
+    for i, s in enumerate(stripped[1:], 1):
+        if s != base:
+            diff = sorted(k for k in set(base) | set(s)
+                          if base.get(k) != s.get(k))
+            raise ValueError(
+                f"rank 0 and rank {i} data cursors diverge on "
+                f"{diff} — the per-rank streams were not slices of "
+                f"one job-level stream")
+    d0 = dps[0]
+    for i, d in enumerate(dps[1:], 1):
+        for knob in ("world_size", "global_batch"):
+            if (d or {}).get(knob) != (d0 or {}).get(knob):
+                raise ValueError(
+                    f"rank 0 and rank {i} disagree on dp {knob} "
+                    f"({(d0 or {}).get(knob)!r} vs "
+                    f"{(d or {}).get(knob)!r})")
+    frontier = dict(base)
+    if d0 is not None:
+        # keep the WRITING topology (minus the per-rank identity): the
+        # restoring loader uses it to validate the global batch and to
+        # log the world-size change
+        frontier["dp"] = {"world_size": d0.get("world_size"),
+                          "global_batch": d0.get("global_batch")}
+    return frontier
+
+
 class FileDataLoader:
     """Iterate device-ready batches parsed from files.
 
@@ -222,12 +292,20 @@ class FileDataLoader:
     exactly-once resume (see the module docstring); it forces the
     deterministic Python reader even when the native library is
     present, and is incompatible with mode='recordio'.
+
+    ``world_size=W, rank=r`` turns on data-parallel slicing:
+    ``batch_size`` becomes the GLOBAL batch, every rank reads the same
+    deterministic job-level stream, and rank r keeps rows
+    ``[r*B/W, (r+1)*B/W)`` of each global batch. Because the stream is
+    rank-count-independent, a checkpointed cursor rescales exactly
+    onto a different world size (see ``merge_rank_states``). Requires
+    ``batch_size % world_size == 0`` and ``drop_last=True``.
     """
 
     def __init__(self, files, parse_fn, batch_size, nthreads=2,
                  shuffle_buffer=0, seed=0, epochs=1, mode="lines",
                  drop_last=True, device_put=True, prefetch=2,
-                 stateful=False):
+                 stateful=False, world_size=None, rank=None):
         self.files = list(files)
         self.parse_fn = parse_fn
         self.batch_size = batch_size
@@ -240,11 +318,42 @@ class FileDataLoader:
         self.device_put = device_put
         self.prefetch = prefetch
         self.stateful = stateful
+        self.world_size = int(world_size) if world_size is not None \
+            else None
+        self.rank = int(rank) if rank is not None else None
+        if self.world_size is not None:
+            if self.world_size < 1:
+                raise ValueError(f"world_size must be >= 1, got "
+                                 f"{world_size!r}")
+            if self.rank is None or not 0 <= self.rank < self.world_size:
+                raise ValueError(
+                    f"rank must be in [0, world_size={self.world_size}),"
+                    f" got {rank!r}")
+            if batch_size % self.world_size:
+                raise ValueError(
+                    f"batch_size={batch_size} is the GLOBAL batch and "
+                    f"must divide evenly across world_size="
+                    f"{self.world_size} — a ragged split would give "
+                    f"ranks different record counts per step and break "
+                    f"cursor rescaling")
+            if not drop_last:
+                raise ValueError(
+                    "world_size slicing requires drop_last=True: a "
+                    "ragged final global batch cannot be sliced into "
+                    "equal per-rank shares")
+        elif self.rank is not None:
+            raise ValueError("rank= given without world_size=")
         if stateful and mode == "recordio":
             raise RuntimeError(
                 "stateful=True needs the deterministic Python reader, "
                 "which has no RecordIO scanner — use mode='lines' or a "
                 "non-stateful loader")
+        if self.world_size is not None and mode == "recordio":
+            raise RuntimeError(
+                "world_size slicing needs the deterministic Python "
+                "reader (every rank must see the SAME job-level "
+                "stream), which has no RecordIO scanner — use "
+                "mode='lines'")
         self._pending_state = None      # applied at next __iter__
         self._delivered_state = None    # after the last consumed batch
         self._live_iter = None          # stateful: weakref to the one
@@ -255,23 +364,34 @@ class FileDataLoader:
         # cyclic GC next runs
 
     # -- resume cursor -----------------------------------------------------
+    def _dp_block(self):
+        return {"world_size": self.world_size, "rank": self.rank,
+                "global_batch": self.batch_size}
+
     def state(self):
         """The cursor after the last batch the CONSUMER received (not
         the worker's read-ahead). Save it with a checkpoint; a new
         loader ``set_state()``-ed with it continues the exact record
         sequence. Before any batch is delivered this returns the
-        pending (restored) state, or the start-of-stream cursor."""
+        pending (restored) state, or the start-of-stream cursor.
+        Under data-parallel slicing the cursor carries a ``dp`` block
+        (world_size/rank/global_batch) describing THIS topology — the
+        merge/rescale machinery reads it."""
         if not self.stateful:
             raise RuntimeError(
                 "state() on a non-stateful FileDataLoader — construct "
                 "with stateful=True (exactly-once resume needs the "
                 "deterministic reader)")
         if self._delivered_state is not None:
-            return self._delivered_state
-        if self._pending_state is not None:
-            return self._pending_state
-        return _PyRecordReader(self.files, self.epochs, self.mode,
-                               self.shuffle_buffer, self.seed).state()
+            s = self._delivered_state
+        elif self._pending_state is not None:
+            s = self._pending_state
+        else:
+            s = _PyRecordReader(self.files, self.epochs, self.mode,
+                                self.shuffle_buffer, self.seed).state()
+        if self.world_size is not None:
+            s = dict(s, dp=self._dp_block())
+        return s
 
     def set_state(self, state):
         """Resume from a ``state()`` snapshot: takes effect on the next
@@ -279,11 +399,60 @@ class FileDataLoader:
         fresh ``set_state``, each subsequent iterator CONTINUES from
         the last delivered batch — the loader is a stream with a
         cursor, so re-iterating never replays consumed records (an
-        exhausted finite stream yields nothing)."""
+        exhausted finite stream yields nothing).
+
+        The snapshot may come from a DIFFERENT topology (another
+        world_size/rank, or a ``merge_rank_states`` frontier): the
+        cursor addresses the shared job-level stream, so it applies
+        directly — only the global batch size must match (record→step
+        boundaries would shift otherwise). A world-size change is
+        logged, including the replay-and-skip cost when a shuffle
+        buffer makes the epoch prefix non-seekable."""
         if not self.stateful:
             raise RuntimeError(
                 "set_state() on a non-stateful FileDataLoader — "
                 "construct with stateful=True")
+        state = dict(state)
+        dp = state.pop("dp", None)
+        if dp is not None:
+            gb = dp.get("global_batch")
+            if gb is not None and gb != self.batch_size:
+                raise ValueError(
+                    f"data cursor was captured with global batch "
+                    f"{gb} but this loader's is {self.batch_size} — "
+                    f"re-partitioning across a changed batch size "
+                    f"would shift every step boundary")
+        if self.world_size is not None:
+            # a cursor without a dp block (saved by a plain stateful
+            # loader) carries no global-batch record to compare — but
+            # alignment is provable from the position itself: delivery
+            # commits whole batches, so a sound resume point must land
+            # on a boundary of THIS loader's global batch (dp slicing
+            # enforces drop_last, so partial deliveries can't occur)
+            rc = int(state.get("records_consumed", 0))
+            if rc % self.batch_size:
+                raise ValueError(
+                    f"data cursor at {rc} consumed record(s) does not "
+                    f"land on a global-batch boundary of "
+                    f"{self.batch_size} — it was saved by a loader "
+                    f"with a different batch size, and resuming would "
+                    f"shift every step boundary")
+        old_w = (dp.get("world_size") or 1) if dp is not None else 1
+        new_w = self.world_size or 1
+        if old_w != new_w:
+            replay = ""
+            if self.shuffle_buffer and state.get("epoch_records"):
+                # the reader can't seek into a reservoir-shuffled
+                # epoch: resume replays the already-consumed prefix
+                # without yielding it — exact, not free
+                replay = (f" (shuffled stream: resume replays-and-"
+                          f"skips {state.get('epoch_records')} "
+                          f"record(s) of the current epoch)")
+            _log.warning(
+                "rescaling data cursor from world_size=%d to "
+                "world_size=%d at %d consumed record(s)%s",
+                old_w, new_w,
+                state.get("records_consumed", 0), replay)
         # validate eagerly (a bad cursor should fail at restore time,
         # not steps later inside the prefetch worker)
         _PyRecordReader(self.files, self.epochs, self.mode,
@@ -331,6 +500,25 @@ class FileDataLoader:
             return _PyRecordReader(self.files, self.epochs, self.mode,
                                    self.shuffle_buffer, self.seed,
                                    start_state=start)
+        if self.world_size is not None:
+            # dp slicing's core invariant — every rank reads the SAME
+            # deterministic job-level stream — only holds for the
+            # deterministic reader: the native loader's multi-threaded
+            # order would make each rank slice a differently-ordered
+            # "global" batch (silent cross-rank sample duplication and
+            # loss), even when nobody asked for a resume cursor
+            from paddle_tpu import native
+            if native.available():
+                from paddle_tpu.core.enforce import warn_once
+                warn_once(
+                    "dataloader-dp-py",
+                    "FileDataLoader(world_size=...) uses the "
+                    "single-threaded Python reader even though the "
+                    "native loader is available: data-parallel "
+                    "slicing requires every rank to read the same "
+                    "deterministic record order")
+            return _py_record_iter(self.files, self.epochs, self.mode,
+                                   self.shuffle_buffer, self.seed)
         from paddle_tpu import native
         if self.mode == "recordio" and not native.available():
             raise RuntimeError(
@@ -346,23 +534,41 @@ class FileDataLoader:
         return _py_record_iter(self.files, self.epochs, self.mode,
                                self.shuffle_buffer, self.seed)
 
+    def _slice_rows(self, batch):
+        """This rank's contiguous row share of a global batch."""
+        b = self.batch_size // self.world_size
+        sl = slice(self.rank * b, (self.rank + 1) * b)
+        if isinstance(batch, tuple):
+            return tuple(f[sl] for f in batch)
+        return batch[sl]
+
     def _batches(self):
         """(batch, n_records, cursor-after-those-records) triples; the
-        cursor is None for non-stateful readers."""
+        cursor is None for non-stateful readers. Under data-parallel
+        slicing the yielded batch is this rank's rows and n_records
+        counts them (the cursor still tracks the GLOBAL stream — it is
+        the job-level position every rank shares)."""
         buf = []
         records = self._records()
         snap = records.state if isinstance(records, _PyRecordReader) \
             else (lambda: None)
+
+        def emit(samples):
+            _m_batches.inc()
+            batch = self._stack(samples)
+            if self.world_size is not None:
+                return (self._slice_rows(batch),
+                        len(samples) // self.world_size, snap())
+            return batch, len(samples), snap()
+
         try:
             for rec in records:
                 buf.append(self.parse_fn(rec))
                 if len(buf) == self.batch_size:
-                    _m_batches.inc()
-                    yield self._stack(buf), len(buf), snap()
+                    yield emit(buf)
                     buf = []
             if buf and not self.drop_last:
-                _m_batches.inc()
-                yield self._stack(buf), len(buf), snap()
+                yield emit(buf)
         finally:
             if hasattr(records, "close"):
                 records.close()

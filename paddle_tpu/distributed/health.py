@@ -17,11 +17,13 @@ Everything here is stdlib-only: the launcher must work without jax.
 """
 
 import os
+import re
 import threading
 import time
 
 __all__ = ["Heartbeat", "heartbeat_path", "metrics_path", "last_beat",
-           "stale_ranks", "silent_ranks", "reset", "ENV_DIR", "ENV_RANK"]
+           "stale_ranks", "silent_ranks", "reset", "sweep_stale_ranks",
+           "ENV_DIR", "ENV_RANK"]
 
 ENV_DIR = "PADDLE_HEARTBEAT_DIR"
 ENV_RANK = "PADDLE_TRAINER_ID"
@@ -149,3 +151,33 @@ def reset(dirname, nranks):
             os.remove(heartbeat_path(dirname, r))
         except OSError:
             pass
+
+
+_RANK_FILE_RE = re.compile(r"^rank(\d+)\.(hb|prom)$")
+
+
+def sweep_stale_ranks(dirname, nranks):
+    """Remove the heartbeat AND metrics files of ranks >= ``nranks`` —
+    leftovers of a previous, larger incarnation. An elastic shrink
+    otherwise leaves ``rank<N>.prom`` polluting the aggregated
+    ``metrics.prom``/status line forever (the dead rank's counters keep
+    being summed in) and a stale ``rank<N>.hb`` lying around for a
+    later incarnation that grows back over the index. Unlike
+    ``reset``, the ``.prom`` removal is deliberate: a rank that no
+    longer EXISTS in the job is not evidence, it is noise. Scan-based
+    (not ``range``) so any count of leftovers is caught. Returns the
+    removed filenames."""
+    removed = []
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return removed
+    for f in names:
+        m = _RANK_FILE_RE.match(f)
+        if m and int(m.group(1)) >= nranks:
+            try:
+                os.remove(os.path.join(dirname, f))
+                removed.append(f)
+            except OSError:
+                pass
+    return sorted(removed)

@@ -31,6 +31,17 @@ restart anywhere"): the launcher is a supervisor, not just a spawner.
   signal) is forwarded to children, which get S seconds to flush
   (`CheckpointManager.wait()` drains pending async shards) before
   SIGKILL. The launcher then exits 143 without restarting.
+- `--min_ranks / --max_ranks`: topology-elastic gangs. A rank exiting
+  with code 31 ("rank departed" — spot reclaim, node repair; see
+  SHRINK_RC) shrinks the next incarnation to the surviving world size
+  instead of respawning a gang that can never be whole again, and
+  late-joining hosts (join-request files under `<log_dir>/elastic/`)
+  are admitted at the next restart boundary instead of being turned
+  away. Each incarnation's world size rides to workers in
+  PADDLE_TRAINERS_NUM, so `CheckpointManager.restore()` re-shards the
+  last-good checkpoint onto the new mesh and the data cursor rescales
+  (see io_checkpoint / docs/ELASTIC_TRAINING.md). Defaults keep
+  today's fixed-gang semantics.
 
 Each child additionally sees PADDLE_RESTART_COUNT (0 on the first
 incarnation) and PADDLE_HEARTBEAT_DIR.
@@ -54,11 +65,21 @@ from paddle_tpu.monitor import exporter as _exporter
 from paddle_tpu.monitor import flight_recorder as _flight
 from paddle_tpu.monitor.registry import REGISTRY as _REGISTRY
 from paddle_tpu.monitor.registry import counter as _counter
+from paddle_tpu.monitor.registry import gauge as _gauge
 
 __all__ = ["launch_collective", "launch_ps", "find_free_ports",
-           "backoff_delay", "probe_port_range"]
+           "backoff_delay", "probe_port_range", "elastic_join_dir",
+           "SHRINK_RC"]
 
 PREEMPTED_RC = 143          # 128 + SIGTERM, the conventional code
+
+#: a rank that exits with this code is PERMANENTLY DEPARTING (spot
+#: reclaim, node repair — or testing.faults' PT_FAULT_SHRINK_AT_STEP,
+#: which must match this value): under elastic flags the supervisor
+#: restarts the gang at the reduced world size instead of respawning
+#: the dead rank. Any other failure code keeps today's same-size gang
+#: restart.
+SHRINK_RC = 31
 
 #: the process exit-code vocabulary (docs/DEBUGGING.md table): naming
 #: the cause in the supervisor log turns "code 29" into something an
@@ -67,6 +88,8 @@ EXIT_CODE_LABELS = {
     17: "non-finite trip (NonFiniteError)",
     23: "injected crash (testing.faults)",
     29: "checkpoint-corruption fault (testing.faults)",
+    31: "rank departed (elastic shrink; supervisor resumes at the "
+        "reduced world size)",
     124: "timeout",
     137: "SIGKILLed (OOM killer or kill -9)",
     139: "segfault",
@@ -97,6 +120,11 @@ _m_stragglers = _counter(
     "straggler_trips_total",
     "Ranks newly flagged as stragglers by the launcher (mean step "
     "time above the skew threshold vs the median rank)")
+_m_world = _gauge(
+    "elastic_world_size",
+    "World size of the current gang incarnation (= --nproc_per_node "
+    "until --min_ranks/--max_ranks elasticity moves it: shrinks on "
+    "rank departure, grows on admitted join requests)")
 
 
 def _postmortem_env(log_dir):
@@ -218,6 +246,39 @@ def backoff_delay(attempt, base=1.0, cap=30.0):
     return min(cap, base * (2.0 ** max(attempt, 0)))
 
 
+def elastic_join_dir(log_dir):
+    """Where late-joining hosts request admission: any file named
+    ``join.*`` dropped here is consumed at the next restart boundary
+    and grows the gang by one rank (up to --max_ranks). File-based on
+    purpose — it crosses the process boundary the same way heartbeats
+    and rank snapshots do, needs no rendezvous service, and a
+    provisioning script can request a join with ``touch``."""
+    if not log_dir:
+        return None
+    return os.path.join(os.path.abspath(log_dir), "elastic")
+
+
+def _take_join_requests(join_dir, room):
+    """Consume (delete) up to ``room`` pending join-request files;
+    returns how many were admitted. Requests beyond the room stay
+    queued for the next boundary."""
+    if not join_dir or room <= 0:
+        return 0
+    try:
+        names = sorted(f for f in os.listdir(join_dir)
+                       if f.startswith("join."))
+    except OSError:
+        return 0
+    taken = 0
+    for f in names[:room]:
+        try:
+            os.remove(os.path.join(join_dir, f))
+        except OSError:
+            continue
+        taken += 1
+    return taken
+
+
 def _spawn(cmd, env, log_prefix, log_dir, append=False):
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
@@ -270,15 +331,28 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
     """Poll one gang incarnation to completion.
 
     ``procs``: name -> Popen; ``ranks``: name -> heartbeat rank (absent
-    = unwatched, e.g. pservers). Returns (status, rc) with status one of
-    "ok" | "fail" | "hung" | "timeout" | "preempted". On every status
-    but "ok" the whole gang has already been torn down and reaped.
-    Every STATUS_INTERVAL the loop logs the aggregated job status line
-    and refreshes <log_dir>/metrics.prom from the rank snapshots.
+    = unwatched, e.g. pservers). Returns (status, rc, departed) with
+    status one of "ok" | "fail" | "hung" | "timeout" | "preempted";
+    ``departed`` is the sorted list of ranks whose process ended with
+    SHRINK_RC ("rank departed") — counted over the WHOLE reaped gang
+    after teardown, not just the first failure observed, so two hosts
+    reclaimed at the same step both register and the elastic
+    supervisor shrinks to the true surviving world size. On every
+    status but "ok" the whole gang has already been torn down and
+    reaped. Every STATUS_INTERVAL the loop logs the aggregated job
+    status line and refreshes <log_dir>/metrics.prom from the rank
+    snapshots.
     """
     start = time.time()
     warned_slow = False
     next_status = time.monotonic() + STATUS_INTERVAL
+
+    def departed():
+        # every proc is reaped by now (_drain or natural exit):
+        # Popen.returncode is authoritative
+        return sorted(ranks[n] for n, p in procs.items()
+                      if n in ranks and p.returncode == SHRINK_RC)
+
     try:
         alive = dict(procs)
         while alive:
@@ -291,11 +365,11 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
                      f"{grace_period}s grace for checkpoint flush")
                 if not _drain(alive.values(), grace_period):
                     _log("grace period expired; SIGKILLed stragglers")
-                return "preempted", PREEMPTED_RC
+                return "preempted", PREEMPTED_RC, []
             if deadline is not None and time.monotonic() > deadline:
                 _log(f"timeout; killing {sorted(alive)}")
                 _drain(alive.values(), grace_period)
-                return "timeout", 124
+                return "timeout", 124, []
             for name, p in list(alive.items()):
                 r = p.poll()
                 if r is None:
@@ -304,7 +378,7 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
                 if r != 0:
                     _log(f"{name} exited with code {r}{_rc_label(r)}")
                     _drain(alive.values(), grace_period)
-                    return "fail", r
+                    return "fail", r, departed()
             if hang_timeout is not None and alive:
                 watched = {ranks[n] for n in alive if n in ranks}
                 stale = [(r, age) for r, age in health.stale_ranks(
@@ -317,7 +391,7 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
                          f"{age:.1f}s ago (hang_timeout={hang_timeout}s); "
                          f"killing gang")
                     _drain(alive.values(), grace_period)
-                    return "hung", 1
+                    return "hung", 1, departed()
                 if not warned_slow and time.time() - start > hang_timeout:
                     silent = [r for r in health.silent_ranks(
                         hb_dir, max(watched, default=-1) + 1)
@@ -329,7 +403,7 @@ def _wait_gang(procs, ranks, logs, deadline, hang_timeout, hb_dir, term,
                              f"that beat then stopped counts as hung)")
                     warned_slow = True
             time.sleep(0.2)
-        return "ok", 0
+        return "ok", 0, []
     except KeyboardInterrupt:
         for p in procs.values():
             if p.poll() is None:
@@ -355,39 +429,93 @@ def _make_hb_dir(log_dir):
 
 def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                       log_dir=None, env_extra=None, timeout=None,
-                      max_restarts=0, hang_timeout=None, grace_period=10.0):
+                      max_restarts=0, hang_timeout=None, grace_period=10.0,
+                      min_ranks=None, max_ranks=None):
+    """Supervise a gang of ``nproc`` trainers.
+
+    ``min_ranks``/``max_ranks`` (either one set) make the gang
+    ELASTIC instead of gang-fatal at a fixed size: with ``min_ranks``
+    set, a rank exiting SHRINK_RC (31 — a spot reclaim / node repair
+    saying goodbye) shrinks the next incarnation to the surviving
+    world size (down to ``min_ranks``; below it the job gives up as
+    before; with only ``max_ranks`` — grow-only elasticity — a
+    departure is an ordinary failure and the gang restarts at full
+    size), and pending
+    join requests (files under ``<log_dir>/elastic/``, see
+    ``elastic_join_dir``) are admitted at the next restart boundary up
+    to ``max_ranks`` — a late-joining host grows the gang instead of
+    being turned away. Restarts still draw from the one
+    ``max_restarts`` budget with the same backoff. Each incarnation's
+    world size is exported to every worker as PADDLE_TRAINERS_NUM (and
+    the ``elastic_world_size`` gauge), which is what lets
+    ``CheckpointManager.restore`` notice a topology change and
+    re-shard. With neither flag set, behavior is exactly the fixed
+    gang of old."""
     host = ips.split(",")[0]
+    elastic = min_ranks is not None or max_ranks is not None
+    # the bounds are contracts, not hints: silently clamping them
+    # would let the gang shrink below (or grow past) what the operator
+    # asked for — e.g. a --max_ranks below nproc overridden to nproc
+    # would re-grow past the ceiling that was protecting the hosts
+    if min_ranks is not None and not 1 <= min_ranks <= nproc:
+        raise ValueError(
+            f"--min_ranks {min_ranks} must be in [1, nproc={nproc}]")
+    if max_ranks is not None and max_ranks < nproc:
+        raise ValueError(
+            f"--max_ranks {max_ranks} is below the starting world "
+            f"size nproc={nproc} — lower --nproc_per_node instead")
+    # shrink-on-departure is OPT-IN via --min_ranks: with only
+    # --max_ranks (grow-only elasticity) a rank exiting SHRINK_RC is
+    # an ordinary failure and the gang restarts at full size — the
+    # floor stays nproc, it must not turn departures fatal
+    can_shrink = min_ranks is not None
+    lo = min_ranks if min_ranks is not None else nproc
+    hi = max_ranks if max_ranks is not None else nproc
     # trainer endpoints double as the jax.distributed rendezvous in
     # collective mode (rank 0's is the coordinator, a long-lived bound
     # port) — trainer-to-trainer traffic like global_shuffle's sample
     # exchange gets its own dedicated ports, as launch_ps does. One
-    # find_free_ports call for both sets: all 2*nproc sockets are
-    # bound simultaneously, so the sets are guaranteed disjoint.
+    # find_free_ports call for both sets: all 2*hi sockets are bound
+    # simultaneously, so the sets are guaranteed disjoint — sized for
+    # the LARGEST world this launch may grow to, so an admitted join
+    # never scrambles the surviving ranks' endpoints.
     if started_port is None:
-        allp = find_free_ports(2 * nproc, host)
+        allp = find_free_ports(2 * hi, host)
     else:
         probe_port_range(
-            host, started_port, 2 * nproc,
-            f"collective mode claims 2*nproc = {2 * nproc} consecutive "
-            f"ports (nproc trainer endpoints, then nproc global_shuffle "
-            f"exchange endpoints)")
-        allp = list(range(started_port, started_port + 2 * nproc))
-    ports, xports = allp[:nproc], allp[nproc:]
-    endpoints = ",".join(f"{host}:{p}" for p in ports)
-    exchange_eps = ",".join(f"{host}:{p}" for p in xports)
+            host, started_port, 2 * hi,
+            f"collective mode claims 2*max world size = {2 * hi} "
+            f"consecutive ports (trainer endpoints, then "
+            f"global_shuffle exchange endpoints)")
+        allp = list(range(started_port, started_port + 2 * hi))
     hb_dir, hb_tmp = _make_hb_dir(log_dir)
     cache_env = _cache_dir_env(log_dir, env_extra)
     pm_env = _postmortem_env(log_dir)
+    join_dir = elastic_join_dir(log_dir) if elastic else None
+    if join_dir:
+        os.makedirs(join_dir, exist_ok=True)
+        _log(f"elastic: world size {nproc} (bounds {lo}..{hi}); join "
+             f"requests = files named join.* in {join_dir}, admitted "
+             f"at restart boundaries")
+    elif elastic and hi > nproc:
+        # growth was requested but there is nowhere to drop a join
+        # request — say so instead of silently never growing
+        _log(f"elastic: --max_ranks {hi} has no effect without "
+             f"--log_dir (join requests are files under "
+             f"<log_dir>/elastic/); the gang can shrink but not grow")
 
-    def spawn_gang(attempt):
+    def spawn_gang(attempt, world):
+        ports, xports = allp[:world], allp[hi:hi + world]
+        endpoints = ",".join(f"{host}:{p}" for p in ports)
+        exchange_eps = ",".join(f"{host}:{p}" for p in xports)
         procs, ranks, logs = {}, {}, []
         try:
-            for rank in range(nproc):
+            for rank in range(world):
                 env = dict(os.environ, **(env_extra or {}), **cache_env,
                            **pm_env)
                 env.update({
                     "PADDLE_TRAINER_ID": str(rank),
-                    "PADDLE_TRAINERS_NUM": str(nproc),
+                    "PADDLE_TRAINERS_NUM": str(world),
                     "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[rank]}",
                     "PADDLE_TRAINER_ENDPOINTS": endpoints,
                     "PADDLE_EXCHANGE_ENDPOINTS": exchange_eps,
@@ -417,14 +545,22 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     flagged_stragglers = set()          # per-launch straggler memory
     try:
         attempt = 0
+        world = nproc
         while True:
-            health.reset(hb_dir, nproc)
-            procs, ranks, logs = spawn_gang(attempt)
-            status, rc = _wait_gang(procs, ranks, logs, deadline,
-                                    hang_timeout, hb_dir, term,
-                                    grace_period, log_dir=log_dir,
-                                    restarts=attempt,
-                                    flagged_stragglers=flagged_stragglers)
+            health.reset(hb_dir, world)
+            # a previous larger incarnation's rank files would pollute
+            # the aggregated metrics.prom/status line and confuse the
+            # watchdog — ranks that no longer exist leave no evidence
+            swept = health.sweep_stale_ranks(hb_dir, world)
+            if swept:
+                _log(f"swept stale rank file(s) of departed ranks: "
+                     f"{swept}")
+            _m_world.set(world)
+            procs, ranks, logs = spawn_gang(attempt, world)
+            status, rc, departed = _wait_gang(
+                procs, ranks, logs, deadline, hang_timeout, hb_dir,
+                term, grace_period, log_dir=log_dir, restarts=attempt,
+                flagged_stragglers=flagged_stragglers)
             _status_tick(hb_dir, log_dir, attempt, flagged_stragglers)
             if status in ("ok", "timeout", "preempted"):
                 return rc
@@ -436,13 +572,41 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                     _log(f"gang {status} (rc={rc}); restart budget "
                          f"{max_restarts} exhausted, giving up")
                 return rc
+            new_world = world
+            if elastic:
+                if departed and can_shrink:
+                    # EVERY rank that ended with SHRINK_RC this
+                    # incarnation is gone for good — two hosts
+                    # reclaimed at the same step both count, whatever
+                    # exit code the supervisor happened to see first
+                    new_world -= len(departed)
+                    _log(f"trainer(s) {departed} departed "
+                         f"(rc={SHRINK_RC}"
+                         f"{_rc_label(SHRINK_RC)}); gang shrinks "
+                         f"{world} -> {new_world}")
+                elif departed:
+                    _log(f"trainer(s) {departed} departed "
+                         f"(rc={SHRINK_RC}) but --min_ranks is not "
+                         f"set; restarting at full size")
+                joined = _take_join_requests(join_dir, hi - new_world)
+                if joined:
+                    _log(f"admitting {joined} late-joining rank(s) at "
+                         f"this restart boundary: world size "
+                         f"{new_world} -> {new_world + joined}")
+                    new_world += joined
+                if new_world < lo:
+                    _log(f"world size {new_world} below --min_ranks "
+                         f"{lo}; giving up")
+                    return rc
             delay = backoff_delay(attempt)
             attempt += 1
             _m_restarts.inc()
+            world = new_world
             # gang restart, not per-rank: surviving ranks would deadlock
             # in their next collective against the dead peer
             _log(f"gang {status} (rc={rc}); restarting gang "
-                 f"{attempt}/{max_restarts} after {delay:.1f}s backoff")
+                 f"{attempt}/{max_restarts} at world size {world} "
+                 f"after {delay:.1f}s backoff")
             if term.wait(delay):
                 return PREEMPTED_RC
             if deadline is not None and time.monotonic() > deadline:
@@ -690,6 +854,22 @@ def _parse_args(argv):
                          "the whole gang, ps mode restarts individual "
                          "workers (per-worker budget) while pservers "
                          "stay up")
+    ap.add_argument("--min_ranks", type=int, default=None,
+                    help="collective mode: make the gang elastic — a "
+                         "rank exiting with code 31 (rank departed: "
+                         "spot reclaim / node repair) shrinks the next "
+                         "incarnation to the surviving world size, "
+                         "down to this floor (below it the job gives "
+                         "up). Default: fixed gang (today's "
+                         "semantics). Workers see the incarnation's "
+                         "world size in PADDLE_TRAINERS_NUM; restore() "
+                         "re-shards checkpoints across the change.")
+    ap.add_argument("--max_ranks", type=int, default=None,
+                    help="collective mode: admit late-joining ranks at "
+                         "the next restart boundary, growing the gang "
+                         "up to this ceiling — a join is requested by "
+                         "dropping a file named join.<anything> in "
+                         "<log_dir>/elastic/. Default: fixed gang.")
     ap.add_argument("--hang_timeout", type=float, default=None,
                     help="hang watchdog: kill+restart a gang whose rank "
                          "heartbeat once and then stopped for this many "
@@ -730,7 +910,9 @@ def main(argv=None):
                                args.log_dir, timeout=args.timeout,
                                max_restarts=args.max_restarts,
                                hang_timeout=args.hang_timeout,
-                               grace_period=args.grace_period)
+                               grace_period=args.grace_period,
+                               min_ranks=args.min_ranks,
+                               max_ranks=args.max_ranks)
     sys.exit(rc)
 
 

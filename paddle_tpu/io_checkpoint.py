@@ -49,6 +49,31 @@ elastic story — the launcher half is PR 1's supervisor):
   meta JSON, and ``auto_checkpoint(data_state=loader)`` restores it
   before the loop — a killed-and-resumed run consumes the same record
   sequence as an uninterrupted one (exactly-once ingest).
+
+Topology elasticity (the fleet-shrinks-and-grows half — real restarts
+change the world size: preemptions, spot reclaims, node repairs):
+
+- ``save(..., axes=...)`` annotates each tree leaf as replicated
+  (``None``) or sharded along an axis; every shard manifest records
+  per-array shape/dtype/axis (``array_info``), so the layout that was
+  written is re-derivable from the files alone;
+- when ``restore()`` runs with a *different* world size than the one
+  that wrote the step, it re-shards: each reader computes its slice
+  of every sharded array (``np.array_split`` convention over the
+  writers' actual extents — uneven divisors included), reads exactly
+  the writer shards it needs, and re-materializes its tree. Every
+  writer shard touched passes the full integrity verification, and
+  corruption still quarantines the step and walks back. The
+  fixed-world fast path is unchanged and pays no reshard cost;
+- per-rank data cursors saved with the step are merged into one
+  job-level frontier (``dataio.dataloader.merge_rank_states``) and
+  handed to the resuming ranks, which re-partition it;
+- a step the reshard plan cannot cover (pre-``array_info`` shards
+  from a multi-host save, diverging tree structures, un-mergeable
+  data cursors) raises ``CheckpointTopologyError`` naming the written
+  and reading ``nproc`` — a precise refusal instead of the opaque
+  collective timeout that would otherwise burn the supervisor's
+  restart budget.
 """
 
 import json
@@ -70,8 +95,9 @@ from paddle_tpu.monitor.registry import counter as _counter
 from paddle_tpu.monitor.registry import histogram as _histogram
 from paddle_tpu.static.serialize import tree_from_manifest, tree_manifest
 
-__all__ = ["CheckpointManager", "CheckpointCorruptError", "auto_checkpoint",
-           "verify_shard"]
+__all__ = ["CheckpointManager", "CheckpointCorruptError",
+           "CheckpointTopologyError", "auto_checkpoint", "verify_shard",
+           "even_interval"]
 
 _log = logging.getLogger("paddle_tpu.checkpoint")
 
@@ -93,6 +119,18 @@ class CheckpointCorruptError(RuntimeError):
     unreadable file, CRC mismatch, missing/extra array, or digest
     drift. The message names the file and the first bad array."""
 
+
+class CheckpointTopologyError(RuntimeError):
+    """A checkpoint step cannot be restored onto this world size: it
+    was written by a different ``nproc`` and the reshard plan cannot
+    cover it (pre-``array_info`` shards, diverging tree structures
+    across writers, or un-mergeable per-rank data cursors). The
+    message names the written and reading ``nproc`` and the recovery
+    move (restart at the written size, or re-save). Deliberately NOT a
+    ``CheckpointCorruptError``: the files are healthy, so restore must
+    never quarantine them over this."""
+
+
 _m_saves = _counter("checkpoint_saves_total",
                     "Checkpoints made durable (shard written, retries "
                     "resolved)")
@@ -113,6 +151,10 @@ _m_verify_fail = _counter("checkpoint_verify_failures_total",
                           "Individual shard integrity-verification "
                           "failures: unreadable file, CRC mismatch, "
                           "missing array, or digest drift")
+_m_reshard = _counter("reshard_restores_total",
+                      "Checkpoint restores that re-sliced writer "
+                      "shards onto a different world size (counted "
+                      "once per reading rank per restore)")
 
 
 def _crc32(arr):
@@ -170,6 +212,65 @@ def _key_paths(manifest):
 
 def _natural_key(k):
     return (len(k), k)       # a0, a1, ... a10 in numeric order
+
+
+def even_interval(total, parts, idx):
+    """The half-open interval ``[start, end)`` part ``idx`` of ``parts``
+    owns when ``total`` elements are split as evenly as possible
+    (``np.array_split`` convention: the first ``total % parts`` parts
+    get one extra element). THE partition convention of the reshard
+    planner and the data-parallel batch slicer — both sides computing
+    it independently is what lets a reader derive its slice without
+    any cross-host negotiation."""
+    base, rem = divmod(int(total), int(parts))
+    start = idx * base + min(idx, rem)
+    return start, start + base + (1 if idx < rem else 0)
+
+
+def _axes_map(manifest, axes):
+    """{npz key: shard axis or None} from an ``axes`` pytree congruent
+    to the saved tree (``None`` anywhere = that whole subtree is
+    replicated). Walks the manifest's tree structure so the key
+    assignment can never drift from ``tree_manifest``'s."""
+    out = {}
+
+    def rec(node, ax, path):
+        if "__d__" in node:
+            for k, v in node["__d__"].items():
+                sub = None
+                if ax is not None:
+                    try:
+                        sub = ax[k]
+                    except (KeyError, TypeError, IndexError):
+                        raise ValueError(
+                            f"axes tree does not match the state tree "
+                            f"at {path or '/'}: no entry for key {k!r}")
+                rec(v, sub, f"{path}/{k}")
+        elif "__l__" in node or "__t__" in node:
+            seq = node.get("__l__")
+            if seq is None:
+                seq = node.get("__t__")
+            for i, v in enumerate(seq):
+                sub = None
+                if ax is not None:
+                    try:
+                        sub = ax[i]
+                    except (KeyError, TypeError, IndexError):
+                        raise ValueError(
+                            f"axes tree does not match the state tree "
+                            f"at {path}[{i}]")
+                rec(v, sub, f"{path}[{i}]")
+        elif "__array__" in node:
+            if ax is not None and (isinstance(ax, bool)
+                                   or not isinstance(ax, int)):
+                raise ValueError(
+                    f"axes leaf at {path or '/'} must be None "
+                    f"(replicated) or an int shard axis, got {ax!r}")
+            out[node["__array__"]] = ax
+        # "__leaf__" (inline scalar): nothing to shard
+
+    rec(manifest["tree"], axes, "")
+    return out
 
 
 def _retry_transient(fn, what, retries=2, delay=0.05):
@@ -322,6 +423,92 @@ def _host_tag():
     return idx, cnt
 
 
+def _cross_writer_blocker(manifests):
+    """Why a complete set of writer-shard manifests cannot be re-sliced
+    onto a different world size, or None when the reshard plan covers
+    them. THE one home of the cross-writer fitness rules — shared by
+    ``CheckpointManager._reshard_load`` (which raises
+    ``CheckpointTopologyError`` on it) and ``tools/fsck_checkpoint``'s
+    offline ``--nproc`` judgment, so a new rule can never make fsck's
+    verdict drift from ``restore()``'s behavior:
+
+    - every writer must agree on tree structure and array set;
+    - an array annotated replicated (axis None) must actually BE
+      replicated — identical shape/dtype/CRC on every writer (per-host
+      state saved under the ``axes=None`` default must refuse, not
+      silently collapse to one host's copy);
+    - a sharded array's off-axis dims must tile across writers.
+
+    ``manifests``: {proc: manifest} for proc 0..W-1, every one carrying
+    ``array_info`` (callers handle the legacy no-``array_info`` case
+    first)."""
+    W = len(manifests)
+    ref = manifests[0]
+    info = ref.get("array_info") or {}
+    for p in range(1, W):
+        m = manifests[p]
+        if (set(m.get("array_info") or {}) != set(info)
+                or m.get("tree") != ref.get("tree")):
+            return (f"writer shards 0 and {p} disagree on tree "
+                    f"structure / array set — not slices of one "
+                    f"data-parallel state")
+
+    def sig(p, key):
+        i = manifests[p]["array_info"][key]
+        crc = ((manifests[p].get("integrity") or {})
+               .get("arrays", {}).get(key, {}).get("crc32"))
+        return tuple(i.get("shape", ())), i.get("dtype"), crc
+
+    for key, inf in info.items():
+        # every writer must have annotated the SAME shard axis: planning
+        # from one writer's annotation while another saved a different
+        # layout would make readers concat a full copy as if it were a
+        # slice (or replicate a slice) — wrong, rank-dependent state
+        ax_by_p = {p: manifests[p]["array_info"][key].get("axis")
+                   for p in range(W)}
+        if len(set(ax_by_p.values())) > 1:
+            return (f"array {key!r}: writers disagree on its shard "
+                    f"axis ({ax_by_p}) — the axes= annotation must be "
+                    f"identical on every host")
+        axis = inf.get("axis")
+        if axis is None:
+            diff = [p for p in range(W) if sig(p, key) != sig(0, key)]
+            if diff:
+                return (f"array {key!r} is annotated replicated but "
+                        f"writer shard(s) {diff} hold different "
+                        f"content than shard 0 — per-host state must "
+                        f"be saved with a shard axis (or excluded), "
+                        f"not the axes=None default; collapsing it to "
+                        f"one host's copy would silently restore "
+                        f"wrong state")
+        else:
+            dts = {manifests[p]["array_info"][key].get("dtype")
+                   for p in range(W)}
+            if len(dts) > 1:
+                return (f"array {key!r}: writers disagree on dtype "
+                        f"({sorted(dts, key=repr)})")
+            shapes = [manifests[p]["array_info"][key].get("shape", ())
+                      for p in range(W)]
+            for p, shp in enumerate(shapes):
+                if (len(shp) != len(shapes[0])
+                        or any(i != axis and d != shapes[0][i]
+                               for i, d in enumerate(shp))):
+                    return (f"array {key!r}: writer shard {p}'s shape "
+                            f"{list(shp)} does not tile shard 0's "
+                            f"{list(shapes[0])} along axis {axis}")
+    return None
+
+
+class _PendingMerge:
+    """Per-writer data cursors a resharded restore stashed for
+    ``restore_data_state`` to merge LAZILY: a job that never wired a
+    ``data_state`` must not fail its model restore over un-mergeable
+    cursors (and must not pay the merge)."""
+
+    def __init__(self, states):
+        self.states = states
+
+
 class CheckpointManager:
     """Step-tagged async checkpoints in ``dirname``.
 
@@ -355,7 +542,8 @@ class CheckpointManager:
 
     def __init__(self, dirname, keep_max=3, save_interval_steps=100,
                  save_interval_secs=None, async_save=True,
-                 disk_retries=None, verify_restore=True):
+                 disk_retries=None, verify_restore=True, proc=None,
+                 nproc=None):
         self.dirname = dirname
         self.keep_max = keep_max
         if disk_retries is not None:
@@ -366,7 +554,14 @@ class CheckpointManager:
         self.verify_restore = verify_restore
         self._last_save_time = time.monotonic()
         os.makedirs(dirname, exist_ok=True)
-        self._proc, self._nproc = _host_tag()
+        # explicit proc/nproc override the jax host tag: under an
+        # elastic supervisor the incarnation's world size is launcher
+        # metadata (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM), not
+        # something single-process jax can see — and it is exactly the
+        # reading-vs-written nproc comparison that triggers resharding
+        tag = _host_tag()
+        self._proc = tag[0] if proc is None else int(proc)
+        self._nproc = tag[1] if nproc is None else int(nproc)
         #: newest step this manager has verified on READ (a restore
         #: that checked out) — _prune never deletes it. Writes are not
         #: "verified": fsync'd+CRC'd at write time, but disk rot after
@@ -462,14 +657,34 @@ class CheckpointManager:
         return step % max(self.save_interval_steps, 1) == 0
 
     # -- save --------------------------------------------------------------
-    def save(self, step, tree, data_state=None):
+    def save(self, step, tree, data_state=None, axes=None):
         """Snapshot now (device→host), write later. Returns immediately
         when async. ``data_state`` is an optional JSON-able input-
         pipeline cursor (``FileDataLoader.state()``) stored in the
         shard manifest (per-host, CRC-covered) and mirrored into the
-        meta JSON for operator visibility."""
+        meta JSON for operator visibility.
+
+        ``axes`` annotates how this host's tree tiles the job-level
+        state: a pytree congruent to ``tree`` whose leaves are ``None``
+        (replicated — every host saved an identical copy) or an int
+        axis (this host saved its slice along that axis; the global
+        array is the proc-ordered concatenation of all hosts' slices).
+        The annotation, plus each array's shape/dtype, is recorded in
+        the manifest (``array_info``) — it is what lets ``restore()``
+        re-shard the step onto a different world size."""
         manifest, arrays = tree_manifest(tree)
         arrays = {k: np.asarray(v) for k, v in arrays.items()}  # d2h copy
+        ax = _axes_map(manifest, axes)
+        info = {}
+        for k, a in arrays.items():
+            axis = ax.get(k)
+            if axis is not None and not 0 <= axis < a.ndim:
+                raise ValueError(
+                    f"save(axes=...): shard axis {axis} out of range "
+                    f"for array of shape {tuple(a.shape)}")
+            info[k] = {"shape": [int(d) for d in a.shape],
+                       "dtype": str(a.dtype.name), "axis": axis}
+        manifest["array_info"] = info
         _m_bytes.inc(sum(a.nbytes for a in arrays.values()))
         payload = (int(step), manifest, arrays, data_state)
         self._last_save_time = time.monotonic()
@@ -479,9 +694,9 @@ class CheckpointManager:
             self._raise_pending()
             self._q.put(payload)
 
-    def maybe_save(self, step, tree, data_state=None):
+    def maybe_save(self, step, tree, data_state=None, axes=None):
         if self.should_save(step):
-            self.save(step, tree, data_state=data_state)
+            self.save(step, tree, data_state=data_state, axes=axes)
             return True
         return False
 
@@ -602,14 +817,25 @@ class CheckpointManager:
         keep = set(steps[-self.keep_max:])
         if self._last_verified is not None:
             keep.add(self._last_verified)
-        for s in steps:
-            if s in keep:
-                continue
-            for p in range(self._nproc):
-                try:
-                    os.remove(self._shard_path(s, p))
-                except FileNotFoundError:
-                    pass
+        drop = [s for s in steps if s not in keep]
+        if not drop:
+            return
+        # scan-based like _quarantine, not range(self._nproc): after an
+        # elastic shrink this incarnation's nproc is SMALLER than the
+        # one that wrote the old steps, and pruning only our own shard
+        # indices would leak the higher-numbered peers' shards forever
+        try:
+            names = os.listdir(self.dirname)
+        except OSError:
+            names = []
+        for s in drop:
+            for f in names:
+                m = SHARD_NAME_RE.match(f)
+                if m and int(m.group(1)) == s:
+                    try:
+                        os.remove(os.path.join(self.dirname, f))
+                    except FileNotFoundError:
+                        pass
             try:
                 os.remove(self._meta_path(s))
             except FileNotFoundError:
@@ -700,28 +926,37 @@ class CheckpointManager:
         except Exception:
             pass
 
-    def _read_own_shard(self, step, verify):
-        """(manifest, arrays) for this host's shard of one step,
-        CRC-verified. Raises CheckpointCorruptError on positive
-        corruption evidence (torn meta JSON, bad shard content);
-        transient OSErrors propagate unchanged (see verify_shard)."""
+    def _saved_nproc(self, step):
+        """The world size that wrote ``step`` (its meta's ``nproc``),
+        retry-reading transient blips. FileNotFoundError propagates
+        (the step vanished — callers own that); torn/garbage content
+        raises CheckpointCorruptError like every other meta read."""
         meta_path = self._meta_path(step)
 
-        def read_meta():
+        def read():
             with open(meta_path) as f:
-                return json.load(f).get("nproc", 1)
+                return int(json.load(f).get("nproc", 1))
 
         try:
-            saved_nproc = _retry_transient(
-                read_meta, f"checkpoint meta {meta_path} read")
-        except FileNotFoundError:
-            enforce(False, f"no checkpoint meta for step {step} in "
-                           f"{self.dirname}")
-        except ValueError as e:         # torn/garbage JSON: corruption
+            return _retry_transient(
+                read, f"checkpoint meta {meta_path} read")
+        except (ValueError, TypeError) as e:
             _m_verify_fail.inc()
             raise CheckpointCorruptError(
                 f"checkpoint meta {meta_path} unreadable "
                 f"({type(e).__name__}: {e})") from e
+
+    def _read_own_shard(self, step, verify, saved_nproc=None):
+        """(manifest, arrays) for this host's shard of one step,
+        CRC-verified. Raises CheckpointCorruptError on positive
+        corruption evidence (torn meta JSON, bad shard content);
+        transient OSErrors propagate unchanged (see verify_shard)."""
+        if saved_nproc is None:
+            try:
+                saved_nproc = self._saved_nproc(step)
+            except FileNotFoundError:
+                enforce(False, f"no checkpoint meta for step {step} in "
+                               f"{self.dirname}")
         path = self._shard_path(step)
         if not os.path.exists(path):
             enforce(saved_nproc == 1,
@@ -734,17 +969,198 @@ class CheckpointManager:
             path = self._shard_path(step, 0)
         return verify_shard(path, verify=verify)
 
-    def _load_step(self, step, verify):
-        """(tree, manifest) for one step, CRC-verified."""
+    @staticmethod
+    def _tree_of(manifest, arrays):
         import jax.numpy as jnp
-        manifest, arrays = self._read_own_shard(step, verify)
-        tree = tree_from_manifest(
+        return tree_from_manifest(
             manifest, {k: jnp.asarray(v) for k, v in arrays.items()})
-        return tree, manifest
+
+    # -- resharding: restore onto a different world size --------------------
+    def _read_shard_manifest(self, path):
+        """Manifest-only read of one shard (parse + manifest_crc32
+        check, no array loads or CRCs) — the reshard planner reads all
+        W of these before deciding which shards to actually load.
+        Corrupt content raises CheckpointCorruptError; transient
+        OSErrors are retried then re-raised (blip-is-not-corruption)."""
+
+        def bad(detail):
+            _m_verify_fail.inc()
+            return CheckpointCorruptError(
+                f"checkpoint shard {path}: {detail}")
+
+        def read():
+            with np.load(path, allow_pickle=False) as blob:
+                if "__manifest__" not in blob.files:
+                    raise bad("no __manifest__ member (not a "
+                              "checkpoint shard, or header torn)")
+                return json.loads(
+                    bytes(blob["__manifest__"].tobytes()).decode("utf-8"))
+
+        try:
+            manifest = _retry_transient(
+                read, f"checkpoint shard {path} manifest read")
+        except (CheckpointCorruptError, OSError):
+            raise
+        except Exception as e:
+            raise bad(f"unreadable ({type(e).__name__}: {e})") from e
+        integ = manifest.get("integrity")
+        if integ is not None:
+            body = {k: v for k, v in manifest.items() if k != "integrity"}
+            mcrc = zlib.crc32(_canon_json(body)) & 0xFFFFFFFF
+            if mcrc != integ.get("manifest_crc32"):
+                raise bad(f"manifest crc32 {mcrc:#010x} != recorded "
+                          f"{integ.get('manifest_crc32'):#010x}")
+        return manifest
+
+    def _topo_error(self, step, written, detail):
+        return CheckpointTopologyError(
+            f"checkpoint step {step} in {self.dirname} was written by "
+            f"nproc={written} host(s) but is being read by "
+            f"nproc={self._nproc}: {detail}")
+
+    def _reshard_load(self, step, verify, saved_nproc=None):
+        """Restore one step written by a DIFFERENT world size: plan a
+        per-array re-slice from the W writer manifests, read (and fully
+        CRC-verify) exactly the writer shards this reader needs, and
+        re-materialize this host's slice of the state.
+
+        Returns ``(tree, reference manifest, [data_state per writer])``.
+        Raises CheckpointCorruptError on any touched shard failing
+        verification (the caller's quarantine/walk-back applies) and
+        CheckpointTopologyError when the plan cannot cover the step
+        (legacy shards without ``array_info``, diverging trees)."""
+        W = saved_nproc if saved_nproc is not None else \
+            self._saved_nproc(step)
+        R, r = self._nproc, self._proc
+        manifests = {p: self._read_shard_manifest(self._shard_path(step, p))
+                     for p in range(W)}
+        infos = {p: m.get("array_info") for p, m in manifests.items()}
+        legacy = sorted(p for p, i in infos.items() if i is None)
+        if legacy:
+            if W == 1:
+                # pre-reshard single-host save: the replicated fallback
+                # (every host reads the whole shard) — today's path
+                manifest, arrays = verify_shard(
+                    self._shard_path(step, 0), verify=verify)
+                return (self._tree_of(manifest, arrays), manifest,
+                        [manifest.get("data_state")])
+            raise self._topo_error(
+                step, W,
+                f"writer shard(s) {legacy} predate the reshard "
+                f"metadata (no array_info in the manifest), so the "
+                f"re-slice plan cannot cover them — restart at the "
+                f"written world size (check `fsck_checkpoint.py "
+                f"--nproc`), or re-save the checkpoint")
+        why = _cross_writer_blocker(manifests)
+        if why:
+            raise self._topo_error(step, W, why)
+        src = r % W                     # replicated-leaf source shard
+        ref = manifests[src]
+        plan, needed = {}, {src}
+        for key, inf in infos[src].items():
+            axis = inf.get("axis")
+            if axis is None:
+                plan[key] = None
+                continue
+            lens = [infos[p][key]["shape"][axis] for p in range(W)]
+            start, end = even_interval(sum(lens), R, r)
+            off, pieces = 0, []
+            for p, ln in enumerate(lens):
+                lo, hi = max(start - off, 0), min(end - off, ln)
+                if lo < hi:
+                    pieces.append((p, lo, hi))
+                    needed.add(p)
+                off += ln
+            plan[key] = (axis, pieces)
+        arrays_by_p = {
+            p: verify_shard(self._shard_path(step, p), verify=verify)[1]
+            for p in sorted(needed)}
+        out = {}
+        for key, pl in plan.items():
+            if pl is None:
+                out[key] = arrays_by_p[src][key]
+                continue
+            axis, pieces = pl
+            if not pieces:
+                # this reader's interval is empty (more readers than
+                # rows): a zero-length slice with the right dtype and
+                # trailing dims
+                a = arrays_by_p[src][key]
+                idx = [slice(None)] * a.ndim
+                idx[axis] = slice(0, 0)
+                out[key] = a[tuple(idx)]
+                continue
+            slices = []
+            for p, lo, hi in pieces:
+                a = arrays_by_p[p][key]
+                idx = [slice(None)] * a.ndim
+                idx[axis] = slice(lo, hi)
+                slices.append(a[tuple(idx)])
+            out[key] = slices[0] if len(slices) == 1 \
+                else np.concatenate(slices, axis=axis)
+        tree = self._tree_of(ref, out)
+        # reshard_restores_total is bumped by the callers at restore
+        # COMMIT time — the coordinated path may pre-load during
+        # verification and reuse the result, which must count once
+        _log.warning(
+            "resharded checkpoint step %s: written nproc=%d -> read "
+            "nproc=%d (host %d read writer shard(s) %s)",
+            step, W, R, r, sorted(needed))
+        return tree, ref, [manifests[p].get("data_state")
+                           for p in range(W)]
+
+    def _merge_data_states(self, step, states):
+        """All W writers' data cursors -> one job-level frontier (the
+        input-pipeline half of a topology change). None when no writer
+        saved one; CheckpointTopologyError when they cannot be merged
+        exactly — a rescale must never silently drop or double-consume
+        records."""
+        if all(s is None for s in states):
+            return None
+        if any(s is None for s in states):
+            saved = [p for p, s in enumerate(states) if s is not None]
+            raise self._topo_error(
+                step, len(states),
+                f"only writer shard(s) {saved} carry a data cursor — "
+                f"a partial frontier cannot be re-partitioned exactly")
+        from paddle_tpu.dataio.dataloader import merge_rank_states
+        try:
+            return merge_rank_states(states)
+        except ValueError as e:
+            raise self._topo_error(
+                step, len(states),
+                f"the per-rank data cursors cannot be merged into a "
+                f"job-level frontier ({e}); resume at the written "
+                f"world size instead") from e
+
+    def _load_step_any(self, step, verify):
+        """(tree, manifest, data_state) honoring topology: a step
+        written by this very world size takes the fast path (own
+        shard, no manifest pre-scan — the fixed-world restore pays no
+        reshard cost); any other written nproc goes through the
+        reshard plan, whose data cursors merge into one frontier."""
+        try:
+            W = self._saved_nproc(step)
+        except FileNotFoundError:
+            enforce(False, f"no checkpoint meta for step {step} in "
+                           f"{self.dirname}")
+        if W == self._nproc:
+            manifest, arrays = self._read_own_shard(step, verify,
+                                                    saved_nproc=W)
+            return (self._tree_of(manifest, arrays), manifest,
+                    manifest.get("data_state"))
+        tree, ref, dstates = self._reshard_load(step, verify,
+                                                saved_nproc=W)
+        _m_reshard.inc()
+        return tree, ref, _PendingMerge(dstates)
 
     def restore(self, step=None, verify=None):
         """Returns (tree, step). Under multi-process, each host reads
-        its own shard (the sharding that was saved).
+        its own shard (the sharding that was saved) — unless the step
+        was written by a *different* world size, in which case the
+        reshard plan re-slices the writer shards onto this topology
+        (see ``_reshard_load``; ``CheckpointTopologyError`` when the
+        plan cannot cover the step, e.g. pre-``array_info`` shards).
 
         With ``step=None`` the newest *verifying* step is restored:
         corrupt/torn steps are quarantined (every host's shard + meta
@@ -761,11 +1177,10 @@ class CheckpointManager:
         if verify is None:
             verify = self.verify_restore
         if step is not None:
-            tree, manifest = self._load_step(step, verify)
+            tree, _manifest, ds = self._load_step_any(step, verify)
             if verify:
                 self._last_verified = step
-            self._restored_data_state = (step,
-                                         manifest.get("data_state"))
+            self._restored_data_state = (step, ds)
             return tree, step
         if self._nproc > 1:
             return self._restore_coordinated(verify)
@@ -775,14 +1190,18 @@ class CheckpointManager:
         quarantined = 0
         for s in reversed(steps):
             try:
-                tree, manifest = self._load_step(s, verify)
+                tree, _manifest, ds = self._load_step_any(s, verify)
             except CheckpointCorruptError as e:
                 self._quarantine(s, e)
                 quarantined += 1
                 continue
+            # CheckpointTopologyError propagates: the step is HEALTHY,
+            # just written for another world size — silently walking
+            # past it to older state would lose training progress with
+            # no operator decision; the error names the recovery move
             if verify:
                 self._last_verified = s
-            self._restored_data_state = (s, manifest.get("data_state"))
+            self._restored_data_state = (s, ds)
             if s != newest:
                 # the restart-from-fallback line (docs/DEBUGGING.md's
                 # exit-code/recovery table points at it)
@@ -854,10 +1273,12 @@ class CheckpointManager:
                     f"restarts the gang")
             time.sleep(0.05)
 
-    def _publish_verdict(self, round_id, nonce, ok, bad, partial):
+    def _publish_verdict(self, round_id, nonce, ok, bad, partial,
+                         unfit=None):
         self._publish_json(self._verdict_path(self._proc),
                            {"round": round_id, "nonce": nonce,
-                            "ok": ok, "bad": bad, "partial": partial},
+                            "ok": ok, "bad": bad, "partial": partial,
+                            "unfit": unfit or {}},
                            prefix=f".restore.v{self._proc}.")
 
     def _read_round(self):
@@ -875,43 +1296,81 @@ class CheckpointManager:
         return rnd
 
     def _verify_own(self, steps, verify, stop_at_first_ok):
-        """Walk ``steps`` NEWEST-FIRST verifying this host's shard of
-        each. Returns ``(ok, bad, cache)``: verified step list, {step:
-        error} for positive corruption, and the newest verified step's
-        ``(step, manifest, arrays)`` — ONE copy retained (keeping every
-        verified step's arrays would hold keep_max model copies in
-        host RAM at once, OOMing a host that trains fine; the decision
-        is overwhelmingly the newest ok step, so keep just that and
-        re-read on the rare older pick). With ``stop_at_first_ok`` the
-        walk stops at the first verifying step — the healthy-path
-        restore reads ONE shard, not keep_max of them. Transient
-        OSError propagates: crash-and-retry, don't vote."""
+        """Walk ``steps`` NEWEST-FIRST verifying this host's share of
+        each. A step written by this very world size means this host's
+        own shard; a step written by a different nproc means this
+        reader runs the full reshard pre-load (``_reshard_load``) — it
+        reads and CRC-verifies exactly the writer shards THIS restore
+        would touch, once, and the result is cached so the agreed step
+        is never read twice. (Verification coverage tracks what is
+        restored: a writer shard no reader overlaps is never read, so
+        it needs no vote.)
+
+        Returns ``(ok, bad, unfit, cache)``: verified step list,
+        {step: error} for positive corruption, {step: reason} for
+        steps the reshard plan cannot cover (HEALTHY files — never
+        quarantined), and the newest verified step's payload — ONE
+        copy retained, tagged ``(step, "own", manifest, arrays)`` or
+        ``(step, "reshard", tree, ref, data_states)`` (keeping every
+        verified step would hold keep_max model copies in host RAM at
+        once). With ``stop_at_first_ok`` the walk stops at the first
+        verifying step — the healthy-path restore reads ONE shard (or
+        one reshard share), not keep_max of them. Transient OSError
+        propagates: crash-and-retry, don't vote."""
         from paddle_tpu.core.enforce import EnforceNotMet
-        ok, bad = [], {}
+        ok, bad, unfit = [], {}, {}
         cache = None
         for s in sorted(steps, reverse=True):
             try:
-                manifest, arrays = self._read_own_shard(s, verify)
+                W = self._saved_nproc(s)
+            except FileNotFoundError:
+                continue            # vanished under us (see below)
             except CheckpointCorruptError as e:
                 bad[s] = str(e)
                 continue
-            except EnforceNotMet:
-                # the step vanished under us — quarantined by host 0
-                # (whose prior incarnation died before publishing its
-                # decision) or pruned by a peer. Neither verified nor
-                # positive corruption evidence: skip it, so the stale
-                # entry in our steps list can't crash the protocol
-                continue
-            ok.append(s)
-            if cache is None:
-                cache = (s, manifest, arrays)
+            if W == self._nproc:
+                try:
+                    manifest, arrays = self._read_own_shard(
+                        s, verify, saved_nproc=W)
+                except CheckpointCorruptError as e:
+                    bad[s] = str(e)
+                    continue
+                except EnforceNotMet:
+                    # the step vanished under us — quarantined by
+                    # host 0 (whose prior incarnation died before
+                    # publishing its decision) or pruned by a peer.
+                    # Neither verified nor positive corruption
+                    # evidence: skip it, so the stale entry in our
+                    # steps list can't crash the protocol
+                    continue
+                ok.append(s)
+                if cache is None:
+                    cache = (s, "own", manifest, arrays)
+            else:
+                try:
+                    tree, ref, dstates = self._reshard_load(
+                        s, verify, saved_nproc=W)
+                except CheckpointCorruptError as e:
+                    bad[s] = str(e)
+                    continue
+                except CheckpointTopologyError as e:
+                    # healthy files the plan cannot cover — reported
+                    # distinctly so host 0 refuses instead of
+                    # quarantining them
+                    unfit[s] = str(e)
+                    continue
+                except FileNotFoundError:
+                    continue        # vanished under us
+                ok.append(s)
+                if cache is None:
+                    cache = (s, "reshard", tree, ref, dstates)
             if stop_at_first_ok:
                 break
-        return ok, bad, cache
+        return ok, bad, unfit, cache
 
     @staticmethod
-    def _is_partial(steps, ok, bad):
-        return len(ok) + len(bad) < len(steps)
+    def _is_partial(steps, ok, bad, unfit):
+        return len(ok) + len(bad) + len(unfit) < len(steps)
 
     def _collect_verdicts(self, round_id, own):
         """Host 0: every host's CURRENT-ROUND verdict (own included).
@@ -949,20 +1408,27 @@ class CheckpointManager:
         verdicts, and — only if the partial ok-sets don't intersect —
         escalate once to a "full" round before agreeing. Quarantines
         the positively-corrupt steps and publishes the nonce-echoed
-        decision. Returns (decision, own shard cache, own ok, bad).
-        The announcement goes out BEFORE host 0's own CRC pass (the
-        escalated round already works this way): followers verify in
-        parallel instead of burning their coord_timeout budget idle
-        while host 0 reads multi-GB shards."""
+        decision; when the agreed step was written by a different
+        world size the decision carries the reshard plan (from/to
+        nproc), and a topology-unfit step NEWER than anything
+        restorable publishes a ``topo_error`` decision instead (every
+        host raises ``CheckpointTopologyError`` — precise refusal, not
+        a collective timeout). Returns (decision, own shard cache, own
+        ok, bad). The announcement goes out BEFORE host 0's own CRC
+        pass (the escalated round already works this way): followers
+        verify in parallel instead of burning their coord_timeout
+        budget idle while host 0 reads multi-GB shards."""
         round_id = nonce
         self._publish_json(self._round_path(),
                            {"round": round_id, "mode": "first"},
                            prefix=".restore.r.")
-        ok, bad, cache = self._verify_own(steps, verify,
-                                          stop_at_first_ok=True)
+        ok, bad, unfit, cache = self._verify_own(steps, verify,
+                                                 stop_at_first_ok=True)
         verdicts = self._collect_verdicts(
             round_id, {"nonce": nonce, "ok": ok, "bad": bad,
-                       "partial": self._is_partial(steps, ok, bad)})
+                       "unfit": unfit,
+                       "partial": self._is_partial(steps, ok, bad,
+                                                   unfit)})
         common = self._common_ok(verdicts)
         if not common and any(v.get("partial")
                               for v in verdicts.values()):
@@ -973,11 +1439,11 @@ class CheckpointManager:
             self._publish_json(self._round_path(),
                                {"round": round_id, "mode": "full"},
                                prefix=".restore.r.")
-            ok, bad, cache = self._verify_own(steps, verify,
-                                              stop_at_first_ok=False)
+            ok, bad, unfit, cache = self._verify_own(
+                steps, verify, stop_at_first_ok=False)
             verdicts = self._collect_verdicts(
                 round_id, {"nonce": nonce, "ok": ok, "bad": bad,
-                           "partial": False})
+                           "unfit": unfit, "partial": False})
             common = self._common_ok(verdicts)
         chosen = max(common) if common else None
         all_bad = {}
@@ -986,10 +1452,41 @@ class CheckpointManager:
                 all_bad.setdefault(int(s), f"host {p}: {msg}")
         for s in sorted(all_bad, reverse=True):
             self._quarantine(s, all_bad[s])
-        decision = {"step": chosen,
-                    "nonces": {str(p): v.get("nonce")
-                               for p, v in verdicts.items()},
+        all_unfit = {}
+        for p, v in verdicts.items():
+            for s, msg in v.get("unfit", {}).items():
+                all_unfit.setdefault(int(s), f"host {p}: {msg}")
+        nonces = {str(p): v.get("nonce") for p, v in verdicts.items()}
+        if all_unfit and (chosen is None or max(all_unfit) > chosen):
+            # something NEWER than the best restorable step cannot be
+            # resharded onto this topology: refuse loudly rather than
+            # silently resuming older state (the files are healthy —
+            # nothing is quarantined over this)
+            s = max(all_unfit)
+            decision = {
+                "step": None, "nonces": nonces,
+                "quarantined": sorted(all_bad),
+                "topo_error": (
+                    f"checkpoint step {s} in {self.dirname} cannot be "
+                    f"restored onto nproc={self._nproc}: "
+                    f"{all_unfit[s]} — restart at the written world "
+                    f"size (check `fsck_checkpoint.py --nproc`), or "
+                    f"re-save the checkpoint")}
+            self._publish_json(self._decision_path(), decision,
+                               prefix=".restore.d.")
+            return decision, cache, ok, bad
+        decision = {"step": chosen, "nonces": nonces,
                     "quarantined": sorted(all_bad)}
+        if chosen is not None:
+            # the reshard plan in the decision is what every host's
+            # load path keys on — a meta blip here propagates
+            # (retries inside _saved_nproc, then crash-and-retry via
+            # the supervisor) rather than silently publishing a
+            # fixed-topology decision for a mismatched step
+            W_c = self._saved_nproc(chosen)
+            if W_c != self._nproc:
+                decision["reshard"] = {"from_nproc": W_c,
+                                       "to_nproc": self._nproc}
         self._publish_json(self._decision_path(), decision,
                            prefix=".restore.d.")
         return decision, cache, ok, bad
@@ -1018,8 +1515,8 @@ class CheckpointManager:
         re-announcement reuses the computed verdict, and full-mode
         verification runs at most once. Returns (decision, own shard
         cache, own ok, bad)."""
-        state = {"round": None, "ok": [], "bad": {}, "cache": None,
-                 "mode": None}
+        state = {"round": None, "ok": [], "bad": {}, "unfit": {},
+                 "cache": None, "mode": None}
         box = {}
 
         def poll():
@@ -1036,20 +1533,22 @@ class CheckpointManager:
                                    + self.coord_timeout)
                 mode = rnd["mode"]
                 if mode == "full" and state["mode"] != "full":
-                    state["ok"], state["bad"], state["cache"] = \
-                        self._verify_own(steps, verify,
-                                         stop_at_first_ok=False)
+                    (state["ok"], state["bad"], state["unfit"],
+                     state["cache"]) = self._verify_own(
+                        steps, verify, stop_at_first_ok=False)
                     state["mode"] = "full"
                 elif state["mode"] is None:
-                    state["ok"], state["bad"], state["cache"] = \
-                        self._verify_own(steps, verify,
-                                         stop_at_first_ok=True)
+                    (state["ok"], state["bad"], state["unfit"],
+                     state["cache"]) = self._verify_own(
+                        steps, verify, stop_at_first_ok=True)
                     state["mode"] = "first"
                 partial = (state["mode"] != "full" and
                            self._is_partial(steps, state["ok"],
-                                            state["bad"]))
+                                            state["bad"],
+                                            state["unfit"]))
                 self._publish_verdict(rid, nonce, state["ok"],
-                                      state["bad"], partial)
+                                      state["bad"], partial,
+                                      unfit=state["unfit"])
                 state["round"] = rid
             return self._read_decision(nonce)
 
@@ -1060,7 +1559,6 @@ class CheckpointManager:
         return decision, state["cache"], state["ok"], state["bad"]
 
     def _restore_coordinated(self, verify):
-        import jax.numpy as jnp
         steps = self._complete_steps()
         enforce(steps, f"no checkpoint in {self.dirname}")
         newest = steps[-1]
@@ -1070,6 +1568,8 @@ class CheckpointManager:
         else:
             decision, cache, ok, bad = self._follow(steps, verify,
                                                     nonce)
+        if decision.get("topo_error"):
+            raise CheckpointTopologyError(decision["topo_error"])
         chosen = decision.get("step")
         if chosen is None:
             raise CheckpointCorruptError(
@@ -1077,15 +1577,43 @@ class CheckpointManager:
                 f"every host (this host: {len(ok)} ok, {len(bad)} "
                 f"bad); nothing safe to restore")
         chosen = int(chosen)
-        if cache is not None and cache[0] == chosen:
-            manifest, arrays = cache[1], cache[2]
+        # the DECISION carries the topology verdict — no meta re-read
+        # here, so the healthy cache-hit path stays I/O-free and a
+        # meta pruned/quarantined between decision and load can't
+        # crash an already-agreed restore
+        plan = decision.get("reshard")
+        if plan:
+            # the agreed step was written by a different world size:
+            # every host re-slices its share per the decision's
+            # reshard plan (integrity applies to every shard touched;
+            # the verification pass already did — and cached — exactly
+            # this work for the newest ok step)
+            if cache is not None and cache[0] == chosen \
+                    and cache[1] == "reshard":
+                tree, _ref, dstates = cache[2], cache[3], cache[4]
+            else:
+                tree, _ref, dstates = self._reshard_load(
+                    chosen, verify,
+                    saved_nproc=int(plan["from_nproc"]))
+            _m_reshard.inc()
+            ds = _PendingMerge(dstates)
         else:
-            manifest, arrays = self._read_own_shard(chosen, verify)
-        tree = tree_from_manifest(
-            manifest, {k: jnp.asarray(v) for k, v in arrays.items()})
+            if cache is not None and cache[0] == chosen \
+                    and cache[1] == "own":
+                manifest, arrays = cache[2], cache[3]
+            else:
+                # no reshard plan in the decision == the agreed step
+                # was written by THIS world size; passing it through
+                # skips the meta re-read here too (a meta pruned by a
+                # stale incarnation between decision and load must not
+                # crash an already-agreed restore)
+                manifest, arrays = self._read_own_shard(
+                    chosen, verify, saved_nproc=self._nproc)
+            tree = self._tree_of(manifest, arrays)
+            ds = manifest.get("data_state")
         if verify:
             self._last_verified = chosen
-        self._restored_data_state = (chosen, manifest.get("data_state"))
+        self._restored_data_state = (chosen, ds)
         if chosen != newest:
             # the restart-from-fallback line (docs/DEBUGGING.md)
             _log.warning(
@@ -1096,24 +1624,39 @@ class CheckpointManager:
         return tree, chosen
 
     def restore_data_state(self, step):
-        """The data-pipeline cursor saved with ``step`` (this host's
-        shard manifest), or None when the step predates data_state /
-        none was saved. Cached from the restore() that just loaded the
-        step, so the common path rereads nothing."""
+        """The data-pipeline cursor saved with ``step``, or None when
+        the step predates data_state / none was saved. For a step
+        written by this very world size that is this host's own shard
+        manifest's cursor; for a different written nproc it is the
+        job-level frontier merged from every writer's cursor (see
+        ``_merge_data_states``). Cached from the restore() that just
+        loaded the step, so the common path rereads nothing."""
         cached = self._restored_data_state
         if cached is not None and cached[0] == step:
+            if isinstance(cached[1], _PendingMerge):
+                merged = self._merge_data_states(step, cached[1].states)
+                self._restored_data_state = (step, merged)
+                return merged
             return cached[1]
         # cold path (restore() didn't just load this step): same shard
-        # resolution as _load_step — shard0 substitutes only for a
+        # resolution as _load_step_any — shard0 substitutes only for a
         # replicated single-host save (another host's cursor would be
         # the wrong host's position)
+        try:
+            saved_nproc = self._saved_nproc(step)
+        except (OSError, CheckpointCorruptError):
+            saved_nproc = None
+        if saved_nproc is not None and saved_nproc != self._nproc \
+                and saved_nproc != 1:
+            # changed topology: the per-writer cursors only make sense
+            # merged into one frontier
+            states = [
+                self._read_shard_manifest(
+                    self._shard_path(step, p)).get("data_state")
+                for p in range(saved_nproc)]
+            return self._merge_data_states(step, states)
         path = self._shard_path(step)
         if not os.path.exists(path):
-            try:
-                with open(self._meta_path(step)) as f:
-                    saved_nproc = json.load(f).get("nproc", 1)
-            except (OSError, ValueError):
-                saved_nproc = None
             enforce(saved_nproc == 1,
                     f"checkpoint step {step}: no shard for host "
                     f"{self._proc} to read data_state from")
@@ -1131,7 +1674,8 @@ class CheckpointManager:
 
 def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
                     save_interval_steps=100, keep_max=3,
-                    data_state=None):
+                    data_state=None, proc=None, nproc=None,
+                    shard_axes=None):
     """Run ``state = step_fn(step, state)`` for steps [resume..total),
     checkpointing every interval and resuming from the newest
     *verified* checkpoint if one exists (corrupt newer steps are
@@ -1144,6 +1688,15 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
     killed-and-resumed run consumes exactly the record sequence an
     uninterrupted run would — create the loader's iterator inside
     ``step_fn`` (first use), after the restore has applied the state.
+
+    ``proc``/``nproc``: this rank's identity in a SHARED checkpoint
+    dir (e.g. ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` under
+    the elastic launcher — the incarnation's world size). When a
+    restart's nproc differs from the one that wrote the newest
+    checkpoint, restore re-shards it (see ``CheckpointManager``).
+    ``shard_axes`` annotates the state tree for that: a congruent
+    pytree of per-leaf shard axes (None = replicated), passed to every
+    ``save``.
 
     The elastic-recovery loop the reference lacks (SURVEY §5.3): kill the
     process at any point and re-invoking continues from the last saved
@@ -1170,7 +1723,8 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
     if exp is not None:
         exp.start()
     mgr = CheckpointManager(dirname, keep_max=keep_max,
-                            save_interval_steps=save_interval_steps)
+                            save_interval_steps=save_interval_steps,
+                            proc=proc, nproc=nproc)
     hb = Heartbeat.from_env()
     preempted = threading.Event()
     restore_handler = None
@@ -1208,7 +1762,8 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
             state = step_fn(step, state)
             if hb is not None:
                 hb.beat()
-            saved = mgr.maybe_save(step, state, data_state=_ds())
+            saved = mgr.maybe_save(step, state, data_state=_ds(),
+                                   axes=shard_axes)
             if preempted.is_set():
                 # flush inside the launcher's grace window: save the
                 # completed step (unless the interval policy just did —
@@ -1216,7 +1771,8 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
                 # grace budget), drain the async writer (meta published
                 # = checkpoint complete), then report SIGTERM death
                 if not saved:
-                    mgr.save(step, state, data_state=_ds())
+                    mgr.save(step, state, data_state=_ds(),
+                             axes=shard_axes)
                 mgr.wait()
                 # this handler shadows the flight recorder's SIGTERM
                 # hook while the loop runs, so dump explicitly: a
@@ -1225,7 +1781,8 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
                 if flight_recorder.is_enabled():
                     flight_recorder.dump(reason="preempted")
                 raise SystemExit(143)
-        mgr.save(total_steps - 1, state, data_state=_ds())
+        mgr.save(total_steps - 1, state, data_state=_ds(),
+                 axes=shard_axes)
         return state
     finally:
         if restore_handler is not None:

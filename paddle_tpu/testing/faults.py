@@ -10,6 +10,13 @@ boundary for free:
   the loop reaches step N: a rank crash.
 - ``PT_FAULT_HANG_AT_STEP=N``   — stop making progress at step N while
   staying alive (and not heartbeating): a hang, for the watchdog.
+- ``PT_FAULT_SHRINK_AT_STEP=N`` — hard-exit with code 31
+  (``SHRINK_EXIT_CODE``, = ``launch.SHRINK_RC``) at step N: the rank
+  *permanently departs* (a spot reclaim / node repair saying goodbye).
+  An elastic supervisor (``--min_ranks``) must resume the job at the
+  reduced world size — the checkpoint re-shards, the data cursor
+  rescales — instead of respawning a gang that can never be whole
+  again. Scope with ``PT_FAULT_RANK``.
 - ``PT_FAULT_SLOW_WRITE=S``     — ``install_slow_write()`` patches
   ``CheckpointManager._write`` to sleep S seconds first: an in-flight
   async checkpoint, for preemption tests.
@@ -50,10 +57,12 @@ boundary for free:
   crash-at-step fault would re-kill every restart and the job could
   never finish.
 
-Exit codes 23 (plain crash) and 29 (checkpoint corruption + crash) are
-deliberately distinct from each other and from the launcher's own codes
-(124 timeout, 143 preemption) and the numerics trip (17) so tests can
-assert who died and why.
+Exit codes 23 (plain crash), 29 (checkpoint corruption + crash) and 31
+(elastic shrink — a rank departing for good) are deliberately distinct
+from each other and from the launcher's own codes (124 timeout, 143
+preemption) and the numerics trip (17) so tests can assert who died and
+why — and so the supervisor can tell "restart me" from "carry on
+without me".
 """
 
 import os
@@ -62,10 +71,15 @@ import time
 
 __all__ = ["maybe_fault", "poison_feed", "install_slow_write",
            "corrupt_checkpoint", "corrupt_newest_checkpoint",
-           "CRASH_EXIT_CODE", "CKPT_FAULT_EXIT_CODE"]
+           "CRASH_EXIT_CODE", "CKPT_FAULT_EXIT_CODE",
+           "SHRINK_EXIT_CODE"]
 
 CRASH_EXIT_CODE = 23
 CKPT_FAULT_EXIT_CODE = 29
+#: must equal distributed.launch.SHRINK_RC (not imported: this module
+#: stays importable without the launcher, and the pair is pinned by a
+#: tier-1 test instead)
+SHRINK_EXIT_CODE = 31
 
 
 def _int_env(name):
@@ -368,6 +382,12 @@ def maybe_fault(step, ckpt_dir=None):
         sys.stderr.write(f"[faults] injected crash at step {step}\n")
         sys.stderr.flush()
         os._exit(CRASH_EXIT_CODE)       # no atexit, no flush: a crash
+    shrink_at = _int_env("PT_FAULT_SHRINK_AT_STEP")
+    if shrink_at is not None and step == shrink_at and gate("shrink"):
+        sys.stderr.write(f"[faults] injected elastic shrink (rank "
+                         f"departs for good) at step {step}\n")
+        sys.stderr.flush()
+        os._exit(SHRINK_EXIT_CODE)
     hang_at = _int_env("PT_FAULT_HANG_AT_STEP")
     if hang_at is not None and step == hang_at and gate("hang"):
         sys.stderr.write(f"[faults] injected hang at step {step}\n")

@@ -1,0 +1,128 @@
+"""Training worker for the topology-elastic end-to-end tests.
+
+Unlike ``elastic_worker.py`` (per-rank independent checkpoint dirs),
+every rank of this worker shares ONE checkpoint dir: each rank writes
+its ``proc``-tagged shard and restore is the coordinated collective —
+including the reshard path when an incarnation comes back with a
+different world size (``PADDLE_TRAINERS_NUM``).
+
+State per rank:
+
+- ``w``      — replicated scalar, w += 0.5*(10-w) each step: a
+  deterministic, data-independent "loss trajectory" that must be
+  bit-identical at any world size;
+- ``emb``    — a 4-row global vector sharded along axis 0 (each rank
+  owns its ``even_interval`` slice); global row i accumulates global
+  batch element i every step, so the job-level ``emb`` evolution is a
+  pure function of the data — resharding across world sizes must
+  reproduce it exactly;
+- ``opt``    — a replicated [array, scalar] list, exercising nested
+  (opt-state-shaped) trees through the reshard planner.
+
+Data: a ``FileDataLoader(stateful=True, world_size=W, rank=r)`` over
+the data dir's ``*.txt`` files — GLOBAL batch 4, so each rank consumes
+its row slice of the same job-level batch sequence at any world size.
+The per-step per-rank batch sums land in
+``<out_prefix>.rank<id>.batches.json`` (atomic flush every step, merged
+across incarnations, keyed by step); summing them across ranks per step
+gives the GLOBAL batch sum, comparable bit-exactly across topologies
+(records are small integers — float32-exact).
+
+argv: out_prefix ckpt_dir total_steps data_dir [step_secs]
+      [save_interval]
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+GLOBAL_BATCH = 4
+EMB_ROWS = 4
+
+
+def main():
+    out_prefix, ckpt_dir = sys.argv[1], sys.argv[2]
+    total_steps = int(sys.argv[3])
+    data_dir = sys.argv[4]
+    step_secs = float(sys.argv[5]) if len(sys.argv) > 5 else 0.05
+    save_interval = int(sys.argv[6]) if len(sys.argv) > 6 else 1
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    import numpy as np
+
+    from paddle_tpu.dataio.dataloader import FileDataLoader
+    from paddle_tpu.io_checkpoint import auto_checkpoint, even_interval
+    from paddle_tpu.testing import faults
+
+    loader = FileDataLoader(
+        sorted(glob.glob(os.path.join(data_dir, "*.txt"))),
+        lambda rec: np.float32(rec), batch_size=GLOBAL_BATCH,
+        shuffle_buffer=8, seed=5, epochs=-1, device_put=False,
+        stateful=True, world_size=world, rank=rank)
+
+    batches_path = f"{out_prefix}.rank{rank}.batches.json"
+    batch_log = {}
+    if os.path.exists(batches_path):
+        with open(batches_path) as f:
+            batch_log = json.load(f)
+
+    lo, hi = even_interval(EMB_ROWS, world, rank)
+    axes = {"w": None, "emb": 0, "opt": [None, None]}
+    first_step = []
+    box = {}
+
+    def init_state():
+        return {"w": 0.0,
+                "emb": np.zeros(hi - lo, dtype=np.float32),
+                "opt": [np.ones((2, 2), dtype=np.float32), 0.0]}
+
+    def step_fn(step, state):
+        if not first_step:
+            first_step.append(step)
+        faults.maybe_fault(step, ckpt_dir=ckpt_dir)
+        if "it" not in box:
+            box["it"] = iter(loader)        # AFTER data-state restore
+        b = np.asarray(next(box["it"]))     # this rank's row slice
+        batch_log[str(step)] = {
+            "bsum": float(np.sum(b)),
+            "w": float(state["w"]),
+        }
+        # flush EVERY step: an os._exit fault skips finally blocks,
+        # and the steps only this incarnation executed must still be
+        # comparable against the clean run
+        tmp = batches_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(batch_log, f)
+        os.replace(tmp, batches_path)
+        time.sleep(step_secs)
+        # global emb row i accumulates global batch element i: this
+        # rank's slice of the batch is exactly its emb rows (GLOBAL
+        # batch == EMB rows, both even_interval-partitioned)
+        emb = np.asarray(state["emb"]) + b
+        opt0 = np.asarray(state["opt"][0])
+        return {"w": state["w"] + 0.5 * (10.0 - state["w"]),
+                "emb": emb,
+                "opt": [opt0, float(state["opt"][1]) + 1.0]}
+
+    final = auto_checkpoint(ckpt_dir, init_state, total_steps, step_fn,
+                            save_interval_steps=save_interval,
+                            data_state=loader, proc=rank, nproc=world,
+                            shard_axes=axes)
+    with open(f"{out_prefix}.rank{rank}.json", "w") as f:
+        json.dump({
+            "w": float(final["w"]),
+            "emb": [float(v) for v in np.asarray(final["emb"])],
+            "emb_rows": [lo, hi],
+            "opt_steps": float(final["opt"][1]),
+            "world": world,
+            "first_step": first_step[0] if first_step else total_steps,
+            "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                "0")),
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -616,10 +616,11 @@ class TestSharedDirMultiHost:
         self._save_two_host(tmp_path, (1, 2))
         m1 = _host_mgr(tmp_path, 1, 2)
         m1._quarantine(2, "peer incarnation found rot")
-        ok, bad, cache = m1._verify_own([1, 2], True,
-                                        stop_at_first_ok=False)
+        ok, bad, unfit, cache = m1._verify_own([1, 2], True,
+                                               stop_at_first_ok=False)
         assert ok == [1]
         assert 2 not in bad         # no positive corruption evidence
+        assert 2 not in unfit       # nor a topology refusal
         assert cache is not None and cache[0] == 1
 
     def test_follower_budget_resets_on_new_round(self, tmp_path):

@@ -88,7 +88,7 @@ class TestPortProbe:
             with pytest.raises(RuntimeError) as ei:
                 launch_collective(["nonexistent.py"], nproc=2,
                                   started_port=port)
-            assert "2*nproc" in str(ei.value)
+            assert "2*max world size" in str(ei.value)
             assert f"{port}..{port + 3}" in str(ei.value)
         finally:
             hold.close()

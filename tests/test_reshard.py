@@ -1,0 +1,1104 @@
+"""Topology-elastic restart: checkpoint resharding onto a different
+world size, data-cursor rescaling across a changed rank count, and the
+elastic gang supervisor (shrink on rank departure, join admission).
+
+Tier-1: the re-slice planner (uneven divisors, replicated leaves,
+opt-state trees, empty slices), CheckpointTopologyError precision,
+coordinated reshard agreement, cursor merge/re-partition, launcher
+elasticity units. The `slow` end-to-end runs kill a 2-rank job
+mid-training and resume it at 1 and at 4 ranks, asserting bit-identical
+per-step GLOBAL batch sums and `w` trajectory — and that a corrupt
+newest step still walks back correctly under the new topology.
+"""
+
+import json
+import logging
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataio.dataloader import FileDataLoader, merge_rank_states
+from paddle_tpu.distributed import health
+from paddle_tpu.distributed.launch import (
+    EXIT_CODE_LABELS, SHRINK_RC, _take_join_requests, elastic_join_dir,
+    launch_collective,
+)
+from paddle_tpu.io_checkpoint import (
+    CheckpointCorruptError, CheckpointManager, CheckpointTopologyError,
+    _integrity_block, even_interval, verify_shard,
+)
+from paddle_tpu.monitor.registry import REGISTRY
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "reshard_worker.py")
+
+SUBPROC_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+
+def _mgr(path, proc, nproc, **kw):
+    kw.setdefault("async_save", False)
+    kw.setdefault("save_interval_steps", 1)
+    kw.setdefault("keep_max", 10)
+    return CheckpointManager(str(path), proc=proc, nproc=nproc, **kw)
+
+
+def _shard(path, step, proc=0):
+    return os.path.join(str(path), f"ckpt_{step}.shard{proc}.npz")
+
+
+def _sharded_state(proc, nproc, step, rows=10):
+    """Host ``proc``'s slice of a job-level state: `w` sharded along
+    axis 0 (rows 0..rows-1 + step), a replicated nested opt list, and
+    an inline scalar."""
+    lo, hi = even_interval(rows, nproc, proc)
+    return {"w": np.arange(float(rows))[lo:hi] + step,
+            "opt": [np.full((3, 2), float(step)), ("m", float(step))],
+            "n": 7}
+
+
+_AXES = {"w": 0, "opt": [None, (None, None)], "n": None}
+
+
+def _save_all_hosts(path, step, nproc, state_fn=_sharded_state,
+                    axes=_AXES, data_states=None, **kw):
+    """One complete multi-host step: every host's shard, host 0 last
+    (it publishes the meta only once the peers' shards exist)."""
+    for p in list(range(1, nproc)) + [0]:
+        m = _mgr(path, p, nproc, **kw)
+        ds = data_states[p] if data_states is not None else None
+        m.save(step, state_fn(p, nproc, step), data_state=ds, axes=axes)
+        m.close()
+
+
+def _strip_array_info(path):
+    """Rewrite a shard as if a pre-reshard version had written it: no
+    ``array_info``, integrity recomputed consistently (the shard stays
+    VERIFIABLE — only the reshard metadata is gone)."""
+    with np.load(path, allow_pickle=False) as blob:
+        arrays = {k: blob[k].copy() for k in blob.files
+                  if k != "__manifest__"}
+        manifest = json.loads(
+            bytes(blob["__manifest__"].tobytes()).decode("utf-8"))
+    body = {k: v for k, v in manifest.items()
+            if k not in ("integrity", "array_info")}
+    manifest = dict(body, integrity=_integrity_block(body, arrays))
+    mblob = np.frombuffer(json.dumps(manifest).encode("utf-8"),
+                          dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, __manifest__=mblob, **arrays)
+
+
+# ---------------------------------------------------------------------------
+class TestEvenInterval:
+    def test_partitions_exactly(self):
+        for total in (0, 1, 7, 10, 64):
+            for parts in (1, 2, 3, 4, 7):
+                ivs = [even_interval(total, parts, i)
+                       for i in range(parts)]
+                assert ivs[0][0] == 0 and ivs[-1][1] == total
+                for (a, b), (c, d) in zip(ivs, ivs[1:]):
+                    assert b == c           # contiguous, disjoint
+                sizes = [b - a for a, b in ivs]
+                assert max(sizes) - min(sizes) <= 1
+
+    def test_matches_array_split(self):
+        for total, parts in ((10, 3), (7, 4), (2, 4)):
+            arr = np.arange(total)
+            for i, piece in enumerate(np.array_split(arr, parts)):
+                lo, hi = even_interval(total, parts, i)
+                assert np.array_equal(arr[lo:hi], piece)
+
+
+class TestSaveAxes:
+    def test_array_info_recorded(self, tmp_path):
+        m = _mgr(tmp_path, 0, 1)
+        m.save(1, {"w": np.zeros((4, 3)), "b": np.ones(2), "n": 5},
+               axes={"w": 0, "b": None, "n": None})
+        manifest, _ = verify_shard(_shard(tmp_path, 1))
+        info = manifest["array_info"]
+        by_shape = {tuple(v["shape"]): v for v in info.values()}
+        assert by_shape[(4, 3)]["axis"] == 0
+        assert by_shape[(2,)]["axis"] is None
+        assert by_shape[(4, 3)]["dtype"] == "float64"
+        m.close()
+
+    def test_axes_default_all_replicated(self, tmp_path):
+        m = _mgr(tmp_path, 0, 1)
+        m.save(1, {"w": np.zeros(3)})
+        manifest, _ = verify_shard(_shard(tmp_path, 1))
+        assert all(v["axis"] is None
+                   for v in manifest["array_info"].values())
+        m.close()
+
+    def test_axis_out_of_range_rejected(self, tmp_path):
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(ValueError, match="out of range"):
+            m.save(1, {"w": np.zeros(3)}, axes={"w": 1})
+        m.close()
+
+    def test_bool_axis_rejected(self, tmp_path):
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(ValueError, match="shard axis"):
+            m.save(1, {"w": np.zeros(3)}, axes={"w": True})
+        m.close()
+
+    def test_mismatched_axes_tree_rejected(self, tmp_path):
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(ValueError, match="does not match"):
+            m.save(1, {"w": np.zeros(3)}, axes={"wrong_key": 0})
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+class TestReshardRestore:
+    def test_two_hosts_to_one(self, tmp_path):
+        _save_all_hosts(tmp_path, 3, 2)
+        m = _mgr(tmp_path, 0, 1)
+        tree, step = m.restore()
+        assert step == 3
+        assert np.array_equal(np.asarray(tree["w"]),
+                              np.arange(10.0) + 3)
+        assert np.asarray(tree["opt"][0]).shape == (3, 2)
+        assert tree["opt"][1] == ("m", 3.0)     # tuple survives
+        assert tree["n"] == 7
+        m.close()
+
+    def test_two_hosts_to_four(self, tmp_path):
+        _save_all_hosts(tmp_path, 2, 2)
+        for r in range(4):
+            m = _mgr(tmp_path, r, 4)
+            tree, _ = m.restore(step=2)
+            lo, hi = even_interval(10, 4, r)
+            assert np.array_equal(np.asarray(tree["w"]),
+                                  np.arange(10.0)[lo:hi] + 2)
+            # replicated leaves identical on every reader
+            assert np.array_equal(np.asarray(tree["opt"][0]),
+                                  np.full((3, 2), 2.0))
+            m.close()
+
+    def test_uneven_divisors_three_to_two(self, tmp_path):
+        # writers hold 4/3/3 rows; readers must get 5/5
+        _save_all_hosts(tmp_path, 1, 3)
+        for r in range(2):
+            m = _mgr(tmp_path, r, 2)
+            tree, _ = m.restore(step=1)
+            lo, hi = even_interval(10, 2, r)
+            assert np.array_equal(np.asarray(tree["w"]),
+                                  np.arange(10.0)[lo:hi] + 1)
+            m.close()
+
+    def test_more_readers_than_rows_empty_slice(self, tmp_path):
+        def small(p, nproc, step):
+            lo, hi = even_interval(2, nproc, p)
+            return {"w": np.arange(2.0).reshape(2, 1)[lo:hi]}
+
+        _save_all_hosts(tmp_path, 1, 2, state_fn=small,
+                        axes={"w": 0})
+        m = _mgr(tmp_path, 3, 4)        # rows 0,1 went to readers 0,1
+        tree, _ = m.restore(step=1)
+        w = np.asarray(tree["w"])
+        # jnp.asarray downcasts float64 -> float32 (jax default, same
+        # as the fixed-topology restore path); shape keeps the
+        # trailing dims
+        assert w.shape == (0, 1) and w.dtype == np.float32
+        m.close()
+
+    def test_one_host_to_many_slices_sharded_leaves(self, tmp_path):
+        # W=1 with array_info: sharded leaves must SLICE, not replicate
+        _save_all_hosts(tmp_path, 5, 1)
+        m = _mgr(tmp_path, 1, 2)
+        tree, _ = m.restore(step=5)
+        lo, hi = even_interval(10, 2, 1)
+        assert np.array_equal(np.asarray(tree["w"]),
+                              np.arange(10.0)[lo:hi] + 5)
+        m.close()
+
+    def test_fixed_world_pays_no_reshard(self, tmp_path):
+        """W == R never touches the reshard path (acceptance: the
+        fast path is unchanged)."""
+        _save_all_hosts(tmp_path, 1, 2)
+        before = REGISTRY.get("reshard_restores_total").value()
+        calls = []
+        orig = CheckpointManager._read_shard_manifest
+
+        def spy(self, path):
+            calls.append(path)
+            return orig(self, path)
+
+        CheckpointManager._read_shard_manifest = spy
+        try:
+            m = _mgr(tmp_path, 0, 2)
+            tree, _ = m.restore(step=1)
+            m.close()
+        finally:
+            CheckpointManager._read_shard_manifest = orig
+        assert not calls                # no manifest pre-scan
+        assert REGISTRY.get("reshard_restores_total").value() == before
+        lo, hi = even_interval(10, 2, 0)
+        assert np.array_equal(np.asarray(tree["w"]),
+                              np.arange(10.0)[lo:hi] + 1)
+
+    def test_reshard_metric_and_log(self, tmp_path, caplog):
+        _save_all_hosts(tmp_path, 1, 2)
+        before = REGISTRY.get("reshard_restores_total").value()
+        with caplog.at_level(logging.WARNING, "paddle_tpu.checkpoint"):
+            m = _mgr(tmp_path, 0, 1)
+            m.restore()
+            m.close()
+        assert REGISTRY.get("reshard_restores_total").value() \
+            == before + 1
+        assert "written nproc=2 -> read nproc=1" in caplog.text
+
+    def test_corrupt_shard_under_new_topology_walks_back(self,
+                                                         tmp_path):
+        """The acceptance case: the newest step's shard 1 is rotted;
+        a 1-rank restore of the 2-host dir must quarantine the WHOLE
+        step and land on the resharded previous one."""
+        _save_all_hosts(tmp_path, 1, 2)
+        _save_all_hosts(tmp_path, 2, 2)
+        faults.corrupt_checkpoint(_shard(tmp_path, 2, proc=1),
+                                  "bitflip")
+        before = REGISTRY.get("corrupt_checkpoints_total").value()
+        m = _mgr(tmp_path, 0, 1)
+        tree, step = m.restore()
+        assert step == 1
+        assert np.array_equal(np.asarray(tree["w"]),
+                              np.arange(10.0) + 1)
+        # both hosts' shards + meta quarantined, not just the bad one
+        assert os.path.exists(_shard(tmp_path, 2, 0) + ".corrupt")
+        assert os.path.exists(_shard(tmp_path, 2, 1) + ".corrupt")
+        assert REGISTRY.get("corrupt_checkpoints_total").value() \
+            == before + 1
+        m.close()
+
+    def test_elastic_prune_collects_old_topology_shards(self, tmp_path):
+        """After a shrink, pruning must collect the larger-world steps'
+        higher-numbered shards too (scan-based, not range(nproc))."""
+        for s in (1, 2):
+            _save_all_hosts(tmp_path, s, 2)
+        m = _mgr(tmp_path, 0, 1, keep_max=1)
+        m.restore()                     # resharded from step 2
+        m.save(3, {"w": np.arange(10.0) + 3, "opt": [np.zeros((3, 2)),
+                   ("m", 3.0)], "n": 7}, axes=_AXES)
+        m.save(4, {"w": np.arange(10.0) + 4, "opt": [np.zeros((3, 2)),
+                   ("m", 4.0)], "n": 7}, axes=_AXES)
+        m.close()
+        leftover = [f for f in os.listdir(str(tmp_path))
+                    if f.startswith("ckpt_1.") or f.startswith("ckpt_2.")]
+        # step 2 survives only because it was the last VERIFIED step;
+        # step 1 (both hosts' shards + meta) must be fully collected
+        assert not [f for f in leftover if f.startswith("ckpt_1.")], \
+            leftover
+
+
+# ---------------------------------------------------------------------------
+class TestTopologyError:
+    def test_legacy_multi_host_names_both_nprocs(self, tmp_path):
+        _save_all_hosts(tmp_path, 1, 2)
+        for p in range(2):
+            _strip_array_info(_shard(tmp_path, 1, p))
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(CheckpointTopologyError) as ei:
+            m.restore()
+        msg = str(ei.value)
+        assert "nproc=2" in msg and "nproc=1" in msg
+        assert "array_info" in msg
+        # the files are HEALTHY: nothing quarantined
+        assert os.path.exists(_shard(tmp_path, 1, 0))
+        assert not os.path.exists(_shard(tmp_path, 1, 0) + ".corrupt")
+        m.close()
+
+    def test_legacy_single_host_still_replicates(self, tmp_path):
+        """W==1 legacy keeps today's replicated fallback at any R."""
+        m0 = _mgr(tmp_path, 0, 1)
+        m0.save(1, {"w": np.arange(4.0)})
+        m0.close()
+        _strip_array_info(_shard(tmp_path, 1, 0))
+        m = _mgr(tmp_path, 1, 2)
+        tree, _ = m.restore(step=1)
+        assert np.array_equal(np.asarray(tree["w"]), np.arange(4.0))
+        m.close()
+
+    def test_newer_legacy_not_walked_past(self, tmp_path):
+        """A healthy-but-unfit newest step must raise, not silently
+        fall back to older resharded state."""
+        _save_all_hosts(tmp_path, 1, 2)
+        _save_all_hosts(tmp_path, 2, 2)
+        for p in range(2):
+            _strip_array_info(_shard(tmp_path, 2, p))
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(CheckpointTopologyError):
+            m.restore()
+        m.close()
+
+    def test_unreplicated_replicated_leaf_refused(self, tmp_path):
+        """Review fix: a leaf annotated replicated (the axes=None
+        default) whose content actually DIFFERS across writers (e.g.
+        per-host RNG keys) must refuse the reshard — collapsing it to
+        one writer's copy would silently restore wrong state. The
+        recorded per-array CRCs prove the divergence from the
+        manifests alone."""
+
+        def per_host(p, nproc, step):
+            lo, hi = even_interval(10, nproc, p)
+            return {"w": np.arange(10.0)[lo:hi] + step,
+                    "rng": np.full(2, float(p))}   # per-host content!
+
+        _save_all_hosts(tmp_path, 1, 2, state_fn=per_host,
+                        axes={"w": 0, "rng": None})
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(CheckpointTopologyError,
+                           match="annotated replicated"):
+            m.restore(step=1)
+        # healthy files: no quarantine
+        assert os.path.exists(_shard(tmp_path, 1, 0))
+        m.close()
+
+    def test_identical_replicated_leaf_passes_crc_check(self, tmp_path):
+        _save_all_hosts(tmp_path, 1, 2)     # opt[0] replicated, equal
+        m = _mgr(tmp_path, 0, 1)
+        tree, _ = m.restore(step=1)
+        assert np.array_equal(np.asarray(tree["opt"][0]),
+                              np.full((3, 2), 1.0))
+        m.close()
+
+    def test_fsck_mirrors_replicated_divergence(self, tmp_path):
+        """Review fix: fsck --nproc must not report 'restorable: yes'
+        for a step restore() will refuse — the cross-writer checks run
+        offline from the manifests fsck already read."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fsck_checkpoint
+
+        def per_host(p, nproc, step):
+            lo, hi = even_interval(10, nproc, p)
+            return {"w": np.arange(10.0)[lo:hi] + step,
+                    "rng": np.full(2, float(p))}   # per-host content
+
+        _save_all_hosts(tmp_path, 1, 2, state_fn=per_host,
+                        axes={"w": 0, "rng": None})
+        steps, _extras = fsck_checkpoint.fsck_dir(str(tmp_path))
+        rec = steps[0]
+        assert rec["status"] == "ok" and not rec["reshardable"]
+        fits, why = fsck_checkpoint.restorable_at(rec, 4)
+        assert not fits and "replicated" in why
+        # at the WRITTEN size it restores fine (no reshard involved)
+        fits, _ = fsck_checkpoint.restorable_at(rec, 2)
+        assert fits
+
+    def test_fsck_nproc_flags_newest_unfit_step(self, tmp_path,
+                                                capsys):
+        """Review fix: per-step 'yes' lines are not the whole story —
+        restore() refuses when a healthy step NEWER than the best
+        fitting one cannot reshard, and fsck --nproc must exit 1 and
+        say so instead of promising a restore that won't happen."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import fsck_checkpoint
+        _save_all_hosts(tmp_path, 1, 2)             # fit at nproc=1
+        _save_all_hosts(tmp_path, 2, 2)             # newest: made unfit
+        for p in range(2):
+            _strip_array_info(_shard(tmp_path, 2, p))
+        rc = fsck_checkpoint.main([str(tmp_path), "--nproc", "1"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "newest healthy step 2 is NOT restorable" in out
+        # and the manager agrees: restore at nproc=1 refuses
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(CheckpointTopologyError):
+            m.restore()
+        m.close()
+
+    def test_diverging_axis_annotations_refused(self, tmp_path):
+        """Review fix: writers that annotated DIFFERENT shard axes for
+        one array (stale config on one host) must refuse — planning
+        from one writer's annotation would concat a full copy as if it
+        were a slice, restoring rank-dependent wrong state."""
+        m1 = _mgr(tmp_path, 1, 2)
+        m1.save(1, {"w": np.arange(10.0)}, axes={"w": None})  # full
+        m1.close()
+        m0 = _mgr(tmp_path, 0, 2)
+        m0.save(1, {"w": np.arange(5.0)}, axes={"w": 0})      # slice
+        m0.close()
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(CheckpointTopologyError,
+                           match="disagree on its shard axis"):
+            m.restore(step=1)
+        m.close()
+
+    def test_diverging_trees_rejected(self, tmp_path):
+        m1 = _mgr(tmp_path, 1, 2)
+        m1.save(1, {"w": np.zeros(3), "extra": np.ones(2)},
+                axes={"w": 0, "extra": None})
+        m1.close()
+        m0 = _mgr(tmp_path, 0, 2)
+        m0.save(1, {"w": np.zeros(3)}, axes={"w": 0})
+        m0.close()
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(CheckpointTopologyError,
+                           match="tree structure"):
+            m.restore(step=1)
+        m.close()
+
+    def test_explicit_step_corrupt_still_raises_corrupt(self, tmp_path):
+        _save_all_hosts(tmp_path, 1, 2)
+        faults.corrupt_checkpoint(_shard(tmp_path, 1, 1), "bitflip")
+        m = _mgr(tmp_path, 0, 1)
+        with pytest.raises(CheckpointCorruptError):
+            m.restore(step=1)
+        m.close()
+
+
+# ---------------------------------------------------------------------------
+class TestCoordinatedReshard:
+    """The multi-host collective restore across a topology change:
+    R readers coordinate over a dir written by W != R hosts."""
+
+    def _restore_all(self, mgrs, timeout=30.0):
+        res, errs = {}, {}
+        for m in mgrs:
+            m.coord_timeout = timeout
+
+        def run(i, m):
+            try:
+                res[i] = m.restore()
+            except Exception as e:      # noqa: BLE001 — re-asserted
+                errs[i] = e
+
+        threads = [threading.Thread(target=run, args=(i, m),
+                                    daemon=True)
+                   for i, m in enumerate(mgrs[1:], 1)]
+        for t in threads:
+            t.start()
+        run(0, mgrs[0])
+        for t in threads:
+            t.join(timeout)
+            assert not t.is_alive(), "a reader hung in restore"
+        return res, errs
+
+    def test_four_readers_of_two_writers(self, tmp_path):
+        _save_all_hosts(tmp_path, 2, 2)
+        res, errs = self._restore_all(
+            [_mgr(tmp_path, r, 4) for r in range(4)])
+        assert not errs, errs
+        full = np.concatenate(
+            [np.asarray(res[r][0]["w"]) for r in range(4)])
+        assert np.array_equal(full, np.arange(10.0) + 2)
+        assert all(res[r][1] == 2 for r in range(4))
+
+    def test_two_readers_of_four_writers(self, tmp_path):
+        _save_all_hosts(tmp_path, 3, 4)
+        res, errs = self._restore_all(
+            [_mgr(tmp_path, r, 2) for r in range(2)])
+        assert not errs, errs
+        full = np.concatenate(
+            [np.asarray(res[r][0]["w"]) for r in range(2)])
+        assert np.array_equal(full, np.arange(10.0) + 3)
+
+    def test_reshard_reads_each_shard_once_per_reader(self, tmp_path):
+        """Review fix: the verification pass pre-loads the reshard and
+        the agreed restore reuses it — no writer shard is fully read
+        (and CRC'd) twice by one reader on the healthy elastic path."""
+        import paddle_tpu.io_checkpoint as ioc
+        _save_all_hosts(tmp_path, 3, 4)
+        seen = {}
+        orig = ioc.verify_shard
+
+        def spy(path, *a, **kw):
+            key = (threading.get_ident(), os.path.basename(path))
+            seen[key] = seen.get(key, 0) + 1
+            return orig(path, *a, **kw)
+
+        ioc.verify_shard = spy
+        try:
+            res, errs = self._restore_all(
+                [_mgr(tmp_path, r, 2) for r in range(2)])
+        finally:
+            ioc.verify_shard = orig
+        assert not errs, errs
+        dup = {k: n for k, n in seen.items() if n > 1}
+        assert not dup, dup
+
+    def test_corrupt_writer_shard_walks_all_readers_back(self,
+                                                         tmp_path):
+        _save_all_hosts(tmp_path, 1, 2)
+        _save_all_hosts(tmp_path, 2, 2)
+        faults.corrupt_checkpoint(_shard(tmp_path, 2, 1), "bitflip")
+        res, errs = self._restore_all(
+            [_mgr(tmp_path, r, 4) for r in range(4)])
+        assert not errs, errs
+        assert all(res[r][1] == 1 for r in range(4))
+        assert os.path.exists(_shard(tmp_path, 2, 0) + ".corrupt")
+
+    def test_legacy_raises_topology_error_on_every_reader(self,
+                                                          tmp_path):
+        _save_all_hosts(tmp_path, 1, 2)
+        for p in range(2):
+            _strip_array_info(_shard(tmp_path, 1, p))
+        res, errs = self._restore_all(
+            [_mgr(tmp_path, r, 4) for r in range(4)])
+        assert not res, res
+        assert set(errs) == {0, 1, 2, 3}
+        assert all(isinstance(e, CheckpointTopologyError)
+                   for e in errs.values()), errs
+        # precise refusal, not a protocol timeout
+        assert "nproc=2" in str(errs[0])
+
+
+# ---------------------------------------------------------------------------
+class TestDataStateRescale:
+    def _dp_state(self, rank, world):
+        return {"version": 1, "epoch": 0, "file_index": 0,
+                "offset": 120, "epoch_records": 12,
+                "records_consumed": 12, "seed": 5, "shuffle_buffer": 8,
+                "nfiles": 1, "files": [["a.txt", 200]],
+                "dp": {"world_size": world, "rank": rank,
+                       "global_batch": 4}}
+
+    def test_merge_equal_cursors(self):
+        fr = merge_rank_states([self._dp_state(0, 2),
+                                self._dp_state(1, 2)])
+        assert fr["records_consumed"] == 12
+        assert fr["dp"] == {"world_size": 2, "global_batch": 4}
+
+    def test_merge_divergent_cursors_refused(self):
+        a, b = self._dp_state(0, 2), self._dp_state(1, 2)
+        b["records_consumed"] = 16
+        with pytest.raises(ValueError, match="diverge"):
+            merge_rank_states([a, b])
+
+    def test_restore_data_state_merges_frontier(self, tmp_path):
+        states = [self._dp_state(p, 2) for p in range(2)]
+        _save_all_hosts(tmp_path, 1, 2, data_states=states)
+        m = _mgr(tmp_path, 0, 1)
+        m.restore()
+        ds = m.restore_data_state(1)
+        assert ds["records_consumed"] == 12
+        assert "rank" not in ds["dp"]
+        m.close()
+
+    def test_restore_divergent_cursors_topology_error(self, tmp_path):
+        states = [self._dp_state(p, 2) for p in range(2)]
+        states[1]["records_consumed"] = 99
+        _save_all_hosts(tmp_path, 1, 2, data_states=states)
+        m = _mgr(tmp_path, 0, 1)
+        m.restore()     # model state reshards fine...
+        with pytest.raises(CheckpointTopologyError, match="cursor"):
+            m.restore_data_state(1)     # ...the cursor refuses
+        m.close()
+
+    def test_partial_data_state_topology_error(self, tmp_path):
+        states = [self._dp_state(0, 2), None]
+        _save_all_hosts(tmp_path, 1, 2, data_states=states)
+        m = _mgr(tmp_path, 0, 1)
+        m.restore()
+        with pytest.raises(CheckpointTopologyError, match="partial"):
+            m.restore_data_state(1)
+        m.close()
+
+    def test_same_topology_keeps_own_cursor(self, tmp_path):
+        states = [self._dp_state(p, 2) for p in range(2)]
+        _save_all_hosts(tmp_path, 1, 2, data_states=states)
+        m = _mgr(tmp_path, 1, 2)
+        ds = m.restore_data_state(1)
+        assert ds["dp"]["rank"] == 1    # own shard's cursor, unmerged
+
+
+# ---------------------------------------------------------------------------
+class TestDpLoader:
+    @pytest.fixture()
+    def data(self, tmp_path):
+        d = tmp_path / "data"
+        d.mkdir()
+        for i, n in enumerate((40, 24)):
+            with open(d / f"f{i}.txt", "w") as f:
+                f.write("\n".join(str(100 * i + j)
+                                  for j in range(n)) + "\n")
+        return sorted(str(p) for p in d.glob("*.txt"))
+
+    def _loader(self, files, w=None, r=None, shuffle=8, bs=4):
+        return FileDataLoader(files, lambda rec: np.float32(rec),
+                              batch_size=bs, shuffle_buffer=shuffle,
+                              seed=5, epochs=-1, device_put=False,
+                              stateful=True, world_size=w, rank=r)
+
+    def test_rank_slices_concat_to_global_batches(self, data):
+        g = iter(self._loader(data))
+        l0, l1 = self._loader(data, 2, 0), self._loader(data, 2, 1)
+        i0, i1 = iter(l0), iter(l1)
+        for _ in range(5):
+            want = next(g)
+            got = np.concatenate([next(i0), next(i1)])
+            assert np.array_equal(got, want)
+
+    def test_state_carries_dp_block(self, data):
+        l0 = self._loader(data, 2, 0)
+        it = iter(l0)
+        next(it)
+        s = l0.state()
+        assert s["dp"] == {"world_size": 2, "rank": 0,
+                           "global_batch": 4}
+        # cursor tracks the GLOBAL stream
+        assert s["records_consumed"] == 4
+
+    def test_rescale_two_to_one_and_four(self, data, caplog):
+        gref = [next(it) for it in [iter(self._loader(data))]
+                for _ in range(8)]
+        l0, l1 = self._loader(data, 2, 0), self._loader(data, 2, 1)
+        i0, i1 = iter(l0), iter(l1)
+        for _ in range(3):
+            next(i0), next(i1)
+        fr = merge_rank_states([l0.state(), l1.state()])
+        # down to 1 rank
+        w1 = self._loader(data)
+        with caplog.at_level(logging.WARNING, "paddle_tpu.dataio"):
+            w1.set_state(fr)
+        assert "rescaling data cursor from world_size=2 to " \
+               "world_size=1" in caplog.text
+        assert "replays-and-skips" in caplog.text
+        it = iter(w1)
+        for s in range(3, 6):
+            assert np.array_equal(next(it), gref[s])
+        # up to 4 ranks
+        l4 = [self._loader(data, 4, r) for r in range(4)]
+        for l in l4:
+            l.set_state(fr)
+        its = [iter(l) for l in l4]
+        got = np.concatenate([next(i) for i in its])
+        assert np.array_equal(got, gref[3])
+
+    def test_rescale_without_shuffle_seeks(self, data):
+        gref = [next(it) for it in [iter(self._loader(data,
+                                                      shuffle=0))]
+                for _ in range(6)]
+        l0 = self._loader(data, 2, 0, shuffle=0)
+        l1 = self._loader(data, 2, 1, shuffle=0)
+        i0, i1 = iter(l0), iter(l1)
+        for _ in range(2):
+            next(i0), next(i1)
+        fr = merge_rank_states([l0.state(), l1.state()])
+        w1 = self._loader(data, shuffle=0)
+        w1.set_state(fr)
+        it = iter(w1)
+        assert np.array_equal(next(it), gref[2])
+
+    def test_foreign_cursor_misalignment_refused(self, data):
+        """Review fix: a cursor WITHOUT a dp block (plain stateful
+        loader) carries no global-batch record — a dp loader must
+        still refuse it when the position doesn't land on its own
+        global-batch boundary (saved batch 8, consumed 8; new global
+        batch 32 would shift every step boundary)."""
+        old = FileDataLoader(data, lambda rec: np.float32(rec),
+                             batch_size=8, shuffle_buffer=0, seed=5,
+                             epochs=-1, device_put=False,
+                             stateful=True)
+        it = iter(old)
+        next(it)
+        it.close()
+        s = old.state()
+        assert "dp" not in s and s["records_consumed"] == 8
+        dp = FileDataLoader(data, lambda rec: np.float32(rec),
+                            batch_size=32, shuffle_buffer=0, seed=5,
+                            epochs=-1, device_put=False, stateful=True,
+                            world_size=4, rank=0)
+        with pytest.raises(ValueError, match="boundary"):
+            dp.set_state(s)
+        # an ALIGNED foreign cursor is fine: 8 % 4 == 0
+        dp4 = FileDataLoader(data, lambda rec: np.float32(rec),
+                             batch_size=4, shuffle_buffer=0, seed=5,
+                             epochs=-1, device_put=False,
+                             stateful=True, world_size=2, rank=0)
+        dp4.set_state(s)
+
+    def test_global_batch_mismatch_refused(self, data):
+        l0 = self._loader(data, 2, 0)
+        it = iter(l0)
+        next(it)
+        s = l0.state()
+        w1 = self._loader(data, bs=8)
+        with pytest.raises(ValueError, match="global batch"):
+            w1.set_state(s)
+
+    def test_constructor_validation(self, data):
+        with pytest.raises(ValueError, match="divide evenly"):
+            self._loader(data, 3, 0)
+        with pytest.raises(ValueError, match="rank must be"):
+            self._loader(data, 2, 2)
+        with pytest.raises(ValueError, match="rank must be"):
+            FileDataLoader(data, lambda r: r, batch_size=4,
+                           world_size=2)
+        with pytest.raises(ValueError, match="without world_size"):
+            FileDataLoader(data, lambda r: r, batch_size=4, rank=0)
+        with pytest.raises(ValueError, match="drop_last"):
+            FileDataLoader(data, lambda r: r, batch_size=4,
+                           world_size=2, rank=0, drop_last=False)
+
+    def test_dp_without_stateful_still_deterministic(self, data):
+        """Review fix: dp slicing must force the deterministic Python
+        reader even when stateful=False — the native loader's
+        multi-threaded order would make ranks slice differently-ordered
+        'global' batches (silent cross-rank duplication and loss)."""
+        def mk(w=None, r=None):
+            return FileDataLoader(data, lambda rec: np.float32(rec),
+                                  batch_size=4, shuffle_buffer=8,
+                                  seed=5, epochs=1, device_put=False,
+                                  stateful=False, world_size=w, rank=r)
+
+        gref = list(iter(mk()))         # may be native-ordered
+        det = list(iter(FileDataLoader(                 # deterministic
+            data, lambda rec: np.float32(rec), batch_size=4,
+            shuffle_buffer=8, seed=5, epochs=1, device_put=False,
+            stateful=True)))
+        i0, i1 = iter(mk(2, 0)), iter(mk(2, 1))
+        for want in det[:5]:
+            got = np.concatenate([next(i0), next(i1)])
+            assert np.array_equal(got, want)
+        assert len(gref) == len(det)    # same record totals either way
+
+    def test_dp_recordio_rejected(self, data):
+        with pytest.raises(RuntimeError, match="RecordIO"):
+            FileDataLoader(data, lambda r: r, batch_size=4,
+                           mode="recordio", world_size=2, rank=0)
+
+    def test_consumed_metric_counts_rank_rows(self, data):
+        before = REGISTRY.get("data_records_consumed_total").value()
+        l0 = self._loader(data, 2, 0)
+        it = iter(l0)
+        for _ in range(3):
+            next(it)
+        it.close()
+        assert REGISTRY.get("data_records_consumed_total").value() \
+            == before + 6               # 3 batches x 2 rows per rank
+
+
+# ---------------------------------------------------------------------------
+class TestElasticLaunchUnits:
+    def test_shrink_rc_constants_agree(self):
+        assert faults.SHRINK_EXIT_CODE == SHRINK_RC == 31
+        assert 31 in EXIT_CODE_LABELS
+        assert "departed" in EXIT_CODE_LABELS[31]
+
+    def test_shrink_fault_exits_31(self, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_SHRINK_AT_STEP", "3")
+        monkeypatch.delenv("PT_FAULT_ONCE_DIR", raising=False)
+        monkeypatch.delenv("PT_FAULT_RANK", raising=False)
+        codes = []
+        monkeypatch.setattr(os, "_exit", lambda rc: codes.append(rc))
+        faults.maybe_fault(2)
+        assert codes == []
+        faults.maybe_fault(3)
+        assert codes == [faults.SHRINK_EXIT_CODE]
+
+    def test_shrink_fault_once_per_job(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PT_FAULT_SHRINK_AT_STEP", "1")
+        monkeypatch.setenv("PT_FAULT_ONCE_DIR", str(tmp_path))
+        codes = []
+        monkeypatch.setattr(os, "_exit", lambda rc: codes.append(rc))
+        faults.maybe_fault(1)
+        faults.maybe_fault(1)           # restarted incarnation: clean
+        assert codes == [faults.SHRINK_EXIT_CODE]
+
+    def test_take_join_requests(self, tmp_path):
+        jd = str(tmp_path / "elastic")
+        os.makedirs(jd)
+        for i in range(3):
+            open(os.path.join(jd, f"join.host{i}"), "w").close()
+        assert _take_join_requests(jd, 2) == 2
+        assert len(os.listdir(jd)) == 1     # third stays queued
+        assert _take_join_requests(jd, 5) == 1
+        assert _take_join_requests(jd, 5) == 0
+        assert _take_join_requests(None, 5) == 0
+        assert _take_join_requests(jd, 0) == 0
+
+    def test_elastic_join_dir(self, tmp_path):
+        assert elastic_join_dir(None) is None
+        assert elastic_join_dir(str(tmp_path)) == \
+            os.path.join(str(tmp_path), "elastic")
+
+    def test_sweep_stale_ranks(self, tmp_path):
+        d = str(tmp_path)
+        for r in range(4):
+            open(os.path.join(d, f"rank{r}.hb"), "w").close()
+            open(os.path.join(d, f"rank{r}.prom"), "w").close()
+        open(os.path.join(d, "metrics.prom"), "w").close()
+        removed = health.sweep_stale_ranks(d, 2)
+        assert removed == ["rank2.hb", "rank2.prom", "rank3.hb",
+                           "rank3.prom"]
+        left = sorted(os.listdir(d))
+        assert left == ["metrics.prom", "rank0.hb", "rank0.prom",
+                        "rank1.hb", "rank1.prom"]
+        assert health.sweep_stale_ranks(d, 2) == []
+
+    def test_sweep_missing_dir_is_noop(self, tmp_path):
+        assert health.sweep_stale_ranks(
+            str(tmp_path / "nope"), 1) == []
+
+    def test_wait_gang_counts_every_departed_rank(self, tmp_path):
+        """Review fix: two ranks reclaimed at the same step must BOTH
+        register, whatever exit the poll loop saw first — shrinking by
+        1 would respawn a rank with nowhere to run and burn an extra
+        restart per extra departure."""
+        from paddle_tpu.distributed.launch import _wait_gang
+
+        class _FakeProc:
+            def __init__(self, rc):
+                self.returncode = None
+                self._rc = rc
+
+            def poll(self):
+                self.returncode = self._rc
+                return self._rc
+
+            def wait(self, timeout=None):
+                return self.poll()
+
+            def send_signal(self, sig):
+                pass
+
+            def kill(self):
+                pass
+
+        def run(rcs):
+            procs = {f"trainer {i}": _FakeProc(rc)
+                     for i, rc in enumerate(rcs)}
+            ranks = {f"trainer {i}": i for i in range(len(rcs))}
+            return _wait_gang(procs, ranks, [], None, None,
+                              str(tmp_path), threading.Event(), 0.0)
+
+        status, rc, departed = run([31, 31])
+        assert status == "fail" and rc == 31 and departed == [0, 1]
+        # a crash alongside a departure: the departure still counts
+        status, rc, departed = run([23, 31])
+        assert status == "fail" and rc == 23 and departed == [1]
+        status, rc, departed = run([0, 0])
+        assert status == "ok" and departed == []
+
+    def test_max_ranks_without_log_dir_warns(self, capfd):
+        rc = launch_collective(["definitely_nonexistent_script.py"],
+                               nproc=1, max_ranks=2, max_restarts=0)
+        assert rc != 0
+        err = capfd.readouterr().err
+        assert "no effect without" in err and "--log_dir" in err
+
+    def test_bounds_are_contracts_not_hints(self):
+        """Review fix: silently clamping --max_ranks up to nproc would
+        let a shrunk gang grow back past the operator's ceiling."""
+        with pytest.raises(ValueError, match="--max_ranks 4 is below"):
+            launch_collective(["x.py"], nproc=8, max_ranks=4)
+        with pytest.raises(ValueError, match="--min_ranks"):
+            launch_collective(["x.py"], nproc=2, min_ranks=0)
+        with pytest.raises(ValueError, match="--min_ranks"):
+            launch_collective(["x.py"], nproc=2, min_ranks=3)
+
+    def test_grow_only_elastic_departure_restarts_full_size(
+            self, tmp_path, capfd):
+        """Review fix: with only --max_ranks (grow-only), a rank
+        exiting SHRINK_RC is an ordinary failure — the gang restarts
+        at FULL size instead of shrinking below the implicit floor and
+        killing the job with budget unspent."""
+        script = tmp_path / "departer.py"
+        script.write_text(
+            "from paddle_tpu.testing import faults\n"
+            "faults.maybe_fault(0)\n")
+        env = dict(SUBPROC_ENV,
+                   PT_FAULT_SHRINK_AT_STEP="0",
+                   PT_FAULT_ONCE_DIR=str(tmp_path / "once"))
+        rc = launch_collective([str(script)], nproc=1, max_ranks=2,
+                               max_restarts=1, env_extra=env,
+                               timeout=120, grace_period=2.0)
+        assert rc == 0          # second incarnation ran clean at n=1
+        err = capfd.readouterr().err
+        assert "--min_ranks is not set" in err
+        assert "world size 1" in err        # restarted at full size
+
+
+# ---------------------------------------------------------------------------
+def _gang_logs(tmp_path):
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.exists():
+        for p in sorted(logdir.glob("*.log")):
+            logs += f"\n--- {p.name} ---\n" + p.read_text()[-2500:]
+    return logs
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+class TestTopologyElasticEndToEnd:
+    """The acceptance arc: a 2-rank shared-checkpoint run killed
+    mid-training resumes at 1 and at 4 ranks from the verified
+    last-good step with bit-identical per-step GLOBAL batch sums and
+    `w` trajectory; a corrupt newest step still walks back under the
+    new topology; the elastic supervisor shrinks on rank departure and
+    grows on join requests."""
+
+    TOTAL = 8
+
+    def _data_dir(self, tmp_path):
+        d = tmp_path / "data"
+        if not d.exists():
+            d.mkdir()
+            # small integers: float32-exact, so partial sums compare
+            # bit-identically across topologies
+            for i in range(2):
+                with open(d / f"f{i}.txt", "w") as f:
+                    f.write("\n".join(str(100 * i + j)
+                                      for j in range(40)) + "\n")
+        return str(d)
+
+    def _launch(self, tmp_path, tag, fault_env, nproc, **kw):
+        prefix = tmp_path / f"{tag}.out"
+        ckpt = kw.pop("ckpt", None) or tmp_path / f"{tag}.ckpt"
+        env = dict(SUBPROC_ENV, **fault_env)
+        if fault_env:
+            env.setdefault("PT_FAULT_ONCE_DIR",
+                           str(tmp_path / f"{tag}.once"))
+            env.setdefault("PT_FAULT_AWAIT_CKPTS", "1")
+        rc = launch_collective(
+            [WORKER, str(prefix), str(ckpt), str(self.TOTAL),
+             self._data_dir(tmp_path), "0.05"],
+            nproc=nproc, log_dir=str(tmp_path / "logs"),
+            env_extra=env, timeout=240, grace_period=3.0, **kw)
+        return rc, prefix, ckpt
+
+    def _steps(self, prefix, final_world, total_ranks):
+        """{step: {"gsum": global batch sum, "w": w}} merged across
+        the per-rank logs. A rank that is NOT part of the final
+        incarnation (``r >= final_world``) contributes only steps
+        BEFORE the final incarnation's resume point: its later entries
+        are work the walk-back rolled back (the surviving ranks
+        re-executed those steps and overwrote their own entries, but
+        nobody rewrites a retired rank's file)."""
+        cut = min(self._report(prefix, r)["first_step"]
+                  for r in range(final_world))
+        out = {}
+        for r in range(total_ranks):
+            path = f"{prefix}.rank{r}.batches.json"
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for step, rec in json.load(f).items():
+                    s = int(step)
+                    if r >= final_world and s >= cut:
+                        continue        # rolled-back, re-executed work
+                    cur = out.setdefault(s, {"gsum": 0.0, "w": set()})
+                    cur["gsum"] += rec["bsum"]
+                    cur["w"].add(rec["w"])
+        for step, cur in out.items():
+            assert len(cur["w"]) == 1, \
+                f"ranks disagree on w at step {step}: {cur['w']}"
+            cur["w"] = cur["w"].pop()
+        return out
+
+    def _report(self, prefix, rank):
+        with open(f"{prefix}.rank{rank}.json") as f:
+            return json.load(f)
+
+    def _final_emb(self, prefix, world):
+        rows = {}
+        for r in range(world):
+            rep = self._report(prefix, r)
+            lo, _hi = rep["emb_rows"]
+            for i, v in enumerate(rep["emb"]):
+                rows[lo + i] = v
+        return [rows[i] for i in sorted(rows)]
+
+    def _clean(self, tmp_path):
+        if not hasattr(self, "_clean_cache"):
+            rc, prefix, _ = self._launch(tmp_path, "clean", {},
+                                         nproc=2)
+            assert rc == 0, _gang_logs(tmp_path)
+            self._clean_cache = (self._steps(prefix, 2, 2),
+                                 self._final_emb(prefix, 2))
+        return self._clean_cache
+
+    def test_shrink_then_resume_at_one_rank(self, tmp_path):
+        """Single elastic launch: rank 1 departs (exit 31) at step 4;
+        the supervisor resumes the job at world size 1, which reshards
+        the 2-host checkpoint and rescales the cursor."""
+        clean_steps, clean_emb = self._clean(tmp_path)
+        rc, prefix, ckpt = self._launch(
+            tmp_path, "shrink",
+            {"PT_FAULT_SHRINK_AT_STEP": "4", "PT_FAULT_RANK": "1"},
+            nproc=2, max_restarts=2, min_ranks=1)
+        assert rc == 0, _gang_logs(tmp_path)
+        rep0 = self._report(prefix, 0)
+        assert rep0["world"] == 1       # final incarnation ran shrunk
+        assert rep0["restart_count"] == 1
+        assert 0 < rep0["first_step"] <= 4
+        steps = self._steps(prefix, 1, 2)
+        assert set(steps) == set(clean_steps)
+        for s in sorted(clean_steps):
+            assert steps[s]["gsum"] == clean_steps[s]["gsum"], \
+                (s, steps[s], clean_steps[s])
+            assert steps[s]["w"] == clean_steps[s]["w"], s
+        # the resharded-and-continued global emb matches the clean run
+        assert self._final_emb(prefix, 1) == clean_emb
+
+    def test_resume_at_four_ranks(self, tmp_path):
+        """Kill a 2-rank run (crash, budget 0), then relaunch the SAME
+        checkpoint dir at nproc=4: coordinated reshard 2→4."""
+        clean_steps, clean_emb = self._clean(tmp_path)
+        rc, prefix, ckpt = self._launch(
+            tmp_path, "grow4",
+            {"PT_FAULT_CRASH_AT_STEP": "4", "PT_FAULT_RANK": "0"},
+            nproc=2, max_restarts=0)
+        assert rc == faults.CRASH_EXIT_CODE, _gang_logs(tmp_path)
+        rc, prefix4, _ = self._launch(tmp_path, "grow4", {}, nproc=4,
+                                      ckpt=ckpt)
+        assert rc == 0, _gang_logs(tmp_path)
+        rep = self._report(prefix4, 3)
+        assert rep["world"] == 4 and rep["first_step"] > 0
+        steps = self._steps(prefix4, 4, 4)
+        assert set(steps) == set(clean_steps)
+        for s in sorted(clean_steps):
+            assert steps[s]["gsum"] == clean_steps[s]["gsum"], s
+            assert steps[s]["w"] == clean_steps[s]["w"], s
+        assert self._final_emb(prefix4, 4) == clean_emb
+
+    def test_corrupt_newest_walks_back_under_new_topology(self,
+                                                          tmp_path):
+        """Bitflip the newest 2-host step (exit 29, budget 0), resume
+        at 1 rank: the 1-rank restore must quarantine the corrupt step
+        and reshard the verified predecessor — and the job still ends
+        bit-identical to the clean run."""
+        clean_steps, clean_emb = self._clean(tmp_path)
+        rc, prefix, ckpt = self._launch(
+            tmp_path, "rot",
+            {"PT_FAULT_BITFLIP_CKPT": "4", "PT_FAULT_RANK": "0",
+             "PT_FAULT_CKPT_WAIT": "60"},
+            nproc=2, max_restarts=0)
+        assert rc == faults.CKPT_FAULT_EXIT_CODE, _gang_logs(tmp_path)
+        rc, prefix1, _ = self._launch(tmp_path, "rot", {}, nproc=1,
+                                      ckpt=ckpt)
+        assert rc == 0, _gang_logs(tmp_path)
+        assert any(f.endswith(".corrupt")
+                   for f in os.listdir(str(ckpt))), \
+            sorted(os.listdir(str(ckpt)))
+        steps = self._steps(prefix1, 1, 2)
+        assert set(steps) == set(clean_steps)
+        for s in sorted(clean_steps):
+            assert steps[s]["gsum"] == clean_steps[s]["gsum"], s
+            assert steps[s]["w"] == clean_steps[s]["w"], s
+        assert self._final_emb(prefix1, 1) == clean_emb
+
+    def test_join_request_grows_gang(self, tmp_path):
+        """A pre-seeded join request is admitted at the first restart
+        boundary: a 1-rank job crashes once and comes back at 2."""
+        join_dir = elastic_join_dir(str(tmp_path / "logs"))
+        os.makedirs(join_dir, exist_ok=True)
+        open(os.path.join(join_dir, "join.newhost"), "w").close()
+        rc, prefix, _ = self._launch(
+            tmp_path, "join",
+            {"PT_FAULT_CRASH_AT_STEP": "3", "PT_FAULT_RANK": "0"},
+            nproc=1, max_restarts=2, max_ranks=2)
+        assert rc == 0, _gang_logs(tmp_path)
+        rep1 = self._report(prefix, 1)      # the admitted rank ran
+        assert rep1["world"] == 2
+        assert os.listdir(join_dir) == []   # request consumed

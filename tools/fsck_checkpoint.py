@@ -10,6 +10,19 @@ and standalone:
     python tools/fsck_checkpoint.py <ckpt_dir>                # report
     python tools/fsck_checkpoint.py <ckpt_dir> --quarantine   # + rename
                                                 # corrupt steps *.corrupt
+    python tools/fsck_checkpoint.py <ckpt_dir> --nproc N      # also check
+                                  # restorability at a target world size
+
+Each step's line reports the WRITER TOPOLOGY — ``nproc`` and the
+per-host shard list — so an operator can see what a directory can
+restore onto *before* launching. ``--nproc N`` additionally judges
+every step against a target world size (N == written nproc always
+fits; any other N needs the reshard metadata ``array_info`` in every
+shard passing the cross-writer fitness checks, or a single-host
+replicated step) and the run exits 1 if no step is restorable at N —
+or if the NEWEST healthy step is not (``restore()`` refuses with
+``CheckpointTopologyError`` rather than silently falling back past
+healthy state, and fsck's verdict must match).
 
 Per-step statuses:
 
@@ -53,10 +66,14 @@ def fsck_dir(dirname):
     """Verify every checkpoint step under ``dirname``.
 
     Returns ``(steps, extras)``: ``steps`` is a list of
-    ``{"step", "status", "detail", "shards"}`` sorted by step;
-    ``extras`` is ``{"quarantined": [...], "tmp": [...],
-    "orphan_shards": [...]}`` (shards with no meta — an interrupted
-    save whose meta never published, or a hand-deleted meta)."""
+    ``{"step", "status", "detail", "shards", "nproc", "reshardable"}``
+    sorted by step (``nproc`` = the writer topology from the meta,
+    None when the meta is unreadable; ``reshardable`` = every shard
+    carries the ``array_info`` reshard metadata, i.e. the step can
+    restore onto a *different* world size); ``extras`` is
+    ``{"quarantined": [...], "tmp": [...], "orphan_shards": [...]}``
+    (shards with no meta — an interrupted save whose meta never
+    published, or a hand-deleted meta)."""
     from paddle_tpu.io_checkpoint import (
         CheckpointCorruptError, _retry_transient, _stat_exists,
         verify_shard,
@@ -83,7 +100,8 @@ def fsck_dir(dirname):
 
     steps = []
     for s in sorted(metas):
-        rec = {"step": s, "status": "ok", "detail": "", "shards": {}}
+        rec = {"step": s, "status": "ok", "detail": "", "shards": {},
+               "nproc": None, "reshardable": False}
         steps.append(rec)
         def read_nproc(fname=metas[s]):
             with open(os.path.join(dirname, fname)) as f:
@@ -108,7 +126,10 @@ def fsck_dir(dirname):
                              f"({type(e).__name__}: {e}) — retry "
                              f"before trusting this verdict")
             continue
+        rec["nproc"] = nproc
         legacy = False
+        reshardable = True
+        step_manifests = {}
         for p in range(nproc):
             fname = f"ckpt_{s}.shard{p}.npz"
             path = os.path.join(dirname, fname)
@@ -153,6 +174,9 @@ def fsck_dir(dirname):
                                      f"retry before trusting this "
                                      f"verdict")
                 continue
+            step_manifests[p] = manifest
+            if manifest.get("array_info") is None:
+                reshardable = False
             if manifest.get("integrity") is None:
                 rec["shards"][fname] = "legacy"
                 legacy = True
@@ -160,11 +184,50 @@ def fsck_dir(dirname):
                 rec["shards"][fname] = (
                     f"ok ({len(arrays)} arrays, "
                     f"{sum(a.nbytes for a in arrays.values())} bytes)")
+        rec["reshardable"] = (rec["status"] in ("ok", "legacy")
+                              and reshardable)
+        if rec["reshardable"] and nproc > 1 \
+                and len(step_manifests) == nproc:
+            why = _reshard_blocker(step_manifests)
+            if why:
+                rec["reshardable"] = False
+                rec["reshard_blocker"] = why
         if rec["status"] == "ok" and legacy:
             rec["status"] = "legacy"
             rec["detail"] = ("predates the integrity format — "
                             "restorable, digests not provable")
     return steps, extras
+
+
+def _reshard_blocker(manifests):
+    """The cross-writer fitness checks ``CheckpointManager``'s reshard
+    planner runs, computed offline from the manifests fsck already
+    read — the SAME ``io_checkpoint._cross_writer_blocker`` the
+    manager raises ``CheckpointTopologyError`` on, imported rather
+    than re-implemented so a new fitness rule can never make
+    ``--nproc``'s verdict drift from ``restore()``'s behavior."""
+    from paddle_tpu.io_checkpoint import _cross_writer_blocker
+    return _cross_writer_blocker(manifests)
+
+
+def restorable_at(rec, target_nproc):
+    """(fits, reason) — can this fsck step record restore onto
+    ``target_nproc`` hosts? Mirrors CheckpointManager's rules: the
+    written world size always fits; a single-host step fits anywhere
+    (replicated fallback / reshard both read the one shard); any other
+    size needs the reshard metadata in every shard AND the cross-writer
+    fitness checks (``_reshard_blocker``) to pass."""
+    if rec["status"] not in ("ok", "legacy"):
+        return False, rec["status"]
+    if rec["nproc"] == target_nproc:
+        return True, "written at this world size"
+    if rec["reshardable"]:
+        return True, f"reshard from nproc={rec['nproc']}"
+    if rec["nproc"] == 1:
+        return True, "single-host step (replicated fallback)"
+    return False, rec.get("reshard_blocker") or (
+        f"shards predate the reshard metadata "
+        f"(written nproc={rec['nproc']}, no array_info)")
 
 
 def quarantine_step(dirname, step):
@@ -192,6 +255,10 @@ def main(argv=None):
                          "verify-and-walk-back at job start (unreadable "
                          "steps are NEVER renamed: an I/O error is not "
                          "proof of corruption)")
+    ap.add_argument("--nproc", type=int, default=None,
+                    help="also judge each step's restorability at this "
+                         "target world size (reshard rules); exit 1 if "
+                         "no step is restorable at it")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.ckpt_dir):
         print(f"fsck_checkpoint: {args.ckpt_dir}: not a directory",
@@ -199,13 +266,25 @@ def main(argv=None):
         return 2
     steps, extras = fsck_dir(args.ckpt_dir)
     bad = 0
+    fit_steps = []
     for rec in steps:
         line = f"step {rec['step']}: {rec['status']}"
+        if rec["nproc"] is not None:
+            # the writer topology, always in normal output: what this
+            # directory can restore onto is decided before any launch
+            line += (f" [written by nproc={rec['nproc']}"
+                     f"{', reshardable' if rec['reshardable'] else ''}]")
         if rec["detail"]:
             line += f" — {rec['detail']}"
         print(line)
         for fname, st in sorted(rec["shards"].items()):
             print(f"  {fname}: {st}")
+        if args.nproc is not None:
+            fits, why = restorable_at(rec, args.nproc)
+            print(f"  restorable at nproc={args.nproc}: "
+                  f"{'yes' if fits else 'NO'} ({why})")
+            if fits:
+                fit_steps.append(rec["step"])
         if rec["status"] not in ("ok", "legacy"):
             bad += 1
             # quarantine needs POSITIVE corruption evidence; an
@@ -223,6 +302,27 @@ def main(argv=None):
     print(f"# {len(steps)} step(s): {len(good)} restorable, {bad} bad; "
           f"newest restorable: "
           f"{good[-1]['step'] if good else 'NONE'}")
+    if args.nproc is not None:
+        print(f"# restorable at nproc={args.nproc}: "
+              f"{len(fit_steps)} step(s); newest: "
+              f"{fit_steps[-1] if fit_steps else 'NONE'}")
+        # the job-level rule restore() actually applies: a HEALTHY
+        # step that doesn't fit and is NEWER than the best fitting one
+        # makes restore refuse (CheckpointTopologyError) rather than
+        # silently fall back past it — per-step "yes" lines alone
+        # would promise a restore that will not happen
+        blocked = [r["step"] for r in steps
+                   if r["status"] in ("ok", "legacy")
+                   and r["step"] not in fit_steps]
+        if blocked and (not fit_steps or max(blocked) > fit_steps[-1]):
+            print(f"# WARNING: newest healthy step {max(blocked)} is "
+                  f"NOT restorable at nproc={args.nproc}; restore() "
+                  f"will refuse (CheckpointTopologyError) instead of "
+                  f"falling back to "
+                  f"{fit_steps[-1] if fit_steps else 'nothing'}")
+            return 1
+        if not fit_steps:
+            return 1
     return 1 if bad else 0
 
 
