@@ -813,6 +813,357 @@ def bench_ckpt():
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_shard():
+    """`python bench.py shard` — unified-mesh topology sweep (ROADMAP
+    item 2): one transformer trunk trained under the ShardingSpec
+    partitioner across mesh topologies — pure-DP (`data=N`),
+    model x data (megatron block sharding over "model"), and
+    pipe x data (the fused 1F1B scan of parallel/pipeline.py) — on
+    whatever devices are visible (the MULTICHIP harness provisions 8).
+
+    Protocol: every topology compiles first, then timed windows
+    INTERLEAVE round-robin across topologies (adjacent windows see the
+    same ambient host load — the bench_dispatch discipline), and each
+    topology reports its BEST window. One JSON line per topology:
+    ms/step, MFU (analytic trunk FLOPs / step time / N x chip peak),
+    and estimated collective bytes per step from the compiled HLO
+    (monitor/cost.estimate_comm — SPMD inserts collectives at compile
+    time, so the estimate reads the optimized executable text).
+
+    The pipe topology also A/Bs FLAGS_overlap_grad_reduce (gradient
+    all-reduce issued per-bucket inside the backward scan vs one
+    epilogue reduction): overlap-on and overlap-off windows interleave
+    in pairs and the headline is the median per-pair on/off ratio —
+    < 1.0 means the in-scan reduction overlapped with compute.
+
+    Env knobs: BENCH_SHARD_TOPOS (csv of dp,modelxdata,pipexdata),
+    BENCH_SHARD_STEPS, BENCH_WINDOWS, BENCH_SHARD_PAIRS,
+    BENCH_SHARD_HIDDEN/FFN/SEQ/BATCH/LAYERS/VOCAB/HEADS/MICRO."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.monitor import cost as _cost
+    from paddle_tpu.monitor.registry import gauge
+    from paddle_tpu.parallel import pipeline as pl
+    from paddle_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, PIPE_AXIS, MeshConfig, make_mesh,
+    )
+    from paddle_tpu.parallel.spec import ShardingSpec
+
+    g_mfu = gauge("shard_topology_mfu",
+                  "Model FLOPs utilization measured by bench.py shard "
+                  "for each mesh topology (analytic trunk FLOPs / best "
+                  "window step time / device count x chip peak)",
+                  labels=("topology",))
+
+    devs = jax.devices()
+    N = int(os.environ.get("BENCH_SHARD_DEVICES", str(len(devs))))
+    devs = devs[:N]
+    on_tpu = devs[0].platform != "cpu"
+
+    def knob(name, tpu_default, cpu_default):
+        return int(os.environ.get(name, str(tpu_default if on_tpu
+                                            else cpu_default)))
+
+    H = knob("BENCH_SHARD_HIDDEN", 1024, 64)
+    F = knob("BENCH_SHARD_FFN", 4 * H, 4 * H)
+    S = knob("BENCH_SHARD_SEQ", 512, 32)
+    B = knob("BENCH_SHARD_BATCH", 4 * N, 2 * N if N > 1 else 8)
+    L = knob("BENCH_SHARD_LAYERS", 8, 4)
+    V = knob("BENCH_SHARD_VOCAB", 8192, 128)
+    NH = knob("BENCH_SHARD_HEADS", 16, 4)
+    n_micro = knob("BENCH_SHARD_MICRO", 4, 4)
+    steps = knob("BENCH_SHARD_STEPS", 10, 4)
+    windows = max(2, int(os.environ.get("BENCH_WINDOWS", "3")))
+    pairs = max(2, int(os.environ.get("BENCH_SHARD_PAIRS", "3")))
+    lr = 0.05
+    assert H % NH == 0, (H, NH)
+
+    # ---- the trunk: pre-LN encoder blocks, shared by every topology --
+    def _ln(x, g):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + 1e-6) * g).astype(x.dtype)
+
+    def _block_apply(p, x):
+        b, s, _ = x.shape
+        h = _ln(x, p["ln1"])
+        qkv = h @ p["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = H // NH
+
+        def heads(t):
+            return t.reshape(b, s, NH, hd).transpose(0, 2, 1, 3)
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(hd)
+        a = jax.nn.softmax(scores, axis=-1) @ v
+        a = a.transpose(0, 2, 1, 3).reshape(b, s, H)
+        x = x + a @ p["wo"]
+        h2 = _ln(x, p["ln2"])
+        return x + jax.nn.relu(h2 @ p["w1"]) @ p["w2"]
+
+    def _block_params(key):
+        ks = jax.random.split(key, 4)
+
+        def init(k, a, b):
+            return jax.random.normal(k, (a, b), jnp.float32) * (a ** -0.5)
+        return {"wqkv": init(ks[0], H, 3 * H), "wo": init(ks[1], H, H),
+                "w1": init(ks[2], H, F), "w2": init(ks[3], F, H),
+                "ln1": jnp.ones((H,)), "ln2": jnp.ones((H,))}
+
+    def _xent(logits, labels):
+        ls = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(ls, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    def _trunk_flops(layers):
+        """Analytic matmul FLOPs per train step (fwd + 2x bwd), the
+        fixed-convention MFU numerator comparable across topologies."""
+        per_tok = layers * (2 * H * 3 * H + 2 * H * H + 2 * 2 * H * F
+                            + 2 * 2 * S * H) + 2 * H * V
+        return 3.0 * B * S * per_tok
+
+    rng = np.random.RandomState(0)
+    xb_np = rng.randint(0, V, size=(B, S)).astype(np.int32)
+    yb_np = rng.randint(0, V, size=(B, S)).astype(np.int32)
+
+    # ---- topology builders: each returns (step_once, carry, meta) ----
+    def build_dense(name, cfg):
+        """Pure-DP and model x data: stacked blocks scanned in one jit,
+        placement from ONE ShardingSpec (megatron rules inert when the
+        model axis has extent 1)."""
+        mesh = make_mesh(cfg, devices=devs)
+        spec = ShardingSpec(mesh, params={
+            "emb": P(), "pos": P(), "head": P(),
+            "blocks/wqkv": P(None, None, MODEL_AXIS),
+            "blocks/w1": P(None, None, MODEL_AXIS),
+            "blocks/wo": P(None, MODEL_AXIS, None),
+            "blocks/w2": P(None, MODEL_AXIS, None),
+        })
+        keys = jax.random.split(jax.random.PRNGKey(0), L + 1)
+        params = {
+            "emb": jax.random.normal(keys[0], (V, H)) * 0.02,
+            "pos": jax.random.normal(keys[0], (S, H)) * 0.02,
+            "blocks": pl.stack_stage_params(
+                [_block_params(k) for k in keys[1:]]),
+            "head": jax.random.normal(keys[0], (H, V)) * 0.02,
+        }
+        params = spec.place_tree(params)
+
+        def loss_fn(p, xt, yt):
+            h = p["emb"][xt] + p["pos"][None]
+
+            def f(x, lp):
+                return _block_apply(lp, x), None
+            h, _ = jax.lax.scan(f, h, p["blocks"])
+            return _xent(h @ p["head"], yt)
+
+        def step(p, xt, yt):
+            loss, g = jax.value_and_grad(loss_fn)(p, xt, yt)
+            return loss, jax.tree.map(lambda w, gw: w - lr * gw, p, g)
+
+        # in/out shardings PINNED to the spec: the params carry is a
+        # true fixed point, so (a) the AOT executable below serves the
+        # timed loop directly — one compile total, also feeding the
+        # comm estimate its optimized-HLO text — and (b) no hidden
+        # step-2 recompile when GSPMD would otherwise drift an
+        # unpinned output leaf to a sharded layout
+        pshard = spec.tree_shardings(params)
+        dsh = NamedSharding(mesh, P(DATA_AXIS))
+        rep = NamedSharding(mesh, P())
+        jit_step = jax.jit(step, donate_argnums=(0,),
+                           in_shardings=(pshard, dsh, dsh),
+                           out_shardings=(rep, pshard))
+        xt = jax.device_put(xb_np, dsh)
+        yt = jax.device_put(yb_np, dsh)
+        exe, text = _compile_once(jit_step, params, xt, yt)
+
+        def once(carry):
+            loss, new_p = exe(carry, xt, yt)
+            return new_p, loss
+
+        return once, params, dict(mesh=cfg, layers=L,
+                                  comm=_cost.estimate_comm(text))
+
+    def build_pipe(name, cfg, overlap=None):
+        """pipe x data: the fused 1F1B scan (one XLA program for the
+        whole trunk) with per-bucket in-scan gradient reduction when
+        ``overlap`` is on."""
+        import paddle_tpu as pt
+        mesh = make_mesh(cfg, devices=devs)
+        n_stages = dict(mesh.shape)[PIPE_AXIS]
+        keys = jax.random.split(jax.random.PRNGKey(0), n_stages + 1)
+        params = {
+            "embed": {"w": jax.random.normal(keys[0], (V, H)) * 0.02,
+                      "pos": jax.random.normal(keys[0], (S, H)) * 0.02},
+            "stages": pl.stack_stage_params(
+                [_block_params(k) for k in keys[1:]]),
+            "head": {"w": jax.random.normal(keys[0], (H, V)) * 0.02},
+        }
+
+        def embed_fn(ep, xt):
+            return ep["w"][xt] + ep["pos"][None]
+
+        def loss_fn(hp, a, yt):
+            return _xent(a @ hp["w"], yt)
+
+        mod = pl.PipelineModule(mesh, embed_fn, _block_apply, loss_fn,
+                                n_micro)
+        init_fn, step = mod.make_train_step(
+            pt.optimizer.SGDOptimizer(learning_rate=lr),
+            schedule="1f1b", overlap_grad_reduce=overlap)
+        params, opt_state = init_fn(params)
+        xt, yt = jnp.asarray(xb_np), jnp.asarray(yb_np)
+        # the module's jitted step keeps auto-commit semantics for the
+        # timed loop (its out shardings are not caller-pinnable), so
+        # the comm estimate pays one extra AOT compile for the HLO
+        # text — pipe topologies only
+        _, text = _compile_once(step, params, opt_state, xt, yt)
+
+        def once(carry):
+            p, o = carry
+            loss, p, o = step(p, o, xt, yt)
+            return (p, o), loss
+
+        return once, (params, opt_state), dict(
+            mesh=cfg, layers=n_stages,
+            comm=_cost.estimate_comm(text))
+
+    def _compile_once(jitted, *args):
+        """(AOT executable, optimized-HLO text) from one compile."""
+        exe = jitted.lower(*args).compile()
+        try:
+            text = exe.as_text()
+        except Exception:       # backend without HLO text
+            text = None
+        return exe, text
+
+    model = 2 if N % 2 == 0 else 1
+    pipe = 4 if N % 4 == 0 else (2 if N % 2 == 0 else 1)
+    wanted = os.environ.get("BENCH_SHARD_TOPOS",
+                            "dp,modelxdata,pipexdata").split(",")
+    topo_defs = {
+        "dp": lambda: build_dense("dp", MeshConfig(data=N)),
+        "modelxdata": lambda: build_dense(
+            "modelxdata", MeshConfig(data=N // model, model=model)),
+        "pipexdata": lambda: build_pipe(
+            "pipexdata",
+            MeshConfig(data=N // pipe, pipe=pipe,
+                       axis_order=("data", "pipe", "model", "seq"))),
+    }
+
+    def window(once, carry, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            carry, res = once(carry)
+        float(np.ravel(np.asarray(res))[0])     # host-fetch sync
+        return time.perf_counter() - t0, carry
+
+    # compile + settle every topology BEFORE any timing, then
+    # interleave windows round-robin
+    runners = {}
+    for name in wanted:
+        name = name.strip()
+        if name not in topo_defs:
+            continue
+        once, carry, meta = topo_defs[name]()
+        dt, carry = window(once, carry, 1)      # compile
+        dt, carry = window(once, carry, 2)      # settle the pipeline
+        runners[name] = [once, carry, meta, []]
+    for w in range(windows):
+        for name, r in runners.items():
+            dt, r[1] = window(r[0], r[1], steps)
+            r[3].append(dt)
+
+    peak = _cost.peak_flops()
+    for topo_i, (name, (once, carry, meta, dts)) in enumerate(
+            runners.items()):
+        best = min(dts)
+        ms = best / steps * 1e3
+        flops = _trunk_flops(meta["layers"])
+        mfu = flops / (best / steps) / (peak * max(N, 1))
+        comm = meta["comm"] or {}
+        cfg = meta["mesh"]
+        g_mfu.set(mfu, topology=name)
+        if comm:
+            # ONE group for the whole sweep, one segment index per
+            # topology: a per-topology group would clear the previous
+            # topology's gauge series on every record (latest-group
+            # semantics), leaving only the last topology in the
+            # end-of-run registry snapshot
+            _cost.record_segment_comm("bench.shard", topo_i, comm)
+        line = {
+            "metric": f"shard_{name}_step_ms",
+            "value": round(ms, 3), "unit": "ms",
+            # significant digits, not decimal places: a tiny CPU-smoke
+            # config's MFU (~1e-7) must not round to a dishonest 0.0
+            "mfu": float(f"{mfu:.4g}"),
+            "comm_bytes_per_step": comm.get("comm_bytes", 0.0),
+            "collectives": comm.get("collectives", {}),
+            "tokens_per_sec": round(B * S / (best / steps), 1),
+            "layout": {"data": cfg.data, "model": cfg.model,
+                       "pipe": cfg.pipe, "n_devices": N},
+            "windows_ms_per_step": [round(d / steps * 1e3, 3)
+                                    for d in dts],
+        }
+        spread = (max(dts) - min(dts)) / min(dts) if dts else 0.0
+        line["window_spread"] = round(spread, 4)
+        if spread > 0.20:
+            line["contention_suspected"] = True
+        print(json.dumps(line))
+
+    # ---- overlap A/B on the pipe topology (comm-bound config) --------
+    from paddle_tpu.parallel.pipeline import _data_reduce_axes
+    pmesh_cfg = MeshConfig(data=N // pipe, pipe=pipe,
+                           axis_order=("data", "pipe", "model", "seq"))
+    pmesh = make_mesh(pmesh_cfg, devices=devs)
+    if "pipexdata" in runners and _data_reduce_axes(pmesh):
+        on_once, on_carry, on_meta = build_pipe("ov_on", pmesh_cfg,
+                                                overlap=True)
+        off_once, off_carry, off_meta = build_pipe("ov_off", pmesh_cfg,
+                                                   overlap=False)
+        onces = {"on": on_once, "off": off_once}
+        carries = {"on": on_carry, "off": off_carry}
+        for k in ("on", "off"):         # compile + settle
+            _, carries[k] = window(onces[k], carries[k], 2)
+        on_ms, off_ms, ratios = [], [], []
+        for w in range(pairs):
+            order = (("on", "off") if w % 2 == 0   # alternate order
+                     else ("off", "on"))           # within each pair
+            pair = {}
+            for k in order:
+                pair[k], carries[k] = window(onces[k], carries[k],
+                                             steps)
+            on_ms.append(pair["on"] / steps * 1e3)
+            off_ms.append(pair["off"] / steps * 1e3)
+            ratios.append(pair["on"] / pair["off"])
+        med = float(np.median(ratios))
+        print(json.dumps({
+            "metric": "shard_overlap_step_ratio",
+            "value": round(med, 4), "unit": "x",
+            "overlap_on_ms_per_step": round(float(np.median(on_ms)), 3),
+            "overlap_off_ms_per_step": round(float(np.median(off_ms)),
+                                             3),
+            "pair_ratios": [round(r, 4) for r in ratios],
+            "overlap_on_comm_bytes": (on_meta["comm"] or {}).get(
+                "comm_bytes", 0.0),
+            "overlap_off_comm_bytes": (off_meta["comm"] or {}).get(
+                "comm_bytes", 0.0),
+            "overlap_on_collectives": (on_meta["comm"] or {}).get(
+                "collectives", {}),
+            "overlap_off_collectives": (off_meta["comm"] or {}).get(
+                "collectives", {}),
+        }))
+        print(f"# overlap A/B: median pair ratio {med:.4f}x over "
+              f"{pairs} interleaved pairs x {steps} steps "
+              f"(pipe={pipe}, data={N // pipe})", file=sys.stderr)
+    else:
+        print("# overlap A/B skipped: pipe topology has no data axis "
+              "to reduce over (n_devices too small)", file=sys.stderr)
+
+
 def _emit_registry_snapshot():
     """End-of-run metrics emission: the registry (bench windows +
     whatever executor/prefetch/checkpoint counters the run touched) as
@@ -864,6 +1215,8 @@ def _dispatch_mode():
         return bench_numerics()
     if len(sys.argv) > 1 and sys.argv[1] == "ckpt":
         return bench_ckpt()
+    if len(sys.argv) > 1 and sys.argv[1] == "shard":
+        return bench_shard()
     import jax
     import jax.numpy as jnp
 

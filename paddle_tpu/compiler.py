@@ -86,9 +86,36 @@ class CompiledProgram:
         self._program = program
         self._build_strategy = build_strategy
         self._exec_strategy = None
-        self._dp = False
         self._mesh = None
+        # the ONE parallel-mode switch: the executor branches on _spec
+        # (with_data_parallel sets the trivial pure-DP spec, so both
+        # entry points leave a consistent state — no separate _dp flag
+        # to drift out of sync)
+        self._spec = None
         self._loss_name = None
+
+    def with_mesh_sharding(self, spec=None, loss_name=None):
+        """Unified mesh partitioner entry (ROADMAP item 2): attach a
+        ``parallel.spec.ShardingSpec`` so the Executor places this
+        program's persistable state per the spec's per-param
+        PartitionSpecs, shards feeds per its batch-axis specs, and pins
+        the spec'd names inside every compiled device segment with
+        ``with_sharding_constraint`` — pjit in/out shardings end to
+        end, one annotation source for data/model/pipe placement.
+        ``with_data_parallel`` is the pure-DP special case (it builds a
+        default spec internally)."""
+        from paddle_tpu.parallel.spec import ShardingSpec
+        if spec is None:
+            spec = ShardingSpec()
+        if not isinstance(spec, ShardingSpec):
+            raise EnforceNotMet(
+                f"with_mesh_sharding expects a parallel.ShardingSpec, "
+                f"got {type(spec).__name__}")
+        self._spec = spec
+        self._mesh = spec.mesh
+        self._loss_name = (loss_name if isinstance(loss_name, str)
+                           or loss_name is None else loss_name.name)
+        return self
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -97,7 +124,6 @@ class CompiledProgram:
         default is every visible device on one "data" mesh axis."""
         import jax
         from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
-        self._dp = True
         self._loss_name = (loss_name if isinstance(loss_name, str)
                            or loss_name is None else loss_name.name)
         self._build_strategy = build_strategy or self._build_strategy \
@@ -112,6 +138,12 @@ class CompiledProgram:
                        for p in places]
         self._mesh = make_mesh(MeshConfig(data=len(devices)),
                                devices=devices)
+        # pure DP is the trivial ShardingSpec: params replicated, feeds
+        # batch-sharded over "data" — the executor consumes ONLY the
+        # spec, so this path and with_mesh_sharding share every line of
+        # the placement/lowering machinery
+        from paddle_tpu.parallel.spec import ShardingSpec
+        self._spec = ShardingSpec(self._mesh)
         return self
 
     # the Executor reads program attributes through the wrapper

@@ -13,6 +13,13 @@ answers "how much of the hardware did we use". Sources:
   segments (older compiled steps — other feed signatures, pre-retrace
   shapes — are superseded, not accumulated: summing two compiles of the
   same program would double-count).
+- ``estimate_comm(compiled.as_text())`` estimates cross-device
+  collective bytes from the post-SPMD optimized HLO (collectives are
+  inserted at COMPILE time, so the pre-partition lowering can't see
+  them); the executor records it at AOT-compile time
+  (``record_segment_comm`` → ``segment_comm_bytes`` gauge,
+  ``comm_bytes_per_step()``), and ``bench.py shard`` reports it per
+  mesh topology.
 - ``estimate_mfu()`` divides achieved FLOP/s (flops_per_step over the
   ``executor_step_ms`` histogram's mean) by ``peak_flops()``.
 
@@ -24,13 +31,16 @@ the stdlib-only launcher.
 """
 
 import os
+import re
 import threading
 
 from paddle_tpu.monitor.registry import gauge
 
 __all__ = [
-    "analyze_lowered", "record_segment", "segments", "flops_per_step",
-    "bytes_per_step", "estimate_mfu", "peak_flops", "reset",
+    "analyze_lowered", "estimate_comm", "record_segment",
+    "record_segment_comm", "segments", "flops_per_step",
+    "bytes_per_step", "comm_bytes_per_step", "estimate_mfu",
+    "peak_flops", "reset",
 ]
 
 #: v5e bf16 peak, the chip this repo benches on (bench.py uses the same
@@ -49,6 +59,69 @@ _g_bytes = gauge(
     "segment_bytes",
     "Analytical bytes accessed per execution of each compiled device "
     "segment", labels=("segment",))
+_g_comm = gauge(
+    "segment_comm_bytes",
+    "Estimated cross-device collective bytes per execution of each "
+    "compiled device segment (result-buffer bytes of the collective "
+    "ops in the post-SPMD optimized HLO)", labels=("segment",))
+
+# collective instructions in XLA's post-SPMD optimized HLO text; the
+# result type precedes the op name ("%x = f32[4,8]{1,0} all-reduce(…"
+# or a tuple "(f32[128]{0}, f32[64]{0})" for fused buckets). Async
+# split pairs count on -done ONLY: a -start op's result tuple bundles
+# operands + results (+ scheduling context), so counting it would
+# tally ~2x the result bytes on backends that lower collectives
+# asynchronously (TPU) while synchronous lowerings (CPU) count 1x —
+# the -done result is exactly the collective result on every backend.
+_COLL_RE = re.compile(
+    r"=\s+(\([^)]*\)|\S+)\s+"
+    r"(all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast)(-start|-done)?\(")
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+def _type_bytes(type_str):
+    total = 0.0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        size = _DTYPE_BYTES.get(dt)
+        if size is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def estimate_comm(hlo_text):
+    """{'comm_bytes': float, 'collectives': {op: count}} from a
+    compiled executable's optimized HLO text (``compiled.as_text()``),
+    or None when the text carries no parseable module. The estimate is
+    the sum of collective RESULT-buffer bytes per execution — a
+    lower-bound proxy for wire traffic (a ring all-reduce moves
+    ~2(n-1)/n of it per hop), comparable across topologies AND
+    backends because the convention is fixed: async-lowered pairs
+    (TPU) count their -done result, never the -start tuple (operands +
+    results + context, which would double-count). Collectives are
+    inserted by SPMD partitioning at COMPILE time, so this must read
+    the compiled text, not the pre-partition lowering."""
+    if not hlo_text:
+        return None
+    comm = 0.0
+    counts = {}
+    for type_str, op, suffix in _COLL_RE.findall(hlo_text):
+        if suffix == "-start":
+            continue
+        counts[op] = counts.get(op, 0) + 1
+        comm += _type_bytes(type_str)
+    return {"comm_bytes": comm, "collectives": counts}
 
 
 def analyze_lowered(lowered):
@@ -84,10 +157,36 @@ def record_segment(group, index, analysis):
         if group != _latest_group:
             _g_flops.clear()
             _g_bytes.clear()
-        _segments.setdefault(group, {})[int(index)] = dict(analysis)
+            _g_comm.clear()
+        # merge, don't replace: comm bytes for the same segment may
+        # already have been recorded (record_segment_comm)
+        _segments.setdefault(group, {}).setdefault(
+            int(index), {}).update(analysis)
         _latest_group = group
     _g_flops.set(analysis["flops"], segment=str(index))
     _g_bytes.set(analysis["bytes"], segment=str(index))
+
+
+def record_segment_comm(group, index, comm):
+    """Record one device segment's estimated collective bytes (the
+    ``estimate_comm`` result) under ``group`` — the executor calls this
+    at AOT-compile time (``Executor.prepare``), when the compiled
+    executable's HLO text is in hand; bench modes call it for their own
+    jitted steps. Same latest-group gauge semantics as
+    ``record_segment``."""
+    global _latest_group
+    if not comm:
+        return
+    with _lock:
+        if group != _latest_group:
+            _g_flops.clear()
+            _g_bytes.clear()
+            _g_comm.clear()
+        entry = _segments.setdefault(group, {}).setdefault(int(index), {})
+        entry["comm_bytes"] = float(comm.get("comm_bytes", 0.0))
+        entry["collectives"] = dict(comm.get("collectives", {}))
+        _latest_group = group
+    _g_comm.set(float(comm.get("comm_bytes", 0.0)), segment=str(index))
 
 
 def segments(group=None):
@@ -110,6 +209,10 @@ def flops_per_step():
 
 def bytes_per_step():
     return _total("bytes")
+
+
+def comm_bytes_per_step():
+    return _total("comm_bytes")
 
 
 def peak_flops():
@@ -148,3 +251,4 @@ def reset():
         _latest_group = None
     _g_flops.clear()
     _g_bytes.clear()
+    _g_comm.clear()

@@ -10,6 +10,7 @@ as shardings over a `jax.sharding.Mesh`; XLA inserts ICI/DCN collectives
 from paddle_tpu.parallel.mesh import (
     make_mesh, get_mesh, set_mesh, mesh_shape_for, MeshConfig,
 )
+from paddle_tpu.parallel.spec import ShardingSpec
 from paddle_tpu.parallel.collective import (
     all_reduce, all_gather, reduce_scatter, broadcast, ppermute, barrier,
     psum, pmean,

@@ -1,12 +1,22 @@
 """jax version-compat shims shared by the parallel modules.
 
-Two renames this codebase has to straddle (the container pin is older
-than the APIs some call sites were written against):
+Renames this codebase has to straddle (the container pin is older than
+the APIs some call sites were written against):
 
-- ``jax.shard_map`` is top-level only in newer jax; older jax ships it
-  as ``jax.experimental.shard_map.shard_map``.
+- ``jax.shard_map`` is top-level only in newer jax; the pinned
+  jax 0.4.37 has **no** ``jax.shard_map`` and ships it as
+  ``jax.experimental.shard_map.shard_map``. The first use of that
+  fallback warns once per process (key ``"shard_map_fallback"``) so a
+  run's logs record which code path actually executed.
 - jax>=0.8 renamed shard_map's ``check_rep`` kwarg to ``check_vma``;
   the kwarg name is probed once, at import.
+
+Because the pin has no stable shard_map, the unified mesh partitioner
+(parallel/spec.py) does NOT build on it: sharding annotations route
+through the pjit path — committed input shardings plus
+``sharding_constraint`` below (``jax.lax.with_sharding_constraint``,
+which jax.jit IS pjit for on this pin). ``HAS_NATIVE_SHARD_MAP`` lets
+tests pin which path runs.
 
 Import from here instead of re-probing per module — five drifting
 copies of version detection is how compat bugs are born.
@@ -14,18 +24,54 @@ copies of version detection is how compat bugs are born.
 
 import inspect as _inspect
 
+import jax as _jax
+
 try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map as _shard_map_impl
+    HAS_NATIVE_SHARD_MAP = True
+except ImportError:  # older jax (the 0.4.37 container pin)
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    HAS_NATIVE_SHARD_MAP = False
 
 SHARD_MAP_CHECK_KW = (
     "check_vma"
-    if "check_vma" in _inspect.signature(shard_map).parameters
+    if "check_vma" in _inspect.signature(_shard_map_impl).parameters
     else "check_rep")
 
 #: splat into a shard_map call to disable replication checking under
 #: either kwarg spelling: ``shard_map(f, ..., **CHECK_DISABLED)``
 CHECK_DISABLED = {SHARD_MAP_CHECK_KW: False}
 
-__all__ = ["shard_map", "SHARD_MAP_CHECK_KW", "CHECK_DISABLED"]
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` when the pin has it; else the
+    ``jax.experimental.shard_map`` fallback, announced once per process
+    the first time it actually engages (a silent fallback left runs
+    with no record of which implementation they exercised)."""
+    if not HAS_NATIVE_SHARD_MAP:
+        from paddle_tpu.core.enforce import warn_once
+        warn_once(
+            "shard_map_fallback",
+            "jax has no top-level jax.shard_map on this pin "
+            f"(jax {_jax.__version__}): falling back to "
+            "jax.experimental.shard_map. Spec-driven sharding "
+            "(parallel/spec.py) routes through pjit/"
+            "with_sharding_constraint instead and does not depend on "
+            "this fallback.")
+    return _shard_map_impl(*args, **kwargs)
+
+
+def sharding_constraint(x, mesh, spec):
+    """Pin ``x``'s sharding inside a jitted computation via the pjit
+    path (``jax.lax.with_sharding_constraint``) — the lowering the
+    unified ShardingSpec uses for the compiled device segments, valid
+    on every supported jax (no shard_map involved). ``spec`` may be a
+    ``PartitionSpec`` or an already-built ``NamedSharding``."""
+    from jax.sharding import NamedSharding
+    if not isinstance(spec, NamedSharding):
+        spec = NamedSharding(mesh, spec)
+    return _jax.lax.with_sharding_constraint(x, spec)
+
+
+__all__ = ["shard_map", "sharding_constraint", "SHARD_MAP_CHECK_KW",
+           "CHECK_DISABLED", "HAS_NATIVE_SHARD_MAP"]

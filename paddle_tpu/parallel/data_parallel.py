@@ -256,8 +256,29 @@ class DataParallelTrainer:
             if self.param_sharding not in ("reduce", "zero"):
                 raise EnforceNotMet(
                     f"param_sharding={self.param_sharding!r}: expected "
-                    f"None, 'reduce'/'zero', or a PartitionSpec tree")
+                    f"None, 'reduce'/'zero', a PartitionSpec tree, or "
+                    f"a parallel.ShardingSpec")
             return zero_param_specs(self.mesh, params, axes=(self.axis,))
+        from paddle_tpu.parallel.spec import ShardingSpec
+        if isinstance(self.param_sharding, ShardingSpec):
+            # the unified spec as placement source: entries must stay
+            # on THIS trainer's data axis — the explicit gather/scatter
+            # collectives below reduce over self.axis, so a model-axis
+            # entry would silently shard without ever being gathered
+            specs = self.param_sharding.tree_specs(params)
+            for sp in jax.tree.leaves(
+                    specs, is_leaf=lambda s: isinstance(s, P)):
+                for entry in sp:
+                    if entry is not None and entry != self.axis:
+                        raise EnforceNotMet(
+                            f"DataParallelTrainer(param_sharding=Shard"
+                            f"ingSpec): entry {sp} references axis "
+                            f"{entry!r}, but this trainer's explicit "
+                            f"all-gather/reduce-scatter pair runs over "
+                            f"{self.axis!r} only — model-axis "
+                            f"placement belongs to the megatron specs "
+                            f"or the executor's spec path")
+            return specs
         return self.param_sharding
 
     def _slot_specs(self, slots):

@@ -77,6 +77,14 @@ def moe_param_specs():
     }
 
 
+def moe_sharding_spec(mesh=None):
+    """The MoE placement as the unified ShardingSpec (parallel/spec.py)
+    — same entries as ``moe_param_specs``, usable for executor interop
+    and ``checkpoint_axes`` (experts tile dim 0 over "expert")."""
+    from paddle_tpu.parallel.spec import ShardingSpec
+    return ShardingSpec(mesh, params=moe_param_specs())
+
+
 def _top_k_mask(gates, k):
     """[T, E] gate probs -> (positions [T, k] int, onehot [T, k, E])."""
     _, idx = jax.lax.top_k(gates, k)

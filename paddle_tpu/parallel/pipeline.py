@@ -31,15 +31,47 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from paddle_tpu.core.flags import define_flag, get_flag
 from paddle_tpu.parallel._compat import CHECK_DISABLED as _CHECK_KW
 from paddle_tpu.parallel._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import DATA_AXIS, PIPE_AXIS
+from .mesh import DATA_AXIS, DCN_AXIS, PIPE_AXIS
 
 __all__ = ["stack_stage_params", "stage_param_sharding", "pipeline_apply",
            "PipelineModule", "pipeline_train_1f1b", "gpipe_bubble_fraction",
            "one_f_one_b_bubble_fraction", "schedule_occupancy"]
+
+define_flag(
+    "overlap_grad_reduce", False,
+    "1F1B schedule: issue the data/dcn_data gradient all-reduce "
+    "per-bucket INSIDE the backward scan as each tick produces its "
+    "gradient contribution (scan-carried partial reductions XLA can "
+    "overlap with the next tick's compute), instead of one fused "
+    "reduction after the scan drains. Off by default: bench.py shard "
+    "A/Bs it per host — on the CPU harness the per-tick collectives "
+    "measured 1.24x SLOWER (synchronous CPU collectives cannot hide "
+    "under compute; docs/PERFORMANCE.md records the evidence), so "
+    "enable it only where the A/B shows a win (TPU ICI)")
+
+
+def _data_reduce_axes(mesh, data_axis=DATA_AXIS):
+    """The data-parallel mesh axes a pipelined trunk's gradients reduce
+    over, DCN-outermost — psum over this tuple is mesh.py's
+    hierarchical allreduce (within-slice ICI first, one DCN crossing
+    per slice). Axes of extent 1 are dropped: a vacuous collective
+    still costs a lowering."""
+    shape = dict(mesh.shape)
+    return tuple(a for a in (DCN_AXIS, data_axis)
+                 if shape.get(a, 1) > 1)
+
+
+def _data_pspec(axes):
+    """P(None, axes) microbatch spec: per-microbatch batch dim (axis 1)
+    sharded over the data axes (hierarchically when DCN is present)."""
+    if not axes:
+        return P()
+    return P(None, axes[0] if len(axes) == 1 else tuple(axes))
 
 
 def stack_stage_params(stage_params):
@@ -136,7 +168,7 @@ def pipeline_apply(mesh, stage_fn, stacked_params, microbatches,
     pspec = jax.tree.map(
         lambda x: P(*([pipe_axis] + [None] * (np.ndim(x) - 1))),
         stacked_params)
-    dspec = P(None, data_axis) if mesh.shape.get(data_axis, 1) > 1 else P()
+    dspec = _data_pspec(_data_reduce_axes(mesh, data_axis))
     body = functools.partial(_pipeline_local, stage_fn, n_micro=n_micro,
                              axis_name=pipe_axis)
 
@@ -171,6 +203,16 @@ class PipelineModule:
         return x.reshape((self.n_micro, x.shape[0] // self.n_micro)
                          + x.shape[1:])
 
+    def sharding_spec(self):
+        """The module's placement as the unified ShardingSpec
+        (parallel/spec.py): stage params tiled over "pipe" on their
+        leading stage axis, embed/head replicated. One annotation
+        source for init placement, executor interop, and
+        ``checkpoint_axes`` (save(axes=) derivation)."""
+        from paddle_tpu.parallel.spec import ShardingSpec
+        return ShardingSpec(self.mesh,
+                            rules=[("stages/*", P(self.pipe_axis))])
+
     def loss(self, params, batch_x, batch_y):
         """Full-batch loss: embed -> pipeline trunk -> mean of per-
         microbatch losses (= the reference's microbatch gradient
@@ -184,12 +226,17 @@ class PipelineModule:
                           )(out, yb)
         return jnp.mean(losses)
 
-    def make_train_step(self, optimizer, schedule="gpipe"):
+    def make_train_step(self, optimizer, schedule="gpipe",
+                        overlap_grad_reduce=None):
         """schedule='gpipe' differentiates the forward scan (activations
         for all M microbatches live through the backward, plus a
         full-activation output psum); schedule='1f1b' uses the
         interleaved fwd/bwd schedule (bounded residuals, grads stay
-        pipe-sharded, no activation broadcast)."""
+        pipe-sharded, no activation broadcast).
+        ``overlap_grad_reduce`` (1f1b only; default
+        FLAGS_overlap_grad_reduce) issues the data-axes gradient
+        all-reduce per bucket inside the backward scan — see
+        pipeline_train_1f1b."""
         mesh = self.mesh
 
         if schedule == "1f1b":
@@ -210,7 +257,8 @@ class PipelineModule:
                 loss, sg, hg, dx = pipeline_train_1f1b(
                     mesh, self.stage_fn, params["stages"], mb,
                     out_grad, yb, head_params=params["head"],
-                    pipe_axis=self.pipe_axis)
+                    pipe_axis=self.pipe_axis,
+                    overlap_grad_reduce=overlap_grad_reduce)
                 # 1F1B sums per-microbatch grads; the GPipe loss is the
                 # MEAN over microbatches — match it
                 sg = jax.tree.map(lambda g: g / self.n_micro, sg)
@@ -235,18 +283,12 @@ class PipelineModule:
             return loss, new_params, new_opt
 
         def init_fn(params):
-            stacked_sh = stage_param_sharding(mesh, params["stages"],
-                                              self.pipe_axis)
-            params = dict(params)
-            params["stages"] = jax.device_put(params["stages"], stacked_sh)
+            # placement flows from the ONE spec (stages over "pipe",
+            # embed/head replicated) — the same object callers hand to
+            # the executor or derive save(axes=) from
+            pshard = self.sharding_spec().tree_shardings(params)
+            params = jax.device_put(params, pshard)
             opt_state = optimizer.init(params)
-            pshard = {
-                "embed": jax.tree.map(
-                    lambda _: NamedSharding(mesh, P()), params["embed"]),
-                "stages": stacked_sh,
-                "head": jax.tree.map(
-                    lambda _: NamedSharding(mesh, P()), params["head"]),
-            }
             opt_state = jax.device_put(
                 opt_state, optimizer.state_shardings(opt_state, pshard,
                                                      mesh))
@@ -293,7 +335,8 @@ def schedule_occupancy(n_micro, n_stages):
 
 def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
                         out_grad_fn, labels, head_params=None,
-                        pipe_axis=PIPE_AXIS, data_axis=DATA_AXIS):
+                        pipe_axis=PIPE_AXIS, data_axis=DATA_AXIS,
+                        overlap_grad_reduce=None):
     """One fused 1F1B forward+backward pass over the pipelined trunk.
 
     Unlike pipeline_apply (GPipe: autodiff over the whole forward scan,
@@ -317,18 +360,38 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
     is stateless).
     Returns (mean_loss, stage_grads [stacked, pipe-sharded],
     head_grads, dx [M, ...] input cotangents for the embed backward).
+
+    ``overlap_grad_reduce`` (default: FLAGS_overlap_grad_reduce) moves
+    the data/dcn_data gradient all-reduce INSIDE the scan: each tick's
+    gradient contribution is pmean'd over the data axes as the backward
+    produces it (one collective per parameter bucket per tick,
+    scan-carried partial sums), so XLA overlaps the reduction with the
+    next tick's fwd/bwd compute instead of serializing one big fused
+    reduction after the scan drains. Same math — sum of per-tick means
+    == mean of summed grads — so on/off is a pure scheduling A/B
+    (bench.py shard measures it; float association differs at the ulp
+    level only). Under a hybrid mesh the reduction spans
+    ("dcn_data", "data"): hierarchical allreduce, DCN crossed once.
     """
     n_micro = int(microbatches.shape[0])
     n_stages = int(dict(mesh.shape)[pipe_axis])
     resid_len = min(2 * n_stages - 1, n_micro) if n_micro else 1
     ticks = n_micro + 2 * (n_stages - 1)
+    if overlap_grad_reduce is None:
+        overlap_grad_reduce = bool(get_flag("overlap_grad_reduce"))
+    red_axes = _data_reduce_axes(mesh, data_axis)
+    shape = dict(mesh.shape)
+    n_red = 1
+    for a in red_axes:
+        n_red *= shape[a]
+    overlap = bool(overlap_grad_reduce) and bool(red_axes)
 
     if head_params is None:
         head_params = {}
     pspec = jax.tree.map(
         lambda x: P(*([pipe_axis] + [None] * (np.ndim(x) - 1))),
         stacked_params)
-    dspec = P(None, data_axis) if mesh.shape.get(data_axis, 1) > 1 else P()
+    dspec = _data_pspec(red_axes)
     hspec = jax.tree.map(lambda _: P(), head_params)
     lspec = dspec
 
@@ -384,9 +447,17 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
             loss_m, dy_m, hg_m = out_grad_fn(hp, y, lab_m)
             take_head = fwd_valid & is_last
             loss_acc = c["loss_acc"] + jnp.where(take_head, loss_m, 0.0)
-            head_acc = jax.tree.map(
-                lambda a, g: a + jnp.where(take_head, g, 0.0),
-                c["head_acc"], hg_m)
+            if overlap:
+                # per-bucket data-axes reduction as the tick produces
+                # the contribution (scan-carried partial mean)
+                head_acc = jax.tree.map(
+                    lambda a, g: a + lax.pmean(
+                        jnp.where(take_head, g, 0.0), red_axes),
+                    c["head_acc"], hg_m)
+            else:
+                head_acc = jax.tree.map(
+                    lambda a, g: a + jnp.where(take_head, g, 0.0),
+                    c["head_acc"], hg_m)
 
             # ---- backward (recompute-from-residual vjp) ----
             x_saved = lax.dynamic_index_in_dim(
@@ -398,9 +469,21 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
             x_for_bwd = jnp.where(is_last, x, x_saved)
             _, vjp_fn = jax.vjp(fn, params, x_for_bwd)
             gp, gx = vjp_fn(g_in)
-            grad_acc = jax.tree.map(
-                lambda a, g: a + jnp.where(bwd_valid, g, 0.0),
-                c["grad_acc"], gp)
+            if overlap:
+                # the gradient all-reduce over data/dcn_data, issued
+                # per bucket (per param leaf) the tick the backward
+                # produces it — XLA overlaps these with the next
+                # tick's compute; the carry accumulates ALREADY-
+                # reduced partial sums, so the epilogue reduction
+                # disappears
+                grad_acc = jax.tree.map(
+                    lambda a, g: a + lax.pmean(
+                        jnp.where(bwd_valid, g, 0.0), red_axes),
+                    c["grad_acc"], gp)
+            else:
+                grad_acc = jax.tree.map(
+                    lambda a, g: a + jnp.where(bwd_valid, g, 0.0),
+                    c["grad_acc"], gp)
             dx_bank = lax.dynamic_update_index_in_dim(
                 c["dx_bank"],
                 jnp.where(bwd_valid & (idx == 0), gx,
@@ -421,21 +504,22 @@ def pipeline_train_1f1b(mesh, stage_fn, stacked_params, microbatches,
         c, _ = lax.scan(tick, carry0, jnp.arange(ticks))
         # scalar/param-sized epilogues only — no activation broadcast.
         # Under DP x PP each data replica computed its slice's local
-        # mean loss: the global loss is the data-axis mean, and every
-        # param grad is likewise the data-axis mean (dx stays sharded
-        # over data, scaled by 1/n_data).
-        n_data = dict(mesh.shape).get(data_axis, 1)
+        # mean loss: the global loss is the data-axes mean, and every
+        # param grad is likewise the data-axes mean (dx stays sharded
+        # over data, scaled by 1/n_red). With overlap on, the grad/head
+        # reductions already happened per tick inside the scan.
         grad_acc = c["grad_acc"]
         head_acc = c["head_acc"]
         loss = lax.psum(c["loss_acc"], pipe_axis) / n_micro
         dx_local = c["dx_bank"]
-        if n_data > 1:
-            loss = lax.pmean(loss, data_axis)
-            grad_acc = jax.tree.map(
-                lambda g: lax.pmean(g, data_axis), grad_acc)
-            head_acc = jax.tree.map(
-                lambda g: lax.pmean(g, data_axis), head_acc)
-            dx_local = dx_local / n_data
+        if red_axes:
+            loss = lax.pmean(loss, red_axes)
+            if not overlap:
+                grad_acc = jax.tree.map(
+                    lambda g: lax.pmean(g, red_axes), grad_acc)
+                head_acc = jax.tree.map(
+                    lambda g: lax.pmean(g, red_axes), head_acc)
+            dx_local = dx_local / n_red
         # stage grads stay pipe-local (re-stack the leading axis of
         # length 1 so the output matches stacked_params' pipe sharding)
         stage_grads = jax.tree.map(lambda g: g[None], grad_acc)

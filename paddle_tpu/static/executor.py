@@ -466,11 +466,20 @@ class _CompiledStep:
             fn, _checked_fn, _writes = fn_w
             donated, rest = self._split(env, donate)
             lowered = fn.lower(donated, rest, base_key, step_idx)
-            lowered.compile()
+            exe = lowered.compile()
             if record_cost:
                 from paddle_tpu.monitor import cost as _cost
                 _cost.record_segment(id(self), compiled,
                                      _cost.analyze_lowered(lowered))
+                # collective bytes only exist POST-SPMD-partitioning,
+                # i.e. in the compiled executable's optimized HLO —
+                # AOT compile is the one place the executor holds it
+                try:
+                    txt = exe.as_text()
+                except Exception:   # backend without HLO text
+                    txt = None
+                _cost.record_segment_comm(id(self), compiled,
+                                          _cost.estimate_comm(txt))
             out = jax.eval_shape(fn, donated, rest, base_key, step_idx)
             compiled += 1
             env = {k: _spec_of(v) for k, v in self.constants.items()}
@@ -487,10 +496,10 @@ class _PreparedRunner:
 
     __slots__ = ("step", "state_names", "host_outs", "scope_ref",
                  "scope_version", "rep", "ok_shardings", "ndev",
-                 "watch_idx")
+                 "watch_idx", "spec", "targets")
 
     def __init__(self, step, state_names, host_outs, scope, rep, ndev,
-                 watch_idx=None):
+                 watch_idx=None, spec=None, targets=None):
         self.step = step
         self.state_names = state_names
         self.host_outs = host_outs
@@ -498,10 +507,16 @@ class _PreparedRunner:
         self.scope_version = scope.version
         self.watch_idx = watch_idx        # auto-appended @watch@stats
         self.rep = rep                    # replicated sharding (DP) or None
-        # shardings proven equivalent to rep, memoized BY IDENTITY with
-        # the object held alive: id alone could be recycled by a new,
-        # non-equivalent sharding after GC
-        self.ok_shardings = {}            # id(s) -> s
+        self.spec = spec                  # ShardingSpec (mesh mode) or None
+        # per-state-name target NamedSharding from the spec (replicated
+        # for names the spec says nothing about) — the residency fast
+        # path compares against THESE, so spec-sharded leaves pass
+        # through without a per-step re-put just like replicated ones
+        self.targets = targets
+        # shardings proven equivalent to their name's target, memoized
+        # BY IDENTITY with the object held alive: id alone could be
+        # recycled by a new, non-equivalent sharding after GC
+        self.ok_shardings = {}            # (name, id(s)) -> s
         self.ndev = ndev
 
     def fresh_for(self, scope):
@@ -546,15 +561,17 @@ class Executor:
         return k
 
     @staticmethod
-    def _dispatch_sig(program, dp_mesh, feeds, fetch_names, scope):
+    def _dispatch_sig(program, spec, feeds, fetch_names, scope):
         """Prepared-runner cache key. The PROGRAM OBJECT itself (not
         id()) rides in the key: the dict entry then keeps it alive, so
         a dead program's id can never be recycled into a silent stale
-        hit (dict hashing is identity-based for Program). The scope is
+        hit (dict hashing is identity-based for Program). The SPEC
+        object (ShardingSpec of the mesh mode, or None) rides the same
+        way — identity-hashed and kept alive by the entry. The scope is
         keyed by id() only — a recycled scope id is caught at use time
         by _PreparedRunner.fresh_for's weakref identity check, NOT by
         this key. feeds values may be arrays or ShapeDtypeStructs."""
-        return (program, program.version, id(dp_mesh),
+        return (program, program.version, spec,
                 tuple(sorted((k, tuple(v.shape), str(v.dtype))
                              for k, v in feeds.items())),
                 tuple(fetch_names), id(scope))
@@ -578,14 +595,17 @@ class Executor:
         (``np.asarray``). ``return_numpy=True`` keeps the blocking
         fluid-parity contract."""
         program = program or default_main_program()
-        # CompiledProgram.with_data_parallel: unwrap and remember the
-        # data mesh; the same compiled step runs SPMD over it (GSPMD
-        # partitions from the feed shardings — SURVEY §3.2's path with
-        # the multi-device graph pass replaced by the partitioner)
-        dp_mesh = None
+        # CompiledProgram.with_mesh_sharding / .with_data_parallel:
+        # unwrap and remember the ShardingSpec; the same compiled step
+        # runs SPMD over the spec's mesh (GSPMD partitions from the
+        # spec-derived feed/state shardings plus the
+        # with_sharding_constraint pins the compiled segments carry —
+        # SURVEY §3.2's path with the multi-device graph pass replaced
+        # by the partitioner)
+        spec = None
         from paddle_tpu.compiler import CompiledProgram
         if isinstance(program, CompiledProgram):
-            dp_mesh = program._mesh if program._dp else None
+            spec = program._spec
             program = program._program
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -634,25 +654,25 @@ class Executor:
         t_run = time.perf_counter()
         with RecordEvent("executor.run/prepare"):
             feeds = {k: _as_feed_array(v) for k, v in feed.items()}
-            dsig = self._dispatch_sig(program, dp_mesh, feeds,
+            dsig = self._dispatch_sig(program, spec, feeds,
                                       fetch_names, scope)
             fast = bool(get_flag("executor_fast_path"))
             runner = self._runners.get(dsig) if fast else None
             if runner is None or not runner.fresh_for(scope):
                 runner = self._prepare_runner(program, feeds, fetch_names,
-                                              scope, dp_mesh)
+                                              scope, spec)
                 if fast:
                     self._store_runner(dsig, runner)
             state = self._gather_state(runner, scope)
             if state is None:             # scope changed under us
                 runner = self._prepare_runner(program, feeds, fetch_names,
-                                              scope, dp_mesh)
+                                              scope, spec)
                 if fast:
                     self._store_runner(dsig, runner)
                 state = self._gather_state(runner, scope)
 
-            if dp_mesh is not None:
-                feeds = self._shard_feeds(feeds, dp_mesh)
+            if spec is not None:
+                feeds = spec.shard_feeds(feeds)
                 state = self._ensure_resident(state, runner, fast)
 
         # per-step rng: the base key is staged on device once per seed,
@@ -735,10 +755,10 @@ class Executor:
         segment was AOT-compiled (programs with host segments warm up
         to the first host boundary only)."""
         program = program or default_main_program()
-        dp_mesh = None
+        sspec = None
         from paddle_tpu.compiler import CompiledProgram
         if isinstance(program, CompiledProgram):
-            dp_mesh = program._mesh if program._dp else None
+            sspec = program._spec
             program = program._program
         feed = feed or {}
         fetch_list = fetch_list or []
@@ -749,9 +769,9 @@ class Executor:
                              else np.asarray(v))
                  for k, v in feed.items()}
         runner = self._prepare_runner(program, specs, fetch_names, scope,
-                                      dp_mesh)
+                                      sspec)
         if bool(get_flag("executor_fast_path")):
-            dsig = self._dispatch_sig(program, dp_mesh, specs,
+            dsig = self._dispatch_sig(program, sspec, specs,
                                       fetch_names, scope)
             self._store_runner(dsig, runner)
         state = {}
@@ -760,20 +780,17 @@ class Executor:
             if v is None:                 # host-written: materializes at
                 continue                  # step time, can't be spec'd
             state[n] = v
-        if dp_mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            from paddle_tpu.parallel.mesh import DATA_AXIS
-            rep = NamedSharding(dp_mesh, PartitionSpec())
-            state = {n: jax.ShapeDtypeStruct(np.shape(v), v.dtype,
-                                             sharding=rep)
+        if sspec is not None:
+            # abstract inputs carry the SPEC-derived shardings, so the
+            # AOT compile partitions exactly like the first real step
+            state = {n: jax.ShapeDtypeStruct(
+                        np.shape(v), v.dtype,
+                        sharding=runner.targets[n])
                      for n, v in state.items()}
             specs = {
                 k: jax.ShapeDtypeStruct(
                     s.shape, s.dtype,
-                    sharding=NamedSharding(
-                        dp_mesh,
-                        PartitionSpec() if len(s.shape) == 0
-                        else PartitionSpec(DATA_AXIS)))
+                    sharding=sspec.feed_sharding(k, len(s.shape)))
                 for k, s in specs.items()}
         base_key = self._base_key(program.random_seed)
         compiled, total = runner.step.aot_compile(
@@ -781,7 +798,7 @@ class Executor:
         return compiled == total
 
     # -- internals ---------------------------------------------------------
-    def _prepare_runner(self, program, feeds, fetch_names, scope, dp_mesh):
+    def _prepare_runner(self, program, feeds, fetch_names, scope, spec):
         """The one-time (per feed-signature) preparation the legacy path
         performed every step: state-name/host-out scans, the
         initialization check, and the compiled-step lookup."""
@@ -813,24 +830,37 @@ class Executor:
                 f"startup program first (exe.run(startup_program))")
         rep = None
         ndev = 0
-        if dp_mesh is not None:
+        targets = None
+        if spec is not None:
             from jax.sharding import NamedSharding, PartitionSpec
-            rep = NamedSharding(dp_mesh, PartitionSpec())
-            ndev = dp_mesh.size
+            rep = NamedSharding(spec.mesh, PartitionSpec())
+            ndev = spec.mesh.size
+            # per-name target shardings (replicated unless the spec
+            # says otherwise), validated ONCE against the live state
+            # shapes so a bad tiling fails here with the param named,
+            # not deep inside the partitioner
+            targets = spec.state_shardings(state_names)
+            for n, v in state.items():
+                if v is not None:
+                    jax.tree.map(
+                        lambda x, n=n: spec.validate_leaf(n, np.shape(x)),
+                        v)
         # program OBJECT in the key (see _dispatch_sig): identity hash
         # plus a live reference — id() alone could be recycled by a new
-        # program after GC and silently serve the stale compiled step
-        sig = (program, program.version, id(dp_mesh),
+        # program after GC and silently serve the stale compiled step.
+        # The spec rides the same way (identity, kept alive).
+        sig = (program, program.version, spec,
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feeds.items())),
                tuple(fetch_names), tuple(sorted(state_names)))
         step = self._cache.get(sig)
         if step is None:
             step = self._compile(program, sorted(state_names),
-                                 sorted(feeds), fetch_names)
+                                 sorted(feeds), fetch_names, spec)
             self._cache[sig] = step
         return _PreparedRunner(step, state_names, host_outs, scope, rep,
-                               ndev, watch_idx=watch_idx)
+                               ndev, watch_idx=watch_idx, spec=spec,
+                               targets=targets)
 
     def _gather_state(self, runner, scope):
         """Pull the current state values for a prepared runner. Returns
@@ -847,53 +877,46 @@ class Executor:
             state[n] = v
         return state
 
-    def _shard_feeds(self, feeds, dp_mesh):
-        from jax.sharding import NamedSharding, PartitionSpec
-        from paddle_tpu.parallel.mesh import DATA_AXIS
-        ndev = dp_mesh.size
-        rep = NamedSharding(dp_mesh, PartitionSpec())
-        data = NamedSharding(dp_mesh, PartitionSpec(DATA_AXIS))
-
-        def shard_leaf(v):
-            if getattr(v, "ndim", 0) == 0:
-                return jax.device_put(v, rep)
-            if v.shape[0] % ndev != 0:
-                raise EnforceNotMet(
-                    f"data-parallel feed batch {v.shape[0]} is not "
-                    f"divisible by the {ndev}-device data mesh")
-            return jax.device_put(v, data)
-        return {k: jax.tree.map(shard_leaf, v) for k, v in feeds.items()}
-
     def _ensure_resident(self, state, runner, fast):
-        """Persistable state rides replicated on the SAME mesh as the
-        feeds — mixing single-device state with mesh-sharded feeds in
-        one jit is an error. Fast path: once the step has run, its
-        outputs are already replicated on the mesh, so re-putting every
-        leaf every step (the legacy behavior, one eager dispatch per
-        parameter per step) is pure overhead — leaves whose sharding is
-        provably equivalent to the target pass through untouched, and
-        the equivalence check memoizes on the sharding object (stable
-        across steps: executables reuse their output shardings)."""
+        """Persistable state rides on the SAME mesh as the feeds, placed
+        per the spec's per-name target sharding (replicated unless the
+        spec shards it) — mixing single-device state with mesh-sharded
+        feeds in one jit is an error. Fast path: once the step has run,
+        its outputs already carry their target shardings (the compiled
+        segments pin them with with_sharding_constraint), so re-putting
+        every leaf every step (the legacy behavior, one eager dispatch
+        per parameter per step) is pure overhead — a leaf whose
+        sharding is provably equivalent to ITS name's target passes
+        through untouched, spec-sharded leaves exactly like replicated
+        ones, and the equivalence check memoizes on the (name, sharding
+        object) pair (stable across steps: executables reuse their
+        output shardings)."""
         rep = runner.rep
+        targets = runner.targets
         ok = runner.ok_shardings
+        out = {}
+        for n, v in state.items():
+            tgt = targets.get(n, rep) if targets is not None else rep
 
-        def place_leaf(v):
-            if fast:
-                s = getattr(v, "sharding", None)
-                if s is not None:
-                    if ok.get(id(s)) is s:
-                        return v
-                    try:
-                        same = s == rep or s.is_equivalent_to(
-                            rep, getattr(v, "ndim", 0))
-                    except Exception:
-                        same = False
-                    if same:
-                        ok[id(s)] = s
-                        return v
-            return jax.device_put(v, rep)
+            def place_leaf(x, n=n, tgt=tgt):
+                if fast:
+                    s = getattr(x, "sharding", None)
+                    if s is not None:
+                        key = (n, id(s))
+                        if ok.get(key) is s:
+                            return x
+                        try:
+                            same = s == tgt or s.is_equivalent_to(
+                                tgt, getattr(x, "ndim", 0))
+                        except Exception:
+                            same = False
+                        if same:
+                            ok[key] = s
+                            return x
+                return jax.device_put(x, tgt)
 
-        return {k: jax.tree.map(place_leaf, v) for k, v in state.items()}
+            out[n] = jax.tree.map(place_leaf, v)
+        return out
 
     def train_from_dataset(self, program=None, dataset=None,
                            fetch_list=None, fetch_info=None,
@@ -976,7 +999,8 @@ class Executor:
     def _exec_op(self, op, env, key):
         return exec_op(op, env, key)
 
-    def _compile(self, program, state_names, feed_names, fetch_names):
+    def _compile(self, program, state_names, feed_names, fetch_names,
+                 spec=None):
         """Partition the block into maximal device runs, each jitted as
         ONE XLA computation (the whole block, in the common case), with
         host segments (attrs['_host']: RPC send/recv, py_func-style
@@ -993,6 +1017,48 @@ class Executor:
         ops = list(blk.ops)
         constants = dict(getattr(program, "_constants", {}))
         state_set = set(state_names)
+
+        # ShardingSpec lowering: names the spec annotates (params and
+        # their @GRADs) are pinned with with_sharding_constraint inside
+        # every jitted segment — the pjit path (parallel/_compat.py;
+        # the jax pin has no shard_map), so GSPMD partitions the fused
+        # step exactly per the program-level annotations instead of
+        # guessing from inputs alone. Lookup is memoized per name;
+        # names the spec says nothing about are left to the
+        # partitioner (the pure-DP default spec pins nothing, keeping
+        # that lowering bit-identical to the pre-spec executor).
+        c_memo = {}
+
+        def _target(n, state_default=False):
+            """Constraint target for name ``n``: the spec's explicit
+            entry (params and their @GRADs), or — with
+            ``state_default`` — the replicated default for UNSPEC'D
+            state names. Segment OUTPUTS pin every state name: left
+            free, GSPMD may pick a sharded layout for an unannotated
+            param (observed: P('model') chosen for a replicated-target
+            leaf), which both breaks the "replicated unless spec'd"
+            state contract and defeats the residency fast path into a
+            re-put per leaf per step."""
+            if spec is None:
+                return None
+            key = (n, state_default)
+            t = c_memo.get(key, _ABSENT)
+            if t is _ABSENT:
+                t = spec.constraint_for(n)
+                if t is None and state_default and n in state_set:
+                    t = spec.param_sharding(n)
+                c_memo[key] = t
+            return t
+
+        def _pin(env, state_default=False):
+            if spec is None:
+                return env
+            from paddle_tpu.parallel._compat import sharding_constraint
+            for n in list(env):
+                t = _target(n, state_default)
+                if t is not None:
+                    env[n] = sharding_constraint(env[n], spec.mesh, t)
+            return env
 
         # a host op BEFORE the autodiff marker splits the differentiated
         # prefix across segments, so value_and_grad cannot see through it
@@ -1076,6 +1142,7 @@ class Executor:
                 env = dict(constants)
                 env.update(rest)
                 env.update(donated)
+                env = _pin(env)
                 if ad is None:
                     env = interpret(env, lo, hi, base_key, step_idx)
                 else:
@@ -1096,10 +1163,22 @@ class Executor:
                         fwd, has_aux=True)(params)
                     env = env2
                     for n in param_names:
-                        env[n + "@GRAD"] = grads[n]
+                        g = grads[n]
+                        t = _target(n + "@GRAD")
+                        if t is not None:
+                            # pin the gradient to its param's placement
+                            # BEFORE the update ops consume it: the
+                            # gradient collective then reduces the
+                            # shard-local buffers where the sharded
+                            # update needs them
+                            from paddle_tpu.parallel._compat import \
+                                sharding_constraint
+                            g = sharding_constraint(g, spec.mesh, t)
+                        env[n + "@GRAD"] = g
                     env = interpret(env, ad + 1, hi, base_key, step_idx)
                 res = {k: v for k, v in env.items()
                        if k not in constants}
+                res = _pin(res, state_default=True)
                 if check:
                     # FLAGS_check_nan_inf: one fused isfinite reduction
                     # over every tensor this segment writes — a single

@@ -70,6 +70,33 @@ class TestBenchModes:
         assert len(row["pair_ratios"]) == 2
         assert all(r > 0 for r in row["pair_ratios"])
 
+    def test_shard_mode_emits_per_topology_rows(self):
+        """`bench.py shard` must sweep every topology (1-device tiny
+        config here: each collapses to a 1x1 mesh but the whole
+        spec->pjit->compile->measure path runs) and emit one JSON line
+        per topology carrying ms/step, MFU, and comm bytes — so the
+        mode can't rot between MULTICHIP runs."""
+        lines = _run_mode("shard", extra_env={
+            "BENCH_SHARD_STEPS": "2",
+            "BENCH_SHARD_LAYERS": "2",
+            "BENCH_SHARD_HIDDEN": "32",
+            "BENCH_SHARD_FFN": "64",
+            "BENCH_SHARD_SEQ": "16",
+            "BENCH_SHARD_VOCAB": "64",
+            "BENCH_SHARD_HEADS": "2",
+            "BENCH_SHARD_MICRO": "2",
+            "BENCH_SHARD_BATCH": "4",
+        })
+        by = {ln["metric"]: ln for ln in lines}
+        for topo in ("dp", "modelxdata", "pipexdata"):
+            row = by.get(f"shard_{topo}_step_ms")
+            assert row is not None, by.keys()
+            assert row["value"] > 0 and row["unit"] == "ms"
+            assert row["mfu"] > 0
+            assert "comm_bytes_per_step" in row
+            assert row["layout"]["n_devices"] == 1
+            assert len(row["windows_ms_per_step"]) >= 2
+
     def test_ckpt_mode_emits_save_restore_and_verify_ratio(self):
         """`bench.py ckpt` must time save/restore on a real
         CheckpointManager and A/B digest verification on interleaved

@@ -252,6 +252,124 @@ def test_1f1b_matches_gpipe_on_dp_pp_mesh():
                                        rtol=2e-4, atol=2e-5)
 
 
+class TestOverlapGradReduce:
+    """Collective/compute overlap A/B: the in-scan per-bucket data-axes
+    gradient reduction (overlap_grad_reduce=True) is the SAME math as
+    the epilogue reduction — a pure scheduling change — so on/off must
+    agree to float tolerance, on flat DP x PP and hierarchical
+    DCN x DP x PP meshes."""
+
+    def _train(self, mesh, overlap, steps=8, n_micro=2, d=8, B=8,
+               seed=3):
+        keys = jax.random.split(jax.random.PRNGKey(0), 4)
+        params = {
+            "embed": {"w": jax.random.normal(keys[0], (4, d)) * 0.3},
+            "stages": pl.stack_stage_params(
+                [_mk_stage(k, d) for k in keys[1:-1]]),
+            "head": {"w": jax.random.normal(keys[-1], (d, 1)) * 0.3},
+        }
+        mod = pl.PipelineModule(mesh, lambda ep, x: x @ ep["w"],
+                                _stage_fn,
+                                lambda hp, a, y: jnp.mean(
+                                    (a @ hp["w"] - y) ** 2),
+                                n_micro)
+        init_fn, step = mod.make_train_step(
+            SGDOptimizer(0.1), schedule="1f1b",
+            overlap_grad_reduce=overlap)
+        p, o = init_fn(params)
+        rng = np.random.RandomState(seed)
+        xb = jnp.asarray(rng.randn(B, 4).astype(np.float32))
+        yb = jnp.asarray(rng.randn(B, 1).astype(np.float32))
+        losses = []
+        for _ in range(steps):
+            l, p, o = step(p, o, xb, yb)
+            losses.append(float(l))
+        return losses, p
+
+    def _assert_parity(self, mesh):
+        on_l, on_p = self._train(mesh, overlap=True)
+        off_l, off_p = self._train(mesh, overlap=False)
+        np.testing.assert_allclose(on_l, off_l, rtol=2e-4, atol=1e-6)
+        for a, b in zip(jax.tree.leaves(on_p), jax.tree.leaves(off_p)):
+            np.testing.assert_allclose(np.asarray(jax.device_get(a)),
+                                       np.asarray(jax.device_get(b)),
+                                       rtol=2e-3, atol=2e-5)
+
+    def test_overlap_parity_dp_x_pp(self):
+        mesh = make_mesh(MeshConfig(data=2, model=1, pipe=2, seq=1,
+                                    axis_order=("data", "pipe",
+                                                "model", "seq")))
+        self._assert_parity(mesh)
+
+    def test_overlap_parity_hierarchical_dcn(self):
+        """The reduction spans ("dcn_data", "data") on a hybrid mesh —
+        mesh.py's hierarchical allreduce — and still matches."""
+        mesh = make_mesh(MeshConfig(data=2, model=1, pipe=2, seq=1,
+                                    dcn_data=2,
+                                    axis_order=("data", "pipe",
+                                                "model", "seq")))
+        assert "dcn_data" in mesh.shape
+        self._assert_parity(mesh)
+
+    def test_flag_is_the_default_lever(self):
+        """overlap_grad_reduce=None reads FLAGS_overlap_grad_reduce."""
+        import paddle_tpu as pt
+        mesh = make_mesh(MeshConfig(data=2, model=1, pipe=2, seq=1,
+                                    axis_order=("data", "pipe",
+                                                "model", "seq")))
+        off_l, _ = self._train(mesh, overlap=False)
+        pt.set_flags({"overlap_grad_reduce": True})
+        try:
+            flag_l, _ = self._train(mesh, overlap=None)
+        finally:
+            pt.set_flags({"overlap_grad_reduce": False})
+        np.testing.assert_allclose(off_l, flag_l, rtol=2e-4, atol=1e-6)
+
+
+def test_1f1b_loss_trajectory_matches_pipeline_apply_reference():
+    """Acceptance pin: the fused 1F1B scan follows the per-stage
+    pipeline_apply (GPipe autodiff) reference's loss TRAJECTORY — many
+    optimizer steps, not just one — on the 8-device harness
+    (DP x PP uses all 8 devices)."""
+    B, n_stages, n_micro, d, steps = 16, 4, 4, 8, 25
+    mesh = make_mesh(MeshConfig(data=2, model=1, pipe=n_stages, seq=1,
+                                axis_order=("data", "pipe", "model",
+                                            "seq")))
+    assert mesh.size == 8
+
+    def build():
+        keys = jax.random.split(jax.random.PRNGKey(0), n_stages + 2)
+        return {
+            "embed": {"w": jax.random.normal(keys[0], (4, d)) * 0.3},
+            "stages": pl.stack_stage_params(
+                [_mk_stage(k, d) for k in keys[1:-1]]),
+            "head": {"w": jax.random.normal(keys[-1], (d, 1)) * 0.3},
+        }
+
+    rng = np.random.RandomState(7)
+    xb = jnp.asarray(rng.randn(B, 4).astype(np.float32))
+    yb = jnp.asarray((xb[:, :1] * 0.8 + xb[:, 1:2] * 0.3))
+
+    trajs = {}
+    for sched in ("gpipe", "1f1b"):
+        mod = pl.PipelineModule(mesh, lambda ep, x: x @ ep["w"],
+                                _stage_fn,
+                                lambda hp, a, y: jnp.mean(
+                                    (a @ hp["w"] - y) ** 2),
+                                n_micro)
+        init_fn, step = mod.make_train_step(SGDOptimizer(0.15),
+                                            schedule=sched)
+        p, o = init_fn(build())
+        losses = []
+        for _ in range(steps):
+            l, p, o = step(p, o, xb, yb)
+            losses.append(float(l))
+        trajs[sched] = losses
+    assert trajs["1f1b"][-1] < trajs["1f1b"][0] * 0.6
+    np.testing.assert_allclose(trajs["gpipe"], trajs["1f1b"],
+                               rtol=2e-3, atol=1e-6)
+
+
 def test_unknown_schedule_raises():
     mod, _ = _mod_and_params()
     with pytest.raises(ValueError, match="unknown pipeline schedule"):
