@@ -17,6 +17,8 @@ into the executable's donated input layout.
 import hashlib
 import json
 import os
+import threading
+import zlib
 
 import numpy as np
 
@@ -26,7 +28,7 @@ from paddle_tpu.static.executor import Executor, Scope, exec_op
 from paddle_tpu.static import io as static_io
 
 __all__ = ["Config", "Predictor", "create_predictor", "ZeroCopyTensor",
-           "export_aot"]
+           "export_aot", "verify_aot_dir", "AOTIntegrityError"]
 
 AOT_DIR = "__aot__"
 AOT_INDEX = "index.json"
@@ -116,6 +118,80 @@ def _sig_key(sig):
     return hashlib.sha256(json.dumps(sig).encode()).hexdigest()[:16]
 
 
+class AOTIntegrityError(RuntimeError):
+    """An AOT artifact failed its integrity manifest (CRC/size drift or
+    a missing file): positive evidence of a torn or bit-rotted export,
+    named precisely — distinct from the silent degrade-to-retrace path
+    taken for wrong-platform/wrong-version artifacts."""
+
+
+def _file_integrity(path):
+    """{"crc32", "nbytes"} of a file's byte image (the io_checkpoint
+    idiom, applied to opaque artifact files)."""
+    crc = 0
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            n += len(chunk)
+    return {"crc32": crc & 0xFFFFFFFF, "nbytes": n}
+
+
+def _verify_artifact(path, expect):
+    """Verify one artifact file against its manifest record; raises
+    :class:`AOTIntegrityError` naming the file and the first mismatch."""
+    name = os.path.basename(path)
+    try:
+        got = _file_integrity(path)
+    except FileNotFoundError:
+        raise AOTIntegrityError(
+            f"AOT artifact {name!r} is missing but listed in the "
+            f"integrity manifest — torn export; re-run export_aot")
+    if got["nbytes"] != expect["nbytes"]:
+        raise AOTIntegrityError(
+            f"AOT artifact {name!r} failed integrity: size "
+            f"{got['nbytes']} != manifest {expect['nbytes']} — torn "
+            f"export or concurrent rewrite; re-run export_aot")
+    if got["crc32"] != expect["crc32"]:
+        raise AOTIntegrityError(
+            f"AOT artifact {name!r} failed integrity: CRC32 "
+            f"{got['crc32']:#010x} != manifest "
+            f"{expect['crc32']:#010x} — bit rot or torn export; "
+            f"re-run export_aot")
+
+
+def verify_aot_dir(model_dir):
+    """Verify every AOT artifact under ``<model_dir>/__aot__`` against
+    the index's integrity manifest. Returns the number of files
+    verified (0 when there is no AOT index, or for legacy indexes
+    without integrity records — nothing to vouch for); raises
+    :class:`AOTIntegrityError` on the first bad file. The serving
+    server runs this at warm boot so corruption fails at load, not
+    mid-traffic."""
+    aot_dir = os.path.join(model_dir or "", AOT_DIR)
+    index_path = os.path.join(aot_dir, AOT_INDEX)
+    if not os.path.exists(index_path):
+        return 0
+    try:
+        with open(index_path) as f:
+            entries = json.load(f)
+    except (OSError, ValueError) as e:
+        raise AOTIntegrityError(
+            f"AOT index {index_path!r} is unreadable ({e}); re-run "
+            f"export_aot")
+    verified = 0
+    for e in entries if isinstance(entries, list) else []:
+        if not isinstance(e, dict):
+            continue
+        for name, rec in sorted(e.get("integrity", {}).items()):
+            _verify_artifact(os.path.join(aot_dir, name), rec)
+            verified += 1
+    return verified
+
+
 def export_aot(dirname, program, feed_names, fetch_names, scope,
                shape_buckets, platforms=("cpu", "tpu")):
     """Compile the frozen program per shape bucket and serialize BOTH
@@ -189,6 +265,13 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
         with open(os.path.join(out_dir, f"{h}.shlo"), "wb") as f:
             f.write(exported.serialize())
         entry["shlo"] = f"{h}.shlo"
+        # integrity manifest (the PR-5 checkpoint idiom, for opaque
+        # artifact files): CRC32 + size per artifact, verified at
+        # Predictor/server load so a torn export names its first bad
+        # file instead of surfacing as a raw deserialization traceback
+        entry["integrity"] = {
+            name: _file_integrity(os.path.join(out_dir, name))
+            for name in (entry["xla"], entry["shlo"])}
         entries.append(entry)
     index_path = os.path.join(out_dir, AOT_INDEX)
     existing = []
@@ -305,10 +388,22 @@ class Predictor:
     unpickles internally — load ``.xla`` artifacts only from model
     directories you trust as much as the code itself (our wrapper
     container is structural, the pickle is jax's own layer).
+
+    Thread safety: ``run(feed=...)`` is serialized by a per-predictor
+    lock — concurrent callers on ONE predictor get correct (if
+    convoyed) results instead of corrupting each other's
+    ``_feeds``/``_outputs`` handle state. The SCALING contract is still
+    ``clone()``-per-thread (shared weights/executables, private handle
+    state, no lock contention); the zero-copy handle flow
+    (``get_input_handle`` → ``copy_from_cpu`` → ``run()`` →
+    ``copy_to_cpu``) spans multiple calls and is only safe on a
+    predictor the thread owns — use clones there. For real QPS use
+    ``paddle_tpu.serving.InferenceServer`` (docs/SERVING.md).
     """
 
     def __init__(self, config):
         self.config = config
+        self._run_lock = threading.Lock()
         self._scope = Scope()
         self._exe = Executor(CPUPlace())
         prog, feeds, fetches = static_io.load_inference_model(
@@ -390,6 +485,16 @@ class Predictor:
                                for v in raw)
         except Exception:
             params = None
+        # integrity gate BEFORE any deserialization attempt: CRC/size
+        # drift is positive corruption evidence and raises precisely
+        # (AOTIntegrityError names the file) — it must NOT be swallowed
+        # into the degrade-to-retrace path reserved for wrong
+        # platform/version artifacts
+        integ = entry.get("integrity", {})
+        for name in (entry.get("xla"), entry.get("shlo")):
+            if name and name in integ:
+                _verify_artifact(os.path.join(aot_dir, name),
+                                 integ[name])
         if (params is not None and entry.get("xla")
                 and entry["platform"] == jax.devices()[0].platform
                 and entry["jax_version"] == jax.__version__):
@@ -443,7 +548,8 @@ class Predictor:
         c.__dict__.update(self.__dict__)
         c._feeds = {}
         c._outputs = {}
-        return c
+        c._run_lock = threading.Lock()   # per-clone: clones must not
+        return c                         # convoy on the parent's lock
 
     # -- introspection (AnalysisPredictor::GetInputNames parity) -----------
     def get_input_names(self):
@@ -462,24 +568,31 @@ class Predictor:
     def run(self, feed=None):
         """feed: optional {name: array} (else use zero-copy handles).
         Returns outputs in fetch order. Compilation is cached per input
-        shape signature by the Executor."""
-        if feed is not None:
-            self._feeds = {k: np.asarray(v) for k, v in feed.items()}
-        missing = [n for n in self._feed_names if n not in self._feeds]
-        if missing:
-            raise KeyError(f"missing inputs: {missing}")
-        aot = self._aot_fn(self._feeds)
-        if aot is not None:
-            fn, params = aot
-            outs = fn(params,
-                      tuple(self._feeds[n] for n in self._feed_names))
-            outs = [np.asarray(o) for o in outs]
-        else:
-            outs = self._exe.run(self._program, feed=dict(self._feeds),
-                                 fetch_list=list(self._fetch_names),
-                                 scope=self._scope)
-        self._outputs = dict(zip(self._fetch_names, outs))
-        return outs
+        shape signature by the Executor. Serialized by the predictor's
+        lock: concurrent ``run(feed=...)`` calls on one predictor are
+        safe (see the class docstring for the clone-per-thread scaling
+        contract)."""
+        with self._run_lock:
+            if feed is not None:
+                self._feeds = {k: np.asarray(v) for k, v in feed.items()}
+            missing = [n for n in self._feed_names
+                       if n not in self._feeds]
+            if missing:
+                raise KeyError(f"missing inputs: {missing}")
+            aot = self._aot_fn(self._feeds)
+            if aot is not None:
+                fn, params = aot
+                outs = fn(params,
+                          tuple(self._feeds[n]
+                                for n in self._feed_names))
+                outs = [np.asarray(o) for o in outs]
+            else:
+                outs = self._exe.run(self._program,
+                                     feed=dict(self._feeds),
+                                     fetch_list=list(self._fetch_names),
+                                     scope=self._scope)
+            self._outputs = dict(zip(self._fetch_names, outs))
+            return outs
 
 
 def create_predictor(config):

@@ -1,0 +1,30 @@
+"""High-QPS serving subsystem (docs/SERVING.md).
+
+The reference devotes a whole side stack to serving
+(``paddle/fluid/inference/``, AnalysisPredictor and its multi-thread
+clone contract); this package is its TPU-native shape: continuous
+micro-batching over per-bucket ahead-of-time compiled XLA executables,
+multi-replica dispatch from one shared queue, warm-boot compile
+preloading, and per-request SLO telemetry riding ``paddle_tpu.monitor``.
+
+Layering: ``scheduler`` (queueing/batching — numpy + stdlib only),
+``replica`` (device-pinned execution), ``server`` (front-end). The
+single-request ``paddle_tpu.inference.Predictor`` remains the simple
+embedded path; this package is the "millions of users" one.
+"""
+
+from paddle_tpu.serving.scheduler import (  # noqa: F401
+    MicroBatch, MicroBatchScheduler, PendingResult, QueueFullError,
+    ServerClosedError, bucket_ladder, pick_bucket,
+)
+from paddle_tpu.serving.replica import Replica, ReplicaPool  # noqa: F401
+from paddle_tpu.serving.server import (  # noqa: F401
+    InferenceServer, ServingConfig,
+)
+
+__all__ = [
+    "InferenceServer", "ServingConfig", "MicroBatchScheduler",
+    "MicroBatch", "PendingResult", "Replica", "ReplicaPool",
+    "QueueFullError", "ServerClosedError", "bucket_ladder",
+    "pick_bucket",
+]

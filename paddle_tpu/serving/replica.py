@@ -1,0 +1,202 @@
+"""Multi-replica dispatch: the execution half of the serving subsystem.
+
+Each :class:`Replica` owns a device, a device-resident copy of the
+frozen program's params, and one ahead-of-time compiled XLA executable
+per bucket of the ladder — compiled at **warm boot** (pool
+construction), before the server accepts traffic, so the first real
+request never pays a trace or an XLA compile. When the PR-2 persistent
+compilation cache is armed (``PADDLE_TPU_CACHE_DIR``, wired at
+``paddle_tpu.core`` import), warm boot itself is a disk read on every
+boot after the first.
+
+Replicas are fed from ONE shared batch queue (the scheduler's dispatch
+target): a slow replica simply takes fewer batches, it cannot convoy
+the others — the reference's multi-stream serving shape
+(inference/api: one AnalysisPredictor clone per stream), with streams
+replaced by device-pinned executables.
+
+Device pinning uses sharding-annotated avals
+(``jax.ShapeDtypeStruct(..., sharding=SingleDeviceSharding(dev))``), so
+each replica's executables are compiled FOR its device and feeds are
+``device_put`` onto it at dispatch; replicas that share a device (more
+replicas than devices) share one executable map and one param copy —
+the extra replicas then only add pipelining across the Python/dispatch
+gap, which is exactly what they are for on a single-chip host.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.monitor.registry import gauge, histogram
+
+__all__ = ["Replica", "ReplicaPool"]
+
+_m_replicas = gauge(
+    "serving_replicas",
+    "Replica workers serving the shared batch queue")
+_m_exec_ms = histogram(
+    "serving_batch_execute_ms",
+    "Wall ms a replica spent executing one micro-batch (device_put + "
+    "compiled call + host fetch)")
+
+#: batch-queue sentinel, one per replica at shutdown
+_STOP = object()
+
+
+class Replica:
+    """One worker: a device, resident params, per-bucket executables,
+    and a thread draining the shared batch queue."""
+
+    def __init__(self, index, device, params, executables, feed_names,
+                 batch_queue):
+        self.index = index
+        self.device = device
+        self._params = params
+        self._executables = executables
+        self._feed_names = tuple(feed_names)
+        self._q = batch_queue
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serving-replica-{index}")
+        self.batches_run = 0
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def join(self, timeout=None):
+        self._thread.join(timeout)
+
+    def is_alive(self):
+        return self._thread.is_alive()
+
+    def _loop(self):
+        import time
+        while True:
+            mb = self._q.get()
+            if mb is _STOP:
+                break
+            t0 = time.perf_counter()
+            try:
+                outs = self.run_batch(mb.bucket, mb.feeds)
+            except Exception as e:
+                # deliver the failure to the batch's requests and keep
+                # serving: one poisoned batch must not kill the replica
+                mb.fail(e)
+                continue
+            try:
+                mb.complete(outs)
+            except Exception as e:
+                # complete() itself failed (e.g. an executable returned
+                # a wrong leading dim): sweep the undelivered requests
+                # with the error (first-wins delivery) and keep serving
+                mb.fail(e)
+                continue
+            self.batches_run += 1
+            _m_exec_ms.observe((time.perf_counter() - t0) * 1e3)
+
+    def run_batch(self, bucket, feeds):
+        """Execute one padded batch dict on this replica's executable
+        for ``bucket``; returns host arrays in fetch order."""
+        import jax
+        exe = self._executables.get(bucket)
+        enforce(exe is not None,
+                f"replica {self.index} has no executable for bucket "
+                f"{bucket} (ladder {sorted(self._executables)})")
+        fd = tuple(jax.device_put(feeds[n], self.device)
+                   for n in self._feed_names)
+        outs = exe(self._params, fd)
+        return [np.asarray(o) for o in outs]
+
+
+class ReplicaPool:
+    """N replicas over the visible devices (round-robin), all draining
+    one shared bounded batch queue. Construction IS the warm boot:
+    every (device, bucket) executable compiles before this returns.
+
+    ``pure_fn`` is the jittable ``fn(params_tuple, feeds_tuple) ->
+    outputs_tuple`` from ``inference._build_pure_fn``; ``params_np``
+    the state arrays in its order; ``sample_specs`` {feed name:
+    (sample_shape, dtype)} fixing every non-batch dim."""
+
+    def __init__(self, pure_fn, params_np, feed_names, sample_specs,
+                 ladder, n_replicas=1, devices=None, queue_depth=None):
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        enforce(n_replicas >= 1, f"n_replicas < 1 ({n_replicas})")
+        self._feed_names = tuple(feed_names)
+        self.ladder = tuple(ladder)
+        devices = list(devices if devices is not None else jax.devices())
+        enforce(devices, "no devices visible for serving")
+        if queue_depth is None:
+            # deep enough that the batcher never stalls behind an idle
+            # replica, shallow enough that batches don't age in queue
+            queue_depth = max(2 * n_replicas, 2)
+        self.batch_queue = queue.Queue(maxsize=queue_depth)
+        jitted = jax.jit(pure_fn)
+        self._by_device = {}        # device -> (params, {bucket: exe})
+        for dev in {devices[i % len(devices)]: None
+                    for i in range(n_replicas)}:
+            sh = SingleDeviceSharding(dev)
+            params = tuple(jax.device_put(np.asarray(p), dev)
+                           for p in params_np)
+            param_sds = tuple(
+                jax.ShapeDtypeStruct(p.shape, p.dtype, sharding=sh)
+                for p in params)
+            exes = {}
+            for bucket in self.ladder:
+                feed_sds = tuple(
+                    jax.ShapeDtypeStruct((bucket,) + tuple(shape),
+                                         np.dtype(dtype), sharding=sh)
+                    for shape, dtype in
+                    (sample_specs[n] for n in self._feed_names))
+                exes[bucket] = jitted.lower(param_sds,
+                                            feed_sds).compile()
+            self._by_device[dev] = (params, exes)
+        self._stopped = False
+        self.replicas = []
+        for i in range(n_replicas):
+            dev = devices[i % len(devices)]
+            params, exes = self._by_device[dev]
+            self.replicas.append(Replica(
+                i, dev, params, exes, self._feed_names,
+                self.batch_queue))
+        for r in self.replicas:
+            r.start()
+        _m_replicas.set(len(self.replicas))
+
+    def dispatch(self, micro_batch):
+        """The scheduler's dispatch target: blocking put, so a saturated
+        pool backpressures the batcher (and through it the bounded
+        request queue) instead of queueing unboundedly."""
+        self.batch_queue.put(micro_batch)
+
+    def executables(self, device=None):
+        """{bucket: executable} for ``device`` (default: first replica's
+        device) — warm-boot introspection for tests and doctors."""
+        if device is None:
+            device = self.replicas[0].device
+        return dict(self._by_device[device][1])
+
+    def close(self, timeout=None):
+        """Stop every replica after the in-queue batches drain.
+        Returns True when every replica has exited; with a ``timeout``,
+        False means some replica is still finishing (its batches will
+        complete — call again). The gauge only zeroes on a TRUE stop.
+        Idempotent: sentinels are enqueued once (a repeat close on the
+        bounded queue must not block behind its own earlier
+        sentinels)."""
+        if not self._stopped:
+            self._stopped = True
+            for _ in self.replicas:
+                self.batch_queue.put(_STOP)
+        for r in self.replicas:
+            r.join(timeout)
+        if any(r.is_alive() for r in self.replicas):
+            return False
+        _m_replicas.set(0)
+        return True

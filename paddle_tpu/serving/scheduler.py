@@ -1,0 +1,406 @@
+"""Continuous micro-batching scheduler: the queueing half of the
+serving subsystem (docs/SERVING.md).
+
+Concurrent requests are coalesced into padded-bucket micro-batches over
+a power-of-two bucket ladder: a request of 3 rows rides the 4-bucket,
+the pad rows are zeros, and the waste is accounted
+(``serving_padded_waste_total``) rather than hidden. The bucket ladder
+exists because each bucket has its OWN ahead-of-time compiled XLA
+executable (replica.py) — serving an arbitrary batch size would retrace
+and recompile per request shape, which is exactly what a latency SLO
+cannot afford.
+
+Scheduling contract, in order of priority:
+
+1. **A lone request is never starved.** The batcher waits at most
+   ``max_wait_ms`` past the FIRST request of a forming batch; when the
+   deadline fires the batch dispatches at whatever fill it reached.
+2. **A full batch never waits.** As soon as the forming batch reaches
+   the top bucket it dispatches immediately; a request that would
+   overflow the bucket carries over to start the next batch.
+3. **Backpressure is typed.** The request queue is bounded
+   (``max_queue``); ``submit`` on a full queue raises
+   :class:`QueueFullError` (counted ``outcome="rejected"``) instead of
+   stretching the tail latency of every queued request behind it.
+4. **Shutdown drains.** ``close()`` stops admission, then processes
+   every already-accepted request before the batcher exits — an
+   accepted request always gets a result or an error, never silence.
+
+The scheduler is executor-agnostic: it hands formed
+:class:`MicroBatch` objects to a ``dispatch`` callable (the server
+wires this to the shared replica batch queue; tests wire a fake) and
+the batch completes via ``MicroBatch.complete``/``fail`` from whatever
+thread ran it. That keeps this module import-light (numpy + stdlib) and
+unit-testable without jax.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.monitor.registry import counter, gauge, histogram
+
+__all__ = [
+    "QueueFullError", "ServerClosedError", "PendingResult", "MicroBatch",
+    "MicroBatchScheduler", "bucket_ladder", "pick_bucket",
+]
+
+
+class QueueFullError(RuntimeError):
+    """``submit`` refused: the bounded request queue is full. The
+    caller should shed load or retry after backoff — queueing deeper
+    would only move the failure into every request's tail latency."""
+
+
+class ServerClosedError(RuntimeError):
+    """``submit`` refused: the server is shutting down (or never
+    started). Already-accepted requests still drain to completion."""
+
+
+_m_requests = counter(
+    "serving_requests_total",
+    "Serving requests by outcome: ok (result delivered), rejected "
+    "(typed backpressure at submit), error (replica/scheduler failure "
+    "delivered as an exception)", labels=("outcome",))
+_m_latency = histogram(
+    "serving_request_latency_ms",
+    "End-to-end serving request latency: submit accept -> result "
+    "ready (queue wait + batching wait + execute); p50/p99 derive "
+    "from the buckets")
+_m_queue_depth = gauge(
+    "serving_queue_depth",
+    "Requests currently waiting in the serving request queue "
+    "(admitted, not yet batched)")
+_m_fill = histogram(
+    "serving_batch_fill_ratio",
+    "Real rows / bucket size per dispatched micro-batch (1.0 = no "
+    "padding; persistently low = lower the bucket ladder or raise "
+    "max_wait_ms)",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+_m_padded = counter(
+    "serving_padded_waste_total",
+    "Pad rows dispatched to round micro-batches up to their bucket "
+    "(compute spent on zeros)")
+_m_batches = counter(
+    "serving_batches_total",
+    "Micro-batches dispatched to the replica pool")
+
+
+def bucket_ladder(max_batch):
+    """The power-of-two bucket ladder ``(1, 2, 4, ..., max_batch)``.
+    ``max_batch`` must itself be a power of two — every ladder rung is
+    a compiled executable, and a non-power top rung would make the
+    ladder's coverage/waste story shape-dependent."""
+    enforce(isinstance(max_batch, int) and max_batch >= 1,
+            f"max_batch must be a positive int, got {max_batch!r}")
+    enforce(max_batch & (max_batch - 1) == 0,
+            f"max_batch must be a power of two (one AOT executable per "
+            f"ladder rung), got {max_batch}")
+    out, b = [], 1
+    while b <= max_batch:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def pick_bucket(rows, ladder):
+    """Smallest ladder bucket holding ``rows`` rows."""
+    enforce(rows >= 1, f"empty request (rows={rows})")
+    enforce(rows <= ladder[-1],
+            f"request of {rows} rows exceeds the top bucket "
+            f"{ladder[-1]}; raise max_batch or split the request")
+    for b in ladder:
+        if rows <= b:
+            return b
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+class PendingResult:
+    """Future-like handle for one submitted request. ``result()``
+    blocks until the micro-batch carrying the request completes and
+    returns the outputs in fetch order (each with this request's
+    leading rows), or raises the delivered error."""
+
+    __slots__ = ("_event", "_outs", "_error", "t_done")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._outs = None
+        self._error = None
+        self.t_done = None          # perf_counter at completion
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"serving request not completed within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._outs
+
+    def _deliver(self, outs=None, error=None):
+        """First delivery wins: a failure-path sweep (``MicroBatch.
+        fail`` after a partial ``complete``) must not overwrite a
+        result a caller may already be reading. Returns whether this
+        call delivered."""
+        if self._event.is_set():
+            return False
+        self._outs = outs
+        self._error = error
+        self.t_done = time.perf_counter()
+        self._event.set()
+        return True
+
+
+class _Request:
+    __slots__ = ("feeds", "rows", "t_enqueue", "pending")
+
+    def __init__(self, feeds, rows):
+        self.feeds = feeds
+        self.rows = rows
+        self.t_enqueue = time.perf_counter()
+        self.pending = PendingResult()
+
+
+class MicroBatch:
+    """A formed batch: requests concatenated along dim 0 and
+    zero-padded up to ``bucket`` rows. ``feeds`` is the padded
+    {name: array} the executor runs; ``complete(outs)`` slices each
+    output back to per-request rows and delivers every pending result
+    (latency observed per request); ``fail(exc)`` delivers the
+    exception to every request instead."""
+
+    def __init__(self, requests, bucket, feed_names):
+        self.requests = list(requests)
+        self.bucket = int(bucket)
+        self.rows = sum(r.rows for r in self.requests)
+        enforce(self.rows <= self.bucket,
+                f"batch of {self.rows} rows formed for bucket "
+                f"{self.bucket}")
+        self.feed_names = tuple(feed_names)
+        self.feeds = {}
+        pad = self.bucket - self.rows
+        for n in self.feed_names:
+            parts = [r.feeds[n] for r in self.requests]
+            if pad:
+                parts.append(np.zeros((pad,) + parts[0].shape[1:],
+                                      dtype=parts[0].dtype))
+            # the exact-fit single-request alias is safe: request
+            # feeds are already PRIVATE copies (ownership taken at
+            # submit in _validate)
+            self.feeds[n] = (parts[0] if len(parts) == 1
+                             else np.concatenate(parts, axis=0))
+
+    def complete(self, outs):
+        """``outs``: sequence of arrays in fetch order, leading dim ==
+        bucket. Routes each request its own row slice."""
+        now = time.perf_counter()
+        outs = [np.asarray(o) for o in outs]
+        for o in outs:
+            enforce(o.shape[:1] == (self.bucket,),
+                    f"micro-batch output leading dim {o.shape[:1]} != "
+                    f"bucket {self.bucket}")
+        off = 0
+        for r in self.requests:
+            if r.pending._deliver(outs=[o[off:off + r.rows]
+                                        for o in outs]):
+                _m_requests.inc(outcome="ok")
+                _m_latency.observe((now - r.t_enqueue) * 1e3)
+            off += r.rows
+
+    def fail(self, exc):
+        """Deliver ``exc`` to every request not already delivered —
+        safe to call after a partial ``complete`` (first-wins), so an
+        executor failure can always sweep the stragglers."""
+        for r in self.requests:
+            if r.pending._deliver(error=exc):
+                _m_requests.inc(outcome="error")
+
+
+#: queue sentinel: admission is closed and everything before it has
+#: been admitted — the batcher drains up to here, then exits
+_STOP = object()
+
+
+class MicroBatchScheduler:
+    """The continuous batcher. ``dispatch(micro_batch)`` is called from
+    the batcher thread for every formed batch; it must arrange for
+    ``micro_batch.complete``/``fail`` to run eventually (inline is
+    fine). ``sample_specs``: optional {feed name: (sample_shape tuple,
+    np.dtype)} validated at submit so a malformed request fails ITSELF
+    with a precise error instead of poisoning a whole micro-batch."""
+
+    def __init__(self, dispatch, feed_names, max_batch=8,
+                 max_wait_ms=5.0, max_queue=256, sample_specs=None):
+        self._dispatch = dispatch
+        self._feed_names = tuple(feed_names)
+        self._ladder = bucket_ladder(max_batch)
+        self._max_bucket = self._ladder[-1]
+        enforce(max_wait_ms >= 0, f"max_wait_ms < 0 ({max_wait_ms})")
+        self._max_wait = max_wait_ms / 1e3
+        enforce(max_queue >= 1, f"max_queue < 1 ({max_queue})")
+        self._max_queue = max_queue
+        self._q = queue.Queue(maxsize=max_queue + 1)  # +1: _STOP always fits
+        self._specs = dict(sample_specs or {})
+        self._closed = False
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-batcher")
+        self._started = False
+
+    @property
+    def ladder(self):
+        return self._ladder
+
+    def start(self):
+        with self._lock:
+            if self._closed:
+                # a resurrected batcher would have no _STOP coming and
+                # the next close() would join it forever
+                raise ServerClosedError(
+                    "serving scheduler already closed")
+            if not self._started:
+                self._started = True
+                self._thread.start()
+        return self
+
+    # -- admission ---------------------------------------------------------
+    def _validate(self, feeds):
+        missing = [n for n in self._feed_names if n not in feeds]
+        enforce(not missing, f"request missing feeds: {missing}")
+        arrs = {n: np.asarray(feeds[n]) for n in self._feed_names}
+        rows = None
+        for n, a in arrs.items():
+            enforce(a.ndim >= 1,
+                    f"feed {n!r} must carry a leading batch dim")
+            if rows is None:
+                rows = int(a.shape[0])
+            else:
+                enforce(int(a.shape[0]) == rows,
+                        f"feed {n!r} rows {a.shape[0]} != {rows} (all "
+                        f"feeds of one request share the batch dim)")
+            spec = self._specs.get(n)
+            if spec is not None:
+                shape, dtype = spec
+                enforce(tuple(a.shape[1:]) == tuple(shape),
+                        f"feed {n!r} sample shape {tuple(a.shape[1:])} "
+                        f"!= served model's {tuple(shape)}")
+            else:
+                dtype = a.dtype
+            # the request takes OWNERSHIP here: submit is async, so
+            # aliasing the caller's buffer would let a post-submit
+            # overwrite change this request's answer in flight
+            # (astype/np.array both copy)
+            arrs[n] = (a.astype(dtype) if a.dtype != dtype
+                       else np.array(a))
+        # bucket-fit check runs through pick_bucket for the precise
+        # message; rows >= 1 enforced there too
+        pick_bucket(rows, self._ladder)
+        return arrs, rows
+
+    def submit(self, feeds):
+        """Admit one request ({feed name: array with leading batch
+        dim}); returns a :class:`PendingResult`. Raises
+        :class:`ServerClosedError` after ``close()``,
+        :class:`QueueFullError` on backpressure, ``EnforceNotMet`` on a
+        malformed request."""
+        arrs, rows = self._validate(feeds)
+        with self._lock:
+            if self._closed or not self._started:
+                raise ServerClosedError(
+                    "serving scheduler is closed" if self._closed
+                    else "serving scheduler not started")
+            if self._q.qsize() >= self._max_queue:
+                _m_requests.inc(outcome="rejected")
+                raise QueueFullError(
+                    f"serving queue full (max_queue={self._max_queue}); "
+                    f"shed load or retry after backoff")
+            req = _Request(arrs, rows)
+            self._q.put_nowait(req)
+        _m_queue_depth.set(self._q.qsize())
+        return req.pending
+
+    def close(self, timeout=None):
+        """Stop admission, drain every accepted request, join the
+        batcher. Returns True when the batcher has fully drained and
+        exited; with a ``timeout``, False means the join expired while
+        the drain is STILL RUNNING (accepted requests will complete —
+        call again, or wait on their PendingResults). Idempotent."""
+        with self._lock:
+            if not self._started:
+                self._closed = True
+                return True
+            already = self._closed
+            self._closed = True
+        if not already:
+            self._q.put(_STOP)      # maxsize has the +1 slot reserved
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    # -- the batching loop -------------------------------------------------
+    def _loop(self):
+        carry = None
+        while True:
+            if carry is not None:
+                first, carry = carry, None
+            else:
+                first = self._q.get()
+            if first is _STOP:
+                break
+            batch, rows = [first], first.rows
+            deadline = first.t_enqueue + self._max_wait
+            saw_stop = False
+            while rows < self._max_bucket:
+                remaining = deadline - time.perf_counter()
+                try:
+                    if remaining > 0:
+                        nxt = self._q.get(timeout=remaining)
+                    else:
+                        # past the deadline: absorb whatever is already
+                        # waiting (free fill), never wait for more
+                        nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    saw_stop = True
+                    break
+                if rows + nxt.rows > self._max_bucket:
+                    carry = nxt     # overflow starts the next batch
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            _m_queue_depth.set(self._q.qsize())
+            self._form_and_dispatch(batch, rows)
+            if saw_stop:
+                # FIFO: everything admitted precedes _STOP, and a carry
+                # cannot coexist with saw_stop in one pass — drained
+                break
+        _m_queue_depth.set(0)
+
+    def _form_and_dispatch(self, requests, rows):
+        try:
+            bucket = pick_bucket(rows, self._ladder)
+            mb = MicroBatch(requests, bucket, self._feed_names)
+        except Exception as e:
+            # batch FORMATION failed (e.g. two spec-less requests with
+            # incompatible trailing shapes hit np.concatenate): the
+            # riders get the error and the batcher survives — an
+            # exception here used to kill the thread, hanging every
+            # pending and future request while submit kept accepting
+            for r in requests:
+                if r.pending._deliver(error=e):
+                    _m_requests.inc(outcome="error")
+            return
+        _m_batches.inc()
+        _m_fill.observe(rows / bucket)
+        if bucket > rows:
+            _m_padded.inc(bucket - rows)
+        try:
+            self._dispatch(mb)
+        except Exception as e:      # dispatch itself failed: the batch
+            mb.fail(e)              # must still deliver, not hang

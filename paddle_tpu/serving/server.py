@@ -1,0 +1,224 @@
+"""InferenceServer: the serving front-end tying the pieces together.
+
+``InferenceServer(model_dir, ServingConfig(...))`` loads a
+``save_inference_model`` artifact, verifies any AOT artifacts' integrity
+manifest (a torn export fails loudly at boot, naming the first bad
+file — never mid-traffic), warm-boots one compiled executable per
+(replica device, bucket), and only then starts accepting requests:
+
+    server = InferenceServer(model_dir, ServingConfig(replicas=2))
+    outs = server.infer({"x": batch})          # blocking convenience
+    pending = server.submit({"x": batch})      # pipelined
+    outs = pending.result(timeout=5)
+    server.close()                             # drains, then stops
+
+Request contract: every feed carries a leading batch dim (1..max_batch
+rows); outputs come back in fetch order, sliced to the request's own
+rows. Telemetry rides the process registry (docs/OBSERVABILITY.md,
+``serving_*`` rows) and therefore the per-rank Prometheus exporter and
+``bench.py`` snapshots for free.
+"""
+
+import os
+
+import numpy as np
+
+from paddle_tpu.core.enforce import enforce
+from paddle_tpu.serving.replica import ReplicaPool
+from paddle_tpu.serving.scheduler import (
+    MicroBatchScheduler, ServerClosedError, bucket_ladder,
+)
+
+__all__ = ["ServingConfig", "InferenceServer"]
+
+
+class ServingConfig:
+    """Knobs for one server (docs/SERVING.md has the tuning guide).
+
+    - ``max_batch``: top of the power-of-two bucket ladder (one AOT
+      executable per rung per replica device).
+    - ``max_wait_ms``: batching deadline — the most latency a lone
+      request trades for fill.
+    - ``max_queue``: admission bound; beyond it ``submit`` raises
+      ``QueueFullError`` (typed backpressure).
+    - ``replicas``: worker count; devices are assigned round-robin
+      over ``devices`` (default: all visible).
+    - ``feed_specs``: optional {feed name: (sample_shape, dtype)}
+      override when the program declares dynamic non-batch dims.
+    - ``verify_aot``: verify the model dir's AOT integrity manifest at
+      boot (on by default; only skips work when no manifest exists).
+    """
+
+    def __init__(self, max_batch=8, max_wait_ms=5.0, max_queue=256,
+                 replicas=1, devices=None, feed_specs=None,
+                 verify_aot=True):
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.max_queue = max_queue
+        self.replicas = replicas
+        self.devices = devices
+        self.feed_specs = feed_specs
+        self.verify_aot = verify_aot
+
+
+def _infer_sample_specs(program, feed_names, overrides):
+    """{feed name: (sample shape, dtype)} from the program's feed var
+    declarations — dim 0 is the batch dim the scheduler owns; every
+    other dim must be static (or overridden) because each bucket
+    compiles ONE executable."""
+    blk = program.global_block()
+    out = {}
+    for n in feed_names:
+        if overrides and n in overrides:
+            shape, dtype = overrides[n]
+            out[n] = (tuple(int(d) for d in shape), np.dtype(dtype))
+            continue
+        v = blk.vars.get(n)
+        enforce(v is not None, f"feed {n!r} not declared in program")
+        shape = list(v.shape)
+        # dim 0 is ALWAYS the batch dim the scheduler owns — for
+        # append_batch_size=False declarations too (the request
+        # contract puts batch first regardless of how the var spelled
+        # its leading dim)
+        sample = shape[1:]
+        enforce(all(d >= 0 for d in sample),
+                f"feed {n!r} has dynamic non-batch dims {shape}; "
+                f"serving compiles fixed-shape bucket executables — "
+                f"pass ServingConfig(feed_specs={{{n!r}: (shape, "
+                f"dtype)}})")
+        out[n] = (tuple(int(d) for d in sample), np.dtype(v.dtype))
+    return out
+
+
+class InferenceServer:
+    """Continuous micro-batching server over a frozen inference model.
+
+    Construction performs the full warm boot (load + verify + compile
+    every bucket executable on every replica device + start workers);
+    when ``__init__`` returns the server is serving.
+    """
+
+    def __init__(self, model_dir, config=None):
+        from paddle_tpu import inference as inf
+        from paddle_tpu.core.place import CPUPlace
+        from paddle_tpu.static import io as static_io
+        from paddle_tpu.static.executor import Executor, Scope
+
+        self.config = config = config or ServingConfig()
+        self.model_dir = model_dir
+        self._scope = Scope()
+        exe = Executor(CPUPlace())
+        prog, feed_names, fetch_names = static_io.load_inference_model(
+            model_dir, exe, scope=self._scope)
+        if config.verify_aot:
+            # boot-time integrity gate: a torn/bit-rotted AOT export
+            # names its first bad file here, not as a mid-traffic
+            # deserialization traceback (legacy dirs without a
+            # manifest verify vacuously)
+            inf.verify_aot_dir(model_dir)
+        self._program = prog
+        self._feed_names = list(feed_names)
+        self._fetch_names = list(fetch_names)
+        self._sample_specs = _infer_sample_specs(
+            prog, self._feed_names, config.feed_specs)
+        pure_fn, state_names = inf._build_pure_fn(
+            prog, self._feed_names, self._fetch_names)
+        raw = [self._scope.find_var(n) for n in state_names]
+        missing = [n for n, v in zip(state_names, raw) if v is None]
+        enforce(not missing,
+                f"scope missing persistables for serving: {missing[:5]}")
+        params_np = [np.asarray(v) for v in raw]
+        ladder = bucket_ladder(config.max_batch)
+        # the scheduler validates every config knob (max_batch ladder,
+        # max_wait_ms, max_queue) — construct it BEFORE the expensive
+        # warm boot so a bad knob fails in microseconds instead of
+        # after compiling (and leaking) every bucket executable; the
+        # dispatch is late-bound to the pool built below
+        self.scheduler = MicroBatchScheduler(
+            dispatch=lambda mb: self.pool.dispatch(mb),
+            feed_names=self._feed_names,
+            max_batch=config.max_batch,
+            max_wait_ms=config.max_wait_ms,
+            max_queue=config.max_queue,
+            sample_specs=self._sample_specs)
+        self._check_fetch_contract(pure_fn, params_np, ladder)
+        self.pool = ReplicaPool(
+            pure_fn, params_np, self._feed_names, self._sample_specs,
+            ladder=ladder,
+            n_replicas=config.replicas, devices=config.devices)
+        self.scheduler.start()
+        self._closed = False
+
+    def _check_fetch_contract(self, pure_fn, params_np, ladder):
+        """Micro-batched serving requires every fetch to be per-row
+        (leading dim = batch): a batch-reduced or rank-0 fetch would
+        boot fine and then error EVERY request at result-slicing time.
+        One cheap ``jax.eval_shape`` at the top bucket catches it at
+        load — the fail-at-boot contract — with a message naming the
+        fetch."""
+        import jax
+        top = ladder[-1]
+        param_sds = tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                          for p in params_np)
+        feed_sds = tuple(
+            jax.ShapeDtypeStruct((top,) + tuple(shape), np.dtype(dt))
+            for shape, dt in (self._sample_specs[n]
+                              for n in self._feed_names))
+        outs = jax.eval_shape(pure_fn, param_sds, feed_sds)
+        for name, o in zip(self._fetch_names, outs):
+            enforce(
+                len(o.shape) >= 1 and int(o.shape[0]) == top,
+                f"fetch {name!r} has output shape {tuple(o.shape)} for "
+                f"a batch of {top}: not per-row, so micro-batched "
+                f"results cannot be sliced back to requests — move the "
+                f"reduction out of the served graph or use the "
+                f"single-request Predictor")
+
+    # -- introspection -----------------------------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    @property
+    def ladder(self):
+        return self.pool.ladder
+
+    # -- serving -----------------------------------------------------------
+    def submit(self, feeds):
+        """Admit one request; returns a ``PendingResult``."""
+        if self._closed:
+            # server-level gate: after close() no request reaches the
+            # scheduler, even mid-drain (the scheduler's own flag also
+            # refuses — this one just fails before feed validation)
+            raise ServerClosedError("server is closed")
+        return self.scheduler.submit(feeds)
+
+    def infer(self, feeds, timeout=None):
+        """Blocking convenience: submit + result."""
+        return self.submit(feeds).result(timeout)
+
+    def close(self, timeout=None):
+        """Graceful shutdown: stop admission, drain every accepted
+        request through the replicas, stop the workers. Returns True
+        when fully stopped. With a ``timeout`` that expires mid-drain,
+        returns False and leaves the batcher AND replicas running
+        (daemon threads) so every accepted request still completes —
+        stopping the replicas early would let their shutdown sentinels
+        overtake still-forming batches in the FIFO and strand those
+        requests forever. Call close() again to finish. Idempotent."""
+        self._closed = True
+        # order matters: the scheduler drains its request queue into
+        # the batch queue first, THEN the pool's per-replica sentinels
+        # land behind every formed batch
+        if not self.scheduler.close(timeout):
+            return False
+        return self.pool.close(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -43,16 +43,38 @@ class TestBenchModes:
             assert ln["unit"] == "x" and ln["value"] > 0
             assert ln["int8_ms"] > 0 and ln["bf16_ms"] > 0
 
-    def test_serving_mode_emits_qps_rows(self):
-        lines = _run_mode("serving")
-        by_threads = {ln["metric"]: ln for ln in lines}
-        for n in (1, 4, 16):
-            row = by_threads.get(f"serving_qps_{n}_threads")
-            assert row is not None, by_threads.keys()
-            assert row["value"] > 0
-            assert row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
-        assert "scaling_vs_1_thread" in by_threads[
-            "serving_qps_16_threads"]
+    def test_serving_mode_emits_openloop_rows(self, tmp_path):
+        """`bench.py serving` must drive OPEN-LOOP Poisson load through
+        both the single-request Predictor baseline and the
+        micro-batching InferenceServer at equal offered load, emit
+        well-formed QPS/latency/fill JSON lines, and land the
+        serving_* metrics in the registry snapshot (tiny request
+        count: CLI/shape smoke — the honest QPS comparison runs with
+        the full default load)."""
+        metrics_out = str(tmp_path / "serving_metrics.prom")
+        lines = _run_mode("serving",
+                          extra_env={"BENCH_SERVING_REQS": "40",
+                                     "BENCH_METRICS_OUT": metrics_out})
+        by = {ln["metric"]: ln for ln in lines}
+        for tag in ("serving_baseline_qps", "serving_server_qps"):
+            row = by.get(tag)
+            assert row is not None, by.keys()
+            assert row["value"] > 0 and row["unit"] == "req/s"
+            assert row["offered_qps"] > 0
+            assert row["p50_ms"] > 0
+            assert row["p50_ms"] <= row["p99_ms"]
+        srv = by["serving_server_qps"]
+        assert srv["max_batch"] >= 1
+        assert 0 < srv["batch_fill_ratio"] <= 1.0
+        ratio = by["serving_server_vs_baseline_qps"]
+        assert ratio["unit"] == "x" and ratio["value"] > 0
+        with open(metrics_out) as f:
+            snap = f.read()
+        for name in ("serving_requests_total", "serving_queue_depth",
+                     "serving_batch_fill_ratio",
+                     "serving_padded_waste_total",
+                     "serving_request_latency_ms"):
+            assert name in snap, f"{name} missing from snapshot"
 
     def test_numerics_mode_emits_overhead_ratio(self):
         """`bench.py numerics` must A/B the check_nan_inf sentinels on

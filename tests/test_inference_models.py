@@ -250,3 +250,82 @@ class TestPredictorClone:
         o1 = np.asarray(base.run()[0])
         o2 = np.asarray(c.run()[0])
         assert o1.shape[0] == 2 and o2.shape[0] == 3
+
+
+class TestPredictorThreadSafety:
+    """The run() lock regression (docs/SERVING.md "embedded path"):
+    concurrent ``run(feed=...)`` callers on ONE predictor used to race
+    on the shared ``_feeds``/``_outputs`` handle state and corrupt each
+    other's feeds; the per-predictor lock makes them correct (if
+    convoyed), while ``clone()`` stays the lock-free scaling path with
+    a lock of its own."""
+
+    def _save_model(self, tmp_path):
+        return TestPredictorClone._save_model(self, tmp_path)
+
+    def test_concurrent_run_on_one_predictor_is_safe(self, tmp_path):
+        import threading
+        from paddle_tpu.inference import Config, create_predictor
+        model_dir = self._save_model(tmp_path / "m")
+        p = create_predictor(Config(model_dir))
+        rng = np.random.RandomState(7)
+        inputs = [rng.rand(2, 8).astype(np.float32) for _ in range(4)]
+        want = [np.asarray(p.run({"x": x})[0]) for x in inputs]
+        errors = []
+
+        def hammer(tid):
+            try:
+                for _ in range(15):
+                    got = np.asarray(p.run({"x": inputs[tid]})[0])
+                    # a racing caller's feed bleeding in would break
+                    # this exact-correspondence check
+                    np.testing.assert_allclose(got, want[tid],
+                                               rtol=1e-5)
+            except Exception as e:      # pragma: no cover
+                errors.append((tid, e))
+
+        ts = [threading.Thread(target=hammer, args=(t,))
+              for t in range(len(inputs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        assert not errors, errors
+
+    def test_clone_gets_its_own_lock_and_handle_state(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        model_dir = self._save_model(tmp_path / "m2")
+        base = create_predictor(Config(model_dir))
+        c = base.clone()
+        # shared: weights/program/executor/AOT caches (scaling contract)
+        assert c._scope is base._scope
+        assert c._program is base._program
+        assert c._aot_loaded is base._aot_loaded
+        # private: handle state AND the run lock — clones must not
+        # convoy on the parent's lock
+        assert c._feeds is not base._feeds
+        assert c._outputs is not base._outputs
+        assert c._run_lock is not base._run_lock
+
+    def test_lock_serializes_but_returns_each_callers_outputs(
+            self, tmp_path):
+        """run() returns its own call's outs (not self._outputs read
+        back post-release), so even under heavy interleaving each
+        caller sees the outputs of the feed IT passed."""
+        import threading
+        from paddle_tpu.inference import Config, create_predictor
+        model_dir = self._save_model(tmp_path / "m3")
+        p = create_predictor(Config(model_dir))
+        a = np.zeros((1, 8), np.float32)
+        b = np.ones((5, 8), np.float32)
+        shapes = {"a": [], "b": []}
+
+        def run_many(tag, x, rows):
+            for _ in range(25):
+                shapes[tag].append(
+                    np.asarray(p.run({"x": x})[0]).shape[0] == rows)
+
+        ta = threading.Thread(target=run_many, args=("a", a, 1))
+        tb = threading.Thread(target=run_many, args=("b", b, 5))
+        ta.start(); tb.start(); ta.join(60); tb.join(60)
+        assert all(shapes["a"]) and all(shapes["b"])

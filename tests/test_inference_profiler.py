@@ -193,17 +193,44 @@ class TestAOTExport:
 
     def test_stablehlo_fallback_when_executable_unusable(self, aot_model):
         d, xv, expected = aot_model
-        # corrupt the native executable: loader must fall back to the
-        # portable StableHLO artifact, same results
+        # an UNUSABLE-but-intact native executable (garbage container
+        # whose integrity record matches — e.g. written by a different
+        # serializer) degrades silently to the portable StableHLO
+        # artifact, same results. A CRC MISMATCH is different: positive
+        # corruption evidence raises AOTIntegrityError (see
+        # test below / tests/test_serving.py TestAOTIntegrity).
+        import zlib
         aot = os.path.join(d, "__aot__")
-        idx = json.load(open(os.path.join(aot, "index.json")))
+        ipath = os.path.join(aot, "index.json")
+        idx = json.load(open(ipath))
         for e in idx:
             with open(os.path.join(aot, e["xla"]), "wb") as f:
                 f.write(b"corrupt")
+            if "integrity" in e:
+                e["integrity"][e["xla"]] = {
+                    "crc32": zlib.crc32(b"corrupt") & 0xFFFFFFFF,
+                    "nbytes": len(b"corrupt")}
+        with open(ipath, "w") as f:
+            json.dump(idx, f)
         p = create_predictor(Config(d))
         out = p.run({"x": xv})[0]
         assert any(v is not None for v in p._aot_loaded.values())
         np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+    def test_corrupt_executable_raises_integrity_error(self, aot_model):
+        """Bit rot under an UNCHANGED integrity manifest is positive
+        corruption evidence: the predictor names the file instead of
+        silently serving the fallback path (docs/SERVING.md)."""
+        from paddle_tpu.inference import AOTIntegrityError
+        d, xv, expected = aot_model
+        aot = os.path.join(d, "__aot__")
+        idx = json.load(open(os.path.join(aot, "index.json")))
+        assert all("integrity" in e for e in idx)
+        with open(os.path.join(aot, idx[0]["xla"]), "wb") as f:
+            f.write(b"corrupt")
+        p = create_predictor(Config(d))
+        with pytest.raises(AOTIntegrityError, match=idx[0]["xla"]):
+            p.run({"x": xv})
 
     def test_resave_never_serves_stale_program(self, tmp_path):
         """Re-saving a CHANGED model into the same dirname must not

@@ -35,6 +35,7 @@ MODULES = [
     "paddle_tpu.backward",
     "paddle_tpu.distributed",
     "paddle_tpu.parallel",
+    "paddle_tpu.serving",
     "paddle_tpu.dataio",
     "paddle_tpu.contrib.slim",
     "paddle_tpu.contrib.quant",
