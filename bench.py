@@ -889,6 +889,202 @@ def bench_ckpt():
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_data():
+    """`python bench.py data` — data-plane A/B (ROADMAP item 4): the
+    deterministic sharded NATIVE loader vs the Python oracle on the
+    STATEFUL (exactly-once) path — the fast path PR 5/6 used to
+    surrender — plus a stateless-native reference row and the
+    device-side double-buffer on/off A/B.
+
+    Protocol (the bench_dispatch discipline): each comparison runs as
+    interleaved pairs — adjacent windows see the same ambient host
+    load — and the headline is the median of per-pair ratios, which a
+    load drift cannot bias. Every window consumes a fixed batch count
+    from a FRESH loader over the same generated dataset (epochs=-1:
+    no window ever hits end-of-stream early).
+
+    JSON lines: data_{native_stateful,python_stateful,stateless}
+    _records_per_sec, data_native_vs_python_ratio (>= 2x is ROADMAP
+    item 4's bar; resume bit-identity is proven separately by the
+    tests/test_data_plane.py conformance suite), and
+    data_h2d_overlap_ratio (double-buffer OFF step time / ON step
+    time; > 1.0 means the prefetch worker's device_put hid transfer
+    under compute — expect ~1.0 on CPU, where jnp.asarray of a host
+    batch is a no-copy alias; re-A/B on a real chip, where H2D is a
+    PCIe/ICI hop: `JAX_PLATFORMS=tpu python bench.py data`).
+
+    Env knobs: BENCH_DATA_FILES/ROWS/BATCH/BATCHES/PAIRS/SHUFFLE."""
+    import shutil
+    import tempfile
+    import time as _time
+
+    from paddle_tpu import native as _native
+    from paddle_tpu.dataio.dataloader import FileDataLoader
+
+    if not _native.available():
+        raise RuntimeError(
+            "bench.py data needs the native library (the A/B's whole "
+            "point); the C++ toolchain is missing or the build failed")
+
+    nfiles = int(os.environ.get("BENCH_DATA_FILES", "4"))
+    rows = int(os.environ.get("BENCH_DATA_ROWS", "25000"))
+    batch = int(os.environ.get("BENCH_DATA_BATCH", "256"))
+    batches = int(os.environ.get("BENCH_DATA_BATCHES", "60"))
+    pairs = max(2, int(os.environ.get("BENCH_DATA_PAIRS", "3")))
+    shuffle = int(os.environ.get("BENCH_DATA_SHUFFLE", "1024"))
+
+    d = tempfile.mkdtemp(prefix="bench_data_")
+    try:
+        files = []
+        for i in range(nfiles):
+            p = os.path.join(d, f"part-{i}.txt")
+            with open(p, "w") as f:
+                for j in range(rows):
+                    f.write(f"{(i * rows + j) % 977}.5\n")
+            files.append(p)
+
+        def mk_loader(native, stateful=True, device_put=False):
+            # minimal real parse (bytes -> number): the mode measures
+            # the DATA PLANE; a heavyweight per-record parse_fn would
+            # just flatten the A/B toward its own cost
+            return FileDataLoader(
+                files, float, batch_size=batch,
+                nthreads=4, shuffle_buffer=shuffle, seed=7, epochs=-1,
+                device_put=device_put, stateful=stateful,
+                native=native)
+
+        def window(native, stateful=True):
+            """Wall seconds to consume `batches` fresh batches."""
+            ld = mk_loader(native, stateful)
+            it = iter(ld)
+            next(it)                      # spin up worker + warm cache
+            t0 = _time.perf_counter()
+            for _ in range(batches):
+                next(it)
+            dt = _time.perf_counter() - t0
+            it.close()
+            return dt
+
+        window(True)                      # warm the .so + page cache
+        window(False)
+        recs = batch * batches
+        nat_rps, py_rps, ratios = [], [], []
+        for w in range(pairs):
+            first_nat = w % 2 == 0        # alternate order within pairs
+            a = window(first_nat)
+            b = window(not first_nat)
+            nat, py = (a, b) if first_nat else (b, a)
+            nat_rps.append(recs / nat)
+            py_rps.append(recs / py)
+            ratios.append(py / nat)       # >1: native faster
+        stateless = [recs / window(True, stateful=False)
+                     for _ in range(2)]
+        med = float(np.median(ratios))
+        print(json.dumps({
+            "metric": "data_native_stateful_records_per_sec",
+            "value": round(float(np.median(nat_rps))), "unit": "rec/s",
+            "batch": batch, "shuffle_buffer": shuffle,
+            "nfiles": nfiles}))
+        print(json.dumps({
+            "metric": "data_python_stateful_records_per_sec",
+            "value": round(float(np.median(py_rps))), "unit": "rec/s"}))
+        print(json.dumps({
+            "metric": "data_stateless_records_per_sec",
+            "value": round(float(np.median(stateless))),
+            "unit": "rec/s"}))
+        print(json.dumps({
+            "metric": "data_native_vs_python_ratio",
+            "value": round(med, 4), "unit": "x",
+            "pair_ratios": [round(r, 4) for r in ratios]}))
+        print(f"# stateful ingest: native {med:.2f}x the Python "
+              f"oracle over {pairs} interleaved pairs x {batches} "
+              f"batches of {batch}", file=sys.stderr)
+
+        # ---- device-side double-buffer A/B --------------------------------
+        import paddle_tpu as pt
+        from paddle_tpu.static.executor import Scope, scope_guard
+
+        steps = min(batches, 40)
+        HIDDEN = 128
+        pt.enable_static()
+        try:
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.static.data("x", shape=[HIDDEN])
+                h = x
+                for i in range(4):
+                    h = pt.layers.fc(h, size=HIDDEN,
+                                     param_attr=f"w{i}",
+                                     bias_attr=f"b{i}", act="relu")
+                loss = pt.layers.mean(h)
+            scope = Scope()
+            with scope_guard(scope):
+                exe = pt.static.Executor()
+                exe.run(startup)
+
+                rs = np.random.RandomState(0)
+                feed_rows = rs.randn(batch, HIDDEN).astype(np.float32)
+
+                def feed_loader(put):
+                    # per-batch distinct rows (a copy per batch), so
+                    # the put stage does real work every step
+                    def gen():
+                        for i in range(steps + 2):
+                            yield feed_rows + np.float32(i)
+                    from paddle_tpu.static.executor import \
+                        background_prefetch
+                    if put is None:
+                        return background_prefetch(gen(), lambda b: b,
+                                                   2)
+                    return background_prefetch(gen(), put, 2)
+
+                put = exe.feed_stage(main, feed_names=["x"])
+
+                def step_window(double_buffer):
+                    it = feed_loader(put if double_buffer else None)
+                    b0 = next(it)                 # warm the pipeline
+                    exe.run(main, feed={"x": b0}, fetch_list=[loss])
+                    t0 = _time.perf_counter()
+                    out = None
+                    for b in it:
+                        out = exe.run(main, feed={"x": b},
+                                      fetch_list=[loss],
+                                      return_numpy=False)
+                    float(np.ravel(np.asarray(out[0]))[0])
+                    dt = _time.perf_counter() - t0
+                    it.close()
+                    return dt
+
+                step_window(True)                 # compile + warm both
+                step_window(False)
+                on_ms, off_ms, h2d_ratios = [], [], []
+                for w in range(pairs):
+                    first_on = w % 2 == 0
+                    a = step_window(first_on)
+                    b = step_window(not first_on)
+                    on, off = (a, b) if first_on else (b, a)
+                    on_ms.append(on / steps * 1e3)
+                    off_ms.append(off / steps * 1e3)
+                    h2d_ratios.append(off / on)   # >1: overlap won
+                med_h = float(np.median(h2d_ratios))
+                print(json.dumps({
+                    "metric": "data_h2d_overlap_ratio",
+                    "value": round(med_h, 4), "unit": "x",
+                    "on_ms_per_step":
+                        round(float(np.median(on_ms)), 4),
+                    "off_ms_per_step":
+                        round(float(np.median(off_ms)), 4),
+                    "pair_ratios": [round(r, 4) for r in h2d_ratios],
+                }))
+                print(f"# double buffer: off/on step-time ratio "
+                      f"{med_h:.4f}x ({'overlap pays' if med_h > 1.05 else 'within noise on this backend'})",
+                      file=sys.stderr)
+        finally:
+            pt.disable_static()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_shard():
     """`python bench.py shard` — unified-mesh topology sweep (ROADMAP
     item 2): one transformer trunk trained under the ShardingSpec
@@ -1291,6 +1487,8 @@ def _dispatch_mode():
         return bench_numerics()
     if len(sys.argv) > 1 and sys.argv[1] == "ckpt":
         return bench_ckpt()
+    if len(sys.argv) > 1 and sys.argv[1] == "data":
+        return bench_data()
     if len(sys.argv) > 1 and sys.argv[1] == "shard":
         return bench_shard()
     import jax

@@ -230,9 +230,9 @@ class InMemoryDataset(_DatasetBase):
         pre-partitioned filelists) it falls back to hash-partitioning
         the locally loaded lines, which matches the reference's
         OUTCOME when every trainer loaded the full dataset. The hash
-        keys on sample content, not load position — the threaded
-        loader's line order is nondeterministic, and all trainers must
-        agree on ownership."""
+        keys on sample content, not load position — trainers may load
+        different filelist partitions, and all of them must agree on
+        ownership."""
         endpoints = []
         if fleet is not None:
             self._trainer_id = fleet.worker_index()

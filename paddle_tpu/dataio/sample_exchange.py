@@ -29,9 +29,11 @@ _CHUNK = 512            # samples per SHUFFLE_PUSH frame
 
 
 def sample_hash(sample):
-    """Deterministic content hash shared by all trainers (load order is
-    nondeterministic under the threaded reader, so ownership must key
-    on sample CONTENT)."""
+    """Deterministic content hash shared by all trainers: ownership
+    keys on sample CONTENT, never load position, so every trainer
+    agrees regardless of per-trainer filelist partitioning (and of
+    reader implementation — the native loader's order is deterministic
+    nowadays, but trainers legitimately load different file sets)."""
     import hashlib
     key = b"|".join(np.asarray(a).tobytes() for a in sample)
     return int(hashlib.md5(key).hexdigest(), 16)

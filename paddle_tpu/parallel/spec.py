@@ -218,7 +218,11 @@ class ShardingSpec:
     def shard_feeds(self, feeds):
         """device_put a {name: array} feed dict per ``feed_spec``.
         Raises on a batch dim that does not divide the data axes — the
-        same contract as data-parallel batch sharding."""
+        same contract as data-parallel batch sharding. An array already
+        carrying its target sharding passes through untouched — the
+        device-side double-buffer path (``Executor.feed_stage`` staging
+        batch N+1 in the prefetch worker) relies on this to keep the
+        H2D hop off the step's critical path."""
         out = {}
         for k, v in feeds.items():
             def put(x, k=k):
@@ -238,7 +242,16 @@ class ShardingSpec:
                             f"feed {k!r} batch dim {d} ({shape[d]}) "
                             f"is not divisible by the {n}-device "
                             f"{_entry_axes(entry)} mesh axes")
-                return jax.device_put(x, NamedSharding(self.mesh, sp))
+                target = NamedSharding(self.mesh, sp)
+                s = getattr(x, "sharding", None)
+                if s is not None:
+                    try:
+                        if s == target or s.is_equivalent_to(
+                                target, np.ndim(x)):
+                            return x
+                    except Exception:
+                        pass
+                return jax.device_put(x, target)
             out[k] = jax.tree.map(put, v)
         return out
 

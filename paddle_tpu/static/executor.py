@@ -184,12 +184,15 @@ class _PrefetchFailure:
     of enqueueing the bare exception so (a) an Exception legitimately
     yielded as DATA is never mis-raised, and (b) the original traceback
     rides along explicitly and re-raises in the consumer with the
-    producer frames intact."""
+    producer frames intact. ``index`` is the ordinal of the item that
+    failed (== items successfully produced before it), so a data-plane
+    postmortem can name WHICH batch blew up, not just how."""
 
-    __slots__ = ("exc",)
+    __slots__ = ("exc", "index")
 
-    def __init__(self, exc):
+    def __init__(self, exc, index=None):
         self.exc = exc
+        self.index = index
 
 
 def background_prefetch(producer, transform, depth=2):
@@ -227,14 +230,18 @@ def background_prefetch(producer, transform, depth=2):
         return False
 
     def worker():
+        produced = 0
         try:
             for b in producer:
                 if stop.is_set():
                     return
                 if not put(transform(b)):
                     return
+                produced += 1
         except BaseException as e:       # surface in consumer
-            put(_PrefetchFailure(e), count=False)
+            # `produced` == the failing item's ordinal: everything
+            # before it was delivered downstream intact
+            put(_PrefetchFailure(e, index=produced), count=False)
             return
         finally:
             # close the producer HERE, deterministically: a generator
@@ -259,6 +266,19 @@ def background_prefetch(producer, transform, depth=2):
             if item is SENTINEL:
                 break
             if isinstance(item, _PrefetchFailure):
+                if _flight._enabled:
+                    # the postmortem names the batch that failed, not
+                    # just the exception: "batch 1337 of the stream"
+                    # is what lets an operator replay/inspect the
+                    # offending records
+                    _flight.RECORDER.note(
+                        "error", "prefetch.producer",
+                        batch_index=item.index,
+                        error=repr(item.exc))
+                try:
+                    item.exc.prefetch_batch_index = item.index
+                except Exception:      # __slots__-restricted exception
+                    pass
                 raise item.exc.with_traceback(item.exc.__traceback__)
             yield item
     finally:
@@ -270,20 +290,30 @@ def background_prefetch(producer, transform, depth=2):
             pass
 
 
-def device_prefetch(batches, depth=2):
+def device_prefetch(batches, depth=2, put=None):
     """Double-buffered device staging (the role of the reference's
     operators/reader/buffered_reader.cc): a background thread transfers
     upcoming feed batches host->device ``depth`` steps ahead, so the
     H2D hop overlaps the current step's compute instead of serializing
     with it. ``batches`` yields feed dicts (or tuples/arrays); yields
-    the same structure with device-resident arrays."""
+    the same structure with device-resident arrays. ``put`` overrides
+    the per-batch placement — pass ``Executor.feed_stage(...)`` to
+    stage batches directly onto the shardings the prepared runner
+    consumes (DP/mesh feed placement) instead of the default device."""
 
     def stage(b):
-        if isinstance(b, dict):
-            return {k: _as_feed_array(v) for k, v in b.items()}
-        if isinstance(b, (tuple, list)):
-            return type(b)(_as_feed_array(v) for v in b)
-        return _as_feed_array(b)
+        t0 = time.perf_counter()
+        if put is not None:
+            out = put(b)
+        elif isinstance(b, dict):
+            out = {k: _as_feed_array(v) for k, v in b.items()}
+        elif isinstance(b, (tuple, list)):
+            out = type(b)(_as_feed_array(v) for v in b)
+        else:
+            out = _as_feed_array(b)
+        from paddle_tpu.dataio.dataloader import _m_h2d_ms
+        _m_h2d_ms.inc((time.perf_counter() - t0) * 1e3)
+        return out
 
     return background_prefetch(batches, stage, depth)
 
@@ -796,6 +826,67 @@ class Executor:
         compiled, total = runner.step.aot_compile(
             state, specs, base_key, np.uint32(0))
         return compiled == total
+
+    def feed_stage(self, program=None, feed_names=None):
+        """Device-side double-buffer stage: returns ``put(batch)`` for
+        a data loader's prefetch worker
+        (``FileDataLoader(device_put=put)`` /
+        ``device_prefetch(put=put)``) that places each feed batch on
+        the EXACT sharding the prepared runner consumes — the
+        spec-derived feed shardings for
+        ``CompiledProgram.with_mesh_sharding`` / ``with_data_parallel``
+        programs, the default device otherwise. The host->device hop
+        for batch N+1 then runs in the worker thread while the
+        compiled step for batch N computes, and ``run()`` passes the
+        already-placed arrays through instead of re-putting them on
+        its critical path (``dataio_h2d_overlap_ms`` counts the moved
+        milliseconds). ``feed_names`` orders tuple/list batches (dict
+        batches carry their own names; a bare-array batch needs
+        exactly one name)."""
+        program = program or default_main_program()
+        spec = None
+        from paddle_tpu.compiler import CompiledProgram
+        if isinstance(program, CompiledProgram):
+            spec = program._spec
+        names = list(feed_names) if feed_names is not None else None
+
+        if spec is None:
+            return jax.device_put
+
+        def place(name, v):
+            sh = spec.feed_sharding(name, np.ndim(v))
+            s = getattr(v, "sharding", None)
+            if s is not None:
+                try:
+                    if s == sh or s.is_equivalent_to(sh, np.ndim(v)):
+                        return v
+                except Exception:
+                    pass
+            return jax.device_put(v, sh)
+
+        def put(batch):
+            if isinstance(batch, dict):
+                return {k: place(k, v) for k, v in batch.items()}
+            if names is None:
+                raise EnforceNotMet(
+                    "feed_stage(feed_names=...) is required for "
+                    "tuple/array batches — the spec's feed shardings "
+                    "are name-keyed")
+            if isinstance(batch, (tuple, list)):
+                if len(batch) != len(names):
+                    raise EnforceNotMet(
+                        f"feed_stage got a {len(batch)}-field batch "
+                        f"for feed_names={names}")
+                return type(batch)(place(n, v)
+                                   for n, v in zip(names, batch))
+            if len(names) != 1:
+                raise EnforceNotMet(
+                    f"feed_stage got a single-array batch but "
+                    f"{len(names)} feed_names — pass the one name "
+                    f"this array feeds")
+            return place(names[0], batch)
+
+        return put
 
     # -- internals ---------------------------------------------------------
     def _prepare_runner(self, program, feeds, fetch_names, scope, spec):

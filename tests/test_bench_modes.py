@@ -119,6 +119,35 @@ class TestBenchModes:
             assert row["layout"]["n_devices"] == 1
             assert len(row["windows_ms_per_step"]) >= 2
 
+    def test_data_mode_emits_loader_ab_and_h2d_rows(self):
+        """`bench.py data` must A/B the native-stateful loader against
+        the Python oracle on interleaved pairs, report the stateless
+        reference row, and A/B the device-side double buffer (tiny
+        dataset: CLI/shape smoke — the honest >= 2x number runs with
+        the defaults)."""
+        lines = _run_mode("data", extra_env={
+            "BENCH_DATA_FILES": "2",
+            "BENCH_DATA_ROWS": "3000",
+            "BENCH_DATA_BATCH": "64",
+            "BENCH_DATA_BATCHES": "10",
+            "BENCH_DATA_PAIRS": "2",
+            "BENCH_DATA_SHUFFLE": "128",
+        })
+        by = {ln["metric"]: ln for ln in lines}
+        for tag in ("data_native_stateful_records_per_sec",
+                    "data_python_stateful_records_per_sec",
+                    "data_stateless_records_per_sec"):
+            row = by.get(tag)
+            assert row is not None, by.keys()
+            assert row["value"] > 0 and row["unit"] == "rec/s"
+        ratio = by["data_native_vs_python_ratio"]
+        assert ratio["unit"] == "x" and ratio["value"] > 0
+        assert len(ratio["pair_ratios"]) == 2
+        h2d = by["data_h2d_overlap_ratio"]
+        assert h2d["unit"] == "x" and h2d["value"] > 0
+        assert h2d["on_ms_per_step"] > 0
+        assert h2d["off_ms_per_step"] > 0
+
     def test_ckpt_mode_emits_save_restore_and_verify_ratio(self):
         """`bench.py ckpt` must time save/restore on a real
         CheckpointManager and A/B digest verification on interleaved

@@ -1,0 +1,502 @@
+"""Deterministic native data plane (ISSUE 10): the sharded-cursor
+contract's conformance suite. The pure-Python ``_PyRecordReader`` is
+the oracle; the multi-threaded native loader must produce BIT-IDENTICAL
+streams and interchangeable cursors — cut the stream anywhere (shard
+boundaries, epoch boundaries, shuffle on/off), resume with either
+implementation, and the continuation must match byte for byte. Plus:
+the v1->v2 cursor migration rules, cross-rank bit-identity for
+data-parallel slicing native-vs-python, the device-side double-buffer
+stage, the prefetch failure ordinal, and the kill->relaunch e2e on the
+native stateful path."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.dataio.dataloader import (
+    FileDataLoader, _PyRecordReader, _ShardRng, _migrate_v1_state,
+)
+from paddle_tpu.monitor.registry import REGISTRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+SUBPROC_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+NATIVE = native.available()
+needs_native = pytest.mark.skipif(not NATIVE,
+                                  reason="native toolchain unavailable")
+
+
+@pytest.fixture
+def shard_files(tmp_path):
+    """Deliberately awkward shard layout: uneven sizes, one EMPTY file,
+    one single-record file — the merge's park/skip logic must handle
+    all of them at every boundary."""
+    sizes = (23, 0, 57, 5)
+    files = []
+    for i, n in enumerate(sizes):
+        p = tmp_path / f"f{i}.txt"
+        with open(p, "w") as f:
+            for j in range(n):
+                f.write(f"{i * 1000 + j}\n")
+        files.append(str(p))
+    return files
+
+
+def _oracle(files, epochs=2, shuffle=0, seed=9):
+    return _PyRecordReader(files, epochs=epochs, shuffle_buffer=shuffle,
+                           seed=seed)
+
+
+class TestShardRng:
+    def test_matches_spec_constants(self):
+        """The RNG is a cross-language CONTRACT (C++ implements the
+        same arithmetic): pin actual output values so an innocent
+        'cleanup' on either side breaks loudly here, not as a silent
+        order change after a checkpoint resume."""
+        r = _ShardRng(0, 0, 0)
+        first = [r.next() for _ in range(3)]
+        assert first == [15986005209933191396, 11098062050021221612,
+                         10333306599109815648]
+        # distinct (seed, shard, epoch) -> distinct streams
+        assert _ShardRng(1, 0, 0).next() != _ShardRng(0, 1, 0).next()
+        assert _ShardRng(0, 0, 1).next() != _ShardRng(0, 1, 0).next()
+
+    def test_negative_seed_wraps_like_uint64(self):
+        # the C side receives seed as a long cast to uint64: two's
+        # complement — python must mask identically
+        assert _ShardRng(-1, 0, 0).next() == \
+            _ShardRng((1 << 64) - 1, 0, 0).next()
+
+    def test_shuffle_is_fisher_yates(self):
+        buf = list(range(6))
+        _ShardRng(3, 1, 0).shuffle(buf)
+        r = _ShardRng(3, 1, 0)
+        want = list(range(6))
+        for i in range(5, 0, -1):
+            j = r.below(i + 1)
+            want[i], want[j] = want[j], want[i]
+        assert buf == want
+
+
+@needs_native
+class TestNativeConformance:
+    """Native stream == Python oracle stream, bit for bit."""
+
+    @pytest.mark.parametrize("shuffle", [0, 7])
+    @pytest.mark.parametrize("nthreads", [1, 2, 4])
+    def test_full_stream_bit_identical(self, shard_files, shuffle,
+                                       nthreads):
+        want = list(_oracle(shard_files, shuffle=shuffle))
+        with native.NativeLoader(shard_files, nthreads=nthreads,
+                                 shuffle_buffer=shuffle, seed=9,
+                                 epochs=2) as ld:
+            got = list(ld)
+        assert got == want      # nthreads is a pure throughput knob
+
+    def test_bulk_read_equals_iteration(self, shard_files):
+        want = list(_oracle(shard_files, shuffle=7))
+        with native.NativeLoader(shard_files, nthreads=3,
+                                 shuffle_buffer=7, seed=9,
+                                 epochs=2) as ld:
+            got = ld.read_records(10 ** 6)
+        assert got == want
+
+    @pytest.mark.parametrize("shuffle", [0, 7])
+    def test_resume_conformance_at_ten_plus_cuts(self, shard_files,
+                                                 shuffle):
+        """The acceptance grid: cuts at stream start, mid-shard, the
+        single-record shard's boundary (23/24), the EPOCH boundary
+        (85/86), deep mid-epoch-2, and end-of-stream — each resumed
+        (a) native->native, (b) native cursor -> Python oracle, and
+        (c) Python cursor -> native. All three must continue
+        byte-identically, and the python/native cursors at each cut
+        must be EQUAL dicts."""
+        full = list(_oracle(shard_files, shuffle=shuffle))
+        assert len(full) == 170
+        cuts = (0, 1, 4, 23, 24, 84, 85, 86, 100, 169, 170)
+        for k in cuts:
+            with native.NativeLoader(shard_files, nthreads=3,
+                                     shuffle_buffer=shuffle, seed=9,
+                                     epochs=2) as ld:
+                head = ld.read_records(k)
+                st = ld.state()
+            assert head == full[:k], f"cut {k}"
+            oracle = _oracle(shard_files, shuffle=shuffle)
+            it = iter(oracle)
+            for _ in range(k):
+                next(it)
+            assert oracle.state() == st, f"cursor mismatch at {k}"
+            with native.NativeLoader(shard_files, nthreads=2,
+                                     shuffle_buffer=shuffle, seed=9,
+                                     epochs=2, start_state=st) as ld2:
+                assert head + list(ld2) == full, f"nat->nat {k}"
+            r = _PyRecordReader(shard_files, epochs=2,
+                                shuffle_buffer=shuffle, seed=9,
+                                start_state=st)
+            assert head + list(r) == full, f"nat->py {k}"
+            with native.NativeLoader(shard_files, nthreads=4,
+                                     shuffle_buffer=shuffle, seed=9,
+                                     epochs=2,
+                                     start_state=oracle.state()) as ld3:
+                assert head + list(ld3) == full, f"py->nat {k}"
+
+    def test_empty_and_no_trailing_newline_records(self, tmp_path):
+        p = tmp_path / "edge.txt"
+        p.write_bytes(b"a\n\nbb\n\nccc")   # empties + unterminated tail
+        q = tmp_path / "other.txt"
+        q.write_text("x\ny\n")
+        files = [str(p), str(q)]
+        want = list(_PyRecordReader(files, epochs=2, shuffle_buffer=3,
+                                    seed=1))
+        assert b"" in want and b"ccc" in want
+        with native.NativeLoader(files, nthreads=2, shuffle_buffer=3,
+                                 seed=1, epochs=2) as ld:
+            assert list(ld) == want
+
+    def test_restore_after_reading_refused(self, shard_files):
+        with native.NativeLoader(shard_files, epochs=1) as ld:
+            ld.read_records(1)
+            with pytest.raises((IOError, ValueError)):
+                ld._restore(ld.state())
+
+    def test_wrong_shard_count_cursor_refused(self, shard_files):
+        st = _oracle(shard_files[:2]).state()
+        with pytest.raises(ValueError, match="shard"):
+            native.NativeLoader(shard_files, epochs=2, start_state=st)
+
+
+class TestV1Migration:
+    def _v1(self, files, **over):
+        st = {"version": 1, "epoch": 1, "file_index": 0, "offset": 0,
+              "epoch_records": 0, "records_consumed": 85, "seed": 0,
+              "shuffle_buffer": 0, "nfiles": len(files),
+              "files": [[os.path.basename(f), os.path.getsize(f)]
+                        for f in files]}
+        st.update(over)
+        return st
+
+    def test_epoch_boundary_migrates(self, shard_files):
+        r = _PyRecordReader(shard_files, epochs=2,
+                            start_state=self._v1(shard_files))
+        # epoch 0 was consumed under the OLD order; the v2 stream
+        # serves epoch 1 onward — exactly one epoch's worth of records
+        assert len(list(r)) == 85
+        assert r.state()["version"] == 2
+
+    def test_single_file_unshuffled_migrates_mid_epoch(self, tmp_path):
+        p = tmp_path / "one.txt"
+        p.write_text("".join(f"{i}\n" for i in range(40)))
+        files = [str(p)]
+        # consume 10 records under the v2 contract to learn the offset
+        r0 = _PyRecordReader(files, epochs=1)
+        it = iter(r0)
+        for _ in range(10):
+            next(it)
+        v1 = self._v1(files, epoch=0,
+                      offset=r0.state()["shards"][0]["offset"],
+                      epoch_records=10, records_consumed=10)
+        r = _PyRecordReader(files, epochs=1, start_state=v1)
+        got = list(r)
+        assert got[0] == b"10" and len(got) == 30
+
+    def test_mid_epoch_multifile_refused_loudly(self, shard_files):
+        v1 = self._v1(shard_files, epoch=0, file_index=1, offset=17,
+                      epoch_records=30, records_consumed=30)
+        with pytest.raises(ValueError, match="epoch boundar"):
+            _PyRecordReader(shard_files, epochs=2, start_state=v1)
+
+    def test_single_file_shuffled_refused(self, tmp_path):
+        """v1's reservoir came from random.Random, v2's from
+        _ShardRng: mid-epoch the orders differ even for one file."""
+        p = tmp_path / "one.txt"
+        p.write_text("".join(f"{i}\n" for i in range(40)))
+        v1 = self._v1([str(p)], epoch=0, offset=99, epoch_records=5,
+                      records_consumed=5, shuffle_buffer=8)
+        with pytest.raises(ValueError, match="epoch boundar"):
+            _PyRecordReader([str(p)], epochs=1, shuffle_buffer=8,
+                            seed=9, start_state=v1)
+
+    def test_loader_set_state_normalizes_v1_to_v2(self, shard_files):
+        ld = FileDataLoader(shard_files, lambda r: np.float32(r),
+                            batch_size=5, epochs=2, device_put=False,
+                            stateful=True, native=False)
+        ld.set_state(self._v1(shard_files))
+        assert ld._pending_state["version"] == 2
+        assert len(list(ld)) == 85 // 5
+
+
+@needs_native
+class TestDpCrossRankIdentity:
+    """The PR-6 restriction is lifted: world_size= slicing rides the
+    native loader, and ranks slice identically-ordered global batches
+    whichever implementation serves each rank."""
+
+    @pytest.fixture
+    def data(self, tmp_path):
+        files = []
+        for i, n in enumerate((40, 24)):
+            p = tmp_path / f"d{i}.txt"
+            with open(p, "w") as f:
+                f.write("\n".join(str(100 * i + j)
+                                  for j in range(n)) + "\n")
+            files.append(str(p))
+        return files
+
+    def _mk(self, files, w=None, r=None, nat=None, stateful=True):
+        return FileDataLoader(files, lambda rec: np.float32(rec),
+                              batch_size=4, shuffle_buffer=8, seed=5,
+                              epochs=-1, device_put=False,
+                              stateful=stateful, world_size=w, rank=r,
+                              native=nat)
+
+    def test_dp_uses_native_loader(self, data):
+        before = REGISTRY.get("dataio_native_stateful_total").value()
+        ld = self._mk(data, 2, 0, stateful=False)
+        recs = ld._records()
+        try:
+            assert isinstance(recs, native.NativeLoader)
+        finally:
+            recs.close()
+        assert REGISTRY.get("dataio_native_stateful_total").value() \
+            == before + 1
+
+    def test_cross_rank_bit_identity_native_vs_python(self, data):
+        """rank 0 on the NATIVE loader + rank 1 on the PYTHON oracle
+        must still concat to the job-level global batches — the
+        cross-implementation version of PR-6's core invariant."""
+        g = iter(self._mk(data, nat=False))
+        i0 = iter(self._mk(data, 2, 0, nat=True))
+        i1 = iter(self._mk(data, 2, 1, nat=False))
+        for _ in range(8):
+            want = next(g)
+            got = np.concatenate([next(i0), next(i1)])
+            assert np.array_equal(got, want)
+
+    def test_dp_native_rescale_resumes_exactly(self, data):
+        """2 native ranks -> merge -> 1 python rank: the frontier is
+        implementation-neutral."""
+        from paddle_tpu.dataio.dataloader import merge_rank_states
+        gref = [next(it) for it in [iter(self._mk(data, nat=False))]
+                for _ in range(6)]
+        l0, l1 = self._mk(data, 2, 0, True), self._mk(data, 2, 1, True)
+        i0, i1 = iter(l0), iter(l1)
+        for _ in range(3):
+            next(i0), next(i1)
+        fr = merge_rank_states([l0.state(), l1.state()])
+        w1 = self._mk(data, nat=False)
+        w1.set_state(fr)
+        it = iter(w1)
+        for s in range(3, 6):
+            assert np.array_equal(next(it), gref[s])
+
+
+class TestDeviceStage:
+    def test_feed_stage_default_device(self, tmp_path):
+        import jax
+        import paddle_tpu as pt
+        from paddle_tpu.static.executor import Executor
+        put = Executor().feed_stage()
+        out = put({"x": np.ones((2, 3), np.float32)})
+        assert isinstance(out["x"], jax.Array)
+
+    def test_loader_device_put_callable_and_overlap_metric(
+            self, tmp_path):
+        import jax
+        from paddle_tpu.static.executor import Executor
+        p = tmp_path / "d.txt"
+        p.write_text("".join(f"{i}\n" for i in range(32)))
+        before = REGISTRY.get("dataio_h2d_overlap_ms").value()
+        put = Executor().feed_stage()
+        ld = FileDataLoader([str(p)], lambda r: np.float32(r),
+                            batch_size=8, device_put=put)
+        tot = 0.0
+        for b in ld:
+            assert isinstance(b, jax.Array)
+            tot += float(np.asarray(b).sum())
+        assert tot == sum(range(32))
+        # the staging time landed on the overlap counter (worker-side)
+        assert REGISTRY.get("dataio_h2d_overlap_ms").value() > before
+
+    def test_feed_stage_places_spec_shardings_and_run_passes_through(
+            self):
+        """Mesh path: feed_stage puts the batch on the spec's feed
+        sharding in the worker; shard_feeds then passes the SAME array
+        object through instead of re-putting it on the critical
+        path."""
+        import jax
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.mesh import (MeshConfig, make_mesh,
+                                              set_mesh)
+        from paddle_tpu.parallel.spec import ShardingSpec
+        from paddle_tpu.static.executor import Executor, Scope, \
+            scope_guard
+        pt.enable_static()
+        try:
+            mesh = set_mesh(make_mesh(MeshConfig(data=1),
+                                      devices=jax.devices()[:1]))
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = pt.static.data("x", shape=[4])
+                y = pt.static.data("y", shape=[1])
+                loss = pt.layers.mean(pt.layers.square_error_cost(
+                    pt.layers.fc(x, size=1, param_attr="w"), y))
+                pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+            spec = ShardingSpec(mesh=mesh)
+            from paddle_tpu.compiler import CompiledProgram
+            compiled = CompiledProgram(main).with_mesh_sharding(spec)
+            scope = Scope()
+            with scope_guard(scope):
+                exe = pt.static.Executor()
+                exe.run(startup)
+                put = exe.feed_stage(compiled, feed_names=["x", "y"])
+                xb = np.ones((4, 4), np.float32)
+                yb = np.zeros((4, 1), np.float32)
+                staged = put((xb, yb))
+                for v in staged:
+                    assert isinstance(v, jax.Array)
+                # pass-through: shard_feeds keeps the staged objects
+                refed = spec.shard_feeds({"x": staged[0],
+                                          "y": staged[1]})
+                assert refed["x"] is staged[0]
+                assert refed["y"] is staged[1]
+                # and a real step consumes the staged batch
+                (lv,) = exe.run(compiled,
+                                feed={"x": staged[0], "y": staged[1]},
+                                fetch_list=[loss])
+                assert np.isfinite(float(lv))
+        finally:
+            pt.disable_static()
+
+    def test_feed_stage_tuple_needs_names(self):
+        import jax
+        import paddle_tpu as pt
+        from paddle_tpu.core.enforce import EnforceNotMet
+        from paddle_tpu.parallel.mesh import (MeshConfig, make_mesh,
+                                              set_mesh)
+        from paddle_tpu.parallel.spec import ShardingSpec
+        from paddle_tpu.compiler import CompiledProgram
+        from paddle_tpu.static.executor import Executor
+        pt.enable_static()
+        try:
+            mesh = set_mesh(make_mesh(MeshConfig(data=1),
+                                      devices=jax.devices()[:1]))
+            prog = pt.Program()
+            compiled = CompiledProgram(prog).with_mesh_sharding(
+                ShardingSpec(mesh=mesh))
+            put = Executor().feed_stage(compiled)
+            with pytest.raises(EnforceNotMet, match="feed_names"):
+                put((np.ones(2),))
+        finally:
+            pt.disable_static()
+
+
+class TestPrefetchFailureOrdinal:
+    def test_producer_exception_carries_batch_index(self):
+        from paddle_tpu.static.executor import background_prefetch
+
+        def boom():
+            yield 0
+            yield 1
+            yield 2
+            raise RuntimeError("record 3 is garbage")
+
+        it = background_prefetch(boom(), lambda b: b, depth=8)
+        got = []
+        with pytest.raises(RuntimeError, match="garbage") as ei:
+            for b in it:
+                got.append(b)
+        assert got == [0, 1, 2]
+        assert ei.value.prefetch_batch_index == 3
+
+    def test_transform_exception_carries_batch_index(self):
+        from paddle_tpu.static.executor import background_prefetch
+
+        def transform(b):
+            if b == 2:
+                raise ValueError("bad batch")
+            return b
+
+        it = background_prefetch(iter(range(5)), transform, depth=8)
+        with pytest.raises(ValueError, match="bad batch") as ei:
+            list(it)
+        assert ei.value.prefetch_batch_index == 2
+
+    def test_loader_parse_error_names_batch(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("1\n2\n3\n4\nnope\n6\n")
+        ld = FileDataLoader([str(p)], lambda r: np.float32(r),
+                            batch_size=2, device_put=False,
+                            native=False)
+        with pytest.raises(ValueError) as ei:
+            list(ld)
+        # batches 0 and 1 parse; batch 2 (records 4-5) blows up
+        assert ei.value.prefetch_batch_index == 2
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+@needs_native
+class TestNativeStatefulEndToEnd:
+    """Kill->relaunch acceptance on the NATIVE stateful path: a
+    crashed-and-resumed run over the native loader consumes the exact
+    per-step record sequence of an undisturbed run over the PYTHON
+    oracle — exactly-once resume AND cross-implementation conformance
+    in one e2e (reuses tests/elastic_worker.py's data_dir mode)."""
+
+    TOTAL = 8
+
+    def _launch(self, tmp_path, tag, fault_env, data_dir, **kw):
+        prefix = tmp_path / f"{tag}.out"
+        ckpt = tmp_path / f"{tag}.ckpt"
+        env = dict(SUBPROC_ENV, **fault_env)
+        if fault_env:
+            env.setdefault("PT_FAULT_ONCE_DIR",
+                           str(tmp_path / f"{tag}.once"))
+        from paddle_tpu.distributed.launch import launch_collective
+        rc = launch_collective(
+            [WORKER, str(prefix), str(ckpt), str(self.TOTAL), "0.05",
+             "1", str(data_dir)],
+            log_dir=str(tmp_path / f"{tag}.logs"), env_extra=env,
+            timeout=240, **kw)
+        return rc, prefix
+
+    def test_crash_resume_native_matches_python_clean_run(
+            self, tmp_path, capfd):
+        data_dir = tmp_path / "data"
+        data_dir.mkdir()
+        for i in range(3):          # multiple shards: the merge runs
+            with open(data_dir / f"d{i}.txt", "w") as f:
+                for j in range(1500):
+                    f.write(f"{i * 10000 + j}\n")
+        rc, prefix = self._launch(
+            tmp_path, "faulted",
+            {"PT_FAULT_CRASH_AT_STEP": "4", "PT_FAULT_RANK": "0"},
+            data_dir, nproc=1, max_restarts=2)
+        err = capfd.readouterr().err
+        assert rc == 0, err[-4000:]
+        assert "exited with code 23" in err
+        # clean run FORCED onto the Python oracle
+        rc0, clean_prefix = self._launch(
+            tmp_path, "clean", {"PT_DATAIO_FORCE_PY": "1"}, data_dir,
+            nproc=1)
+        assert rc0 == 0
+        with open(f"{prefix}.rank0.batches.json") as f:
+            fb = json.load(f)
+        with open(f"{clean_prefix}.rank0.batches.json") as f:
+            cb = json.load(f)
+        assert set(fb) == set(cb) == {str(s) for s in range(self.TOTAL)}
+        assert fb == cb, "native faulted run diverged from python " \
+                         "oracle clean run"

@@ -160,17 +160,59 @@ class TestStatefulLoader:
         with pytest.raises(RuntimeError, match="stateful"):
             self._loader(data_files, mode="recordio")
 
-    def test_stateful_uses_python_reader_even_with_native(
+    def test_stateful_uses_native_reader_when_available(
             self, data_files):
-        """The documented fallback: the native loader's multi-threaded
-        order is nondeterministic, so stateful always reads in
-        Python."""
+        """The deterministic sharded-cursor contract lifted the PR-5
+        forced-Python fallback: stateful streams ride the native
+        loader (counted by dataio_native_stateful_total), and
+        native=False / PT_DATAIO_FORCE_PY pin the Python oracle."""
         from paddle_tpu import native
         if not native.available():
-            pytest.skip("native library unavailable; nothing to fall "
-                        "back from")
+            pytest.skip("native library unavailable; nothing to "
+                        "accelerate")
+        before = REGISTRY.get("dataio_native_stateful_total").value()
         ld = self._loader(data_files)
-        assert isinstance(ld._records(), _PyRecordReader)
+        recs = ld._records()
+        try:
+            assert isinstance(recs, native.NativeLoader)
+        finally:
+            recs.close()
+        assert REGISTRY.get("dataio_native_stateful_total").value() \
+            == before + 1
+        forced = self._loader(data_files, native=False)
+        assert isinstance(forced._records(), _PyRecordReader)
+        os.environ["PT_DATAIO_FORCE_PY"] = "1"
+        try:
+            assert isinstance(self._loader(data_files)._records(),
+                              _PyRecordReader)
+        finally:
+            os.environ.pop("PT_DATAIO_FORCE_PY", None)
+
+    def test_native_and_python_stateful_paths_bit_identical(
+            self, data_files):
+        """The loader-level conformance pin: the same batches, in the
+        same order, whichever reader implementation serves a stateful
+        stream — including a mid-stream cursor handoff FROM the native
+        reader TO the Python oracle."""
+        from paddle_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        kw = dict(epochs=2, seed=3, shuffle_buffer=16)
+        want = list(self._loader(data_files, native=False, **kw))
+        got = list(self._loader(data_files, native=True, **kw))
+        assert np.array_equal(np.concatenate(got),
+                              np.concatenate(want))
+        nat = self._loader(data_files, native=True, **kw)
+        head = []
+        for i, b in enumerate(nat):
+            head.append(b)
+            if i == 6:
+                break
+        py = self._loader(data_files, native=False, **kw)
+        py.set_state(nat.state())       # native cursor, Python reader
+        tail = list(py)
+        assert np.array_equal(np.concatenate(head + tail),
+                              np.concatenate(want))
 
     @pytest.mark.parametrize("shuffle_buffer", [0, 16])
     def test_second_iterator_continues_not_replays(self, data_files,
