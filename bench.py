@@ -115,6 +115,46 @@ def _timed_steps(step_once, carry, steps, settle=3, windows=None,
     return tr
 
 
+def _abba_overhead(window, pairs, bound=1.05, rounds=3):
+    """Shared tracing-on/off A/B protocol (bench serving + dispatch):
+    ABBA-ordered window quadruples — both sides of each ratio sit in
+    the same slice of a shared host's drifting load — estimated by the
+    TRIMMED MEAN of pair ratios (individual pairs are wide on this
+    host: ~30% exceed 1.05 even for a true-1.00 effect, so a median
+    over a dozen pairs flakes; the mean tightens by CLT and the trim
+    guards the one wild pair). When the estimate sits above ``bound``,
+    gather ``pairs`` more quadruples (all data kept, never discarded)
+    up to ``rounds`` extra times — a true regression stays above the
+    bound however many pairs pile on.
+
+    ``window(traced)`` runs one timed window and returns its per-unit
+    time. Returns ``(estimate, pair_ratios, on_times, off_times)``."""
+    pair_ratios, on_ts, off_ts = [], [], []
+
+    def run_pairs(n):
+        for _ in range(n):
+            a1 = window(True)
+            b1 = window(False)
+            b2 = window(False)
+            a2 = window(True)
+            on_ts.extend((a1, a2))
+            off_ts.extend((b1, b2))
+            pair_ratios.append((a1 + a2) / (b1 + b2))
+
+    def estimate():
+        rs = sorted(pair_ratios)
+        if len(rs) >= 6:
+            rs = rs[1:-1]
+        return float(np.mean(rs))
+
+    run_pairs(pairs)
+    for _round in range(rounds):
+        if estimate() < bound:
+            break
+        run_pairs(pairs)
+    return estimate(), pair_ratios, on_ts, off_ts
+
+
 def bench_resnet50():
     """Secondary benchmark (`python bench.py resnet50`): ResNet-50
     images/sec/chip + MFU — BASELINE.json's second headline config."""
@@ -597,6 +637,105 @@ def bench_serving():
           f"(rate_x={rate_x} x measured {1 / svc_s:.0f}/s x "
           f"{replicas} replica(s)), baseline {base_qps:.0f} vs "
           f"server {srv_qps:.0f} sustained", file=sys.stderr)
+
+    # ---- tracing: p99 attribution + on/off overhead ------------------
+    # Attribution pass: the SAME open-loop schedule, traced keep-all
+    # (monitor/trace.py) — every request's span tree lands in the
+    # ring, so the slowest decile's time splits into queue-wait /
+    # execute / deliver shares BY MEASUREMENT, not guesswork. The
+    # headline A/B above stays untraced; tracing's own cost is the
+    # separate interleaved ratio below.
+    from paddle_tpu.monitor import trace as mtrace
+
+    mtrace.enable(sample_rate=1.0, capacity=max(8 * n_reqs, 4096))
+    srv = InferenceServer(d, ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=n_reqs + replicas, replicas=replicas))
+    pend = [None] * n_reqs
+    arrived = [0.0] * n_reqs
+    t_origin = open_loop(lambda i, ta: (
+        arrived.__setitem__(i, ta),
+        pend.__setitem__(i, srv.submit({"x": feed}))))
+    for p in pend:
+        p.result(timeout=600)
+    srv.close()
+    lat = np.asarray([p.t_done - ta for p, ta in zip(pend, arrived)])
+    n_dec = max(1, n_reqs // 10)
+    phases = ("queue_wait", "batch_form", "dispatch_wait", "execute",
+              "deliver")
+    shares = {k: [] for k in phases}
+    for i in np.argsort(lat)[::-1][:n_dec]:
+        durs = {}
+        for s in mtrace.spans(pend[int(i)].trace_id):
+            durs[s["name"].split("/", 1)[1]] = \
+                durs.get(s["name"].split("/", 1)[1], 0.0) + s["dur"]
+        total = durs.get("request", 0.0)
+        if total <= 0:
+            continue
+        for k in phases:
+            shares[k].append(durs.get(k, 0.0) / total)
+    print(json.dumps({
+        "metric": "serving_p99_attribution",
+        "value": round(float(np.percentile(lat * 1e3, 99)), 2),
+        "unit": "ms", "n_slowest": n_dec,
+        **{f"{k}_share":
+           (round(float(np.median(v)), 4) if v else None)
+           for k, v in shares.items()},
+    }))
+    mtrace.disable()
+
+    # Overhead pass: tracing-on/off A/B of the p50 request latency
+    # under sub-saturation OPEN-LOOP load — the regime serving SLOs
+    # are about (the hot-path tracing cost is µs against ms-scale
+    # latencies; a throughput-mode µbench of this host's GIL
+    # scheduling cannot resolve it honestly). The shared
+    # _abba_overhead protocol (ABBA quadruples + trimmed-mean +
+    # sequential more-pairs) cancels the host's load drift; the smoke
+    # test asserts the estimate < 1.05x.
+    pairs = int(os.environ.get("BENCH_SERVING_TRACE_PAIRS", "3"))
+    win = int(os.environ.get("BENCH_SERVING_TRACE_WIN", "120"))
+    mtrace.enable(sample_rate=0.05, slow_keep=8)    # default policy,
+    mtrace.disable()                                # tracer persists
+    srv = InferenceServer(d, ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=4 * win, replicas=replicas))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        srv.infer({"x": feed}, timeout=60)
+    ab_rate = 0.5 * replicas / ((time.perf_counter() - t0) / 20)
+    ab_rng = np.random.RandomState(7)
+
+    def p50_window(traced, n=win):
+        if traced:
+            mtrace.enable()
+        else:
+            mtrace.disable()
+        sched = np.cumsum(ab_rng.exponential(1.0 / ab_rate, size=n))
+        t0 = time.perf_counter()
+        pend = []
+        for i in range(n):
+            dly = t0 + sched[i] - time.perf_counter()
+            if dly > 0:
+                time.sleep(dly)
+            pend.append((srv.submit({"x": feed}), t0 + sched[i]))
+        lat_w = []
+        for p, ta in pend:
+            p.result(timeout=120)
+            lat_w.append(p.t_done - ta)
+        return float(np.median(lat_w)) * 1e3
+
+    p50_window(True), p50_window(False)             # warm both paths
+    est, pair_ratios, on_ms, off_ms = _abba_overhead(p50_window, pairs)
+    mtrace.disable()
+    srv.close()
+    print(json.dumps({
+        "metric": "serving_trace_overhead_ratio",
+        "value": round(est, 4), "unit": "x",
+        "traced_p50_ms": round(float(np.median(on_ms)), 4),
+        "untraced_p50_ms": round(float(np.median(off_ms)), 4),
+        "pair_ratios": [round(r, 4) for r in pair_ratios],
+        "window_reqs": win, "offered_fraction_of_capacity": 0.5,
+    }))
 
 
 def bench_longcontext():

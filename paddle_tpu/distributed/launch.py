@@ -63,6 +63,7 @@ from paddle_tpu.distributed import health
 from paddle_tpu.monitor import anomaly as _anomaly
 from paddle_tpu.monitor import exporter as _exporter
 from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor import trace as _trace
 from paddle_tpu.monitor.registry import REGISTRY as _REGISTRY
 from paddle_tpu.monitor.registry import counter as _counter
 from paddle_tpu.monitor.registry import gauge as _gauge
@@ -136,6 +137,39 @@ def _postmortem_env(log_dir):
     d = os.path.join(os.path.abspath(log_dir), "postmortem")
     os.makedirs(d, exist_ok=True)
     return {_flight.ENV_DIR: d}
+
+
+def _trace_env(log_dir):
+    """Arm workers' distributed tracing: PADDLE_TRACE_DIR under the
+    log dir (per-rank span files land in <log_dir>/traces; see
+    monitor/trace.py — tail sampling keeps the hot path cheap, so a
+    supervised job traces by default). No log_dir means nowhere
+    durable."""
+    if not log_dir:
+        return {}
+    d = os.path.join(os.path.abspath(log_dir), "traces")
+    os.makedirs(d, exist_ok=True)
+    return {_trace.ENV_DIR: d}
+
+
+def _merge_job_trace(log_dir):
+    """Clock-align and merge every rank's trace file into ONE
+    Perfetto/Chrome JSON at <log_dir>/trace.json — the launcher-side
+    close of the tracing loop. Never raises (evidence collection must
+    not mask the job's exit code)."""
+    if not log_dir:
+        return None
+    d = os.path.join(os.path.abspath(log_dir), "traces")
+    try:
+        out = _trace.merge_rank_traces(
+            d, os.path.join(os.path.abspath(log_dir), "trace.json"))
+    except Exception as e:
+        _log(f"trace merge failed (ignored): {type(e).__name__}: {e}")
+        return None
+    if out:
+        _log(f"job trace: {out} (per-rank spans clock-aligned and "
+             f"merged; open in Perfetto / chrome://tracing)")
+    return out
 
 
 def _report_postmortems(log_dir, why):
@@ -491,6 +525,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     hb_dir, hb_tmp = _make_hb_dir(log_dir)
     cache_env = _cache_dir_env(log_dir, env_extra)
     pm_env = _postmortem_env(log_dir)
+    tr_env = _trace_env(log_dir)
     join_dir = elastic_join_dir(log_dir) if elastic else None
     if join_dir:
         os.makedirs(join_dir, exist_ok=True)
@@ -512,7 +547,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
         try:
             for rank in range(world):
                 env = dict(os.environ, **(env_extra or {}), **cache_env,
-                           **pm_env)
+                           **pm_env, **tr_env)
                 env.update({
                     "PADDLE_TRAINER_ID": str(rank),
                     "PADDLE_TRAINERS_NUM": str(world),
@@ -614,6 +649,9 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                 return 124
     finally:
         undo()
+        # the merged job timeline is evidence like the postmortems:
+        # produced however the job ended (ok, budget-exhausted, killed)
+        _merge_job_trace(log_dir)
         if hb_tmp:
             shutil.rmtree(hb_dir, ignore_errors=True)
 
@@ -640,6 +678,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     hb_dir, hb_tmp = _make_hb_dir(log_dir)
     cache_env = _cache_dir_env(log_dir, env_extra)
     pm_env = _postmortem_env(log_dir)
+    tr_env = _trace_env(log_dir)
 
     def spawn_server(i):
         env = dict(os.environ, **(env_extra or {}), **cache_env)
@@ -655,7 +694,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
 
     def spawn_worker(i, attempt):
         env = dict(os.environ, **(env_extra or {}), **cache_env,
-                   **pm_env)
+                   **pm_env, **tr_env)
         env.update({
             "TRAINING_ROLE": "TRAINER",
             "PADDLE_TRAINER_ID": str(i),
@@ -820,6 +859,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
         raise
     finally:
         undo()
+        _merge_job_trace(log_dir)
         if hb_tmp:
             shutil.rmtree(hb_dir, ignore_errors=True)
         for f in logs:

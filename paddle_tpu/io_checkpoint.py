@@ -1710,15 +1710,20 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
       --grace_period window) checkpoints the current state, waits for
       the async writer to publish it, and exits 143 — preemption never
       loses more than the in-flight step;
-    - the flight recorder is armed (PADDLE_POSTMORTEM_DIR) and a
-      metrics snapshot is exported next to the heartbeat file
-      (monitor/exporter.py) — a supervised job leaves telemetry and
-      postmortems without any per-script wiring.
+    - the flight recorder is armed (PADDLE_POSTMORTEM_DIR), distributed
+      tracing is armed (PADDLE_TRACE_DIR — per-step span trees land in
+      <log_dir>/traces and the launcher merges them into one Perfetto
+      timeline, see monitor/trace.py), and a metrics snapshot is
+      exported next to the heartbeat file (monitor/exporter.py) — a
+      supervised job leaves telemetry and postmortems without any
+      per-script wiring.
     """
     from paddle_tpu.distributed.health import Heartbeat
     from paddle_tpu.monitor import flight_recorder
     from paddle_tpu.monitor.exporter import RankExporter
     flight_recorder.install_from_env()
+    from paddle_tpu.monitor import trace as _trace_mod
+    _trace_mod.install_from_env()
     exp = RankExporter.from_env()
     if exp is not None:
         exp.start()

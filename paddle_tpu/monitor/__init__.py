@@ -19,6 +19,10 @@ Four pieces, one API:
 - ``monitor.cost`` — per-compiled-segment FLOPs/bytes from XLA's cost
   analysis, combined with the step-time histogram into an MFU estimate
   (surfaced by ``profiler.summary()``).
+- ``monitor.trace`` — end-to-end distributed tracing: per-request /
+  per-step span trees with explicit context propagation across thread
+  boundaries, tail sampling, SLO exemplars, per-rank trace files and
+  the launcher-side cross-rank merge into one Perfetto timeline.
 
 Training-health observability (the "has the run gone wrong" half,
 docs/DEBUGGING.md):
@@ -49,6 +53,7 @@ from paddle_tpu.monitor import flight_recorder
 from paddle_tpu.monitor import numerics
 from paddle_tpu.monitor import registry
 from paddle_tpu.monitor import tensorwatch
+from paddle_tpu.monitor import trace
 from paddle_tpu.monitor.anomaly import AnomalyDetector
 from paddle_tpu.monitor.exporter import (
     MetricsServer, RankExporter, render_text, write_snapshot,
@@ -60,10 +65,14 @@ from paddle_tpu.monitor.registry import (
     histogram,
 )
 from paddle_tpu.monitor.tensorwatch import TensorMonitor
+from paddle_tpu.monitor.trace import (
+    TRACER, TraceContext, Tracer, merge_rank_traces,
+)
 
 __all__ = [
     "registry", "exporter", "flight_recorder", "cost", "numerics",
-    "tensorwatch", "anomaly",
+    "tensorwatch", "anomaly", "trace",
+    "Tracer", "TraceContext", "TRACER", "merge_rank_traces",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram",
     "RankExporter", "MetricsServer", "render_text", "write_snapshot",

@@ -138,6 +138,18 @@ class FlightRecorder:
             "events": self.events(),
             "metrics": self._metrics_snapshot(),
         }
+        try:
+            # when tracing is armed, a dump (SIGTERM, watchdog kill,
+            # uncaught exception) also carries the dumping thread's
+            # in-flight trace tree — lazy import: the recorder must
+            # stay importable standalone
+            from paddle_tpu.monitor import trace as _trace_mod
+            if _trace_mod._enabled:
+                tr = _trace_mod.inflight_report()
+                if tr is not None:
+                    doc["trace"] = tr
+        except Exception:       # telemetry must not break the dump
+            pass
         if extra:
             doc.update(extra)
         try:

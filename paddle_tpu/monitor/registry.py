@@ -207,6 +207,15 @@ class Gauge(_Metric):
         with self._lock:
             self._values.clear()
 
+    def remove(self, **labels):
+        """Drop ONE labeled series — for gauges whose label values
+        rotate (e.g. the trace exemplar's ``trace_id``): without
+        removal every superseded label value would linger in exports
+        as unbounded series cardinality."""
+        key = self._labelkey(labels)
+        with self._lock:
+            self._values.pop(key, None)
+
     def samples(self):
         with self._lock:
             return dict(self._values)

@@ -94,7 +94,7 @@ class _ShardedRing:
         return sum(len(d) for d in self._all())
 
 
-_events = _ShardedRing(_DEFAULT_MAX_EVENTS)      # (name, t0, dur, tid)
+_events = _ShardedRing(_DEFAULT_MAX_EVENTS)   # (name, t0, dur, tid, args)
 _mem_events = _ShardedRing(_DEFAULT_MAX_EVENTS)  # (name, ts, bytes, place)
 _active = {"on": False, "jax_dir": None}
 
@@ -111,10 +111,14 @@ class RecordEvent:
     """RAII span (ref: platform/profiler.h:81 RecordEvent). Feeds the
     profiler ring when profiling is on AND the flight recorder when it
     is armed — a postmortem can name in-flight spans even when the
-    profiler was never started."""
+    profiler was never started. ``args`` rides into the recorded event
+    (and the Chrome export); the executor passes ``{"flow": id}`` so
+    ``export_chrome_trace`` can pair each dispatch with the fetch that
+    materialized it BY ID instead of FIFO order."""
 
-    def __init__(self, name):
+    def __init__(self, name, args=None):
         self.name = name
+        self.args = args
 
     def __enter__(self):
         self.t0 = time.perf_counter()
@@ -126,7 +130,7 @@ class RecordEvent:
         dur = time.perf_counter() - self.t0
         if _active["on"]:
             _events.append((self.name, self.t0, dur,
-                            threading.get_ident()))
+                            threading.get_ident(), self.args))
         if _flight._enabled:
             _flight.RECORDER.span_pop(self.name, dur)
 
@@ -150,28 +154,39 @@ def export_chrome_trace(path):
     that materializes it (under async dispatch they are separated in
     time — the arrow shows which fetch paid for which dispatch), and a
     ``steps/s`` counter track derived from consecutive dispatch
-    starts."""
+    starts.
+
+    Dispatch->fetch pairing is BY SPAN ID: the executor stamps both
+    events of one ``run()`` call with the same ``args={"flow": id}``.
+    The old per-tid FIFO pairing misattributed whenever a dispatch had
+    no fetch — async steps (``return_numpy=False``) emit none, so a
+    later blocking step's fetch was paired to the oldest unpaired
+    dispatch — and whenever concurrent ``run()`` callers interleaved.
+    Events recorded without a flow id (third-party RecordEvents) keep
+    the FIFO fallback per tid."""
     spans = sorted(_events.snapshot(), key=lambda e: e[1])
     events = []
     tids = {}
-    for name, t0, dur, tid in spans:
+    for name, t0, dur, tid, _args in spans:
         tids.setdefault(tid, len(tids))
         events.append({
             "name": name, "ph": "X", "cat": "host",
             "ts": t0 * 1e6, "dur": dur * 1e6,
             "pid": 0, "tid": tids[tid],
         })
-    # flow arrows: dispatch -> the next fetch on the same thread (FIFO:
-    # with k steps in flight, fetch N still pairs with dispatch N)
     flow_id = 0
-    pending = {}                      # tid -> deque of (id, end ts)
+    by_flow = {}                      # executor flow id -> chrome id
+    fifo = {}                         # tid -> deque of chrome ids
     prev_dispatch = {}                # tid -> previous dispatch start
-    for name, t0, dur, tid in spans:
+    for name, t0, dur, tid, args in spans:
         t = tids[tid]
         if name == "executor.run/dispatch":
             flow_id += 1
-            pending.setdefault(t, collections.deque()).append(
-                (flow_id, (t0 + dur) * 1e6))
+            fid = (args or {}).get("flow")
+            if fid is not None:
+                by_flow[fid] = flow_id
+            else:
+                fifo.setdefault(t, collections.deque()).append(flow_id)
             events.append({
                 "name": "dispatch->fetch", "ph": "s", "cat": "flow",
                 "id": flow_id, "ts": (t0 + dur * 0.5) * 1e6,
@@ -185,13 +200,18 @@ def export_chrome_trace(path):
                     "pid": 0, "args": {"steps/s":
                                        round(1.0 / (t0 - last), 3)},
                 })
-        elif name == "executor.run/fetch" and pending.get(t):
-            fid, _end = pending[t].popleft()
-            events.append({
-                "name": "dispatch->fetch", "ph": "f", "bp": "e",
-                "cat": "flow", "id": fid,
-                "ts": (t0 + dur * 0.5) * 1e6, "pid": 0, "tid": t,
-            })
+        elif name == "executor.run/fetch":
+            fid = (args or {}).get("flow")
+            if fid is not None:
+                cid = by_flow.pop(fid, None)
+            else:
+                cid = fifo[t].popleft() if fifo.get(t) else None
+            if cid is not None:
+                events.append({
+                    "name": "dispatch->fetch", "ph": "f", "bp": "e",
+                    "cat": "flow", "id": cid,
+                    "ts": (t0 + dur * 0.5) * 1e6, "pid": 0, "tid": t,
+                })
     for name, ts, nbytes, place in sorted(_mem_events.snapshot(),
                                           key=lambda e: e[1]):
         events.append({
@@ -241,7 +261,7 @@ def compilation_cache_stats():
 
 def summary(sorted_key="total", profile_path=None):
     agg = {}
-    for name, _, dur, _tid in _events.snapshot():
+    for name, _, dur, _tid, _args in _events.snapshot():
         tot, cnt = agg.get(name, (0.0, 0))
         agg[name] = (tot + dur, cnt + 1)
     rows = sorted(agg.items(), key=lambda kv: -kv[1][0])

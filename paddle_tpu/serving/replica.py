@@ -80,6 +80,16 @@ class Replica:
             if mb is _STOP:
                 break
             t0 = time.perf_counter()
+            # trace stamps only (dispatch_wait ends / execute starts
+            # here; fakes enqueued by tests may lack the slots): the
+            # per-request spans assemble from these at tail-sampling
+            # keep time, so the serving hot path pays attribute
+            # stores, never span construction
+            stamped = hasattr(mb, "t_pick")
+            if stamped:
+                mb.t_pick = t0
+                mb.tid_replica = threading.get_ident()
+                mb.replica = self.index
             try:
                 outs = self.run_batch(mb.bucket, mb.feeds)
             except Exception as e:
@@ -87,6 +97,8 @@ class Replica:
                 # serving: one poisoned batch must not kill the replica
                 mb.fail(e)
                 continue
+            if stamped:
+                mb.t_exec = time.perf_counter()
             try:
                 mb.complete(outs)
             except Exception as e:

@@ -32,6 +32,14 @@ wires this to the shared replica batch queue; tests wire a fake) and
 the batch completes via ``MicroBatch.complete``/``fail`` from whatever
 thread ran it. That keeps this module import-light (numpy + stdlib) and
 unit-testable without jax.
+
+Distributed tracing (``monitor.trace``, docs/OBSERVABILITY.md): each
+request can carry a span tree ``request -> queue_wait -> batch_form ->
+dispatch_wait -> execute -> deliver``. The HOT PATH only stamps
+per-batch timestamps (``MicroBatch._TRACE_STAMPS``); the tail-sampling
+screen runs once per batch at delivery, and only kept traces
+materialize spans retroactively — so tracing costs the request path a
+handful of attribute stores and compares, not span construction.
 """
 
 import queue
@@ -41,6 +49,7 @@ import time
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.monitor import trace as _trace
 from paddle_tpu.monitor.registry import counter, gauge, histogram
 
 __all__ = [
@@ -122,15 +131,21 @@ class PendingResult:
     """Future-like handle for one submitted request. ``result()``
     blocks until the micro-batch carrying the request completes and
     returns the outputs in fetch order (each with this request's
-    leading rows), or raises the delivered error."""
+    leading rows), or raises the delivered error. When tracing is on
+    (``monitor.trace``) and this request's trace was KEPT by tail
+    sampling (errors, slow/exemplar requests, the head-sampled rate —
+    every request at ``sample_rate=1.0``), ``trace_id`` names its span
+    tree; None otherwise. The trace is materialized retroactively at
+    delivery, so read it after ``result()``."""
 
-    __slots__ = ("_event", "_outs", "_error", "t_done")
+    __slots__ = ("_event", "_outs", "_error", "t_done", "trace_id")
 
     def __init__(self):
         self._event = threading.Event()
         self._outs = None
         self._error = None
         self.t_done = None          # perf_counter at completion
+        self.trace_id = None        # monitor.trace id (kept traces)
 
     def done(self):
         return self._event.is_set()
@@ -175,9 +190,19 @@ class MicroBatch:
     (latency observed per request); ``fail(exc)`` delivers the
     exception to every request instead."""
 
+    #: per-batch trace timestamps, stamped by whatever thread ran the
+    #: phase (batcher: form; replica: pick/execute). Per-REQUEST spans
+    #: derive from these at tail-sampling KEEP time only
+    #: (_assemble_trace) — the hot path pays attribute stores, never
+    #: span construction.
+    _TRACE_STAMPS = ("t_form", "t_formed", "t_dispatch", "t_pick",
+                     "t_exec", "tid_batcher", "tid_replica", "replica")
+
     def __init__(self, requests, bucket, feed_names):
         self.requests = list(requests)
         self.bucket = int(bucket)
+        for n in self._TRACE_STAMPS:
+            setattr(self, n, None)
         self.rows = sum(r.rows for r in self.requests)
         enforce(self.rows <= self.bucket,
                 f"batch of {self.rows} rows formed for bucket "
@@ -205,19 +230,107 @@ class MicroBatch:
             enforce(o.shape[:1] == (self.bucket,),
                     f"micro-batch output leading dim {o.shape[:1]} != "
                     f"bucket {self.bucket}")
+        hint = None
+        if _trace._enabled and self.requests:
+            # the whole trace is RETROACTIVE, and the tail screen runs
+            # ONCE per micro-batch: the riders share the execute
+            # window, the FIRST rider (FIFO formation) carries the max
+            # latency, and only screened-in batches (head-sampled,
+            # slow-reservoir/exemplar candidates — a few percent)
+            # materialize contexts and assemble spans from the batch
+            # stamps, BEFORE the _deliver wakes (the woken clients
+            # contend for the GIL the moment the events set). The
+            # exemplar force-keeps the slowest request's tree so the
+            # SLO histogram's trace_id always dereferences.
+            lat0 = (now - self.requests[0].t_enqueue) * 1e3
+            hint = _trace.tail_candidate(
+                "serving_request_latency_ms", lat0, lat0 / 1e3,
+                count=len(self.requests))
         off = 0
         for r in self.requests:
-            if r.pending._deliver(outs=[o[off:off + r.rows]
-                                        for o in outs]):
+            sliced = [o[off:off + r.rows] for o in outs]
+            lat_ms = (now - r.t_enqueue) * 1e3
+            if hint is not None and not r.pending.done():
+                self._finish_trace(r, lat_ms, now, hint=hint)
+            if r.pending._deliver(outs=sliced):
                 _m_requests.inc(outcome="ok")
-                _m_latency.observe((now - r.t_enqueue) * 1e3)
+                _m_latency.observe(lat_ms)
             off += r.rows
+
+    def _finish_trace(self, r, lat_ms, t_deliver0, error=None,
+                      hint=None):
+        """Retroactive trace materialization for one delivered request
+        of a screened-in batch (``hint`` from the per-batch
+        ``tail_candidate``). ``error`` skips the screen entirely —
+        errors are always kept."""
+        if error is None and hint is None:
+            return
+        ctx = _trace.start_trace("serving/request")
+        ctx.t0 = r.t_enqueue
+        if error is None:
+            # the per-batch screen already consumed this request's
+            # sampling credit — end_trace must not count it again
+            ctx.screened = True
+            if hint == "sampled":
+                ctx.keep_reason = "sampled"
+            _trace.record_exemplar("serving_request_latency_ms",
+                                   lat_ms, ctx)
+        reason = _trace.end_trace(
+            ctx, error=error is not None,
+            assemble=lambda c: self._assemble_trace(
+                c, r, t_deliver0,
+                None if error is not None else time.perf_counter()))
+        if reason is not None:
+            # only a trace that was actually kept is worth handing to
+            # the client — a dropped candidate's id dereferences to
+            # nothing
+            r.pending.trace_id = ctx.trace_id
+
+    def _assemble_trace(self, ctx, r, t_deliver0, t_done):
+        """Materialize one request's span tree from the batch-level
+        timestamps — invoked by ``end_trace`` ONLY for kept traces.
+        Each span carries the tid of the thread that actually ran its
+        phase (stamped alongside the timestamps), so the cross-thread
+        story in the timeline stays truthful even though assembly runs
+        on the delivering thread. Phases whose stamps are missing
+        (fail before pickup) are simply absent."""
+        if self.t_form is not None:
+            _trace.record_span(ctx, "serving/queue_wait",
+                               r.t_enqueue, self.t_form,
+                               tid=self.tid_batcher)
+            _trace.record_span(
+                ctx, "serving/batch_form", self.t_form, self.t_formed,
+                tid=self.tid_batcher,
+                attrs={"bucket": self.bucket, "rows": self.rows,
+                       "fill": round(self.rows / self.bucket, 4),
+                       "pad_rows": self.bucket - self.rows})
+        if self.t_pick is not None:
+            _trace.record_span(
+                ctx, "serving/dispatch_wait",
+                self.t_dispatch if self.t_dispatch is not None
+                else self.t_pick,
+                self.t_pick, tid=self.tid_replica,
+                attrs={"replica": self.replica})
+        if self.t_exec is not None:
+            _trace.record_span(
+                ctx, "serving/execute", self.t_pick, self.t_exec,
+                tid=self.tid_replica,
+                attrs={"replica": self.replica,
+                       "bucket": self.bucket})
+        if t_done is not None:
+            _trace.record_span(ctx, "serving/deliver", t_deliver0,
+                               t_done)
 
     def fail(self, exc):
         """Deliver ``exc`` to every request not already delivered —
         safe to call after a partial ``complete`` (first-wins), so an
         executor failure can always sweep the stragglers."""
         for r in self.requests:
+            if _trace._enabled and not r.pending.done():
+                # error traces are always kept by tail sampling; the
+                # retroactive tree carries whatever phases were
+                # stamped before the failure
+                self._finish_trace(r, None, None, error=exc)
             if r.pending._deliver(error=exc):
                 _m_requests.inc(outcome="error")
 
@@ -310,6 +423,7 @@ class MicroBatchScheduler:
         :class:`QueueFullError` on backpressure, ``EnforceNotMet`` on a
         malformed request."""
         arrs, rows = self._validate(feeds)
+        req = _Request(arrs, rows)
         with self._lock:
             if self._closed or not self._started:
                 raise ServerClosedError(
@@ -320,7 +434,6 @@ class MicroBatchScheduler:
                 raise QueueFullError(
                     f"serving queue full (max_queue={self._max_queue}); "
                     f"shed load or retry after backoff")
-            req = _Request(arrs, rows)
             self._q.put_nowait(req)
         _m_queue_depth.set(self._q.qsize())
         return req.pending
@@ -383,6 +496,7 @@ class MicroBatchScheduler:
         _m_queue_depth.set(0)
 
     def _form_and_dispatch(self, requests, rows):
+        t_form = time.perf_counter()
         try:
             bucket = pick_bucket(rows, self._ladder)
             mb = MicroBatch(requests, bucket, self._feed_names)
@@ -393,6 +507,13 @@ class MicroBatchScheduler:
             # exception here used to kill the thread, hanging every
             # pending and future request while submit kept accepting
             for r in requests:
+                if _trace._enabled and not r.pending.done():
+                    # no batch, no stamps: a root-only error trace
+                    # still names the request and its fate
+                    ctx = _trace.start_trace("serving/request")
+                    ctx.t0 = r.t_enqueue
+                    r.pending.trace_id = ctx.trace_id
+                    _trace.end_trace(ctx, error=True)
                 if r.pending._deliver(error=e):
                     _m_requests.inc(outcome="error")
             return
@@ -400,6 +521,11 @@ class MicroBatchScheduler:
         _m_fill.observe(rows / bucket)
         if bucket > rows:
             _m_padded.inc(bucket - rows)
+        # trace stamps only — four attribute stores per BATCH; the
+        # per-request spans assemble from them at keep time
+        mb.t_form = t_form
+        mb.t_formed = mb.t_dispatch = time.perf_counter()
+        mb.tid_batcher = threading.get_ident()
         try:
             self._dispatch(mb)
         except Exception as e:      # dispatch itself failed: the batch

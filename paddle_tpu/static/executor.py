@@ -55,6 +55,7 @@ from paddle_tpu.core.flags import define_flag, get_flag
 from paddle_tpu.monitor import anomaly as _anomaly
 from paddle_tpu.monitor import flight_recorder as _flight
 from paddle_tpu.monitor import tensorwatch as _tensorwatch
+from paddle_tpu.monitor import trace as _trace
 from paddle_tpu.monitor.numerics import SENTINEL_KEY as _SENTINEL_KEY
 from paddle_tpu.monitor.registry import counter as _counter
 from paddle_tpu.monitor.registry import gauge as _gauge
@@ -229,13 +230,30 @@ def background_prefetch(producer, transform, depth=2):
             return True
         return False
 
+    # pipeline trace: the context is created on the CONSUMER thread
+    # and the worker records its per-item spans against it — the
+    # explicit cross-thread propagation monitor/trace.py is built on
+    # (a postmortem/timeline then shows the producer's work under the
+    # pipeline that owns it, not as orphan spans of an anonymous
+    # thread)
+    tctx = _trace.start_trace("prefetch/pipeline") \
+        if _trace._enabled else None
+
     def worker():
         produced = 0
         try:
             for b in producer:
                 if stop.is_set():
                     return
-                if not put(transform(b)):
+                if tctx is not None:
+                    t0 = time.perf_counter()
+                    item = transform(b)
+                    _trace.record_span(tctx, "prefetch/item", t0,
+                                       time.perf_counter(),
+                                       attrs={"index": produced})
+                else:
+                    item = transform(b)
+                if not put(item):
                     return
                 produced += 1
         except BaseException as e:       # surface in consumer
@@ -288,6 +306,8 @@ def background_prefetch(producer, transform, depth=2):
                 q.get_nowait()
         except _queue.Empty:
             pass
+        if tctx is not None:
+            _trace.end_trace(tctx)
 
 
 def device_prefetch(batches, depth=2, put=None):
@@ -313,6 +333,15 @@ def device_prefetch(batches, depth=2, put=None):
             out = _as_feed_array(b)
         from paddle_tpu.dataio.dataloader import _m_h2d_ms
         _m_h2d_ms.inc((time.perf_counter() - t0) * 1e3)
+        if put is None and _trace._enabled:
+            # park the staging interval for the consuming step's trace
+            # to adopt as its feed_stage phase (a feed_stage() put
+            # notes for itself — see Executor.feed_stage); keyed by
+            # the staged arrays' identity so only their consumer
+            # adopts it
+            _trace.stage_note("executor/feed_stage", t0,
+                              time.perf_counter(),
+                              key=_stage_key(out))
         return out
 
     return background_prefetch(batches, stage, depth)
@@ -337,7 +366,29 @@ def exec_op(op, env, key):
     return bound
 
 
+def _stage_key(batch):
+    """ids of the arrays a staged batch carries — the identity a
+    stage note is matched to its consuming step by (trace.adopt_stage:
+    an interleaved step that did NOT consume these arrays can never
+    adopt their staging span)."""
+    if isinstance(batch, dict):
+        return [id(v) for v in batch.values()]
+    if isinstance(batch, (tuple, list)):
+        return [id(v) for v in batch]
+    return [id(batch)]
+
+
 _ABSENT = object()
+
+#: PROCESS-GLOBAL per-run flow ids pairing each dispatch RecordEvent
+#: with the fetch that materializes it (profiler.export_chrome_trace
+#: draws the arrow by THIS id, not FIFO order — async steps emit
+#: dispatches with no fetch, which made FIFO pairing hand a later
+#: blocking step's fetch to the wrong dispatch). Global, not
+#: per-Executor: all executors share one profiler ring, and
+#: per-instance counters would collide ids across executors — the
+#: same misattribution class the id pairing exists to kill.
+_flow_ids = itertools.count(1)
 
 
 def _spec_of(v):
@@ -682,6 +733,11 @@ class Executor:
                 self._fetch_value(scope, n, return_numpy) for n in fetch_names]
 
         t_run = time.perf_counter()
+        # per-step trace (tail-sampled; monitor/trace.py): opened as
+        # this thread's CURRENT trace so an anomaly/non-finite
+        # postmortem fired mid-step embeds the phases recorded so far
+        tctx = _trace.start_trace("executor/step", current=True) \
+            if _trace._enabled else None
         with RecordEvent("executor.run/prepare"):
             feeds = {k: _as_feed_array(v) for k, v in feed.items()}
             dsig = self._dispatch_sig(program, spec, feeds,
@@ -704,6 +760,20 @@ class Executor:
             if spec is not None:
                 feeds = spec.shard_feeds(feeds)
                 state = self._ensure_resident(state, runner, fast)
+        if tctx is not None:
+            _trace.record_span(tctx, "executor/prepare", t_run,
+                               time.perf_counter())
+            # adopt the prefetch worker's staging interval for the
+            # batch this step consumes: the span ran on the worker
+            # thread (its tid says so) but belongs to THIS step's
+            # tree. Matched BY ARRAY IDENTITY — only the note whose
+            # staged arrays this step actually feeds is adopted, so an
+            # interleaved manually-fed step (even one fed device_put
+            # jax arrays) can neither steal a pipeline's note nor
+            # shift later adoptions off by one.
+            if feed:
+                _trace.adopt_stage(
+                    tctx, match={id(v) for v in feed.values()})
 
         # per-step rng: the base key is staged on device once per seed,
         # and the step fold happens INSIDE the jitted program (the old
@@ -712,14 +782,24 @@ class Executor:
         base_key = self._base_key(program.random_seed)
         step_idx = np.uint32(scope.find_var("@step@") or 0)
         scope.set_var("@step@", (scope.find_var("@step@") or 0) + 1)
+        if tctx is not None:
+            tctx.attrs["step"] = int(step_idx)
         check = bool(get_flag("check_nan_inf"))
-        with RecordEvent("executor.run/dispatch"):
+        fid = next(_flow_ids)
+        t_disp = time.perf_counter()
+        with RecordEvent("executor.run/dispatch", args={"flow": fid}):
             if check:
                 fetches, new_state, sentinels = runner.step(
                     state, feeds, base_key, step_idx, check=True)
             else:
                 fetches, new_state = runner.step(state, feeds, base_key,
                                                  step_idx)
+        if tctx is not None:
+            # recorded BEFORE the sentinel verification so a
+            # non-finite trip's postmortem already names the dispatch
+            # phase and its duration
+            _trace.record_span(tctx, "executor/dispatch", t_disp,
+                               time.perf_counter())
         if check:
             # the one deliberate host sync of the checked mode: a
             # scalar per segment, verified BEFORE the new state reaches
@@ -739,11 +819,14 @@ class Executor:
             # fetches; published after the step-time observation below
             watch_v = fetches.pop(runner.watch_idx)
         if return_numpy:
-            with RecordEvent("executor.run/fetch"):
+            with RecordEvent("executor.run/fetch", args={"flow": fid}):
                 t_fetch = time.perf_counter()
                 fetches = [np.asarray(f) for f in fetches]
                 _m_fetch_ms.observe(
                     (time.perf_counter() - t_fetch) * 1e3)
+            if tctx is not None:
+                _trace.record_span(tctx, "executor/fetch", t_fetch,
+                                   time.perf_counter())
         elif runner.step.donated_fetch_idx:
             # async contract: a fetched var that is also donated state
             # (e.g. fetch_list=[some_param]) would have its buffer
@@ -766,6 +849,13 @@ class Executor:
         if _flight._enabled:
             _flight.RECORDER.note("step", "executor.run",
                                   step=int(step_idx))
+        if tctx is not None:
+            # exemplar BEFORE the tail-sampling verdict (it force-
+            # keeps the slowest step's tree), end AFTER the anomaly
+            # feed above (a step_stall trip must still find this trace
+            # in flight to embed it in its postmortem)
+            _trace.record_exemplar("executor_step_ms", step_ms, tctx)
+            _trace.end_trace(tctx)
         return fetches
 
     def prepare(self, program=None, feed=None, fetch_list=None,
@@ -850,8 +940,24 @@ class Executor:
             spec = program._spec
         names = list(feed_names) if feed_names is not None else None
 
+        def _staged(base_put):
+            # tracing wrapper: the staging runs in a prefetch WORKER
+            # thread, so the interval is parked as a stage note the
+            # consuming step's trace adopts (monitor/trace.py) — one
+            # `_enabled` check per batch when tracing is off
+            def staged(batch):
+                if not _trace._enabled:
+                    return base_put(batch)
+                t0 = time.perf_counter()
+                out = base_put(batch)
+                _trace.stage_note("executor/feed_stage", t0,
+                                  time.perf_counter(),
+                                  key=_stage_key(out))
+                return out
+            return staged
+
         if spec is None:
-            return jax.device_put
+            return _staged(jax.device_put)
 
         def place(name, v):
             sh = spec.feed_sharding(name, np.ndim(v))
@@ -886,7 +992,7 @@ class Executor:
                     f"this array feeds")
             return place(names[0], batch)
 
-        return put
+        return _staged(put)
 
     # -- internals ---------------------------------------------------------
     def _prepare_runner(self, program, feeds, fetch_names, scope, spec):

@@ -54,6 +54,8 @@ class TestBenchModes:
         metrics_out = str(tmp_path / "serving_metrics.prom")
         lines = _run_mode("serving",
                           extra_env={"BENCH_SERVING_REQS": "40",
+                                     "BENCH_SERVING_TRACE_PAIRS": "2",
+                                     "BENCH_SERVING_TRACE_WIN": "60",
                                      "BENCH_METRICS_OUT": metrics_out})
         by = {ln["metric"]: ln for ln in lines}
         for tag in ("serving_baseline_qps", "serving_server_qps"):
@@ -68,13 +70,59 @@ class TestBenchModes:
         assert 0 < srv["batch_fill_ratio"] <= 1.0
         ratio = by["serving_server_vs_baseline_qps"]
         assert ratio["unit"] == "x" and ratio["value"] > 0
+        # p99 attribution: traced open-loop pass must split the
+        # slowest decile's time into phase shares that sum sanely
+        attr = by["serving_p99_attribution"]
+        assert attr["unit"] == "ms" and attr["value"] > 0
+        assert attr["n_slowest"] >= 1
+        shares = [attr[k] for k in
+                  ("queue_wait_share", "batch_form_share",
+                   "dispatch_wait_share", "execute_share",
+                   "deliver_share")]
+        assert all(s is not None and 0 <= s <= 1 for s in shares), attr
+        assert sum(shares) > 0.3, attr       # phases cover the latency
+        # tracing overhead: interleaved ABBA open-loop p50 A/B must
+        # stay within 1.05x (the ISSUE's hot-path-cheapness bound)
+        ov = by["serving_trace_overhead_ratio"]
+        assert ov["unit"] == "x" and ov["value"] > 0
+        assert ov["value"] < 1.05, ov
+        assert ov["traced_p50_ms"] > 0 and ov["untraced_p50_ms"] > 0
         with open(metrics_out) as f:
             snap = f.read()
         for name in ("serving_requests_total", "serving_queue_depth",
                      "serving_batch_fill_ratio",
                      "serving_padded_waste_total",
-                     "serving_request_latency_ms"):
+                     "serving_request_latency_ms",
+                     "trace_spans_total", "trace_traces_kept_total"):
             assert name in snap, f"{name} missing from snapshot"
+
+    def test_dispatch_mode_emits_trace_overhead_and_attribution(self):
+        """`bench.py dispatch` must A/B per-step tracing on ABBA
+        micro-windows (ratio < 1.05x — tail sampling's hot-path
+        promise) and attribute the slowest decile of traced steps to
+        prepare/dispatch/fetch shares."""
+        lines = _run_mode("dispatch",
+                          extra_env={"BENCH_DISPATCH_STEPS": "10",
+                                     "BENCH_DISPATCH_TRACE_PAIRS": "6",
+                                     "BENCH_DISPATCH_TRACE_WIN": "8",
+                                     "XLA_FLAGS":
+                                     "--xla_force_host_platform_"
+                                     "device_count=8"},
+                          )
+        by = {ln["metric"]: ln for ln in lines}
+        ov = by["dispatch_trace_overhead_ratio"]
+        assert ov["unit"] == "x" and ov["value"] > 0
+        assert ov["value"] < 1.05, ov
+        # >= the base pair count (the bench gathers more pairs when
+        # the first estimate straddles the bound)
+        assert len(ov["pair_ratios"]) >= 6
+        attr = by["dispatch_p99_attribution"]
+        assert attr["value"] > 0 and attr["n_slowest"] >= 1
+        # the deep-narrow model is dispatch-dominated by design
+        assert attr["dispatch_share"] is not None \
+            and attr["dispatch_share"] > 0.2, attr
+        assert attr["prepare_share"] is not None \
+            and 0 <= attr["prepare_share"] <= 1
 
     def test_numerics_mode_emits_overhead_ratio(self):
         """`bench.py numerics` must A/B the check_nan_inf sentinels on

@@ -1,0 +1,87 @@
+"""Training worker for the distributed-tracing end-to-end test.
+
+A tiny fc-regressor loop instrumented the way a supervised worker
+should be: flight recorder + distributed tracing armed from the
+launcher's env FIRST, heartbeats each step, per-rank metrics snapshots
+(which carry the ``slo_exemplar_ms`` series the test dereferences).
+
+argv: out_prefix total_steps [slow_ms]
+
+env: TRACE_WORKER_SLOW_RANK — on that rank every compiled-step call
+gains a ``slow_ms`` sleep, injected INSIDE ``_CompiledStep.__call__``
+so it lands inside the step trace's ``executor/dispatch`` span. That
+is the fault the merged job trace plus the SLO exemplar must pin to
+(a) the right rank and (b) the dispatch phase.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    out_prefix = sys.argv[1]
+    total_steps = int(sys.argv[2])
+    slow_ms = float(sys.argv[3]) if len(sys.argv) > 3 else 50.0
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+
+    from paddle_tpu.monitor import flight_recorder, trace
+    flight_recorder.install_from_env()
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.health import Heartbeat
+    from paddle_tpu.monitor.exporter import RankExporter
+
+    hb = Heartbeat.from_env(interval=0.1)
+    exp = RankExporter.from_env(interval=0.5)
+    if exp is not None:
+        exp.start()
+
+    if os.environ.get("TRACE_WORKER_SLOW_RANK") == rank:
+        from paddle_tpu.static import executor as _ex
+        orig = _ex._CompiledStep.__call__
+
+        def slow_call(self, *a, **k):
+            time.sleep(slow_ms / 1e3)
+            return orig(self, *a, **k)
+
+        _ex._CompiledStep.__call__ = slow_call
+
+    pt.enable_static()
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        x = pt.static.data("x", [4], dtype="float32")
+        y = pt.static.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = pt.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True).astype(np.float32)
+    # warm (compile) BEFORE arming tracing: the one-off XLA compile
+    # step would otherwise own every rank's step-time exemplar for the
+    # whole window, drowning the steady-state signal the test injects
+    exe.run(main_p, feed={"x": xv, "y": yv}, fetch_list=[loss])
+    trace.install_from_env()
+    losses = []
+    for _step in range(total_steps):
+        (lv,) = exe.run(main_p, feed={"x": xv, "y": yv},
+                        fetch_list=[loss])
+        losses.append(float(lv))
+        if hb is not None:
+            hb.beat()
+        time.sleep(0.01)
+    trace.flush()
+    if exp is not None:
+        exp.stop()          # final snapshot carries the exemplar
+    with open(f"{out_prefix}.rank{rank}.json", "w") as f:
+        json.dump({"steps": total_steps, "losses": losses[:3]}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,27 @@ _REG_RE = re.compile(
 _DOC_RE = re.compile(
     r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|\s*([a-z]+)\s*\|",
     re.MULTILINE)
+# the trace module's exemplar-metric declaration: every name listed
+# there must be a documented HISTOGRAM (the exemplar is "the slowest
+# observation of <histogram>"; an exemplar on a gauge/counter would be
+# meaningless, and an undocumented one invisible)
+_EXEMPLAR_RE = re.compile(
+    r"EXEMPLAR_METRICS\s*=\s*\(([^)]*)\)", re.DOTALL)
+_NAME_IN_TUPLE_RE = re.compile(r"[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']")
+
+
+def exemplar_metrics(repo=REPO):
+    """Names declared in monitor/trace.py's EXEMPLAR_METRICS tuple
+    (statically parsed — the lint must not import the tree)."""
+    path = os.path.join(repo, "paddle_tpu", "monitor", "trace.py")
+    try:
+        with open(path) as f:
+            m = _EXEMPLAR_RE.search(f.read())
+    except OSError:
+        return []
+    if not m:
+        return []
+    return _NAME_IN_TUPLE_RE.findall(m.group(1))
 
 
 def code_metrics(repo=REPO):
@@ -75,6 +96,10 @@ def main():
         (n, next(iter(code[n])), docs[n])
         for n in set(code) & set(docs)
         if len(code[n]) == 1 and docs[n] not in code[n])
+    bad_exemplars = sorted(
+        n for n in exemplar_metrics()
+        if docs.get(n) != "histogram" or "histogram" not in
+        code.get(n, set()))
     if undocumented:
         print(f"metrics registered in code but missing from "
               f"docs/OBSERVABILITY.md catalogue: {undocumented}")
@@ -87,7 +112,12 @@ def main():
     for name, ck, dk in mismatched:
         print(f"metric {name!r} is registered as a {ck} but "
               f"documented as a {dk}")
-    if undocumented or stale or conflicted or mismatched:
+    for name in bad_exemplars:
+        print(f"exemplar metric {name!r} (monitor/trace.py "
+              f"EXEMPLAR_METRICS) must be a registered AND documented "
+              f"histogram")
+    if undocumented or stale or conflicted or mismatched \
+            or bad_exemplars:
         return 1
     print(f"metrics catalogue in sync ({len(code)} metrics, "
           f"kinds verified)")
