@@ -44,7 +44,6 @@ import statistics
 import threading
 
 from paddle_tpu.monitor import flight_recorder as _flight
-from paddle_tpu.monitor import trace as _trace
 from paddle_tpu.monitor.registry import counter, gauge
 
 __all__ = [
@@ -95,13 +94,10 @@ def trip(kind, report=None, step=None):
     doc["kind"] = kind
     if step is not None:
         doc.setdefault("step", step)
-    if _trace._enabled:
-        # embed the tripping thread's in-flight span tree: the
-        # postmortem then names the PHASE the step died in
-        # (dispatch vs fetch vs feed_stage), not just the step number
-        tr = _trace.inflight_report()
-        if tr is not None:
-            doc["trace"] = tr
+    # the tripping thread's in-flight span tree rides the dump's own
+    # top-level "trace" embed (flight_recorder.dump) — the postmortem
+    # names the PHASE the step died in (dispatch vs fetch vs
+    # feed_stage), not just the step number
     return _flight.RECORDER.dump(reason=f"anomaly-{kind}",
                                  extra={"anomaly": doc})
 

@@ -96,9 +96,11 @@ _enabled = False
 
 _m_spans = counter(
     "trace_spans_total",
-    "Spans recorded into trace trees (pre-tail-sampling; dropped "
-    "traces' spans count too — this is the recording hot path's "
-    "volume)")
+    "Spans recorded into trace trees. For span-recording paths "
+    "(executor, prefetch) this is pre-tail-sampling volume — dropped "
+    "traces' spans count too; deferred-assembly traces (serving) only "
+    "materialize spans when kept, so a dropped request counts its "
+    "root alone")
 _m_kept = counter(
     "trace_traces_kept_total",
     "Traces kept by tail sampling, by reason: error (a span errored), "
@@ -119,6 +121,13 @@ _g_exemplar = gauge(
 #: spans one trace may hold before the oldest drop (a long-lived
 #: pipeline trace must not grow host memory without bound)
 _MAX_SPANS_PER_TRACE = 256
+
+#: how long an unadopted stage note may park before adopt_stage drops
+#: it: once the staged arrays are garbage-collected their id()s can be
+#: reused, and a stale note matched by a recycled id would misattribute
+#: its feed_stage phase to an unrelated step. Staging-to-consumption is
+#: normally sub-second; a note this old has no live consumer.
+_STAGE_NOTE_TTL_S = 60.0
 
 #: PROCESS-GLOBAL trace-id sequence: ids must stay unique across
 #: tracer rebuilds (enable(**kwargs) swaps the Tracer but the gauge
@@ -506,10 +515,17 @@ class Tracer:
         those arrays."""
         d = dict(attrs or {})
         d["stage_seq"] = next(self._stage_seq)
-        self._stage_notes.append(
-            (name, t0, t1,
-             threading.get_ident() if tid is None else tid, d,
-             frozenset(key) if key is not None else None))
+        # the trailing parked-at stamp (NOT t1, which callers may
+        # backfill) is what adopt_stage ages the note out by
+        note = (name, t0, t1,
+                threading.get_ident() if tid is None else tid, d,
+                frozenset(key) if key is not None else None,
+                time.perf_counter())
+        # locked: adopt_stage iterates this deque from the consumer
+        # thread while prefetch workers append — an unlocked append
+        # mid-iteration raises "deque mutated during iteration" there
+        with self._lock:
+            self._stage_notes.append(note)
 
     def adopt_stage(self, ctx, match=None):
         """Adopt a parked stage note as a span of ``ctx`` — the
@@ -520,21 +536,30 @@ class Tracer:
         an interleaved manually-fed step can neither steal a
         pipeline's note nor shift later adoptions off by one. Without
         ``match``, FIFO. Returns the span id or None."""
-        if match is None:
-            try:
-                note = self._stage_notes.popleft()
-            except IndexError:
-                return None
-        else:
-            note = None
-            for n in self._stage_notes:
-                if n[5] is not None and not n[5].isdisjoint(match):
-                    note = n
-                    break
-            if note is None:
-                return None
-            self._stage_notes.remove(note)
-        name, t0, t1, tid, attrs, _key = note
+        with self._lock:
+            # age out notes nobody adopted (an abandoned pipeline):
+            # the staged arrays are gone and CPython may reuse their
+            # ids, so a lingering note could otherwise be adopted into
+            # an unrelated later step's tree. FIFO by parked-at, so
+            # popping stale heads bounds the lingering window.
+            horizon = time.perf_counter() - _STAGE_NOTE_TTL_S
+            while self._stage_notes and self._stage_notes[0][6] < horizon:
+                self._stage_notes.popleft()
+            if match is None:
+                try:
+                    note = self._stage_notes.popleft()
+                except IndexError:
+                    return None
+            else:
+                note = None
+                for n in self._stage_notes:
+                    if n[5] is not None and not n[5].isdisjoint(match):
+                        note = n
+                        break
+                if note is None:
+                    return None
+                self._stage_notes.remove(note)
+        name, t0, t1, tid, attrs, _key, _parked = note
         return self.record_span(ctx, name, t0, t1, tid=tid,
                                 attrs=attrs)
 
@@ -565,8 +590,14 @@ class Tracer:
 
     # -- arming ------------------------------------------------------------
     def install(self, dirname):
-        """Arm the jsonl writer under ``dirname`` for this rank."""
+        """Arm the jsonl writer under ``dirname`` for this rank. An
+        already-armed writer is flushed before being replaced — a
+        re-arm (enable(d) twice, or install_from_env after a manual
+        enable) must not drop its buffered span lines on the floor."""
         rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        old = self._writer
+        if old is not None:
+            old.flush()
         self._writer = _TraceWriter(
             dirname, rank if rank.isdigit() else "0")
         return self._writer.path
@@ -615,7 +646,8 @@ def disable():
     adopted by an unrelated later step."""
     global _enabled
     _enabled = False
-    TRACER._stage_notes.clear()
+    with TRACER._lock:
+        TRACER._stage_notes.clear()
     TRACER.flush()
 
 
@@ -633,10 +665,19 @@ def install_from_env(env=None):
     if not d:
         return None
     kw = {}
+    # malformed knobs fall back to defaults: this runs inside
+    # auto_checkpoint's startup wiring, and the tracing stack is
+    # never-fail — a typo'd sample rate must not kill the worker
     if env.get(ENV_SAMPLE):
-        kw["sample_rate"] = float(env[ENV_SAMPLE])
+        try:
+            kw["sample_rate"] = float(env[ENV_SAMPLE])
+        except ValueError:
+            pass
     if env.get(ENV_SLOW_KEEP):
-        kw["slow_keep"] = int(env[ENV_SLOW_KEEP])
+        try:
+            kw["slow_keep"] = int(env[ENV_SLOW_KEEP])
+        except ValueError:
+            pass
     return enable(d, **kw)
 
 

@@ -138,7 +138,8 @@ class PendingResult:
     tree; None otherwise. The trace is materialized retroactively at
     delivery, so read it after ``result()``."""
 
-    __slots__ = ("_event", "_outs", "_error", "t_done", "trace_id")
+    __slots__ = ("_event", "_outs", "_error", "t_done", "trace_id",
+                 "_claim")
 
     def __init__(self):
         self._event = threading.Event()
@@ -146,6 +147,7 @@ class PendingResult:
         self._error = None
         self.t_done = None          # perf_counter at completion
         self.trace_id = None        # monitor.trace id (kept traces)
+        self._claim = threading.Lock()
 
     def done(self):
         return self._event.is_set()
@@ -158,12 +160,25 @@ class PendingResult:
             raise self._error
         return self._outs
 
-    def _deliver(self, outs=None, error=None):
+    def claim(self):
+        """Atomically win the right to deliver this request — first
+        wins, losers get False and must deliver NOTHING. The claim
+        (not ``done()``) is the delivery arbiter: ``complete`` racing
+        ``fail`` on another thread would otherwise both pass a
+        ``done()`` pre-check and materialize two traces for one
+        request, with ``trace_id`` naming whichever finished last —
+        possibly an "ok" tree for a request that was delivered the
+        error. The winner may do pre-wake work (retroactive trace
+        assembly, so ``trace_id`` is readable the moment ``result()``
+        returns) and MUST then call ``_deliver(claimed=True)``."""
+        return self._claim.acquire(False)
+
+    def _deliver(self, outs=None, error=None, claimed=False):
         """First delivery wins: a failure-path sweep (``MicroBatch.
         fail`` after a partial ``complete``) must not overwrite a
         result a caller may already be reading. Returns whether this
         call delivered."""
-        if self._event.is_set():
+        if not claimed and not self.claim():
             return False
         self._outs = outs
         self._error = error
@@ -250,9 +265,14 @@ class MicroBatch:
         for r in self.requests:
             sliced = [o[off:off + r.rows] for o in outs]
             lat_ms = (now - r.t_enqueue) * 1e3
-            if hint is not None and not r.pending.done():
-                self._finish_trace(r, lat_ms, now, hint=hint)
-            if r.pending._deliver(outs=sliced):
+            # claim BEFORE trace assembly: the claim is the first-wins
+            # arbiter against a racing fail(), so exactly one thread
+            # materializes exactly one trace — and it is the thread
+            # whose outcome the client actually receives
+            if r.pending.claim():
+                if hint is not None:
+                    self._finish_trace(r, lat_ms, now, hint=hint)
+                r.pending._deliver(outs=sliced, claimed=True)
                 _m_requests.inc(outcome="ok")
                 _m_latency.observe(lat_ms)
             off += r.rows
@@ -265,26 +285,33 @@ class MicroBatch:
         errors are always kept."""
         if error is None and hint is None:
             return
-        ctx = _trace.start_trace("serving/request")
-        ctx.t0 = r.t_enqueue
-        if error is None:
-            # the per-batch screen already consumed this request's
-            # sampling credit — end_trace must not count it again
-            ctx.screened = True
-            if hint == "sampled":
-                ctx.keep_reason = "sampled"
-            _trace.record_exemplar("serving_request_latency_ms",
-                                   lat_ms, ctx)
-        reason = _trace.end_trace(
-            ctx, error=error is not None,
-            assemble=lambda c: self._assemble_trace(
-                c, r, t_deliver0,
-                None if error is not None else time.perf_counter()))
-        if reason is not None:
-            # only a trace that was actually kept is worth handing to
-            # the client — a dropped candidate's id dereferences to
-            # nothing
-            r.pending.trace_id = ctx.trace_id
+        try:
+            ctx = _trace.start_trace("serving/request")
+            ctx.t0 = r.t_enqueue
+            if error is None:
+                # the per-batch screen already consumed this request's
+                # sampling credit — end_trace must not count it again
+                ctx.screened = True
+                if hint == "sampled":
+                    ctx.keep_reason = "sampled"
+                _trace.record_exemplar("serving_request_latency_ms",
+                                       lat_ms, ctx)
+            reason = _trace.end_trace(
+                ctx, error=error is not None,
+                assemble=lambda c: self._assemble_trace(
+                    c, r, t_deliver0,
+                    None if error is not None else time.perf_counter()))
+            if reason is not None:
+                # only a trace that was actually kept is worth handing
+                # to the client — a dropped candidate's id dereferences
+                # to nothing
+                r.pending.trace_id = ctx.trace_id
+        except Exception:
+            # telemetry must not break delivery: this runs INSIDE the
+            # claim->_deliver window, and an escaped exception would
+            # strand the claimed request forever (no sweep can re-claim
+            # it, so result() would never wake)
+            pass
 
     def _assemble_trace(self, ctx, r, t_deliver0, t_done):
         """Materialize one request's span tree from the batch-level
@@ -326,12 +353,13 @@ class MicroBatch:
         safe to call after a partial ``complete`` (first-wins), so an
         executor failure can always sweep the stragglers."""
         for r in self.requests:
-            if _trace._enabled and not r.pending.done():
-                # error traces are always kept by tail sampling; the
-                # retroactive tree carries whatever phases were
-                # stamped before the failure
-                self._finish_trace(r, None, None, error=exc)
-            if r.pending._deliver(error=exc):
+            if r.pending.claim():   # first-wins vs a racing complete()
+                if _trace._enabled:
+                    # error traces are always kept by tail sampling;
+                    # the retroactive tree carries whatever phases
+                    # were stamped before the failure
+                    self._finish_trace(r, None, None, error=exc)
+                r.pending._deliver(error=exc, claimed=True)
                 _m_requests.inc(outcome="error")
 
 
@@ -423,7 +451,6 @@ class MicroBatchScheduler:
         :class:`QueueFullError` on backpressure, ``EnforceNotMet`` on a
         malformed request."""
         arrs, rows = self._validate(feeds)
-        req = _Request(arrs, rows)
         with self._lock:
             if self._closed or not self._started:
                 raise ServerClosedError(
@@ -434,6 +461,11 @@ class MicroBatchScheduler:
                 raise QueueFullError(
                     f"serving queue full (max_queue={self._max_queue}); "
                     f"shed load or retry after backoff")
+            # constructed AFTER admission: a shed request must not pay
+            # the Event/Lock allocation, and t_enqueue (the batcher's
+            # max_wait deadline anchor AND the latency-metric origin)
+            # must not start ticking while submit contends for the lock
+            req = _Request(arrs, rows)
             self._q.put_nowait(req)
         _m_queue_depth.set(self._q.qsize())
         return req.pending
@@ -507,15 +539,20 @@ class MicroBatchScheduler:
             # exception here used to kill the thread, hanging every
             # pending and future request while submit kept accepting
             for r in requests:
-                if _trace._enabled and not r.pending.done():
-                    # no batch, no stamps: a root-only error trace
-                    # still names the request and its fate
-                    ctx = _trace.start_trace("serving/request")
-                    ctx.t0 = r.t_enqueue
-                    r.pending.trace_id = ctx.trace_id
-                    _trace.end_trace(ctx, error=True)
-                if r.pending._deliver(error=e):
-                    _m_requests.inc(outcome="error")
+                if not r.pending.claim():
+                    continue
+                if _trace._enabled:
+                    try:
+                        # no batch, no stamps: a root-only error trace
+                        # still names the request and its fate
+                        ctx = _trace.start_trace("serving/request")
+                        ctx.t0 = r.t_enqueue
+                        r.pending.trace_id = ctx.trace_id
+                        _trace.end_trace(ctx, error=True)
+                    except Exception:  # telemetry must not block
+                        pass           # delivery of a claimed request
+                r.pending._deliver(error=e, claimed=True)
+                _m_requests.inc(outcome="error")
             return
         _m_batches.inc()
         _m_fill.observe(rows / bucket)

@@ -738,125 +738,142 @@ class Executor:
         # postmortem fired mid-step embeds the phases recorded so far
         tctx = _trace.start_trace("executor/step", current=True) \
             if _trace._enabled else None
-        with RecordEvent("executor.run/prepare"):
-            feeds = {k: _as_feed_array(v) for k, v in feed.items()}
-            dsig = self._dispatch_sig(program, spec, feeds,
-                                      fetch_names, scope)
-            fast = bool(get_flag("executor_fast_path"))
-            runner = self._runners.get(dsig) if fast else None
-            if runner is None or not runner.fresh_for(scope):
-                runner = self._prepare_runner(program, feeds, fetch_names,
-                                              scope, spec)
-                if fast:
-                    self._store_runner(dsig, runner)
-            state = self._gather_state(runner, scope)
-            if state is None:             # scope changed under us
-                runner = self._prepare_runner(program, feeds, fetch_names,
-                                              scope, spec)
-                if fast:
-                    self._store_runner(dsig, runner)
+        if tctx is not None:
+            # the root must start at t_run: the prepare child span is
+            # stamped from t_run, and a child beginning before its own
+            # root renders mis-nested in the merged timeline
+            tctx.t0 = t_run
+        try:
+            with RecordEvent("executor.run/prepare"):
+                feeds = {k: _as_feed_array(v) for k, v in feed.items()}
+                dsig = self._dispatch_sig(program, spec, feeds,
+                                          fetch_names, scope)
+                fast = bool(get_flag("executor_fast_path"))
+                runner = self._runners.get(dsig) if fast else None
+                if runner is None or not runner.fresh_for(scope):
+                    runner = self._prepare_runner(program, feeds, fetch_names,
+                                                  scope, spec)
+                    if fast:
+                        self._store_runner(dsig, runner)
                 state = self._gather_state(runner, scope)
+                if state is None:             # scope changed under us
+                    runner = self._prepare_runner(program, feeds, fetch_names,
+                                                  scope, spec)
+                    if fast:
+                        self._store_runner(dsig, runner)
+                    state = self._gather_state(runner, scope)
 
-            if spec is not None:
-                feeds = spec.shard_feeds(feeds)
-                state = self._ensure_resident(state, runner, fast)
-        if tctx is not None:
-            _trace.record_span(tctx, "executor/prepare", t_run,
-                               time.perf_counter())
-            # adopt the prefetch worker's staging interval for the
-            # batch this step consumes: the span ran on the worker
-            # thread (its tid says so) but belongs to THIS step's
-            # tree. Matched BY ARRAY IDENTITY — only the note whose
-            # staged arrays this step actually feeds is adopted, so an
-            # interleaved manually-fed step (even one fed device_put
-            # jax arrays) can neither steal a pipeline's note nor
-            # shift later adoptions off by one.
-            if feed:
-                _trace.adopt_stage(
-                    tctx, match={id(v) for v in feed.values()})
-
-        # per-step rng: the base key is staged on device once per seed,
-        # and the step fold happens INSIDE the jitted program (the old
-        # eager PRNGKey+fold_in cost two device round-trips per step on
-        # the remote-PJRT tunnel)
-        base_key = self._base_key(program.random_seed)
-        step_idx = np.uint32(scope.find_var("@step@") or 0)
-        scope.set_var("@step@", (scope.find_var("@step@") or 0) + 1)
-        if tctx is not None:
-            tctx.attrs["step"] = int(step_idx)
-        check = bool(get_flag("check_nan_inf"))
-        fid = next(_flow_ids)
-        t_disp = time.perf_counter()
-        with RecordEvent("executor.run/dispatch", args={"flow": fid}):
-            if check:
-                fetches, new_state, sentinels = runner.step(
-                    state, feeds, base_key, step_idx, check=True)
-            else:
-                fetches, new_state = runner.step(state, feeds, base_key,
-                                                 step_idx)
-        if tctx is not None:
-            # recorded BEFORE the sentinel verification so a
-            # non-finite trip's postmortem already names the dispatch
-            # phase and its duration
-            _trace.record_span(tctx, "executor/dispatch", t_disp,
-                               time.perf_counter())
-        if check:
-            # the one deliberate host sync of the checked mode: a
-            # scalar per segment, verified BEFORE the new state reaches
-            # the scope so a trip leaves the pre-step params intact for
-            # inspection. handle_trip localizes + raises.
-            for seg_i, s in enumerate(sentinels):
-                if not bool(np.asarray(s)):
-                    from paddle_tpu.monitor import numerics as _numerics
-                    _numerics.handle_trip(runner.step, state, feeds,
-                                          base_key, step_idx, seg_i)
-        for n, v in new_state.items():
-            scope.set_var(n, v)
-        watch_v = None
-        if runner.watch_idx is not None:
-            # @watch@stats rides last in the fetch list (auto-appended
-            # by _prepare_runner) — peel it off before the user sees
-            # fetches; published after the step-time observation below
-            watch_v = fetches.pop(runner.watch_idx)
-        if return_numpy:
-            with RecordEvent("executor.run/fetch", args={"flow": fid}):
-                t_fetch = time.perf_counter()
-                fetches = [np.asarray(f) for f in fetches]
-                _m_fetch_ms.observe(
-                    (time.perf_counter() - t_fetch) * 1e3)
+                if spec is not None:
+                    feeds = spec.shard_feeds(feeds)
+                    state = self._ensure_resident(state, runner, fast)
             if tctx is not None:
-                _trace.record_span(tctx, "executor/fetch", t_fetch,
+                _trace.record_span(tctx, "executor/prepare", t_run,
                                    time.perf_counter())
-        elif runner.step.donated_fetch_idx:
-            # async contract: a fetched var that is also donated state
-            # (e.g. fetch_list=[some_param]) would have its buffer
-            # deleted by the NEXT step's donation before the caller
-            # materializes it — hand back an (async) device copy
-            for i in runner.step.donated_fetch_idx:
-                fetches[i] = jnp.array(fetches[i], copy=True)
-        _m_steps.inc()
-        step_ms = (time.perf_counter() - t_run) * 1e3
-        _m_step_ms.observe(step_ms)
-        if watch_v is not None and _tensorwatch._enabled:
-            _tensorwatch.on_step(watch_v, int(step_idx),
-                                 sync=return_numpy)
-        if _anomaly._enabled:
-            # keyed by compiled-step identity: train and eval programs
-            # through one executor get separate stall baselines
-            _anomaly.DETECTOR.observe(step=int(step_idx),
-                                      step_ms=step_ms,
-                                      step_ms_key=runner.step.uid)
-        if _flight._enabled:
-            _flight.RECORDER.note("step", "executor.run",
-                                  step=int(step_idx))
-        if tctx is not None:
-            # exemplar BEFORE the tail-sampling verdict (it force-
-            # keeps the slowest step's tree), end AFTER the anomaly
-            # feed above (a step_stall trip must still find this trace
-            # in flight to embed it in its postmortem)
-            _trace.record_exemplar("executor_step_ms", step_ms, tctx)
-            _trace.end_trace(tctx)
-        return fetches
+                # adopt the prefetch worker's staging interval for the
+                # batch this step consumes: the span ran on the worker
+                # thread (its tid says so) but belongs to THIS step's
+                # tree. Matched BY ARRAY IDENTITY — only the note whose
+                # staged arrays this step actually feeds is adopted, so an
+                # interleaved manually-fed step (even one fed device_put
+                # jax arrays) can neither steal a pipeline's note nor
+                # shift later adoptions off by one.
+                if feed:
+                    _trace.adopt_stage(
+                        tctx, match={id(v) for v in feed.values()})
+
+            # per-step rng: the base key is staged on device once per seed,
+            # and the step fold happens INSIDE the jitted program (the old
+            # eager PRNGKey+fold_in cost two device round-trips per step on
+            # the remote-PJRT tunnel)
+            base_key = self._base_key(program.random_seed)
+            step_idx = np.uint32(scope.find_var("@step@") or 0)
+            scope.set_var("@step@", (scope.find_var("@step@") or 0) + 1)
+            if tctx is not None:
+                tctx.attrs["step"] = int(step_idx)
+            check = bool(get_flag("check_nan_inf"))
+            fid = next(_flow_ids)
+            t_disp = time.perf_counter()
+            with RecordEvent("executor.run/dispatch", args={"flow": fid}):
+                if check:
+                    fetches, new_state, sentinels = runner.step(
+                        state, feeds, base_key, step_idx, check=True)
+                else:
+                    fetches, new_state = runner.step(state, feeds, base_key,
+                                                     step_idx)
+            if tctx is not None:
+                # recorded BEFORE the sentinel verification so a
+                # non-finite trip's postmortem already names the dispatch
+                # phase and its duration
+                _trace.record_span(tctx, "executor/dispatch", t_disp,
+                                   time.perf_counter())
+            if check:
+                # the one deliberate host sync of the checked mode: a
+                # scalar per segment, verified BEFORE the new state reaches
+                # the scope so a trip leaves the pre-step params intact for
+                # inspection. handle_trip localizes + raises.
+                for seg_i, s in enumerate(sentinels):
+                    if not bool(np.asarray(s)):
+                        from paddle_tpu.monitor import numerics as _numerics
+                        _numerics.handle_trip(runner.step, state, feeds,
+                                              base_key, step_idx, seg_i)
+            for n, v in new_state.items():
+                scope.set_var(n, v)
+            watch_v = None
+            if runner.watch_idx is not None:
+                # @watch@stats rides last in the fetch list (auto-appended
+                # by _prepare_runner) — peel it off before the user sees
+                # fetches; published after the step-time observation below
+                watch_v = fetches.pop(runner.watch_idx)
+            if return_numpy:
+                with RecordEvent("executor.run/fetch", args={"flow": fid}):
+                    t_fetch = time.perf_counter()
+                    fetches = [np.asarray(f) for f in fetches]
+                    _m_fetch_ms.observe(
+                        (time.perf_counter() - t_fetch) * 1e3)
+                if tctx is not None:
+                    _trace.record_span(tctx, "executor/fetch", t_fetch,
+                                       time.perf_counter())
+            elif runner.step.donated_fetch_idx:
+                # async contract: a fetched var that is also donated state
+                # (e.g. fetch_list=[some_param]) would have its buffer
+                # deleted by the NEXT step's donation before the caller
+                # materializes it — hand back an (async) device copy
+                for i in runner.step.donated_fetch_idx:
+                    fetches[i] = jnp.array(fetches[i], copy=True)
+            _m_steps.inc()
+            step_ms = (time.perf_counter() - t_run) * 1e3
+            _m_step_ms.observe(step_ms)
+            if watch_v is not None and _tensorwatch._enabled:
+                _tensorwatch.on_step(watch_v, int(step_idx),
+                                     sync=return_numpy)
+            if _anomaly._enabled:
+                # keyed by compiled-step identity: train and eval programs
+                # through one executor get separate stall baselines
+                _anomaly.DETECTOR.observe(step=int(step_idx),
+                                          step_ms=step_ms,
+                                          step_ms_key=runner.step.uid)
+            if _flight._enabled:
+                _flight.RECORDER.note("step", "executor.run",
+                                      step=int(step_idx))
+            if tctx is not None:
+                # exemplar BEFORE the tail-sampling verdict (it force-
+                # keeps the slowest step's tree), end AFTER the anomaly
+                # feed above (a step_stall trip must still find this trace
+                # in flight to embed it in its postmortem)
+                _trace.record_exemplar("executor_step_ms", step_ms, tctx)
+                _trace.end_trace(tctx)
+            return fetches
+        except BaseException:
+            # a step that dies mid-flight (runner.step, a non-finite
+            # sentinel trip, fetch) still ends its trace as an error:
+            # errors are always kept by tail sampling, and leaving the
+            # context in flight would pin _tls.current at a dead step
+            # until the next run() on this thread. handle_trip /
+            # anomaly postmortems embed the in-flight trace BEFORE
+            # raising, so ending it here loses nothing.
+            if tctx is not None:
+                _trace.end_trace(tctx, error=True)
+            raise
 
     def prepare(self, program=None, feed=None, fetch_list=None,
                 scope=None):

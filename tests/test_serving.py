@@ -341,6 +341,76 @@ class TestMicroBatchUnits:
         np.testing.assert_allclose(reqs[0].pending.result(timeout=0)[0],
                                    1.0)
 
+    def test_complete_fail_race_one_trace_matching_outcome(self):
+        """Review finding: complete() racing fail() on another thread
+        both passed a done() pre-check and could materialize TWO kept
+        traces for one request, with trace_id naming whichever
+        finished last — possibly an 'ok' tree for a request that was
+        delivered the error. The pending claim arbitrates: one
+        delivery, one kept tree, root status matching what the client
+        actually received."""
+        from paddle_tpu.monitor import trace
+        from paddle_tpu.monitor.trace import Tracer
+        trace.enable(sample_rate=1.0, slow_keep=0)
+        try:
+            for _ in range(10):
+                reqs = self._reqs([1, 1])
+                mb = MicroBatch(reqs, bucket=2, feed_names=("x",))
+                gate = threading.Barrier(2)
+
+                def ok():
+                    gate.wait()
+                    mb.complete([np.zeros((2, 2), np.float32)])
+
+                def err():
+                    gate.wait()
+                    mb.fail(RuntimeError("late failure"))
+
+                ths = [threading.Thread(target=ok),
+                       threading.Thread(target=err)]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join()
+                for r in reqs:
+                    try:
+                        r.pending.result(timeout=0)
+                        errored = False
+                    except RuntimeError:
+                        errored = True
+                    tid = r.pending.trace_id
+                    assert tid is not None
+                    roots = [s for s in trace.spans(tid)
+                             if s["kind"] == "root"]
+                    assert len(roots) == 1    # exactly ONE kept tree
+                    assert roots[0]["status"] == \
+                        ("error" if errored else "ok")
+        finally:
+            trace.disable()
+            trace.TRACER = Tracer()
+
+    def test_trace_failure_does_not_strand_claimed_request(
+            self, monkeypatch):
+        """Review finding: trace materialization runs inside the
+        claim->deliver window; if it raised, the claimed request could
+        never be delivered by any later sweep (the claim is first-
+        wins), hanging result() forever. Telemetry failures must not
+        block delivery."""
+        from paddle_tpu.monitor import trace
+        from paddle_tpu.monitor.trace import Tracer
+        trace.enable(sample_rate=1.0, slow_keep=0)
+        try:
+            monkeypatch.setattr(trace, "record_exemplar",
+                                lambda *a, **k: 1 / 0)
+            reqs = self._reqs([1])
+            mb = MicroBatch(reqs, bucket=1, feed_names=("x",))
+            mb.complete([np.zeros((1, 2), np.float32)])
+            np.testing.assert_allclose(
+                reqs[0].pending.result(timeout=1)[0], 0.0)
+        finally:
+            trace.disable()
+            trace.TRACER = Tracer()
+
     def test_bad_executor_output_fails_batch_not_batcher(self):
         """A dispatch whose complete() raises (wrong leading dim)
         delivers the error to every rider; the scheduler keeps

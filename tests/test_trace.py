@@ -367,6 +367,96 @@ class TestStageNotes:
         ctx = t.start_trace("executor/step")
         assert t.adopt_stage(ctx) is None
 
+    def test_concurrent_stage_note_during_adopt(self):
+        """Review finding: prefetch workers stage_note-append while the
+        consumer thread iterates the mailbox in adopt_stage — an
+        unlocked deque raises RuntimeError('deque mutated during
+        iteration') intermittently, crashing the training step of any
+        traced prefetch-fed loop."""
+        t = _mk()
+        stop = threading.Event()
+        errs = []
+
+        def producer():
+            k = object()
+            while not stop.is_set():
+                t.stage_note("executor/feed_stage", 1.0, 1.5,
+                             key=[id(k)])
+
+        def consumer():
+            probe = object()
+            ctx = t.start_trace("executor/step")
+            try:
+                for _ in range(2000):
+                    t.adopt_stage(ctx, match={id(probe)})
+            except Exception as e:  # pragma: no cover — the regression
+                errs.append(e)
+
+        workers = [threading.Thread(target=producer) for _ in range(2)]
+        cons = [threading.Thread(target=consumer) for _ in range(2)]
+        for th in workers + cons:
+            th.start()
+        for th in cons:
+            th.join()
+        stop.set()
+        for th in workers:
+            th.join()
+        assert not errs, errs
+
+    def test_unadopted_note_ages_out(self):
+        """Review finding: a stale note keyed by a garbage-collected
+        array's id() can be adopted by an unrelated later step once
+        CPython reuses the id. Notes parked longer than the TTL are
+        dropped at adoption time instead."""
+        t = _mk()
+        t.stage_note("executor/feed_stage", 1.0, 1.5, key=[123456])
+        # rewind the parked-at stamp (trailing tuple slot) past the TTL
+        old = t._stage_notes.popleft()
+        t._stage_notes.append(
+            old[:6] + (old[6] - trace._STAGE_NOTE_TTL_S - 1.0,))
+        ctx = t.start_trace("executor/step")
+        assert t.adopt_stage(ctx, match={123456}) is None
+        assert len(t._stage_notes) == 0       # dropped, not kept parked
+
+
+# ---------------------------------------------------------------------------
+class TestErrorStepTrace:
+    def test_step_exception_keeps_error_trace(self):
+        """Review finding: a step that raises mid-flight (dispatch, a
+        sentinel trip, fetch) never reached end_trace — the errored
+        step's trace was silently dropped, contradicting the errors-
+        always-kept tail-sampling policy, and _tls.current kept
+        pointing at the dead context."""
+        import paddle_tpu as pt
+        from paddle_tpu.framework import unique_name
+        from paddle_tpu.static.executor import Executor, Scope, \
+            scope_guard
+        trace.enable(sample_rate=0.0, slow_keep=0)  # only errors kept
+        pt.enable_static()
+        main_p, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main_p, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            out = pt.layers.fc(x, 1)
+        with scope_guard(Scope()):
+            exe = Executor()
+            exe.run(startup)
+
+            def boom(runner, scope):
+                raise RuntimeError("device on fire")
+
+            exe._gather_state = boom
+            with pytest.raises(RuntimeError, match="device on fire"):
+                exe.run(main_p,
+                        feed={"x": np.ones((2, 4), np.float32)},
+                        fetch_list=[out])
+        roots = [s for s in trace.spans()
+                 if s["name"] == "executor/step"
+                 and s["kind"] == "root"]
+        assert roots and roots[-1]["status"] == "error"
+        # the dead context must not linger as this thread's in-flight
+        # trace (a later postmortem would embed the wrong step)
+        assert trace.inflight_report() is None
+
     def test_notes_bounded(self):
         t = _mk()
         for i in range(200):
@@ -541,6 +631,19 @@ class TestWriterAndMerge:
                  .read_text().splitlines()]
         assert any(ln.get("trace") == b.trace_id for ln in lines)
 
+    def test_rearm_flushes_buffered_lines(self, tmp_path):
+        """Review finding: install() replaced an armed writer without
+        flushing it — up to flush_every-1 buffered span lines (plus
+        the clock-anchor meta) were silently lost on a re-arm."""
+        trace.enable(str(tmp_path), sample_rate=1.0, slow_keep=0)
+        ctx = trace.start_trace("unit/root")
+        trace.end_trace(ctx)                 # kept, but still buffered
+        trace.enable(str(tmp_path))          # re-arm replaces writer
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "rank0.trace.jsonl")
+                 .read_text().splitlines()]
+        assert any(ln.get("trace") == ctx.trace_id for ln in lines)
+
     def test_install_from_env(self, tmp_path):
         env = {trace.ENV_DIR: str(tmp_path), trace.ENV_SAMPLE: "0.5",
                trace.ENV_SLOW_KEEP: "3"}
@@ -550,6 +653,22 @@ class TestWriterAndMerge:
             assert t.sample_rate == 0.5 and t.slow_keep == 3
             assert t._writer is not None
             assert trace.install_from_env({}) is None
+        finally:
+            trace.disable()
+
+    def test_install_from_env_malformed_knobs_fall_back(self, tmp_path):
+        """Review finding: a typo'd sampling knob raised ValueError
+        inside auto_checkpoint's startup wiring and killed the worker
+        — the never-fail tracing stack must fall back to defaults."""
+        env = {trace.ENV_DIR: str(tmp_path),
+               trace.ENV_SAMPLE: "often",
+               trace.ENV_SLOW_KEEP: "3.5"}
+        try:
+            t = trace.install_from_env(env)
+            assert t is not None and trace.is_enabled()
+            assert t.sample_rate == Tracer().sample_rate
+            assert t.slow_keep == Tracer().slow_keep
+            assert t._writer is not None
         finally:
             trace.disable()
 
@@ -846,7 +965,10 @@ class TestPostmortemEmbedding:
                                 report={"value": 1.0}, step=17)
             assert path is not None
             doc = json.loads(open(path).read())
-            tr = doc["anomaly"]["trace"]
+            # the tree rides the dump's top-level embed exactly once
+            # (trip() used to embed a second copy under "anomaly")
+            tr = doc["trace"]
+            assert "trace" not in doc["anomaly"]
             assert tr["trace_id"] == ctx.trace_id
             assert tr["root"] == "executor/step"
             assert tr["attrs"]["step"] == 17
