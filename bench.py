@@ -477,7 +477,17 @@ def bench_serving():
     and (server) the micro-batch fill ratio, plus a ratio line.
     Knobs: BENCH_SERVING_REQS / _REPLICAS / _MAX_BATCH / _RATE_X /
     _MAX_WAIT_MS. The ``serving_*`` registry metrics land in the
-    end-of-run snapshot every bench mode emits."""
+    end-of-run snapshot every bench mode emits.
+
+    ``BENCH_SERVING_CHAOS=1`` runs the RESILIENCE bench instead
+    (docs/SERVING.md "Resilience"): a 2-replica clean-vs-stall A/B
+    emitting ``serving_chaos_p99_ratio`` (p99 of unaffected requests
+    with one replica wedged mid-load vs the clean run),
+    ``serving_shed_precision`` (fraction of adaptively shed requests
+    that DID miss their deadline in the shed-off control pass — same
+    schedule, traced keep-all), and ``serving_shed_overhead_ratio``
+    (the controller's clean-path open-loop p50 cost via the shared
+    ABBA protocol; must stay < 1.05x)."""
     import queue as _queue
     import tempfile
     import threading
@@ -523,6 +533,9 @@ def bench_serving():
     rng = np.random.RandomState(0)
     feed = rng.rand(1, 256).astype(np.float32)
     np.asarray(base.run({"x": feed})[0])       # compile once, shared
+
+    if os.environ.get("BENCH_SERVING_CHAOS") == "1":
+        return _bench_serving_chaos(d, feed, max_batch, max_wait_ms)
 
     # single-request service time -> offered rate for BOTH systems
     probes = 30 if not on_tpu else 50
@@ -733,6 +746,238 @@ def bench_serving():
         "value": round(est, 4), "unit": "x",
         "traced_p50_ms": round(float(np.median(on_ms)), 4),
         "untraced_p50_ms": round(float(np.median(off_ms)), 4),
+        "pair_ratios": [round(r, 4) for r in pair_ratios],
+        "window_reqs": win, "offered_fraction_of_capacity": 0.5,
+    }))
+
+
+def _bench_serving_chaos(d, feed, max_batch, max_wait_ms):
+    """The resilience half of `bench.py serving`
+    (BENCH_SERVING_CHAOS=1). Three measurements on the 2-replica
+    server, each a paired A/B on the same deterministic schedule:
+
+    - ``serving_chaos_p99_ratio``: open-loop load at ~0.5x capacity,
+      clean vs one replica wedged mid-load (PT_FAULT_REPLICA_STALL) —
+      the ratio of the UNAFFECTED requests' p99; the wedged batch's
+      riders resolve as typed errors and are reported, never hidden
+      in the percentile.
+    - ``serving_shed_precision``: overload (~2.5x capacity) with
+      deadlines, shed OFF (traced keep-all — ground truth for who
+      missed) vs shed adaptive — precision = shed requests that would
+      in fact have missed their deadline.
+    - ``serving_shed_overhead_ratio``: the controller's clean-path
+      cost, ABBA-interleaved open-loop p50 at ~0.5x capacity with the
+      controller swapped in/out (the shared _abba_overhead protocol);
+      the smoke test pins < 1.05x.
+
+    Knobs: BENCH_SERVING_CHAOS_REQS / _STALL_MS / _DEADLINE_MS /
+    _SHED_PAIRS / _SHED_WIN."""
+    from paddle_tpu.monitor import trace as mtrace
+    from paddle_tpu.monitor.registry import REGISTRY
+    from paddle_tpu.serving import (DeadlineExceededError,
+                                    InferenceServer, OverloadedError,
+                                    QueueFullError, ReplicaLostError,
+                                    ServingConfig, ShedController)
+    from paddle_tpu.testing import faults
+
+    n = int(os.environ.get("BENCH_SERVING_CHAOS_REQS", "200"))
+    stall_ms = float(os.environ.get("BENCH_SERVING_STALL_MS", "300"))
+    replicas = 2
+
+    def boot(**kw):
+        kw.setdefault("max_batch", max_batch)
+        kw.setdefault("max_wait_ms", max_wait_ms)
+        kw.setdefault("max_queue", 4 * n + 64)
+        kw.setdefault("replicas", replicas)
+        kw.setdefault("replica_stall_ms", stall_ms)
+        kw.setdefault("respawn_backoff_ms", 20.0)
+        return InferenceServer(d, ServingConfig(**kw))
+
+    def open_loop(srv, sched_arr, deadline_ms=None, timeout=120):
+        """Submit on the schedule; returns per-request (ok_latency_s
+        | exception-class-name | 'hang')."""
+        pend = [None] * len(sched_arr)
+        t0 = time.perf_counter()
+        for i, t_arr in enumerate(sched_arr):
+            dly = t0 + t_arr - time.perf_counter()
+            if dly > 0:
+                time.sleep(dly)
+            try:
+                pend[i] = (srv.submit({"x": feed},
+                                      deadline_ms=deadline_ms),
+                           t0 + t_arr)
+            except (OverloadedError, DeadlineExceededError,
+                    QueueFullError) as e:
+                pend[i] = (e, None)
+        out = []
+        for p, t_arr in pend:
+            if not hasattr(p, "result"):
+                out.append(type(p).__name__)
+                continue
+            try:
+                p.result(timeout=timeout)
+                out.append(p.t_done - t_arr)
+            except TimeoutError:
+                out.append("hang")
+            except Exception as e:
+                out.append(type(e).__name__)
+        return out
+
+    def warm(srv, rounds=3):
+        # sequential singles warm the 1-bucket; concurrent bursts
+        # coalesce into the larger buckets so EVERY executable has
+        # run before a timed pass (first executions pay one-time
+        # transfer/donation setup that would otherwise land in
+        # whichever pass ran first)
+        for _ in range(6):
+            srv.infer({"x": feed}, timeout=60)
+        for _ in range(rounds):
+            for p in [srv.submit({"x": feed}) for _ in range(16)]:
+                p.result(timeout=60)
+
+    # -- capacity probe on a clean warm server -------------------------
+    srv = boot()
+    warm(srv)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        srv.infer({"x": feed}, timeout=60)
+    svc_s = (time.perf_counter() - t0) / 30
+    half_rate = 0.5 * replicas / svc_s
+
+    # -- chaos A/B: clean pass, then one replica wedged mid-load -------
+    sched = np.cumsum(np.random.RandomState(42).exponential(
+        1.0 / half_rate, size=n))
+    clean = open_loop(srv, sched)
+    srv.close(timeout=120)
+    clean_ok = [x for x in clean if isinstance(x, float)]
+    p99_clean = float(np.percentile(np.asarray(clean_ok) * 1e3, 99))
+
+    resp_m = REGISTRY.get("serving_replica_respawns_total")
+    resp0 = resp_m.value() if resp_m else 0.0
+    srv = boot()
+    warm(srv)       # same warm-up as the clean pass, pre-arm
+    os.environ["PT_FAULT_REPLICA_STALL"] = "8"
+    os.environ["PT_FAULT_REPLICA"] = "1"
+    os.environ["PT_FAULT_STALL_SECS"] = "120"
+    faults._serving_fired.discard("replica_stall")
+    uninstall = faults.install_serving_faults()
+    try:
+        chaos = open_loop(srv, sched)
+    finally:
+        uninstall()
+        for k in ("PT_FAULT_REPLICA_STALL", "PT_FAULT_REPLICA",
+                  "PT_FAULT_STALL_SECS"):
+            os.environ.pop(k, None)
+    # the respawn lands after quarantine + backoff — give the
+    # supervisor a bounded moment (BEFORE close stops it) so the row
+    # reports the heal
+    lost_any = any(x == "ReplicaLostError" for x in chaos)
+    heal_by = time.monotonic() + (10 if lost_any else 0)
+    while time.monotonic() < heal_by:
+        if resp_m is not None and resp_m.value() > resp0:
+            break
+        time.sleep(0.02)
+    srv.close(timeout=120)
+    chaos_ok = [x for x in chaos if isinstance(x, float)]
+    hangs = sum(1 for x in chaos if x == "hang")
+    lost = sum(1 for x in chaos if x == "ReplicaLostError")
+    p99_chaos = float(np.percentile(np.asarray(chaos_ok) * 1e3, 99))
+    print(json.dumps({
+        "metric": "serving_chaos_p99_ratio",
+        "value": round(p99_chaos / p99_clean, 3), "unit": "x",
+        "clean_p99_ms": round(p99_clean, 2),
+        "chaos_p99_ok_ms": round(p99_chaos, 2),
+        "n_requests": n, "replicas": replicas,
+        "stall_ms": stall_ms,
+        "lost_requests": lost, "hangs": hangs,
+        "respawns": round((resp_m.value() if resp_m else 0.0)
+                          - resp0, 0),
+    }))
+
+    # -- shed precision: overload with deadlines, off vs adaptive ------
+    # the shed passes serve single-request buckets (max_batch=1):
+    # continuous batching multiplies capacity severalfold, so a
+    # deterministic sustained overload of a batching ladder would
+    # need tens of thousands of requests to hold queue pressure for
+    # long enough to observe the controller — with batch=1 the same
+    # 2.5x overload holds for the whole pass and the admission
+    # mechanism (what this row measures) is identical
+    deadline_ms = float(os.environ.get("BENCH_SERVING_DEADLINE_MS")
+                        or max(6 * svc_s * 1e3, 20.0))
+    n_ov = max(4 * n, 800)
+    # true single-bucket capacity, closed loop: the open-loop probe's
+    # svc_s includes max_wait_ms batching slack, and an "overload"
+    # derived from it can sit at the capacity knife-edge where queue
+    # wait never grows and nothing sheds
+    srv = boot(max_batch=1, max_queue=n_ov + 64)
+    burst = [srv.submit({"x": feed}) for _ in range(200)]
+    tb = time.perf_counter()
+    for p in burst:
+        p.result(timeout=120)
+    rate1 = 200 / (time.perf_counter() - tb)
+    srv.close(timeout=120)
+    over_rate = 2.5 * rate1
+    sched_ov = np.cumsum(np.random.RandomState(7).exponential(
+        1.0 / over_rate, size=n_ov))
+    # ground truth: shed OFF on the same schedule — who actually
+    # missed. BOTH passes run keep-all traced (the evidence trail for
+    # per-request postmortems) so tracing's cost cancels out of the
+    # A/B instead of loading only the control side; try/finally so an
+    # exception can't leave process-global tracing enabled
+    mtrace.enable(sample_rate=1.0, capacity=max(8 * n_ov, 4096))
+    try:
+        srv = boot(default_deadline_ms=deadline_ms, max_batch=1,
+                   max_queue=n_ov + 64)
+        control = open_loop(srv, sched_ov)
+        srv.close(timeout=120)
+        missed = {i for i, x in enumerate(control)
+                  if x == "DeadlineExceededError"}
+        # adaptive pass on the SAME schedule
+        srv = boot(default_deadline_ms=deadline_ms,
+                   shed_mode="adaptive", max_batch=1,
+                   max_queue=n_ov + 64)
+        adaptive = open_loop(srv, sched_ov)
+        srv.close(timeout=120)
+    finally:
+        mtrace.disable()
+    shed = {i for i, x in enumerate(adaptive)
+            if x == "OverloadedError"}
+    precision = (round(len(shed & missed) / len(shed), 4)
+                 if shed else None)
+    print(json.dumps({
+        "metric": "serving_shed_precision",
+        "value": precision, "unit": "fraction",
+        "n_shed": len(shed), "n_missed_control": len(missed),
+        "deadline_ms": round(deadline_ms, 2),
+        "overload_x": 2.5, "n_requests": n_ov, "max_batch": 1,
+    }))
+
+    # -- shed controller overhead on the clean path (ABBA p50) ---------
+    pairs = int(os.environ.get("BENCH_SERVING_SHED_PAIRS", "3"))
+    win = int(os.environ.get("BENCH_SERVING_SHED_WIN", "100"))
+    srv = boot(default_deadline_ms=10_000.0)
+    ctrl = ShedController(deadline_ms=10_000.0)
+    ab_rng = np.random.RandomState(11)
+
+    def p50_window(shed_on, n_w=win):
+        # swapping the controller in/out of the live scheduler is the
+        # honest A/B: admission checks `self._shed is not None`
+        srv.scheduler._shed = ctrl if shed_on else None
+        sched_w = np.cumsum(ab_rng.exponential(1.0 / half_rate,
+                                               size=n_w))
+        lat = open_loop(srv, sched_w, timeout=120)
+        return float(np.median([x for x in lat
+                                if isinstance(x, float)])) * 1e3
+
+    p50_window(True), p50_window(False)         # warm both paths
+    est, pair_ratios, on_ms, off_ms = _abba_overhead(p50_window, pairs)
+    srv.scheduler._shed = None
+    srv.close(timeout=120)
+    print(json.dumps({
+        "metric": "serving_shed_overhead_ratio",
+        "value": round(est, 4), "unit": "x",
+        "shed_on_p50_ms": round(float(np.median(on_ms)), 4),
+        "shed_off_p50_ms": round(float(np.median(off_ms)), 4),
         "pair_ratios": [round(r, 4) for r in pair_ratios],
         "window_reqs": win, "offered_fraction_of_capacity": 0.5,
     }))

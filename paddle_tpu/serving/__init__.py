@@ -7,12 +7,20 @@ micro-batching over per-bucket ahead-of-time compiled XLA executables,
 multi-replica dispatch from one shared queue, warm-boot compile
 preloading, and per-request SLO telemetry riding ``paddle_tpu.monitor``.
 
-Layering: ``scheduler`` (queueing/batching — numpy + stdlib only),
-``replica`` (device-pinned execution), ``server`` (front-end). The
-single-request ``paddle_tpu.inference.Predictor`` remains the simple
-embedded path; this package is the "millions of users" one.
+Layering: ``resilience`` (typed failure vocabulary + shed controller —
+stdlib only), ``scheduler`` (queueing/batching — numpy + stdlib only),
+``replica`` (device-pinned execution + pool supervisor), ``server``
+(front-end). The single-request ``paddle_tpu.inference.Predictor``
+remains the simple embedded path; this package is the "millions of
+users" one — and it fails TYPED: request deadlines, replica
+quarantine/respawn, and adaptive load shedding are documented in
+docs/SERVING.md "Resilience".
 """
 
+from paddle_tpu.serving.resilience import (  # noqa: F401
+    DeadlineExceededError, OverloadedError, ReplicaLostError,
+    ShedController,
+)
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     MicroBatch, MicroBatchScheduler, PendingResult, QueueFullError,
     ServerClosedError, bucket_ladder, pick_bucket,
@@ -25,6 +33,7 @@ from paddle_tpu.serving.server import (  # noqa: F401
 __all__ = [
     "InferenceServer", "ServingConfig", "MicroBatchScheduler",
     "MicroBatch", "PendingResult", "Replica", "ReplicaPool",
-    "QueueFullError", "ServerClosedError", "bucket_ladder",
-    "pick_bucket",
+    "QueueFullError", "ServerClosedError", "DeadlineExceededError",
+    "OverloadedError", "ReplicaLostError", "ShedController",
+    "bucket_ladder", "pick_bucket",
 ]

@@ -51,6 +51,9 @@ import numpy as np
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.monitor import trace as _trace
 from paddle_tpu.monitor.registry import counter, gauge, histogram
+from paddle_tpu.serving.resilience import (
+    DeadlineExceededError, OverloadedError,
+)
 
 __all__ = [
     "QueueFullError", "ServerClosedError", "PendingResult", "MicroBatch",
@@ -73,7 +76,10 @@ _m_requests = counter(
     "serving_requests_total",
     "Serving requests by outcome: ok (result delivered), rejected "
     "(typed backpressure at submit), error (replica/scheduler failure "
-    "delivered as an exception)", labels=("outcome",))
+    "delivered as an exception), deadline (request deadline exceeded "
+    "at admission/batch-formation/dispatch-wait/delivery), shed "
+    "(refused by the adaptive brownout controller)",
+    labels=("outcome",))
 _m_latency = histogram(
     "serving_request_latency_ms",
     "End-to-end serving request latency: submit accept -> result "
@@ -188,13 +194,60 @@ class PendingResult:
 
 
 class _Request:
-    __slots__ = ("feeds", "rows", "t_enqueue", "pending")
+    __slots__ = ("feeds", "rows", "t_enqueue", "pending", "deadline",
+                 "deadline_ms")
 
-    def __init__(self, feeds, rows):
+    def __init__(self, feeds, rows, deadline=None, deadline_ms=None):
         self.feeds = feeds
         self.rows = rows
         self.t_enqueue = time.perf_counter()
         self.pending = PendingResult()
+        #: absolute perf_counter second past which this request is
+        #: dead (anchored at submit ENTRY — the client's clock), or
+        #: None for no deadline; deadline_ms kept for error messages
+        self.deadline = deadline
+        self.deadline_ms = deadline_ms
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (time.perf_counter() if now is None else now) >= self.deadline
+
+
+def _deadline_error(req, stage, now=None):
+    now = time.perf_counter() if now is None else now
+    return DeadlineExceededError(
+        f"request deadline {req.deadline_ms:g}ms exceeded at {stage} "
+        f"({(now - req.t_enqueue) * 1e3:.1f}ms since submit); the "
+        f"request was failed without consuming further serving work")
+
+
+def _trace_root_error(t0):
+    """Keep a root-only error trace for a request that never joined a
+    batch (no stamps, no phases — errors are always kept). Returns
+    the trace id, or None when tracing is off or telemetry failed —
+    telemetry must never block delivery of a claimed request."""
+    if not _trace._enabled:
+        return None
+    try:
+        ctx = _trace.start_trace("serving/request")
+        ctx.t0 = t0
+        _trace.end_trace(ctx, error=True)
+        return ctx.trace_id
+    except Exception:
+        return None
+
+
+def _fail_request(r, exc, outcome):
+    """Deliver a typed failure to one request OUTSIDE any formed
+    micro-batch (queue-time deadline expiry, formation-time drop):
+    claims first-wins, keeps a root-only error trace, counts the
+    outcome. Returns whether this call delivered."""
+    if not r.pending.claim():
+        return False
+    r.pending.trace_id = _trace_root_error(r.t_enqueue)
+    r.pending._deliver(error=exc, claimed=True)
+    _m_requests.inc(outcome=outcome)
+    return True
 
 
 class MicroBatch:
@@ -265,6 +318,14 @@ class MicroBatch:
         for r in self.requests:
             sliced = [o[off:off + r.rows] for o in outs]
             lat_ms = (now - r.t_enqueue) * 1e3
+            # delivery-stage deadline: the result exists, but past the
+            # deadline it is useless to the caller — the SLO contract
+            # says fail typed, not hand back a late answer
+            if r.expired(now):
+                self._fail_one(r, _deadline_error(r, "delivery", now),
+                               outcome="deadline")
+                off += r.rows
+                continue
             # claim BEFORE trace assembly: the claim is the first-wins
             # arbiter against a racing fail(), so exactly one thread
             # materializes exactly one trace — and it is the thread
@@ -353,14 +414,39 @@ class MicroBatch:
         safe to call after a partial ``complete`` (first-wins), so an
         executor failure can always sweep the stragglers."""
         for r in self.requests:
-            if r.pending.claim():   # first-wins vs a racing complete()
-                if _trace._enabled:
-                    # error traces are always kept by tail sampling;
-                    # the retroactive tree carries whatever phases
-                    # were stamped before the failure
-                    self._finish_trace(r, None, None, error=exc)
-                r.pending._deliver(error=exc, claimed=True)
-                _m_requests.inc(outcome="error")
+            self._fail_one(r, exc, outcome="error")
+
+    def _fail_one(self, r, exc, outcome):
+        """Typed failure for one rider of THIS batch: first-wins claim,
+        error trace carrying whatever phase stamps exist (errors are
+        always kept), delivery, outcome accounting. Returns whether
+        this call delivered."""
+        if not r.pending.claim():   # first-wins vs a racing complete()
+            return False
+        if _trace._enabled:
+            self._finish_trace(r, None, None, error=exc)
+        r.pending._deliver(error=exc, claimed=True)
+        _m_requests.inc(outcome=outcome)
+        return True
+
+    def expire_riders(self, now=None, stage="dispatch-wait"):
+        """Fail every undelivered rider whose deadline has passed with
+        a typed :class:`DeadlineExceededError` (``outcome="deadline"``,
+        trace kept) and return the count of undelivered LIVE riders
+        remaining. The replica calls this at pickup: a batch whose
+        every rider is already dead must never consume a dispatch —
+        the executable run would compute answers nobody can use."""
+        now = time.perf_counter() if now is None else now
+        live = 0
+        for r in self.requests:
+            if r.pending.done():
+                continue
+            if r.expired(now):
+                self._fail_one(r, _deadline_error(r, stage, now),
+                               outcome="deadline")
+            else:
+                live += 1
+        return live
 
 
 #: queue sentinel: admission is closed and everything before it has
@@ -377,7 +463,8 @@ class MicroBatchScheduler:
     with a precise error instead of poisoning a whole micro-batch."""
 
     def __init__(self, dispatch, feed_names, max_batch=8,
-                 max_wait_ms=5.0, max_queue=256, sample_specs=None):
+                 max_wait_ms=5.0, max_queue=256, sample_specs=None,
+                 default_deadline_ms=None, shed=None):
         self._dispatch = dispatch
         self._feed_names = tuple(feed_names)
         self._ladder = bucket_ladder(max_batch)
@@ -386,6 +473,15 @@ class MicroBatchScheduler:
         self._max_wait = max_wait_ms / 1e3
         enforce(max_queue >= 1, f"max_queue < 1 ({max_queue})")
         self._max_queue = max_queue
+        enforce(default_deadline_ms is None
+                or float(default_deadline_ms) > 0,
+                f"default_deadline_ms must be positive or None, got "
+                f"{default_deadline_ms!r}")
+        self._default_deadline_ms = (None if default_deadline_ms is None
+                                     else float(default_deadline_ms))
+        #: resilience.ShedController (or None = shedding off; off is
+        #: the default and takes the exact legacy admission path)
+        self._shed = shed
         self._q = queue.Queue(maxsize=max_queue + 1)  # +1: _STOP always fits
         self._specs = dict(sample_specs or {})
         self._closed = False
@@ -411,6 +507,24 @@ class MicroBatchScheduler:
         return self
 
     # -- admission ---------------------------------------------------------
+    def _validate_deadline(self, deadline_ms):
+        """Argument validation for ``deadline_ms`` — runs with the
+        feed validation, BEFORE any server-state check, so a malformed
+        argument is a deterministic typed EnforceNotMet whether the
+        server is open, closed, or mid-brownout. None means "use the
+        configured default"; 0 is a legal already-exhausted budget
+        (it expires at admission, with the deadline outcome — useful
+        for propagated upstream deadlines)."""
+        if deadline_ms is None:
+            return self._default_deadline_ms
+        enforce(isinstance(deadline_ms,
+                           (int, float, np.integer, np.floating))
+                and not isinstance(deadline_ms, bool)
+                and float(deadline_ms) >= 0,   # also rejects NaN
+                f"deadline_ms must be a non-negative number of "
+                f"milliseconds, got {deadline_ms!r}")
+        return float(deadline_ms)
+
     def _validate(self, feeds):
         missing = [n for n in self._feed_names if n not in feeds]
         enforce(not missing, f"request missing feeds: {missing}")
@@ -444,18 +558,56 @@ class MicroBatchScheduler:
         pick_bucket(rows, self._ladder)
         return arrs, rows
 
-    def submit(self, feeds):
+    def submit(self, feeds, deadline_ms=None):
         """Admit one request ({feed name: array with leading batch
-        dim}); returns a :class:`PendingResult`. Raises
-        :class:`ServerClosedError` after ``close()``,
-        :class:`QueueFullError` on backpressure, ``EnforceNotMet`` on a
-        malformed request."""
+        dim}); returns a :class:`PendingResult`. ``deadline_ms``
+        bounds the request end to end (None = the scheduler's
+        ``default_deadline_ms``; 0 = already exhausted). Failure
+        precedence, deterministic regardless of server state:
+        malformed arguments (bad feed, negative deadline) raise
+        ``EnforceNotMet`` first; then :class:`ServerClosedError`; then
+        :class:`DeadlineExceededError` (admission-stage expiry,
+        ``outcome="deadline"``); then
+        :class:`~.resilience.OverloadedError` (adaptive shed,
+        ``outcome="shed"``); then :class:`QueueFullError`
+        (``outcome="rejected"``)."""
+        t_adm = time.perf_counter()
+        # ALL argument validation before any state check: a malformed
+        # request must fail the same typed way on a closed server as
+        # on an open one (satellite-pinned precedence)
         arrs, rows = self._validate(feeds)
+        deadline_ms = self._validate_deadline(deadline_ms)
+        deadline = (None if deadline_ms is None
+                    else t_adm + deadline_ms / 1e3)
         with self._lock:
             if self._closed or not self._started:
                 raise ServerClosedError(
                     "serving scheduler is closed" if self._closed
                     else "serving scheduler not started")
+            if deadline is not None and \
+                    time.perf_counter() >= deadline:
+                # admission-stage expiry (deadline_ms=0, or a budget
+                # so tight validation ate it): typed, counted, and the
+                # trace kept (errors-always-kept) — no queue slot, no
+                # batch, no dispatch ever spent on it
+                _m_requests.inc(outcome="deadline")
+                _trace_root_error(t_adm)
+                raise DeadlineExceededError(
+                    f"request deadline {deadline_ms:g}ms already "
+                    f"exceeded at admission; nothing was enqueued")
+            if self._shed is not None:
+                reason = self._shed.should_shed(deadline_ms,
+                                                self._q.qsize())
+                if reason is not None:
+                    _m_requests.inc(outcome="shed")
+                    raise OverloadedError(
+                        f"request shed at admission ({reason}): "
+                        f"queue-wait p50 "
+                        f"{self._shed.p50_wait_ms:.1f}ms already "
+                        f"exceeds the headroom of a "
+                        f"{deadline_ms:g}ms deadline — slow down or "
+                        f"route elsewhere until serving_brownout "
+                        f"clears")
             if self._q.qsize() >= self._max_queue:
                 _m_requests.inc(outcome="rejected")
                 raise QueueFullError(
@@ -465,7 +617,8 @@ class MicroBatchScheduler:
             # the Event/Lock allocation, and t_enqueue (the batcher's
             # max_wait deadline anchor AND the latency-metric origin)
             # must not start ticking while submit contends for the lock
-            req = _Request(arrs, rows)
+            req = _Request(arrs, rows, deadline=deadline,
+                           deadline_ms=deadline_ms)
             self._q.put_nowait(req)
         _m_queue_depth.set(self._q.qsize())
         return req.pending
@@ -488,6 +641,18 @@ class MicroBatchScheduler:
         return not self._thread.is_alive()
 
     # -- the batching loop -------------------------------------------------
+    def _expire_in_queue(self, r):
+        """A request found already past deadline as the batcher pulls
+        it from the queue: its wait STILL feeds the shed controller —
+        the casualties are the strongest overload evidence there is,
+        and sampling only survivors would understate p50 exactly when
+        shedding matters — then the typed failure."""
+        now = time.perf_counter()
+        if self._shed is not None:
+            self._shed.observe_wait((now - r.t_enqueue) * 1e3)
+        _fail_request(r, _deadline_error(r, "batch-formation", now),
+                      outcome="deadline")
+
     def _loop(self):
         carry = None
         while True:
@@ -497,11 +662,17 @@ class MicroBatchScheduler:
                 first = self._q.get()
             if first is _STOP:
                 break
+            if first.expired():
+                # dead on arrival at the batcher: fail it now instead
+                # of anchoring a max_wait window on a request nobody
+                # can be answered
+                self._expire_in_queue(first)
+                continue
             batch, rows = [first], first.rows
-            deadline = first.t_enqueue + self._max_wait
+            wait_deadline = first.t_enqueue + self._max_wait
             saw_stop = False
             while rows < self._max_bucket:
-                remaining = deadline - time.perf_counter()
+                remaining = wait_deadline - time.perf_counter()
                 try:
                     if remaining > 0:
                         nxt = self._q.get(timeout=remaining)
@@ -514,6 +685,9 @@ class MicroBatchScheduler:
                 if nxt is _STOP:
                     saw_stop = True
                     break
+                if nxt.expired():
+                    self._expire_in_queue(nxt)
+                    continue
                 if rows + nxt.rows > self._max_bucket:
                     carry = nxt     # overflow starts the next batch
                     break
@@ -529,30 +703,38 @@ class MicroBatchScheduler:
 
     def _form_and_dispatch(self, requests, rows):
         t_form = time.perf_counter()
+        if self._shed is not None:
+            # queue-wait observations feed the brownout controller —
+            # including the casualties below, whose waits are exactly
+            # the overload evidence the controller exists to see
+            for r in requests:
+                self._shed.observe_wait((t_form - r.t_enqueue) * 1e3)
+        live = [r for r in requests if not r.expired(t_form)]
+        if len(live) != len(requests):
+            # expired riders drop OUT of the forming batch BEFORE
+            # padding: the bucket is picked for the survivors, and the
+            # dead get their typed error now
+            for r in requests:
+                if r.expired(t_form):
+                    _fail_request(
+                        r, _deadline_error(r, "batch-formation",
+                                           t_form),
+                        outcome="deadline")
+            if not live:
+                return      # never dispatch a batch with no live rider
+            requests, rows = live, sum(r.rows for r in live)
         try:
             bucket = pick_bucket(rows, self._ladder)
             mb = MicroBatch(requests, bucket, self._feed_names)
         except Exception as e:
             # batch FORMATION failed (e.g. two spec-less requests with
             # incompatible trailing shapes hit np.concatenate): the
-            # riders get the error and the batcher survives — an
-            # exception here used to kill the thread, hanging every
-            # pending and future request while submit kept accepting
+            # riders get the error (root-only kept trace, no stamps)
+            # and the batcher survives — an exception here used to
+            # kill the thread, hanging every pending and future
+            # request while submit kept accepting
             for r in requests:
-                if not r.pending.claim():
-                    continue
-                if _trace._enabled:
-                    try:
-                        # no batch, no stamps: a root-only error trace
-                        # still names the request and its fate
-                        ctx = _trace.start_trace("serving/request")
-                        ctx.t0 = r.t_enqueue
-                        r.pending.trace_id = ctx.trace_id
-                        _trace.end_trace(ctx, error=True)
-                    except Exception:  # telemetry must not block
-                        pass           # delivery of a claimed request
-                r.pending._deliver(error=e, claimed=True)
-                _m_requests.inc(outcome="error")
+                _fail_request(r, e, outcome="error")
             return
         _m_batches.inc()
         _m_fill.observe(rows / bucket)

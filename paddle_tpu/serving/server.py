@@ -25,6 +25,7 @@ import numpy as np
 
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.serving.replica import ReplicaPool
+from paddle_tpu.serving.resilience import ShedController
 from paddle_tpu.serving.scheduler import (
     MicroBatchScheduler, ServerClosedError, bucket_ladder,
 )
@@ -47,11 +48,33 @@ class ServingConfig:
       override when the program declares dynamic non-batch dims.
     - ``verify_aot``: verify the model dir's AOT integrity manifest at
       boot (on by default; only skips work when no manifest exists).
+
+    Resilience knobs (docs/SERVING.md "Resilience"):
+
+    - ``default_deadline_ms``: deadline applied to every request that
+      doesn't pass its own ``submit(deadline_ms=)``; None (default) =
+      no deadline. Past it a request fails with
+      ``DeadlineExceededError`` at whichever stage observes the
+      expiry.
+    - ``replica_stall_ms`` / ``max_consecutive_stalls`` /
+      ``respawn_backoff_ms`` / ``supervise``: the replica-pool
+      supervisor (wedge detection, quarantine + warm respawn,
+      permanent retirement) — see ``ReplicaPool``.
+    - ``shed_mode``: ``"off"`` (default — admission is bit-for-bit the
+      pre-resilience path) or ``"adaptive"`` (brownout shedding with
+      ``OverloadedError``; requires ``default_deadline_ms``).
+    - ``shed_enter_frac`` / ``shed_exit_frac``: brownout hysteresis
+      thresholds as fractions of the deadline (see
+      ``resilience.ShedController``).
     """
 
     def __init__(self, max_batch=8, max_wait_ms=5.0, max_queue=256,
                  replicas=1, devices=None, feed_specs=None,
-                 verify_aot=True):
+                 verify_aot=True, default_deadline_ms=None,
+                 replica_stall_ms=30_000.0, max_consecutive_stalls=3,
+                 respawn_backoff_ms=100.0, supervise=True,
+                 shed_mode="off", shed_enter_frac=0.5,
+                 shed_exit_frac=0.25):
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
         self.max_queue = max_queue
@@ -59,6 +82,14 @@ class ServingConfig:
         self.devices = devices
         self.feed_specs = feed_specs
         self.verify_aot = verify_aot
+        self.default_deadline_ms = default_deadline_ms
+        self.replica_stall_ms = replica_stall_ms
+        self.max_consecutive_stalls = max_consecutive_stalls
+        self.respawn_backoff_ms = respawn_backoff_ms
+        self.supervise = supervise
+        self.shed_mode = shed_mode
+        self.shed_enter_frac = shed_enter_frac
+        self.shed_exit_frac = shed_exit_frac
 
 
 def _infer_sample_specs(program, feed_names, overrides):
@@ -129,25 +160,48 @@ class InferenceServer:
                 f"scope missing persistables for serving: {missing[:5]}")
         params_np = [np.asarray(v) for v in raw]
         ladder = bucket_ladder(config.max_batch)
+        # shed_mode gates the whole adaptive controller: "off" (the
+        # default) constructs NOTHING — admission stays bit-for-bit
+        # the pre-resilience path
+        enforce(config.shed_mode in ("off", "adaptive"),
+                f"shed_mode must be 'off' or 'adaptive', got "
+                f"{config.shed_mode!r}")
+        shed = None
+        if config.shed_mode == "adaptive":
+            enforce(config.default_deadline_ms is not None,
+                    "shed_mode='adaptive' requires "
+                    "default_deadline_ms: the controller sheds "
+                    "against deadline headroom, and without a "
+                    "deadline there is none")
+            shed = ShedController(
+                deadline_ms=config.default_deadline_ms,
+                enter_frac=config.shed_enter_frac,
+                exit_frac=config.shed_exit_frac)
         # the scheduler validates every config knob (max_batch ladder,
-        # max_wait_ms, max_queue) — construct it BEFORE the expensive
-        # warm boot so a bad knob fails in microseconds instead of
-        # after compiling (and leaking) every bucket executable; the
-        # dispatch is late-bound to the pool built below
+        # max_wait_ms, max_queue, default_deadline_ms) — construct it
+        # BEFORE the expensive warm boot so a bad knob fails in
+        # microseconds instead of after compiling (and leaking) every
+        # bucket executable; the dispatch is late-bound to the pool
+        # built below
         self.scheduler = MicroBatchScheduler(
             dispatch=lambda mb: self.pool.dispatch(mb),
             feed_names=self._feed_names,
             max_batch=config.max_batch,
             max_wait_ms=config.max_wait_ms,
             max_queue=config.max_queue,
-            sample_specs=self._sample_specs)
+            sample_specs=self._sample_specs,
+            default_deadline_ms=config.default_deadline_ms,
+            shed=shed)
         self._check_fetch_contract(pure_fn, params_np, ladder)
         self.pool = ReplicaPool(
             pure_fn, params_np, self._feed_names, self._sample_specs,
             ladder=ladder,
-            n_replicas=config.replicas, devices=config.devices)
+            n_replicas=config.replicas, devices=config.devices,
+            replica_stall_ms=config.replica_stall_ms,
+            max_consecutive_stalls=config.max_consecutive_stalls,
+            respawn_backoff_ms=config.respawn_backoff_ms,
+            supervise=config.supervise)
         self.scheduler.start()
-        self._closed = False
 
     def _check_fetch_contract(self, pure_fn, params_np, ladder):
         """Micro-batched serving requires every fetch to be per-row
@@ -186,18 +240,23 @@ class InferenceServer:
         return self.pool.ladder
 
     # -- serving -----------------------------------------------------------
-    def submit(self, feeds):
-        """Admit one request; returns a ``PendingResult``."""
-        if self._closed:
-            # server-level gate: after close() no request reaches the
-            # scheduler, even mid-drain (the scheduler's own flag also
-            # refuses — this one just fails before feed validation)
-            raise ServerClosedError("server is closed")
-        return self.scheduler.submit(feeds)
+    def submit(self, feeds, deadline_ms=None):
+        """Admit one request; returns a ``PendingResult``.
+        ``deadline_ms`` bounds it end to end (None = the config's
+        ``default_deadline_ms``); past the deadline the request fails
+        with ``DeadlineExceededError`` at whichever serving stage
+        observes the expiry."""
+        # no server-level pre-gate: the scheduler validates ARGUMENTS
+        # first and then refuses with ServerClosedError — so a
+        # malformed request fails the same deterministic typed way on
+        # a closed server as on an open one (the documented
+        # precedence; server.close() closes the scheduler, so the
+        # closed refusal is never lost)
+        return self.scheduler.submit(feeds, deadline_ms=deadline_ms)
 
-    def infer(self, feeds, timeout=None):
+    def infer(self, feeds, timeout=None, deadline_ms=None):
         """Blocking convenience: submit + result."""
-        return self.submit(feeds).result(timeout)
+        return self.submit(feeds, deadline_ms=deadline_ms).result(timeout)
 
     def close(self, timeout=None):
         """Graceful shutdown: stop admission, drain every accepted
@@ -208,13 +267,18 @@ class InferenceServer:
         stopping the replicas early would let their shutdown sentinels
         overtake still-forming batches in the FIFO and strand those
         requests forever. Call close() again to finish. Idempotent."""
-        self._closed = True
         # order matters: the scheduler drains its request queue into
         # the batch queue first, THEN the pool's per-replica sentinels
         # land behind every formed batch
         if not self.scheduler.close(timeout):
             return False
-        return self.pool.close(timeout)
+        if not self.pool.close(timeout):
+            return False
+        if self.scheduler._shed is not None:
+            # gauge truth on the way out: a closed server is not in
+            # brownout, whatever the last minutes looked like
+            self.scheduler._shed.shutdown()
+        return True
 
     def __enter__(self):
         return self

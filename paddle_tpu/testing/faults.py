@@ -49,6 +49,22 @@ boundary for free:
   wait (same bounded wait) until the rank's checkpoint dir holds K
   complete steps, so "restarts resume from a checkpoint" assertions
   never race the async writer; fires anyway after the timeout.
+- ``PT_FAULT_REPLICA_STALL=N``  — ``install_serving_faults()`` patches
+  the serving ``Replica.run_batch``: the scoped replica's Nth batch
+  pickup wedges (sleeps, not heartbeating) until the pool supervisor
+  abandons the thread — the wedged-dispatch path: riders must get
+  typed errors and the replica must quarantine + respawn.
+- ``PT_FAULT_REPLICA_DIE=N``    — same hook; the Nth pickup raises
+  ``SystemExit`` so the replica THREAD dies by uncaught exception
+  (the exact path that used to leave ``serving_replicas`` lying).
+- ``PT_FAULT_DISPATCH_ERROR=N`` — same hook; the Nth pickup raises a
+  RuntimeError the replica loop catches: the batch's riders get the
+  error, the replica keeps serving.
+  All three are scoped by ``PT_FAULT_REPLICA=R`` (replica index;
+  default: every replica) on top of ``PT_FAULT_RANK``, count pickups
+  PER REPLICA (batch N is deterministic per worker), and share the
+  once-marker semantics below. ``PT_FAULT_STALL_SECS`` bounds the
+  stall (default 3600 — effectively until abandoned or process exit).
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -70,6 +86,7 @@ import sys
 import time
 
 __all__ = ["maybe_fault", "poison_feed", "install_slow_write",
+           "install_serving_faults",
            "corrupt_checkpoint", "corrupt_newest_checkpoint",
            "CRASH_EXIT_CODE", "CKPT_FAULT_EXIT_CODE",
            "SHRINK_EXIT_CODE"]
@@ -424,6 +441,107 @@ def poison_feed(step, feed):
         sys.stderr.flush()
         return out
     return feed
+
+
+_SERVING_FAULT_ENVS = ("PT_FAULT_REPLICA_STALL",
+                       "PT_FAULT_REPLICA_DIE",
+                       "PT_FAULT_DISPATCH_ERROR")
+
+#: serving-fault tags already fired IN THIS PROCESS: a respawned
+#: replica restarts its pickup counter at 0, so without a process-
+#: local claim a stall-at-batch-N fault would wedge every respawn in
+#: turn and the pool could never heal — the exact recovery the fault
+#: exists to prove. PT_FAULT_ONCE_DIR still scopes the firing across
+#: process incarnations on top of this.
+_serving_fired = set()
+
+
+def _serving_fire_once(tag):
+    if tag in _serving_fired:
+        return False
+    if not _fire_once(tag):
+        _serving_fired.add(tag)
+        return False
+    _serving_fired.add(tag)
+    return True
+
+
+def _applies_to_replica(replica):
+    want = os.environ.get("PT_FAULT_REPLICA")
+    if want in (None, ""):
+        return True
+    return str(replica.index) == want
+
+
+def _maybe_serving_fault(replica):
+    """Fire-once serving chaos, scoped (rank, replica), counted in
+    per-replica batch PICKUPS — deterministic "batch N of replica R"
+    semantics regardless of how the shared queue interleaves."""
+    if not _applies_to_rank() or not _applies_to_replica(replica):
+        return
+    n = replica._fault_batch_n = getattr(replica, "_fault_batch_n",
+                                         0) + 1
+    stall_at = _int_env("PT_FAULT_REPLICA_STALL")
+    if stall_at is not None and n == stall_at and \
+            _serving_fire_once("replica_stall"):
+        sys.stderr.write(f"[faults] injected replica stall: replica "
+                         f"{replica.index} wedges at its batch {n}\n")
+        sys.stderr.flush()
+        limit = float(os.environ.get("PT_FAULT_STALL_SECS") or 3600.0)
+        deadline = time.monotonic() + limit
+        # wedge WITHOUT heartbeating until the supervisor abandons
+        # this thread (quarantine observed) or the bound expires —
+        # then raise so the thread unwinds instead of lingering
+        while not getattr(replica, "_abandoned", False) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        raise RuntimeError(
+            f"[faults] injected stall on replica {replica.index} "
+            f"released (abandoned="
+            f"{getattr(replica, '_abandoned', False)})")
+    die_at = _int_env("PT_FAULT_REPLICA_DIE")
+    if die_at is not None and n == die_at and _serving_fire_once("replica_die"):
+        sys.stderr.write(f"[faults] injected replica thread death: "
+                         f"replica {replica.index} at its batch {n}\n")
+        sys.stderr.flush()
+        # SystemExit escapes the replica loop's `except Exception` and
+        # kills ONLY this thread, silently — the uncaught-exception
+        # death the supervisor must detect
+        raise SystemExit(CRASH_EXIT_CODE)
+    err_at = _int_env("PT_FAULT_DISPATCH_ERROR")
+    if err_at is not None and n == err_at and \
+            _serving_fire_once("dispatch_error"):
+        sys.stderr.write(f"[faults] injected dispatch error: replica "
+                         f"{replica.index} at its batch {n}\n")
+        sys.stderr.flush()
+        raise RuntimeError(
+            f"[faults] injected dispatch error on replica "
+            f"{replica.index} at batch {n}")
+
+
+def install_serving_faults():
+    """If any serving chaos env (PT_FAULT_REPLICA_STALL /
+    PT_FAULT_REPLICA_DIE / PT_FAULT_DISPATCH_ERROR) is set, patch the
+    serving ``Replica.run_batch`` to consult the fault gate before
+    executing. Production never imports this module — a chaos test or
+    ``bench.py serving`` (BENCH_SERVING_CHAOS=1) opts in explicitly,
+    mirroring ``install_slow_write``. Returns an uninstall callable
+    when installed, False otherwise."""
+    if not any(os.environ.get(k) for k in _SERVING_FAULT_ENVS):
+        return False
+    from paddle_tpu.serving.replica import Replica
+    orig = Replica.run_batch
+
+    def chaos_run_batch(self, bucket, feeds):
+        _maybe_serving_fault(self)
+        return orig(self, bucket, feeds)
+
+    Replica.run_batch = chaos_run_batch
+
+    def uninstall():
+        Replica.run_batch = orig
+
+    return uninstall
 
 
 def install_slow_write():

@@ -96,6 +96,38 @@ class TestBenchModes:
                      "trace_spans_total", "trace_traces_kept_total"):
             assert name in snap, f"{name} missing from snapshot"
 
+    def test_serving_chaos_mode_emits_resilience_rows(self):
+        """`bench.py serving` with BENCH_SERVING_CHAOS=1 must run the
+        resilience A/Bs end to end (tiny request count: CLI/shape
+        smoke): the one-replica-stall p99 ratio with zero hangs and a
+        respawn, the shed-precision row (precision in [0,1] when
+        anything shed; the control pass must observe misses under the
+        sustained overload), and the shed controller's clean-path
+        ABBA overhead under the 1.05x bound."""
+        lines = _run_mode("serving",
+                          extra_env={"BENCH_SERVING_CHAOS": "1",
+                                     "BENCH_SERVING_CHAOS_REQS": "40",
+                                     "BENCH_SERVING_SHED_PAIRS": "2",
+                                     "BENCH_SERVING_SHED_WIN": "40"})
+        by = {ln["metric"]: ln for ln in lines}
+        chaos = by["serving_chaos_p99_ratio"]
+        assert chaos["unit"] == "x" and chaos["value"] > 0
+        assert chaos["clean_p99_ms"] > 0
+        assert chaos["chaos_p99_ok_ms"] > 0
+        assert chaos["hangs"] == 0, chaos       # zero hangs, always
+        assert chaos["lost_requests"] >= 1, chaos
+        assert chaos["respawns"] >= 1, chaos
+        shed = by["serving_shed_precision"]
+        assert shed["n_missed_control"] > 0, shed
+        if shed["n_shed"] > 0:
+            assert 0.0 <= shed["value"] <= 1.0, shed
+        else:
+            assert shed["value"] is None
+        ov = by["serving_shed_overhead_ratio"]
+        assert ov["unit"] == "x" and ov["value"] > 0
+        assert ov["value"] < 1.05, ov
+        assert len(ov["pair_ratios"]) >= 2
+
     def test_dispatch_mode_emits_trace_overhead_and_attribution(self):
         """`bench.py dispatch` must A/B per-step tracing on ABBA
         micro-windows (ratio < 1.05x — tail sampling's hot-path

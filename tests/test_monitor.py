@@ -852,6 +852,53 @@ class TestMetricsCatalogueLint:
         assert check_metrics.doc_metrics(str(doc)) == \
             {"t_documented_total": "counter", "t_aliased": "histogram"}
 
+    def test_outcome_vocabulary_lint(self, tmp_path):
+        """Every outcome=-labeled counter must document its FULL label
+        vocabulary in the catalogue row: the values are gathered from
+        the registering file's outcome="..." keywords, and a row
+        missing one (a new outcome added in code but not docs) fails
+        the lint."""
+        pkg = tmp_path / "paddle_tpu"
+        pkg.mkdir()
+        # t_plain_total sits immediately BEFORE the outcome-labeled
+        # registration: its scan window must stop at the next
+        # registration and never swallow the neighbor's
+        # labels=("outcome",) (that misclassification would demand
+        # the neighbor's vocabulary in t_plain_total's doc row)
+        (pkg / "m.py").write_text(
+            'plain = counter("t_plain_total", "no labels")\n'
+            'c = counter("t_reqs_total", "by outcome",\n'
+            '            labels=("outcome",))\n'
+            'c.inc(outcome="ok")\n'
+            'c.inc(outcome="deadline")\n'
+            'd = counter("t_other_total", "also by outcome",\n'
+            '            labels=("outcome",))\n'
+            'd.inc(outcome="hit")\n')
+        (tmp_path / "bench.py").write_text("")
+        vocab = check_metrics.outcome_vocabularies(repo=str(tmp_path))
+        # the vocabulary is the registering FILE's union — coarse on
+        # purpose: a value reaching inc() through a helper variable is
+        # still caught at its literal call site, where finer
+        # attribution would let it escape the lint. The plain neighbor
+        # just before t_reqs_total is never misclassified by window
+        # bleed (it gets NO vocabulary).
+        assert vocab == {"t_reqs_total": {"ok", "deadline", "hit"},
+                         "t_other_total": {"ok", "deadline", "hit"}}
+        doc = tmp_path / "doc.md"
+        doc.write_text(
+            "| `t_reqs_total` | counter | `outcome` | `ok` only |\n")
+        rows = check_metrics.doc_rows(str(doc))
+        missing = sorted((n, v) for n, vs in vocab.items()
+                         for v in sorted(vs)
+                         if f"`{v}`" not in rows.get(n, ""))
+        # t_reqs_total's row lacks `deadline` (and the union's `hit`)
+        assert ("t_reqs_total", "deadline") in missing
+        # the real tree is clean (main() green is pinned above); the
+        # serving counter's row must carry the full vocabulary
+        real = check_metrics.outcome_vocabularies()
+        assert {"ok", "rejected", "error", "deadline", "shed"} <= \
+            real["serving_requests_total"]
+
 
 # ---------------------------------------------------------------------------
 @pytest.mark.slow

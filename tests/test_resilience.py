@@ -1,0 +1,969 @@
+"""Serving resilience tests (paddle_tpu/serving/resilience.py,
+docs/SERVING.md "Resilience").
+
+Four mechanisms, each provable in isolation:
+
+- **request deadlines** — expiry observed (and typed
+  ``DeadlineExceededError`` delivered, ``outcome="deadline"``, trace
+  kept) at each stage: admission, batch formation (expired riders drop
+  before padding; an all-dead batch never dispatches), dispatch-wait
+  (replica pickup; expired riders never consume a dispatch), delivery;
+- **replica supervision** — a dead or wedged replica thread is
+  quarantined (gauge truth + loud log), its in-flight riders failed
+  with ``ReplicaLostError``, the slot respawned against the warm
+  executable map; repeated losses retire it and a fully-retired pool
+  still fails batches instead of hanging them;
+- **adaptive load shedding** — brownout hysteresis, typed
+  ``OverloadedError`` distinct from ``QueueFullError``, off-mode
+  bit-for-bit legacy admission;
+- **chaos injection** — the PT_FAULT_REPLICA_* faults in
+  testing/faults.py (install/uninstall, scoping, fire-once).
+
+The slow e2e (2-replica server under open-loop load with a stall
+injected on replica 1) runs in a subprocess worker
+(tests/serving_chaos_worker.py) so the .prom evidence of the
+quarantine -> respawn transitions is captured exactly as an operator
+would see it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.enforce import EnforceNotMet
+from paddle_tpu.monitor.registry import REGISTRY
+from paddle_tpu.serving import (
+    DeadlineExceededError, MicroBatch, MicroBatchScheduler,
+    OverloadedError, QueueFullError, ReplicaLostError, ReplicaPool,
+    ServerClosedError, ShedController,
+)
+from paddle_tpu.serving import scheduler as sch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "serving_chaos_worker.py")
+
+
+def _counter(name, **labels):
+    m = REGISTRY.get(name)
+    return m.value(**labels) if m else 0.0
+
+
+def _gauge(name, **labels):
+    m = REGISTRY.get(name)
+    return m.value(**labels) if m else 0.0
+
+
+class _FakeDispatch:
+    def __init__(self, complete=True, gate=None, sleep_s=0.0):
+        self.batches = []
+        self.complete = complete
+        self.gate = gate
+        self.sleep_s = sleep_s
+
+    def __call__(self, mb):
+        self.batches.append(mb)
+        if self.gate is not None:
+            self.gate.wait()
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        if self.complete:
+            mb.complete([mb.feeds["x"] * 2.0])
+
+
+def _sched(dispatch, **kw):
+    kw.setdefault("feed_names", ("x",))
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 50.0)
+    kw.setdefault("max_queue", 64)
+    return MicroBatchScheduler(dispatch, **kw).start()
+
+
+def _row(v, rows=1, width=2):
+    return {"x": np.full((rows, width), float(v), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# request deadlines: typed expiry at every observable stage
+# ---------------------------------------------------------------------------
+class TestDeadlines:
+    def test_admission_expiry_typed_counted_and_traced(self):
+        """deadline_ms=0 (an exhausted upstream budget) fails AT
+        submit: typed, outcome="deadline", nothing enqueued, and the
+        trace kept under errors-always-kept."""
+        from paddle_tpu.monitor import trace
+        from paddle_tpu.monitor.trace import Tracer
+        d0 = _counter("serving_requests_total", outcome="deadline")
+        k0 = _counter("trace_traces_kept_total", reason="error")
+        disp = _FakeDispatch()
+        s = _sched(disp)
+        trace.enable(sample_rate=0.0, slow_keep=0)
+        try:
+            with pytest.raises(DeadlineExceededError, match="admission"):
+                s.submit(_row(1.0), deadline_ms=0)
+        finally:
+            trace.disable()
+            trace.TRACER = Tracer()
+        assert _counter("serving_requests_total",
+                        outcome="deadline") - d0 == 1
+        assert _counter("trace_traces_kept_total",
+                        reason="error") - k0 == 1
+        # nothing was enqueued: a well-formed request still serves
+        out = s.submit(_row(2.0)).result(timeout=10)
+        np.testing.assert_allclose(out[0], np.full((1, 2), 4.0))
+        assert not disp.batches or disp.batches[0].rows == 1
+        s.close()
+
+    def test_negative_deadline_is_validation_not_deadline(self):
+        s = _sched(_FakeDispatch())
+        with pytest.raises(EnforceNotMet, match="deadline_ms"):
+            s.submit(_row(1.0), deadline_ms=-5)
+        with pytest.raises(EnforceNotMet, match="deadline_ms"):
+            s.submit(_row(1.0), deadline_ms="soon")
+        s.close()
+
+    def test_batch_formation_expiry_never_dispatches_dead_batch(self):
+        """A lone request whose deadline expires while the batcher
+        waits out max_wait is failed at formation — and the batch,
+        having no live rider, is never dispatched (no replica work)."""
+        d0 = _counter("serving_requests_total", outcome="deadline")
+        disp = _FakeDispatch()
+        s = _sched(disp, max_wait_ms=150.0)
+        p = s.submit(_row(1.0), deadline_ms=30)
+        with pytest.raises(DeadlineExceededError,
+                           match="batch-formation"):
+            p.result(timeout=10)
+        time.sleep(0.05)
+        assert disp.batches == []       # nothing consumed a dispatch
+        assert _counter("serving_requests_total",
+                        outcome="deadline") - d0 == 1
+        s.close()
+
+    def test_expired_rider_dropped_before_padding(self):
+        """Mixed batch: the expired rider drops OUT of the forming
+        batch and the bucket is picked for the survivors — the pad
+        rows are not spent on a corpse."""
+        disp = _FakeDispatch()
+        s = _sched(disp, max_batch=8, max_wait_ms=150.0)
+        p_dead = s.submit(_row(1.0, rows=3), deadline_ms=30)
+        p_live = s.submit(_row(2.0), deadline_ms=10_000)
+        out = p_live.result(timeout=10)
+        np.testing.assert_allclose(out[0], np.full((1, 2), 4.0))
+        with pytest.raises(DeadlineExceededError,
+                           match="batch-formation"):
+            p_dead.result(timeout=0)
+        assert len(disp.batches) == 1
+        # 4 rows (3 dead + 1 live) would have picked the 4-bucket;
+        # the survivor alone rides the 1-bucket
+        assert disp.batches[0].bucket == 1
+        assert disp.batches[0].rows == 1
+        s.close()
+
+    def test_dispatch_wait_expiry_skips_replica_execution(self):
+        """expire_riders at pickup: expired riders get the typed
+        error and an all-dead batch reports zero live riders."""
+        r_dead = sch._Request(_row(1.0), 1,
+                              deadline=time.perf_counter() - 0.01,
+                              deadline_ms=5.0)
+        r_live = sch._Request(_row(2.0), 1,
+                              deadline=time.perf_counter() + 60,
+                              deadline_ms=60_000.0)
+        mb = MicroBatch([r_dead, r_live], bucket=2, feed_names=("x",))
+        assert mb.expire_riders() == 1
+        with pytest.raises(DeadlineExceededError,
+                           match="dispatch-wait"):
+            r_dead.pending.result(timeout=0)
+        assert not r_live.pending.done()
+        # all-dead: zero live riders -> the replica must skip the run
+        r2 = sch._Request(_row(3.0), 1,
+                          deadline=time.perf_counter() - 0.01,
+                          deadline_ms=1.0)
+        mb2 = MicroBatch([r2], bucket=1, feed_names=("x",))
+        assert mb2.expire_riders() == 0
+
+    def test_delivery_expiry_fails_late_result(self):
+        """The result exists but arrived past the deadline: the SLO
+        contract delivers the typed error, not a late answer."""
+        d0 = _counter("serving_requests_total", outcome="deadline")
+        disp = _FakeDispatch(sleep_s=0.12)
+        s = _sched(disp, max_wait_ms=0.0)
+        p = s.submit(_row(1.0), deadline_ms=40)
+        with pytest.raises(DeadlineExceededError, match="delivery"):
+            p.result(timeout=10)
+        assert _counter("serving_requests_total",
+                        outcome="deadline") - d0 == 1
+        s.close()
+
+    def test_default_deadline_from_ctor_applies(self):
+        disp = _FakeDispatch(sleep_s=0.12)
+        s = _sched(disp, max_wait_ms=0.0, default_deadline_ms=40.0)
+        p = s.submit(_row(1.0))     # no per-request deadline
+        with pytest.raises(DeadlineExceededError):
+            p.result(timeout=10)
+        # an explicit per-request deadline overrides the default
+        s2 = _sched(_FakeDispatch(sleep_s=0.12), max_wait_ms=0.0,
+                    default_deadline_ms=40.0)
+        out = s2.submit(_row(2.0),
+                        deadline_ms=10_000).result(timeout=10)
+        np.testing.assert_allclose(out[0], np.full((1, 2), 4.0))
+        s.close()
+        s2.close()
+
+    def test_deadline_failure_trace_kept_with_id(self):
+        """A deadline failure inside a formed batch keeps its trace
+        (errors-always-kept) and hands the id to the client."""
+        from paddle_tpu.monitor import trace
+        from paddle_tpu.monitor.trace import Tracer
+        trace.enable(sample_rate=0.0, slow_keep=0)
+        try:
+            disp = _FakeDispatch(sleep_s=0.12)
+            s = _sched(disp, max_wait_ms=0.0)
+            p = s.submit(_row(1.0), deadline_ms=40)
+            with pytest.raises(DeadlineExceededError):
+                p.result(timeout=10)
+            assert p.trace_id is not None
+            roots = [sp for sp in trace.spans(p.trace_id)
+                     if sp["kind"] == "root"]
+            assert len(roots) == 1 and roots[0]["status"] == "error"
+            s.close()
+        finally:
+            trace.disable()
+            trace.TRACER = Tracer()
+
+    def test_no_deadline_requests_unaffected(self):
+        """The deadline machinery is inert for deadline-less requests
+        — the legacy contract untouched."""
+        disp = _FakeDispatch(sleep_s=0.05)
+        s = _sched(disp, max_wait_ms=0.0)
+        out = s.submit(_row(1.0)).result(timeout=10)
+        np.testing.assert_allclose(out[0], np.full((1, 2), 2.0))
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# submit precedence: argument validation is deterministic and typed
+# regardless of server state (satellite fix)
+# ---------------------------------------------------------------------------
+class TestSubmitPrecedence:
+    def test_validation_beats_closed_state(self):
+        s = _sched(_FakeDispatch())
+        s.close()
+        # malformed arguments fail the same typed way on a CLOSED
+        # server as on an open one
+        with pytest.raises(EnforceNotMet, match="missing feeds"):
+            s.submit({})
+        with pytest.raises(EnforceNotMet, match="deadline_ms"):
+            s.submit(_row(1.0), deadline_ms=-1)
+        # well-formed arguments on a closed server: the state error
+        with pytest.raises(ServerClosedError):
+            s.submit(_row(1.0))
+        with pytest.raises(ServerClosedError):
+            s.submit(_row(1.0), deadline_ms=0)  # closed beats deadline
+
+    def test_deadline_beats_shed_beats_queue_full(self):
+        gate = threading.Event()
+        disp = _FakeDispatch(gate=gate)
+        ctrl = ShedController(deadline_ms=100.0, min_samples=4,
+                              window=8)
+        s = _sched(disp, max_wait_ms=0.0, max_queue=2,
+                   default_deadline_ms=100.0, shed=ctrl)
+        try:
+            # batcher grabs the first request and blocks in dispatch
+            first = s.submit(_row(0))
+            deadline = time.time() + 5
+            while not disp.batches and time.time() < deadline:
+                time.sleep(0.001)
+            # fill the bounded queue behind it
+            admitted = [s.submit(_row(i + 1)) for i in range(2)]
+            # force a brownout
+            for _ in range(6):
+                ctrl.observe_wait(90.0)
+            assert ctrl.brownout
+            # deadline-at-admission outranks the shed verdict
+            with pytest.raises(DeadlineExceededError):
+                s.submit(_row(9), deadline_ms=0)
+            # shed outranks queue-full (both currently true)
+            with pytest.raises(OverloadedError):
+                s.submit(_row(9))
+            # an ample deadline is admitted past the brownout — and
+            # the queue, still full, refuses it the legacy typed way
+            with pytest.raises(QueueFullError):
+                s.submit(_row(9), deadline_ms=60_000)
+        finally:
+            gate.set()
+            s.close(timeout=10)
+        for p in [first] + admitted:
+            assert p.done()
+
+
+# ---------------------------------------------------------------------------
+# adaptive load shedding
+# ---------------------------------------------------------------------------
+class TestShedController:
+    def test_enter_and_exit_hysteresis(self):
+        b0 = _gauge("serving_brownout")
+        ctrl = ShedController(deadline_ms=100.0, min_samples=4,
+                              window=8)
+        for _ in range(4):
+            ctrl.observe_wait(30.0)     # p50 30 < 50: no brownout
+        assert not ctrl.brownout
+        for _ in range(8):
+            ctrl.observe_wait(80.0)     # p50 80 > 50: enter
+        assert ctrl.brownout
+        assert _gauge("serving_brownout") == 1
+        # hysteresis: p50 must fall below exit_frac (25), not merely
+        # below enter_frac — feed mid-range waits first
+        for _ in range(8):
+            ctrl.observe_wait(30.0)
+        assert ctrl.brownout            # 30 > 25: still shedding
+        for _ in range(8):
+            ctrl.observe_wait(5.0)
+        assert not ctrl.brownout
+        assert _gauge("serving_brownout") == 0
+        assert b0 in (0, 1)             # gauge existed/updated
+
+    def test_queue_drain_exits_brownout(self):
+        ctrl = ShedController(deadline_ms=100.0, min_samples=4,
+                              window=8)
+        for _ in range(6):
+            ctrl.observe_wait(90.0)
+        assert ctrl.brownout
+        # an empty queue at admission means the waits are history
+        assert ctrl.should_shed(100.0, queue_depth=0) is None
+        assert not ctrl.brownout
+
+    def test_shed_spares_long_deadline_requests(self):
+        s0 = _counter("serving_shed_total", reason="brownout")
+        ctrl = ShedController(deadline_ms=100.0, min_samples=4,
+                              window=8)
+        for _ in range(6):
+            ctrl.observe_wait(90.0)
+        assert ctrl.brownout
+        assert ctrl.should_shed(100.0, queue_depth=3) == "brownout"
+        assert _counter("serving_shed_total",
+                        reason="brownout") - s0 == 1
+        # p50 90 < 0.5 * 10000: plenty of headroom, admitted
+        assert ctrl.should_shed(10_000.0, queue_depth=3) is None
+
+    def test_validation(self):
+        with pytest.raises(EnforceNotMet, match="deadline"):
+            ShedController(deadline_ms=None)
+        with pytest.raises(EnforceNotMet, match="hysteresis"):
+            ShedController(deadline_ms=100, enter_frac=0.2,
+                           exit_frac=0.5)
+
+    def test_shutdown_clears_brownout_gauge(self):
+        """Server close must not leave serving_brownout reading 1 —
+        a closed server is not a live overload (found driving the
+        user flow: the gauge lingered after close)."""
+        ctrl = ShedController(deadline_ms=100.0, min_samples=4,
+                              window=8)
+        for _ in range(6):
+            ctrl.observe_wait(90.0)
+        assert ctrl.brownout and _gauge("serving_brownout") == 1
+        ctrl.shutdown()
+        assert not ctrl.brownout
+        assert _gauge("serving_brownout") == 0
+        assert ctrl.p50_wait_ms == 0.0
+
+    def test_scheduler_sheds_typed_and_counted(self):
+        o0 = _counter("serving_requests_total", outcome="shed")
+        gate = threading.Event()
+        disp = _FakeDispatch(gate=gate)
+        ctrl = ShedController(deadline_ms=100.0, min_samples=4,
+                              window=8)
+        s = _sched(disp, max_wait_ms=0.0, default_deadline_ms=100.0,
+                   shed=ctrl)
+        try:
+            first = s.submit(_row(0))       # batcher blocks on gate
+            deadline = time.time() + 5
+            while not disp.batches and time.time() < deadline:
+                time.sleep(0.001)
+            second = s.submit(_row(1))      # sits in the queue
+            for _ in range(6):
+                ctrl.observe_wait(90.0)
+            with pytest.raises(OverloadedError, match="brownout"):
+                s.submit(_row(2))
+            assert _counter("serving_requests_total",
+                            outcome="shed") - o0 == 1
+        finally:
+            gate.set()
+            s.close(timeout=10)
+        for p in (first, second):
+            p.result(timeout=10)            # admitted ones delivered
+
+    def test_queue_expired_casualties_feed_the_controller(self):
+        """Review fix: requests that expire IN QUEUE (failed as the
+        batcher pulls them) must still observe_wait — they are the
+        strongest overload evidence, and sampling only the survivors
+        understates p50 exactly when shedding matters."""
+        gate = threading.Event()
+        disp = _FakeDispatch(gate=gate)
+        ctrl = ShedController(deadline_ms=1_000.0, min_samples=4,
+                              window=16)
+        s = _sched(disp, max_wait_ms=0.0, max_queue=64,
+                   default_deadline_ms=1_000.0, shed=ctrl)
+        try:
+            blocker = s.submit(_row(0))     # batcher blocks in dispatch
+            deadline = time.time() + 5
+            while not disp.batches and time.time() < deadline:
+                time.sleep(0.001)
+            doomed = [s.submit(_row(i + 1), deadline_ms=30)
+                      for i in range(5)]
+            time.sleep(0.1)                 # all five expire in queue
+            gate.set()
+            for p in doomed:
+                with pytest.raises(DeadlineExceededError):
+                    p.result(timeout=10)
+            blocker.result(timeout=10)
+            # every casualty's wait was observed (plus the blocker's)
+            assert len(ctrl._waits) >= 6, len(ctrl._waits)
+            assert ctrl.p50_wait_ms >= 30.0
+        finally:
+            gate.set()
+            s.close(timeout=10)
+
+    def test_off_mode_is_legacy_admission(self):
+        """shed off (the default) constructs nothing and the
+        admission path is the legacy one: no controller, no deadline,
+        identical outcomes for a canned workload."""
+        s = _sched(_FakeDispatch(), max_wait_ms=0.0)
+        assert s._shed is None
+        assert s._default_deadline_ms is None
+        ok0 = _counter("serving_requests_total", outcome="ok")
+        sh0 = _counter("serving_requests_total", outcome="shed")
+        dl0 = _counter("serving_requests_total", outcome="deadline")
+        pends = [s.submit(_row(i)) for i in range(8)]
+        for i, p in enumerate(pends):
+            np.testing.assert_allclose(p.result(timeout=10)[0],
+                                       np.full((1, 2), 2.0 * i))
+        s.close()
+        assert _counter("serving_requests_total",
+                        outcome="ok") - ok0 == 8
+        assert _counter("serving_requests_total",
+                        outcome="shed") == sh0
+        assert _counter("serving_requests_total",
+                        outcome="deadline") == dl0
+
+
+# ---------------------------------------------------------------------------
+# replica supervision: quarantine, respawn, retire — real pool, tiny fn
+# ---------------------------------------------------------------------------
+def _tiny_pool(**kw):
+    kw.setdefault("replica_stall_ms", 30_000.0)
+    kw.setdefault("respawn_backoff_ms", 5.0)
+    pool = ReplicaPool(
+        lambda params, feeds: (feeds[0] * 2.0,), [], ("x",),
+        {"x": ((2,), np.dtype("float32"))}, ladder=(1, 2), **kw)
+    return pool
+
+
+def _req(v, rows=1, deadline=None, deadline_ms=None):
+    return sch._Request(_row(v, rows=rows), rows, deadline=deadline,
+                        deadline_ms=deadline_ms)
+
+
+def _wait_until(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestReplicaSupervision:
+    def test_pool_executes_and_skips_dead_batches(self):
+        pool = _tiny_pool(n_replicas=1)
+        try:
+            # all-dead batch: typed errors, no dispatch consumed
+            dead = _req(1.0, deadline=time.perf_counter() - 0.01,
+                        deadline_ms=1.0)
+            pool.dispatch(MicroBatch([dead], 1, ("x",)))
+            with pytest.raises(DeadlineExceededError,
+                               match="dispatch-wait"):
+                dead.pending.result(timeout=10)
+            assert pool.replicas[0].batches_run == 0
+            # mixed batch: the corpse errors, the live rider answers,
+            # exactly one dispatch runs
+            dead2 = _req(2.0, deadline=time.perf_counter() - 0.01,
+                         deadline_ms=1.0)
+            live = _req(3.0, deadline=time.perf_counter() + 60,
+                        deadline_ms=60_000.0)
+            pool.dispatch(MicroBatch([dead2, live], 2, ("x",)))
+            np.testing.assert_allclose(
+                live.pending.result(timeout=10)[0],
+                np.full((1, 2), 6.0))
+            with pytest.raises(DeadlineExceededError):
+                dead2.pending.result(timeout=0)
+            assert pool.replicas[0].batches_run == 1
+        finally:
+            assert pool.close(timeout=10) is True
+
+    def test_dead_thread_detected_gauge_respawn_and_loud_log(
+            self, monkeypatch, capfd):
+        """Satellite regression: a replica thread dying by uncaught
+        exception used to leave serving_replicas (and capacity) lying
+        forever. The supervisor owns gauge truth: quarantine drops the
+        gauge, the riders get typed errors, a respawn restores it —
+        all loudly."""
+        monkeypatch.setenv("PT_FAULT_REPLICA_DIE", "1")
+        from paddle_tpu.testing import faults
+        monkeypatch.setattr(faults, "_serving_fired", set())
+        uninstall = faults.install_serving_faults()
+        assert callable(uninstall)
+        resp0 = _counter("serving_replica_respawns_total")
+        try:
+            pool = _tiny_pool(n_replicas=1)
+            victim = _req(1.0)
+            pool.dispatch(MicroBatch([victim], 1, ("x",)))
+            with pytest.raises(ReplicaLostError, match="thread died"):
+                victim.pending.result(timeout=15)
+            # the supervisor told the truth the moment it knew
+            _wait_until(lambda: _gauge("serving_replica_state",
+                                       state="up") == 1
+                        and _counter("serving_replica_respawns_total")
+                        > resp0,
+                        msg="respawn")
+            assert _gauge("serving_replicas") == 1
+            # the respawned replica serves (fault fired once)
+            ok = _req(2.0)
+            pool.dispatch(MicroBatch([ok], 1, ("x",)))
+            np.testing.assert_allclose(
+                ok.pending.result(timeout=15)[0],
+                np.full((1, 2), 4.0))
+            assert pool.close(timeout=10) is True
+        finally:
+            uninstall()
+        err = capfd.readouterr().err
+        assert "replica 0 thread died" in err
+        assert "respawned" in err
+
+    def test_stalled_dispatch_quarantined_and_respawned(
+            self, monkeypatch, capfd):
+        monkeypatch.setenv("PT_FAULT_REPLICA_STALL", "1")
+        monkeypatch.setenv("PT_FAULT_STALL_SECS", "30")
+        from paddle_tpu.testing import faults
+        monkeypatch.setattr(faults, "_serving_fired", set())
+        uninstall = faults.install_serving_faults()
+        assert callable(uninstall)
+        try:
+            pool = _tiny_pool(n_replicas=1, replica_stall_ms=150.0)
+            t0 = time.perf_counter()
+            victim = _req(1.0)
+            pool.dispatch(MicroBatch([victim], 1, ("x",)))
+            with pytest.raises(ReplicaLostError, match="wedged"):
+                victim.pending.result(timeout=15)
+            # the rider resolved in bounded time: stall threshold +
+            # supervisor poll + slack, nowhere near the 30s wedge
+            assert time.perf_counter() - t0 < 5.0
+            _wait_until(lambda: _gauge("serving_replica_state",
+                                       state="up") == 1,
+                        msg="respawn after stall")
+            ok = _req(2.0)
+            pool.dispatch(MicroBatch([ok], 1, ("x",)))
+            np.testing.assert_allclose(
+                ok.pending.result(timeout=15)[0],
+                np.full((1, 2), 4.0))
+            assert pool.close(timeout=10) is True
+        finally:
+            uninstall()
+        err = capfd.readouterr().err
+        assert "wedged mid-dispatch" in err
+        assert "quarantined" in err
+
+    def test_consecutive_losses_retire_never_silently_hang(
+            self, monkeypatch, capfd):
+        """N consecutive losses permanently retire the replica and
+        shrink the pool — and a pool with ZERO live replicas still
+        fails queued batches typed instead of hanging them."""
+        from paddle_tpu.serving.replica import Replica
+
+        def always_die(self, bucket, feeds):
+            raise SystemExit(1)
+
+        monkeypatch.setattr(Replica, "run_batch", always_die)
+        pool = _tiny_pool(n_replicas=1, max_consecutive_stalls=2,
+                          respawn_backoff_ms=1.0)
+        # first death: quarantine + respawn; second: retire
+        v1 = _req(1.0)
+        pool.dispatch(MicroBatch([v1], 1, ("x",)))
+        with pytest.raises(ReplicaLostError):
+            v1.pending.result(timeout=15)
+        v2 = _req(2.0)
+        pool.dispatch(MicroBatch([v2], 1, ("x",)))
+        with pytest.raises(ReplicaLostError):
+            v2.pending.result(timeout=15)
+        _wait_until(lambda: _gauge("serving_replica_state",
+                                   state="retired") == 1,
+                    msg="retirement")
+        assert _gauge("serving_replicas") == 0
+        # the dead pool fails new batches, never silence
+        v3 = _req(3.0)
+        pool.dispatch(MicroBatch([v3], 1, ("x",)))
+        with pytest.raises(ReplicaLostError, match="no live replicas"):
+            v3.pending.result(timeout=15)
+        assert pool.close(timeout=10) is True
+        err = capfd.readouterr().err
+        assert "PERMANENTLY RETIRED" in err
+        assert "ZERO live replicas" in err
+
+    def test_close_contract_survives_respawn(self, monkeypatch):
+        """Drain + sentinel-idempotence + timeout contract after a
+        respawn: the respawned replica is the one that drains and
+        joins."""
+        monkeypatch.setenv("PT_FAULT_REPLICA_DIE", "1")
+        from paddle_tpu.testing import faults
+        monkeypatch.setattr(faults, "_serving_fired", set())
+        uninstall = faults.install_serving_faults()
+        try:
+            pool = _tiny_pool(n_replicas=1)
+            v = _req(1.0)
+            pool.dispatch(MicroBatch([v], 1, ("x",)))
+            with pytest.raises(ReplicaLostError):
+                v.pending.result(timeout=15)
+            _wait_until(lambda: _gauge("serving_replica_state",
+                                       state="up") == 1,
+                        msg="respawn")
+            # enqueue work, then close: the respawned replica drains
+            riders = [_req(float(i + 2)) for i in range(3)]
+            for r in riders:
+                pool.dispatch(MicroBatch([r], 1, ("x",)))
+            assert pool.close(timeout=20) is True
+            for i, r in enumerate(riders):
+                np.testing.assert_allclose(
+                    r.pending.result(timeout=0)[0],
+                    np.full((1, 2), 2.0 * (i + 2)))
+            assert pool.close() is True     # idempotent
+            assert _gauge("serving_replicas") == 0
+        finally:
+            uninstall()
+
+    def test_stale_busy_since_without_batch_never_quarantines(self):
+        """Review fix: the supervisor's stall verdict re-validates the
+        judged dispatch at loss time. A stale ``busy_since`` reading
+        with no in-flight batch (the dispatch ended between the check
+        and the act) must NOT quarantine a healthy replica — before
+        the fix it did, spuriously abandoning a live thread."""
+        pool = _tiny_pool(n_replicas=1, replica_stall_ms=100.0)
+        try:
+            # forge the stale stamp the race would produce: old
+            # busy_since, current already cleared
+            pool.replicas[0].busy_since = time.perf_counter() - 999.0
+            time.sleep(0.4)     # several supervisor polls
+            assert _gauge("serving_replica_state", state="up") == 1
+            assert _gauge("serving_replica_state",
+                          state="quarantined") == 0
+            r = _req(1.0)
+            pool.dispatch(MicroBatch([r], 1, ("x",)))
+            np.testing.assert_allclose(
+                r.pending.result(timeout=10)[0],
+                np.full((1, 2), 2.0))
+        finally:
+            assert pool.close(timeout=10) is True
+
+    def test_abandoned_thread_never_eats_a_live_sentinel(self):
+        """Review fix: an abandoned thread blocked in get() must hand
+        a won _STOP back instead of consuming it — otherwise the live
+        replica on the slot never sees its sentinel and close() hangs
+        forever. Two drainers race the queue, so repeat the scenario."""
+        from paddle_tpu.serving.replica import Replica, _UP
+        for _ in range(5):
+            pool = _tiny_pool(n_replicas=1, supervise=False)
+            old = pool.replicas[0]
+            old._abandoned = True       # as a quarantine would
+            nr = Replica(0, old.device, old._params, old._executables,
+                         ("x",), pool.batch_queue)
+            pool.replicas[0] = nr
+            pool._states[0] = _UP
+            nr.start()                  # as a respawn would
+            r = _req(1.0)
+            pool.dispatch(MicroBatch([r], 1, ("x",)))
+            np.testing.assert_allclose(
+                r.pending.result(timeout=10)[0],
+                np.full((1, 2), 2.0))
+            assert pool.close(timeout=5) is True, \
+                "close hung: a sentinel was consumed by the " \
+                "abandoned drainer"
+            old.join(5)
+            assert not old.is_alive()
+
+    def test_close_fails_batch_of_replica_dead_mid_drain(
+            self, monkeypatch):
+        """Review fix: the supervisor is stopped during close(), so
+        the drain must handle losses itself — a replica thread that
+        died with a batch in flight used to leave its riders hanging
+        forever while close() returned True."""
+        from paddle_tpu.serving.replica import Replica
+
+        def die(self, bucket, feeds):
+            raise SystemExit(1)
+
+        monkeypatch.setattr(Replica, "run_batch", die)
+        # no supervisor at all: close() alone must keep the invariant
+        pool = _tiny_pool(n_replicas=1, supervise=False)
+        v = _req(1.0)
+        pool.dispatch(MicroBatch([v], 1, ("x",)))
+        time.sleep(0.2)         # let the thread pick the batch and die
+        assert pool.close(timeout=10) is True
+        with pytest.raises(ReplicaLostError, match="died during"):
+            v.pending.result(timeout=5)
+
+    def test_close_fails_batch_of_replica_wedged_mid_drain(
+            self, monkeypatch):
+        """Review fix: a replica wedged past replica_stall_ms at
+        close() is failed+abandoned instead of blocking the join
+        forever (close(timeout=None) used to hang on it)."""
+        from paddle_tpu.serving.replica import Replica
+        orig = Replica.run_batch
+
+        def wedge(self, bucket, feeds):
+            time.sleep(3.0)
+            return orig(self, bucket, feeds)
+
+        monkeypatch.setattr(Replica, "run_batch", wedge)
+        pool = _tiny_pool(n_replicas=1, supervise=False,
+                          replica_stall_ms=100.0)
+        v = _req(1.0)
+        pool.dispatch(MicroBatch([v], 1, ("x",)))
+        time.sleep(0.3)         # picked, now past the stall threshold
+        t0 = time.perf_counter()
+        assert pool.close(timeout=10) is True
+        assert time.perf_counter() - t0 < 3.0   # did not wait the wedge
+        with pytest.raises(ReplicaLostError, match="wedged"):
+            v.pending.result(timeout=5)
+
+    def test_close_timeout_honored_with_full_queue_and_wedge(
+            self, monkeypatch):
+        """Review fix: close() used to enqueue sentinels with a
+        BLOCKING put before any loss handling — with the batch queue
+        full and the only consumer wedged, close hung forever ignoring
+        its timeout. The drain loop enqueues sentinels non-blocking
+        and judges the wedge, so the riders resolve typed and close
+        returns."""
+        from paddle_tpu.serving.replica import Replica
+        orig = Replica.run_batch
+
+        def wedge(self, bucket, feeds):
+            time.sleep(5.0)
+            return orig(self, bucket, feeds)
+
+        monkeypatch.setattr(Replica, "run_batch", wedge)
+        pool = _tiny_pool(n_replicas=1, supervise=False,
+                          replica_stall_ms=100.0)   # queue depth 2
+        first = _req(1.0)
+        pool.dispatch(MicroBatch([first], 1, ("x",)))
+        time.sleep(0.15)        # picked; now wedged in run_batch
+        queued = [_req(float(i + 2)) for i in range(2)]
+        for r in queued:
+            pool.dispatch(MicroBatch([r], 1, ("x",)))   # queue FULL
+        t0 = time.perf_counter()
+        assert pool.close(timeout=5) is True
+        assert time.perf_counter() - t0 < 4.0
+        for r in [first] + queued:
+            with pytest.raises(ReplicaLostError):
+                r.pending.result(timeout=5)
+
+    def test_close_zeroes_every_state_series(self):
+        """Review fix: a true close must zero quarantined/retired too
+        — a stale serving_replica_state{quarantined}=1 on a closed
+        server reads as a respawn that can never come."""
+        from paddle_tpu.serving.replica import _QUARANTINED
+        pool = _tiny_pool(n_replicas=1, supervise=False)
+        with pool._lock:
+            pool.replicas[0]._abandoned = True
+            pool._states[0] = _QUARANTINED
+            pool._publish_states()
+        assert _gauge("serving_replica_state", state="quarantined") == 1
+        assert pool.close(timeout=10) is True
+        for st in ("up", "quarantined", "retired"):
+            assert _gauge("serving_replica_state", state=st) == 0, st
+
+    def test_unsupervised_pool_is_legacy(self):
+        pool = _tiny_pool(n_replicas=1, supervise=False)
+        assert pool._supervisor is None
+        r = _req(1.0)
+        pool.dispatch(MicroBatch([r], 1, ("x",)))
+        np.testing.assert_allclose(r.pending.result(timeout=10)[0],
+                                   np.full((1, 2), 2.0))
+        assert pool.close(timeout=10) is True
+
+    def test_pool_knob_validation(self):
+        with pytest.raises(EnforceNotMet, match="replica_stall_ms"):
+            _tiny_pool(replica_stall_ms=0)
+        with pytest.raises(EnforceNotMet,
+                           match="max_consecutive_stalls"):
+            _tiny_pool(max_consecutive_stalls=0)
+
+
+# ---------------------------------------------------------------------------
+# chaos fault plumbing (testing/faults.py)
+# ---------------------------------------------------------------------------
+class TestServingFaultUnits:
+    def test_install_requires_env(self, monkeypatch):
+        for k in ("PT_FAULT_REPLICA_STALL", "PT_FAULT_REPLICA_DIE",
+                  "PT_FAULT_DISPATCH_ERROR"):
+            monkeypatch.delenv(k, raising=False)
+        from paddle_tpu.testing import faults
+        assert faults.install_serving_faults() is False
+
+    def test_install_uninstall_restores(self, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_DISPATCH_ERROR", "1")
+        from paddle_tpu.serving.replica import Replica
+        from paddle_tpu.testing import faults
+        orig = Replica.run_batch
+        uninstall = faults.install_serving_faults()
+        assert Replica.run_batch is not orig
+        uninstall()
+        assert Replica.run_batch is orig
+
+    def test_dispatch_error_fires_once_and_replica_survives(
+            self, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_DISPATCH_ERROR", "2")
+        from paddle_tpu.testing import faults
+        monkeypatch.setattr(faults, "_serving_fired", set())
+        uninstall = faults.install_serving_faults()
+        try:
+            pool = _tiny_pool(n_replicas=1)
+            outs = []
+            for i in range(4):
+                r = _req(float(i + 1))
+                pool.dispatch(MicroBatch([r], 1, ("x",)))
+                try:
+                    outs.append(r.pending.result(timeout=10)[0][0, 0])
+                except RuntimeError as e:
+                    outs.append(str(e))
+            # batch 2 of the replica errored; 1, 3, 4 served — the
+            # replica survived the injected dispatch error
+            assert outs[0] == 2.0 and outs[2] == 6.0 and outs[3] == 8.0
+            assert "injected dispatch error" in outs[1]
+            assert pool.replicas[0].batches_run == 3
+            assert _counter("serving_replica_respawns_total") >= 0
+            assert pool.close(timeout=10) is True
+        finally:
+            uninstall()
+
+    def test_replica_scope_filter(self, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_DISPATCH_ERROR", "1")
+        monkeypatch.setenv("PT_FAULT_REPLICA", "7")   # nobody
+        from paddle_tpu.testing import faults
+        monkeypatch.setattr(faults, "_serving_fired", set())
+        uninstall = faults.install_serving_faults()
+        try:
+            pool = _tiny_pool(n_replicas=1)
+            r = _req(1.0)
+            pool.dispatch(MicroBatch([r], 1, ("x",)))
+            np.testing.assert_allclose(
+                r.pending.result(timeout=10)[0],
+                np.full((1, 2), 2.0))   # scoped away: no fault
+            assert pool.close(timeout=10) is True
+        finally:
+            uninstall()
+
+    def test_rank_scope_respected(self, monkeypatch):
+        monkeypatch.setenv("PT_FAULT_DISPATCH_ERROR", "1")
+        monkeypatch.setenv("PT_FAULT_RANK", "1")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+        from paddle_tpu.testing import faults
+        monkeypatch.setattr(faults, "_serving_fired", set())
+        uninstall = faults.install_serving_faults()
+        try:
+            pool = _tiny_pool(n_replicas=1)
+            r = _req(1.0)
+            pool.dispatch(MicroBatch([r], 1, ("x",)))
+            np.testing.assert_allclose(
+                r.pending.result(timeout=10)[0],
+                np.full((1, 2), 2.0))
+            assert pool.close(timeout=10) is True
+        finally:
+            uninstall()
+
+
+# ---------------------------------------------------------------------------
+# slow e2e: 2-replica server under open-loop load, stall on replica 1
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+class TestChaosEndToEnd:
+    """Acceptance run (ISSUE 12): with a stall injected on one of two
+    replicas mid-load, every submitted request resolves (typed error
+    or answer — per-request accounting, zero hangs), the wedged
+    batch's riders get typed errors, the replica respawns (the
+    serving_replica_state transitions land in .prom snapshots), and
+    post-recovery QPS returns to within 1.2x of a clean run."""
+
+    def _run_worker(self, tmp_path, tag, fault_env):
+        hb = tmp_path / f"hb_{tag}"
+        hb.mkdir()
+        out = tmp_path / f"{tag}.json"
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "PADDLE_HEARTBEAT_DIR": str(hb),
+            "PADDLE_TRAINER_ID": "0",
+        })
+        env.update(fault_env)
+        r = subprocess.run(
+            [sys.executable, WORKER, str(tmp_path / f"model_{tag}"),
+             str(out)],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=REPO)
+        assert r.returncode == 0, \
+            f"[{tag}] rc={r.returncode}\n{r.stderr[-3000:]}"
+        with open(out) as f:
+            return json.load(f), hb, r.stderr
+
+    def test_stall_chaos_end_to_end(self, tmp_path):
+        from paddle_tpu.monitor import exporter
+        clean, _hb_c, _ = self._run_worker(tmp_path, "clean", {})
+        chaos, hb, err = self._run_worker(tmp_path, "chaos", {
+            "PT_FAULT_REPLICA_STALL": "3",
+            "PT_FAULT_REPLICA": "1",
+            "PT_FAULT_STALL_SECS": "60",
+        })
+        # -- every request resolved: typed error or answer, 0 hangs --
+        assert chaos["hangs"] == 0, chaos
+        assert chaos["total"] == chaos["ok"] + chaos["errors"], chaos
+        assert chaos["replica_lost_errors"] >= 1, chaos
+        assert "injected replica stall" in err
+        # -- the replica respawned; transitions visible in .prom --
+        assert chaos["respawns"] >= 1, chaos
+        qsnap = hb / "quarantine.prom"
+        assert qsnap.exists(), "quarantine snapshot never captured"
+        _qtypes, qsamples = exporter.parse_text(qsnap.read_text())
+        qval = [v for (name, labels), v in qsamples.items()
+                if name == "serving_replica_state"
+                and dict(labels).get("state") == "quarantined"]
+        assert qval and qval[0] >= 1, qsamples
+        _rtypes, rsamples = exporter.parse_text(
+            (hb / "recovered.prom").read_text())
+        assert rsamples.get(("serving_replica_state",
+                             (("state", "up"),))) == 2, rsamples
+        assert rsamples.get(
+            ("serving_replica_respawns_total", ())) >= 1
+        # the respawn evidence survives shutdown in the final snapshot
+        _ftypes, fsamples = exporter.parse_text(
+            (hb / "rank0.prom").read_text())
+        assert fsamples.get(
+            ("serving_replica_respawns_total", ())) >= 1
+        # -- unaffected requests kept a bounded p99: the stall holds
+        # one batch for ~replica_stall_ms; everyone else flows --
+        stall_ms = chaos["replica_stall_ms"]
+        assert chaos["p99_ok_ms"] < 2 * stall_ms + 2000, chaos
+        # -- post-recovery QPS within 1.2x of the clean run --
+        assert chaos["recovery_qps"] * 1.2 >= clean["recovery_qps"], \
+            (chaos["recovery_qps"], clean["recovery_qps"])
+
+    def test_clean_worker_reports_no_transitions(self, tmp_path):
+        clean, hb, _ = self._run_worker(tmp_path, "clean2", {})
+        assert clean["hangs"] == 0 and clean["errors"] == 0
+        assert clean["respawns"] == 0
+        assert not (hb / "quarantine.prom").exists()

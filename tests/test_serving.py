@@ -592,6 +592,61 @@ class TestInferenceServer:
         g1 = REGISTRY.get("serving_replicas")
         assert (g1.value() if g1 else 0.0) == g0
 
+    def test_shed_off_default_is_legacy_wiring(self, model_dir):
+        """shed_mode='off' (the default) must be bit-for-bit the
+        pre-resilience scheduler: no controller, no default deadline —
+        the admission path has nothing new to execute."""
+        from paddle_tpu.serving import InferenceServer, ServingConfig
+        with InferenceServer(model_dir, ServingConfig(
+                max_batch=4, max_wait_ms=1.0)) as srv:
+            assert srv.scheduler._shed is None
+            assert srv.scheduler._default_deadline_ms is None
+            assert srv.config.shed_mode == "off"
+
+    def test_bad_shed_config_fails_before_warm_boot(self, model_dir):
+        from paddle_tpu.serving import InferenceServer, ServingConfig
+        g0 = REGISTRY.get("serving_replicas")
+        g0 = g0.value() if g0 else 0.0
+        with pytest.raises(EnforceNotMet, match="shed_mode"):
+            InferenceServer(model_dir,
+                            ServingConfig(shed_mode="sometimes"))
+        with pytest.raises(EnforceNotMet, match="default_deadline_ms"):
+            InferenceServer(model_dir,
+                            ServingConfig(shed_mode="adaptive"))
+        g1 = REGISTRY.get("serving_replicas")
+        assert (g1.value() if g1 else 0.0) == g0
+
+    def test_closed_server_still_validates_arguments_first(
+            self, model_dir):
+        """Review fix: the server-level submit no longer pre-gates on
+        closed state — a malformed request fails the documented typed
+        way (EnforceNotMet) whether the server is open or closed; a
+        well-formed one gets ServerClosedError."""
+        from paddle_tpu.serving import (InferenceServer,
+                                        ServerClosedError, ServingConfig)
+        srv = InferenceServer(model_dir, ServingConfig(
+            max_batch=4, max_wait_ms=1.0))
+        assert srv.close(timeout=30) is True
+        with pytest.raises(EnforceNotMet, match="missing feeds"):
+            srv.submit({})
+        with pytest.raises(EnforceNotMet, match="deadline_ms"):
+            srv.submit({"x": np.zeros((1, 16), np.float32)},
+                       deadline_ms=-5)
+        with pytest.raises(ServerClosedError):
+            srv.submit({"x": np.zeros((1, 16), np.float32)})
+
+    def test_deadline_passthrough_end_to_end(self, model_dir):
+        from paddle_tpu.serving import (DeadlineExceededError,
+                                        InferenceServer, ServingConfig)
+        with InferenceServer(model_dir, ServingConfig(
+                max_batch=4, max_wait_ms=1.0)) as srv:
+            out = srv.infer({"x": np.zeros((1, 16), np.float32)},
+                            timeout=30, deadline_ms=60_000)
+            assert out[0].shape == (1, 4)
+            with pytest.raises(DeadlineExceededError):
+                srv.submit({"x": np.zeros((1, 16), np.float32)},
+                           deadline_ms=0)
+
     def test_dynamic_nonbatch_dim_requires_feed_specs(self, tmp_path):
         import paddle_tpu as pt
         from paddle_tpu import layers

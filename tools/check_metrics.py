@@ -37,6 +37,14 @@ _DOC_RE = re.compile(
 _EXEMPLAR_RE = re.compile(
     r"EXEMPLAR_METRICS\s*=\s*\(([^)]*)\)", re.DOTALL)
 _NAME_IN_TUPLE_RE = re.compile(r"[\"']([a-zA-Z_:][a-zA-Z0-9_:]*)[\"']")
+# an ``outcome`` label declared on a registration (scanned in the
+# registration's source window), and the ``outcome="value"`` keyword
+# uses that define the vocabulary — in inc() calls and in help/doc
+# strings alike (a value the help text promises must be documented too)
+_OUTCOME_LABEL_RE = re.compile(
+    r"labels\s*=\s*[\(\[][^)\]]*[\"']outcome[\"']")
+_OUTCOME_VALUE_RE = re.compile(
+    r"outcome\s*=\s*[\"']([A-Za-z0-9_]+)[\"']")
 
 
 def exemplar_metrics(repo=REPO):
@@ -60,15 +68,7 @@ def code_metrics(repo=REPO):
     but only when both import) — the lint flags it statically rather
     than letting the last os.walk hit win."""
     out = {}
-    roots = [os.path.join(repo, "paddle_tpu")]
-    files = [os.path.join(repo, "bench.py")]
-    for root in roots:
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [d for d in dirnames
-                           if d not in ("__pycache__",)]
-            files.extend(os.path.join(dirpath, f) for f in filenames
-                         if f.endswith(".py"))
-    for path in files:
+    for path in _code_files(repo):
         try:
             with open(path) as f:
                 src = f.read()
@@ -79,10 +79,69 @@ def code_metrics(repo=REPO):
     return out
 
 
+def _code_files(repo):
+    files = [os.path.join(repo, "bench.py")]
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(repo, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d not in ("__pycache__",)]
+        files.extend(os.path.join(dirpath, f) for f in filenames
+                     if f.endswith(".py"))
+    return files
+
+
+def outcome_vocabularies(repo=REPO):
+    """{metric name: set of ``outcome`` label values} for every
+    counter registered with an ``outcome`` label. The vocabulary is
+    every ``outcome="..."`` literal in the REGISTERING file — the repo
+    convention keeps a counter's inc sites in the module that
+    registers it, and the deliberately-coarse union errs in the SAFE
+    direction: a value reaching ``inc`` through a helper variable
+    (``inc(outcome=outcome)``) is still caught by its literal at the
+    call site, where a per-variable attribution would silently let a
+    new outcome escape the lint. Two outcome counters in one file
+    over-demand each other's values — split modules if that bites."""
+    out = {}
+    for path in _code_files(repo):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        file_union = None
+        regs = list(_REG_RE.finditer(src))
+        for k, m in enumerate(regs):
+            kind, name = m.group(1), m.group(2)
+            # the registration call's argument window runs to the
+            # NEXT registration (or EOF): a neighbor's
+            # labels=("outcome",) can't bleed in and misclassify this
+            # one, and a long help string can't push this one's own
+            # labels out of a fixed-size window (false green)
+            end = regs[k + 1].start() if k + 1 < len(regs) else len(src)
+            if kind != "counter" or \
+                    not _OUTCOME_LABEL_RE.search(src[m.start():end]):
+                continue
+            if file_union is None:
+                file_union = set(_OUTCOME_VALUE_RE.findall(src))
+            out.setdefault(name, set()).update(file_union)
+    return out
+
+
 def doc_metrics(path=DOCS):
     """{name: documented type} from the catalogue table rows."""
     with open(path) as f:
         return {name: kind for name, kind in _DOC_RE.findall(f.read())}
+
+
+def doc_rows(path=DOCS):
+    """{name: full catalogue row line} — for lints that inspect a
+    row's prose (e.g. the outcome-vocabulary check)."""
+    rows = {}
+    with open(path) as f:
+        for line in f.read().splitlines():
+            m = _DOC_RE.match(line)
+            if m:
+                rows[m.group(1)] = line
+    return rows
 
 
 def main():
@@ -100,6 +159,12 @@ def main():
         n for n in exemplar_metrics()
         if docs.get(n) != "histogram" or "histogram" not in
         code.get(n, set()))
+    rows = doc_rows()
+    missing_vocab = sorted(
+        (name, v)
+        for name, vocab in outcome_vocabularies().items()
+        for v in sorted(vocab)
+        if f"`{v}`" not in rows.get(name, ""))
     if undocumented:
         print(f"metrics registered in code but missing from "
               f"docs/OBSERVABILITY.md catalogue: {undocumented}")
@@ -116,8 +181,13 @@ def main():
         print(f"exemplar metric {name!r} (monitor/trace.py "
               f"EXEMPLAR_METRICS) must be a registered AND documented "
               f"histogram")
+    for name, v in missing_vocab:
+        print(f"outcome-labeled counter {name!r} uses "
+              f"outcome=\"{v}\" but its docs/OBSERVABILITY.md "
+              f"catalogue row does not document `{v}` — the row must "
+              f"carry the full label vocabulary")
     if undocumented or stale or conflicted or mismatched \
-            or bad_exemplars:
+            or bad_exemplars or missing_vocab:
         return 1
     print(f"metrics catalogue in sync ({len(code)} metrics, "
           f"kinds verified)")
