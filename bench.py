@@ -454,6 +454,158 @@ def bench_int8():
         report("bert_layer", mb, i_ms, b_ms, c1 or c2)
 
 
+def _freeze_serving_mlp(dirname):
+    """The serving-bench model: a dispatch-bound MLP — online serving
+    of small models is dominated by per-request dispatch overhead,
+    exactly the cost continuous batching amortizes (a compute-bound
+    model would measure the chip, not the serving stack). Shared by
+    the headline A/B, the chaos bench, and the hot-swap bench (which
+    freezes a SECOND copy as the new version)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [256], dtype="float32")
+        h = layers.fc(x, 256, act="relu")
+        h = layers.fc(h, 256, act="relu")
+        out = layers.fc(h, 10)
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.io.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=main)
+    return dirname
+
+
+def _bench_serving_swap(d, feed, max_batch, max_wait_ms):
+    """The hot-swap half of `bench.py serving`
+    (BENCH_SERVING_SWAP=1, docs/SERVING.md "Hot model swap"): ONE
+    open-loop Poisson schedule at ~0.5x measured capacity with a
+    ``server.swap()`` to a freshly frozen second version fired at the
+    schedule midpoint. Every request is accounted (a hang is a bench
+    failure); two JSON lines:
+
+    - ``serving_swap_p99_ratio``: p99 latency of requests whose
+      [arrival, completion] overlaps the swap window (gate ->
+      watchdog-pass) vs the p99 of the rest — the acceptance target
+      is <= 1.5x (the swap builds the standby OFF the serving path,
+      so overlap requests should barely notice).
+    - ``serving_swap_blip_ms``: the longest gap between consecutive
+      request completions that overlaps the swap window — the cutover
+      stall an operator would see on a completions dashboard.
+
+    Knobs: BENCH_SERVING_SWAP_REQS (default 300),
+    BENCH_SERVING_SWAP_WATCHDOG_MS (default 200)."""
+    import tempfile
+    import threading
+
+    from paddle_tpu.serving import InferenceServer, ServingConfig
+
+    n = int(os.environ.get("BENCH_SERVING_SWAP_REQS", "300"))
+    watchdog_ms = float(os.environ.get(
+        "BENCH_SERVING_SWAP_WATCHDOG_MS", "200"))
+    d2 = _freeze_serving_mlp(tempfile.mkdtemp())
+
+    srv = InferenceServer(d, ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=n + 64, replicas=1))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        srv.infer({"x": feed}, timeout=60)
+    cap = 20 / (time.perf_counter() - t0)
+    offered = 0.5 * cap
+    sched = np.cumsum(np.random.RandomState(17).exponential(
+        1.0 / offered, size=n))
+
+    swap_state = {}
+
+    def do_swap():
+        t_s = time.perf_counter()
+        try:
+            swap_state["report"] = srv.swap(d2,
+                                            watchdog_ms=watchdog_ms)
+        except Exception as e:       # surfaced in the JSON row
+            swap_state["error"] = f"{type(e).__name__}: {e}"
+        swap_state["t0"] = t_s
+        swap_state["t1"] = time.perf_counter()
+
+    pend = [None] * n
+    arrived = [0.0] * n
+    swap_thread = None
+    t_origin = time.perf_counter()
+    for i in range(n):
+        dly = t_origin + sched[i] - time.perf_counter()
+        if dly > 0:
+            time.sleep(dly)
+        if i == n // 2 and swap_thread is None:
+            swap_thread = threading.Thread(target=do_swap,
+                                           daemon=True)
+            swap_thread.start()
+        arrived[i] = t_origin + sched[i]
+        pend[i] = srv.submit({"x": feed})
+    hangs = 0
+    for p in pend:
+        try:
+            p.result(timeout=120)
+        except TimeoutError:
+            hangs += 1
+        except Exception:
+            pass                     # typed errors are accounted below
+    if swap_thread is not None:
+        swap_thread.join(120)
+    srv.close(timeout=60)
+
+    t0s = swap_state.get("t0", float("inf"))
+    t1s = swap_state.get("t1", float("-inf"))
+    done = [p.t_done for p in pend]
+    lat_ms = [(dn - ar) * 1e3 for dn, ar in zip(done, arrived)
+              if dn is not None]
+    overlap = [(dn - ar) * 1e3 for dn, ar in zip(done, arrived)
+               if dn is not None and ar <= t1s and dn >= t0s]
+    steady = [(dn - ar) * 1e3 for dn, ar in zip(done, arrived)
+              if dn is not None and (ar > t1s or dn < t0s)]
+    p99_overlap = (float(np.percentile(overlap, 99))
+                   if overlap else None)
+    p99_steady = (float(np.percentile(steady, 99))
+                  if steady else None)
+    ratio = (round(p99_overlap / p99_steady, 3)
+             if overlap and steady and p99_steady > 0 else None)
+    # the longest completion silence overlapping the swap window: the
+    # stall an operator's completions-per-second dashboard would show
+    comp = sorted(dn for dn in done if dn is not None)
+    blip = 0.0
+    for a, b in zip(comp, comp[1:]):
+        if b >= t0s and a <= t1s:
+            blip = max(blip, (b - a) * 1e3)
+    print(json.dumps({
+        "metric": "serving_swap_p99_ratio",
+        "value": ratio, "unit": "x",
+        "p99_overlap_ms": (round(p99_overlap, 2)
+                           if p99_overlap is not None else None),
+        "p99_steady_ms": (round(p99_steady, 2)
+                          if p99_steady is not None else None),
+        "n_overlap": len(overlap), "n_steady": len(steady),
+        "hangs": hangs,
+        "outcome": (swap_state.get("report", {}).get("outcome")
+                    if "report" in swap_state
+                    else swap_state.get("error", "not-run")),
+        "swap_ms": (round((t1s - t0s) * 1e3, 1)
+                    if "t0" in swap_state else None),
+        "offered_qps": round(offered, 1),
+    }))
+    print(json.dumps({
+        "metric": "serving_swap_blip_ms",
+        "value": round(blip, 2), "unit": "ms",
+        "swap_window_ms": (round((t1s - t0s) * 1e3, 1)
+                           if "t0" in swap_state else None),
+        "watchdog_ms": watchdog_ms,
+    }))
+
+
 def bench_serving():
     """`python bench.py serving` — OPEN-LOOP serving load (the honest
     way to measure tail latency: arrivals follow a deterministic-seed
@@ -487,16 +639,21 @@ def bench_serving():
     that DID miss their deadline in the shed-off control pass — same
     schedule, traced keep-all), and ``serving_shed_overhead_ratio``
     (the controller's clean-path open-loop p50 cost via the shared
-    ABBA protocol; must stay < 1.05x)."""
+    ABBA protocol; must stay < 1.05x).
+
+    ``BENCH_SERVING_SWAP=1`` runs the HOT-SWAP bench instead
+    (docs/SERVING.md "Hot model swap"): one open-loop schedule with a
+    mid-run ``server.swap()`` to a second model version, emitting
+    ``serving_swap_p99_ratio`` (p99 of requests whose lifetime
+    overlaps the swap window vs steady-state) and
+    ``serving_swap_blip_ms`` (the longest completion silence
+    overlapping the cutover — the stall an operator would see)."""
     import queue as _queue
     import tempfile
     import threading
 
     import jax
 
-    import paddle_tpu as pt
-    from paddle_tpu import layers
-    from paddle_tpu.framework import unique_name
     from paddle_tpu.inference import Config, create_predictor
     from paddle_tpu.monitor.registry import REGISTRY
     from paddle_tpu.serving import InferenceServer, ServingConfig
@@ -511,31 +668,20 @@ def bench_serving():
     max_wait_ms = float(os.environ.get("BENCH_SERVING_MAX_WAIT_MS",
                                        "2.0"))
 
-    # dispatch-bound MLP: online serving of small models is dominated
-    # by per-request dispatch overhead — exactly the cost continuous
-    # batching amortizes (a compute-bound model would measure the
-    # chip, not the serving stack)
-    pt.enable_static()
-    main, startup = pt.Program(), pt.Program()
-    with pt.program_guard(main, startup), unique_name.guard():
-        x = pt.static.data("x", [256], dtype="float32")
-        h = layers.fc(x, 256, act="relu")
-        h = layers.fc(h, 256, act="relu")
-        out = layers.fc(h, 10)
-    scope = pt.static.Scope()
-    with pt.static.scope_guard(scope):
-        exe = pt.Executor()
-        exe.run(startup)
-        d = tempfile.mkdtemp()
-        pt.io.save_inference_model(d, ["x"], [out], exe,
-                                   main_program=main)
-    base = create_predictor(Config(d))
+    d = _freeze_serving_mlp(tempfile.mkdtemp())
     rng = np.random.RandomState(0)
     feed = rng.rand(1, 256).astype(np.float32)
-    np.asarray(base.run({"x": feed})[0])       # compile once, shared
 
+    # branch BEFORE the baseline predictor warm-boot: neither the
+    # chaos nor the swap bench uses it, and its compile is seconds of
+    # dead work per invocation
     if os.environ.get("BENCH_SERVING_CHAOS") == "1":
         return _bench_serving_chaos(d, feed, max_batch, max_wait_ms)
+    if os.environ.get("BENCH_SERVING_SWAP") == "1":
+        return _bench_serving_swap(d, feed, max_batch, max_wait_ms)
+
+    base = create_predictor(Config(d))
+    np.asarray(base.run({"x": feed})[0])       # compile once, shared
 
     # single-request service time -> offered rate for BOTH systems
     probes = 30 if not on_tpu else 50

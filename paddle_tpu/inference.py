@@ -18,6 +18,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 import zlib
 
 import numpy as np
@@ -28,7 +29,8 @@ from paddle_tpu.static.executor import Executor, Scope, exec_op
 from paddle_tpu.static import io as static_io
 
 __all__ = ["Config", "Predictor", "create_predictor", "ZeroCopyTensor",
-           "export_aot", "verify_aot_dir", "AOTIntegrityError"]
+           "export_aot", "verify_aot_dir", "read_aot_version",
+           "AOTIntegrityError"]
 
 AOT_DIR = "__aot__"
 AOT_INDEX = "index.json"
@@ -125,6 +127,36 @@ class AOTIntegrityError(RuntimeError):
     taken for wrong-platform/wrong-version artifacts."""
 
 
+class AOTVerifyResult(int):
+    """``verify_aot_dir``'s return value: the number of artifact files
+    verified (an int, so every existing ``== N`` caller keeps working)
+    plus the ``model_version`` the manifest declares (``None`` for
+    legacy/absent indexes). The version is what the serving hot-swap
+    gate compares against the live server (docs/SERVING.md
+    "Hot model swap")."""
+
+    def __new__(cls, verified, model_version=None):
+        self = super().__new__(cls, int(verified))
+        self.model_version = model_version
+        return self
+
+
+def _model_version_of(prog_hash, state_names, params):
+    """Deterministic content hash of (program, weights) plus an export
+    timestamp: ``<sha256[:12]>.<unix-microseconds>``. Two exports of
+    identical content get distinct versions (the timestamp is the
+    publish event — a republish is a deliberate deploy signal for
+    ``watch_dir`` mode), while the hash half answers "is this the same
+    model bits" for operators reading logs."""
+    h = hashlib.sha256(prog_hash.encode())
+    for n, p in zip(state_names, params):
+        h.update(n.encode())
+        h.update(str(p.shape).encode())
+        h.update(np.dtype(p.dtype).name.encode())
+        h.update(np.ascontiguousarray(p).tobytes())
+    return f"{h.hexdigest()[:12]}.{int(time.time() * 1e6)}"
+
+
 def _file_integrity(path):
     """{"crc32", "nbytes"} of a file's byte image (the io_checkpoint
     idiom, applied to opaque artifact files)."""
@@ -163,18 +195,41 @@ def _verify_artifact(path, expect):
             f"re-run export_aot")
 
 
+def _version_from_entries(entries):
+    """The manifest's model version: the NEWEST per-entry stamp by
+    publish timestamp (the ``.<unix-micros>`` suffix). An index merged
+    across exports keeps older entries with older stamps — the latest
+    export is the dir's deploy identity."""
+    best, best_ts = None, -1
+    for e in entries if isinstance(entries, list) else []:
+        if not isinstance(e, dict):
+            continue
+        v = e.get("model_version")
+        if not v:
+            continue
+        try:
+            ts = int(str(v).rsplit(".", 1)[1])
+        except (IndexError, ValueError):
+            ts = 0
+        if ts >= best_ts:
+            best, best_ts = v, ts
+    return best
+
+
 def verify_aot_dir(model_dir):
     """Verify every AOT artifact under ``<model_dir>/__aot__`` against
-    the index's integrity manifest. Returns the number of files
-    verified (0 when there is no AOT index, or for legacy indexes
-    without integrity records — nothing to vouch for); raises
-    :class:`AOTIntegrityError` on the first bad file. The serving
-    server runs this at warm boot so corruption fails at load, not
-    mid-traffic."""
+    the index's integrity manifest. Returns an :class:`AOTVerifyResult`
+    — an int (the number of files verified; 0 when there is no AOT
+    index, or for legacy indexes without integrity records — nothing to
+    vouch for) carrying ``model_version`` (the manifest's declared
+    version, or None); raises :class:`AOTIntegrityError` on the first
+    bad file. The serving server runs this at warm boot AND at every
+    hot-swap gate (``InferenceServer.swap``) so corruption fails at
+    load/swap time, not mid-traffic."""
     aot_dir = os.path.join(model_dir or "", AOT_DIR)
     index_path = os.path.join(aot_dir, AOT_INDEX)
     if not os.path.exists(index_path):
-        return 0
+        return AOTVerifyResult(0)
     try:
         with open(index_path) as f:
             entries = json.load(f)
@@ -189,7 +244,22 @@ def verify_aot_dir(model_dir):
         for name, rec in sorted(e.get("integrity", {}).items()):
             _verify_artifact(os.path.join(aot_dir, name), rec)
             verified += 1
-    return verified
+    return AOTVerifyResult(verified, _version_from_entries(entries))
+
+
+def read_aot_version(model_dir):
+    """The manifest's ``model_version`` WITHOUT verifying artifact
+    CRCs — a cheap index-only probe (one small JSON read) for the
+    hot-swap directory watcher, which polls it every interval; the
+    full CRC pass runs once, at the swap gate. Returns None when the
+    dir has no AOT index, the index is unreadable, or the export
+    predates versioning."""
+    index_path = os.path.join(model_dir or "", AOT_DIR, AOT_INDEX)
+    try:
+        with open(index_path) as f:
+            return _version_from_entries(json.load(f))
+    except (OSError, ValueError):
+        return None
 
 
 def export_aot(dirname, program, feed_names, fetch_names, scope,
@@ -227,6 +297,11 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
     entries = []
     platform = jax.devices()[0].platform
     prog_hash = _program_hash(program)
+    # the deploy identity of THIS export (content hash + publish
+    # timestamp), stamped on every entry — the serving hot-swap
+    # gate/watcher reads the newest stamp back via
+    # verify_aot_dir/read_aot_version
+    model_version = _model_version_of(prog_hash, state_names, params)
     for bucket in shape_buckets:
         sig = _sig_of(feed_names, bucket)
         feed_sds = tuple(
@@ -241,6 +316,7 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
         entry = {"sig": sig, "key": h, "platform": platform,
                  "jax_version": jax.__version__,
                  "program_hash": prog_hash,
+                 "model_version": model_version,
                  "state_names": state_names, "num_devices": 1}
         payload, in_tree, out_tree = se.serialize(compiled)
         # the wrapper is a structural container (header + counts +
@@ -312,7 +388,9 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
                     except OSError:
                         pass
     # atomic replace: a reader (or a killed exporter) must never see a
-    # truncated index
+    # truncated index. The dir-level model_version is the NEWEST
+    # per-entry stamp (kept entries from older exports carry older
+    # ones) — the index stays a plain list of bucket entries.
     tmp = f"{index_path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(existing + entries, f, indent=1)

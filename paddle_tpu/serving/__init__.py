@@ -10,16 +10,19 @@ preloading, and per-request SLO telemetry riding ``paddle_tpu.monitor``.
 Layering: ``resilience`` (typed failure vocabulary + shed controller —
 stdlib only), ``scheduler`` (queueing/batching — numpy + stdlib only),
 ``replica`` (device-pinned execution + pool supervisor), ``server``
-(front-end). The single-request ``paddle_tpu.inference.Predictor``
-remains the simple embedded path; this package is the "millions of
-users" one — and it fails TYPED: request deadlines, replica
-quarantine/respawn, and adaptive load shedding are documented in
-docs/SERVING.md "Resilience".
+(front-end), ``swap`` (zero-downtime hot model swap: gate → standby
+warm-boot → canary → atomic cutover → watchdog/rollback, plus a
+watch-dir continuous-deploy mode — docs/SERVING.md "Hot model swap").
+The single-request ``paddle_tpu.inference.Predictor`` remains the
+simple embedded path; this package is the "millions of users" one —
+and it fails TYPED: request deadlines, replica quarantine/respawn,
+adaptive load shedding, and supervised reversible deploys are
+documented in docs/SERVING.md.
 """
 
 from paddle_tpu.serving.resilience import (  # noqa: F401
     DeadlineExceededError, OverloadedError, ReplicaLostError,
-    ShedController,
+    ShedController, SwapFailedError, SwapWatchdog,
 )
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     MicroBatch, MicroBatchScheduler, PendingResult, QueueFullError,
@@ -29,11 +32,13 @@ from paddle_tpu.serving.replica import Replica, ReplicaPool  # noqa: F401
 from paddle_tpu.serving.server import (  # noqa: F401
     InferenceServer, ServingConfig,
 )
+from paddle_tpu.serving.swap import SwapController  # noqa: F401
 
 __all__ = [
     "InferenceServer", "ServingConfig", "MicroBatchScheduler",
     "MicroBatch", "PendingResult", "Replica", "ReplicaPool",
     "QueueFullError", "ServerClosedError", "DeadlineExceededError",
     "OverloadedError", "ReplicaLostError", "ShedController",
+    "SwapController", "SwapFailedError", "SwapWatchdog",
     "bucket_ladder", "pick_bucket",
 ]

@@ -80,18 +80,36 @@ _STOP = object()
 _UP, _QUARANTINED, _RETIRED = "up", "quarantined", "retired"
 
 
+def zero_pool_gauges():
+    """Zero every pool gauge — a TRULY closed server has nothing up,
+    nothing awaiting respawn, nothing newly retired. Called by a live
+    pool's own close AND by the server's close epilogue: during a
+    hot-swap rollback racing a close, the pool the server closes may
+    already be demoted (role-gated zeroing skips it), so the server
+    re-asserts gauge truth itself."""
+    _m_replicas.set(0)
+    for s in (_UP, _QUARANTINED, _RETIRED):
+        _m_state.set(0, state=s)
+
+
 class Replica:
     """One worker: a device, resident params, per-bucket executables,
     and a thread draining the shared batch queue."""
 
     def __init__(self, index, device, params, executables, feed_names,
-                 batch_queue):
+                 batch_queue, pool=None):
         self.index = index
         self.device = device
         self._params = params
         self._executables = executables
         self._feed_names = tuple(feed_names)
         self._q = batch_queue
+        #: owning pool (None in direct unit-test construction) — the
+        #: failure-attribution home: a batch failed HERE counts
+        #: against THIS pool, which is what the hot-swap watchdog
+        #: needs (the process-global error counter can't tell a new
+        #: version's errors from the old pool's draining stragglers)
+        self._pool = pool
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"serving-replica-{index}")
@@ -173,6 +191,7 @@ class Replica:
             except Exception as e:
                 # deliver the failure to the batch's requests and keep
                 # serving: one poisoned batch must not kill the replica
+                self._note_failure()
                 mb.fail(e)
                 self._idle()
                 if self._abandoned:
@@ -186,6 +205,7 @@ class Replica:
                 # complete() itself failed (e.g. an executable returned
                 # a wrong leading dim): sweep the undelivered requests
                 # with the error (first-wins delivery) and keep serving
+                self._note_failure()
                 mb.fail(e)
                 self._idle()
                 if self._abandoned:
@@ -200,6 +220,10 @@ class Replica:
     def _idle(self):
         self.current = None
         self.busy_since = None
+
+    def _note_failure(self):
+        if self._pool is not None:
+            self._pool._note_batch_failures()
 
     def run_batch(self, bucket, feeds):
         """Execute one padded batch dict on this replica's executable
@@ -231,12 +255,21 @@ class ReplicaPool:
     with no successful batch in between before the slot permanently
     retires; ``respawn_backoff_ms`` — base of the capped (5s)
     exponential respawn backoff; ``supervise=False`` disables the
-    supervisor thread entirely (the pre-resilience pool)."""
+    supervisor thread entirely (the pre-resilience pool).
+
+    ``role`` makes two pools coexist for the hot model swap
+    (docs/SERVING.md "Hot model swap"): only the ``"live"`` pool
+    publishes the ``serving_replicas``/``serving_replica_state``
+    gauges — a ``"standby"`` pool warm-boots and drains its own queue
+    silently (its supervisor still heals it), and ``promote()``/
+    ``demote()`` hand gauge ownership over at cutover. A demoted
+    pool's ``close()`` never zeroes the gauges the new live pool now
+    owns."""
 
     def __init__(self, pure_fn, params_np, feed_names, sample_specs,
                  ladder, n_replicas=1, devices=None, queue_depth=None,
                  replica_stall_ms=30_000.0, max_consecutive_stalls=3,
-                 respawn_backoff_ms=100.0, supervise=True):
+                 respawn_backoff_ms=100.0, supervise=True, role="live"):
         import jax
         from jax.sharding import SingleDeviceSharding
 
@@ -250,6 +283,9 @@ class ReplicaPool:
         enforce(respawn_backoff_ms >= 0,
                 f"respawn_backoff_ms must be >= 0, got "
                 f"{respawn_backoff_ms!r}")
+        enforce(role in ("live", "standby"),
+                f"role must be 'live' or 'standby', got {role!r}")
+        self.role = role
         self._feed_names = tuple(feed_names)
         self.ladder = tuple(ladder)
         devices = list(devices if devices is not None else jax.devices())
@@ -280,6 +316,16 @@ class ReplicaPool:
                                             feed_sds).compile()
             self._by_device[dev] = (params, exes)
         self._stopped = False
+        #: True only after a TRUE close finished its final sweep — the
+        #: dispatch() post-put sweep keys on it (see dispatch)
+        self._closed_done = False
+        #: batches this pool delivered as typed FAILURES (replica
+        #: execution/complete errors, supervisor-failed in-flight
+        #: batches, dead-pool/close sweeps) — per-pool attribution for
+        #: the hot-swap watchdog; deadline expiries are load symptoms,
+        #: not version faults, and don't count
+        self.batch_failures = 0
+        self._fail_lock = threading.Lock()
         self._stall_s = replica_stall_ms / 1e3
         self._max_stalls = int(max_consecutive_stalls)
         self._backoff_s = respawn_backoff_ms / 1e3
@@ -297,7 +343,7 @@ class ReplicaPool:
             params, exes = self._by_device[self._slot_device[i]]
             self.replicas.append(Replica(
                 i, self._slot_device[i], params, exes,
-                self._feed_names, self.batch_queue))
+                self._feed_names, self.batch_queue, pool=self))
         for r in self.replicas:
             r.start()
         self._publish_states()
@@ -311,6 +357,11 @@ class ReplicaPool:
 
     # -- supervision -------------------------------------------------------
     def _publish_states(self):
+        if self.role != "live":
+            # a standby pool coexists with the live one during a hot
+            # swap: publishing its counts would overwrite the live
+            # pool's gauge truth with the not-yet-serving pool's
+            return
         counts = {_UP: 0, _QUARANTINED: 0, _RETIRED: 0}
         for s in self._states:
             counts[s] += 1
@@ -319,6 +370,38 @@ class ReplicaPool:
         # the supervisor owns gauge truth: serving_replicas is the
         # count actually draining the queue, not the count booted
         _m_replicas.set(counts[_UP])
+
+    def promote(self):
+        """Standby -> live at hot-swap cutover: take gauge ownership
+        and publish this pool's current states (flip and publish under
+        the pool lock — see ``demote`` for why the serialization
+        matters)."""
+        with self._lock:
+            self.role = "live"
+            self._publish_states()
+
+    def demote(self):
+        """Live -> draining-out at hot-swap cutover (or rollback of a
+        freshly promoted standby): stop publishing gauges — the other
+        pool owns them now — while the replicas keep draining whatever
+        batches were already dispatched here. Taken under the pool
+        lock so a supervisor mid-``_publish_states`` finishes BEFORE
+        the role flips: an unserialized flip would let this pool's
+        in-flight publish land after the new owner's, leaving the
+        gauges describing the demoted pool until its next (never)
+        state change."""
+        with self._lock:
+            self.role = "standby"
+
+    def release(self):
+        """Drop the device-resident param copies and executable maps
+        after a TRUE close — the hot swap's ~2x-param-memory window
+        ends here, when the drained old pool lets go. A released pool
+        cannot respawn; only call once close() returned True."""
+        self._by_device.clear()
+        for r in self.replicas:
+            r._params = ()
+            r._executables = {}
 
     def _supervise(self):
         """Detect wedged/dead replicas, quarantine, respawn (capped
@@ -375,6 +458,7 @@ class ReplicaPool:
                 dead_pool = all(s == _RETIRED for s in self._states)
             for mb, exc in to_fail:
                 if mb is not None and hasattr(mb, "fail"):
+                    self._note_batch_failures()
                     mb.fail(exc)
             if dead_pool:
                 self._drain_dead_pool()
@@ -415,12 +499,16 @@ class ReplicaPool:
             f"the request is safe to retry")
         return mb, exc
 
+    def _note_batch_failures(self, n=1):
+        with self._fail_lock:
+            self.batch_failures += n
+
     def _respawn_locked(self, i):
         self._respawn_due.pop(i, None)
         dev = self._slot_device[i]
         params, exes = self._by_device[dev]     # warm: never recompiles
         nr = Replica(i, dev, params, exes, self._feed_names,
-                     self.batch_queue)
+                     self.batch_queue, pool=self)
         self.replicas[i] = nr
         self._states[i] = _UP
         nr.start()
@@ -438,6 +526,7 @@ class ReplicaPool:
             except queue.Empty:
                 return
             if mb is not _STOP and hasattr(mb, "fail"):
+                self._note_batch_failures()
                 mb.fail(ReplicaLostError(why))
 
     def _drain_dead_pool(self):
@@ -457,8 +546,22 @@ class ReplicaPool:
     def dispatch(self, micro_batch):
         """The scheduler's dispatch target: blocking put, so a saturated
         pool backpressures the batcher (and through it the bounded
-        request queue) instead of queueing unboundedly."""
+        request queue) instead of queueing unboundedly. The post-put
+        sweep closes the hot-swap cutover's one standing race: the
+        batcher can load THIS pool's dispatch, be descheduled, and put
+        only after a committed swap's background drain fully closed
+        the pool — nothing would ever consume that batch, so its
+        riders would hang. If the pool is truly stopped, the batch is
+        failed typed right here (first-wins delivery makes a double
+        sweep harmless); the in-close window is covered by close()'s
+        OWN final sweep, which runs after ``_closed_done`` is set."""
         self.batch_queue.put(micro_batch)
+        if self._closed_done:
+            self._fail_queued(
+                "serving pool was already closed when this batch was "
+                "dispatched (hot-swap drain completed); the batch was "
+                "failed without dispatch — the request is safe to "
+                "retry")
 
     def executables(self, device=None):
         """{bucket: executable} for ``device`` (default: first replica's
@@ -482,6 +585,7 @@ class ReplicaPool:
             if not r.is_alive():
                 if not r._exited_clean and r.current is not None \
                         and hasattr(r.current, "fail"):
+                    self._note_batch_failures()
                     r.current.fail(ReplicaLostError(
                         f"serving replica {r.index} thread died "
                         f"during shutdown with this batch in flight; "
@@ -493,6 +597,7 @@ class ReplicaPool:
                     and now - t > self._stall_s:
                 r._abandoned = True
                 if hasattr(mb, "fail"):
+                    self._note_batch_failures()
                     mb.fail(ReplicaLostError(
                         f"serving replica {r.index} wedged "
                         f"mid-dispatch during shutdown; its in-flight "
@@ -544,17 +649,21 @@ class ReplicaPool:
             if deadline is not None and time.monotonic() >= deadline:
                 return False
             time.sleep(0.005)
-        # true stop: nothing will ever drain the queue again. Sweep
-        # any stranded batch (leftover sentinels included) so its
-        # riders get a typed error, never silence.
+        # true stop: nothing will ever drain the queue again. Set the
+        # flag BEFORE the final sweep so a dispatch racing this close
+        # either lands before the sweep (swept here) or sees the flag
+        # and sweeps itself — either way its riders get a typed error,
+        # never silence.
+        self._closed_done = True
         self._fail_queued(
             "serving pool closed with this batch undispatched (no "
             "live replica remained to run it)")
-        _m_replicas.set(0)
-        # gauge truth on the way out: a closed pool has nothing up,
-        # nothing awaiting respawn, nothing newly retired — a stale
-        # {quarantined}=1 on a dead server would read as a respawn
-        # that can never come
-        for s in (_UP, _QUARANTINED, _RETIRED):
-            _m_state.set(0, state=s)
+        if self.role == "live":
+            # gauge truth on the way out: a closed pool has nothing
+            # up, nothing awaiting respawn, nothing newly retired — a
+            # stale {quarantined}=1 on a dead server would read as a
+            # respawn that can never come. A DEMOTED pool draining out
+            # after a hot-swap cutover skips this: the promoted pool
+            # owns the gauges now.
+            zero_pool_gauges()
         return True

@@ -39,11 +39,11 @@ import threading
 import time
 
 from paddle_tpu.core.enforce import enforce
-from paddle_tpu.monitor.registry import counter, gauge
+from paddle_tpu.monitor.registry import REGISTRY, counter, gauge
 
 __all__ = [
     "DeadlineExceededError", "OverloadedError", "ReplicaLostError",
-    "ShedController",
+    "ShedController", "SwapFailedError", "SwapWatchdog",
 ]
 
 
@@ -72,6 +72,31 @@ class ReplicaLostError(RuntimeError):
     supervisor failed the in-flight riders rather than let them hang.
     The replica is quarantined and respawned (or permanently retired
     after repeated stalls); the request itself is safe to retry."""
+
+
+class SwapFailedError(RuntimeError):
+    """A hot model swap (``InferenceServer.swap``, docs/SERVING.md
+    "Hot model swap") was refused or rolled back. ``stage`` names
+    where: ``gate`` (integrity/compatibility refusal before any
+    resource was committed), ``standby`` (the new version's warm boot
+    failed or wedged past its timeout), ``canary`` (golden requests
+    through the standby executables failed shape/finiteness/parity),
+    ``cutover`` (the dispatch flip itself failed and was reverted), or
+    ``watchdog`` (the post-cutover error/latency window tripped and
+    traffic was reverted). In EVERY case the previously-live version
+    is still serving — a failed swap costs the standby resources, not
+    the old version's traffic.
+
+    ``retryable`` distinguishes refusals that say nothing about the
+    TARGET version (a concurrent swap held the lock, the server is
+    closing) from verdicts against the artifact itself: the watch-dir
+    failed-version memo only records the latter — blacklisting a
+    never-evaluated publish would silently strand a good deploy."""
+
+    def __init__(self, message, stage=None, retryable=False):
+        super().__init__(message)
+        self.stage = stage
+        self.retryable = retryable
 
 
 _m_shed = counter(
@@ -228,3 +253,93 @@ class ShedController:
             self._waits.clear()
             self._p50 = 0.0
         _m_brownout.set(0)
+
+
+class SwapWatchdog:
+    """Post-cutover rollback verdict for the hot model swap
+    (docs/SERVING.md "Hot model swap"): for a bounded window after the
+    dispatch flip, watch the process serving telemetry for evidence
+    the NEW version is hurting live traffic —
+
+    - **error storm**: the error count grew by ``max_errors`` or more
+      since the flip. ``errors_fn`` supplies the count — the swap
+      controller passes the NEW pool's ``batch_failures``, so errors
+      from the OLD pool's still-draining batches can never roll back
+      a healthy new version (attribution, not just a threshold);
+      without ``errors_fn`` the process-global
+      ``serving_requests_total{outcome="error"}`` counter is the
+      fallback.
+    - **latency regression** (opt-in, ``latency_x``): the window's
+      mean request latency exceeds ``latency_x`` times the
+      ``baseline_ms`` captured before the swap, judged only once
+      ``min_latency_samples`` requests have landed (a 2-request window
+      is noise, not a verdict). The latency histogram is
+      process-global — run one server per process when this verdict
+      must be attributable.
+
+    The swap controller polls :meth:`verdict` until :meth:`expired`;
+    a non-None verdict reason triggers the automatic rollback."""
+
+    def __init__(self, window_ms, max_errors=3, latency_x=None,
+                 baseline_ms=None, min_latency_samples=8,
+                 errors_fn=None):
+        enforce(window_ms >= 0,
+                f"watchdog window_ms must be >= 0, got {window_ms!r}")
+        enforce(int(max_errors) >= 1,
+                f"watchdog max_errors must be >= 1, got {max_errors!r}")
+        enforce(latency_x is None or float(latency_x) > 1.0,
+                f"watchdog latency_x must be > 1.0 (a ratio) or None, "
+                f"got {latency_x!r}")
+        self.window_s = float(window_ms) / 1e3
+        self.max_errors = int(max_errors)
+        self.latency_x = None if latency_x is None else float(latency_x)
+        self.baseline_ms = baseline_ms
+        self.min_latency_samples = int(min_latency_samples)
+        self._errors_fn = errors_fn
+        self._t0 = None
+        self._err0 = 0.0
+        self._lat0 = (0.0, 0)
+
+    def _errors(self):
+        if self._errors_fn is not None:
+            return float(self._errors_fn())
+        m = REGISTRY.get("serving_requests_total")
+        return m.value(outcome="error") if m is not None else 0.0
+
+    @staticmethod
+    def _latency():
+        m = REGISTRY.get("serving_request_latency_ms")
+        return (m.sum(), m.count()) if m is not None else (0.0, 0)
+
+    def start(self):
+        """Anchor the window at the cutover instant: only errors and
+        latency observed AFTER the flip count against the new
+        version."""
+        self._t0 = time.monotonic()
+        self._err0 = self._errors()
+        self._lat0 = self._latency()
+        return self
+
+    def expired(self):
+        return self._t0 is not None and \
+            time.monotonic() - self._t0 >= self.window_s
+
+    def verdict(self):
+        """A rollback reason string, or None while the window looks
+        healthy."""
+        errs = self._errors() - self._err0
+        if errs >= self.max_errors:
+            return (f"{errs:.0f} request error(s) within "
+                    f"{(time.monotonic() - self._t0) * 1e3:.0f}ms of "
+                    f"cutover (watchdog max_errors={self.max_errors})")
+        if self.latency_x is not None and self.baseline_ms:
+            s, c = self._latency()
+            ds, dc = s - self._lat0[0], c - self._lat0[1]
+            if dc >= self.min_latency_samples:
+                mean = ds / dc
+                if mean > self.latency_x * float(self.baseline_ms):
+                    return (f"post-cutover mean latency {mean:.1f}ms > "
+                            f"{self.latency_x:g}x pre-swap baseline "
+                            f"{float(self.baseline_ms):.1f}ms over "
+                            f"{dc} request(s)")
+        return None

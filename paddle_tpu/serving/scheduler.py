@@ -494,6 +494,18 @@ class MicroBatchScheduler:
     def ladder(self):
         return self._ladder
 
+    def set_dispatch(self, dispatch):
+        """Retarget batch dispatch — the hot-swap cutover primitive
+        (serving/swap.py). The batcher reads the target exactly ONCE
+        per formed batch (a single GIL-atomic attribute load in
+        ``_form_and_dispatch``), so the flip lands at a batch
+        boundary: every micro-batch executes WHOLLY on the target it
+        was dispatched to, never split across the old and new model
+        version. Requests admitted mid-swap simply form batches
+        against whichever target is current at their formation
+        instant."""
+        self._dispatch = dispatch
+
     def start(self):
         with self._lock:
             if self._closed:

@@ -10,6 +10,7 @@ file — never mid-traffic), warm-boots one compiled executable per
     outs = server.infer({"x": batch})          # blocking convenience
     pending = server.submit({"x": batch})      # pipelined
     outs = pending.result(timeout=5)
+    server.swap(new_model_dir)                 # zero-downtime deploy
     server.close()                             # drains, then stops
 
 Request contract: every feed carries a leading batch dim (1..max_batch
@@ -17,6 +18,14 @@ rows); outputs come back in fetch order, sliced to the request's own
 rows. Telemetry rides the process registry (docs/OBSERVABILITY.md,
 ``serving_*`` rows) and therefore the per-rank Prometheus exporter and
 ``bench.py`` snapshots for free.
+
+Deploying a new model version is a first-class, supervised operation:
+``swap(model_dir)`` runs the staged gate → standby warm-boot → canary →
+atomic cutover → watchdog pipeline (serving/swap.py, docs/SERVING.md
+"Hot model swap"), and ``watch_dir()`` keeps doing it automatically as
+training publishes new ``export_aot`` outputs. Model loading is split
+out of the server boot (``_load_bundle``/``_boot_pool``) exactly so the
+swap controller can build a SECOND pool alongside the live one.
 """
 
 import os
@@ -25,10 +34,11 @@ import numpy as np
 
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.serving.replica import ReplicaPool
-from paddle_tpu.serving.resilience import ShedController
+from paddle_tpu.serving.resilience import ShedController, _log
 from paddle_tpu.serving.scheduler import (
     MicroBatchScheduler, ServerClosedError, bucket_ladder,
 )
+from paddle_tpu.serving import swap as _swap
 
 __all__ = ["ServingConfig", "InferenceServer"]
 
@@ -48,6 +58,8 @@ class ServingConfig:
       override when the program declares dynamic non-batch dims.
     - ``verify_aot``: verify the model dir's AOT integrity manifest at
       boot (on by default; only skips work when no manifest exists).
+      ``swap()`` always re-gates regardless — a server that outlives
+      an artifact rewrite must never promote bits it didn't verify.
 
     Resilience knobs (docs/SERVING.md "Resilience"):
 
@@ -59,7 +71,8 @@ class ServingConfig:
     - ``replica_stall_ms`` / ``max_consecutive_stalls`` /
       ``respawn_backoff_ms`` / ``supervise``: the replica-pool
       supervisor (wedge detection, quarantine + warm respawn,
-      permanent retirement) — see ``ReplicaPool``.
+      permanent retirement) — see ``ReplicaPool``. A hot-swap standby
+      pool inherits the same knobs.
     - ``shed_mode``: ``"off"`` (default — admission is bit-for-bit the
       pre-resilience path) or ``"adaptive"`` (brownout shedding with
       ``OverloadedError``; requires ``default_deadline_ms``).
@@ -121,45 +134,123 @@ def _infer_sample_specs(program, feed_names, overrides):
     return out
 
 
+class _ModelBundle:
+    """Everything one model version needs to serve, loaded but not yet
+    compiled: the frozen program, its feed/fetch contract, the
+    jittable pure fn and host param arrays, and the manifest's
+    ``model_version``. The server boots from one; the swap controller
+    loads a SECOND one for the standby pool — the split that lets two
+    versions coexist in one server."""
+
+    __slots__ = ("model_dir", "program", "feed_names", "fetch_names",
+                 "sample_specs", "pure_fn", "params_np", "version",
+                 "scope")
+
+    def __init__(self, model_dir, program, feed_names, fetch_names,
+                 sample_specs, pure_fn, params_np, version, scope):
+        self.model_dir = model_dir
+        self.program = program
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self.sample_specs = sample_specs
+        self.pure_fn = pure_fn
+        self.params_np = params_np
+        self.version = version
+        self.scope = scope
+
+
+def _load_bundle(model_dir, feed_specs=None, verify=True):
+    """Load + (optionally) integrity-verify one model version into a
+    :class:`_ModelBundle`. Commits NO device resources — compilation
+    and ``device_put`` happen in ``_boot_pool``, so a gate refusal
+    costs a few file reads."""
+    from paddle_tpu import inference as inf
+    from paddle_tpu.core.place import CPUPlace
+    from paddle_tpu.static import io as static_io
+    from paddle_tpu.static.executor import Executor, Scope
+
+    scope = Scope()
+    exe = Executor(CPUPlace())
+    prog, feed_names, fetch_names = static_io.load_inference_model(
+        model_dir, exe, scope=scope)
+    if verify:
+        # integrity gate: a torn/bit-rotted AOT export names its first
+        # bad file here, not as a mid-traffic deserialization
+        # traceback (legacy dirs without a manifest verify vacuously);
+        # the verify result also carries the manifest model_version
+        version = inf.verify_aot_dir(model_dir).model_version
+    else:
+        version = inf.read_aot_version(model_dir)
+    feed_names = list(feed_names)
+    fetch_names = list(fetch_names)
+    sample_specs = _infer_sample_specs(prog, feed_names, feed_specs)
+    pure_fn, state_names = inf._build_pure_fn(prog, feed_names,
+                                              fetch_names)
+    raw = [scope.find_var(n) for n in state_names]
+    missing = [n for n, v in zip(state_names, raw) if v is None]
+    enforce(not missing,
+            f"scope missing persistables for serving: {missing[:5]}")
+    params_np = [np.asarray(v) for v in raw]
+    return _ModelBundle(model_dir, prog, feed_names, fetch_names,
+                        sample_specs, pure_fn, params_np, version,
+                        scope)
+
+
+def _check_fetch_contract(bundle, ladder):
+    """Micro-batched serving requires every fetch to be per-row
+    (leading dim = batch): a batch-reduced or rank-0 fetch would boot
+    fine and then error EVERY request at result-slicing time. One
+    cheap ``jax.eval_shape`` at the top bucket catches it at load (and
+    at the swap gate) — the fail-at-boot contract — with a message
+    naming the fetch."""
+    import jax
+    top = ladder[-1]
+    param_sds = tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                      for p in bundle.params_np)
+    feed_sds = tuple(
+        jax.ShapeDtypeStruct((top,) + tuple(shape), np.dtype(dt))
+        for shape, dt in (bundle.sample_specs[n]
+                          for n in bundle.feed_names))
+    outs = jax.eval_shape(bundle.pure_fn, param_sds, feed_sds)
+    for name, o in zip(bundle.fetch_names, outs):
+        enforce(
+            len(o.shape) >= 1 and int(o.shape[0]) == top,
+            f"fetch {name!r} has output shape {tuple(o.shape)} for "
+            f"a batch of {top}: not per-row, so micro-batched "
+            f"results cannot be sliced back to requests — move the "
+            f"reduction out of the served graph or use the "
+            f"single-request Predictor")
+
+
+def _boot_pool(bundle, config, role="live"):
+    """Warm-boot a replica pool for one model bundle: compile every
+    (device, bucket) executable and ``device_put`` the params. The
+    expensive half of a server boot — and of a hot-swap standby, which
+    passes ``role="standby"`` so the live pool keeps gauge ownership
+    while both are resident (the documented ~2x-param-memory
+    window)."""
+    return ReplicaPool(
+        bundle.pure_fn, bundle.params_np, bundle.feed_names,
+        bundle.sample_specs, ladder=bucket_ladder(config.max_batch),
+        n_replicas=config.replicas, devices=config.devices,
+        replica_stall_ms=config.replica_stall_ms,
+        max_consecutive_stalls=config.max_consecutive_stalls,
+        respawn_backoff_ms=config.respawn_backoff_ms,
+        supervise=config.supervise, role=role)
+
+
 class InferenceServer:
     """Continuous micro-batching server over a frozen inference model.
 
     Construction performs the full warm boot (load + verify + compile
     every bucket executable on every replica device + start workers);
-    when ``__init__`` returns the server is serving.
+    when ``__init__`` returns the server is serving. ``swap()`` /
+    ``watch_dir()`` replace the served model version with zero
+    downtime (docs/SERVING.md "Hot model swap").
     """
 
     def __init__(self, model_dir, config=None):
-        from paddle_tpu import inference as inf
-        from paddle_tpu.core.place import CPUPlace
-        from paddle_tpu.static import io as static_io
-        from paddle_tpu.static.executor import Executor, Scope
-
         self.config = config = config or ServingConfig()
-        self.model_dir = model_dir
-        self._scope = Scope()
-        exe = Executor(CPUPlace())
-        prog, feed_names, fetch_names = static_io.load_inference_model(
-            model_dir, exe, scope=self._scope)
-        if config.verify_aot:
-            # boot-time integrity gate: a torn/bit-rotted AOT export
-            # names its first bad file here, not as a mid-traffic
-            # deserialization traceback (legacy dirs without a
-            # manifest verify vacuously)
-            inf.verify_aot_dir(model_dir)
-        self._program = prog
-        self._feed_names = list(feed_names)
-        self._fetch_names = list(fetch_names)
-        self._sample_specs = _infer_sample_specs(
-            prog, self._feed_names, config.feed_specs)
-        pure_fn, state_names = inf._build_pure_fn(
-            prog, self._feed_names, self._fetch_names)
-        raw = [self._scope.find_var(n) for n in state_names]
-        missing = [n for n, v in zip(state_names, raw) if v is None]
-        enforce(not missing,
-                f"scope missing persistables for serving: {missing[:5]}")
-        params_np = [np.asarray(v) for v in raw]
-        ladder = bucket_ladder(config.max_batch)
         # shed_mode gates the whole adaptive controller: "off" (the
         # default) constructs NOTHING — admission stays bit-for-bit
         # the pre-resilience path
@@ -177,14 +268,18 @@ class InferenceServer:
                 deadline_ms=config.default_deadline_ms,
                 enter_frac=config.shed_enter_frac,
                 exit_frac=config.shed_exit_frac)
+        bundle = _load_bundle(model_dir, config.feed_specs,
+                              verify=config.verify_aot)
+        self._apply_bundle(bundle)
         # the scheduler validates every config knob (max_batch ladder,
         # max_wait_ms, max_queue, default_deadline_ms) — construct it
         # BEFORE the expensive warm boot so a bad knob fails in
         # microseconds instead of after compiling (and leaking) every
-        # bucket executable; the dispatch is late-bound to the pool
-        # built below
+        # bucket executable; dispatch targets the live pool through
+        # ONE attribute read (_dispatch_batch), which is also the
+        # hot-swap cutover point (scheduler.set_dispatch)
         self.scheduler = MicroBatchScheduler(
-            dispatch=lambda mb: self.pool.dispatch(mb),
+            dispatch=self._dispatch_batch,
             feed_names=self._feed_names,
             max_batch=config.max_batch,
             max_wait_ms=config.max_wait_ms,
@@ -192,41 +287,38 @@ class InferenceServer:
             sample_specs=self._sample_specs,
             default_deadline_ms=config.default_deadline_ms,
             shed=shed)
-        self._check_fetch_contract(pure_fn, params_np, ladder)
-        self.pool = ReplicaPool(
-            pure_fn, params_np, self._feed_names, self._sample_specs,
-            ladder=ladder,
-            n_replicas=config.replicas, devices=config.devices,
-            replica_stall_ms=config.replica_stall_ms,
-            max_consecutive_stalls=config.max_consecutive_stalls,
-            respawn_backoff_ms=config.respawn_backoff_ms,
-            supervise=config.supervise)
+        _check_fetch_contract(bundle, bucket_ladder(config.max_batch))
+        self.pool = _boot_pool(bundle, config, role="live")
+        self._swap_controller = None
+        self._closing = False
+        # the operator must always be able to answer "which version is
+        # this server serving" from plain logs — at boot and after
+        # every cutover (swap.py logs the latter)
+        _swap.publish_model_version(self.model_version)
+        _log(f"serving model version "
+             f"{self.model_version or 'unversioned'} from "
+             f"{model_dir} (boot)")
         self.scheduler.start()
 
-    def _check_fetch_contract(self, pure_fn, params_np, ladder):
-        """Micro-batched serving requires every fetch to be per-row
-        (leading dim = batch): a batch-reduced or rank-0 fetch would
-        boot fine and then error EVERY request at result-slicing time.
-        One cheap ``jax.eval_shape`` at the top bucket catches it at
-        load — the fail-at-boot contract — with a message naming the
-        fetch."""
-        import jax
-        top = ladder[-1]
-        param_sds = tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
-                          for p in params_np)
-        feed_sds = tuple(
-            jax.ShapeDtypeStruct((top,) + tuple(shape), np.dtype(dt))
-            for shape, dt in (self._sample_specs[n]
-                              for n in self._feed_names))
-        outs = jax.eval_shape(pure_fn, param_sds, feed_sds)
-        for name, o in zip(self._fetch_names, outs):
-            enforce(
-                len(o.shape) >= 1 and int(o.shape[0]) == top,
-                f"fetch {name!r} has output shape {tuple(o.shape)} for "
-                f"a batch of {top}: not per-row, so micro-batched "
-                f"results cannot be sliced back to requests — move the "
-                f"reduction out of the served graph or use the "
-                f"single-request Predictor")
+    def _apply_bundle(self, bundle):
+        """Point the server's introspection surface at one model
+        bundle — called at boot and at every hot-swap cutover/rollback
+        (the gate guarantees feed/fetch/spec compatibility, so
+        in-flight requests validated under the previous bundle stay
+        valid)."""
+        self._bundle = bundle
+        self.model_dir = bundle.model_dir
+        self._program = bundle.program
+        self._feed_names = bundle.feed_names
+        self._fetch_names = bundle.fetch_names
+        self._sample_specs = bundle.sample_specs
+
+    def _dispatch_batch(self, mb):
+        # ONE attribute read of self.pool per formed batch: the
+        # hot-swap cutover rebinds the scheduler's dispatch directly
+        # (set_dispatch), so this late-bound path only carries boot
+        # traffic — but it must keep the same batch-atomicity contract
+        self.pool.dispatch(mb)
 
     # -- introspection -----------------------------------------------------
     def get_input_names(self):
@@ -238,6 +330,13 @@ class InferenceServer:
     @property
     def ladder(self):
         return self.pool.ladder
+
+    @property
+    def model_version(self):
+        """The manifest ``model_version`` this server is serving
+        (None for unversioned exports) — updated atomically at every
+        hot-swap cutover and rollback."""
+        return self._bundle.version
 
     # -- serving -----------------------------------------------------------
     def submit(self, feeds, deadline_ms=None):
@@ -258,6 +357,47 @@ class InferenceServer:
         """Blocking convenience: submit + result."""
         return self.submit(feeds, deadline_ms=deadline_ms).result(timeout)
 
+    # -- hot model swap ----------------------------------------------------
+    def _swap_ctl(self):
+        if self._swap_controller is None:
+            self._swap_controller = _swap.SwapController(self)
+            if self._closing:
+                # a controller created lazily AFTER close() must
+                # inherit the closed state — otherwise swap() on a
+                # closed server would warm-boot and promote a pool
+                # nothing will ever close
+                self._swap_controller._closed = True
+        return self._swap_controller
+
+    def swap(self, model_dir, **kwargs):
+        """Zero-downtime hot model swap: gate (integrity +
+        compatibility) → standby warm-boot (new pool alongside the
+        live one; ~2x param memory for the window) → canary (golden
+        requests through the standby executables) → atomic cutover at
+        a batch boundary → post-cutover watchdog, with automatic
+        rollback to the still-resident old version on any failure
+        (typed :class:`~.resilience.SwapFailedError` naming the
+        stage). Returns the swap report dict. Keyword knobs:
+        ``canary_feeds``, ``canary_check``, ``parity_rtol``/
+        ``parity_atol``, ``standby_timeout_ms``, ``watchdog_ms``,
+        ``watchdog_max_errors``, ``watchdog_latency_x`` — see
+        :class:`~.swap.SwapController` and docs/SERVING.md
+        "Hot model swap"."""
+        return self._swap_ctl().swap(model_dir, **kwargs)
+
+    def watch_dir(self, model_dir=None, poll_ms=1000.0, **swap_kwargs):
+        """Continuous-deploy mode: poll ``model_dir`` (default: the
+        dir this server booted from) for a NEW manifest
+        ``model_version`` — the cheap index-only probe — and ``swap``
+        to it as training publishes fresh ``export_aot`` outputs. A
+        failed version is remembered and not retried until the
+        publisher writes a different one (no crash-loop on a bad
+        artifact; the live version keeps serving). Returns the
+        :class:`~.swap.SwapController`; ``stop_watch()`` or
+        ``close()`` ends it."""
+        return self._swap_ctl().watch_dir(model_dir, poll_ms=poll_ms,
+                                          **swap_kwargs)
+
     def close(self, timeout=None):
         """Graceful shutdown: stop admission, drain every accepted
         request through the replicas, stop the workers. Returns True
@@ -267,6 +407,14 @@ class InferenceServer:
         stopping the replicas early would let their shutdown sentinels
         overtake still-forming batches in the FIFO and strand those
         requests forever. Call close() again to finish. Idempotent."""
+        # swap machinery brackets the close: the FAST half first (no
+        # new swap can start, an in-flight one will abort before
+        # cutover, the watcher stops) so admission shutdown below is
+        # never raced by a version flip... — and the flag survives for
+        # a controller lazily created after this close (_swap_ctl)
+        self._closing = True
+        if self._swap_controller is not None:
+            self._swap_controller.begin_shutdown()
         # order matters: the scheduler drains its request queue into
         # the batch queue first, THEN the pool's per-replica sentinels
         # land behind every formed batch
@@ -274,10 +422,27 @@ class InferenceServer:
             return False
         if not self.pool.close(timeout):
             return False
+        # ...and the SLOW half last: wait out the aborting swap and
+        # the background pool drains — a False here means swap
+        # machinery is still running, and claiming "fully stopped"
+        # over it would let a caller tear down scopes under live
+        # replica threads (admission is already stopped either way)
+        if self._swap_controller is not None and \
+                not self._swap_controller.finish_shutdown(timeout):
+            return False
         if self.scheduler._shed is not None:
             # gauge truth on the way out: a closed server is not in
             # brownout, whatever the last minutes looked like
             self.scheduler._shed.shutdown()
+        # gauge truth is the SERVER's on a true close: a rollback
+        # racing this close can leave the pool we just closed demoted
+        # (its role-gated zeroing skipped), so re-assert zeros here
+        # rather than trust whichever pool object we happened to hold
+        from paddle_tpu.serving.replica import zero_pool_gauges
+        zero_pool_gauges()
+        # a closed server serves nothing: a lingering version series
+        # in exports would read as a live deployment
+        _swap.clear_model_version(self.model_version)
         return True
 
     def __enter__(self):

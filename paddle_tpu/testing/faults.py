@@ -65,6 +65,26 @@ boundary for free:
   PER REPLICA (batch N is deterministic per worker), and share the
   once-marker semantics below. ``PT_FAULT_STALL_SECS`` bounds the
   stall (default 3600 — effectively until abandoned or process exit).
+- ``PT_FAULT_SWAP_BITFLIP=1``   — ``install_swap_faults()`` patches
+  the hot-swap ``SwapController._gate``: flip one byte in the middle
+  of the target model dir's first AOT artifact BEFORE the gate's
+  integrity pass runs — the gate must refuse (``SwapFailedError``
+  stage ``gate``, outcome ``gate_failed``) and the LIVE version must
+  keep serving. Requires an ``export_aot``'d target dir (no artifacts
+  = nothing for the gate to catch).
+- ``PT_FAULT_SWAP_STANDBY_STALL=1`` — same install; the standby
+  warm-boot wedges (sleeps up to ``PT_FAULT_STALL_SECS``, then raises
+  so the abandoned thread unwinds) — the swap must quarantine
+  (``SwapFailedError`` stage ``standby``, outcome ``rolled_back``)
+  while live traffic never notices.
+- ``PT_FAULT_SWAP_ERROR_STORM=N`` — same install; AFTER a real
+  cutover commits, the NEW pool's next N batch dispatches raise — the
+  post-cutover watchdog must trip and roll traffic back to the old,
+  still-resident version (stage ``watchdog``, outcome
+  ``rolled_back``). The storm never touches the old pool, so
+  post-rollback serving is provably unaffected.
+  All three swap faults fire once per process (plus the once-dir
+  marker across incarnations) and are scoped by ``PT_FAULT_RANK``.
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -86,7 +106,7 @@ import sys
 import time
 
 __all__ = ["maybe_fault", "poison_feed", "install_slow_write",
-           "install_serving_faults",
+           "install_serving_faults", "install_swap_faults",
            "corrupt_checkpoint", "corrupt_newest_checkpoint",
            "CRASH_EXIT_CODE", "CKPT_FAULT_EXIT_CODE",
            "SHRINK_EXIT_CODE"]
@@ -540,6 +560,134 @@ def install_serving_faults():
 
     def uninstall():
         Replica.run_batch = orig
+
+    return uninstall
+
+
+_SWAP_FAULT_ENVS = ("PT_FAULT_SWAP_BITFLIP",
+                    "PT_FAULT_SWAP_STANDBY_STALL",
+                    "PT_FAULT_SWAP_ERROR_STORM")
+
+
+def _bitflip_file(path):
+    """Flip one byte in the middle of an opaque artifact file — the
+    AOT analog of the checkpoint bitflip (no zip layout to aim at:
+    CRC32 over the whole byte image catches any flip)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([(b[0] if b else 0) ^ 0xFF]))
+
+
+def _bitflip_first_aot_artifact(model_dir):
+    """Corrupt the first artifact the AOT index's integrity manifest
+    vouches for; returns its path or None when the dir has no
+    manifest (nothing a gate could catch — the fault stays armed)."""
+    import json
+    from paddle_tpu.inference import AOT_DIR, AOT_INDEX
+    index_path = os.path.join(model_dir, AOT_DIR, AOT_INDEX)
+    try:
+        with open(index_path) as f:
+            entries = json.load(f)
+    except (OSError, ValueError):
+        return None
+    for e in entries if isinstance(entries, list) else []:
+        if not isinstance(e, dict):
+            continue
+        for name in sorted(e.get("integrity", {})):
+            path = os.path.join(model_dir, AOT_DIR, name)
+            try:
+                _bitflip_file(path)
+            except OSError:
+                continue
+            return path
+    return None
+
+
+def install_swap_faults():
+    """If any hot-swap chaos env (PT_FAULT_SWAP_BITFLIP /
+    PT_FAULT_SWAP_STANDBY_STALL / PT_FAULT_SWAP_ERROR_STORM) is set,
+    patch the serving ``SwapController`` stage methods to consult the
+    fault gates. Production never imports this module — a chaos test
+    or worker opts in explicitly, mirroring
+    ``install_serving_faults``. Returns an uninstall callable when
+    installed, False otherwise. Each fault proves the same invariant
+    from a different stage: THE LIVE VERSION KEEPS SERVING."""
+    if not any(os.environ.get(k) for k in _SWAP_FAULT_ENVS):
+        return False
+    from paddle_tpu.serving.swap import SwapController
+    orig_gate = SwapController._gate
+    orig_build = SwapController._build_standby_pool
+    orig_cutover = SwapController._cutover
+
+    def chaos_gate(self, model_dir):
+        if os.environ.get("PT_FAULT_SWAP_BITFLIP") and \
+                _applies_to_rank() \
+                and "swap_bitflip" not in _serving_fired \
+                and not _already_fired("swap_bitflip"):
+            # peek BEFORE flipping (a later swap to a fresh export
+            # must run clean), claim only on an actual hit (the
+            # poison_feed rule: a manifest-less dir must not silently
+            # consume the fault)
+            hit = _bitflip_first_aot_artifact(model_dir)
+            if hit is not None and _serving_fire_once("swap_bitflip"):
+                sys.stderr.write(f"[faults] bitflipped swap artifact "
+                                 f"{hit} before the gate\n")
+                sys.stderr.flush()
+        return orig_gate(self, model_dir)
+
+    def chaos_build(self, bundle):
+        if os.environ.get("PT_FAULT_SWAP_STANDBY_STALL") and \
+                _applies_to_rank() and \
+                _serving_fire_once("swap_standby_stall"):
+            limit = float(os.environ.get("PT_FAULT_STALL_SECS")
+                          or 3600.0)
+            sys.stderr.write(f"[faults] injected standby stall: swap "
+                             f"warm boot wedges (<= {limit:g}s)\n")
+            sys.stderr.flush()
+            time.sleep(limit)
+            raise RuntimeError(
+                "[faults] injected standby stall released")
+        return orig_build(self, bundle)
+
+    def chaos_cutover(self, standby, bundle):
+        out = orig_cutover(self, standby, bundle)
+        n = _int_env("PT_FAULT_SWAP_ERROR_STORM")
+        if n and _applies_to_rank() and \
+                _serving_fire_once("swap_error_storm"):
+            sys.stderr.write(f"[faults] injected post-cutover error "
+                             f"storm: the new pool's next {n} batch "
+                             f"dispatch(es) raise\n")
+            sys.stderr.flush()
+            left = [n]          # shared across the pool's replicas
+
+            def storm(orig_run):
+                def run_batch(bucket, feeds):
+                    if left[0] > 0:
+                        left[0] -= 1
+                        raise RuntimeError(
+                            "[faults] injected post-cutover dispatch "
+                            "error (swap error storm)")
+                    return orig_run(bucket, feeds)
+                return run_batch
+
+            # instance-level wrap: ONLY the freshly promoted pool's
+            # replicas storm — the old pool must stay provably healthy
+            # for the post-rollback traffic
+            for r in standby.replicas:
+                r.run_batch = storm(r.run_batch)
+        return out
+
+    SwapController._gate = chaos_gate
+    SwapController._build_standby_pool = chaos_build
+    SwapController._cutover = chaos_cutover
+
+    def uninstall():
+        SwapController._gate = orig_gate
+        SwapController._build_standby_pool = orig_build
+        SwapController._cutover = orig_cutover
 
     return uninstall
 

@@ -1,0 +1,202 @@
+"""Hot-swap e2e worker (tests/test_swap.py TestSwapEndToEnd).
+
+Boots an InferenceServer on a versioned v1 export (``out = 2 * x`` —
+the answer IS the version), arms the per-rank Prometheus exporter, and
+drives continuous open-loop Poisson load with per-request accounting
+(every submitted request must resolve as an answer or a TYPED error —
+a hang is a test failure). Mid-load it walks the whole deploy story:
+
+1. export v2 (``3 * x``) and ``swap()`` — must commit with the load
+   flowing; the swap window is recorded so the test can compare the
+   p99 of overlapping requests against steady state;
+2. export v3, bitflip an artifact, ``swap()`` — must refuse at the
+   GATE (outcome ``gate_failed``), v2 still serving;
+3. export v4 and swap under ``PT_FAULT_SWAP_ERROR_STORM`` — the
+   cutover commits, the storm trips the watchdog, traffic rolls back
+   to v2 (outcome ``rolled_back``), v2 still serving.
+
+Every request's answer is checked for version purity (wholly 2x or
+wholly 3x after the good swap — never mixed rows); the final registry
+snapshot lands in ``rank0.prom`` so the test reads the
+``serving_swaps_total{outcome}`` evidence exactly as an operator would.
+
+Usage: swap_worker.py <work_dir> <out_json>
+Env knobs: SWAP_E2E_REQS (default 400), SWAP_E2E_SECS (default 8).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _freeze(dirname, scale):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+
+    pt.enable_static()
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup), unique_name.guard():
+        x = pt.static.data("x", [16], dtype="float32")
+        out = layers.scale(x, scale=float(scale))
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.io.save_inference_model(
+            dirname, ["x"], [out], exe, main_program=main_p,
+            aot_shapes=[{"x": ((2, 16), "float32")}])
+    return dirname
+
+
+def main():
+    work_dir, out_json = sys.argv[1], sys.argv[2]
+    n_reqs = int(os.environ.get("SWAP_E2E_REQS", "400"))
+    load_secs = float(os.environ.get("SWAP_E2E_SECS", "8"))
+
+    from paddle_tpu.inference import read_aot_version
+    from paddle_tpu.monitor import exporter
+    from paddle_tpu.serving import (InferenceServer, ServingConfig,
+                                    SwapFailedError)
+    from paddle_tpu.testing import faults
+
+    v1 = _freeze(os.path.join(work_dir, "v1"), 2.0)
+    rank_exp = exporter.RankExporter.from_env(interval=0.5)
+    if rank_exp is not None:
+        rank_exp.start()
+
+    srv = InferenceServer(v1, ServingConfig(
+        replicas=1, max_batch=4, max_wait_ms=1.0,
+        max_queue=n_reqs + 64))
+    feed = {"x": np.ones((2, 16), np.float32)}  # 2 rows: purity check
+    for _ in range(4):
+        srv.infer(feed, timeout=30)
+
+    # -- open-loop load on its own thread, per-request accounting ------
+    offered = n_reqs / load_secs
+    sched = np.cumsum(np.random.RandomState(42).exponential(
+        1.0 / offered, size=n_reqs))
+    pend = [None] * n_reqs
+    arrived = [0.0] * n_reqs
+    load_done = threading.Event()
+
+    def load():
+        t0 = time.perf_counter()
+        for i in range(n_reqs):
+            dly = t0 + sched[i] - time.perf_counter()
+            if dly > 0:
+                time.sleep(dly)
+            arrived[i] = t0 + sched[i]
+            pend[i] = srv.submit(feed)
+        load_done.set()
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+
+    # -- 1: the good swap, mid-load ------------------------------------
+    time.sleep(load_secs * 0.25)
+    v2 = _freeze(os.path.join(work_dir, "v2"), 3.0)
+    v2_version = read_aot_version(v2)
+    t_swap0 = time.perf_counter()
+    report = srv.swap(v2, watchdog_ms=250)
+    t_swap1 = time.perf_counter()
+    swap_ok = 1 if report["outcome"] == "ok" else 0
+
+    # -- 2: corrupt v3 must refuse at the gate -------------------------
+    time.sleep(load_secs * 0.15)
+    v3 = _freeze(os.path.join(work_dir, "v3"), 4.0)
+    faults._bitflip_first_aot_artifact(v3)
+    gate_failed_stage = None
+    try:
+        srv.swap(v3)
+    except SwapFailedError as e:
+        gate_failed_stage = e.stage
+
+    # -- 3: error-storm v4 must roll back to v2 ------------------------
+    time.sleep(load_secs * 0.15)
+    v4 = _freeze(os.path.join(work_dir, "v4"), 5.0)
+    os.environ["PT_FAULT_SWAP_ERROR_STORM"] = "6"
+    uninstall = faults.install_swap_faults()
+    rolled_back_stage = None
+    try:
+        srv.swap(v4, watchdog_ms=3000, watchdog_max_errors=2)
+    except SwapFailedError as e:
+        rolled_back_stage = e.stage
+    if uninstall:
+        uninstall()
+
+    # -- drain the load, account every request -------------------------
+    load_done.wait(120)
+    ok = errors = hangs = storm_errors = mixed = 0
+    ok_lat_arr = []
+    for i, p in enumerate(pend):
+        if p is None:
+            hangs += 1          # never admitted == lost by the bench
+            continue
+        try:
+            out = p.result(timeout=60)[0]
+            vals = set(np.unique(out).tolist())
+            # legitimate answers: v1 (pre-swap), v2 (post-swap and
+            # post-rollback), v4 (batches dispatched in the brief
+            # cutover->rollback window complete on the version they
+            # were dispatched to — the batch-atomicity contract).
+            # NEVER v3 (corrupt, refused at the gate), never a mix of
+            # versions within one request.
+            if vals not in ({2.0}, {3.0}, {5.0}):
+                mixed += 1      # split/forbidden-version answer
+            ok += 1
+            ok_lat_arr.append((i, (p.t_done - arrived[i]) * 1e3))
+        except TimeoutError:
+            hangs += 1
+        except RuntimeError as e:
+            errors += 1
+            if "error storm" in str(e):
+                storm_errors += 1
+
+    overlap = [lat for i, lat in ok_lat_arr
+               if arrived[i] <= t_swap1
+               and pend[i].t_done >= t_swap0]
+    steady = [lat for i, lat in ok_lat_arr
+              if arrived[i] > t_swap1 or pend[i].t_done < t_swap0]
+
+    # -- final truth: v2 serving, version surface agrees ---------------
+    final_out = srv.infer(feed, timeout=30)[0]
+    final_scale = float(final_out.ravel()[0])
+    result = {
+        "total": n_reqs,
+        "ok": ok,
+        "errors": errors,
+        "hangs": hangs,
+        "mixed_version_answers": mixed,
+        "storm_errors": storm_errors,
+        "swap_ok": swap_ok,
+        "swap_window_ms": round((t_swap1 - t_swap0) * 1e3, 1),
+        "gate_failed_stage": gate_failed_stage,
+        "rolled_back_stage": rolled_back_stage,
+        "p99_overlap_ms": (round(float(np.percentile(overlap, 99)), 2)
+                           if overlap else None),
+        "p99_steady_ms": (round(float(np.percentile(steady, 99)), 2)
+                          if steady else None),
+        "n_overlap": len(overlap),
+        "final_scale": final_scale,
+        "final_version": srv.model_version,
+        "v2_version": v2_version,
+        "offered_qps": round(offered, 1),
+    }
+    if mixed:
+        result["hangs"] = hangs + mixed     # fail loudly via the test
+    srv.close(timeout=60)
+    if rank_exp is not None:
+        rank_exp.stop()
+    with open(out_json, "w") as f:
+        json.dump(result, f)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

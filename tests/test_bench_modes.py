@@ -128,6 +128,29 @@ class TestBenchModes:
         assert ov["value"] < 1.05, ov
         assert len(ov["pair_ratios"]) >= 2
 
+    def test_serving_swap_mode_emits_swap_rows(self):
+        """`bench.py serving` with BENCH_SERVING_SWAP=1 must run one
+        open-loop schedule with a mid-run hot swap (tiny request
+        count: CLI/shape smoke) and emit the swap-window p99 ratio
+        and cutover-blip rows: swap committed (outcome ok), zero
+        hangs, both request groups populated."""
+        lines = _run_mode("serving",
+                          extra_env={"BENCH_SERVING_SWAP": "1",
+                                     "BENCH_SERVING_SWAP_REQS": "60"})
+        by = {ln["metric"]: ln for ln in lines}
+        ratio = by["serving_swap_p99_ratio"]
+        assert ratio["unit"] == "x"
+        assert ratio["outcome"] == "ok", ratio
+        assert ratio["hangs"] == 0, ratio
+        assert ratio["n_overlap"] >= 1 and ratio["n_steady"] >= 1
+        assert ratio["value"] is not None and ratio["value"] > 0
+        assert ratio["p99_overlap_ms"] > 0
+        assert ratio["p99_steady_ms"] > 0
+        assert ratio["swap_ms"] > 0
+        blip = by["serving_swap_blip_ms"]
+        assert blip["unit"] == "ms" and blip["value"] >= 0
+        assert blip["swap_window_ms"] > 0
+
     def test_dispatch_mode_emits_trace_overhead_and_attribution(self):
         """`bench.py dispatch` must A/B per-step tracing on ABBA
         micro-windows (ratio < 1.05x — tail sampling's hot-path
