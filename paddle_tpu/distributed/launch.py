@@ -42,6 +42,17 @@ restart anywhere"): the launcher is a supervisor, not just a spawner.
   last-good checkpoint onto the new mesh and the data cursor rescales
   (see io_checkpoint / docs/ELASTIC_TRAINING.md). Defaults keep
   today's fixed-gang semantics.
+- `--ps_snapshot_secs S` (ps mode): pserver failover. Pservers
+  snapshot their hosted state to `<log_dir>/ps_state` every S seconds
+  (integrity-manifested, atomically published — see distributed/ps.py
+  and docs/ELASTIC_TRAINING.md "Pserver failover"); a pserver that
+  dies is respawned at its original endpoint under the --max_restarts
+  budget and warm-boots from the last-good snapshot while the
+  trainers' clients reconnect; with --hang_timeout the supervisor
+  also probes each pserver's request loop (a LIST_VARS ping) so a
+  wedged-but-alive server is detected and restarted, not just a dead
+  one. Without the flag a pserver death tears the job down (today's
+  semantics).
 
 Each child additionally sees PADDLE_RESTART_COUNT (0 on the first
 incarnation) and PADDLE_HEARTBEAT_DIR.
@@ -91,6 +102,9 @@ EXIT_CODE_LABELS = {
     29: "checkpoint-corruption fault (testing.faults)",
     31: "rank departed (elastic shrink; supervisor resumes at the "
         "reduced world size)",
+    37: "injected pserver crash (testing.faults; supervisor respawns "
+        "it at the same endpoint, warm-booting from the last-good "
+        "snapshot)",
     124: "timeout",
     137: "SIGKILLed (OOM killer or kill -9)",
     139: "segfault",
@@ -126,6 +140,12 @@ _m_world = _gauge(
     "World size of the current gang incarnation (= --nproc_per_node "
     "until --min_ranks/--max_ranks elasticity moves it: shrinks on "
     "rank departure, grows on admitted join requests)")
+_m_ps_restarts = _counter(
+    "ps_restarts_total",
+    "Pserver processes the launcher respawned at their original "
+    "endpoint after a death or a failed liveness probe (ps mode with "
+    "--ps_snapshot_secs; the respawn warm-boots from the last-good "
+    "snapshot)")
 
 
 def _postmortem_env(log_dir):
@@ -656,9 +676,75 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
             shutil.rmtree(hb_dir, ignore_errors=True)
 
 
+def ps_probe(ep, timeout=2.0):
+    """One supervisor-side pserver liveness probe: a LIST_VARS request
+    over a fresh connection; True iff the server produced a well-formed
+    reply within ``timeout`` (an ERR reply counts — the server
+    ANSWERED). A wedged-but-alive pserver (accepting connections,
+    never replying) times out here, which is exactly what
+    ``hang_timeout`` cannot see from process liveness alone. The wire
+    codec imports lazily (it needs numpy): the collective launcher
+    keeps its stdlib-only contract, and a probe that cannot even
+    import the codec returns None (probing disabled) rather than
+    killing servers it cannot judge."""
+    try:
+        from paddle_tpu.distributed import wire
+    except Exception:
+        return None
+    host, port = ep.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as s:
+            s.settimeout(timeout)
+            wire.send_frame(s, wire.LIST_VARS, ())
+            wire.recv_frame(s)
+        return True
+    except Exception:
+        return False
+
+
+class _PsWatch:
+    """Per-pserver liveness bookkeeping for the supervision loop,
+    mirroring the trainer watchdog's asymmetry: only a server that
+    ANSWERED a probe at least once and then stopped answering for
+    longer than the hang timeout is *wedged* (kill + respawn); a
+    server that never answered is merely *slow* (long startup — jax
+    import alone takes seconds) and is logged, never killed."""
+
+    def __init__(self, n):
+        self._last_ok = [None] * n      # monotonic time of last reply
+        self._warned_slow = set()
+
+    def observe(self, i, ok, now=None):
+        now = time.monotonic() if now is None else now
+        if ok:
+            self._last_ok[i] = now
+
+    def forget(self, i):
+        """A respawned server starts a fresh history (its boot must
+        not be judged against the dead incarnation's last answer)."""
+        self._last_ok[i] = None
+        self._warned_slow.discard(i)
+
+    def wedged(self, hang_timeout, now=None):
+        """[(index, seconds-since-last-answer)] past the timeout."""
+        now = time.monotonic() if now is None else now
+        return [(i, now - t) for i, t in enumerate(self._last_ok)
+                if t is not None and now - t > hang_timeout]
+
+    def slow(self, i):
+        """True ONCE per server that never answered (for the one-shot
+        slow log line)."""
+        if self._last_ok[i] is None and i not in self._warned_slow:
+            self._warned_slow.add(i)
+            return True
+        return False
+
+
 def launch_ps(script_args, server_num, worker_num, started_port=None,
               log_dir=None, env_extra=None, timeout=None, max_restarts=0,
-              hang_timeout=None, grace_period=10.0):
+              hang_timeout=None, grace_period=10.0,
+              ps_snapshot_secs=None):
     host = "127.0.0.1"
     if started_port is None:
         ports = find_free_ports(server_num, host)
@@ -679,8 +765,33 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     cache_env = _cache_dir_env(log_dir, env_extra)
     pm_env = _postmortem_env(log_dir)
     tr_env = _trace_env(log_dir)
+    # pserver failover (docs/ELASTIC_TRAINING.md "Pserver failover") is
+    # OPT-IN via --ps_snapshot_secs: the snapshot dir under log_dir is
+    # what makes a pserver death recoverable — without snapshots a
+    # respawned server would serve freshly initialized parameters,
+    # silently wrong training, so respawning stays off
+    ps_state_dir = None
+    if ps_snapshot_secs is not None:
+        if ps_snapshot_secs <= 0:
+            raise ValueError(
+                f"--ps_snapshot_secs must be > 0, got {ps_snapshot_secs}")
+        if log_dir:
+            ps_state_dir = os.path.join(os.path.abspath(log_dir),
+                                        "ps_state")
+            os.makedirs(ps_state_dir, exist_ok=True)
+            _log(f"pserver failover armed: snapshots every "
+                 f"{ps_snapshot_secs:g}s to {ps_state_dir}; a dead "
+                 f"pserver respawns at its endpoint and warm-boots "
+                 f"from the last-good snapshot"
+                 + ("" if max_restarts else
+                    " (set --max_restarts to actually respawn)"))
+        else:
+            _log("--ps_snapshot_secs has no effect without --log_dir "
+                 "(snapshots need somewhere durable); pserver "
+                 "failover disabled")
+    ps_elastic = ps_state_dir is not None and max_restarts > 0
 
-    def spawn_server(i):
+    def spawn_server(i, attempt=0):
         env = dict(os.environ, **(env_extra or {}), **cache_env)
         env.update({
             "TRAINING_ROLE": "PSERVER",
@@ -688,9 +799,23 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
             "PADDLE_TRAINERS_NUM": str(worker_num),
             "PADDLE_PSERVER_ENDPOINTS": server_eps,
             "PADDLE_CURRENT_ENDPOINT": f"{host}:{ports[i]}",
+            # run_pserver's exporter hookup: pserver-side metrics land
+            # at rank<worker_num + i>.prom (offset past the trainers).
+            # A DEDICATED env var, NOT PADDLE_HEARTBEAT_DIR: pservers
+            # share the trainer id numbering, and handing them the
+            # heartbeat env would make a role-shared script's
+            # Heartbeat.from_env()/RankExporter.from_env() (the
+            # documented worker hookup) clobber trainer i's files —
+            # the pserver's beat could even mask a hung trainer i from
+            # the watchdog
+            "PT_PS_METRICS_DIR": hb_dir,
+            "PADDLE_RESTART_COUNT": str(attempt),
         })
+        if ps_state_dir:
+            env["PT_PS_SNAPSHOT_DIR"] = ps_state_dir
+            env["PT_PS_SNAPSHOT_SECS"] = str(ps_snapshot_secs)
         return _spawn([sys.executable, "-u"] + script_args, env,
-                      f"serverlog.{i}", log_dir)
+                      f"serverlog.{i}", log_dir, append=attempt > 0)
 
     def spawn_worker(i, attempt):
         env = dict(os.environ, **(env_extra or {}), **cache_env,
@@ -713,7 +838,32 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
 
     servers, workers, logs = {}, {}, []
     restarts = [0] * worker_num
+    server_restarts = [0] * server_num
     flagged_stragglers = set()          # per-launch straggler memory
+    # pserver liveness probe: a wedged-but-alive pserver (process up,
+    # request loop stuck) stalls every trainer with nothing else to
+    # notice it. Armed only when BOTH the hang watchdog and failover
+    # are on: killing a slow-but-recoverable server is only an
+    # improvement when a warm-booting respawn follows — without
+    # --ps_snapshot_secs a probe kill would turn a survivable stall
+    # into job teardown, changing pre-failover --hang_timeout
+    # semantics
+    ps_watch = (_PsWatch(server_num)
+                if hang_timeout is not None and server_num
+                and ps_elastic else None)
+    ps_probe_interval = (max(0.5, min(hang_timeout / 3.0, 5.0))
+                         if ps_watch else None)
+    # probes run serially inside the ONE supervision loop, and only a
+    # WEDGED server pays its full timeout (a healthy one answers in
+    # ms, a dead one refuses instantly) — so the per-probe timeout is
+    # divided by the server count to bound the worst-case loop stall
+    # (all servers wedged) at ~hang_timeout/4 per round, keeping
+    # trainer reaping / respawn timers / the global deadline serviced
+    ps_probe_timeout = (
+        max(0.2, min(2.0, hang_timeout / (4.0 * max(server_num, 1))))
+        if ps_watch else None)
+    next_ps_probe = (time.monotonic() + ps_probe_interval
+                     if ps_watch else None)
     health.reset(hb_dir, worker_num)    # a reused log_dir must not
                                         # vouch for the new run
     deadline = None if timeout is None else time.monotonic() + timeout
@@ -733,6 +883,31 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     # deaths, other workers' faults, preemption, and the global
     # deadline for up to the backoff cap)
     pending_respawn = {}
+    # pserver idx -> monotonic respawn time (same non-blocking idiom)
+    pending_ps_respawn = {}
+
+    def fail_server(i, why):
+        """Pserver restart policy (only reachable with failover armed):
+        respawn pserver i at the SAME endpoint after backoff — the
+        respawned process warm-boots from the last-good snapshot and
+        the trainers' clients reconnect — until the per-server budget
+        is spent; then tear down the whole job (its hosted state is
+        gone past recovery)."""
+        if server_restarts[i] >= max_restarts:
+            _log(f"pserver {i} {why}; restart budget {max_restarts} "
+                 f"exhausted, tearing down the job")
+            _drain(all_procs(), grace_period)
+            return False
+        delay = backoff_delay(server_restarts[i])
+        server_restarts[i] += 1
+        _m_ps_restarts.inc()
+        _log(f"pserver {i} {why}; respawning at {host}:{ports[i]} "
+             f"{server_restarts[i]}/{max_restarts} after {delay:.1f}s "
+             f"backoff (warm boot from {ps_state_dir})")
+        pending_ps_respawn[i] = time.monotonic() + delay
+        if ps_watch:
+            ps_watch.forget(i)
+        return True
 
     def fail_worker(i, why):
         """Individual-worker restart policy: respawn worker i after
@@ -794,11 +969,65 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                     continue
                 del servers[name]
                 if r != 0:
-                    # a dead pserver loses hosted state no worker
-                    # restart can recover — fail fast
                     _log(f"{name} exited with code {r}{_rc_label(r)}")
+                    i = int(name.rsplit(None, 1)[-1])
+                    if ps_elastic:
+                        if not fail_server(i, f"died (rc={r})"):
+                            return r
+                        continue
+                    # without snapshots a dead pserver loses hosted
+                    # state no worker restart can recover — fail fast
                     _drain(all_procs(), grace_period)
                     return r
+            for i, due in list(pending_ps_respawn.items()):
+                if time.monotonic() < due:
+                    continue
+                del pending_ps_respawn[i]
+                p, f = spawn_server(i, server_restarts[i])
+                servers[f"pserver {i}"] = p
+                logs.append(f)
+            if ps_watch is not None and time.monotonic() >= next_ps_probe:
+                next_ps_probe = time.monotonic() + ps_probe_interval
+                for i in range(server_num):
+                    p = servers.get(f"pserver {i}")
+                    if (p is None or p.poll() is not None
+                            or i in pending_ps_respawn):
+                        continue
+                    ok = ps_probe(f"{host}:{ports[i]}",
+                                  timeout=ps_probe_timeout)
+                    if ok is None:      # codec unavailable: disabled
+                        ps_watch = None
+                        _log("pserver liveness probe disabled (wire "
+                             "codec unavailable in the launcher "
+                             "process)")
+                        break
+                    ps_watch.observe(i, ok)
+                for i, age in (ps_watch.wedged(hang_timeout)
+                               if ps_watch else []):
+                    p = servers.get(f"pserver {i}")
+                    if p is None or p.poll() is not None:
+                        continue
+                    _m_watchdog.inc()
+                    _log(f"watchdog: pserver {i} wedged — answered "
+                         f"its liveness probe, then stopped for "
+                         f"{age:.1f}s (hang_timeout={hang_timeout}s); "
+                         f"killing it")
+                    # no grace: a wedged request loop won't act on
+                    # SIGTERM; the death is handled next poll
+                    # (respawn under the budget, or fail fast)
+                    _drain([p], 0.0)
+                    ps_watch.forget(i)
+                if ps_watch:
+                    for i in range(server_num):
+                        p = servers.get(f"pserver {i}")
+                        if (p is not None and p.poll() is None
+                                and i not in pending_ps_respawn
+                                and time.time() - started > hang_timeout
+                                and ps_watch.slow(i)):
+                            _log(f"watchdog: pserver {i} slow — no "
+                                 f"probe reply yet (not killed: only "
+                                 f"a server that answered then "
+                                 f"stopped counts as wedged)")
             for i, due in list(pending_respawn.items()):
                 if time.monotonic() < due:
                     continue
@@ -910,6 +1139,20 @@ def _parse_args(argv):
                          "up to this ceiling — a join is requested by "
                          "dropping a file named join.<anything> in "
                          "<log_dir>/elastic/. Default: fixed gang.")
+    ap.add_argument("--ps_snapshot_secs", type=float, default=None,
+                    help="ps mode: arm pserver failover — each pserver "
+                         "snapshots its hosted state (integrity-"
+                         "manifested, atomically published) to "
+                         "<log_dir>/ps_state every this many seconds "
+                         "on a background thread, a dead pserver is "
+                         "respawned at its endpoint under the "
+                         "--max_restarts budget and warm-boots from "
+                         "the last-good snapshot, and (with "
+                         "--hang_timeout) a wedged-but-alive pserver "
+                         "is probe-detected and restarted too. "
+                         "Default: off (a pserver death tears the job "
+                         "down, today's semantics). See "
+                         "docs/ELASTIC_TRAINING.md 'Pserver failover'.")
     ap.add_argument("--hang_timeout", type=float, default=None,
                     help="hang watchdog: kill+restart a gang whose rank "
                          "heartbeat once and then stopped for this many "
@@ -937,7 +1180,8 @@ def main(argv=None):
                        timeout=args.timeout,
                        max_restarts=args.max_restarts,
                        hang_timeout=args.hang_timeout,
-                       grace_period=args.grace_period)
+                       grace_period=args.grace_period,
+                       ps_snapshot_secs=args.ps_snapshot_secs)
     else:
         nproc = args.nproc_per_node
         if nproc is None:

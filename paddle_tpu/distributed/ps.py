@@ -29,11 +29,29 @@ fan-in of all trainers and steps the optimizer, then wakes pullers.
 Round 0 is the server-side initial value, so every trainer starts from
 identical parameters (the reference broadcasts startup from pserver the
 same way).
+
+Fault tolerance (docs/ELASTIC_TRAINING.md "Pserver failover"): a
+pserver's hosted state snapshots to generation-tagged artifact sets
+published through ``io_checkpoint``'s integrity machinery (per-array
+CRC32 manifest, mkstemp + fsync + atomic ``os.replace``), periodically
+on a background thread (``start_snapshots``) off the request path. A
+restarted server (``run_pserver`` under ``launch_ps
+--ps_snapshot_secs``) warm-boots from the newest generation that
+VERIFIES — a torn/bit-rotted one is quarantined (``*.corrupt``) and
+the restore walks back. Every server carries a random ``incarnation``
+token served via the ``SERVER_INFO`` frame; ``PSClient`` probes it on
+every reconnect, so a client that outlives a server restart detects
+the new incarnation, counts the optimizer rounds lost since the last
+snapshot (``ps_stale_rounds_total``), and re-establishes its sync-mode
+round expectations instead of deadlocking on a round the reborn server
+will never reach.
 """
 
 import collections
+import json
 import logging
 import os
+import re
 import socket
 import socketserver
 import threading
@@ -44,9 +62,31 @@ import numpy as np
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.flags import define_flag, get_flag
 from paddle_tpu.distributed import wire
+from paddle_tpu.monitor.registry import counter as _counter
+from paddle_tpu.monitor.registry import histogram as _histogram
 
 __all__ = ["ParameterServer", "NativeParameterServer", "PSClient",
            "Communicator", "run_pserver", "make_parameter_server"]
+
+_m_snap_saves = _counter(
+    "ps_snapshot_saves_total",
+    "Pserver snapshot generations made durable (periodic background "
+    "snapshots + checkpoint-notify + final flush)")
+_m_snap_ms = _histogram(
+    "ps_snapshot_ms",
+    "Wall ms to make one pserver snapshot generation durable "
+    "(state export under the table/var locks + integrity-manifested "
+    "atomic publish)")
+_m_reconnects = _counter(
+    "ps_client_reconnects_total",
+    "PSClient calls that survived at least one dropped/refused pserver "
+    "connection (retried with backoff; mutating frames stay "
+    "exactly-once via the (client_id, seq) dedup)")
+_m_stale_rounds = _counter(
+    "ps_stale_rounds_total",
+    "Optimizer rounds a restarted pserver lost between its last "
+    "snapshot and the crash, as observed by reconnecting clients "
+    "re-establishing their sync-round expectations")
 
 define_flag("ps_transport", "auto",
             "PS server transport: auto (C++ when the hosted state is "
@@ -80,42 +120,358 @@ _recv_exact = wire.recv_exact
 _send_frame = wire.send_frame
 _recv_frame = wire.recv_frame
 
+#: the SERVER reply path, separated from the client-side _send_frame so
+#: testing/faults' wire chaos (reply drop / delay) can patch exactly
+#: the server side of the conversation and nothing else
+_reply_frame = wire.send_frame
 
-def _ps_checkpoint_save(dirname, host, port, dense_values,
-                        sparse_tables):
+#: the pserver snapshot filename grammar, in ONE place —
+#: testing/faults and tools/fsck_checkpoint parse the same names
+#: _ps_checkpoint_save writes, and a format change must break loudly
+#: there, not silently no-op the fault injection / fsck verdicts
+PS_GEN_META_RE = re.compile(r"^pserver_(.+)\.gen(\d+)\.json$")
+PS_GEN_ARTIFACT_RE = re.compile(r"^pserver_(.+)\.gen(\d+)\.npz$")
+
+#: the dense-artifact slot-array key prefix (``__slot__/<var>/<slot>``)
+_SLOT_KEY_PREFIX = "__slot__/"
+
+
+def _ps_log(msg):
+    """Loud pserver-lifecycle line: straight to stderr (the launcher's
+    serverlog), like the launcher's own ``[launch]`` idiom — warm-boot
+    and quarantine evidence must be greppable even when the worker
+    never configured logging."""
+    import sys
+    print(f"[pserver] {msg}", file=sys.stderr, flush=True)
+
+
+def _ps_tag(host, port):
+    return f"{host}_{port}".replace(".", "_")
+
+
+def _ps_dense_path(dirname, tag, gen):
+    return os.path.join(dirname, f"pserver_{tag}.gen{gen}.npz")
+
+
+def _ps_table_path(dirname, tag, table, gen):
+    return os.path.join(dirname, f"pserver_{tag}_{table}.gen{gen}.npz")
+
+
+def _ps_meta_path(dirname, tag, gen):
+    return os.path.join(dirname, f"pserver_{tag}.gen{gen}.json")
+
+
+def _ps_gen_files(dirname, tag, gen, tables):
+    """Every file a complete generation comprises (meta last)."""
+    return ([_ps_dense_path(dirname, tag, gen)]
+            + [_ps_table_path(dirname, tag, t, gen) for t in tables]
+            + [_ps_meta_path(dirname, tag, gen)])
+
+
+def _ps_listdir(dirname):
+    """``os.listdir`` under the blip-is-not-corruption rule: a
+    transient OSError is retried and then RE-RAISED — swallowing it
+    into an empty listing would make a warm boot silently restore
+    nothing (discarding training) and a save reuse a generation
+    number it couldn't see. ``FileNotFoundError`` (dir never created:
+    no snapshots yet) is genuinely empty."""
+    from paddle_tpu import io_checkpoint as ioc
+    try:
+        return ioc._retry_transient(
+            lambda: os.listdir(dirname),
+            f"pserver snapshot dir {dirname} list")
+    except FileNotFoundError:
+        return []
+
+
+def _ps_complete_gens(dirname, tag):
+    """Sorted ``[(gen, meta), ...]`` of generations with a parseable
+    meta AND every artifact it promises on disk — the generations a
+    warm boot will consider (the PR-5 complete-step rule: the meta is
+    published LAST, so a crash mid-snapshot can never yield a
+    half-generation that looks whole). A garbage meta CONTENT
+    (ValueError/TypeError) makes its generation invisible, like a
+    torn ``ckpt_N.json``; a transient I/O error re-raises — dropping
+    the newest generation over a blip would silently rewind the warm
+    boot (``run_pserver`` crashes into the restart budget instead)."""
+    from paddle_tpu import io_checkpoint as ioc
+    meta_re = re.compile(rf"^pserver_{re.escape(tag)}\.gen(\d+)\.json$")
+    out = []
+    for f in _ps_listdir(dirname):
+        m = meta_re.match(f)
+        if not m:
+            continue
+        gen = int(m.group(1))
+
+        def read_meta(fname=f):
+            with open(os.path.join(dirname, fname)) as fh:
+                return json.load(fh)
+
+        try:
+            meta = ioc._retry_transient(
+                read_meta, f"pserver snapshot meta {f} read")
+            tables = list(meta.get("tables", []))
+        except FileNotFoundError:
+            continue            # pruned under us
+        except (ValueError, TypeError):
+            continue            # garbage content: never complete
+        promised = _ps_gen_files(dirname, tag, gen, tables)[:-1]
+        if all(ioc._stat_exists(p) for p in promised):
+            out.append((gen, meta))
+    return sorted(out)
+
+
+def _ps_next_gen(dirname, tag):
+    """One past the highest generation index ANY matching file (meta,
+    artifact, or quarantined ``*.corrupt``) has ever used — a
+    quarantined generation's number is never reused, so its evidence
+    files can't collide with a later healthy publish. A persistent
+    listing error re-raises (via ``_ps_listdir``): guessing 0 would
+    silently overwrite whatever the listing failed to show."""
+    pat = re.compile(
+        rf"^pserver_{re.escape(tag)}(?:_.+)?\.gen(\d+)\.(?:npz|json)$")
+    best = -1
+    for f in _ps_listdir(dirname):
+        if f.endswith(".corrupt"):
+            f = f[:-len(".corrupt")]
+        m = pat.match(f)
+        if m:
+            best = max(best, int(m.group(1)))
+    return best + 1
+
+
+def _ps_sweep_tmps(dirname, tag):
+    """Remove a killed previous incarnation's publish temps
+    (``.pserver_<tag>*.tmp.npz`` / this tag's meta temps). The
+    supervisor guarantees the previous incarnation of THIS endpoint is
+    dead before a respawn, so same-tag temps are stale by
+    construction; other endpoints' in-flight temps are never touched."""
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return
+    for f in names:
+        # the tag must end at a '.' (dense/meta artifact) or '_'
+        # (table artifact): launch_ps puts EVERY pserver's snapshots
+        # in one shared ps_state dir, and a bare prefix match would
+        # let tag "..._1234" sweep a live sibling "..._12345"'s
+        # in-flight publish temp out from under its writer
+        mine = (f.startswith((f".pserver_{tag}.", f".pserver_{tag}_"))
+                and f.endswith((".tmp.npz", ".json.tmp")))
+        if not mine:
+            continue
+        try:
+            os.remove(os.path.join(dirname, f))
+        except OSError:
+            pass
+
+
+def _ps_publish_json(path, obj):
+    """fsync'd atomic JSON publish (io_checkpoint's one shared
+    idiom; the ``.{basename}.`` temp prefix is what _ps_sweep_tmps
+    and fsck recognize)."""
+    from paddle_tpu import io_checkpoint as ioc
+    ioc._publish_json_atomic(path, obj,
+                             prefix=f".{os.path.basename(path)}.")
+    ioc._fsync_dir(os.path.dirname(path) or ".")
+
+
+def _ps_checkpoint_save(dirname, host, port, dense, sparse_tables,
+                        incarnation=0, keep=2):
     """The pserver checkpoint artifact contract, shared by BOTH
-    transports (cross-transport restore depends on it):
-    `pserver_<host>_<port>.npz` holding {name: value} plus one
-    `..._<table>.npz` per sparse table with ids/rows/accum
-    (kCheckpointBlockId parity, listen_and_serv_op.cc:345)."""
+    transports (cross-transport restore depends on it): one
+    generation-tagged artifact set per save —
+    ``pserver_<tag>.gen<G>.npz`` holding {name: value} plus per-var
+    optimizer slots (``__slot__/<var>/<slot>`` keys) and round/step
+    counters in the manifest body, one ``pserver_<tag>_<table>.gen<G>
+    .npz`` per sparse table with ids/rows/accum (kCheckpointBlockId
+    parity, listen_and_serv_op.cc:345), and a ``.gen<G>.json`` meta
+    marker published LAST — a generation without its meta is invisible
+    to restore, so a crash mid-save can never look whole. Every npz
+    publishes through ``io_checkpoint.publish_npz`` (per-array CRC32
+    manifest, mkstemp + fsync + atomic ``os.replace``); the newest
+    ``keep`` complete generations survive pruning — the walk-back
+    budget a corrupt newest generation falls back into.
+
+    ``dense`` is the ``_dense_export()`` triple
+    ``(values, var_state, slots)``: values {name: array}, var_state
+    {name: (round, step_count)}, slots {name: {slot: array}}."""
+    from paddle_tpu import io_checkpoint as ioc
     os.makedirs(dirname, exist_ok=True)
-    tag = f"{host}_{port}".replace(".", "_")
-    np.savez(os.path.join(dirname, f"pserver_{tag}.npz"),
-             **dense_values)
-    for n, t in sparse_tables.items():
+    tag = _ps_tag(host, port)
+    gen = _ps_next_gen(dirname, tag)
+    values, var_state, slots = dense
+    arrays = {n: v for n, v in values.items()}
+    for n, sl in slots.items():
+        for k, a in sl.items():
+            arrays[f"{_SLOT_KEY_PREFIX}{n}/{k}"] = a
+    body = {
+        "kind": "pserver_dense",
+        "endpoint": tag,
+        "gen": gen,
+        "incarnation": int(incarnation),
+        "var_state": {n: {"round": int(r), "step": int(s)}
+                      for n, (r, s) in var_state.items()},
+    }
+    ioc.publish_npz(_ps_dense_path(dirname, tag, gen), arrays, body)
+    for n, t in sorted(sparse_tables.items()):
         ids, rows, accum = t.snapshot()
-        np.savez(os.path.join(dirname, f"pserver_{tag}_{n}.npz"),
-                 ids=ids, rows=rows, accum=accum)
+        ioc.publish_npz(
+            _ps_table_path(dirname, tag, n, gen),
+            {"ids": ids, "rows": rows, "accum": accum},
+            {"kind": "pserver_table", "endpoint": tag, "table": n,
+             "gen": gen})
+    _ps_publish_json(_ps_meta_path(dirname, tag, gen), {
+        "gen": gen, "endpoint": tag, "incarnation": int(incarnation),
+        "tables": sorted(sparse_tables), "time": time.time(),
+    })
+    # prune: meta FIRST (a killed prune must leave meta-less artifacts
+    # — invisible to restore — never a meta promising missing files)
+    complete = _ps_complete_gens(dirname, tag)
+    for g, m in (complete[:-keep] if keep else []):
+        files = _ps_gen_files(dirname, tag, g,
+                              list(m.get("tables", [])))
+        for p in [files[-1]] + files[:-1]:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+    return gen
 
 
-def _ps_checkpoint_load(dirname, host, port, set_dense, sparse_tables):
-    """Counterpart of _ps_checkpoint_save: calls ``set_dense(name,
-    value)`` per hosted dense var found in the artifact and restores
-    each sparse table (old artifacts without accum restore with empty
-    accumulators so stale G cannot scale the rows)."""
-    tag = f"{host}_{port}".replace(".", "_")
+def _ps_quarantine_gen(dirname, tag, gen, tables):
+    """Rename a generation's meta + artifacts ``*.corrupt`` (the
+    restore walk-back's quarantine — evidence preserved, never offered
+    for restore again; its generation number is never reused)."""
+    renamed = []
+    files = _ps_gen_files(dirname, tag, gen, tables)
+    # meta first: a crash mid-quarantine leaves meta-less artifacts,
+    # which restore already ignores
+    for p in [files[-1]] + files[:-1]:
+        try:
+            os.replace(p, p + ".corrupt")
+            renamed.append(os.path.basename(p) + ".corrupt")
+        except OSError:
+            pass
+    return renamed
+
+
+def _ps_load_legacy(dirname, tag, apply_dense, sparse_tables):
+    """The pre-generation artifact layout (plain
+    ``pserver_<tag>.npz`` + ``pserver_<tag>_<table>.npz``): verified
+    when a manifest is present, accepted structurally otherwise; a
+    torn artifact is quarantined and restore proceeds without it
+    (there is nothing older to walk back to in the legacy layout)."""
+    from paddle_tpu import io_checkpoint as ioc
+    restored = False
     path = os.path.join(dirname, f"pserver_{tag}.npz")
     if os.path.exists(path):
-        blob = np.load(path)
-        for n in blob.files:
-            set_dense(n, blob[n])
+        try:
+            _, arrays = ioc.verify_npz(path)
+        except ioc.CheckpointCorruptError as e:
+            _ps_log(f"quarantined corrupt legacy artifact {path}: {e}")
+            ioc._m_corrupt.inc()
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
+        else:
+            for n, v in arrays.items():
+                if not n.startswith(_SLOT_KEY_PREFIX):
+                    apply_dense(n, v, None, None)
+            restored = True
     for n, t in sparse_tables.items():
         p = os.path.join(dirname, f"pserver_{tag}_{n}.npz")
-        if os.path.exists(p):
-            with np.load(p) as blob:
-                t.restore(blob["ids"], blob["rows"],
-                          blob["accum"] if "accum" in blob.files
-                          else None)
+        if not os.path.exists(p):
+            continue
+        try:
+            _, arrays = ioc.verify_npz(p)
+        except ioc.CheckpointCorruptError as e:
+            _ps_log(f"quarantined corrupt legacy artifact {p}: {e}")
+            ioc._m_corrupt.inc()
+            try:
+                os.replace(p, p + ".corrupt")
+            except OSError:
+                pass
+            continue
+        t.restore(arrays["ids"], arrays["rows"],
+                  arrays.get("accum"))
+        restored = True
+    return {"gen": None, "legacy": True} if restored else None
+
+
+def _ps_checkpoint_load(dirname, host, port, apply_dense,
+                        sparse_tables):
+    """Counterpart of ``_ps_checkpoint_save``: restore the newest
+    complete generation that VERIFIES, walking back past corrupt ones.
+
+    Calls ``apply_dense(name, value, state, slots)`` per hosted dense
+    var found in the artifact (``state`` = (round, step_count) or
+    None; ``slots`` = {slot: array} or None) and restores each sparse
+    table (old artifacts without accum restore with empty accumulators
+    so stale G cannot scale the rows). A generation whose any artifact
+    fails integrity verification is QUARANTINED (every file renamed
+    ``*.corrupt``, ``corrupt_checkpoints_total``++) and the previous
+    one restores — one rotted file never bricks the warm boot. A
+    transient ``OSError`` persisting through retries re-raises
+    unchanged (blip is not corruption: crash into the supervisor's
+    restart budget rather than quarantine a healthy snapshot). Falls
+    back to the legacy un-generational layout when no generation
+    exists. Returns the restored generation's meta, or None when
+    nothing restorable was found."""
+    from paddle_tpu import io_checkpoint as ioc
+    tag = _ps_tag(host, port)
+    gens = _ps_complete_gens(dirname, tag)
+    if not gens:
+        return _ps_load_legacy(dirname, tag, apply_dense,
+                               sparse_tables)
+    quarantined = 0
+    for gen, meta in reversed(gens):
+        tables = list(meta.get("tables", []))
+        try:
+            manifest, arrays = ioc.verify_npz(
+                _ps_dense_path(dirname, tag, gen))
+            table_blobs = {}
+            for t in tables:
+                _, tb = ioc.verify_npz(
+                    _ps_table_path(dirname, tag, t, gen))
+                table_blobs[t] = tb
+        except ioc.CheckpointCorruptError as e:
+            ioc._m_corrupt.inc()
+            renamed = _ps_quarantine_gen(dirname, tag, gen, tables)
+            quarantined += 1
+            _ps_log(f"quarantined corrupt snapshot generation {gen} "
+                    f"({', '.join(renamed) or 'nothing renamed'}): "
+                    f"{e}; walking back")
+            continue
+        var_state = (manifest or {}).get("var_state", {})
+        slots = {}
+        for key, a in arrays.items():
+            if not key.startswith(_SLOT_KEY_PREFIX):
+                continue
+            name, slot = key[len(_SLOT_KEY_PREFIX):].rsplit("/", 1)
+            slots.setdefault(name, {})[slot] = a
+        for n, v in arrays.items():
+            if n.startswith(_SLOT_KEY_PREFIX):
+                continue
+            st = var_state.get(n)
+            state = ((int(st["round"]), int(st["step"]))
+                     if st else None)
+            apply_dense(n, v, state, slots.get(n))
+        for t, table in sparse_tables.items():
+            tb = table_blobs.get(t)
+            if tb is None:
+                continue        # table added since this snapshot
+            table.restore(tb["ids"], tb["rows"], tb.get("accum"))
+        if quarantined:
+            _ps_log(f"restored from last-good snapshot generation "
+                    f"{gen} after quarantining {quarantined} corrupt "
+                    f"newer generation(s)")
+        return meta
+    _ps_log(f"every snapshot generation in {dirname} for {tag} was "
+            f"corrupt ({quarantined} quarantined); starting from "
+            f"initial values")
+    return None
 
 
 class _DenseVar:
@@ -445,7 +801,87 @@ class _SparseTable:
                         self.accum[int(i)] = a
 
 
-class ParameterServer:
+def _new_incarnation():
+    """A fresh random 63-bit token per server object (nonzero; fits the
+    SERVER_INFO int64 reply). Random, not PADDLE_RESTART_COUNT: two
+    incarnations must never collide even across supervisor restarts
+    that reset the attempt counter."""
+    return (int.from_bytes(os.urandom(8), "little") & (2 ** 63 - 1)) or 1
+
+
+class _SnapshotLoop:
+    """Periodic async background snapshot, shared by both transports:
+    a daemon thread calls ``self.save(dirname)`` every ``interval``
+    seconds OFF the request path (the save itself takes each var/table
+    lock only long enough to copy). ``stop_snapshots`` joins the
+    thread and (by default) flushes one final generation so a graceful
+    STOP never loses the tail of training."""
+
+    _snap_thread = None
+
+    def save(self, dirname):
+        """One snapshot generation (see ``_ps_checkpoint_save``).
+        Serialized per server: the background thread and a request-path
+        CHECKPOINT_NOTIFY racing on the same generation number could
+        otherwise publish a set whose dense and table artifacts came
+        from different moments."""
+        with self._save_lock:
+            t0 = time.perf_counter()
+            _ps_checkpoint_save(dirname, self.host, self.port,
+                                self._dense_export(), self.sparse,
+                                incarnation=self.incarnation)
+            _m_snap_saves.inc()
+            _m_snap_ms.observe((time.perf_counter() - t0) * 1e3)
+
+    def start_snapshots(self, dirname, interval=5.0):
+        enforce(self._snap_thread is None, "snapshots already started")
+        enforce(interval > 0, f"snapshot interval must be > 0 "
+                              f"(got {interval})")
+        os.makedirs(dirname, exist_ok=True)
+        _ps_sweep_tmps(dirname, _ps_tag(self.host, self.port))
+        self._snap_dir = dirname
+        self._snap_stop = threading.Event()
+
+        def loop():
+            while not self._snap_stop.wait(interval):
+                try:
+                    self.save(dirname)
+                except Exception as e:
+                    # a snapshot failure must never kill the serving
+                    # loop it protects; the next interval retries
+                    _ps_log(f"snapshot failed (will retry next "
+                            f"interval): {type(e).__name__}: {e}")
+
+        self._snap_thread = threading.Thread(
+            target=loop, daemon=True, name="pt-ps-snapshot")
+        self._snap_thread.start()
+        return self
+
+    def stop_snapshots(self, final_save=True, timeout=30.0):
+        if self._snap_thread is None:
+            return
+        self._snap_stop.set()
+        t = self._snap_thread
+        t.join(timeout)
+        self._snap_thread = None
+        if t.is_alive():
+            # a save wedged in I/O still HOLDS _save_lock: attempting
+            # the final flush would block this (shutdown) path on that
+            # lock forever — skip it loudly instead; the wedged save
+            # may still land on its own
+            _ps_log(f"snapshot thread did not stop within {timeout}s "
+                    f"(a save is wedged in I/O); skipping the final "
+                    f"flush rather than blocking shutdown on its lock")
+            return
+        if final_save:
+            try:
+                self.save(self._snap_dir)
+            except Exception as e:
+                _ps_log(f"final snapshot failed: "
+                        f"{type(e).__name__}: {e}")
+
+
+class ParameterServer(_SnapshotLoop):
     """listen_and_serv parity: hosts a set of dense vars + sparse tables,
     applies optimizer updates on grad fan-in, serves pulls/barriers/
     checkpoint-notify over TCP."""
@@ -455,6 +891,8 @@ class ParameterServer:
         self.port = int(port)
         self.num_trainers = num_trainers
         self.sync_mode = sync_mode
+        self.incarnation = _new_incarnation()
+        self._save_lock = threading.Lock()
         self.dense = {}
         self.sparse = {}
         self._barrier_lock = threading.Condition()
@@ -554,6 +992,14 @@ class ParameterServer:
         if kind == wire.LIST_VARS:
             return (wire.OK_NAMES, ("\n".join(sorted(self.dense)),
                                     "\n".join(sorted(self.sparse))))
+        if kind == wire.SERVER_INFO:
+            # the failover probe: [incarnation, min dense round] — a
+            # reconnecting client compares the token against the one it
+            # last saw and, on a change, re-establishes its sync-round
+            # expectations at the round the reborn server can serve
+            return (wire.OK_ARR,
+                    (np.asarray([self.incarnation, self._min_round()],
+                                np.int64),))
         if kind == wire.STOP:
             def stop_after_grace():
                 # only a multi-trainer job has the in-flight-reply
@@ -632,25 +1078,54 @@ class ParameterServer:
                 self._inflight.discard(key)
                 self._dedup_cv.notify_all()
 
+    def _min_round(self):
+        rounds = []
+        for v in self.dense.values():
+            with v.cv:
+                rounds.append(int(v.round))
+        return min(rounds) if rounds else 0
+
     # -- checkpoint (kCheckpointBlockId parity) ----------------------------
-    def save(self, dirname):
-        # snapshot each var under its cv: the native step mutates slot
-        # buffers in place, and a mid-step serialization must not see a
-        # half-updated state
-        dense = {}
+    def _dense_export(self):
+        """(values, var_state, slots) — each var copied under its cv:
+        the native step mutates slot buffers in place, and a mid-step
+        serialization must not see a half-updated state. Per-var
+        atomic; a sync round's partial fan-in (accum/pushed) is NOT
+        snapshotted — after a restart the trainers re-push the round."""
+        values, state, slots = {}, {}, {}
         for n, v in self.dense.items():
             with v.cv:
-                dense[n] = np.array(v.value, copy=True)
-        _ps_checkpoint_save(dirname, self.host, self.port, dense,
-                            self.sparse)
+                values[n] = np.array(v.value, copy=True)
+                state[n] = (int(v.round), int(v.step_count))
+                if v.slots:
+                    slots[n] = {k: np.array(s, copy=True)
+                                for k, s in v.slots.items()}
+        return values, state, slots
+
+    def _dense_import(self, name, value, state, slots):
+        v = self.dense.get(name)
+        if v is None:
+            return
+        with v.cv:
+            v.value = np.asarray(value)
+            if state is not None:
+                v.round, v.step_count = state
+            if slots:
+                # contiguous float32: the native dense kernels hand
+                # these buffers to C by pointer
+                v.slots = {k: np.ascontiguousarray(a, np.float32)
+                           for k, a in slots.items()}
+            v.accum = None
+            v.pushed.clear()
+            v.cv.notify_all()
 
     def load(self, dirname):
-        def set_dense(n, val):
-            if n in self.dense:
-                self.dense[n].value = val
-
-        _ps_checkpoint_load(dirname, self.host, self.port, set_dense,
-                            self.sparse)
+        """Warm boot: restore the newest integrity-verified snapshot
+        generation (walking back past corrupt ones). Returns the
+        restored generation's meta, or None when nothing restorable
+        exists."""
+        return _ps_checkpoint_load(dirname, self.host, self.port,
+                                   self._dense_import, self.sparse)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -670,8 +1145,8 @@ class ParameterServer:
                                             wire.HEADER_SIZE))
                         except wire.WireError as e:
                             try:
-                                _send_frame(self.request, wire.ERR,
-                                            (f"malformed frame: {e}",))
+                                _reply_frame(self.request, wire.ERR,
+                                             (f"malformed frame: {e}",))
                             except OSError:
                                 pass
                             return
@@ -682,9 +1157,9 @@ class ParameterServer:
                             # bytes were never evaluated; typed error,
                             # drop the connection
                             try:
-                                _send_frame(self.request, wire.ERR,
-                                            (f"malformed frame: {e}",),
-                                            cid, seq)
+                                _reply_frame(self.request, wire.ERR,
+                                             (f"malformed frame: {e}",),
+                                             cid, seq)
                             except OSError:
                                 pass
                             return
@@ -696,8 +1171,10 @@ class ParameterServer:
                         # echo (client_id, seq): the client rejects a
                         # reply whose seq does not match its request
                         # (a late reply to a timed-out call must never
-                        # be consumed as the next call's answer)
-                        _send_frame(self.request, rk, rf, cid, seq)
+                        # be consumed as the next call's answer).
+                        # _reply_frame, not _send_frame: the module
+                        # hook testing/faults' wire chaos patches
+                        _reply_frame(self.request, rk, rf, cid, seq)
                 except (ConnectionError, EOFError, OSError):
                     pass
 
@@ -776,7 +1253,7 @@ class _NativeDenseView:
             self._server._h, self.name.encode()))
 
 
-class NativeParameterServer:
+class NativeParameterServer(_SnapshotLoop):
     """The C++ control-plane transport (native/src/ps_server.cc):
     listen_and_serv parity with the SAME wire protocol and observable
     semantics as ParameterServer, but the accept loop, frame codec,
@@ -815,6 +1292,9 @@ class NativeParameterServer:
         # the ctypes callback object must outlive the server
         self._ckpt_cb = native.PS_CKPT_CB(self._on_checkpoint)
         self._lib.pt_pss_set_checkpoint_cb(self._h, self._ckpt_cb)
+        self.incarnation = _new_incarnation()
+        self._lib.pt_pss_set_incarnation(self._h, self.incarnation)
+        self._save_lock = threading.Lock()
 
     # -- expressibility ---------------------------------------------------
     @staticmethod
@@ -894,6 +1374,13 @@ class NativeParameterServer:
             .from_handle(handle, dim, owner=self)
 
     # -- checkpoint (same artifacts as ParameterServer.save/load) ---------
+    #: Python slot name -> native slot selector (ps_server.cc:
+    #: pt_pss_dense_set_slot takes it directly; pt_pss_dense_export
+    #: reports presence as the bitmask ``1 << which``). The artifact
+    #: contract speaks the Python names so cross-transport restore
+    #: works either direction.
+    _SLOT_WHICH = {"velocity": 0, "moment1": 1, "moment2": 2}
+
     def _on_checkpoint(self, dirname):
         try:
             self.save(os.fsdecode(dirname))
@@ -901,18 +1388,66 @@ class NativeParameterServer:
             logging.getLogger("paddle_tpu.ps").exception(
                 "checkpoint-notify save failed")
 
-    def save(self, dirname):
-        dense = {n: v.value for n, v in self.dense.items()}
-        _ps_checkpoint_save(dirname, self.host, self.port, dense,
-                            self.sparse)
+    def _dense_export(self):
+        import ctypes
+        fp = ctypes.POINTER(ctypes.c_float)
+        values, state, slots = {}, {}, {}
+        for n, view in self.dense.items():
+            count = int(np.prod(view.shape or (1,), dtype=np.int64))
+            # ONE native lock acquisition per var (pt_pss_dense_export)
+            # copies value + round/step + every materialized slot
+            # together: reading them through separate getters would let
+            # an optimizer step land in between and publish round R+1
+            # stamped onto round-R parameters — a torn snapshot whose
+            # lost update no staleness accounting would ever see (the
+            # Python transport's export holds the var cv the same way)
+            val = np.empty(count, np.float32)
+            bufs = {k: np.empty(count, np.float32)
+                    for k in self._SLOT_WHICH}
+            rnd = ctypes.c_uint64()
+            stp = ctypes.c_long()
+            have = ctypes.c_int()
+            rc = self._lib.pt_pss_dense_export(
+                self._h, n.encode(), val.ctypes.data_as(fp),
+                ctypes.byref(rnd), ctypes.byref(stp),
+                bufs["velocity"].ctypes.data_as(fp),
+                bufs["moment1"].ctypes.data_as(fp),
+                bufs["moment2"].ctypes.data_as(fp),
+                ctypes.byref(have))
+            enforce(rc == 0, f"no hosted dense var {n!r}")
+            values[n] = val.reshape(view.shape)
+            state[n] = (int(rnd.value), int(stp.value))
+            sl = {k: bufs[k].reshape(view.shape)
+                  for k, which in self._SLOT_WHICH.items()
+                  if have.value & (1 << which)}
+            if sl:
+                slots[n] = sl
+        return values, state, slots
+
+    def _dense_import(self, name, value, state, slots):
+        import ctypes
+        fp = ctypes.POINTER(ctypes.c_float)
+        view = self.dense.get(name)
+        if view is None:
+            return
+        view.value = value
+        if state is not None:
+            self._lib.pt_pss_dense_set_state(
+                self._h, name.encode(), int(state[0]), int(state[1]))
+        for k, a in (slots or {}).items():
+            which = self._SLOT_WHICH.get(k)
+            if which is None:
+                continue
+            a = np.ascontiguousarray(a, np.float32).ravel()
+            self._lib.pt_pss_dense_set_slot(
+                self._h, name.encode(), which,
+                a.ctypes.data_as(fp), a.size)
 
     def load(self, dirname):
-        def set_dense(n, val):
-            if n in self.dense:
-                self.dense[n].value = val
-
-        _ps_checkpoint_load(dirname, self.host, self.port, set_dense,
-                            self.sparse)
+        """Warm boot (see ParameterServer.load): returns the restored
+        generation's meta or None."""
+        return _ps_checkpoint_load(dirname, self.host, self.port,
+                                   self._dense_import, self.sparse)
 
     # -- observability ----------------------------------------------------
     @property
@@ -997,16 +1532,34 @@ class PSClient:
     pserver, var→endpoint routing, send/get/prefetch/barrier/checkpoint.
     Connection failures retry with exponential backoff (grpc_client.cc
     retry path); retried mutating frames carry the same (client_id, seq)
-    so the server dedups instead of re-applying."""
+    so the server dedups instead of re-applying.
+
+    Pserver-restart awareness (docs/ELASTIC_TRAINING.md "Pserver
+    failover"): a connection-REFUSED/RESET failure is pserver downtime
+    under supervised failover, retried against a wall-clock budget
+    (``PT_PS_RECONNECT_SECS``, default 60 — sized for respawn backoff
+    plus a worker-process warm boot) rather than the fixed attempt
+    count transient blips get. Every fresh connection probes
+    ``SERVER_INFO``; a changed incarnation token means the server
+    restarted from its last snapshot, and the next sync-mode pull
+    re-establishes its round expectation at the server's round —
+    counting the lost rounds in ``ps_stale_rounds_total`` — instead of
+    blocking 120 s for a round the reborn server will never reach."""
 
     MAX_RETRIES = 5
     BACKOFF = 0.05          # seconds, doubles per attempt (cap 2 s)
 
-    def __init__(self, endpoints, var_ep=None, trainer_id=0):
+    def __init__(self, endpoints, var_ep=None, trainer_id=0,
+                 timeout=150.0):
         self.endpoints = list(endpoints)
         self.var_ep = dict(var_ep or {})
         self.trainer_id = trainer_id
         self.client_id = int.from_bytes(os.urandom(8), "little") or 1
+        # per-connection reply timeout; the 150 s default stays above
+        # the server-side wait timeouts (120 s) so the server's own
+        # EnforceNotMet surfaces as a typed error response before the
+        # transport gives up. Chaos tests lower it.
+        self.timeout = float(timeout)
         self._seq = 0
         self._seq_lock = threading.Lock()
         # connections are per-thread: a blocking pull (sync-mode round
@@ -1015,6 +1568,29 @@ class PSClient:
         self._tls = threading.local()
         self._all_socks = []
         self._all_lock = threading.Lock()
+        # failover bookkeeping (shared across threads, under one lock):
+        # last SERVER_INFO token per endpoint, the server round captured
+        # when a restart was detected (consumed by the next pull), and
+        # the cumulative per-endpoint round offset pulls subtract
+        self._inc_lock = threading.Lock()
+        self._incarnations = {}
+        self._stale_pending = {}
+        self._round_offset = {}
+        self._no_info = set()     # endpoints without SERVER_INFO
+
+    @staticmethod
+    def _reconnect_budget():
+        """Wall-clock budget for connection-refused/reset retries
+        (pserver downtime under supervised failover): the supervisor's
+        respawn backoff plus a fresh worker process's warm boot."""
+        try:
+            v = float(os.environ.get("PT_PS_RECONNECT_SECS", "60"))
+        except ValueError:
+            return 60.0
+        import math as _math
+        if not _math.isfinite(v):
+            return 60.0
+        return max(v, 0.0)
 
     def _next_seq(self):
         with self._seq_lock:
@@ -1034,15 +1610,90 @@ class PSClient:
             s = None
         if s is None:
             host, port = ep.rsplit(":", 1)
-            # client timeout > server-side wait timeouts (120 s): the
-            # server's own EnforceNotMet must surface as a typed error
-            # response before the transport gives up
-            s = socket.create_connection((host, int(port)), timeout=150.0)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self.timeout)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             socks[ep] = s
             with self._all_lock:
                 self._all_socks.append(s)
+            # a NEW connection is the only moment the server identity
+            # can have changed under us — probe it before any frame
+            # rides this socket
+            self._note_incarnation(ep, s)
         return s
+
+    def _note_incarnation(self, ep, s):
+        """SERVER_INFO probe on a fresh connection: record the server's
+        incarnation token; a CHANGE means the pserver restarted (it
+        warm-booted from its last snapshot — updates since are gone)
+        and arms the round resync the next pull consumes."""
+        with self._inc_lock:
+            if ep in self._no_info:
+                return
+        seq = self._next_seq()
+        try:
+            _send_frame(s, wire.SERVER_INFO, (), self.client_id, seq)
+            rk, _, rseq, rf = _recv_frame(s)
+        except (ConnectionError, socket.timeout, OSError,
+                wire.WireError):
+            # no reply at all — a dying server, not a legacy one;
+            # surface as a connection failure so the caller's retry
+            # path reconnects (and re-probes)
+            self._drop_sock(ep)
+            raise ConnectionError(
+                f"pserver {ep}: SERVER_INFO probe got no reply")
+        if rk != wire.OK_ARR or rseq != seq:
+            # a pre-SERVER_INFO server rejects the unknown kind (ERR,
+            # then closes the connection): remember it has no failover
+            # probe and hand the caller a fresh socket
+            with self._inc_lock:
+                self._no_info.add(ep)
+            self._drop_sock(ep)
+            raise ConnectionError(
+                f"pserver {ep}: no SERVER_INFO support (legacy "
+                f"server); restart detection disabled")
+        vals = np.asarray(rf[0]).ravel()
+        inc, srv_round = int(vals[0]), int(vals[1])
+        with self._inc_lock:
+            prev = self._incarnations.get(ep)
+            self._incarnations[ep] = inc
+            if prev is not None and prev != inc:
+                self._stale_pending[ep] = srv_round
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "pserver %s restarted (incarnation %#x -> %#x): "
+                    "serving round %d from its last snapshot; pulls "
+                    "resync and lost rounds count in "
+                    "ps_stale_rounds_total", ep, prev, inc, srv_round)
+
+    def _effective_round(self, ep, min_round):
+        """The round a pull should actually wait for: ``min_round``
+        minus this endpoint's accumulated restart offset; a pending
+        restart detection is consumed HERE, growing the offset by the
+        rounds the reborn server lost (precise staleness — counted
+        once, at the resync)."""
+        with self._inc_lock:
+            off = self._round_offset.get(ep, 0)
+            want = min_round - off
+            pend = self._stale_pending.get(ep)
+            if pend is not None and want > pend:
+                # consume the armed resync ONLY when this pull
+                # actually outruns the reborn server: popping it on a
+                # low-round pull (eval fetch, async min_round=0) would
+                # disarm the resync and leave the NEXT training pull
+                # deadlocking on a round the server will never reach —
+                # the exact failure this machinery exists to prevent
+                self._stale_pending.pop(ep, None)
+                lost = want - pend
+                self._round_offset[ep] = off + lost
+                _m_stale_rounds.inc(lost)
+                logging.getLogger("paddle_tpu.ps").warning(
+                    "pserver %s: pull expected round %d but the "
+                    "restarted server is at round %d — %d round(s) of "
+                    "updates since its last snapshot were lost; "
+                    "resuming from the snapshot round", ep, want, pend,
+                    lost)
+                want = pend
+            return max(0, want)
 
     def _drop_sock(self, ep):
         """Close + forget the cached connection: a socket whose stream
@@ -1062,10 +1713,20 @@ class PSClient:
     def _call(self, ep, kind, *fields):
         seq = self._next_seq()
         delay = self.BACKOFF
-        for attempt in range(self.MAX_RETRIES + 1):
+        attempts = 0            # transient failures (fixed budget)
+        conn_failures = 0
+        refused_deadline = None  # downtime failures (wall-clock budget)
+        while True:
             try:
-                s = self._sock(ep, fresh=attempt > 0)
-                _send_frame(s, kind, fields, self.client_id, seq)
+                s = self._sock(ep, fresh=conn_failures > 0)
+                send_fields = fields
+                if kind == wire.PULL_PARAM:
+                    # computed AFTER _sock: a reconnect's SERVER_INFO
+                    # probe may have just armed the round resync this
+                    # pull must consume
+                    send_fields = (fields[0], self._effective_round(
+                        ep, int(fields[1])))
+                _send_frame(s, kind, send_fields, self.client_id, seq)
                 rk, _, rseq, rf = _recv_frame(s)
                 if rseq != seq:
                     if rk == wire.ERR and rseq == 0:
@@ -1080,12 +1741,33 @@ class PSClient:
                         f"stale reply on {ep}: seq {rseq} != {seq}")
                 break
             except (ConnectionError, socket.timeout, OSError,
-                    wire.WireError):
+                    wire.WireError) as e:
                 self._drop_sock(ep)
-                if attempt == self.MAX_RETRIES:
-                    raise
+                conn_failures += 1
+                if isinstance(e, (ConnectionRefusedError,
+                                  ConnectionResetError,
+                                  BrokenPipeError)):
+                    # pserver DOWNTIME (death, or supervised failover
+                    # mid-respawn): a fixed attempt count would give up
+                    # seconds into a restart that takes tens — retry
+                    # against a wall-clock budget instead
+                    now = time.monotonic()
+                    if refused_deadline is None:
+                        refused_deadline = (now
+                                            + self._reconnect_budget())
+                    if now >= refused_deadline:
+                        raise
+                else:
+                    attempts += 1
+                    if attempts > self.MAX_RETRIES:
+                        raise
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
+        if conn_failures:
+            # the call survived at least one dropped/refused
+            # connection — mutating frames stayed exactly-once via the
+            # server's (client_id, seq) dedup
+            _m_reconnects.inc()
         enforce(rk != wire.ERR, f"pserver {ep} error: "
                                 f"{rf[0] if rf else '?'}")
         if rk == wire.OK_ARR:
@@ -1136,6 +1818,14 @@ class PSClient:
 
     def list_vars(self, ep=None):
         return self._call(ep or self.endpoints[0], wire.LIST_VARS)
+
+    def server_info(self, ep=None):
+        """(incarnation, min dense round) of one pserver — the
+        failover probe, also sent automatically on every fresh
+        connection (see ``_note_incarnation``)."""
+        out = self._call(ep or self.endpoints[0], wire.SERVER_INFO)
+        vals = np.asarray(out).ravel()
+        return int(vals[0]), int(vals[1])
 
     def stop_servers(self):
         for ep in self.endpoints:
@@ -1219,9 +1909,92 @@ class Communicator:
         self._thread.join(timeout=10.0)
 
 
-def run_pserver(pserver_program):
+def _maybe_ps_exporter():
+    """A RankExporter for THIS pserver process when launched under a
+    supervisor (PT_PS_METRICS_DIR, set by launch_ps — deliberately
+    NOT PADDLE_HEARTBEAT_DIR, which the launcher reserves for
+    trainers so a role-shared script's ``from_env`` hookups can never
+    clobber a trainer's files): snapshots land at
+    ``rank<worker_num + index>.prom`` — offset past the trainer
+    ranks, because pservers share the trainer id numbering and
+    ``rank<i>.prom`` would collide with trainer i's. The launcher's
+    job aggregation reads every rank*.prom, so the pserver-side
+    snapshot metrics reach the job-level metrics.prom; the hang
+    watchdog only consults ranks < worker_num, so the offset files
+    never vouch for liveness."""
+    d = os.environ.get("PT_PS_METRICS_DIR")
+    if not d or os.environ.get("TRAINING_ROLE") != "PSERVER":
+        return None
+    try:
+        from paddle_tpu.distributed import health
+        from paddle_tpu.monitor.exporter import RankExporter
+        rank = (int(os.environ.get("PADDLE_TRAINERS_NUM", "0") or 0)
+                + int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0))
+        return RankExporter(health.metrics_path(d, rank),
+                            interval=1.0).start()
+    except Exception:
+        return None             # telemetry must not block serving
+
+
+def run_pserver(pserver_program, state_dir=None, snapshot_secs=None,
+                on_server=None):
     """Build + run a blocking ParameterServer from a transpiled
-    PServerProgram (the exe.run(pserver_prog) role in §3.3)."""
+    PServerProgram (the exe.run(pserver_prog) role in §3.3).
+
+    Failover wiring (docs/ELASTIC_TRAINING.md "Pserver failover"):
+    with ``state_dir`` (or ``PT_PS_SNAPSHOT_DIR``, exported by
+    ``launch_ps --ps_snapshot_secs``) the server WARM-BOOTS from its
+    newest integrity-verified snapshot generation before serving —
+    quarantining and walking back past corrupt ones — then keeps a
+    periodic background snapshot every ``snapshot_secs`` (or
+    ``PT_PS_SNAPSHOT_SECS``, default 5 s) plus a final flush on
+    graceful stop. ``on_server`` (if given) is called with the built
+    server after the warm boot, before serving — the hook chaos tests
+    use to install ``testing.faults.install_ps_faults``."""
     server = pserver_program.build_server()
-    server.run()
+    state_dir = state_dir or os.environ.get("PT_PS_SNAPSHOT_DIR") or None
+    exporter = _maybe_ps_exporter()
+    if state_dir:
+        try:
+            meta = server.load(state_dir)
+        except OSError as e:
+            # a transient I/O error that persisted through retries is
+            # NOT corruption (the PR-5 rule): serving initial values
+            # would silently discard training, so crash into the
+            # supervisor's restart budget and let the respawn retry
+            # the read
+            _ps_log(f"warm boot failed on an I/O error "
+                    f"({type(e).__name__}: {e}); exiting so the "
+                    f"supervisor's restart budget can retry the read "
+                    f"(a blip is not corruption)")
+            raise
+        except Exception as e:
+            _ps_log(f"warm boot failed ({type(e).__name__}: {e}); "
+                    f"starting from initial values")
+            meta = None
+        if meta is not None:
+            _ps_log(f"warm boot: restored pserver state generation "
+                    f"{meta.get('gen')} (written by incarnation "
+                    f"{meta.get('incarnation', 0):#x}) from "
+                    f"{state_dir}; now serving as incarnation "
+                    f"{server.incarnation:#x}")
+        else:
+            _ps_log(f"no restorable pserver snapshot in {state_dir}; "
+                    f"starting from initial values")
+        if snapshot_secs is None:
+            try:
+                snapshot_secs = float(
+                    os.environ.get("PT_PS_SNAPSHOT_SECS") or 5.0)
+            except ValueError:
+                snapshot_secs = 5.0
+        server.start_snapshots(state_dir, snapshot_secs)
+    if on_server is not None:
+        on_server(server)
+    try:
+        server.run()
+    finally:
+        if state_dir:
+            server.stop_snapshots(final_save=True)
+        if exporter is not None:
+            exporter.stop()
     return server

@@ -47,6 +47,11 @@ STOP = 8               # -
 SHRINK_TABLE = 9       # name, max_age u64
 SHUFFLE_PUSH = 10      # from_trainer u64, npz-packed sample blob arr
 SHUFFLE_DONE = 11      # from_trainer u64, sent-count u64
+SERVER_INFO = 12       # - (reply: i64 arr [incarnation, min dense round];
+                       #    the failover probe — a client reconnecting
+                       #    after a pserver restart reads the new
+                       #    incarnation token here and re-establishes its
+                       #    round expectations instead of deadlocking)
 # responses
 OK = 100               # -
 OK_ARR = 101           # arr
@@ -67,6 +72,7 @@ SCHEMAS = {
     SHRINK_TABLE: (STR, U64),
     SHUFFLE_PUSH: (U64, ARR),
     SHUFFLE_DONE: (U64, U64),
+    SERVER_INFO: (),
     OK: (),
     OK_ARR: (ARR,),
     OK_NAMES: (STR, STR),

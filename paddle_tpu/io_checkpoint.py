@@ -97,7 +97,7 @@ from paddle_tpu.static.serialize import tree_from_manifest, tree_manifest
 
 __all__ = ["CheckpointManager", "CheckpointCorruptError",
            "CheckpointTopologyError", "auto_checkpoint", "verify_shard",
-           "even_interval"]
+           "even_interval", "publish_npz", "verify_npz"]
 
 _log = logging.getLogger("paddle_tpu.checkpoint")
 
@@ -160,6 +160,11 @@ _m_reshard = _counter("reshard_restores_total",
 def _crc32(arr):
     """CRC32 of an array's canonical (C-contiguous) byte image."""
     a = np.ascontiguousarray(arr)
+    if a.size == 0:
+        # a zero-size array (e.g. an empty sparse-table snapshot)
+        # can't cast its memoryview (a 0 in the shape); its byte
+        # image is empty
+        return zlib.crc32(b"") & 0xFFFFFFFF
     return zlib.crc32(memoryview(a).cast("B")) & 0xFFFFFFFF
 
 
@@ -360,9 +365,20 @@ def verify_shard(path, verify=True, read_retries=2, retry_delay=0.05):
         raise bad(f"unreadable ({type(e).__name__}: {e})") from e
     if not verify:
         return manifest, arrays
+    _check_integrity(manifest, arrays, bad)
+    return manifest, arrays
+
+
+def _check_integrity(manifest, arrays, bad):
+    """The CRC/digest verification pass shared by ``verify_shard`` and
+    ``verify_npz``: per-array CRC32s, the sorted-entry-table digest
+    (missing/extra arrays), and the manifest-body CRC. ``bad(detail)``
+    builds the caller's exception (naming its own artifact kind + path).
+    A manifest without an ``integrity`` block (pre-integrity format)
+    passes vacuously — old artifacts stay restorable."""
     integ = manifest.get("integrity")
     if integ is None:           # pre-integrity format: nothing to check
-        return manifest, arrays
+        return
     paths = _key_paths(manifest)
 
     def name(key):
@@ -391,6 +407,105 @@ def verify_shard(path, verify=True, read_retries=2, retry_delay=0.05):
         raise bad(f"manifest crc32 {mcrc:#010x} != recorded "
                   f"{integ.get('manifest_crc32'):#010x} (tree "
                   f"structure or data_state bit-rotted)")
+
+
+def _publish_json_atomic(path, obj, prefix):
+    """fsync'd atomic JSON publish via an mkstemp temp in the target
+    directory (``prefix`` names the temp recognizably for the init
+    sweeps) — THE one home of the idiom, shared by
+    ``CheckpointManager._publish_json`` and the pserver snapshot
+    store's meta markers (``distributed/ps.py``): the temp-name
+    grammar the sweeps and fsck parse must not be able to drift
+    between the two writers."""
+    dirname = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".json.tmp",
+                               prefix=prefix)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f)
+            _fsync_file(f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def publish_npz(path, arrays, body=None):
+    """Publish ``arrays`` as an integrity-manifested npz at ``path``
+    ATOMICALLY: per-array CRC32 + sorted-entry digest embedded as a
+    ``__manifest__`` member (``body`` — a JSON-able dict — rides in the
+    manifest, covered by ``manifest_crc32``), written to an mkstemp
+    temp in the same directory, fsync'd, then ``os.replace``d into
+    place with a directory fsync. A crash at ANY point leaves either
+    the previous artifact or a recognizable ``.tmp.npz`` leftover —
+    never a half-written file under the published name. The pserver
+    checkpoint artifacts (``distributed/ps.py``) publish through here;
+    ``verify_npz`` is the reading side."""
+    dirname = os.path.dirname(path) or "."
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    body = dict(body or {})
+    manifest = dict(body, integrity=_integrity_block(body, arrays))
+    mblob = np.frombuffer(json.dumps(manifest).encode("utf-8"),
+                          dtype=np.uint8)
+    fd, tmp = tempfile.mkstemp(
+        dir=dirname, suffix=".tmp.npz",
+        prefix=f".{os.path.basename(path)}.")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __manifest__=mblob, **arrays)
+            _fsync_file(f)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(dirname)
+    return path
+
+
+def verify_npz(path, verify=True, read_retries=2, retry_delay=0.05):
+    """Read one ``publish_npz`` artifact, verifying its integrity
+    record. Returns ``(manifest, arrays)``; ``manifest`` is None for a
+    LEGACY artifact (a raw ``np.savez`` file with no ``__manifest__``
+    member — accepted structurally, restorable but not provable).
+    Raises ``CheckpointCorruptError`` on positive corruption evidence
+    (torn zip, CRC mismatch, missing/extra array, digest drift); a
+    transient ``OSError`` is retried and then re-raised unchanged —
+    the blip-is-not-corruption rule ``verify_shard`` follows. Shared
+    by the pserver warm-boot restore and ``tools/fsck_checkpoint``."""
+
+    def bad(detail):
+        _m_verify_fail.inc()
+        return CheckpointCorruptError(f"npz artifact {path}: {detail}")
+
+    def read():
+        with np.load(path, allow_pickle=False) as blob:
+            manifest = None
+            if "__manifest__" in blob.files:
+                manifest = json.loads(
+                    bytes(blob["__manifest__"].tobytes())
+                    .decode("utf-8"))
+            arrays = {k: blob[k] for k in blob.files
+                      if k != "__manifest__"}
+        return manifest, arrays
+
+    try:
+        manifest, arrays = _retry_transient(
+            read, f"npz artifact {path} read",
+            retries=read_retries, delay=retry_delay)
+    except (CheckpointCorruptError, OSError):
+        raise               # corruption verdict / transient I-O resp.
+    except Exception as e:  # zipfile.BadZipFile, EOFError,
+        # ValueError (torn npy header), UnicodeDecodeError/JSON
+        # errors — the file's CONTENT is wrong, not the disk
+        raise bad(f"unreadable ({type(e).__name__}: {e})") from e
+    if verify and manifest is not None:
+        _check_integrity(manifest, arrays, bad)
     return manifest, arrays
 
 
@@ -635,19 +750,7 @@ class CheckpointManager:
         """fsync'd atomic JSON publish via an mkstemp temp in the
         checkpoint dir (``prefix`` names the temp recognizably for the
         init sweep)."""
-        fd, tmp = tempfile.mkstemp(dir=self.dirname,
-                                   suffix=".json.tmp", prefix=prefix)
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(obj, f)
-                _fsync_file(f)
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
-            raise
+        _publish_json_atomic(path, obj, prefix)
 
     # -- policy ------------------------------------------------------------
     def should_save(self, step):

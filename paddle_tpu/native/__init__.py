@@ -197,6 +197,18 @@ def _bind(lib):
     lib.pt_pss_set_checkpoint_cb.argtypes = [c_void_p, PS_CKPT_CB]
     lib.pt_pss_possible_replays.restype = ctypes.c_uint64
     lib.pt_pss_possible_replays.argtypes = [c_void_p]
+    lib.pt_pss_set_incarnation.argtypes = [c_void_p, ctypes.c_uint64]
+    lib.pt_pss_dense_set_state.restype = c_int
+    lib.pt_pss_dense_set_state.argtypes = [c_void_p, c_char_p,
+                                           ctypes.c_uint64, c_long]
+    lib.pt_pss_dense_export.restype = c_int
+    lib.pt_pss_dense_export.argtypes = [
+        c_void_p, c_char_p, c_float_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(c_long),
+        c_float_p, c_float_p, c_float_p, ctypes.POINTER(c_int)]
+    lib.pt_pss_dense_set_slot.restype = c_int
+    lib.pt_pss_dense_set_slot.argtypes = [c_void_p, c_char_p, c_int,
+                                          c_float_p, c_long]
     lib.pt_ps_bench_push.restype = ctypes.c_double
     lib.pt_ps_bench_push.argtypes = [c_char_p, c_int, c_char_p, c_long,
                                      c_int]

@@ -76,7 +76,7 @@ constexpr uint8_t kVersion = 1;
 enum Kind : uint8_t {
   kPushGrad = 1, kPullParam = 2, kPullSparse = 3, kPushSparse = 4,
   kBarrier = 5, kCkptNotify = 6, kListVars = 7, kStop = 8, kShrink = 9,
-  kShufflePush = 10, kShuffleDone = 11,
+  kShufflePush = 10, kShuffleDone = 11, kServerInfo = 12,
   kOk = 100, kOkArr = 101, kOkNames = 102, kErr = 103,
 };
 constexpr size_t kHeaderSize = 28;  // 2s B B Q Q Q little-endian
@@ -84,7 +84,7 @@ enum Dt : uint8_t { kF32 = 1, kF64 = 2, kI32 = 3, kI64 = 4, kU8 = 5,
                     kBool = 6 };
 
 inline bool known_kind(uint8_t k) {
-  return (k >= 1 && k <= 11) || (k >= 100 && k <= 103);
+  return (k >= 1 && k <= 12) || (k >= 100 && k <= 103);
 }
 inline bool mutating_kind(uint8_t k) {  // wire.MUTATING
   return k == kPushGrad || k == kPushSparse || k == kCkptNotify ||
@@ -501,6 +501,11 @@ struct Server {
 
   void (*ckpt_cb)(const char*) = nullptr;
   std::string last_error;
+  // failover identity (ps.py parity): a fresh random token per server
+  // object; a client that reconnects and reads a DIFFERENT token knows
+  // the server restarted (warm-booted from its last snapshot) and
+  // re-establishes its round expectations instead of deadlocking
+  std::atomic<uint64_t> incarnation{0};
 
   ~Server() {
     stop();
@@ -700,6 +705,21 @@ struct Server {
         // LISTENER closes here, live connections drain as clients
         // close (ps.py parity)
         return make_ok(cid, seq);
+      }
+      case kServerInfo: {
+        r.done();
+        // [incarnation, min dense round] — the reconnect probe
+        // (ps.py ParameterServer._handle SERVER_INFO parity)
+        int64_t minr = -1;
+        for (auto& kv : dense) {
+          std::lock_guard<std::mutex> lk(kv.second->mu);
+          int64_t rd = static_cast<int64_t>(kv.second->round);
+          if (minr < 0 || rd < minr) minr = rd;
+        }
+        auto out = std::make_shared<std::vector<int64_t>>(2);
+        (*out)[0] = static_cast<int64_t>(incarnation.load());
+        (*out)[1] = minr < 0 ? 0 : minr;
+        return make_arr(cid, seq, kI64, {2}, out->data(), 16, out);
       }
       default:
         return make_err(cid, seq, "unhandled request kind " +
@@ -1161,6 +1181,79 @@ void pt_pss_set_checkpoint_cb(void* h, pt_pss_ckpt_cb_t cb) {
 
 uint64_t pt_pss_possible_replays(void* h) {
   return static_cast<psrv::Server*>(h)->possible_replays.load();
+}
+
+void pt_pss_set_incarnation(void* h, uint64_t v) {
+  static_cast<psrv::Server*>(h)->incarnation.store(v);
+}
+
+// ---- warm-boot state surface (snapshot/restore round + optimizer
+// slots from Python; the artifact contract lives in ps.py and is
+// shared with the Python transport) ----------------------------------
+int pt_pss_dense_set_state(void* h, const char* name, uint64_t round,
+                           long step) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->dense.find(name);
+  if (it == s->dense.end()) return -1;
+  {
+    std::lock_guard<std::mutex> lk(it->second->mu);
+    it->second->round = round;
+    it->second->step_count = step;
+  }
+  it->second->cv.notify_all();  // pullers waiting on a round re-check
+  return 0;
+}
+
+// One-lock export of a var's value + round/step + every materialized
+// slot: the snapshot's within-var consistency guarantee. Separate
+// getter calls (value, then state, then slots) could interleave with
+// an optimizer step and publish round R+1 stamped onto round-R
+// parameters — a lost update no staleness accounting would ever see.
+// `value`/`vslot`/`m1`/`m2` are caller-allocated n-element buffers;
+// `have` returns a bitmask of the slots actually copied (1=velocity,
+// 2=moment1, 4=moment2). Returns 0, or -1 on an unknown var.
+int pt_pss_dense_export(void* h, const char* name, float* value,
+                        uint64_t* round, long* step, float* vslot,
+                        float* m1, float* m2, int* have) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->dense.find(name);
+  if (it == s->dense.end()) return -1;
+  psrv::DenseVar& v = *it->second;
+  std::lock_guard<std::mutex> lk(v.mu);
+  std::memcpy(value, v.value->data(), v.n * 4);
+  *round = v.round;
+  *step = v.step_count;
+  *have = 0;
+  if (!v.vslot.empty()) {
+    std::memcpy(vslot, v.vslot.data(), v.n * 4);
+    *have |= 1;
+  }
+  if (!v.m1.empty()) {
+    std::memcpy(m1, v.m1.data(), v.n * 4);
+    *have |= 2;
+  }
+  if (!v.m2.empty()) {
+    std::memcpy(m2, v.m2.data(), v.n * 4);
+    *have |= 4;
+  }
+  return 0;
+}
+
+// which: 0=velocity (momentum), 1=moment1, 2=moment2 (adam) — the
+// Python-side slot names of ps.py's _DenseVar (export goes through
+// the one-lock pt_pss_dense_export above).
+int pt_pss_dense_set_slot(void* h, const char* name, int which,
+                          const float* in, long n) {
+  auto* s = static_cast<psrv::Server*>(h);
+  auto it = s->dense.find(name);
+  if (it == s->dense.end() || which < 0 || which > 2) return -1;
+  std::lock_guard<std::mutex> lk(it->second->mu);
+  if (n != it->second->n) return -1;
+  std::vector<float>& dst =
+      which == 0 ? it->second->vslot
+                 : (which == 1 ? it->second->m1 : it->second->m2);
+  dst.assign(in, in + n);
+  return 0;
 }
 
 // ---- bench-only loopback client -------------------------------------

@@ -85,6 +85,38 @@ boundary for free:
   post-rollback serving is provably unaffected.
   All three swap faults fire once per process (plus the once-dir
   marker across incarnations) and are scoped by ``PT_FAULT_RANK``.
+- ``PT_FAULT_PS_CRASH_AT_STEP=N`` — ``install_ps_faults(server)``
+  (called by a pserver worker script, e.g. via ``run_pserver``'s
+  ``on_server=`` hook): a watcher thread polls the server's applied
+  optimizer rounds and hard-exits with code 37
+  (``PS_CRASH_EXIT_CODE``) once they reach N — a pserver crash
+  mid-training. The supervisor (``launch_ps --ps_snapshot_secs``)
+  must respawn it at the same endpoint, the respawn must warm-boot
+  from the last-good snapshot, and the trainers' clients must
+  reconnect. Scoped by ``PT_FAULT_RANK`` (= the pserver index — the
+  launcher numbers pservers through PADDLE_TRAINER_ID too).
+- ``PT_FAULT_PS_AWAIT_SNAPS=K`` — before the pserver crash fires,
+  wait (bounded, ``PT_FAULT_CKPT_WAIT``) until the snapshot dir holds
+  K complete generations, so "the respawn restored state" assertions
+  never race the background snapshot thread.
+- ``PT_FAULT_PS_BITFLIP_SNAP=1`` — at pserver-crash time, STOP the
+  in-process snapshot thread (a generation it publishes between the
+  flip and ``os._exit`` would mask the corruption — the PR-5 writer-
+  freeze lesson) and flip one byte in the newest complete
+  generation's dense artifact before exiting: the respawned server
+  must quarantine it and walk back to the previous generation.
+  Implies awaiting 2 complete generations (a walk-back needs a
+  predecessor).
+- ``PT_FAULT_PS_DROP_EVERY=N`` / ``PT_FAULT_PS_DELAY_EVERY=K`` (+
+  ``PT_FAULT_PS_DELAY_MS=M``) — ``install_ps_wire_faults()``: wire-
+  level reply chaos on the PYTHON transport's reply hook
+  (``ps._reply_frame``, mirroring ``install_serving_faults``'s patch
+  idiom). Drop closes the connection with every Nth reply UNSENT —
+  the mutation is already applied and cached, so the client's retry
+  must be answered from the (client_id, seq) dedup cache, never
+  re-applied; delay holds every Kth reply M ms, past a short client
+  timeout. Continuous chaos (not fire-once): the exactly-once
+  contract must hold under sustained adversity.
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -93,12 +125,13 @@ boundary for free:
   crash-at-step fault would re-kill every restart and the job could
   never finish.
 
-Exit codes 23 (plain crash), 29 (checkpoint corruption + crash) and 31
-(elastic shrink — a rank departing for good) are deliberately distinct
-from each other and from the launcher's own codes (124 timeout, 143
-preemption) and the numerics trip (17) so tests can assert who died and
-why — and so the supervisor can tell "restart me" from "carry on
-without me".
+Exit codes 23 (plain crash), 29 (checkpoint corruption + crash), 31
+(elastic shrink — a rank departing for good) and 37 (pserver crash —
+the supervisor respawns it at the same endpoint) are deliberately
+distinct from each other and from the launcher's own codes (124
+timeout, 143 preemption) and the numerics trip (17) so tests can
+assert who died and why — and so the supervisor can tell "restart me"
+from "carry on without me" from "respawn my endpoint".
 """
 
 import os
@@ -107,9 +140,10 @@ import time
 
 __all__ = ["maybe_fault", "poison_feed", "install_slow_write",
            "install_serving_faults", "install_swap_faults",
+           "install_ps_faults", "install_ps_wire_faults",
            "corrupt_checkpoint", "corrupt_newest_checkpoint",
            "CRASH_EXIT_CODE", "CKPT_FAULT_EXIT_CODE",
-           "SHRINK_EXIT_CODE"]
+           "SHRINK_EXIT_CODE", "PS_CRASH_EXIT_CODE"]
 
 CRASH_EXIT_CODE = 23
 CKPT_FAULT_EXIT_CODE = 29
@@ -117,6 +151,10 @@ CKPT_FAULT_EXIT_CODE = 29
 #: stays importable without the launcher, and the pair is pinned by a
 #: tier-1 test instead)
 SHRINK_EXIT_CODE = 31
+#: pserver crash (install_ps_faults): distinct so the supervisor log
+#: names the cause and tests can assert WHICH process died; labeled in
+#: launch.EXIT_CODE_LABELS (pinned by a tier-1 test like SHRINK)
+PS_CRASH_EXIT_CODE = 37
 
 
 def _int_env(name):
@@ -688,6 +726,157 @@ def install_swap_faults():
         SwapController._gate = orig_gate
         SwapController._build_standby_pool = orig_build
         SwapController._cutover = orig_cutover
+
+    return uninstall
+
+
+def _await_ps_snapshots(server, snap_dir, k):
+    """Poll until the server's snapshot dir holds >= k complete
+    generations or PT_FAULT_CKPT_WAIT (default 30 s) elapses — a
+    pserver crash that fires before anything durable exists tests
+    start-from-scratch, not the warm-boot path the test meant to
+    exercise."""
+    from paddle_tpu.distributed.ps import _ps_complete_gens, _ps_tag
+    tag = _ps_tag(server.host, server.port)
+    timeout = float(os.environ.get("PT_FAULT_CKPT_WAIT") or 30.0)
+    deadline = time.monotonic() + timeout
+    while True:
+        gens = _ps_complete_gens(snap_dir, tag)
+        if len(gens) >= k or time.monotonic() >= deadline:
+            return gens
+        time.sleep(0.05)
+
+
+def _bitflip_newest_ps_snapshot(snap_dir, host, port):
+    """Flip one byte mid-file in the newest COMPLETE generation's
+    dense artifact; returns its path or None when no complete
+    generation exists. The caller must have stopped the snapshot
+    thread first (a healthy generation published after the flip would
+    mask the corruption — restore stops at the first verifying one)."""
+    from paddle_tpu.distributed.ps import (_ps_complete_gens,
+                                           _ps_dense_path, _ps_tag)
+    tag = _ps_tag(host, port)
+    gens = _ps_complete_gens(snap_dir, tag)
+    if not gens:
+        return None
+    path = _ps_dense_path(snap_dir, tag, gens[-1][0])
+    try:
+        corrupt_checkpoint(path, "bitflip")
+    except OSError:
+        return None
+    return path
+
+
+def install_ps_faults(server):
+    """If PT_FAULT_PS_CRASH_AT_STEP selects this pserver, start a
+    watcher thread that polls the server's applied optimizer rounds
+    (transport-agnostic: both the Python and the C++ server expose
+    per-var rounds through ``server.dense``) and hard-exits with
+    PS_CRASH_EXIT_CODE once they reach N — optionally after awaiting
+    durable snapshot generations and/or bitflipping the newest one
+    (PT_FAULT_PS_AWAIT_SNAPS / PT_FAULT_PS_BITFLIP_SNAP). Production
+    never imports this module: a pserver worker script opts in via
+    ``run_pserver(..., on_server=faults.install_ps_faults)``. Returns
+    True when the watcher was armed."""
+    at = _int_env("PT_FAULT_PS_CRASH_AT_STEP")
+    if at is None or not _applies_to_rank():
+        return False
+    if _already_fired("ps_crash"):
+        return False            # respawned incarnation runs clean
+    import threading
+
+    def rounds():
+        best = 0
+        for v in server.dense.values():
+            try:
+                best = max(best, int(v.round))
+            except Exception:
+                pass
+        return best
+
+    def watch():
+        while rounds() < at:
+            time.sleep(0.02)
+        if _already_fired("ps_crash"):
+            return
+        snap_dir = os.environ.get("PT_PS_SNAPSHOT_DIR")
+        bitflip = bool(os.environ.get("PT_FAULT_PS_BITFLIP_SNAP"))
+        k = _int_env("PT_FAULT_PS_AWAIT_SNAPS") or (2 if bitflip else 0)
+        if k and snap_dir:
+            _await_ps_snapshots(server, snap_dir, k)
+        if not _fire_once("ps_crash"):
+            return
+        hit = None
+        if bitflip and snap_dir:
+            # FREEZE the snapshot thread before corrupting: it shares
+            # this process, and a generation it publishes between the
+            # flip and os._exit would hand the warm boot a healthy
+            # newer generation, masking the corruption entirely (the
+            # PR-5 checkpoint-writer-freeze lesson)
+            try:
+                server.stop_snapshots(final_save=False)
+            except Exception:
+                pass
+            hit = _bitflip_newest_ps_snapshot(snap_dir, server.host,
+                                              server.port)
+        sys.stderr.write(
+            f"[faults] injected pserver crash at round {rounds()}"
+            + (f" after bitflipping {hit}" if hit else "")
+            + f"; exiting {PS_CRASH_EXIT_CODE}\n")
+        sys.stderr.flush()
+        os._exit(PS_CRASH_EXIT_CODE)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="pt-fault-ps-crash").start()
+    return True
+
+
+_PS_WIRE_ENVS = ("PT_FAULT_PS_DROP_EVERY", "PT_FAULT_PS_DELAY_EVERY")
+
+
+def install_ps_wire_faults():
+    """If any PS wire-chaos env is set, patch the Python transport's
+    server-side reply hook (``ps._reply_frame`` — ONLY the server
+    sends through it) with frame drop/delay chaos, mirroring
+    ``install_serving_faults``'s patch idiom. Dropping a reply closes
+    the connection AFTER the request was handled and its reply cached,
+    so the client's retried frame (same client_id+seq) must be
+    answered from the dedup cache — the exactly-once contract under
+    the nastiest wire conditions. Returns an uninstall callable when
+    installed, False otherwise. Python transport only: the C++
+    server's reply path never touches this hook (chaos tests pin
+    ``transport='python'``)."""
+    drop_every = _int_env("PT_FAULT_PS_DROP_EVERY")
+    delay_every = _int_env("PT_FAULT_PS_DELAY_EVERY")
+    if not drop_every and not delay_every:
+        return False
+    delay_ms = _int_env("PT_FAULT_PS_DELAY_MS") or 0
+    import threading
+
+    from paddle_tpu.distributed import ps as _ps
+    orig = _ps._reply_frame
+    lock = threading.Lock()
+    count = [0]
+
+    def chaos_reply(sock, kind, fields, client_id=0, seq=0):
+        with lock:
+            count[0] += 1
+            n = count[0]
+        if drop_every and n % drop_every == 0:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionError(
+                f"[faults] injected reply drop (server frame {n})")
+        if delay_every and n % delay_every == 0:
+            time.sleep(delay_ms / 1000.0)
+        return orig(sock, kind, fields, client_id, seq)
+
+    _ps._reply_frame = chaos_reply
+
+    def uninstall():
+        _ps._reply_frame = orig
 
     return uninstall
 

@@ -391,6 +391,72 @@ class TestLaunchPS:
         np.testing.assert_allclose(avg, local, rtol=1e-4)
 
 
+class TestWireChaosExactlyOnce:
+    """PSClient retry/dedup under adversarial wire conditions (the
+    PR-14 satellite pin): a chaos server that drops every Nth reply
+    frame (mutation APPLIED, reply unsent, connection closed) and
+    delays replies past the client's first timeout must still yield
+    exactly-once application of mutating frames — the retries are
+    answered from the server's (client_id, seq) dedup cache, the
+    ``possible_replays`` double-apply detector stays at 0, and no
+    "will be re-applied" warning is logged."""
+
+    def _run_chaos(self, monkeypatch, caplog, envs, pushes=10,
+                   timeout=2.0):
+        import logging
+
+        from paddle_tpu.testing import faults
+        for k, v in envs.items():
+            monkeypatch.setenv(k, v)
+        uninstall = faults.install_ps_wire_faults()
+        assert callable(uninstall)
+        s = ParameterServer("127.0.0.1:0", 1, True)
+        s.host_dense("w", np.ones(4, np.float32),
+                     pt.optimizer.SGDOptimizer(0.5))
+        s.start()
+        try:
+            c = PSClient([s.endpoint], {"w": s.endpoint},
+                         trainer_id=0, timeout=timeout)
+            g = np.full(4, 1.0, np.float32)
+            with caplog.at_level(logging.WARNING, "paddle_tpu.ps"):
+                for _ in range(pushes):
+                    c.push_grad("w", g)
+            # exactly-once: every push advanced the round exactly one
+            # step and the value moved by exactly lr*g per push
+            assert s.dense["w"].round == pushes
+            np.testing.assert_allclose(
+                np.asarray(c.pull_param("w", pushes)),
+                1.0 - 0.5 * pushes)
+            assert s.possible_replays == 0
+            assert "will be re-applied" not in caplog.text
+        finally:
+            s.stop()
+            uninstall()
+
+    def test_reply_drop_every_third_frame(self, monkeypatch, caplog):
+        self._run_chaos(monkeypatch, caplog,
+                        {"PT_FAULT_PS_DROP_EVERY": "3"})
+
+    def test_reply_delayed_past_client_timeout(self, monkeypatch,
+                                               caplog):
+        # every 3rd reply held 0.9 s against a 0.4 s client timeout:
+        # the first reply of an affected push times out, the retry hits
+        # the dedup cache (3 is coprime to the 2-frame reconnect
+        # cadence — probe + reply — so a retry eventually lands on an
+        # undelayed frame instead of starving forever)
+        self._run_chaos(monkeypatch, caplog,
+                        {"PT_FAULT_PS_DELAY_EVERY": "3",
+                         "PT_FAULT_PS_DELAY_MS": "900"},
+                        pushes=6, timeout=0.4)
+
+    def test_drop_and_delay_combined(self, monkeypatch, caplog):
+        self._run_chaos(monkeypatch, caplog,
+                        {"PT_FAULT_PS_DROP_EVERY": "4",
+                         "PT_FAULT_PS_DELAY_EVERY": "3",
+                         "PT_FAULT_PS_DELAY_MS": "700"},
+                        pushes=6, timeout=0.4)
+
+
 class TestFleetPSFacade:
     def test_fleet_run_server_and_worker_roundtrip(self):
         """fleet_base parity: run_server/stop_worker drive the same PS

@@ -139,9 +139,12 @@ class TestService:
             assert d == ["w"] and sp == ["emb"]
             cls[0].pull_sparse("emb", np.array([1], np.int64))
             cls[0].checkpoint_notify(str(tmp_path))
+            # generation-tagged artifact set (PR-14 contract): dense +
+            # per-table npz plus the meta marker that makes it complete
             tag = s.endpoint.replace(".", "_").replace(":", "_")
-            assert (tmp_path / f"pserver_{tag}.npz").exists()
-            assert (tmp_path / f"pserver_{tag}_emb.npz").exists()
+            assert (tmp_path / f"pserver_{tag}.gen0.npz").exists()
+            assert (tmp_path / f"pserver_{tag}_emb.gen0.npz").exists()
+            assert (tmp_path / f"pserver_{tag}.gen0.json").exists()
             # round-trip: restore into a fresh native server
             s2 = NativeParameterServer(f"{s.host}:{s.port}", 2, True)
             s2.host_dense("w", np.zeros(4, np.float32))

@@ -41,6 +41,17 @@ stray write temps (a killed writer's leftovers; the manager sweeps its
 own on init). Exit code 0 when every step is ok/legacy, 1 otherwise
 (incomplete counts: a step that cannot restore is a failure an
 operator should know about before they need it).
+
+Pserver snapshot dirs (``launch_ps --ps_snapshot_secs``'s
+``<log_dir>/ps_state``) are recognized too: every generation-tagged
+artifact set (``pserver_<endpoint>.gen<G>.npz`` + per-table npz +
+meta) gets the same per-generation ok/legacy/corrupt/unreadable/
+incomplete verdicts against the digests the warm boot
+(``distributed/ps.py _ps_checkpoint_load``) verifies, plus per-file
+verdicts for legacy un-generational ``pserver_*.npz`` artifacts.
+``--quarantine`` renames corrupt generations ``*.corrupt`` under the
+same transient-I/O-is-not-corruption rule (``unreadable`` is NEVER
+renamed).
 """
 
 import argparse
@@ -230,6 +241,179 @@ def restorable_at(rec, target_nproc):
         f"(written nproc={rec['nproc']}, no array_info)")
 
 
+def fsck_ps_dir(dirname):
+    """Verify every pserver snapshot generation under ``dirname``.
+
+    Returns ``(gens, extras)``: ``gens`` is a list of
+    ``{"endpoint", "gen", "status", "detail", "artifacts"}`` sorted by
+    (endpoint, gen) — one record per generation-tagged artifact set
+    (meta + dense npz + per-table npz), statuses mirroring
+    ``fsck_dir``'s (ok / legacy / corrupt / unreadable / incomplete) —
+    plus one ``gen=None`` record per legacy un-generational
+    ``pserver_*.npz`` artifact. ``extras`` is ``{"quarantined": [...],
+    "tmp": [...], "orphan_artifacts": [...]}`` (gen artifacts whose
+    meta never published — an interrupted snapshot, invisible to the
+    warm boot)."""
+    from paddle_tpu.distributed.ps import (
+        PS_GEN_ARTIFACT_RE, PS_GEN_META_RE, _ps_gen_files,
+    )
+    from paddle_tpu.io_checkpoint import (
+        CheckpointCorruptError, _retry_transient, _stat_exists,
+        verify_npz,
+    )
+    names = sorted(os.listdir(dirname))
+    extras = {"quarantined": [], "tmp": [], "orphan_artifacts": []}
+    metas = {}                   # (tag, gen) -> meta filename
+    gen_artifacts = set()        # gen-tagged npz filenames
+    legacy = []                  # plain pserver_*.npz
+    for f in names:
+        if f.endswith(".corrupt"):
+            if f.startswith("pserver_") or ".pserver_" in f:
+                extras["quarantined"].append(f)
+            continue
+        if f.startswith(".pserver_") and (f.endswith(".tmp.npz")
+                                          or f.endswith(".json.tmp")):
+            extras["tmp"].append(f)
+            continue
+        m = PS_GEN_META_RE.match(f)
+        if m:
+            metas[(m.group(1), int(m.group(2)))] = f
+            continue
+        m = PS_GEN_ARTIFACT_RE.match(f)
+        if m:
+            gen_artifacts.add(f)
+            continue
+        if f.startswith("pserver_") and f.endswith(".npz"):
+            legacy.append(f)
+
+    def verdict(rec, fname, path):
+        """One artifact's verdict folded into the record (the same
+        precedence fsck_dir uses: incomplete > corrupt > unreadable)."""
+        try:
+            present = _stat_exists(path)
+        except OSError as e:
+            rec["artifacts"][fname] = "unreadable"
+            if rec["status"] == "ok":
+                rec["status"] = "unreadable"
+                rec["detail"] = (f"I/O error probing {fname} "
+                                 f"({type(e).__name__}: {e}) — retry "
+                                 f"before trusting this verdict")
+            return
+        if not present:
+            rec["artifacts"][fname] = "missing"
+            rec["status"] = "incomplete"
+            rec["detail"] = (f"meta promises {fname} but it is "
+                             f"missing")
+            return
+        try:
+            manifest, arrays = verify_npz(path)
+        except CheckpointCorruptError as e:
+            rec["artifacts"][fname] = "corrupt"
+            if rec["status"] != "incomplete":
+                rec["status"] = "corrupt"
+                rec["detail"] = str(e)
+            return
+        except OSError as e:
+            rec["artifacts"][fname] = "unreadable"
+            if rec["status"] == "ok":
+                rec["status"] = "unreadable"
+                rec["detail"] = (f"I/O error reading {fname} "
+                                 f"({type(e).__name__}: {e}) — retry "
+                                 f"before trusting this verdict")
+            return
+        if manifest is None:
+            rec["artifacts"][fname] = "legacy"
+            rec.setdefault("_legacy", True)
+        else:
+            rec["artifacts"][fname] = (
+                f"ok ({len(arrays)} arrays, "
+                f"{sum(a.nbytes for a in arrays.values())} bytes)")
+
+    gens = []
+    promised = set()
+    for (tag, g) in sorted(metas):
+        rec = {"endpoint": tag, "gen": g, "status": "ok",
+               "detail": "", "artifacts": {}}
+        gens.append(rec)
+        # a generation WITH a meta is never "orphaned", even when the
+        # meta turns out corrupt/unreadable below — listing its (still
+        # healthy) artifacts under 'orphan_artifacts: meta never
+        # published' would contradict the generation's own verdict
+        gen_pat = re.compile(
+            rf"^pserver_{re.escape(tag)}(?:_.+)?\.gen{g}\.npz$")
+        promised.update(a for a in gen_artifacts if gen_pat.match(a))
+
+        def read_meta(fname=metas[(tag, g)]):
+            with open(os.path.join(dirname, fname)) as f:
+                return json.load(f)
+
+        try:
+            meta = _retry_transient(
+                read_meta, f"pserver meta {metas[(tag, g)]} read")
+            tables = list(meta.get("tables", []))
+        except (ValueError, TypeError) as e:
+            rec["status"] = "corrupt"
+            rec["detail"] = (f"meta {metas[(tag, g)]} unreadable "
+                             f"({type(e).__name__}: {e})")
+            continue
+        except OSError as e:
+            rec["status"] = "unreadable"
+            rec["detail"] = (f"I/O error reading meta "
+                             f"{metas[(tag, g)]} ({type(e).__name__}: "
+                             f"{e}) — retry before trusting this "
+                             f"verdict")
+            continue
+        for path in _ps_gen_files(dirname, tag, g, tables)[:-1]:
+            fname = os.path.basename(path)
+            promised.add(fname)
+            verdict(rec, fname, path)
+        if rec["status"] == "ok" and rec.pop("_legacy", False):
+            rec["status"] = "legacy"
+            rec["detail"] = ("predates the integrity format — "
+                            "restorable, digests not provable")
+        rec.pop("_legacy", None)
+    extras["orphan_artifacts"] = sorted(gen_artifacts - promised)
+
+    for f in legacy:
+        rec = {"endpoint": f[len("pserver_"):-len(".npz")],
+               "gen": None, "status": "ok", "detail": "",
+               "artifacts": {}}
+        verdict(rec, f, os.path.join(dirname, f))
+        if rec["status"] == "ok" and rec.pop("_legacy", False):
+            rec["status"] = "legacy"
+            rec["detail"] = ("legacy un-generational artifact — "
+                            "restorable, digests not provable")
+        rec.pop("_legacy", None)
+        gens.append(rec)
+    return gens, extras
+
+
+def quarantine_ps_gen(dirname, tag, gen):
+    """Rename one pserver snapshot generation's meta + artifacts
+    ``*.corrupt`` (what the warm-boot walk-back does on a
+    verification failure). ``gen=None`` quarantines a legacy
+    un-generational artifact (``tag`` is then its filename stem)."""
+    from paddle_tpu.distributed.ps import (PS_GEN_ARTIFACT_RE,
+                                           PS_GEN_META_RE)
+    renamed = []
+    for f in sorted(os.listdir(dirname)):
+        if gen is None:
+            if f != f"pserver_{tag}.npz":
+                continue
+        else:
+            m = PS_GEN_META_RE.match(f) or PS_GEN_ARTIFACT_RE.match(f)
+            if not m or int(m.group(2)) != gen:
+                continue
+            # the artifact grammar's tag group spans table suffixes
+            # (pserver_<tag>_<table>); prefix-match the endpoint tag
+            if not (m.group(1) == tag or m.group(1).startswith(tag + "_")):
+                continue
+        os.replace(os.path.join(dirname, f),
+                   os.path.join(dirname, f + ".corrupt"))
+        renamed.append(f + ".corrupt")
+    return renamed
+
+
 def quarantine_step(dirname, step):
     """Rename a step's meta + shards ``*.corrupt`` (what restore()'s
     walk-back does on a verification failure)."""
@@ -295,6 +479,37 @@ def main(argv=None):
             if args.quarantine and rec["status"] != "unreadable":
                 for r in quarantine_step(args.ckpt_dir, rec["step"]):
                     print(f"  quarantined -> {r}")
+    # pserver snapshot artifacts (launch_ps --ps_snapshot_secs state
+    # dirs) get the same treatment when present — counted separately:
+    # the step summary line must not report a pserver-artifact failure
+    # as a bad training-checkpoint step
+    ps_records, ps_extras, ps_bad = [], None, 0
+    if any(f.startswith("pserver_") or f.startswith(".pserver_")
+           for f in os.listdir(args.ckpt_dir)):
+        ps_records, ps_extras = fsck_ps_dir(args.ckpt_dir)
+    for rec in ps_records:
+        label = (f"pserver {rec['endpoint']} gen {rec['gen']}"
+                 if rec["gen"] is not None
+                 else f"pserver legacy artifact {rec['endpoint']}")
+        line = f"{label}: {rec['status']}"
+        if rec["detail"]:
+            line += f" — {rec['detail']}"
+        print(line)
+        for fname, st in sorted(rec["artifacts"].items()):
+            print(f"  {fname}: {st}")
+        if rec["status"] not in ("ok", "legacy"):
+            ps_bad += 1
+            # same rule as the step quarantine above: POSITIVE
+            # corruption evidence only — `unreadable` is never renamed
+            if args.quarantine and rec["status"] != "unreadable":
+                for r in quarantine_ps_gen(args.ckpt_dir,
+                                           rec["endpoint"],
+                                           rec["gen"]):
+                    print(f"  quarantined -> {r}")
+    if ps_extras:
+        for kind, files in sorted(ps_extras.items()):
+            for f in files:
+                print(f"{kind}: {f}")
     for kind, files in sorted(extras.items()):
         for f in files:
             print(f"{kind}: {f}")
@@ -302,6 +517,17 @@ def main(argv=None):
     print(f"# {len(steps)} step(s): {len(good)} restorable, {bad} bad; "
           f"newest restorable: "
           f"{good[-1]['step'] if good else 'NONE'}")
+    if ps_records:
+        ps_good = [r for r in ps_records
+                   if r["status"] in ("ok", "legacy")]
+        by_ep = {}
+        for r in ps_good:
+            if r["gen"] is not None:
+                by_ep.setdefault(r["endpoint"], []).append(r["gen"])
+        newest = {ep: max(gs) for ep, gs in by_ep.items()}
+        print(f"# pserver: {len(ps_records)} artifact set(s): "
+              f"{len(ps_good)} restorable, {ps_bad} bad; newest per "
+              f"endpoint: {newest if newest else 'NONE'}")
     if args.nproc is not None:
         print(f"# restorable at nproc={args.nproc}: "
               f"{len(fit_steps)} step(s); newest: "
@@ -323,7 +549,7 @@ def main(argv=None):
             return 1
         if not fit_steps:
             return 1
-    return 1 if bad else 0
+    return 1 if bad or ps_bad else 0
 
 
 if __name__ == "__main__":
