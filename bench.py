@@ -454,13 +454,169 @@ def bench_int8():
         report("bert_layer", mb, i_ms, b_ms, c1 or c2)
 
 
-def _freeze_serving_mlp(dirname):
+def _passes_trunk_program(hidden, seq, blocks):
+    """Static-graph BERT trunk for `bench.py passes` (the pass pipeline
+    operates on Programs; models/bert.py is functional): ``blocks``
+    post-LN transformer blocks of fc-projected attention + fc FFN —
+    mul+bias(+act) chains (FuseMatmulBiasActPass fodder, the
+    reference's fc_fuse_pass shape), the 1/sqrt(d) attention scale
+    (scale-chain family) and the k-transpose (transpose/reshape
+    family). Returns (main, startup, fetch_name)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [seq, hidden], dtype="float32")
+        for _ in range(blocks):
+            q = layers.fc(x, hidden, num_flatten_dims=2)
+            k = layers.fc(x, hidden, num_flatten_dims=2)
+            v = layers.fc(x, hidden, num_flatten_dims=2)
+            kt = layers.transpose(k, [0, 2, 1])
+            att = layers.matmul(q, kt)
+            att = layers.scale(att, scale=1.0 / np.sqrt(hidden))
+            att = layers.softmax(att)
+            ctx = layers.matmul(att, v)
+            o = layers.fc(ctx, hidden, num_flatten_dims=2)
+            x = layers.layer_norm(layers.elementwise_add(x, o),
+                                  begin_norm_axis=2)
+            h = layers.fc(x, 4 * hidden, act="relu",
+                          num_flatten_dims=2)
+            h = layers.fc(h, hidden, num_flatten_dims=2)
+            x = layers.layer_norm(layers.elementwise_add(x, h),
+                                  begin_norm_axis=2)
+        out = layers.mean(x)
+    return main, startup, out.name
+
+
+def _passes_mlp_program():
+    """The serving MLP (same shape as ``_freeze_serving_mlp``) as a
+    bare program, for the `bench.py passes` A/B."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [256], dtype="float32")
+        h = layers.fc(x, 256, act="relu")
+        h = layers.fc(h, 256, act="relu")
+        out = layers.fc(h, 10)
+        out = layers.mean(out)
+    return main, startup, out.name
+
+
+def bench_passes():
+    """`python bench.py passes` — the program-level pass pipeline's
+    on/off A/B (docs/PERFORMANCE.md "Program pass pipeline"): the SAME
+    program runs through the Executor twice, wrapped in
+    ``CompiledProgram``s whose ``BuildStrategy.apply_ir_passes`` pins
+    the pipeline on vs off (off = the bit-identical legacy lowering),
+    over the static BERT trunk and the serving MLP. Windows interleave
+    in ABBA quadruples (the shared ``_abba_overhead`` protocol) so both
+    sides of each ratio see the same slice of host drift; one JSON line
+    per model carries the step-time ratio, the per-pass ops-removed
+    evidence (``PipelineReport``; the live compile also lands
+    ``program_pass_*`` in the registry snapshot) and an
+    ``outputs_match`` fetch-equivalence check. Headline
+    ``passes_step_ratio`` is the WORST model ratio — the acceptance
+    bar is <= 1.0x (the pipeline must never cost a step). Knobs:
+    BENCH_PASSES_STEPS / BENCH_PASSES_PAIRS."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.compiler import BuildStrategy, CompiledProgram
+    from paddle_tpu.static import opt_passes
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    steps = int(os.environ.get("BENCH_PASSES_STEPS",
+                               "30" if on_tpu else "6"))
+    pairs = int(os.environ.get("BENCH_PASSES_PAIRS", "3"))
+    rng = np.random.RandomState(0)
+
+    models = []
+    main, startup, fetch = _passes_mlp_program()
+    models.append(("serving_mlp", main, startup,
+                   {"x": rng.rand(8, 256).astype(np.float32)}, fetch))
+    h, s, b = (256, 128, 4) if on_tpu else (32, 16, 2)
+    main, startup, fetch = _passes_trunk_program(h, s, b)
+    models.append(("bert_trunk", main, startup,
+                   {"x": rng.rand(8 if on_tpu else 2, s, h)
+                    .astype(np.float32)}, fetch))
+
+    worst = None
+    for tag, main, startup, feed, fetch in models:
+        scope = pt.static.Scope()
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            bs_on, bs_off = BuildStrategy(), BuildStrategy()
+            bs_on.apply_ir_passes = True
+            bs_off.apply_ir_passes = False
+            prog_on = CompiledProgram(main, build_strategy=bs_on)
+            prog_off = CompiledProgram(main, build_strategy=bs_off)
+
+            def run_once(prog, feed=feed, fetch=fetch, exe=exe):
+                return np.asarray(
+                    exe.run(prog, feed=feed, fetch_list=[fetch])[0])
+
+            out_on = run_once(prog_on)      # compiles each path once
+            out_off = run_once(prog_off)
+            outputs_match = bool(np.allclose(out_on, out_off,
+                                             rtol=1e-5, atol=1e-6))
+
+            def window(on, prog_on=prog_on, prog_off=prog_off,
+                       run_once=run_once):
+                prog = prog_on if on else prog_off
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    r = run_once(prog)
+                float(np.ravel(r)[0])
+                return (time.perf_counter() - t0) / steps * 1e3
+
+            window(True), window(False)     # settle both paths
+            est, pair_ratios, on_ms, off_ms = _abba_overhead(
+                window, pairs, bound=1.0)
+        # evidence from a metrics-silent re-run of the pipeline (the
+        # live compile above already published program_pass_* to the
+        # registry; this report is the per-model JSON the smoke reads)
+        _, report = opt_passes.optimize_program(
+            main, targets=(fetch,), record=False)
+        print(json.dumps({
+            "metric": f"passes_step_ratio_{tag}",
+            "value": round(est, 4), "unit": "x",
+            "on_ms_per_step": round(float(np.median(on_ms)), 3),
+            "off_ms_per_step": round(float(np.median(off_ms)), 3),
+            "pair_ratios": [round(r, 4) for r in pair_ratios],
+            "outputs_match": outputs_match,
+            "steps_per_window": steps,
+            **report.as_dict(),
+        }))
+        if worst is None or est > worst:
+            worst = est
+    print(json.dumps({
+        "metric": "passes_step_ratio",
+        "value": round(worst, 4), "unit": "x",
+        # bigger-is-better convention: legacy/optimized step speedup
+        "vs_baseline": round(1.0 / worst, 4),
+    }))
+
+
+def _freeze_serving_mlp(dirname, quant_dir=None, quant_mode="int8"):
     """The serving-bench model: a dispatch-bound MLP — online serving
     of small models is dominated by per-request dispatch overhead,
     exactly the cost continuous batching amortizes (a compute-bound
     model would measure the chip, not the serving stack). Shared by
     the headline A/B, the chaos bench, and the hot-swap bench (which
-    freezes a SECOND copy as the new version)."""
+    freezes a SECOND copy as the new version). ``quant_dir``
+    additionally freezes THE SAME weights there with an
+    ``export_aot(quantize=quant_mode)`` sidecar — the quantized side
+    of the BENCH_SERVING_QUANT A/B (same-weights is what makes its
+    accuracy delta meaningful)."""
     import paddle_tpu as pt
     from paddle_tpu import layers
     from paddle_tpu.framework import unique_name
@@ -478,6 +634,13 @@ def _freeze_serving_mlp(dirname):
         exe.run(startup)
         pt.io.save_inference_model(dirname, ["x"], [out], exe,
                                    main_program=main)
+        if quant_dir is not None:
+            from paddle_tpu import inference as inf
+            pt.io.save_inference_model(quant_dir, ["x"], [out], exe,
+                                       main_program=main)
+            inf.export_aot(quant_dir, main, ["x"], [out.name], scope,
+                           [{"x": ((1, 256), "float32")}],
+                           quantize=quant_mode)
     return dirname
 
 
@@ -606,6 +769,123 @@ def _bench_serving_swap(d, feed, max_batch, max_wait_ms):
     }))
 
 
+def _bench_serving_quant(max_batch, max_wait_ms):
+    """The quantized-serving half of `bench.py serving`
+    (BENCH_SERVING_QUANT=1, docs/SERVING.md "Quantized serving"):
+    fp32 vs weight-quantized serving of THE SAME weights under the
+    SAME open-loop Poisson schedule. Two ``InferenceServer``s boot
+    from two frozen dirs sharing one init (``_freeze_serving_mlp``'s
+    quant_dir); the quantized dir carries the
+    ``export_aot(quantize=...)`` sidecar the warm boot loads
+    transparently. JSON rows: per-system sustained QPS + p50/p99 +
+    device-resident param bytes (``ReplicaPool.resident_param_bytes``),
+    the QPS ratio (acceptance: >= 1.0x — weight-only PTQ must never
+    cost throughput), the resident-bytes ratio (acceptance: <= 0.55x
+    for int8) and the fixture accuracy delta (max |quant - fp| over
+    the fp output span on a 16-row fixture batch — the documented
+    accuracy evidence). Knobs: BENCH_SERVING_QUANT_REQS / _MODE,
+    BENCH_SERVING_REPLICAS / _RATE_X."""
+    import tempfile
+
+    from paddle_tpu.serving import InferenceServer, ServingConfig
+
+    mode = os.environ.get("BENCH_SERVING_QUANT_MODE", "int8")
+    n = int(os.environ.get("BENCH_SERVING_QUANT_REQS", "400"))
+    rate_x = float(os.environ.get("BENCH_SERVING_RATE_X", "3.0"))
+    replicas = int(os.environ.get("BENCH_SERVING_REPLICAS", "1"))
+
+    d_fp = tempfile.mkdtemp()
+    d_q = tempfile.mkdtemp()
+    _freeze_serving_mlp(d_fp, quant_dir=d_q, quant_mode=mode)
+    rng = np.random.RandomState(0)
+    feed = rng.rand(1, 256).astype(np.float32)
+    fixture = rng.rand(16, 256).astype(np.float32)
+
+    results = {}
+    sched = offered = None
+    for tag, d in (("fp", d_fp), ("quant", d_q)):
+        srv = InferenceServer(d, ServingConfig(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue=n + 64, replicas=replicas))
+        # fixture rides in bucket-ladder-sized chunks (a single
+        # 16-row request would overflow a small max_batch)
+        chunk = max(1, min(max_batch, len(fixture)))
+        fix_out = np.vstack([
+            np.asarray(srv.infer({"x": fixture[i:i + chunk]},
+                                 timeout=120)[0])
+            for i in range(0, len(fixture), chunk)])
+        t0 = time.perf_counter()
+        for _ in range(20):
+            srv.infer({"x": feed}, timeout=60)
+        svc_s = (time.perf_counter() - t0) / 20
+        if sched is None:
+            # ONE schedule, derived from the FP service rate, shared
+            # by both systems — equal offered load is literal
+            offered = rate_x * replicas / svc_s
+            sched = np.cumsum(np.random.RandomState(42).exponential(
+                1.0 / offered, size=n))
+        pend = [None] * n
+        arrived = [0.0] * n
+        t_origin = time.perf_counter()
+        for i in range(n):
+            dly = t_origin + sched[i] - time.perf_counter()
+            if dly > 0:
+                time.sleep(dly)
+            arrived[i] = t_origin + sched[i]
+            pend[i] = srv.submit({"x": feed})
+        for p in pend:
+            p.result(timeout=600)
+        done = [p.t_done for p in pend]
+        lat_ms = np.sort((np.asarray(done) - np.asarray(arrived))
+                         * 1e3)
+        qps = n / (max(done) - t_origin)
+        param_bytes = srv.pool.resident_param_bytes()
+        srv.close(timeout=60)
+        results[tag] = {"qps": qps, "bytes": param_bytes,
+                        "out": fix_out}
+        row = {
+            "metric": f"serving_{tag}_qps",
+            "value": round(qps, 1), "unit": "req/s",
+            "offered_qps": round(offered, 1), "n_requests": n,
+            "replicas": replicas,
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            "param_bytes": int(param_bytes),
+            "service_ms": round(svc_s * 1e3, 3),
+        }
+        if tag == "quant":
+            row["quantize"] = mode
+        print(json.dumps(row))
+
+    span = float(np.max(np.abs(results["fp"]["out"]))) + 1e-9
+    delta = float(np.max(np.abs(results["quant"]["out"]
+                                - results["fp"]["out"]))) / span
+    print(json.dumps({
+        "metric": "serving_quant_vs_fp_qps",
+        "value": round(results["quant"]["qps"]
+                       / results["fp"]["qps"], 3),
+        "unit": "x",
+        "vs_baseline": round(results["quant"]["qps"]
+                             / results["fp"]["qps"], 3),
+        "quantize": mode,
+    }))
+    print(json.dumps({
+        "metric": "serving_quant_param_bytes_ratio",
+        "value": round(results["quant"]["bytes"]
+                       / results["fp"]["bytes"], 4),
+        "unit": "x",
+        "fp_bytes": int(results["fp"]["bytes"]),
+        "quant_bytes": int(results["quant"]["bytes"]),
+    }))
+    print(json.dumps({
+        "metric": "serving_quant_accuracy_delta",
+        "value": round(delta, 6), "unit": "rel",
+        "fixture_rows": int(fixture.shape[0]),
+        "fp_output_span": round(span, 4),
+        "quantize": mode,
+    }))
+
+
 def bench_serving():
     """`python bench.py serving` — OPEN-LOOP serving load (the honest
     way to measure tail latency: arrivals follow a deterministic-seed
@@ -641,6 +921,12 @@ def bench_serving():
     (the controller's clean-path open-loop p50 cost via the shared
     ABBA protocol; must stay < 1.05x).
 
+    ``BENCH_SERVING_QUANT=1`` runs the QUANTIZED-SERVING A/B instead
+    (docs/SERVING.md "Quantized serving"): fp32 vs int8/bf16
+    weight-only serving of the same weights under one open-loop
+    schedule — sustained QPS, p99, device-resident param bytes and
+    the fixture accuracy delta (``_bench_serving_quant``).
+
     ``BENCH_SERVING_SWAP=1`` runs the HOT-SWAP bench instead
     (docs/SERVING.md "Hot model swap"): one open-loop schedule with a
     mid-run ``server.swap()`` to a second model version, emitting
@@ -668,13 +954,16 @@ def bench_serving():
     max_wait_ms = float(os.environ.get("BENCH_SERVING_MAX_WAIT_MS",
                                        "2.0"))
 
+    # branch BEFORE freezing the shared dir / warm-booting the
+    # baseline predictor: the quant A/B freezes its own same-weights
+    # pair, and neither chaos nor swap uses the predictor
+    if os.environ.get("BENCH_SERVING_QUANT") == "1":
+        return _bench_serving_quant(max_batch, max_wait_ms)
+
     d = _freeze_serving_mlp(tempfile.mkdtemp())
     rng = np.random.RandomState(0)
     feed = rng.rand(1, 256).astype(np.float32)
 
-    # branch BEFORE the baseline predictor warm-boot: neither the
-    # chaos nor the swap bench uses it, and its compile is seconds of
-    # dead work per invocation
     if os.environ.get("BENCH_SERVING_CHAOS") == "1":
         return _bench_serving_chaos(d, feed, max_batch, max_wait_ms)
     if os.environ.get("BENCH_SERVING_SWAP") == "1":
@@ -2011,6 +2300,8 @@ def _dispatch_mode():
         return bench_longcontext()
     if len(sys.argv) > 1 and sys.argv[1] == "int8":
         return bench_int8()
+    if len(sys.argv) > 1 and sys.argv[1] == "passes":
+        return bench_passes()
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         return bench_serving()
     if len(sys.argv) > 1 and sys.argv[1] == "numerics":

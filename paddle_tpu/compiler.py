@@ -59,6 +59,13 @@ class BuildStrategy:
     def __init__(self):
         self.reduce_strategy = ReduceStrategy.AllReduce
         self.gradient_scale_strategy = "CoeffNumDevice"
+        # program-level optimization pass pipeline
+        # (static/opt_passes.py): None = inherit FLAGS_apply_ir_passes
+        # (on by default); True/False pin it for THIS program —
+        # False is the bit-identical legacy lowering, the A/B lever
+        # `bench.py passes` measures against (docs/PERFORMANCE.md
+        # "Program pass pipeline")
+        self.apply_ir_passes = None
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.memory_optimize = None

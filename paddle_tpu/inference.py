@@ -30,7 +30,7 @@ from paddle_tpu.static import io as static_io
 
 __all__ = ["Config", "Predictor", "create_predictor", "ZeroCopyTensor",
            "export_aot", "verify_aot_dir", "read_aot_version",
-           "AOTIntegrityError"]
+           "load_quantized_params", "AOTIntegrityError"]
 
 AOT_DIR = "__aot__"
 AOT_INDEX = "index.json"
@@ -66,7 +66,11 @@ def _build_pure_fn(program, feed_names, fetch_names):
                     # is stateless: every AOT call draws step-0 keys.
                     key = jax.random.fold_in(
                         jax.random.PRNGKey(seed), 0)
-                k = jax.random.fold_in(key, i)
+                # an optimized program (opt_passes) pins each rng op's
+                # pre-pass index in _rng_idx so masks match the
+                # unoptimized lowering
+                k = jax.random.fold_in(
+                    key, op.attrs.get("_rng_idx", i))
             else:
                 k = None
             env.update(exec_op(op, env, k))
@@ -247,6 +251,74 @@ def verify_aot_dir(model_dir):
     return AOTVerifyResult(verified, _version_from_entries(entries))
 
 
+def load_quantized_params(model_dir):
+    """The quantized-serving sidecar of ``export_aot(quantize=...)``,
+    or None when the dir has no quantized export. Returns
+    ``{"mode", "weights", "values"}`` where ``values`` maps each
+    quantized weight (and its ``@quant_scale`` table for int8) to the
+    stored array. The sidecar's CRC is part of the integrity manifest —
+    run ``verify_aot_dir`` first (the serving boot/swap gate does);
+    this loader re-checks the file against the newest entry's record
+    so a direct caller can't load tampered scales either. The WEIGHT
+    LIST comes from the manifest, never re-derived — the loader applies
+    exactly what the exporter quantized (static/opt_passes.
+    apply_weight_quant refuses on mismatch)."""
+    index_path = os.path.join(model_dir or "", AOT_DIR, AOT_INDEX)
+    try:
+        with open(index_path) as f:
+            entries = json.load(f)
+    except (OSError, ValueError):
+        return None
+    # the NEWEST export overall decides, not the newest export that
+    # happens to carry a quant block: a later fp32 re-export under a
+    # different shape-bucket set leaves older entries in the index
+    # (key-based pruning), and serving its stale sidecar would
+    # silently overwrite the freshly loaded fp32 weights
+    best, best_ts = None, -1
+    for e in entries if isinstance(entries, list) else []:
+        if not isinstance(e, dict):
+            continue
+        v = e.get("model_version")
+        try:
+            ts = int(str(v).rsplit(".", 1)[1])
+        except (IndexError, ValueError, AttributeError):
+            ts = 0
+        if ts > best_ts or (
+                ts == best_ts
+                and isinstance(e.get("quant"), dict)
+                and not isinstance((best or {}).get("quant"), dict)):
+            best, best_ts = e, ts
+    if best is None or not isinstance(best.get("quant"), dict):
+        return None
+    q = best["quant"]
+    qpath = os.path.join(model_dir, AOT_DIR, q.get("file", ""))
+    rec = (best.get("integrity") or {}).get(q.get("file"))
+    if not rec:
+        # quant sidecars have carried integrity records since the
+        # feature shipped — an entry without one is a doctored index,
+        # not a legacy artifact; refusing beats loading unverifiable
+        # scale tables
+        raise AOTIntegrityError(
+            f"quantized sidecar {q.get('file')!r} has no integrity "
+            f"record in the AOT index; treating as tampered — re-run "
+            f"export_aot")
+    _verify_artifact(qpath, rec)
+    try:
+        with np.load(qpath) as z:
+            values = {k: z[k] for k in z.files}
+    except (OSError, ValueError) as e:
+        raise AOTIntegrityError(
+            f"quantized sidecar {qpath!r} is unreadable ({e}); "
+            f"re-run export_aot")
+    mode = q.get("mode")
+    weights = list(q.get("weights", []))
+    if mode == "bf16":
+        import jax.numpy as jnp
+        values = {k: (v.view(jnp.bfloat16) if k in weights else v)
+                  for k, v in values.items()}
+    return {"mode": mode, "weights": weights, "values": values}
+
+
 def read_aot_version(model_dir):
     """The manifest's ``model_version`` WITHOUT verifying artifact
     CRCs — a cheap index-only probe (one small JSON read) for the
@@ -263,7 +335,8 @@ def read_aot_version(model_dir):
 
 
 def export_aot(dirname, program, feed_names, fetch_names, scope,
-               shape_buckets, platforms=("cpu", "tpu")):
+               shape_buckets, platforms=("cpu", "tpu"), quantize=None,
+               apply_passes=None):
     """Compile the frozen program per shape bucket and serialize BOTH
     artifacts (the VERDICT-r1 'inference artifact export' gap; ref
     capability: inference/io.cc + analysis_predictor.h:46 serialize an
@@ -278,25 +351,99 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
     ``shape_buckets``: list of {feed name: (shape, dtype)} (or example
     arrays). ``platforms`` lowers the portable export for each named
     platform (default cpu+tpu) so the .shlo artifact really is
-    cross-platform. Returns the index entries."""
+    cross-platform. Returns the index entries.
+
+    ``apply_passes`` (default: ``FLAGS_apply_ir_passes``) runs the
+    program-level optimization pipeline (static/opt_passes.py) on a
+    clone of the frozen program before compiling.
+
+    ``quantize="int8"|"bf16"`` additionally performs weight-only
+    post-training quantization (docs/SERVING.md "Quantized serving"):
+    every eligible matmul weight is stored quantized (int8: per-output-
+    channel abs-max scales; bf16: storage cast) in a ``quant.<mode>.npz``
+    sidecar under ``__aot__`` — covered by the integrity manifest, so
+    a tampered scale table fails ``verify_aot_dir`` — and the dequant
+    is folded into the consuming matmul as one ``fused_matmul`` op.
+    The serving warm boot (``InferenceServer``/``swap``) loads such a
+    dir transparently with int8-resident params; the single-request
+    ``Predictor`` keeps using the fp32 params file."""
     import jax
     import jax.export  # not in the jax namespace by default on this pin
     from jax.experimental import serialize_executable as se
 
+    from paddle_tpu.core.flags import get_flag
+    from paddle_tpu.static import opt_passes as _opt
+
+    if apply_passes is None:
+        apply_passes = bool(get_flag("apply_ir_passes"))
+    # the deploy identity is the CALLER's program — the same graph
+    # save_inference_model wrote. The Predictor matches entries by the
+    # hash of the loaded __model__, which never sees the pass/quant
+    # rewrites below, so hashing the rewritten clone would orphan
+    # every entry into the silent retrace path.
+    prog_hash = _program_hash(program)
+    if apply_passes:
+        program = _opt.optimize_inference(program, fetch_names)
+    out_dir = os.path.join(dirname, AOT_DIR)
+    os.makedirs(out_dir, exist_ok=True)
+    overlay = {}
+    qmeta = None
+    if quantize is not None:
+        enforce(quantize in ("int8", "bf16"),
+                f"quantize must be 'int8' or 'bf16', got {quantize!r}")
+        blk = program.global_block()
+        values = {n: np.asarray(scope.find_var(n))
+                  for n, v in blk.vars.items()
+                  if getattr(v, "persistable", False)
+                  and scope.find_var(n) is not None}
+        plan = _opt.plan_weight_quant(program, values, quantize)
+        enforce(plan,
+                f"quantize={quantize!r}: no eligible weight found "
+                f"(2-D persistable float32 consumed only as a "
+                f"matmul/mul RHS in [in, out] layout)")
+        program = _opt.apply_weight_quant(program, plan, quantize)
+        overlay = _opt.quantize_weight_values(values, plan, quantize)
+        # per-export filename (the {h}.xla idiom): a FIXED name would
+        # let a later quantized re-export overwrite the file older
+        # surviving index entries still record CRCs for (npz bytes are
+        # not reproducible — zip headers embed mtimes), and
+        # verify_aot_dir would then refuse the whole dir after a
+        # legitimate export. Dropped entries' sidecars are unlinked by
+        # the live_files sweep below.
+        qfile = f"quant.{quantize}.{time.time_ns() // 1000}.npz"
+        qtmp = os.path.join(out_dir, f".{qfile}.{os.getpid()}.tmp")
+        with open(qtmp, "wb") as f:
+            # bf16 has no stable npz dtype (numpy reloads it as void):
+            # store the raw 16-bit lanes; the loader views them back
+            np.savez(f, **{
+                k: (np.asarray(v).view(np.uint16)
+                    if quantize == "bf16" and k in plan else v)
+                for k, v in overlay.items()})
+        os.replace(qtmp, os.path.join(out_dir, qfile))
+        qmeta = {
+            "mode": quantize, "file": qfile, "weights": sorted(plan),
+            # per-weight scale-table digests: the manifest names the
+            # exact scale bytes a loader must see (the file CRC in
+            # `integrity` is the enforcement; this is the evidence an
+            # operator can diff across exports)
+            "scales_sha256": {
+                w: hashlib.sha256(np.ascontiguousarray(
+                    overlay[w + _opt.QUANT_SCALE_SUFFIX])
+                    .tobytes()).hexdigest()[:16]
+                for w in plan} if quantize == "int8" else {},
+        }
+
     fn, state_names = _build_pure_fn(program, feed_names, fetch_names)
-    raw = [scope.find_var(n) for n in state_names]
+    raw = [overlay.get(n, scope.find_var(n)) for n in state_names]
     missing = [n for n, v in zip(state_names, raw) if v is None]
     enforce(not missing,
             f"scope missing persistables for AOT export: {missing[:5]}")
     params = tuple(np.asarray(v) for v in raw)
     param_sds = tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
                       for p in params)
-    out_dir = os.path.join(dirname, AOT_DIR)
-    os.makedirs(out_dir, exist_ok=True)
     jitted = jax.jit(fn)
     entries = []
     platform = jax.devices()[0].platform
-    prog_hash = _program_hash(program)
     # the deploy identity of THIS export (content hash + publish
     # timestamp), stamped on every entry — the serving hot-swap
     # gate/watcher reads the newest stamp back via
@@ -341,13 +488,18 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
         with open(os.path.join(out_dir, f"{h}.shlo"), "wb") as f:
             f.write(exported.serialize())
         entry["shlo"] = f"{h}.shlo"
+        if qmeta is not None:
+            entry["quant"] = qmeta
         # integrity manifest (the PR-5 checkpoint idiom, for opaque
         # artifact files): CRC32 + size per artifact, verified at
         # Predictor/server load so a torn export names its first bad
         # file instead of surfacing as a raw deserialization traceback
+        # — the quant sidecar (weights + scale tables) is covered too,
+        # so a quantized artifact is tamper-evident end to end
         entry["integrity"] = {
             name: _file_integrity(os.path.join(out_dir, name))
-            for name in (entry["xla"], entry["shlo"])}
+            for name in ([entry["xla"], entry["shlo"]]
+                         + ([qmeta["file"]] if qmeta else []))}
         entries.append(entry)
     index_path = os.path.join(out_dir, AOT_INDEX)
     existing = []
@@ -378,11 +530,28 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
             else:
                 dropped.append(e)
         existing = keep
+        # a dropped entry's quant sidecar is shared by every entry of
+        # its export — unlink only when no surviving entry references it
+        live_files = {n for e in keep + entries
+                      for n in (e.get("xla"), e.get("shlo"),
+                                (e.get("quant") or {}).get("file"))
+                      if n}
         for e in dropped:
+            # the sidecar is uniquely named per export, so a same-key
+            # re-export does NOT rewrite it in place the way {h}.xla /
+            # {h}.shlo are rewritten — the dropped entry's old sidecar
+            # must be swept here or a continuous-deploy loop leaks one
+            # full-weight npz per publish
+            old_q = (e.get("quant") or {}).get("file")
+            if old_q and old_q not in live_files:
+                try:
+                    os.unlink(os.path.join(out_dir, old_q))
+                except OSError:
+                    pass
             if e["key"] in new_keys:
                 continue   # same key: this export just rewrote the files
             for name in (e.get("xla"), e.get("shlo")):
-                if name:
+                if name and name not in live_files:
                     try:
                         os.unlink(os.path.join(out_dir, name))
                     except OSError:
@@ -553,16 +722,26 @@ class Predictor:
         aot_dir = os.path.join(self.config.model_dir, AOT_DIR)
         fn = None
         params = None
-        try:
-            # per-entry params (state_names may differ across entries);
-            # any failure — e.g. a stale entry naming a var the scope
-            # no longer holds — degrades to the retrace path
-            raw = [self._scope.find_var(n) for n in entry["state_names"]]
-            if not any(v is None for v in raw):
-                params = tuple(jax.device_put(np.asarray(v))
-                               for v in raw)
-        except Exception:
+        if entry.get("quant"):
+            # quantized entries expect int8/bf16 state this fp32
+            # Predictor doesn't hold (scale tables live in the sidecar;
+            # bf16 weights differ in dtype from the params file) — the
+            # single-request path serves fp32 via retrace; the
+            # integrity gate below still runs
             params = None
+        else:
+            try:
+                # per-entry params (state_names may differ across
+                # entries); any failure — e.g. a stale entry naming a
+                # var the scope no longer holds — degrades to the
+                # retrace path
+                raw = [self._scope.find_var(n)
+                       for n in entry["state_names"]]
+                if not any(v is None for v in raw):
+                    params = tuple(jax.device_put(np.asarray(v))
+                                   for v in raw)
+            except Exception:
+                params = None
         # integrity gate BEFORE any deserialization attempt: CRC/size
         # drift is positive corruption evidence and raises precisely
         # (AOTIntegrityError names the file) — it must NOT be swallowed

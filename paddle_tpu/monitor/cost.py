@@ -34,13 +34,13 @@ import os
 import re
 import threading
 
-from paddle_tpu.monitor.registry import gauge
+from paddle_tpu.monitor.registry import counter, gauge, histogram
 
 __all__ = [
     "analyze_lowered", "estimate_comm", "record_segment",
     "record_segment_comm", "segments", "flops_per_step",
     "bytes_per_step", "comm_bytes_per_step", "estimate_mfu",
-    "peak_flops", "reset",
+    "peak_flops", "record_pass", "pass_evidence", "reset",
 ]
 
 #: v5e bf16 peak, the chip this repo benches on (bench.py uses the same
@@ -64,6 +64,25 @@ _g_comm = gauge(
     "Estimated cross-device collective bytes per execution of each "
     "compiled device segment (result-buffer bytes of the collective "
     "ops in the post-SPMD optimized HLO)", labels=("segment",))
+
+# program-level pass pipeline evidence (static/opt_passes.py): one
+# record_pass call per pass application at step-compile / export time
+_c_pass_runs = counter(
+    "program_pass_runs_total",
+    "Applications of each program-level optimization pass "
+    "(static/opt_passes.py; one per pass per step compile/export)",
+    labels=("pass",))
+_c_pass_removed = counter(
+    "program_pass_ops_removed_total",
+    "Program ops removed (folded, fused away, or dead-eliminated) by "
+    "each optimization pass, summed over applications",
+    labels=("pass",))
+_h_pass_ms = histogram(
+    "program_pass_ms",
+    "Wall ms per optimization-pass application (program-level pass "
+    "pipeline ahead of segment compilation)")
+
+_pass_totals = {}               # pass name -> {"runs", "ops_removed"}
 
 # collective instructions in XLA's post-SPMD optimized HLO text; the
 # result type precedes the op name ("%x = f32[4,8]{1,0} all-reduce(…"
@@ -215,6 +234,30 @@ def comm_bytes_per_step():
     return _total("comm_bytes")
 
 
+def record_pass(name, ops_removed=0, ms=0.0):
+    """Publish one optimization-pass application (opt_passes drivers
+    call this): bumps the program_pass_* metrics and folds into the
+    in-process evidence table ``pass_evidence`` reports (the
+    ``bench.py passes`` per-pass JSON)."""
+    name = str(name)
+    _c_pass_runs.inc(**{"pass": name})
+    if ops_removed:
+        _c_pass_removed.inc(float(ops_removed), **{"pass": name})
+    _h_pass_ms.observe(float(ms))
+    with _lock:
+        t = _pass_totals.setdefault(name,
+                                    {"runs": 0, "ops_removed": 0})
+        t["runs"] += 1
+        t["ops_removed"] += int(ops_removed)
+
+
+def pass_evidence():
+    """{pass name: {"runs", "ops_removed"}} accumulated since process
+    start (or the last ``reset``)."""
+    with _lock:
+        return {k: dict(v) for k, v in _pass_totals.items()}
+
+
 def peak_flops():
     v = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
     try:
@@ -249,6 +292,7 @@ def reset():
     with _lock:
         _segments.clear()
         _latest_group = None
+        _pass_totals.clear()
     _g_flops.clear()
     _g_bytes.clear()
     _g_comm.clear()

@@ -72,6 +72,11 @@ _m_respawns = counter(
     "Replica worker threads respawned by the pool supervisor after a "
     "stall or thread death (against the already-compiled executable "
     "map — a respawn never recompiles)")
+_m_param_bytes = gauge(
+    "serving_param_bytes",
+    "Device-resident model-parameter bytes per replica device of the "
+    "LIVE pool (weight-quantized serving shrinks this ~4x for int8, "
+    "2x for bf16 — docs/SERVING.md \"Quantized serving\")")
 
 #: batch-queue sentinel, one per live replica at shutdown
 _STOP = object()
@@ -88,6 +93,7 @@ def zero_pool_gauges():
     already be demoted (role-gated zeroing skips it), so the server
     re-asserts gauge truth itself."""
     _m_replicas.set(0)
+    _m_param_bytes.set(0)
     for s in (_UP, _QUARANTINED, _RETIRED):
         _m_state.set(0, state=s)
 
@@ -295,6 +301,12 @@ class ReplicaPool:
             # replica, shallow enough that batches don't age in queue
             queue_depth = max(2 * n_replicas, 2)
         self.batch_queue = queue.Queue(maxsize=queue_depth)
+        #: bytes of ONE device's resident param copy — int8/bf16
+        #: quantized bundles land here ~4x/2x smaller than fp32, the
+        #: replicas-per-device headroom the quantized export buys
+        #: (bench.py serving BENCH_SERVING_QUANT A/B reads this)
+        self._param_bytes = int(sum(np.asarray(p).nbytes
+                                    for p in params_np))
         jitted = jax.jit(pure_fn)
         self._by_device = {}        # device -> (params, {bucket: exe})
         for dev in {devices[i % len(devices)]: None
@@ -370,6 +382,7 @@ class ReplicaPool:
         # the supervisor owns gauge truth: serving_replicas is the
         # count actually draining the queue, not the count booted
         _m_replicas.set(counts[_UP])
+        _m_param_bytes.set(self._param_bytes)
 
     def promote(self):
         """Standby -> live at hot-swap cutover: take gauge ownership
@@ -562,6 +575,12 @@ class ReplicaPool:
                 "dispatched (hot-swap drain completed); the batch was "
                 "failed without dispatch — the request is safe to "
                 "retry")
+
+    def resident_param_bytes(self):
+        """Bytes of one device-resident param copy (every replica
+        device holds one) — the quantized-serving A/B's memory
+        evidence."""
+        return self._param_bytes
 
     def executables(self, device=None):
         """{bucket: executable} for ``device`` (default: first replica's

@@ -144,10 +144,11 @@ class _ModelBundle:
 
     __slots__ = ("model_dir", "program", "feed_names", "fetch_names",
                  "sample_specs", "pure_fn", "params_np", "version",
-                 "scope")
+                 "scope", "quantized")
 
     def __init__(self, model_dir, program, feed_names, fetch_names,
-                 sample_specs, pure_fn, params_np, version, scope):
+                 sample_specs, pure_fn, params_np, version, scope,
+                 quantized=None):
         self.model_dir = model_dir
         self.program = program
         self.feed_names = feed_names
@@ -157,6 +158,9 @@ class _ModelBundle:
         self.params_np = params_np
         self.version = version
         self.scope = scope
+        #: "int8"/"bf16" when the dir carries a quantized export the
+        #: bundle loaded (docs/SERVING.md "Quantized serving"), else None
+        self.quantized = quantized
 
 
 def _load_bundle(model_dir, feed_specs=None, verify=True):
@@ -184,6 +188,28 @@ def _load_bundle(model_dir, feed_specs=None, verify=True):
     feed_names = list(feed_names)
     fetch_names = list(fetch_names)
     sample_specs = _infer_sample_specs(prog, feed_names, feed_specs)
+    # program-level pass pipeline on the served graph (same lever as
+    # the executor's compile path; sample_specs read the feed var
+    # declarations above, which passes never touch)
+    from paddle_tpu.core.flags import get_flag
+    if bool(get_flag("apply_ir_passes")):
+        from paddle_tpu.static import opt_passes as _opt
+        prog = _opt.optimize_inference(prog, fetch_names)
+    # quantized export sidecar (export_aot(quantize=...)): rewrite the
+    # served program per the manifest's weight list and make the
+    # QUANTIZED arrays the resident params — the whole point of
+    # weight-only PTQ for serving (int8: ~4x smaller resident params,
+    # more replicas per device). Transparent: the swap gate/canary and
+    # warm boot run the same path.
+    quant = inf.load_quantized_params(model_dir)
+    if quant is not None:
+        from paddle_tpu.static import opt_passes as _opt
+        prog = _opt.apply_weight_quant(prog, quant["weights"],
+                                       quant["mode"])
+        for n, v in quant["values"].items():
+            scope.set_var(n, v)
+        _log(f"loaded {quant['mode']} weight-quantized params for "
+             f"{len(quant['weights'])} weight(s) from {model_dir}")
     pure_fn, state_names = inf._build_pure_fn(prog, feed_names,
                                               fetch_names)
     raw = [scope.find_var(n) for n in state_names]
@@ -193,7 +219,8 @@ def _load_bundle(model_dir, feed_specs=None, verify=True):
     params_np = [np.asarray(v) for v in raw]
     return _ModelBundle(model_dir, prog, feed_names, fetch_names,
                         sample_specs, pure_fn, params_np, version,
-                        scope)
+                        scope,
+                        quantized=quant["mode"] if quant else None)
 
 
 def _check_fetch_contract(bundle, ladder):

@@ -311,6 +311,12 @@ class SwapController:
                 "model_version": bundle.version,
                 "previous_version": old_version,
                 "model_dir": model_dir,
+                # "int8"/"bf16" when the new version is a quantized
+                # export (fp->quant and quant->fp swaps are ordinary
+                # swaps; the gate/canary already ran the quantized
+                # graph) — an operator reading the report can tell a
+                # PTQ deploy from a retrain
+                "quantized": bundle.quantized,
                 "stage_ms": stage_ms}
 
     # -- stage 1: gate -----------------------------------------------------

@@ -27,6 +27,10 @@ from paddle_tpu.static.debugger import pprint_program, draw_graph, memory_usage
 # registers the while_block/scan_block computes in OP_REGISTRY — a
 # deserialized program must execute without the builder APIs having run
 import paddle_tpu.static.nested  # noqa: F401
+# registers the fused_matmul compute — a program optimized/quantized in
+# another process (AOT export, quantized serving) must execute without
+# the pass pipeline having run here
+import paddle_tpu.static.opt_passes  # noqa: F401
 from paddle_tpu.static.backward import append_backward, gradients
 from paddle_tpu.static.io import (
     save_inference_model, load_inference_model, save_params,

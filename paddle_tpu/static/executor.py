@@ -73,6 +73,13 @@ define_flag("monitor_cost", True,
             "Record per-compiled-segment FLOPs/bytes (XLA cost "
             "analysis) into the metrics registry on first execution "
             "(0 = skip the one-time extra lowering)")
+define_flag("apply_ir_passes", True,
+            "Run the program-level optimization pass pipeline "
+            "(static/opt_passes.py: constant folding, matmul+bias+act "
+            "fusion, transpose/reshape cancellation, dead-op "
+            "elimination) before compiling each step; "
+            "BuildStrategy.apply_ir_passes overrides per program "
+            "(0 = bit-identical legacy lowering)")
 
 # unified telemetry (monitor/registry.py): the hot-loop counters every
 # layer above reads — catalogued in docs/OBSERVABILITY.md
@@ -355,6 +362,9 @@ def exec_op(op, env, key):
     ins = {slot: [env[n] for n in names]
            for slot, names in op.inputs.items()}
     attrs = dict(op.attrs)
+    # pass-pipeline bookkeeping (opt_passes._stamp_rng_indices), not a
+    # compute kwarg — consumed by the caller's key derivation
+    attrs.pop("_rng_idx", None)
     if attrs.pop("_needs_rng", False):
         attrs["rng"] = key
     outs = fn(ins, attrs)
@@ -642,7 +652,24 @@ class Executor:
         return k
 
     @staticmethod
-    def _dispatch_sig(program, spec, feeds, fetch_names, scope):
+    def _passes_enabled(compiled):
+        """Effective apply_ir_passes setting for one run: the wrapped
+        program's ``BuildStrategy.apply_ir_passes`` when explicitly
+        set, else ``FLAGS_apply_ir_passes`` (on by default). Off means
+        the bit-identical legacy lowering — the A/B lever
+        ``bench.py passes`` measures against."""
+        on = bool(get_flag("apply_ir_passes"))
+        if compiled is not None:
+            bs = compiled.__dict__.get("_build_strategy")
+            knob = getattr(bs, "apply_ir_passes", None) \
+                if bs is not None else None
+            if knob is not None:
+                on = bool(knob)
+        return on
+
+    @staticmethod
+    def _dispatch_sig(program, spec, feeds, fetch_names, scope,
+                      apply_passes):
         """Prepared-runner cache key. The PROGRAM OBJECT itself (not
         id()) rides in the key: the dict entry then keeps it alive, so
         a dead program's id can never be recycled into a silent stale
@@ -651,11 +678,14 @@ class Executor:
         way — identity-hashed and kept alive by the entry. The scope is
         keyed by id() only — a recycled scope id is caught at use time
         by _PreparedRunner.fresh_for's weakref identity check, NOT by
-        this key. feeds values may be arrays or ShapeDtypeStructs."""
+        this key. ``apply_passes`` rides in the key so flipping the
+        pass pipeline mid-process (the bench A/B) can never serve a
+        step compiled under the other setting. feeds values may be
+        arrays or ShapeDtypeStructs."""
         return (program, program.version, spec,
                 tuple(sorted((k, tuple(v.shape), str(v.dtype))
                              for k, v in feeds.items())),
-                tuple(fetch_names), id(scope))
+                tuple(fetch_names), id(scope), bool(apply_passes))
 
     def _store_runner(self, dsig, runner):
         # dead-scope eviction: a scope-per-request caller would
@@ -687,7 +717,10 @@ class Executor:
         from paddle_tpu.compiler import CompiledProgram
         if isinstance(program, CompiledProgram):
             spec = program._spec
+            apply_passes = self._passes_enabled(program)
             program = program._program
+        else:
+            apply_passes = self._passes_enabled(None)
         feed = feed or {}
         fetch_list = fetch_list or []
         fetch_names = [f if isinstance(f, str) else f.name
@@ -747,18 +780,19 @@ class Executor:
             with RecordEvent("executor.run/prepare"):
                 feeds = {k: _as_feed_array(v) for k, v in feed.items()}
                 dsig = self._dispatch_sig(program, spec, feeds,
-                                          fetch_names, scope)
+                                          fetch_names, scope,
+                                          apply_passes)
                 fast = bool(get_flag("executor_fast_path"))
                 runner = self._runners.get(dsig) if fast else None
                 if runner is None or not runner.fresh_for(scope):
                     runner = self._prepare_runner(program, feeds, fetch_names,
-                                                  scope, spec)
+                                                  scope, spec, apply_passes)
                     if fast:
                         self._store_runner(dsig, runner)
                 state = self._gather_state(runner, scope)
                 if state is None:             # scope changed under us
                     runner = self._prepare_runner(program, feeds, fetch_names,
-                                                  scope, spec)
+                                                  scope, spec, apply_passes)
                     if fast:
                         self._store_runner(dsig, runner)
                     state = self._gather_state(runner, scope)
@@ -896,7 +930,10 @@ class Executor:
         from paddle_tpu.compiler import CompiledProgram
         if isinstance(program, CompiledProgram):
             sspec = program._spec
+            apply_passes = self._passes_enabled(program)
             program = program._program
+        else:
+            apply_passes = self._passes_enabled(None)
         feed = feed or {}
         fetch_list = fetch_list or []
         fetch_names = [f if isinstance(f, str) else f.name
@@ -906,10 +943,10 @@ class Executor:
                              else np.asarray(v))
                  for k, v in feed.items()}
         runner = self._prepare_runner(program, specs, fetch_names, scope,
-                                      sspec)
+                                      sspec, apply_passes)
         if bool(get_flag("executor_fast_path")):
             dsig = self._dispatch_sig(program, sspec, specs,
-                                      fetch_names, scope)
+                                      fetch_names, scope, apply_passes)
             self._store_runner(dsig, runner)
         state = {}
         for n in runner.state_names:
@@ -1012,7 +1049,8 @@ class Executor:
         return _staged(put)
 
     # -- internals ---------------------------------------------------------
-    def _prepare_runner(self, program, feeds, fetch_names, scope, spec):
+    def _prepare_runner(self, program, feeds, fetch_names, scope, spec,
+                        apply_passes=False):
         """The one-time (per feed-signature) preparation the legacy path
         performed every step: state-name/host-out scans, the
         initialization check, and the compiled-step lookup."""
@@ -1066,11 +1104,13 @@ class Executor:
         sig = (program, program.version, spec,
                tuple(sorted((k, tuple(v.shape), str(v.dtype))
                             for k, v in feeds.items())),
-               tuple(fetch_names), tuple(sorted(state_names)))
+               tuple(fetch_names), tuple(sorted(state_names)),
+               bool(apply_passes))
         step = self._cache.get(sig)
         if step is None:
             step = self._compile(program, sorted(state_names),
-                                 sorted(feeds), fetch_names, spec)
+                                 sorted(feeds), fetch_names, spec,
+                                 apply_passes=apply_passes)
             self._cache[sig] = step
         return _PreparedRunner(step, state_names, host_outs, scope, rep,
                                ndev, watch_idx=watch_idx, spec=spec,
@@ -1214,7 +1254,7 @@ class Executor:
         return exec_op(op, env, key)
 
     def _compile(self, program, state_names, feed_names, fetch_names,
-                 spec=None):
+                 spec=None, apply_passes=False):
         """Partition the block into maximal device runs, each jitted as
         ONE XLA computation (the whole block, in the common case), with
         host segments (attrs['_host']: RPC send/recv, py_func-style
@@ -1227,6 +1267,16 @@ class Executor:
         ops*, so a transpiler that brackets a program with host ops
         leaves the original ops' randomness (dropout masks…) unchanged
         — transpiled runs remain bit-comparable to local runs."""
+        if apply_passes:
+            # program-level pass pipeline (static/opt_passes.py): runs
+            # on a CLONE against this step's actual fetch list, so the
+            # caller's program object — and the apply_ir_passes=False
+            # legacy lowering — stay bit-identical. Per-pass evidence
+            # lands in monitor/cost.py (program_pass_* metrics). Rng
+            # ops carry _rng_idx stamps, so optimization never shifts
+            # a dropout mask.
+            from paddle_tpu.static import opt_passes as _opt
+            program = _opt.optimize_for_execution(program, fetch_names)
         blk = program.global_block()
         ops = list(blk.ops)
         constants = dict(getattr(program, "_constants", {}))
@@ -1325,7 +1375,14 @@ class Executor:
                 if ops[k].attrs.get("_needs_rng"):
                     if key is None:
                         key = jax.random.fold_in(base_key, step_idx)
-                    op_key = jax.random.fold_in(key, k - hosts_before[k])
+                    # _rng_idx (stamped by the pass pipeline before any
+                    # op moved) pins the fold index an optimized op had
+                    # in the ORIGINAL program — masks stay bit-identical
+                    # to the unoptimized lowering
+                    idx = ops[k].attrs.get("_rng_idx")
+                    if idx is None:
+                        idx = k - hosts_before[k]
+                    op_key = jax.random.fold_in(key, idx)
                 else:
                     op_key = None
                 env.update(self._exec_op(ops[k], env, op_key))

@@ -1,0 +1,905 @@
+"""Program-level optimization pass library + the default pipeline.
+
+Parity: the reference's ``framework/ir`` layer — ~70 registered graph
+passes (fusion: fc_fuse_pass/conv_bn_fuse_pass, constant folding:
+constant_folding_pass, pruning: graph_to_program_pass + prune.cc,
+layout: transpose_flatten_concat_fuse_pass) applied by ParallelExecutor
+and the inference AnalysisPredictor before execution (PAPER.md layer
+map). Here the Program IS the IR (static/passes.py), so each pass is a
+``ProgramPass`` over the op list, orchestrated by ``PassManager`` and
+run by the Executor's compile path / ``export_aot`` behind
+``BuildStrategy.apply_ir_passes`` / ``FLAGS_apply_ir_passes``
+(docs/PERFORMANCE.md "Program pass pipeline").
+
+Design constraints every pass obeys:
+
+- **Never mutate the caller's program.** The drivers
+  (``optimize_for_execution`` / ``optimize_inference``) clone first;
+  the original object stays bit-identical for the
+  ``apply_ir_passes=False`` A/B path.
+- **RNG stability.** Removing/fusing ops shifts op indices, and the
+  executor folds each rng op's key by its index — so the drivers stamp
+  every ``_needs_rng`` op with ``_rng_idx`` (its pre-pass net index)
+  and the executor/pure-fn honor it. Optimized and legacy programs
+  draw IDENTICAL dropout masks (the equivalence fuzz pins exactness
+  through rng ops).
+- **Conservatism beats coverage.** A rewrite fires only when the
+  matched vars are written once, the cancelled intermediates have no
+  other consumer and are neither fetched nor persistable, and the
+  chain doesn't cross a host-op/autodiff barrier. Anything uncertain
+  is left alone — a skipped fusion costs nothing (XLA fuses anyway);
+  a wrong one is a miscompile.
+
+Evidence: every pass application publishes
+``program_pass_runs_total{pass}`` / ``program_pass_ops_removed_total``
+/ ``program_pass_ms`` through ``monitor/cost.py`` (``record_pass``),
+and ``tools/dump_program.py --diff-passes`` prints the per-pass op
+diff for triaging a miscompile to the guilty pass.
+
+The weight-only PTQ half (``plan_weight_quant`` / ``apply_weight_quant``
+/ ``quantize_weight_values``) serves ``export_aot(quantize=)`` and the
+serving warm boot: per-channel abs-max int8 (or bf16 storage), with the
+dequant folded into the consuming matmul as ONE ``fused_matmul`` op so
+XLA sees convert+scale+dot as a single fusion (docs/SERVING.md
+"Quantized serving").
+"""
+
+import time
+
+import numpy as np
+
+from paddle_tpu.core.enforce import EnforceNotMet, enforce
+from paddle_tpu.static.passes import PassManager, ProgramPass
+from paddle_tpu.static.program import register_op
+
+__all__ = [
+    "ConstantFoldingPass", "FoldScaleCastChainPass",
+    "CancelTransposeReshapePass", "FuseMatmulBiasActPass",
+    "DeadOpEliminationPass", "default_pipeline", "optimize_program",
+    "optimize_for_execution", "optimize_inference", "PipelineReport",
+    "FUSED_MATMUL", "QUANT_SCALE_SUFFIX", "QUANT_BINS",
+    "plan_weight_quant", "apply_weight_quant", "quantize_weight_values",
+]
+
+#: the fused matmul(+dequant)(+bias)(+act) op the fusion and quant
+#: passes emit — semantics are BY CONSTRUCTION the composition of the
+#: registered float ops it replaces (the compute calls them in
+#: sequence), so fused == unfused bit-for-bit on the same backend
+FUSED_MATMUL = "fused_matmul"
+#: per-channel scale table var name: ``<weight>@quant_scale``
+QUANT_SCALE_SUFFIX = "@quant_scale"
+#: int8 bins (ops/quantize._bin_cnt(8)): q = round(w / scale * 127)
+QUANT_BINS = 127
+
+#: ops kept regardless of reachability (observable side effects that
+#: don't ride the _host attr)
+_SIDE_EFFECT_TYPES = frozenset({
+    "print", "py_func", "save_combine", "load_combine",
+    "ps_send", "ps_recv",
+})
+
+#: activations the matmul fusion absorbs (attr-free unary ops)
+_FUSABLE_ACTS = frozenset({"relu", "sigmoid", "tanh", "gelu"})
+
+_MATMUL_TYPES = ("mul", "matmul")
+
+
+# ---------------------------------------------------------------------------
+# fused op compute
+# ---------------------------------------------------------------------------
+def _fused_matmul_compute(ins, attrs):
+    """x @ dequant(w) (+ bias) (+ act): the exact composition of the
+    registered float ops (ops/math.mul|matmul, elementwise_add,
+    activation) — XLA fuses convert/scale/dot/add/act into one kernel
+    (the MXU path), the program sees ONE op."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import math as _m
+
+    xs = list(ins["X"])
+    x, w = xs[0], xs[1]
+    i = 2
+    quant = attrs.get("quant")
+    if quant == "int8":
+        scale = xs[i]
+        i += 1
+        # weight-only dequant: per-output-channel abs-max scale over
+        # the LAST axis of the [in, out] weight (broadcasts [out])
+        w = w.astype(jnp.float32) * (scale / float(QUANT_BINS))
+    elif quant == "bf16":
+        w = w.astype(jnp.float32)
+    out = getattr(_m, attrs["mm_type"])(x, w, **attrs.get("mm_attrs", {}))
+    if attrs.get("has_bias"):
+        out = _m.elementwise_add(out, xs[i],
+                                 axis=attrs.get("bias_axis", -1))
+        i += 1
+    act = attrs.get("act")
+    if act:
+        from paddle_tpu import ops as _ops
+        out = getattr(_ops, act)(out)
+    return {"Out": [out]}
+
+
+register_op(FUSED_MATMUL, _fused_matmul_compute)
+
+
+# ---------------------------------------------------------------------------
+# shared analysis helpers
+# ---------------------------------------------------------------------------
+def _block(program):
+    return program.global_block()
+
+
+def _write_counts(block):
+    c = {}
+    for op in block.ops:
+        for n in op.output_names():
+            c[n] = c.get(n, 0) + 1
+    return c
+
+
+def _consumer_map(block):
+    out = {}
+    for i, op in enumerate(block.ops):
+        for n in set(op.input_names()):
+            out.setdefault(n, []).append((i, op))
+    return out
+
+
+def _write_indices(block):
+    """{name: [op indices that write it]}. Multi-write names are legal
+    in this IR (optimizer ops write params in place via ``ParamOut``),
+    so a rewrite that moves a READ of ``name`` across one of these
+    indices — or points a reader past one at ``name`` directly — would
+    observe the re-written value instead of the snapshot the
+    eliminated op held. Every rewire/move guards with
+    ``_written_between``."""
+    w = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_names():
+            w.setdefault(n, []).append(i)
+    return w
+
+
+def _written_between(widx, name, lo, hi):
+    """True when ``name`` is written by an op with index in (lo, hi] —
+    the unsafe interval for moving a read of ``name`` from ``lo`` to
+    ``hi`` (or for redirecting a reader at ``hi`` to ``name`` as of
+    ``lo``)."""
+    return any(lo < k <= hi for k in widx.get(name, ()))
+
+
+def _regions(ops):
+    """Region id per op index: host ops and the autodiff marker are
+    barriers (fusing across one would move computation between device
+    segments or in/out of the differentiated prefix)."""
+    rid, out = 0, []
+    for op in ops:
+        barrier = op.type == "autodiff" or bool(op.attrs.get("_host"))
+        if barrier:
+            rid += 1
+        out.append(rid)
+        if barrier:
+            rid += 1
+    return out
+
+
+def _has_program_attr(op):
+    """Control-flow ops carry sub-Programs in attrs (static/nested.py);
+    their captures ride the input list, so reachability is sound, but
+    value-rewrites must treat them as opaque."""
+    from paddle_tpu.static.program import Program
+    return any(isinstance(v, Program) for v in op.attrs.values())
+
+
+def _protected_names(block, targets):
+    """Vars no rewrite may erase or retype: fetch targets, persistable
+    state, feed (is_data) vars."""
+    prot = set(targets)
+    for n, v in block.vars.items():
+        if getattr(v, "persistable", False) or getattr(v, "is_data",
+                                                       False):
+            prot.add(n)
+    return prot
+
+
+def _rewire(block, old, new, skip_ops=()):
+    """Point every reader of var ``old`` at ``new``."""
+    for op in block.ops:
+        if op in skip_ops:
+            continue
+        for slot, names in op.inputs.items():
+            if old in names:
+                op.inputs[slot] = [new if n == old else n for n in names]
+
+
+def _single_consumer(cons_map, name, wcounts):
+    """The one (index, op) consuming ``name``, or None if the var is
+    multi-consumer, multi-writer, or unconsumed."""
+    if wcounts.get(name, 0) != 1:
+        return None
+    cs = cons_map.get(name, [])
+    if len(cs) != 1:
+        return None
+    i, op = cs[0]
+    # an op reading the var in two slots counts once in the map; check
+    # it reads it exactly once overall so rewires stay unambiguous
+    if op.input_names().count(name) != 1:
+        return None
+    return cs[0]
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+class ConstantFoldingPass(ProgramPass):
+    """Evaluate ops whose inputs are all compile-time constants
+    (``program._constants`` literals, or earlier folds) and record the
+    result as a new constant (constant_folding_pass parity). Skips rng/
+    host/side-effect/control-flow ops, persistable outputs, and results
+    over ``max_elements`` (folding a giant fill into a materialized
+    host array would trade compile-time work for trace memory)."""
+
+    name = "constant_fold"
+
+    def __init__(self, targets=(), max_elements=1 << 22):
+        # targets accepted for pipeline-constructor uniformity but not
+        # consulted: folding a FETCHED op is safe because fetch reads
+        # the execution env, which is seeded from program._constants
+        # (executor._compile / inference._build_pure_fn; pinned by
+        # test_fetched_constant_output_still_fetchable)
+        self.targets = set(targets)
+        self.max_elements = int(max_elements)
+
+    def apply(self, program):
+        import jax.numpy as jnp
+
+        from paddle_tpu.static.executor import exec_op
+
+        blk = _block(program)
+        consts = dict(getattr(program, "_constants", {}))
+        wcounts = _write_counts(blk)
+        kept = []
+        for op in blk.ops:
+            if (op.type == "autodiff" or op.attrs.get("_host")
+                    or op.attrs.get("_needs_rng")
+                    or op.type in _SIDE_EFFECT_TYPES
+                    or _has_program_attr(op)):
+                kept.append(op)
+                continue
+            ins = op.input_names()
+            outs = op.output_names()
+            if not outs or not all(n in consts for n in ins):
+                kept.append(op)
+                continue
+            if any(wcounts.get(n, 0) != 1 for n in outs):
+                kept.append(op)       # re-written name: order matters
+                continue
+            if any(blk.has_var(n)
+                   and getattr(blk.vars[n], "persistable", False)
+                   for n in outs):
+                kept.append(op)       # state writes are never folded
+                continue
+            try:
+                bound = exec_op(op, consts, None)
+            except Exception:
+                kept.append(op)       # not evaluable eagerly: leave it
+                continue
+            if sum(int(np.size(v)) for v in bound.values()) \
+                    > self.max_elements:
+                kept.append(op)
+                continue
+            for n, v in bound.items():
+                consts[n] = jnp.asarray(v)
+        if len(kept) != len(blk.ops):
+            blk.ops = kept
+            program._constants = consts
+            program._bump()
+        return program
+
+
+class FoldScaleCastChainPass(ProgramPass):
+    """scale→scale chains compose into one scale op; identity scales
+    (x*1+0) and identity casts (target dtype == input var dtype) are
+    dropped with their readers rewired."""
+
+    name = "fold_scale_cast"
+
+    def __init__(self, targets=()):
+        self.targets = set(targets)
+
+    @staticmethod
+    def _affine(attrs):
+        """(a, c) with y = a*x + c for one scale op."""
+        s = float(attrs.get("scale", 1.0))
+        b = float(attrs.get("bias", 0.0))
+        if attrs.get("bias_after_scale", True):
+            return s, b
+        return s, b * s
+
+    def apply(self, program):
+        blk = _block(program)
+        prot = _protected_names(blk, self.targets)
+        changed = True
+        while changed:
+            changed = False
+            wcounts = _write_counts(blk)
+            cons = _consumer_map(blk)
+            widx = _write_indices(blk)
+            drop = set()
+
+            def last_read(name, at):
+                return max((k for k, _ in cons.get(name, ())),
+                           default=at)
+
+            for i, op in enumerate(blk.ops):
+                if id(op) in drop:
+                    continue
+                if op.type == "scale":
+                    src = op.inputs["X"][0]
+                    out = op.outputs["Out"][0]
+                    nxt = _single_consumer(cons, out, wcounts)
+                    if (nxt is not None and nxt[1].type == "scale"
+                            and out not in prot
+                            and id(nxt[1]) not in drop
+                            and not _written_between(widx, src, i,
+                                                     nxt[0])):
+                        a1, c1 = self._affine(op.attrs)
+                        a2, c2 = self._affine(nxt[1].attrs)
+                        nxt[1].inputs["X"] = list(op.inputs["X"])
+                        nxt[1].attrs = {"scale": a1 * a2,
+                                        "bias": c1 * a2 + c2,
+                                        "bias_after_scale": True}
+                        drop.add(id(op))
+                        changed = True
+                        continue
+                    a, c = self._affine(op.attrs)
+                    if a == 1.0 and c == 0.0 and out not in prot \
+                            and wcounts.get(out, 0) == 1 \
+                            and not _written_between(
+                                widx, src, i, last_read(out, i)):
+                        _rewire(blk, out, src, skip_ops=(op,))
+                        drop.add(id(op))
+                        changed = True
+                elif op.type == "cast":
+                    src = op.inputs["X"][0]
+                    out = op.outputs["Out"][0]
+                    v = blk.vars.get(src)
+                    if v is None or v.dtype is None or out in prot \
+                            or wcounts.get(out, 0) != 1 \
+                            or _written_between(widx, src, i,
+                                                last_read(out, i)):
+                        continue
+                    from paddle_tpu.core.dtypes import convert_dtype
+                    try:
+                        same = convert_dtype(
+                            op.attrs.get("dtype")) == v.dtype
+                    except Exception:
+                        continue
+                    if same:
+                        _rewire(blk, out, src, skip_ops=(op,))
+                        drop.add(id(op))
+                        changed = True
+            if drop:
+                blk.ops = [o for o in blk.ops if id(o) not in drop]
+                program._bump()
+        return program
+
+
+class CancelTransposeReshapePass(ProgramPass):
+    """transpose∘transpose == identity and reshape∘reshape chains
+    cancel/collapse; identity transposes (perm == iota) and identity
+    reshapes (static target shape == static input shape) drop
+    (transpose_flatten_concat_fuse_pass family, reduced to the
+    provably-safe cases)."""
+
+    name = "cancel_transpose_reshape"
+
+    def __init__(self, targets=()):
+        self.targets = set(targets)
+
+    def apply(self, program):
+        blk = _block(program)
+        prot = _protected_names(blk, self.targets)
+        changed = True
+        while changed:
+            changed = False
+            wcounts = _write_counts(blk)
+            cons = _consumer_map(blk)
+            widx = _write_indices(blk)
+            drop = set()
+
+            def last_read(name, at):
+                return max((k for k, _ in cons.get(name, ())),
+                           default=at)
+
+            for i, op in enumerate(blk.ops):
+                if id(op) in drop:
+                    continue
+                if op.type == "transpose":
+                    src = op.inputs["X"][0]
+                    out = op.outputs["Out"][0]
+                    perm = [int(p) for p in op.attrs.get("perm", [])]
+                    if out in prot or wcounts.get(out, 0) != 1:
+                        continue
+                    if perm == list(range(len(perm))):
+                        if _written_between(widx, src, i,
+                                            last_read(out, i)):
+                            continue
+                        _rewire(blk, out, src, skip_ops=(op,))
+                        drop.add(id(op))
+                        changed = True
+                        continue
+                    nxt = _single_consumer(cons, out, wcounts)
+                    if nxt is None or nxt[1].type != "transpose" \
+                            or id(nxt[1]) in drop:
+                        continue
+                    perm2 = [int(p)
+                             for p in nxt[1].attrs.get("perm", [])]
+                    out2 = nxt[1].outputs["Out"][0]
+                    if len(perm2) != len(perm) or out2 in prot \
+                            or wcounts.get(out2, 0) != 1:
+                        continue
+                    composed = [perm[p] for p in perm2]
+                    if composed == list(range(len(perm))):
+                        # both cancel: readers of out2 read src
+                        if _written_between(widx, src, i,
+                                            last_read(out2, nxt[0])):
+                            continue
+                        _rewire(blk, out2, src, skip_ops=(op, nxt[1]))
+                        drop.add(id(op))
+                        drop.add(id(nxt[1]))
+                    else:
+                        # collapse into one transpose at the second
+                        # op's position (which now reads src there)
+                        if _written_between(widx, src, i, nxt[0]):
+                            continue
+                        nxt[1].inputs["X"] = [src]
+                        nxt[1].attrs = dict(nxt[1].attrs)
+                        nxt[1].attrs["perm"] = composed
+                        drop.add(id(op))
+                    changed = True
+                elif op.type == "reshape":
+                    src = op.inputs["X"][0]
+                    out = op.outputs["Out"][0]
+                    if out in prot or wcounts.get(out, 0) != 1:
+                        continue
+                    v_in = blk.vars.get(src)
+                    shape = [int(s) for s in op.attrs.get("shape", [])]
+                    if (v_in is not None and v_in.shape is not None
+                            and all(d not in (-1, None)
+                                    for d in v_in.shape)
+                            and shape == [int(d) for d in v_in.shape]):
+                        # identity reshape (fully static both sides)
+                        if _written_between(widx, src, i,
+                                            last_read(out, i)):
+                            continue
+                        _rewire(blk, out, src, skip_ops=(op,))
+                        drop.add(id(op))
+                        changed = True
+                        continue
+                    nxt = _single_consumer(cons, out, wcounts)
+                    if nxt is None or nxt[1].type != "reshape" \
+                            or id(nxt[1]) in drop \
+                            or _written_between(widx, src, i, nxt[0]):
+                        continue
+                    shape2 = nxt[1].attrs.get("shape", [])
+                    # a 0 entry copies the INPUT dim at that position
+                    # — collapsing would re-anchor it on a different
+                    # input, so only -1/positive target shapes collapse
+                    if any(int(s) == 0 for s in shape2):
+                        continue
+                    nxt[1].inputs["X"] = [src]
+                    drop.add(id(op))
+                    changed = True
+            if drop:
+                blk.ops = [o for o in blk.ops if id(o) not in drop]
+                program._bump()
+        return program
+
+
+class FuseMatmulBiasActPass(ProgramPass):
+    """mul|matmul → elementwise_add(bias) → [relu|sigmoid|tanh|gelu]
+    chains (the ``layers.fc`` emission, fc_fuse_pass parity) collapse
+    into ONE ``fused_matmul`` op. Fires only when the intermediates
+    are single-writer/single-consumer, unprotected, and the whole
+    chain sits in one host/autodiff region."""
+
+    name = "fuse_matmul_bias_act"
+
+    def __init__(self, targets=()):
+        self.targets = set(targets)
+
+    def apply(self, program):
+        blk = _block(program)
+        prot = _protected_names(blk, self.targets)
+        wcounts = _write_counts(blk)
+        cons = _consumer_map(blk)
+        widx = _write_indices(blk)
+        regions = _regions(blk.ops)
+        region_of = {id(op): regions[i] for i, op in enumerate(blk.ops)}
+        index_of = {id(op): i for i, op in enumerate(blk.ops)}
+        used = set()
+        plans = []          # (member op ids, fused Operator, anchor id)
+        for i, op in enumerate(blk.ops):
+            if op.type not in _MATMUL_TYPES or id(op) in used:
+                continue
+            xs = op.inputs.get("X", [])
+            if len(xs) != 2:
+                continue
+            mm_out = op.outputs["Out"][0]
+            if mm_out in prot:
+                continue
+            nxt = _single_consumer(cons, mm_out, wcounts)
+            if nxt is None or nxt[1].type != "elementwise_add" \
+                    or id(nxt[1]) in used \
+                    or region_of[id(nxt[1])] != regions[i]:
+                continue
+            j, add = nxt
+            add_xs = add.inputs.get("X", [])
+            # the matmul out must be the LEFT operand: axis-aligned
+            # broadcast is defined on (big, small) operand order
+            if len(add_xs) != 2 or add_xs[0] != mm_out \
+                    or add_xs[1] == mm_out:
+                continue
+            add_out = add.outputs["Out"][0]
+            members = [op, add]
+            act = None
+            anchor = add
+            if add_out not in prot:
+                nxt2 = _single_consumer(cons, add_out, wcounts)
+                if nxt2 is not None and nxt2[1].type in _FUSABLE_ACTS \
+                        and id(nxt2[1]) not in used \
+                        and region_of[id(nxt2[1])] == regions[i] \
+                        and not _attrs_nontrivial(nxt2[1]):
+                    act = nxt2[1].type
+                    anchor = nxt2[1]
+                    members.append(nxt2[1])
+            # the fused op reads the matmul operands and the bias at
+            # the ANCHOR's (later) position — refuse if any is
+            # re-written in the moved interval (in-place updates, e.g.
+            # optimizer ParamOut, are legal in this IR; writes AFTER
+            # the anchor are fine, the read still precedes them)
+            anchor_idx = index_of[id(anchor)]
+            if any(_written_between(widx, n, i, anchor_idx)
+                   for n in xs) \
+                    or _written_between(widx, add_xs[1], j,
+                                        anchor_idx):
+                continue
+            from paddle_tpu.static.program import Operator
+            mm_attrs = {k: v for k, v in op.attrs.items()
+                        if k != "name" and v is not None}
+            fused = Operator(
+                blk, FUSED_MATMUL,
+                inputs={"X": [xs[0], xs[1], add_xs[1]]},
+                outputs={"Out": [anchor.outputs["Out"][0]]},
+                attrs={"mm_type": op.type, "mm_attrs": mm_attrs,
+                       "has_bias": True,
+                       "bias_axis": add.attrs.get("axis", -1),
+                       **({"act": act} if act else {})})
+            used.update(id(m) for m in members)
+            plans.append((set(id(m) for m in members), fused,
+                          id(anchor)))
+        if not plans:
+            return program
+        member_ids = set()
+        fused_at = {}
+        for ids, fused, anchor_id in plans:
+            member_ids |= ids
+            fused_at[anchor_id] = fused
+        new_ops = []
+        for op in blk.ops:
+            if id(op) in fused_at:
+                new_ops.append(fused_at[id(op)])
+            elif id(op) not in member_ids:
+                new_ops.append(op)
+        blk.ops = new_ops
+        program._bump()
+        return program
+
+
+def _attrs_nontrivial(op):
+    """True when an activation op carries attrs beyond cosmetic
+    defaults — such an op must not be absorbed into a fusion that
+    replays it attr-free."""
+    for k, v in op.attrs.items():
+        if k in ("name",) or v is None:
+            continue
+        return True
+    return False
+
+
+class DeadOpEliminationPass(ProgramPass):
+    """Drop ops whose outputs reach neither a fetch target, persistable
+    state, a host/side-effect op, nor the autodiff marker — the
+    backward_slice reachability core (prune.cc / dead-fetch
+    elimination), applied at compile time against the step's actual
+    fetch list."""
+
+    name = "dead_op_elim"
+
+    def __init__(self, targets=()):
+        self.targets = set(targets)
+
+    def apply(self, program):
+        blk = _block(program)
+        needed = set(self.targets)
+        kept = []
+        for op in reversed(blk.ops):
+            keep = (bool(op.attrs.get("_host"))
+                    or op.type == "autodiff"
+                    or op.type in _SIDE_EFFECT_TYPES
+                    or any(blk.has_var(n)
+                           and getattr(blk.vars[n], "persistable",
+                                       False)
+                           for n in op.output_names())
+                    or any(n in needed for n in op.output_names()))
+            if keep:
+                kept.append(op)
+                needed.update(op.input_names())
+        if len(kept) != len(blk.ops):
+            kept.reverse()
+            blk.ops = kept
+            program._bump()
+        return program
+
+
+# ---------------------------------------------------------------------------
+# pipeline drivers
+# ---------------------------------------------------------------------------
+class PipelineReport:
+    """What one pipeline run did: per-pass op counts + total delta —
+    the raw material of ``tools/dump_program.py --diff-passes`` and the
+    ``bench.py passes`` evidence JSON."""
+
+    def __init__(self):
+        self.per_pass = []       # {"pass", "ops_before", "ops_after",
+        #                           "ops_removed", "ms"}
+        self.ops_before = 0
+        self.ops_after = 0
+
+    def ops_removed(self):
+        return self.ops_before - self.ops_after
+
+    def as_dict(self):
+        return {"ops_before": self.ops_before,
+                "ops_after": self.ops_after,
+                "ops_removed": self.ops_removed(),
+                "per_pass": [dict(p) for p in self.per_pass]}
+
+
+def default_pipeline(targets=()):
+    """The standard pass order. Folding runs first (it creates dead
+    producers), shape/scale cleanups next (they expose adjacent
+    chains), fusion after cleanups (so it sees the canonical chains),
+    DCE last (it sweeps everything the others orphaned)."""
+    return PassManager([
+        ConstantFoldingPass(targets),
+        FoldScaleCastChainPass(targets),
+        CancelTransposeReshapePass(targets),
+        FuseMatmulBiasActPass(targets),
+        DeadOpEliminationPass(targets),
+    ])
+
+
+def _stamp_rng_indices(program):
+    """Freeze each rng op's key-fold index BEFORE any op moves: the
+    executor folds by ``_rng_idx`` when present, so optimization never
+    shifts a dropout mask (optimized == legacy bit-for-bit)."""
+    ops = program.global_block().ops
+    h = 0
+    for i, op in enumerate(ops):
+        if op.attrs.get("_needs_rng") and "_rng_idx" not in op.attrs:
+            op.attrs["_rng_idx"] = i - h
+        if op.attrs.get("_host"):
+            h += 1
+
+
+def optimize_program(program, targets=(), pipeline=None, record=True):
+    """Clone ``program``, run the pass pipeline against ``targets``
+    (the step's fetch names), publish per-pass evidence through
+    ``monitor/cost.py``, and return ``(optimized_program, report)``.
+    The input program is never mutated."""
+    from paddle_tpu.monitor import cost as _cost
+
+    prog = program.clone()
+    _stamp_rng_indices(prog)
+    pm = pipeline or default_pipeline(targets)
+    report = PipelineReport()
+    report.ops_before = len(prog.global_block().ops)
+    for p in pm.passes:
+        n0 = len(prog.global_block().ops)
+        t0 = time.perf_counter()
+        out = p.apply(prog)
+        ms = (time.perf_counter() - t0) * 1e3
+        prog = out if out is not None else prog
+        n1 = len(prog.global_block().ops)
+        pm.applied.append(p.name)
+        report.per_pass.append({"pass": p.name, "ops_before": n0,
+                                "ops_after": n1,
+                                "ops_removed": n0 - n1,
+                                "ms": round(ms, 3)})
+        if record:
+            _cost.record_pass(p.name, ops_removed=n0 - n1, ms=ms)
+    # keep only constants a surviving op (or fetch target) still
+    # reads: folding a const chain materializes every intermediate as
+    # a device array, and the optimized clone lives in the executor's
+    # compile cache — without this sweep each cached step would pin
+    # the dead intermediates for the program's lifetime
+    consts = getattr(prog, "_constants", None)
+    if consts:
+        live = set(targets)
+        for op in prog.global_block().ops:
+            live.update(op.input_names())
+        prog._constants = {k: v for k, v in consts.items()
+                           if k in live}
+    report.ops_after = len(prog.global_block().ops)
+    return prog, report
+
+
+def optimize_for_execution(program, fetch_names):
+    """The Executor's entry: optimize against the step's actual fetch
+    list (persistable state writes are DCE roots by construction)."""
+    prog, _ = optimize_program(program, targets=tuple(fetch_names))
+    return prog
+
+
+def optimize_inference(program, fetch_names):
+    """The export/serving entry — same pipeline; a separate name so the
+    two call sites can diverge (e.g. inference-only layout passes)
+    without touching the training path."""
+    prog, _ = optimize_program(program, targets=tuple(fetch_names))
+    return prog
+
+
+# ---------------------------------------------------------------------------
+# weight-only post-training quantization (export_aot cash-in)
+# ---------------------------------------------------------------------------
+def _mm_weight_slot(op):
+    """The weight var name if ``op`` consumes its RHS in a
+    quantization-compatible way ([in, out] layout, no transpose), else
+    None."""
+    xs = op.inputs.get("X", [])
+    if op.type in _MATMUL_TYPES:
+        if len(xs) != 2 or xs[0] == xs[1]:
+            return None
+        if op.type == "matmul" and op.attrs.get("transpose_y"):
+            return None
+        if op.type == "mul" and op.attrs.get("y_num_col_dims", 1) != 1:
+            return None
+        return xs[1]
+    if op.type == FUSED_MATMUL:
+        # xs[0] == xs[1] (self-product): only the RHS is dequantized,
+        # so quantizing the shared operand would feed the LHS raw int8
+        # — same guard as the raw-matmul branch above
+        if len(xs) < 2 or xs[0] == xs[1] or op.attrs.get("quant"):
+            return None
+        mm_attrs = op.attrs.get("mm_attrs", {})
+        if op.attrs.get("mm_type") == "matmul" \
+                and mm_attrs.get("transpose_y"):
+            return None
+        if op.attrs.get("mm_type") == "mul" \
+                and mm_attrs.get("y_num_col_dims", 1) != 1:
+            return None
+        return xs[1]
+    return None
+
+
+def plan_weight_quant(program, values, mode):
+    """Names of weights eligible for weight-only PTQ: persistable 2-D
+    float32 vars written by no op, consumed EXCLUSIVELY as the RHS of
+    matmul/mul/fused_matmul ops in the standard [in, out] layout.
+    ``values`` maps names to their trained arrays (shape/dtype
+    evidence). Returns a sorted name list."""
+    enforce(mode in ("int8", "bf16"),
+            f"quantize mode must be 'int8' or 'bf16', got {mode!r}")
+    blk = _block(program)
+    written = {n for op in blk.ops for n in op.output_names()}
+    cons = _consumer_map(blk)
+    eligible = []
+    for name, var in blk.vars.items():
+        if not getattr(var, "persistable", False) or name in written:
+            continue
+        v = values.get(name)
+        if v is None:
+            continue
+        v = np.asarray(v)
+        if v.ndim != 2 or v.dtype != np.float32 or not v.size:
+            continue
+        readers = [op for _, op in cons.get(name, ())]
+        if not readers:
+            continue
+        if all(_mm_weight_slot(op) == name for op in readers):
+            eligible.append(name)
+    return sorted(eligible)
+
+
+def apply_weight_quant(program, weights, mode):
+    """Clone ``program`` with each weight in ``weights`` retyped to its
+    quantized storage dtype and every consuming matmul rewritten to a
+    ``fused_matmul`` carrying the dequant (int8: + a per-channel
+    ``<w>@quant_scale`` persistable input). Shared by ``export_aot``
+    (which decides the list via ``plan_weight_quant``) and the serving
+    warm boot (which applies the list the AOT manifest recorded) — the
+    loader never re-derives eligibility, so a program/manifest mismatch
+    fails loudly here instead of serving wrong bits."""
+    enforce(mode in ("int8", "bf16"),
+            f"quantize mode must be 'int8' or 'bf16', got {mode!r}")
+    prog = program.clone()
+    blk = _block(prog)
+    wset = set(weights)
+    missing = sorted(n for n in wset if n not in blk.vars)
+    enforce(not missing,
+            f"quantized weight(s) {missing[:3]} not in program — the "
+            f"quant manifest does not match this model; re-export")
+    for w in sorted(wset):
+        var = blk.vars[w]
+        enforce(var.shape is not None and len(var.shape) == 2,
+                f"quantized weight {w!r} is not 2-D in this program")
+        var.dtype = np.dtype("int8") if mode == "int8" \
+            else _bf16_dtype()
+        if mode == "int8":
+            sv = blk.create_var(name=w + QUANT_SCALE_SUFFIX,
+                                shape=[int(var.shape[1])],
+                                dtype="float32")
+            sv.persistable = True
+    rewritten = 0
+    for op in blk.ops:
+        target = _mm_weight_slot(op)
+        if target is None or target not in wset:
+            # a non-matmul reader of a quantized weight means the plan
+            # and this program disagree — loud, not wrong-math
+            bad = sorted(set(op.input_names()) & wset)
+            if bad:
+                raise EnforceNotMet(
+                    f"op {op.type!r} reads quantized weight "
+                    f"{bad[0]!r} in a non-dequantizable position — "
+                    f"the quant manifest does not match this model; "
+                    f"re-export")
+            continue
+        xs = list(op.inputs["X"])
+        new_xs, tail = xs[:2], xs[2:]
+        if op.type in _MATMUL_TYPES:
+            mm_attrs = {k: v for k, v in op.attrs.items()
+                        if k != "name" and v is not None}
+            op.attrs = {"mm_type": op.type, "mm_attrs": mm_attrs,
+                        "has_bias": False, "quant": mode}
+            op.type = FUSED_MATMUL
+        else:                       # already fused_matmul
+            op.attrs = dict(op.attrs)
+            op.attrs["quant"] = mode
+        if mode == "int8":
+            new_xs.append(target + QUANT_SCALE_SUFFIX)
+        new_xs.extend(tail)         # bias rides after the scale
+        op.inputs["X"] = new_xs
+        rewritten += 1
+    enforce(rewritten > 0 or not wset,
+            "quant rewrite matched no consuming matmul op")
+    prog._bump()
+    return prog
+
+
+def _bf16_dtype():
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+def quantize_weight_values(values, weights, mode):
+    """{name: quantized array} (+ ``<name>@quant_scale`` float32 tables
+    for int8) — per-output-channel abs-max over the [in, out] weight's
+    columns, the ``fake_channel_wise_quantize_abs_max`` convention
+    (ops/quantize.py) at quant_axis=1."""
+    out = {}
+    for w in weights:
+        v = np.asarray(values[w], np.float32)
+        if mode == "bf16":
+            import jax.numpy as jnp
+            out[w] = np.asarray(v, dtype=jnp.bfloat16)
+            continue
+        scale = np.max(np.abs(v), axis=0)        # [out] channels
+        safe = np.maximum(scale, 1e-12)
+        q = np.clip(np.round(v / safe[None, :] * QUANT_BINS),
+                    -QUANT_BINS - 1, QUANT_BINS).astype(np.int8)
+        out[w] = q
+        out[w + QUANT_SCALE_SUFFIX] = scale.astype(np.float32)
+    return out

@@ -268,3 +268,70 @@ class TestBenchModes:
         ratio = by["ckpt_verify_overhead_ratio"]
         assert ratio["unit"] == "x" and ratio["value"] > 0
         assert len(ratio["pair_ratios"]) == 2
+
+    def test_passes_mode_emits_ratio_and_evidence(self, tmp_path):
+        """`bench.py passes` must A/B the pass pipeline on/off over
+        both models (tiny windows: CLI/shape smoke — the <= 1.0x
+        acceptance ratio runs with the on-chip defaults), prove the
+        optimized program computes the same fetches, report nonzero
+        ops-removed on the BERT trunk, and land the program_pass_*
+        metrics in the registry snapshot."""
+        metrics_out = str(tmp_path / "passes_metrics.prom")
+        lines = _run_mode("passes",
+                         extra_env={"BENCH_PASSES_STEPS": "3",
+                                    "BENCH_PASSES_PAIRS": "1",
+                                    "BENCH_METRICS_OUT": metrics_out})
+        by = {ln["metric"]: ln for ln in lines}
+        for tag in ("passes_step_ratio_serving_mlp",
+                    "passes_step_ratio_bert_trunk"):
+            row = by.get(tag)
+            assert row is not None, by.keys()
+            assert row["unit"] == "x" and row["value"] > 0
+            assert row["on_ms_per_step"] > 0
+            assert row["off_ms_per_step"] > 0
+            assert row["outputs_match"] is True, row
+            assert row["ops_before"] > row["ops_after"]
+            per_pass = {p["pass"]: p for p in row["per_pass"]}
+            assert "fuse_matmul_bias_act" in per_pass, row
+        trunk = by["passes_step_ratio_bert_trunk"]
+        assert trunk["ops_removed"] > 0, trunk
+        head = by["passes_step_ratio"]
+        assert head["unit"] == "x" and head["value"] > 0
+        assert head["vs_baseline"] > 0
+        with open(metrics_out) as f:
+            snap = f.read()
+        for name in ("program_pass_runs_total",
+                     "program_pass_ops_removed_total",
+                     "program_pass_ms"):
+            assert name in snap, f"{name} missing from snapshot"
+
+    def test_serving_quant_mode_emits_ab_rows(self):
+        """`bench.py serving` with BENCH_SERVING_QUANT=1 must freeze a
+        same-weights fp/int8 pair, serve both under one open-loop
+        schedule (tiny request count: CLI/shape smoke) and emit the
+        QPS rows, the resident-param-bytes ratio (int8 must be well
+        under the 0.55x acceptance bar even on the small MLP) and a
+        small fixture accuracy delta."""
+        lines = _run_mode("serving",
+                         extra_env={"BENCH_SERVING_QUANT": "1",
+                                    "BENCH_SERVING_QUANT_REQS": "40"})
+        by = {ln["metric"]: ln for ln in lines}
+        for tag in ("serving_fp_qps", "serving_quant_qps"):
+            row = by.get(tag)
+            assert row is not None, by.keys()
+            assert row["value"] > 0 and row["unit"] == "req/s"
+            assert row["param_bytes"] > 0
+            assert row["p50_ms"] > 0
+            assert row["p50_ms"] <= row["p99_ms"]
+        assert by["serving_quant_qps"]["quantize"] == "int8"
+        assert (by["serving_quant_qps"]["param_bytes"]
+                < by["serving_fp_qps"]["param_bytes"])
+        ratio = by["serving_quant_vs_fp_qps"]
+        assert ratio["unit"] == "x" and ratio["value"] > 0
+        pb = by["serving_quant_param_bytes_ratio"]
+        assert 0 < pb["value"] <= 0.55, pb
+        acc = by["serving_quant_accuracy_delta"]
+        assert acc["unit"] == "rel"
+        # per-channel int8 weight-only on a 3-layer MLP: relative
+        # output error stays at the percent level
+        assert 0 <= acc["value"] < 0.05, acc

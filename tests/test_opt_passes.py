@@ -1,0 +1,862 @@
+"""Program-level optimization pass pipeline (static/opt_passes.py):
+per-pass unit + golden-dump tests, the optimized-vs-unoptimized
+semantic-equivalence fuzz (random op-soup programs, eager-interpreted
+both ways), the BuildStrategy/flag wiring, and the weight-only PTQ
+(int8/bf16) export → verify → serving-load chain."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.core.flags import get_flag, set_flags
+from paddle_tpu.framework import unique_name
+from paddle_tpu.static import opt_passes
+from paddle_tpu.static.executor import exec_op
+from paddle_tpu.static.program import Operator
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+@pytest.fixture(autouse=True)
+def _passes_flag_guard():
+    """Tests flip FLAGS_apply_ir_passes; restore the ambient default."""
+    old = bool(get_flag("apply_ir_passes"))
+    yield
+    set_flags({"apply_ir_passes": 1 if old else 0})
+
+
+def _fc_program(act="relu", extra_fetch=False):
+    """fc(relu) -> fc program + (main, startup, x, out, hidden)."""
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [8], dtype="float32")
+        h = layers.fc(x, 16, act=act)
+        out = layers.fc(h, 4)
+    return main, startup, x, h, out
+
+
+def _run(program, startup, feed, fetches, apply_passes):
+    scope = pt.static.Scope()
+    set_flags({"apply_ir_passes": 1 if apply_passes else 0})
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        return [np.asarray(v) for v in
+                exe.run(program, feed=feed, fetch_list=fetches)]
+
+
+def _interp(program, env0, fetches, seed=0):
+    """Eager reference interpreter mirroring the executor's rng
+    derivation (fold(base, step 0) then per-op ``_rng_idx``-or-index;
+    no host ops in these tests)."""
+    env = dict(getattr(program, "_constants", {}))
+    env.update(env0)
+    base = jax.random.fold_in(jax.random.PRNGKey(seed), np.uint32(0))
+    for i, op in enumerate(program.global_block().ops):
+        key = None
+        if op.attrs.get("_needs_rng"):
+            key = jax.random.fold_in(base,
+                                     op.attrs.get("_rng_idx", i))
+        env.update(exec_op(op, env, key))
+    return [np.asarray(env[n]) for n in fetches]
+
+
+def _startup_values(startup, scope=None):
+    scope = scope or pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+    return {n: scope.find_var(n) for n in scope.names()
+            if scope.find_var(n) is not None}
+
+
+class TestFusion:
+    def test_fc_chain_fuses_to_single_ops(self):
+        main, startup, x, h, out = _fc_program()
+        prog, report = opt_passes.optimize_program(
+            main, targets=[out.name])
+        types = [op.type for op in prog.global_block().ops]
+        # mul+add+relu and mul+add -> two fused_matmul ops
+        assert types == [opt_passes.FUSED_MATMUL,
+                         opt_passes.FUSED_MATMUL]
+        assert report.ops_removed() == 3
+        fused = prog.global_block().ops[0]
+        assert fused.attrs["act"] == "relu"
+        assert fused.attrs["mm_type"] == "mul"
+        # the caller's program is untouched
+        assert [op.type for op in main.global_block().ops] == [
+            "mul", "elementwise_add", "relu", "mul",
+            "elementwise_add"]
+
+    def test_fused_program_matches_unfused(self):
+        main, startup, x, h, out = _fc_program()
+        feed = {"x": np.random.RandomState(0).rand(4, 8)
+                .astype(np.float32)}
+        a = _run(main, startup, feed, [out.name], apply_passes=True)
+        b = _run(main, startup, feed, [out.name], apply_passes=False)
+        np.testing.assert_array_equal(a[0], b[0])
+
+    def test_fetched_intermediate_blocks_fusion(self):
+        main, startup, x, h, out = _fc_program()
+        # fetching the hidden activation protects it: the chain that
+        # produces it must survive un-fused
+        prog, _ = opt_passes.optimize_program(
+            main, targets=[out.name, h.name])
+        types = [op.type for op in prog.global_block().ops]
+        assert h.name in {n for op in prog.global_block().ops
+                          for n in op.output_names()}
+        assert types.count(opt_passes.FUSED_MATMUL) >= 1
+        feed = {"x": np.ones((2, 8), np.float32)}
+        vals = _startup_values(startup)
+        a = _interp(prog, {**vals, **feed}, [out.name, h.name])
+        b = _interp(main, {**vals, **feed}, [out.name, h.name])
+        for u, v in zip(a, b):
+            np.testing.assert_array_equal(u, v)
+
+    def test_multi_consumer_intermediate_blocks_fusion(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            w = layers.create_parameter([4, 4], "float32", name="w")
+            mm = layers.mul(x, w)
+            b = layers.create_parameter([4], "float32", name="b")
+            added = layers.elementwise_add(mm, b)
+            # mm feeds BOTH the add and a second consumer
+            other = layers.scale(mm, scale=2.0)
+        prog, _ = opt_passes.optimize_program(
+            main, targets=[added.name, other.name])
+        assert "mul" in [op.type for op in prog.global_block().ops]
+
+
+class TestScaleCastTranspose:
+    def test_scale_chain_composes(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            y = layers.scale(x, scale=2.0, bias=1.0)
+            z = layers.scale(y, scale=3.0, bias=-2.0)
+        prog, _ = opt_passes.optimize_program(main, targets=[z.name])
+        ops = prog.global_block().ops
+        assert [op.type for op in ops] == ["scale"]
+        assert ops[0].attrs["scale"] == pytest.approx(6.0)
+        assert ops[0].attrs["bias"] == pytest.approx(1.0)
+        feed = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+        a = _interp(prog, {"x": feed}, [z.name])
+        b = _interp(main, {"x": feed}, [z.name])
+        np.testing.assert_allclose(a[0], b[0], rtol=1e-6, atol=1e-6)
+
+    def test_identity_scale_and_cast_dropped(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            y = layers.scale(x, scale=1.0, bias=0.0)
+            z = layers.cast(y, "float32")        # same dtype
+            w = layers.relu(z)
+        prog, _ = opt_passes.optimize_program(main, targets=[w.name])
+        assert [op.type for op in prog.global_block().ops] == ["relu"]
+        feed = np.random.RandomState(2).rand(2, 4).astype(np.float32) \
+            - 0.5
+        a = _interp(prog, {"x": feed}, [w.name])
+        b = _interp(main, {"x": feed}, [w.name])
+        np.testing.assert_array_equal(a[0], b[0])
+
+    def test_inverse_transposes_cancel(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [3, 5], dtype="float32")
+            t1 = layers.transpose(x, [0, 2, 1])
+            t2 = layers.transpose(t1, [0, 2, 1])
+            out = layers.relu(t2)
+        prog, _ = opt_passes.optimize_program(main, targets=[out.name])
+        assert [op.type for op in prog.global_block().ops] == ["relu"]
+
+    def test_transpose_chain_composes(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [3, 5], dtype="float32")
+            t1 = layers.transpose(x, [1, 0, 2])
+            t2 = layers.transpose(t1, [0, 2, 1])
+            out = layers.scale(t2, scale=2.0)
+        prog, _ = opt_passes.optimize_program(main, targets=[out.name])
+        types = [op.type for op in prog.global_block().ops]
+        assert types == ["transpose", "scale"]
+        feed = np.random.RandomState(3).rand(2, 3, 5) \
+            .astype(np.float32)
+        a = _interp(prog, {"x": feed}, [out.name])
+        b = _interp(main, {"x": feed}, [out.name])
+        np.testing.assert_array_equal(a[0], b[0])
+
+    def test_reshape_chain_collapses_but_not_zero_entries(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4, 6], dtype="float32")
+            r1 = layers.reshape(x, [-1, 24])
+            r2 = layers.reshape(r1, [-1, 4, 6])
+            out = layers.relu(r2)
+        prog, _ = opt_passes.optimize_program(main, targets=[out.name])
+        assert [op.type for op in prog.global_block().ops] == [
+            "reshape", "relu"]
+        # a 0-entry in the SECOND reshape anchors on its input's dims:
+        # collapsing would re-anchor it — must NOT fire
+        main2, startup2 = pt.Program(), pt.Program()
+        with pt.program_guard(main2, startup2), unique_name.guard():
+            x = pt.static.data("x", [4, 6], dtype="float32")
+            r1 = layers.reshape(x, [-1, 2, 12])
+            r2 = layers.reshape(r1, [0, -1])     # 0 copies r1's dim 0
+            out = layers.relu(r2)
+        prog2, _ = opt_passes.optimize_program(main2,
+                                               targets=[out.name])
+        assert [op.type for op in prog2.global_block().ops] == [
+            "reshape", "reshape", "relu"]
+
+
+class TestConstantFoldingAndDCE:
+    def _const_program(self):
+        """Hand-built (deserialized-program shape): a const-only chain
+        feeding a live op, plus a dead branch."""
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            blk = main.global_block()
+            cvar = blk.create_var(name="c0", shape=(2, 4),
+                                  dtype="float32")
+            main._constants["c0"] = jnp.ones((2, 4), jnp.float32)
+            blk.create_var(name="c1", shape=(2, 4), dtype="float32")
+            blk.append_op("scale", inputs={"X": ["c0"]},
+                          outputs={"Out": ["c1"]},
+                          attrs={"scale": 3.0, "bias": 1.0,
+                                 "bias_after_scale": True})
+            out = layers.elementwise_add(x, blk.vars["c1"])
+            dead = layers.scale(out, scale=5.0)      # nothing reads it
+        return main, startup, out, dead
+
+    def test_const_chain_folds_and_dead_op_drops(self):
+        main, startup, out, dead = self._const_program()
+        prog, report = opt_passes.optimize_program(
+            main, targets=[out.name])
+        types = [op.type for op in prog.global_block().ops]
+        assert "scale" not in types          # const scale folded,
+        assert types == ["elementwise_add"]  # dead scale eliminated
+        assert "c1" in prog._constants
+        np.testing.assert_allclose(np.asarray(prog._constants["c1"]),
+                                   np.full((2, 4), 4.0), rtol=1e-6)
+        per = {p["pass"]: p for p in report.per_pass}
+        assert per["constant_fold"]["ops_removed"] == 1
+        assert per["dead_op_elim"]["ops_removed"] == 1
+        feed = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        a = _interp(prog, {"x": feed}, [out.name])
+        b = _interp(main, {"x": feed}, [out.name])
+        np.testing.assert_array_equal(a[0], b[0])
+
+    def test_fetched_constant_output_still_fetchable(self):
+        main, startup, out, dead = self._const_program()
+        prog, _ = opt_passes.optimize_program(
+            main, targets=[out.name, "c1"])
+        feed = {"x": np.zeros((2, 4), np.float32)}
+        got = _run(prog, startup, feed, [out.name, "c1"],
+                   apply_passes=False)
+        np.testing.assert_allclose(got[1], np.full((2, 4), 4.0))
+
+    def test_dce_keeps_persistable_writes_and_fetched_branch(self):
+        main, startup, out, dead = self._const_program()
+        # fetching the "dead" branch keeps it
+        prog, _ = opt_passes.optimize_program(
+            main, targets=[dead.name])
+        assert "scale" in [op.type for op in prog.global_block().ops]
+        # optimizer programs keep their persistable updates with NO
+        # fetch at all
+        pt.enable_static()
+        m2, s2 = pt.Program(), pt.Program()
+        with pt.program_guard(m2, s2), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            y = layers.fc(x, 2)
+            loss = layers.reduce_mean(layers.square(y))
+            pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        prog2, _ = opt_passes.optimize_program(m2, targets=[])
+        types = [op.type for op in prog2.global_block().ops]
+        assert "autodiff" in types
+        assert "apply_optimizer" in types
+
+
+class TestWiring:
+    def test_flag_off_is_legacy_path(self, monkeypatch):
+        main, startup, x, h, out = _fc_program()
+        called = []
+        monkeypatch.setattr(
+            opt_passes, "optimize_for_execution",
+            lambda *a, **k: called.append(1) or (_ for _ in ()).throw(
+                AssertionError("pipeline ran with flag off")))
+        feed = {"x": np.ones((2, 8), np.float32)}
+        _run(main, startup, feed, [out.name], apply_passes=False)
+        assert not called
+
+    def test_build_strategy_knob_overrides_flag(self):
+        from paddle_tpu.monitor import cost as mcost
+        main, startup, x, h, out = _fc_program()
+        from paddle_tpu.compiler import BuildStrategy, CompiledProgram
+        strat = BuildStrategy()
+        strat.apply_ir_passes = False
+        cp = CompiledProgram(main, build_strategy=strat)
+        set_flags({"apply_ir_passes": 1})
+        before = mcost.pass_evidence().get(
+            "fuse_matmul_bias_act", {}).get("runs", 0)
+        scope = pt.static.Scope()
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            exe.run(cp, feed={"x": np.ones((2, 8), np.float32)},
+                    fetch_list=[out.name])
+        after = mcost.pass_evidence().get(
+            "fuse_matmul_bias_act", {}).get("runs", 0)
+        assert after == before      # knob False beats flag True
+
+    def test_flag_flip_recompiles_not_stale(self):
+        """One executor, same program/scope: flipping the flag serves
+        the matching compiled step, not a stale cache hit."""
+        main, startup, x, h, out = _fc_program()
+        feed = {"x": np.random.RandomState(5).rand(2, 8)
+                .astype(np.float32)}
+        scope = pt.static.Scope()
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            set_flags({"apply_ir_passes": 1})
+            a = exe.run(main, feed=feed, fetch_list=[out.name])[0]
+            t1 = exe.trace_count
+            set_flags({"apply_ir_passes": 0})
+            b = exe.run(main, feed=feed, fetch_list=[out.name])[0]
+            assert exe.trace_count > t1      # distinct compiled step
+            set_flags({"apply_ir_passes": 1})
+            t2 = exe.trace_count
+            c = exe.run(main, feed=feed, fetch_list=[out.name])[0]
+            assert exe.trace_count == t2     # cached again
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+    def test_rng_ops_bit_identical_on_off(self):
+        """Dropout masks must not shift when fusion removes ops ahead
+        of the rng op (_rng_idx pinning)."""
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [8], dtype="float32")
+            h = layers.fc(x, 16, act="relu")
+            d = layers.dropout(h, dropout_prob=0.5)
+            out = layers.fc(d, 4)
+        feed = {"x": np.random.RandomState(7).rand(4, 8)
+                .astype(np.float32)}
+        a = _run(main, startup, feed, [out.name], apply_passes=True)
+        b = _run(main, startup, feed, [out.name], apply_passes=False)
+        np.testing.assert_array_equal(a[0], b[0])
+
+
+class TestGoldenDumps:
+    """Golden before/after op dumps per pass on the canonical fc
+    program (tools/dump_program.diff_passes is the same code path the
+    CLI prints)."""
+
+    def test_diff_passes_golden(self):
+        import sys
+        sys.path.insert(0, TOOLS)
+        try:
+            import dump_program
+        finally:
+            sys.path.remove(TOOLS)
+        main, startup, x, h, out = _fc_program()
+        diffs = dump_program.diff_passes(main, [out.name])
+        by_name = {d["pass"]: d for d in diffs}
+        assert [d["pass"] for d in diffs] == [
+            "constant_fold", "fold_scale_cast",
+            "cancel_transpose_reshape", "fuse_matmul_bias_act",
+            "dead_op_elim"]
+        fuse = by_name["fuse_matmul_bias_act"]
+        assert fuse["ops_before"] == 5 and fuse["ops_after"] == 2
+        removed = [ln for ln in fuse["diff"] if ln.startswith("-")]
+        added = [ln for ln in fuse["diff"] if ln.startswith("+")]
+        assert len(removed) == 5 and len(added) == 2
+        assert all("fused_matmul" in ln for ln in added)
+        assert any("act='relu'" in ln for ln in added)
+        # passes with nothing to do report no diff
+        assert by_name["constant_fold"]["diff"] == []
+
+    def test_cli_runs(self, tmp_path):
+        import subprocess
+        import sys
+        main, startup, x, h, out = _fc_program()
+        scope = pt.static.Scope()
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            pt.io.save_inference_model(str(tmp_path), ["x"], [out],
+                                       exe, main_program=main)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "dump_program.py"),
+             str(tmp_path), "--diff-passes"],
+            capture_output=True, text=True, timeout=240, env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "fuse_matmul_bias_act" in r.stdout
+        assert "fused_matmul" in r.stdout
+        assert "pipeline total: 5 -> 2 ops" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# semantic-equivalence fuzz
+# ---------------------------------------------------------------------------
+def _random_program(rng):
+    """One random op-soup program over the fused/foldable families.
+    Returns (main, startup, feed_dict, fetch_names)."""
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        batch = int(rng.randint(1, 4))
+        dim = int(rng.randint(2, 6))
+        x = pt.static.data("x", [dim], dtype="float32")
+        pool = [x]                     # 2-D [batch, d] variables
+        for _ in range(rng.randint(3, 9)):
+            v = pool[rng.randint(len(pool))]
+            width = int(v.shape[-1])
+            kind = rng.randint(9)
+            if kind == 0:
+                nv = layers.fc(v, int(rng.randint(2, 6)),
+                               act=str(rng.choice(
+                                   ["relu", "tanh", "sigmoid"]))
+                               if rng.rand() < 0.7 else None)
+            elif kind == 1:
+                nv = layers.scale(v, scale=float(rng.randn()),
+                                  bias=float(rng.randn()),
+                                  bias_after_scale=bool(
+                                      rng.rand() < 0.5))
+            elif kind == 2:
+                # inverse pair keeps the pool [batch, d] (the cancel
+                # pass's bread and butter)
+                t = layers.transpose(v, [1, 0])
+                nv = layers.transpose(t, [1, 0])
+            elif kind == 3:
+                r = layers.reshape(v, [-1, 1, width])
+                nv = layers.reshape(r, [-1, width])
+            elif kind == 4:
+                w = pool[rng.randint(len(pool))]
+                if int(w.shape[-1]) == width:
+                    nv = layers.elementwise_add(v, w) \
+                        if rng.rand() < 0.5 \
+                        else layers.elementwise_mul(v, w)
+                else:
+                    nv = layers.scale(v, scale=2.0)
+            elif kind == 5:
+                nv = layers.softmax(v)
+            elif kind == 6:
+                nv = layers.cast(layers.cast(v, "float32"), "float32")
+            elif kind == 7:
+                nv = layers.dropout(v, dropout_prob=0.3)
+            else:
+                c = np.asarray(rng.randn(1, width), np.float32)
+                nv = layers.elementwise_add(v, c)
+            pool.append(nv)
+        n_fetch = int(rng.randint(1, 3))
+        fetch = [pool[-1].name]
+        for _ in range(n_fetch - 1):
+            fetch.append(pool[rng.randint(1, len(pool))].name)
+        fetch = list(dict.fromkeys(fetch))
+    feed = {"x": rng.rand(batch, dim).astype(np.float32)}
+    return main, startup, feed, fetch
+
+
+N_FUZZ = int(os.environ.get("PT_OPT_FUZZ_PROGRAMS", "220"))
+
+
+class TestEquivalenceFuzz:
+    def test_fuzz_optimized_matches_unoptimized(self):
+        """>= 200 random programs: optimized and unoptimized fetch
+        outputs must agree (eager interpretation through the same op
+        registry the executor compiles — program-transform equivalence,
+        independent of XLA)."""
+        rng = np.random.RandomState(1234)
+        checked = 0
+        total_removed = 0
+        for i in range(N_FUZZ):
+            main, startup, feed, fetch = _random_program(rng)
+            vals = _startup_values(startup)
+            prog, report = opt_passes.optimize_program(
+                main, targets=fetch)
+            total_removed += report.ops_removed()
+            a = _interp(main, {**vals, **feed}, fetch)
+            b = _interp(prog, {**vals, **feed}, fetch)
+            for u, v in zip(a, b):
+                np.testing.assert_allclose(
+                    u, v, rtol=1e-5, atol=1e-5,
+                    err_msg=f"program {i} diverged "
+                            f"(fetch={fetch}, report="
+                            f"{report.as_dict()})")
+            checked += 1
+        assert checked >= 200
+        assert total_removed > 0     # the fuzz actually exercises passes
+
+    def test_fuzz_through_real_executor(self):
+        """A slice of the fuzz space through the COMPILED path (jit,
+        donation, runner caching) with the on/off A/B."""
+        rng = np.random.RandomState(99)
+        for _ in range(6):
+            main, startup, feed, fetch = _random_program(rng)
+            a = _run(main, startup, feed, fetch, apply_passes=True)
+            b = _run(main, startup, feed, fetch, apply_passes=False)
+            for u, v in zip(a, b):
+                np.testing.assert_allclose(u, v, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# weight-only PTQ
+# ---------------------------------------------------------------------------
+def _freeze_mlp(dirname, quantize=None, hidden=32, seed=0):
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [16], dtype="float32")
+        h = layers.fc(x, hidden, act="relu")
+        out = layers.fc(h, 4)
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        if dirname is not None:
+            pt.io.save_inference_model(dirname, ["x"], [out], exe,
+                                       main_program=main)
+            from paddle_tpu import inference as inf
+            if quantize:
+                inf.export_aot(dirname, main, ["x"], [out.name],
+                               scope, [{"x": ((4, 16), "float32")}],
+                               platforms=("cpu",), quantize=quantize)
+    return main, startup, scope, out
+
+
+class TestWeightQuant:
+    def test_plan_rejects_non_matmul_consumers(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            w = layers.create_parameter([4, 4], "float32", name="w")
+            y = layers.mul(x, w)
+            layers.relu(w)                      # non-matmul reader of w
+            w2 = layers.create_parameter([4, 3], "float32", name="w2")
+            out = layers.mul(y, w2)
+        vals = {"w": np.ones((4, 4), np.float32),
+                "w2": np.ones((4, 3), np.float32)}
+        plan = opt_passes.plan_weight_quant(main, vals, "int8")
+        assert plan == ["w2"]
+
+    def test_plan_rejects_transposed_rhs(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            w = layers.create_parameter([3, 4], "float32", name="w")
+            out = layers.matmul(x, w, transpose_y=True)
+        plan = opt_passes.plan_weight_quant(
+            main, {"w": np.ones((3, 4), np.float32)}, "int8")
+        assert plan == []
+
+    def test_int8_quantized_matmul_close_to_fp(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(16, 8).astype(np.float32)
+        x = rng.randn(4, 16).astype(np.float32)
+        q = opt_passes.quantize_weight_values({"w": w}, ["w"], "int8")
+        assert q["w"].dtype == np.int8
+        scale = q["w" + opt_passes.QUANT_SCALE_SUFFIX]
+        assert scale.shape == (8,)
+        wq = q["w"].astype(np.float32) * scale[None, :] / 127.0
+        # per-channel int8: max weight error is scale/254 per entry
+        assert np.max(np.abs(wq - w)) <= np.max(scale) / 254 + 1e-6
+        np.testing.assert_allclose(x @ wq, x @ w, atol=0.25, rtol=0.1)
+
+    def test_apply_weight_quant_rewrites_and_matches(self):
+        main, startup, scope, out = _freeze_mlp(None)
+        # (freeze writes nothing for dirname=None? use scope directly)
+        vals = {n: np.asarray(scope.find_var(n))
+                for n in scope.names()
+                if scope.find_var(n) is not None
+                and not n.startswith("@")}
+        plan = opt_passes.plan_weight_quant(main, vals, "int8")
+        assert len(plan) == 2
+        prog = opt_passes.apply_weight_quant(main, plan, "int8")
+        types = [op.type for op in prog.global_block().ops]
+        assert types.count(opt_passes.FUSED_MATMUL) == 2
+        qv = opt_passes.quantize_weight_values(vals, plan, "int8")
+        feed = np.random.RandomState(3).rand(4, 16) \
+            .astype(np.float32)
+        ref = _interp(main, {**vals, "x": feed}, [out.name])[0]
+        got = _interp(prog, {**vals, **qv, "x": feed}, [out.name])[0]
+        span = np.max(np.abs(ref)) + 1e-6
+        assert np.max(np.abs(got - ref)) / span < 0.05
+
+    def test_apply_refuses_manifest_mismatch(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4, 4], dtype="float32")
+            w = layers.create_parameter([4, 4], "float32", name="w")
+            out = layers.relu(layers.elementwise_add(x, w))
+        from paddle_tpu.core.enforce import EnforceNotMet
+        with pytest.raises(EnforceNotMet, match="non-dequantizable"):
+            opt_passes.apply_weight_quant(main, ["w"], "int8")
+        with pytest.raises(EnforceNotMet, match="not in program"):
+            opt_passes.apply_weight_quant(main, ["nope"], "int8")
+
+    def test_export_verify_load_roundtrip_int8(self, tmp_path):
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        _freeze_mlp(d, quantize="int8")
+        n = inf.verify_aot_dir(d)
+        assert int(n) == 3           # xla + shlo + quant sidecar
+        q = inf.load_quantized_params(d)
+        assert q["mode"] == "int8" and len(q["weights"]) == 2
+        for w in q["weights"]:
+            assert q["values"][w].dtype == np.int8
+            assert q["values"][
+                w + opt_passes.QUANT_SCALE_SUFFIX].dtype == np.float32
+
+    def test_export_bf16_roundtrip(self, tmp_path):
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        main, startup, scope, out = _freeze_mlp(d, quantize="bf16")
+        q = inf.load_quantized_params(d)
+        assert q["mode"] == "bf16"
+        for w in q["weights"]:
+            assert q["values"][w].dtype == jnp.bfloat16
+
+    def test_tampered_scale_table_fails_verify(self, tmp_path):
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        _freeze_mlp(d, quantize="int8")
+        # the sidecar filename is per-export: resolve it from the dir
+        qname, = [f for f in os.listdir(os.path.join(d, inf.AOT_DIR))
+                  if f.startswith("quant.int8.")]
+        qpath = os.path.join(d, inf.AOT_DIR, qname)
+        blob = bytearray(open(qpath, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(qpath, "wb") as f:
+            f.write(bytes(blob))
+        with pytest.raises(inf.AOTIntegrityError):
+            inf.verify_aot_dir(d)
+        with pytest.raises(inf.AOTIntegrityError):
+            inf.load_quantized_params(d)
+
+    def test_predictor_on_quantized_dir_serves_fp32(self, tmp_path):
+        """The single-request Predictor ignores the quant sidecar (its
+        AOT entries name quantized state it doesn't hold) and degrades
+        to the fp32 retrace path — correct results, no error."""
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        main, startup, scope, out = _freeze_mlp(d, quantize="int8")
+        feed = np.random.RandomState(4).rand(4, 16) \
+            .astype(np.float32)
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            ref = exe.run(main, feed={"x": feed},
+                          fetch_list=[out.name])[0]
+        p = inf.create_predictor(inf.Config(d))
+        got = p.run({"x": feed})[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+class TestQuantServing:
+    def test_server_boots_quantized_and_swaps(self, tmp_path):
+        """Warm boot on a quantized dir: int8-resident params, correct
+        shapes; fp -> int8 hot swap cuts resident bytes and reports
+        the quantized mode."""
+        from paddle_tpu.serving import InferenceServer, ServingConfig
+        dq = str(tmp_path / "q")
+        _freeze_mlp(dq, quantize="int8")
+        srv = InferenceServer(dq, ServingConfig(max_batch=2))
+        try:
+            feed = np.random.RandomState(5).rand(2, 16) \
+                .astype(np.float32)
+            outs = srv.infer({"x": feed})
+            assert np.asarray(outs[0]).shape == (2, 4)
+            qbytes = srv.pool.resident_param_bytes()
+        finally:
+            srv.close(timeout=30)
+        dfp = str(tmp_path / "fp")
+        main, startup, scope, out = _freeze_mlp(dfp)
+        srv2 = InferenceServer(dfp, ServingConfig(max_batch=2))
+        try:
+            fp_bytes = srv2.pool.resident_param_bytes()
+            assert qbytes < 0.55 * fp_bytes
+            ref = np.asarray(srv2.infer({"x": feed})[0])
+            dq2 = str(tmp_path / "q2")
+            _freeze_mlp(dq2, quantize="int8")
+            rep = srv2.swap(dq2)
+            assert rep["outcome"] == "ok"
+            assert rep["quantized"] == "int8"
+            assert srv2.pool.resident_param_bytes() < 0.55 * fp_bytes
+            got = np.asarray(srv2.infer({"x": feed})[0])
+            assert got.shape == ref.shape
+        finally:
+            srv2.close(timeout=30)
+
+
+class TestInPlaceRewriteHazards:
+    """Multi-write names are legal in this IR (optimizer ops write
+    params in place via ParamOut). A rewrite that points a reader past
+    such a write at the source var — or moves a read across it — must
+    refuse (the _written_between guards)."""
+
+    def test_identity_elim_refuses_reader_past_inplace_write(self):
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            b = layers.scale(x, scale=1.0, bias=0.0)   # identity
+            blk = main.global_block()
+            # in-place rewrite of x BETWEEN the identity op and b's
+            # reader — rewiring that reader to x would observe this
+            blk.append_op("scale", inputs={"X": [b.name]},
+                          outputs={"Out": [x.name]},
+                          attrs={"scale": 2.0, "bias": 0.0,
+                                 "bias_after_scale": True})
+            c = layers.relu(b)
+        prog, _ = opt_passes.optimize_program(main, targets=[c.name])
+        feed = np.random.RandomState(11).rand(2, 4) \
+            .astype(np.float32) - 0.5
+        a = _interp(main, {"x": feed}, [c.name])
+        o = _interp(prog, {"x": feed}, [c.name])
+        np.testing.assert_array_equal(a[0], o[0])
+        # the identity scale survived: its reader sits past the
+        # in-place write of its source
+        kept = [op.type for op in prog.global_block().ops]
+        assert "scale" in kept, kept
+
+    def test_fusion_still_fires_before_optimizer_style_write(self):
+        """A write AFTER the whole chain (the optimizer-update shape)
+        must not block fusion — the interval guard is positional, not
+        a blanket any-later-write refusal."""
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [4], dtype="float32")
+            w = layers.create_parameter([4, 3], "float32", name="w")
+            bvar = layers.create_parameter([3], "float32", name="b")
+            out = layers.relu(layers.elementwise_add(
+                layers.mul(x, w), bvar))
+            blk = main.global_block()
+            # in-place param update AFTER the chain (ParamOut shape)
+            blk.append_op("scale", inputs={"X": [w.name]},
+                          outputs={"Out": [w.name]},
+                          attrs={"scale": 0.5, "bias": 0.0,
+                                 "bias_after_scale": True})
+        prog, _ = opt_passes.optimize_program(main, targets=[out.name])
+        types = [op.type for op in prog.global_block().ops]
+        assert opt_passes.FUSED_MATMUL in types, types
+        vals = {"w": np.random.RandomState(1).rand(4, 3)
+                .astype(np.float32),
+                "b": np.random.RandomState(2).rand(3)
+                .astype(np.float32)}
+        feed = np.random.RandomState(3).rand(2, 4).astype(np.float32)
+        a = _interp(main, {**vals, "x": feed}, [out.name])
+        o = _interp(prog, {**vals, "x": feed}, [out.name])
+        np.testing.assert_array_equal(a[0], o[0])
+
+
+class TestQuantSidecarStaleness:
+    def test_fp_reexport_supersedes_quant_sidecar(self, tmp_path):
+        """A later fp32 re-export (different bucket set, so key-based
+        index pruning keeps the old entries) must supersede the quant
+        sidecar: serving the NEW fp weights, not silently overwriting
+        them with the stale int8 arrays."""
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        main, startup, scope, out = _freeze_mlp(d, quantize="int8")
+        assert inf.load_quantized_params(d) is not None
+        with pt.static.scope_guard(scope):
+            inf.export_aot(d, main, ["x"], [out.name], scope,
+                           [{"x": ((2, 16), "float32")}],
+                           platforms=("cpu",))
+        assert inf.load_quantized_params(d) is None
+
+    def test_same_key_quant_reexport_sweeps_old_sidecar(self, tmp_path):
+        """Sidecar files are uniquely named per export, so a same-key
+        re-export must unlink the superseded one — a continuous-deploy
+        loop would otherwise leak one full-weight npz per publish."""
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        main, startup, scope, out = _freeze_mlp(d, quantize="int8")
+        with pt.static.scope_guard(scope):
+            inf.export_aot(d, main, ["x"], [out.name], scope,
+                           [{"x": ((4, 16), "float32")}],
+                           platforms=("cpu",), quantize="int8")
+        sidecars = [f for f in os.listdir(os.path.join(d, inf.AOT_DIR))
+                    if f.startswith("quant.int8.")]
+        assert len(sidecars) == 1, sidecars
+        assert int(inf.verify_aot_dir(d)) == 3
+        assert inf.load_quantized_params(d)["mode"] == "int8"
+
+    def test_self_product_weight_not_quant_eligible_after_fusion(self):
+        """matmul(w, w) + bias: the fused_matmul dequantizes only the
+        RHS, so the shared operand must stay ineligible after fusion
+        exactly as it is on the raw program."""
+        pt.enable_static()
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            w = layers.create_parameter([4, 4], "float32", name="w")
+            b = layers.create_parameter([4], "float32", name="b")
+            out = layers.elementwise_add(layers.matmul(w, w), b)
+        vals = {"w": np.ones((4, 4), np.float32),
+                "b": np.ones((4,), np.float32)}
+        assert opt_passes.plan_weight_quant(main, vals, "int8") == []
+        fused, _ = opt_passes.optimize_program(main,
+                                               targets=[out.name])
+        types = [op.type for op in fused.global_block().ops]
+        assert opt_passes.FUSED_MATMUL in types, types
+        assert opt_passes.plan_weight_quant(fused, vals, "int8") == []
+
+    def test_quant_reexport_subset_buckets_keeps_verify_green(
+            self, tmp_path):
+        """A quantized re-export under a different bucket set leaves
+        the old entries in the index (key-based pruning); each entry
+        must keep naming ITS OWN sidecar file with a valid CRC — a
+        fixed sidecar filename would strand the old entries with
+        stale CRCs and verify_aot_dir would refuse the whole dir."""
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        main, startup, scope, out = _freeze_mlp(d, quantize="int8")
+        with pt.static.scope_guard(scope):
+            inf.export_aot(d, main, ["x"], [out.name], scope,
+                           [{"x": ((2, 16), "float32")}],
+                           platforms=("cpu",), quantize="int8")
+        assert int(inf.verify_aot_dir(d)) == 6   # 2 exports x 3 files
+        assert inf.load_quantized_params(d)["mode"] == "int8"
+
+    def test_missing_integrity_record_refuses(self, tmp_path):
+        """An index entry whose quant sidecar has no integrity record
+        is a doctored index — load must raise, not serve unverifiable
+        scale tables."""
+        import json as _json
+        from paddle_tpu import inference as inf
+        d = str(tmp_path / "m")
+        _freeze_mlp(d, quantize="int8")
+        idx_path = os.path.join(d, inf.AOT_DIR, inf.AOT_INDEX)
+        with open(idx_path) as f:
+            idx = _json.load(f)
+        for e in idx:
+            if isinstance(e.get("quant"), dict):
+                e["integrity"].pop(e["quant"]["file"], None)
+        with open(idx_path, "w") as f:
+            _json.dump(idx, f)
+        with pytest.raises(inf.AOTIntegrityError,
+                           match="no integrity record"):
+            inf.load_quantized_params(d)

@@ -1,0 +1,123 @@
+"""Dump a saved inference program's op list; ``--diff-passes`` runs
+the program-level optimization pipeline (static/opt_passes.py) one
+pass at a time and prints the op-list diff each pass produced — the
+triage tool for blaming a miscompile on the guilty pass
+(docs/PERFORMANCE.md "Program pass pipeline"):
+
+    python tools/dump_program.py <model_dir>               # op list
+    python tools/dump_program.py <model_dir> --diff-passes # per-pass diff
+    python tools/dump_program.py <model_dir> --diff-passes \\
+        --targets out.0                                    # custom roots
+
+``<model_dir>`` is a ``save_inference_model`` directory (its
+``__model__`` file is read directly — params are not loaded, nothing
+executes). Targets default to the artifact's recorded fetch names.
+Exit 0 always (this is a viewer, not a lint).
+"""
+
+import argparse
+import difflib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:                       # CLI use from anywhere
+    sys.path.insert(0, REPO)
+
+
+def _op_lines(program):
+    """One canonical line per op — the diff currency (indices shift as
+    passes remove ops, so lines carry structure, not positions)."""
+    out = []
+    for op in program.global_block().ops:
+        ins = ",".join(sorted(op.input_names()))
+        outs = ",".join(op.output_names())
+        attrs = ",".join(
+            f"{k}={op.attrs[k]!r}" for k in sorted(op.attrs)
+            if not k.startswith("_") and k != "name"
+            and not _is_program_attr(op.attrs[k]))
+        out.append(f"{op.type}({ins}) -> {outs}"
+                   + (f" [{attrs}]" if attrs else ""))
+    return out
+
+
+def _is_program_attr(v):
+    from paddle_tpu.static.program import Program
+    return isinstance(v, Program)
+
+
+def diff_passes(program, targets):
+    """Run the default pipeline pass-by-pass; returns a list of
+    ``{"pass", "ops_before", "ops_after", "diff"}`` where ``diff`` is
+    the unified op-list diff lines that pass produced (empty = the
+    pass was a no-op on this program)."""
+    from paddle_tpu.static import opt_passes
+
+    prog = program.clone()
+    opt_passes._stamp_rng_indices(prog)
+    results = []
+    for p in opt_passes.default_pipeline(targets).passes:
+        before = _op_lines(prog)
+        out = p.apply(prog)
+        prog = out if out is not None else prog
+        after = _op_lines(prog)
+        diff = [ln for ln in difflib.unified_diff(
+            before, after, lineterm="", n=1)
+            if not ln.startswith(("---", "+++", "@@"))]
+        results.append({"pass": p.name, "ops_before": len(before),
+                        "ops_after": len(after), "diff": diff})
+    return results
+
+
+def load_model_program(model_dir):
+    """(program, feed_names, fetch_names) from a save_inference_model
+    dir's ``__model__`` file — no params read, nothing executed."""
+    from paddle_tpu.static.serialize import loads_program
+
+    path = model_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, "__model__")
+    with open(path) as f:
+        program, doc = loads_program(f.read())
+    return program, doc.get("feed_names", []), doc.get("fetch_names", [])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Dump a saved program's ops; --diff-passes prints "
+                    "the op-list diff each optimization pass produced")
+    ap.add_argument("model_dir",
+                    help="save_inference_model dir (or a __model__ "
+                         "file path)")
+    ap.add_argument("--diff-passes", action="store_true",
+                    help="run the pass pipeline pass-by-pass and "
+                         "print each pass's op diff")
+    ap.add_argument("--targets", default=None,
+                    help="comma-separated DCE roots (default: the "
+                         "artifact's fetch names)")
+    args = ap.parse_args(argv)
+
+    program, feeds, fetches = load_model_program(args.model_dir)
+    targets = (args.targets.split(",") if args.targets else
+               list(fetches))
+    print(f"# feeds: {feeds}  fetches: {fetches}  targets: {targets}")
+    if not args.diff_passes:
+        for i, ln in enumerate(_op_lines(program)):
+            print(f"[{i:3d}] {ln}")
+        return 0
+    total0 = len(program.global_block().ops)
+    total1 = total0
+    for r in diff_passes(program, targets):
+        delta = r["ops_before"] - r["ops_after"]
+        total1 = r["ops_after"]
+        print(f"== pass {r['pass']}: {r['ops_before']} -> "
+              f"{r['ops_after']} ops "
+              f"({'-' + str(delta) if delta else 'no change'})")
+        for ln in r["diff"]:
+            print(f"   {ln}")
+    print(f"== pipeline total: {total0} -> {total1} ops")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

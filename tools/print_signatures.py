@@ -19,6 +19,7 @@ MODULES = [
     "paddle_tpu.ops",
     "paddle_tpu.optimizer",
     "paddle_tpu.static",
+    "paddle_tpu.static.opt_passes",
     "paddle_tpu.io",
     "paddle_tpu.io_checkpoint",
     "paddle_tpu.nn",
