@@ -81,7 +81,7 @@ from paddle_tpu.monitor.registry import gauge as _gauge
 
 __all__ = ["launch_collective", "launch_ps", "find_free_ports",
            "backoff_delay", "probe_port_range", "elastic_join_dir",
-           "SHRINK_RC"]
+           "SHRINK_RC", "MIGRATE_RC"]
 
 PREEMPTED_RC = 143          # 128 + SIGTERM, the conventional code
 
@@ -92,6 +92,13 @@ PREEMPTED_RC = 143          # 128 + SIGTERM, the conventional code
 #: the dead rank. Any other failure code keeps today's same-size gang
 #: restart.
 SHRINK_RC = 31
+
+#: launch_ps exits with this code when a fleet-resize migration keeps
+#: failing past its retry budget: every attempt rolled back to the old
+#: epoch (no state was lost), the fleet still serves at its old size,
+#: but the requested resize was ABANDONED — see docs/DEBUGGING.md
+#: "my resize failed"
+MIGRATE_RC = 41
 
 #: the process exit-code vocabulary (docs/DEBUGGING.md table): naming
 #: the cause in the supervisor log turns "code 29" into something an
@@ -105,6 +112,9 @@ EXIT_CODE_LABELS = {
     37: "injected pserver crash (testing.faults; supervisor respawns "
         "it at the same endpoint, warm-booting from the last-good "
         "snapshot)",
+    41: "pserver fleet resize abandoned (every migration attempt "
+        "aborted + rolled back; the fleet still serves at its old "
+        "epoch/size — see DEBUGGING.md 'my resize failed')",
     124: "timeout",
     137: "SIGKILLed (OOM killer or kill -9)",
     139: "segfault",
@@ -140,6 +150,12 @@ _m_world = _gauge(
     "World size of the current gang incarnation (= --nproc_per_node "
     "until --min_ranks/--max_ranks elasticity moves it: shrinks on "
     "rank departure, grows on admitted join requests)")
+_m_ps_migration_aborts = _counter(
+    "ps_migration_aborts_total",
+    "Fleet-resize migration attempts the coordinator aborted and "
+    "rolled back to the old epoch (a crashed/unresponsive server or "
+    "a failed shadow verification mid-migration; the attempt is "
+    "retried up to the resize retry budget)")
 _m_ps_restarts = _counter(
     "ps_restarts_total",
     "Pserver processes the launcher respawned at their original "
@@ -310,6 +326,48 @@ def elastic_join_dir(log_dir):
     if not log_dir:
         return None
     return os.path.join(os.path.abspath(log_dir), "elastic")
+
+
+def _take_ps_resize_request(dirname):
+    """Consume (delete) the oldest pending pserver fleet-resize
+    trigger (``ps_grow.*`` / ``ps_shrink.*`` — same file-based
+    admission idiom as the collective gang's ``join.*``). Returns
+    "grow", "shrink", or None."""
+    if not dirname:
+        return None
+    try:
+        names = sorted(os.listdir(dirname))
+    except OSError:
+        return None
+    for n in names:
+        if n.startswith("ps_grow.") or n.startswith("ps_shrink."):
+            try:
+                os.remove(os.path.join(dirname, n))
+            except OSError:
+                continue
+            return "grow" if n.startswith("ps_grow.") else "shrink"
+    return None
+
+
+def _ps_retire_grace():
+    """Seconds a shrunk-away pserver keeps serving AFTER the epoch
+    commit (PT_PS_RETIRE_GRACE, default 2): in-flight client requests
+    land on a live server that answers WRONG_EPOCH with the new map
+    instead of a connection refusal."""
+    try:
+        return max(0.0, float(os.environ.get("PT_PS_RETIRE_GRACE",
+                                             "2")))
+    except ValueError:
+        return 2.0
+
+
+def _ps_resize_retries():
+    """Aborted-migration retry budget before the coordinator abandons
+    a resize and exits MIGRATE_RC (PT_PS_RESIZE_RETRIES, default 3)."""
+    try:
+        return max(1, int(os.environ.get("PT_PS_RESIZE_RETRIES", "3")))
+    except ValueError:
+        return 3
 
 
 def _take_join_requests(join_dir, room):
@@ -744,20 +802,34 @@ class _PsWatch:
 def launch_ps(script_args, server_num, worker_num, started_port=None,
               log_dir=None, env_extra=None, timeout=None, max_restarts=0,
               hang_timeout=None, grace_period=10.0,
-              ps_snapshot_secs=None):
+              ps_snapshot_secs=None, ps_min_servers=None,
+              ps_max_servers=None):
     host = "127.0.0.1"
+    if ps_max_servers is not None and ps_max_servers < server_num:
+        raise ValueError(f"--ps_max_servers {ps_max_servers} < "
+                         f"--server_num {server_num}")
+    if ps_min_servers is not None and ps_min_servers > server_num:
+        raise ValueError(f"--ps_min_servers {ps_min_servers} > "
+                         f"--server_num {server_num}")
+    # ports for the whole REACHABLE fleet are claimed up front: a grown
+    # server's endpoint must be deterministic before it exists
+    hi = max(server_num, ps_max_servers or server_num)
+    lo = max(1, ps_min_servers or 1)
     if started_port is None:
-        ports = find_free_ports(server_num, host)
+        ports = find_free_ports(hi, host)
         wports = find_free_ports(worker_num, host)
     else:
-        n = server_num + worker_num
+        n = hi + worker_num
         probe_port_range(
             host, started_port, n,
-            f"ps mode claims server_num+worker_num = {n} consecutive "
+            f"ps mode claims max_servers+worker_num = {n} consecutive "
             f"ports (pserver endpoints, then trainer exchange endpoints)")
-        ports = list(range(started_port, started_port + server_num))
-        wports = list(range(started_port + server_num, started_port + n))
-    server_eps = ",".join(f"{host}:{p}" for p in ports)
+        ports = list(range(started_port, started_port + hi))
+        wports = list(range(started_port + hi, started_port + n))
+    # the gang transpiles against the LAUNCH-time fleet only: ports
+    # reserved for --ps_max_servers growth stay out of the endpoint
+    # list, and clients discover grown servers via the epoch map
+    server_eps = ",".join(f"{host}:{p}" for p in ports[:server_num])
     # trainers also get their own endpoints: trainer-to-trainer traffic
     # (global_shuffle's sample exchange) rides these in PS mode too
     worker_eps = ",".join(f"{host}:{p}" for p in wports)
@@ -790,6 +862,25 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                  "(snapshots need somewhere durable); pserver "
                  "failover disabled")
     ps_elastic = ps_state_dir is not None and max_restarts > 0
+    # fleet elasticity (docs/ELASTIC_TRAINING.md "Resizing the pserver
+    # fleet"): grow/shrink requests arrive as ps_grow.*/ps_shrink.*
+    # trigger files, and the supervisor coordinates the epoch-fenced
+    # two-phase migration. Needs the snapshot dir (shadow staging +
+    # fleet_epoch.json live there).
+    fleet_elastic = ((ps_min_servers is not None
+                      or ps_max_servers is not None)
+                     and ps_state_dir is not None)
+    resize_dir = None
+    if fleet_elastic:
+        resize_dir = elastic_join_dir(log_dir)
+        os.makedirs(resize_dir, exist_ok=True)
+        _log(f"pserver fleet elasticity armed: {lo} <= servers <= "
+             f"{hi}; drop ps_grow.*/ps_shrink.* files in {resize_dir} "
+             f"to resize (epoch-fenced two-phase migration)")
+    elif ps_min_servers is not None or ps_max_servers is not None:
+        _log("--ps_min_servers/--ps_max_servers need --ps_snapshot_secs "
+             "and --log_dir (migration stages shadows in the snapshot "
+             "dir); fleet resizing disabled")
 
     def spawn_server(i, attempt=0):
         env = dict(os.environ, **(env_extra or {}), **cache_env)
@@ -814,6 +905,8 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
         if ps_state_dir:
             env["PT_PS_SNAPSHOT_DIR"] = ps_state_dir
             env["PT_PS_SNAPSHOT_SECS"] = str(ps_snapshot_secs)
+        if fleet_elastic:
+            env["PT_PS_ELASTIC"] = "1"
         return _spawn([sys.executable, "-u"] + script_args, env,
                       f"serverlog.{i}", log_dir, append=attempt > 0)
 
@@ -833,12 +926,18 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
             "PADDLE_HEARTBEAT_DIR": hb_dir,
             "PADDLE_RESTART_COUNT": str(attempt),
         })
+        if fleet_elastic:
+            # where a trainer (or an operator) drops resize triggers,
+            # and where it can watch fleet_epoch.json for the commit
+            env["PT_PS_ELASTIC_DIR"] = resize_dir
+            env["PT_PS_STATE_DIR"] = ps_state_dir
         return _spawn([sys.executable, "-u"] + script_args, env,
                       f"workerlog.{i}", log_dir, append=attempt > 0)
 
     servers, workers, logs = {}, {}, []
     restarts = [0] * worker_num
-    server_restarts = [0] * server_num
+    server_restarts = [0] * hi
+    active = list(range(server_num))    # indices of the serving fleet
     flagged_stragglers = set()          # per-launch straggler memory
     # pserver liveness probe: a wedged-but-alive pserver (process up,
     # request loop stuck) stalls every trainer with nothing else to
@@ -848,7 +947,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     # --ps_snapshot_secs a probe kill would turn a survivable stall
     # into job teardown, changing pre-failover --hang_timeout
     # semantics
-    ps_watch = (_PsWatch(server_num)
+    ps_watch = (_PsWatch(hi)
                 if hang_timeout is not None and server_num
                 and ps_elastic else None)
     ps_probe_interval = (max(0.5, min(hang_timeout / 3.0, 5.0))
@@ -885,6 +984,82 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
     pending_respawn = {}
     # pserver idx -> monotonic respawn time (same non-blocking idiom)
     pending_ps_respawn = {}
+    # one in-flight fleet-resize request: {"kind", "attempts", "due"}
+    pending_resize = None
+
+    def do_resize(kind):
+        """One epoch-fenced migration attempt (grow appends index
+        len(active), shrink retires max(active)). Returns None on
+        success; on any failure the migration has already rolled back
+        to the old epoch and the failure description is returned."""
+        from paddle_tpu.distributed import membership
+        cur_eps = [f"{host}:{ports[i]}" for i in active]
+        if kind == "grow":
+            ni = len(active)
+            name = f"pserver {ni}"
+            if name not in servers or servers[name].poll() is not None:
+                p, f = spawn_server(ni, server_restarts[ni])
+                servers[name] = p
+                logs.append(f)
+            new_ep = f"{host}:{ports[ni]}"
+            ready_by = time.monotonic() + 20.0
+            while True:
+                ok = ps_probe(new_ep, timeout=1.0)
+                if ok:
+                    break
+                if ok is None:
+                    # no wire codec in the launcher process means the
+                    # migration RPCs below cannot run either
+                    return ("wire codec unavailable in the launcher "
+                            "process; fleet resize needs it")
+                if servers[name].poll() is not None:
+                    return f"new pserver {ni} died while booting"
+                if time.monotonic() > ready_by:
+                    return f"new pserver {ni} not serving after 20s"
+                time.sleep(0.25)
+            new_eps = cur_eps + [new_ep]
+        else:
+            ni = max(active)
+            new_eps = [f"{host}:{ports[i]}" for i in active
+                       if i != ni]
+        # every participant must be SERVING (not merely alive) before
+        # the migration RPCs start: a respawned-but-still-booting
+        # server would otherwise burn a whole retry attempt
+        ready_by = time.monotonic() + 20.0
+        for ep in sorted(set(cur_eps) | set(new_eps)):
+            while not ps_probe(ep, timeout=1.0):
+                if time.monotonic() > ready_by:
+                    return f"pserver {ep} not serving; resize needs " \
+                           f"the whole fleet reachable"
+                time.sleep(0.25)
+        try:
+            epoch, rows = membership.run_migration(
+                ps_state_dir, cur_eps, new_eps, log=_log)
+        except membership.MigrationError as e:
+            return str(e)
+        if kind == "grow":
+            active.append(ni)
+        else:
+            active.remove(ni)
+            # retire grace: clients still routed at the old epoch
+            # learn the committed map via WRONG_EPOCH (or the
+            # EPOCH_MAP probe once this endpoint refuses) — give the
+            # in-flight requests a moment before the refusals start
+            time.sleep(_ps_retire_grace())
+            p = servers.pop(f"pserver {ni}", None)
+            if p is not None:
+                _drain([p], grace_period)
+            pending_ps_respawn.pop(ni, None)
+            if ps_watch:
+                ps_watch.forget(ni)
+        # the PS analog of the trainer-side sweep_stale_ranks: a
+        # retired server's rank<worker_num+i>.hb/.prom files must not
+        # linger in the metrics.prom aggregate
+        health.sweep_stale_ranks(hb_dir, worker_num + len(active))
+        _log(f"pserver fleet resize '{kind}' committed at epoch "
+             f"{epoch}: now {len(active)} server(s), {rows} row(s) "
+             f"migrated")
+        return None
 
     def fail_server(i, why):
         """Pserver restart policy (only reachable with failover armed):
@@ -986,9 +1161,50 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                 p, f = spawn_server(i, server_restarts[i])
                 servers[f"pserver {i}"] = p
                 logs.append(f)
+            if fleet_elastic and pending_resize is None:
+                kind = _take_ps_resize_request(resize_dir)
+                if kind == "grow" and len(active) >= hi:
+                    _log(f"ignoring pserver grow request: already at "
+                         f"--ps_max_servers ({hi})")
+                elif kind == "shrink" and len(active) <= lo:
+                    _log(f"ignoring pserver shrink request: already "
+                         f"at --ps_min_servers ({lo})")
+                elif kind:
+                    pending_resize = {"kind": kind, "attempts": 0,
+                                      "due": time.monotonic()}
+                    _log(f"pserver fleet resize requested: {kind} "
+                         f"(currently {len(active)} server(s))")
+            if (pending_resize is not None
+                    and time.monotonic() >= pending_resize["due"]
+                    and not pending_ps_respawn
+                    and all(p.poll() is None
+                            for p in servers.values())):
+                err = do_resize(pending_resize["kind"])
+                if err is None:
+                    pending_resize = None
+                else:
+                    # every failed attempt already rolled back to the
+                    # old epoch — nothing is lost, only not-yet-resized
+                    _m_ps_migration_aborts.inc()
+                    pending_resize["attempts"] += 1
+                    budget = _ps_resize_retries()
+                    if pending_resize["attempts"] >= budget:
+                        _log(f"pserver fleet resize "
+                             f"'{pending_resize['kind']}' ABANDONED "
+                             f"after {budget} aborted attempt(s) "
+                             f"(last: {err}); tearing down "
+                             f"[exit {MIGRATE_RC}]")
+                        _drain(all_procs(), grace_period)
+                        return MIGRATE_RC
+                    delay = backoff_delay(pending_resize["attempts"])
+                    _log(f"pserver fleet resize attempt "
+                         f"{pending_resize['attempts']}/{budget} "
+                         f"aborted + rolled back ({err}); retrying "
+                         f"in {delay:.1f}s")
+                    pending_resize["due"] = time.monotonic() + delay
             if ps_watch is not None and time.monotonic() >= next_ps_probe:
                 next_ps_probe = time.monotonic() + ps_probe_interval
-                for i in range(server_num):
+                for i in list(active):
                     p = servers.get(f"pserver {i}")
                     if (p is None or p.poll() is not None
                             or i in pending_ps_respawn):
@@ -1018,7 +1234,7 @@ def launch_ps(script_args, server_num, worker_num, started_port=None,
                     _drain([p], 0.0)
                     ps_watch.forget(i)
                 if ps_watch:
-                    for i in range(server_num):
+                    for i in list(active):
                         p = servers.get(f"pserver {i}")
                         if (p is not None and p.poll() is None
                                 and i not in pending_ps_respawn
@@ -1153,6 +1369,25 @@ def _parse_args(argv):
                          "Default: off (a pserver death tears the job "
                          "down, today's semantics). See "
                          "docs/ELASTIC_TRAINING.md 'Pserver failover'.")
+    ap.add_argument("--ps_min_servers", type=int, default=None,
+                    help="ps mode: arm pserver fleet elasticity — the "
+                         "fleet may shrink down to this floor via "
+                         "epoch-fenced live migration (requires "
+                         "--ps_snapshot_secs + --log_dir). A shrink is "
+                         "requested by dropping a file named "
+                         "ps_shrink.<anything> in <log_dir>/elastic/. "
+                         "Default: fixed fleet.")
+    ap.add_argument("--ps_max_servers", type=int, default=None,
+                    help="ps mode: allow the fleet to grow up to this "
+                         "ceiling (ports for the whole range are "
+                         "claimed up front; a grow is requested via a "
+                         "ps_grow.<anything> file in "
+                         "<log_dir>/elastic/). Each resize is a "
+                         "two-phase migration that rolls back on any "
+                         "failure; after PT_PS_RESIZE_RETRIES aborted "
+                         "attempts the job exits 41. See "
+                         "docs/ELASTIC_TRAINING.md 'Resizing the "
+                         "pserver fleet'.")
     ap.add_argument("--hang_timeout", type=float, default=None,
                     help="hang watchdog: kill+restart a gang whose rank "
                          "heartbeat once and then stopped for this many "
@@ -1181,7 +1416,9 @@ def main(argv=None):
                        max_restarts=args.max_restarts,
                        hang_timeout=args.hang_timeout,
                        grace_period=args.grace_period,
-                       ps_snapshot_secs=args.ps_snapshot_secs)
+                       ps_snapshot_secs=args.ps_snapshot_secs,
+                       ps_min_servers=args.ps_min_servers,
+                       ps_max_servers=args.ps_max_servers)
     else:
         nproc = args.nproc_per_node
         if nproc is None:

@@ -1,0 +1,382 @@
+"""Epoch-fenced elastic membership for the parameter-server fleet.
+
+Parity target: the reference fleet's pslib downpour resharding
+(framework/fleet — a production sparse-table fleet can grow or shrink
+without restarting the job). This module is the *control plane* for
+that: a monotonic **fleet epoch** pins a server list plus an explicit,
+epoch-versioned shard map (replacing the static var->endpoint modulo
+placement), and a resize is a two-phase migration driven by the
+`launch_ps` coordinator:
+
+  phase 1  the coordinator computes a delta plan (`plan_resize`) and
+           asks each source server (MIGRATE_PLAN) to stream the moving
+           units — whole dense vars and per-vshard slices of sparse
+           tables — to their targets (MIGRATE_BEGIN/CHUNK/END, each
+           chunk CRC-gated). Targets stage the state into durable
+           shadow files (`psshadow_*`, published through the
+           io_checkpoint publish/verify idiom, so a torn write is
+           detected, never adopted).
+  phase 2  the coordinator verifies every staged shadow, then performs
+           the single atomic commit: publishing `fleet_epoch.json`.
+           MIGRATE_COMMIT fans the new map out to the servers
+           (idempotent — a server that misses it reconciles from the
+           epoch file on respawn); sources retire moved units; clients
+           carrying a stale epoch are fenced with WRONG_EPOCH and
+           re-route (the PR-14 incarnation-token discipline, one level
+           up).
+
+Any failure before the epoch-file publish aborts: MIGRATE_ABORT
+unfreezes the sources, staged shadows are swept, and the old epoch
+stays in force — the coordinator retries with the same target epoch,
+so a half-done migration is never observable.
+"""
+
+import io
+import json
+import os
+import re
+import socket
+import time
+import zlib
+
+import numpy as np
+
+from paddle_tpu.distributed import wire
+from paddle_tpu import io_checkpoint as ioc
+
+# sparse tables are sharded into a fixed number of virtual shards; a
+# resize reassigns whole vshards, so the unit of migration is bounded
+# and the map stays a small JSON object regardless of table size
+NUM_VSHARDS = 8
+
+EPOCH_FILE = "fleet_epoch.json"
+
+_SHADOW_RE = re.compile(
+    r"^psshadow_(?P<tag>[A-Za-z0-9_\-]+)\.ep(?P<epoch>\d+)\."
+    r"(?P<unit>.+)\.npz$")
+
+
+class MigrationError(Exception):
+    """A migration attempt failed and was rolled back to the old epoch
+    (the coordinator may retry; nothing half-applied is observable)."""
+
+
+def vshard_of(ids):
+    """Deterministic vshard index for each sparse id (multiplicative
+    hash — splits consecutive id ranges instead of striding them)."""
+    ids = np.asarray(ids, np.int64).astype(np.uint64)
+    with np.errstate(over="ignore"):
+        h = ids * np.uint64(0x9E3779B97F4A7C15)
+        h ^= h >> np.uint64(29)
+    return (h % np.uint64(NUM_VSHARDS)).astype(np.int64)
+
+
+def dense_unit(name):
+    return "d/" + name
+
+
+def sparse_unit(table, v):
+    return f"s/{table}/{int(v)}"
+
+
+def parse_unit(unit):
+    """-> ("d", var_name, None) or ("s", table_name, vshard)."""
+    kind, rest = unit.split("/", 1)
+    if kind == "d":
+        return "d", rest, None
+    table, v = rest.rsplit("/", 1)
+    return "s", table, int(v)
+
+
+def tag_of_ep(endpoint):
+    """Filesystem-safe endpoint tag (matches ps._ps_tag)."""
+    host, port = endpoint.rsplit(":", 1)
+    return f"{host}_{port}".replace(".", "_")
+
+
+def shadow_path(state_dir, tag, epoch, unit):
+    safe = re.sub(r"[^A-Za-z0-9_.\-]", "_", unit)
+    return os.path.join(state_dir,
+                        f"psshadow_{tag}.ep{int(epoch)}.{safe}.npz")
+
+
+def list_shadows(state_dir, tag=None):
+    """[(path, tag, epoch, safe_unit)] for staged shadow files."""
+    out = []
+    try:
+        names = os.listdir(state_dir)
+    except OSError:
+        return out
+    for f in sorted(names):
+        m = _SHADOW_RE.match(f)
+        if m and (tag is None or m.group("tag") == tag):
+            out.append((os.path.join(state_dir, f), m.group("tag"),
+                        int(m.group("epoch")), m.group("unit")))
+    return out
+
+
+def pack_arrays(arrays):
+    """npz-pack an arrays dict into a u8 wire blob + its crc32 (the
+    SHUFFLE_PUSH blob idiom, plus the per-chunk CRC the migration
+    protocol gates on)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    raw = np.frombuffer(buf.getvalue(), np.uint8)
+    return raw, zlib.crc32(raw) & 0xFFFFFFFF
+
+
+def unpack_blob(blob):
+    """Inverse of pack_arrays -> {name: array}."""
+    raw = np.ascontiguousarray(np.asarray(blob, np.uint8))
+    with np.load(io.BytesIO(raw.tobytes()),
+                 allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+# -- epoch file (THE commit point) ----------------------------------------
+
+def epoch_file_path(state_dir):
+    return os.path.join(state_dir, EPOCH_FILE)
+
+
+def publish_epoch_file(state_dir, epoch, shard_map):
+    """Atomically publish the committed epoch + map. This single
+    os.replace IS the migration's commit point: everything before it
+    is abortable staging, everything after is reconcilable catch-up."""
+    ioc._publish_json_atomic(
+        epoch_file_path(state_dir),
+        {"epoch": int(epoch), "map": shard_map, "time": time.time()},
+        "." + EPOCH_FILE + ".")
+    ioc._fsync_dir(state_dir)
+
+
+def load_epoch_file(state_dir):
+    """Committed {"epoch", "map", "time"} or None when no resize has
+    ever committed (epoch 0 — the implicit static-placement epoch)."""
+    try:
+        with open(epoch_file_path(state_dir)) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except ValueError:
+        # unreachable for our own atomic publishes; treat a mangled
+        # hand-edited file as absent rather than wedging every respawn
+        return None
+
+
+# -- shard-map construction / resize planning -----------------------------
+
+def initial_map(servers, dense_owner, sparse_owner):
+    """Epoch-0 map from the static placement: dense var -> its hosting
+    endpoint, every vshard of a table -> the table's hosting endpoint."""
+    return {
+        "epoch": 0,
+        "servers": list(servers),
+        "dense": dict(dense_owner),
+        "sparse": {t: {str(v): ep for v in range(NUM_VSHARDS)}
+                   for t, ep in sparse_owner.items()},
+    }
+
+
+def _balance_vshards(owners, servers):
+    """Quota-balanced vshard assignment: keep the current owner while
+    it is under quota, reassign overflow to the underfull server with
+    the lowest index — minimal movement, fully deterministic."""
+    s_count = len(servers)
+    quota = {s: NUM_VSHARDS // s_count + (1 if i < NUM_VSHARDS % s_count
+                                          else 0)
+             for i, s in enumerate(servers)}
+    count = {s: 0 for s in servers}
+    out = {}
+    for v in range(NUM_VSHARDS):
+        o = owners[str(v)]
+        if o in count and count[o] < quota[o]:
+            out[str(v)] = o
+            count[o] += 1
+    for v in range(NUM_VSHARDS):
+        if str(v) in out:
+            continue
+        for s in servers:
+            if count[s] < quota[s]:
+                out[str(v)] = s
+                count[s] += 1
+                break
+    return out
+
+
+def plan_resize(cur_map, new_servers):
+    """Delta plan for moving from cur_map to a fleet of new_servers.
+
+    Returns (new_map, moves) where moves is a list of
+    (unit, src_endpoint, dst_endpoint). Dense vars keep their owner
+    when it survives, else round-robin over the new fleet in sorted
+    var order; sparse vshards rebalance under per-server quotas."""
+    new_servers = list(new_servers)
+    old_dense = cur_map.get("dense", {})
+    old_sparse = cur_map.get("sparse", {})
+    dense, rr = {}, 0
+    for name in sorted(old_dense):
+        owner = old_dense[name]
+        if owner in new_servers:
+            dense[name] = owner
+        else:
+            dense[name] = new_servers[rr % len(new_servers)]
+            rr += 1
+    sparse = {t: _balance_vshards(old_sparse[t], new_servers)
+              for t in sorted(old_sparse)}
+    moves = []
+    for name in sorted(dense):
+        if dense[name] != old_dense[name]:
+            moves.append((dense_unit(name), old_dense[name],
+                          dense[name]))
+    for table in sorted(sparse):
+        for v in range(NUM_VSHARDS):
+            o, n = old_sparse[table][str(v)], sparse[table][str(v)]
+            if o != n:
+                moves.append((sparse_unit(table, v), o, n))
+    new_map = {"epoch": int(cur_map.get("epoch", 0)) + 1,
+               "servers": new_servers, "dense": dense,
+               "sparse": sparse}
+    return new_map, moves
+
+
+# -- coordinator-side migration driver ------------------------------------
+
+def _rpc(ep, kind, fields, timeout=60.0):
+    """One control-plane call (client_id=0: dedup bypass; every
+    migration kind is idempotent-by-state). ERR replies raise."""
+    host, port = ep.rsplit(":", 1)
+    with socket.create_connection((host, int(port)),
+                                  timeout=timeout) as s:
+        s.settimeout(timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        wire.send_frame(s, kind, fields)
+        rk, _, _, rf = wire.recv_frame(s)
+    if rk == wire.ERR:
+        raise MigrationError(f"{ep}: {rf[0]}")
+    return rk, rf
+
+
+def _split_names(blob):
+    return [n for n in blob.split("\n") if n]
+
+
+def inventory_map(endpoints):
+    """Build the implicit epoch-0 map by asking each live server what
+    it hosts (LIST_VARS — the same probe ps_probe rides)."""
+    dense_owner, sparse_owner = {}, {}
+    for ep in endpoints:
+        rk, rf = _rpc(ep, wire.LIST_VARS, ())
+        if rk != wire.OK_NAMES:
+            raise MigrationError(
+                f"{ep}: unexpected LIST_VARS reply kind {rk}")
+        for n in _split_names(rf[0]):
+            if dense_owner.setdefault(n, ep) != ep:
+                raise MigrationError(
+                    f"dense var {n!r} hosted on both "
+                    f"{dense_owner[n]} and {ep}: static placement "
+                    f"is ambiguous, refusing to build an epoch map")
+        for t in _split_names(rf[1]):
+            if sparse_owner.setdefault(t, ep) != ep:
+                raise MigrationError(
+                    f"sparse table {t!r} hosted on both "
+                    f"{sparse_owner[t]} and {ep}: static placement "
+                    f"is ambiguous, refusing to build an epoch map")
+    return initial_map(endpoints, dense_owner, sparse_owner)
+
+
+def sweep_epoch_shadows(state_dir, epoch):
+    """Remove every staged shadow for an (aborted) epoch, any tag."""
+    for path, _tag, ep_n, _unit in list_shadows(state_dir):
+        if ep_n == int(epoch):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def _abort(state_dir, endpoints, epoch, say):
+    msg = json.dumps({"epoch": int(epoch)})
+    for ep in sorted(endpoints):
+        try:
+            _rpc(ep, wire.MIGRATE_ABORT, (msg,), timeout=10.0)
+        except Exception:
+            pass  # dead server: its respawn sweeps staging itself
+    sweep_epoch_shadows(state_dir, epoch)
+    say(f"migration to epoch {epoch} aborted; epoch {epoch - 1} "
+        f"stays in force")
+
+
+def run_migration(state_dir, cur_endpoints, new_endpoints, log=None,
+                  rpc_timeout=120.0):
+    """Drive one two-phase resize. Returns (epoch, rows_moved) on
+    success; raises MigrationError after rolling back on any failure
+    before the commit point. Retrying with the same arguments reuses
+    the same target epoch, so a retry after an abort is idempotent."""
+    say = log or (lambda m: None)
+    cur_endpoints = list(cur_endpoints)
+    new_endpoints = list(new_endpoints)
+    cur = load_epoch_file(state_dir)
+    if cur is not None:
+        cur_map = dict(cur["map"], servers=cur_endpoints)
+        cur_map["epoch"] = int(cur["epoch"])
+    else:
+        cur_map = inventory_map(cur_endpoints)
+    new_map, moves = plan_resize(cur_map, new_endpoints)
+    epoch = int(new_map["epoch"])
+    say(f"migration to epoch {epoch}: {len(moves)} unit(s) move "
+        f"({len(cur_endpoints)} -> {len(new_endpoints)} servers)")
+    rows = 0
+    all_eps = set(cur_endpoints) | set(new_endpoints)
+    try:
+        by_src = {}
+        for unit, src, dst in moves:
+            by_src.setdefault(src, []).append({"unit": unit, "to": dst})
+        for src in sorted(by_src):
+            plan = {"epoch": epoch, "units": by_src[src]}
+            rk, rf = _rpc(src, wire.MIGRATE_PLAN, (json.dumps(plan),),
+                          timeout=rpc_timeout)
+            if rk != wire.OK_ARR:
+                raise MigrationError(
+                    f"source {src}: unexpected reply kind {rk}")
+            rows += int(np.asarray(rf[0]).reshape(-1)[0])
+        # phase-2 gate: every staged shadow must exist, verify, and
+        # describe the unit we expect (the TORN-fault catch point)
+        for unit, _src, dst in moves:
+            p = shadow_path(state_dir, tag_of_ep(dst), epoch, unit)
+            try:
+                manifest, _ = ioc.verify_npz(p)
+            except Exception as e:
+                raise MigrationError(
+                    f"staged shadow {os.path.basename(p)}: "
+                    f"{type(e).__name__}: {e}")
+            body = {k: v for k, v in (manifest or {}).items()
+                    if k != "integrity"}
+            if body.get("unit") != unit or \
+                    int(body.get("epoch", -1)) != epoch:
+                raise MigrationError(
+                    f"staged shadow {os.path.basename(p)} describes "
+                    f"{body.get('unit')!r}@{body.get('epoch')!r}, "
+                    f"expected {unit!r}@{epoch}")
+    except MigrationError:
+        _abort(state_dir, all_eps, epoch, say)
+        raise
+    except Exception as e:
+        _abort(state_dir, all_eps, epoch, say)
+        raise MigrationError(f"{type(e).__name__}: {e}")
+    # THE commit point: one atomic publish
+    publish_epoch_file(state_dir, epoch, new_map)
+    say(f"fleet epoch {epoch} committed ({rows} row(s) migrated)")
+    commit = json.dumps({"epoch": epoch, "map": new_map})
+    for ep in sorted(all_eps):
+        for _attempt in range(3):
+            try:
+                _rpc(ep, wire.MIGRATE_COMMIT, (commit,),
+                     timeout=rpc_timeout)
+                break
+            except Exception:
+                time.sleep(0.2)
+        else:
+            say(f"MIGRATE_COMMIT to {ep} failed; its respawn "
+                f"reconciles from {EPOCH_FILE}")
+    return epoch, rows

@@ -63,6 +63,7 @@ from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.flags import define_flag, get_flag
 from paddle_tpu.distributed import wire
 from paddle_tpu.monitor.registry import counter as _counter
+from paddle_tpu.monitor.registry import gauge as _gauge
 from paddle_tpu.monitor.registry import histogram as _histogram
 
 __all__ = ["ParameterServer", "NativeParameterServer", "PSClient",
@@ -87,6 +88,27 @@ _m_stale_rounds = _counter(
     "Optimizer rounds a restarted pserver lost between its last "
     "snapshot and the crash, as observed by reconnecting clients "
     "re-establishing their sync-round expectations")
+_m_epoch = _gauge(
+    "ps_epoch",
+    "Committed fleet-membership epoch this pserver serves (0 = the "
+    "implicit static-placement epoch: no resize has ever committed)")
+_m_migrated = _counter(
+    "ps_migrated_rows_total",
+    "Sparse rows + dense vars this pserver adopted across committed "
+    "fleet-resize migrations (counted once, at MIGRATE_COMMIT / the "
+    "warm-boot epoch reconcile)")
+
+
+def _migrate_fault_point(stage, path=None):
+    """Migration chaos hook: no-op in production. The stage boundaries
+    the elastic protocol crosses — \"plan\" (source, before freezing),
+    \"chunk\" (source, before streaming a unit), \"staged\" (target,
+    after publishing a unit's durable shadow; ``path`` names it) and
+    \"commit\" (any server, at MIGRATE_COMMIT entry) — are exactly the
+    points ``testing.faults.install_ps_migrate_faults`` patches to
+    crash (PT_FAULT_PS_MIGRATE_CRASH) or tear a staged shadow
+    (PT_FAULT_PS_MIGRATE_TORN)."""
+
 
 define_flag("ps_transport", "auto",
             "PS server transport: auto (C++ when the hosted state is "
@@ -277,7 +299,7 @@ def _ps_publish_json(path, obj):
 
 
 def _ps_checkpoint_save(dirname, host, port, dense, sparse_tables,
-                        incarnation=0, keep=2):
+                        incarnation=0, keep=2, epoch=0, shard_map=None):
     """The pserver checkpoint artifact contract, shared by BOTH
     transports (cross-transport restore depends on it): one
     generation-tagged artifact set per save —
@@ -321,10 +343,18 @@ def _ps_checkpoint_save(dirname, host, port, dense, sparse_tables,
             {"ids": ids, "rows": rows, "accum": accum},
             {"kind": "pserver_table", "endpoint": tag, "table": n,
              "gen": gen})
-    _ps_publish_json(_ps_meta_path(dirname, tag, gen), {
+    meta = {
         "gen": gen, "endpoint": tag, "incarnation": int(incarnation),
         "tables": sorted(sparse_tables), "time": time.time(),
-    })
+        # fleet-membership record (docs/ELASTIC_TRAINING.md "Resizing
+        # the pserver fleet"): a snapshot taken at epoch E restores
+        # into epoch E — the warm-boot reconcile and fsck's
+        # --num-servers verdict both read these
+        "epoch": int(epoch),
+    }
+    if shard_map is not None:
+        meta["shard_map"] = shard_map
+    _ps_publish_json(_ps_meta_path(dirname, tag, gen), meta)
     # prune: meta FIRST (a killed prune must leave meta-less artifacts
     # — invisible to restore — never a meta promising missing files)
     complete = _ps_complete_gens(dirname, tag)
@@ -401,7 +431,7 @@ def _ps_load_legacy(dirname, tag, apply_dense, sparse_tables):
 
 
 def _ps_checkpoint_load(dirname, host, port, apply_dense,
-                        sparse_tables):
+                        sparse_tables, make_table=None):
     """Counterpart of ``_ps_checkpoint_save``: restore the newest
     complete generation that VERIFIES, walking back past corrupt ones.
 
@@ -458,10 +488,15 @@ def _ps_checkpoint_load(dirname, host, port, apply_dense,
             state = ((int(st["round"]), int(st["step"]))
                      if st else None)
             apply_dense(n, v, state, slots.get(n))
-        for t, table in sparse_tables.items():
-            tb = table_blobs.get(t)
-            if tb is None:
-                continue        # table added since this snapshot
+        for t, tb in table_blobs.items():
+            table = sparse_tables.get(t)
+            if table is None and make_table is not None:
+                # elastic warm boot: the table was adopted via
+                # migration (hosted from a recipe, not the program),
+                # so re-create it before restoring its rows
+                table = make_table(t)
+            if table is None:
+                continue        # table not hosted here
             table.restore(tb["ids"], tb["rows"], tb.get("accum"))
         if quarantined:
             _ps_log(f"restored from last-good snapshot generation "
@@ -472,6 +507,13 @@ def _ps_checkpoint_load(dirname, host, port, apply_dense,
             f"corrupt ({quarantined} quarantined); starting from "
             f"initial values")
     return None
+
+
+class _UnitRetired(Exception):
+    """The hosted unit was migrated away mid-request (elastic resize
+    committed while this request waited): the handler converts this
+    into a WRONG_EPOCH reply, and the client re-routes via the new
+    shard map — nothing was applied here."""
 
 
 class _DenseVar:
@@ -494,6 +536,7 @@ class _DenseVar:
         self.round = 0
         self.accum = None              # sum of grads this round
         self.pushed = set()            # trainer ids seen this round
+        self.evicted = False           # migrated away (elastic resize)
         self.cv = threading.Condition()
         self._native = None            # (lib, kind) once probed
 
@@ -642,12 +685,20 @@ class _DenseVar:
 
     def push_sync(self, trainer_id, grad, num_trainers, timeout=120.0):
         with self.cv:
+            if self.evicted:
+                raise _UnitRetired("var migrated away")
             if trainer_id in self.pushed:
                 # stale duplicate (e.g. retry) — wait for next round
                 ok = self.cv.wait_for(
-                    lambda: trainer_id not in self.pushed, timeout=timeout)
+                    lambda: trainer_id not in self.pushed
+                    or self.evicted, timeout=timeout)
                 enforce(ok, f"duplicate push from trainer {trainer_id} "
                             f"timed out waiting for round fan-in")
+                if self.evicted:
+                    # the round this duplicate waited on (including
+                    # this trainer's FIRST push) migrated verbatim —
+                    # this push re-routes and applies at the new owner
+                    raise _UnitRetired("var migrated away mid-fan-in")
             self._accumulate(grad)
             self.pushed.add(trainer_id)
             if len(self.pushed) >= num_trainers:
@@ -665,9 +716,14 @@ class _DenseVar:
 
     def pull(self, min_round, timeout=120.0):
         with self.cv:
-            ok = self.cv.wait_for(lambda: self.round >= min_round,
-                                  timeout=timeout)
+            ok = self.cv.wait_for(
+                lambda: self.round >= min_round or self.evicted,
+                timeout=timeout)
             enforce(ok, f"pull timed out waiting for round {min_round}")
+            if self.evicted and self.round < min_round:
+                # the rounds this pull waits for will complete at the
+                # NEW owner (partial fan-in state migrated verbatim)
+                raise _UnitRetired("var migrated away mid-round")
             return self.value
 
 
@@ -829,7 +885,10 @@ class _SnapshotLoop:
             t0 = time.perf_counter()
             _ps_checkpoint_save(dirname, self.host, self.port,
                                 self._dense_export(), self.sparse,
-                                incarnation=self.incarnation)
+                                incarnation=self.incarnation,
+                                epoch=getattr(self, "epoch", 0),
+                                shard_map=getattr(self, "shard_map",
+                                                  None))
             _m_snap_saves.inc()
             _m_snap_ms.observe((time.perf_counter() - t0) * 1e3)
 
@@ -881,6 +940,49 @@ class _SnapshotLoop:
                         f"{type(e).__name__}: {e}")
 
 
+def _row_chunks(ids, rows, accum):
+    """Split one vshard's rows into wire-sized chunks (ids/rows/accum
+    sliced together). Always returns at least one chunk so an empty
+    vshard still stages a (valid, empty) shadow at the target."""
+    if ids.size == 0:
+        return [{"ids": ids, "rows": rows, "accum": accum}]
+    per_row = int(rows.itemsize
+                  * (rows.shape[1] if rows.ndim > 1 else 1)) * 2 + 8
+    cap_bytes = max(1, min(wire.max_message_bytes() // 2, 4 << 20))
+    cap = max(1, cap_bytes // max(per_row, 1))
+    return [{"ids": ids[i:i + cap], "rows": rows[i:i + cap],
+             "accum": accum[i:i + cap]}
+            for i in range(0, int(ids.size), cap)]
+
+
+def _merge_parts(parts):
+    if len(parts) == 1:
+        return parts[0]
+    return {k: np.concatenate([p[k] for p in parts], axis=0)
+            for k in parts[0]}
+
+
+def _unit_owned_by(shard_map, unit, me):
+    from paddle_tpu.distributed import membership as mb
+    kind, name, vsh = mb.parse_unit(unit)
+    if kind == "d":
+        return shard_map.get("dense", {}).get(name) == me
+    owners = (shard_map.get("sparse") or {}).get(name, {})
+    return owners.get(str(vsh)) == me
+
+
+# Epoch-fenced data kinds → their legacy twins. The _E variants carry
+# the client's committed fleet epoch as field 0; the server strips it,
+# fences, and dispatches the legacy arm (docs/ELASTIC_TRAINING.md
+# "Resizing the pserver fleet").
+_EPOCH_KINDS = {
+    wire.PUSH_GRAD_E: wire.PUSH_GRAD,
+    wire.PULL_PARAM_E: wire.PULL_PARAM,
+    wire.PULL_SPARSE_E: wire.PULL_SPARSE,
+    wire.PUSH_SPARSE_E: wire.PUSH_SPARSE,
+}
+
+
 class ParameterServer(_SnapshotLoop):
     """listen_and_serv parity: hosts a set of dense vars + sparse tables,
     applies optimizer updates on grad fan-in, serves pulls/barriers/
@@ -900,6 +1002,20 @@ class ParameterServer(_SnapshotLoop):
         self._barrier_gen = {}
         self._server = None
         self._thread = None
+        # elastic fleet membership (docs/ELASTIC_TRAINING.md "Resizing
+        # the pserver fleet"): the committed epoch + shard map this
+        # server fences data frames against (None = the implicit
+        # epoch-0 static placement — no fencing, today's behavior),
+        # hosting recipes for units migrated IN, the shadow-staging
+        # dir, and the freeze gate migration holds over moving units
+        self.epoch = 0
+        self.shard_map = None
+        self.recipes = {}
+        self.state_dir = None
+        self._mig_cv = threading.Condition()
+        self._frozen = set()          # unit keys mid-migration
+        self._busy = {}               # unit key -> in-flight op count
+        self._staged = {}             # epoch -> {unit: entry}
         # retry dedup for mutating requests (grpc retry-idempotence
         # role): per-client bounded LRU of seq -> cached reply, plus an
         # in-flight set so a retry that races the original request
@@ -939,28 +1055,140 @@ class ParameterServer(_SnapshotLoop):
         self.sparse[name] = _SparseTable(dim, initializer, seed, lr,
                                          optimizer)
 
+    # -- elastic-membership fencing (docs/ELASTIC_TRAINING.md) --------------
+    def _map_json(self):
+        return json.dumps(self.shard_map or {})
+
+    def _fence_reply(self):
+        """WRONG_EPOCH carrying the committed epoch + map: the client
+        adopts the newer map and re-routes without a second probe.
+        Nothing was applied when this reply is sent."""
+        return (wire.WRONG_EPOCH, (int(self.epoch), self._map_json()))
+
+    def _owns_dense(self, name):
+        if self.shard_map is None:
+            return True
+        return self.shard_map.get("dense", {}).get(
+            name, self.endpoint) == self.endpoint
+
+    def _owns_sparse(self, name, ids):
+        if self.shard_map is None:
+            return True
+        owners = (self.shard_map.get("sparse") or {}).get(name)
+        if owners is None:
+            return True           # table outside the map: static route
+        from paddle_tpu.distributed import membership as mb
+        me = self.endpoint
+        return all(owners.get(str(int(v))) == me
+                   for v in np.unique(mb.vshard_of(ids)))
+
+    def _sparse_units(self, name, ids):
+        from paddle_tpu.distributed import membership as mb
+        return {mb.sparse_unit(name, int(v))
+                for v in np.unique(mb.vshard_of(ids))}
+
+    def _admit(self, epoch, units, owned, timeout=150.0):
+        """Epoch fence + ownership check + migration-freeze gate for
+        one data request. Returns a WRONG_EPOCH reply to send, or None
+        after marking the units busy (caller must _release(units)).
+        Requests touching a frozen (mid-migration) unit wait here and
+        re-evaluate the fence — after a commit they bounce with
+        WRONG_EPOCH instead of mutating a retired shard."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if epoch is not None and int(epoch) != self.epoch:
+                return self._fence_reply()
+            if self.shard_map is not None and not owned():
+                return self._fence_reply()
+            with self._mig_cv:
+                if not (self._frozen & units):
+                    for u in units:
+                        self._busy[u] = self._busy.get(u, 0) + 1
+                    return None
+                left = deadline - time.monotonic()
+                enforce(left > 0, "request blocked on a migration "
+                                  "freeze that never released")
+                self._mig_cv.wait(timeout=min(left, 1.0))
+
+    def _release(self, units):
+        with self._mig_cv:
+            for u in units:
+                n = self._busy.get(u, 0) - 1
+                if n <= 0:
+                    self._busy.pop(u, None)
+                else:
+                    self._busy[u] = n
+            self._mig_cv.notify_all()
+
     # -- request handling (request_handler_impl.cc parity) -----------------
     def _handle(self, kind, fields):
         """Dispatch one decoded request; returns (resp_kind, fields)."""
+        epoch = None
+        legacy = _EPOCH_KINDS.get(kind)
+        if legacy is not None:
+            epoch, fields = int(fields[0]), fields[1:]
+            kind = legacy
         if kind == wire.PUSH_GRAD:
             name, trainer_id, grad = fields
-            v = self.dense[name]
-            if self.sync_mode:
-                v.push_sync(int(trainer_id), grad, self.num_trainers)
-            else:
-                v.push_async(grad)
+            units = {"d/" + name}
+            gate = self._admit(epoch, units,
+                               lambda: self._owns_dense(name))
+            if gate is not None:
+                return gate
+            try:
+                v = self.dense[name]
+                if self.sync_mode:
+                    v.push_sync(int(trainer_id), grad,
+                                self.num_trainers)
+                else:
+                    v.push_async(grad)
+            except _UnitRetired:
+                return self._fence_reply()
+            finally:
+                self._release(units)
             return (wire.OK, ())
         if kind == wire.PULL_PARAM:
             name, min_round = fields
             if not self.sync_mode:
                 min_round = 0
-            return (wire.OK_ARR, (self.dense[name].pull(int(min_round)),))
+            # fence + ownership only — no freeze gate: a sync pull may
+            # legitimately wait minutes for a round fan-in, and holding
+            # the busy count through that wait would deadlock the
+            # migration drain against the pushes it gates
+            if epoch is not None and int(epoch) != self.epoch:
+                return self._fence_reply()
+            if self.shard_map is not None and \
+                    not self._owns_dense(name):
+                return self._fence_reply()
+            try:
+                return (wire.OK_ARR,
+                        (self.dense[name].pull(int(min_round)),))
+            except _UnitRetired:
+                return self._fence_reply()
         if kind == wire.PULL_SPARSE:
+            # the python-store pull MATERIALIZES missing rows — it
+            # mutates, so it takes the freeze gate like a push
             name, ids = fields
-            return (wire.OK_ARR, (self.sparse[name].pull(ids),))
+            units = self._sparse_units(name, ids)
+            gate = self._admit(epoch, units,
+                               lambda: self._owns_sparse(name, ids))
+            if gate is not None:
+                return gate
+            try:
+                return (wire.OK_ARR, (self.sparse[name].pull(ids),))
+            finally:
+                self._release(units)
         if kind == wire.PUSH_SPARSE:
             name, ids, grads, lr = fields
-            self.sparse[name].push(ids, grads, lr)
+            units = self._sparse_units(name, ids)
+            gate = self._admit(epoch, units,
+                               lambda: self._owns_sparse(name, ids))
+            if gate is not None:
+                return gate
+            try:
+                self.sparse[name].push(ids, grads, lr)
+            finally:
+                self._release(units)
             return (wire.OK, ())
         if kind == wire.BARRIER:
             tag, trainer_id = fields
@@ -1011,7 +1239,364 @@ class ParameterServer(_SnapshotLoop):
             threading.Thread(target=stop_after_grace,
                              daemon=True).start()
             return (wire.OK, ())
+        if kind == wire.MIGRATE_PLAN:
+            return self._migrate_source(json.loads(fields[0]))
+        if kind == wire.MIGRATE_BEGIN:
+            return self._migrate_begin(json.loads(fields[0]))
+        if kind == wire.MIGRATE_CHUNK:
+            meta, blob, crc = fields
+            return self._migrate_chunk(json.loads(meta), blob,
+                                       int(crc))
+        if kind == wire.MIGRATE_END:
+            return self._migrate_end(json.loads(fields[0]))
+        if kind == wire.MIGRATE_COMMIT:
+            spec = json.loads(fields[0])
+            return self._migrate_commit(int(spec["epoch"]),
+                                        spec["map"])
+        if kind == wire.MIGRATE_ABORT:
+            return self._migrate_abort(
+                int(json.loads(fields[0])["epoch"]))
+        if kind == wire.EPOCH_MAP:
+            return (wire.OK_JSON,
+                    (json.dumps({"epoch": int(self.epoch),
+                                 "map": self.shard_map}),))
         return (wire.ERR, (f"unhandled request kind {kind}",))
+
+    # -- two-phase migration: source side ----------------------------------
+    def _migrate_source(self, plan):
+        """MIGRATE_PLAN from the coordinator: freeze the moving units,
+        drain in-flight writes, stream every unit to its target, and
+        stay frozen until COMMIT or ABORT resolves the epoch. Any
+        failure unfreezes and replies ERR — the ERR reply is the
+        coordinator's abort trigger, and once unfrozen this (still
+        authoritative) server keeps applying writes at the old epoch."""
+        _migrate_fault_point("plan")
+        epoch = int(plan["epoch"])
+        units = [(u["unit"], u["to"]) for u in plan["units"]]
+        names = {u for u, _ in units}
+        with self._mig_cv:
+            self._frozen |= names
+            ok = self._mig_cv.wait_for(
+                lambda: not any(self._busy.get(u) for u in names),
+                timeout=30.0)
+            if not ok:
+                self._frozen -= names
+                self._mig_cv.notify_all()
+                return (wire.ERR,
+                        ("migration freeze drain timed out",))
+        by_target = {}
+        for u, to in units:
+            by_target.setdefault(to, []).append(u)
+        rows = 0
+        try:
+            for to in sorted(by_target):
+                rows += self._stream_units(to, epoch, by_target[to])
+        except Exception as e:                      # noqa: BLE001
+            with self._mig_cv:
+                self._frozen -= names
+                self._mig_cv.notify_all()
+            return (wire.ERR,
+                    (f"migration stream failed: "
+                     f"{type(e).__name__}: {e}",))
+        return (wire.OK_ARR, (np.asarray([rows], np.int64),))
+
+    def _stream_units(self, to, epoch, units):
+        from paddle_tpu.distributed import membership as mb
+        mb._rpc(to, wire.MIGRATE_BEGIN,
+                (json.dumps({"epoch": epoch, "from": self.endpoint,
+                             "units": list(units)}),))
+        rows = 0
+        for unit in sorted(units):
+            _migrate_fault_point("chunk")
+            kind, name, vsh = mb.parse_unit(unit)
+            if kind == "d":
+                chunks = [self._export_dense_unit(name)]
+                rows += 1
+            else:
+                ids, vals, accum = self.sparse[name].snapshot()
+                sel = mb.vshard_of(ids) == vsh
+                ids, vals = ids[sel], vals[sel]
+                accum = accum[sel] if accum is not None else \
+                    np.zeros_like(vals)
+                rows += int(ids.size)
+                chunks = _row_chunks(ids, vals, accum)
+            last = len(chunks) - 1
+            for i, arrays in enumerate(chunks):
+                blob, crc = mb.pack_arrays(arrays)
+                mb._rpc(to, wire.MIGRATE_CHUNK,
+                        (json.dumps({"unit": unit, "epoch": epoch,
+                                     "seq": i,
+                                     "last": i == last}),
+                         blob, crc))
+        mb._rpc(to, wire.MIGRATE_END,
+                (json.dumps({"epoch": epoch, "from": self.endpoint,
+                             "units": list(units)}),))
+        return rows
+
+    def _export_dense_unit(self, name):
+        """Copy a dense var for the wire — including the mid-round
+        fan-in (accum + pushed set): with multiple trainers a round may
+        be half-collected at freeze time, and the target must resume
+        the fan-in exactly where the source stopped or the round
+        double-counts the already-pushed trainers."""
+        v = self.dense[name]
+        with v.cv:
+            out = {"value": np.array(v.value, copy=True),
+                   "round": np.asarray([v.round], np.int64),
+                   "step": np.asarray([v.step_count], np.int64),
+                   "pushed": np.asarray(sorted(v.pushed), np.int64)}
+            if v.accum is not None:
+                out["accum"] = np.array(v.accum, copy=True)
+            if v.slots:
+                for k, s in v.slots.items():
+                    out["slot/" + k] = np.array(s, copy=True)
+        return out
+
+    # -- two-phase migration: target side ----------------------------------
+    def _migrate_begin(self, spec):
+        epoch = int(spec["epoch"])
+        for unit in spec["units"]:
+            from paddle_tpu.distributed import membership as mb
+            kind, name, _ = mb.parse_unit(unit)
+            hosted = name in (self.dense if kind == "d"
+                              else self.sparse)
+            if not hosted and name not in self.recipes:
+                return (wire.ERR,
+                        (f"no hosting recipe for migrated "
+                         f"unit {unit!r}",))
+        with self._mig_cv:
+            stage = self._staged.setdefault(epoch, {})
+            for unit in spec["units"]:
+                stage[unit] = {"parts": [],
+                               "from": spec.get("from")}
+        return (wire.OK, ())
+
+    def _migrate_chunk(self, meta, blob, crc):
+        from paddle_tpu.distributed import membership as mb
+        import zlib
+        if zlib.crc32(blob.tobytes()) & 0xFFFFFFFF != crc:
+            return (wire.ERR,
+                    (f"migration chunk CRC mismatch for "
+                     f"{meta.get('unit')!r}",))
+        epoch, unit = int(meta["epoch"]), meta["unit"]
+        with self._mig_cv:
+            ent = self._staged.get(epoch, {}).get(unit)
+        if ent is None:
+            return (wire.ERR,
+                    (f"chunk for unstaged unit {unit!r}",))
+        ent["parts"].append(mb.unpack_blob(blob))
+        return (wire.OK, ())
+
+    def _migrate_end(self, spec):
+        """Source finished streaming: merge the chunks and publish each
+        unit as a durable, CRC-manifested shadow file. The shadow is
+        what survives a target crash between staging and commit — the
+        warm-boot reconcile adopts it if the epoch file says we won."""
+        from paddle_tpu.distributed import membership as mb
+        from paddle_tpu import io_checkpoint as ioc
+        if not self.state_dir:
+            return (wire.ERR,
+                    ("target has no state_dir for shadow staging",))
+        epoch = int(spec["epoch"])
+        tag = mb.tag_of_ep(self.endpoint)
+        staged_rows = 0
+        for unit in spec["units"]:
+            with self._mig_cv:
+                ent = self._staged.get(epoch, {}).get(unit)
+            if ent is None:
+                return (wire.ERR,
+                        (f"END for unstaged unit {unit!r}",))
+            arrays = _merge_parts(ent["parts"])
+            ent["arrays"] = arrays
+            path = mb.shadow_path(self.state_dir, tag, epoch, unit)
+            ioc.publish_npz(path, arrays,
+                            {"kind": "pserver_shadow",
+                             "endpoint": self.endpoint,
+                             "epoch": epoch, "unit": unit})
+            _migrate_fault_point("staged", path)
+            ids = arrays.get("ids")
+            staged_rows += int(ids.size) if ids is not None else 1
+        return (wire.OK_ARR,
+                (np.asarray([staged_rows], np.int64),))
+
+    # -- two-phase migration: resolution -----------------------------------
+    def _migrate_commit(self, epoch, new_map):
+        """Coordinator published fleet_epoch.json (the commit point)
+        and is now telling everyone. Idempotent: a retried COMMIT after
+        we already moved to `epoch` is a no-op ack. Adopt what we
+        staged, retire what we lost, serve the new epoch."""
+        _migrate_fault_point("commit")
+        if self.epoch >= epoch:
+            return (wire.OK_ARR,
+                    (np.asarray([self.epoch], np.int64),))
+        with self._mig_cv:
+            staged = self._staged.pop(epoch, {})
+        from paddle_tpu.distributed import membership as mb
+        adopted = 0
+        for unit in sorted(staged):
+            if not _unit_owned_by(new_map, unit, self.endpoint):
+                continue
+            ent = staged[unit]
+            arrays = ent.get("arrays")
+            if arrays is None:
+                # crashed-and-respawned between END and COMMIT: the
+                # in-memory parts are gone but the shadow survived
+                from paddle_tpu import io_checkpoint as ioc
+                tag = mb.tag_of_ep(self.endpoint)
+                path = mb.shadow_path(self.state_dir, tag, epoch,
+                                      unit)
+                try:
+                    _, arrays = ioc.verify_npz(path)
+                except Exception as e:              # noqa: BLE001
+                    return (wire.ERR,
+                            (f"staged shadow for {unit!r} "
+                             f"unreadable: {e}",))
+            adopted += self._adopt_unit(unit, arrays)
+        if adopted:
+            _m_migrated.inc(adopted)
+        self._retire_units(new_map)
+        self.epoch = int(epoch)
+        self.shard_map = new_map
+        _m_epoch.set(self.epoch)
+        with self._mig_cv:
+            self._frozen.clear()
+            for e in [e for e in self._staged if e <= epoch]:
+                self._staged.pop(e, None)
+            self._mig_cv.notify_all()
+        snap_dir = getattr(self, "_snap_dir", None)
+        saved = True
+        if snap_dir:
+            try:
+                self.save(snap_dir)
+            except Exception as e:                  # noqa: BLE001
+                # keep the staged shadows: until a snapshot holding
+                # the adopted rows lands, they are the only durable
+                # copy — a crash now must find them at respawn
+                saved = False
+                _ps_log(f"post-commit snapshot failed: {e}")
+        if saved:
+            self._sweep_my_shadows(max_epoch=epoch)
+        _ps_log(f"committed fleet epoch {epoch} "
+                f"(adopted {adopted} rows)")
+        return (wire.OK_ARR,
+                (np.asarray([self.epoch], np.int64),))
+
+    def _migrate_abort(self, epoch):
+        """Coordinator gave up on `epoch` before the commit point.
+        Stale aborts (epoch already committed) must not act — the
+        coordinator only aborts epochs it never published."""
+        if epoch <= self.epoch:
+            return (wire.OK_ARR,
+                    (np.asarray([self.epoch], np.int64),))
+        with self._mig_cv:
+            self._staged.pop(epoch, None)
+            self._frozen.clear()
+            self._mig_cv.notify_all()
+        self._sweep_my_shadows(min_epoch=epoch)
+        _ps_log(f"aborted migration toward epoch {epoch}; "
+                f"serving epoch {self.epoch}")
+        return (wire.OK_ARR,
+                (np.asarray([self.epoch], np.int64),))
+
+    def _sweep_my_shadows(self, min_epoch=None, max_epoch=None):
+        if not self.state_dir:
+            return
+        from paddle_tpu.distributed import membership as mb
+        tag = mb.tag_of_ep(self.endpoint)
+        for path, _, ep, _ in mb.list_shadows(self.state_dir,
+                                              tag=tag):
+            if min_epoch is not None and ep < min_epoch:
+                continue
+            if max_epoch is not None and ep > max_epoch:
+                continue
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _adopt_unit(self, unit, arrays):
+        """Install one migrated unit, hosting it from the recipe if it
+        is not already resident. Returns the row count adopted."""
+        from paddle_tpu.distributed import membership as mb
+        kind, name, vsh = mb.parse_unit(unit)
+        if kind == "d":
+            if name not in self.dense:
+                rec = self.recipes.get(name, {})
+                self.host_dense(
+                    name, np.zeros_like(arrays["value"]),
+                    optimizer=rec.get("optimizer"),
+                    regularizer=rec.get("regularizer"),
+                    param_lr=rec.get("param_lr", 1.0))
+            v = self.dense[name]
+            with v.cv:
+                v.value = np.ascontiguousarray(arrays["value"])
+                v.round = int(arrays["round"][0])
+                v.step_count = int(arrays["step"][0])
+                slots = {k[len("slot/"):]:
+                         np.ascontiguousarray(a, dtype=np.float32)
+                         for k, a in arrays.items()
+                         if k.startswith("slot/")}
+                v.slots = slots or None
+                v.accum = (np.ascontiguousarray(arrays["accum"])
+                           if "accum" in arrays else None)
+                v.pushed = set(int(t) for t
+                               in arrays.get("pushed", []))
+                v.evicted = False
+                v.cv.notify_all()
+            return 1
+        if name not in self.sparse:
+            rec = self.recipes.get(name, {})
+            self.host_sparse(name, int(rec["dim"]),
+                             initializer=rec.get("initializer"),
+                             seed=rec.get("seed", 0),
+                             lr=rec.get("lr", 1.0),
+                             optimizer=rec.get("optimizer", "sgd"))
+        tbl = self.sparse[name]
+        ids_in = np.asarray(arrays["ids"], np.int64)
+        rows_in = np.asarray(arrays["rows"], np.float32)
+        acc_in = np.asarray(arrays["accum"], np.float32)
+        ids0, rows0, acc0 = tbl.snapshot()
+        if acc0 is None:
+            acc0 = np.zeros_like(rows0)
+        keep = mb.vshard_of(ids0) != vsh if ids0.size else \
+            np.zeros(0, bool)
+        tbl.restore(np.concatenate([ids0[keep], ids_in]),
+                    np.concatenate([rows0[keep], rows_in])
+                    if rows0.size or rows_in.size else rows_in,
+                    np.concatenate([acc0[keep], acc_in])
+                    if acc0.size or acc_in.size else acc_in)
+        return int(ids_in.size)
+
+    def _retire_units(self, new_map):
+        """Drop everything the new map assigns elsewhere. Dense vars
+        are evicted (wakes blocked pullers/pushers into _UnitRetired →
+        WRONG_EPOCH); sparse tables stay hosted but shed the vshards
+        they lost — a table with zero vshards still answers BEGIN for
+        a later grow."""
+        from paddle_tpu.distributed import membership as mb
+        me = self.endpoint
+        dense_map = new_map.get("dense", {})
+        for name in list(self.dense):
+            if dense_map.get(name, me) != me:
+                v = self.dense.pop(name)
+                with v.cv:
+                    v.evicted = True
+                    v.cv.notify_all()
+        sparse_map = new_map.get("sparse", {})
+        for name, tbl in self.sparse.items():
+            owners = sparse_map.get(name)
+            if owners is None:
+                continue
+            mine = {int(v) for v, ep in owners.items() if ep == me}
+            ids, rows, acc = tbl.snapshot()
+            if not ids.size:
+                continue
+            keep = np.isin(mb.vshard_of(ids),
+                           np.asarray(sorted(mine), np.int64))
+            if keep.all():
+                continue
+            tbl.restore(ids[keep], rows[keep],
+                        acc[keep] if acc is not None else None)
 
     def _handle_frame(self, kind, client_id, seq, fields):
         """Dedup wrapper: retried mutating frames (same client, same
@@ -1105,7 +1690,17 @@ class ParameterServer(_SnapshotLoop):
     def _dense_import(self, name, value, state, slots):
         v = self.dense.get(name)
         if v is None:
-            return
+            # elastic warm boot: a var this server adopted via
+            # migration is in the snapshot but not in the transpiled
+            # program — re-host it from the recipe before restoring
+            rec = self.recipes.get(name)
+            if rec is None or rec.get("kind") != "dense":
+                return
+            self.host_dense(name, np.zeros_like(np.asarray(value)),
+                            optimizer=rec.get("optimizer"),
+                            regularizer=rec.get("regularizer"),
+                            param_lr=rec.get("param_lr", 1.0))
+            v = self.dense[name]
         with v.cv:
             v.value = np.asarray(value)
             if state is not None:
@@ -1124,8 +1719,19 @@ class ParameterServer(_SnapshotLoop):
         generation (walking back past corrupt ones). Returns the
         restored generation's meta, or None when nothing restorable
         exists."""
+        def make_table(t):
+            rec = self.recipes.get(t)
+            if rec is None or rec.get("kind") != "sparse":
+                return None
+            self.host_sparse(t, int(rec["dim"]),
+                             initializer=rec.get("initializer"),
+                             seed=rec.get("seed", 0),
+                             lr=rec.get("lr", 1.0),
+                             optimizer=rec.get("optimizer", "sgd"))
+            return self.sparse[t]
         return _ps_checkpoint_load(dirname, self.host, self.port,
-                                   self._dense_import, self.sparse)
+                                   self._dense_import, self.sparse,
+                                   make_table=make_table)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -1527,6 +2133,13 @@ def make_parameter_server(endpoint, num_trainers=1, sync_mode=True,
         return ParameterServer(endpoint, num_trainers, sync_mode)
 
 
+class _Rerouted(Exception):
+    """A call was fenced (WRONG_EPOCH) or its endpoint retired: the
+    client adopted a newer shard map and the caller must recompute the
+    route and re-send. The fenced server applied NOTHING, so the
+    re-send (with a fresh seq) stays exactly-once."""
+
+
 class PSClient:
     """RPCClient parity (rpc_client.h:33): persistent connections to every
     pserver, var→endpoint routing, send/get/prefetch/barrier/checkpoint.
@@ -1577,6 +2190,10 @@ class PSClient:
         self._stale_pending = {}
         self._round_offset = {}
         self._no_info = set()     # endpoints without SERVER_INFO
+        # elastic membership: the newest committed (epoch, shard map)
+        # this client has seen — None until a resize fences us
+        self._epoch = None
+        self._map = None
 
     @staticmethod
     def _reconnect_budget():
@@ -1716,16 +2333,18 @@ class PSClient:
         attempts = 0            # transient failures (fixed budget)
         conn_failures = 0
         refused_deadline = None  # downtime failures (wall-clock budget)
+        probed_map = False
         while True:
             try:
                 s = self._sock(ep, fresh=conn_failures > 0)
                 send_fields = fields
-                if kind == wire.PULL_PARAM:
+                if kind in (wire.PULL_PARAM, wire.PULL_PARAM_E):
                     # computed AFTER _sock: a reconnect's SERVER_INFO
                     # probe may have just armed the round resync this
                     # pull must consume
-                    send_fields = (fields[0], self._effective_round(
-                        ep, int(fields[1])))
+                    i = 1 if kind == wire.PULL_PARAM else 2
+                    send_fields = fields[:i] + (self._effective_round(
+                        ep, int(fields[i])),)
                 _send_frame(s, kind, send_fields, self.client_id, seq)
                 rk, _, rseq, rf = _recv_frame(s)
                 if rseq != seq:
@@ -1757,6 +2376,16 @@ class PSClient:
                                             + self._reconnect_budget())
                     if now >= refused_deadline:
                         raise
+                    # a refused endpoint may be RETIRED (fleet shrink),
+                    # not restarting: once per call, ask a surviving
+                    # server for the committed map — if it is newer,
+                    # re-route instead of burning the whole budget
+                    if not probed_map:
+                        probed_map = True
+                        if self._maybe_probe_map(ep):
+                            raise _Rerouted(
+                                f"pserver {ep} unreachable and a newer "
+                                f"shard map is committed")
                 else:
                     attempts += 1
                     if attempts > self.MAX_RETRIES:
@@ -1768,12 +2397,20 @@ class PSClient:
             # connection — mutating frames stayed exactly-once via the
             # server's (client_id, seq) dedup
             _m_reconnects.inc()
+        if rk == wire.WRONG_EPOCH:
+            # the server fenced us: it applied NOTHING and handed back
+            # the committed (epoch, map) — adopt and re-route
+            self._adopt_map(int(rf[0]), rf[1])
+            raise _Rerouted(f"pserver {ep} fenced request at epoch "
+                            f"{int(rf[0])}")
         enforce(rk != wire.ERR, f"pserver {ep} error: "
                                 f"{rf[0] if rf else '?'}")
         if rk == wire.OK_ARR:
             return rf[0]
         if rk == wire.OK_NAMES:
             return tuple(t.split("\n") if t else [] for t in rf)
+        if rk == wire.OK_JSON:
+            return rf[0]
         return None
 
     def _ep_of(self, name):
@@ -1781,43 +2418,240 @@ class PSClient:
         enforce(ep is not None, f"var {name!r} not routed to any pserver")
         return ep
 
+    # -- elastic routing (docs/ELASTIC_TRAINING.md "Resizing") -------------
+    def _routing(self):
+        with self._inc_lock:
+            return self._epoch, self._map
+
+    def _adopt_map(self, epoch, map_obj):
+        """Adopt a committed (epoch, shard map) if strictly newer.
+        Accepts the map as a dict or its JSON wire form."""
+        if isinstance(map_obj, str):
+            try:
+                map_obj = json.loads(map_obj) if map_obj else None
+            except ValueError:
+                return False
+        if not map_obj or "servers" not in map_obj:
+            return False
+        epoch = int(epoch)
+        with self._inc_lock:
+            if self._epoch is not None and epoch <= self._epoch:
+                return False
+            self._epoch, self._map = epoch, map_obj
+        logging.getLogger("paddle_tpu.ps").info(
+            "adopted fleet epoch %d (%d servers)", epoch,
+            len(map_obj.get("servers", [])))
+        return True
+
+    def _maybe_probe_map(self, failed_ep):
+        """Backstop for a RETIRED endpoint (fleet shrink): ask any
+        surviving server for the committed map via EPOCH_MAP. Returns
+        True iff a strictly newer map was adopted."""
+        _, m = self._routing()
+        eps = list(m.get("servers", [])) if m else list(self.endpoints)
+        for ep in eps:
+            if ep == failed_ep:
+                continue
+            try:
+                host, port = ep.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)),
+                                             timeout=2.0)
+                try:
+                    s.setsockopt(socket.IPPROTO_TCP,
+                                 socket.TCP_NODELAY, 1)
+                    seq = self._next_seq()
+                    _send_frame(s, wire.EPOCH_MAP, (),
+                                self.client_id, seq)
+                    rk, _, rseq, rf = _recv_frame(s)
+                finally:
+                    s.close()
+                if rk != wire.OK_JSON or rseq != seq:
+                    continue
+                obj = json.loads(rf[0])
+                if obj.get("map"):
+                    return self._adopt_map(int(obj.get("epoch", 0)),
+                                           obj["map"])
+                return False
+            except (ConnectionError, socket.timeout, OSError,
+                    wire.WireError, ValueError):
+                continue
+        return False
+
+    def _routed(self, fn):
+        """Run ``fn`` (which routes off the current map), re-running it
+        on _Rerouted — each fence refreshes the map, so the route
+        converges on the committed epoch."""
+        last = None
+        for n in range(20):
+            try:
+                return fn()
+            except _Rerouted as e:
+                last = e
+                time.sleep(min(0.05 * (n + 1), 0.5))
+        enforce(False, f"pserver request never settled on a fleet "
+                       f"epoch after 20 re-routes (last: {last})")
+
+    def _dense_ep(self, name):
+        """(epoch, endpoint) for a dense var: the committed map when we
+        have one and it routes this var, else the static transpile-time
+        placement with epoch None (legacy, unfenced frame kinds)."""
+        epoch, m = self._routing()
+        if m is None or name not in m.get("dense", {}):
+            return None, self._ep_of(name)
+        return epoch, m["dense"][name]
+
+    def _sparse_route(self, table, ids):
+        """[(epoch, endpoint, positions-or-None)] covering ``ids``.
+        positions None means "all of ids" (the single-route legacy
+        path). Always non-empty, even for empty ids."""
+        epoch, m = self._routing()
+        owners = (m.get("sparse") or {}).get(table) if m else None
+        if owners is None:
+            return [(None, self._ep_of(table), None)]
+        from paddle_tpu.distributed import membership as mb
+        vs = mb.vshard_of(ids)
+        groups = {}
+        for v in np.unique(vs):
+            ep = owners.get(str(int(v))) or self._ep_of(table)
+            groups.setdefault(ep, []).append(int(v))
+        out = [(epoch, ep,
+                np.flatnonzero(np.isin(vs, np.asarray(groups[ep],
+                                                      np.int64))))
+               for ep in sorted(groups)]
+        if not out:
+            eps = sorted(set(owners.values()))
+            out = [(epoch, eps[0] if eps else self._ep_of(table),
+                    np.zeros(0, np.int64))]
+        return out
+
     # -- dense -------------------------------------------------------------
     def push_grad(self, name, grad):
-        self._call(self._ep_of(name), wire.PUSH_GRAD, name,
-                   self.trainer_id, np.asarray(grad))
+        g = np.asarray(grad)
+
+        def go():
+            epoch, ep = self._dense_ep(name)
+            if epoch is None:
+                self._call(ep, wire.PUSH_GRAD, name, self.trainer_id,
+                           g)
+            else:
+                self._call(ep, wire.PUSH_GRAD_E, epoch, name,
+                           self.trainer_id, g)
+        self._routed(go)
 
     def pull_param(self, name, min_round=0):
-        return self._call(self._ep_of(name), wire.PULL_PARAM, name,
-                          min_round)
+        def go():
+            epoch, ep = self._dense_ep(name)
+            if epoch is None:
+                return self._call(ep, wire.PULL_PARAM, name, min_round)
+            return self._call(ep, wire.PULL_PARAM_E, epoch, name,
+                              min_round)
+        return self._routed(go)
 
     # -- sparse (parameter_prefetch.cc parity) -----------------------------
     def pull_sparse(self, table, ids):
-        return self._call(self._ep_of(table), wire.PULL_SPARSE, table,
-                          np.asarray(ids, np.int64))
+        ids = np.asarray(ids, np.int64)
+        buf = [None]
+
+        def fetch(pos, depth=0):
+            enforce(depth < 20, f"sparse pull on {table!r} never "
+                                f"settled on a fleet epoch")
+            sub_ids = ids if pos is None else ids[pos]
+            for epoch, ep, idx in self._sparse_route(table, sub_ids):
+                if pos is None:
+                    p = idx
+                elif idx is None:
+                    p = pos
+                else:
+                    p = pos[idx]
+                si = ids if p is None else ids[p]
+                try:
+                    if epoch is None:
+                        sub = self._call(ep, wire.PULL_SPARSE, table,
+                                         si)
+                    else:
+                        sub = self._call(ep, wire.PULL_SPARSE_E,
+                                         epoch, table, si)
+                except _Rerouted:
+                    time.sleep(min(0.05 * (depth + 1), 0.5))
+                    fetch(p, depth + 1)
+                    continue
+                sub = np.asarray(sub)
+                if p is None:
+                    buf[0] = sub
+                    return
+                if buf[0] is None:
+                    buf[0] = np.zeros((ids.size,) + sub.shape[1:],
+                                      sub.dtype)
+                buf[0][p] = sub
+        fetch(None)
+        return buf[0]
 
     def push_sparse(self, table, ids, grads, lr=None):
-        self._call(self._ep_of(table), wire.PUSH_SPARSE, table,
-                   np.asarray(ids, np.int64), np.asarray(grads), lr)
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads)
+
+        def send(pos, depth=0):
+            # only the fenced GROUP is re-sent (the fenced server
+            # applied nothing), so a re-route mid-multi-server push
+            # never double-applies the groups that already landed
+            enforce(depth < 20, f"sparse push on {table!r} never "
+                                f"settled on a fleet epoch")
+            sub_ids = ids if pos is None else ids[pos]
+            for epoch, ep, idx in self._sparse_route(table, sub_ids):
+                if pos is None:
+                    p = idx
+                elif idx is None:
+                    p = pos
+                else:
+                    p = pos[idx]
+                si = ids if p is None else ids[p]
+                sg = grads if p is None else grads[p]
+                try:
+                    if epoch is None:
+                        self._call(ep, wire.PUSH_SPARSE, table, si,
+                                   sg, lr)
+                    else:
+                        self._call(ep, wire.PUSH_SPARSE_E, epoch,
+                                   table, si, sg, lr)
+                except _Rerouted:
+                    time.sleep(min(0.05 * (depth + 1), 0.5))
+                    send(p, depth + 1)
+        send(None)
 
     def shrink_table(self, table, max_age):
         """FleetWrapper::ShrinkSparseTable parity: evict rows untouched
         for more than ``max_age`` pull/push calls. Returns evicted
-        count."""
-        out = self._call(self._ep_of(table), wire.SHRINK_TABLE, table,
-                         int(max_age))
-        return int(np.asarray(out).ravel()[0])
+        count (summed across owners when the table is resharded)."""
+        def go():
+            _, m = self._routing()
+            owners = (m.get("sparse") or {}).get(table) if m else None
+            eps = sorted(set(owners.values())) if owners \
+                else [self._ep_of(table)]
+            total = 0
+            for ep in eps:
+                out = self._call(ep, wire.SHRINK_TABLE, table,
+                                 int(max_age))
+                total += int(np.asarray(out).ravel()[0])
+            return total
+        return self._routed(go)
 
     # -- control -----------------------------------------------------------
+    def _all_eps(self):
+        _, m = self._routing()
+        return list(m["servers"]) if m else list(self.endpoints)
+
     def barrier(self, tag="global"):
-        for ep in self.endpoints:
-            self._call(ep, wire.BARRIER, tag, self.trainer_id)
+        def go():
+            for ep in self._all_eps():
+                self._call(ep, wire.BARRIER, tag, self.trainer_id)
+        self._routed(go)
 
     def checkpoint_notify(self, dirname):
-        for ep in self.endpoints:
+        for ep in self._all_eps():
             self._call(ep, wire.CHECKPOINT_NOTIFY, dirname)
 
     def list_vars(self, ep=None):
-        return self._call(ep or self.endpoints[0], wire.LIST_VARS)
+        return self._call(ep or self._all_eps()[0], wire.LIST_VARS)
 
     def server_info(self, ep=None):
         """(incarnation, min dense round) of one pserver — the
@@ -1828,7 +2662,7 @@ class PSClient:
         return int(vals[0]), int(vals[1])
 
     def stop_servers(self):
-        for ep in self.endpoints:
+        for ep in self._all_eps():
             try:
                 self._call(ep, wire.STOP)
             except Exception:
@@ -1936,8 +2770,74 @@ def _maybe_ps_exporter():
         return None             # telemetry must not block serving
 
 
+def _ps_reconcile_epoch(server, state_dir, meta):
+    """Warm-boot membership reconcile: line this server up with the
+    committed fleet epoch. The snapshot meta carries the epoch + map
+    the server was serving when it last saved; ``fleet_epoch.json`` is
+    the fleet's single source of truth. If the file is AHEAD of the
+    snapshot, this server crashed between the coordinator's commit
+    publish and its own post-commit snapshot — adopt the verified
+    shadows the file says we won, retire what we lost, and serve the
+    committed epoch. All remaining shadows for our tag are then swept:
+    at-or-below the committed epoch they are consumed, above it they
+    are debris of a migration whose coordinator will abort or restage."""
+    from paddle_tpu.distributed import membership as mb
+    from paddle_tpu import io_checkpoint as ioc
+    server.state_dir = state_dir
+    server.epoch = int((meta or {}).get("epoch", 0) or 0)
+    server.shard_map = (meta or {}).get("shard_map") or None
+    ef = mb.load_epoch_file(state_dir)
+    if ef and int(ef.get("epoch", 0)) > server.epoch:
+        epoch, new_map = int(ef["epoch"]), ef["map"]
+        tag = mb.tag_of_ep(server.endpoint)
+        adopted = 0
+        for path, _, ep, _ in mb.list_shadows(state_dir, tag=tag):
+            if ep != epoch:
+                continue
+            try:
+                manifest, arrays = ioc.verify_npz(path)
+            except Exception as e:                  # noqa: BLE001
+                _ps_log(f"ignoring unreadable shadow {path}: {e}")
+                continue
+            unit = (manifest or {}).get("unit")
+            if not unit or not _unit_owned_by(new_map, unit,
+                                              server.endpoint):
+                continue
+            adopted += server._adopt_unit(unit, arrays)
+        server._retire_units(new_map)
+        server.epoch, server.shard_map = epoch, new_map
+        if adopted:
+            _m_migrated.inc(adopted)
+            # persist the adoption before sweeping its shadows: until
+            # a snapshot holds these rows the shadows are the only
+            # durable copy, and a crash here must find them again
+            try:
+                server.save(state_dir)
+            except Exception as e:                  # noqa: BLE001
+                _ps_log(f"post-reconcile snapshot failed ({e}); "
+                        f"keeping staged shadows")
+                _m_epoch.set(server.epoch)
+                return
+        _ps_log(f"reconciled to committed fleet epoch {epoch} "
+                f"(adopted {adopted} rows from staged shadows)")
+    server._sweep_my_shadows()
+    tag = mb.tag_of_ep(server.endpoint)
+    swept = 0
+    for fn in _ps_listdir(state_dir):
+        if fn.startswith(f".psshadow_{tag}.") \
+                and fn.endswith(".tmp.npz"):
+            try:
+                os.remove(os.path.join(state_dir, fn))
+                swept += 1
+            except OSError:
+                pass
+    if swept:
+        _ps_log(f"swept {swept} torn shadow temp file(s)")
+    _m_epoch.set(server.epoch)
+
+
 def run_pserver(pserver_program, state_dir=None, snapshot_secs=None,
-                on_server=None):
+                on_server=None, recipes=None):
     """Build + run a blocking ParameterServer from a transpiled
     PServerProgram (the exe.run(pserver_prog) role in §3.3).
 
@@ -1950,8 +2850,21 @@ def run_pserver(pserver_program, state_dir=None, snapshot_secs=None,
     ``PT_PS_SNAPSHOT_SECS``, default 5 s) plus a final flush on
     graceful stop. ``on_server`` (if given) is called with the built
     server after the warm boot, before serving — the hook chaos tests
-    use to install ``testing.faults.install_ps_faults``."""
+    use to install ``testing.faults.install_ps_faults``.
+
+    Elastic membership (``PT_PS_ELASTIC``, set by ``launch_ps
+    --ps_max_servers/--ps_min_servers``): forces the python transport
+    (the native server has no migration handlers), hands the server
+    its hosting ``recipes`` (specs for units it may ADOPT in a future
+    resize without hosting them today), and reconciles the warm boot
+    against ``fleet_epoch.json`` — see ``_ps_reconcile_epoch``."""
+    elastic = bool(os.environ.get("PT_PS_ELASTIC"))
+    if elastic:
+        from paddle_tpu.core.flags import set_flags
+        set_flags({"ps_transport": "python"})
     server = pserver_program.build_server()
+    if isinstance(server, ParameterServer):
+        server.recipes = dict(recipes or {})
     state_dir = state_dir or os.environ.get("PT_PS_SNAPSHOT_DIR") or None
     exporter = _maybe_ps_exporter()
     if state_dir:
@@ -1981,6 +2894,8 @@ def run_pserver(pserver_program, state_dir=None, snapshot_secs=None,
         else:
             _ps_log(f"no restorable pserver snapshot in {state_dir}; "
                     f"starting from initial values")
+        if elastic and isinstance(server, ParameterServer):
+            _ps_reconcile_epoch(server, state_dir, meta)
         if snapshot_secs is None:
             try:
                 snapshot_secs = float(

@@ -330,11 +330,33 @@ class DistributeTranspiler:
         enforce(self._done, "call transpile() first")
         return self._trainer_program
 
-    def get_pserver_program(self, endpoint):
+    def get_pserver_program(self, endpoint, allow_new=False):
         enforce(self._done, "call transpile() first")
+        if allow_new and endpoint not in self._pserver_programs:
+            # elastic fleet (docs/ELASTIC_TRAINING.md "Resizing the
+            # pserver fleet"): a GROWN server sits outside the static
+            # transpile-time placement — it starts hosting nothing and
+            # acquires state through the epoch-fenced migration
+            pp = PServerProgram(endpoint, self.trainer_num,
+                                self.sync_mode, self._startup_seed)
+            self._pserver_programs[endpoint] = pp
+            return pp
         enforce(endpoint in self._pserver_programs,
                 f"{endpoint!r} not in {list(self._pserver_programs)}")
         return self._pserver_programs[endpoint]
+
+    def pserver_recipes(self):
+        """Hosting recipes for EVERY dense var in the job, regardless
+        of placement — what ``ps.run_pserver(recipes=...)`` hands each
+        elastic server so it can adopt any unit a future resize
+        assigns it (sparse-table recipes are the caller's to add: the
+        transpiler never sees ``host_sparse`` tables)."""
+        enforce(self._done, "call transpile() first")
+        out = {}
+        for pp in self._pserver_programs.values():
+            for name, spec in pp.dense.items():
+                out[name] = dict(spec, kind="dense")
+        return out
 
     def get_pserver_programs(self, endpoint):
         # fluid returns (main, startup); server-side init is embedded

@@ -52,11 +52,36 @@ SERVER_INFO = 12       # - (reply: i64 arr [incarnation, min dense round];
                        #    after a pserver restart reads the new
                        #    incarnation token here and re-establishes its
                        #    round expectations instead of deadlocking)
+# elastic-membership migration (docs/ELASTIC_TRAINING.md "Resizing the
+# pserver fleet"): the coordinator and migration peers speak these with
+# client_id=0 (control plane — no retry dedup; every call is idempotent
+# or answered-by-state), data frames carry their fleet epoch so a
+# server on a different epoch can fence them with WRONG_EPOCH
+MIGRATE_PLAN = 13      # plan json (coordinator -> source: stream these
+                       #   units to their targets; reply OK_ARR [rows])
+MIGRATE_BEGIN = 14     # spec json (source -> target: units incoming)
+MIGRATE_CHUNK = 15     # meta json, npz-blob u8 arr, crc32 u64
+MIGRATE_END = 16       # end json (target stages durable shadows;
+                       #   reply OK_ARR [staged rows])
+MIGRATE_COMMIT = 17    # commit json {"epoch","map"} (idempotent;
+                       #   reply OK_ARR [server's epoch])
+MIGRATE_ABORT = 18     # abort json {"epoch"} (drop staging, unfreeze)
+EPOCH_MAP = 19         # - (reply OK_JSON {"epoch","map"})
+# epoch-fenced data variants (PSClient sends these once it holds a
+# shard map; schema = epoch u64 + the legacy kind's fields)
+PUSH_GRAD_E = 20       # epoch u64, name, trainer_id u64, grad arr
+PULL_PARAM_E = 21      # epoch u64, name, min_round u64
+PULL_SPARSE_E = 22     # epoch u64, name, ids arr
+PUSH_SPARSE_E = 23     # epoch u64, name, ids arr, grads arr, lr f64
 # responses
 OK = 100               # -
 OK_ARR = 101           # arr
 OK_NAMES = 102         # dense-names str, sparse-names str ("\n"-joined)
 ERR = 103              # message
+OK_JSON = 104          # json str
+WRONG_EPOCH = 105      # server's epoch u64, shard-map json str (the
+                       #   fencing reply: nothing was applied; the
+                       #   client adopts the newer map and re-routes)
 
 STR, U64, F64, ARR = "str", "u64", "f64", "arr"
 
@@ -73,18 +98,34 @@ SCHEMAS = {
     SHUFFLE_PUSH: (U64, ARR),
     SHUFFLE_DONE: (U64, U64),
     SERVER_INFO: (),
+    MIGRATE_PLAN: (STR,),
+    MIGRATE_BEGIN: (STR,),
+    MIGRATE_CHUNK: (STR, ARR, U64),
+    MIGRATE_END: (STR,),
+    MIGRATE_COMMIT: (STR,),
+    MIGRATE_ABORT: (STR,),
+    EPOCH_MAP: (),
+    PUSH_GRAD_E: (U64, STR, U64, ARR),
+    PULL_PARAM_E: (U64, STR, U64),
+    PULL_SPARSE_E: (U64, STR, ARR),
+    PUSH_SPARSE_E: (U64, STR, ARR, ARR, F64),
     OK: (),
     OK_ARR: (ARR,),
     OK_NAMES: (STR, STR),
     ERR: (STR,),
+    OK_JSON: (STR,),
+    WRONG_EPOCH: (U64, STR),
 }
 
 # kinds whose server-side effect must not re-apply on a retried frame.
 # BARRIER is here because its set-based fan-in is only idempotent
 # within an unreleased round: a retry landing after the release would
 # enroll the trainer in the NEXT generation and desynchronize rounds.
+# The MIGRATE_* control plane is deliberately absent: it is spoken with
+# client_id=0 (dedup bypass) and every call is idempotent by state
+# (COMMIT/ABORT compare epochs, PLAN/BEGIN/CHUNK/END restage).
 MUTATING = {PUSH_GRAD, PUSH_SPARSE, CHECKPOINT_NOTIFY, STOP, BARRIER,
-            SHRINK_TABLE}
+            SHRINK_TABLE, PUSH_GRAD_E, PUSH_SPARSE_E}
 
 _HDR = struct.Struct("<2sBBQQQ")
 _U16 = struct.Struct("<H")

