@@ -174,10 +174,11 @@ def param_specs(cfg):
 # forward
 # ---------------------------------------------------------------------------
 def _layer_norm(x, g, b, eps=1e-12):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
-    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype)
+    # registry-selected body (ops/pallas/registry.py): the stock-jnp
+    # reference is bit-identical to the historical inline math here, the
+    # Pallas body is one VMEM pass (ops/pallas_kernels.fused_layer_norm)
+    from paddle_tpu.ops import pallas_kernels as _pk
+    return _pk.fused_layer_norm(x, g, b, eps=eps)
 
 
 def _attention(lp, x, mask_bias, cfg, mesh=None, key_padding_mask=None):

@@ -15,9 +15,14 @@ what fusion can't express:
   vocab axis (the reference's softmax_with_cross_entropy fused op,
   operators/softmax_with_cross_entropy_op.cc).
 
-Every entry point takes `interpret=None` → auto: compiled on TPU,
-interpreter mode elsewhere (CI runs on CPU; tests exercise the same code
-path the TPU runs).
+All three are registered in the Pallas kernel registry
+(ops/pallas/registry.py) — selection between the Pallas body and a
+stock-jnp reference is the registry's job (`FLAGS_use_pallas_kernels`,
+`PADDLE_TPU_PALLAS`). The public entry points keep their historical
+`interpret=` escape hatch: passing an explicit bool bypasses the
+registry and forces the Pallas body with that interpreter setting
+(tests pin kernel behavior this way); `interpret=None` defers to the
+registry's platform-based selection.
 """
 
 import functools
@@ -27,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+
+from paddle_tpu.ops.pallas import registry as _registry
 
 try:  # pltpu import fails on some CPU-only builds; interpret mode works
     from jax.experimental.pallas import tpu as pltpu
@@ -43,10 +50,9 @@ _NEG_INF = -1e30
 def _auto_interpret(interpret):
     if interpret is not None:
         return interpret
-    try:
-        return jax.devices()[0].platform == "cpu"
-    except Exception:  # pragma: no cover
-        return True
+    # registry.platform() is the per-process cached probe — jax.devices()
+    # must not be re-walked on every kernel invocation (hot path)
+    return _registry.platform() == "cpu"
 
 
 def _vmem_spec(*args, **kwargs):
@@ -336,14 +342,36 @@ def _flash_attention_bwd(sm_scale, causal, block_q, block_k, interpret,
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
-def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
-                    block_q=512, block_k=512, interpret=None):
-    """Blockwise (flash) attention.
+def _dense_attention_reference(q, k, v, bias=None, causal=False,
+                               sm_scale=None, block_q=512, block_k=512,
+                               interpret=None):
+    """Stock-jnp attention (scores materialized): the semantic reference
+    the flash kernel is pinned against. block_q/block_k/interpret are
+    accepted (and ignored) so both bodies share one signature."""
+    q = jnp.asarray(q)
+    k = jnp.asarray(k)
+    v = jnp.asarray(v)
+    b, h, s, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    qs = q.astype(jnp.float32) * sm_scale
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qs, k.astype(jnp.float32))
+    if bias is not None:
+        bias = jnp.asarray(bias, jnp.float32).reshape(b, s)
+        scores = scores + bias[:, None, None, :]
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        ki = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        scores = jnp.where(ki <= qi, scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
 
-    q, k, v: [B, H, S, D]. bias: optional [B, S] additive key bias
-    (e.g. key-padding mask as 0 / -inf). Returns [B, H, S, D] in q.dtype.
-    Sequence is padded to the block size internally (padded keys masked).
-    """
+
+def _flash_attention_pallas(q, k, v, bias=None, causal=False,
+                            sm_scale=None, block_q=512, block_k=512,
+                            interpret=False):
+    """Pallas body: block-size resolution, 128-lane padding, kernel call."""
     q = jnp.asarray(q)
     k = jnp.asarray(k)
     v = jnp.asarray(v)
@@ -353,7 +381,6 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     if bias is None:
         bias = jnp.zeros((b, s), jnp.float32)
     bias = jnp.asarray(bias, jnp.float32).reshape(b, s)
-    interpret = _auto_interpret(interpret)
     if s <= max(block_q, block_k):
         # short sequences: one block each way — but still pad to the
         # 128-lane grain so Mosaic never gets an unaligned whole-array
@@ -388,6 +415,25 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
     if pad:
         out = out[:, :, :s, :]
     return out
+
+
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                    block_q=512, block_k=512, interpret=None):
+    """Blockwise (flash) attention.
+
+    q, k, v: [B, H, S, D]. bias: optional [B, S] additive key bias
+    (e.g. key-padding mask as 0 / -inf). Returns [B, H, S, D] in q.dtype.
+    Sequence is padded to the block size internally (padded keys masked).
+
+    Body selection is the registry's (`FLAGS_use_pallas_kernels`); an
+    explicit ``interpret=`` bool forces the Pallas body.
+    """
+    kw = dict(bias=bias, causal=causal, sm_scale=sm_scale,
+              block_q=block_q, block_k=block_k)
+    if interpret is not None:
+        return _flash_attention_pallas(q, k, v, interpret=bool(interpret),
+                                       **kw)
+    return _registry.dispatch("flash_attention", q, k, v, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -465,19 +511,27 @@ def _fused_ln_bwd(eps, block_n, interpret, res, dy):
 _fused_layer_norm.defvjp(_fused_ln_fwd, _fused_ln_bwd)
 
 
-def fused_layer_norm(x, gamma, beta, eps=1e-12, block_n=256,
-                     interpret=None):
-    """LayerNorm over the last axis in a single VMEM pass.
+def _layer_norm_reference(x, gamma, beta, eps=1e-12, block_n=256,
+                          interpret=None):
+    """Stock-jnp layer norm, bit-identical to models/bert._layer_norm's
+    historical inline math (fp32 stats, x.dtype out)."""
+    x = jnp.asarray(x)
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps) \
+        * jnp.asarray(gamma).astype(jnp.float32) \
+        + jnp.asarray(beta).astype(jnp.float32)
+    return y.astype(x.dtype)
 
-    x: [..., H]; gamma/beta: [H]. Stats in fp32, output in x.dtype
-    (parity: operators/layer_norm_op.cc; jit/ layernorm kernel).
-    """
+
+def _fused_layer_norm_pallas(x, gamma, beta, eps=1e-12, block_n=256,
+                             interpret=False):
     x = jnp.asarray(x)
     shape = x.shape
     hdim = shape[-1]
     x2 = x.reshape(-1, hdim)
     n = x2.shape[0]
-    interpret = _auto_interpret(interpret)
     block_n = min(block_n, n)
     pad = (-n) % block_n
     if pad:
@@ -487,6 +541,23 @@ def fused_layer_norm(x, gamma, beta, eps=1e-12, block_n=256,
     if pad:
         y = y[:n]
     return y.reshape(shape)
+
+
+def fused_layer_norm(x, gamma, beta, eps=1e-12, block_n=256,
+                     interpret=None):
+    """LayerNorm over the last axis in a single VMEM pass.
+
+    x: [..., H]; gamma/beta: [H]. Stats in fp32, output in x.dtype
+    (parity: operators/layer_norm_op.cc; jit/ layernorm kernel).
+    Body selection is the registry's; explicit ``interpret=`` forces the
+    Pallas body.
+    """
+    if interpret is not None:
+        return _fused_layer_norm_pallas(x, gamma, beta, eps=eps,
+                                        block_n=block_n,
+                                        interpret=bool(interpret))
+    return _registry.dispatch("fused_layer_norm", x, gamma, beta, eps=eps,
+                              block_n=block_n)
 
 
 # ---------------------------------------------------------------------------
@@ -553,13 +624,19 @@ def _softmax_xent_bwd(block_n, interpret, res, dloss):
 _softmax_xent.defvjp(_softmax_xent_fwd, _softmax_xent_bwd)
 
 
-def softmax_cross_entropy(logits, labels, block_n=128, interpret=None):
-    """Fused per-example softmax cross-entropy.
+def _xent_reference(logits, labels, block_n=128, interpret=None):
+    """Stock-jnp softmax cross-entropy (fp32 max/logsumexp/pick)."""
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels, jnp.int32)
+    x = logits.astype(jnp.float32)
+    m = jnp.max(x, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m[..., None]), axis=-1))
+    picked = jnp.take_along_axis(x, labels[..., None],
+                                 axis=-1)[..., 0]
+    return lse - picked
 
-    logits: [..., V]; labels: [...] int. Returns [...] fp32 losses.
-    One pass computes max, logsumexp, and the label pick (parity:
-    operators/softmax_with_cross_entropy_op.cc fused op).
-    """
+
+def _softmax_xent_pallas(logits, labels, block_n=128, interpret=False):
     logits = jnp.asarray(logits)
     labels = jnp.asarray(labels, jnp.int32)
     v = logits.shape[-1]
@@ -567,7 +644,6 @@ def softmax_cross_entropy(logits, labels, block_n=128, interpret=None):
     logits2 = logits.reshape(-1, v)
     labels1 = labels.reshape(-1)
     n = logits2.shape[0]
-    interpret = _auto_interpret(interpret)
     # cap the row block so one (block_n, V) fp32 tile (double-buffered)
     # stays well under the ~16MB VMEM budget even at LM vocab sizes
     vmem_rows = max(8, (4 << 20) // max(4 * v, 1) // 8 * 8)
@@ -580,3 +656,29 @@ def softmax_cross_entropy(logits, labels, block_n=128, interpret=None):
     if pad:
         loss = loss[:n]
     return loss.reshape(lead)
+
+
+def softmax_cross_entropy(logits, labels, block_n=128, interpret=None):
+    """Fused per-example softmax cross-entropy.
+
+    logits: [..., V]; labels: [...] int. Returns [...] fp32 losses.
+    One pass computes max, logsumexp, and the label pick (parity:
+    operators/softmax_with_cross_entropy_op.cc fused op). Body selection
+    is the registry's; explicit ``interpret=`` forces the Pallas body.
+    """
+    if interpret is not None:
+        return _softmax_xent_pallas(logits, labels, block_n=block_n,
+                                    interpret=bool(interpret))
+    return _registry.dispatch("softmax_cross_entropy", logits, labels,
+                              block_n=block_n)
+
+
+_registry.register_kernel(
+    "flash_attention", _dense_attention_reference, _flash_attention_pallas,
+    doc="blockwise online-softmax attention; [S,S] scores never in HBM")
+_registry.register_kernel(
+    "fused_layer_norm", _layer_norm_reference, _fused_layer_norm_pallas,
+    doc="one-VMEM-pass layer norm (fp32 stats)")
+_registry.register_kernel(
+    "softmax_cross_entropy", _xent_reference, _softmax_xent_pallas,
+    doc="fused max/logsumexp/pick over the vocab axis")

@@ -30,14 +30,27 @@ class SelectedRows(NamedTuple):
     height: int            # logical dim-0 of the dense tensor
 
 
+def _scatter_add(dense, rows, values):
+    """dense[rows] += values, through the Pallas kernel registry when it
+    selects the fused scatter-add body; the stock .at[].add otherwise
+    (bit-identical flag-off path)."""
+    from paddle_tpu.ops import pallas as _plk
+    if jnp.ndim(values) == 2 and jnp.ndim(dense) == 2 \
+            and _plk.use_pallas("embedding_scatter_add"):
+        return _plk.dispatch("embedding_scatter_add", dense, rows, values)
+    return dense.at[rows].add(values)
+
+
 def merge_selected_rows(sr):
     """Sum duplicate rows (merge_selected_rows_op.cc). Jittable: the
     output keeps first-occurrence order of unique rows."""
     rows = jnp.asarray(sr.rows)
     uniq, inv = jnp.unique(rows, return_inverse=True,
                            size=rows.shape[0], fill_value=-1)
-    summed = jax.ops.segment_sum(sr.values, inv.reshape(-1),
-                                 num_segments=rows.shape[0])
+    summed = _scatter_add(
+        jnp.zeros((rows.shape[0],) + tuple(sr.values.shape[1:]),
+                  sr.values.dtype),
+        inv.reshape(-1), sr.values)
     valid = uniq >= 0
     return SelectedRows(jnp.where(valid, uniq, 0), summed, sr.height), valid
 
@@ -46,7 +59,7 @@ def get_tensor_from_selected_rows(sr):
     """Densify (get_tensor_from_selected_rows_op.cc)."""
     dense = jnp.zeros((sr.height,) + tuple(sr.values.shape[1:]),
                       sr.values.dtype)
-    return dense.at[sr.rows].add(sr.values)
+    return _scatter_add(dense, sr.rows, sr.values)
 
 
 def split_selected_rows(sr, num_splits):
@@ -67,7 +80,7 @@ def split_selected_rows(sr, num_splits):
 def sparse_sgd_update(param, sr_grad, lr):
     """sgd_op.cc SelectedRows branch: scatter-subtract only touched
     rows."""
-    return param.at[sr_grad.rows].add(-lr * sr_grad.values)
+    return _scatter_add(param, sr_grad.rows, -lr * sr_grad.values)
 
 
 def lookup_sparse_table(table_dict, ids, dim, init_fn=None, seed=0):

@@ -91,11 +91,22 @@ def _fused_matmul_compute(ins, attrs):
     """x @ dequant(w) (+ bias) (+ act): the exact composition of the
     registered float ops (ops/math.mul|matmul, elementwise_add,
     activation) — XLA fuses convert/scale/dot/add/act into one kernel
-    (the MXU path), the program sees ONE op."""
+    (the MXU path), the program sees ONE op.
+
+    When the Pallas kernel registry selects a Pallas body
+    (ops/pallas/registry.py), the fp and int8 variants run as single
+    blocked kernels instead — dequant/bias/act fused into the tile loop.
+    ``try_fused_matmul`` returns None for stock selection or operand
+    patterns outside the kernel contract, keeping this flag-off path
+    bit-identical."""
     import jax.numpy as jnp
 
     from paddle_tpu.ops import math as _m
+    from paddle_tpu.ops.pallas import try_fused_matmul
 
+    fast = try_fused_matmul(ins, attrs)
+    if fast is not None:
+        return {"Out": [fast]}
     xs = list(ins["X"])
     x, w = xs[0], xs[1]
     i = 2

@@ -485,7 +485,13 @@ class TestEquivalenceFuzz:
         """>= 200 random programs: optimized and unoptimized fetch
         outputs must agree (eager interpretation through the same op
         registry the executor compiles — program-transform equivalence,
-        independent of XLA)."""
+        independent of XLA). Each optimized program is ALSO interpreted
+        with the Pallas kernel registry forced on, pinning the Pallas
+        fused_matmul bodies (interpreter mode on CPU — the same kernel
+        code the TPU compiles) semantically equivalent to the stock
+        composition across the whole fuzzed op soup."""
+        from paddle_tpu.ops import pallas as plk
+
         rng = np.random.RandomState(1234)
         checked = 0
         total_removed = 0
@@ -497,11 +503,18 @@ class TestEquivalenceFuzz:
             total_removed += report.ops_removed()
             a = _interp(main, {**vals, **feed}, fetch)
             b = _interp(prog, {**vals, **feed}, fetch)
-            for u, v in zip(a, b):
+            with plk.override("on"):
+                c = _interp(prog, {**vals, **feed}, fetch)
+            for u, v, w in zip(a, b, c):
                 np.testing.assert_allclose(
                     u, v, rtol=1e-5, atol=1e-5,
                     err_msg=f"program {i} diverged "
                             f"(fetch={fetch}, report="
+                            f"{report.as_dict()})")
+                np.testing.assert_allclose(
+                    u, w, rtol=1e-5, atol=1e-5,
+                    err_msg=f"program {i} diverged under forced-on "
+                            f"Pallas registry (fetch={fetch}, report="
                             f"{report.as_dict()})")
             checked += 1
         assert checked >= 200

@@ -1,0 +1,509 @@
+"""Pallas kernel registry: selection semantics + kernel/stock parity.
+
+Every registered kernel must agree with its stock-jnp reference — forward
+AND backward (value_and_grad) — across dtypes (fp32/bf16) and ragged
+shapes (non-multiples of the Mosaic block grain, zero-row gathers,
+duplicate-index scatter-adds). On CPU the Pallas bodies run in
+interpreter mode: the same kernel code the TPU compiles, so these tests
+pin TPU semantics from the CI host."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops.pallas as plk
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.ops import pallas_kernels as pk
+
+RNG = np.random.RandomState(42)
+
+
+def _f(shape, dtype=jnp.float32, scale=1.0):
+    return jnp.asarray(RNG.randn(*shape) * scale, dtype)
+
+
+def _close(a, b, dtype=jnp.float32, **kw):
+    tol = dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=1e-5, atol=1e-5)
+    tol.update(kw)
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32), **tol)
+
+
+def _tree_close(a, b, dtype=jnp.float32, **kw):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for u, v in zip(la, lb):
+        _close(u, v, dtype, **kw)
+
+
+# ---------------------------------------------------------------------------
+# registry mechanics
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_all_kernels_registered(self):
+        names = plk.list_kernels()
+        for want in ("fused_matmul", "fused_matmul_int8",
+                     "embedding_gather", "embedding_scatter_add",
+                     "fused_sgd", "fused_momentum", "fused_adam",
+                     "flash_attention", "fused_layer_norm",
+                     "softmax_cross_entropy"):
+            assert want in names
+
+    def test_selection_policy_cpu(self):
+        if plk.platform() != "cpu":
+            pytest.skip("selection table below is the CPU one")
+        with plk.override("auto"):
+            assert plk.selected_body("fused_matmul") == "reference"
+            assert not plk.use_pallas("fused_matmul")
+        with plk.override("on"):
+            assert plk.selected_body("fused_matmul") == "pallas_interpret"
+            assert plk.use_pallas("fused_matmul")
+        with plk.override("off"):
+            assert plk.selected_body("fused_matmul") == "reference"
+
+    def test_flag_controls_selection(self):
+        if plk.platform() != "cpu":
+            pytest.skip("CPU selection table")
+        old = None
+        from paddle_tpu.core.flags import get_flag
+        old = get_flag("use_pallas_kernels")
+        try:
+            set_flags({"use_pallas_kernels": "on"})
+            assert plk.selected_body("fused_matmul") == "pallas_interpret"
+            set_flags({"use_pallas_kernels": "off"})
+            assert plk.selected_body("fused_matmul") == "reference"
+            # an override context beats the flag
+            with plk.override("on"):
+                assert plk.use_pallas("fused_matmul")
+        finally:
+            set_flags({"use_pallas_kernels": old})
+
+    def test_reference_only_kernel_never_selects_pallas(self):
+        plk.register_kernel("_test_ref_only", lambda x: x + 1)
+        try:
+            with plk.override("on"):
+                assert plk.selected_body("_test_ref_only") == "reference"
+                assert plk.dispatch("_test_ref_only", 1) == 2
+        finally:
+            plk.register_kernel("_test_ref_only", lambda x: x + 1)
+
+    def test_selection_gauge_published(self):
+        from paddle_tpu.monitor.registry import gauge
+        with plk.override("on"):
+            plk.dispatch("fused_layer_norm", _f((4, 8)), _f((8,)),
+                         _f((8,)))
+        g = gauge("pallas_kernels_selected",
+                  "Which body the Pallas kernel registry selected "
+                  "(1 = active), per kernel",
+                  labels=("kernel", "body"))
+        body = "pallas_interpret" if plk.platform() == "cpu" else "pallas"
+        assert g.value(kernel="fused_layer_norm", body=body) == 1.0
+
+    def test_override_nests_and_restores(self):
+        with plk.override("off"):
+            with plk.override("on"):
+                assert plk.selection_mode() == "on"
+            assert plk.selection_mode() == "off"
+
+    def test_platform_probe_is_cached(self):
+        assert plk.platform() is plk.platform.__wrapped__() \
+            or plk.platform() == plk.platform.__wrapped__()
+        info = plk.platform.cache_info()
+        assert info.hits >= 1
+
+
+# ---------------------------------------------------------------------------
+# fused_matmul parity
+# ---------------------------------------------------------------------------
+class TestFusedMatmul:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("shape", [(4, 7, 9), (1, 3, 5), (16, 64, 32),
+                                       (130, 260, 140)])
+    @pytest.mark.parametrize("act", [None, "relu", "gelu"])
+    def test_forward_backward_parity(self, dtype, shape, act):
+        m, k, n = shape
+        x = _f((m, k), dtype)
+        w = _f((k, n), dtype)
+        b = _f((n,), dtype)
+        def run(*args):
+            def loss(x, w, b):
+                out = plk.dispatch("fused_matmul", x, w, bias=b, act=act)
+                return jnp.sum(out.astype(jnp.float32) ** 2), out
+            return jax.value_and_grad(loss, (0, 1, 2), has_aux=True)(
+                *args)
+
+        with plk.override("off"):
+            (lr, outr), gr = run(x, w, b)
+        with plk.override("on"):
+            (lp, outp), gp = run(x, w, b)
+
+        # both sides accumulate in different orders (the kernel splits K
+        # into tiles; bf16 additionally rounds at different points), so
+        # cancellation makes per-element relative error unbounded near
+        # zero — compare with atol scaled to the array's magnitude
+        rtol = 2e-2 if dtype == jnp.bfloat16 else 1e-4
+        def close(u, v):
+            scale = float(max(1.0, np.abs(np.asarray(v, np.float32)).max()))
+            _close(u, v, dtype, rtol=rtol, atol=rtol * scale)
+        close(outr, outp)
+        close(lr, lp)
+        for u, v in zip(jax.tree.leaves(gr), jax.tree.leaves(gp)):
+            close(u, v)
+        assert outp.dtype == outr.dtype
+        for u, v in zip(jax.tree.leaves(gr), jax.tree.leaves(gp)):
+            assert u.dtype == v.dtype
+
+    @pytest.mark.parametrize("act", [None, "sigmoid", "tanh"])
+    def test_leading_dims_and_acts(self, act):
+        x = _f((2, 3, 5))
+        w = _f((5, 11))
+        ref = plk.get_body("fused_matmul", "reference")(x, w, act=act)
+        pal = plk.get_body("fused_matmul", "pallas")(
+            x, w, act=act, interpret=plk.platform() == "cpu")
+        _close(ref, pal)
+        assert pal.shape == (2, 3, 11)
+
+    def test_int8_matches_sidecar_dequant(self):
+        for m, k, n in [(4, 7, 9), (16, 256, 128), (3, 130, 200)]:
+            x = _f((m, k))
+            w8 = jnp.asarray(RNG.randint(-127, 128, (k, n)), jnp.int8)
+            scale = jnp.abs(_f((n,))) + 0.01
+            b = _f((n,))
+            for act in (None, "relu", "gelu"):
+                ref = plk.get_body("fused_matmul_int8", "reference")(
+                    x, w8, scale, bias=b, act=act)
+                with plk.override("on"):
+                    pal = plk.dispatch("fused_matmul_int8", x, w8, scale,
+                                       bias=b, act=act)
+                _close(ref, pal)
+
+    def test_static_program_fused_matmul_forced_on(self):
+        """End-to-end: the optimized static program's fused_matmul op
+        must produce identical fetches with the registry forced on."""
+        import paddle_tpu as pt
+        from paddle_tpu import layers
+
+        pt.enable_static()
+        from paddle_tpu.framework import unique_name
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup), unique_name.guard():
+            x = pt.static.data("x", [24], dtype="float32")
+            h = layers.fc(x, 48, act="relu")
+            out = layers.fc(h, 8, act="gelu")
+        scope = pt.static.Scope()
+        with pt.static.scope_guard(scope):
+            exe = pt.Executor()
+            exe.run(startup)
+            feed = {"x": RNG.rand(6, 24).astype(np.float32)}
+            a = exe.run(main, feed=feed, fetch_list=[out])[0]
+            with plk.override("on"):
+                b = exe.run(main, feed=feed, fetch_list=[out])[0]
+        _close(a, b)
+
+
+# ---------------------------------------------------------------------------
+# embedding gather / scatter-add parity
+# ---------------------------------------------------------------------------
+class TestEmbedding:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("hd", [(11, 5), (64, 128), (130, 200)])
+    def test_gather_forward_backward(self, dtype, hd):
+        h, d = hd
+        tbl = _f((h, d), dtype)
+        ids = jnp.asarray(RNG.randint(0, h, 17), jnp.int32)
+
+        def loss(t):
+            out = plk.dispatch("embedding_gather", t, ids)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        with plk.override("off"):
+            lr, gr = jax.value_and_grad(loss)(tbl)
+        with plk.override("on"):
+            lp, gp = jax.value_and_grad(loss)(tbl)
+        _close(lr, lp, dtype, rtol=1e-3)
+        _close(gr, gp, dtype)
+        assert gp.dtype == gr.dtype
+
+    def test_gather_zero_rows(self):
+        tbl = _f((8, 16))
+        with plk.override("on"):
+            out = plk.dispatch("embedding_gather", tbl,
+                               jnp.zeros((0,), jnp.int32))
+        assert out.shape == (0, 16)
+
+    def test_gather_2d_ids_and_oob_clip(self):
+        tbl = _f((10, 12))
+        ids = jnp.asarray([[0, 9], [15, 3]], jnp.int32)  # 15 clips to 9
+        ref = jnp.take(tbl, ids, axis=0)
+        with plk.override("on"):
+            pal = plk.dispatch("embedding_gather", tbl, ids)
+        _close(ref, pal)
+        assert pal.shape == (2, 2, 12)
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_scatter_add_duplicates_deterministic(self, dtype):
+        dst = _f((33, 130), dtype)
+        # heavy duplication: 40 updates onto 5 distinct rows
+        ids = jnp.asarray(RNG.randint(0, 5, 40), jnp.int32)
+        upd = _f((40, 130), dtype)
+        ref = plk.get_body("embedding_scatter_add", "reference")(
+            dst, ids, upd)
+        with plk.override("on"):
+            a = plk.dispatch("embedding_scatter_add", dst, ids, upd)
+            b = plk.dispatch("embedding_scatter_add", dst, ids, upd)
+        _close(ref, a, dtype)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_scatter_add_backward(self):
+        dst = _f((16, 24))
+        ids = jnp.asarray([3, 3, 0, 15, 7], jnp.int32)
+        upd = _f((5, 24))
+
+        def loss(d, u):
+            return jnp.sum(
+                plk.dispatch("embedding_scatter_add", d, ids, u) ** 2)
+
+        with plk.override("off"):
+            lr, gr = jax.value_and_grad(loss, (0, 1))(dst, upd)
+        with plk.override("on"):
+            lp, gp = jax.value_and_grad(loss, (0, 1))(dst, upd)
+        _close(lr, lp)
+        _tree_close(gr, gp)
+
+    def test_selected_rows_ops_forced_on(self):
+        from paddle_tpu.ops.selected_rows import (
+            SelectedRows, get_tensor_from_selected_rows,
+            merge_selected_rows, sparse_sgd_update)
+
+        sr = SelectedRows(jnp.asarray([2, 5, 2, 0], jnp.int32),
+                          _f((4, 6)), 9)
+        dense_off = get_tensor_from_selected_rows(sr)
+        merged_off, valid_off = merge_selected_rows(sr)
+        upd_off = sparse_sgd_update(_f((9, 6)), sr, 0.1)
+        with plk.override("on"):
+            dense_on = get_tensor_from_selected_rows(sr)
+            merged_on, valid_on = merge_selected_rows(sr)
+        _close(dense_off, dense_on)
+        _close(merged_off.values, merged_on.values)
+        np.testing.assert_array_equal(np.asarray(valid_off),
+                                      np.asarray(valid_on))
+
+    def test_nn_embedding_forced_on(self):
+        from paddle_tpu.ops import nn
+
+        tbl = _f((30, 18))
+        ids = jnp.asarray(RNG.randint(0, 30, (4, 7)), jnp.int32)
+        off = nn.embedding(ids, tbl, padding_idx=0)
+        with plk.override("on"):
+            on = nn.embedding(ids, tbl, padding_idx=0)
+        _close(off, on)
+
+
+# ---------------------------------------------------------------------------
+# fused optimizer updates
+# ---------------------------------------------------------------------------
+class TestFusedOptimizer:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("shape", [(7,), (3, 37), (130, 129)])
+    def test_kernels_match_references(self, dtype, shape):
+        p = _f(shape, dtype)
+        g = _f(shape, dtype)
+        v = _f(shape, dtype)
+        m1 = jnp.abs(_f(shape, dtype))
+        m2 = jnp.abs(_f(shape, dtype))
+        lr = jnp.float32(0.01)
+        t = jnp.int32(7)
+        cases = [
+            ("fused_sgd", (p, g, lr), {}),
+            ("fused_momentum", (p, g, v, lr),
+             {"momentum": 0.9, "use_nesterov": False}),
+            ("fused_momentum", (p, g, v, lr),
+             {"momentum": 0.8, "use_nesterov": True}),
+            ("fused_adam", (p, g, m1, m2, lr, t),
+             {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}),
+        ]
+        for name, args, kw in cases:
+            ref = plk.get_body(name, "reference")(*args, **kw)
+            with plk.override("on"):
+                pal = plk.dispatch(name, *args, **kw)
+            if dtype == jnp.bfloat16:
+                # the fused body computes in f32 and rounds once at the
+                # end; the stock chain rounds to bf16 after every op —
+                # agreement is at bf16 resolution, not better
+                _tree_close(ref, pal, dtype, rtol=5e-2, atol=5e-2)
+            else:
+                _tree_close(ref, pal, dtype, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("opt_name", ["sgd", "momentum", "nesterov",
+                                          "adam"])
+    def test_apply_gradients_forced_on_matches_stock(self, opt_name):
+        from paddle_tpu import optimizer as opt_mod
+
+        mk = {
+            "sgd": lambda: opt_mod.SGDOptimizer(0.1),
+            "momentum": lambda: opt_mod.MomentumOptimizer(0.1, 0.9),
+            "nesterov": lambda: opt_mod.MomentumOptimizer(
+                0.1, 0.9, use_nesterov=True),
+            "adam": lambda: opt_mod.AdamOptimizer(0.01),
+        }[opt_name]
+        params = {"w": _f((9, 130)), "b": _f((17,))}
+        grads = {"w": _f((9, 130)), "b": _f((17,))}
+        opt_a, opt_b = mk(), mk()
+        st_a, st_b = opt_a.init(params), opt_b.init(params)
+        for _ in range(3):
+            with plk.override("off"):
+                params_a, st_a = opt_a.apply_gradients(params, grads,
+                                                       st_a)
+            with plk.override("on"):
+                params_b, st_b = opt_b.apply_gradients(params, grads,
+                                                       st_b)
+        _tree_close(params_a, params_b)
+        _tree_close(st_a["slots"], st_b["slots"])
+        for u, v in zip(jax.tree.leaves(params_a),
+                        jax.tree.leaves(params_b)):
+            assert u.dtype == v.dtype
+
+    def test_bf16_param_dtype_promotion_preserved(self):
+        """Stock momentum on bf16 params promotes new_p to f32 (strong
+        f32 lr) while the velocity slot stays bf16 — the fused path must
+        reproduce that exactly (the eval_shape dtype pin)."""
+        from paddle_tpu import optimizer as opt_mod
+
+        opt = opt_mod.MomentumOptimizer(0.1, 0.9)
+        p = _f((12, 130), jnp.bfloat16)
+        g = _f((12, 130), jnp.bfloat16)
+        slots = {"velocity": jnp.zeros_like(p)}
+        lr = jnp.float32(0.1)
+        t = jnp.int32(1)
+        ref_p, ref_s = opt._update(p, g, slots, lr, t)
+        with plk.override("on"):
+            fused = opt_mod._pallas_fused_update(opt, p, g, slots, lr, t)
+        assert fused is not None
+        fp, fs = fused
+        assert fp.dtype == ref_p.dtype
+        assert fs["velocity"].dtype == ref_s["velocity"].dtype
+        _close(ref_p, fp, jnp.bfloat16)
+        _close(ref_s["velocity"], fs["velocity"], jnp.bfloat16)
+
+    def test_unfused_rules_fall_through(self):
+        from paddle_tpu import optimizer as opt_mod
+
+        opt = opt_mod.AdagradOptimizer(0.1)
+        with plk.override("on"):
+            assert opt_mod._pallas_fused_update(
+                opt, _f((4, 4)), _f((4, 4)), {"moment": jnp.zeros((4, 4))},
+                jnp.float32(0.1), jnp.int32(1)) is None
+
+    def test_ps_dense_step_forced_on(self):
+        """The hosted-param PS apply path must stay bit-identical to its
+        stock result when the registry selects the fused kernel."""
+        from paddle_tpu import optimizer as opt_mod
+        from paddle_tpu.distributed.ps import _DenseVar
+
+        def mk():
+            dv = _DenseVar(np.ones((6, 130), np.float32),
+                           opt_mod.AdamOptimizer(0.01))
+            # the native C fast path (when built) bypasses both jnp
+            # bodies; force the jnp route so the A/B is stock vs fused
+            dv._native = (None, None)
+            return dv
+
+        grad = RNG.randn(6, 130).astype(np.float32)
+        a, b = mk(), mk()
+        with plk.override("off"):
+            a._step(grad)
+        with plk.override("on"):
+            b._step(grad)
+        np.testing.assert_allclose(a.value, b.value, rtol=1e-6,
+                                   atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# migrated legacy kernels (flash attention / layer norm / xent)
+# ---------------------------------------------------------------------------
+class TestMigratedKernels:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_attention_parity(self, dtype, causal):
+        q = _f((2, 2, 72, 16), dtype, 0.5)   # ragged S=72 (pads to 128)
+        k = _f((2, 2, 72, 16), dtype, 0.5)
+        v = _f((2, 2, 72, 16), dtype, 0.5)
+        bias = jnp.where(jnp.arange(72)[None, :] < 60, 0.0, -1e9) \
+            * jnp.ones((2, 1))
+
+        def loss(body, q, k, v):
+            out = body(q, k, v, bias=bias, causal=causal)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        ref = pk._dense_attention_reference
+        lr, gr = jax.value_and_grad(
+            lambda *a: loss(ref, *a), (0, 1, 2))(q, k, v)
+        with plk.override("on"):
+            lp, gp = jax.value_and_grad(
+                lambda *a: loss(pk.flash_attention, *a), (0, 1, 2))(
+                q, k, v)
+        tol = dict(rtol=5e-2, atol=5e-2) if dtype == jnp.bfloat16 \
+            else dict(rtol=2e-4, atol=2e-4)
+        _close(lr, lp, dtype, **tol)
+        _tree_close(gr, gp, dtype, **tol)
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_layer_norm_parity(self, dtype):
+        x = _f((5, 33, 130), dtype)   # ragged rows AND hidden
+        g = _f((130,))
+        b = _f((130,))
+
+        def loss(body, x, g, b):
+            return jnp.sum(body(x, g, b).astype(jnp.float32) ** 2)
+
+        ref = pk._layer_norm_reference
+        lr, gr = jax.value_and_grad(
+            lambda *a: loss(ref, *a), (0, 1, 2))(x, g, b)
+        with plk.override("on"):
+            lp, gp = jax.value_and_grad(
+                lambda *a: loss(pk.fused_layer_norm, *a), (0, 1, 2))(
+                x, g, b)
+        tol = dict(rtol=5e-2, atol=5e-1) if dtype == jnp.bfloat16 \
+            else dict(rtol=1e-4, atol=1e-3)
+        _close(lr, lp, dtype, **tol)
+        _tree_close(gr, gp, dtype, **tol)
+
+    def test_layer_norm_reference_is_flag_off_dispatch(self):
+        """auto mode on CPU must return the stock reference result
+        bit-for-bit (models/bert._layer_norm routes through it)."""
+        if plk.platform() != "cpu":
+            pytest.skip("CPU selection table")
+        x, g, b = _f((7, 64)), _f((64,)), _f((64,))
+        a = pk.fused_layer_norm(x, g, b)
+        r = pk._layer_norm_reference(x, g, b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(r))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_xent_parity(self, dtype):
+        logits = _f((13, 77), dtype, 2.0)    # ragged rows and vocab
+        labels = jnp.asarray(RNG.randint(0, 77, 13), jnp.int32)
+
+        def loss(body, lg):
+            return jnp.sum(body(lg, labels))
+
+        ref = pk._xent_reference
+        lr, gr = jax.value_and_grad(lambda lg: loss(ref, lg))(logits)
+        with plk.override("on"):
+            lp, gp = jax.value_and_grad(
+                lambda lg: loss(pk.softmax_cross_entropy, lg))(logits)
+        tol = dict(rtol=5e-2, atol=5e-2) if dtype == jnp.bfloat16 \
+            else dict(rtol=1e-4, atol=1e-4)
+        _close(lr, lp, dtype, **tol)
+        _close(gr, gp, dtype, **tol)
+
+    def test_explicit_interpret_bypasses_registry(self):
+        """interpret= pins the Pallas body regardless of selection mode
+        (the legacy escape hatch tests rely on)."""
+        x, g, b = _f((4, 64)), _f((64,)), _f((64,))
+        with plk.override("off"):
+            y = pk.fused_layer_norm(x, g, b, interpret=True)
+        _close(y, pk._layer_norm_reference(x, g, b), rtol=1e-5,
+               atol=1e-5)

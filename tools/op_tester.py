@@ -5,11 +5,17 @@ optionally fwd+bwd) on the current device, print one JSON line per op.
     python tools/op_tester.py --op matmul flash_attention --repeat 30
     python tools/op_tester.py --list
     python tools/op_tester.py --all --preset tiny     # CI / CPU
+    python tools/op_tester.py --op fused_matmul --pallas both
 
 Presets scale shapes: "bench" (TPU-sized) and "tiny" (CPU/CI).
+``--pallas on|off|both`` wraps each run in the Pallas kernel registry's
+override (ops/pallas/registry.py) so any op routed through the registry
+(fused_matmul, embedding_gather, fused_adam, layer_norm, ...) can be
+A/B'd from the CLI; "both" prints one JSON line per body.
 """
 
 import argparse
+import contextlib
 import json
 import sys
 import time
@@ -20,6 +26,7 @@ def _ops(preset):
     import jax.numpy as jnp
 
     import paddle_tpu.layers as L
+    from paddle_tpu.ops import pallas as PLK
     from paddle_tpu.ops import pallas_kernels as PK
 
     big = preset == "bench"
@@ -67,6 +74,29 @@ def _ops(preset):
         "embedding": (lambda ids, w: w[ids],
                       (jax.random.randint(key, (B, S), 0, V),
                        r(V, H, dtype=jnp.float32)), None),
+        # registry-routed ops: honor --pallas on|off|both
+        "fused_matmul":
+            (lambda x, w, b: PLK.dispatch("fused_matmul", x, w,
+                                          bias=b, act="relu"),
+             (r(4 * H, 4 * H), r(4 * H, 4 * H), r(4 * H)),
+             2 * (4 * H) ** 3),
+        "embedding_gather":
+            (lambda w, ids: PLK.dispatch("embedding_gather", w, ids),
+             (r(V, H, dtype=jnp.float32),
+              jax.random.randint(key, (B * S,), 0, V)), None),
+        "embedding_scatter_add":
+            (lambda d, ids, u: PLK.dispatch("embedding_scatter_add",
+                                            d, ids, u),
+             (r(V, H, dtype=jnp.float32),
+              jax.random.randint(key, (B * S,), 0, V),
+              r(B * S, H, dtype=jnp.float32)), None),
+        "fused_adam":
+            (lambda p, g, m1, m2: PLK.dispatch(
+                "fused_adam", p, g, m1, m2, 1e-3, 10.0),
+             (r(4 * H * H, dtype=jnp.float32),
+              r(4 * H * H, dtype=jnp.float32),
+              r(4 * H * H, dtype=jnp.float32),
+              jnp.abs(r(4 * H * H, dtype=jnp.float32))), None),
     }
     return reg
 
@@ -137,6 +167,10 @@ def main(argv=None):
     ap.add_argument("--grad", action="store_true",
                     help="time fwd+bwd instead of fwd")
     ap.add_argument("--preset", choices=("bench", "tiny"), default=None)
+    ap.add_argument("--pallas", choices=("on", "off", "both"), default=None,
+                    help="force the Pallas kernel registry selection "
+                         "around each timed run ('on' uses interpreter "
+                         "mode on CPU); 'both' prints one line per body")
     args = ap.parse_args(argv)
 
     import jax
@@ -147,13 +181,23 @@ def main(argv=None):
         print("\n".join(reg))
         return 0
     names = list(reg) if (args.all or not args.op) else args.op
+    modes = {"both": ("off", "on")}.get(args.pallas, (args.pallas,))
     for n in names:
         if n not in reg:
             print(json.dumps({"op": n, "error": "unknown op"}))
             continue
         fn, a, flops = reg[n]
-        print(json.dumps(run_op(n, fn, a, flops, args.repeat,
-                                grad=args.grad)))
+        for mode in modes:
+            if mode is None:
+                ctx = contextlib.nullcontext()
+            else:
+                from paddle_tpu.ops import pallas as plk
+                ctx = plk.override(mode)
+            with ctx:
+                rec = run_op(n, fn, a, flops, args.repeat, grad=args.grad)
+            if mode is not None:
+                rec["pallas"] = mode
+            print(json.dumps(rec))
     return 0
 
 

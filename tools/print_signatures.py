@@ -17,6 +17,7 @@ MODULES = [
     "paddle_tpu",
     "paddle_tpu.layers",
     "paddle_tpu.ops",
+    "paddle_tpu.ops.pallas",
     "paddle_tpu.optimizer",
     "paddle_tpu.static",
     "paddle_tpu.static.opt_passes",
