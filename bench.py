@@ -1175,7 +1175,6 @@ def bench_serving():
     p50_window(True), p50_window(False)             # warm both paths
     est, pair_ratios, on_ms, off_ms = _abba_overhead(p50_window, pairs)
     mtrace.disable()
-    srv.close()
     print(json.dumps({
         "metric": "serving_trace_overhead_ratio",
         "value": round(est, 4), "unit": "x",
@@ -1183,6 +1182,51 @@ def bench_serving():
         "untraced_p50_ms": round(float(np.median(off_ms)), 4),
         "pair_ratios": [round(r, 4) for r in pair_ratios],
         "window_reqs": win, "offered_fraction_of_capacity": 0.5,
+    }))
+
+    # Memory-poller overhead pass (monitor/memory.py): identical
+    # open-loop protocol and server, with the live-buffer poller
+    # sampling at a deliberately hostile 50 ms interval vs fully off
+    # (disable == zero recording — no thread, no gauge writes). The
+    # poller aggregates jax.live_arrays on its own daemon thread, so
+    # this measures the GIL/allocator shadow it casts over request
+    # latency; the smoke test asserts the ABBA estimate < 1.05x.
+    from paddle_tpu.monitor import memory as _memory
+    mem_pairs = int(os.environ.get("BENCH_SERVING_MEM_PAIRS",
+                                   str(pairs)))
+
+    def p50_mem_window(polling, n=win):
+        if polling:
+            _memory.enable(interval=0.05)
+        else:
+            _memory.disable()
+        sched = np.cumsum(ab_rng.exponential(1.0 / ab_rate, size=n))
+        t0 = time.perf_counter()
+        pend = []
+        for i in range(n):
+            dly = t0 + sched[i] - time.perf_counter()
+            if dly > 0:
+                time.sleep(dly)
+            pend.append((srv.submit({"x": feed}), t0 + sched[i]))
+        lat_w = []
+        for p, ta in pend:
+            p.result(timeout=120)
+            lat_w.append(p.t_done - ta)
+        return float(np.median(lat_w)) * 1e3
+
+    p50_mem_window(True), p50_mem_window(False)     # warm both paths
+    est_m, pair_ratios_m, on_m, off_m = _abba_overhead(p50_mem_window,
+                                                       mem_pairs)
+    _memory.disable()
+    srv.close()
+    print(json.dumps({
+        "metric": "memory_overhead_ratio", "path": "serving",
+        "value": round(est_m, 4), "unit": "x",
+        "polled_p50_ms": round(float(np.median(on_m)), 4),
+        "unpolled_p50_ms": round(float(np.median(off_m)), 4),
+        "pair_ratios": [round(r, 4) for r in pair_ratios_m],
+        "poll_interval_s": 0.05, "window_reqs": win,
+        "offered_fraction_of_capacity": 0.5,
     }))
 
 
@@ -2400,10 +2444,30 @@ def _emit_registry_snapshot():
         print(f"# metrics snapshot failed: {e}", file=sys.stderr)
 
 
+def _emit_peak_hbm():
+    """End-of-run device-memory line, emitted for EVERY mode: one
+    final live-buffer sample (monitor/memory.py) folded into the
+    high-water mark — the run's peak when the poller was on, its
+    end-of-run residency floor otherwise (``sampled`` says which).
+    Never fatal: a bench must not fail on its own telemetry."""
+    try:
+        from paddle_tpu.monitor import memory as _memory
+        sampled = _memory.poller_enabled()
+        _memory.sample_now()
+        print(json.dumps({
+            "metric": "peak_hbm_bytes",
+            "value": int(_memory.high_water()),
+            "unit": "bytes", "sampled_continuously": sampled,
+        }))
+    except Exception as e:   # pragma: no cover - telemetry-only path
+        print(f"# peak_hbm_bytes failed: {e}", file=sys.stderr)
+
+
 def main():
     try:
         return _dispatch_mode()
     finally:
+        _emit_peak_hbm()
         _emit_registry_snapshot()
 
 

@@ -332,6 +332,37 @@ def main():
            for k, v in shares.items()},
     }))
 
+    # memory-poller A/B (monitor/memory.py): the same ABBA protocol
+    # with the live-buffer poller sampling at a deliberately hostile
+    # 50 ms interval vs fully off (disable == zero recording). The
+    # poller's work — jax.live_arrays aggregation — runs on its own
+    # daemon thread, so what this measures is the GIL/allocator
+    # shadow it casts over the dispatch hot path; the smoke test
+    # asserts < 1.05x.
+    from paddle_tpu.monitor import memory as _memory
+    mem_pairs = int(os.environ.get("BENCH_DISPATCH_MEM_PAIRS", "8"))
+
+    def m_win(polling):
+        if polling:
+            _memory.enable(interval=0.05)
+        else:
+            _memory.disable()
+        _td, tt = mode._window(twin)
+        return tt / twin * 1e3
+
+    m_win(True), m_win(False)           # warm both paths
+    est_m, pair_ratios_m, on_m, off_m = _abba_overhead(m_win,
+                                                       mem_pairs)
+    _memory.disable()
+    print(json.dumps({
+        "metric": "memory_overhead_ratio", "path": "dispatch",
+        "value": round(est_m, 4), "unit": "x",
+        "polled_ms_per_step": round(_median(on_m), 4),
+        "unpolled_ms_per_step": round(_median(off_m), 4),
+        "pair_ratios": [round(r, 4) for r in pair_ratios_m],
+        "poll_interval_s": 0.05, "steps_per_window": twin,
+    }))
+
 
 if __name__ == "__main__":
     main()

@@ -92,6 +92,12 @@ _m_epoch = _gauge(
     "ps_epoch",
     "Committed fleet-membership epoch this pserver serves (0 = the "
     "implicit static-placement epoch: no resize has ever committed)")
+_m_table_bytes = _gauge(
+    "ps_sparse_table_bytes",
+    "Host-resident bytes of each hosted sparse table's row store "
+    "(float32 rows + adagrad accumulators; native and Python stores "
+    "count the same payload), refreshed at every snapshot generation",
+    labels=("table",))
 _m_migrated = _counter(
     "ps_migrated_rows_total",
     "Sparse rows + dense vars this pserver adopted across committed "
@@ -773,6 +779,17 @@ class _SparseTable:
         with self.lock:
             return len(self.rows)
 
+    def nbytes(self):
+        """Host-resident bytes of this table's row store: rows are
+        float32[dim], adagrad doubles that with the per-row G
+        accumulator. Same arithmetic for the native (C++) and Python
+        stores — both hold the same float32 payload (the native store's
+        hash-map overhead is not counted, matching how the ledger
+        counts array payloads everywhere else)."""
+        per_row = self.dim * 4 * (2 if self.optimizer == "adagrad"
+                                  else 1)
+        return len(self) * per_row
+
     def pull(self, ids):
         if self._native is not None:
             return self._native.pull(ids)
@@ -894,6 +911,14 @@ class _SnapshotLoop:
                                                   None))
             _m_snap_saves.inc()
             _m_snap_ms.observe((time.perf_counter() - t0) * 1e3)
+            # snapshot cadence doubles as the sparse-table memory
+            # accounting tick: cheap (len * row bytes), off the
+            # request path, and fresh enough for capacity planning
+            try:
+                for name, tbl in self.sparse.items():
+                    _m_table_bytes.set(tbl.nbytes(), table=name)
+            except Exception:
+                pass
 
     def start_snapshots(self, dirname, interval=5.0):
         enforce(self._snap_thread is None, "snapshots already started")

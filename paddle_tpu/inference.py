@@ -458,6 +458,16 @@ def export_aot(dirname, program, feed_names, fetch_names, scope,
         # serve a stale graph from a surviving shape bucket
         h = _sig_key(sig + [["__program__", [], prog_hash]])
         compiled = jitted.lower(param_sds, feed_sds).compile()
+        try:
+            # compile-time memory ledger: each bucket's footprint is
+            # a capacity-planning number the swap admission and the
+            # postmortems read back (monitor/memory.py)
+            from paddle_tpu.monitor import memory as _memory
+            _memory.record_segment_memory(
+                ("export", prog_hash), bucket,
+                _memory.analyze_compiled(compiled))
+        except Exception:
+            pass
         # the unsharded jit above compiles single-device; recorded so
         # the loader binds the executable to exactly that many devices
         entry = {"sig": sig, "key": h, "platform": platform,

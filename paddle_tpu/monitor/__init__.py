@@ -36,6 +36,12 @@ docs/DEBUGGING.md):
   explosion, step stall, non-finite) that dumps the flight recorder
   with the anomaly named, and the launcher-side straggler/health
   readout over the per-rank snapshots.
+- ``monitor.memory`` — device-memory observability: compile-time
+  per-segment memory ledger from ``compiled.memory_analysis()``, the
+  named-entity residency ledger, the sampled HBM poller
+  (in-use/limit/utilization/high-water gauges), and typed
+  ``OutOfDeviceMemoryError`` postmortems for RESOURCE_EXHAUSTED
+  (docs/DEBUGGING.md "Why did the job OOM?").
 
 Everything importable here is stdlib-only at module level (jax/numpy
 are touched lazily inside ``cost``/``numerics``/``tensorwatch``): the
@@ -50,6 +56,7 @@ from paddle_tpu.monitor import anomaly
 from paddle_tpu.monitor import cost
 from paddle_tpu.monitor import exporter
 from paddle_tpu.monitor import flight_recorder
+from paddle_tpu.monitor import memory
 from paddle_tpu.monitor import numerics
 from paddle_tpu.monitor import registry
 from paddle_tpu.monitor import tensorwatch
@@ -59,6 +66,7 @@ from paddle_tpu.monitor.exporter import (
     MetricsServer, RankExporter, render_text, write_snapshot,
 )
 from paddle_tpu.monitor.flight_recorder import RECORDER, FlightRecorder
+from paddle_tpu.monitor.memory import OutOfDeviceMemoryError
 from paddle_tpu.monitor.numerics import NonFiniteError
 from paddle_tpu.monitor.registry import (
     REGISTRY, Counter, Gauge, Histogram, Registry, counter, gauge,
@@ -71,11 +79,12 @@ from paddle_tpu.monitor.trace import (
 
 __all__ = [
     "registry", "exporter", "flight_recorder", "cost", "numerics",
-    "tensorwatch", "anomaly", "trace",
+    "tensorwatch", "anomaly", "trace", "memory",
     "Tracer", "TraceContext", "TRACER", "merge_rank_traces",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram",
     "RankExporter", "MetricsServer", "render_text", "write_snapshot",
     "FlightRecorder", "RECORDER",
     "NonFiniteError", "TensorMonitor", "AnomalyDetector",
+    "OutOfDeviceMemoryError",
 ]

@@ -270,11 +270,18 @@ def _sum_matching(samples, name):
     return sum(v for (n, _), v in samples.items() if n == name)
 
 
+def _max_matching(samples, name):
+    return max((v for (n, _), v in samples.items() if n == name),
+               default=0.0)
+
+
 def job_status_line(hb_dir, restarts=0, snaps=None, health=None):
     """The launcher's periodic one-liner:
-    ``step=… ms/step=… mfu=… health=… ranks=… restarts=…`` computed
-    from the rank snapshots in ``hb_dir``; None when no rank has
-    exported yet.
+    ``step=… ms/step=… mem=…/…GB mfu=… health=… ranks=… restarts=…``
+    computed from the rank snapshots in ``hb_dir``; None when no rank
+    has exported yet. ``mem`` (worst device's high-water mark over
+    the known limit, monitor/memory.py) appears only once some rank's
+    memory poller has sampled.
 
     ``step`` is the max across ranks (they advance together in data
     parallel); ms/step pools every rank's histogram; mfu uses the
@@ -301,6 +308,20 @@ def job_status_line(hb_dir, restarts=0, snaps=None, health=None):
     ms_count = _sum_matching(merged, "executor_step_ms_count")
     ms = ms_sum / ms_count if ms_count else 0.0
     parts = [f"step={step}", f"ms/step={ms:.1f}"]
+    # worst device's high-water mark across ranks (gauges merge as
+    # max, but read the pre-merge snapshots so a single stale rank
+    # can't pin the number): mem=<high-water>/<limit>GB, limit part
+    # only when some rank knows one (monitor/memory.py poller)
+    hwm = max((_max_matching(s, "hbm_bytes_high_water")
+               for _, (_, s) in snaps.items()), default=0.0)
+    if hwm > 0:
+        limit = max((_max_matching(s, "hbm_bytes_limit")
+                     for _, (_, s) in snaps.items()), default=0.0)
+        gb = 1024.0 ** 3
+        mem = f"mem={hwm / gb:.2f}"
+        if limit > 0:
+            mem += f"/{limit / gb:.2f}"
+        parts.append(mem + "GB")
     if flops > 0 and ms > 0:
         from paddle_tpu.monitor.cost import peak_flops
         mfu = flops / (ms / 1e3) / peak_flops()

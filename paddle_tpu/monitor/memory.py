@@ -1,0 +1,568 @@
+"""Device-memory observability: compile-time ledger, runtime HBM
+accounting, and OOM postmortems.
+
+``cost.py`` answers "how much of the hardware did we use";
+this module answers "how much of the hardware do we OCCUPY" — the
+missing third axis of the observability spine (metrics, numerics/
+tracing, memory). Four layers:
+
+- **Compile-time ledger.** ``analyze_compiled(compiled)`` reads jax's
+  ``compiled.memory_analysis()`` (XLA's ``CompiledMemoryStats``:
+  argument/output/temp/alias/generated-code bytes — the memory analog
+  of ``cost_analysis``) for each AOT-compiled device segment; the
+  executor records them here (``record_segment_memory``) at
+  AOT-compile time, serving records per-bucket executables, and the
+  ``memory_ledger_bytes`` gauge attributes resident bytes to *named
+  entities* (params, optimizer slots, serving buckets, cache pools)
+  via ``ledger_set``. Capture happens ONLY where a compiled executable
+  is already in hand — compiling a lowering solely to ask its memory
+  footprint would double first-step compile cost.
+- **Runtime accounting.** ``enable(interval)`` starts a sampled
+  live-buffer poller: ``jax.live_arrays()`` aggregated by device into
+  ``hbm_bytes_in_use`` / ``hbm_bytes_limit`` / ``hbm_utilization``
+  gauges plus a high-water mark (``high_water``) the launcher status
+  line reports as ``mem=…/…GB``. ``disable()`` == zero recording: no
+  thread, no samples, no gauge series.
+- **OOM postmortem.** ``is_oom_error`` recognizes XLA's
+  RESOURCE_EXHAUSTED at the executor-dispatch and serving-replica
+  boundaries; ``handle_oom`` converts it to a typed
+  ``OutOfDeviceMemoryError`` carrying ``oom_postmortem()`` (ledger
+  table, top-K live buffers with shapes/dtypes, the segment's
+  compile-time estimate vs the limit) and dumps it through
+  ``anomaly.trip("oom")`` → flight recorder (which embeds the
+  in-flight trace when tracing is armed).
+- **Admission.** ``admission_headroom(projected)`` is the arithmetic
+  ``serving/swap.py`` consults before booting a standby pool: refuse
+  with projected numbers instead of discovering a mid-cutover OOM.
+
+``hbm_bytes_limit`` comes from ``device.memory_stats()`` when the
+backend reports one (TPU/GPU) else the ``PADDLE_TPU_HBM_LIMIT_BYTES``
+env override (CPU hosts report none — the utilization gauge stays
+unset there unless the override is given). jax is only imported
+inside functions: this module loads under the stdlib-only launcher.
+"""
+
+import os
+import threading
+import time
+
+from paddle_tpu.monitor.registry import counter, gauge
+
+__all__ = [
+    "analyze_compiled", "record_segment_memory", "memory_segments",
+    "peak_bytes_per_step", "ledger_set", "ledger_remove", "ledger",
+    "ledger_table", "enable", "disable", "poller_enabled",
+    "sample_now", "high_water", "hbm_limit_bytes",
+    "hbm_utilization_max", "device_usage", "top_live_buffers",
+    "OutOfDeviceMemoryError", "is_oom_error", "oom_postmortem",
+    "handle_oom", "admission_headroom", "summary_line", "reset",
+]
+
+#: env override for the per-device HBM capacity when the backend
+#: reports no memory_stats (CPU hosts); also the serving admission
+#: limit fallback when ServingConfig.hbm_limit_bytes is unset
+HBM_LIMIT_ENV = "PADDLE_TPU_HBM_LIMIT_BYTES"
+
+_lock = threading.Lock()
+_segments = {}            # group -> {index: {"temp_bytes", ...}}
+_latest_group = None
+_ledger = {}              # entity -> bytes
+_high_water = {}          # device label -> peak observed in-use bytes
+
+_g_temp = gauge(
+    "segment_temp_bytes",
+    "Compile-time temp-buffer bytes XLA reserves per execution of each "
+    "compiled device segment (scratch/workspace from "
+    "compiled.memory_analysis)", labels=("segment",))
+_g_arg = gauge(
+    "segment_argument_bytes",
+    "Compile-time argument-buffer bytes of each compiled device "
+    "segment (inputs resident for the call, from memory_analysis)",
+    labels=("segment",))
+_g_peak = gauge(
+    "segment_peak_bytes_estimate",
+    "Compile-time peak device bytes estimate per execution of each "
+    "compiled segment (argument + output + temp - aliased + generated "
+    "code)", labels=("segment",))
+_g_ledger = gauge(
+    "memory_ledger_bytes",
+    "Resident device/host bytes the memory ledger attributes to each "
+    "named entity (params, optimizer slots, serving buckets, cache "
+    "pools)", labels=("entity",))
+_g_in_use = gauge(
+    "hbm_bytes_in_use",
+    "Live device-buffer bytes per device, sampled by the memory "
+    "poller from jax.live_arrays aggregation", labels=("device",))
+_g_limit = gauge(
+    "hbm_bytes_limit",
+    "Device memory capacity bytes per device (backend memory_stats "
+    "when reported, else the PADDLE_TPU_HBM_LIMIT_BYTES override)",
+    labels=("device",))
+_g_util = gauge(
+    "hbm_utilization",
+    "hbm_bytes_in_use / hbm_bytes_limit per device, in [0, 1]; unset "
+    "when no limit is known (CPU host without the env override)",
+    labels=("device",))
+_g_hwm = gauge(
+    "hbm_bytes_high_water",
+    "Peak hbm_bytes_in_use observed per device since process start "
+    "(or the last reset) — the capacity-planning bytes number",
+    labels=("device",))
+_c_oom = counter(
+    "oom_errors_total",
+    "RESOURCE_EXHAUSTED device allocations converted to typed "
+    "OutOfDeviceMemoryError postmortems, by boundary",
+    labels=("where",))
+
+
+def analyze_compiled(compiled):
+    """{'argument_bytes', 'output_bytes', 'temp_bytes',
+    'generated_code_bytes', 'alias_bytes', 'peak_bytes_estimate'} from
+    a ``jax.stages.Compiled`` (XLA ``CompiledMemoryStats``), or None
+    when the backend offers none. The peak estimate is the sum of what
+    must co-reside during one execution: arguments + outputs + temps
+    - aliased (donated buffers counted once) + generated code."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def _b(attr):
+        try:
+            return float(getattr(ma, attr, 0) or 0)
+        except Exception:
+            return 0.0
+
+    arg = _b("argument_size_in_bytes")
+    out = _b("output_size_in_bytes")
+    tmp = _b("temp_size_in_bytes")
+    alias = _b("alias_size_in_bytes")
+    gen = _b("generated_code_size_in_bytes")
+    if not any((arg, out, tmp, gen)):
+        return None
+    return {
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": tmp,
+        "alias_bytes": alias,
+        "generated_code_bytes": gen,
+        "peak_bytes_estimate": max(0.0, arg + out + tmp - alias + gen),
+    }
+
+
+def record_segment_memory(group, index, analysis):
+    """Record one device segment's compile-time memory analysis under
+    ``group`` (an identity for the compiled step, e.g. ``id(step)``).
+    Same latest-group-wins gauge semantics as ``cost.record_segment``:
+    the gauges mirror ONLY the most recent group, so a retrace can't
+    leave stale segment series inflating sums."""
+    global _latest_group
+    if not analysis:
+        return
+    with _lock:
+        if group != _latest_group:
+            _g_temp.clear()
+            _g_arg.clear()
+            _g_peak.clear()
+        _segments.setdefault(group, {}).setdefault(
+            int(index), {}).update(analysis)
+        _latest_group = group
+    seg = str(index)
+    _g_temp.set(analysis.get("temp_bytes", 0.0), segment=seg)
+    _g_arg.set(analysis.get("argument_bytes", 0.0), segment=seg)
+    _g_peak.set(analysis.get("peak_bytes_estimate", 0.0), segment=seg)
+
+
+def memory_segments(group=None):
+    """{segment index: analysis dict} for ``group`` (default: the most
+    recently recorded compiled step)."""
+    with _lock:
+        g = _latest_group if group is None else group
+        return {i: dict(a) for i, a in _segments.get(g, {}).items()}
+
+
+def peak_bytes_per_step():
+    """Max compile-time peak estimate across the latest compiled
+    step's segments (segments execute sequentially, so the step's peak
+    is the worst segment, not the sum)."""
+    with _lock:
+        segs = _segments.get(_latest_group, {})
+        return max((a.get("peak_bytes_estimate", 0.0)
+                    for a in segs.values()), default=0.0)
+
+
+# -- ledger ----------------------------------------------------------------
+
+def ledger_set(entity, nbytes):
+    """Attribute ``nbytes`` resident bytes to ``entity`` (a stable
+    name like ``"train/params"`` or ``"serving/live/bucket8"``);
+    publishes/updates the ``memory_ledger_bytes`` series."""
+    entity = str(entity)
+    with _lock:
+        _ledger[entity] = float(nbytes)
+    _g_ledger.set(float(nbytes), entity=entity)
+
+
+def ledger_remove(entity):
+    """Forget ``entity`` and drop its gauge series (e.g. a released
+    serving pool)."""
+    entity = str(entity)
+    with _lock:
+        _ledger.pop(entity, None)
+    _g_ledger.remove(entity=entity)
+
+
+def ledger(prefix=None):
+    """{entity: bytes}, optionally restricted to entities whose name
+    starts with ``prefix``."""
+    with _lock:
+        if prefix is None:
+            return dict(_ledger)
+        return {k: v for k, v in _ledger.items()
+                if k.startswith(prefix)}
+
+
+def ledger_total(prefix=None):
+    """Sum of ledger bytes, optionally under ``prefix``."""
+    return sum(ledger(prefix).values())
+
+
+def ledger_table(top=None):
+    """[(entity, bytes)] sorted descending by bytes; ``top`` limits
+    the row count (postmortems and the profiler summary use this)."""
+    rows = sorted(ledger().items(), key=lambda kv: -kv[1])
+    return rows[:top] if top else rows
+
+
+# -- runtime poller --------------------------------------------------------
+
+_poller = None                  # (thread, stop_event) when enabled
+
+
+def _device_label(dev):
+    try:
+        return f"{dev.platform}:{dev.id}"
+    except Exception:
+        return str(dev)
+
+
+def hbm_limit_bytes(device=None):
+    """Capacity bytes for ``device`` (any jax device object), or the
+    env override, or None when neither side knows. The backend's
+    ``memory_stats()['bytes_limit']`` wins when present (TPU/GPU);
+    CPU reports None."""
+    if device is not None:
+        try:
+            stats = device.memory_stats()
+            if stats and stats.get("bytes_limit"):
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+    v = os.environ.get(HBM_LIMIT_ENV)
+    try:
+        return int(float(v)) if v else None
+    except ValueError:
+        return None
+
+
+def device_usage():
+    """{device label: live-buffer bytes} from ``jax.live_arrays()``
+    right now (one sample, no thread). Committed arrays count once per
+    device shard; uncommitted single-device arrays count on their
+    resident device."""
+    import jax
+    usage = {}
+    for arr in jax.live_arrays():
+        try:
+            devs = list(arr.devices())
+            nbytes = int(arr.nbytes)
+        except Exception:
+            continue
+        if not devs:
+            continue
+        per_dev = nbytes // max(1, len(devs))
+        for d in devs:
+            lbl = _device_label(d)
+            usage[lbl] = usage.get(lbl, 0) + per_dev
+    return usage
+
+
+def top_live_buffers(k=8):
+    """[{'shape', 'dtype', 'nbytes', 'device'}] for the ``k`` largest
+    live device buffers — the postmortem's "what is actually resident"
+    evidence, and the ledger diff's unattributed-buffer hint."""
+    import jax
+    rows = []
+    for arr in jax.live_arrays():
+        try:
+            rows.append({
+                "shape": tuple(arr.shape),
+                "dtype": str(arr.dtype),
+                "nbytes": int(arr.nbytes),
+                "device": ",".join(sorted(_device_label(d)
+                                          for d in arr.devices())),
+            })
+        except Exception:
+            continue
+    rows.sort(key=lambda r: -r["nbytes"])
+    return rows[:k]
+
+
+def sample_now():
+    """Take one poll sample synchronously: refresh the in-use /
+    limit / utilization gauges per device and advance the high-water
+    marks. Returns the {device: bytes} usage map. Safe on any backend;
+    never raises (telemetry must not fail a step)."""
+    try:
+        import jax
+        usage = device_usage()
+        # devices with zero live buffers still get a 0 sample so the
+        # series exists and utilization can read as 0, not absent
+        for d in jax.local_devices():
+            usage.setdefault(_device_label(d), 0)
+        limits = {_device_label(d): hbm_limit_bytes(d)
+                  for d in jax.local_devices()}
+    except Exception:
+        return {}
+    with _lock:
+        for lbl, used in usage.items():
+            if used > _high_water.get(lbl, 0):
+                _high_water[lbl] = used
+    for lbl, used in usage.items():
+        _g_in_use.set(float(used), device=lbl)
+        _g_hwm.set(float(_high_water.get(lbl, used)), device=lbl)
+        limit = limits.get(lbl) or hbm_limit_bytes()
+        if limit:
+            _g_limit.set(float(limit), device=lbl)
+            _g_util.set(used / float(limit), device=lbl)
+    return usage
+
+
+def _poll_loop(stop, interval):
+    while not stop.wait(interval):
+        sample_now()
+
+
+def enable(interval=2.0):
+    """Start the background live-buffer poller (daemon thread sampling
+    every ``interval`` seconds). Idempotent; takes one sample
+    immediately so gauges are live before the first tick."""
+    global _poller
+    with _lock:
+        if _poller is not None:
+            return
+        stop = threading.Event()
+        t = threading.Thread(target=_poll_loop,
+                             args=(stop, float(interval)),
+                             name="memory-poller", daemon=True)
+        _poller = (t, stop)
+    sample_now()
+    t.start()
+
+
+def disable():
+    """Stop the poller and drop the runtime gauge series — disabled
+    means ZERO recording (the bench overhead baseline), not stale
+    last-values."""
+    global _poller
+    with _lock:
+        p, _poller = _poller, None
+    if p is not None:
+        p[1].set()
+        p[0].join(timeout=5.0)
+    _g_in_use.clear()
+    _g_util.clear()
+
+
+def poller_enabled():
+    with _lock:
+        return _poller is not None
+
+
+def high_water(device=None):
+    """Peak observed in-use bytes — for ``device`` (label) when given,
+    else the max across devices. 0 before any sample."""
+    with _lock:
+        if device is not None:
+            return _high_water.get(device, 0)
+        return max(_high_water.values(), default=0)
+
+
+def hbm_utilization_max():
+    """Worst-device current utilization in [0, 1] from the last poll
+    sample, or None when no limit is known / no sample taken — the
+    ShedController's optional HBM-pressure input."""
+    vals = list(_g_util.samples().values())
+    return max(vals) if vals else None
+
+
+# -- OOM postmortem --------------------------------------------------------
+
+class OutOfDeviceMemoryError(RuntimeError):
+    """A device allocation failed (XLA RESOURCE_EXHAUSTED), re-raised
+    with attribution: ``.postmortem`` holds the ledger table, top live
+    buffers, the failing boundary, and the compile-time estimate vs
+    the limit (docs/DEBUGGING.md 'Why did the job OOM?')."""
+
+    def __init__(self, message, postmortem=None):
+        super().__init__(message)
+        self.postmortem = postmortem or {}
+
+
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted",
+                "out of memory", "oom")
+
+
+def is_oom_error(exc):
+    """True when ``exc`` looks like a device out-of-memory failure:
+    jaxlib raises XlaRuntimeError whose message leads with
+    RESOURCE_EXHAUSTED; allocator paths say 'out of memory'."""
+    if exc is None:
+        return False
+    if isinstance(exc, OutOfDeviceMemoryError):
+        return True
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def oom_postmortem(where, exc=None, top_k=8):
+    """Build the postmortem dict: everything needed to answer "why did
+    it OOM" without archaeology — the ledger's attribution of resident
+    bytes, the largest actually-live buffers (shape/dtype/device), the
+    latest compiled step's per-segment compile-time estimates, and the
+    in-use / limit / high-water numbers per device."""
+    try:
+        usage = sample_now()
+    except Exception:
+        usage = {}
+    try:
+        buffers = top_live_buffers(top_k)
+    except Exception:
+        buffers = []
+    limit = hbm_limit_bytes()
+    try:
+        import jax
+        devs = jax.local_devices()
+        if devs:
+            limit = hbm_limit_bytes(devs[0]) or limit
+    except Exception:
+        pass
+    return {
+        "where": str(where),
+        "error": str(exc) if exc is not None else None,
+        "ledger": ledger_table(),
+        "top_live_buffers": buffers,
+        "segments": memory_segments(),
+        "peak_bytes_estimate": peak_bytes_per_step(),
+        "hbm_bytes_in_use": dict(usage),
+        "hbm_bytes_limit": limit,
+        "hbm_bytes_high_water": dict(_high_water),
+    }
+
+
+def handle_oom(exc, where, step=None):
+    """Convert a RESOURCE_EXHAUSTED into the typed error: build the
+    postmortem, bump ``oom_errors_total{where=…}``, trip the
+    ``anomaly.trip("oom")`` escalation (health gauge + flight-recorder
+    dump embedding the in-flight trace), and raise
+    ``OutOfDeviceMemoryError`` chained from the original. Callers
+    invoke this only after ``is_oom_error(exc)``."""
+    pm = oom_postmortem(where, exc)
+    _c_oom.inc(where=str(where))
+    try:
+        from paddle_tpu.monitor import anomaly
+        anomaly.trip("oom", report=pm, step=step)
+    except Exception:
+        pass
+    est = pm.get("peak_bytes_estimate") or 0
+    limit = pm.get("hbm_bytes_limit")
+    msg = (f"device out of memory at {where}: compile-time peak "
+           f"estimate {_fmt_bytes(est)}"
+           + (f" vs limit {_fmt_bytes(limit)}" if limit else "")
+           + f"; top resident: "
+           + ", ".join(f"{e}={_fmt_bytes(b)}"
+                       for e, b in pm["ledger"][:3]))
+    raise OutOfDeviceMemoryError(msg, postmortem=pm) from exc
+
+
+# -- admission -------------------------------------------------------------
+
+def admission_headroom(projected_bytes, limit=None):
+    """(ok, projected, limit): would adding ``projected_bytes`` on top
+    of the current resident high-water mark still fit under ``limit``
+    (default: the env/backend HBM limit)? ``ok`` is True when no limit
+    is known — admission is advisory without a configured capacity."""
+    if limit is None:
+        limit = hbm_limit_bytes()
+        try:
+            import jax
+            devs = jax.local_devices()
+            if devs:
+                limit = hbm_limit_bytes(devs[0]) or limit
+        except Exception:
+            pass
+    resident = max(high_water(), int(ledger_total()))
+    projected = int(resident + projected_bytes)
+    if not limit:
+        return True, projected, None
+    return projected <= int(limit), projected, int(limit)
+
+
+# -- reporting -------------------------------------------------------------
+
+def _fmt_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.2f}{unit}")
+        n /= 1024.0
+
+
+def summary_line():
+    """One human line for ``profiler.summary()``: per-device
+    high-water mark (vs limit when known) + the top-3 ledger entries,
+    or None when nothing has been recorded."""
+    with _lock:
+        hwm = dict(_high_water)
+    rows = ledger_table(top=3)
+    if not hwm and not rows:
+        return None
+    parts = []
+    if hwm:
+        limit = hbm_limit_bytes()
+        peak = max(hwm.values())
+        parts.append("high-water " + _fmt_bytes(peak)
+                     + (f"/{_fmt_bytes(limit)}" if limit else "")
+                     + f" across {len(hwm)} device(s)")
+    if rows:
+        parts.append("top: " + ", ".join(
+            f"{e}={_fmt_bytes(b)}" for e, b in rows))
+    return "memory: " + "; ".join(parts)
+
+
+def reset():
+    """Forget segments, ledger, and high-water marks; stop the poller;
+    drop all gauge series (tests)."""
+    global _latest_group
+    disable()
+    with _lock:
+        _segments.clear()
+        _latest_group = None
+        _ledger.clear()
+        _high_water.clear()
+    _g_temp.clear()
+    _g_arg.clear()
+    _g_peak.clear()
+    _g_ledger.clear()
+    _g_in_use.clear()
+    _g_limit.clear()
+    _g_util.clear()
+    _g_hwm.clear()

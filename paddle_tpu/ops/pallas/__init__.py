@@ -8,8 +8,9 @@ fused_adam (optimizer.py), and — via ops/pallas_kernels.py —
 flash_attention / fused_layer_norm / softmax_cross_entropy."""
 
 from paddle_tpu.ops.pallas.registry import (  # noqa: F401
-    register_kernel, get_kernel, list_kernels, dispatch, get_body,
-    selected_body, use_pallas, selection_mode, override, platform,
+    DEFAULT_VMEM_BUDGET, register_kernel, get_kernel, list_kernels,
+    dispatch, get_body, selected_body, use_pallas, selection_mode,
+    override, platform, within_vmem_budget,
 )
 from paddle_tpu.ops.pallas import matmul as _matmul  # noqa: F401
 from paddle_tpu.ops.pallas import embedding as _embedding  # noqa: F401
@@ -30,4 +31,5 @@ __all__ = [
     "register_kernel", "get_kernel", "list_kernels", "dispatch",
     "get_body", "selected_body", "use_pallas", "selection_mode",
     "override", "platform", "try_fused_matmul",
+    "within_vmem_budget", "DEFAULT_VMEM_BUDGET",
 ]

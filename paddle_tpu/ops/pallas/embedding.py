@@ -178,10 +178,6 @@ def _scatter_bwd(interpret, ids, dy):
 
 _scatter_add.defvjp(_scatter_fwd, _scatter_bwd)
 
-# updates held whole in VMEM by the one-hot body — past this, fall back
-_SCATTER_VMEM_BUDGET = 4 << 20  # fp32 elements (~16 MB)
-
-
 def embedding_scatter_add_pallas(dst, ids, updates, interpret=False):
     """dst[ids] += updates via per-row-block one-hot matmul reduction."""
     dst = jnp.asarray(dst)
@@ -189,9 +185,12 @@ def embedding_scatter_add_pallas(dst, ids, updates, interpret=False):
     updates = jnp.asarray(updates)
     if ids.shape[0] == 0 or dst.ndim != 2 or updates.ndim != 2:
         return embedding_scatter_add_reference(dst, ids, updates)
+    # the one-hot body holds the padded updates block whole in VMEM —
+    # the shared registry budget guard decides (and counts) fallback
     n_pad = _round_up(ids.shape[0], 128)
     dp = _round_up(dst.shape[1], 128)
-    if n_pad * dp > _SCATTER_VMEM_BUDGET:
+    if not _registry.within_vmem_budget("embedding_scatter_add",
+                                        n_pad * dp):
         return embedding_scatter_add_reference(dst, ids, updates)
     return _scatter_add(dst, ids, updates, bool(interpret))
 

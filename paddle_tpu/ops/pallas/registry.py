@@ -31,8 +31,14 @@ from paddle_tpu.core.flags import define_flag, get_flag
 __all__ = [
     "register_kernel", "get_kernel", "list_kernels", "dispatch",
     "get_body", "selected_body", "use_pallas", "selection_mode",
-    "override", "platform",
+    "override", "platform", "within_vmem_budget",
+    "DEFAULT_VMEM_BUDGET",
 ]
+
+#: fp32 elements a kernel body may hold whole in VMEM (~16 MB of a
+#: v5e core's ~16 MB/core VMEM at 4 B/element) — the shared default
+#: every budget-guarded kernel falls back past
+DEFAULT_VMEM_BUDGET = 4 << 20
 
 _REGISTRY = {}
 _lock = threading.Lock()
@@ -173,6 +179,33 @@ def _note_selection(name, body):
         g.set(1, kernel=name, body=body)
     except Exception:  # pragma: no cover - telemetry must never fail a step
         pass
+
+
+def within_vmem_budget(kernel, elements, budget=None):
+    """True when a kernel body planning to hold ``elements`` fp32
+    elements whole in VMEM fits under ``budget`` (default
+    :data:`DEFAULT_VMEM_BUDGET`). The shared guard every Pallas body
+    calls BEFORE committing to its VMEM-resident strategy: a False
+    means "fall back to the reference body", and every such rejection
+    counts in ``pallas_vmem_budget_rejections_total{kernel}`` so
+    budget fallbacks are visible per kernel instead of silently
+    vanishing into the reference path."""
+    if budget is None:
+        budget = DEFAULT_VMEM_BUDGET
+    if int(elements) <= int(budget):
+        return True
+    try:
+        from paddle_tpu.monitor.registry import counter
+        counter("pallas_vmem_budget_rejections_total",
+                "Pallas kernel dispatches that fell back to the "
+                "stock reference body because the planned "
+                "VMEM-resident working set exceeded the budget "
+                "(fp32 elements, ops/pallas/registry.py "
+                "within_vmem_budget)",
+                labels=("kernel",)).inc(kernel=str(kernel))
+    except Exception:  # pragma: no cover - telemetry must never fail a step
+        pass
+    return False
 
 
 def get_body(name, which):

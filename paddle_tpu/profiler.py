@@ -297,6 +297,13 @@ def summary(sorted_key="total", profile_path=None):
             f"(flops/step={_cost.flops_per_step():.3e}, "
             f"ms/step={ms:.3f}, peak={_cost.peak_flops():.3e} FLOP/s "
             f"-- see docs/OBSERVABILITY.md for CPU-host caveats)")
+    from paddle_tpu.monitor import memory as _memory
+    mem_line = _memory.summary_line()
+    if mem_line is not None:
+        lines.append(
+            mem_line + " -- live-buffer accounting; on a CPU host "
+            "the limit needs PADDLE_TPU_HBM_LIMIT_BYTES "
+            "(docs/OBSERVABILITY.md)")
     report = "\n".join(lines)
     if profile_path:
         with open(profile_path, "w") as f:

@@ -39,6 +39,7 @@ the pool — loudly. If every slot retires, the supervisor keeps
 draining the batch queue and failing riders so no request ever hangs.
 """
 
+import itertools
 import queue
 import sys
 import threading
@@ -80,6 +81,10 @@ _m_param_bytes = gauge(
 
 #: batch-queue sentinel, one per live replica at shutdown
 _STOP = object()
+
+#: monotonic pool tags scoping memory-ledger entities — two pools
+#: coexist during a hot swap, so role alone cannot name residency
+_POOL_SEQ = itertools.count()
 
 #: replica lifecycle states (the serving_replica_state vocabulary)
 _UP, _QUARANTINED, _RETIRED = "up", "quarantined", "retired"
@@ -241,8 +246,17 @@ class Replica:
                 f"{bucket} (ladder {sorted(self._executables)})")
         fd = tuple(jax.device_put(feeds[n], self.device)
                    for n in self._feed_names)
-        outs = exe(self._params, fd)
-        return [np.asarray(o) for o in outs]
+        try:
+            outs = exe(self._params, fd)
+            return [np.asarray(o) for o in outs]
+        except Exception as e:
+            from paddle_tpu.monitor import memory as _memory
+            if _memory.is_oom_error(e):
+                # typed postmortem instead of a raw RESOURCE_EXHAUSTED
+                # traceback; flows through _loop's failure handling to
+                # mb.fail, so riders see the attributed error
+                _memory.handle_oom(e, f"serving.replica/bucket{bucket}")
+            raise
 
 
 class ReplicaPool:
@@ -307,6 +321,12 @@ class ReplicaPool:
         #: (bench.py serving BENCH_SERVING_QUANT A/B reads this)
         self._param_bytes = int(sum(np.asarray(p).nbytes
                                     for p in params_np))
+        #: per-bucket CompiledMemoryStats (one device's — buckets
+        #: compile identically per device); feeds projected_bytes()
+        #: and the memory ledger
+        self._bucket_mem = {}
+        self._pool_tag = f"pool{next(_POOL_SEQ)}"
+        self._ledger_entities = ()
         jitted = jax.jit(pure_fn)
         self._by_device = {}        # device -> (params, {bucket: exe})
         for dev in {devices[i % len(devices)]: None
@@ -326,7 +346,15 @@ class ReplicaPool:
                     (sample_specs[n] for n in self._feed_names))
                 exes[bucket] = jitted.lower(param_sds,
                                             feed_sds).compile()
+                if bucket not in self._bucket_mem:
+                    try:
+                        from paddle_tpu.monitor import memory as _memory
+                        self._bucket_mem[bucket] = \
+                            _memory.analyze_compiled(exes[bucket])
+                    except Exception:
+                        self._bucket_mem[bucket] = None
             self._by_device[dev] = (params, exes)
+        self._ledger_publish()
         self._stopped = False
         #: True only after a TRUE close finished its final sweep — the
         #: dispatch() post-put sweep keys on it (see dispatch)
@@ -384,6 +412,50 @@ class ReplicaPool:
         _m_replicas.set(counts[_UP])
         _m_param_bytes.set(self._param_bytes)
 
+    def projected_bytes(self):
+        """Per-device bytes this pool needs to co-reside: the worst
+        bucket's compile-time peak estimate (params ride as arguments,
+        so the estimate already covers them + feeds + temps + outputs)
+        when the backend reported one, else the raw param bytes — the
+        number swap admission projects BEFORE booting a standby."""
+        peaks = [m.get("peak_bytes_estimate", 0.0)
+                 for m in self._bucket_mem.values() if m]
+        return int(max([self._param_bytes] + peaks))
+
+    def _ledger_publish(self):
+        """Attribute this pool's device residency in the memory
+        ledger: params (summed across the pool's distinct devices) +
+        each bucket executable's compile-time peak. Entities are
+        scoped by the pool's own tag, NOT the role alone — during a
+        hot swap two pools coexist and the ledger must show BOTH
+        (that ~2x-param window is exactly what memory-aware admission
+        guards). Never fatal — telemetry must not fail a boot or a
+        cutover."""
+        try:
+            from paddle_tpu.monitor import memory as _memory
+            self._ledger_drop()
+            ndev = max(1, len(self._by_device))
+            pre = f"serving/{self._pool_tag}:{self.role}"
+            entities = {f"{pre}/params": self._param_bytes * ndev}
+            for bucket, m in self._bucket_mem.items():
+                if m:
+                    entities[f"{pre}/bucket{bucket}"] = \
+                        m.get("peak_bytes_estimate", 0.0)
+            for e, b in entities.items():
+                _memory.ledger_set(e, b)
+            self._ledger_entities = tuple(entities)
+        except Exception:
+            pass
+
+    def _ledger_drop(self):
+        try:
+            from paddle_tpu.monitor import memory as _memory
+            for e in getattr(self, "_ledger_entities", ()):
+                _memory.ledger_remove(e)
+            self._ledger_entities = ()
+        except Exception:
+            pass
+
     def promote(self):
         """Standby -> live at hot-swap cutover: take gauge ownership
         and publish this pool's current states (flip and publish under
@@ -392,6 +464,7 @@ class ReplicaPool:
         with self._lock:
             self.role = "live"
             self._publish_states()
+            self._ledger_publish()
 
     def demote(self):
         """Live -> draining-out at hot-swap cutover (or rollback of a
@@ -405,12 +478,16 @@ class ReplicaPool:
         state change."""
         with self._lock:
             self.role = "standby"
+            # its residency is still real until release(): re-attribute
+            # under the draining role rather than vanish from the ledger
+            self._ledger_publish()
 
     def release(self):
         """Drop the device-resident param copies and executable maps
         after a TRUE close — the hot swap's ~2x-param-memory window
         ends here, when the drained old pool lets go. A released pool
         cannot respawn; only call once close() returned True."""
+        self._ledger_drop()
         self._by_device.clear()
         for r in self.replicas:
             r._params = ()
@@ -674,6 +751,7 @@ class ReplicaPool:
         # and sweeps itself — either way its riders get a typed error,
         # never silence.
         self._closed_done = True
+        self._ledger_drop()
         self._fail_queued(
             "serving pool closed with this batch undispatched (no "
             "live replica remained to run it)")

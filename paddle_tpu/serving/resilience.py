@@ -103,7 +103,8 @@ _m_shed = counter(
     "serving_shed_total",
     "Requests shed at admission by the adaptive brownout controller, "
     "by reason: brownout (queue-wait p50 exceeded the request's "
-    "deadline headroom while the brownout was active)",
+    "deadline headroom while the brownout was active), hbm_pressure "
+    "(worst-device HBM utilization at/above shed_hbm_frac)",
     labels=("reason",))
 _m_brownout = gauge(
     "serving_brownout",
@@ -142,13 +143,21 @@ class ShedController:
       The window is cleared on exit so stale overload samples cannot
       re-trigger instantly.
 
+    Optional HBM-pressure input (``hbm_high_frac``): worst-device
+    utilization from the memory poller (``monitor.memory``) at/above
+    the fraction sheds new admissions with ``reason="hbm_pressure"``
+    regardless of queue-wait state — device-memory exhaustion, unlike
+    queue wait, does not heal by admitting fewer marginal requests,
+    so there is no hysteresis: the shed lasts exactly as long as the
+    pressure reading does. None (the default) disables the input.
+
     The clean path stays cheap: ``should_shed`` is a few unlocked
     float compares when not in brownout; the median runs on the
     batcher thread (bounded window), never on ``submit``.
     """
 
     def __init__(self, deadline_ms, enter_frac=0.5, exit_frac=0.25,
-                 window=64, min_samples=8):
+                 window=64, min_samples=8, hbm_high_frac=None):
         enforce(deadline_ms is not None and float(deadline_ms) > 0,
                 f"ShedController needs a positive reference "
                 f"deadline_ms (ServingConfig.default_deadline_ms), "
@@ -160,9 +169,15 @@ class ShedController:
         enforce(int(min_samples) >= 1 and int(window) >= int(min_samples),
                 f"shed window must hold min_samples "
                 f"(window={window}, min_samples={min_samples})")
+        enforce(hbm_high_frac is None or
+                0.0 < float(hbm_high_frac) <= 1.0,
+                f"shed_hbm_frac must be in (0, 1], got "
+                f"{hbm_high_frac!r}")
         self.deadline_ms = float(deadline_ms)
         self.enter_frac = float(enter_frac)
         self.exit_frac = float(exit_frac)
+        self.hbm_high_frac = None if hbm_high_frac is None \
+            else float(hbm_high_frac)
         self._min_samples = int(min_samples)
         self._waits = collections.deque(maxlen=int(window))
         self._p50 = 0.0         # GIL-atomic float, read by submit
@@ -202,6 +217,15 @@ class ShedController:
         admit. ``deadline_ms`` is THIS request's effective deadline;
         ``queue_depth`` the request queue's current depth (0 exits the
         brownout on the spot — drained means the window is history)."""
+        if self.hbm_high_frac is not None:
+            try:
+                from paddle_tpu.monitor import memory as _memory
+                util = _memory.hbm_utilization_max()
+            except Exception:
+                util = None
+            if util is not None and util >= self.hbm_high_frac:
+                _m_shed.inc(reason="hbm_pressure")
+                return "hbm_pressure"
         if not self._brownout:
             return None
         if queue_depth == 0:

@@ -82,9 +82,9 @@ _m_requests = counter(
     labels=("outcome",))
 _m_latency = histogram(
     "serving_request_latency_ms",
-    "End-to-end serving request latency: submit accept -> result "
-    "ready (queue wait + batching wait + execute); p50/p99 derive "
-    "from the buckets")
+    "End-to-end serving request latency in wall ms: submit accept -> "
+    "result ready (queue wait + batching wait + execute); p50/p99 "
+    "derive from the buckets")
 _m_queue_depth = gauge(
     "serving_queue_depth",
     "Requests currently waiting in the serving request queue "

@@ -79,6 +79,16 @@ class ServingConfig:
     - ``shed_enter_frac`` / ``shed_exit_frac``: brownout hysteresis
       thresholds as fractions of the deadline (see
       ``resilience.ShedController``).
+    - ``hbm_limit_bytes``: per-device HBM capacity the memory-aware
+      admission projects against (hot-swap standby boot refuses when
+      the two pools cannot co-reside under it — docs/SERVING.md
+      "Memory-aware admission"). Default None falls back to the
+      backend-reported limit / ``PADDLE_TPU_HBM_LIMIT_BYTES``; with
+      neither, admission is advisory (never refuses).
+    - ``shed_hbm_frac``: optional HBM-pressure shed input — worst-
+      device utilization at/above this fraction sheds new admissions
+      (``reason="hbm_pressure"``); requires the memory poller
+      (``monitor.memory.enable()``) for live samples. None disables.
     """
 
     def __init__(self, max_batch=8, max_wait_ms=5.0, max_queue=256,
@@ -87,7 +97,8 @@ class ServingConfig:
                  replica_stall_ms=30_000.0, max_consecutive_stalls=3,
                  respawn_backoff_ms=100.0, supervise=True,
                  shed_mode="off", shed_enter_frac=0.5,
-                 shed_exit_frac=0.25):
+                 shed_exit_frac=0.25, hbm_limit_bytes=None,
+                 shed_hbm_frac=None):
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
         self.max_queue = max_queue
@@ -103,6 +114,8 @@ class ServingConfig:
         self.shed_mode = shed_mode
         self.shed_enter_frac = shed_enter_frac
         self.shed_exit_frac = shed_exit_frac
+        self.hbm_limit_bytes = hbm_limit_bytes
+        self.shed_hbm_frac = shed_hbm_frac
 
 
 def _infer_sample_specs(program, feed_names, overrides):
@@ -294,7 +307,8 @@ class InferenceServer:
             shed = ShedController(
                 deadline_ms=config.default_deadline_ms,
                 enter_frac=config.shed_enter_frac,
-                exit_frac=config.shed_exit_frac)
+                exit_frac=config.shed_exit_frac,
+                hbm_high_frac=config.shed_hbm_frac)
         bundle = _load_bundle(model_dir, config.feed_specs,
                               verify=config.verify_aot)
         self._apply_bundle(bundle)

@@ -49,8 +49,8 @@ instead of crash-looping the gate.
 
 Telemetry: ``serving_model_version{version}`` (1 for the live version,
 superseded series removed) and
-``serving_swaps_total{outcome=ok|gate_failed|canary_failed|rolled_back}``
-(docs/OBSERVABILITY.md).
+``serving_swaps_total{outcome=ok|gate_failed|refused_memory|
+canary_failed|rolled_back}`` (docs/OBSERVABILITY.md).
 """
 
 import threading
@@ -84,7 +84,9 @@ _m_swaps = counter(
     "through the standby executables failed shape/finiteness/parity), "
     "rolled_back (standby warm-boot failed or wedged, cutover "
     "reverted, or the post-cutover watchdog tripped — the old "
-    "version is serving again)",
+    "version is serving again), refused_memory (memory-aware "
+    "admission projected the standby could not co-reside with the "
+    "live pool under the HBM limit — refused BEFORE booting it)",
     labels=("outcome",))
 
 _version_lock = threading.Lock()
@@ -227,6 +229,10 @@ class SwapController:
              f"{bundle.version or 'unversioned'} (live: "
              f"{old_version or 'unversioned'}); warm-booting standby")
 
+        ta = time.perf_counter()
+        self._admit(bundle)
+        stage_ms["admit"] = round((time.perf_counter() - ta) * 1e3, 2)
+
         t1 = time.perf_counter()
         standby = self._standby(bundle, standby_timeout_ms)
         stage_ms["standby"] = round((time.perf_counter() - t1) * 1e3, 2)
@@ -363,6 +369,49 @@ class SwapController:
                 f"swap gate refused {model_dir!r}: {e}",
                 stage="gate") from e
         return bundle
+
+    # -- stage 1.5: memory-aware admission --------------------------------
+    def _admit(self, bundle):
+        """Project whether the standby can CO-RESIDE with the live
+        pool under the per-device HBM limit — and refuse with the
+        projected numbers BEFORE the expensive warm boot, instead of
+        discovering a mid-cutover OOM. Projection (per device): the
+        live pool's worst-bucket compile-time peak (its params ride
+        as arguments, so that covers the whole pool) + one copy of
+        the standby's param bytes (its executables aren't compiled
+        yet — params dominate, and the refusal errs permissive).
+        Limit: ``ServingConfig.hbm_limit_bytes``, else the backend /
+        PADDLE_TPU_HBM_LIMIT_BYTES fallback; no known limit means
+        admission is advisory and always passes."""
+        from paddle_tpu.monitor import memory as _memory
+        limit = self._server.config.hbm_limit_bytes
+        if limit is None:
+            limit = _memory.hbm_limit_bytes()
+            try:
+                import jax
+                devs = jax.local_devices()
+                if devs:
+                    limit = _memory.hbm_limit_bytes(devs[0]) or limit
+            except Exception:
+                pass
+        if not limit:
+            return
+        live = int(self._server.pool.projected_bytes())
+        standby_params = int(sum(np.asarray(p).nbytes
+                                 for p in bundle.params_np))
+        projected = live + standby_params
+        if projected <= int(limit):
+            return
+        _m_swaps.inc(outcome="refused_memory")
+        msg = (f"standby {bundle.version or 'unversioned'} projects "
+               f"{projected} bytes per device (live pool {live} + "
+               f"standby params {standby_params}) over the HBM limit "
+               f"{int(limit)} — the two versions cannot co-reside "
+               f"for the cutover window")
+        _log(f"SWAP REFUSED at memory admission: {msg}")
+        raise SwapFailedError(
+            f"swap refused at memory admission: {msg}",
+            stage="admission")
 
     # -- stage 2: standby warm boot ---------------------------------------
     def _build_standby_pool(self, bundle):

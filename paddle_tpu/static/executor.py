@@ -571,6 +571,13 @@ class _CompiledStep:
                     txt = None
                 _cost.record_segment_comm(id(self), compiled,
                                           _cost.estimate_comm(txt))
+                # memory analysis likewise lives on the COMPILED
+                # executable (CompiledMemoryStats) — captured here so
+                # the lazy first-call path never compiles twice just
+                # to ask a footprint
+                from paddle_tpu.monitor import memory as _memory
+                _memory.record_segment_memory(
+                    id(self), compiled, _memory.analyze_compiled(exe))
             out = jax.eval_shape(fn, donated, rest, base_key, step_idx)
             compiled += 1
             env = {k: _spec_of(v) for k, v in self.constants.items()}
@@ -828,12 +835,24 @@ class Executor:
             fid = next(_flow_ids)
             t_disp = time.perf_counter()
             with RecordEvent("executor.run/dispatch", args={"flow": fid}):
-                if check:
-                    fetches, new_state, sentinels = runner.step(
-                        state, feeds, base_key, step_idx, check=True)
-                else:
-                    fetches, new_state = runner.step(state, feeds, base_key,
-                                                     step_idx)
+                try:
+                    if check:
+                        fetches, new_state, sentinels = runner.step(
+                            state, feeds, base_key, step_idx, check=True)
+                    else:
+                        fetches, new_state = runner.step(
+                            state, feeds, base_key, step_idx)
+                except Exception as e:
+                    from paddle_tpu.monitor import memory as _memory
+                    if _memory.is_oom_error(e):
+                        # typed OOM with attribution: ledger table, top
+                        # live buffers, compile-time estimate vs limit,
+                        # dumped via anomaly.trip("oom") (which embeds
+                        # the in-flight trace). The BaseException
+                        # handler below still ends the trace as error.
+                        _memory.handle_oom(e, "executor.run/dispatch",
+                                           step=int(step_idx))
+                    raise
             if tctx is not None:
                 # recorded BEFORE the sentinel verification so a
                 # non-finite trip's postmortem already names the dispatch
@@ -954,6 +973,24 @@ class Executor:
             if v is None:                 # host-written: materializes at
                 continue                  # step time, can't be spec'd
             state[n] = v
+        try:
+            # ledger attribution of scope residency: optimizer slots
+            # are named "<param>@<slot>" and internal optimizer state
+            # leads with "@" — everything else is a persistable param
+            from paddle_tpu.monitor import memory as _memory
+            p_bytes = s_bytes = 0
+            for n, v in state.items():
+                nb = int(getattr(v, "nbytes", 0) or
+                         np.asarray(v).nbytes)
+                if "@" in n:
+                    s_bytes += nb
+                else:
+                    p_bytes += nb
+            _memory.ledger_set("train/params", p_bytes)
+            if s_bytes:
+                _memory.ledger_set("train/optimizer_slots", s_bytes)
+        except Exception:
+            pass
         if sspec is not None:
             # abstract inputs carry the SPEC-derived shardings, so the
             # AOT compile partitions exactly like the first real step

@@ -1,0 +1,103 @@
+"""Training worker for the OOM-postmortem end-to-end test.
+
+Same shape as monitor_worker.py (real Executor loop, flight recorder
+armed from the launcher env, per-rank metrics snapshots, heartbeats)
+but at step PT_OOM_AT_STEP the selected rank's next dispatch raises a
+fake XLA RESOURCE_EXHAUSTED from INSIDE the executor's dispatch
+boundary (the prepared runner's ``step`` is wrapped for one call) —
+the exact place a real device OOM surfaces. The executor must convert
+it to a typed ``OutOfDeviceMemoryError`` whose postmortem names the
+compiled segment, the compile-time estimate, the top live buffers and
+the ledger; the worker writes error + postmortem to its report and
+exits 0 (the test asserts on the artifacts, not the exit).
+
+argv: out_prefix total_steps [step_secs]
+
+Scoped by PT_FAULT_RANK like testing/faults.py (default: every rank).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    out_prefix = sys.argv[1]
+    total_steps = int(sys.argv[2])
+    step_secs = float(sys.argv[3]) if len(sys.argv) > 3 else 0.05
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    oom_at = int(os.environ.get("PT_OOM_AT_STEP", "-1"))
+    want_rank = os.environ.get("PT_FAULT_RANK")
+    inject = oom_at >= 0 and (want_rank in (None, "", rank))
+
+    from paddle_tpu.monitor import flight_recorder
+    flight_recorder.install_from_env()
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.health import Heartbeat
+    from paddle_tpu.monitor.exporter import RankExporter
+    from paddle_tpu.monitor.memory import OutOfDeviceMemoryError
+    from paddle_tpu.static import executor as _ex
+
+    hb = Heartbeat.from_env(interval=0.1)
+    exp = RankExporter.from_env(interval=0.5)
+    if exp is not None:
+        exp.start()
+
+    pt.enable_static()
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        x = pt.static.data("x", [4], dtype="float32")
+        y = pt.static.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = pt.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True).astype(np.float32)
+    # AOT warm-up: records the per-segment memory_analysis gauges the
+    # postmortem's segment table is built from
+    exe.prepare(main_p, feed={"x": xv, "y": yv}, fetch_list=[loss])
+
+    def arm_oom():
+        orig = _ex._PreparedRunner.step
+
+        def oom_step(self, *a, **k):
+            _ex._PreparedRunner.step = orig     # one-shot
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 98765432100 bytes. (injected by "
+                "memory_oom_worker)")
+
+        _ex._PreparedRunner.step = oom_step
+
+    report = {"steps": 0, "oom": None}
+    try:
+        for step in range(total_steps):
+            if inject and step == oom_at:
+                arm_oom()
+            exe.run(main_p, feed={"x": xv, "y": yv},
+                    fetch_list=[loss])
+            report["steps"] = step + 1
+            if hb is not None:
+                hb.beat()
+            time.sleep(step_secs)
+    except OutOfDeviceMemoryError as e:
+        report["oom"] = {
+            "type": type(e).__name__,
+            "message": str(e),
+            "postmortem": e.postmortem,
+        }
+    if exp is not None:
+        exp.stop()              # final snapshot carries oom_errors_total
+    with open(f"{out_prefix}.rank{rank}.json", "w") as f:
+        json.dump(report, f, default=str)
+
+
+if __name__ == "__main__":
+    main()

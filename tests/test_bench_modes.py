@@ -29,6 +29,13 @@ def _run_mode(mode, timeout=600, extra_env=None):
     lines = [json.loads(ln) for ln in r.stdout.splitlines()
              if ln.startswith("{")]
     assert lines, r.stdout[-500:]
+    # every mode exits through bench.main's finally hook, which samples
+    # device memory once and emits peak_hbm_bytes — assert it here so a
+    # mode can't silently lose the field
+    peaks = [ln for ln in lines if ln.get("metric") == "peak_hbm_bytes"]
+    assert peaks, r.stdout[-500:]
+    assert peaks[-1]["unit"] == "bytes" and peaks[-1]["value"] >= 0
+    assert "sampled_continuously" in peaks[-1]
     return lines
 
 
@@ -40,6 +47,8 @@ class TestBenchModes:
         assert any(m.startswith("int8_resnet50convs") for m in metrics)
         assert any(m.startswith("int8_bert_layer") for m in metrics)
         for ln in lines:
+            if not ln["metric"].startswith("int8_"):
+                continue        # e.g. the mode-agnostic peak_hbm_bytes
             assert ln["unit"] == "x" and ln["value"] > 0
             assert ln["int8_ms"] > 0 and ln["bf16_ms"] > 0
 
@@ -56,6 +65,7 @@ class TestBenchModes:
                           extra_env={"BENCH_SERVING_REQS": "40",
                                      "BENCH_SERVING_TRACE_PAIRS": "2",
                                      "BENCH_SERVING_TRACE_WIN": "60",
+                                     "BENCH_SERVING_MEM_PAIRS": "2",
                                      "BENCH_METRICS_OUT": metrics_out})
         by = {ln["metric"]: ln for ln in lines}
         for tag in ("serving_baseline_qps", "serving_server_qps"):
@@ -87,6 +97,14 @@ class TestBenchModes:
         assert ov["unit"] == "x" and ov["value"] > 0
         assert ov["value"] < 1.05, ov
         assert ov["traced_p50_ms"] > 0 and ov["untraced_p50_ms"] > 0
+        # HBM-poller overhead: same ABBA protocol, poller on vs off —
+        # sampled live-array accounting must stay inside the 1.05x
+        # hot-path bound on the serving path
+        mem = by["memory_overhead_ratio"]
+        assert mem["path"] == "serving" and mem["unit"] == "x"
+        assert mem["value"] < 1.05, mem
+        assert mem["polled_p50_ms"] > 0 and mem["unpolled_p50_ms"] > 0
+        assert len(mem["pair_ratios"]) >= 2
         with open(metrics_out) as f:
             snap = f.read()
         for name in ("serving_requests_total", "serving_queue_depth",
@@ -160,6 +178,7 @@ class TestBenchModes:
                           extra_env={"BENCH_DISPATCH_STEPS": "10",
                                      "BENCH_DISPATCH_TRACE_PAIRS": "6",
                                      "BENCH_DISPATCH_TRACE_WIN": "8",
+                                     "BENCH_DISPATCH_MEM_PAIRS": "2",
                                      "XLA_FLAGS":
                                      "--xla_force_host_platform_"
                                      "device_count=8"},
@@ -178,6 +197,13 @@ class TestBenchModes:
             and attr["dispatch_share"] > 0.2, attr
         assert attr["prepare_share"] is not None \
             and 0 <= attr["prepare_share"] <= 1
+        # HBM-poller overhead on the dispatch hot path — same ABBA
+        # protocol and 1.05x bound as the serving-side check
+        mem = by["memory_overhead_ratio"]
+        assert mem["path"] == "dispatch" and mem["unit"] == "x"
+        assert mem["value"] < 1.05, mem
+        assert mem["polled_ms_per_step"] > 0
+        assert mem["unpolled_ms_per_step"] > 0
 
     def test_numerics_mode_emits_overhead_ratio(self):
         """`bench.py numerics` must A/B the check_nan_inf sentinels on

@@ -1,0 +1,541 @@
+"""Device-memory observability tests (paddle_tpu/monitor/memory.py,
+docs/OBSERVABILITY.md, docs/DEBUGGING.md "Why did the job OOM?").
+
+Tier-1 fast: the compile-time ledger (memory_analysis capture at
+Executor.prepare and its latest-group-wins gauges), the entity ledger,
+the live-buffer poller (disable == ZERO recording), the typed OOM
+postmortem at the executor-dispatch boundary, memory-aware swap
+admission (refusal with projected numbers, BEFORE the standby boots),
+and the launcher status line's ``mem=`` field.
+
+Slow: the 2-rank e2e where an injected RESOURCE_EXHAUSTED inside
+dispatch must leave a typed postmortem naming the segment, the
+compile-time estimate and the top live buffers (the acceptance run),
+and the oversized-model hot-swap refusal under a real HBM limit.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.monitor import memory
+from paddle_tpu.monitor.registry import REGISTRY, Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OOM_WORKER = os.path.join(REPO, "tests", "memory_oom_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memory():
+    memory.reset()
+    yield
+    memory.reset()
+
+
+def _counter(name, **labels):
+    m = REGISTRY.get(name)
+    return m.value(**labels) if m else 0.0
+
+
+def _gauge_samples(name):
+    m = REGISTRY.get(name)
+    return m.samples() if m else {}
+
+
+def _compiled(n=32):
+    import jax
+    import jax.numpy as jnp
+    x = jnp.zeros((n, n), jnp.float32)
+    return jax.jit(lambda a: a @ a + 1.0).lower(x).compile()
+
+
+def _tiny_train_setup():
+    """Build + AOT-prepare a tiny regressor; returns (exe, program,
+    feed, loss) ready for exe.run."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework import unique_name
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [4], dtype="float32")
+        y = pt.static.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, size=3)
+        loss = pt.layers.mean(
+            pt.layers.square_error_cost(pt.layers.fc(pred, size=1), y))
+        pt.optimizer.SGDOptimizer(0.1).minimize(loss)
+    scope = pt.static.Scope()
+    guard = pt.static.scope_guard(scope)
+    guard.__enter__()
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = {"x": np.ones((8, 4), np.float32),
+            "y": np.ones((8, 1), np.float32)}
+    exe.prepare(main, feed=feed, fetch_list=[loss])
+    return exe, main, feed, loss, guard
+
+
+# ---------------------------------------------------------------------------
+class TestCompileTimeLedger:
+    def test_analyze_compiled_reports_sizes(self):
+        a = memory.analyze_compiled(_compiled())
+        assert a is not None
+        for k in ("argument_bytes", "output_bytes", "temp_bytes",
+                  "alias_bytes", "generated_code_bytes",
+                  "peak_bytes_estimate"):
+            assert k in a and a[k] >= 0
+        # 32x32 fp32 in and out must show up in the estimate
+        assert a["peak_bytes_estimate"] >= 2 * 32 * 32 * 4
+
+    def test_record_segment_latest_group_wins(self):
+        memory.record_segment_memory(
+            "g1", 0, {"temp_bytes": 1.0, "argument_bytes": 2.0,
+                      "peak_bytes_estimate": 100.0})
+        memory.record_segment_memory(
+            "g1", 1, {"temp_bytes": 3.0, "argument_bytes": 4.0,
+                      "peak_bytes_estimate": 300.0})
+        assert set(memory.memory_segments()) == {0, 1}
+        # sequential segments: the step's peak is the WORST one
+        assert memory.peak_bytes_per_step() == 300.0
+        # a retrace (new group) must clear the old series — no stale
+        # segment gauges inflating sums
+        memory.record_segment_memory(
+            "g2", 0, {"temp_bytes": 7.0, "argument_bytes": 8.0,
+                      "peak_bytes_estimate": 50.0})
+        assert set(memory.memory_segments()) == {0}
+        assert memory.peak_bytes_per_step() == 50.0
+        assert _gauge_samples("segment_peak_bytes_estimate") == {
+            ("0",): 50.0}
+        assert _gauge_samples("segment_temp_bytes") == {("0",): 7.0}
+        # the old group's raw table is still queryable by key
+        assert memory.memory_segments("g1")[1]["temp_bytes"] == 3.0
+        # a None/empty analysis (backend without memory stats) is a
+        # silent no-op, not a crash or a group reset
+        memory.record_segment_memory("g3", 0, None)
+        assert set(memory.memory_segments()) == {0}
+        assert memory.peak_bytes_per_step() == 50.0
+
+    def test_executor_prepare_captures_segments_and_ledger(self):
+        exe, main, feed, loss, guard = _tiny_train_setup()
+        try:
+            segs = memory.memory_segments()
+            assert segs, "prepare() must record memory_analysis"
+            assert all(s["peak_bytes_estimate"] > 0
+                       for s in segs.values())
+            led = memory.ledger("train/")
+            assert led.get("train/params", 0) > 0
+            # every ledger entry mirrors into the gauge
+            samples = _gauge_samples("memory_ledger_bytes")
+            assert (("train/params",) in samples
+                    and samples[("train/params",)] == led["train/params"])
+            # and the step still runs (capture is observation-only)
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(lv).all()
+        finally:
+            guard.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+class TestEntityLedger:
+    def test_set_query_remove(self):
+        memory.ledger_set("train/params", 1000)
+        memory.ledger_set("train/optimizer_slots", 2000)
+        memory.ledger_set("serving/pool0:live/params", 4000)
+        assert memory.ledger_total() == 7000
+        assert memory.ledger_total("train/") == 3000
+        assert memory.ledger("serving/") == {
+            "serving/pool0:live/params": 4000.0}
+        assert memory.ledger_table(top=1) == [
+            ("serving/pool0:live/params", 4000.0)]
+        memory.ledger_remove("serving/pool0:live/params")
+        assert memory.ledger_total() == 3000
+        assert ("serving/pool0:live/params",) not in _gauge_samples(
+            "memory_ledger_bytes")
+
+
+# ---------------------------------------------------------------------------
+class TestRuntimePoller:
+    def test_sample_now_and_high_water(self):
+        import jax.numpy as jnp
+        keep = jnp.ones((64, 64), jnp.float32) + 0  # a live buffer
+        usage = memory.sample_now()
+        assert usage and all(v >= 0 for v in usage.values())
+        assert memory.high_water() >= keep.nbytes
+        assert _gauge_samples("hbm_bytes_in_use")
+        assert _gauge_samples("hbm_bytes_high_water")
+        rows = memory.top_live_buffers(k=4)
+        assert rows and rows[0]["nbytes"] >= rows[-1]["nbytes"]
+        assert {"shape", "dtype", "nbytes", "device"} <= set(rows[0])
+        del keep
+
+    def test_limit_env_utilization_and_admission(self, monkeypatch):
+        import jax.numpy as jnp
+        keep = jnp.ones((64, 64), jnp.float32) + 0
+        monkeypatch.setenv(memory.HBM_LIMIT_ENV, str(16 << 30))
+        assert memory.hbm_limit_bytes() == 16 << 30
+        memory.sample_now()
+        util = memory.hbm_utilization_max()
+        assert util is not None and 0 <= util <= 1
+        assert _gauge_samples("hbm_bytes_limit")
+        line = memory.summary_line()
+        assert line.startswith("memory: high-water ")
+        assert "/16.00GB" in line
+        # admission: projected on top of resident must respect the cap
+        ok, projected, limit = memory.admission_headroom(1024)
+        assert ok and limit == 16 << 30
+        assert projected >= memory.high_water() + 1024 - 1
+        ok2, projected2, _ = memory.admission_headroom(16 << 30)
+        assert not ok2 and projected2 > 16 << 30
+        del keep
+
+    def test_no_limit_means_advisory(self, monkeypatch):
+        monkeypatch.delenv(memory.HBM_LIMIT_ENV, raising=False)
+        memory.sample_now()
+        # CPU devices report no memory_stats: utilization stays unset
+        assert memory.hbm_utilization_max() is None
+        ok, _projected, limit = memory.admission_headroom(1 << 50)
+        assert ok and limit is None
+
+    def test_disable_is_zero_recording(self):
+        memory.enable(interval=0.05)
+        assert memory.poller_enabled()
+        memory.enable(interval=0.05)        # idempotent
+        deadline = time.monotonic() + 5.0
+        while not _gauge_samples("hbm_bytes_in_use") \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert _gauge_samples("hbm_bytes_in_use")
+        memory.disable()
+        assert not memory.poller_enabled()
+        # disabled == ZERO recording: the in-use/utilization series are
+        # gone (not stale last-values), and nothing rewrites them
+        assert _gauge_samples("hbm_bytes_in_use") == {}
+        assert _gauge_samples("hbm_utilization") == {}
+        time.sleep(0.12)
+        assert _gauge_samples("hbm_bytes_in_use") == {}
+        memory.disable()                    # idempotent
+
+
+# ---------------------------------------------------------------------------
+class TestOOMPostmortem:
+    def test_is_oom_error_recognizers(self):
+        assert memory.is_oom_error(RuntimeError(
+            "RESOURCE_EXHAUSTED: Out of memory allocating 1234 bytes"))
+        assert memory.is_oom_error(MemoryError())
+        assert memory.is_oom_error(
+            memory.OutOfDeviceMemoryError("x"))
+        assert not memory.is_oom_error(RuntimeError("shape mismatch"))
+        assert not memory.is_oom_error(None)
+
+    def test_handle_oom_raises_typed_with_postmortem(self):
+        memory.ledger_set("train/params", 4096)
+        memory.record_segment_memory(
+            "g", 0, {"temp_bytes": 10.0, "argument_bytes": 20.0,
+                     "peak_bytes_estimate": 5000.0})
+        c0 = _counter("oom_errors_total", where="unit.test")
+        t0 = _counter("anomaly_trips_total", kind="oom")
+        src = RuntimeError("RESOURCE_EXHAUSTED: injected")
+        with pytest.raises(memory.OutOfDeviceMemoryError,
+                           match="device out of memory at "
+                                 "unit.test") as ei:
+            memory.handle_oom(src, "unit.test", step=7)
+        e = ei.value
+        assert e.__cause__ is src
+        assert "train/params" in str(e)       # top resident named
+        pm = e.postmortem
+        assert pm["where"] == "unit.test"
+        assert pm["peak_bytes_estimate"] == 5000.0
+        assert dict(pm["ledger"])["train/params"] == 4096.0
+        assert pm["segments"][0]["temp_bytes"] == 10.0
+        assert isinstance(pm["top_live_buffers"], list)
+        assert "hbm_bytes_in_use" in pm
+        assert _counter("oom_errors_total",
+                        where="unit.test") - c0 == 1
+        # the trip escalates through anomaly (health + flight recorder)
+        assert _counter("anomaly_trips_total", kind="oom") - t0 == 1
+
+    def test_executor_dispatch_converts_resource_exhausted(
+            self, monkeypatch):
+        from paddle_tpu.static import executor as _ex
+        exe, main, feed, loss, guard = _tiny_train_setup()
+        try:
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])  # warm
+            c0 = _counter("oom_errors_total",
+                          where="executor.run/dispatch")
+            monkeypatch.setattr(
+                _ex._PreparedRunner, "step",
+                lambda self, *a, **k: (_ for _ in ()).throw(
+                    RuntimeError("RESOURCE_EXHAUSTED: Out of memory "
+                                 "while trying to allocate 987654321 "
+                                 "bytes.")))
+            with pytest.raises(memory.OutOfDeviceMemoryError) as ei:
+                exe.run(main, feed=feed, fetch_list=[loss])
+            pm = ei.value.postmortem
+            assert pm["where"] == "executor.run/dispatch"
+            assert pm["segments"], "postmortem must name the segments"
+            assert pm["peak_bytes_estimate"] > 0
+            assert dict(pm["ledger"]).get("train/params", 0) > 0
+            assert _counter("oom_errors_total",
+                            where="executor.run/dispatch") - c0 == 1
+            # a non-OOM dispatch failure must NOT be retyped
+            monkeypatch.setattr(
+                _ex._PreparedRunner, "step",
+                lambda self, *a, **k: (_ for _ in ()).throw(
+                    RuntimeError("some unrelated dispatch failure")))
+            with pytest.raises(RuntimeError,
+                               match="unrelated dispatch failure"):
+                exe.run(main, feed=feed, fetch_list=[loss])
+        finally:
+            guard.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+def _freeze_scale(dirname, scale, width=16, params=False):
+    """out = scale * x (the answer IS the version — test_swap's
+    fixture idiom). ``params=True`` routes through an fc layer so the
+    model has real parameter bytes (memory-admission fixtures need a
+    standby that actually projects residency); seed before each export
+    to keep the weights — and thus the scale ratio — assertable."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [width], dtype="float32")
+        out = layers.fc(x, size=width) if params else x
+        out = layers.scale(out, scale=float(scale))
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.io.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=main)
+    return dirname
+
+
+def _server(model_dir, **cfg):
+    from paddle_tpu.serving import InferenceServer, ServingConfig
+    cfg.setdefault("max_batch", 4)
+    cfg.setdefault("max_wait_ms", 1.0)
+    return InferenceServer(model_dir, ServingConfig(**cfg))
+
+
+def _ones(rows=1, width=16):
+    return {"x": np.ones((rows, width), np.float32)}
+
+
+class TestSwapMemoryAdmission:
+    def test_swap_refused_over_limit_before_standby(self, tmp_path,
+                                                    capfd):
+        from paddle_tpu.serving import SwapFailedError
+        d1 = _freeze_scale(str(tmp_path / "v1"), 2.0)
+        d2 = _freeze_scale(str(tmp_path / "v2"), 3.0)
+        r0 = _counter("serving_swaps_total", outcome="refused_memory")
+        srv = _server(d1, hbm_limit_bytes=1)
+        try:
+            np.testing.assert_allclose(
+                srv.infer(_ones(), timeout=30)[0], 2.0)
+            with pytest.raises(SwapFailedError,
+                               match="memory admission") as ei:
+                srv.swap(d2)
+            assert ei.value.stage == "admission"
+            msg = str(ei.value)
+            # the refusal carries the projection arithmetic
+            assert "projects" in msg and "standby params" in msg
+            assert "over the HBM limit 1" in msg
+            assert _counter("serving_swaps_total",
+                            outcome="refused_memory") - r0 == 1
+            # refused BEFORE the standby booted: the live pool alone
+            # owns the serving ledger, and the live version serves on
+            led = memory.ledger("serving/")
+            assert led and all(":live/" in k for k in led)
+            np.testing.assert_allclose(
+                srv.infer(_ones(), timeout=30)[0], 2.0)
+            assert "SWAP REFUSED at memory admission" in \
+                capfd.readouterr().err
+        finally:
+            srv.close(timeout=60)
+
+    def test_swap_admitted_under_generous_limit(self, tmp_path):
+        d1 = _freeze_scale(str(tmp_path / "v1"), 2.0)
+        d2 = _freeze_scale(str(tmp_path / "v2"), 3.0)
+        srv = _server(d1, hbm_limit_bytes=1 << 40)
+        try:
+            rep = srv.swap(d2, watchdog_ms=100)
+            assert rep["outcome"] == "ok"
+            assert "admit" in rep["stage_ms"]
+            np.testing.assert_allclose(
+                srv.infer(_ones(), timeout=30)[0], 3.0)
+        finally:
+            srv.close(timeout=60)
+
+    def test_pool_ledger_published_and_dropped(self, tmp_path):
+        d1 = _freeze_scale(str(tmp_path / "v1"), 2.0)
+        srv = _server(d1)
+        try:
+            led = memory.ledger("serving/")
+            # the scale fixture is parameter-less: its params entity
+            # is a legitimate 0 — the bucket executables' compile-time
+            # peaks carry the residency
+            assert any(k.endswith("/params") for k in led)
+            assert any("/bucket" in k and v > 0
+                       for k, v in led.items()), led
+            assert srv.pool.projected_bytes() > 0
+        finally:
+            srv.close(timeout=60)
+        # a closed pool releases its ledger entities — no ghost
+        # residency attributed to freed params
+        assert memory.ledger("serving/") == {}
+
+
+# ---------------------------------------------------------------------------
+class TestStatusLineMem:
+    def _write_rank(self, tmp_path, rank, steps, hwm=None, limit=None):
+        from paddle_tpu.distributed import health
+        from paddle_tpu.monitor import exporter
+        r = Registry()
+        r.counter("executor_steps_total", "steps").inc(steps)
+        h = r.histogram("executor_step_ms", "ms")
+        h.observe(4.0)
+        if hwm is not None:
+            g = r.gauge("hbm_bytes_high_water", "byte peak",
+                        labels=("device",))
+            g.set(hwm, device="tpu:0")
+        if limit is not None:
+            g = r.gauge("hbm_bytes_limit", "byte cap",
+                        labels=("device",))
+            g.set(limit, device="tpu:0")
+        exporter.write_snapshot(
+            health.metrics_path(str(tmp_path), rank), r)
+
+    def test_mem_field_appears_with_high_water(self, tmp_path):
+        from paddle_tpu.monitor import exporter
+        gb = 1024 ** 3
+        self._write_rank(tmp_path, 0, 10, hwm=2 * gb, limit=8 * gb)
+        self._write_rank(tmp_path, 1, 10, hwm=3 * gb, limit=8 * gb)
+        line = exporter.job_status_line(str(tmp_path))
+        # worst rank's high-water over the known limit
+        assert "mem=3.00/8.00GB" in line, line
+
+    def test_mem_field_without_limit(self, tmp_path):
+        from paddle_tpu.monitor import exporter
+        self._write_rank(tmp_path, 0, 5, hwm=int(1.5 * 1024 ** 3))
+        line = exporter.job_status_line(str(tmp_path))
+        assert "mem=1.50GB" in line, line
+
+    def test_mem_field_absent_before_any_sample(self, tmp_path):
+        from paddle_tpu.monitor import exporter
+        self._write_rank(tmp_path, 0, 5)
+        line = exporter.job_status_line(str(tmp_path))
+        assert "mem=" not in line, line
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+class TestMemoryEndToEnd:
+    TOTAL = 8
+
+    def test_injected_oom_leaves_typed_postmortem(self, tmp_path):
+        """The acceptance run: 2 ranks, rank 0's dispatch raises
+        RESOURCE_EXHAUSTED at step 3 — the executor must surface a
+        typed OutOfDeviceMemoryError whose postmortem names the
+        compiled segment, the compile-time estimate and the top live
+        buffers; the anomaly trip leaves a flight-recorder dump and
+        the rank's final /metrics snapshot carries oom_errors_total."""
+        from paddle_tpu.distributed.launch import launch_collective
+        from paddle_tpu.monitor import exporter
+        prefix = tmp_path / "oom.out"
+        log_dir = tmp_path / "logs"
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+            "PT_OOM_AT_STEP": "3",
+            "PT_FAULT_RANK": "0",
+        }
+        rc = launch_collective(
+            [OOM_WORKER, str(prefix), str(self.TOTAL), "0.05"],
+            nproc=2, log_dir=str(log_dir), env_extra=env,
+            timeout=240, max_restarts=0, grace_period=5.0)
+        logs = "\n".join(
+            f"--- {p.name} ---\n" + p.read_text()[-2000:]
+            for p in sorted(log_dir.glob("*.log")))
+        assert rc == 0, logs
+
+        rep0 = json.loads(
+            (tmp_path / "oom.out.rank0.json").read_text())
+        oom = rep0["oom"]
+        assert oom, logs
+        assert oom["type"] == "OutOfDeviceMemoryError"
+        assert "compile-time peak estimate" in oom["message"]
+        pm = oom["postmortem"]
+        assert pm["where"] == "executor.run/dispatch"
+        assert pm["segments"], pm          # names the segment(s)
+        assert float(pm["peak_bytes_estimate"]) > 0
+        assert pm["top_live_buffers"], pm  # what was resident
+        assert dict(pm["ledger"]).get("train/params", 0) > 0
+        # the uninjected rank trained to completion
+        rep1 = json.loads(
+            (tmp_path / "oom.out.rank1.json").read_text())
+        assert rep1["steps"] == self.TOTAL and rep1["oom"] is None
+
+        # anomaly-oom flight-recorder dump from rank 0
+        dumps = sorted((log_dir / "postmortem").glob("rank0.*.json"))
+        assert dumps, logs
+        docs = [json.loads(p.read_text()) for p in dumps]
+        doc = next(d for d in docs if d["reason"] == "anomaly-oom")
+        assert doc["anomaly"]["kind"] == "oom"
+        assert doc["anomaly"]["where"] == "executor.run/dispatch"
+
+        # the final snapshot carries the counter
+        snap = (log_dir / "heartbeat" / "rank0.prom").read_text()
+        _types, samples = exporter.parse_text(snap)
+        assert samples[("oom_errors_total",
+                        (("where", "executor.run/dispatch"),))] == 1.0
+
+    def test_oversized_swap_refused_under_real_limit(
+            self, tmp_path, monkeypatch, capfd):
+        """Hot-swapping a model whose standby cannot co-reside with
+        the live pool under the (env-fallback) HBM limit must be
+        refused pre-cutover with the projected numbers — and the same
+        swap must succeed once the limit allows co-residency."""
+        from paddle_tpu.core import random as ptrandom
+        from paddle_tpu.serving import SwapFailedError
+
+        def seeded_freeze(d, scale):
+            np.random.seed(0)
+            ptrandom.seed(0)        # identical fc weights per export
+            return _freeze_scale(d, scale, width=64, params=True)
+
+        d1 = seeded_freeze(str(tmp_path / "v1"), 2.0)
+        d2 = seeded_freeze(str(tmp_path / "v2"), 3.0)
+        srv = _server(d1)
+        try:
+            before = srv.infer(_ones(width=64), timeout=30)[0]
+            live = int(srv.pool.projected_bytes())
+            assert live > 0
+            # room for the live pool but NOT live + standby params
+            monkeypatch.setenv(memory.HBM_LIMIT_ENV, str(live + 1))
+            with pytest.raises(SwapFailedError,
+                               match="cannot co-reside") as ei:
+                srv.swap(d2)
+            assert ei.value.stage == "admission"
+            assert f"over the HBM limit {live + 1}" in str(ei.value)
+            np.testing.assert_allclose(
+                srv.infer(_ones(width=64), timeout=30)[0], before)
+            # generous limit: the identical swap is admitted — and the
+            # new version serves (same weights, 3.0/2.0 scale ratio)
+            monkeypatch.setenv(memory.HBM_LIMIT_ENV, str(1 << 40))
+            rep = srv.swap(d2, watchdog_ms=100)
+            assert rep["outcome"] == "ok"
+            np.testing.assert_allclose(
+                srv.infer(_ones(width=64), timeout=30)[0],
+                before * 1.5, rtol=1e-5)
+        finally:
+            srv.close(timeout=60)

@@ -250,7 +250,8 @@ class TestSwapPipeline:
             assert rep["model_version"] == v2
             assert rep["previous_version"] == v1
             assert set(rep["stage_ms"]) == {
-                "gate", "standby", "canary", "cutover", "watchdog"}
+                "gate", "admit", "standby", "canary", "cutover",
+                "watchdog"}
             np.testing.assert_allclose(
                 srv.infer(_ones(), timeout=30)[0], 3.0)
             assert srv.model_version == v2

@@ -126,6 +126,49 @@ def outcome_vocabularies(repo=REPO):
     return out
 
 
+#: unit-suffix discipline: a name's trailing unit promises what the
+#: number means, so the registration's help text must spell the SAME
+#: unit — a *_bytes gauge whose help says "ms" (or says nothing) makes
+#: operators guess the scale of every dashboard they build on it
+_UNIT_WORDS = {
+    "bytes": ("byte",),
+    "ms": ("ms", "millisecond"),
+}
+
+
+def _unit_suffix(name):
+    base = name[:-len("_total")] if name.endswith("_total") else name
+    tail = base.rsplit("_", 1)[-1]
+    return tail if tail in _UNIT_WORDS else None
+
+
+def unit_suffix_violations(repo=REPO):
+    """[(name, suffix, path)] for every *_bytes/*_ms registration
+    whose source window (the call's arguments — i.e. its help text)
+    never mentions the unit the suffix promises."""
+    out = set()
+    for path in _code_files(repo):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        regs = list(_REG_RE.finditer(src))
+        for k, m in enumerate(regs):
+            name = m.group(2)
+            suffix = _unit_suffix(name)
+            if suffix is None:
+                continue
+            end = regs[k + 1].start() if k + 1 < len(regs) else len(src)
+            # window starts AFTER the name literal: the name itself
+            # always contains its own suffix, which would green-wash
+            # every registration
+            window = src[m.end():end].lower()
+            if not any(w in window for w in _UNIT_WORDS[suffix]):
+                out.add((name, suffix, os.path.relpath(path, repo)))
+    return sorted(out)
+
+
 def doc_metrics(path=DOCS):
     """{name: documented type} from the catalogue table rows."""
     with open(path) as f:
@@ -165,6 +208,7 @@ def main():
         for name, vocab in outcome_vocabularies().items()
         for v in sorted(vocab)
         if f"`{v}`" not in rows.get(name, ""))
+    bad_units = unit_suffix_violations()
     if undocumented:
         print(f"metrics registered in code but missing from "
               f"docs/OBSERVABILITY.md catalogue: {undocumented}")
@@ -186,8 +230,14 @@ def main():
               f"outcome=\"{v}\" but its docs/OBSERVABILITY.md "
               f"catalogue row does not document `{v}` — the row must "
               f"carry the full label vocabulary")
+    for name, suffix, path in bad_units:
+        print(f"metric {name!r} ({path}) promises unit "
+              f"'{suffix}' in its name but its registration help "
+              f"text never mentions "
+              f"{' or '.join(_UNIT_WORDS[suffix])!s} — unit-suffix "
+              f"discipline: the help must spell the unit")
     if undocumented or stale or conflicted or mismatched \
-            or bad_exemplars or missing_vocab:
+            or bad_exemplars or missing_vocab or bad_units:
         return 1
     print(f"metrics catalogue in sync ({len(code)} metrics, "
           f"kinds verified)")
