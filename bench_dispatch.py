@@ -363,6 +363,36 @@ def main():
         "poll_interval_s": 0.05, "steps_per_window": twin,
     }))
 
+    # goodput-ledger A/B (monitor/goodput.py): same ABBA protocol with
+    # the ledger armed vs disarmed. Armed, every Executor.run pays
+    # on_run_start/on_run_end (two perf_counter stamps + one
+    # thread-local counter bump); disarmed it's a single module-global
+    # check. The smoke test asserts < 1.05x — the always-on
+    # attribution claim.
+    from paddle_tpu.monitor import goodput as _goodput
+    gp_pairs = int(os.environ.get("BENCH_DISPATCH_GOODPUT_PAIRS", "8"))
+
+    def g_win(armed):
+        if armed:
+            _goodput.enable()
+        else:
+            _goodput.disable()
+        _td, tt = mode._window(twin)
+        return tt / twin * 1e3
+
+    g_win(True), g_win(False)           # warm both paths
+    est_g, pair_ratios_g, on_g, off_g = _abba_overhead(g_win,
+                                                       gp_pairs)
+    _goodput.disable()
+    print(json.dumps({
+        "metric": "goodput_overhead_ratio", "path": "dispatch",
+        "value": round(est_g, 4), "unit": "x",
+        "armed_ms_per_step": round(_median(on_g), 4),
+        "disarmed_ms_per_step": round(_median(off_g), 4),
+        "pair_ratios": [round(r, 4) for r in pair_ratios_g],
+        "steps_per_window": twin,
+    }))
+
 
 if __name__ == "__main__":
     main()

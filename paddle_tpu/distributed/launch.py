@@ -74,6 +74,7 @@ from paddle_tpu.distributed import health
 from paddle_tpu.monitor import anomaly as _anomaly
 from paddle_tpu.monitor import exporter as _exporter
 from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor import goodput as _goodput
 from paddle_tpu.monitor import trace as _trace
 from paddle_tpu.monitor.registry import REGISTRY as _REGISTRY
 from paddle_tpu.monitor.registry import counter as _counter
@@ -188,6 +189,72 @@ def _trace_env(log_dir):
     return {_trace.ENV_DIR: d}
 
 
+def _goodput_env(log_dir):
+    """Arm workers' goodput ledgers: PADDLE_GOODPUT_DIR under the log
+    dir (see monitor/goodput.py — the dir also holds the launcher's
+    incarnations.jsonl, the replay-watermark source). No log_dir means
+    nowhere durable."""
+    if not log_dir:
+        return {}
+    d = os.path.join(os.path.abspath(log_dir), "goodput")
+    os.makedirs(d, exist_ok=True)
+    return {_goodput.ENV_DIR: d}
+
+
+def _record_incarnation(gp_dir, hb_dir, attempt, world, t_start,
+                        status, rc, departed):
+    """Append one gang-incarnation record to
+    <gp_dir>/incarnations.jsonl: identity (attempt, world), lifetime,
+    how it ended (status + labeled exit code), the replay watermark
+    (max goodput_step across rank snapshots — the NEXT incarnation
+    reads it to price replayed lost work), and each rank's per-phase
+    ledger at death (tools/goodput_report.py's per-incarnation
+    waterfall input). Never raises — evidence collection must not mask
+    the job's exit path."""
+    if not gp_dir:
+        return
+    try:
+        snaps = _exporter.read_rank_snapshots(hb_dir)
+
+        def _gv(samples, name):
+            for (n, _pairs), v in samples.items():
+                if n == name:
+                    return float(v)
+            return None
+
+        last = [v for v in (_gv(s, "goodput_step")
+                            for _t, s in snaps.values())
+                if v is not None]
+        restored = [v for v in (_gv(s, "goodput_restored_step")
+                                for _t, s in snaps.values())
+                    if v is not None]
+        rec = {
+            "incarnation": int(attempt),
+            "world": int(world),
+            "start": float(t_start),
+            "end": time.time(),
+            "status": status,
+            "rc": int(rc),
+            "rc_label": EXIT_CODE_LABELS.get(
+                128 - rc if rc < 0 else rc),
+            "departed": sorted(departed or []),
+            "last_step": int(max(last)) if last else None,
+            # MIN across ranks: the most-behind rank's restore point
+            # prices the replayed lost work (a rank that restored
+            # further ahead replays less, not more)
+            "restored_step": int(min(restored)) if restored else None,
+            "ranks": {
+                str(r): {
+                    "wall_seconds": _gv(s, "goodput_wall_seconds"),
+                    "phases": _goodput.phase_seconds_of(s),
+                } for r, (_t, s) in snaps.items()},
+        }
+        _goodput.record_incarnation(gp_dir, rec)
+    except Exception as e:
+        _log(f"goodput record failed (ignored): "
+             f"{type(e).__name__}: {e}")
+
+
 def _merge_job_trace(log_dir):
     """Clock-align and merge every rank's trace file into ONE
     Perfetto/Chrome JSON at <log_dir>/trace.json — the launcher-side
@@ -239,7 +306,8 @@ def _status_tick(hb_dir, log_dir, restarts, flagged_stragglers=None):
         # who is a straggler within a single tick
         health, stragglers = _anomaly.job_health(snaps)
         line = _exporter.job_status_line(hb_dir, restarts=restarts,
-                                         snaps=snaps, health=health)
+                                         snaps=snaps, health=health,
+                                         registry=_REGISTRY)
         if line:
             _log("status " + line)
         if flagged_stragglers is not None:
@@ -604,6 +672,8 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     cache_env = _cache_dir_env(log_dir, env_extra)
     pm_env = _postmortem_env(log_dir)
     tr_env = _trace_env(log_dir)
+    gp_env = _goodput_env(log_dir)
+    gp_dir = gp_env.get(_goodput.ENV_DIR)
     join_dir = elastic_join_dir(log_dir) if elastic else None
     if join_dir:
         os.makedirs(join_dir, exist_ok=True)
@@ -625,7 +695,7 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
         try:
             for rank in range(world):
                 env = dict(os.environ, **(env_extra or {}), **cache_env,
-                           **pm_env, **tr_env)
+                           **pm_env, **tr_env, **gp_env)
                 env.update({
                     "PADDLE_TRAINER_ID": str(rank),
                     "PADDLE_TRAINERS_NUM": str(world),
@@ -635,6 +705,8 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                     "TRAINING_ROLE": "TRAINER",
                     "PADDLE_HEARTBEAT_DIR": hb_dir,
                     "PADDLE_RESTART_COUNT": str(attempt),
+                    # goodput: startup = spawn stamp to ledger arming
+                    _goodput.ENV_SPAWN: repr(time.time()),
                 })
                 p, f = _spawn([sys.executable, "-u"] + script_args, env,
                               f"workerlog.{rank}", log_dir,
@@ -659,6 +731,8 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
     try:
         attempt = 0
         world = nproc
+        gang_end = None
+        _goodput.enable()
         while True:
             health.reset(hb_dir, world)
             # a previous larger incarnation's rank files would pollute
@@ -669,12 +743,23 @@ def launch_collective(script_args, nproc, started_port=None, ips="127.0.0.1",
                 _log(f"swept stale rank file(s) of departed ranks: "
                      f"{swept}")
             _m_world.set(world)
+            if gang_end is not None:
+                # goodput: previous gang's death to this spawn, priced
+                # at the NEW world size so launcher seconds and
+                # rank-seconds share one denominator
+                _goodput.attribute(
+                    (time.time() - gang_end) * world,
+                    phase="restart_downtime")
+            gang_t0 = time.time()
             procs, ranks, logs = spawn_gang(attempt, world)
             status, rc, departed = _wait_gang(
                 procs, ranks, logs, deadline, hang_timeout, hb_dir,
                 term, grace_period, log_dir=log_dir, restarts=attempt,
                 flagged_stragglers=flagged_stragglers)
             _status_tick(hb_dir, log_dir, attempt, flagged_stragglers)
+            _record_incarnation(gp_dir, hb_dir, attempt, world,
+                                gang_t0, status, rc, departed)
+            gang_end = time.time()
             if status in ("ok", "timeout", "preempted"):
                 return rc
             # the killed gang's flight-recorder dumps are the evidence

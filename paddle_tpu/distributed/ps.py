@@ -62,6 +62,7 @@ import numpy as np
 from paddle_tpu.core.enforce import enforce
 from paddle_tpu.core.flags import define_flag, get_flag
 from paddle_tpu.distributed import wire
+from paddle_tpu.monitor import goodput as _goodput
 from paddle_tpu.monitor.registry import counter as _counter
 from paddle_tpu.monitor.registry import gauge as _gauge
 from paddle_tpu.monitor.registry import histogram as _histogram
@@ -2418,6 +2419,10 @@ class PSClient:
                     attempts += 1
                     if attempts > self.MAX_RETRIES:
                         raise
+                if _goodput._armed:
+                    # reconnect backoff is time spent waiting on the
+                    # fleet, not computing (goodput ledger)
+                    _goodput.attribute(delay, phase="collective_wait")
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
         if conn_failures:
@@ -2672,6 +2677,16 @@ class PSClient:
         def go():
             for ep in self._all_eps():
                 self._call(ep, wire.BARRIER, tag, self.trainer_id)
+        if _goodput._armed:
+            # barrier wall time = waiting for the slowest peer
+            # (goodput ledger's collective_wait / straggler phase)
+            _t_gp = time.perf_counter()
+            try:
+                self._routed(go)
+            finally:
+                _goodput.attribute(time.perf_counter() - _t_gp,
+                                   phase="collective_wait")
+            return
         self._routed(go)
 
     def checkpoint_notify(self, dirname):

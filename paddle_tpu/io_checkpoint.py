@@ -91,6 +91,7 @@ import jax
 import numpy as np
 
 from paddle_tpu.core.enforce import enforce
+from paddle_tpu.monitor import goodput as _goodput
 from paddle_tpu.monitor.registry import counter as _counter
 from paddle_tpu.monitor.registry import histogram as _histogram
 from paddle_tpu.static.serialize import tree_from_manifest, tree_manifest
@@ -775,6 +776,7 @@ class CheckpointManager:
         The annotation, plus each array's shape/dtype, is recorded in
         the manifest (``array_info``) — it is what lets ``restore()``
         re-shard the step onto a different world size."""
+        _t_gp = time.perf_counter() if _goodput._armed else None
         manifest, arrays = tree_manifest(tree)
         arrays = {k: np.asarray(v) for k, v in arrays.items()}  # d2h copy
         ax = _axes_map(manifest, axes)
@@ -796,6 +798,11 @@ class CheckpointManager:
         else:
             self._raise_pending()
             self._q.put(payload)
+        if _t_gp is not None:
+            # goodput ledger: the step loop was blocked for the d2h
+            # snapshot + enqueue (or the full durable write when sync)
+            _goodput.attribute(time.perf_counter() - _t_gp,
+                               phase="checkpoint_save")
 
     def maybe_save(self, step, tree, data_state=None, axes=None):
         if self.should_save(step):
@@ -905,7 +912,12 @@ class CheckpointManager:
         if self._thread is not None and self._thread.is_alive():
             done = threading.Event()
             self._q.put(done)
-            enforce(done.wait(timeout), "checkpoint writer stalled")
+            _t_gp = time.perf_counter() if _goodput._armed else None
+            ok = done.wait(timeout)
+            if _t_gp is not None:
+                _goodput.attribute(time.perf_counter() - _t_gp,
+                                   phase="checkpoint_save")
+            enforce(ok, "checkpoint writer stalled")
         self._raise_pending()
 
     def _prune(self):
@@ -1277,6 +1289,18 @@ class CheckpointManager:
         ``CheckpointCorruptError`` naming the file and first bad
         array. ``verify=False`` skips CRC checks (default: the
         manager's ``verify_restore``)."""
+        if _goodput._armed:
+            # goodput ledger: restore stall (verification walk-back and
+            # the multi-host coordination wait included)
+            _t_gp = time.perf_counter()
+            try:
+                return self._restore_inner(step, verify)
+            finally:
+                _goodput.attribute(time.perf_counter() - _t_gp,
+                                   phase="checkpoint_restore")
+        return self._restore_inner(step, verify)
+
+    def _restore_inner(self, step=None, verify=None):
         if verify is None:
             verify = self.verify_restore
         if step is not None:
@@ -1827,6 +1851,7 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
     flight_recorder.install_from_env()
     from paddle_tpu.monitor import trace as _trace_mod
     _trace_mod.install_from_env()
+    _goodput.install_from_env()
     exp = RankExporter.from_env()
     if exp is not None:
         exp.start()
@@ -1863,10 +1888,12 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
                 ds = mgr.restore_data_state(start)
                 if ds is not None:
                     data_state.set_state(ds)
+            _goodput.on_restore(start)
             start += 1
         else:
             state, start = init_state_fn(), 0
         for step in range(start, total_steps):
+            _goodput.on_step(step)
             state = step_fn(step, state)
             if hb is not None:
                 hb.beat()
@@ -1896,6 +1923,7 @@ def auto_checkpoint(dirname, init_state_fn, total_steps, step_fn,
         if restore_handler is not None:
             restore_handler()
         mgr.close()             # drain the async writer FIRST, so the
-        if exp is not None:     # exporter's final snapshot sees every
-            exp.stop()          # checkpoint counter increment
+        _goodput.flush_idle()   # ledger tail closed before the final
+        if exp is not None:     # snapshot, so per-rank phase seconds
+            exp.stop()          # sum to the wall gauge
 

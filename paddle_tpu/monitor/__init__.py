@@ -36,6 +36,13 @@ docs/DEBUGGING.md):
   explosion, step stall, non-finite) that dumps the flight recorder
   with the anomaly named, and the launcher-side straggler/health
   readout over the per-rank snapshots.
+- ``monitor.goodput`` — the goodput ledger: every wall-clock second of
+  a supervised job attributed to a phase (device compute, compile,
+  input wait, checkpoint stall, replayed lost work, restart downtime…)
+  per rank and per incarnation; the launcher rolls the per-rank
+  counters into a job-level ``goodput_fraction`` and
+  ``tools/goodput_report.py`` renders the per-incarnation waterfall
+  (docs/DEBUGGING.md "Where did my wall-clock go?").
 - ``monitor.memory`` — device-memory observability: compile-time
   per-segment memory ledger from ``compiled.memory_analysis()``, the
   named-entity residency ledger, the sampled HBM poller
@@ -56,6 +63,7 @@ from paddle_tpu.monitor import anomaly
 from paddle_tpu.monitor import cost
 from paddle_tpu.monitor import exporter
 from paddle_tpu.monitor import flight_recorder
+from paddle_tpu.monitor import goodput
 from paddle_tpu.monitor import memory
 from paddle_tpu.monitor import numerics
 from paddle_tpu.monitor import registry
@@ -79,7 +87,7 @@ from paddle_tpu.monitor.trace import (
 
 __all__ = [
     "registry", "exporter", "flight_recorder", "cost", "numerics",
-    "tensorwatch", "anomaly", "trace", "memory",
+    "tensorwatch", "anomaly", "trace", "memory", "goodput",
     "Tracer", "TraceContext", "TRACER", "merge_rank_traces",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram",

@@ -81,6 +81,18 @@ _h_pass_ms = histogram(
     "program_pass_ms",
     "Wall ms per optimization-pass application (program-level pass "
     "pipeline ahead of segment compilation)")
+_g_pass_flops_delta = gauge(
+    "program_pass_flops_delta",
+    "Predicted analytical-FLOPs change of the last application of each "
+    "optimization pass (post minus pre lowering cost_analysis, "
+    "negative = cheaper; FLAGS_pass_cost_evidence probe)",
+    labels=("pass",))
+_g_pass_bytes_delta = gauge(
+    "program_pass_bytes_delta",
+    "Predicted bytes-accessed change of the last application of each "
+    "optimization pass (post minus pre lowering cost_analysis, "
+    "negative = cheaper; FLAGS_pass_cost_evidence probe)",
+    labels=("pass",))
 
 _pass_totals = {}               # pass name -> {"runs", "ops_removed"}
 
@@ -234,26 +246,41 @@ def comm_bytes_per_step():
     return _total("comm_bytes")
 
 
-def record_pass(name, ops_removed=0, ms=0.0):
+def record_pass(name, ops_removed=0, ms=0.0, flops_delta=None,
+                bytes_delta=None):
     """Publish one optimization-pass application (opt_passes drivers
     call this): bumps the program_pass_* metrics and folds into the
     in-process evidence table ``pass_evidence`` reports (the
-    ``bench.py passes`` per-pass JSON)."""
+    ``bench.py passes`` per-pass JSON). ``flops_delta``/``bytes_delta``
+    (FLAGS_pass_cost_evidence) are the pass's predicted analytical cost
+    change — signed, so they publish as gauges and accumulate in the
+    evidence table."""
     name = str(name)
     _c_pass_runs.inc(**{"pass": name})
     if ops_removed:
         _c_pass_removed.inc(float(ops_removed), **{"pass": name})
     _h_pass_ms.observe(float(ms))
+    if flops_delta is not None:
+        _g_pass_flops_delta.set(float(flops_delta), **{"pass": name})
+    if bytes_delta is not None:
+        _g_pass_bytes_delta.set(float(bytes_delta), **{"pass": name})
     with _lock:
         t = _pass_totals.setdefault(name,
                                     {"runs": 0, "ops_removed": 0})
         t["runs"] += 1
         t["ops_removed"] += int(ops_removed)
+        if flops_delta is not None:
+            t["flops_delta"] = t.get("flops_delta", 0.0) \
+                + float(flops_delta)
+        if bytes_delta is not None:
+            t["bytes_delta"] = t.get("bytes_delta", 0.0) \
+                + float(bytes_delta)
 
 
 def pass_evidence():
-    """{pass name: {"runs", "ops_removed"}} accumulated since process
-    start (or the last ``reset``)."""
+    """{pass name: {"runs", "ops_removed"[, "flops_delta",
+    "bytes_delta"]}} accumulated since process start (or the last
+    ``reset``)."""
     with _lock:
         return {k: dict(v) for k, v in _pass_totals.items()}
 

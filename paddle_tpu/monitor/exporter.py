@@ -275,13 +275,16 @@ def _max_matching(samples, name):
                default=0.0)
 
 
-def job_status_line(hb_dir, restarts=0, snaps=None, health=None):
+def job_status_line(hb_dir, restarts=0, snaps=None, health=None,
+                    registry=None):
     """The launcher's periodic one-liner:
-    ``step=… ms/step=… mem=…/…GB mfu=… health=… ranks=… restarts=…``
-    computed from the rank snapshots in ``hb_dir``; None when no rank
-    has exported yet. ``mem`` (worst device's high-water mark over
-    the known limit, monitor/memory.py) appears only once some rank's
-    memory poller has sampled.
+    ``step=… ms/step=… mem=…/…GB mfu=… goodput=…% health=… ranks=…
+    restarts=…`` computed from the rank snapshots in ``hb_dir``; None
+    when no rank has exported yet. ``mem`` (worst device's high-water
+    mark over the known limit, monitor/memory.py) appears only once
+    some rank's memory poller has sampled; ``goodput`` (device-compute
+    share of all ledger-attributed seconds, monitor/goodput.py) only
+    once some party's ledger is armed.
 
     ``step`` is the max across ranks (they advance together in data
     parallel); ms/step pools every rank's histogram; mfu uses the
@@ -292,7 +295,15 @@ def job_status_line(hb_dir, restarts=0, snaps=None, health=None):
     Pass pre-read ``snaps`` and a pre-computed ``health`` string to
     reuse one directory scan / one job_health judgment (the launcher's
     status tick does, so its log line and straggler bookkeeping judge
-    the SAME snapshot state with the SAME skew threshold)."""
+    the SAME snapshot state with the SAME skew threshold). Every field
+    of one line derives from that single read — mem/health/goodput in
+    one tick can never disagree about which snapshots they judged.
+    ``registry`` (the launcher passes its own) joins the aggregation
+    so launcher-side ledger phases (``restart_downtime``) count in the
+    goodput denominator; the computed fraction is published back to it
+    as the ``goodput_fraction`` gauge, which the subsequent
+    ``write_job_snapshot(registry=...)`` then carries into
+    <log_dir>/metrics.prom."""
     if snaps is None:
         snaps = read_rank_snapshots(hb_dir)
     if not snaps:
@@ -303,20 +314,22 @@ def job_status_line(hb_dir, restarts=0, snaps=None, health=None):
         step = max(step, int(_sum_matching(samples,
                                            "executor_steps_total")))
         flops = max(flops, _sum_matching(samples, "segment_flops"))
-    _, merged = aggregate(list(snaps.values()))
+    parsed = list(snaps.values())
+    if registry is not None:
+        parsed.append(parse_text(render_text(registry)))
+    _, merged = aggregate(parsed)
     ms_sum = _sum_matching(merged, "executor_step_ms_sum")
     ms_count = _sum_matching(merged, "executor_step_ms_count")
     ms = ms_sum / ms_count if ms_count else 0.0
     parts = [f"step={step}", f"ms/step={ms:.1f}"]
-    # worst device's high-water mark across ranks (gauges merge as
-    # max, but read the pre-merge snapshots so a single stale rank
-    # can't pin the number): mem=<high-water>/<limit>GB, limit part
-    # only when some rank knows one (monitor/memory.py poller)
-    hwm = max((_max_matching(s, "hbm_bytes_high_water")
-               for _, (_, s) in snaps.items()), default=0.0)
+    # worst device's high-water mark across ranks, off the SAME merged
+    # view as every other field (gauges max-merge, and the launcher
+    # sweeps departed ranks' files, so no stale rank pins the number):
+    # mem=<high-water>/<limit>GB, limit part only when some rank knows
+    # one (monitor/memory.py poller)
+    hwm = _max_matching(merged, "hbm_bytes_high_water")
     if hwm > 0:
-        limit = max((_max_matching(s, "hbm_bytes_limit")
-                     for _, (_, s) in snaps.items()), default=0.0)
+        limit = _max_matching(merged, "hbm_bytes_limit")
         gb = 1024.0 ** 3
         mem = f"mem={hwm / gb:.2f}"
         if limit > 0:
@@ -326,6 +339,12 @@ def job_status_line(hb_dir, restarts=0, snaps=None, health=None):
         from paddle_tpu.monitor.cost import peak_flops
         mfu = flops / (ms / 1e3) / peak_flops()
         parts.append(f"mfu={mfu:.4f}")
+    from paddle_tpu.monitor import goodput as _goodput
+    frac = _goodput.fraction_of(merged)
+    if frac is not None:
+        parts.append(f"goodput={frac * 100.0:.0f}%")
+        if registry is not None:
+            _goodput._g_fraction.set(frac)
     if health is None:
         from paddle_tpu.monitor import anomaly as _anomaly
         health, _stragglers = _anomaly.job_health(snaps)

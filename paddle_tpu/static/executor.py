@@ -54,6 +54,7 @@ from paddle_tpu.core.enforce import EnforceNotMet, enforce
 from paddle_tpu.core.flags import define_flag, get_flag
 from paddle_tpu.monitor import anomaly as _anomaly
 from paddle_tpu.monitor import flight_recorder as _flight
+from paddle_tpu.monitor import goodput as _goodput
 from paddle_tpu.monitor import tensorwatch as _tensorwatch
 from paddle_tpu.monitor import trace as _trace
 from paddle_tpu.monitor.numerics import SENTINEL_KEY as _SENTINEL_KEY
@@ -80,6 +81,13 @@ define_flag("apply_ir_passes", True,
             "elimination) before compiling each step; "
             "BuildStrategy.apply_ir_passes overrides per program "
             "(0 = bit-identical legacy lowering)")
+define_flag("pass_cost_evidence", False,
+            "Probe XLA's analytical FLOPs/bytes before the pass "
+            "pipeline and after every pass, publishing per-pass "
+            "predicted deltas (program_pass_flops_delta/_bytes_delta "
+            "gauges + the pass_evidence table). One extra lowering per "
+            "pass per compile signature — evidence tooling, off by "
+            "default")
 
 # unified telemetry (monitor/registry.py): the hot-loop counters every
 # layer above reads — catalogued in docs/OBSERVABILITY.md
@@ -286,7 +294,17 @@ def background_prefetch(producer, transform, depth=2):
     t.start()
     try:
         while True:
-            item = q.get()
+            if _goodput._armed:
+                # consumer blocked on an empty queue = the input
+                # pipeline couldn't keep up — the goodput ledger's
+                # input_wait phase (docs/DEBUGGING.md "Where did my
+                # wall-clock go?")
+                _t_get = time.perf_counter()
+                item = q.get()
+                _goodput.attribute(time.perf_counter() - _t_get,
+                                   phase="input_wait")
+            else:
+                item = q.get()
             _m_q_depth.set(q.qsize())
             if item is SENTINEL:
                 break
@@ -586,6 +604,40 @@ class _CompiledStep:
             self._cost_done = True
         return compiled, total
 
+    def lower_cost(self, state, feeds, base_key, step_idx):
+        """Sum XLA's analytical FLOPs/bytes over the device segments by
+        lowering them abstractly (no ``.compile()``, no metric
+        recording) — the probe behind FLAGS_pass_cost_evidence. Host
+        segments stop the walk like ``aot_compile``; returns
+        ``{"flops", "bytes"}`` or None when nothing lowered."""
+        env = {k: _spec_of(v) for k, v in self.constants.items()}
+        env.update({k: _spec_of(v) for k, v in state.items()})
+        env.update({k: _spec_of(v) for k, v in feeds.items()})
+        from paddle_tpu.monitor import cost as _cost
+        flops = bytes_ = 0.0
+        lowered_any = False
+        for (is_host, a, b), fn_w, donate in zip(
+                self.segs, self.seg_fns, self._donate_names):
+            if is_host:
+                break
+            fn, _checked_fn, _writes = fn_w
+            donated, rest = self._split(env, donate)
+            try:
+                lowered = fn.lower(donated, rest, base_key, step_idx)
+                est = _cost.analyze_lowered(lowered)
+            except Exception:
+                est = None
+            if est:
+                flops += float(est.get("flops") or 0.0)
+                bytes_ += float(est.get("bytes") or 0.0)
+                lowered_any = True
+            out = jax.eval_shape(fn, donated, rest, base_key, step_idx)
+            env = {k: _spec_of(v) for k, v in self.constants.items()}
+            env.update(out)
+        if not lowered_any:
+            return None
+        return {"flops": flops, "bytes": bytes_}
+
 
 class _PreparedRunner:
     """Everything `Executor.run` needs per (program, feed-signature)
@@ -773,6 +825,11 @@ class Executor:
                 self._fetch_value(scope, n, return_numpy) for n in fetch_names]
 
         t_run = time.perf_counter()
+        if _goodput._armed:
+            # goodput ledger boundary: the gap since the last run's
+            # end (minus stalls the seams attributed) was device_idle
+            _goodput.on_run_start(t_run)
+        tc0 = self._trace_count
         # per-step trace (tail-sampled; monitor/trace.py): opened as
         # this thread's CURRENT trace so an anomaly/non-finite
         # postmortem fired mid-step embeds the phases recorded so far
@@ -807,9 +864,10 @@ class Executor:
                 if spec is not None:
                     feeds = spec.shard_feeds(feeds)
                     state = self._ensure_resident(state, runner, fast)
+            t_prep = time.perf_counter()
             if tctx is not None:
                 _trace.record_span(tctx, "executor/prepare", t_run,
-                                   time.perf_counter())
+                                   t_prep)
                 # adopt the prefetch worker's staging interval for the
                 # batch this step consumes: the span ran on the worker
                 # thread (its tid says so) but belongs to THIS step's
@@ -853,12 +911,13 @@ class Executor:
                         _memory.handle_oom(e, "executor.run/dispatch",
                                            step=int(step_idx))
                     raise
+            t_disp_end = time.perf_counter()
             if tctx is not None:
                 # recorded BEFORE the sentinel verification so a
                 # non-finite trip's postmortem already names the dispatch
                 # phase and its duration
                 _trace.record_span(tctx, "executor/dispatch", t_disp,
-                                   time.perf_counter())
+                                   t_disp_end)
             if check:
                 # the one deliberate host sync of the checked mode: a
                 # scalar per segment, verified BEFORE the new state reaches
@@ -896,6 +955,11 @@ class Executor:
             _m_steps.inc()
             step_ms = (time.perf_counter() - t_run) * 1e3
             _m_step_ms.observe(step_ms)
+            if _goodput._armed:
+                # close the ledger's in-run window: compile vs compute
+                # (vs replay) split for this step
+                _goodput.on_run_end(t_run, t_prep, t_disp, t_disp_end,
+                                    self._trace_count > tc0)
             if watch_v is not None and _tensorwatch._enabled:
                 _tensorwatch.on_step(watch_v, int(step_idx),
                                      sync=return_numpy)
@@ -1145,9 +1209,31 @@ class Executor:
                bool(apply_passes))
         step = self._cache.get(sig)
         if step is None:
+            cost_probe = None
+            if apply_passes and bool(get_flag("pass_cost_evidence")):
+                # FLAGS_pass_cost_evidence: lower each intermediate
+                # program of the pass pipeline abstractly and hand XLA's
+                # analytical FLOPs/bytes back to opt_passes, which
+                # publishes the per-pass predicted delta
+                # (program_pass_flops_delta / program_pass_bytes_delta
+                # and the pass_evidence table). Needs the live shapes,
+                # hence built here rather than in _compile.
+                p_state = {n: v for n, v in state.items()
+                           if v is not None}
+                p_key = self._base_key(program.random_seed)
+
+                def cost_probe(prog, _s=p_state, _f=dict(feeds),
+                               _fn=tuple(fetch_names), _spec=spec,
+                               _k=p_key):
+                    probe_step = self._compile(
+                        prog, sorted(_s), sorted(_f), list(_fn), _spec,
+                        apply_passes=False)
+                    return probe_step.lower_cost(_s, _f, _k,
+                                                 np.uint32(0))
             step = self._compile(program, sorted(state_names),
                                  sorted(feeds), fetch_names, spec,
-                                 apply_passes=apply_passes)
+                                 apply_passes=apply_passes,
+                                 cost_probe=cost_probe)
             self._cache[sig] = step
         return _PreparedRunner(step, state_names, host_outs, scope, rep,
                                ndev, watch_idx=watch_idx, spec=spec,
@@ -1291,7 +1377,7 @@ class Executor:
         return exec_op(op, env, key)
 
     def _compile(self, program, state_names, feed_names, fetch_names,
-                 spec=None, apply_passes=False):
+                 spec=None, apply_passes=False, cost_probe=None):
         """Partition the block into maximal device runs, each jitted as
         ONE XLA computation (the whole block, in the common case), with
         host segments (attrs['_host']: RPC send/recv, py_func-style
@@ -1313,7 +1399,8 @@ class Executor:
             # ops carry _rng_idx stamps, so optimization never shifts
             # a dropout mask.
             from paddle_tpu.static import opt_passes as _opt
-            program = _opt.optimize_for_execution(program, fetch_names)
+            program = _opt.optimize_for_execution(program, fetch_names,
+                                                  cost_probe=cost_probe)
         blk = program.global_block()
         ops = list(blk.ops)
         constants = dict(getattr(program, "_constants", {}))

@@ -706,11 +706,21 @@ def _stamp_rng_indices(program):
             h += 1
 
 
-def optimize_program(program, targets=(), pipeline=None, record=True):
+def optimize_program(program, targets=(), pipeline=None, record=True,
+                     cost_probe=None):
     """Clone ``program``, run the pass pipeline against ``targets``
     (the step's fetch names), publish per-pass evidence through
     ``monitor/cost.py``, and return ``(optimized_program, report)``.
-    The input program is never mutated."""
+    The input program is never mutated.
+
+    ``cost_probe`` (optional, FLAGS_pass_cost_evidence): callable
+    ``prog -> {"flops", "bytes"} | None`` probing XLA's analytical cost
+    of the program as lowered. When given, it runs before the pipeline
+    and after every pass; each pass's predicted delta (negative =
+    cheaper) lands in its ``report.per_pass`` row and the
+    ``program_pass_flops_delta`` / ``program_pass_bytes_delta``
+    evidence gauges. Probe failures disable probing, never the
+    pipeline."""
     from paddle_tpu.monitor import cost as _cost
 
     prog = program.clone()
@@ -718,6 +728,18 @@ def optimize_program(program, targets=(), pipeline=None, record=True):
     pm = pipeline or default_pipeline(targets)
     report = PipelineReport()
     report.ops_before = len(prog.global_block().ops)
+
+    def _probe(p):
+        nonlocal cost_probe
+        if cost_probe is None:
+            return None
+        try:
+            return cost_probe(p)
+        except Exception:
+            cost_probe = None
+            return None
+
+    cost0 = _probe(prog)
     for p in pm.passes:
         n0 = len(prog.global_block().ops)
         t0 = time.perf_counter()
@@ -726,12 +748,21 @@ def optimize_program(program, targets=(), pipeline=None, record=True):
         prog = out if out is not None else prog
         n1 = len(prog.global_block().ops)
         pm.applied.append(p.name)
-        report.per_pass.append({"pass": p.name, "ops_before": n0,
-                                "ops_after": n1,
-                                "ops_removed": n0 - n1,
-                                "ms": round(ms, 3)})
+        row = {"pass": p.name, "ops_before": n0, "ops_after": n1,
+               "ops_removed": n0 - n1, "ms": round(ms, 3)}
+        flops_d = bytes_d = None
+        if cost0 is not None:
+            cost1 = _probe(prog)
+            if cost1 is not None:
+                flops_d = cost1["flops"] - cost0["flops"]
+                bytes_d = cost1["bytes"] - cost0["bytes"]
+                row["flops_delta"] = flops_d
+                row["bytes_delta"] = bytes_d
+                cost0 = cost1
+        report.per_pass.append(row)
         if record:
-            _cost.record_pass(p.name, ops_removed=n0 - n1, ms=ms)
+            _cost.record_pass(p.name, ops_removed=n0 - n1, ms=ms,
+                              flops_delta=flops_d, bytes_delta=bytes_d)
     # keep only constants a surviving op (or fetch target) still
     # reads: folding a const chain materializes every intermediate as
     # a device array, and the optimized clone lives in the executor's
@@ -748,10 +779,11 @@ def optimize_program(program, targets=(), pipeline=None, record=True):
     return prog, report
 
 
-def optimize_for_execution(program, fetch_names):
+def optimize_for_execution(program, fetch_names, cost_probe=None):
     """The Executor's entry: optimize against the step's actual fetch
     list (persistable state writes are DCE roots by construction)."""
-    prog, _ = optimize_program(program, targets=tuple(fetch_names))
+    prog, _ = optimize_program(program, targets=tuple(fetch_names),
+                               cost_probe=cost_probe)
     return prog
 
 

@@ -1,0 +1,68 @@
+"""Training worker for the goodput-ledger end-to-end test.
+
+Same supervised shape as elastic_worker.py (``auto_checkpoint`` under
+``paddle_tpu.distributed.launch``, ``faults`` injecting the crash the
+test selected), but each step runs a real Executor program — so the
+ledger's in-run split has actual compile/device_compute seconds to
+attribute, not just ``device_idle``. The deterministic toy state
+(w moves halfway to 10 per step) rides along so resume correctness is
+still observable.
+
+argv: out_prefix ckpt_root total_steps [step_secs] [save_interval]
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    out_prefix, ckpt_root = sys.argv[1], sys.argv[2]
+    total_steps = int(sys.argv[3])
+    step_secs = float(sys.argv[4]) if len(sys.argv) > 4 else 0.05
+    save_interval = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+    rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+    ckpt_dir = os.path.join(ckpt_root, f"rank{rank}")
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.io_checkpoint import auto_checkpoint
+    from paddle_tpu.testing import faults
+
+    pt.enable_static()
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup):
+        x = pt.static.data("x", [4], dtype="float32")
+        y = pt.static.data("y", [1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = pt.static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype(np.float32)
+    yv = xv.sum(1, keepdims=True).astype(np.float32)
+
+    def init_state():
+        return {"w": 0.0}
+
+    def step_fn(step, state):
+        faults.maybe_fault(step, ckpt_dir=ckpt_dir)
+        exe.run(main_p, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        time.sleep(step_secs)
+        return {"w": state["w"] + 0.5 * (10.0 - state["w"])}
+
+    final = auto_checkpoint(ckpt_dir, init_state, total_steps, step_fn,
+                            save_interval_steps=save_interval)
+    with open(f"{out_prefix}.rank{rank}.json", "w") as f:
+        json.dump({
+            "w": float(final["w"]),
+            "restart_count": int(os.environ.get("PADDLE_RESTART_COUNT",
+                                                "0")),
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,7 @@ class TestBenchModes:
                                      "BENCH_SERVING_TRACE_PAIRS": "2",
                                      "BENCH_SERVING_TRACE_WIN": "60",
                                      "BENCH_SERVING_MEM_PAIRS": "2",
+                                     "BENCH_SERVING_GOODPUT_PAIRS": "2",
                                      "BENCH_METRICS_OUT": metrics_out})
         by = {ln["metric"]: ln for ln in lines}
         for tag in ("serving_baseline_qps", "serving_server_qps"):
@@ -105,6 +106,14 @@ class TestBenchModes:
         assert mem["value"] < 1.05, mem
         assert mem["polled_p50_ms"] > 0 and mem["unpolled_p50_ms"] > 0
         assert len(mem["pair_ratios"]) >= 2
+        # goodput-ledger overhead: armed vs disarmed on the same ABBA
+        # protocol — wall-clock attribution must stay inside the same
+        # 1.05x hot-path bound
+        gp = by["goodput_overhead_ratio"]
+        assert gp["path"] == "serving" and gp["unit"] == "x"
+        assert gp["value"] < 1.05, gp
+        assert gp["armed_p50_ms"] > 0 and gp["disarmed_p50_ms"] > 0
+        assert len(gp["pair_ratios"]) >= 2
         with open(metrics_out) as f:
             snap = f.read()
         for name in ("serving_requests_total", "serving_queue_depth",
@@ -179,6 +188,8 @@ class TestBenchModes:
                                      "BENCH_DISPATCH_TRACE_PAIRS": "6",
                                      "BENCH_DISPATCH_TRACE_WIN": "8",
                                      "BENCH_DISPATCH_MEM_PAIRS": "2",
+                                     "BENCH_DISPATCH_GOODPUT_PAIRS":
+                                     "2",
                                      "XLA_FLAGS":
                                      "--xla_force_host_platform_"
                                      "device_count=8"},
@@ -204,6 +215,14 @@ class TestBenchModes:
         assert mem["value"] < 1.05, mem
         assert mem["polled_ms_per_step"] > 0
         assert mem["unpolled_ms_per_step"] > 0
+        # goodput-ledger overhead on the dispatch hot path — armed vs
+        # disarmed ABBA windows, same 1.05x bound
+        gp = by["goodput_overhead_ratio"]
+        assert gp["path"] == "dispatch" and gp["unit"] == "x"
+        assert gp["value"] < 1.05, gp
+        assert gp["armed_ms_per_step"] > 0
+        assert gp["disarmed_ms_per_step"] > 0
+        assert len(gp["pair_ratios"]) >= 2
 
     def test_numerics_mode_emits_overhead_ratio(self):
         """`bench.py numerics` must A/B the check_nan_inf sentinels on
@@ -319,6 +338,13 @@ class TestBenchModes:
             assert row["ops_before"] > row["ops_after"]
             per_pass = {p["pass"]: p for p in row["per_pass"]}
             assert "fuse_matmul_bias_act" in per_pass, row
+            # satellite evidence: the live compile runs under
+            # FLAGS_pass_cost_evidence, so per-pass predicted
+            # FLOPs/bytes deltas ride the row
+            deltas = row["pass_cost_deltas"]
+            assert deltas, row
+            for d in deltas.values():
+                assert set(d) == {"flops_delta", "bytes_delta"}
         trunk = by["passes_step_ratio_bert_trunk"]
         assert trunk["ops_removed"] > 0, trunk
         head = by["passes_step_ratio"]
@@ -328,7 +354,9 @@ class TestBenchModes:
             snap = f.read()
         for name in ("program_pass_runs_total",
                      "program_pass_ops_removed_total",
-                     "program_pass_ms"):
+                     "program_pass_ms",
+                     "program_pass_flops_delta",
+                     "program_pass_bytes_delta"):
             assert name in snap, f"{name} missing from snapshot"
 
     def test_serving_quant_mode_emits_ab_rows(self):

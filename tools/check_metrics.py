@@ -45,6 +45,15 @@ _OUTCOME_LABEL_RE = re.compile(
     r"labels\s*=\s*[\(\[][^)\]]*[\"']outcome[\"']")
 _OUTCOME_VALUE_RE = re.compile(
     r"outcome\s*=\s*[\"']([A-Za-z0-9_]+)[\"']")
+# the goodput ledger's ``phase`` label: unlike outcome counters,
+# attribution sites are deliberately spread across the tree (executor,
+# checkpoint, ps, launcher), so its vocabulary is every
+# ``phase="..."`` keyword literal in ANY scanned file; the lookbehind
+# keeps unrelated keywords (``print_phase=``) out
+_PHASE_LABEL_RE = re.compile(
+    r"labels\s*=\s*[\(\[][^)\]]*[\"']phase[\"']")
+_PHASE_VALUE_RE = re.compile(
+    r"(?<![A-Za-z0-9_])phase\s*=\s*[\"']([A-Za-z0-9_]+)[\"']")
 
 
 def exemplar_metrics(repo=REPO):
@@ -126,6 +135,32 @@ def outcome_vocabularies(repo=REPO):
     return out
 
 
+def phase_vocabularies(repo=REPO):
+    """{metric name: set of ``phase`` label values} for every metric
+    registered with a ``phase`` label (the goodput ledger). The
+    vocabulary is the union of ``phase="..."`` keyword literals across
+    ALL scanned files — attribution sites live at the instrumented
+    seams throughout the tree, not in the registering module — and
+    every value must appear backticked in the metric's catalogue row,
+    so an operator reading docs/OBSERVABILITY.md sees the ledger's
+    full phase set."""
+    values = set()
+    metrics = set()
+    for path in _code_files(repo):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        values.update(_PHASE_VALUE_RE.findall(src))
+        regs = list(_REG_RE.finditer(src))
+        for k, m in enumerate(regs):
+            end = regs[k + 1].start() if k + 1 < len(regs) else len(src)
+            if _PHASE_LABEL_RE.search(src[m.start():end]):
+                metrics.add(m.group(2))
+    return {name: set(values) for name in metrics}
+
+
 #: unit-suffix discipline: a name's trailing unit promises what the
 #: number means, so the registration's help text must spell the SAME
 #: unit — a *_bytes gauge whose help says "ms" (or says nothing) makes
@@ -133,6 +168,7 @@ def outcome_vocabularies(repo=REPO):
 _UNIT_WORDS = {
     "bytes": ("byte",),
     "ms": ("ms", "millisecond"),
+    "seconds": ("second",),
 }
 
 
@@ -208,6 +244,11 @@ def main():
         for name, vocab in outcome_vocabularies().items()
         for v in sorted(vocab)
         if f"`{v}`" not in rows.get(name, ""))
+    missing_phase = sorted(
+        (name, v)
+        for name, vocab in phase_vocabularies().items()
+        for v in sorted(vocab)
+        if f"`{v}`" not in rows.get(name, ""))
     bad_units = unit_suffix_violations()
     if undocumented:
         print(f"metrics registered in code but missing from "
@@ -230,6 +271,12 @@ def main():
               f"outcome=\"{v}\" but its docs/OBSERVABILITY.md "
               f"catalogue row does not document `{v}` — the row must "
               f"carry the full label vocabulary")
+    for name, v in missing_phase:
+        print(f"phase-labeled metric {name!r} is attributed "
+              f"phase=\"{v}\" somewhere in the tree but its "
+              f"docs/OBSERVABILITY.md catalogue row does not document "
+              f"`{v}` — the row must enumerate the ledger's full "
+              f"phase vocabulary")
     for name, suffix, path in bad_units:
         print(f"metric {name!r} ({path}) promises unit "
               f"'{suffix}' in its name but its registration help "
@@ -237,7 +284,8 @@ def main():
               f"{' or '.join(_UNIT_WORDS[suffix])!s} — unit-suffix "
               f"discipline: the help must spell the unit")
     if undocumented or stale or conflicted or mismatched \
-            or bad_exemplars or missing_vocab or bad_units:
+            or bad_exemplars or missing_vocab or missing_phase \
+            or bad_units:
         return 1
     print(f"metrics catalogue in sync ({len(code)} metrics, "
           f"kinds verified)")

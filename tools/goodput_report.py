@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""Offline goodput waterfall: where did the job's wall-clock go?
+
+Merges everything a supervised run (``paddle_tpu.distributed.launch``
+with ``--log_dir``) leaves behind —
+
+- ``<log_dir>/goodput/incarnations.jsonl``: one record per gang
+  incarnation (attempt, world size, lifetime, labeled exit code, the
+  replay watermark, and each rank's per-phase ledger at gang end);
+- ``<log_dir>/heartbeat/rank*.prom``: the final per-rank metric
+  snapshots (the live view for a job still running / a record-less
+  single incarnation);
+- ``<log_dir>/traces/*`` (when present): named so the reader knows
+  deeper per-step evidence exists (tools/trace_summary.py, the merged
+  <log_dir>/trace.json).
+
+— into one per-incarnation waterfall naming the top time sinks with
+where-in-the-tree evidence: which restart, which phase, how many
+replayed steps (docs/DEBUGGING.md "Where did my wall-clock go?").
+
+Usage:
+    python tools/goodput_report.py LOG_DIR [--json]
+
+Exit code 0; a log dir with no goodput evidence at all exits 2.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from paddle_tpu.monitor import exporter as _exporter       # noqa: E402
+from paddle_tpu.monitor import goodput as _goodput         # noqa: E402
+
+#: phase -> (what it is, where the seconds were attributed) — the
+#: "file:line-style" evidence column of the waterfall
+PHASE_EVIDENCE = {
+    "device_compute": (
+        "compiled-step dispatch + fetch (goodput)",
+        "paddle_tpu/static/executor.py:Executor.run on_run_end split"),
+    "compile": (
+        "XLA trace/compile (first step, retrace, cache replay)",
+        "paddle_tpu/static/executor.py:Executor.run prepare+dispatch "
+        "of runs where trace_count moved"),
+    "replay": (
+        "re-executing steps a crash already paid for",
+        "paddle_tpu/io_checkpoint.py:auto_checkpoint steps <= the "
+        "crashed incarnation's last_step (incarnations.jsonl)"),
+    "input_wait": (
+        "input pipeline couldn't keep up",
+        "paddle_tpu/static/executor.py:background_prefetch consumer "
+        "q.get()"),
+    "device_idle": (
+        "between-step host time no instrumented stall claims",
+        "paddle_tpu/monitor/goodput.py:on_run_start residual"),
+    "checkpoint_save": (
+        "synchronous part of checkpoint save (d2h + enqueue/write)",
+        "paddle_tpu/io_checkpoint.py:CheckpointManager.save / wait"),
+    "checkpoint_restore": (
+        "checkpoint restore incl. verification walk-back",
+        "paddle_tpu/io_checkpoint.py:CheckpointManager.restore"),
+    "collective_wait": (
+        "blocked on the fleet (barrier / reconnect backoff)",
+        "paddle_tpu/distributed/ps.py:PSClient.barrier and reconnect"),
+    "startup": (
+        "process spawn to ledger arming (imports, jax init, build)",
+        "paddle_tpu/monitor/goodput.py:install_from_env vs "
+        "PADDLE_SPAWN_WALLTIME"),
+    "restart_downtime": (
+        "gang death to next spawn, x new world size",
+        "paddle_tpu/distributed/launch.py:launch_collective restart "
+        "backoff"),
+}
+
+
+def _fmt_s(v):
+    return f"{v:8.2f}s"
+
+
+def _live_rank_view(log_dir):
+    """{rank: {"wall_seconds", "phases"}} from the final heartbeat
+    snapshots — the fallback when no incarnation record covers them."""
+    hb = os.path.join(log_dir, "heartbeat")
+    out = {}
+    for rank, (_t, samples) in \
+            _exporter.read_rank_snapshots(hb).items():
+        phases = _goodput.phase_seconds_of(samples)
+        if not phases:
+            continue
+        wall = None
+        for (n, _p), v in samples.items():
+            if n == "goodput_wall_seconds":
+                wall = float(v)
+        out[str(rank)] = {"wall_seconds": wall, "phases": phases}
+    return out
+
+
+def build_report(log_dir):
+    """Returns ``(text, data)``: the rendered waterfall and its
+    machine-readable twin. Raises SystemExit(2) when the log dir holds
+    no goodput evidence (no incarnation records AND no rank snapshot
+    with ledger phases)."""
+    log_dir = os.path.abspath(log_dir)
+    recs = _goodput.read_incarnations(os.path.join(log_dir, "goodput"))
+    live = _live_rank_view(log_dir)
+    if not recs and not live:
+        print(f"no goodput evidence under {log_dir}: neither "
+              f"goodput/incarnations.jsonl nor rank snapshots with "
+              f"goodput_seconds_total — was the job launched with "
+              f"--log_dir under paddle_tpu.distributed.launch?",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not recs and live:
+        # record-less live view: synthesize one open incarnation
+        recs = [{"incarnation": 0, "world": len(live), "status": "live",
+                 "rc": None, "rc_label": None, "last_step": None,
+                 "restored_step": None, "ranks": live}]
+
+    incarnations = []
+    job_phases = {}
+    prev_last = None
+    for rec in recs:
+        ranks = rec.get("ranks") or {}
+        inc_phases = {}
+        rank_rows = []
+        for r in sorted(ranks, key=lambda x: int(x) if
+                        str(x).isdigit() else 0):
+            info = ranks[r] or {}
+            phases = info.get("phases") or {}
+            wall = info.get("wall_seconds")
+            total = sum(phases.values())
+            for k, v in phases.items():
+                inc_phases[k] = inc_phases.get(k, 0.0) + float(v)
+            rank_rows.append({"rank": str(r), "wall_seconds": wall,
+                              "attributed_seconds": total,
+                              "phases": phases})
+        # replayed lost work: the previous incarnation died at
+        # last_step; this one restored at restored_step and re-ran
+        # (restored_step, prev_last] before making new progress
+        restored = rec.get("restored_step")
+        replayed = None
+        if prev_last is not None and restored is not None:
+            replayed = max(0, int(prev_last) - int(restored))
+        lifetime = None
+        if rec.get("start") is not None and rec.get("end") is not None:
+            lifetime = float(rec["end"]) - float(rec["start"])
+        sinks = sorted(inc_phases.items(), key=lambda kv: -kv[1])
+        incarnations.append({
+            "incarnation": rec.get("incarnation"),
+            "world": rec.get("world"),
+            "status": rec.get("status"),
+            "rc": rec.get("rc"),
+            "rc_label": rec.get("rc_label"),
+            "lifetime_seconds": lifetime,
+            "last_step": rec.get("last_step"),
+            "restored_step": restored,
+            "replayed_steps": replayed,
+            "phases": inc_phases,
+            "top_sinks": [s for s, _ in sinks[:3]],
+            "ranks": rank_rows,
+        })
+        for k, v in inc_phases.items():
+            job_phases[k] = job_phases.get(k, 0.0) + float(v)
+        if rec.get("last_step") is not None:
+            prev_last = rec["last_step"]
+
+    total = sum(job_phases.values())
+    goodput = (job_phases.get("device_compute", 0.0) / total) \
+        if total > 0 else None
+    data = {
+        "log_dir": log_dir,
+        "incarnations": incarnations,
+        "job_phases": job_phases,
+        "attributed_seconds_total": total,
+        "goodput_fraction": goodput,
+    }
+
+    lines = [f"goodput report: {log_dir}",
+             f"incarnations: {len(incarnations)}"]
+    if goodput is not None:
+        lines.append(f"job goodput: {goodput * 100.0:.1f}% "
+                     f"(device_compute "
+                     f"{job_phases.get('device_compute', 0.0):.2f}s "
+                     f"of {total:.2f}s attributed)")
+    for i, inc in enumerate(incarnations):
+        lines.append("")
+        head = (f"incarnation {inc['incarnation']} "
+                f"(world={inc['world']}, status={inc['status']}")
+        if inc["rc"] is not None:
+            head += f", rc={inc['rc']}"
+            if inc["rc_label"]:
+                head += f" [{inc['rc_label']}]"
+        head += ")"
+        lines.append(head)
+        if inc["lifetime_seconds"] is not None:
+            lines.append(f"  lifetime: {inc['lifetime_seconds']:.2f}s"
+                         + (f", reached step {inc['last_step']}"
+                            if inc["last_step"] is not None else ""))
+        if inc["replayed_steps"] is not None:
+            lines.append(
+                f"  replayed lost work: {inc['replayed_steps']} "
+                f"step(s) (restored at step {inc['restored_step']}, "
+                f"previous incarnation died at step "
+                f"{incarnations[i - 1]['last_step']})")
+        inc_total = sum(inc["phases"].values())
+        for phase, secs in sorted(inc["phases"].items(),
+                                  key=lambda kv: -kv[1]):
+            share = (secs / inc_total * 100.0) if inc_total else 0.0
+            what, where = PHASE_EVIDENCE.get(
+                phase, ("(undocumented phase)", "?"))
+            lines.append(f"  {_fmt_s(secs)} {share:5.1f}%  "
+                         f"{phase:<18} {what}")
+            lines.append(f"              {'':5}   {'':<18} "
+                         f"-> {where}")
+        for row in inc["ranks"]:
+            wall = row["wall_seconds"]
+            att = row["attributed_seconds"]
+            cov = f"{att / wall * 100.0:5.1f}%" if wall else "    ?"
+            lines.append(f"  rank {row['rank']}: attributed "
+                         f"{att:.2f}s of wall "
+                         f"{wall:.2f}s ({cov} covered)"
+                         if wall is not None else
+                         f"  rank {row['rank']}: attributed "
+                         f"{att:.2f}s (no wall gauge)")
+    traces = os.path.join(log_dir, "traces")
+    if os.path.isdir(traces) and os.listdir(traces):
+        lines.append("")
+        lines.append(
+            f"per-step evidence: rank traces in {traces} (merge: "
+            f"{os.path.join(log_dir, 'trace.json')}; summarize: "
+            f"tools/trace_summary.py)")
+    return "\n".join(lines), data
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-incarnation goodput waterfall from a "
+                    "launcher log dir")
+    ap.add_argument("log_dir", help="--log_dir of the supervised run")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report instead")
+    args = ap.parse_args(argv)
+    text, data = build_report(args.log_dir)
+    if args.json:
+        print(json.dumps(data, indent=2, sort_keys=True))
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
