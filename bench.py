@@ -906,6 +906,129 @@ def _bench_serving_quant(max_batch, max_wait_ms):
     }))
 
 
+def _bench_serving_http(d, feed, max_batch, max_wait_ms, replicas):
+    """The front-door half of `bench.py serving`
+    (BENCH_SERVING_HTTP=1, docs/SERVING.md "Front door"): ONE
+    deterministic open-loop Poisson schedule, run through the wire
+    (persistent ``WireClient`` connections against a live
+    ``HttpFrontDoor``) and in-process (``srv.submit``), interleaved in
+    ABBA quadruples via the shared ``_abba_overhead`` protocol so both
+    sides see the same slice of host drift. Emits
+    ``serving_http_vs_inproc_p99_ratio`` — the wire path's tail cost
+    over the library path (JSON + socket + handler thread per
+    request; no bound asserted, the number IS the evidence). Offered
+    load is half the measured closed-loop capacity, so both windows
+    measure overhead rather than saturation queueing. Knobs:
+    BENCH_SERVING_HTTP_REQS (default 80), _PAIRS (default 2), _CONNS
+    (default 8 client connections)."""
+    import queue as _queue
+    import threading
+
+    from paddle_tpu.serving import (
+        FrontDoorConfig, HttpFrontDoor, InferenceServer,
+        ServingConfig, WireClient,
+    )
+
+    n = int(os.environ.get("BENCH_SERVING_HTTP_REQS", "80"))
+    pairs = int(os.environ.get("BENCH_SERVING_HTTP_PAIRS", "2"))
+    conns = int(os.environ.get("BENCH_SERVING_HTTP_CONNS", "8"))
+
+    srv = InferenceServer(d, ServingConfig(
+        max_batch=max_batch, max_wait_ms=max_wait_ms,
+        max_queue=4 * n + conns, replicas=replicas))
+    door = HttpFrontDoor(srv, FrontDoorConfig()).start()
+    try:
+        np.asarray(srv.infer({"x": feed}, timeout=120)[0])
+        with WireClient("127.0.0.1", door.port) as warm:
+            st, _, _ = warm.infer({"x": feed})
+            assert st == 200, f"warm wire request failed: {st}"
+
+        t0 = time.perf_counter()
+        for _ in range(20):
+            srv.infer({"x": feed}, timeout=60)
+        cap = 20 / (time.perf_counter() - t0)
+        offered = 0.5 * cap
+        sched = np.cumsum(np.random.RandomState(42).exponential(
+            1.0 / offered, size=n))
+
+        def open_loop(submit):
+            t_origin = time.perf_counter()
+            for i in range(n):
+                dly = t_origin + sched[i] - time.perf_counter()
+                if dly > 0:
+                    time.sleep(dly)
+                submit(i, t_origin + sched[i])
+            return t_origin
+
+        def window_inproc():
+            pend, arrived = [None] * n, [0.0] * n
+            open_loop(lambda i, ta: (
+                arrived.__setitem__(i, ta),
+                pend.__setitem__(i, srv.submit({"x": feed}))))
+            for p in pend:
+                p.result(timeout=600)
+            lat = [(p.t_done - ta) * 1e3
+                   for p, ta in zip(pend, arrived)]
+            return float(np.percentile(lat, 99))
+
+        def window_wire():
+            work = _queue.Queue()
+            lat = [None] * n
+            errs = []
+
+            def client_worker():
+                c = WireClient("127.0.0.1", door.port)
+                try:
+                    while True:
+                        item = work.get()
+                        if item is None:
+                            return
+                        i, ta = item
+                        status, _h, _p = c.infer({"x": feed})
+                        if status != 200:
+                            errs.append((i, status))
+                        lat[i] = (time.perf_counter() - ta) * 1e3
+                except Exception as e:          # pragma: no cover
+                    errs.append(e)
+                finally:
+                    c.close()
+
+            threads = [threading.Thread(target=client_worker,
+                                        daemon=True)
+                       for _ in range(conns)]
+            for t in threads:
+                t.start()
+            open_loop(lambda i, ta: work.put((i, ta)))
+            for _ in threads:
+                work.put(None)
+            for t in threads:
+                t.join(600)
+            # every request accounted: a silent drop would flatter
+            # the wire tail exactly where it hurts
+            assert not errs and all(v is not None for v in lat), \
+                f"wire window failures: {errs[:3]}"
+            return float(np.percentile(lat, 99))
+
+        def window(wire):
+            return window_wire() if wire else window_inproc()
+
+        window(True), window(False)             # settle both paths
+        est, pair_ratios, wire_p99, inproc_p99 = _abba_overhead(
+            window, pairs, bound=float("inf"), rounds=0)
+        print(json.dumps({
+            "metric": "serving_http_vs_inproc_p99_ratio",
+            "value": round(est, 3), "unit": "x",
+            "http_p99_ms": round(float(np.median(wire_p99)), 2),
+            "inproc_p99_ms": round(float(np.median(inproc_p99)), 2),
+            "pair_ratios": [round(r, 3) for r in pair_ratios],
+            "n_per_window": n, "client_conns": conns,
+            "offered_qps": round(offered, 1),
+        }))
+    finally:
+        door.stop()
+        srv.close(timeout=60)
+
+
 def bench_serving():
     """`python bench.py serving` — OPEN-LOOP serving load (the honest
     way to measure tail latency: arrivals follow a deterministic-seed
@@ -953,7 +1076,13 @@ def bench_serving():
     ``serving_swap_p99_ratio`` (p99 of requests whose lifetime
     overlaps the swap window vs steady-state) and
     ``serving_swap_blip_ms`` (the longest completion silence
-    overlapping the cutover — the stall an operator would see)."""
+    overlapping the cutover — the stall an operator would see).
+
+    ``BENCH_SERVING_HTTP=1`` runs the FRONT-DOOR bench instead
+    (docs/SERVING.md "Front door"): the same open-loop schedule
+    through the wire (``HttpFrontDoor`` + persistent ``WireClient``
+    connections) vs in-process ``submit``, ABBA-interleaved, emitting
+    ``serving_http_vs_inproc_p99_ratio`` (``_bench_serving_http``)."""
     import queue as _queue
     import tempfile
     import threading
@@ -988,6 +1117,9 @@ def bench_serving():
         return _bench_serving_chaos(d, feed, max_batch, max_wait_ms)
     if os.environ.get("BENCH_SERVING_SWAP") == "1":
         return _bench_serving_swap(d, feed, max_batch, max_wait_ms)
+    if os.environ.get("BENCH_SERVING_HTTP") == "1":
+        return _bench_serving_http(d, feed, max_batch, max_wait_ms,
+                                   replicas)
 
     base = create_predictor(Config(d))
     np.asarray(base.run({"x": feed})[0])       # compile once, shared

@@ -64,6 +64,7 @@ from paddle_tpu.monitor import cost
 from paddle_tpu.monitor import exporter
 from paddle_tpu.monitor import flight_recorder
 from paddle_tpu.monitor import goodput
+from paddle_tpu.monitor import httpd
 from paddle_tpu.monitor import memory
 from paddle_tpu.monitor import numerics
 from paddle_tpu.monitor import registry
@@ -74,6 +75,7 @@ from paddle_tpu.monitor.exporter import (
     MetricsServer, RankExporter, render_text, write_snapshot,
 )
 from paddle_tpu.monitor.flight_recorder import RECORDER, FlightRecorder
+from paddle_tpu.monitor.httpd import ThreadedHTTPServerBase
 from paddle_tpu.monitor.memory import OutOfDeviceMemoryError
 from paddle_tpu.monitor.numerics import NonFiniteError
 from paddle_tpu.monitor.registry import (
@@ -87,7 +89,8 @@ from paddle_tpu.monitor.trace import (
 
 __all__ = [
     "registry", "exporter", "flight_recorder", "cost", "numerics",
-    "tensorwatch", "anomaly", "trace", "memory", "goodput",
+    "tensorwatch", "anomaly", "trace", "memory", "goodput", "httpd",
+    "ThreadedHTTPServerBase",
     "Tracer", "TraceContext", "TRACER", "merge_rank_traces",
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram",

@@ -24,6 +24,7 @@ import os
 import re
 import threading
 
+from paddle_tpu.monitor.httpd import ThreadedHTTPServerBase
 from paddle_tpu.monitor.registry import REGISTRY, counter
 
 __all__ = [
@@ -424,20 +425,24 @@ class RankExporter:
 
 
 # -- optional /metrics endpoint ---------------------------------------------
-class MetricsServer:
-    """``GET /metrics`` over stdlib http.server on a daemon thread.
-    ``port=0`` picks a free port (read ``self.port`` after
-    ``start()``). Loopback-only by default: metrics can leak shapes and
-    step counts, so exposing beyond the host is an explicit choice."""
+class MetricsServer(ThreadedHTTPServerBase):
+    """``GET /metrics`` over the shared threaded-HTTP base
+    (``monitor/httpd.py``) on a daemon thread. ``port=0`` picks a free
+    port (read ``self.port`` after ``start()``). Loopback-only by
+    default: metrics can leak shapes and step counts, so exposing
+    beyond the host is an explicit choice. ``socket_timeout_s`` bounds
+    every socket read/write per connection, so a scraper that connects
+    and then stalls can no longer pin a handler thread forever."""
 
-    def __init__(self, port=0, host="127.0.0.1", registry=None):
-        self.host = host
-        self.port = port
+    thread_name = "pt-metrics-server"
+
+    def __init__(self, port=0, host="127.0.0.1", registry=None,
+                 socket_timeout_s=10.0):
+        super().__init__(port=port, host=host,
+                         socket_timeout_s=socket_timeout_s)
         self.registry = registry or REGISTRY
-        self._httpd = None
-        self._thread = None
 
-    def start(self):
+    def _handler_class(self):
         import http.server
 
         registry = self.registry
@@ -457,27 +462,4 @@ class MetricsServer:
             def log_message(self, *a):    # quiet: no per-scrape stderr
                 pass
 
-        self._httpd = http.server.ThreadingHTTPServer(
-            (self.host, self.port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True,
-            name="pt-metrics-server")
-        self._thread.start()
-        return self
-
-    def stop(self):
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, *exc):
-        self.stop()
-        return False
+        return Handler

@@ -17,28 +17,36 @@ The single-request ``paddle_tpu.inference.Predictor`` remains the
 simple embedded path; this package is the "millions of users" one —
 and it fails TYPED: request deadlines, replica quarantine/respawn,
 adaptive load shedding, and supervised reversible deploys are
-documented in docs/SERVING.md.
+documented in docs/SERVING.md. ``frontdoor`` extends the same typed
+discipline to the network boundary: HTTP/1.1 over ``submit`` with
+wire-to-device deadline propagation, per-tenant admission, connection
+robustness and graceful drain (docs/SERVING.md "Front door").
 """
 
 from paddle_tpu.serving.resilience import (  # noqa: F401
     DeadlineExceededError, OverloadedError, ReplicaLostError,
-    ShedController, SwapFailedError, SwapWatchdog,
+    ShedController, SwapFailedError, SwapWatchdog, TenantFairShare,
 )
 from paddle_tpu.serving.scheduler import (  # noqa: F401
     MicroBatch, MicroBatchScheduler, PendingResult, QueueFullError,
-    ServerClosedError, bucket_ladder, pick_bucket,
+    ServerClosedError, ServerDrainingError, bucket_ladder, pick_bucket,
 )
 from paddle_tpu.serving.replica import Replica, ReplicaPool  # noqa: F401
 from paddle_tpu.serving.server import (  # noqa: F401
     InferenceServer, ServingConfig,
 )
 from paddle_tpu.serving.swap import SwapController  # noqa: F401
+from paddle_tpu.serving.frontdoor import (  # noqa: F401
+    FrontDoorConfig, HttpFrontDoor, WireClient, WireReset,
+)
 
 __all__ = [
     "InferenceServer", "ServingConfig", "MicroBatchScheduler",
     "MicroBatch", "PendingResult", "Replica", "ReplicaPool",
-    "QueueFullError", "ServerClosedError", "DeadlineExceededError",
-    "OverloadedError", "ReplicaLostError", "ShedController",
+    "QueueFullError", "ServerClosedError", "ServerDrainingError",
+    "DeadlineExceededError", "OverloadedError", "ReplicaLostError",
+    "ShedController", "TenantFairShare",
     "SwapController", "SwapFailedError", "SwapWatchdog",
+    "FrontDoorConfig", "HttpFrontDoor", "WireClient", "WireReset",
     "bucket_ladder", "pick_bucket",
 ]

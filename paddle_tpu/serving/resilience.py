@@ -27,6 +27,13 @@ the serving package:
   (the *bounded-queue* refusal) because the remedies differ: a full
   queue wants retry-after-backoff, a brownout wants the client to slow
   down or route elsewhere until ``serving_brownout`` drops.
+- **Per-tenant fair share** (:class:`TenantFairShare`, wired by the
+  HTTP front door — ``serving/frontdoor.py``, docs/SERVING.md "Front
+  door"): bounded per-tenant in-flight quotas plus a brownout
+  fair-share layer over the shed controller, so one abusive tenant
+  brownouts ITSELF instead of the fleet. The state machine lives here
+  (stdlib, unit-testable); the front door owns the metrics and the
+  429 mapping.
 
 Everything here is numpy-free stdlib so the scheduler half of serving
 stays importable (and unit-testable) without jax.
@@ -44,6 +51,7 @@ from paddle_tpu.monitor.registry import REGISTRY, counter, gauge
 __all__ = [
     "DeadlineExceededError", "OverloadedError", "ReplicaLostError",
     "ShedController", "SwapFailedError", "SwapWatchdog",
+    "TenantFairShare",
 ]
 
 
@@ -367,3 +375,95 @@ class SwapWatchdog:
                             f"{float(self.baseline_ms):.1f}ms over "
                             f"{dc} request(s)")
         return None
+
+
+class TenantFairShare:
+    """Per-tenant in-flight admission: a hard quota always, plus a
+    fair-share squeeze while the shed controller is in brownout.
+
+    The HTTP front door (``serving/frontdoor.py``) asks
+    :meth:`admit` before submitting a tenant's request and MUST pair
+    every successful admit with exactly one :meth:`release` (the front
+    door's try/finally owns that contract, including the
+    client-disconnected-mid-wait path). Two refusal verdicts:
+
+    - ``"quota"`` — the tenant already holds ``max_inflight``
+      requests. An absolute per-tenant bound, active in any load
+      state: no single key can occupy the whole request queue.
+    - ``"fair_share"`` — the shed controller is in brownout AND
+      admitting this request would push the tenant past
+      ``fair_frac`` of ALL in-flight front-door requests. This is the
+      "one abusive tenant brownouts itself, not the fleet" rule: in
+      overload the heavy key gets squeezed back toward its fair
+      share while light tenants keep flowing untouched.
+      ``fair_min_inflight`` exempts small holdings — with one tenant
+      and two requests the share test would otherwise refuse
+      everyone.
+
+    Verdicts are strings rather than exceptions because the caller
+    maps them to BOTH a metric label and a status code; the counting
+    itself (``serving_tenant_refused_total``) stays in the front door
+    with the rest of the HTTP metrics. Stdlib-only and lock-cheap:
+    one dict update under one lock per admit/release.
+    """
+
+    def __init__(self, max_inflight=64, fair_frac=0.5,
+                 fair_min_inflight=4, shed=None):
+        enforce(int(max_inflight) >= 1,
+                f"tenant max_inflight must be >= 1, got "
+                f"{max_inflight!r}")
+        enforce(0.0 < float(fair_frac) <= 1.0,
+                f"tenant fair_frac must be in (0, 1], got "
+                f"{fair_frac!r}")
+        enforce(int(fair_min_inflight) >= 1,
+                f"tenant fair_min_inflight must be >= 1, got "
+                f"{fair_min_inflight!r}")
+        self.max_inflight = int(max_inflight)
+        self.fair_frac = float(fair_frac)
+        self.fair_min_inflight = int(fair_min_inflight)
+        self.shed = shed
+        self._inflight = {}
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def admit(self, tenant):
+        """Refusal verdict (``"quota"`` / ``"fair_share"``) or None.
+        None means the tenant's in-flight count was incremented and
+        the caller OWES a :meth:`release`; a verdict changes no
+        state."""
+        with self._lock:
+            cur = self._inflight.get(tenant, 0)
+            if cur >= self.max_inflight:
+                return "quota"
+            if self.shed is not None and self.shed.brownout \
+                    and cur >= self.fair_min_inflight \
+                    and cur + 1 > self.fair_frac * (self._total + 1):
+                return "fair_share"
+            self._inflight[tenant] = cur + 1
+            self._total += 1
+        return None
+
+    def release(self, tenant):
+        """Return the tenant's remaining in-flight count (0 removes
+        the entry, so idle tenants cost nothing and the front door
+        knows to drop the per-tenant gauge)."""
+        with self._lock:
+            cur = self._inflight.get(tenant, 0)
+            enforce(cur > 0,
+                    f"TenantFairShare.release({tenant!r}) without a "
+                    f"matching admit — the front door's "
+                    f"admit/release pairing is broken")
+            if cur == 1:
+                del self._inflight[tenant]
+            else:
+                self._inflight[tenant] = cur - 1
+            self._total -= 1
+            return cur - 1
+
+    def inflight(self, tenant):
+        with self._lock:
+            return self._inflight.get(tenant, 0)
+
+    @property
+    def total_inflight(self):
+        return self._total

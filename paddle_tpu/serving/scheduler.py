@@ -56,8 +56,9 @@ from paddle_tpu.serving.resilience import (
 )
 
 __all__ = [
-    "QueueFullError", "ServerClosedError", "PendingResult", "MicroBatch",
-    "MicroBatchScheduler", "bucket_ladder", "pick_bucket",
+    "QueueFullError", "ServerClosedError", "ServerDrainingError",
+    "PendingResult", "MicroBatch", "MicroBatchScheduler",
+    "bucket_ladder", "pick_bucket",
 ]
 
 
@@ -70,6 +71,19 @@ class QueueFullError(RuntimeError):
 class ServerClosedError(RuntimeError):
     """``submit`` refused: the server is shutting down (or never
     started). Already-accepted requests still drain to completion."""
+
+
+class ServerDrainingError(ServerClosedError):
+    """``submit`` refused: the server is DRAINING (``begin_drain()``)
+    — a deliberate, bounded wind-down ahead of a restart or deploy,
+    not the terminal close. Subclassing :class:`ServerClosedError`
+    keeps existing closed-handlers working unchanged, while callers
+    that can route traffic (the HTTP front door, a multi-server
+    client) read ``retryable`` and retry AGAINST ANOTHER SERVER after
+    backoff: this one's already-accepted requests still complete, but
+    it will not take new work again."""
+
+    retryable = True
 
 
 _m_requests = counter(
@@ -195,9 +209,10 @@ class PendingResult:
 
 class _Request:
     __slots__ = ("feeds", "rows", "t_enqueue", "pending", "deadline",
-                 "deadline_ms")
+                 "deadline_ms", "trace_attrs")
 
-    def __init__(self, feeds, rows, deadline=None, deadline_ms=None):
+    def __init__(self, feeds, rows, deadline=None, deadline_ms=None,
+                 trace_attrs=None):
         self.feeds = feeds
         self.rows = rows
         self.t_enqueue = time.perf_counter()
@@ -207,6 +222,10 @@ class _Request:
         #: None for no deadline; deadline_ms kept for error messages
         self.deadline = deadline
         self.deadline_ms = deadline_ms
+        #: caller-attributed trace attrs (the front door stamps the
+        #: tenant id here); None — the in-process default — costs the
+        #: hot path one attribute store and nothing at delivery
+        self.trace_attrs = trace_attrs
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -221,9 +240,10 @@ def _deadline_error(req, stage, now=None):
         f"request was failed without consuming further serving work")
 
 
-def _trace_root_error(t0):
+def _trace_root_error(t0, attrs=None):
     """Keep a root-only error trace for a request that never joined a
-    batch (no stamps, no phases — errors are always kept). Returns
+    batch (no stamps, no phases — errors are always kept). ``attrs``
+    (e.g. the front door's tenant id) land on the root span. Returns
     the trace id, or None when tracing is off or telemetry failed —
     telemetry must never block delivery of a claimed request."""
     if not _trace._enabled:
@@ -231,6 +251,8 @@ def _trace_root_error(t0):
     try:
         ctx = _trace.start_trace("serving/request")
         ctx.t0 = t0
+        if attrs:
+            ctx.attrs.update(attrs)
         _trace.end_trace(ctx, error=True)
         return ctx.trace_id
     except Exception:
@@ -244,7 +266,8 @@ def _fail_request(r, exc, outcome):
     outcome. Returns whether this call delivered."""
     if not r.pending.claim():
         return False
-    r.pending.trace_id = _trace_root_error(r.t_enqueue)
+    r.pending.trace_id = _trace_root_error(
+        r.t_enqueue, getattr(r, "trace_attrs", None))
     r.pending._deliver(error=exc, claimed=True)
     _m_requests.inc(outcome=outcome)
     return True
@@ -349,6 +372,12 @@ class MicroBatch:
         try:
             ctx = _trace.start_trace("serving/request")
             ctx.t0 = r.t_enqueue
+            r_attrs = getattr(r, "trace_attrs", None)
+            if r_attrs:
+                # caller attribution (front-door tenant id): on the
+                # ROOT span, so a tenant's p99 is queryable
+                # socket-to-device from the kept trees
+                ctx.attrs.update(r_attrs)
             if error is None:
                 # the per-batch screen already consumed this request's
                 # sampling credit — end_trace must not count it again
@@ -485,6 +514,7 @@ class MicroBatchScheduler:
         self._q = queue.Queue(maxsize=max_queue + 1)  # +1: _STOP always fits
         self._specs = dict(sample_specs or {})
         self._closed = False
+        self._draining = False
         self._lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-batcher")
@@ -493,6 +523,24 @@ class MicroBatchScheduler:
     @property
     def ladder(self):
         return self._ladder
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def begin_drain(self):
+        """Flip admission into DRAINING: every subsequent ``submit``
+        refuses with the retryable :class:`ServerDrainingError` while
+        already-accepted requests keep flowing to completion — the
+        reversible first half of a graceful shutdown (``close()`` is
+        the terminal second half, and still drains the same way).
+        Idempotent; returns whether THIS call flipped the state (False
+        when already draining or closed)."""
+        with self._lock:
+            if self._draining or self._closed:
+                return False
+            self._draining = True
+        return True
 
     def set_dispatch(self, dispatch):
         """Retarget batch dispatch — the hot-swap cutover primitive
@@ -570,14 +618,18 @@ class MicroBatchScheduler:
         pick_bucket(rows, self._ladder)
         return arrs, rows
 
-    def submit(self, feeds, deadline_ms=None):
+    def submit(self, feeds, deadline_ms=None, trace_attrs=None):
         """Admit one request ({feed name: array with leading batch
         dim}); returns a :class:`PendingResult`. ``deadline_ms``
         bounds the request end to end (None = the scheduler's
-        ``default_deadline_ms``; 0 = already exhausted). Failure
-        precedence, deterministic regardless of server state:
-        malformed arguments (bad feed, negative deadline) raise
-        ``EnforceNotMet`` first; then :class:`ServerClosedError`; then
+        ``default_deadline_ms``; 0 = already exhausted).
+        ``trace_attrs`` (optional dict) rides the request's kept trace
+        as root-span attributes — the front door stamps the tenant id
+        here. Failure precedence, deterministic regardless of server
+        state: malformed arguments (bad feed, negative deadline, non-
+        dict trace_attrs) raise ``EnforceNotMet`` first; then
+        :class:`ServerClosedError` (with the retryable
+        :class:`ServerDrainingError` subclass during a drain); then
         :class:`DeadlineExceededError` (admission-stage expiry,
         ``outcome="deadline"``); then
         :class:`~.resilience.OverloadedError` (adaptive shed,
@@ -589,6 +641,9 @@ class MicroBatchScheduler:
         # on an open one (satellite-pinned precedence)
         arrs, rows = self._validate(feeds)
         deadline_ms = self._validate_deadline(deadline_ms)
+        enforce(trace_attrs is None or isinstance(trace_attrs, dict),
+                f"trace_attrs must be a dict or None, got "
+                f"{type(trace_attrs).__name__}")
         deadline = (None if deadline_ms is None
                     else t_adm + deadline_ms / 1e3)
         with self._lock:
@@ -596,6 +651,15 @@ class MicroBatchScheduler:
                 raise ServerClosedError(
                     "serving scheduler is closed" if self._closed
                     else "serving scheduler not started")
+            if self._draining:
+                # draining beats deadline/shed/queue checks: the
+                # verdict is about THIS server's lifecycle, and the
+                # retryable type tells the caller to take the request
+                # elsewhere rather than burn its remaining budget here
+                raise ServerDrainingError(
+                    "serving scheduler is draining (begin_drain); "
+                    "already-accepted requests are completing — retry "
+                    "against another server")
             if deadline is not None and \
                     time.perf_counter() >= deadline:
                 # admission-stage expiry (deadline_ms=0, or a budget
@@ -603,7 +667,7 @@ class MicroBatchScheduler:
                 # trace kept (errors-always-kept) — no queue slot, no
                 # batch, no dispatch ever spent on it
                 _m_requests.inc(outcome="deadline")
-                _trace_root_error(t_adm)
+                _trace_root_error(t_adm, trace_attrs)
                 raise DeadlineExceededError(
                     f"request deadline {deadline_ms:g}ms already "
                     f"exceeded at admission; nothing was enqueued")
@@ -630,7 +694,8 @@ class MicroBatchScheduler:
             # max_wait deadline anchor AND the latency-metric origin)
             # must not start ticking while submit contends for the lock
             req = _Request(arrs, rows, deadline=deadline,
-                           deadline_ms=deadline_ms)
+                           deadline_ms=deadline_ms,
+                           trace_attrs=trace_attrs)
             self._q.put_nowait(req)
         _m_queue_depth.set(self._q.qsize())
         return req.pending

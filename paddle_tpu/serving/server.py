@@ -380,23 +380,52 @@ class InferenceServer:
         return self._bundle.version
 
     # -- serving -----------------------------------------------------------
-    def submit(self, feeds, deadline_ms=None):
+    def submit(self, feeds, deadline_ms=None, trace_attrs=None):
         """Admit one request; returns a ``PendingResult``.
         ``deadline_ms`` bounds it end to end (None = the config's
         ``default_deadline_ms``); past the deadline the request fails
         with ``DeadlineExceededError`` at whichever serving stage
-        observes the expiry."""
+        observes the expiry. ``trace_attrs`` (optional dict) rides the
+        request's kept trace as root-span attributes — the HTTP front
+        door stamps the tenant id here."""
         # no server-level pre-gate: the scheduler validates ARGUMENTS
         # first and then refuses with ServerClosedError — so a
         # malformed request fails the same deterministic typed way on
         # a closed server as on an open one (the documented
         # precedence; server.close() closes the scheduler, so the
         # closed refusal is never lost)
-        return self.scheduler.submit(feeds, deadline_ms=deadline_ms)
+        return self.scheduler.submit(feeds, deadline_ms=deadline_ms,
+                                     trace_attrs=trace_attrs)
 
     def infer(self, feeds, timeout=None, deadline_ms=None):
         """Blocking convenience: submit + result."""
         return self.submit(feeds, deadline_ms=deadline_ms).result(timeout)
+
+    # -- graceful drain ----------------------------------------------------
+    @property
+    def draining(self):
+        """True between ``begin_drain()`` and ``close()``: admission
+        refuses with the retryable ``ServerDrainingError`` while
+        accepted requests complete."""
+        return self.scheduler.draining
+
+    def begin_drain(self):
+        """Begin a graceful drain: admission flips to the retryable
+        :class:`~.scheduler.ServerDrainingError` (a
+        ``ServerClosedError`` subclass, so existing handlers keep
+        working) while every already-accepted request completes
+        through the normal path. The terminal half is still
+        ``close()`` — a drain stops new work WITHOUT committing to
+        teardown, which is what a rolling restart wants between
+        "readiness off" and "process exit". Idempotent; returns
+        whether this call flipped the state."""
+        flipped = self.scheduler.begin_drain()
+        if flipped:
+            _log(f"drain begun: model version "
+                 f"{self.model_version or 'unversioned'} refusing "
+                 f"new admissions (ServerDrainingError, retryable); "
+                 f"accepted requests completing")
+        return flipped
 
     # -- hot model swap ----------------------------------------------------
     def _swap_ctl(self):

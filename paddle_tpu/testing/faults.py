@@ -130,6 +130,18 @@ boundary for free:
   stage, truncate the shadow file the target just published (a torn
   stage the coordinator's pre-commit ``verify_npz`` gate must catch,
   aborting + rolling back the migration) and keep serving.
+- ``PT_FAULT_HTTP_SLOWLORIS_EVERY=N`` / ``PT_FAULT_HTTP_DISCONNECT_EVERY=N``
+  / ``PT_FAULT_HTTP_HEADER_BOMB_EVERY=N`` (+
+  ``PT_FAULT_HTTP_BOMB_HEADERS=K``, default 200) —
+  ``install_http_faults()``: wire-level chaos against the serving
+  front door, patched into the CLIENT (``frontdoor.WireClient._send``)
+  so the server under test runs unpatched production code. Slow-loris
+  stalls every Nth request after half its body (the server's socket
+  timeout must answer a typed 408); disconnect hangs up after sending
+  (the server must detect it and release the rider); header-bomb
+  injects K junk headers (stdlib parsing refuses >100 → typed 431).
+  Continuous chaos like the PS wire faults: the zero-hangs invariant
+  must hold under sustained adversity.
 - ``PT_FAULT_RANK=R``           — scope injection to PADDLE_TRAINER_ID R
   (default: every rank).
 - ``PT_FAULT_ONCE_DIR=dir``     — fire each fault once *per job*, not
@@ -153,6 +165,7 @@ import time
 
 __all__ = ["maybe_fault", "poison_feed", "install_slow_write",
            "install_serving_faults", "install_swap_faults",
+           "install_http_faults",
            "install_ps_faults", "install_ps_wire_faults",
            "install_ps_migrate_faults",
            "corrupt_checkpoint", "corrupt_newest_checkpoint",
@@ -612,6 +625,89 @@ def install_serving_faults():
 
     def uninstall():
         Replica.run_batch = orig
+
+    return uninstall
+
+
+_HTTP_FAULT_ENVS = ("PT_FAULT_HTTP_SLOWLORIS_EVERY",
+                    "PT_FAULT_HTTP_DISCONNECT_EVERY",
+                    "PT_FAULT_HTTP_HEADER_BOMB_EVERY")
+
+
+def install_http_faults():
+    """If any front-door wire chaos env (PT_FAULT_HTTP_SLOWLORIS_EVERY
+    / PT_FAULT_HTTP_DISCONNECT_EVERY / PT_FAULT_HTTP_HEADER_BOMB_EVERY
+    = N) is set, patch the serving ``WireClient._send`` — the
+    client-side wire seam — to misbehave on every Nth request.
+    CONTINUOUS chaos like the PS wire faults (not fire-once): the
+    front door's "every request resolves typed, zero hangs" invariant
+    must hold under sustained adversity, and the faults are
+    client-side because the server code under test must be the
+    UNPATCHED production path. Three behaviors:
+
+    - **slow-loris**: send the head + first half of the body, then go
+      silent. The server's per-connection socket timeout must fire
+      and answer a typed 408 (read back by the normal client path).
+    - **disconnect**: send the full request, then close the socket
+      before reading the response — the injected
+      disconnect-mid-response. Raises ``WireReset`` so the CLIENT side
+      resolves typed too; the server must detect the hangup and
+      release the rider (outcome="disconnect").
+    - **header-bomb**: inject PT_FAULT_HTTP_BOMB_HEADERS (default
+      200) junk headers before the blank line. stdlib parsing refuses
+      >100 headers, so the server answers 431 — counted, typed,
+      connection closed.
+
+    Returns an uninstall callable when installed, False otherwise."""
+    if not any(os.environ.get(k) for k in _HTTP_FAULT_ENVS) or \
+            not _applies_to_rank():
+        return False
+    import threading
+
+    from paddle_tpu.serving.frontdoor import WireClient, WireReset
+
+    loris_every = _int_env("PT_FAULT_HTTP_SLOWLORIS_EVERY")
+    drop_every = _int_env("PT_FAULT_HTTP_DISCONNECT_EVERY")
+    bomb_every = _int_env("PT_FAULT_HTTP_HEADER_BOMB_EVERY")
+    bomb_n = _int_env("PT_FAULT_HTTP_BOMB_HEADERS") or 200
+    orig = WireClient._send
+    lock = threading.Lock()
+    state = {"n": 0}
+
+    def _nth():
+        with lock:
+            state["n"] += 1
+            return state["n"]
+
+    def chaos_send(self, head, body):
+        n = _nth()
+        if loris_every and n % loris_every == 0:
+            sys.stderr.write(f"[faults] injected slow-loris: request "
+                             f"{n} stalls after half its body\n")
+            sys.stderr.flush()
+            self._sock.sendall(head + body[:len(body) // 2])
+            return      # silence: the server's socket timeout must fire
+        if bomb_every and n % bomb_every == 0:
+            sys.stderr.write(f"[faults] injected header bomb: request "
+                             f"{n} carries {bomb_n} junk headers\n")
+            sys.stderr.flush()
+            junk = "".join(f"X-Bomb-{k}: {'b' * 100}\r\n"
+                           for k in range(bomb_n)).encode("utf-8")
+            self._sock.sendall(head[:-2] + junk + b"\r\n" + body)
+            return
+        orig(self, head, body)
+        if drop_every and n % drop_every == 0:
+            sys.stderr.write(f"[faults] injected client disconnect: "
+                             f"request {n} hangs up after sending\n")
+            sys.stderr.flush()
+            self._drop()
+            raise WireReset(f"[faults] injected client disconnect "
+                            f"after request {n} was sent")
+
+    WireClient._send = chaos_send
+
+    def uninstall():
+        WireClient._send = orig
 
     return uninstall
 

@@ -1,0 +1,225 @@
+"""HTTP front-door chaos e2e worker (tests/test_serving_http_e2e.py).
+
+Boots an in-process InferenceServer behind an HttpFrontDoor on a tiny
+frozen model, arms the per-rank exporter, optionally installs the
+connection-level chaos faults from the environment
+(PT_FAULT_HTTP_SLOWLORIS_EVERY / _DISCONNECT_EVERY /
+_HEADER_BOMB_EVERY — the clean run sets none; the faults patch the
+CLIENT's send seam so the server under test runs unmodified), then
+drives open-loop Poisson wire load over a small connection pool with
+per-request accounting: every request must terminate as an HTTP
+status or a typed client-side error (WireReset from an injected
+disconnect) within the timeout — a hang is a test failure. With
+HTTP_E2E_DRAIN=1 the worker flips ``begin_drain`` mid-load and
+separately accounts requests sent after the flip (they must be
+refused 503 + Retry-After while everything in flight completes), then
+asserts ``drain()`` converges inside its bound.
+
+Because the server is in-process, the result also carries the
+server-side ``serving_http_requests_total`` outcome breakdown, so the
+test can cross-check wire-observed statuses against the door's own
+typed accounting.
+
+Usage: serving_http_worker.py <model_dir> <out_json>
+Env knobs: HTTP_E2E_REQS (default 160), HTTP_E2E_LOAD_SECS (default
+4.0), HTTP_E2E_CONNS (default 6), HTTP_E2E_DRAIN (default off), plus
+the PT_FAULT_HTTP_* family.
+"""
+
+import json
+import os
+import queue
+import sys
+import threading
+import time
+
+import numpy as np
+
+# every status the front door is allowed to emit — anything else on
+# the wire is an untyped failure and fails the run
+TYPED_STATUSES = {200, 400, 404, 405, 408, 413, 429, 431, 500, 503, 504}
+
+
+def main():
+    model_dir, out_json = sys.argv[1], sys.argv[2]
+    n_reqs = int(os.environ.get("HTTP_E2E_REQS", "160"))
+    load_secs = float(os.environ.get("HTTP_E2E_LOAD_SECS", "4.0"))
+    n_conns = int(os.environ.get("HTTP_E2E_CONNS", "6"))
+    do_drain = os.environ.get("HTTP_E2E_DRAIN") == "1"
+
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.monitor import exporter
+    from paddle_tpu.monitor.registry import REGISTRY
+    from paddle_tpu.serving import (FrontDoorConfig, HttpFrontDoor,
+                                    InferenceServer, ServingConfig,
+                                    WireClient, WireReset)
+    from paddle_tpu.testing import faults
+
+    # -- tiny frozen model -------------------------------------------------
+    pt.enable_static()
+    main_p, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main_p, startup), unique_name.guard():
+        x = pt.static.data("x", [16], dtype="float32")
+        h = layers.fc(x, 32, act="relu")
+        out = layers.fc(h, 4)
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                   main_program=main_p)
+
+    rank_exp = exporter.RankExporter.from_env(interval=0.5)
+    if rank_exp is not None:
+        rank_exp.start()
+
+    srv = InferenceServer(model_dir, ServingConfig(
+        max_batch=4, max_wait_ms=1.0, max_queue=n_reqs + n_conns + 16))
+    # a short socket timeout keeps each slow-loris'd connection from
+    # parking a handler for the default 10s — the 408 must still be
+    # typed, just sooner
+    door = HttpFrontDoor(srv, FrontDoorConfig(
+        socket_timeout_s=1.0, drain_retry_after_s=2.0)).start()
+    feed = {"x": np.random.RandomState(0).rand(1, 16).astype(
+        np.float32)}
+    with WireClient("127.0.0.1", door.port, timeout_s=30) as warm:
+        for _ in range(4):
+            st, _, _ = warm.infer(feed, deadline_ms=30000)
+            assert st == 200, f"warm-up got {st}"
+
+    installed = faults.install_http_faults()
+
+    # -- open-loop load over a connection pool -----------------------------
+    offered = n_reqs / load_secs
+    sched = np.cumsum(np.random.RandomState(42).exponential(
+        1.0 / offered, size=n_reqs))
+    work = queue.Queue()
+    results = [None] * n_reqs       # every slot MUST be filled
+    drain_flag = threading.Event()
+
+    def worker():
+        c = WireClient("127.0.0.1", door.port, timeout_s=20)
+        try:
+            while True:
+                item = work.get()
+                if item is None:
+                    return
+                i, t_arr = item
+                after_drain = drain_flag.is_set()
+                try:
+                    st, hdrs, payload = c.infer(
+                        feed, deadline_ms=30000, tenant="e2e")
+                    # stdlib refusals (431 header bomb, ...) carry an
+                    # HTML body, not the door's JSON envelope
+                    err = (payload.get("error", "")
+                           if isinstance(payload, dict)
+                           else str(payload or "")[:200])
+                    results[i] = {
+                        "status": st,
+                        "retry_after": "retry-after" in hdrs,
+                        "error": err,
+                        "lat_ms": (time.perf_counter() - t_arr) * 1e3,
+                        "after_drain": after_drain,
+                    }
+                except WireReset as e:
+                    results[i] = {"status": "wire_reset",
+                                  "error": str(e),
+                                  "after_drain": after_drain}
+                except (TimeoutError, OSError) as e:
+                    results[i] = {"status": "hang",
+                                  "error": repr(e),
+                                  "after_drain": after_drain}
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(n_conns)]
+    for t in threads:
+        t.start()
+
+    drain_at = n_reqs // 2 if do_drain else None
+    drained = None
+    t0 = time.perf_counter()
+    for i in range(n_reqs):
+        if drain_at is not None and i == drain_at:
+            drain_flag.set()
+            flipped = door.begin_drain(why="e2e mid-load drain")
+            assert flipped is True
+        dly = t0 + sched[i] - time.perf_counter()
+        if dly > 0:
+            time.sleep(dly)
+        work.put((i, t0 + sched[i]))
+    for _ in threads:
+        work.put(None)
+    t_join = time.monotonic() + 60
+    for t in threads:
+        t.join(max(0.0, t_join - time.monotonic()))
+    stragglers = sum(t.is_alive() for t in threads)
+
+    if do_drain:
+        drained = door.drain(timeout_s=30)
+
+    # -- per-request accounting --------------------------------------------
+    unaccounted = sum(1 for r in results if r is None)
+    hangs = sum(1 for r in results
+                if r is not None and r["status"] == "hang")
+    wire_resets = sum(1 for r in results
+                      if r is not None and r["status"] == "wire_reset")
+    statuses = {}
+    untyped = 0
+    ok_lat = []
+    drain_refused = drain_ok_after = 0
+    for r in results:
+        if r is None or r["status"] in ("hang", "wire_reset"):
+            continue
+        st = r["status"]
+        statuses[str(st)] = statuses.get(str(st), 0) + 1
+        if st not in TYPED_STATUSES:
+            untyped += 1
+        if st == 200 and "lat_ms" in r:
+            ok_lat.append(r["lat_ms"])
+        if r["after_drain"]:
+            if st == 503 and "draining" in r["error"]:
+                assert r["retry_after"], r
+                drain_refused += 1
+            elif st == 200:
+                # a request already picked up by a pool worker when
+                # the flag flipped — completed, never hung
+                drain_ok_after += 1
+
+    outcomes_m = REGISTRY.get("serving_http_requests_total")
+    server_outcomes = {k[0]: v for k, v in outcomes_m.samples().items()}
+
+    result = {
+        "total": n_reqs,
+        "unaccounted": unaccounted,
+        "hangs": hangs + stragglers,
+        "wire_resets": wire_resets,
+        "statuses": statuses,
+        "untyped_statuses": untyped,
+        "ok": statuses.get("200", 0),
+        "p99_ok_ms": (round(float(np.percentile(ok_lat, 99)), 2)
+                      if ok_lat else None),
+        "server_outcomes": server_outcomes,
+        "drained": drained,
+        "drain_refused": drain_refused,
+        "drain_ok_after_flag": drain_ok_after,
+        "offered_qps": round(offered, 1),
+        "client_conns": n_conns,
+        "faults_installed": bool(installed),
+    }
+    if not do_drain:
+        door.stop()
+    srv.close(timeout=60)
+    if rank_exp is not None:
+        rank_exp.stop()
+    with open(out_json, "w") as f:
+        json.dump(result, f)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -178,6 +178,28 @@ class TestBenchModes:
         assert blip["unit"] == "ms" and blip["value"] >= 0
         assert blip["swap_window_ms"] > 0
 
+    def test_serving_http_mode_emits_wire_ratio(self):
+        """`bench.py serving` with BENCH_SERVING_HTTP=1 must run the
+        front-door wire-vs-in-process A/B end to end (tiny request
+        count: CLI/shape smoke) and emit the
+        serving_http_vs_inproc_p99_ratio row: ABBA pair ratios
+        populated, both window p99s measured, every wire request
+        accounted (the window asserts internally — a hang or an
+        untyped status fails the subprocess)."""
+        lines = _run_mode("serving",
+                          extra_env={"BENCH_SERVING_HTTP": "1",
+                                     "BENCH_SERVING_HTTP_REQS": "30",
+                                     "BENCH_SERVING_HTTP_PAIRS": "1",
+                                     "BENCH_SERVING_HTTP_CONNS": "4"})
+        by = {ln["metric"]: ln for ln in lines}
+        ratio = by["serving_http_vs_inproc_p99_ratio"]
+        assert ratio["unit"] == "x" and ratio["value"] > 0
+        assert ratio["http_p99_ms"] > 0
+        assert ratio["inproc_p99_ms"] > 0
+        assert len(ratio["pair_ratios"]) >= 1
+        assert ratio["n_per_window"] == 30
+        assert ratio["client_conns"] == 4
+
     def test_dispatch_mode_emits_trace_overhead_and_attribution(self):
         """`bench.py dispatch` must A/B per-step tracing on ABBA
         micro-windows (ratio < 1.05x — tail sampling's hot-path

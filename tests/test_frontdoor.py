@@ -1,0 +1,685 @@
+"""Front-door tests (paddle_tpu/serving/frontdoor.py, docs/SERVING.md
+"Front door").
+
+The HTTP layer runs against a recording FAKE server for the status
+matrix, deadline-deduction math, tenant admission, connection
+robustness and drain semantics (no jax in the loop — every wire
+behavior is the front door's own), and against the REAL
+InferenceServer for the two pinned acceptance criteria: a
+wire-exhausted X-Deadline-Ms budget is refused at admission WITHOUT
+ever being enqueued, and the in-process path with the front door off
+is bit-for-bit legacy (no serving_http_*/serving_tenant_* movement,
+tenant admission never consulted). The slow e2e under sustained wire
+chaos lives in test_serving_http_e2e.py.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.enforce import EnforceNotMet
+from paddle_tpu.monitor.registry import REGISTRY
+from paddle_tpu.serving.frontdoor import (
+    FrontDoorConfig, HttpFrontDoor, WireClient, WireReset,
+)
+from paddle_tpu.serving.resilience import (
+    DeadlineExceededError, OverloadedError, ReplicaLostError,
+    TenantFairShare,
+)
+from paddle_tpu.serving.scheduler import (
+    MicroBatchScheduler, PendingResult, QueueFullError,
+    ServerClosedError, ServerDrainingError,
+)
+
+
+def _counter(name, **labels):
+    m = REGISTRY.get(name)
+    return m.value(**labels) if m else 0.0
+
+
+def _wait_until(cond, timeout=5.0, what="condition"):
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"{what} not reached within {timeout}s")
+
+
+class FakeServer:
+    """Records submit calls; completes inline with feeds['x'] * 2,
+    raises ``fail_with``, or parks the pending behind ``gate``."""
+
+    model_version = "fake-v1"
+    draining = False
+
+    def __init__(self, fail_with=None, gate=None, gate_tenants=None):
+        self.fail_with = fail_with
+        self.gate = gate
+        self.gate_tenants = gate_tenants    # None = gate everyone
+        self.calls = []
+        self.drain_calls = 0
+        self.close_calls = 0
+
+    def submit(self, feeds, deadline_ms=None, trace_attrs=None):
+        self.calls.append(
+            {"feeds": feeds, "deadline_ms": deadline_ms,
+             "trace_attrs": trace_attrs})
+        if self.fail_with is not None:
+            raise self.fail_with
+        p = PendingResult()
+        gated = self.gate is not None and (
+            self.gate_tenants is None or
+            (trace_attrs or {}).get("tenant") in self.gate_tenants)
+        if gated:
+            threading.Thread(
+                target=lambda: (self.gate.wait(10),
+                                p._deliver(outs=[feeds["x"] * 2.0])),
+                daemon=True).start()
+        else:
+            p._deliver(outs=[feeds["x"] * 2.0])
+        return p
+
+    def begin_drain(self):
+        self.drain_calls += 1
+        return self.drain_calls == 1
+
+    def close(self, timeout=None):
+        self.close_calls += 1
+        return True
+
+
+def _door(server, **cfg):
+    cfg.setdefault("socket_timeout_s", 5.0)
+    return HttpFrontDoor(server, FrontDoorConfig(**cfg)).start()
+
+
+def _raw_exchange(port, data, timeout=5.0, settle=0.0):
+    """Send raw bytes, optionally linger, read whatever comes back
+    (b'' = server closed without answering)."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall(data)
+        if settle:
+            time.sleep(settle)
+        s.settimeout(timeout)
+        chunks = []
+        try:
+            while True:
+                c = s.recv(65536)
+                if not c:
+                    break
+                chunks.append(c)
+        except (TimeoutError, socket.timeout):
+            pass
+        return b"".join(chunks)
+
+
+# ---------------------------------------------------------------------------
+class TestTenantFairShare:
+    def test_admit_release_counting(self):
+        t = TenantFairShare(max_inflight=2)
+        assert t.admit("a") is None
+        assert t.admit("a") is None
+        assert t.inflight("a") == 2 and t.total_inflight == 2
+        assert t.admit("a") == "quota"
+        assert t.inflight("a") == 2     # a verdict changes no state
+        assert t.release("a") == 1
+        assert t.admit("a") is None
+        assert t.release("a") == 1 and t.release("a") == 0
+        assert t.total_inflight == 0
+
+    def test_release_without_admit_is_a_bug(self):
+        with pytest.raises(EnforceNotMet, match="matching admit"):
+            TenantFairShare().release("ghost")
+
+    def test_fair_share_only_squeezes_in_brownout(self):
+        class Shed:
+            brownout = False
+
+        shed = Shed()
+        t = TenantFairShare(max_inflight=100, fair_frac=0.5,
+                            fair_min_inflight=2, shed=shed)
+        for _ in range(6):
+            assert t.admit("heavy") is None
+        # healthy: no squeeze however lopsided the holdings
+        assert t.admit("heavy") is None
+        t.release("heavy")
+        shed.brownout = True
+        # brownout: heavy (6 of 6 in flight) is over fair_frac...
+        assert t.admit("heavy") == "fair_share"
+        # ...but a light tenant below fair_min_inflight flows freely
+        assert t.admit("light") is None
+        assert t.inflight("light") == 1
+
+    def test_fair_min_inflight_exempts_small_holdings(self):
+        class Shed:
+            brownout = True
+
+        t = TenantFairShare(max_inflight=100, fair_frac=0.1,
+                            fair_min_inflight=4, shed=Shed())
+        # the only tenant would always exceed fair_frac of the total;
+        # the floor keeps a brownout from refusing everyone
+        for _ in range(4):
+            assert t.admit("solo") is None
+        assert t.admit("solo") == "fair_share"
+
+
+# ---------------------------------------------------------------------------
+class TestServerDraining:
+    def _sched(self, **kw):
+        def dispatch(mb):
+            mb.complete([mb.feeds["x"] * 2.0])
+
+        return MicroBatchScheduler(dispatch, ("x",), max_batch=4,
+                                   max_wait_ms=1.0, **kw).start()
+
+    def test_drain_refuses_typed_and_retryable(self):
+        s = self._sched()
+        try:
+            assert s.begin_drain() is True
+            assert s.draining
+            with pytest.raises(ServerDrainingError) as ei:
+                s.submit({"x": np.ones((1, 4), np.float32)})
+            assert isinstance(ei.value, ServerClosedError)
+            assert ei.value.retryable is True
+            # idempotent: the second flip reports it did nothing
+            assert s.begin_drain() is False
+        finally:
+            s.close()
+
+    def test_accepted_request_completes_through_drain(self):
+        gate = threading.Event()
+
+        def dispatch(mb):
+            gate.wait(10)
+            mb.complete([mb.feeds["x"] * 2.0])
+
+        s = MicroBatchScheduler(dispatch, ("x",), max_batch=4,
+                                max_wait_ms=1.0).start()
+        try:
+            p = s.submit({"x": np.ones((1, 4), np.float32)})
+            s.begin_drain()
+            gate.set()
+            out = p.result(timeout=10)
+            np.testing.assert_allclose(out[0], 2.0)
+        finally:
+            s.close()
+
+    def test_close_wins_over_drain(self):
+        s = self._sched()
+        s.begin_drain()
+        s.close()
+        with pytest.raises(ServerClosedError) as ei:
+            s.submit({"x": np.ones((1, 4), np.float32)})
+        # terminal, not the retryable drain subclass
+        assert type(ei.value) is ServerClosedError
+
+    def test_validation_beats_drain(self):
+        s = self._sched()
+        try:
+            s.begin_drain()
+            with pytest.raises(EnforceNotMet):
+                s.submit({"x": np.ones((1, 4), np.float32)},
+                         deadline_ms="soon")
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+class TestFrontDoorHTTP:
+    def test_ok_roundtrip_carries_outputs_version_trace(self):
+        srv = FakeServer()
+        door = _door(srv)
+        try:
+            with WireClient("127.0.0.1", door.port) as c:
+                before = _counter("serving_http_requests_total",
+                                  outcome="ok")
+                st, hdrs, payload = c.infer(
+                    {"x": [[1.0, 2.0]]}, deadline_ms=5000,
+                    tenant="acme")
+                assert st == 200
+                np.testing.assert_allclose(payload["outputs"][0],
+                                           [[2.0, 4.0]])
+                assert payload["model_version"] == "fake-v1"
+                assert "trace_id" in payload
+                # the counter lands just after the response bytes
+                # (write failures flip the outcome to disconnect)
+                _wait_until(
+                    lambda: _counter("serving_http_requests_total",
+                                     outcome="ok") == before + 1,
+                    what="ok outcome counted")
+            call = srv.calls[-1]
+            assert call["trace_attrs"] == {"tenant": "acme",
+                                           "transport": "http"}
+        finally:
+            door.stop()
+
+    def test_probes(self):
+        door = _door(FakeServer())
+        try:
+            with WireClient("127.0.0.1", door.port) as c:
+                assert c.get("/healthz")[0] == 200
+                assert c.get("/readyz")[0] == 200
+        finally:
+            door.stop()
+
+    @pytest.mark.parametrize("body,match", [
+        (b"not json", "not valid JSON"),
+        (b"[1, 2]", "feeds"),
+        (b'{"feeds": {}}', "feeds"),
+    ])
+    def test_malformed_body_is_400_with_message(self, body, match):
+        door = _door(FakeServer())
+        try:
+            with WireClient("127.0.0.1", door.port) as c:
+                st, _, payload = c.request("POST", "/v1/infer", body,
+                                           {})
+                assert st == 400
+                assert match in payload["error"]
+        finally:
+            door.stop()
+
+    def test_bad_deadline_header_and_long_tenant_are_400(self):
+        door = _door(FakeServer())
+        try:
+            with WireClient("127.0.0.1", door.port) as c:
+                st, _, payload = c.infer(
+                    {"x": [[1.0]]}, headers={"X-Deadline-Ms": "soon"})
+                assert st == 400 and "X-Deadline-Ms" in payload["error"]
+                st, _, payload = c.infer({"x": [[1.0]]},
+                                         tenant="t" * 200)
+                assert st == 400 and "128" in payload["error"]
+        finally:
+            door.stop()
+
+    def test_unknown_path_and_wrong_method(self):
+        door = _door(FakeServer())
+        try:
+            with WireClient("127.0.0.1", door.port) as c:
+                assert c.get("/nope")[0] == 404
+                assert c.get("/v1/infer")[0] == 405
+                assert c.request("POST", "/nope", b"{}", {})[0] == 404
+        finally:
+            door.stop()
+
+    def test_oversized_body_is_413(self):
+        door = _door(FakeServer(), max_body_bytes=64)
+        try:
+            with WireClient("127.0.0.1", door.port) as c:
+                st, _, payload = c.infer(
+                    {"x": [[float(i) for i in range(64)]]})
+                assert st == 413
+                assert "max_body_bytes" in payload["error"]
+        finally:
+            door.stop()
+
+    def test_missing_content_length_is_400(self):
+        door = _door(FakeServer())
+        try:
+            raw = _raw_exchange(
+                door.port,
+                b"POST /v1/infer HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: close\r\n\r\n")
+            assert b" 400 " in raw.split(b"\r\n", 1)[0]
+            assert b"Content-Length required" in raw
+        finally:
+            door.stop()
+
+    @pytest.mark.parametrize("error,status,outcome,retry_after", [
+        (DeadlineExceededError("expired"), 504, "deadline", False),
+        (OverloadedError("shed"), 429, "overloaded", True),
+        (QueueFullError("full"), 429, "queue_full", True),
+        (ServerDrainingError("draining"), 503, "draining", True),
+        (ServerClosedError("closed"), 503, "closed", False),
+        (ReplicaLostError("lost"), 503, "replica_lost", True),
+        (EnforceNotMet("bad rows"), 400, "bad_request", False),
+        (RuntimeError("boom"), 500, "internal", False),
+    ])
+    def test_typed_error_maps_to_stable_status(self, error, status,
+                                               outcome, retry_after):
+        door = _door(FakeServer(fail_with=error))
+        try:
+            before = _counter("serving_http_requests_total",
+                              outcome=outcome)
+            with WireClient("127.0.0.1", door.port) as c:
+                st, hdrs, payload = c.infer({"x": [[1.0]]})
+            assert st == status
+            assert str(error) in payload["error"] or \
+                type(error).__name__ in payload["error"]
+            assert ("retry-after" in hdrs) == retry_after, hdrs
+            _wait_until(
+                lambda: _counter("serving_http_requests_total",
+                                 outcome=outcome) == before + 1,
+                what=f"{outcome} outcome counted")
+        finally:
+            door.stop()
+
+    def test_validation_beats_drain_gate(self):
+        """The PR-12 precedence, mirrored at the wire: a malformed
+        body is a deterministic 400 whether the door is draining or
+        not — never masked by the 503."""
+        srv = FakeServer()
+        door = _door(srv)
+        try:
+            door.begin_drain()
+            with WireClient("127.0.0.1", door.port) as c:
+                st, _, payload = c.request("POST", "/v1/infer",
+                                           b"not json", {})
+                assert st == 400
+                assert "JSON" in payload["error"]
+        finally:
+            door.stop()
+
+    def test_deadline_deduction_math(self):
+        """X-Deadline-Ms anchors at request arrival; submit sees the
+        REMAINING budget — positive, strictly below the header, and
+        within a generous parse bound of it."""
+        srv = FakeServer()
+        door = _door(srv)
+        try:
+            with WireClient("127.0.0.1", door.port) as c:
+                assert c.infer({"x": [[1.0]]},
+                               deadline_ms=5000)[0] == 200
+                assert c.infer({"x": [[1.0]]})[0] == 200
+        finally:
+            door.stop()
+        with_budget, without = srv.calls
+        got = with_budget["deadline_ms"]
+        assert got is not None and 0 < got < 5000.0
+        assert got > 4000.0, \
+            f"parse deduction ate {5000 - got:.1f}ms on loopback"
+        assert without["deadline_ms"] is None
+
+    def test_tenant_quota_brownouts_the_tenant_only(self):
+        gate = threading.Event()
+        srv = FakeServer(gate=gate, gate_tenants={"acme"})
+        door = _door(srv, max_tenant_inflight=1)
+        try:
+            results = {}
+
+            def client(tag, tenant):
+                with WireClient("127.0.0.1", door.port,
+                                timeout_s=15) as c:
+                    results[tag] = c.infer({"x": [[1.0]]},
+                                           tenant=tenant)
+
+            t1 = threading.Thread(target=client, args=("held", "acme"))
+            t1.start()
+            _wait_until(lambda: door.tenants.inflight("acme") == 1,
+                        what="first acme request in flight")
+            before = _counter("serving_tenant_refused_total",
+                              reason="quota")
+            # same tenant: refused at its own bound...
+            client("refused", "acme")
+            assert results["refused"][0] == 429
+            assert "retry-after" in results["refused"][1]
+            _wait_until(
+                lambda: _counter("serving_tenant_refused_total",
+                                 reason="quota") == before + 1,
+                what="quota refusal counted")
+            # ...while another tenant flows
+            client("other", "zen")
+            assert results["other"][0] == 200
+            gate.set()
+            t1.join(10)
+            assert results["held"][0] == 200
+            _wait_until(lambda: door.tenants.total_inflight == 0,
+                        what="tenant slots released")
+        finally:
+            gate.set()
+            door.stop()
+
+    def test_disconnect_mid_wait_releases_the_rider(self):
+        gate = threading.Event()
+        srv = FakeServer(gate=gate)
+        door = _door(srv)
+        try:
+            before = _counter("serving_http_requests_total",
+                              outcome="disconnect")
+            c = WireClient("127.0.0.1", door.port)
+            body = b'{"feeds": {"x": [[1.0]]}}'
+            c.connect()
+            c._send(
+                (f"POST /v1/infer HTTP/1.1\r\nHost: x\r\n"
+                 f"X-Tenant: ghost\r\n"
+                 f"Content-Length: {len(body)}\r\n\r\n"
+                 ).encode(), body)
+            _wait_until(lambda: door.tenants.inflight("ghost") == 1,
+                        what="request in flight")
+            c.close()       # hang up while the result is pending
+            _wait_until(
+                lambda: door.tenants.inflight("ghost") == 0,
+                what="disconnect released the tenant slot")
+            _wait_until(
+                lambda: _counter("serving_http_requests_total",
+                                 outcome="disconnect") == before + 1,
+                what="disconnect outcome counted")
+            assert door.inflight == 0
+        finally:
+            gate.set()
+            door.stop()
+
+    def test_slow_loris_body_gets_typed_408(self):
+        door = _door(FakeServer(), socket_timeout_s=0.3)
+        try:
+            before = _counter("serving_http_requests_total",
+                              outcome="timeout")
+            body = b'{"feeds": {"x": [[1.0]]}}'
+            head = (f"POST /v1/infer HTTP/1.1\r\nHost: x\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n").encode()
+            # half the body, then silence: the socket timeout must
+            # answer typed, not pin the handler thread
+            raw = _raw_exchange(door.port,
+                               head + body[:len(body) // 2],
+                               timeout=5.0)
+            assert b" 408 " in raw.split(b"\r\n", 1)[0], raw[:200]
+            assert _counter("serving_http_requests_total",
+                            outcome="timeout") == before + 1
+        finally:
+            door.stop()
+
+    def test_header_bomb_gets_431(self):
+        door = _door(FakeServer())
+        try:
+            before = _counter("serving_http_requests_total",
+                              outcome="bad_request")
+            junk = "".join(f"X-Bomb-{i}: {'b' * 100}\r\n"
+                           for i in range(200)).encode()
+            raw = _raw_exchange(
+                door.port,
+                b"POST /v1/infer HTTP/1.1\r\nHost: x\r\n" + junk +
+                b"Content-Length: 2\r\n\r\n{}")
+            assert b" 431 " in raw.split(b"\r\n", 1)[0], raw[:200]
+            assert _counter("serving_http_requests_total",
+                            outcome="bad_request") == before + 1
+        finally:
+            door.stop()
+
+    def test_drain_flips_readiness_and_503s_new_requests(self):
+        srv = FakeServer()
+        door = _door(srv)
+        try:
+            draining_g = REGISTRY.get("serving_http_draining")
+            assert door.begin_drain() is True
+            assert door.begin_drain() is False
+            assert srv.drain_calls == 1     # server drain propagated
+            assert draining_g.value() == 1
+            with WireClient("127.0.0.1", door.port) as c:
+                st, hdrs, _ = c.get("/readyz")
+                assert st == 503 and "retry-after" in hdrs
+                st, hdrs, payload = c.infer({"x": [[1.0]]})
+                assert st == 503 and "retry-after" in hdrs
+                assert "draining" in payload["error"]
+                # liveness is NOT readiness: healthz stays 200
+                assert c.get("/healthz")[0] == 200
+        finally:
+            door.stop()
+
+    def test_drain_completes_inflight_and_closes(self):
+        gate = threading.Event()
+        srv = FakeServer(gate=gate)
+        door = _door(srv)
+        results = {}
+        try:
+            def held_client():
+                with WireClient("127.0.0.1", door.port,
+                                timeout_s=15) as c:
+                    results["held"] = c.infer({"x": [[1.0]]})
+
+            t = threading.Thread(target=held_client)
+            t.start()
+            _wait_until(lambda: door.inflight == 1,
+                        what="request in flight")
+            drained = {}
+            dt = threading.Thread(
+                target=lambda: drained.setdefault(
+                    "ok", door.drain(timeout_s=10)))
+            dt.start()
+            _wait_until(lambda: door.draining, what="drain begun")
+            gate.set()                  # let the in-flight finish
+            t.join(10)
+            dt.join(10)
+            assert results["held"][0] == 200    # in-flight completed
+            assert drained["ok"] is True        # inside the bound
+            assert srv.close_calls == 1         # server closed after
+            assert door.running is False        # listener stopped
+        finally:
+            gate.set()
+            if door.running:
+                door.stop()
+
+
+# ---------------------------------------------------------------------------
+def _freeze_tiny_model(dirname):
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import unique_name
+
+    pt.enable_static()
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup), unique_name.guard():
+        x = pt.static.data("x", [16], dtype="float32")
+        h = layers.fc(x, 32, act="relu")
+        out = layers.fc(h, 4)
+    scope = pt.static.Scope()
+    with pt.static.scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.io.save_inference_model(dirname, ["x"], [out], exe,
+                                   main_program=main)
+    return dirname
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    return _freeze_tiny_model(
+        str(tmp_path_factory.mktemp("frontdoor_model")))
+
+
+class TestFrontDoorRealServer:
+    def test_wire_exhausted_budget_504_without_enqueue(self,
+                                                       model_dir):
+        """Acceptance pin: a request whose X-Deadline-Ms budget is
+        already spent by wire/parse time is refused at admission
+        (504, outcome deadline) and the scheduler queue NEVER sees
+        it."""
+        from paddle_tpu.serving import InferenceServer, ServingConfig
+        with InferenceServer(model_dir, ServingConfig(
+                max_batch=2, max_wait_ms=1.0)) as srv:
+            enqueued = []
+            q = srv.scheduler._q
+            orig_put = q.put_nowait
+            q.put_nowait = lambda item: (enqueued.append(item),
+                                         orig_put(item))[1]
+            door = HttpFrontDoor(srv, FrontDoorConfig()).start()
+            try:
+                with WireClient("127.0.0.1", door.port) as c:
+                    # a zero budget arrives already exhausted however
+                    # fast the wire was — deterministic admission 504
+                    st, _, payload = c.infer(
+                        {"x": [[0.0] * 16]}, deadline_ms=0)
+                    assert st == 504
+                    assert "admission" in payload["error"]
+                    assert enqueued == [], \
+                        "an expired request reached the queue"
+                    # sanity: the same request WITH budget works
+                    st, _, payload = c.infer(
+                        {"x": [[0.0] * 16]}, deadline_ms=10000)
+                    assert st == 200
+                    assert len(enqueued) == 1
+            finally:
+                q.put_nowait = orig_put
+                door.stop()
+
+    def test_front_door_off_is_bitwise_legacy(self, model_dir):
+        """Acceptance pin: without a front door, the in-process path
+        touches NOTHING of the HTTP layer — no serving_http_* /
+        serving_tenant_* movement, tenant admission never consulted,
+        submit signature defaults identical to PR-12."""
+        from paddle_tpu.serving import InferenceServer, ServingConfig
+        http_names = [
+            "serving_http_requests_total", "serving_http_inflight",
+            "serving_tenant_requests_total",
+            "serving_tenant_refused_total",
+        ]
+
+        def snap():
+            # the text render is the ground truth: every label series
+            # of every front-door metric, bit-for-bit
+            from paddle_tpu.monitor.exporter import render_text
+            return [ln for ln in render_text(REGISTRY).splitlines()
+                    if any(ln.startswith(n) for n in http_names)
+                    and not ln.startswith("#")]
+
+        before = snap()
+        consulted = []
+        orig_admit = TenantFairShare.admit
+        TenantFairShare.admit = lambda self, tenant: (
+            consulted.append(tenant), orig_admit(self, tenant))[1]
+        try:
+            with InferenceServer(model_dir, ServingConfig(
+                    max_batch=2, max_wait_ms=1.0)) as srv:
+                out = srv.infer({"x": np.zeros((1, 16), np.float32)},
+                                timeout=30)
+                assert out[0].shape == (1, 4)
+        finally:
+            TenantFairShare.admit = orig_admit
+        assert snap() == before, \
+            "in-process serving moved front-door metrics"
+        assert consulted == [], \
+            "in-process serving consulted tenant admission"
+
+
+# ---------------------------------------------------------------------------
+class TestMetricsServerTimeout:
+    def test_stalled_scrape_cannot_pin_a_handler_forever(self):
+        """The shared-base satellite: a client that connects and goes
+        silent is closed within the socket timeout, and real scrapes
+        keep working throughout."""
+        from paddle_tpu.monitor.exporter import MetricsServer
+        from paddle_tpu.monitor.registry import Registry, counter
+
+        r = Registry()
+        counter("stall_probe_total", "probe", registry=r).inc()
+        with MetricsServer(port=0, registry=r,
+                           socket_timeout_s=0.3) as ms:
+            # the staller: half a request line, then silence
+            s = socket.create_connection(("127.0.0.1", ms.port),
+                                         timeout=5)
+            s.sendall(b"GET /metr")
+            # a healthy scrape is unaffected while the staller hangs
+            import urllib.request
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{ms.port}/metrics",
+                timeout=5).read().decode()
+            assert "stall_probe_total 1" in body
+            # the server must hang up on the staller within the bound
+            s.settimeout(5)
+            t0 = time.monotonic()
+            assert s.recv(1) == b""     # EOF = handler closed it
+            assert time.monotonic() - t0 < 4.0
+            s.close()

@@ -1,0 +1,106 @@
+"""Slow e2e: the HTTP front door under connection-level chaos
+(tests/serving_http_worker.py, docs/SERVING.md "Front door").
+
+Acceptance run (ISSUE 20): under open-loop wire load with each of the
+three injected connection faults — slow-loris, disconnect-mid-response
+and header-bomb — every request terminates with a typed HTTP status
+or a typed client-side WireReset (per-request accounting, zero
+hangs), and a mid-load ``begin_drain`` completes everything in flight
+while refusing the rest with 503 + Retry-After, with ``drain()``
+converging inside its bound. The faults patch the CLIENT send seam,
+so the server under test runs exactly the shipped code.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "serving_http_worker.py")
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+class TestHttpChaosEndToEnd:
+    def _run_worker(self, tmp_path, tag, extra_env):
+        out = tmp_path / f"{tag}.json"
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "PADDLE_TRAINER_ID": "0",
+        })
+        env.update(extra_env)
+        r = subprocess.run(
+            [sys.executable, WORKER, str(tmp_path / f"model_{tag}"),
+             str(out)],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=REPO)
+        assert r.returncode == 0, \
+            f"[{tag}] rc={r.returncode}\n{r.stderr[-3000:]}"
+        with open(out) as f:
+            return json.load(f), r.stderr
+
+    def _assert_fully_accounted(self, res):
+        assert res["unaccounted"] == 0, res
+        assert res["hangs"] == 0, res
+        assert res["untyped_statuses"] == 0, res
+
+    def test_clean_wire_load_all_ok(self, tmp_path):
+        res, _ = self._run_worker(tmp_path, "clean", {})
+        self._assert_fully_accounted(res)
+        assert res["faults_installed"] is False
+        assert res["ok"] == res["total"], res
+        assert res["wire_resets"] == 0, res
+
+    def test_slow_loris_chaos(self, tmp_path):
+        res, err = self._run_worker(tmp_path, "slowloris", {
+            "PT_FAULT_HTTP_SLOWLORIS_EVERY": "13",
+        })
+        self._assert_fully_accounted(res)
+        assert res["faults_installed"] is True
+        assert "injected slow-loris" in err
+        # every wedged connection was cut by the socket timeout and
+        # answered with the typed 408 — never a pinned handler
+        assert res["statuses"].get("408", 0) >= 1, res
+        assert res["ok"] >= 1, res
+        assert res["server_outcomes"].get("timeout", 0) >= 1, res
+
+    def test_disconnect_chaos(self, tmp_path):
+        res, err = self._run_worker(tmp_path, "disconnect", {
+            "PT_FAULT_HTTP_DISCONNECT_EVERY": "11",
+        })
+        self._assert_fully_accounted(res)
+        assert "injected client disconnect" in err
+        # the injected hangups surface client-side as typed WireReset
+        assert res["wire_resets"] >= 1, res
+        assert res["ok"] >= 1, res
+
+    def test_header_bomb_chaos(self, tmp_path):
+        res, err = self._run_worker(tmp_path, "bomb", {
+            "PT_FAULT_HTTP_HEADER_BOMB_EVERY": "17",
+        })
+        self._assert_fully_accounted(res)
+        assert "injected header bomb" in err
+        # stdlib's header cap answers 431, which the door counts as
+        # bad_request — the bomb never reaches parsing or admission
+        assert res["statuses"].get("431", 0) >= 1, res
+        assert res["ok"] >= 1, res
+        assert res["server_outcomes"].get("bad_request", 0) >= 1, res
+
+    def test_mid_load_drain(self, tmp_path):
+        res, _ = self._run_worker(tmp_path, "drain", {
+            "HTTP_E2E_DRAIN": "1",
+        })
+        self._assert_fully_accounted(res)
+        # everything in flight at the flip completed; everything after
+        # was refused with the retryable 503
+        assert res["drained"] is True, res
+        assert res["drain_refused"] >= 1, res
+        assert res["ok"] >= 1, res
+        assert res["server_outcomes"].get("draining", 0) >= 1, res

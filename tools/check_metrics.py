@@ -45,6 +45,14 @@ _OUTCOME_LABEL_RE = re.compile(
     r"labels\s*=\s*[\(\[][^)\]]*[\"']outcome[\"']")
 _OUTCOME_VALUE_RE = re.compile(
     r"outcome\s*=\s*[\"']([A-Za-z0-9_]+)[\"']")
+# a ``reason`` label declared on a registration, and the
+# ``reason="value"`` keyword uses in the SAME file that define its
+# vocabulary (the outcome convention: inc sites live with the
+# registration; the lookbehind keeps ``keep_reason=`` and friends out)
+_REASON_LABEL_RE = re.compile(
+    r"labels\s*=\s*[\(\[][^)\]]*[\"']reason[\"']")
+_REASON_VALUE_RE = re.compile(
+    r"(?<![A-Za-z0-9_])reason\s*=\s*[\"']([A-Za-z0-9_]+)[\"']")
 # the goodput ledger's ``phase`` label: unlike outcome counters,
 # attribution sites are deliberately spread across the tree (executor,
 # checkpoint, ps, launcher), so its vocabulary is every
@@ -131,6 +139,35 @@ def outcome_vocabularies(repo=REPO):
                 continue
             if file_union is None:
                 file_union = set(_OUTCOME_VALUE_RE.findall(src))
+            out.setdefault(name, set()).update(file_union)
+    return out
+
+
+def reason_vocabularies(repo=REPO):
+    """{metric name: set of ``reason`` label values} for every
+    counter registered with a ``reason`` label — same per-file-union
+    contract as :func:`outcome_vocabularies` (and the same caveat:
+    two reason counters in one file over-demand each other's values,
+    so modules whose reason vocabularies differ must stay separate —
+    the shed counter lives in resilience.py, the tenant-refusal
+    counter in frontdoor.py, deliberately)."""
+    out = {}
+    for path in _code_files(repo):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        file_union = None
+        regs = list(_REG_RE.finditer(src))
+        for k, m in enumerate(regs):
+            kind, name = m.group(1), m.group(2)
+            end = regs[k + 1].start() if k + 1 < len(regs) else len(src)
+            if kind != "counter" or \
+                    not _REASON_LABEL_RE.search(src[m.start():end]):
+                continue
+            if file_union is None:
+                file_union = set(_REASON_VALUE_RE.findall(src))
             out.setdefault(name, set()).update(file_union)
     return out
 
@@ -249,6 +286,11 @@ def main():
         for name, vocab in phase_vocabularies().items()
         for v in sorted(vocab)
         if f"`{v}`" not in rows.get(name, ""))
+    missing_reason = sorted(
+        (name, v)
+        for name, vocab in reason_vocabularies().items()
+        for v in sorted(vocab)
+        if f"`{v}`" not in rows.get(name, ""))
     bad_units = unit_suffix_violations()
     if undocumented:
         print(f"metrics registered in code but missing from "
@@ -277,6 +319,11 @@ def main():
               f"docs/OBSERVABILITY.md catalogue row does not document "
               f"`{v}` — the row must enumerate the ledger's full "
               f"phase vocabulary")
+    for name, v in missing_reason:
+        print(f"reason-labeled counter {name!r} uses "
+              f"reason=\"{v}\" but its docs/OBSERVABILITY.md "
+              f"catalogue row does not document `{v}` — the row must "
+              f"carry the full label vocabulary")
     for name, suffix, path in bad_units:
         print(f"metric {name!r} ({path}) promises unit "
               f"'{suffix}' in its name but its registration help "
@@ -285,7 +332,7 @@ def main():
               f"discipline: the help must spell the unit")
     if undocumented or stale or conflicted or mismatched \
             or bad_exemplars or missing_vocab or missing_phase \
-            or bad_units:
+            or missing_reason or bad_units:
         return 1
     print(f"metrics catalogue in sync ({len(code)} metrics, "
           f"kinds verified)")
